@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""Benchmark driver: TPC-H Q6 (BASELINE.md ladder #1) on the device path vs a
+single-process pandas CPU baseline (the Spark-CPU stand-in).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": speedup_x, "unit": "x", "vs_baseline": ...}
+
+vs_baseline scales against the reference's "4x typical" end-to-end speedup
+claim (docs/FAQ.md:100-106): vs_baseline = speedup / 4.0.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    sf = float(os.environ.get("BENCH_SF", "0.5"))
+    rows = int(6_000_000 * sf)
+    import jax
+    from spark_rapids_tpu.session import TpuSession
+    from spark_rapids_tpu.tools import tpch
+
+    backend = jax.default_backend()
+    lineitem = tpch.gen_lineitem(sf, seed=0, rows=rows)
+
+    sess = TpuSession({
+        "spark.rapids.tpu.batchRowsMinBucket": 1 << 20,
+    })
+    df = sess.create_dataframe(lineitem, num_partitions=1).cache()
+    q = tpch.q6(df)
+
+    # warm-up (XLA compile) then timed best-of-3
+    q.collect(device=True)
+    device_times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = q.collect(device=True)
+        device_times.append(time.perf_counter() - t0)
+    device_t = min(device_times)
+    got = out.column("revenue")[0].as_py()
+
+    # pandas baseline (vectorized CPU)
+    import pyarrow as pa
+    pdf = lineitem.to_pandas()
+    sd_all = np.asarray(lineitem.column("l_shipdate").combine_chunks().cast(pa.int32()))
+
+    def pandas_q6():
+        m = ((sd_all >= 8766) & (sd_all < 9131)
+             & (pdf["l_discount"] >= 0.05) & (pdf["l_discount"] <= 0.07)
+             & (pdf["l_quantity"] < 24.0))
+        return (pdf["l_extendedprice"][m] * pdf["l_discount"][m]).sum()
+
+    expected = pandas_q6()
+    cpu_times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        pandas_q6()
+        cpu_times.append(time.perf_counter() - t0)
+    cpu_t = min(cpu_times)
+
+    rel_err = abs(got - expected) / max(abs(expected), 1e-9)
+    speedup = cpu_t / device_t
+    result = {
+        "metric": f"tpch_q6_rows{rows}_speedup_vs_pandas",
+        "value": round(speedup, 4),
+        "unit": "x",
+        "vs_baseline": round(speedup / 4.0, 4),
+    }
+    print(json.dumps(result))
+    print(f"# backend={backend} device_t={device_t:.4f}s cpu_t={cpu_t:.4f}s "
+          f"rel_err={rel_err:.2e} device_times={['%.4f' % t for t in device_times]}",
+          file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

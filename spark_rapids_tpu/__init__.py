@@ -1,0 +1,26 @@
+"""spark-rapids-tpu: a TPU-native columnar SQL acceleration framework.
+
+A ground-up re-design of the RAPIDS Accelerator for Apache Spark
+(reference: hyperbolic2346/spark-rapids) targeting TPU via JAX/XLA:
+
+- columnar device batches are JAX pytrees with bucketed static shapes
+  (``spark_rapids_tpu.columnar``)
+- a Catalyst-style plan framework tags and lowers logical plans onto device
+  operators with per-op fallback reasons (``spark_rapids_tpu.plan``)
+- device operators execute as fused, jitted XLA computations
+  (``spark_rapids_tpu.exec``)
+- exchanges ride device-mesh collectives (``spark_rapids_tpu.shuffle``,
+  ``spark_rapids_tpu.parallel``)
+"""
+
+__version__ = "0.1.0"
+
+import jax as _jax
+
+# SQL semantics require 64-bit longs/doubles/timestamps; JAX defaults to 32.
+_jax.config.update("jax_enable_x64", True)
+
+from .conf import RapidsConf  # noqa: F401
+from .columnar import (  # noqa: F401
+    HostTable, DeviceTable, TypeSig,
+)

@@ -1,0 +1,10 @@
+from . import dtypes
+from .dtypes import (  # noqa: F401
+    DataType, TypeSig, BOOLEAN, BYTE, SHORT, INT, LONG, FLOAT, DOUBLE,
+    STRING, BINARY, DATE, TIMESTAMP, NULL, DecimalType, ArrayType,
+    StructType, StructField, MapType,
+)
+from .host import HostColumn, HostTable  # noqa: F401
+from .device import (  # noqa: F401
+    DeviceColumn, DeviceTable, bucket_rows, bucket_width, concat_device_tables,
+)

@@ -1,0 +1,261 @@
+"""Device-side columnar batches as JAX pytrees — the ``GpuColumnVector`` /
+``cudf.Table`` replacement (reference: sql-plugin/src/main/java/.../GpuColumnVector.java).
+
+TPU-first design decisions (this is where we deliberately diverge from cuDF):
+
+1. **Static shapes via bucketing.** XLA compiles per shape. Every device batch
+   has a row *capacity* that is a power-of-two multiple of a minimum bucket, so
+   a pipeline sees a small bounded set of shapes regardless of actual row
+   counts. cuDF's dynamically-sized columns have no analogue here.
+
+2. **Selection masks instead of compaction.** A filter does not gather
+   survivors into a smaller buffer (dynamic output size!); it ANDs a per-table
+   ``row_mask``. Downstream kernels treat masked-off rows as nonexistent.
+   Physical compaction (a stable argsort of the mask + gather) happens only at
+   operator boundaries that need dense data: sort, join build, shuffle slice,
+   and host download. This is vectorized-engine "late materialization" mapped
+   onto XLA's static-shape world.
+
+3. **Validity as bool vectors** (not bitmasks): the VPU operates on 8x128
+   lanes; bool vectors fuse into elementwise ops for free.
+
+4. **Strings as fixed-width padded uint8 matrices** (capacity, width) +
+   int32 lengths, width bucketed per batch. Wasteful for long tails but keeps
+   every string op a dense 2-D vector op that XLA can fuse and tile.
+
+The pytree registration makes DeviceTable a first-class jit/shard_map citizen:
+whole operator pipelines take and return DeviceTables inside one jit.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtypes as dt
+from .host import HostColumn, HostTable
+
+__all__ = ["DeviceColumn", "DeviceTable", "bucket_rows", "bucket_width"]
+
+
+def bucket_rows(n: int, min_bucket: int = 1024) -> int:
+    """Round row count up to a power-of-two multiple of ``min_bucket``."""
+    cap = min_bucket
+    while cap < n:
+        cap *= 2
+    return cap
+
+
+def bucket_width(w: int, min_width: int = 8, max_width: int = 4096) -> int:
+    cap = min_width
+    while cap < w:
+        cap *= 2
+    return min(cap, max(max_width, w))
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class DeviceColumn:
+    """One device column: padded values + validity (+ lengths for strings)."""
+    data: jax.Array                   # (capacity,) or (capacity, width) uint8
+    validity: jax.Array               # (capacity,) bool — True = non-null
+    dtype: dt.DataType                # static
+    lengths: Optional[jax.Array] = None  # (capacity,) int32 for string/binary
+
+    # -- pytree protocol ------------------------------------------------------
+    def tree_flatten(self):
+        if self.lengths is None:
+            return (self.data, self.validity), (self.dtype, False)
+        return (self.data, self.validity, self.lengths), (self.dtype, True)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        dtype, has_len = aux
+        if has_len:
+            data, validity, lengths = children
+            return cls(data, validity, dtype, lengths)
+        data, validity = children
+        return cls(data, validity, dtype, None)
+
+    @property
+    def capacity(self) -> int:
+        return self.data.shape[0]
+
+    @property
+    def is_string_like(self) -> bool:
+        return isinstance(self.dtype, (dt.StringType, dt.BinaryType))
+
+    def gather(self, idx: jax.Array) -> "DeviceColumn":
+        lengths = None if self.lengths is None else jnp.take(self.lengths, idx, axis=0)
+        return DeviceColumn(jnp.take(self.data, idx, axis=0),
+                            jnp.take(self.validity, idx, axis=0),
+                            self.dtype, lengths)
+
+    def with_validity(self, validity: jax.Array) -> "DeviceColumn":
+        return DeviceColumn(self.data, validity, self.dtype, self.lengths)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class DeviceTable:
+    """A batch of device columns + row mask (active rows) + row count."""
+    columns: Tuple[DeviceColumn, ...]
+    row_mask: jax.Array              # (capacity,) bool — True = row exists
+    num_rows: jax.Array              # scalar int32 (traced) == sum(row_mask)
+    names: Tuple[str, ...]           # static
+
+    def tree_flatten(self):
+        return (self.columns, self.row_mask, self.num_rows), self.names
+
+    @classmethod
+    def tree_unflatten(cls, names, children):
+        columns, row_mask, num_rows = children
+        return cls(tuple(columns), row_mask, num_rows, names)
+
+    # -- shape info -----------------------------------------------------------
+    @property
+    def capacity(self) -> int:
+        return self.row_mask.shape[0]
+
+    @property
+    def num_columns(self) -> int:
+        return len(self.columns)
+
+    def column(self, name: str) -> DeviceColumn:
+        return self.columns[self.names.index(name)]
+
+    def schema(self) -> Dict[str, dt.DataType]:
+        return {n: c.dtype for n, c in zip(self.names, self.columns)}
+
+    def with_columns(self, names: Sequence[str], columns: Sequence[DeviceColumn]
+                     ) -> "DeviceTable":
+        return DeviceTable(tuple(columns), self.row_mask, self.num_rows, tuple(names))
+
+    def filter_mask(self, keep: jax.Array) -> "DeviceTable":
+        """AND a predicate into the row mask (no data movement)."""
+        mask = jnp.logical_and(self.row_mask, keep)
+        return DeviceTable(self.columns, mask, jnp.sum(mask, dtype=jnp.int32),
+                           self.names)
+
+    # -- compaction -----------------------------------------------------------
+    def compact(self) -> "DeviceTable":
+        """Move active rows to the front (stable). Same capacity.
+
+        After this, ``row_mask == iota < num_rows`` so dense kernels (sort,
+        join, contiguous slicing for shuffle) can assume a prefix layout.
+        """
+        order = jnp.argsort(jnp.logical_not(self.row_mask), stable=True)
+        cols = tuple(c.gather(order) for c in self.columns)
+        iota = jnp.arange(self.capacity, dtype=jnp.int32)
+        mask = iota < self.num_rows
+        # masked-off tail keeps stale data; null it for hygiene
+        cols = tuple(c.with_validity(jnp.logical_and(c.validity, mask)) for c in cols)
+        return DeviceTable(cols, mask, self.num_rows, self.names)
+
+    def nbytes(self) -> int:
+        total = int(self.row_mask.nbytes) + 4
+        for c in self.columns:
+            total += int(c.data.nbytes) + int(c.validity.nbytes)
+            if c.lengths is not None:
+                total += int(c.lengths.nbytes)
+        return total
+
+    # -- host <-> device ------------------------------------------------------
+    @staticmethod
+    def from_host(table: HostTable, min_bucket: int = 1024,
+                  capacity: Optional[int] = None) -> "DeviceTable":
+        n = table.num_rows
+        cap = capacity if capacity is not None else bucket_rows(max(n, 1), min_bucket)
+        assert cap >= n, (cap, n)
+        cols = []
+        for hc in table.columns:
+            cols.append(_upload_column(hc, cap))
+        iota = np.arange(cap, dtype=np.int32)
+        row_mask = jnp.asarray(iota < n)
+        return DeviceTable(tuple(cols), row_mask,
+                           jnp.asarray(n, dtype=jnp.int32), tuple(table.names))
+
+    def to_host(self) -> HostTable:
+        """Download and compact to exactly num_rows host rows."""
+        mask = np.asarray(self.row_mask)
+        n = int(np.asarray(self.num_rows))
+        # row_mask may be non-prefix (post-filter); boolean-index on host
+        cols: List[HostColumn] = []
+        for c in self.columns:
+            validity = np.asarray(c.validity)[mask][:n]
+            if c.is_string_like:
+                data = np.asarray(c.data)[mask][:n]
+                lengths = np.asarray(c.lengths)[mask][:n]
+                out = np.empty(n, dtype=object)
+                for i in range(n):
+                    raw = bytes(data[i, :lengths[i]].tobytes())
+                    out[i] = raw.decode("utf-8", errors="replace") \
+                        if isinstance(c.dtype, dt.StringType) else raw
+                cols.append(HostColumn(c.dtype, out,
+                                       None if validity.all() else validity))
+            else:
+                vals = np.asarray(c.data)[mask][:n]
+                if isinstance(c.dtype, dt.BooleanType):
+                    vals = vals.astype(np.bool_)
+                cols.append(HostColumn(c.dtype, vals,
+                                       None if validity.all() else validity))
+        return HostTable(list(self.names), cols)
+
+
+def _upload_column(hc: HostColumn, capacity: int) -> DeviceColumn:
+    n = len(hc)
+    validity = np.zeros(capacity, dtype=np.bool_)
+    validity[:n] = hc.valid_mask()
+    if isinstance(hc.dtype, (dt.StringType, dt.BinaryType)):
+        encoded = [v.encode("utf-8") if isinstance(v, str) else bytes(v)
+                   for v in hc.values]
+        max_len = max((len(b) for b in encoded), default=0)
+        width = bucket_width(max(max_len, 1))
+        mat = np.zeros((capacity, width), dtype=np.uint8)
+        lengths = np.zeros(capacity, dtype=np.int32)
+        for i, b in enumerate(encoded):
+            if b:
+                mat[i, :len(b)] = np.frombuffer(b, dtype=np.uint8)
+            lengths[i] = len(b)
+        return DeviceColumn(jnp.asarray(mat), jnp.asarray(validity), hc.dtype,
+                            jnp.asarray(lengths))
+    np_dt = hc.dtype.np_dtype()
+    vals = np.zeros(capacity, dtype=np_dt)
+    vals[:n] = hc.values.astype(np_dt, copy=False)
+    return DeviceColumn(jnp.asarray(vals), jnp.asarray(validity), hc.dtype, None)
+
+
+def concat_device_tables(tables: Sequence[DeviceTable], min_bucket: int = 1024
+                         ) -> DeviceTable:
+    """Device-side concatenation (reference: GpuCoalesceBatches concat).
+
+    Compacts each input then concatenates into a bucketed output capacity.
+    """
+    assert tables, "cannot concat zero device tables"
+    if len(tables) == 1:
+        return tables[0]
+    first = tables[0]
+    total_cap = sum(t.capacity for t in tables)
+    compacted = [t.compact() for t in tables]
+    out_cols: List[DeviceColumn] = []
+    for ci in range(first.num_columns):
+        parts = [t.columns[ci] for t in compacted]
+        if parts[0].is_string_like:
+            width = max(p.data.shape[1] for p in parts)
+            datas = [jnp.pad(p.data, ((0, 0), (0, width - p.data.shape[1])))
+                     for p in parts]
+            data = jnp.concatenate(datas, axis=0)
+            lengths = jnp.concatenate([p.lengths for p in parts])
+        else:
+            data = jnp.concatenate([p.data for p in parts])
+            lengths = None
+        validity = jnp.concatenate([p.validity for p in parts])
+        out_cols.append(DeviceColumn(data, validity, parts[0].dtype, lengths))
+    row_mask = jnp.concatenate([t.row_mask for t in compacted])
+    num_rows = sum((t.num_rows for t in tables), jnp.asarray(0, jnp.int32))
+    out = DeviceTable(tuple(out_cols), row_mask, num_rows, first.names)
+    del total_cap
+    return out.compact()

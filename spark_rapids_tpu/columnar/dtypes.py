@@ -1,0 +1,415 @@
+"""Data type system and the TypeSig capability algebra.
+
+TPU-native re-design of the reference's type system:
+  - Spark SQL data types        -> ``DataType`` singletons here
+  - ``TypeSig`` set algebra     -> reference ``sql-plugin/.../TypeChecks.scala:166``
+    (supported type sets +/- with notes, used by every operator rule to declare
+    what it can run on device, producing tag-time fallback reasons)
+
+Device mapping notes (TPU/XLA, static shapes):
+  - integers map to int8/16/32/64 jnp dtypes
+  - BOOLEAN is stored as int8 on device wrapped validity-style bool masks
+  - STRING is stored as a fixed-width padded uint8 matrix + int32 lengths
+  - DATE is days-since-epoch int32; TIMESTAMP is micros-since-epoch int64
+  - DECIMAL(p<=18) is scaled int64 (decimal128 deferred)
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, Optional
+
+import numpy as np
+
+__all__ = [
+    "DataType", "IntegralType", "FractionalType",
+    "BooleanType", "ByteType", "ShortType", "IntegerType", "LongType",
+    "FloatType", "DoubleType", "StringType", "BinaryType", "DateType",
+    "TimestampType", "NullType", "DecimalType", "ArrayType", "StructType",
+    "StructField", "MapType",
+    "BOOLEAN", "BYTE", "SHORT", "INT", "LONG", "FLOAT", "DOUBLE", "STRING",
+    "BINARY", "DATE", "TIMESTAMP", "NULL",
+    "TypeSig", "TypeEnum",
+]
+
+
+class DataType:
+    """Base class for SQL-level data types (reference: Spark's DataType)."""
+
+    #: short name used in TypeSig docs / explain output
+    simple_name: str = "?"
+
+    def __eq__(self, other):
+        return type(self) is type(other)
+
+    def __hash__(self):
+        return hash(type(self))
+
+    def __repr__(self):
+        return self.simple_name
+
+    @property
+    def is_numeric(self) -> bool:
+        return isinstance(self, (IntegralType, FractionalType, DecimalType))
+
+    @property
+    def is_nested(self) -> bool:
+        return isinstance(self, (ArrayType, StructType, MapType))
+
+    # -- device representation ------------------------------------------------
+    def jnp_dtype(self):
+        """The jax.numpy dtype used for the device value buffer."""
+        raise NotImplementedError(self.simple_name)
+
+    def np_dtype(self):
+        return np.dtype(self.jnp_dtype())
+
+
+class IntegralType(DataType):
+    pass
+
+
+class FractionalType(DataType):
+    pass
+
+
+class BooleanType(DataType):
+    simple_name = "boolean"
+
+    def jnp_dtype(self):
+        return np.bool_
+
+
+class ByteType(IntegralType):
+    simple_name = "tinyint"
+
+    def jnp_dtype(self):
+        return np.int8
+
+
+class ShortType(IntegralType):
+    simple_name = "smallint"
+
+    def jnp_dtype(self):
+        return np.int16
+
+
+class IntegerType(IntegralType):
+    simple_name = "int"
+
+    def jnp_dtype(self):
+        return np.int32
+
+
+class LongType(IntegralType):
+    simple_name = "bigint"
+
+    def jnp_dtype(self):
+        return np.int64
+
+
+class FloatType(FractionalType):
+    simple_name = "float"
+
+    def jnp_dtype(self):
+        return np.float32
+
+
+class DoubleType(FractionalType):
+    simple_name = "double"
+
+    def jnp_dtype(self):
+        return np.float64
+
+
+class StringType(DataType):
+    simple_name = "string"
+
+    def jnp_dtype(self):
+        # fixed-width padded bytes; second axis is the width bucket
+        return np.uint8
+
+
+class BinaryType(DataType):
+    simple_name = "binary"
+
+    def jnp_dtype(self):
+        return np.uint8
+
+
+class DateType(DataType):
+    """Days since unix epoch (int32), like Arrow date32."""
+    simple_name = "date"
+
+    def jnp_dtype(self):
+        return np.int32
+
+
+class TimestampType(DataType):
+    """Microseconds since unix epoch (int64), like Spark/Arrow timestamp[us]."""
+    simple_name = "timestamp"
+
+    def jnp_dtype(self):
+        return np.int64
+
+
+class NullType(DataType):
+    simple_name = "null"
+
+    def jnp_dtype(self):
+        return np.int8
+
+
+@dataclasses.dataclass(frozen=True, eq=True)
+class DecimalType(DataType):
+    """Decimal with precision<=18 backed by scaled int64 on device.
+
+    The reference supports decimal128 via cudf; we gate at 18 digits for now
+    (reference gates similarly via ``DecimalUtil``/TypeSig.DECIMAL_64).
+    """
+    precision: int = 10
+    scale: int = 0
+
+    MAX_INT64_PRECISION = 18
+
+    def __post_init__(self):
+        if not (1 <= self.precision <= 38):
+            raise ValueError(f"bad decimal precision {self.precision}")
+        if not (0 <= self.scale <= self.precision):
+            raise ValueError(f"bad decimal scale {self.scale}")
+
+    @property
+    def simple_name(self):  # type: ignore[override]
+        return f"decimal({self.precision},{self.scale})"
+
+    def jnp_dtype(self):
+        return np.int64
+
+    def __repr__(self):
+        return self.simple_name
+
+
+@dataclasses.dataclass(frozen=True, eq=True)
+class StructField:
+    name: str
+    data_type: DataType
+    nullable: bool = True
+
+
+@dataclasses.dataclass(frozen=True, eq=True)
+class ArrayType(DataType):
+    element_type: DataType = None  # type: ignore[assignment]
+    contains_null: bool = True
+
+    @property
+    def simple_name(self):  # type: ignore[override]
+        return f"array<{self.element_type!r}>"
+
+    def __repr__(self):
+        return self.simple_name
+
+
+@dataclasses.dataclass(frozen=True, eq=True)
+class StructType(DataType):
+    fields: tuple = ()
+
+    @property
+    def simple_name(self):  # type: ignore[override]
+        inner = ",".join(f"{f.name}:{f.data_type!r}" for f in self.fields)
+        return f"struct<{inner}>"
+
+    def field_names(self):
+        return [f.name for f in self.fields]
+
+    def __repr__(self):
+        return self.simple_name
+
+
+@dataclasses.dataclass(frozen=True, eq=True)
+class MapType(DataType):
+    key_type: DataType = None  # type: ignore[assignment]
+    value_type: DataType = None  # type: ignore[assignment]
+    value_contains_null: bool = True
+
+    @property
+    def simple_name(self):  # type: ignore[override]
+        return f"map<{self.key_type!r},{self.value_type!r}>"
+
+    def __repr__(self):
+        return self.simple_name
+
+
+# Singletons
+BOOLEAN = BooleanType()
+BYTE = ByteType()
+SHORT = ShortType()
+INT = IntegerType()
+LONG = LongType()
+FLOAT = FloatType()
+DOUBLE = DoubleType()
+STRING = StringType()
+BINARY = BinaryType()
+DATE = DateType()
+TIMESTAMP = TimestampType()
+NULL = NullType()
+
+
+class TypeEnum:
+    """Canonical names for TypeSig membership (reference TypeEnum in TypeChecks.scala)."""
+    BOOLEAN = "BOOLEAN"
+    BYTE = "BYTE"
+    SHORT = "SHORT"
+    INT = "INT"
+    LONG = "LONG"
+    FLOAT = "FLOAT"
+    DOUBLE = "DOUBLE"
+    STRING = "STRING"
+    BINARY = "BINARY"
+    DATE = "DATE"
+    TIMESTAMP = "TIMESTAMP"
+    NULL = "NULL"
+    DECIMAL = "DECIMAL"
+    ARRAY = "ARRAY"
+    STRUCT = "STRUCT"
+    MAP = "MAP"
+
+    ALL = (BOOLEAN, BYTE, SHORT, INT, LONG, FLOAT, DOUBLE, STRING, BINARY,
+           DATE, TIMESTAMP, NULL, DECIMAL, ARRAY, STRUCT, MAP)
+
+
+def _enum_of(dt: DataType) -> str:
+    if isinstance(dt, BooleanType):
+        return TypeEnum.BOOLEAN
+    if isinstance(dt, ByteType):
+        return TypeEnum.BYTE
+    if isinstance(dt, ShortType):
+        return TypeEnum.SHORT
+    if isinstance(dt, IntegerType):
+        return TypeEnum.INT
+    if isinstance(dt, LongType):
+        return TypeEnum.LONG
+    if isinstance(dt, FloatType):
+        return TypeEnum.FLOAT
+    if isinstance(dt, DoubleType):
+        return TypeEnum.DOUBLE
+    if isinstance(dt, StringType):
+        return TypeEnum.STRING
+    if isinstance(dt, BinaryType):
+        return TypeEnum.BINARY
+    if isinstance(dt, DateType):
+        return TypeEnum.DATE
+    if isinstance(dt, TimestampType):
+        return TypeEnum.TIMESTAMP
+    if isinstance(dt, NullType):
+        return TypeEnum.NULL
+    if isinstance(dt, DecimalType):
+        return TypeEnum.DECIMAL
+    if isinstance(dt, ArrayType):
+        return TypeEnum.ARRAY
+    if isinstance(dt, StructType):
+        return TypeEnum.STRUCT
+    if isinstance(dt, MapType):
+        return TypeEnum.MAP
+    raise TypeError(f"unknown data type {dt!r}")
+
+
+class TypeSig:
+    """Immutable set of supported types with per-type notes.
+
+    Mirrors the algebra of the reference's ``TypeSig`` (TypeChecks.scala:166):
+    ``+`` union, ``-`` removal, ``withPsNote`` partial-support annotations, and
+    ``is_supported``/``reasons_not_supported`` used at tag time.
+    """
+
+    __slots__ = ("_types", "_notes", "_max_decimal_precision", "_child_sig")
+
+    def __init__(self, types: Iterable[str] = (), notes: Optional[dict] = None,
+                 max_decimal_precision: int = DecimalType.MAX_INT64_PRECISION,
+                 child_sig: "Optional[TypeSig]" = None):
+        self._types = frozenset(types)
+        self._notes = dict(notes or {})
+        self._max_decimal_precision = max_decimal_precision
+        # signature allowed for nested children (arrays/structs/maps)
+        self._child_sig = child_sig
+
+    # -- constructors ---------------------------------------------------------
+    @staticmethod
+    def none() -> "TypeSig":
+        return TypeSig(())
+
+    @staticmethod
+    def of(*enums: str) -> "TypeSig":
+        return TypeSig(enums)
+
+    # -- algebra --------------------------------------------------------------
+    def __add__(self, other: "TypeSig") -> "TypeSig":
+        notes = dict(self._notes)
+        notes.update(other._notes)
+        return TypeSig(self._types | other._types, notes,
+                       max(self._max_decimal_precision, other._max_decimal_precision),
+                       self._child_sig or other._child_sig)
+
+    def __sub__(self, other: "TypeSig") -> "TypeSig":
+        notes = {k: v for k, v in self._notes.items() if k not in other._types}
+        return TypeSig(self._types - other._types, notes,
+                       self._max_decimal_precision, self._child_sig)
+
+    def with_ps_note(self, type_enum: str, note: str) -> "TypeSig":
+        notes = dict(self._notes)
+        notes[type_enum] = note
+        return TypeSig(self._types | {type_enum}, notes,
+                       self._max_decimal_precision, self._child_sig)
+
+    def nested(self, child_sig: "Optional[TypeSig]" = None) -> "TypeSig":
+        """Allow nested types whose children satisfy ``child_sig`` (default: self)."""
+        return TypeSig(self._types | {TypeEnum.ARRAY, TypeEnum.STRUCT, TypeEnum.MAP},
+                       self._notes, self._max_decimal_precision,
+                       child_sig or self)
+
+    # -- checks ---------------------------------------------------------------
+    def is_supported(self, dt: DataType) -> bool:
+        return not self.reasons_not_supported(dt)
+
+    def reasons_not_supported(self, dt: DataType) -> list:
+        e = _enum_of(dt)
+        if e not in self._types:
+            return [f"{dt!r} is not supported"]
+        reasons = []
+        if isinstance(dt, DecimalType) and dt.precision > self._max_decimal_precision:
+            reasons.append(
+                f"{dt!r} exceeds max supported decimal precision "
+                f"{self._max_decimal_precision}")
+        child = self._child_sig or self
+        if isinstance(dt, ArrayType):
+            reasons += [f"array child: {r}" for r in child.reasons_not_supported(dt.element_type)]
+        elif isinstance(dt, StructType):
+            for f in dt.fields:
+                reasons += [f"struct field {f.name}: {r}"
+                            for r in child.reasons_not_supported(f.data_type)]
+        elif isinstance(dt, MapType):
+            reasons += [f"map key: {r}" for r in child.reasons_not_supported(dt.key_type)]
+            reasons += [f"map value: {r}" for r in child.reasons_not_supported(dt.value_type)]
+        return reasons
+
+    def note_for(self, dt: DataType) -> Optional[str]:
+        return self._notes.get(_enum_of(dt))
+
+    def describe(self) -> str:
+        return ", ".join(sorted(self._types))
+
+    def __contains__(self, dt: DataType) -> bool:
+        return self.is_supported(dt)
+
+    def __repr__(self):
+        return f"TypeSig({self.describe()})"
+
+
+# Common signatures (named after the reference's, TypeChecks.scala:400-523)
+TypeSig.integral = TypeSig.of(TypeEnum.BYTE, TypeEnum.SHORT, TypeEnum.INT, TypeEnum.LONG)
+TypeSig.gpuNumeric = TypeSig.integral + TypeSig.of(TypeEnum.FLOAT, TypeEnum.DOUBLE, TypeEnum.DECIMAL)
+TypeSig.fp = TypeSig.of(TypeEnum.FLOAT, TypeEnum.DOUBLE)
+TypeSig.numeric = TypeSig.gpuNumeric
+TypeSig.comparable = TypeSig.gpuNumeric + TypeSig.of(
+    TypeEnum.BOOLEAN, TypeEnum.DATE, TypeEnum.TIMESTAMP, TypeEnum.STRING)
+TypeSig.commonScalar = TypeSig.comparable + TypeSig.of(TypeEnum.NULL)
+TypeSig.orderable = TypeSig.comparable + TypeSig.of(TypeEnum.NULL)
+TypeSig.all = TypeSig(TypeEnum.ALL)

@@ -1,0 +1,245 @@
+"""Host-side columnar batches (the CPU staging layer).
+
+Plays the role of the reference's ``HostColumnVector`` / ``RapidsHostColumnVector``
+(sql-plugin/src/main/java/...): data sits in host memory in a layout that can be
+uploaded to the device without reinterpretation. Fixed-width types are numpy
+arrays; strings are materialized to a fixed-width padded uint8 matrix + lengths
+at upload time (device layout) but kept as numpy object arrays host-side so the
+CPU fallback operators can compute on them directly.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+import pyarrow as pa
+
+from . import dtypes as dt
+
+__all__ = ["HostColumn", "HostTable"]
+
+
+def _arrow_to_dtype(t: pa.DataType) -> dt.DataType:
+    if pa.types.is_boolean(t):
+        return dt.BOOLEAN
+    if pa.types.is_int8(t):
+        return dt.BYTE
+    if pa.types.is_int16(t):
+        return dt.SHORT
+    if pa.types.is_int32(t):
+        return dt.INT
+    if pa.types.is_int64(t):
+        return dt.LONG
+    if pa.types.is_float32(t):
+        return dt.FLOAT
+    if pa.types.is_float64(t):
+        return dt.DOUBLE
+    if pa.types.is_string(t) or pa.types.is_large_string(t):
+        return dt.STRING
+    if pa.types.is_binary(t) or pa.types.is_large_binary(t):
+        return dt.BINARY
+    if pa.types.is_date32(t):
+        return dt.DATE
+    if pa.types.is_timestamp(t):
+        return dt.TIMESTAMP
+    if pa.types.is_decimal(t):
+        return dt.DecimalType(t.precision, t.scale)
+    raise TypeError(f"unsupported arrow type {t}")
+
+
+def _dtype_to_arrow(d: dt.DataType) -> pa.DataType:
+    if isinstance(d, dt.BooleanType):
+        return pa.bool_()
+    if isinstance(d, dt.ByteType):
+        return pa.int8()
+    if isinstance(d, dt.ShortType):
+        return pa.int16()
+    if isinstance(d, dt.IntegerType):
+        return pa.int32()
+    if isinstance(d, dt.LongType):
+        return pa.int64()
+    if isinstance(d, dt.FloatType):
+        return pa.float32()
+    if isinstance(d, dt.DoubleType):
+        return pa.float64()
+    if isinstance(d, dt.StringType):
+        return pa.string()
+    if isinstance(d, dt.BinaryType):
+        return pa.binary()
+    if isinstance(d, dt.DateType):
+        return pa.date32()
+    if isinstance(d, dt.TimestampType):
+        return pa.timestamp("us")
+    if isinstance(d, dt.DecimalType):
+        return pa.decimal128(d.precision, d.scale)
+    raise TypeError(f"unsupported data type {d!r}")
+
+
+@dataclasses.dataclass
+class HostColumn:
+    """One host column: values + optional validity mask (True = present)."""
+    dtype: dt.DataType
+    values: np.ndarray          # fixed width: typed array; string: object array of str
+    validity: Optional[np.ndarray] = None   # bool array, None means all-valid
+
+    def __post_init__(self):
+        if self.validity is not None and self.validity.dtype != np.bool_:
+            self.validity = self.validity.astype(np.bool_)
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+    @property
+    def null_count(self) -> int:
+        return 0 if self.validity is None else int((~self.validity).sum())
+
+    def valid_mask(self) -> np.ndarray:
+        if self.validity is None:
+            return np.ones(len(self.values), dtype=np.bool_)
+        return self.validity
+
+    # -- conversions ---------------------------------------------------------
+    @staticmethod
+    def from_arrow(arr: pa.ChunkedArray | pa.Array) -> "HostColumn":
+        if isinstance(arr, pa.ChunkedArray):
+            arr = arr.combine_chunks()
+        d = _arrow_to_dtype(arr.type)
+        validity = None
+        if arr.null_count:
+            validity = np.asarray(arr.is_valid())
+        if isinstance(d, dt.StringType) or isinstance(d, dt.BinaryType):
+            values = np.asarray(arr.to_pylist(), dtype=object)
+            if validity is not None:
+                values[~validity] = "" if isinstance(d, dt.StringType) else b""
+        elif isinstance(d, dt.DateType):
+            values = np.asarray(arr.cast(pa.int32()).fill_null(0))
+        elif isinstance(d, dt.TimestampType):
+            values = np.asarray(arr.cast(pa.timestamp("us")).cast(pa.int64()).fill_null(0))
+        elif isinstance(d, dt.DecimalType):
+            if d.precision > dt.DecimalType.MAX_INT64_PRECISION:
+                raise TypeError(f"decimal precision > 18 not supported: {d!r}")
+            # scaled int64 representation
+            ints = arr.cast(pa.decimal128(38, d.scale)).fill_null(0)
+            values = np.asarray(
+                [int(x.as_py().scaleb(d.scale)) if x.is_valid else 0 for x in ints],
+                dtype=np.int64)
+        else:
+            fill = False if pa.types.is_boolean(arr.type) else 0
+            values = np.asarray(arr.fill_null(fill))
+            if values.dtype != d.np_dtype() and not isinstance(d, dt.BooleanType):
+                values = values.astype(d.np_dtype())
+        if isinstance(d, dt.BooleanType):
+            values = values.astype(np.bool_)
+        return HostColumn(d, values, validity)
+
+    def to_arrow(self) -> pa.Array:
+        at = _dtype_to_arrow(self.dtype)
+        mask = None if self.validity is None else ~self.validity
+        if isinstance(self.dtype, (dt.StringType, dt.BinaryType)):
+            vals = list(self.values)
+            if mask is not None:
+                vals = [None if m else v for v, m in zip(vals, mask)]
+            return pa.array(vals, type=at)
+        if isinstance(self.dtype, dt.DecimalType):
+            import decimal
+            s = self.dtype.scale
+            vals = [decimal.Decimal(int(v)).scaleb(-s) for v in self.values]
+            if mask is not None:
+                vals = [None if m else v for v, m in zip(vals, mask)]
+            return pa.array(vals, type=at)
+        if isinstance(self.dtype, dt.DateType):
+            return pa.array(self.values.astype(np.int32), type=pa.int32(),
+                            mask=mask).cast(pa.date32())
+        if isinstance(self.dtype, dt.TimestampType):
+            return pa.array(self.values.astype(np.int64), type=pa.int64(),
+                            mask=mask).cast(pa.timestamp("us"))
+        return pa.array(self.values, type=at, mask=mask)
+
+    def take(self, indices: np.ndarray) -> "HostColumn":
+        vals = self.values[indices]
+        validity = None if self.validity is None else self.validity[indices]
+        return HostColumn(self.dtype, vals, validity)
+
+    def slice(self, start: int, length: int) -> "HostColumn":
+        end = start + length
+        validity = None if self.validity is None else self.validity[start:end]
+        return HostColumn(self.dtype, self.values[start:end], validity)
+
+
+@dataclasses.dataclass
+class HostTable:
+    """A batch of host columns with names (reference: host-side ColumnarBatch)."""
+    names: List[str]
+    columns: List[HostColumn]
+
+    def __post_init__(self):
+        assert len(self.names) == len(self.columns)
+        if self.columns:
+            n = len(self.columns[0])
+            assert all(len(c) == n for c in self.columns), "ragged host table"
+
+    @property
+    def num_rows(self) -> int:
+        return len(self.columns[0]) if self.columns else 0
+
+    @property
+    def num_columns(self) -> int:
+        return len(self.columns)
+
+    def column(self, name: str) -> HostColumn:
+        return self.columns[self.names.index(name)]
+
+    def schema(self) -> Dict[str, dt.DataType]:
+        return {n: c.dtype for n, c in zip(self.names, self.columns)}
+
+    # -- conversions ---------------------------------------------------------
+    @staticmethod
+    def from_arrow(table: pa.Table) -> "HostTable":
+        cols = [HostColumn.from_arrow(table.column(i)) for i in range(table.num_columns)]
+        return HostTable(list(table.column_names), cols)
+
+    def to_arrow(self) -> pa.Table:
+        return pa.table({n: c.to_arrow() for n, c in zip(self.names, self.columns)})
+
+    @staticmethod
+    def from_pydict(data: Dict[str, Sequence], schema: Optional[Dict[str, dt.DataType]] = None
+                    ) -> "HostTable":
+        at = None
+        if schema:
+            at = pa.schema([(k, _dtype_to_arrow(v)) for k, v in schema.items()])
+        return HostTable.from_arrow(pa.table(data, schema=at))
+
+    def take(self, indices: np.ndarray) -> "HostTable":
+        return HostTable(list(self.names), [c.take(indices) for c in self.columns])
+
+    def slice(self, start: int, length: int) -> "HostTable":
+        return HostTable(list(self.names), [c.slice(start, length) for c in self.columns])
+
+    @staticmethod
+    def concat(tables: "Sequence[HostTable]") -> "HostTable":
+        assert tables, "cannot concat zero host tables"
+        first = tables[0]
+        if len(tables) == 1:
+            return first
+        cols = []
+        for i in range(first.num_columns):
+            parts = [t.columns[i] for t in tables]
+            values = np.concatenate([p.values for p in parts])
+            if any(p.validity is not None for p in parts):
+                validity = np.concatenate([p.valid_mask() for p in parts])
+            else:
+                validity = None
+            cols.append(HostColumn(first.columns[i].dtype, values, validity))
+        return HostTable(list(first.names), cols)
+
+    def nbytes(self) -> int:
+        total = 0
+        for c in self.columns:
+            if c.values.dtype == object:
+                total += sum(len(str(v).encode()) for v in c.values) + 4 * len(c.values)
+            else:
+                total += c.values.nbytes
+            if c.validity is not None:
+                total += c.validity.nbytes
+        return total

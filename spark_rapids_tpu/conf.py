@@ -1,0 +1,297 @@
+"""Typed configuration system.
+
+TPU-native re-design of the reference's ``RapidsConf``
+(``sql-plugin/.../RapidsConf.scala``, builder DSL at lines 121-299): a typed
+registry of ``spark.rapids.*`` entries with docs, defaults and validators, and
+a self-documenting ``help()`` generator. We keep the same key surface wherever
+the semantics carry over (``spark.rapids.sql.enabled``,
+``spark.rapids.sql.batchSizeBytes``, ``spark.rapids.sql.concurrentGpuTasks``)
+so users of the reference find the same knobs.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+__all__ = ["ConfEntry", "RapidsConf", "register_conf", "conf_entries"]
+
+_REGISTRY: "Dict[str, ConfEntry]" = {}
+_REG_LOCK = threading.Lock()
+
+
+class ConfEntry:
+    """One typed config entry (reference ConfEntry/ConfBuilder, RapidsConf.scala:121-175)."""
+
+    def __init__(self, key: str, doc: str, default: Any, conf_type: type,
+                 checker: Optional[Callable[[Any], Optional[str]]] = None,
+                 internal: bool = False, startup_only: bool = False):
+        self.key = key
+        self.doc = doc
+        self.default = default
+        self.conf_type = conf_type
+        self.checker = checker
+        self.internal = internal
+        self.startup_only = startup_only
+
+    def convert(self, raw: Any) -> Any:
+        if raw is None:
+            return self.default
+        if self.conf_type is bool:
+            if isinstance(raw, bool):
+                v: Any = raw
+            else:
+                s = str(raw).strip().lower()
+                if s in ("true", "1", "yes", "on"):
+                    v = True
+                elif s in ("false", "0", "no", "off"):
+                    v = False
+                else:
+                    raise ValueError(f"{self.key}: cannot parse boolean from {raw!r}")
+        elif self.conf_type in (int, float, str):
+            v = self.conf_type(raw)
+        else:
+            v = raw
+        if self.checker is not None:
+            err = self.checker(v)
+            if err:
+                raise ValueError(f"{self.key}: {err}")
+        return v
+
+
+def register_conf(key: str, doc: str, default: Any, conf_type: Optional[type] = None,
+                  checker: Optional[Callable[[Any], Optional[str]]] = None,
+                  internal: bool = False, startup_only: bool = False) -> ConfEntry:
+    if conf_type is None:
+        conf_type = type(default) if default is not None else str
+    entry = ConfEntry(key, doc, default, conf_type, checker, internal, startup_only)
+    with _REG_LOCK:
+        _REGISTRY[key] = entry
+    return entry
+
+
+def conf_entries() -> List[ConfEntry]:
+    return sorted(_REGISTRY.values(), key=lambda e: e.key)
+
+
+def _positive(what: str):
+    def check(v):
+        return None if v > 0 else f"{what} must be positive, got {v}"
+    return check
+
+
+def _in(*allowed: str):
+    def check(v):
+        return None if v in allowed else f"must be one of {allowed}, got {v!r}"
+    return check
+
+
+# ---------------------------------------------------------------------------
+# Entry definitions. Keys deliberately mirror the reference's RapidsConf keys;
+# TPU-specific knobs live under spark.rapids.tpu.*.
+# ---------------------------------------------------------------------------
+SQL_ENABLED = register_conf(
+    "spark.rapids.sql.enabled",
+    "Enable (true) or disable (false) lowering query plans onto the TPU. "
+    "(reference: RapidsConf.scala SQL_ENABLED)", True)
+
+SQL_MODE = register_conf(
+    "spark.rapids.sql.mode",
+    "executeOnGPU lowers and runs supported plans on the TPU; explainOnly only "
+    "tags plans and reports what would/would not run on device. "
+    "(reference: RapidsConf.scala:515)", "executeongpu",
+    checker=_in("executeongpu", "explainonly"))
+
+SQL_EXPLAIN = register_conf(
+    "spark.rapids.sql.explain",
+    "NONE, ALL, or NOT_ON_GPU: when to print plan-tagging explain output.",
+    "NONE", checker=_in("NONE", "ALL", "NOT_ON_GPU"))
+
+BATCH_SIZE_BYTES = register_conf(
+    "spark.rapids.sql.batchSizeBytes",
+    "Target device batch size in bytes. Batches are bucketed to power-of-two "
+    "row capacities below this bound to bound XLA recompilation. "
+    "(reference: RapidsConf.scala:425-432; 2GiB cudf bound does not apply)",
+    512 * 1024 * 1024, checker=_positive("batch size"))
+
+BATCH_ROWS_MIN_BUCKET = register_conf(
+    "spark.rapids.tpu.batchRowsMinBucket",
+    "Smallest row-capacity bucket for device batches. Row counts are padded "
+    "up to power-of-two multiples of this so XLA sees a bounded set of shapes.",
+    1024, checker=_positive("bucket"))
+
+CONCURRENT_TPU_TASKS = register_conf(
+    "spark.rapids.sql.concurrentGpuTasks",
+    "Number of tasks that may submit device work concurrently per TPU chip "
+    "(admission control via TpuSemaphore). (reference: RapidsConf.scala:412-418)",
+    1, checker=_positive("concurrent tasks"))
+
+IMPROVED_FLOAT_OPS = register_conf(
+    "spark.rapids.sql.improvedFloatOps.enabled",
+    "Allow float aggregations whose ordering differs from row-at-a-time CPU "
+    "execution (device reductions are tree-shaped).", True)
+
+HAS_NANS = register_conf(
+    "spark.rapids.sql.hasNans",
+    "Assume floating point data may contain NaNs (affects eligibility of some "
+    "ops, matching the reference conf).", True)
+
+ENABLED_FLOAT_AGG = register_conf(
+    "spark.rapids.sql.variableFloatAgg.enabled",
+    "Allow float/double aggregations on device even though result may differ "
+    "in ulps from CPU due to reduction order.", True)
+
+METRICS_LEVEL = register_conf(
+    "spark.rapids.sql.metrics.level",
+    "ESSENTIAL, MODERATE or DEBUG metric collection on exec nodes. "
+    "(reference: RapidsConf.scala:486)", "MODERATE",
+    checker=_in("ESSENTIAL", "MODERATE", "DEBUG"))
+
+HOST_SPILL_STORAGE_SIZE = register_conf(
+    "spark.rapids.memory.host.spillStorageSize",
+    "Bytes of host memory used to spill device buffers before disk. "
+    "(reference: RapidsConf.scala:363)", 1024 * 1024 * 1024,
+    checker=_positive("spill storage"))
+
+DEVICE_POOL_FRACTION = register_conf(
+    "spark.rapids.memory.gpu.allocFraction",
+    "Fraction of device HBM the buffer pool may use.", 0.9,
+    conf_type=float)
+
+SHUFFLE_TRANSPORT_CLASS = register_conf(
+    "spark.rapids.shuffle.transport.class",
+    "Fully-qualified class name of the shuffle transport implementation; "
+    "loaded reflectively like the reference's RapidsShuffleTransport SPI "
+    "(shuffle/RapidsShuffleTransport.scala:545).",
+    "spark_rapids_tpu.shuffle.transport.LocalShuffleTransport")
+
+SHUFFLE_COMPRESSION_CODEC = register_conf(
+    "spark.rapids.shuffle.compression.codec",
+    "Codec for shuffle payloads: none or lz4-style host codec.",
+    "none", checker=_in("none", "zstd", "lz4"))
+
+TEST_ENABLED = register_conf(
+    "spark.rapids.sql.test.enabled",
+    "Fail if a query does not fully run on device except allowed fallbacks "
+    "(reference: RapidsConf.scala:968-989).", False)
+
+TEST_ALLOWED_NON_TPU = register_conf(
+    "spark.rapids.sql.test.allowedNonGpu",
+    "Comma-separated op names allowed to fall back when test.enabled is set.",
+    "")
+
+OPTIMIZER_ENABLED = register_conf(
+    "spark.rapids.sql.optimizer.enabled",
+    "Enable the cost-based optimizer that avoids device sections not worth "
+    "the transition cost (reference: RapidsConf.scala:1231).", False)
+
+MULTITHREAD_READ_NUM_THREADS = register_conf(
+    "spark.rapids.sql.multiThreadedRead.numThreads",
+    "Thread pool size for the MULTITHREADED file reader "
+    "(reference: GpuParquetScanBase.scala:934).", 8,
+    checker=_positive("threads"))
+
+PARQUET_READER_TYPE = register_conf(
+    "spark.rapids.sql.format.parquet.reader.type",
+    "PERFILE, COALESCING or MULTITHREADED parquet reader strategy "
+    "(reference: RapidsConf.scala:721).", "COALESCING",
+    checker=_in("PERFILE", "COALESCING", "MULTITHREADED", "AUTO"))
+
+
+class RapidsConf:
+    """An immutable snapshot of config values (reference ``RapidsConf`` class)."""
+
+    def __init__(self, settings: Optional[Dict[str, Any]] = None):
+        settings = dict(settings or {})
+        # environment override: SPARK_RAPIDS_TPU_CONF_<key with dots as __>
+        for k, entry in _REGISTRY.items():
+            env_key = "SPARK_RAPIDS_TPU_CONF_" + k.replace(".", "__")
+            if env_key in os.environ and k not in settings:
+                settings[k] = os.environ[env_key]
+        self._values: Dict[str, Any] = {}
+        unknown = [k for k in settings
+                   if k not in _REGISTRY and k.startswith("spark.rapids.")]
+        # Unknown spark.rapids keys are kept (forward compat) but not typed.
+        self._extra = {k: settings[k] for k in unknown}
+        for k, entry in _REGISTRY.items():
+            self._values[k] = entry.convert(settings.get(k))
+
+    def get(self, key_or_entry) -> Any:
+        key = key_or_entry.key if isinstance(key_or_entry, ConfEntry) else key_or_entry
+        if key in self._values:
+            return self._values[key]
+        if key in self._extra:
+            return self._extra[key]
+        raise KeyError(key)
+
+    def __getitem__(self, key):
+        return self.get(key)
+
+    def with_overrides(self, **kv) -> "RapidsConf":
+        merged = dict(self._values)
+        merged.update({k.replace("__", "."): v for k, v in kv.items()})
+        return RapidsConf(merged)
+
+    def set(self, key: str, value: Any) -> "RapidsConf":
+        merged = dict(self._values)
+        merged.update(self._extra)
+        merged[key] = value
+        return RapidsConf(merged)
+
+    # convenience accessors -------------------------------------------------
+    @property
+    def is_sql_enabled(self) -> bool:
+        return self.get(SQL_ENABLED)
+
+    @property
+    def is_explain_only(self) -> bool:
+        return str(self.get(SQL_MODE)).lower() == "explainonly"
+
+    @property
+    def explain(self) -> str:
+        return self.get(SQL_EXPLAIN)
+
+    @property
+    def batch_size_bytes(self) -> int:
+        return self.get(BATCH_SIZE_BYTES)
+
+    @property
+    def min_bucket_rows(self) -> int:
+        return self.get(BATCH_ROWS_MIN_BUCKET)
+
+    @property
+    def concurrent_tpu_tasks(self) -> int:
+        return self.get(CONCURRENT_TPU_TASKS)
+
+    @property
+    def metrics_level(self) -> str:
+        return self.get(METRICS_LEVEL)
+
+    @property
+    def test_enabled(self) -> bool:
+        return self.get(TEST_ENABLED)
+
+    @property
+    def allowed_non_tpu(self) -> List[str]:
+        raw = self.get(TEST_ALLOWED_NON_TPU)
+        return [s.strip() for s in raw.split(",") if s.strip()]
+
+    def is_op_enabled(self, conf_key: str) -> bool:
+        """Per-op enable keys (spark.rapids.sql.exec.* / expression.*) default on."""
+        if conf_key in self._values:
+            return bool(self._values[conf_key])
+        raw = self._extra.get(conf_key)
+        if raw is None:
+            return True
+        return str(raw).strip().lower() in ("true", "1", "yes", "on")
+
+    @staticmethod
+    def help_markdown() -> str:
+        """Generate configs documentation (reference: RapidsConf.help -> docs/configs.md)."""
+        lines = ["# spark-rapids-tpu configs", "",
+                 "| key | default | description |", "|---|---|---|"]
+        for e in conf_entries():
+            if e.internal:
+                continue
+            lines.append(f"| `{e.key}` | `{e.default}` | {e.doc} |")
+        return "\n".join(lines) + "\n"

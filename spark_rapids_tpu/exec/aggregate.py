@@ -1,0 +1,315 @@
+"""Device hash aggregate (reference: aggregate.scala — GpuHashAggregateIterator
+at :181, partial/final projections at :193-208, GpuHashAggregateExec at :1319).
+
+TPU-first re-design: cuDF's hash-based groupby assumes dynamic output sizes;
+XLA wants static shapes. We use a **sort-based groupby** entirely inside one
+jitted computation:
+
+    lexsort rows by (active, key nulls, key values)   -> equal keys adjacent
+    boundary flags -> segment ids (cumsum)            -> static capacity
+    jax.ops.segment_{sum,min,max} reductions          -> per-group states
+    representative-row gather                         -> group key columns
+
+Output capacity == input capacity (groups <= rows), so the whole kernel is one
+static-shape XLA program that fuses with upstream project/filter. Grouped
+float keys are normalized (-0.0 -> +0.0, NaNs equal) matching Spark's
+NormalizeFloatingNumbers pass.
+
+Per-batch partial aggregation emits one aggregated batch per input batch; the
+exchange + final merge reduce across batches/partitions exactly like the
+reference's merge passes.
+"""
+from __future__ import annotations
+
+from typing import Callable, Iterator, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import dtypes as dt
+from ..columnar.device import DeviceColumn, DeviceTable
+from ..plan.physical import AggSpec, PhysicalPlan
+from ..plan.schema import Field, Schema
+from ..utils import metrics as M
+from .base import TpuExec
+
+__all__ = ["TpuHashAggregateExec"]
+
+_BIG = np.int64(2**62)
+
+
+def _minmax_identity(xp_dtype, for_min: bool):
+    if xp_dtype == jnp.bool_:
+        return True if for_min else False
+    info = jnp.finfo(xp_dtype) if jnp.issubdtype(xp_dtype, jnp.floating) \
+        else jnp.iinfo(xp_dtype)
+    return info.max if for_min else info.min
+
+
+def _normalize_float_key(v: jax.Array) -> jax.Array:
+    if jnp.issubdtype(v.dtype, jnp.floating):
+        v = jnp.where(v == 0, jnp.zeros_like(v), v)
+    return v
+
+
+def _keys_equal_prev(sv: jax.Array) -> jax.Array:
+    """eq[i] = sv[i] == sv[i-1] (with NaN==NaN); eq[0] = False."""
+    prev = jnp.roll(sv, 1, axis=0)
+    eq = sv == prev
+    if jnp.issubdtype(sv.dtype, jnp.floating):
+        eq = jnp.logical_or(eq, jnp.logical_and(jnp.isnan(sv), jnp.isnan(prev)))
+    return eq.at[0].set(False) if eq.ndim == 1 else eq
+
+
+def _reduce_segment(op: str, vals: jax.Array, contrib: jax.Array,
+                    gid: jax.Array, cap: int, pos: jax.Array,
+                    out_dtype) -> Tuple[jax.Array, jax.Array]:
+    """Per-group reduction -> (values[cap], validity[cap])."""
+    counts = jax.ops.segment_sum(contrib.astype(jnp.int64), gid, num_segments=cap)
+    has = counts > 0
+    if op == "count":
+        return counts.astype(out_dtype), jnp.ones(cap, dtype=bool)
+    if op in ("sum", "sumsq"):
+        x = vals.astype(out_dtype)
+        if op == "sumsq":
+            x = x * x
+        x = jnp.where(contrib, x, jnp.zeros_like(x))
+        return jax.ops.segment_sum(x, gid, num_segments=cap), has
+    if op == "min" or op == "max":
+        ident = _minmax_identity(vals.dtype, op == "min")
+        x = vals
+        isfloat = jnp.issubdtype(vals.dtype, jnp.floating)
+        if isfloat:
+            # Spark total order: NaN is the largest double
+            nan = jnp.isnan(vals)
+            x = jnp.where(nan, jnp.full_like(vals, jnp.inf if op == "min"
+                                             else -jnp.inf), vals)
+        x = jnp.where(contrib, x, jnp.full_like(x, ident))
+        red = jax.ops.segment_min if op == "min" else jax.ops.segment_max
+        out = red(x, gid, num_segments=cap)
+        if isfloat:
+            nan_contrib = jnp.logical_and(contrib, nan)
+            nan_counts = jax.ops.segment_sum(nan_contrib.astype(jnp.int32),
+                                             gid, num_segments=cap)
+            if op == "min":
+                nonnan = jax.ops.segment_sum(
+                    jnp.logical_and(contrib, jnp.logical_not(nan)).astype(jnp.int32),
+                    gid, num_segments=cap)
+                out = jnp.where(jnp.logical_and(has, nonnan == 0),
+                                jnp.full_like(out, jnp.nan), out)
+            else:
+                out = jnp.where(nan_counts > 0, jnp.full_like(out, jnp.nan), out)
+        return out.astype(out_dtype), has
+    if op in ("first", "last"):
+        p = jnp.where(contrib, -pos if op == "last" else pos,
+                      jnp.full_like(pos, _BIG))
+        best = jax.ops.segment_min(p, gid, num_segments=cap)
+        idx = -best if op == "last" else best
+        idx = jnp.clip(idx, 0, vals.shape[0] - 1).astype(jnp.int32)
+        return jnp.take(vals, idx, axis=0).astype(out_dtype), has
+    if op == "any":
+        x = jnp.where(contrib, vals, jnp.zeros_like(vals))
+        return jax.ops.segment_max(x.astype(jnp.int32), gid,
+                                   num_segments=cap).astype(bool), has
+    if op == "all":
+        x = jnp.where(contrib, vals, jnp.ones_like(vals))
+        return jax.ops.segment_min(x.astype(jnp.int32), gid,
+                                   num_segments=cap).astype(bool), has
+    raise ValueError(op)
+
+
+class TpuHashAggregateExec(TpuExec):
+    """Same pre-projected input contract as CpuHashAggregateExec."""
+
+    def __init__(self, child: PhysicalPlan, key_names: List[str],
+                 specs: List[AggSpec], mode: str):
+        super().__init__()
+        assert mode in ("partial", "final")
+        self.child = child
+        self.children = (child,)
+        self.key_names = list(key_names)
+        self.specs = specs
+        self.mode = mode
+        key_fields = [child.schema.field(k) for k in key_names]
+        state_fields = [Field(n, d, nb) for s in specs
+                        for (n, d, nb) in s.state_fields]
+        self.schema = Schema(key_fields + state_fields)
+
+    @property
+    def fusible(self) -> bool:
+        # partial mode may emit one state-batch per input batch (downstream
+        # merge reduces them); final mode must merge across batches itself
+        return self.mode == "partial"
+
+    def _columns_ops(self) -> List[Tuple[str, str, str, dt.DataType]]:
+        out = []
+        for s in self.specs:
+            ops = s.update_ops if self.mode == "partial" else s.merge_ops
+            in_cols = s.input_cols if self.mode == "partial" \
+                else [n for (n, _, _) in s.state_fields]
+            for (in_col, op, (out_col, out_dt, _)) in zip(in_cols, ops, s.state_fields):
+                out.append((in_col, op, out_col, out_dt))
+        return out
+
+    # -- kernels -------------------------------------------------------------
+    def batch_fn(self) -> Callable[[DeviceTable], DeviceTable]:
+        cols_ops = self._columns_ops()
+        key_names = self.key_names
+        out_names = tuple(self.schema.names)
+
+        def ungrouped(table: DeviceTable) -> DeviceTable:
+            cap_out = 8  # tiny fixed capacity for the single state row
+            out_cols = []
+            pos = jnp.arange(table.capacity, dtype=jnp.int64)
+            for in_col, op, out_col, out_dt in cols_ops:
+                col = table.column(in_col)
+                contrib = jnp.logical_and(col.validity, table.row_mask)
+                gid = jnp.zeros(table.capacity, dtype=jnp.int32)
+                vals1, has1 = _reduce_segment(
+                    op, col.data, contrib, gid, 1, pos,
+                    jnp.dtype(out_dt.np_dtype()))
+                vals = jnp.zeros(cap_out, dtype=vals1.dtype).at[0].set(vals1[0])
+                validity = jnp.zeros(cap_out, dtype=bool).at[0].set(has1[0])
+                out_cols.append(DeviceColumn(vals, validity, out_dt, None))
+            iota = jnp.arange(cap_out, dtype=jnp.int32)
+            return DeviceTable(tuple(out_cols), iota < 1,
+                               jnp.asarray(1, jnp.int32), out_names)
+
+        def grouped(table: DeviceTable) -> DeviceTable:
+            cap = table.capacity
+            active = table.row_mask
+            # ---- sort so equal keys are adjacent, active rows first
+            sort_keys = []
+            key_cols = [table.column(k) for k in key_names]
+            for kc in reversed(key_cols):
+                v = _normalize_float_key(kc.data)
+                if jnp.issubdtype(v.dtype, jnp.floating):
+                    # NaNs must sort together deterministically
+                    nan = jnp.isnan(v)
+                    v = jnp.where(nan, jnp.full_like(v, jnp.inf), v)
+                    sort_keys.append(v)
+                    sort_keys.append(nan)
+                else:
+                    sort_keys.append(v)
+                sort_keys.append(jnp.logical_not(kc.validity))
+            sort_keys.append(jnp.logical_not(active))  # primary: active first
+            order = jnp.lexsort(tuple(sort_keys))
+            active_s = jnp.take(active, order)
+            # ---- group boundaries among sorted active rows
+            same = jnp.ones(cap, dtype=bool)
+            for kc in key_cols:
+                sv = jnp.take(_normalize_float_key(kc.data), order)
+                sn = jnp.take(jnp.logical_not(kc.validity), order)
+                prev_sn = jnp.roll(sn, 1)
+                veq = _keys_equal_prev(sv)
+                both_null = jnp.logical_and(sn, prev_sn).at[0].set(False)
+                col_same = jnp.where(jnp.logical_or(sn, prev_sn), both_null, veq)
+                same = jnp.logical_and(same, col_same)
+            boundary = jnp.logical_and(jnp.logical_not(same), active_s)
+            boundary = boundary.at[0].set(active_s[0])
+            gid = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+            gid = jnp.clip(gid, 0, cap - 1)
+            num_groups = jnp.sum(boundary.astype(jnp.int32))
+            pos = jnp.arange(cap, dtype=jnp.int64)
+            # ---- representative sorted-row per group for key output
+            rep_src = jnp.where(active_s, pos, jnp.full_like(pos, _BIG))
+            rep = jnp.clip(jax.ops.segment_min(rep_src, gid, num_segments=cap),
+                           0, cap - 1).astype(jnp.int32)
+            out_cols: List[DeviceColumn] = []
+            iota = jnp.arange(cap, dtype=jnp.int32)
+            group_mask = iota < num_groups
+            for kc in key_cols:
+                sv = jnp.take(kc.data, order, axis=0)
+                svalid = jnp.take(kc.validity, order)
+                gv = jnp.take(sv, rep, axis=0)
+                gvalid = jnp.logical_and(jnp.take(svalid, rep), group_mask)
+                glen = None
+                if kc.lengths is not None:
+                    glen = jnp.take(jnp.take(kc.lengths, order), rep)
+                out_cols.append(DeviceColumn(gv, gvalid, kc.dtype, glen))
+            # ---- state reductions
+            for in_col, op, out_col, out_dt in cols_ops:
+                col = table.column(in_col)
+                sv = jnp.take(col.data, order, axis=0)
+                svalid = jnp.take(col.validity, order)
+                contrib = jnp.logical_and(svalid, active_s)
+                vals, has = _reduce_segment(op, sv, contrib, gid, cap, pos,
+                                            jnp.dtype(out_dt.np_dtype()))
+                validity = jnp.logical_and(has, group_mask) if op != "count" \
+                    else group_mask
+                out_cols.append(DeviceColumn(vals, validity, out_dt, None))
+            return DeviceTable(tuple(out_cols), group_mask,
+                               num_groups.astype(jnp.int32), out_names)
+
+        return ungrouped if not key_names else grouped
+
+    def plan_signature(self) -> str:
+        child_schema = repr(self.children[0].schema) \
+            if hasattr(self.children[0], "schema") else ""
+        return (f"HashAgg|{self.mode}|{self.key_names}|"
+                f"{self._columns_ops()!r}|{child_schema}")
+
+    def execute_columnar(self, pidx: int) -> Iterator[DeviceTable]:
+        from ..utils.compile_cache import cached_jit
+        fn = cached_jit(self.plan_signature(), self.batch_fn)
+        pending = None
+        merge_fn = None
+        for batch in self.child_device_batches(pidx):
+            with self.metrics.timed(M.AGG_TIME):
+                out = fn(batch)
+            if pending is None:
+                pending = out
+            else:
+                # merge-as-you-go keeps a single running aggregated batch
+                if merge_fn is None:
+                    merge_fn = cached_jit(self.plan_signature() + "|merge",
+                                          self._merge_batch_fn)
+                from ..columnar.device import concat_device_tables
+                both = concat_device_tables([pending, out])
+                pending = merge_fn(both)
+        if pending is None:
+            if not self.key_names:
+                empty = _empty_device_table(self.child.schema, 8)
+                yield fn(empty)
+            return
+        yield pending
+
+    def _merge_batch_fn(self):
+        """Re-aggregate concatenated partial outputs (merge semantics)."""
+        merged = TpuHashAggregateExec.__new__(TpuHashAggregateExec)
+        TpuExec.__init__(merged)
+        merged.key_names = self.key_names
+        merged.mode = "final"
+        # after the partial pass the state columns are inputs to merge ops
+        specs = []
+        for s in self.specs:
+            ms = AggSpec(s.prefix, s.fn)
+            specs.append(ms)
+        merged.specs = specs
+        merged.child = _SchemaOnly(self.schema)
+        merged.children = (merged.child,)
+        merged.schema = self.schema
+        return merged.batch_fn()
+
+    def node_desc(self):
+        return f"mode={self.mode} keys={self.key_names}"
+
+
+class _SchemaOnly:
+    def __init__(self, schema: Schema):
+        self.schema = schema
+
+
+def _empty_device_table(schema: Schema, cap: int) -> DeviceTable:
+    cols = []
+    for f in schema:
+        if isinstance(f.dtype, (dt.StringType, dt.BinaryType)):
+            data = jnp.zeros((cap, 8), dtype=jnp.uint8)
+            lengths = jnp.zeros(cap, dtype=jnp.int32)
+        else:
+            data = jnp.zeros(cap, dtype=f.dtype.np_dtype())
+            lengths = None
+        cols.append(DeviceColumn(data, jnp.zeros(cap, dtype=bool), f.dtype, lengths))
+    return DeviceTable(tuple(cols), jnp.zeros(cap, dtype=bool),
+                       jnp.asarray(0, jnp.int32), tuple(schema.names))

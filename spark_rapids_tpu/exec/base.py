@@ -1,0 +1,64 @@
+"""TpuExec — base class for device operators (reference: GpuExec.scala:208).
+
+A TpuExec produces ``DeviceTable`` batches via ``execute_columnar``; the
+row-oriented ``execute`` inherited from PhysicalPlan is implemented once here
+as download (matching GpuColumnarToRowExec being the only row bridge).
+
+Fusibility: operators whose per-batch work is a pure function
+``DeviceTable -> DeviceTable`` return it from ``batch_fn()``; the planner's
+whole-stage pass (exec/wholestage.py) composes adjacent fusible operators into
+a single jitted XLA computation — the TPU analogue of Spark's whole-stage
+codegen, and the replacement for cuDF's kernel-per-call execution.
+"""
+from __future__ import annotations
+
+from typing import Callable, Iterator, Optional, Tuple
+
+from ..columnar.device import DeviceTable
+from ..columnar.host import HostTable
+from ..plan.physical import PhysicalPlan
+from ..utils.metrics import MetricRegistry
+
+__all__ = ["TpuExec"]
+
+
+class TpuExec(PhysicalPlan):
+    """Columnar-only device operator."""
+
+    def __init__(self):
+        self.metrics = MetricRegistry()
+
+    @property
+    def num_partitions(self) -> int:
+        return self.children[0].num_partitions if self.children else 1
+
+    def execute_columnar(self, pidx: int) -> Iterator[DeviceTable]:
+        raise NotImplementedError(type(self).__name__)
+
+    def batch_fn(self) -> Optional[Callable[[DeviceTable], DeviceTable]]:
+        """Pure per-batch device function, or None if not fusible."""
+        return None
+
+    @property
+    def fusible(self) -> bool:
+        """Whether per-batch application preserves semantics (operators that
+        must see all batches — final aggregates, sorts — override to False)."""
+        return self.batch_fn() is not None
+
+    def plan_signature(self) -> str:
+        """Canonical signature of this node's traced computation, used to key
+        the global XLA compile cache (utils/compile_cache.py)."""
+        child_schema = repr(self.children[0].schema) \
+            if self.children and hasattr(self.children[0], "schema") else ""
+        return f"{type(self).__name__}|{self.node_desc()}|{child_schema}"
+
+    def execute(self, pidx: int) -> Iterator[HostTable]:
+        for batch in self.execute_columnar(pidx):
+            yield batch.to_host()
+
+    def child_device_batches(self, pidx: int) -> Iterator[DeviceTable]:
+        child = self.children[0]
+        assert isinstance(child, TpuExec) or hasattr(child, "execute_columnar"), \
+            f"device exec {type(self).__name__} over non-columnar child " \
+            f"{type(child).__name__} (missing transition)"
+        return child.execute_columnar(pidx)

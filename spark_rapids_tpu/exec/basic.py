@@ -1,0 +1,199 @@
+"""Basic device operators: Project / Filter / Range / Union / Limit
+(reference: basicPhysicalOperators.scala:115,313,540 and limit.scala).
+
+Project and Filter are pure per-batch functions — Filter only ANDs the
+selection mask (no gather!), so a filter+project chain fuses into one XLA
+computation with zero intermediate materialization.
+"""
+from __future__ import annotations
+
+from typing import Callable, Iterator, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar import dtypes as dt
+from ..columnar.device import DeviceColumn, DeviceTable
+from ..expr.base import EvalContext, Expression
+from ..plan.physical import PhysicalPlan
+from ..plan.schema import Field, Schema
+from ..utils import metrics as M
+from .base import TpuExec
+
+__all__ = ["TpuProjectExec", "TpuFilterExec", "TpuRangeExec", "TpuUnionExec",
+           "TpuLocalLimitExec", "eval_exprs_device"]
+
+
+def eval_exprs_device(table: DeviceTable, exprs: Sequence[Expression],
+                      names: Sequence[str]) -> DeviceTable:
+    ctx = EvalContext.for_device(table)
+    cols: List[DeviceColumn] = []
+    for e in exprs:
+        c = e.eval(ctx)
+        validity = c.validity
+        if validity is None:
+            validity = jnp.ones(table.capacity, dtype=bool)
+        values = c.values
+        want = c.dtype.np_dtype()
+        if not isinstance(c.dtype, (dt.StringType, dt.BinaryType)) \
+                and values.dtype != want:
+            values = values.astype(want)
+        cols.append(DeviceColumn(values, validity, c.dtype, c.lengths))
+    return DeviceTable(tuple(cols), table.row_mask, table.num_rows, tuple(names))
+
+
+class TpuProjectExec(TpuExec):
+    def __init__(self, child: PhysicalPlan, exprs: Sequence[Expression],
+                 names: Sequence[str]):
+        super().__init__()
+        self.child = child
+        self.children = (child,)
+        self.exprs = list(exprs)
+        self.names = list(names)
+        self.schema = Schema([Field(n, e.data_type, e.nullable)
+                              for n, e in zip(names, exprs)])
+
+    def batch_fn(self) -> Callable[[DeviceTable], DeviceTable]:
+        exprs, names = self.exprs, self.names
+
+        def fn(table: DeviceTable) -> DeviceTable:
+            return eval_exprs_device(table, exprs, names)
+        return fn
+
+    def plan_signature(self) -> str:
+        child_schema = repr(self.children[0].schema) if self.children else ""
+        return f"Project|{[repr(e) for e in self.exprs]}|{self.names}|{child_schema}"
+
+    def execute_columnar(self, pidx: int) -> Iterator[DeviceTable]:
+        from ..utils.compile_cache import cached_jit
+        fn = cached_jit(self.plan_signature(), self.batch_fn)
+        for batch in self.child_device_batches(pidx):
+            with self.metrics.timed(M.OP_TIME):
+                out = fn(batch)
+            self.metrics.add(M.NUM_OUTPUT_BATCHES, 1)
+            yield out
+
+    def node_desc(self):
+        return ", ".join(self.names)
+
+
+class TpuFilterExec(TpuExec):
+    def __init__(self, child: PhysicalPlan, condition: Expression):
+        super().__init__()
+        self.child = child
+        self.children = (child,)
+        self.condition = condition
+        self.schema = child.schema
+
+    def batch_fn(self) -> Callable[[DeviceTable], DeviceTable]:
+        cond = self.condition
+
+        def fn(table: DeviceTable) -> DeviceTable:
+            ctx = EvalContext.for_device(table)
+            c = cond.eval(ctx)
+            keep = c.values
+            if c.validity is not None:
+                keep = jnp.logical_and(keep, c.validity)
+            return table.filter_mask(keep)
+        return fn
+
+    def plan_signature(self) -> str:
+        child_schema = repr(self.children[0].schema) if self.children else ""
+        return f"Filter|{self.condition!r}|{child_schema}"
+
+    def execute_columnar(self, pidx: int) -> Iterator[DeviceTable]:
+        from ..utils.compile_cache import cached_jit
+        fn = cached_jit(self.plan_signature(), self.batch_fn)
+        for batch in self.child_device_batches(pidx):
+            with self.metrics.timed(M.OP_TIME):
+                out = fn(batch)
+            yield out
+
+    def node_desc(self):
+        return repr(self.condition)
+
+
+class TpuRangeExec(TpuExec):
+    def __init__(self, start: int, end: int, step: int, num_partitions: int = 1,
+                 min_bucket: int = 1024, max_batch_rows: int = 1 << 22):
+        super().__init__()
+        import math
+        self.start, self.end, self.step = start, end, step
+        self._parts = num_partitions
+        self.min_bucket = min_bucket
+        self.max_batch_rows = max_batch_rows
+        self.children = ()
+        self.schema = Schema([Field("id", dt.LONG, False)])
+        self._total = max(0, math.ceil((end - start) / step))
+
+    @property
+    def num_partitions(self) -> int:
+        return self._parts
+
+    def execute_columnar(self, pidx: int) -> Iterator[DeviceTable]:
+        import math
+        per = math.ceil(self._total / self._parts) if self._total else 0
+        lo = min(self._total, pidx * per)
+        hi = min(self._total, (pidx + 1) * per)
+        pos = lo
+        while pos < hi:
+            n = min(self.max_batch_rows, hi - pos)
+            from ..columnar.device import bucket_rows
+            cap = bucket_rows(max(n, 1), self.min_bucket)
+            iota = jnp.arange(cap, dtype=jnp.int64)
+            values = jnp.asarray(self.start, jnp.int64) \
+                + jnp.asarray(self.step, jnp.int64) * (iota + pos)
+            mask = iota < n
+            col = DeviceColumn(values, mask, dt.LONG, None)
+            yield DeviceTable((col,), mask, jnp.asarray(n, jnp.int32), ("id",))
+            pos += n
+
+
+class TpuUnionExec(TpuExec):
+    def __init__(self, children: Sequence[PhysicalPlan]):
+        super().__init__()
+        self.children = tuple(children)
+        self.schema = children[0].schema
+
+    @property
+    def num_partitions(self) -> int:
+        return sum(c.num_partitions for c in self.children)
+
+    def execute_columnar(self, pidx: int) -> Iterator[DeviceTable]:
+        for c in self.children:
+            if pidx < c.num_partitions:
+                for b in c.execute_columnar(pidx):
+                    yield DeviceTable(b.columns, b.row_mask, b.num_rows,
+                                      tuple(self.schema.names))
+                return
+            pidx -= c.num_partitions
+        raise IndexError(pidx)
+
+
+class TpuLocalLimitExec(TpuExec):
+    """Per-partition limit: compacts then masks the first n rows."""
+
+    def __init__(self, child: PhysicalPlan, n: int):
+        super().__init__()
+        self.child = child
+        self.children = (child,)
+        self.n = n
+        self.schema = child.schema
+
+    def execute_columnar(self, pidx: int) -> Iterator[DeviceTable]:
+        remaining = self.n
+
+        @jax.jit
+        def take(table: DeviceTable, k) -> DeviceTable:
+            t = table.compact()
+            iota = jnp.arange(t.capacity, dtype=jnp.int32)
+            nr = jnp.minimum(t.num_rows, k).astype(jnp.int32)
+            mask = iota < nr
+            return DeviceTable(t.columns, mask, nr, t.names)
+
+        for batch in self.child_device_batches(pidx):
+            if remaining <= 0:
+                return
+            out = take(batch, jnp.asarray(remaining, jnp.int32))
+            remaining -= int(out.num_rows)
+            yield out

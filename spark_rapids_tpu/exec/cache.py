@@ -1,0 +1,69 @@
+"""Cached (materialized) scans — device-resident df.cache().
+
+Reference analogue: ParquetCachedBatchSerializer (SURVEY §2.8) lets
+``df.cache()`` keep columnar batches on device. Here the cache stores
+DeviceTables keyed by partition in a storage object owned by the *logical*
+plan node, so repeated executions of the same DataFrame skip upload and
+upstream compute entirely. The CPU engine caches HostTables symmetrically.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional
+
+from ..columnar.device import DeviceTable
+from ..columnar.host import HostTable
+from ..plan.physical import PhysicalPlan
+from ..utils import metrics as M
+from .base import TpuExec
+
+__all__ = ["CacheStorage", "CpuCacheExec", "TpuCacheExec"]
+
+
+class CacheStorage:
+    def __init__(self):
+        self.host: Dict[int, List[HostTable]] = {}
+        self.device: Dict[int, List[DeviceTable]] = {}
+
+    def clear(self):
+        self.host.clear()
+        self.device.clear()
+
+
+class CpuCacheExec(PhysicalPlan):
+    def __init__(self, child: PhysicalPlan, storage: CacheStorage):
+        self.child = child
+        self.children = (child,)
+        self.storage = storage
+        self.schema = child.schema
+
+    def execute(self, pidx: int) -> Iterator[HostTable]:
+        cached = self.storage.host.get(pidx)
+        if cached is not None:
+            yield from cached
+            return
+        acc: List[HostTable] = []
+        for b in self.child.execute(pidx):
+            acc.append(b)
+            yield b
+        self.storage.host[pidx] = acc
+
+
+class TpuCacheExec(TpuExec):
+    def __init__(self, child: PhysicalPlan, storage: CacheStorage):
+        super().__init__()
+        self.child = child
+        self.children = (child,)
+        self.storage = storage
+        self.schema = child.schema
+
+    def execute_columnar(self, pidx: int) -> Iterator[DeviceTable]:
+        cached = self.storage.device.get(pidx)
+        if cached is not None:
+            self.metrics.add("cacheHits", 1)
+            yield from cached
+            return
+        acc: List[DeviceTable] = []
+        for b in self.child_device_batches(pidx):
+            acc.append(b)
+            yield b
+        self.storage.device[pidx] = acc

@@ -1,0 +1,90 @@
+"""Device sort (reference: GpuSortExec.scala — FullSortSingleBatch /
+OutOfCoreSort / SortEachBatch modes; this implements the single-batch mode,
+out-of-core splitting arrives with the spill framework).
+
+TPU shape: one lexsort over transformed key arrays inside one jitted program.
+Spark ordering semantics: nulls first/last per order, NaN greater than all
+numbers, -0.0 == 0.0.
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar.device import DeviceTable, concat_device_tables
+from ..expr.base import EvalContext
+from ..expr.functions import SortOrder
+from ..plan.physical import PhysicalPlan
+from ..utils import metrics as M
+from .base import TpuExec
+
+__all__ = ["TpuSortExec", "device_sort_table"]
+
+
+def _order_keys(table: DeviceTable, orders: Sequence[SortOrder]) -> List[jax.Array]:
+    """lexsort key list (minor..major) implementing Spark ordering."""
+    ctx = EvalContext.for_device(table)
+    keys: List[jax.Array] = []
+    for o in reversed(list(orders)):
+        c = o.expr.eval(ctx)
+        v = c.values
+        if jnp.issubdtype(v.dtype, jnp.floating):
+            nan = jnp.isnan(v)
+            v = jnp.where(v == 0, jnp.zeros_like(v), v)       # -0.0 -> 0.0
+            v = jnp.where(nan, jnp.full_like(v, jnp.inf), v)  # NaN sorts high
+            nan_key = nan  # among +inf ties, NaN after true inf
+            if not o.ascending:
+                v = -v
+                nan_key = jnp.logical_not(nan)
+            keys.append(nan_key)
+            keys.append(v)
+        elif v.dtype == jnp.bool_:
+            keys.append(v != o.ascending)
+        else:
+            keys.append(v if o.ascending else -v)
+        valid = c.validity
+        if valid is None:
+            valid = jnp.ones(table.capacity, dtype=bool)
+        null = jnp.logical_not(valid)
+        # nulls_first: null sorts as 0 (before valid=1); else after
+        null_key = jnp.logical_not(null) if o.nulls_first else null
+        keys.append(null_key)
+    # primary: active rows first
+    keys.append(jnp.logical_not(table.row_mask))
+    return keys
+
+
+def device_sort_table(table: DeviceTable, orders: Sequence[SortOrder]) -> DeviceTable:
+    keys = _order_keys(table, orders)
+    order = jnp.lexsort(tuple(keys))
+    cols = tuple(c.gather(order) for c in table.columns)
+    iota = jnp.arange(table.capacity, dtype=jnp.int32)
+    mask = iota < table.num_rows
+    return DeviceTable(cols, mask, table.num_rows, table.names)
+
+
+class TpuSortExec(TpuExec):
+    def __init__(self, child: PhysicalPlan, orders: Sequence[SortOrder]):
+        super().__init__()
+        self.child = child
+        self.children = (child,)
+        self.orders = list(orders)
+        self.schema = child.schema
+
+    def execute_columnar(self, pidx: int) -> Iterator[DeviceTable]:
+        batches = list(self.child_device_batches(pidx))
+        if not batches:
+            return
+        table = concat_device_tables(batches) if len(batches) > 1 else batches[0]
+        from ..utils.compile_cache import cached_jit
+        orders = self.orders
+        fn = cached_jit(self.plan_signature(),
+                        lambda: (lambda t: device_sort_table(t, orders)))
+        with self.metrics.timed(M.SORT_TIME):
+            yield fn(table)
+
+    def node_desc(self):
+        return ", ".join(f"{o.expr!r} {'ASC' if o.ascending else 'DESC'}"
+                         for o in self.orders)

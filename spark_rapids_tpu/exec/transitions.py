@@ -1,0 +1,99 @@
+"""Host<->device transitions and batch coalescing.
+
+Reference equivalents:
+- ``HostToDeviceExec``   ~ GpuRowToColumnarExec / HostColumnarToGpu
+- ``DeviceToHostExec``   ~ GpuColumnarToRowExec / GpuBringBackToHost
+- ``TpuCoalesceBatchesExec`` ~ GpuCoalesceBatches (GpuCoalesceBatches.scala:528)
+
+The transition inserter (plan/transitions.py) places these where device
+sections start/end, exactly like GpuTransitionOverrides.scala:37.
+"""
+from __future__ import annotations
+
+from typing import Iterator, List
+
+from ..columnar.device import DeviceTable, bucket_rows, concat_device_tables
+from ..columnar.host import HostTable
+from ..plan.physical import PhysicalPlan
+from ..utils import metrics as M
+from .base import TpuExec
+
+__all__ = ["HostToDeviceExec", "DeviceToHostExec", "TpuCoalesceBatchesExec"]
+
+
+class HostToDeviceExec(TpuExec):
+    def __init__(self, child: PhysicalPlan, min_bucket: int = 1024):
+        super().__init__()
+        self.child = child
+        self.children = (child,)
+        self.schema = child.schema
+        self.min_bucket = min_bucket
+
+    def execute_columnar(self, pidx: int) -> Iterator[DeviceTable]:
+        for batch in self.child.execute(pidx):
+            with self.metrics.timed(M.UPLOAD_TIME):
+                dtb = DeviceTable.from_host(batch, self.min_bucket)
+            self.metrics.add(M.NUM_OUTPUT_BATCHES, 1)
+            self.metrics.add(M.NUM_OUTPUT_ROWS, batch.num_rows)
+            yield dtb
+
+
+class DeviceToHostExec(PhysicalPlan):
+    def __init__(self, child: TpuExec):
+        self.child = child
+        self.children = (child,)
+        self.schema = child.schema
+        self.metrics = M.MetricRegistry()
+
+    @property
+    def num_partitions(self) -> int:
+        return self.child.num_partitions
+
+    def execute(self, pidx: int) -> Iterator[HostTable]:
+        for batch in self.child.execute_columnar(pidx):
+            with self.metrics.timed(M.DOWNLOAD_TIME):
+                ht = batch.to_host()
+            yield ht
+
+
+class TpuCoalesceBatchesExec(TpuExec):
+    """Concatenate small device batches up to a target row goal.
+
+    The reference distinguishes TargetSize vs RequireSingleBatch goals
+    (CoalesceGoal lattice, GpuCoalesceBatches.scala:93-200); here the goal is
+    expressed in rows (``target_rows``) or single-batch (``require_single``).
+    """
+
+    def __init__(self, child: PhysicalPlan, target_rows: int = 1 << 20,
+                 require_single: bool = False, min_bucket: int = 1024):
+        super().__init__()
+        self.child = child
+        self.children = (child,)
+        self.schema = child.schema
+        self.target_rows = target_rows
+        self.require_single = require_single
+        self.min_bucket = min_bucket
+
+    def execute_columnar(self, pidx: int) -> Iterator[DeviceTable]:
+        pending: List[DeviceTable] = []
+        pending_rows = 0
+        for batch in self.child_device_batches(pidx):
+            n = int(batch.num_rows)
+            if self.require_single or pending_rows + n <= self.target_rows \
+                    or not pending:
+                pending.append(batch)
+                pending_rows += n
+                if not self.require_single and pending_rows >= self.target_rows:
+                    yield self._flush(pending)
+                    pending, pending_rows = [], 0
+            else:
+                yield self._flush(pending)
+                pending, pending_rows = [batch], n
+        if pending:
+            yield self._flush(pending)
+
+    def _flush(self, pending: List[DeviceTable]) -> DeviceTable:
+        with self.metrics.timed(M.OP_TIME):
+            out = concat_device_tables(pending, self.min_bucket)
+        self.metrics.add(M.NUM_OUTPUT_BATCHES, 1)
+        return out

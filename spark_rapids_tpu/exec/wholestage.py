@@ -1,0 +1,106 @@
+"""Whole-stage fusion: compose adjacent fusible device operators into a single
+jitted XLA computation.
+
+The reference executes one cuDF kernel per operator call; on TPU the win is
+the opposite — let XLA fuse a project/filter/partial-aggregate chain into one
+program so intermediate columns never hit HBM. This is the TPU analogue of
+Spark's whole-stage codegen (which the reference replaces with columnar
+exec — see GpuExec.scala docs) and is inserted by plan/transitions.py after
+lowering.
+"""
+from __future__ import annotations
+
+from typing import Iterator, List
+
+import jax
+
+from ..columnar.device import DeviceTable
+from ..utils import metrics as M
+from .base import TpuExec
+
+__all__ = ["TpuWholeStageExec", "fuse_stages"]
+
+
+class TpuWholeStageExec(TpuExec):
+    """Wraps a linear chain of fusible TpuExecs [bottom, ..., top]."""
+
+    def __init__(self, chain: List[TpuExec]):
+        super().__init__()
+        assert chain, "empty fusion chain"
+        self.chain = chain
+        bottom = chain[0]
+        # the producer feeding the chain (transition or other non-fused exec)
+        self.source = bottom.children[0]
+        self.children = (self.source,)
+        self.schema = chain[-1].schema
+
+    @property
+    def num_partitions(self) -> int:
+        return self.source.num_partitions
+
+    def node_name(self):
+        inner = "+".join(type(n).__name__.replace("Tpu", "").replace("Exec", "")
+                         for n in self.chain)
+        return f"TpuWholeStage[{inner}]"
+
+    def plan_signature(self) -> str:
+        return "WS|" + "||".join(n.plan_signature() for n in self.chain)
+
+    def execute_columnar(self, pidx: int) -> Iterator[DeviceTable]:
+        from ..utils.compile_cache import cached_jit
+        chain = self.chain
+
+        def build():
+            fns = [n.batch_fn() for n in chain]
+
+            def run(table: DeviceTable) -> DeviceTable:
+                for f in fns:
+                    table = f(table)
+                return table
+            return run
+
+        fused = cached_jit(self.plan_signature(), build)
+        for batch in self.source.execute_columnar(pidx):
+            with self.metrics.timed(M.OP_TIME):
+                out = fused(batch)
+            self.metrics.add(M.NUM_OUTPUT_BATCHES, 1)
+            yield out
+
+
+def fuse_stages(plan):
+    """Bottom-up pass replacing maximal fusible chains with TpuWholeStageExec.
+
+    A node joins a chain when it is a TpuExec with ``batch_fn() is not None``
+    and exactly one child. Chains of length 1 are left alone (plain jit in the
+    node itself is equivalent).
+    """
+    from ..plan.physical import PhysicalPlan
+
+    def rebuild(node: PhysicalPlan) -> PhysicalPlan:
+        new_children = [rebuild(c) for c in node.children]
+        node = _with_children(node, new_children)
+        if _fusible(node):
+            chain = [node]
+            cur = node.children[0] if node.children else None
+            while cur is not None and _fusible(cur):
+                chain.insert(0, cur)
+                cur = cur.children[0] if cur.children else None
+            if len(chain) > 1:
+                return TpuWholeStageExec(chain)
+        return node
+
+    return rebuild(plan)
+
+
+def _fusible(node) -> bool:
+    return isinstance(node, TpuExec) and len(node.children) == 1 \
+        and node.fusible
+
+
+def _with_children(node, children):
+    if list(node.children) == list(children):
+        return node
+    node.children = tuple(children)
+    if hasattr(node, "child") and len(children) == 1:
+        node.child = children[0]
+    return node

@@ -1,0 +1,309 @@
+"""Aggregate functions (reference: org/apache/spark/sql/rapids/AggregateFunctions.scala).
+
+Each aggregate declares, in the style of the reference's partial/final mode
+projections (aggregate.scala:193-208):
+
+- ``input_projection``: expressions evaluated per input row before reduction
+- ``update_ops``:  per projected column, the reduction used in the partial pass
+- ``merge_ops``:   reductions used when merging partial states
+- ``state_fields``: (suffix, dtype, nullable) of partial-state columns
+- ``evaluate(post_ctx)``: final expression over state columns
+
+Reduction op names understood by the device/host aggregate kernels:
+``sum, count, min, max, any, all, first, last, sumsq``.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from ..columnar import dtypes as dt
+from .arithmetic import Divide
+from .base import AttributeReference, Expression, Literal
+from .cast import Cast
+
+__all__ = ["AggregateFunction", "Sum", "Count", "CountStar", "Min", "Max",
+           "Average", "First", "Last", "StddevPop", "StddevSamp",
+           "VariancePop", "VarianceSamp"]
+
+
+class AggregateFunction(Expression):
+    def __init__(self, child: Optional[Expression] = None):
+        self.child = child
+        self.children = (child,) if child is not None else ()
+
+    def with_children(self, children):
+        return type(self)(children[0]) if children else type(self)()
+
+    # -- aggregation contract -------------------------------------------------
+    def input_projection(self) -> List[Expression]:
+        return [self.child]
+
+    def update_ops(self) -> List[str]:
+        raise NotImplementedError
+
+    def merge_ops(self) -> List[str]:
+        raise NotImplementedError
+
+    def state_fields(self, prefix: str) -> List[Tuple[str, dt.DataType, bool]]:
+        raise NotImplementedError
+
+    def evaluate(self, prefix: str) -> Expression:
+        """Final projection over the named state columns."""
+        raise NotImplementedError
+
+    @property
+    def nullable(self) -> bool:
+        return True
+
+
+def _sum_result_type(t: dt.DataType) -> dt.DataType:
+    if isinstance(t, dt.DecimalType):
+        return dt.DecimalType(min(t.precision + 10, dt.DecimalType.MAX_INT64_PRECISION),
+                              t.scale)
+    if isinstance(t, (dt.FloatType, dt.DoubleType)):
+        return dt.DOUBLE
+    return dt.LONG
+
+
+class Sum(AggregateFunction):
+    @property
+    def data_type(self):
+        return _sum_result_type(self.child.data_type)
+
+    def input_projection(self):
+        return [Cast(self.child, self.data_type)
+                if self.child.data_type != self.data_type else self.child]
+
+    def update_ops(self):
+        return ["sum"]
+
+    def merge_ops(self):
+        return ["sum"]
+
+    def state_fields(self, prefix):
+        return [(f"{prefix}_sum", self.data_type, True)]
+
+    def evaluate(self, prefix):
+        return AttributeReference(f"{prefix}_sum", self.data_type, True)
+
+
+class Count(AggregateFunction):
+    @property
+    def data_type(self):
+        return dt.LONG
+
+    @property
+    def nullable(self):
+        return False
+
+    def update_ops(self):
+        return ["count"]
+
+    def merge_ops(self):
+        return ["sum"]
+
+    def state_fields(self, prefix):
+        return [(f"{prefix}_count", dt.LONG, False)]
+
+    def evaluate(self, prefix):
+        return AttributeReference(f"{prefix}_count", dt.LONG, False)
+
+
+class CountStar(AggregateFunction):
+    """count(*) — counts rows regardless of nulls."""
+
+    def __init__(self, child: Optional[Expression] = None):
+        super().__init__(None)
+
+    def input_projection(self):
+        return [Literal(1, dt.LONG)]
+
+    @property
+    def data_type(self):
+        return dt.LONG
+
+    @property
+    def nullable(self):
+        return False
+
+    def update_ops(self):
+        return ["count"]
+
+    def merge_ops(self):
+        return ["sum"]
+
+    def state_fields(self, prefix):
+        return [(f"{prefix}_count", dt.LONG, False)]
+
+    def evaluate(self, prefix):
+        return AttributeReference(f"{prefix}_count", dt.LONG, False)
+
+
+class Min(AggregateFunction):
+    @property
+    def data_type(self):
+        return self.child.data_type
+
+    def update_ops(self):
+        return ["min"]
+
+    def merge_ops(self):
+        return ["min"]
+
+    def state_fields(self, prefix):
+        return [(f"{prefix}_min", self.data_type, True)]
+
+    def evaluate(self, prefix):
+        return AttributeReference(f"{prefix}_min", self.data_type, True)
+
+
+class Max(AggregateFunction):
+    @property
+    def data_type(self):
+        return self.child.data_type
+
+    def update_ops(self):
+        return ["max"]
+
+    def merge_ops(self):
+        return ["max"]
+
+    def state_fields(self, prefix):
+        return [(f"{prefix}_max", self.data_type, True)]
+
+    def evaluate(self, prefix):
+        return AttributeReference(f"{prefix}_max", self.data_type, True)
+
+
+class Average(AggregateFunction):
+    @property
+    def data_type(self):
+        return dt.DOUBLE
+
+    def input_projection(self):
+        return [Cast(self.child, dt.DOUBLE)
+                if self.child.data_type != dt.DOUBLE else self.child,
+                self.child]
+
+    def update_ops(self):
+        return ["sum", "count"]
+
+    def merge_ops(self):
+        return ["sum", "sum"]
+
+    def state_fields(self, prefix):
+        return [(f"{prefix}_sum", dt.DOUBLE, True),
+                (f"{prefix}_count", dt.LONG, False)]
+
+    def evaluate(self, prefix):
+        return Divide(AttributeReference(f"{prefix}_sum", dt.DOUBLE, True),
+                      AttributeReference(f"{prefix}_count", dt.LONG, False)).coerce()
+
+
+class First(AggregateFunction):
+    def __init__(self, child=None, ignore_nulls: bool = True):
+        super().__init__(child)
+        self.ignore_nulls = ignore_nulls
+
+    def with_children(self, children):
+        return First(children[0], self.ignore_nulls)
+
+    @property
+    def data_type(self):
+        return self.child.data_type
+
+    def update_ops(self):
+        return ["first"]
+
+    def merge_ops(self):
+        return ["first"]
+
+    def state_fields(self, prefix):
+        return [(f"{prefix}_first", self.data_type, True)]
+
+    def evaluate(self, prefix):
+        return AttributeReference(f"{prefix}_first", self.data_type, True)
+
+
+class Last(First):
+    def with_children(self, children):
+        return Last(children[0], self.ignore_nulls)
+
+    def update_ops(self):
+        return ["last"]
+
+    def merge_ops(self):
+        return ["last"]
+
+    def state_fields(self, prefix):
+        return [(f"{prefix}_last", self.data_type, True)]
+
+    def evaluate(self, prefix):
+        return AttributeReference(f"{prefix}_last", self.data_type, True)
+
+
+class _MomentAgg(AggregateFunction):
+    """Variance/stddev via (sum, sumsq, count) moments.
+
+    The reference uses cuDF's native variance; on TPU three fused reductions
+    over the same input fuse into one pass anyway, so moments are the natural
+    shape. Population/sample selected by ``ddof``.
+    """
+    ddof = 0
+
+    @property
+    def data_type(self):
+        return dt.DOUBLE
+
+    def input_projection(self):
+        c = Cast(self.child, dt.DOUBLE) if self.child.data_type != dt.DOUBLE else self.child
+        return [c, c, self.child]
+
+    def update_ops(self):
+        return ["sum", "sumsq", "count"]
+
+    def merge_ops(self):
+        return ["sum", "sum", "sum"]
+
+    def state_fields(self, prefix):
+        return [(f"{prefix}_sum", dt.DOUBLE, True),
+                (f"{prefix}_sumsq", dt.DOUBLE, True),
+                (f"{prefix}_count", dt.LONG, False)]
+
+    def _variance_expr(self, prefix) -> Expression:
+        from .conditional import If
+        from .arithmetic import Multiply, Subtract
+        from .predicates import GreaterThan
+        s = AttributeReference(f"{prefix}_sum", dt.DOUBLE, True)
+        ss = AttributeReference(f"{prefix}_sumsq", dt.DOUBLE, True)
+        n = Cast(AttributeReference(f"{prefix}_count", dt.LONG, False), dt.DOUBLE)
+        # var = (sumsq - sum^2/n) / (n - ddof), null when n <= ddof
+        num = Subtract(ss, Divide(Multiply(s, s).coerce(), n).coerce()).coerce()
+        den = Subtract(n, Literal(float(self.ddof), dt.DOUBLE)).coerce()
+        cond = GreaterThan(n, Literal(float(self.ddof), dt.DOUBLE))
+        return If(cond, Divide(num, den).coerce(), Literal(None, dt.DOUBLE))
+
+    def evaluate(self, prefix):
+        return self._variance_expr(prefix)
+
+
+class VariancePop(_MomentAgg):
+    ddof = 0
+
+
+class VarianceSamp(_MomentAgg):
+    ddof = 1
+
+
+class _StddevMixin(_MomentAgg):
+    def evaluate(self, prefix):
+        from .math import Sqrt
+        return Sqrt(self._variance_expr(prefix))
+
+
+class StddevPop(_StddevMixin):
+    ddof = 0
+
+
+class StddevSamp(_StddevMixin):
+    ddof = 1

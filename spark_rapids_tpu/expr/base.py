@@ -1,0 +1,285 @@
+"""Expression tree core.
+
+Plays the role of Catalyst expressions + the reference's ``GpuExpression``
+(sql-plugin/.../GpuExpressions.scala): each expression evaluates columnar over
+a whole batch. One expression class carries BOTH evaluation paths:
+
+- device: traced jax.numpy ops over ``DeviceColumn`` buffers (fused under jit)
+- host:   numpy ops over ``HostColumn`` buffers (the CPU fallback engine)
+
+The two paths share code through an ``EvalContext`` whose ``xp`` is either
+``jax.numpy`` or ``numpy``; expressions touching string payloads branch on
+``ctx.is_device`` because host strings are object arrays while device strings
+are fixed-width uint8 matrices.
+
+SQL null semantics: value ops propagate null if any input is null; And/Or use
+Kleene three-valued logic; aggregates skip nulls.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..columnar import dtypes as dt
+from ..columnar.host import HostColumn, HostTable
+from ..columnar.device import DeviceColumn, DeviceTable
+
+__all__ = ["EvalCol", "EvalContext", "Expression", "AttributeReference",
+           "Literal", "Alias", "resolve_expression"]
+
+
+@dataclasses.dataclass
+class EvalCol:
+    """Backend-agnostic column during evaluation (values+validity arrays)."""
+    values: Any                 # np.ndarray | jax.Array; strings: obj array (host) / (n,w) u8 (device)
+    validity: Any               # bool array or None (all valid)
+    dtype: dt.DataType
+    lengths: Any = None         # device strings only
+
+    def valid_mask(self, ctx: "EvalContext"):
+        if self.validity is None:
+            return ctx.xp.ones(self.shape0(ctx), dtype=bool)
+        return self.validity
+
+    def shape0(self, ctx: "EvalContext") -> int:
+        return self.values.shape[0] if hasattr(self.values, "shape") else len(self.values)
+
+
+class EvalContext:
+    """Evaluation context: column lookup + array backend."""
+
+    def __init__(self, is_device: bool, xp, columns: Dict[str, EvalCol],
+                 num_rows: int, row_mask=None):
+        self.is_device = is_device
+        self.xp = xp
+        self._columns = columns
+        self.num_rows = num_rows
+        self.row_mask = row_mask
+
+    @staticmethod
+    def for_host(table: HostTable) -> "EvalContext":
+        cols = {n: EvalCol(c.values, c.validity, c.dtype)
+                for n, c in zip(table.names, table.columns)}
+        return EvalContext(False, np, cols, table.num_rows)
+
+    @staticmethod
+    def for_device(table: DeviceTable) -> "EvalContext":
+        import jax.numpy as jnp
+        cols = {n: EvalCol(c.data, c.validity, c.dtype, c.lengths)
+                for n, c in zip(table.names, table.columns)}
+        return EvalContext(True, jnp, cols, table.capacity, table.row_mask)
+
+    def lookup(self, name: str) -> EvalCol:
+        return self._columns[name]
+
+    def to_host_column(self, col: EvalCol) -> HostColumn:
+        return HostColumn(col.dtype, np.asarray(col.values)
+                          if not isinstance(col.values, np.ndarray) else col.values,
+                          col.validity)
+
+    def to_device_column(self, col: EvalCol) -> DeviceColumn:
+        validity = col.validity
+        if validity is None:
+            validity = self.xp.ones(col.values.shape[0], dtype=bool)
+        return DeviceColumn(col.values, validity, col.dtype, col.lengths)
+
+
+class Expression:
+    """Base expression node.
+
+    Subclasses define ``_data_type``/``nullable`` after resolution and
+    implement ``eval(ctx)``. ``children`` drives tree traversal for the
+    tagging/meta layer (plan/meta.py).
+    """
+
+    children: Tuple["Expression", ...] = ()
+
+    @property
+    def data_type(self) -> dt.DataType:
+        raise NotImplementedError(type(self).__name__)
+
+    @property
+    def nullable(self) -> bool:
+        return True
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        raise NotImplementedError(type(self).__name__)
+
+    def with_children(self, children: Sequence["Expression"]) -> "Expression":
+        """Rebuild this node with new children (for resolution rewrites).
+
+        Default assumes the constructor takes the children positionally
+        (unary/binary op convention); others override.
+        """
+        return type(self)(*children)
+
+    # convenience for tests / debugging
+    def __repr__(self):
+        if self.children:
+            return f"{self.name}({', '.join(map(repr, self.children))})"
+        return self.name
+
+    # references used by column pruning
+    def references(self) -> set:
+        refs = set()
+        for c in self.children:
+            refs |= c.references()
+        if isinstance(self, AttributeReference):
+            refs.add(self.column_name)
+        return refs
+
+
+@dataclasses.dataclass(repr=False)
+class AttributeReference(Expression):
+    """A named column reference, resolved against the child's schema."""
+    column_name: str
+    _dtype: Optional[dt.DataType] = None
+    _nullable: bool = True
+
+    def __post_init__(self):
+        self.children = ()
+
+    @property
+    def data_type(self) -> dt.DataType:
+        if self._dtype is None:
+            raise RuntimeError(f"unresolved attribute {self.column_name!r}")
+        return self._dtype
+
+    @property
+    def nullable(self) -> bool:
+        return self._nullable
+
+    @property
+    def name(self) -> str:
+        return self.column_name
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        return ctx.lookup(self.column_name)
+
+    def __repr__(self):
+        return f"col({self.column_name!r})"
+
+
+@dataclasses.dataclass(repr=False)
+class Literal(Expression):
+    """A typed scalar constant (reference: literals.scala)."""
+    value: Any
+    _dtype: Optional[dt.DataType] = None
+
+    def __post_init__(self):
+        self.children = ()
+        if self._dtype is None:
+            self._dtype = _infer_literal_type(self.value)
+
+    @property
+    def data_type(self) -> dt.DataType:
+        return self._dtype
+
+    @property
+    def nullable(self) -> bool:
+        return self.value is None
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        xp = ctx.xp
+        n = ctx.num_rows
+        if self.value is None:
+            values = xp.zeros(n, dtype=self._dtype.np_dtype())
+            return EvalCol(values, xp.zeros(n, dtype=bool), self._dtype)
+        if isinstance(self._dtype, (dt.StringType, dt.BinaryType)):
+            b = self.value.encode() if isinstance(self.value, str) else bytes(self.value)
+            if ctx.is_device:
+                from ..columnar.device import bucket_width
+                w = bucket_width(max(len(b), 1))
+                mat = np.zeros((n, w), dtype=np.uint8)
+                if b:
+                    mat[:, :len(b)] = np.frombuffer(b, dtype=np.uint8)
+                lengths = xp.full((n,), len(b), dtype=xp.int32)
+                return EvalCol(xp.asarray(mat), None, self._dtype, lengths)
+            values = np.empty(n, dtype=object)
+            values[:] = self.value
+            return EvalCol(values, None, self._dtype)
+        values = xp.full((n,), self.value, dtype=self._dtype.np_dtype())
+        return EvalCol(values, None, self._dtype)
+
+    def __repr__(self):
+        return f"lit({self.value!r})"
+
+
+@dataclasses.dataclass(repr=False)
+class Alias(Expression):
+    """Renames its child in project output."""
+    child: Expression
+    alias: str
+
+    def __post_init__(self):
+        self.children = (self.child,)
+
+    @property
+    def data_type(self) -> dt.DataType:
+        return self.child.data_type
+
+    @property
+    def nullable(self) -> bool:
+        return self.child.nullable
+
+    @property
+    def name(self) -> str:
+        return self.alias
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        return self.child.eval(ctx)
+
+    def with_children(self, children):
+        return Alias(children[0], self.alias)
+
+    def __repr__(self):
+        return f"{self.child!r} AS {self.alias}"
+
+
+def _infer_literal_type(value: Any) -> dt.DataType:
+    if value is None:
+        return dt.NULL
+    if isinstance(value, bool):
+        return dt.BOOLEAN
+    if isinstance(value, int):
+        return dt.INT if -2**31 <= value < 2**31 else dt.LONG
+    if isinstance(value, float):
+        return dt.DOUBLE
+    if isinstance(value, str):
+        return dt.STRING
+    if isinstance(value, (bytes, bytearray)):
+        return dt.BINARY
+    import datetime
+    if isinstance(value, datetime.datetime):
+        return dt.TIMESTAMP
+    if isinstance(value, datetime.date):
+        return dt.DATE
+    raise TypeError(f"cannot infer literal type for {value!r}")
+
+
+def resolve_expression(expr: Expression, schema: Dict[str, dt.DataType],
+                       nullable: Optional[Dict[str, bool]] = None) -> Expression:
+    """Resolve attribute dtypes and insert implicit casts bottom-up.
+
+    Catalyst's analyzer equivalent, minimal: binds AttributeReferences to the
+    child schema and lets nodes with a ``coerce`` hook rewrite their children
+    (numeric promotion for arithmetic/comparison).
+    """
+    new_children = [resolve_expression(c, schema, nullable) for c in expr.children]
+    if isinstance(expr, AttributeReference):
+        if expr.column_name not in schema:
+            raise KeyError(
+                f"column {expr.column_name!r} not found; available: {list(schema)}")
+        is_nullable = True if nullable is None else nullable.get(expr.column_name, True)
+        return AttributeReference(expr.column_name, schema[expr.column_name], is_nullable)
+    out = expr.with_children(new_children) if expr.children else expr
+    coerce = getattr(out, "coerce", None)
+    if coerce is not None:
+        out = coerce()
+    return out

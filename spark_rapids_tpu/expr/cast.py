@@ -1,0 +1,98 @@
+"""Cast expression (reference: sql-plugin/.../GpuCast.scala — the 1513-line
+ANSI + legacy cast matrix; this is the numeric/date/timestamp core, the
+string-cast directions are layered on in strings.py / later rounds).
+"""
+from __future__ import annotations
+
+from ..columnar import dtypes as dt
+from .base import EvalCol, EvalContext, Expression
+
+__all__ = ["Cast"]
+
+
+class Cast(Expression):
+    def __init__(self, child: Expression, to: dt.DataType, ansi: bool = False):
+        self.child = child
+        self.to = to
+        self.ansi = ansi
+        self.children = (child,)
+
+    @property
+    def data_type(self) -> dt.DataType:
+        return self.to
+
+    @property
+    def nullable(self) -> bool:
+        return self.child.nullable
+
+    def with_children(self, children):
+        return Cast(children[0], self.to, self.ansi)
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        c = self.child.eval(ctx)
+        src, to = c.dtype, self.to
+        if src == to:
+            return c
+        xp = ctx.xp
+        if isinstance(to, dt.BooleanType):
+            values = c.values != 0
+            return EvalCol(values, c.validity, to)
+        if isinstance(src, dt.BooleanType) and to.is_numeric:
+            return EvalCol(c.values.astype(to.np_dtype()), c.validity, to)
+        if src.is_numeric and to.is_numeric and not isinstance(src, dt.DecimalType) \
+                and not isinstance(to, dt.DecimalType):
+            return EvalCol(c.values.astype(to.np_dtype()), c.validity, to)
+        if isinstance(src, dt.DecimalType) and not isinstance(to, dt.DecimalType):
+            scaled = c.values.astype(xp.float64) / (10.0 ** src.scale)
+            if to in (dt.FLOAT, dt.DOUBLE):
+                return EvalCol(scaled.astype(to.np_dtype()), c.validity, to)
+            return EvalCol(xp.trunc(scaled).astype(to.np_dtype()), c.validity, to)
+        if isinstance(to, dt.DecimalType) and not isinstance(src, dt.DecimalType):
+            scale_f = 10.0 ** to.scale
+            if src in (dt.FLOAT, dt.DOUBLE):
+                v = xp.round(c.values.astype(xp.float64) * scale_f).astype(xp.int64)
+            else:
+                v = c.values.astype(xp.int64) * int(scale_f)
+            return EvalCol(v, c.validity, to)
+        if isinstance(src, dt.DecimalType) and isinstance(to, dt.DecimalType):
+            if to.scale >= src.scale:
+                v = c.values.astype(xp.int64) * (10 ** (to.scale - src.scale))
+            else:
+                v = c.values.astype(xp.int64) // (10 ** (src.scale - to.scale))
+            return EvalCol(v, c.validity, to)
+        if isinstance(src, dt.DateType) and to.is_numeric:
+            # days-since-epoch as integer (engine-internal; Spark exposes
+            # datediff/unix_date for this)
+            return EvalCol(c.values.astype(to.np_dtype()), c.validity, to)
+        if isinstance(src, dt.DateType) and isinstance(to, dt.TimestampType):
+            return EvalCol(c.values.astype(xp.int64) * 86_400_000_000, c.validity, to)
+        if isinstance(src, dt.TimestampType) and isinstance(to, dt.DateType):
+            days = xp.floor_divide(c.values, 86_400_000_000).astype(xp.int32)
+            return EvalCol(days, c.validity, to)
+        if isinstance(src, dt.TimestampType) and to in (dt.LONG, dt.INT):
+            secs = xp.floor_divide(c.values, 1_000_000)
+            return EvalCol(secs.astype(to.np_dtype()), c.validity, to)
+        if isinstance(src, dt.NullType):
+            values = xp.zeros(c.shape0(ctx), dtype=to.np_dtype())
+            return EvalCol(values, xp.zeros(c.shape0(ctx), dtype=bool), to)
+        if isinstance(to, dt.StringType):
+            return self._cast_to_string(ctx, c)
+        raise TypeError(f"cast {src!r} -> {to!r} not supported")
+
+    def _cast_to_string(self, ctx: EvalContext, c: EvalCol) -> EvalCol:
+        if ctx.is_device:
+            # Device-side number->string needs a digit-emission kernel; tagged
+            # unsupported at planning time for now so this never traces.
+            raise TypeError("cast to string not supported on device yet")
+        import numpy as np
+        src = c.dtype
+        if isinstance(src, dt.BooleanType):
+            vals = np.asarray(["true" if v else "false" for v in c.values], dtype=object)
+        elif src in (dt.FLOAT, dt.DOUBLE):
+            vals = np.asarray([repr(float(v)) for v in c.values], dtype=object)
+        else:
+            vals = np.asarray([str(int(v)) for v in c.values], dtype=object)
+        return EvalCol(vals, c.validity, dt.STRING)
+
+    def __repr__(self):
+        return f"cast({self.child!r} as {self.to!r})"

@@ -1,0 +1,270 @@
+"""Math expressions (reference: sql-plugin/.../mathExpressions.scala)."""
+from __future__ import annotations
+
+from ..columnar import dtypes as dt
+from .base import EvalCol, EvalContext, Expression
+from .cast import Cast
+
+_LONG_MAX = 9223372036854775807
+_LONG_MIN = -9223372036854775808
+
+
+def _f2long(xp, v):
+    """Float -> long with Java cast semantics: NaN->0, +-inf saturates."""
+    safe = xp.where(xp.isnan(v) | (v >= 2.0 ** 63) | (v <= -(2.0 ** 63)),
+                    xp.zeros_like(v), v)
+    out = safe.astype(xp.int64)
+    out = xp.where(v >= 2.0 ** 63, xp.asarray(_LONG_MAX, xp.int64), out)
+    out = xp.where(v <= -(2.0 ** 63), xp.asarray(_LONG_MIN, xp.int64), out)
+    return xp.where(xp.isnan(v), xp.asarray(0, xp.int64), out)
+
+
+__all__ = ["UnaryMathExpression", "Sqrt", "Exp", "Log", "Log10", "Log2",
+           "Sin", "Cos", "Tan", "Asin", "Acos", "Atan", "Sinh", "Cosh",
+           "Tanh", "Cbrt", "Ceil", "Floor", "Round", "Signum", "Pow",
+           "Atan2", "Expm1", "Log1p", "ToDegrees", "ToRadians", "Rint"]
+
+
+class UnaryMathExpression(Expression):
+    """Double-typed elementwise math; domain errors produce NaN like Spark."""
+
+    def __init__(self, child: Expression):
+        self.child = child
+        self.children = (child,)
+
+    def coerce(self):
+        if self.child.data_type != dt.DOUBLE:
+            return type(self)(Cast(self.child, dt.DOUBLE))
+        return self
+
+    @property
+    def data_type(self):
+        return dt.DOUBLE
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        c = self.child.eval(ctx)
+        return EvalCol(self._compute(ctx.xp, c.values), c.validity, dt.DOUBLE)
+
+    def _compute(self, xp, v):
+        raise NotImplementedError
+
+
+class Sqrt(UnaryMathExpression):
+    def _compute(self, xp, v):
+        return xp.sqrt(v)
+
+
+class Exp(UnaryMathExpression):
+    def _compute(self, xp, v):
+        return xp.exp(v)
+
+
+class Expm1(UnaryMathExpression):
+    def _compute(self, xp, v):
+        return xp.expm1(v)
+
+
+class Log(UnaryMathExpression):
+    def _compute(self, xp, v):
+        return xp.log(v)
+
+
+class Log1p(UnaryMathExpression):
+    def _compute(self, xp, v):
+        return xp.log1p(v)
+
+
+class Log10(UnaryMathExpression):
+    def _compute(self, xp, v):
+        return xp.log10(v)
+
+
+class Log2(UnaryMathExpression):
+    def _compute(self, xp, v):
+        return xp.log2(v)
+
+
+class Sin(UnaryMathExpression):
+    def _compute(self, xp, v):
+        return xp.sin(v)
+
+
+class Cos(UnaryMathExpression):
+    def _compute(self, xp, v):
+        return xp.cos(v)
+
+
+class Tan(UnaryMathExpression):
+    def _compute(self, xp, v):
+        return xp.tan(v)
+
+
+class Asin(UnaryMathExpression):
+    def _compute(self, xp, v):
+        return xp.arcsin(v)
+
+
+class Acos(UnaryMathExpression):
+    def _compute(self, xp, v):
+        return xp.arccos(v)
+
+
+class Atan(UnaryMathExpression):
+    def _compute(self, xp, v):
+        return xp.arctan(v)
+
+
+class Sinh(UnaryMathExpression):
+    def _compute(self, xp, v):
+        return xp.sinh(v)
+
+
+class Cosh(UnaryMathExpression):
+    def _compute(self, xp, v):
+        return xp.cosh(v)
+
+
+class Tanh(UnaryMathExpression):
+    def _compute(self, xp, v):
+        return xp.tanh(v)
+
+
+class Cbrt(UnaryMathExpression):
+    def _compute(self, xp, v):
+        return xp.cbrt(v)
+
+
+class ToDegrees(UnaryMathExpression):
+    def _compute(self, xp, v):
+        return xp.degrees(v)
+
+
+class ToRadians(UnaryMathExpression):
+    def _compute(self, xp, v):
+        return xp.radians(v)
+
+
+class Rint(UnaryMathExpression):
+    def _compute(self, xp, v):
+        return xp.round(v)
+
+
+class Signum(UnaryMathExpression):
+    def _compute(self, xp, v):
+        return xp.sign(v)
+
+
+class Ceil(Expression):
+    """ceil returns LONG for fp input (Spark semantics)."""
+
+    def __init__(self, child: Expression):
+        self.child = child
+        self.children = (child,)
+
+    @property
+    def data_type(self):
+        t = self.child.data_type
+        return t if isinstance(t, (dt.IntegralType, dt.DecimalType)) else dt.LONG
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        c = self.child.eval(ctx)
+        if isinstance(c.dtype, dt.IntegralType):
+            return c
+        return EvalCol(_f2long(ctx.xp, ctx.xp.ceil(c.values)), c.validity, dt.LONG)
+
+
+class Floor(Expression):
+    def __init__(self, child: Expression):
+        self.child = child
+        self.children = (child,)
+
+    @property
+    def data_type(self):
+        t = self.child.data_type
+        return t if isinstance(t, (dt.IntegralType, dt.DecimalType)) else dt.LONG
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        c = self.child.eval(ctx)
+        if isinstance(c.dtype, dt.IntegralType):
+            return c
+        return EvalCol(_f2long(ctx.xp, ctx.xp.floor(c.values)), c.validity, dt.LONG)
+
+
+class Round(Expression):
+    """round(x, scale) with HALF_UP semantics (Spark default)."""
+
+    def __init__(self, child: Expression, scale: Expression = None):
+        from .base import Literal
+        self.child = child
+        self.scale = scale if scale is not None else Literal(0, dt.INT)
+        self.children = (self.child, self.scale)
+
+    def with_children(self, children):
+        return Round(children[0], children[1] if len(children) > 1 else None)
+
+    @property
+    def data_type(self):
+        return self.child.data_type
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        from .base import Literal
+        xp = ctx.xp
+        c = self.child.eval(ctx)
+        assert isinstance(self.scale, Literal), "round scale must be a literal"
+        s = int(self.scale.value)
+        if isinstance(c.dtype, dt.IntegralType):
+            if s >= 0:
+                return c
+            f = 10 ** (-s)
+            half = f // 2
+            shifted = xp.where(c.values >= 0, c.values + half, c.values - half)
+            return EvalCol((shifted // f) * f, c.validity, c.dtype)
+        f = 10.0 ** s
+        v = c.values * f
+        # HALF_UP: away from zero on ties (numpy.round is banker's rounding)
+        r = xp.where(v >= 0, xp.floor(v + 0.5), xp.ceil(v - 0.5)) / f
+        return EvalCol(r.astype(c.values.dtype), c.validity, c.dtype)
+
+
+class Pow(Expression):
+    def __init__(self, left: Expression, right: Expression):
+        self.left, self.right = left, right
+        self.children = (left, right)
+
+    def coerce(self):
+        l = self.left if self.left.data_type == dt.DOUBLE else Cast(self.left, dt.DOUBLE)
+        r = self.right if self.right.data_type == dt.DOUBLE else Cast(self.right, dt.DOUBLE)
+        return Pow(l, r)
+
+    @property
+    def data_type(self):
+        return dt.DOUBLE
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        from .arithmetic import _combine_validity
+        l = self.left.eval(ctx)
+        r = self.right.eval(ctx)
+        return EvalCol(ctx.xp.power(l.values, r.values),
+                       _combine_validity(ctx, l, r), dt.DOUBLE)
+
+
+class Atan2(Expression):
+    def __init__(self, left: Expression, right: Expression):
+        self.left, self.right = left, right
+        self.children = (left, right)
+
+    def coerce(self):
+        l = self.left if self.left.data_type == dt.DOUBLE else Cast(self.left, dt.DOUBLE)
+        r = self.right if self.right.data_type == dt.DOUBLE else Cast(self.right, dt.DOUBLE)
+        return Atan2(l, r)
+
+    @property
+    def data_type(self):
+        return dt.DOUBLE
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        from .arithmetic import _combine_validity
+        l = self.left.eval(ctx)
+        r = self.right.eval(ctx)
+        return EvalCol(ctx.xp.arctan2(l.values, r.values),
+                       _combine_validity(ctx, l, r), dt.DOUBLE)

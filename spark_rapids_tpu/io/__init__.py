@@ -1,0 +1,1 @@
+from .memory import InMemorySource  # noqa: F401

@@ -1,0 +1,51 @@
+"""In-memory data source (arrow/pandas/pydict), the LocalTableScan analogue."""
+from __future__ import annotations
+
+import math
+from typing import Iterator, List, Optional
+
+import pyarrow as pa
+
+from ..columnar.host import HostTable
+from ..plan.logical import DataSource
+from ..plan.schema import Field, Schema
+
+__all__ = ["InMemorySource"]
+
+
+class InMemorySource(DataSource):
+    def __init__(self, table: pa.Table, num_partitions: int = 1,
+                 batch_rows: int = 1 << 20):
+        self.table = table
+        self._parts = max(1, num_partitions)
+        self.batch_rows = batch_rows
+        ht = HostTable.from_arrow(table.slice(0, 0))
+        self._schema = Schema([
+            Field(n, c.dtype, table.column(i).null_count > 0 or True)
+            for i, (n, c) in enumerate(zip(ht.names, ht.columns))])
+
+    def schema(self) -> Schema:
+        return self._schema
+
+    def partitions(self) -> int:
+        return self._parts
+
+    def read_partition(self, pidx: int, columns: Optional[List[str]] = None
+                       ) -> Iterator[HostTable]:
+        n = self.table.num_rows
+        per = math.ceil(n / self._parts) if n else 0
+        lo = min(n, pidx * per)
+        hi = min(n, (pidx + 1) * per)
+        t = self.table.slice(lo, hi - lo)
+        if columns:
+            t = t.select(columns)
+        pos = 0
+        while pos < t.num_rows or (pos == 0 and t.num_rows == 0):
+            chunk = t.slice(pos, self.batch_rows)
+            yield HostTable.from_arrow(chunk)
+            pos += self.batch_rows
+            if t.num_rows == 0:
+                break
+
+    def name(self) -> str:
+        return f"InMemory[{self.table.num_rows} rows]"

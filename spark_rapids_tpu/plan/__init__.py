@@ -1,0 +1,3 @@
+from .schema import Field, Schema  # noqa: F401
+from .logical import LogicalPlan  # noqa: F401
+from .physical import PhysicalPlan  # noqa: F401

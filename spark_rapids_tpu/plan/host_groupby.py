@@ -1,0 +1,150 @@
+"""Host-side (CPU engine) groupby kernels with Spark semantics.
+
+numpy-based rather than pandas: pandas nullable floats conflate NaN with NA,
+but Spark distinguishes them (NaN is a *value*, the largest double; null is
+absence). Semantics implemented here and mirrored by the device kernels
+(exec/aggregate.py):
+
+- null keys form their own group; NaN keys group together; -0.0 == 0.0
+- sum/avg propagate NaN; all-null group -> null sum, 0 count
+- min ignores NaN unless all values are NaN; max returns NaN if any NaN
+  (total order: -inf < ... < inf < NaN)
+- first/last skip nulls (ignore-nulls semantics)
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+import pandas as pd
+
+from ..columnar import dtypes as dt
+from ..columnar.host import HostColumn, HostTable
+
+__all__ = ["group_codes", "host_group_reduce"]
+
+
+def _key_codes(col: HostColumn) -> np.ndarray:
+    """Per-column int64 codes: equal values (Spark grouping semantics) get
+    equal codes; nulls get code 0."""
+    vals = col.values
+    if vals.dtype.kind == "f":
+        v = vals.copy()
+        v[v == 0] = 0.0  # -0.0 == 0.0
+        codes = pd.factorize(v, use_na_sentinel=False)[0].astype(np.int64)
+    elif vals.dtype == object:
+        codes = pd.factorize(vals, use_na_sentinel=False)[0].astype(np.int64)
+    else:
+        codes = vals.astype(np.int64)
+    valid = col.valid_mask()
+    lo = codes.min() if len(codes) else 0
+    return np.where(valid, codes - lo + 1, 0)
+
+
+def group_codes(table: HostTable, key_names: Sequence[str]
+                ) -> Tuple[np.ndarray, int, np.ndarray]:
+    """-> (group_id per row, num_groups, representative row index per group)."""
+    n = table.num_rows
+    if not key_names:
+        return np.zeros(n, dtype=np.int64), 1, np.zeros(1, dtype=np.int64)
+    mats = np.stack([_key_codes(table.column(k)) for k in key_names], axis=1)
+    _, first_idx, gid = np.unique(mats, axis=0, return_index=True,
+                                  return_inverse=True)
+    gid = gid.reshape(-1)
+    # renumber groups by first appearance for deterministic output order
+    order = np.argsort(first_idx, kind="stable")
+    remap = np.empty(len(order), dtype=np.int64)
+    remap[order] = np.arange(len(order))
+    gid = remap[gid]
+    rep = first_idx[order]
+    return gid, len(rep), rep
+
+
+def host_group_reduce(op: str, col: HostColumn, gid: np.ndarray, ngroups: int,
+                      out_dtype: dt.DataType
+                      ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+    """-> (values[ngroups], validity[ngroups] or None)."""
+    valid = col.valid_mask()
+    vals = col.values
+    np_out = out_dtype.np_dtype() if not isinstance(
+        out_dtype, (dt.StringType, dt.BinaryType)) else object
+    vcount = np.zeros(ngroups, dtype=np.int64)
+    np.add.at(vcount, gid[valid], 1)
+    has = vcount > 0
+
+    if op == "count":
+        return vcount.astype(np.int64), None
+
+    if op in ("sum", "sumsq"):
+        x = vals[valid]
+        if op == "sumsq":
+            x = x * x
+        acc = np.zeros(ngroups, dtype=np_out)
+        with np.errstate(over="ignore", invalid="ignore"):
+            np.add.at(acc, gid[valid], x.astype(np_out))
+        return acc, has.copy()
+
+    if op in ("min", "max"):
+        return _host_minmax(op, vals, valid, gid, ngroups, has)
+
+    if op in ("first", "last"):
+        pos = np.arange(len(vals), dtype=np.int64)
+        sel = np.full(ngroups, -1, dtype=np.int64)
+        if op == "first":
+            big = np.full(ngroups, len(vals), dtype=np.int64)
+            np.minimum.at(big, gid[valid], pos[valid])
+            sel = np.where(has, np.minimum(big, len(vals) - 1), 0)
+        else:
+            small = np.full(ngroups, -1, dtype=np.int64)
+            np.maximum.at(small, gid[valid], pos[valid])
+            sel = np.where(has, np.maximum(small, 0), 0)
+        out = vals[sel] if len(vals) else np.zeros(ngroups, dtype=vals.dtype)
+        return out, has.copy()
+
+    if op == "any":
+        acc = np.zeros(ngroups, dtype=np.bool_)
+        np.logical_or.at(acc, gid[valid], vals[valid].astype(bool))
+        return acc, has.copy()
+    if op == "all":
+        acc = np.ones(ngroups, dtype=np.bool_)
+        np.logical_and.at(acc, gid[valid], vals[valid].astype(bool))
+        return acc, has.copy()
+    raise ValueError(op)
+
+
+def _host_minmax(op: str, vals: np.ndarray, valid: np.ndarray,
+                 gid: np.ndarray, ngroups: int, has: np.ndarray):
+    if vals.dtype == object:  # strings: order via sorted factorize codes
+        codes, uniques = pd.factorize(vals, use_na_sentinel=False, sort=True)
+        red, rhas = _host_minmax(op, codes.astype(np.int64), valid, gid,
+                                 ngroups, has)
+        idx = np.clip(red, 0, max(len(uniques) - 1, 0)).astype(np.int64)
+        out = np.asarray(uniques, dtype=object)[idx] if len(uniques) \
+            else np.full(ngroups, "", dtype=object)
+        return out, rhas
+    isfloat = vals.dtype.kind == "f"
+    work = vals.copy()
+    nan_mask = np.zeros(len(vals), dtype=bool)
+    if isfloat:
+        nan_mask = np.isnan(vals)
+        # NaN is the largest value in Spark's total order
+        work = np.where(nan_mask, np.inf if op == "min" else -np.inf, vals)
+    if op == "min":
+        ident = np.inf if isfloat else np.iinfo(vals.dtype).max \
+            if vals.dtype != np.bool_ else True
+        acc = np.full(ngroups, ident, dtype=work.dtype)
+        np.minimum.at(acc, gid[valid], work[valid])
+        if isfloat:
+            nonnan = np.zeros(ngroups, dtype=np.int64)
+            np.add.at(nonnan, gid[valid], (~nan_mask[valid]).astype(np.int64))
+            acc = np.where(has & (nonnan == 0), np.nan, acc)
+    else:
+        ident = -np.inf if isfloat else np.iinfo(vals.dtype).min \
+            if vals.dtype != np.bool_ else False
+        acc = np.full(ngroups, ident, dtype=work.dtype)
+        np.maximum.at(acc, gid[valid], work[valid])
+        if isfloat:
+            anynan = np.zeros(ngroups, dtype=np.int64)
+            np.add.at(anynan, gid[valid], nan_mask[valid].astype(np.int64))
+            acc = np.where(anynan > 0, np.nan, acc)
+    return acc, has.copy()

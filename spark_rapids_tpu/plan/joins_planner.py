@@ -1,0 +1,80 @@
+"""Join planning: equi-key extraction + shuffled hash join, broadcast nested
+loop for the rest (reference: GpuOverrides join rules; Spark's
+ExtractEquiJoinKeys is mirrored by ``extract_equi_keys``).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Set, Tuple
+
+from ..conf import RapidsConf
+from ..expr.base import AttributeReference, Expression
+from ..expr.predicates import And, EqualTo
+from .logical import LogicalJoin
+from .physical import HashPartitioning, PhysicalPlan, ShuffleExchangeExec
+from .physical_joins import CpuBroadcastNestedLoopJoinExec, CpuShuffledHashJoinExec
+
+__all__ = ["plan_join", "extract_equi_keys"]
+
+
+def extract_equi_keys(condition: Optional[Expression], lnames: Set[str],
+                      rnames: Set[str]
+                      ) -> Tuple[List[str], List[str], Optional[Expression]]:
+    """Split a join condition into equi-key column pairs + residual."""
+    if condition is None:
+        return [], [], None
+    conjuncts: List[Expression] = []
+
+    def flatten(e: Expression):
+        if isinstance(e, And):
+            flatten(e.left)
+            flatten(e.right)
+        else:
+            conjuncts.append(e)
+    flatten(condition)
+    lkeys, rkeys, residual = [], [], []
+    for c in conjuncts:
+        if isinstance(c, EqualTo) \
+                and isinstance(c.left, AttributeReference) \
+                and isinstance(c.right, AttributeReference):
+            ln, rn = c.left.column_name, c.right.column_name
+            if ln in lnames and rn in rnames:
+                lkeys.append(ln)
+                rkeys.append(rn)
+                continue
+            if rn in lnames and ln in rnames:
+                lkeys.append(rn)
+                rkeys.append(ln)
+                continue
+        residual.append(c)
+    res: Optional[Expression] = None
+    for c in residual:
+        res = c if res is None else And(res, c)
+    return lkeys, rkeys, res
+
+
+def plan_join(node: LogicalJoin, conf: RapidsConf,
+              required: Optional[Set[str]], plan_fn, nparts: int) -> PhysicalPlan:
+    lnames = set(node.left.schema.names)
+    rnames = set(node.right.schema.names)
+    if node.on:
+        lkeys, rkeys, residual = list(node.on), list(node.on), node.condition
+        merge_keys = True
+    else:
+        lkeys, rkeys, residual = extract_equi_keys(node.condition, lnames, rnames)
+        merge_keys = False
+    lreq = rreq = None
+    if required is not None:
+        refs = set(required) | set(lkeys) | set(rkeys)
+        if residual is not None:
+            refs |= residual.references()
+        lreq = refs & lnames
+        rreq = refs & rnames
+    left = plan_fn(node.left, conf, lreq)
+    right = plan_fn(node.right, conf, rreq)
+    if lkeys:
+        if left.num_partitions > 1 or right.num_partitions > 1:
+            left = ShuffleExchangeExec(left, HashPartitioning(lkeys, nparts))
+            right = ShuffleExchangeExec(right, HashPartitioning(rkeys, nparts))
+        return CpuShuffledHashJoinExec(left, right, lkeys, rkeys, node.how,
+                                       residual, merge_keys)
+    return CpuBroadcastNestedLoopJoinExec(left, right, node.how, node.condition)

@@ -1,0 +1,274 @@
+"""Logical plan nodes (Catalyst logical-plan stand-in).
+
+The reference plugs into Spark *after* logical planning; since this framework
+is standalone, we carry a minimal logical layer whose only jobs are (a) the
+DataFrame builder API, (b) expression resolution, and (c) feeding the physical
+planner (plan/planner.py). Everything interesting — tagging, lowering,
+transitions — happens at the physical level exactly like the reference.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from ..columnar import dtypes as dt
+from ..expr.aggregates import AggregateFunction
+from ..expr.base import (Alias, AttributeReference, Expression,
+                         resolve_expression)
+from ..expr.functions import SortOrder
+from .schema import Field, Schema
+
+__all__ = ["LogicalPlan", "LogicalScan", "LogicalProject", "LogicalFilter",
+           "LogicalAggregate", "LogicalSort", "LogicalLimit", "LogicalJoin",
+           "LogicalUnion", "LogicalRange", "LogicalCache", "DataSource"]
+
+
+class DataSource:
+    """Abstract scan source; see io/ for Parquet/CSV/JSON, memory.py for local."""
+
+    def schema(self) -> Schema:
+        raise NotImplementedError
+
+    def partitions(self) -> int:
+        raise NotImplementedError
+
+    def read_partition(self, pidx: int, columns: Optional[List[str]] = None):
+        """Yield HostTable batches for one partition (column-pruned)."""
+        raise NotImplementedError
+
+    def name(self) -> str:
+        return type(self).__name__
+
+
+class LogicalPlan:
+    children: Tuple["LogicalPlan", ...] = ()
+
+    @property
+    def schema(self) -> Schema:
+        raise NotImplementedError
+
+    def node_name(self) -> str:
+        return type(self).__name__
+
+
+class LogicalScan(LogicalPlan):
+    def __init__(self, source: DataSource):
+        self.source = source
+        self.children = ()
+
+    @property
+    def schema(self) -> Schema:
+        return self.source.schema()
+
+
+class LogicalProject(LogicalPlan):
+    def __init__(self, child: LogicalPlan, exprs: Sequence[Expression]):
+        self.child = child
+        self.children = (child,)
+        cs = child.schema
+        self.exprs = [_named(resolve_expression(e, cs.to_dict(), cs.nullable_dict()), i)
+                      for i, e in enumerate(exprs)]
+
+    @property
+    def schema(self) -> Schema:
+        return Schema([Field(e.name, e.data_type, e.nullable) for e in self.exprs])
+
+
+class LogicalFilter(LogicalPlan):
+    def __init__(self, child: LogicalPlan, condition: Expression):
+        self.child = child
+        self.children = (child,)
+        cs = child.schema
+        self.condition = resolve_expression(condition, cs.to_dict(), cs.nullable_dict())
+        if not isinstance(self.condition.data_type, dt.BooleanType):
+            raise TypeError(
+                f"filter condition must be boolean, got {self.condition.data_type!r}")
+
+    @property
+    def schema(self) -> Schema:
+        return self.child.schema
+
+
+class LogicalAggregate(LogicalPlan):
+    """groupBy(groupings).agg(aggregates).
+
+    ``aggregates`` entries are either AggregateFunction or Alias(AggregateFunction)
+    (deeper expressions over aggregates, e.g. sum(x)+1, are planned as a
+    post-projection in the physical planner — not yet supported here).
+    """
+
+    def __init__(self, child: LogicalPlan, groupings: Sequence[Expression],
+                 aggregates: Sequence[Expression]):
+        self.child = child
+        self.children = (child,)
+        cs = child.schema
+        self.groupings = [_named(resolve_expression(g, cs.to_dict(), cs.nullable_dict()), i,
+                                 prefix="group")
+                          for i, g in enumerate(groupings)]
+        resolved = []
+        for i, a in enumerate(aggregates):
+            r = resolve_expression(a, cs.to_dict(), cs.nullable_dict())
+            fn = r.child if isinstance(r, Alias) else r
+            if not isinstance(fn, AggregateFunction):
+                raise TypeError(f"agg expression must be an aggregate, got {r!r}")
+            name = r.name if isinstance(r, Alias) else _default_agg_name(fn)
+            resolved.append((name, fn))
+        self.aggregates: List[Tuple[str, AggregateFunction]] = resolved
+        _check_dup([e.name for e in self.groupings] + [n for n, _ in resolved])
+
+    @property
+    def schema(self) -> Schema:
+        fields = [Field(g.name, g.data_type, g.nullable) for g in self.groupings]
+        fields += [Field(n, f.data_type, f.nullable) for n, f in self.aggregates]
+        return Schema(fields)
+
+
+class LogicalSort(LogicalPlan):
+    def __init__(self, child: LogicalPlan, orders: Sequence[SortOrder],
+                 global_sort: bool = True):
+        self.child = child
+        self.children = (child,)
+        cs = child.schema
+        self.orders = [SortOrder(resolve_expression(o.expr, cs.to_dict(),
+                                                    cs.nullable_dict()),
+                                 o.ascending, o.nulls_first)
+                       for o in orders]
+        self.global_sort = global_sort
+
+    @property
+    def schema(self) -> Schema:
+        return self.child.schema
+
+
+class LogicalLimit(LogicalPlan):
+    def __init__(self, child: LogicalPlan, n: int):
+        self.child = child
+        self.children = (child,)
+        self.n = n
+
+    @property
+    def schema(self) -> Schema:
+        return self.child.schema
+
+
+class LogicalJoin(LogicalPlan):
+    VALID_TYPES = ("inner", "left", "right", "full", "left_semi", "left_anti", "cross")
+
+    def __init__(self, left: LogicalPlan, right: LogicalPlan,
+                 on: Optional[Sequence[str]] = None,
+                 condition: Optional[Expression] = None,
+                 how: str = "inner"):
+        how = how.lower().replace("outer", "").strip("_")
+        aliases = {"leftsemi": "left_semi", "leftanti": "left_anti", "semi": "left_semi",
+                   "anti": "left_anti"}
+        how = aliases.get(how, how)
+        if how not in self.VALID_TYPES:
+            raise ValueError(f"bad join type {how!r}")
+        self.left, self.right = left, right
+        self.children = (left, right)
+        self.how = how
+        self.on = list(on) if on else None
+        self.condition = None
+        if condition is not None:
+            merged = _join_schema(left.schema, right.schema, self.on, how)
+            self.condition = resolve_expression(
+                condition, merged.to_dict(), merged.nullable_dict())
+
+    @property
+    def schema(self) -> Schema:
+        return _join_schema(self.left.schema, self.right.schema, self.on, self.how)
+
+
+def _join_schema(ls: Schema, rs: Schema, on, how: str) -> Schema:
+    if how in ("left_semi", "left_anti"):
+        return ls
+    fields: List[Field] = []
+    if on:
+        for k in on:
+            lf = ls.field(k)
+            fields.append(Field(k, lf.dtype, lf.nullable or how in ("right", "full")))
+        fields += [Field(f.name, f.dtype, f.nullable or how in ("right", "full"))
+                   for f in ls.fields if f.name not in on]
+        fields += [Field(f.name, f.dtype, f.nullable or how in ("left", "full"))
+                   for f in rs.fields if f.name not in on]
+    else:
+        fields += [Field(f.name, f.dtype, f.nullable or how in ("right", "full"))
+                   for f in ls.fields]
+        fields += [Field(f.name, f.dtype, f.nullable or how in ("left", "full"))
+                   for f in rs.fields]
+    return Schema(fields)
+
+
+class LogicalUnion(LogicalPlan):
+    def __init__(self, children: Sequence[LogicalPlan]):
+        assert len(children) >= 2
+        self.children = tuple(children)
+        first = children[0].schema
+        for c in children[1:]:
+            s = c.schema
+            if s.names != first.names or [f.dtype for f in s] != [f.dtype for f in first]:
+                raise TypeError(f"union schema mismatch: {first!r} vs {s!r}")
+
+    @property
+    def schema(self) -> Schema:
+        first = self.children[0].schema
+        nullable = [any(c.schema.fields[i].nullable for c in self.children)
+                    for i in range(len(first))]
+        return Schema([Field(f.name, f.dtype, nb)
+                       for f, nb in zip(first.fields, nullable)])
+
+
+class LogicalCache(LogicalPlan):
+    """df.cache(): materialized child (device-resident when lowered)."""
+
+    def __init__(self, child: LogicalPlan):
+        from ..exec.cache import CacheStorage
+        self.child = child
+        self.children = (child,)
+        self.storage = CacheStorage()
+
+    @property
+    def schema(self) -> Schema:
+        return self.child.schema
+
+
+class LogicalRange(LogicalPlan):
+    """range(start, end, step) -> single LONG column ``id`` (reference: GpuRangeExec)."""
+
+    def __init__(self, start: int, end: int, step: int = 1, num_partitions: int = 1):
+        assert step != 0
+        self.start, self.end, self.step = start, end, step
+        self.num_partitions = num_partitions
+        self.children = ()
+
+    @property
+    def schema(self) -> Schema:
+        return Schema([Field("id", dt.LONG, False)])
+
+
+def _named(e: Expression, i: int, prefix: str = "col") -> Expression:
+    """Ensure a projected expression has a stable output name."""
+    if isinstance(e, (Alias, AttributeReference)):
+        return e
+    from ..expr.aggregates import AggregateFunction as AF
+    if isinstance(e, AF):
+        return e
+    return Alias(e, f"{prefix}_{i}" if not _pretty_name(e) else _pretty_name(e))
+
+
+def _pretty_name(e: Expression) -> Optional[str]:
+    return None
+
+
+def _default_agg_name(fn: AggregateFunction) -> str:
+    base = type(fn).__name__.lower()
+    if fn.children:
+        c = fn.children[0]
+        inner = c.name if isinstance(c, (AttributeReference, Alias)) else "expr"
+        return f"{base}({inner})"
+    return f"{base}(*)"
+
+
+def _check_dup(names: Sequence[str]):
+    if len(set(names)) != len(names):
+        dupes = sorted({n for n in names if list(names).count(n) > 1})
+        raise ValueError(f"duplicate output columns: {dupes}")

@@ -1,0 +1,196 @@
+"""TpuOverrides — the rule registry + main override pass
+(reference: GpuOverrides.scala:4008 apply; rule tables at :3348-3800).
+
+``apply_overrides(cpu_plan, conf)`` wraps the plan in metas, tags every node
+and expression with device capability (recording fallback reasons), optionally
+prints explain output, converts convertible subtrees to Tpu execs, inserts
+host<->device transitions (GpuTransitionOverrides analogue), and finally runs
+whole-stage fusion.
+"""
+from __future__ import annotations
+
+from typing import List
+
+from ..columnar import dtypes as dt
+from ..columnar.dtypes import TypeEnum, TypeSig
+from ..conf import RapidsConf
+from ..expr import (Abs, Alias, And, AttributeReference, BinaryArithmetic,
+                    BinaryComparison, CaseWhen, Cast, Coalesce, EqualNullSafe,
+                    If, In, IsNaN, IsNotNull, IsNull, Literal, Not, Or,
+                    UnaryMinus)
+from ..expr.aggregates import AggregateFunction
+from ..expr.math import (Atan2, Ceil, Floor, Pow, Round, UnaryMathExpression)
+from .meta import (EXEC_RULES, EXPR_RULES, register_exec_rule,
+                   register_expr_rule, wrap_plan)
+from .physical import (CpuFilterExec, CpuHashAggregateExec, CpuLocalLimitExec,
+                       CpuProjectExec, CpuRangeExec, CpuSortExec, CpuUnionExec,
+                       PhysicalPlan)
+
+__all__ = ["apply_overrides", "explain_plan"]
+
+# device-supported scalar types (strings supported for carry/compare, not yet
+# as aggregation keys or in every expression)
+_device_common = (TypeSig.gpuNumeric
+                  + TypeSig.of(TypeEnum.BOOLEAN, TypeEnum.DATE,
+                               TypeEnum.TIMESTAMP, TypeEnum.NULL))
+_device_all = _device_common + TypeSig.of(TypeEnum.STRING, TypeEnum.BINARY)
+
+
+def _register_expr_rules():
+    register_expr_rule(AttributeReference, _device_all)
+    register_expr_rule(Literal, _device_all)
+    register_expr_rule(Alias, _device_all)
+    register_expr_rule(BinaryArithmetic, _device_common)
+    register_expr_rule(UnaryMinus, _device_common)
+    register_expr_rule(Abs, _device_common)
+    register_expr_rule(BinaryComparison, _device_all)
+    register_expr_rule(EqualNullSafe, _device_all)
+    register_expr_rule(And, TypeSig.of(TypeEnum.BOOLEAN))
+    register_expr_rule(Or, TypeSig.of(TypeEnum.BOOLEAN))
+    register_expr_rule(Not, TypeSig.of(TypeEnum.BOOLEAN))
+    register_expr_rule(IsNull, _device_all)
+    register_expr_rule(IsNotNull, _device_all)
+    register_expr_rule(IsNaN, _device_common)
+    register_expr_rule(In, _device_all)
+    register_expr_rule(If, _device_all)
+    register_expr_rule(CaseWhen, _device_all)
+    register_expr_rule(Coalesce, _device_all)
+    register_expr_rule(UnaryMathExpression, TypeSig.fp + TypeSig.integral)
+    register_expr_rule(Ceil, _device_common)
+    register_expr_rule(Floor, _device_common)
+    register_expr_rule(Round, _device_common)
+    register_expr_rule(Pow, TypeSig.fp + TypeSig.integral)
+    register_expr_rule(Atan2, TypeSig.fp + TypeSig.integral)
+
+    def tag_cast(meta, conf):
+        c: Cast = meta.expr
+        src = c.child.data_type
+        if isinstance(c.to, (dt.StringType, dt.BinaryType)):
+            meta.cannot_run("cast to string not implemented on device")
+        if isinstance(src, (dt.StringType, dt.BinaryType)) and src != c.to:
+            meta.cannot_run("cast from string not implemented on device")
+    register_expr_rule(Cast, _device_all, tag_fn=tag_cast)
+
+    # aggregate functions: checked inside aggregate exec rule; sig covers
+    # their input expressions
+    register_expr_rule(AggregateFunction, _device_common)
+
+
+def _register_exec_rules():
+    from ..exec.aggregate import TpuHashAggregateExec
+    from ..exec.basic import (TpuFilterExec, TpuLocalLimitExec, TpuProjectExec,
+                              TpuRangeExec, TpuUnionExec)
+    from ..exec.sort import TpuSortExec
+
+    register_exec_rule(
+        CpuProjectExec, _device_all,
+        lambda p, ch, conf: TpuProjectExec(ch[0], p.exprs, p.names),
+        exprs_fn=lambda p: p.exprs)
+
+    register_exec_rule(
+        CpuFilterExec, _device_all,
+        lambda p, ch, conf: TpuFilterExec(ch[0], p.condition),
+        exprs_fn=lambda p: [p.condition])
+
+    register_exec_rule(
+        CpuRangeExec, _device_all,
+        lambda p, ch, conf: TpuRangeExec(p.start, p.end, p.step, p.num_partitions,
+                                         conf.min_bucket_rows))
+
+    register_exec_rule(
+        CpuUnionExec, _device_all,
+        lambda p, ch, conf: TpuUnionExec(ch))
+
+    register_exec_rule(
+        CpuLocalLimitExec, _device_all,
+        lambda p, ch, conf: TpuLocalLimitExec(ch[0], p.n))
+
+    def tag_agg(meta, conf):
+        p: CpuHashAggregateExec = meta.plan
+        for k in p.key_names:
+            kt = p.child.schema.field(k).dtype
+            if isinstance(kt, (dt.StringType, dt.BinaryType)):
+                meta.cannot_run(
+                    f"group-by key {k}: string keys not yet supported on device")
+            elif not _device_common.is_supported(kt):
+                meta.cannot_run(f"group-by key {k}: {kt!r} not supported")
+        for s in p.specs:
+            for (n, d, _) in s.state_fields:
+                if not _device_common.is_supported(d):
+                    meta.cannot_run(f"aggregate state {n}: {d!r} not supported "
+                                    "on device")
+            in_schema = p.child.schema
+            in_cols = s.input_cols if p.mode == "partial" \
+                else [n for (n, _, _) in s.state_fields]
+            for c in in_cols:
+                ct = in_schema.field(c).dtype
+                if not _device_common.is_supported(ct):
+                    meta.cannot_run(f"aggregate input {c}: {ct!r} not supported "
+                                    "on device")
+
+    register_exec_rule(
+        CpuHashAggregateExec, _device_all,
+        lambda p, ch, conf: TpuHashAggregateExec(ch[0], p.key_names, p.specs,
+                                                 p.mode),
+        tag_fn=tag_agg)
+
+    from ..exec.cache import CpuCacheExec, TpuCacheExec
+    register_exec_rule(
+        CpuCacheExec, _device_all,
+        lambda p, ch, conf: TpuCacheExec(ch[0], p.storage))
+
+    def tag_sort(meta, conf):
+        p: CpuSortExec = meta.plan
+        for o in p.orders:
+            if isinstance(o.expr.data_type, (dt.StringType, dt.BinaryType)):
+                meta.cannot_run("string sort keys not yet supported on device")
+
+    register_exec_rule(
+        CpuSortExec, _device_all,
+        lambda p, ch, conf: TpuSortExec(ch[0], p.orders),
+        exprs_fn=lambda p: [o.expr for o in p.orders],
+        tag_fn=tag_sort)
+
+
+_register_expr_rules()
+_register_exec_rules()
+
+
+def explain_plan(cpu_plan: PhysicalPlan, conf: RapidsConf) -> str:
+    meta = wrap_plan(cpu_plan)
+    meta.tag(conf)
+    return meta.explain(not_on_device_only=(conf.explain == "NOT_ON_GPU"))
+
+
+def apply_overrides(cpu_plan: PhysicalPlan, conf: RapidsConf) -> PhysicalPlan:
+    """Tag + convert + insert transitions + fuse (SURVEY §3.2 call stack)."""
+    if not conf.is_sql_enabled:
+        return cpu_plan
+    meta = wrap_plan(cpu_plan)
+    meta.tag(conf)
+    if conf.explain != "NONE":
+        text = meta.explain(not_on_device_only=(conf.explain == "NOT_ON_GPU"))
+        if text:
+            print(text)
+    if conf.test_enabled:
+        allowed = set(conf.allowed_non_tpu)
+        for m in meta.walk():
+            name = type(m.plan).__name__.replace("Cpu", "")
+            if not m.can_run and name not in allowed \
+                    and not _always_cpu(m.plan):
+                raise AssertionError(
+                    f"[test.enabled] {name} fell off the device: {m.reasons}")
+    if conf.is_explain_only:
+        return cpu_plan
+    converted = meta.convert_if_needed(conf)
+    from .transitions import insert_transitions
+    from ..exec.wholestage import fuse_stages
+    with_transitions = insert_transitions(converted, conf)
+    return fuse_stages(with_transitions)
+
+
+def _always_cpu(plan: PhysicalPlan) -> bool:
+    """Nodes with no device rule by design (scans/exchanges stay host-side in
+    this round; see SURVEY §7.5)."""
+    from .physical import CpuScanExec, CpuGlobalLimitExec, ShuffleExchangeExec
+    return isinstance(plan, (CpuScanExec, ShuffleExchangeExec, CpuGlobalLimitExec))

@@ -1,0 +1,226 @@
+"""CPU join operators (fallback engine side of the reference's join family,
+SURVEY §2.4: GpuShuffledHashJoinExec / GpuBroadcastHashJoinExec /
+GpuBroadcastNestedLoopJoinExec / GpuCartesianProductExec).
+
+Spark join-key semantics: null keys never match (except null-safe equality,
+not yet planned); NaN keys match NaN; -0.0 matches 0.0; ``on=`` (same-name)
+joins output the key columns once (coalesced for full outer), expression
+equi-joins keep both sides' columns.
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pandas as pd
+
+from ..columnar import dtypes as dt
+from ..columnar.host import HostColumn, HostTable
+from ..expr.base import EvalContext, Expression
+from .physical import PhysicalPlan, _empty_values
+from .schema import Schema
+from .logical import _join_schema
+
+__all__ = ["CpuShuffledHashJoinExec", "CpuBroadcastNestedLoopJoinExec",
+           "join_host_tables"]
+
+
+def _factorize_pair(lt: HostTable, rt: HostTable, lkeys: Sequence[str],
+                    rkeys: Sequence[str]):
+    """Comparable integer key codes across both sides + any-null masks."""
+    lcodes, lnull = {}, np.zeros(lt.num_rows, dtype=bool)
+    rcodes, rnull = {}, np.zeros(rt.num_rows, dtype=bool)
+    for i, (lkn, rkn) in enumerate(zip(lkeys, rkeys)):
+        lc, rc = lt.column(lkn), rt.column(rkn)
+        lnull |= ~lc.valid_mask()
+        rnull |= ~rc.valid_mask()
+        lv, rv = lc.values, rc.values
+        if lv.dtype == object or rv.dtype == object or lv.dtype.kind == "f" \
+                or rv.dtype.kind == "f":
+            combined = np.concatenate([lv, rv])
+            if combined.dtype.kind == "f":
+                combined = combined.copy()
+                combined[combined == 0] = 0.0
+            codes = pd.factorize(combined, use_na_sentinel=False)[0]
+            lcodes[f"k{i}"] = codes[:lt.num_rows]
+            rcodes[f"k{i}"] = codes[lt.num_rows:]
+        else:
+            lcodes[f"k{i}"] = lv.astype(np.int64)
+            rcodes[f"k{i}"] = rv.astype(np.int64)
+    return (pd.DataFrame(lcodes), lnull), (pd.DataFrame(rcodes), rnull)
+
+
+def _gather_with_nulls(table: HostTable, idx: np.ndarray) -> HostTable:
+    """take() where idx == -1 produces an all-null row."""
+    safe = np.where(idx < 0, 0, idx)
+    out_cols: List[HostColumn] = []
+    matched = idx >= 0
+    for c in table.columns:
+        if table.num_rows == 0:
+            vals = np.zeros(len(idx), dtype=c.values.dtype
+                            if c.values.dtype != object else object)
+            if c.values.dtype == object:
+                vals[:] = ""
+            out_cols.append(HostColumn(c.dtype, vals,
+                                       np.zeros(len(idx), dtype=bool)))
+            continue
+        vals = c.values[safe]
+        validity = c.valid_mask()[safe] & matched
+        out_cols.append(HostColumn(c.dtype, vals,
+                                   None if validity.all() else validity))
+    return HostTable(list(table.names), out_cols)
+
+
+def join_host_tables(lt: HostTable, rt: HostTable, lkeys: Sequence[str],
+                     rkeys: Sequence[str], how: str,
+                     condition: Optional[Expression],
+                     merge_keys: bool) -> HostTable:
+    if how == "cross" or not lkeys:
+        li = np.repeat(np.arange(lt.num_rows, dtype=np.int64), rt.num_rows)
+        ri = np.tile(np.arange(rt.num_rows, dtype=np.int64), lt.num_rows)
+    else:
+        (lk, lnull), (rk, rnull) = _factorize_pair(lt, rt, lkeys, rkeys)
+        lk = lk.assign(_lidx=np.arange(lt.num_rows, dtype=np.int64))
+        rk = rk.assign(_ridx=np.arange(rt.num_rows, dtype=np.int64))
+        keys = [c for c in lk.columns if c.startswith("k")]
+        merged = lk[~lnull].merge(rk[~rnull], on=keys, how="inner")
+        li = merged["_lidx"].to_numpy()
+        ri = merged["_ridx"].to_numpy()
+    if condition is not None:
+        pairs = _combine(lt, rt, li, ri, lkeys, rkeys, "inner", False)
+        ctx = EvalContext.for_host(pairs)
+        c = condition.eval(ctx)
+        keep = np.asarray(c.values, dtype=np.bool_)
+        if c.validity is not None:
+            keep &= c.validity
+        li, ri = li[keep], ri[keep]
+    if how in ("inner", "cross"):
+        return _combine(lt, rt, li, ri, lkeys, rkeys, how, merge_keys)
+    if how == "left_semi":
+        matched = np.zeros(lt.num_rows, dtype=bool)
+        matched[li] = True
+        return lt.take(np.nonzero(matched)[0])
+    if how == "left_anti":
+        matched = np.zeros(lt.num_rows, dtype=bool)
+        matched[li] = True
+        return lt.take(np.nonzero(~matched)[0])
+    if how in ("left", "right", "full"):
+        li2, ri2 = li, ri
+        if how in ("left", "full"):
+            lmatched = np.zeros(lt.num_rows, dtype=bool)
+            lmatched[li] = True
+            extra = np.nonzero(~lmatched)[0]
+            li2 = np.concatenate([li2, extra])
+            ri2 = np.concatenate([ri2, np.full(len(extra), -1, dtype=np.int64)])
+        if how in ("right", "full"):
+            rmatched = np.zeros(rt.num_rows, dtype=bool)
+            rmatched[ri] = True
+            extra = np.nonzero(~rmatched)[0]
+            ri2 = np.concatenate([ri2, extra])
+            li2 = np.concatenate([li2, np.full(len(extra), -1, dtype=np.int64)])
+        return _combine(lt, rt, li2, ri2, lkeys, rkeys, how, merge_keys)
+    raise ValueError(how)
+
+
+def _combine(lt: HostTable, rt: HostTable, li: np.ndarray, ri: np.ndarray,
+             lkeys: Sequence[str], rkeys: Sequence[str], how: str,
+             merge_keys: bool) -> HostTable:
+    lpart = _gather_with_nulls(lt, li)
+    rpart = _gather_with_nulls(rt, ri)
+    names: List[str] = []
+    cols: List[HostColumn] = []
+    on = list(lkeys) if merge_keys else []
+    for k in on:
+        lc = lpart.column(k)
+        if how in ("right", "full"):
+            rc = rpart.column(k)
+            lv = lc.valid_mask()
+            vals = lc.values.copy()
+            take_r = ~lv
+            vals[take_r] = rc.values[take_r]
+            validity = lv | rc.valid_mask()
+            cols.append(HostColumn(lc.dtype, vals,
+                                   None if validity.all() else validity))
+        else:
+            cols.append(lc)
+        names.append(k)
+    skip_r = set(on)
+    for n, c in zip(lpart.names, lpart.columns):
+        if n not in on:
+            names.append(n)
+            cols.append(c)
+    for n, c in zip(rpart.names, rpart.columns):
+        if n not in skip_r:
+            names.append(n)
+            cols.append(c)
+    return HostTable(names, cols)
+
+
+class CpuShuffledHashJoinExec(PhysicalPlan):
+    def __init__(self, left: PhysicalPlan, right: PhysicalPlan,
+                 left_keys: Sequence[str], right_keys: Sequence[str],
+                 how: str, condition: Optional[Expression],
+                 merge_keys: bool):
+        self.left, self.right = left, right
+        self.children = (left, right)
+        self.left_keys = list(left_keys)
+        self.right_keys = list(right_keys)
+        self.how = how
+        self.condition = condition
+        self.merge_keys = merge_keys
+        on = self.left_keys if merge_keys else None
+        self.schema = _join_schema(left.schema, right.schema, on, how)
+
+    @property
+    def num_partitions(self) -> int:
+        return self.left.num_partitions
+
+    def execute(self, pidx: int) -> Iterator[HostTable]:
+        lbatches = list(self.left.execute(pidx))
+        rbatches = list(self.right.execute(pidx))
+        lt = HostTable.concat(lbatches) if lbatches else _empty_like(self.left.schema)
+        rt = HostTable.concat(rbatches) if rbatches else _empty_like(self.right.schema)
+        out = join_host_tables(lt, rt, self.left_keys, self.right_keys,
+                               self.how, self.condition, self.merge_keys)
+        yield HostTable(self.schema.names, out.columns)
+
+    def node_desc(self):
+        return f"{self.how} lkeys={self.left_keys} rkeys={self.right_keys}"
+
+
+class CpuBroadcastNestedLoopJoinExec(PhysicalPlan):
+    """Cross/conditional join: right side broadcast (materialized once)."""
+
+    def __init__(self, left: PhysicalPlan, right: PhysicalPlan, how: str,
+                 condition: Optional[Expression]):
+        self.left, self.right = left, right
+        self.children = (left, right)
+        self.how = how
+        self.condition = condition
+        self.schema = _join_schema(left.schema, right.schema, None, how)
+        self._broadcast: Optional[HostTable] = None
+
+    @property
+    def num_partitions(self) -> int:
+        return self.left.num_partitions
+
+    def _right_table(self) -> HostTable:
+        if self._broadcast is None:
+            batches = []
+            for p in range(self.right.num_partitions):
+                batches.extend(self.right.execute(p))
+            self._broadcast = HostTable.concat(batches) if batches \
+                else _empty_like(self.right.schema)
+        return self._broadcast
+
+    def execute(self, pidx: int) -> Iterator[HostTable]:
+        rt = self._right_table()
+        for batch in self.left.execute(pidx):
+            out = join_host_tables(batch, rt, [], [], self.how, self.condition,
+                                   False)
+            yield HostTable(self.schema.names, out.columns)
+
+
+def _empty_like(schema: Schema) -> HostTable:
+    return HostTable(schema.names,
+                     [HostColumn(f.dtype, _empty_values(f.dtype)) for f in schema])

@@ -1,0 +1,145 @@
+"""Logical -> CPU physical planning.
+
+Produces the CPU plan that the overrides layer (plan/overrides.py) then tags
+and lowers onto the device — structurally the same two-step as Spark physical
+planning + the reference's ColumnarOverrideRules (SURVEY §3.2).
+
+Aggregates are planned two-phase (partial -> exchange -> final -> post-project)
+like Spark/the reference; sorts get a range exchange; limits a single-partition
+exchange.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Set
+
+from ..conf import RapidsConf, register_conf
+from ..expr.base import Alias, AttributeReference, Expression
+from .logical import (LogicalAggregate, LogicalCache, LogicalFilter,
+                      LogicalJoin, LogicalLimit, LogicalPlan, LogicalProject,
+                      LogicalRange, LogicalScan, LogicalSort, LogicalUnion)
+from .physical import (AggSpec, CpuFilterExec, CpuGlobalLimitExec,
+                       CpuHashAggregateExec, CpuLocalLimitExec, CpuProjectExec,
+                       CpuRangeExec, CpuScanExec, CpuSortExec, CpuUnionExec,
+                       HashPartitioning, PhysicalPlan, RangePartitioning,
+                       ShuffleExchangeExec, SinglePartitioning)
+
+SHUFFLE_PARTITIONS = register_conf(
+    "spark.rapids.tpu.shuffle.partitions",
+    "Number of output partitions for hash/range exchanges (Spark's "
+    "spark.sql.shuffle.partitions analogue).", 8)
+
+__all__ = ["plan_physical", "SHUFFLE_PARTITIONS"]
+
+
+def plan_physical(logical: LogicalPlan, conf: RapidsConf) -> PhysicalPlan:
+    return _plan(logical, conf, required=None)
+
+
+def _plan(node: LogicalPlan, conf: RapidsConf,
+          required: Optional[Set[str]]) -> PhysicalPlan:
+    nparts = conf.get(SHUFFLE_PARTITIONS)
+
+    if isinstance(node, LogicalScan):
+        cols = None
+        if required is not None:
+            cols = [n for n in node.schema.names if n in required]
+            if not cols:  # count(*)-style: keep the narrowest column
+                cols = [node.schema.names[0]] if node.schema.names else None
+        return CpuScanExec(node.source, cols)
+
+    if isinstance(node, LogicalProject):
+        refs = _refs(e for e in node.exprs)
+        child = _plan(node.child, conf, refs)
+        return CpuProjectExec(child, node.exprs, [e.name for e in node.exprs])
+
+    if isinstance(node, LogicalFilter):
+        child_req = None if required is None \
+            else required | node.condition.references()
+        child = _plan(node.child, conf, child_req)
+        return CpuFilterExec(child, node.condition)
+
+    if isinstance(node, LogicalAggregate):
+        refs = _refs(node.groupings)
+        for _, fn in node.aggregates:
+            refs |= _refs(fn.input_projection())
+        child = _plan(node.child, conf, refs)
+        return plan_aggregate(child, node, nparts)
+
+    if isinstance(node, LogicalSort):
+        child_req = None if required is None \
+            else required | _refs(o.expr for o in node.orders)
+        child = _plan(node.child, conf, child_req)
+        if node.global_sort and child.num_partitions > 1:
+            part = RangePartitioning(node.orders, nparts)
+            child = ShuffleExchangeExec(child, part)
+        return CpuSortExec(child, node.orders)
+
+    if isinstance(node, LogicalLimit):
+        child = _plan(node.child, conf, required)
+        local = CpuLocalLimitExec(child, node.n)
+        if child.num_partitions > 1:
+            single = ShuffleExchangeExec(local, SinglePartitioning())
+            return CpuGlobalLimitExec(single, node.n)
+        return CpuGlobalLimitExec(local, node.n)
+
+    if isinstance(node, LogicalUnion):
+        children = [_plan(c, conf, required) for c in node.children]
+        return CpuUnionExec(children)
+
+    if isinstance(node, LogicalRange):
+        return CpuRangeExec(node.start, node.end, node.step, node.num_partitions)
+
+    if isinstance(node, LogicalCache):
+        from ..exec.cache import CpuCacheExec
+        # caches materialize every column; no pruning through them
+        child = _plan(node.child, conf, None)
+        return CpuCacheExec(child, node.storage)
+
+    if isinstance(node, LogicalJoin):
+        from .joins_planner import plan_join
+        return plan_join(node, conf, required, _plan, nparts)
+
+    raise NotImplementedError(type(node).__name__)
+
+
+def plan_aggregate(child: PhysicalPlan, node: LogicalAggregate,
+                   nparts: int) -> PhysicalPlan:
+    # 1. pre-projection: group keys + aggregate inputs
+    specs = [AggSpec(f"_agg{i}", fn) for i, (_, fn) in enumerate(node.aggregates)]
+    pre_exprs: List[Expression] = list(node.groupings)
+    pre_names: List[str] = [g.name for g in node.groupings]
+    for spec in specs:
+        for in_name, in_expr in zip(spec.input_cols, spec.fn.input_projection()):
+            pre_exprs.append(in_expr)
+            pre_names.append(in_name)
+    pre = CpuProjectExec(child, pre_exprs, pre_names)
+    key_names = [g.name for g in node.groupings]
+    # 2. partial aggregate
+    partial = CpuHashAggregateExec(pre, key_names, specs, "partial")
+    # 3. exchange
+    if key_names:
+        exchange = ShuffleExchangeExec(partial, HashPartitioning(key_names, nparts)) \
+            if partial.num_partitions > 1 else partial
+    else:
+        exchange = ShuffleExchangeExec(partial, SinglePartitioning()) \
+            if partial.num_partitions > 1 else partial
+    # 4. final merge
+    final = CpuHashAggregateExec(exchange, key_names, specs, "final")
+    # 5. post-projection: keys + evaluated aggregate results
+    post_exprs: List[Expression] = []
+    post_names: List[str] = []
+    for g in node.groupings:
+        f = final.schema.field(g.name)
+        post_exprs.append(AttributeReference(g.name, f.dtype, f.nullable))
+        post_names.append(g.name)
+    for spec, (out_name, _) in zip(specs, node.aggregates):
+        post_exprs.append(spec.fn.evaluate(spec.prefix))
+        post_names.append(out_name)
+    return CpuProjectExec(final, post_exprs, post_names)
+
+
+def _refs(exprs) -> Set[str]:
+    out: Set[str] = set()
+    for e in exprs:
+        out |= e.references()
+    return out

@@ -1,0 +1,53 @@
+"""Transition insertion (reference: GpuTransitionOverrides.scala:37).
+
+Walks the converted (mixed CPU/TPU) plan and inserts:
+- ``HostToDeviceExec`` where a device operator consumes a host-producing child
+- ``DeviceToHostExec`` where a host operator (or the collect boundary)
+  consumes a device operator
+
+Coalesce goals: device aggregates and sorts prefer larger batches; a
+``TpuCoalesceBatchesExec`` is inserted above upload when the producer is a
+multi-batch scan (reference: childrenCoalesceGoal / GpuCoalesceBatches).
+"""
+from __future__ import annotations
+
+from ..conf import RapidsConf
+from ..exec.base import TpuExec
+from ..exec.transitions import DeviceToHostExec, HostToDeviceExec
+from .physical import PhysicalPlan
+
+__all__ = ["insert_transitions"]
+
+
+def _is_device(node: PhysicalPlan) -> bool:
+    return isinstance(node, TpuExec)
+
+
+def insert_transitions(plan: PhysicalPlan, conf: RapidsConf) -> PhysicalPlan:
+    out = _walk(plan, conf)
+    if _is_device(out):
+        out = DeviceToHostExec(out)
+    return out
+
+
+def _walk(node: PhysicalPlan, conf: RapidsConf) -> PhysicalPlan:
+    new_children = []
+    for c in node.children:
+        c2 = _walk(c, conf)
+        if _is_device(node) and not _is_device(c2):
+            c2 = HostToDeviceExec(c2, conf.min_bucket_rows)
+        elif not _is_device(node) and _is_device(c2):
+            c2 = DeviceToHostExec(c2)
+        new_children.append(c2)
+    return _set_children(node, new_children)
+
+
+def _set_children(node: PhysicalPlan, children) -> PhysicalPlan:
+    if list(node.children) == children:
+        return node
+    node.children = tuple(children)
+    if hasattr(node, "child") and len(children) == 1:
+        node.child = children[0]
+    if hasattr(node, "left") and len(children) == 2:
+        node.left, node.right = children
+    return node

@@ -1,0 +1,234 @@
+"""TpuSession + DataFrame — the user entry point.
+
+Plays the combined role of SparkSession + the plugin lifecycle
+(reference: Plugin.scala RapidsDriverPlugin/RapidsExecutorPlugin): holds the
+RapidsConf, initializes the device runtime (semaphore, memory), and drives
+logical -> physical -> overrides -> execution.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Union
+
+import pyarrow as pa
+
+from .conf import RapidsConf
+from .columnar.host import HostTable
+from .expr.base import Expression
+from .expr.functions import Column, SortOrder, _to_expr
+from .plan.logical import (LogicalAggregate, LogicalFilter, LogicalJoin,
+                           LogicalLimit, LogicalPlan, LogicalProject,
+                           LogicalRange, LogicalScan, LogicalSort,
+                           LogicalUnion)
+from .plan.overrides import apply_overrides, explain_plan
+from .plan.physical import PhysicalPlan
+from .plan.planner import plan_physical
+from .plan.schema import Schema
+
+__all__ = ["TpuSession", "DataFrame"]
+
+
+class TpuSession:
+    _active: "Optional[TpuSession]" = None
+
+    def __init__(self, conf: Optional[Union[RapidsConf, Dict]] = None):
+        if isinstance(conf, dict):
+            conf = RapidsConf(conf)
+        self.conf = conf or RapidsConf()
+        TpuSession._active = self
+
+    # -- data sources --------------------------------------------------------
+    def create_dataframe(self, data, schema=None, num_partitions: int = 1
+                         ) -> "DataFrame":
+        from .io.memory import InMemorySource
+        if isinstance(data, pa.Table):
+            table = data
+        elif isinstance(data, dict):
+            table = pa.table(data)
+        elif isinstance(data, HostTable):
+            table = data.to_arrow()
+        else:  # pandas
+            table = pa.Table.from_pandas(data, preserve_index=False)
+        return DataFrame(self, LogicalScan(InMemorySource(table, num_partitions)))
+
+    def read_parquet(self, paths, num_partitions: Optional[int] = None
+                     ) -> "DataFrame":
+        from .io.parquet import ParquetSource
+        return DataFrame(self, LogicalScan(
+            ParquetSource(paths, self.conf, num_partitions)))
+
+    def read_csv(self, paths, schema=None, header: bool = True, sep: str = ",",
+                 num_partitions: Optional[int] = None) -> "DataFrame":
+        from .io.csv import CsvSource
+        return DataFrame(self, LogicalScan(
+            CsvSource(paths, self.conf, schema=schema, header=header, sep=sep,
+                      num_partitions=num_partitions)))
+
+    def read_json(self, paths, num_partitions: Optional[int] = None
+                  ) -> "DataFrame":
+        from .io.json import JsonSource
+        return DataFrame(self, LogicalScan(
+            JsonSource(paths, self.conf, num_partitions=num_partitions)))
+
+    def read_orc(self, paths, num_partitions: Optional[int] = None
+                 ) -> "DataFrame":
+        from .io.orc import OrcSource
+        return DataFrame(self, LogicalScan(
+            OrcSource(paths, self.conf, num_partitions=num_partitions)))
+
+    def range(self, start: int, end: Optional[int] = None, step: int = 1,
+              num_partitions: int = 1) -> "DataFrame":
+        if end is None:
+            start, end = 0, start
+        return DataFrame(self, LogicalRange(start, end, step, num_partitions))
+
+    # -- execution -----------------------------------------------------------
+    def _physical(self, logical: LogicalPlan,
+                  device: Optional[bool] = None) -> PhysicalPlan:
+        cpu = plan_physical(logical, self.conf)
+        use_device = self.conf.is_sql_enabled if device is None else device
+        if not use_device:
+            return cpu
+        return apply_overrides(cpu, self.conf)
+
+    def set_conf(self, key: str, value) -> "TpuSession":
+        self.conf = self.conf.set(key, value)
+        return self
+
+
+class DataFrame:
+    def __init__(self, session: TpuSession, logical: LogicalPlan):
+        self.session = session
+        self.logical = logical
+
+    @property
+    def schema(self) -> Schema:
+        return self.logical.schema
+
+    @property
+    def columns(self) -> List[str]:
+        return self.schema.names
+
+    # -- transformations -----------------------------------------------------
+    def select(self, *cols) -> "DataFrame":
+        exprs = [self._col_expr(c) for c in cols]
+        return DataFrame(self.session, LogicalProject(self.logical, exprs))
+
+    def with_column(self, name: str, c) -> "DataFrame":
+        from .expr.base import Alias, AttributeReference
+        exprs: List[Expression] = [
+            AttributeReference(n) for n in self.schema.names if n != name]
+        exprs.append(Alias(_to_expr(c), name))
+        return DataFrame(self.session, LogicalProject(self.logical, exprs))
+
+    def filter(self, cond) -> "DataFrame":
+        return DataFrame(self.session,
+                         LogicalFilter(self.logical, _to_expr(cond)))
+
+    where = filter
+
+    def group_by(self, *cols) -> "GroupedData":
+        return GroupedData(self, [self._col_expr(c) for c in cols])
+
+    groupBy = group_by
+
+    def agg(self, *aggs) -> "DataFrame":
+        return GroupedData(self, []).agg(*aggs)
+
+    def sort(self, *orders, ascending: bool = True) -> "DataFrame":
+        sos = []
+        for o in orders:
+            if isinstance(o, SortOrder):
+                sos.append(o)
+            elif isinstance(o, Column):
+                sos.append(SortOrder(o.expr, ascending))
+            else:
+                sos.append(SortOrder(_to_expr(_as_col(o)), ascending))
+        return DataFrame(self.session, LogicalSort(self.logical, sos, True))
+
+    order_by = sort
+    orderBy = sort
+
+    def cache(self) -> "DataFrame":
+        from .plan.logical import LogicalCache
+        return DataFrame(self.session, LogicalCache(self.logical))
+
+    def limit(self, n: int) -> "DataFrame":
+        return DataFrame(self.session, LogicalLimit(self.logical, n))
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        return DataFrame(self.session, LogicalUnion([self.logical, other.logical]))
+
+    union_all = union
+
+    def join(self, other: "DataFrame", on=None, how: str = "inner",
+             condition=None) -> "DataFrame":
+        if isinstance(on, str):
+            on = [on]
+        cond = _to_expr(condition) if condition is not None else None
+        return DataFrame(self.session,
+                         LogicalJoin(self.logical, other.logical, on, cond, how))
+
+    def cross_join(self, other: "DataFrame") -> "DataFrame":
+        return DataFrame(self.session,
+                         LogicalJoin(self.logical, other.logical, None, None,
+                                     "cross"))
+
+    def _col_expr(self, c) -> Expression:
+        return _to_expr(_as_col(c))
+
+    # -- actions -------------------------------------------------------------
+    def collect(self, device: Optional[bool] = None) -> pa.Table:
+        plan = self.session._physical(self.logical, device)
+        return plan.collect().to_arrow()
+
+    def to_pandas(self, device: Optional[bool] = None):
+        return self.collect(device).to_pandas()
+
+    def count(self) -> int:
+        from .expr.functions import count_star
+        t = self.agg(count_star().alias("n")).collect()
+        return t.column("n")[0].as_py()
+
+    def explain(self, mode: str = "plan") -> str:
+        cpu = plan_physical(self.logical, self.session.conf)
+        if mode == "tpu":
+            text = explain_plan(cpu, self.session.conf)
+        else:
+            plan = self.session._physical(self.logical)
+            text = plan.tree_string()
+        print(text)
+        return text
+
+    def write_parquet(self, path, **kw):
+        from .io.writer import write_parquet
+        write_parquet(self, path, **kw)
+
+    def write_csv(self, path, **kw):
+        from .io.writer import write_csv
+        write_csv(self, path, **kw)
+
+    def write_orc(self, path, **kw):
+        from .io.writer import write_orc
+        write_orc(self, path, **kw)
+
+
+class GroupedData:
+    def __init__(self, df: DataFrame, groupings: Sequence[Expression]):
+        self.df = df
+        self.groupings = list(groupings)
+
+    def agg(self, *aggs) -> DataFrame:
+        exprs = [_to_expr(a) for a in aggs]
+        return DataFrame(self.df.session,
+                         LogicalAggregate(self.df.logical, self.groupings, exprs))
+
+    def count(self) -> DataFrame:
+        from .expr.functions import count_star
+        return self.agg(count_star().alias("count"))
+
+
+def _as_col(c):
+    from .expr.functions import col as _col
+    if isinstance(c, str):
+        return _col(c)
+    return c

@@ -1,0 +1,195 @@
+"""TPC-H workload support: data generator + query definitions.
+
+The reference ships benchmark workloads (mortgage ETL, NDS) rather than a
+generator; BASELINE.md's ladder starts at TPC-H Q6 @ SF10. This module
+generates TPC-H-shaped data (numpy, seeded) and defines queries against the
+DataFrame API. Prices are double (not decimal) matching the common
+benchmarking simplification; row counts follow the spec scale factors.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pyarrow as pa
+
+__all__ = ["gen_lineitem", "gen_orders", "gen_customer", "gen_part",
+           "gen_supplier", "gen_nation", "gen_region", "q6", "q1", "q3"]
+
+_EPOCH_1992 = 8035   # days from unix epoch to 1992-01-01
+_DATE_RANGE = 2557   # ~7 years of ship dates
+
+
+def gen_lineitem(sf: float, seed: int = 0, rows: int | None = None) -> pa.Table:
+    n = rows if rows is not None else int(6_000_000 * sf)
+    rng = np.random.default_rng(seed)
+    orderkey = rng.integers(1, max(int(1_500_000 * sf), n // 4 + 1) * 4 + 1, size=n)
+    partkey = rng.integers(1, max(int(200_000 * sf), 1) + 1, size=n)
+    suppkey = rng.integers(1, max(int(10_000 * sf), 1) + 1, size=n)
+    quantity = rng.integers(1, 51, size=n).astype(np.float64)
+    extendedprice = np.round(rng.uniform(900.0, 105_000.0, size=n), 2)
+    discount = np.round(rng.integers(0, 11, size=n) * 0.01, 2)
+    tax = np.round(rng.integers(0, 9, size=n) * 0.01, 2)
+    shipdate = (_EPOCH_1992 + rng.integers(0, _DATE_RANGE, size=n)).astype(np.int32)
+    commitdate = shipdate + rng.integers(-30, 31, size=n).astype(np.int32)
+    receiptdate = shipdate + rng.integers(1, 31, size=n).astype(np.int32)
+    returnflag = rng.choice(np.array(["A", "N", "R"]), size=n)
+    linestatus = np.where(shipdate > _EPOCH_1992 + 1460, "O", "F")
+    shipmode = rng.choice(np.array(
+        ["AIR", "FOB", "MAIL", "RAIL", "REG AIR", "SHIP", "TRUCK"]), size=n)
+    return pa.table({
+        "l_orderkey": pa.array(orderkey, type=pa.int64()),
+        "l_partkey": pa.array(partkey, type=pa.int64()),
+        "l_suppkey": pa.array(suppkey, type=pa.int64()),
+        "l_quantity": pa.array(quantity),
+        "l_extendedprice": pa.array(extendedprice),
+        "l_discount": pa.array(discount),
+        "l_tax": pa.array(tax),
+        "l_returnflag": pa.array(returnflag),
+        "l_linestatus": pa.array(linestatus),
+        "l_shipdate": pa.array(shipdate, type=pa.int32()).cast(pa.date32()),
+        "l_commitdate": pa.array(commitdate, type=pa.int32()).cast(pa.date32()),
+        "l_receiptdate": pa.array(receiptdate, type=pa.int32()).cast(pa.date32()),
+        "l_shipmode": pa.array(shipmode),
+    })
+
+
+def gen_orders(sf: float, seed: int = 1, rows: int | None = None) -> pa.Table:
+    n = rows if rows is not None else int(1_500_000 * sf)
+    rng = np.random.default_rng(seed)
+    orderkey = np.arange(1, n + 1, dtype=np.int64) * 4
+    custkey = rng.integers(1, max(int(150_000 * sf), n // 10 + 1) + 1, size=n)
+    totalprice = np.round(rng.uniform(850.0, 560_000.0, size=n), 2)
+    orderdate = (_EPOCH_1992 + rng.integers(0, _DATE_RANGE - 151, size=n)
+                 ).astype(np.int32)
+    orderstatus = rng.choice(np.array(["F", "O", "P"]), size=n)
+    orderpriority = rng.choice(np.array(
+        ["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED", "5-LOW"]), size=n)
+    shippriority = np.zeros(n, dtype=np.int32)
+    return pa.table({
+        "o_orderkey": pa.array(orderkey),
+        "o_custkey": pa.array(custkey, type=pa.int64()),
+        "o_orderstatus": pa.array(orderstatus),
+        "o_totalprice": pa.array(totalprice),
+        "o_orderdate": pa.array(orderdate, type=pa.int32()).cast(pa.date32()),
+        "o_orderpriority": pa.array(orderpriority),
+        "o_shippriority": pa.array(shippriority),
+    })
+
+
+def gen_customer(sf: float, seed: int = 2, rows: int | None = None) -> pa.Table:
+    n = rows if rows is not None else int(150_000 * sf)
+    rng = np.random.default_rng(seed)
+    custkey = np.arange(1, n + 1, dtype=np.int64)
+    nationkey = rng.integers(0, 25, size=n).astype(np.int64)
+    acctbal = np.round(rng.uniform(-999.99, 9999.99, size=n), 2)
+    mktsegment = rng.choice(np.array(
+        ["AUTOMOBILE", "BUILDING", "FURNITURE", "HOUSEHOLD", "MACHINERY"]),
+        size=n)
+    return pa.table({
+        "c_custkey": pa.array(custkey),
+        "c_nationkey": pa.array(nationkey),
+        "c_acctbal": pa.array(acctbal),
+        "c_mktsegment": pa.array(mktsegment),
+    })
+
+
+def gen_part(sf: float, seed: int = 3, rows: int | None = None) -> pa.Table:
+    n = rows if rows is not None else int(200_000 * sf)
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "p_partkey": pa.array(np.arange(1, n + 1, dtype=np.int64)),
+        "p_size": pa.array(rng.integers(1, 51, size=n).astype(np.int32)),
+        "p_retailprice": pa.array(np.round(rng.uniform(900, 2000, size=n), 2)),
+        "p_brand": pa.array(rng.choice(
+            np.array([f"Brand#{i}{j}" for i in range(1, 6) for j in range(1, 6)]),
+            size=n)),
+        "p_container": pa.array(rng.choice(np.array(
+            ["SM CASE", "SM BOX", "MED BAG", "LG JAR", "JUMBO PKG"]), size=n)),
+    })
+
+
+def gen_supplier(sf: float, seed: int = 4, rows: int | None = None) -> pa.Table:
+    n = rows if rows is not None else int(10_000 * sf)
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "s_suppkey": pa.array(np.arange(1, n + 1, dtype=np.int64)),
+        "s_nationkey": pa.array(rng.integers(0, 25, size=n).astype(np.int64)),
+        "s_acctbal": pa.array(np.round(rng.uniform(-999.99, 9999.99, size=n), 2)),
+    })
+
+
+def gen_nation() -> pa.Table:
+    return pa.table({
+        "n_nationkey": pa.array(np.arange(25, dtype=np.int64)),
+        "n_regionkey": pa.array((np.arange(25) % 5).astype(np.int64)),
+        "n_name": pa.array([f"NATION_{i:02d}" for i in range(25)]),
+    })
+
+
+def gen_region() -> pa.Table:
+    return pa.table({
+        "r_regionkey": pa.array(np.arange(5, dtype=np.int64)),
+        "r_name": pa.array(["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDEAST"]),
+    })
+
+
+# ---------------------------------------------------------------------------
+# Queries (DataFrame API). Dates passed as days-since-epoch ints compared
+# against date columns via casts.
+# ---------------------------------------------------------------------------
+_D_1994_01_01 = 8766
+_D_1995_01_01 = 9131
+_D_1998_09_02 = 10471
+_D_1995_03_15 = 9204
+
+
+def q6(lineitem_df):
+    """TPC-H Q6: forecast revenue change (scan+filter+sum, BASELINE ladder #1)."""
+    from ..expr.functions import col, lit, sum as fsum
+    from ..columnar import dtypes as dt
+    sd = col("l_shipdate").cast(dt.INT)
+    return (lineitem_df
+            .filter((sd >= lit(_D_1994_01_01)) & (sd < lit(_D_1995_01_01))
+                    & (col("l_discount") >= lit(0.05))
+                    & (col("l_discount") <= lit(0.07))
+                    & (col("l_quantity") < lit(24.0)))
+            .agg(fsum(col("l_extendedprice") * col("l_discount"))
+                 .alias("revenue")))
+
+
+def q1(lineitem_df):
+    """TPC-H Q1: pricing summary report (grouped agg over most of lineitem)."""
+    from ..expr.functions import avg, col, count_star, lit, sum as fsum
+    from ..columnar import dtypes as dt
+    sd = col("l_shipdate").cast(dt.INT)
+    disc_price = col("l_extendedprice") * (lit(1.0) - col("l_discount"))
+    charge = disc_price * (lit(1.0) + col("l_tax"))
+    return (lineitem_df
+            .filter(sd <= lit(_D_1998_09_02))
+            .group_by("l_returnflag", "l_linestatus")
+            .agg(fsum(col("l_quantity")).alias("sum_qty"),
+                 fsum(col("l_extendedprice")).alias("sum_base_price"),
+                 fsum(disc_price).alias("sum_disc_price"),
+                 fsum(charge).alias("sum_charge"),
+                 avg(col("l_quantity")).alias("avg_qty"),
+                 avg(col("l_extendedprice")).alias("avg_price"),
+                 avg(col("l_discount")).alias("avg_disc"),
+                 count_star().alias("count_order"))
+            .sort("l_returnflag", "l_linestatus"))
+
+
+def q3(lineitem_df, orders_df, customer_df):
+    """TPC-H Q3: shipping priority (join-heavy)."""
+    from ..expr.functions import col, lit, sum as fsum
+    from ..columnar import dtypes as dt
+    od = col("o_orderdate").cast(dt.INT)
+    sd = col("l_shipdate").cast(dt.INT)
+    cust = customer_df.filter(col("c_mktsegment") == lit("BUILDING"))
+    orders = orders_df.filter(od < lit(_D_1995_03_15))
+    li = lineitem_df.filter(sd > lit(_D_1995_03_15))
+    joined = (cust.join(orders, condition=(col("c_custkey") == col("o_custkey")))
+                  .join(li, condition=(col("o_orderkey") == col("l_orderkey"))))
+    rev = col("l_extendedprice") * (lit(1.0) - col("l_discount"))
+    return (joined.group_by("l_orderkey", "o_orderdate", "o_shippriority")
+            .agg(fsum(rev).alias("revenue"))
+            .sort(col("revenue").desc())
+            .limit(10))

@@ -1,0 +1,85 @@
+"""Tiered operator metrics (reference: GpuMetric, GpuExec.scala:30-131).
+
+ESSENTIAL/MODERATE/DEBUG tiers gate collection cost by
+``spark.rapids.sql.metrics.level``; timers measure wall time around device
+dispatch (opTime), upload/download, and semaphore waits.
+"""
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from typing import Dict
+
+__all__ = ["MetricLevel", "Metric", "MetricRegistry"]
+
+
+class MetricLevel:
+    ESSENTIAL = 0
+    MODERATE = 1
+    DEBUG = 2
+
+    _NAMES = {"ESSENTIAL": ESSENTIAL, "MODERATE": MODERATE, "DEBUG": DEBUG}
+
+    @staticmethod
+    def parse(name: str) -> int:
+        return MetricLevel._NAMES[name.upper()]
+
+
+# canonical metric names (reference GpuExec.scala:44-100)
+NUM_OUTPUT_ROWS = "numOutputRows"
+NUM_OUTPUT_BATCHES = "numOutputBatches"
+OP_TIME = "opTime"
+SEMAPHORE_WAIT_TIME = "semaphoreWaitTime"
+UPLOAD_TIME = "hostToDeviceTime"
+DOWNLOAD_TIME = "deviceToHostTime"
+PEAK_DEVICE_MEMORY = "peakDevMemory"
+SPILL_BYTES = "spillBytes"
+SORT_TIME = "sortTime"
+AGG_TIME = "computeAggTime"
+JOIN_TIME = "joinTime"
+COMPILE_TIME = "xlaCompileTime"
+
+
+class Metric:
+    __slots__ = ("name", "level", "value")
+
+    def __init__(self, name: str, level: int):
+        self.name = name
+        self.level = level
+        self.value = 0
+
+    def add(self, v):
+        self.value += v
+
+
+class MetricRegistry:
+    """Per-exec metric set, filtered by the configured level."""
+
+    def __init__(self, collect_level: int = MetricLevel.MODERATE):
+        self.collect_level = collect_level
+        self._metrics: Dict[str, Metric] = {}
+
+    def metric(self, name: str, level: int = MetricLevel.MODERATE) -> Metric:
+        m = self._metrics.get(name)
+        if m is None:
+            m = Metric(name, level)
+            self._metrics[name] = m
+        return m
+
+    def add(self, name: str, v, level: int = MetricLevel.MODERATE):
+        if level <= self.collect_level:
+            self.metric(name, level).add(v)
+
+    @contextmanager
+    def timed(self, name: str, level: int = MetricLevel.MODERATE):
+        if level > self.collect_level:
+            yield
+            return
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.metric(name, level).add(time.perf_counter() - t0)
+
+    def snapshot(self) -> Dict[str, float]:
+        return {k: m.value for k, m in self._metrics.items()}

@@ -1,0 +1,37 @@
+"""Test config: run on a virtual 8-device CPU mesh (no TPU needed in CI).
+
+Mirrors the reference's approach of testing distributed behavior without a
+cluster (SURVEY §4: local-cluster + transport mocks): JAX is forced onto CPU
+with 8 virtual devices so sharding/collective paths compile and run.
+"""
+import os
+
+# NOTE: the environment may pre-set JAX_PLATFORMS (e.g. to a TPU plugin);
+# plain env setdefault is not enough — force CPU through jax.config.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pyarrow as pa  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def session():
+    from spark_rapids_tpu.session import TpuSession
+    return TpuSession({
+        "spark.rapids.tpu.batchRowsMinBucket": 8,
+        "spark.rapids.tpu.shuffle.partitions": 4,
+    })
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

@@ -1,0 +1,77 @@
+"""Columnar representation tests (reference analogues:
+GpuColumnVector round-trips, GpuCoalesceBatchesSuite, GpuPartitioningSuite)."""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.columnar import (DeviceTable, HostTable, bucket_rows,
+                                       concat_device_tables)
+
+
+def test_bucket_rows():
+    assert bucket_rows(1, 8) == 8
+    assert bucket_rows(8, 8) == 8
+    assert bucket_rows(9, 8) == 16
+    assert bucket_rows(1000, 1024) == 1024
+    assert bucket_rows(1025, 1024) == 2048
+
+
+def _roundtrip(t: pa.Table):
+    ht = HostTable.from_arrow(t)
+    dt = DeviceTable.from_host(ht, min_bucket=8)
+    back = dt.to_host().to_arrow()
+    assert back.cast(t.schema).equals(t)
+
+
+def test_roundtrip_numeric_nulls():
+    _roundtrip(pa.table({
+        "i8": pa.array([1, None, -3], type=pa.int8()),
+        "i64": pa.array([2**40, None, -5], type=pa.int64()),
+        "f32": pa.array([1.5, None, float("inf")], type=pa.float32()),
+        "f64": pa.array([1e300, -0.0, None], type=pa.float64()),
+        "b": pa.array([True, None, False]),
+    }))
+
+
+def test_roundtrip_strings_dates():
+    _roundtrip(pa.table({
+        "s": ["", "hello", None, "ünïcode", "x" * 100],
+        "d": pa.array([0, 100, None, 7, -1], type=pa.int32()).cast(pa.date32()),
+        "ts": pa.array([0, None, 2**45, 1, 2],
+                       type=pa.int64()).cast(pa.timestamp("us")),
+    }))
+
+
+def test_filter_mask_and_compact():
+    t = pa.table({"a": list(range(10))})
+    dt = DeviceTable.from_host(HostTable.from_arrow(t), min_bucket=8)
+    import jax.numpy as jnp
+    keep = jnp.asarray(np.arange(16) % 2 == 0)
+    f = dt.filter_mask(keep)
+    assert int(f.num_rows) == 5
+    c = f.compact()
+    out = c.to_host().to_arrow()
+    assert out.column("a").to_pylist() == [0, 2, 4, 6, 8]
+
+
+def test_concat_device_tables():
+    t1 = pa.table({"a": [1, 2, 3], "s": ["x", "yy", None]})
+    t2 = pa.table({"a": [4, None], "s": ["zzzzzzzzzzzzzzzz", "w"]})
+    d1 = DeviceTable.from_host(HostTable.from_arrow(t1), min_bucket=8)
+    d2 = DeviceTable.from_host(HostTable.from_arrow(t2), min_bucket=8)
+    out = concat_device_tables([d1, d2]).to_host().to_arrow()
+    assert out.column("a").to_pylist() == [1, 2, 3, 4, None]
+    assert out.column("s").to_pylist() == ["x", "yy", None, "zzzzzzzzzzzzzzzz", "w"]
+
+
+def test_decimal_roundtrip():
+    import decimal
+    t = pa.table({"d": pa.array(
+        [None, decimal.Decimal("1.25"), decimal.Decimal("-3.50")],
+        type=pa.decimal128(10, 2))})
+    _roundtrip(t)
+
+
+def test_empty_table():
+    _roundtrip(pa.table({"a": pa.array([], type=pa.int64()),
+                         "s": pa.array([], type=pa.string())}))

@@ -1,0 +1,102 @@
+"""Expression differential tests: device vs CPU over random typed data
+(reference analogues: arithmetic/predicate/conditional op integration tests)."""
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.expr.functions import (coalesce, col, lit, when, sqrt,
+                                             abs as fabs, round as fround,
+                                             floor, ceil, pow as fpow)
+from harness import assert_tpu_cpu_equal, data_gen
+
+
+@pytest.fixture
+def df(session, rng):
+    t = data_gen(rng, 200, {
+        "i32": "int32", "i64": "int64", "f64": "float64", "f32": "float32",
+        "b": "bool", "s": "string",
+    })
+    return session.create_dataframe(t)
+
+
+def test_arithmetic(df):
+    assert_tpu_cpu_equal(df.select(
+        (col("i32") + col("i64")).alias("add"),
+        (col("i32") - lit(7)).alias("sub"),
+        (col("i64") * col("i32")).alias("mul"),
+        (-col("i32")).alias("neg"),
+        fabs(col("i32")).alias("abs"),
+    ))
+
+
+def test_division_and_remainder(df):
+    assert_tpu_cpu_equal(df.select(
+        (col("f64") / col("i32")).alias("div"),
+        (col("i64") / lit(0)).alias("div0"),
+        (col("i32") % lit(7)).alias("mod"),
+        (col("i32") // lit(3)).alias("intdiv"),
+    ))
+
+
+def test_comparisons(df):
+    assert_tpu_cpu_equal(df.select(
+        (col("i32") > lit(0)).alias("gt"),
+        (col("i32") <= col("i64")).alias("le"),
+        (col("f64") == col("f64")).alias("eq"),
+        col("i32").eq_null_safe(col("i64")).alias("nseq"),
+        (col("s") == lit("tpu")).alias("streq"),
+        (col("s") < lit("b")).alias("strlt"),
+    ))
+
+
+def test_boolean_logic_kleene(df):
+    a = col("i32") > lit(0)
+    b = col("f64") > lit(0.0)
+    assert_tpu_cpu_equal(df.select(
+        (a & b).alias("and"), (a | b).alias("or"), (~a).alias("not"),
+    ))
+
+
+def test_null_predicates(df):
+    assert_tpu_cpu_equal(df.select(
+        col("i32").is_null().alias("isn"),
+        col("s").is_not_null().alias("nn"),
+        col("f64").is_nan().alias("nan"),
+    ))
+
+
+def test_conditional(df):
+    assert_tpu_cpu_equal(df.select(
+        when(col("i32") > 0, col("i64")).otherwise(lit(-1)).alias("w"),
+        when(col("b"), lit(1)).when(col("i32") > 10, lit(2)).otherwise(lit(3))
+            .alias("case"),
+        coalesce(col("i32"), col("i64"), lit(0)).alias("coal"),
+    ))
+
+
+def test_in_and_between(df):
+    assert_tpu_cpu_equal(df.select(
+        col("i32").isin(1, 2, 3, 100).alias("in"),
+        col("i32").between(-10, 10).alias("btw"),
+    ))
+
+
+def test_math(df):
+    assert_tpu_cpu_equal(df.select(
+        sqrt(fabs(col("f64"))).alias("sqrt"),
+        floor(col("f64")).alias("fl"),
+        ceil(col("f64")).alias("ce"),
+        fround(col("f64"), 2).alias("rnd"),
+        fpow(col("f32"), lit(2.0)).alias("pw"),
+    ), rel_tol=1e-6)
+
+
+def test_casts(df):
+    assert_tpu_cpu_equal(df.select(
+        col("i32").cast(dt.LONG).alias("to_long"),
+        col("i64").cast(dt.INT).alias("to_int"),
+        col("f64").cast(dt.FLOAT).alias("to_f32"),
+        col("i32").cast(dt.DOUBLE).alias("to_f64"),
+        col("b").cast(dt.INT).alias("b_int"),
+        col("i32").cast(dt.BOOLEAN).alias("i_bool"),
+    ))

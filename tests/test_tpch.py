@@ -1,0 +1,88 @@
+"""TPC-H mini-scale tests: our engine (device + CPU paths) vs an independent
+pandas implementation (reference analogue: mortgage/qa_nightly benchmark-ish
+suites used as correctness nets)."""
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.tools import tpch
+from harness import assert_tpu_cpu_equal
+
+
+ROWS = 20_000
+
+
+@pytest.fixture(scope="module")
+def lineitem():
+    return tpch.gen_lineitem(0, seed=7, rows=ROWS)
+
+
+@pytest.fixture(scope="module")
+def orders():
+    return tpch.gen_orders(0, seed=8, rows=5_000)
+
+
+@pytest.fixture(scope="module")
+def customer():
+    return tpch.gen_customer(0, seed=9, rows=1_000)
+
+
+def test_q6(session, lineitem):
+    df = session.create_dataframe(lineitem, num_partitions=2)
+    out = assert_tpu_cpu_equal(tpch.q6(df), rel_tol=1e-9)
+    # independent pandas check
+    pdf = lineitem.to_pandas()
+    import pyarrow as pa
+    sd = pd.Series(lineitem.column("l_shipdate").combine_chunks().cast(pa.int32()).to_numpy())
+    m = ((sd >= 8766) & (sd < 9131)
+         & (pdf["l_discount"] >= 0.05) & (pdf["l_discount"] <= 0.07)
+         & (pdf["l_quantity"] < 24.0))
+    expected = (pdf.loc[m, "l_extendedprice"] * pdf.loc[m, "l_discount"]).sum()
+    got = out.column("revenue")[0].as_py()
+    assert got == pytest.approx(expected, rel=1e-9)
+
+
+def test_q1(session, lineitem):
+    df = session.create_dataframe(lineitem, num_partitions=2)
+    out = assert_tpu_cpu_equal(tpch.q1(df), ignore_order=False, rel_tol=1e-9)
+    pdf = lineitem.to_pandas()
+    import pyarrow as pa
+    sd = pd.Series(lineitem.column("l_shipdate").combine_chunks().cast(pa.int32()).to_numpy())
+    sub = pdf[sd <= 10471]
+    grouped = sub.groupby(["l_returnflag", "l_linestatus"])
+    assert out.num_rows == len(grouped)
+    exp_qty = grouped["l_quantity"].sum().sort_index()
+    got = out.to_pandas().set_index(["l_returnflag", "l_linestatus"]) \
+        .sort_index()["sum_qty"]
+    np.testing.assert_allclose(got.to_numpy(), exp_qty.to_numpy(), rtol=1e-9)
+
+
+def test_q3(session, lineitem, orders, customer):
+    li = session.create_dataframe(lineitem, num_partitions=2)
+    od = session.create_dataframe(orders, num_partitions=2)
+    cu = session.create_dataframe(customer)
+    out = tpch.q3(li, od, cu)
+    device = out.collect(device=True)
+    cpu = out.collect(device=False)
+    # top-10 by revenue with ties: compare the revenue column
+    np.testing.assert_allclose(
+        np.sort(device.column("revenue").to_numpy(zero_copy_only=False)),
+        np.sort(cpu.column("revenue").to_numpy(zero_copy_only=False)),
+        rtol=1e-9)
+    # independent pandas check of the top revenue value
+    pdf_l = lineitem.to_pandas()
+    pdf_o = orders.to_pandas()
+    pdf_c = customer.to_pandas()
+    sd_l = lineitem.column("l_shipdate").combine_chunks().cast(__import__("pyarrow").int32()).to_numpy()
+    pdf_l = pdf_l[sd_l > 9204]
+    od_o = orders.column("o_orderdate").combine_chunks().cast(__import__("pyarrow").int32()).to_numpy()
+    pdf_o = pdf_o[od_o < 9204]
+    pdf_c = pdf_c[pdf_c["c_mktsegment"] == "BUILDING"]
+    j = pdf_c.merge(pdf_o, left_on="c_custkey", right_on="o_custkey") \
+             .merge(pdf_l, left_on="o_orderkey", right_on="l_orderkey")
+    j["revenue"] = j["l_extendedprice"] * (1.0 - j["l_discount"])
+    exp = j.groupby(["l_orderkey", "o_orderdate", "o_shippriority"])["revenue"] \
+        .sum().sort_values(ascending=False)
+    if len(exp):
+        assert device.column("revenue")[0].as_py() == \
+            pytest.approx(exp.iloc[0], rel=1e-9)
