@@ -220,6 +220,11 @@ class RapidsConf:
         key = key_or_entry.key if isinstance(key_or_entry, ConfEntry) else key_or_entry
         if key in self._values:
             return self._values[key]
+        # entries registered after this snapshot was built (module import
+        # order): convert any user-set raw value, else use the default
+        entry = _REGISTRY.get(key)
+        if entry is not None:
+            return entry.convert(self._extra.get(key))
         if key in self._extra:
             return self._extra[key]
         raise KeyError(key)

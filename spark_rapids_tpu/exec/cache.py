@@ -22,10 +22,14 @@ __all__ = ["CacheStorage", "CpuCacheExec", "TpuCacheExec"]
 class CacheStorage:
     def __init__(self):
         self.host: Dict[int, List[HostTable]] = {}
-        self.device: Dict[int, List[DeviceTable]] = {}
+        # device entries are SpillableDeviceTable handles (memory/catalog.py)
+        self.device: Dict[int, list] = {}
 
     def clear(self):
         self.host.clear()
+        for handles in self.device.values():
+            for h in handles:
+                h.close()
         self.device.clear()
 
 
@@ -49,6 +53,10 @@ class CpuCacheExec(PhysicalPlan):
 
 
 class TpuCacheExec(TpuExec):
+    """Cached batches are registered with the buffer catalog as spillable
+    (priority BROADCAST-level), so cached data yields HBM under pressure and
+    transparently restores from host/disk tiers on re-access."""
+
     def __init__(self, child: PhysicalPlan, storage: CacheStorage):
         super().__init__()
         self.child = child
@@ -60,10 +68,16 @@ class TpuCacheExec(TpuExec):
         cached = self.storage.device.get(pidx)
         if cached is not None:
             self.metrics.add("cacheHits", 1)
-            yield from cached
+            for handle in cached:
+                yield handle.get()
             return
+        from ..memory import SpillPriorities, get_catalog
         acc: List[DeviceTable] = []
         for b in self.child_device_batches(pidx):
             acc.append(b)
             yield b
-        self.storage.device[pidx] = acc
+        # register only after a full drain; an abandoned generator (e.g.
+        # under a limit) must not leak catalog entries
+        catalog = get_catalog()
+        self.storage.device[pidx] = [
+            catalog.register(b, SpillPriorities.BROADCAST) for b in acc]

@@ -1,0 +1,325 @@
+"""Device equi-joins (reference: GpuHashJoin.scala:507 + JoinGatherer.scala +
+AbstractGpuJoinIterator.scala out-of-core gather sub-partitioning;
+GpuShuffledHashJoinExec / GpuBroadcastHashJoinExec wrappers).
+
+TPU-first re-design — cuDF's hash join produces dynamically-sized gather maps;
+XLA needs static shapes. Three-kernel pipeline per probe batch:
+
+1. **Join codes** (exact, no hash collisions): concatenate build+probe key
+   columns, one lexsort over (null flags, normalized values), boundary flags →
+   dense group ids. Equal key tuples on either side get equal codes; null keys
+   get per-row sentinel codes so they never match (Spark semantics); NaN keys
+   match NaN; -0.0 == 0.0.
+2. **Count kernel**: sort build codes once; per probe row,
+   ``searchsorted(left/right)`` gives match count + start. One scalar
+   (total pairs) syncs to host.
+3. **Expand kernel**: compiled per *bucketed* output capacity chosen from the
+   true total — the static-shape answer to cuDF's dynamic gather map, playing
+   the role of the reference's oversized-gather sub-partitioning.
+
+The build side is gathered to a single batch (the reference's
+RequireSingleBatch build-side contract).
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import dtypes as dt
+from ..columnar.device import (DeviceColumn, DeviceTable, bucket_rows,
+                               concat_device_tables)
+from ..expr.base import EvalContext, Expression
+from ..plan.logical import _join_schema
+from ..plan.physical import PhysicalPlan
+from ..plan.schema import Schema
+from ..utils import metrics as M
+from ..utils.compile_cache import cached_jit
+from .base import TpuExec
+
+__all__ = ["TpuShuffledHashJoinExec", "TpuBroadcastHashJoinExec"]
+
+
+def _sort_key_arrays(cols: List[DeviceColumn], active: jax.Array):
+    """lexsort keys (minor..major) + per-row null flag for a key column set."""
+    keys = []
+    anynull = jnp.zeros(active.shape[0], dtype=bool)
+    for kc in reversed(cols):
+        v = kc.data
+        if jnp.issubdtype(v.dtype, jnp.floating):
+            nan = jnp.isnan(v)
+            v = jnp.where(v == 0, jnp.zeros_like(v), v)
+            v = jnp.where(nan, jnp.full_like(v, jnp.inf), v)
+            keys.append(v)
+            keys.append(nan)
+        else:
+            keys.append(v)
+    for kc in cols:
+        anynull = jnp.logical_or(anynull, jnp.logical_not(kc.validity))
+    return keys, anynull
+
+
+def _join_codes(bcols: List[DeviceColumn], bactive: jax.Array,
+                pcols: List[DeviceColumn], pactive: jax.Array
+                ) -> Tuple[jax.Array, jax.Array]:
+    """Dense int64 codes: equal key tuples <-> equal codes across both sides.
+
+    Inactive/null-key rows get unique negative sentinels (never match).
+    """
+    nb = bactive.shape[0]
+    npr = pactive.shape[0]
+    cat_cols = []
+    for bc, pc in zip(bcols, pcols):
+        data = jnp.concatenate([bc.data, pc.data])
+        validity = jnp.concatenate([bc.validity, pc.validity])
+        cat_cols.append(DeviceColumn(data, validity, bc.dtype, None))
+    active = jnp.concatenate([bactive, pactive])
+    keys, anynull = _sort_key_arrays(cat_cols, active)
+    usable = jnp.logical_and(active, jnp.logical_not(anynull))
+    keys.append(jnp.logical_not(usable))  # primary: usable rows first
+    order = jnp.lexsort(tuple(keys))
+    usable_s = jnp.take(usable, order)
+    # boundary among sorted usable rows (same logic as aggregate kernel)
+    same = jnp.ones(nb + npr, dtype=bool)
+    for kc in cat_cols:
+        v = kc.data
+        if jnp.issubdtype(v.dtype, jnp.floating):
+            v = jnp.where(v == 0, jnp.zeros_like(v), v)
+        sv = jnp.take(v, order)
+        eq = sv == jnp.roll(sv, 1)
+        if jnp.issubdtype(sv.dtype, jnp.floating):
+            eq = jnp.logical_or(eq, jnp.logical_and(
+                jnp.isnan(sv), jnp.isnan(jnp.roll(sv, 1))))
+        eq = eq.at[0].set(False)
+        same = jnp.logical_and(same, eq)
+    boundary = jnp.logical_and(jnp.logical_not(same), usable_s)
+    boundary = boundary.at[0].set(usable_s[0])
+    gid_sorted = jnp.cumsum(boundary.astype(jnp.int64)) - 1
+    # scatter back to original positions
+    gid = jnp.zeros(nb + npr, dtype=jnp.int64).at[order].set(gid_sorted)
+    iota = jnp.arange(nb + npr, dtype=jnp.int64)
+    gid = jnp.where(usable, gid, -(iota + 2))  # unique non-matching sentinels
+    return gid[:nb], gid[nb:]
+
+
+def _count_matches(bgid: jax.Array, pgid: jax.Array):
+    """-> (b_order, b_sorted, starts, counts) for probe rows."""
+    b_order = jnp.argsort(bgid)
+    b_sorted = jnp.take(bgid, b_order)
+    # sentinels are negative and unique so they contribute zero matches;
+    # clamp probe sentinels to a value absent from build (-1)
+    p = jnp.where(pgid < 0, jnp.full_like(pgid, -1), pgid)
+    starts = jnp.searchsorted(b_sorted, p, side="left")
+    ends = jnp.searchsorted(b_sorted, p, side="right")
+    # build sentinels: strip them from matches (they sit < 0 in sorted order)
+    counts = jnp.where(pgid < 0, 0, ends - starts)
+    return b_order, starts.astype(jnp.int64), counts.astype(jnp.int64)
+
+
+def _gather_columns(table: DeviceTable, idx: jax.Array, matched: jax.Array
+                    ) -> List[DeviceColumn]:
+    cols = []
+    for c in table.columns:
+        g = c.gather(idx)
+        cols.append(g.with_validity(jnp.logical_and(g.validity, matched)))
+    return cols
+
+
+class _JoinKernels:
+    """Builds the jitted count + expand kernels for a (schema, how) combo."""
+
+    def __init__(self, exec_node: "TpuShuffledHashJoinExec"):
+        self.node = exec_node
+
+    def counts_fn(self):
+        lkeys = self.node.left_keys
+        rkeys = self.node.right_keys
+
+        def fn(build: DeviceTable, probe: DeviceTable):
+            bcols = [build.column(k) for k in rkeys]
+            pcols = [probe.column(k) for k in lkeys]
+            bgid, pgid = _join_codes(bcols, build.row_mask, pcols,
+                                     probe.row_mask)
+            b_order, starts, counts = _count_matches(bgid, pgid)
+            return b_order, starts, counts
+        return fn
+
+    def expand_fn(self, out_cap: int, how: str):
+        node = self.node
+
+        def fn(build: DeviceTable, probe: DeviceTable, b_order, starts,
+               counts):
+            outer = how in ("left", "full")
+            slot_counts = jnp.maximum(counts, 1) if outer else counts
+            slot_counts = jnp.where(probe.row_mask, slot_counts, 0)
+            cum = jnp.cumsum(slot_counts)
+            total = cum[-1]
+            offsets = cum - slot_counts
+            j = jnp.arange(out_cap, dtype=jnp.int64)
+            # probe row for each output slot
+            pi = jnp.searchsorted(cum, j, side="right")
+            pi = jnp.clip(pi, 0, probe.capacity - 1)
+            k = j - jnp.take(offsets, pi)
+            has_match = jnp.take(counts, pi) > 0
+            b_sorted_pos = jnp.take(starts, pi) + k
+            b_sorted_pos = jnp.clip(b_sorted_pos, 0, build.capacity - 1)
+            bi = jnp.take(b_order, b_sorted_pos)
+            valid_slot = j < total
+            build_matched = jnp.logical_and(valid_slot, has_match)
+            pcols = _gather_columns(probe, pi.astype(jnp.int32), valid_slot)
+            bcols = _gather_columns(build, bi.astype(jnp.int32), build_matched)
+            out_cols, names = node.assemble(pcols, bcols, build_matched)
+            return DeviceTable(tuple(out_cols), valid_slot,
+                               total.astype(jnp.int32), tuple(names))
+        return fn
+
+    def semi_mask_fn(self, anti: bool):
+        def fn(probe: DeviceTable, counts):
+            keep = counts == 0 if anti else counts > 0
+            return probe.filter_mask(keep)
+        return fn
+
+
+class TpuShuffledHashJoinExec(TpuExec):
+    """Equi-join: build side = right child, probe side = left child."""
+
+    SUPPORTED = ("inner", "left", "left_semi", "left_anti")
+
+    def __init__(self, left: PhysicalPlan, right: PhysicalPlan,
+                 left_keys: Sequence[str], right_keys: Sequence[str],
+                 how: str, condition: Optional[Expression], merge_keys: bool,
+                 min_bucket: int = 1024):
+        super().__init__()
+        assert how in self.SUPPORTED, how
+        self.left, self.right = left, right
+        self.children = (left, right)
+        self.left_keys = list(left_keys)
+        self.right_keys = list(right_keys)
+        self.how = how
+        self.condition = condition
+        self.merge_keys = merge_keys
+        self.min_bucket = min_bucket
+        on = self.left_keys if merge_keys else None
+        self.schema = _join_schema(left.schema, right.schema, on, how)
+        self._kernels = _JoinKernels(self)
+
+    @property
+    def num_partitions(self) -> int:
+        return self.left.num_partitions
+
+    def node_desc(self):
+        return f"{self.how} lkeys={self.left_keys} rkeys={self.right_keys}"
+
+    def plan_signature(self) -> str:
+        return (f"Join|{self.how}|{self.left_keys}|{self.right_keys}|"
+                f"{self.merge_keys}|{self.condition!r}|"
+                f"{self.left.schema!r}|{self.right.schema!r}")
+
+    # -- column assembly (traced inside expand kernel) ------------------------
+    def assemble(self, pcols: List[DeviceColumn], bcols: List[DeviceColumn],
+                 build_matched: jax.Array):
+        lnames = list(self.left.schema.names)
+        rnames = list(self.right.schema.names)
+        names: List[str] = []
+        cols: List[DeviceColumn] = []
+        if self.merge_keys:
+            for k in self.left_keys:
+                cols.append(pcols[lnames.index(k)])
+                names.append(k)
+            skip_l = set(self.left_keys)
+            skip_r = set(self.right_keys)
+        else:
+            skip_l = set()
+            skip_r = set()
+        for n, c in zip(lnames, pcols):
+            if n not in skip_l:
+                names.append(n)
+                cols.append(c)
+        for n, c in zip(rnames, bcols):
+            if n not in skip_r:
+                names.append(n)
+                cols.append(c)
+        return cols, names
+
+    # -- execution ------------------------------------------------------------
+    def _build_table(self, pidx: int) -> DeviceTable:
+        batches = list(_device_batches(self.right, pidx))
+        if not batches:
+            from .aggregate import _empty_device_table
+            return _empty_device_table(self.right.schema, self.min_bucket)
+        table = concat_device_tables(batches) if len(batches) > 1 else batches[0]
+        return table
+
+    def execute_columnar(self, pidx: int) -> Iterator[DeviceTable]:
+        build = self._build_table(pidx)
+        counts_fn = cached_jit(self.plan_signature() + "|counts",
+                               self._kernels.counts_fn)
+        for probe in _device_batches(self.left, pidx):
+            with self.metrics.timed(M.JOIN_TIME):
+                b_order, starts, counts = counts_fn(build, probe)
+                if self.how in ("left_semi", "left_anti"):
+                    fn = cached_jit(
+                        self.plan_signature() + "|semi",
+                        lambda: self._kernels.semi_mask_fn(
+                            self.how == "left_anti"))
+                    yield fn(probe, counts)
+                    continue
+                outer = self.how in ("left", "full")
+                slot_counts = np.asarray(
+                    jnp.sum(jnp.where(
+                        probe.row_mask,
+                        jnp.maximum(counts, 1) if outer else counts, 0)))
+                total = int(slot_counts)
+                out_cap = bucket_rows(max(total, 1), self.min_bucket)
+                expand = cached_jit(
+                    self.plan_signature() + f"|expand{out_cap}",
+                    lambda: self._kernels.expand_fn(out_cap, self.how))
+                out = expand(build, probe, b_order, starts, counts)
+                if self.condition is not None:
+                    cond_fn = cached_jit(
+                        self.plan_signature() + "|cond",
+                        lambda: _condition_filter_fn(self.condition))
+                    out = cond_fn(out)
+                yield out
+
+
+class TpuBroadcastHashJoinExec(TpuShuffledHashJoinExec):
+    """Build side materialized once across partitions (reference:
+    GpuBroadcastHashJoinExec + SerializeConcatHostBuffersDeserializeBatch)."""
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        self._broadcast: Optional[DeviceTable] = None
+
+    def _build_table(self, pidx: int) -> DeviceTable:
+        if self._broadcast is None:
+            batches = []
+            for p in range(self.right.num_partitions):
+                batches.extend(_device_batches(self.right, p))
+            if not batches:
+                from .aggregate import _empty_device_table
+                self._broadcast = _empty_device_table(self.right.schema,
+                                                      self.min_bucket)
+            else:
+                self._broadcast = concat_device_tables(batches) \
+                    if len(batches) > 1 else batches[0]
+        return self._broadcast
+
+
+def _condition_filter_fn(condition: Expression):
+    def fn(table: DeviceTable) -> DeviceTable:
+        ctx = EvalContext.for_device(table)
+        c = condition.eval(ctx)
+        keep = c.values
+        if c.validity is not None:
+            keep = jnp.logical_and(keep, c.validity)
+        return table.filter_mask(keep)
+    return fn
+
+
+def _device_batches(child: PhysicalPlan, pidx: int) -> Iterator[DeviceTable]:
+    assert hasattr(child, "execute_columnar"), \
+        f"join child {type(child).__name__} is not columnar (missing transition)"
+    return child.execute_columnar(pidx)

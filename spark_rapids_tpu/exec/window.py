@@ -1,0 +1,360 @@
+"""Device window exec (reference: GpuWindowExec.scala — running-window
+optimization at :161,1346; frame -> rolling/scan mapping in
+GpuWindowExpression.scala).
+
+TPU-first: one lexsort puts rows in (partition, order) layout; every window
+function is then a data-parallel kernel over that layout inside a single jit:
+
+- segment flags + ``lax.associative_scan`` give segmented cumulative ops
+  (the running-window scan path)
+- entire-partition aggregates are segment reductions gathered back per row
+- bounded ROWS frames use clamped prefix-sum differences (sum/count/avg)
+- ranking functions are index arithmetic over segment starts / peer flags
+
+All static shapes; no per-partition loops.
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar import dtypes as dt
+from ..columnar.device import DeviceColumn, DeviceTable, concat_device_tables
+from ..expr.aggregates import (AggregateFunction, Average, Count, CountStar,
+                               Max, Min, Sum)
+from ..expr.base import EvalContext
+from ..expr.functions import SortOrder
+from ..expr.window import (DenseRank, Lag, Lead, NTile, Rank, RowNumber,
+                           WindowExpression)
+from ..plan.physical import PhysicalPlan
+from ..plan.schema import Field, Schema
+from ..utils import metrics as M
+from ..utils.compile_cache import cached_jit
+from .base import TpuExec
+from .sort import _order_keys
+
+__all__ = ["TpuWindowExec"]
+
+
+def _segmented_scan(vals: jax.Array, new_seg: jax.Array, op) -> jax.Array:
+    """Inclusive segmented scan: resets at rows where new_seg is True."""
+    def combine(a, b):
+        fa, va = a
+        fb, vb = b
+        return jnp.logical_or(fa, fb), jnp.where(fb, vb, op(va, vb))
+    _, out = jax.lax.associative_scan(combine, (new_seg, vals))
+    return out
+
+
+def _seg_info(table: DeviceTable, part_names: List[str]):
+    """Assumes rows already sorted by partition keys: returns
+    (new_seg flags, seg_start index per row, pos, pos_in_seg)."""
+    cap = table.capacity
+    pos = jnp.arange(cap, dtype=jnp.int64)
+    active = table.row_mask
+    new_seg = jnp.zeros(cap, dtype=bool).at[0].set(True)
+    for k in part_names:
+        c = table.column(k)
+        v = c.data
+        if jnp.issubdtype(v.dtype, jnp.floating):
+            v = jnp.where(v == 0, jnp.zeros_like(v), v)
+            eq = (v == jnp.roll(v, 1)) | (jnp.isnan(v) & jnp.isnan(jnp.roll(v, 1)))
+        else:
+            eq = v == jnp.roll(v, 1)
+        null = jnp.logical_not(c.validity)
+        eq = jnp.where(null | jnp.roll(null, 1), null & jnp.roll(null, 1), eq)
+        new_seg = jnp.logical_or(new_seg, jnp.logical_not(eq).at[0].set(True))
+    # inactive rows are at the end after compact-sort; give them their own seg
+    new_seg = jnp.logical_or(new_seg, jnp.logical_not(active)
+                             != jnp.logical_not(jnp.roll(active, 1)))
+    new_seg = new_seg.at[0].set(True)
+    seg_start = _segmented_scan(jnp.where(new_seg, pos, 0), new_seg,
+                                lambda a, b: jnp.maximum(a, b))
+    # simpler: seg_start via scan of "carry start"
+    seg_start = _segmented_scan(pos * new_seg, new_seg, jnp.maximum)
+    return new_seg, seg_start, pos, pos - seg_start
+
+
+def _peer_flags(table: DeviceTable, orders: Sequence[SortOrder],
+                new_seg: jax.Array) -> jax.Array:
+    """True where a new peer group (distinct order keys) starts."""
+    if not orders:
+        return new_seg
+    ctx = EvalContext.for_device(table)
+    neq = jnp.zeros(table.capacity, dtype=bool)
+    for o in orders:
+        c = o.expr.eval(ctx)
+        v = c.values
+        if jnp.issubdtype(v.dtype, jnp.floating):
+            v = jnp.where(v == 0, jnp.zeros_like(v), v)
+            eq = (v == jnp.roll(v, 1)) | (jnp.isnan(v) & jnp.isnan(jnp.roll(v, 1)))
+        else:
+            eq = v == jnp.roll(v, 1)
+        valid = c.validity if c.validity is not None \
+            else jnp.ones(table.capacity, dtype=bool)
+        null = jnp.logical_not(valid)
+        eq = jnp.where(null | jnp.roll(null, 1), null & jnp.roll(null, 1), eq)
+        neq = jnp.logical_or(neq, jnp.logical_not(eq))
+    return jnp.logical_or(new_seg, neq).at[0].set(True)
+
+
+class TpuWindowExec(TpuExec):
+    def __init__(self, child: PhysicalPlan,
+                 window_cols: Sequence[Tuple[str, WindowExpression]],
+                 child_names: Sequence[str]):
+        super().__init__()
+        self.child = child
+        self.children = (child,)
+        self.window_cols = list(window_cols)
+        self.child_names = list(child_names)
+        fields = list(child.schema.fields)
+        for name, w in self.window_cols:
+            fields.append(Field(name, w.data_type, w.nullable))
+        self.schema = Schema(fields)
+
+    def node_desc(self):
+        return ", ".join(n for n, _ in self.window_cols)
+
+    def plan_signature(self) -> str:
+        descs = [f"{n}={w!r}" for n, w in self.window_cols]
+        return f"Window|{descs}|{self.child.schema!r}"
+
+    @property
+    def fusible(self) -> bool:
+        return False  # needs whole-partition batches
+
+    def _kernel(self):
+        window_cols = self.window_cols
+        spec0 = window_cols[0][1].spec
+        out_names = tuple(self.schema.names)
+
+        def fn(table: DeviceTable) -> DeviceTable:
+            # sort by (partition keys, order keys); actives first
+            part_orders = [SortOrder(e, True) for e in spec0.partition_exprs]
+            orders = part_orders + list(spec0.orders)
+            keys = _order_keys(table, orders) if orders else \
+                [jnp.logical_not(table.row_mask)]
+            order = jnp.lexsort(tuple(keys))
+            cols = tuple(c.gather(order) for c in table.columns)
+            iota = jnp.arange(table.capacity, dtype=jnp.int32)
+            mask = iota < table.num_rows
+            sorted_t = DeviceTable(cols, mask, table.num_rows, table.names)
+            # partition segments: evaluate partition exprs on sorted table
+            ctx = EvalContext.for_device(sorted_t)
+            part_cols = []
+            part_names = []
+            scratch = sorted_t
+            for i, e in enumerate(spec0.partition_exprs):
+                c = e.eval(ctx)
+                validity = c.validity if c.validity is not None \
+                    else jnp.ones(sorted_t.capacity, dtype=bool)
+                part_cols.append(DeviceColumn(c.values, validity, c.dtype,
+                                              c.lengths))
+                part_names.append(f"_wp{i}")
+            scratch = DeviceTable(tuple(sorted_t.columns) + tuple(part_cols),
+                                  mask, sorted_t.num_rows,
+                                  tuple(sorted_t.names) + tuple(part_names))
+            new_seg, seg_start, pos, pos_in_seg = _seg_info(scratch, part_names)
+            out_cols = list(sorted_t.columns)
+            for name, w in window_cols:
+                out_cols.append(_window_column(scratch, w, new_seg, seg_start,
+                                               pos, pos_in_seg, mask))
+            return DeviceTable(tuple(out_cols), mask, sorted_t.num_rows,
+                               out_names)
+        return fn
+
+    def execute_columnar(self, pidx: int) -> Iterator[DeviceTable]:
+        batches = list(self.child_device_batches(pidx))
+        if not batches:
+            return
+        table = concat_device_tables(batches) if len(batches) > 1 else batches[0]
+        fn = cached_jit(self.plan_signature(), self._kernel)
+        with self.metrics.timed(M.OP_TIME):
+            yield fn(table)
+
+
+def _window_column(scratch: DeviceTable, w: WindowExpression,
+                   new_seg, seg_start, pos, pos_in_seg, mask) -> DeviceColumn:
+    cap = scratch.capacity
+    fn = w.fn
+    all_valid = jnp.ones(cap, dtype=bool)
+    if isinstance(fn, RowNumber):
+        return DeviceColumn((pos_in_seg + 1).astype(jnp.int32), all_valid,
+                            dt.INT, None)
+    if isinstance(fn, NTile):
+        seg_len = _seg_len(new_seg, seg_start, pos, cap)
+        k = fn.n
+        base = seg_len // k
+        rem = seg_len % k
+        cut = rem * (base + 1)
+        tile = jnp.where(pos_in_seg < cut,
+                         pos_in_seg // jnp.maximum(base + 1, 1),
+                         rem + (pos_in_seg - cut) // jnp.maximum(base, 1))
+        return DeviceColumn((tile + 1).astype(jnp.int32), all_valid, dt.INT,
+                            None)
+    if isinstance(fn, (Rank, DenseRank)):
+        peers = _peer_flags(scratch, w.spec.orders, new_seg)
+        if isinstance(fn, DenseRank):
+            dr = _segmented_scan(peers.astype(jnp.int64), new_seg,
+                                 lambda a, b: a + b)
+            return DeviceColumn(dr.astype(jnp.int32), all_valid, dt.INT, None)
+        first_of_peer = _segmented_scan(jnp.where(peers, pos, 0), new_seg,
+                                        jnp.maximum)
+        return DeviceColumn((first_of_peer - seg_start + 1).astype(jnp.int32),
+                            all_valid, dt.INT, None)
+    if isinstance(fn, (Lag, Lead)):
+        off = fn.offset if isinstance(fn, Lead) else -fn.offset
+        ctx = EvalContext.for_device(scratch)
+        c = fn.child.eval(ctx)
+        src = jnp.clip(pos + off, 0, cap - 1).astype(jnp.int32)
+        seg_len = _seg_len(new_seg, seg_start, pos, cap)
+        in_seg = jnp.logical_and(pos_in_seg + off >= 0,
+                                 pos_in_seg + off < seg_len)
+        vals = jnp.take(c.values, src, axis=0)
+        valid = jnp.take(c.valid_mask(ctx), src) & in_seg
+        if fn.default is not None:
+            vals = jnp.where(in_seg, vals,
+                             jnp.full_like(vals, fn.default))
+            valid = jnp.logical_or(valid, jnp.logical_not(in_seg))
+        lengths = None if c.lengths is None else jnp.take(c.lengths, src)
+        return DeviceColumn(vals, valid & mask, c.dtype, lengths)
+    if isinstance(fn, AggregateFunction):
+        return _agg_window_device(scratch, w, new_seg, seg_start, pos,
+                                  pos_in_seg, mask)
+    raise NotImplementedError(type(fn).__name__)
+
+
+def _seg_len(new_seg, seg_start, pos, cap):
+    # segment end: next segment's start (propagated backwards)
+    rev_new = jnp.flip(new_seg)
+    rev_pos = jnp.flip(pos)
+    # for each row (reversed), the minimum pos of the NEXT segment start at or
+    # after it == first new_seg position after current row + 1 ... compute via
+    # reverse segmented scan of "start of my segment" on flipped array:
+    # flipped segments are delimited one off; easier: seg_end = seg_start of
+    # next seg. seg_end[i] = min over j>i of (pos[j] where new_seg[j]) else cap
+    nxt = jnp.where(new_seg, pos, cap)
+    rev_min = jnp.flip(jax.lax.associative_scan(jnp.minimum, jnp.flip(nxt)))
+    # rev_min[i] = min(nxt[i:]) -> next boundary at or after i; but boundary at
+    # own segment start should not count: use strictly-after by shifting
+    after = jnp.concatenate([rev_min[1:], jnp.asarray([cap], rev_min.dtype)])
+    seg_end = after
+    return seg_end - seg_start
+
+
+def _agg_window_device(scratch, w, new_seg, seg_start, pos, pos_in_seg, mask
+                       ) -> DeviceColumn:
+    fn = w.fn
+    frame = w.spec.frame
+    cap = scratch.capacity
+    ctx = EvalContext.for_device(scratch)
+    if isinstance(fn, CountStar):
+        vals = jnp.ones(cap, dtype=jnp.int64)
+        valid = mask
+        in_dt = dt.LONG
+    else:
+        c = fn.children[0].eval(ctx)
+        vals = c.values
+        valid = (c.validity if c.validity is not None
+                 else jnp.ones(cap, dtype=bool)) & mask
+        in_dt = c.dtype
+    out_dt = fn.data_type
+    np_out = jnp.dtype(out_dt.np_dtype())
+
+    def prefix_pair():
+        x = jnp.where(valid, vals, jnp.zeros_like(vals)).astype(
+            jnp.float64 if jnp.issubdtype(vals.dtype, jnp.floating)
+            else jnp.int64)
+        csum = jnp.concatenate([jnp.zeros(1, x.dtype), jnp.cumsum(x)])
+        ccnt = jnp.concatenate([jnp.zeros(1, jnp.int64),
+                                jnp.cumsum(valid.astype(jnp.int64))])
+        return csum, ccnt
+
+    def finish(s, cnt):
+        if isinstance(fn, (Count, CountStar)):
+            return DeviceColumn(cnt.astype(jnp.int64),
+                                jnp.ones(cap, dtype=bool), dt.LONG, None)
+        if isinstance(fn, Sum):
+            return DeviceColumn(s.astype(np_out), cnt > 0, out_dt, None)
+        avg = s.astype(jnp.float64) / jnp.maximum(cnt, 1)
+        return DeviceColumn(avg, cnt > 0, dt.DOUBLE, None)
+
+    seg_len = _seg_len(new_seg, seg_start, pos, cap)
+    if frame.is_unbounded_entire or (not w.spec.orders and frame.is_running):
+        if isinstance(fn, (Sum, Count, CountStar, Average)):
+            csum, ccnt = prefix_pair()
+            lo = seg_start
+            hi = seg_start + seg_len
+            return finish(csum[hi] - csum[lo], ccnt[hi] - ccnt[lo])
+        # min/max entire partition: forward + effectively segment reduce;
+        # do running scan then take value at segment end
+        col = _running_minmax(fn, vals, valid, new_seg)
+        end_idx = jnp.clip(seg_start + seg_len - 1, 0, cap - 1).astype(jnp.int32)
+        v = jnp.take(col[0], end_idx)
+        has = jnp.take(col[1], end_idx)
+        return DeviceColumn(v.astype(np_out), has & mask, out_dt, None)
+    if frame.is_running:
+        if frame.kind == "range" and w.spec.orders:
+            peers = _peer_flags(scratch, w.spec.orders, new_seg)
+            # hi = end of my peer group: next peer boundary after me
+            nxt = jnp.where(peers, pos, cap)
+            rev_min = jnp.flip(jax.lax.associative_scan(
+                jnp.minimum, jnp.flip(nxt)))
+            after = jnp.concatenate([rev_min[1:],
+                                     jnp.asarray([cap], rev_min.dtype)])
+            hi = jnp.minimum(after, seg_start + seg_len)
+        else:
+            hi = pos + 1
+        if isinstance(fn, (Sum, Count, CountStar, Average)):
+            csum, ccnt = prefix_pair()
+            return finish(csum[hi] - csum[seg_start],
+                          ccnt[hi] - ccnt[seg_start])
+        run_v, run_has = _running_minmax(fn, vals, valid, new_seg)
+        idx = jnp.clip(hi - 1, 0, cap - 1).astype(jnp.int32)
+        return DeviceColumn(jnp.take(run_v, idx).astype(np_out),
+                            jnp.take(run_has, idx) & mask, out_dt, None)
+    if frame.kind == "rows" and isinstance(fn, (Sum, Count, CountStar, Average)):
+        s = seg_start if frame.start is None else jnp.maximum(
+            pos + frame.start, seg_start)
+        e = (seg_start + seg_len) if frame.end is None else jnp.minimum(
+            pos + frame.end + 1, seg_start + seg_len)
+        e = jnp.maximum(e, s)
+        csum, ccnt = prefix_pair()
+        return finish(csum[e] - csum[s], ccnt[e] - ccnt[s])
+    raise NotImplementedError(
+        f"{type(fn).__name__} over {frame.describe()} on device")
+
+
+def _running_minmax(fn, vals, valid, new_seg):
+    """Segmented running min/max with Spark NaN ordering; returns (vals, has)."""
+    is_min = isinstance(fn, Min)
+    isfloat = jnp.issubdtype(vals.dtype, jnp.floating)
+    x = vals
+    if isfloat:
+        nan = jnp.isnan(vals)
+        x = jnp.where(nan, jnp.full_like(vals, jnp.inf if is_min else -jnp.inf),
+                      vals)
+        # NaN counts tracked separately for Spark total order
+    ident = (jnp.finfo(x.dtype).max if jnp.issubdtype(x.dtype, jnp.floating)
+             else jnp.iinfo(x.dtype).max) if is_min else \
+        (jnp.finfo(x.dtype).min if jnp.issubdtype(x.dtype, jnp.floating)
+         else jnp.iinfo(x.dtype).min)
+    x = jnp.where(valid, x, jnp.full_like(x, ident))
+    op = jnp.minimum if is_min else jnp.maximum
+    run = _segmented_scan(x, new_seg, op)
+    has = _segmented_scan(valid.astype(jnp.int64), new_seg,
+                          lambda a, b: a + b) > 0
+    if isfloat:
+        nan_run = _segmented_scan((valid & jnp.isnan(vals)).astype(jnp.int64),
+                                  new_seg, lambda a, b: a + b)
+        nonnan_run = _segmented_scan(
+            (valid & jnp.logical_not(jnp.isnan(vals))).astype(jnp.int64),
+            new_seg, lambda a, b: a + b)
+        if is_min:
+            run = jnp.where(has & (nonnan_run == 0),
+                            jnp.full_like(run, jnp.nan), run)
+        else:
+            run = jnp.where(nan_run > 0, jnp.full_like(run, jnp.nan), run)
+    return run, has

@@ -1,0 +1,108 @@
+"""CSV scan (reference: GpuTextBasedPartitionReader.scala +
+GpuReadCSVFileFormat.scala — host line handling + device parse; here pyarrow
+does the host decode, the same per-type enable flags gate planning
+(RapidsConf.scala:877-917)).
+"""
+from __future__ import annotations
+
+import concurrent.futures as cf
+import glob as _glob
+import math
+import os
+from typing import Iterator, List, Optional
+
+import pyarrow as pa
+import pyarrow.csv as pacsv
+
+from ..conf import MULTITHREAD_READ_NUM_THREADS, RapidsConf, register_conf
+from ..columnar.host import HostTable, _dtype_to_arrow
+from ..plan.logical import DataSource
+from ..plan.schema import Field, Schema
+
+CSV_ENABLED = register_conf(
+    "spark.rapids.sql.format.csv.enabled",
+    "Enable CSV scans (reference: RapidsConf.scala csv flags).", True)
+
+__all__ = ["CsvSource"]
+
+
+def _expand(paths) -> List[str]:
+    if isinstance(paths, (str, os.PathLike)):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        p = os.fspath(p)
+        if os.path.isdir(p):
+            out.extend(sorted(_glob.glob(os.path.join(p, "**", "*.csv"),
+                                         recursive=True)))
+        elif any(ch in p for ch in "*?["):
+            out.extend(sorted(_glob.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no csv files for {paths}")
+    return out
+
+
+class CsvSource(DataSource):
+    def __init__(self, paths, conf: Optional[RapidsConf] = None, schema=None,
+                 header: bool = True, sep: str = ",",
+                 num_partitions: Optional[int] = None,
+                 batch_rows: int = 1 << 21):
+        self.files = _expand(paths)
+        self.conf = conf or RapidsConf()
+        self.header = header
+        self.sep = sep
+        self.batch_rows = batch_rows
+        self._explicit_schema = schema
+        first = self._read_file(self.files[0], nrows=1000)
+        ht = HostTable.from_arrow(first.slice(0, 0))
+        self._schema = Schema([Field(n, c.dtype, True)
+                               for n, c in zip(ht.names, ht.columns)])
+        nparts = num_partitions or min(len(self.files), 8)
+        per = math.ceil(len(self.files) / nparts)
+        self._file_parts = [self.files[i * per:(i + 1) * per]
+                            for i in range(nparts)
+                            if self.files[i * per:(i + 1) * per]]
+
+    def _read_options(self, nrows=None):
+        ro = pacsv.ReadOptions(autogenerate_column_names=not self.header)
+        po = pacsv.ParseOptions(delimiter=self.sep)
+        column_types = None
+        if self._explicit_schema:
+            column_types = {k: _dtype_to_arrow(v)
+                            for k, v in self._explicit_schema.items()}
+        co = pacsv.ConvertOptions(column_types=column_types,
+                                  strings_can_be_null=True)
+        return ro, po, co
+
+    def _read_file(self, path: str, nrows=None) -> pa.Table:
+        ro, po, co = self._read_options(nrows)
+        return pacsv.read_csv(path, read_options=ro, parse_options=po,
+                              convert_options=co)
+
+    def schema(self) -> Schema:
+        return self._schema
+
+    def partitions(self) -> int:
+        return len(self._file_parts)
+
+    def read_partition(self, pidx: int, columns: Optional[List[str]] = None
+                       ) -> Iterator[HostTable]:
+        nthreads = self.conf.get(MULTITHREAD_READ_NUM_THREADS)
+        files = self._file_parts[pidx]
+        with cf.ThreadPoolExecutor(max_workers=nthreads) as pool:
+            futures = [pool.submit(self._read_file, f) for f in files]
+            for fut in futures:
+                t = fut.result()
+                if columns:
+                    t = t.select([c for c in columns if c in t.column_names])
+                pos = 0
+                while pos < t.num_rows or (pos == 0 and t.num_rows == 0):
+                    yield HostTable.from_arrow(t.slice(pos, self.batch_rows))
+                    pos += self.batch_rows
+                    if t.num_rows == 0:
+                        break
+
+    def name(self) -> str:
+        return f"CSV[{len(self.files)} files]"

@@ -1,0 +1,76 @@
+"""JSON-lines scan (reference: GpuJsonScan.scala — cuDF JSON decode; here
+pyarrow.json host decode with the same source/partitioning shape)."""
+from __future__ import annotations
+
+import math
+import os
+import glob as _glob
+from typing import Iterator, List, Optional
+
+import pyarrow as pa
+import pyarrow.json as pajson
+
+from ..conf import RapidsConf, register_conf
+from ..columnar.host import HostTable
+from ..plan.logical import DataSource
+from ..plan.schema import Field, Schema
+
+JSON_ENABLED = register_conf(
+    "spark.rapids.sql.format.json.enabled",
+    "Enable JSON scans.", True)
+
+__all__ = ["JsonSource"]
+
+
+class JsonSource(DataSource):
+    def __init__(self, paths, conf: Optional[RapidsConf] = None,
+                 num_partitions: Optional[int] = None,
+                 batch_rows: int = 1 << 21):
+        if isinstance(paths, (str, os.PathLike)):
+            paths = [paths]
+        files: List[str] = []
+        for p in paths:
+            p = os.fspath(p)
+            if os.path.isdir(p):
+                files.extend(sorted(
+                    _glob.glob(os.path.join(p, "**", "*.json*"), recursive=True)))
+            elif any(ch in p for ch in "*?["):
+                files.extend(sorted(_glob.glob(p)))
+            else:
+                files.append(p)
+        if not files:
+            raise FileNotFoundError(f"no json files for {paths}")
+        self.files = files
+        self.conf = conf or RapidsConf()
+        self.batch_rows = batch_rows
+        first = pajson.read_json(self.files[0])
+        ht = HostTable.from_arrow(first.slice(0, 0))
+        self._schema = Schema([Field(n, c.dtype, True)
+                               for n, c in zip(ht.names, ht.columns)])
+        nparts = num_partitions or min(len(self.files), 8)
+        per = math.ceil(len(self.files) / nparts)
+        self._file_parts = [self.files[i * per:(i + 1) * per]
+                            for i in range(nparts)
+                            if self.files[i * per:(i + 1) * per]]
+
+    def schema(self) -> Schema:
+        return self._schema
+
+    def partitions(self) -> int:
+        return len(self._file_parts)
+
+    def read_partition(self, pidx: int, columns: Optional[List[str]] = None
+                       ) -> Iterator[HostTable]:
+        for f in self._file_parts[pidx]:
+            t = pajson.read_json(f)
+            if columns:
+                t = t.select([c for c in columns if c in t.column_names])
+            pos = 0
+            while pos < t.num_rows or (pos == 0 and t.num_rows == 0):
+                yield HostTable.from_arrow(t.slice(pos, self.batch_rows))
+                pos += self.batch_rows
+                if t.num_rows == 0:
+                    break
+
+    def name(self) -> str:
+        return f"JSON[{len(self.files)} files]"

@@ -47,5 +47,8 @@ class InMemorySource(DataSource):
             if t.num_rows == 0:
                 break
 
+    def estimated_size_bytes(self):
+        return self.table.nbytes
+
     def name(self) -> str:
         return f"InMemory[{self.table.num_rows} rows]"

@@ -141,5 +141,8 @@ class ParquetSource(DataSource):
                 t = fut.result()
                 yield from self._slice_out(t, allow_empty=True)
 
+    def estimated_size_bytes(self):
+        return sum(os.path.getsize(f) for f in self.files)
+
     def name(self) -> str:
         return f"Parquet[{len(self.files)} files, {self.reader_type}]"

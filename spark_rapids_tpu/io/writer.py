@@ -1,0 +1,127 @@
+"""Write path (reference: GpuParquetFileFormat.scala, GpuOrcFileFormat.scala,
+ColumnarOutputWriter.scala, GpuFileFormatDataWriter.scala — dynamic
+partitioning + write stats trackers).
+
+Writes execute per input partition producing part files (Spark layout:
+``part-NNNNN-*.ext``); ``partition_by`` columns produce Hive-style
+``col=value/`` directories via the dynamic partitioning path. Stats
+(files/rows/bytes written) mirror BasicColumnarWriteStatsTracker.
+"""
+from __future__ import annotations
+
+import os
+import uuid
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.csv as pacsv
+import pyarrow.orc as paorc
+import pyarrow.parquet as pq
+
+__all__ = ["write_parquet", "write_csv", "write_orc", "WriteStats"]
+
+
+class WriteStats:
+    """reference: BasicColumnarWriteStatsTracker.scala"""
+
+    def __init__(self):
+        self.num_files = 0
+        self.num_rows = 0
+        self.num_bytes = 0
+        self.partitions: List[str] = []
+
+    def record(self, path: str, rows: int):
+        self.num_files += 1
+        self.num_rows += rows
+        try:
+            self.num_bytes += os.path.getsize(path)
+        except OSError:
+            pass
+
+    def __repr__(self):
+        return (f"WriteStats(files={self.num_files}, rows={self.num_rows}, "
+                f"bytes={self.num_bytes}, partitions={len(self.partitions)})")
+
+
+def _write_one(table: pa.Table, path: str, fmt: str, **kw):
+    if fmt == "parquet":
+        pq.write_table(table, path, **kw)
+    elif fmt == "orc":
+        paorc.write_table(table, path)
+    elif fmt == "csv":
+        pacsv.write_csv(table, path)
+    else:
+        raise ValueError(fmt)
+
+
+def _partition_value_str(v) -> str:
+    if v is None:
+        return "__HIVE_DEFAULT_PARTITION__"
+    return str(v)
+
+
+def _write_table(df, path: str, fmt: str,
+                 partition_by: Optional[Sequence[str]] = None,
+                 mode: str = "error", **kw) -> WriteStats:
+    ext = {"parquet": "parquet", "orc": "orc", "csv": "csv"}[fmt]
+    if os.path.exists(path) and os.listdir(path):
+        if mode == "error":
+            raise FileExistsError(f"path {path} already exists (mode=error)")
+        if mode == "overwrite":
+            import shutil
+            shutil.rmtree(path)
+    os.makedirs(path, exist_ok=True)
+    stats = WriteStats()
+    job_id = uuid.uuid4().hex[:8]
+    plan = df.session._physical(df.logical)
+    for pidx in range(plan.num_partitions):
+        batches = list(plan.execute(pidx))
+        if not batches:
+            continue
+        from ..columnar.host import HostTable
+        table = HostTable.concat(batches).to_arrow()
+        if table.num_rows == 0:
+            continue
+        if partition_by:
+            # dynamic partitioning (reference: GpuFileFormatDataWriter)
+            keys = [table.column(k).to_pylist() for k in partition_by]
+            combos: Dict[tuple, List[int]] = {}
+            for i, combo in enumerate(zip(*keys)):
+                combos.setdefault(combo, []).append(i)
+            data_cols = [c for c in table.column_names if c not in partition_by]
+            for combo, idxs in combos.items():
+                sub = table.take(pa.array(idxs)).select(data_cols)
+                dirpath = os.path.join(path, *[
+                    f"{k}={_partition_value_str(v)}"
+                    for k, v in zip(partition_by, combo)])
+                os.makedirs(dirpath, exist_ok=True)
+                rel = os.path.relpath(dirpath, path)
+                if rel not in stats.partitions:
+                    stats.partitions.append(rel)
+                fpath = os.path.join(
+                    dirpath, f"part-{pidx:05d}-{job_id}.{ext}")
+                _write_one(sub, fpath, fmt, **kw)
+                stats.record(fpath, sub.num_rows)
+        else:
+            fpath = os.path.join(path, f"part-{pidx:05d}-{job_id}.{ext}")
+            _write_one(table, fpath, fmt, **kw)
+            stats.record(fpath, table.num_rows)
+    # _SUCCESS marker like Hadoop committers
+    open(os.path.join(path, "_SUCCESS"), "w").close()
+    return stats
+
+
+def write_parquet(df, path: str, partition_by=None, mode: str = "error",
+                  **kw) -> WriteStats:
+    return _write_table(df, path, "parquet", partition_by, mode, **kw)
+
+
+def write_orc(df, path: str, partition_by=None, mode: str = "error",
+              **kw) -> WriteStats:
+    return _write_table(df, path, "orc", partition_by, mode, **kw)
+
+
+def write_csv(df, path: str, partition_by=None, mode: str = "error",
+              **kw) -> WriteStats:
+    return _write_table(df, path, "csv", partition_by, mode, **kw)

@@ -1,0 +1,6 @@
+from .catalog import (  # noqa: F401
+    BufferCatalog, SpillableDeviceTable, SpillPriorities, get_catalog,
+    set_catalog,
+)
+from .semaphore import TpuSemaphore, get_semaphore  # noqa: F401
+from .stores import StorageTier  # noqa: F401

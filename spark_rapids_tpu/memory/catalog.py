@@ -1,0 +1,253 @@
+"""Buffer catalog: global registry of spillable device tables.
+
+Reference mapping (SURVEY §2.2):
+- ``BufferCatalog``        ~ RapidsBufferCatalog.scala:40,156
+- ``SpillableDeviceTable`` ~ SpillableColumnarBatch.scala (operator-facing
+  handle: register once, re-acquire on access, migrates tiers underneath)
+- ``synchronous_spill``    ~ RapidsBufferStore.synchronousSpill +
+  DeviceMemoryEventHandler.scala:33 (OOM callback -> spill)
+- spill priorities         ~ SpillPriorities.scala
+"""
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+from typing import Dict, List, Optional
+
+import jax
+
+from ..columnar.device import DeviceTable
+from ..conf import RapidsConf, register_conf
+from .stores import (DeviceStore, DiskStore, HostStore, StorageTier,
+                     StoredTable, _host_arrays_to_table)
+
+DEVICE_POOL_BYTES = register_conf(
+    "spark.rapids.tpu.memory.pool.size",
+    "Logical HBM budget in bytes for spillable buffers (reference: RMM pool "
+    "sizing, GpuDeviceManager.scala:176-222). 0 = derive from device.",
+    0)
+
+OOM_SPILL_ENABLED = register_conf(
+    "spark.rapids.memory.gpu.oomSpill.enabled",
+    "Spill lowest-priority buffers when the device budget is exceeded "
+    "(reference: DeviceMemoryEventHandler).", True)
+
+__all__ = ["SpillPriorities", "BufferCatalog", "SpillableDeviceTable",
+           "get_catalog", "set_catalog"]
+
+
+class SpillPriorities:
+    """Lower value spills first (reference: SpillPriorities.scala)."""
+    INPUT = 0
+    OUTPUT_FOR_SHUFFLE = 10
+    BROADCAST = 50
+    ACTIVE_ON_DECK = 100
+
+
+class BufferCatalog:
+    def __init__(self, conf: Optional[RapidsConf] = None,
+                 device_limit: Optional[int] = None,
+                 host_limit: Optional[int] = None,
+                 disk_dir: Optional[str] = None):
+        conf = conf or RapidsConf()
+        if device_limit is None:
+            device_limit = conf.get(DEVICE_POOL_BYTES) or _device_memory_bytes()
+        from ..conf import HOST_SPILL_STORAGE_SIZE
+        if host_limit is None:
+            host_limit = conf.get(HOST_SPILL_STORAGE_SIZE)
+        self.device = DeviceStore(device_limit)
+        self.host = HostStore(host_limit)
+        self.disk = DiskStore(disk_dir)
+        self._buffers: Dict[int, StoredTable] = {}
+        self._ids = itertools.count()
+        self._lock = threading.RLock()
+        self._oom_spill = conf.get(OOM_SPILL_ENABLED)
+        self.spill_count = {StorageTier.HOST: 0, StorageTier.DISK: 0}
+        self.spilled_bytes = {StorageTier.HOST: 0, StorageTier.DISK: 0}
+
+    # -- registration ---------------------------------------------------------
+    def register(self, table: DeviceTable,
+                 priority: int = SpillPriorities.INPUT
+                 ) -> "SpillableDeviceTable":
+        nbytes = table.nbytes()
+        with self._lock:
+            if not self.device.fits(nbytes) and self._oom_spill:
+                self.synchronous_spill(
+                    nbytes - (self.device.limit_bytes - self.device.used_bytes))
+            bid = next(self._ids)
+            stored = StoredTable(bid, table, priority, nbytes)
+            self._buffers[bid] = stored
+            self.device.used_bytes += nbytes
+        return SpillableDeviceTable(self, bid)
+
+    # -- spill machinery ------------------------------------------------------
+    def synchronous_spill(self, target_bytes: int) -> int:
+        """Move lowest-priority device buffers down-tier until target freed
+        (reference: RapidsBufferStore.synchronousSpill)."""
+        freed = 0
+        with self._lock:
+            candidates = [(s.priority, s.buffer_id) for s in
+                          self._buffers.values()
+                          if s.tier == StorageTier.DEVICE and s.refcount == 0]
+            heapq.heapify(candidates)
+            while candidates and freed < target_bytes:
+                _, bid = heapq.heappop(candidates)
+                stored = self._buffers.get(bid)
+                if stored is None or stored.tier != StorageTier.DEVICE:
+                    continue
+                self._spill_one(stored)
+                freed += stored.size_bytes
+        return freed
+
+    def _spill_one(self, stored: StoredTable):
+        # device -> host; if host full, push host's lowest priority to disk
+        if not self.host.fits(stored.size_bytes):
+            self._spill_host_to_disk(stored.size_bytes)
+        if self.host.fits(stored.size_bytes):
+            self.host.put(stored)
+            self.device.used_bytes -= stored.size_bytes
+            self.spill_count[StorageTier.HOST] += 1
+            self.spilled_bytes[StorageTier.HOST] += stored.size_bytes
+        else:  # straight to disk (host tier full even after its own spills)
+            from .stores import _table_to_host_arrays
+            arrays, meta = _table_to_host_arrays(stored.device_table)
+            stored.host_arrays = arrays
+            stored.meta = meta
+            stored.device_table = None
+            self.disk.put(stored)
+            self.device.used_bytes -= stored.size_bytes
+            self.spill_count[StorageTier.DISK] += 1
+            self.spilled_bytes[StorageTier.DISK] += stored.size_bytes
+
+    def _spill_host_to_disk(self, need_bytes: int):
+        victims = sorted((s for s in self._buffers.values()
+                          if s.tier == StorageTier.HOST and s.refcount == 0),
+                         key=lambda s: s.priority)
+        freed = 0
+        for s in victims:
+            if self.host.fits(need_bytes):
+                break
+            self.disk.put(s)
+            self.host.used_bytes -= s.size_bytes
+            self.spill_count[StorageTier.DISK] += 1
+            self.spilled_bytes[StorageTier.DISK] += s.size_bytes
+            freed += s.size_bytes
+
+    # -- access ---------------------------------------------------------------
+    def acquire(self, buffer_id: int) -> DeviceTable:
+        with self._lock:
+            stored = self._buffers[buffer_id]
+            assert not stored.closed, "buffer already closed"
+            # pin first so spill passes triggered below can't victimize the
+            # buffer being restored
+            stored.refcount += 1
+            if stored.tier == StorageTier.DISK:
+                arrays = self.disk.load(stored)
+                stored.host_arrays = arrays
+                self.disk.drop(stored)
+                stored.tier = StorageTier.HOST
+                self.host.used_bytes += stored.size_bytes
+            if stored.tier == StorageTier.HOST:
+                if not self.device.fits(stored.size_bytes) and self._oom_spill:
+                    self.synchronous_spill(stored.size_bytes)
+                table = _host_arrays_to_table(stored.host_arrays, stored.meta)
+                self.host.drop(stored)
+                stored.device_table = table
+                stored.tier = StorageTier.DEVICE
+                self.device.used_bytes += stored.size_bytes
+            return stored.device_table
+
+    def release(self, buffer_id: int):
+        with self._lock:
+            stored = self._buffers.get(buffer_id)
+            if stored is not None:
+                stored.refcount = max(0, stored.refcount - 1)
+
+    def close_buffer(self, buffer_id: int):
+        with self._lock:
+            stored = self._buffers.pop(buffer_id, None)
+            if stored is None:
+                return
+            stored.closed = True
+            if stored.tier == StorageTier.DEVICE:
+                self.device.used_bytes -= stored.size_bytes
+            elif stored.tier == StorageTier.HOST:
+                self.host.drop(stored)
+            else:
+                self.disk.drop(stored)
+
+    def tier_of(self, buffer_id: int) -> int:
+        return self._buffers[buffer_id].tier
+
+    def stats(self) -> dict:
+        with self._lock:
+            tiers = {}
+            for s in self._buffers.values():
+                name = StorageTier.NAMES[s.tier]
+                tiers[name] = tiers.get(name, 0) + 1
+            return {
+                "buffers": len(self._buffers),
+                "tiers": tiers,
+                "device_used": self.device.used_bytes,
+                "host_used": self.host.used_bytes,
+                "disk_used": self.disk.used_bytes,
+                "spill_count": dict(self.spill_count),
+                "spilled_bytes": dict(self.spilled_bytes),
+            }
+
+
+class SpillableDeviceTable:
+    """Operator-facing handle (reference: SpillableColumnarBatch)."""
+
+    def __init__(self, catalog: BufferCatalog, buffer_id: int):
+        self.catalog = catalog
+        self.buffer_id = buffer_id
+
+    def get(self) -> DeviceTable:
+        """Acquire the table on device (restoring from lower tiers)."""
+        table = self.catalog.acquire(self.buffer_id)
+        self.catalog.release(self.buffer_id)
+        return table
+
+    def __enter__(self) -> DeviceTable:
+        return self.catalog.acquire(self.buffer_id)
+
+    def __exit__(self, *exc):
+        self.catalog.release(self.buffer_id)
+
+    @property
+    def tier(self) -> int:
+        return self.catalog.tier_of(self.buffer_id)
+
+    def close(self):
+        self.catalog.close_buffer(self.buffer_id)
+
+
+def _device_memory_bytes() -> int:
+    try:
+        d = jax.devices()[0]
+        ms = d.memory_stats()
+        if ms and "bytes_limit" in ms:
+            return int(ms["bytes_limit"])
+    except Exception:
+        pass
+    return 8 * 1024 * 1024 * 1024  # assume 8 GiB HBM when unknown
+
+
+_GLOBAL: Optional[BufferCatalog] = None
+_GLOBAL_LOCK = threading.Lock()
+
+
+def get_catalog(conf: Optional[RapidsConf] = None) -> BufferCatalog:
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        if _GLOBAL is None:
+            _GLOBAL = BufferCatalog(conf)
+        return _GLOBAL
+
+
+def set_catalog(catalog: Optional[BufferCatalog]):
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        _GLOBAL = catalog

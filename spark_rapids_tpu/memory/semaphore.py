@@ -1,0 +1,76 @@
+"""TpuSemaphore — per-chip task admission control (reference:
+GpuSemaphore.scala:27,58,74 + spark.rapids.sql.concurrentGpuTasks).
+
+On GPU, over-admission causes OOM; on TPU it is worse — a chip runs one
+program at a time, so concurrent dispatch only adds queueing (SURVEY §7 hard
+part (d): the semaphore is mandatory, not advisory). Tasks acquire before
+their first device dispatch and release when blocked on host work (the
+python-worker pattern, GpuArrowEvalPythonExec.scala:306-332) or done.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+from ..conf import RapidsConf
+
+__all__ = ["TpuSemaphore", "get_semaphore"]
+
+
+class TpuSemaphore:
+    def __init__(self, permits: int = 1):
+        self.permits = permits
+        self._sem = threading.BoundedSemaphore(permits)
+        self._holders: Dict[int, int] = {}  # task/thread id -> depth
+        self._lock = threading.Lock()
+        self.total_wait_time = 0.0
+        self.acquire_count = 0
+
+    def acquire_if_necessary(self, task_id: Optional[int] = None):
+        """Reentrant per task (reference: acquireIfNecessary semantics)."""
+        tid = task_id if task_id is not None else threading.get_ident()
+        with self._lock:
+            if self._holders.get(tid, 0) > 0:
+                self._holders[tid] += 1
+                return
+        t0 = time.perf_counter()
+        self._sem.acquire()
+        with self._lock:
+            self.total_wait_time += time.perf_counter() - t0
+            self.acquire_count += 1
+            self._holders[tid] = 1
+
+    def release_if_held(self, task_id: Optional[int] = None):
+        tid = task_id if task_id is not None else threading.get_ident()
+        with self._lock:
+            depth = self._holders.get(tid, 0)
+            if depth == 0:
+                return
+            if depth > 1:
+                self._holders[tid] = depth - 1
+                return
+            del self._holders[tid]
+        self._sem.release()
+
+    @contextmanager
+    def held(self, task_id: Optional[int] = None):
+        self.acquire_if_necessary(task_id)
+        try:
+            yield
+        finally:
+            self.release_if_held(task_id)
+
+
+_GLOBAL: Optional[TpuSemaphore] = None
+_LOCK = threading.Lock()
+
+
+def get_semaphore(conf: Optional[RapidsConf] = None) -> TpuSemaphore:
+    global _GLOBAL
+    with _LOCK:
+        if _GLOBAL is None:
+            permits = (conf or RapidsConf()).concurrent_tpu_tasks
+            _GLOBAL = TpuSemaphore(permits)
+        return _GLOBAL

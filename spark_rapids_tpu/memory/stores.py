@@ -1,0 +1,148 @@
+"""Tiered buffer stores: DEVICE -> HOST -> DISK spill chain.
+
+Reference mapping (SURVEY §2.2):
+- ``StorageTier``            ~ RapidsBuffer.scala:53 (DEVICE/HOST/DISK/GDS)
+- ``DeviceStore/HostStore/DiskStore`` ~ RapidsDeviceMemoryStore /
+  RapidsHostMemoryStore / RapidsDiskStore
+- spill-priority ordering    ~ RapidsBufferStore's HashedPriorityQueue
+  (RapidsBufferStore.scala:48-90)
+
+TPU adaptation: there is no UVM and no partial-buffer spill — a buffer is a
+whole DeviceTable pytree. Spilling devices->host materializes numpy arrays
+(PJRT device_get); host->disk writes an .npz; restore is the inverse. XLA owns
+the actual HBM, so the device store enforces a *logical* budget and frees by
+dropping references (buffer donation to XLA's allocator).
+"""
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+from typing import Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..columnar import dtypes as dt
+from ..columnar.device import DeviceColumn, DeviceTable
+
+__all__ = ["StorageTier", "StoredTable", "DeviceStore", "HostStore",
+           "DiskStore"]
+
+
+class StorageTier:
+    DEVICE = 0
+    HOST = 1
+    DISK = 2
+
+    NAMES = {0: "DEVICE", 1: "HOST", 2: "DISK"}
+
+
+def _table_to_host_arrays(table: DeviceTable) -> Tuple[dict, dict]:
+    """Flatten a DeviceTable into numpy arrays + static metadata."""
+    arrays = {}
+    meta = {"names": list(table.names), "dtypes": [], "has_lengths": []}
+    arrays["row_mask"] = np.asarray(table.row_mask)
+    arrays["num_rows"] = np.asarray(table.num_rows)
+    for i, c in enumerate(table.columns):
+        arrays[f"data{i}"] = np.asarray(c.data)
+        arrays[f"validity{i}"] = np.asarray(c.validity)
+        meta["dtypes"].append(c.dtype)
+        meta["has_lengths"].append(c.lengths is not None)
+        if c.lengths is not None:
+            arrays[f"lengths{i}"] = np.asarray(c.lengths)
+    return arrays, meta
+
+
+def _host_arrays_to_table(arrays: dict, meta: dict) -> DeviceTable:
+    import jax.numpy as jnp
+    cols = []
+    for i, d in enumerate(meta["dtypes"]):
+        lengths = jnp.asarray(arrays[f"lengths{i}"]) \
+            if meta["has_lengths"][i] else None
+        cols.append(DeviceColumn(jnp.asarray(arrays[f"data{i}"]),
+                                 jnp.asarray(arrays[f"validity{i}"]),
+                                 d, lengths))
+    return DeviceTable(tuple(cols), jnp.asarray(arrays["row_mask"]),
+                       jnp.asarray(arrays["num_rows"]),
+                       tuple(meta["names"]))
+
+
+class StoredTable:
+    """One buffer's storage state across tiers."""
+
+    def __init__(self, buffer_id: int, table: DeviceTable, priority: int,
+                 size_bytes: int):
+        self.buffer_id = buffer_id
+        self.priority = priority
+        self.size_bytes = size_bytes
+        self.tier = StorageTier.DEVICE
+        self.device_table: Optional[DeviceTable] = table
+        self.host_arrays: Optional[dict] = None
+        self.meta: Optional[dict] = None
+        self.disk_path: Optional[str] = None
+        self.refcount = 0
+        self.closed = False
+
+
+class DeviceStore:
+    """Logical HBM budget tracker (reference: RapidsDeviceMemoryStore)."""
+
+    def __init__(self, limit_bytes: int):
+        self.limit_bytes = limit_bytes
+        self.used_bytes = 0
+
+    def fits(self, nbytes: int) -> bool:
+        return self.used_bytes + nbytes <= self.limit_bytes
+
+
+class HostStore:
+    """Host staging tier with its own size bound (reference:
+    RapidsHostMemoryStore, spark.rapids.memory.host.spillStorageSize)."""
+
+    def __init__(self, limit_bytes: int):
+        self.limit_bytes = limit_bytes
+        self.used_bytes = 0
+
+    def fits(self, nbytes: int) -> bool:
+        return self.used_bytes + nbytes <= self.limit_bytes
+
+    def put(self, stored: StoredTable):
+        arrays, meta = _table_to_host_arrays(stored.device_table)
+        stored.host_arrays = arrays
+        stored.meta = meta
+        stored.device_table = None
+        stored.tier = StorageTier.HOST
+        self.used_bytes += stored.size_bytes
+
+    def drop(self, stored: StoredTable):
+        stored.host_arrays = None
+        self.used_bytes -= stored.size_bytes
+
+
+class DiskStore:
+    """Disk tier (reference: RapidsDiskStore + RapidsDiskBlockManager)."""
+
+    def __init__(self, directory: Optional[str] = None):
+        self.dir = directory or tempfile.mkdtemp(prefix="srt_spill_")
+        os.makedirs(self.dir, exist_ok=True)
+        self.used_bytes = 0
+
+    def put(self, stored: StoredTable):
+        assert stored.host_arrays is not None
+        path = os.path.join(self.dir, f"buf{stored.buffer_id}.npz")
+        np.savez(path, **stored.host_arrays)
+        stored.disk_path = path
+        stored.host_arrays = None
+        stored.tier = StorageTier.DISK
+        self.used_bytes += os.path.getsize(path)
+
+    def load(self, stored: StoredTable) -> dict:
+        with np.load(stored.disk_path, allow_pickle=False) as z:
+            return {k: z[k] for k in z.files}
+
+    def drop(self, stored: StoredTable):
+        if stored.disk_path and os.path.exists(stored.disk_path):
+            self.used_bytes -= os.path.getsize(stored.disk_path)
+            os.unlink(stored.disk_path)
+        stored.disk_path = None

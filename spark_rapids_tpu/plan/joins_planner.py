@@ -11,9 +11,29 @@ from ..expr.base import AttributeReference, Expression
 from ..expr.predicates import And, EqualTo
 from .logical import LogicalJoin
 from .physical import HashPartitioning, PhysicalPlan, ShuffleExchangeExec
-from .physical_joins import CpuBroadcastNestedLoopJoinExec, CpuShuffledHashJoinExec
+from .physical_joins import (CpuBroadcastHashJoinExec,
+                             CpuBroadcastNestedLoopJoinExec,
+                             CpuShuffledHashJoinExec)
+from ..conf import register_conf
+
+BROADCAST_THRESHOLD = register_conf(
+    "spark.rapids.tpu.autoBroadcastJoinThreshold",
+    "Max estimated build-side bytes for broadcast hash join planning "
+    "(Spark's spark.sql.autoBroadcastJoinThreshold analogue; -1 disables).",
+    10 * 1024 * 1024)
 
 __all__ = ["plan_join", "extract_equi_keys"]
+
+
+def _estimate_subtree_bytes(node):
+    """Sum of scan-source estimates under a logical node; None if unknown."""
+    from .logical import LogicalScan
+    if isinstance(node, LogicalScan):
+        return node.source.estimated_size_bytes()
+    sizes = [_estimate_subtree_bytes(c) for c in node.children]
+    if not sizes or any(s is None for s in sizes):
+        return None
+    return sum(sizes)
 
 
 def extract_equi_keys(condition: Optional[Expression], lnames: Set[str],
@@ -72,6 +92,15 @@ def plan_join(node: LogicalJoin, conf: RapidsConf,
     left = plan_fn(node.left, conf, lreq)
     right = plan_fn(node.right, conf, rreq)
     if lkeys:
+        threshold = conf.get(BROADCAST_THRESHOLD)
+        rsize = _estimate_subtree_bytes(node.right)
+        # broadcasting the RIGHT side is only sound when unmatched right rows
+        # never appear in the output (they would duplicate per left partition)
+        broadcastable = node.how in ("inner", "left", "left_semi", "left_anti")
+        if broadcastable and threshold >= 0 and rsize is not None \
+                and rsize <= threshold:
+            return CpuBroadcastHashJoinExec(left, right, lkeys, rkeys,
+                                            node.how, residual, merge_keys)
         if left.num_partitions > 1 or right.num_partitions > 1:
             left = ShuffleExchangeExec(left, HashPartitioning(lkeys, nparts))
             right = ShuffleExchangeExec(right, HashPartitioning(rkeys, nparts))

@@ -19,7 +19,8 @@ from .schema import Field, Schema
 
 __all__ = ["LogicalPlan", "LogicalScan", "LogicalProject", "LogicalFilter",
            "LogicalAggregate", "LogicalSort", "LogicalLimit", "LogicalJoin",
-           "LogicalUnion", "LogicalRange", "LogicalCache", "DataSource"]
+           "LogicalUnion", "LogicalRange", "LogicalCache", "LogicalWindow",
+           "DataSource"]
 
 
 class DataSource:
@@ -37,6 +38,10 @@ class DataSource:
 
     def name(self) -> str:
         return type(self).__name__
+
+    def estimated_size_bytes(self):
+        """Best-effort size estimate for broadcast planning; None = unknown."""
+        return None
 
 
 class LogicalPlan:
@@ -215,6 +220,32 @@ class LogicalUnion(LogicalPlan):
                     for i in range(len(first))]
         return Schema([Field(f.name, f.dtype, nb)
                        for f, nb in zip(first.fields, nullable)])
+
+
+class LogicalWindow(LogicalPlan):
+    """Window exec node: child columns + appended window columns
+    (reference: GpuWindowExec). All entries share one WindowSpec
+    (partition/order); the DataFrame layer stacks nodes per distinct spec."""
+
+    def __init__(self, child: LogicalPlan, window_cols):
+        from ..expr.window import WindowExpression
+        self.child = child
+        self.children = (child,)
+        cs = child.schema
+        resolved = []
+        for name, w in window_cols:
+            r = resolve_expression(w, cs.to_dict(), cs.nullable_dict())
+            assert isinstance(r, WindowExpression), r
+            resolved.append((name, r))
+        self.window_cols = resolved
+        _check_dup(list(cs.names) + [n for n, _ in resolved])
+
+    @property
+    def schema(self) -> Schema:
+        fields = list(self.child.schema.fields)
+        fields += [Field(n, w.data_type, w.nullable)
+                   for n, w in self.window_cols]
+        return Schema(fields)
 
 
 class LogicalCache(LogicalPlan):

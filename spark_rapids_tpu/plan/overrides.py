@@ -139,6 +139,88 @@ def _register_exec_rules():
         CpuCacheExec, _device_all,
         lambda p, ch, conf: TpuCacheExec(ch[0], p.storage))
 
+    from ..exec.joins import TpuBroadcastHashJoinExec, TpuShuffledHashJoinExec
+    from .physical_joins import CpuBroadcastHashJoinExec, CpuShuffledHashJoinExec
+
+    def tag_join(meta, conf):
+        p = meta.plan
+        if p.how not in TpuShuffledHashJoinExec.SUPPORTED:
+            meta.cannot_run(f"join type {p.how} not yet supported on device")
+        for k, side in [(k, p.left) for k in p.left_keys] + \
+                       [(k, p.right) for k in p.right_keys]:
+            kt = side.schema.field(k).dtype
+            if isinstance(kt, (dt.StringType, dt.BinaryType)):
+                meta.cannot_run(f"join key {k}: string keys not yet supported "
+                                "on device")
+            elif not _device_common.is_supported(kt):
+                meta.cannot_run(f"join key {k}: {kt!r} not supported")
+        if p.condition is not None and p.how != "inner":
+            meta.cannot_run("join residual condition only supported for "
+                            "inner joins on device")
+
+    def _join_exprs(p):
+        return [p.condition] if p.condition is not None else []
+
+    register_exec_rule(
+        CpuShuffledHashJoinExec, _device_all,
+        lambda p, ch, conf: TpuShuffledHashJoinExec(
+            ch[0], ch[1], p.left_keys, p.right_keys, p.how, p.condition,
+            p.merge_keys, conf.min_bucket_rows),
+        exprs_fn=_join_exprs, tag_fn=tag_join)
+
+    register_exec_rule(
+        CpuBroadcastHashJoinExec, _device_all,
+        lambda p, ch, conf: TpuBroadcastHashJoinExec(
+            ch[0], ch[1], p.left_keys, p.right_keys, p.how, p.condition,
+            p.merge_keys, conf.min_bucket_rows),
+        exprs_fn=_join_exprs, tag_fn=tag_join)
+
+    from ..exec.window import TpuWindowExec
+    from .physical_window import CpuWindowExec
+    from ..expr.aggregates import (Average, Count, CountStar, Max, Min, Sum)
+    from ..expr.window import (DenseRank, Lag, Lead, NTile, Rank, RowNumber)
+
+    _DEVICE_WINDOW_FNS = (RowNumber, Rank, DenseRank, NTile, Lag, Lead,
+                          Sum, Min, Max, Count, CountStar, Average)
+
+    def tag_window(meta, conf):
+        p = meta.plan
+        for name, w in p.window_cols:
+            if not isinstance(w.fn, _DEVICE_WINDOW_FNS):
+                meta.cannot_run(
+                    f"window function {type(w.fn).__name__} not supported "
+                    "on device")
+                continue
+            frame = w.spec.frame
+            running_or_entire = frame.is_unbounded_entire or frame.is_running
+            if frame.kind == "range" and not running_or_entire:
+                meta.cannot_run("bounded RANGE frames not supported on device")
+            if isinstance(w.fn, (Min, Max)) and not running_or_entire:
+                meta.cannot_run("min/max over bounded ROWS frames not "
+                                "supported on device")
+            for e in w.spec.partition_exprs:
+                if isinstance(e.data_type, (dt.StringType, dt.BinaryType)):
+                    meta.cannot_run("string partition keys not supported on "
+                                    "device window")
+            for o in w.spec.orders:
+                if isinstance(o.expr.data_type, (dt.StringType, dt.BinaryType)):
+                    meta.cannot_run("string order keys not supported on "
+                                    "device window")
+            if isinstance(w.fn, (Sum, Min, Max, Count, Average)) \
+                    and w.fn.children:
+                if isinstance(w.fn.children[0].data_type,
+                              (dt.StringType, dt.BinaryType)):
+                    meta.cannot_run("string aggregate input not supported on "
+                                    "device window")
+
+    register_exec_rule(
+        CpuWindowExec, _device_all,
+        lambda p, ch, conf: TpuWindowExec(ch[0], p.window_cols,
+                                          p.child.schema.names),
+        exprs_fn=lambda p: [c for _, w in p.window_cols
+                            for c in w.fn.children],
+        tag_fn=tag_window)
+
     def tag_sort(meta, conf):
         p: CpuSortExec = meta.plan
         for o in p.orders:

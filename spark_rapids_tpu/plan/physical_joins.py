@@ -21,8 +21,8 @@ from .physical import PhysicalPlan, _empty_values
 from .schema import Schema
 from .logical import _join_schema
 
-__all__ = ["CpuShuffledHashJoinExec", "CpuBroadcastNestedLoopJoinExec",
-           "join_host_tables"]
+__all__ = ["CpuShuffledHashJoinExec", "CpuBroadcastHashJoinExec",
+           "CpuBroadcastNestedLoopJoinExec", "join_host_tables"]
 
 
 def _factorize_pair(lt: HostTable, rt: HostTable, lkeys: Sequence[str],
@@ -186,6 +186,32 @@ class CpuShuffledHashJoinExec(PhysicalPlan):
 
     def node_desc(self):
         return f"{self.how} lkeys={self.left_keys} rkeys={self.right_keys}"
+
+
+class CpuBroadcastHashJoinExec(CpuShuffledHashJoinExec):
+    """Equi-join with the build (right) side broadcast instead of shuffled
+    (reference: GpuBroadcastHashJoinExec.scala)."""
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        self._broadcast = None
+
+    def _right_table(self) -> HostTable:
+        if self._broadcast is None:
+            batches = []
+            for p in range(self.right.num_partitions):
+                batches.extend(self.right.execute(p))
+            self._broadcast = HostTable.concat(batches) if batches \
+                else _empty_like(self.right.schema)
+        return self._broadcast
+
+    def execute(self, pidx: int):
+        lbatches = list(self.left.execute(pidx))
+        lt = HostTable.concat(lbatches) if lbatches else _empty_like(self.left.schema)
+        rt = self._right_table()
+        out = join_host_tables(lt, rt, self.left_keys, self.right_keys,
+                               self.how, self.condition, self.merge_keys)
+        yield HostTable(self.schema.names, out.columns)
 
 
 class CpuBroadcastNestedLoopJoinExec(PhysicalPlan):

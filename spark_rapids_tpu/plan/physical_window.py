@@ -1,0 +1,361 @@
+"""CPU window exec (fallback engine; reference: the CPU side of
+GpuWindowExec.scala / GpuWindowExpression.scala frame semantics).
+
+Rows are sorted by (partition keys, order keys); output is in that order with
+window columns appended. Frames: entire partition, running (RANGE UNBOUNDED
+PRECEDING..CURRENT ROW — includes peer rows), and bounded ROWS frames.
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Sequence, Tuple
+
+import numpy as np
+
+from ..columnar import dtypes as dt
+from ..columnar.host import HostColumn, HostTable
+from ..expr.aggregates import AggregateFunction, Average, Count, CountStar, \
+    Max, Min, Sum
+from ..expr.base import EvalContext, Expression
+from ..expr.functions import SortOrder
+from ..expr.window import (DenseRank, Lag, Lead, NTile, Rank, RowNumber,
+                           WindowExpression)
+from .host_groupby import group_codes, host_group_reduce
+from .physical import PhysicalPlan, _sort_indices, host_eval_exprs
+from .schema import Field, Schema
+
+__all__ = ["CpuWindowExec"]
+
+
+class CpuWindowExec(PhysicalPlan):
+    def __init__(self, child: PhysicalPlan,
+                 window_cols: Sequence[Tuple[str, WindowExpression]]):
+        self.child = child
+        self.children = (child,)
+        self.window_cols = list(window_cols)
+        fields = list(child.schema.fields)
+        for name, w in self.window_cols:
+            fields.append(Field(name, w.data_type, w.nullable))
+        self.schema = Schema(fields)
+
+    @property
+    def num_partitions(self) -> int:
+        return self.child.num_partitions
+
+    def node_desc(self):
+        return ", ".join(n for n, _ in self.window_cols)
+
+    def execute(self, pidx: int) -> Iterator[HostTable]:
+        batches = list(self.child.execute(pidx))
+        if not batches:
+            return
+        table = HostTable.concat(batches)
+        if table.num_rows == 0:
+            empty_cols = list(table.columns)
+            for name, w in self.window_cols:
+                empty_cols.append(HostColumn(
+                    w.data_type,
+                    np.empty(0, dtype=w.data_type.np_dtype()
+                             if not isinstance(w.data_type, dt.StringType)
+                             else object)))
+            yield HostTable(self.schema.names, empty_cols)
+            return
+        # one sort: partition keys then order keys
+        spec0 = self.window_cols[0][1].spec
+        part_names, table = _materialize_exprs(
+            table, spec0.partition_exprs, "_wpart")
+        orders = spec0.orders
+        sort_orders = [SortOrder(_ref(table, n), True) for n in part_names] \
+            + list(orders)
+        idx = _sort_indices(table, sort_orders) if sort_orders \
+            else np.arange(table.num_rows)
+        sorted_t = table.take(idx)
+        gid, ngroups, _ = group_codes(sorted_t, part_names)
+        seg_bounds = _segment_bounds(gid, ngroups)
+        out_cols: List[HostColumn] = [
+            sorted_t.column(n) for n in self.child.schema.names]
+        for name, w in self.window_cols:
+            out_cols.append(_compute_window(sorted_t, w, gid, seg_bounds))
+        yield HostTable(self.schema.names, out_cols)
+
+
+def _materialize_exprs(table: HostTable, exprs, prefix: str
+                       ) -> Tuple[List[str], HostTable]:
+    if not exprs:
+        return [], table
+    names = [f"{prefix}{i}" for i in range(len(exprs))]
+    extra = host_eval_exprs(table, list(exprs), names)
+    return names, HostTable(list(table.names) + names,
+                            list(table.columns) + list(extra.columns))
+
+
+def _ref(table: HostTable, name: str):
+    from ..expr.base import AttributeReference
+    i = table.names.index(name)
+    return AttributeReference(name, table.columns[i].dtype, True)
+
+
+def _segment_bounds(gid: np.ndarray, ngroups: int):
+    starts = np.zeros(ngroups, dtype=np.int64)
+    ends = np.zeros(ngroups, dtype=np.int64)
+    # gid is sorted ascending after partition sort renumbering? It is grouped
+    # contiguously because rows are sorted by partition keys.
+    change = np.nonzero(np.diff(gid))[0] + 1
+    starts[1:] = change if len(change) == ngroups - 1 else starts[1:]
+    if len(change) == ngroups - 1:
+        ends[:-1] = change
+        ends[-1] = len(gid)
+    else:  # single group
+        ends[:] = len(gid)
+    return starts, ends
+
+
+def _order_key_codes(sorted_t: HostTable, spec) -> np.ndarray:
+    """int codes increasing with the sort order, for peer detection."""
+    if not spec.orders:
+        return np.zeros(sorted_t.num_rows, dtype=np.int64)
+    # rows already sorted: peers = consecutive rows with equal order keys
+    ctx = EvalContext.for_host(sorted_t)
+    eq = np.ones(sorted_t.num_rows, dtype=bool)
+    for o in spec.orders:
+        c = o.expr.eval(ctx)
+        v = np.asarray(c.values)
+        valid = c.validity if c.validity is not None \
+            else np.ones(len(v), dtype=bool)
+        if v.dtype.kind == "f":
+            same = (v == np.roll(v, 1)) | (np.isnan(v) & np.isnan(np.roll(v, 1)))
+        else:
+            same = v == np.roll(v, 1)
+        same &= valid == np.roll(valid, 1)
+        same |= (~valid) & (~np.roll(valid, 1))
+        eq &= same
+    eq[0] = False
+    return np.cumsum(~eq)
+
+
+def _compute_window(sorted_t: HostTable, w: WindowExpression, gid: np.ndarray,
+                    seg_bounds) -> HostColumn:
+    n = sorted_t.num_rows
+    starts, ends = seg_bounds
+    seg_start = starts[gid]
+    seg_end = ends[gid]
+    pos = np.arange(n, dtype=np.int64)
+    pos_in_seg = pos - seg_start
+    fn = w.fn
+    if isinstance(fn, RowNumber):
+        return HostColumn(dt.INT, (pos_in_seg + 1).astype(np.int32))
+    if isinstance(fn, (Rank, DenseRank, NTile)) or isinstance(fn, (Lag, Lead)):
+        if isinstance(fn, NTile):
+            seg_len = seg_end - seg_start
+            k = fn.n
+            # Spark NTile: first (len % k) buckets get (len//k + 1) rows
+            base = seg_len // k
+            rem = seg_len % k
+            cut = rem * (base + 1)
+            tile = np.where(pos_in_seg < cut,
+                            pos_in_seg // np.maximum(base + 1, 1),
+                            rem + (pos_in_seg - cut) // np.maximum(base, 1))
+            return HostColumn(dt.INT, (tile + 1).astype(np.int32))
+        if isinstance(fn, (Lag, Lead)):
+            off = fn.offset if isinstance(fn, Lead) else -fn.offset
+            src = np.clip(pos + off, 0, max(n - 1, 0))
+            in_seg = (pos + off >= seg_start) & (pos + off < seg_end)
+            ctx = EvalContext.for_host(sorted_t)
+            c = fn.child.eval(ctx)
+            vals = np.asarray(c.values)[src] if n else np.asarray(c.values)
+            valid = (c.validity[src] if c.validity is not None
+                     else np.ones(n, dtype=bool)) & in_seg
+            if fn.default is not None:
+                fill = ~in_seg
+                vals = vals.copy()
+                vals[fill] = fn.default
+                valid = valid | fill
+            return HostColumn(c.dtype, vals, None if valid.all() else valid)
+        peers = _order_key_codes(sorted_t, w.spec)
+        if isinstance(fn, DenseRank):
+            # dense rank: count of distinct peer groups so far within segment
+            first_peer = np.zeros(n, dtype=np.int64)
+            # peer code at segment start
+            start_code = peers[seg_start]
+            dr = peers - start_code + 1
+            return HostColumn(dt.INT, dr.astype(np.int32))
+        # rank: position of first row of this peer group within segment + 1
+        first_of_peer = np.zeros(n, dtype=np.int64)
+        is_first = np.ones(n, dtype=bool)
+        is_first[1:] = (peers[1:] != peers[:-1]) | (gid[1:] != gid[:-1])
+        first_idx = np.where(is_first, pos, 0)
+        first_idx = np.maximum.accumulate(first_idx)
+        return HostColumn(dt.INT, (first_idx - seg_start + 1).astype(np.int32))
+    if isinstance(fn, AggregateFunction):
+        return _agg_window(sorted_t, w, gid, seg_start, seg_end, pos)
+    raise NotImplementedError(type(fn).__name__)
+
+
+def _agg_window(sorted_t: HostTable, w: WindowExpression, gid, seg_start,
+                seg_end, pos) -> HostColumn:
+    fn = w.fn
+    frame = w.spec.frame
+    n = sorted_t.num_rows
+    ctx = EvalContext.for_host(sorted_t)
+    if isinstance(fn, CountStar):
+        vals = np.ones(n, dtype=np.int64)
+        valid = np.ones(n, dtype=bool)
+        in_dtype = dt.LONG
+    else:
+        c = fn.children[0].eval(ctx)
+        vals = np.asarray(c.values)
+        valid = c.validity if c.validity is not None \
+            else np.ones(n, dtype=bool)
+        in_dtype = c.dtype
+    out_dt = fn.data_type
+    if frame.is_unbounded_entire or (not w.spec.orders and
+                                     frame.start is None and frame.end == 0):
+        col = HostColumn(in_dtype, vals, None if valid.all() else valid)
+        op = _op_of(fn)
+        ngroups = int(gid.max()) + 1 if n else 0
+        red, rvalid = host_group_reduce(op, col, gid, max(ngroups, 1), out_dt)
+        out, ovalid = _final_of(fn, sorted_t, gid, red, rvalid, col, out_dt)
+        res = out[gid]
+        resv = None if ovalid is None else ovalid[gid]
+        return HostColumn(out_dt, _cast_np(res, out_dt),
+                          None if resv is None or resv.all() else resv)
+    if frame.is_running:
+        lo = seg_start
+        if frame.kind == "range" and w.spec.orders:
+            peers = _order_key_codes(sorted_t, w.spec)
+            # end of my peer group
+            is_last = np.ones(n, dtype=bool)
+            is_last[:-1] = (peers[1:] != peers[:-1]) | (gid[1:] != gid[:-1])
+            last_idx = np.where(is_last, pos, n - 1)
+            last_idx = _backward_min(last_idx, is_last)
+            hi = last_idx + 1
+        else:
+            hi = pos + 1
+        return _range_reduce(fn, vals, valid, lo, hi, out_dt)
+    if frame.kind == "rows":
+        s = seg_start if frame.start is None else np.maximum(
+            pos + frame.start, seg_start)
+        e = seg_end if frame.end is None else np.minimum(
+            pos + frame.end + 1, seg_end)
+        e = np.maximum(e, s)
+        return _range_reduce(fn, vals, valid, s, e, out_dt)
+    raise NotImplementedError(f"frame {frame.describe()}")
+
+
+def _backward_min(last_idx, is_last):
+    """Propagate each peer-group-end index backwards over the group."""
+    n = len(last_idx)
+    marked = np.where(is_last, last_idx, np.int64(n))
+    return np.minimum.accumulate(marked[::-1])[::-1]
+
+
+def _range_reduce(fn, vals, valid, lo, hi, out_dt) -> HostColumn:
+    """Reduce vals[lo[i]:hi[i]] per row via prefix sums / cumulative tricks."""
+    n = len(vals)
+    if isinstance(fn, (Sum, Average, Count, CountStar)):
+        x = np.where(valid, vals, 0)
+        if vals.dtype.kind == "f":
+            x = np.where(valid, vals, 0.0)
+        csum = np.concatenate([[0], np.cumsum(x.astype(np.float64)
+                                              if vals.dtype.kind == "f"
+                                              else x.astype(np.int64))])
+        ccnt = np.concatenate([[0], np.cumsum(valid.astype(np.int64))])
+        s = csum[hi] - csum[lo]
+        cnt = ccnt[hi] - ccnt[lo]
+        if isinstance(fn, (Count, CountStar)):
+            return HostColumn(dt.LONG, cnt.astype(np.int64))
+        if isinstance(fn, Sum):
+            return HostColumn(out_dt, _cast_np(s, out_dt),
+                              None if (cnt > 0).all() else cnt > 0)
+        with np.errstate(invalid="ignore", divide="ignore"):
+            avg = s / cnt
+        return HostColumn(dt.DOUBLE, avg, None if (cnt > 0).all() else cnt > 0)
+    if isinstance(fn, (Min, Max)):
+        return _range_minmax(isinstance(fn, Min), vals, valid, lo, hi, out_dt)
+    raise NotImplementedError(type(fn).__name__)
+
+
+def _sparse_table(x: np.ndarray, op) -> list:
+    """Power-of-two range-query table: T[k][i] = op over x[i:i+2^k]."""
+    table = [x]
+    k = 1
+    n = len(x)
+    while (1 << k) <= n:
+        prev = table[-1]
+        half = 1 << (k - 1)
+        table.append(op(prev[:n - (1 << k) + 1], prev[half:n - half + 1]))
+        k += 1
+    return table
+
+
+def _range_minmax(is_min: bool, vals, valid, lo, hi, out_dt) -> HostColumn:
+    """Vectorized per-row [lo, hi) min/max via two overlapping power-of-two
+    windows (sparse table), with Spark NaN total order."""
+    n = len(vals)
+    isfloat = vals.dtype.kind == "f"
+    nan_mask = np.isnan(vals) if isfloat else np.zeros(n, dtype=bool)
+    if isfloat:
+        work = np.where(nan_mask, np.inf if is_min else -np.inf, vals)
+        ident = np.inf if is_min else -np.inf
+    else:
+        work = vals.astype(np.int64)
+        ident = np.iinfo(np.int64).max if is_min else np.iinfo(np.int64).min
+    work = np.where(valid, work, ident)
+    op = np.minimum if is_min else np.maximum
+    table = _sparse_table(work, op)
+    w = np.maximum(hi - lo, 0)
+    has_any = w > 0
+    k = np.zeros(n, dtype=np.int64)
+    nz = w > 0
+    k[nz] = np.floor(np.log2(w[nz])).astype(np.int64)
+    out = np.full(n, ident, dtype=work.dtype)
+    for kk in range(len(table)):
+        sel = nz & (k == kk)
+        if not sel.any():
+            continue
+        a = table[kk][lo[sel]]
+        b = table[kk][hi[sel] - (1 << kk)]
+        out[sel] = op(a, b)
+    # validity: any valid value in range (prefix counts)
+    ccnt = np.concatenate([[0], np.cumsum(valid.astype(np.int64))])
+    cnt = ccnt[np.minimum(hi, n)] - ccnt[np.minimum(lo, n)]
+    has = cnt > 0
+    if isfloat:
+        cnan = np.concatenate([[0], np.cumsum((valid & nan_mask).astype(np.int64))])
+        nnan = cnan[np.minimum(hi, n)] - cnan[np.minimum(lo, n)]
+        if is_min:
+            out = np.where(has & (cnt == nnan), np.nan, out)
+        else:
+            out = np.where(nnan > 0, np.nan, out)
+    return HostColumn(out_dt, _cast_np(out, out_dt),
+                      None if has.all() else has)
+
+
+def _op_of(fn) -> str:
+    if isinstance(fn, Sum):
+        return "sum"
+    if isinstance(fn, (Count, CountStar)):
+        return "count"
+    if isinstance(fn, Min):
+        return "min"
+    if isinstance(fn, Max):
+        return "max"
+    if isinstance(fn, Average):
+        return "sum"
+    raise NotImplementedError(type(fn).__name__)
+
+
+def _final_of(fn, sorted_t, gid, red, rvalid, col, out_dt):
+    if isinstance(fn, Average):
+        cnts, _ = host_group_reduce("count", col, gid, len(red), dt.LONG)
+        with np.errstate(invalid="ignore", divide="ignore"):
+            out = red.astype(np.float64) / cnts
+        return out, cnts > 0
+    return red, rvalid
+
+
+def _cast_np(vals: np.ndarray, out_dt) -> np.ndarray:
+    want = out_dt.np_dtype()
+    if vals.dtype == want or vals.dtype == object:
+        return vals
+    with np.errstate(invalid="ignore"):
+        return vals.astype(want)

@@ -16,7 +16,8 @@ from ..conf import RapidsConf, register_conf
 from ..expr.base import Alias, AttributeReference, Expression
 from .logical import (LogicalAggregate, LogicalCache, LogicalFilter,
                       LogicalJoin, LogicalLimit, LogicalPlan, LogicalProject,
-                      LogicalRange, LogicalScan, LogicalSort, LogicalUnion)
+                      LogicalRange, LogicalScan, LogicalSort, LogicalUnion,
+                      LogicalWindow)
 from .physical import (AggSpec, CpuFilterExec, CpuGlobalLimitExec,
                        CpuHashAggregateExec, CpuLocalLimitExec, CpuProjectExec,
                        CpuRangeExec, CpuScanExec, CpuSortExec, CpuUnionExec,
@@ -88,6 +89,25 @@ def _plan(node: LogicalPlan, conf: RapidsConf,
 
     if isinstance(node, LogicalRange):
         return CpuRangeExec(node.start, node.end, node.step, node.num_partitions)
+
+    if isinstance(node, LogicalWindow):
+        from ..expr.base import AttributeReference
+        from .physical_window import CpuWindowExec
+        refs = set() if required is None else set(required)
+        for _, w in node.window_cols:
+            refs |= w.references()
+        child_req = None if required is None else refs
+        child = _plan(node.child, conf, child_req)
+        spec = node.window_cols[0][1].spec
+        if child.num_partitions > 1:
+            part_cols = [e.column_name for e in spec.partition_exprs
+                         if isinstance(e, AttributeReference)]
+            if part_cols and len(part_cols) == len(spec.partition_exprs):
+                child = ShuffleExchangeExec(
+                    child, HashPartitioning(part_cols, nparts))
+            else:
+                child = ShuffleExchangeExec(child, SinglePartitioning())
+        return CpuWindowExec(child, node.window_cols)
 
     if isinstance(node, LogicalCache):
         from ..exec.cache import CpuCacheExec

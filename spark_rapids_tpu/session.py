@@ -111,14 +111,61 @@ class DataFrame:
     # -- transformations -----------------------------------------------------
     def select(self, *cols) -> "DataFrame":
         exprs = [self._col_expr(c) for c in cols]
-        return DataFrame(self.session, LogicalProject(self.logical, exprs))
+        return self._project_with_windows(exprs)
+
+    def _project_with_windows(self, exprs: List[Expression]) -> "DataFrame":
+        """Pull top-level window expressions into stacked LogicalWindow nodes
+        (reference: GpuWindowExec meta splitting pre/post projections)."""
+        from .expr.base import Alias, AttributeReference
+        from .expr.window import WindowExpression
+        from .plan.logical import LogicalWindow
+
+        def top_window(e):
+            if isinstance(e, WindowExpression):
+                return e
+            if isinstance(e, Alias) and isinstance(e.child, WindowExpression):
+                return e.child
+            return None
+
+        win_items = []
+        final_exprs: List[Expression] = []
+        for i, e in enumerate(exprs):
+            w = top_window(e)
+            if w is None:
+                if any(isinstance(x, WindowExpression)
+                       for x in _walk_expr(e)):
+                    raise NotImplementedError(
+                        "window expressions nested inside other expressions "
+                        "are not supported yet; alias the window column first")
+                final_exprs.append(e)
+            else:
+                # internal name avoids collisions when the window column
+                # overwrites an existing column (with_column("x", ...over(w)))
+                target = e.name if isinstance(e, Alias) else f"_w{i}"
+                internal = f"__win{i}_{target}"
+                win_items.append((internal, w))
+                final_exprs.append(Alias(AttributeReference(internal), target))
+        if not win_items:
+            return DataFrame(self.session,
+                             LogicalProject(self.logical, exprs))
+        # group by identical (partition, order) spec to share one sort each
+        base = self.logical
+        groups = {}
+        for name, w in win_items:
+            key = (tuple(repr(p) for p in w.spec.partition_exprs),
+                   tuple((repr(o.expr), o.ascending, o.nulls_first)
+                         for o in w.spec.orders))
+            groups.setdefault(key, []).append((name, w))
+        for _, items in groups.items():
+            base = LogicalWindow(base, items)
+        return DataFrame(self.session, LogicalProject(base, final_exprs))
 
     def with_column(self, name: str, c) -> "DataFrame":
         from .expr.base import Alias, AttributeReference
         exprs: List[Expression] = [
             AttributeReference(n) for n in self.schema.names if n != name]
         exprs.append(Alias(_to_expr(c), name))
-        return DataFrame(self.session, LogicalProject(self.logical, exprs))
+        return self._project_with_windows(exprs)
 
     def filter(self, cond) -> "DataFrame":
         return DataFrame(self.session,
@@ -225,6 +272,12 @@ class GroupedData:
     def count(self) -> DataFrame:
         from .expr.functions import count_star
         return self.agg(count_star().alias("count"))
+
+
+def _walk_expr(e):
+    yield e
+    for c in e.children:
+        yield from _walk_expr(c)
 
 
 def _as_col(c):

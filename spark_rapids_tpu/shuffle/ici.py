@@ -1,0 +1,158 @@
+"""ICI mesh-collective exchange — the accelerated shuffle tier.
+
+Reference mapping (SURVEY §2.7 / §5): the UCX RDMA transport
+(shuffle-plugin/.../UCX.scala:69) moves partitioned batches executor-to-
+executor over NVLink/IB. The TPU-native equivalent keeps exchanges ON DEVICE:
+rows live as one mesh-sharded DeviceTable; a hash-partition kernel + a single
+``jax.lax.all_to_all`` over the ``dp`` axis re-homes every row across ICI
+links inside one XLA program — no host staging, no serialization.
+
+Static-shape contract: all_to_all needs equal per-destination quotas, so each
+shard reserves ``local_capacity`` slots per destination (worst case: every
+local row targets one peer). Overflow is thus impossible; the cost is an
+n_devices× intermediate, bounded by per-shard batch capacity. A later round
+can exchange per-destination counts first and right-size quotas.
+
+Works under ``shard_map`` on any mesh — real ICI on TPU pods, XLA-emulated on
+the CPU test mesh (tests/conftest.py).
+"""
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+try:
+    from jax import shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..columnar.device import DeviceColumn, DeviceTable
+from .manager import device_partition_ids
+
+__all__ = ["ici_all_to_all_exchange", "shard_table", "unshard_table"]
+
+
+def shard_table(table: DeviceTable, mesh: Mesh, axis: str = "dp"
+                ) -> DeviceTable:
+    """Place a DeviceTable row-sharded over the mesh axis."""
+    n = mesh.shape[axis]
+    assert table.capacity % n == 0, \
+        f"capacity {table.capacity} not divisible by mesh axis {n}"
+    sharding = NamedSharding(mesh, P(axis))
+    rep = NamedSharding(mesh, P())
+
+    def put_col(c: DeviceColumn) -> DeviceColumn:
+        return DeviceColumn(
+            jax.device_put(c.data, sharding),
+            jax.device_put(c.validity, sharding), c.dtype,
+            None if c.lengths is None else jax.device_put(c.lengths, sharding))
+
+    return DeviceTable(tuple(put_col(c) for c in table.columns),
+                       jax.device_put(table.row_mask, sharding),
+                       jax.device_put(table.num_rows, rep), table.names)
+
+
+def unshard_table(table: DeviceTable) -> DeviceTable:
+    import numpy as np
+    cols = tuple(DeviceColumn(jnp.asarray(np.asarray(c.data)),
+                              jnp.asarray(np.asarray(c.validity)), c.dtype,
+                              None if c.lengths is None
+                              else jnp.asarray(np.asarray(c.lengths)))
+                 for c in table.columns)
+    mask = jnp.asarray(np.asarray(table.row_mask))
+    return DeviceTable(cols, mask, jnp.sum(mask, dtype=jnp.int32), table.names)
+
+
+def ici_all_to_all_exchange(table: DeviceTable, key_names: List[str],
+                            mesh: Mesh, axis: str = "dp") -> DeviceTable:
+    """Hash-exchange a row-sharded table so rows with equal keys land on the
+    same shard, as one jitted shard_map program (collectives over ICI).
+
+    Returns a row-sharded table with per-shard capacity n * local_capacity
+    (padding masked off)."""
+    n = mesh.shape[axis]
+    names = table.names
+    dtypes = [c.dtype for c in table.columns]
+    has_lengths = [c.lengths is not None for c in table.columns]
+
+    # flatten to arrays: mask, then per column: data, validity, (lengths)
+    arrays = [table.row_mask]
+    for c in table.columns:
+        arrays.append(c.data)
+        arrays.append(c.validity)
+        if c.lengths is not None:
+            arrays.append(c.lengths)
+
+    def local(*arrs):
+        mask = arrs[0]
+        cap = mask.shape[0]
+        pos = 1
+        cols = []
+        for d, hl in zip(dtypes, has_lengths):
+            data = arrs[pos]
+            validity = arrs[pos + 1]
+            pos_inc = 2
+            lengths = None
+            if hl:
+                lengths = arrs[pos + 2]
+                pos_inc = 3
+            cols.append(DeviceColumn(data, validity, d, lengths))
+            pos += pos_inc
+        local_tbl = DeviceTable(tuple(cols), mask,
+                                jnp.sum(mask, dtype=jnp.int32), names)
+        pid = device_partition_ids(local_tbl, key_names, n)
+        pid = jnp.where(mask, pid, n)  # park inactive rows past the end
+        order = jnp.argsort(pid, stable=True)
+        sorted_pid = jnp.take(pid, order)
+        iota = jnp.arange(cap, dtype=jnp.int32)
+        start = jnp.searchsorted(sorted_pid,
+                                 jnp.arange(n, dtype=sorted_pid.dtype))
+        dst = jnp.clip(sorted_pid, 0, n - 1).astype(jnp.int32)
+        k = iota - jnp.take(start, dst).astype(jnp.int32)
+        ok = sorted_pid < n
+
+        def scatter(x):
+            xs = jnp.take(x, order, axis=0)
+            buckets = jnp.zeros((n, cap) + xs.shape[1:], dtype=xs.dtype)
+            fill = jnp.where(ok.reshape((-1,) + (1,) * (xs.ndim - 1)), xs,
+                             jnp.zeros_like(xs))
+            return buckets.at[dst, k].set(fill, mode="drop")
+
+        out = []
+        slot_mask = jnp.zeros((n, cap), dtype=bool).at[dst, k].set(
+            ok, mode="drop")
+        out.append(jax.lax.all_to_all(slot_mask, axis, 0, 0,
+                                      tiled=True).reshape(n * cap))
+        for c in cols:
+            out.append(jax.lax.all_to_all(scatter(c.data), axis, 0, 0,
+                                          tiled=True)
+                       .reshape((n * cap,) + c.data.shape[1:]))
+            out.append(jax.lax.all_to_all(scatter(c.validity), axis, 0, 0,
+                                          tiled=True).reshape(n * cap))
+            if c.lengths is not None:
+                out.append(jax.lax.all_to_all(scatter(c.lengths), axis, 0, 0,
+                                              tiled=True).reshape(n * cap))
+        return tuple(out)
+
+    in_specs = tuple(P(axis) for _ in arrays)
+    n_out = 1 + sum(2 + int(h) for h in has_lengths)
+    out_specs = tuple(P(axis) for _ in range(n_out))
+    fn = jax.jit(shard_map(local, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, check_vma=False))
+    results = fn(*arrays)
+
+    mask = results[0]
+    pos = 1
+    out_cols = []
+    for d, hl in zip(dtypes, has_lengths):
+        data = results[pos]
+        validity = results[pos + 1]
+        lengths = results[pos + 2] if hl else None
+        pos += 3 if hl else 2
+        out_cols.append(DeviceColumn(data, validity, d, lengths))
+    total = jnp.sum(mask, dtype=jnp.int32)
+    return DeviceTable(tuple(out_cols), mask, total, names)

@@ -1,0 +1,161 @@
+"""Shuffle manager: device-side partition slicing + transport-backed exchange
+(reference: RapidsShuffleInternalManagerBase.scala — RapidsCachingWriter at
+:92-155, RapidsShuffleIterator / RapidsShuffleClient on the read side; and
+GpuPartitioning.sliceInternalOnGpu, GpuPartitioning.scala:49,130).
+
+Write path per map partition:
+  device batch -> device hash kernel assigns reduce partition per row
+  -> one compact-by-partition sort -> slice per reduce partition (host loop
+     over bucketed slices) -> serialize (+codec) -> transport.publish
+Read path per reduce partition:
+  transport.fetch -> deserialize -> host-concat (GpuShuffleCoalesceExec
+  analogue) -> upload as one device batch.
+
+A heartbeat registry stands in for the executor discovery control plane
+(reference: RapidsShuffleHeartbeatManager.scala).
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Dict, Iterator, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar.device import DeviceTable
+from ..columnar.host import HostTable
+from ..conf import RapidsConf, SHUFFLE_COMPRESSION_CODEC
+from .serializer import deserialize_table, serialize_table
+from .transport import BlockId, ShuffleTransport, load_transport
+
+__all__ = ["ShuffleManager", "HeartbeatManager", "device_partition_ids"]
+
+
+_MURMUR_C1 = np.uint32(0x85EBCA6B)
+_MURMUR_C2 = np.uint32(0xC2B2AE35)
+
+
+def _fmix_device(x: jax.Array) -> jax.Array:
+    x = x.astype(jnp.uint32)
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(_MURMUR_C1)
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(_MURMUR_C2)
+    x = x ^ (x >> 16)
+    return x
+
+
+def device_partition_ids(table: DeviceTable, key_names: List[str],
+                         num_parts: int, seed: int = 42) -> jax.Array:
+    """Per-row reduce-partition ids; bitwise-identical to the host
+    murmur-style partitioner (plan/physical.py murmur_hash_columns) so host
+    and device paths agree on placement."""
+    h = jnp.full(table.capacity, jnp.uint32(seed), dtype=jnp.uint32)
+    for name in key_names:
+        col = table.column(name)
+        v = col.data
+        if v.dtype == jnp.bool_:
+            k = v.astype(jnp.uint32)
+        elif jnp.issubdtype(v.dtype, jnp.floating):
+            bits = v.astype(jnp.float64).view(jnp.uint64)
+            k = (bits & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32) \
+                ^ (bits >> jnp.uint64(32)).astype(jnp.uint32)
+        else:
+            bits = v.astype(jnp.int64).view(jnp.uint64)
+            k = (bits & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32) \
+                ^ (bits >> jnp.uint64(32)).astype(jnp.uint32)
+        k = _fmix_device(k)
+        k = jnp.where(col.validity, k, jnp.uint32(0))
+        h = h ^ k
+        h = h * jnp.uint32(5) + jnp.uint32(0xE6546B64)
+    return (h % jnp.uint32(num_parts)).astype(jnp.int32)
+
+
+class HeartbeatManager:
+    """Executor registration/heartbeat control plane (reference:
+    Plugin.scala:149-161 + RapidsShuffleHeartbeatManager.scala)."""
+
+    def __init__(self, timeout_s: float = 60.0):
+        self._peers: Dict[int, float] = {}
+        self._lock = threading.Lock()
+        self.timeout_s = timeout_s
+
+    def register(self, executor_id: int):
+        self.heartbeat(executor_id)
+
+    def heartbeat(self, executor_id: int):
+        with self._lock:
+            self._peers[executor_id] = time.monotonic()
+
+    def live_peers(self) -> List[int]:
+        now = time.monotonic()
+        with self._lock:
+            return sorted(e for e, t in self._peers.items()
+                          if now - t < self.timeout_s)
+
+    def expire(self):
+        now = time.monotonic()
+        with self._lock:
+            for e in [e for e, t in self._peers.items()
+                      if now - t >= self.timeout_s]:
+                del self._peers[e]
+
+
+class ShuffleManager:
+    def __init__(self, conf: Optional[RapidsConf] = None,
+                 transport: Optional[ShuffleTransport] = None):
+        self.conf = conf or RapidsConf()
+        self.transport = transport or load_transport(self.conf)
+        self.codec = self.conf.get(SHUFFLE_COMPRESSION_CODEC)
+        if self.codec not in ("none", "zlib"):
+            self.codec = "zlib" if self.codec in ("zstd", "lz4") else "none"
+        self._ids = itertools.count()
+        self.heartbeats = HeartbeatManager()
+
+    def new_shuffle_id(self) -> int:
+        return next(self._ids)
+
+    # -- write side -----------------------------------------------------------
+    def write_partition(self, shuffle_id: int, map_id: int,
+                        batches: Iterator[DeviceTable], key_names: List[str],
+                        num_parts: int) -> List[int]:
+        """Slice + publish one map task's output; returns bytes per block."""
+        sizes = [0] * num_parts
+        merged: List[List[HostTable]] = [[] for _ in range(num_parts)]
+        for batch in batches:
+            pids = device_partition_ids(batch, key_names, num_parts)
+            pids = jnp.where(batch.row_mask, pids, num_parts)  # park inactive
+            order = jnp.argsort(pids, stable=True)
+            sorted_tbl = DeviceTable(
+                tuple(c.gather(order) for c in batch.columns),
+                jnp.take(batch.row_mask, order), batch.num_rows, batch.names)
+            sorted_pids = np.asarray(jnp.take(pids, order))
+            bounds = np.searchsorted(sorted_pids, np.arange(num_parts + 1))
+            host = sorted_tbl.to_host()  # single download, dense prefix
+            for p in range(num_parts):
+                lo, hi = int(bounds[p]), int(bounds[p + 1])
+                if hi > lo:
+                    merged[p].append(host.slice(lo, hi - lo))
+        for p in range(num_parts):
+            if merged[p]:
+                payload = serialize_table(HostTable.concat(merged[p]),
+                                          self.codec)
+                self.transport.publish(BlockId(shuffle_id, map_id, p), payload)
+                sizes[p] = len(payload)
+        return sizes
+
+    # -- read side ------------------------------------------------------------
+    def read_partition(self, shuffle_id: int, num_maps: int, reduce_id: int,
+                       min_bucket: int = 1024) -> Iterator[DeviceTable]:
+        blocks = [BlockId(shuffle_id, m, reduce_id) for m in range(num_maps)]
+        tables: List[HostTable] = []
+        for _, payload in self.transport.fetch(blocks):
+            tables.append(deserialize_table(payload))
+        if not tables:
+            return
+        # host-side coalesce then single upload (GpuShuffleCoalesceExec)
+        merged = HostTable.concat(tables)
+        yield DeviceTable.from_host(merged, min_bucket)

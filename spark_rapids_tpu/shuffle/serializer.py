@@ -1,0 +1,133 @@
+"""Host columnar batch serialization (reference: GpuColumnarBatchSerializer +
+JCudfSerialization host-buffer table format + TableCompressionCodec.scala).
+
+Framed binary format (little-endian):
+
+    magic 'SRTT' | u32 version | u32 codec | u64 payload_len | payload
+
+payload (possibly compressed) = a pickle-free header (JSON) + raw column
+buffers. Strings are serialized as concatenated UTF-8 + int32 offsets (dense),
+not the device fixed-width layout — wire size matters more than device layout
+here. A C++ serializer can swap in underneath without format change.
+"""
+from __future__ import annotations
+
+import io
+import json
+import struct
+import zlib
+from typing import List, Optional
+
+import numpy as np
+
+from ..columnar import dtypes as dt
+from ..columnar.host import HostColumn, HostTable
+
+__all__ = ["serialize_table", "deserialize_table", "CODECS"]
+
+_MAGIC = b"SRTT"
+_VERSION = 1
+
+CODECS = {"none": 0, "zlib": 1}
+_CODEC_BY_ID = {v: k for k, v in CODECS.items()}
+
+
+def _dtype_tag(d: dt.DataType) -> str:
+    if isinstance(d, dt.DecimalType):
+        return f"decimal({d.precision},{d.scale})"
+    return d.simple_name
+
+
+def _tag_dtype(tag: str) -> dt.DataType:
+    if tag.startswith("decimal("):
+        p, s = tag[8:-1].split(",")
+        return dt.DecimalType(int(p), int(s))
+    table = {
+        "boolean": dt.BOOLEAN, "tinyint": dt.BYTE, "smallint": dt.SHORT,
+        "int": dt.INT, "bigint": dt.LONG, "float": dt.FLOAT,
+        "double": dt.DOUBLE, "string": dt.STRING, "binary": dt.BINARY,
+        "date": dt.DATE, "timestamp": dt.TIMESTAMP, "null": dt.NULL,
+    }
+    return table[tag]
+
+
+def serialize_table(table: HostTable, codec: str = "none") -> bytes:
+    buf = io.BytesIO()
+    n = table.num_rows
+    header = {"n": n, "cols": []}
+    payloads: List[bytes] = []
+    for name, col in zip(table.names, table.columns):
+        entry = {"name": name, "dtype": _dtype_tag(col.dtype),
+                 "has_validity": col.validity is not None}
+        if isinstance(col.dtype, (dt.StringType, dt.BinaryType)):
+            encoded = [v.encode("utf-8") if isinstance(v, str) else bytes(v)
+                       for v in col.values]
+            offsets = np.zeros(n + 1, dtype=np.int32)
+            for i, b in enumerate(encoded):
+                offsets[i + 1] = offsets[i] + len(b)
+            blob = b"".join(encoded)
+            entry["nbytes"] = [offsets.nbytes, len(blob)]
+            payloads.append(offsets.tobytes())
+            payloads.append(blob)
+        else:
+            data = np.ascontiguousarray(col.values)
+            entry["np"] = data.dtype.str
+            entry["nbytes"] = [data.nbytes]
+            payloads.append(data.tobytes())
+        if col.validity is not None:
+            v = np.packbits(col.validity)
+            entry["validity_nbytes"] = v.nbytes
+            payloads.append(v.tobytes())
+        header["cols"].append(entry)
+    hj = json.dumps(header).encode()
+    body = struct.pack("<I", len(hj)) + hj + b"".join(payloads)
+    if codec == "zlib":
+        body = zlib.compress(body, level=1)
+    buf.write(_MAGIC)
+    buf.write(struct.pack("<II", _VERSION, CODECS[codec]))
+    buf.write(struct.pack("<Q", len(body)))
+    buf.write(body)
+    return buf.getvalue()
+
+
+def deserialize_table(data: bytes) -> HostTable:
+    assert data[:4] == _MAGIC, "bad magic"
+    version, codec_id = struct.unpack_from("<II", data, 4)
+    assert version == _VERSION, version
+    (length,) = struct.unpack_from("<Q", data, 12)
+    body = data[20:20 + length]
+    if _CODEC_BY_ID[codec_id] == "zlib":
+        body = zlib.decompress(body)
+    (hlen,) = struct.unpack_from("<I", body, 0)
+    header = json.loads(body[4:4 + hlen])
+    pos = 4 + hlen
+    n = header["n"]
+    names, cols = [], []
+    for entry in header["cols"]:
+        d = _tag_dtype(entry["dtype"])
+        if isinstance(d, (dt.StringType, dt.BinaryType)):
+            olen, blen = entry["nbytes"]
+            offsets = np.frombuffer(body, dtype=np.int32, count=n + 1,
+                                    offset=pos)
+            pos += olen
+            blob = body[pos:pos + blen]
+            pos += blen
+            vals = np.empty(n, dtype=object)
+            for i in range(n):
+                raw = blob[offsets[i]:offsets[i + 1]]
+                vals[i] = raw.decode("utf-8") if isinstance(d, dt.StringType) \
+                    else bytes(raw)
+        else:
+            (nbytes,) = entry["nbytes"]
+            vals = np.frombuffer(body, dtype=np.dtype(entry["np"]), count=n,
+                                 offset=pos).copy()
+            pos += nbytes
+        validity = None
+        if entry["has_validity"]:
+            vb = np.frombuffer(body, dtype=np.uint8,
+                               count=entry["validity_nbytes"], offset=pos)
+            pos += entry["validity_nbytes"]
+            validity = np.unpackbits(vb)[:n].astype(bool)
+        names.append(entry["name"])
+        cols.append(HostColumn(d, vals, validity))
+    return HostTable(names, cols)

@@ -1,0 +1,83 @@
+"""Shuffle transport SPI (reference: shuffle/RapidsShuffleTransport.scala:303
+— connections, transaction lifecycle, bounce-buffer throttling; implementation
+loaded reflectively by class name at :545-569 so alternative transports drop
+in without a hard dependency, exactly like the optional UCX jar).
+
+``LocalShuffleTransport`` is the in-process default. A multi-host DCN/ICI
+transport implements the same three methods; tests drive the protocol with a
+mock transport (reference test strategy SURVEY §4.2).
+"""
+from __future__ import annotations
+
+import importlib
+import threading
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from ..conf import RapidsConf, SHUFFLE_TRANSPORT_CLASS, register_conf
+
+MAX_INFLIGHT_BYTES = register_conf(
+    "spark.rapids.shuffle.maxMetadataSize",
+    "Throttle: max in-flight fetched bytes per reader (reference: "
+    "maxReceiveInflightBytes, RapidsConf.scala:1064).", 1024 * 1024 * 1024)
+
+__all__ = ["BlockId", "ShuffleTransport", "LocalShuffleTransport",
+           "load_transport"]
+
+
+class BlockId(Tuple[int, int, int]):
+    """(shuffle_id, map_id, reduce_id) — reference: ShuffleBlockId."""
+
+    def __new__(cls, shuffle_id: int, map_id: int, reduce_id: int):
+        return super().__new__(cls, (shuffle_id, map_id, reduce_id))
+
+
+class ShuffleTransport:
+    """SPI: store blocks on the 'server' side, fetch from the 'client'."""
+
+    def publish(self, block: BlockId, payload: bytes) -> None:
+        raise NotImplementedError
+
+    def fetch(self, blocks: List[BlockId]) -> Iterator[Tuple[BlockId, bytes]]:
+        raise NotImplementedError
+
+    def remove_shuffle(self, shuffle_id: int) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class LocalShuffleTransport(ShuffleTransport):
+    """In-process block store (the 'boring fallback' tier of SURVEY §5)."""
+
+    def __init__(self, conf: Optional[RapidsConf] = None):
+        self._blocks: Dict[BlockId, bytes] = {}
+        self._lock = threading.Lock()
+        self.bytes_published = 0
+        self.bytes_fetched = 0
+
+    def publish(self, block: BlockId, payload: bytes) -> None:
+        with self._lock:
+            self._blocks[block] = payload
+            self.bytes_published += len(payload)
+
+    def fetch(self, blocks: List[BlockId]) -> Iterator[Tuple[BlockId, bytes]]:
+        for b in blocks:
+            with self._lock:
+                payload = self._blocks.get(b)
+            if payload is not None:
+                self.bytes_fetched += len(payload)
+                yield b, payload
+
+    def remove_shuffle(self, shuffle_id: int) -> None:
+        with self._lock:
+            for b in [b for b in self._blocks if b[0] == shuffle_id]:
+                del self._blocks[b]
+
+
+def load_transport(conf: RapidsConf) -> ShuffleTransport:
+    """Reflective load by class name (reference: RapidsShuffleTransport.scala:545)."""
+    clsname = conf.get(SHUFFLE_TRANSPORT_CLASS)
+    module, _, name = clsname.rpartition(".")
+    cls = getattr(importlib.import_module(module), name)
+    return cls(conf)

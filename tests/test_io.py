@@ -1,0 +1,97 @@
+"""IO format tests (reference analogues: csv_test.py, json_test.py,
+orc_test.py, parquet_write_test.py in integration_tests/)."""
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.expr.functions import col, lit, sum as fsum
+from harness import assert_tables_equal, assert_tpu_cpu_equal, data_gen
+
+
+@pytest.fixture
+def table(rng):
+    return data_gen(rng, 300, {"k": ("int32", 0, 5), "i": "int64",
+                               "f": "float64", "s": "string"})
+
+
+def test_parquet_roundtrip(session, table, tmp_path):
+    df = session.create_dataframe(table)
+    df.write_parquet(str(tmp_path / "out"))
+    assert os.path.exists(tmp_path / "out" / "_SUCCESS")
+    back = session.read_parquet(str(tmp_path / "out"))
+    assert_tables_equal(back.collect(), table.select(back.columns))
+
+
+def test_parquet_partitioned_write(session, table, tmp_path):
+    df = session.create_dataframe(table)
+    from spark_rapids_tpu.io.writer import write_parquet
+    stats = write_parquet(df, str(tmp_path / "p"), partition_by=["k"])
+    assert stats.num_rows == 300
+    assert len(stats.partitions) >= 2
+    dirs = [d for d in os.listdir(tmp_path / "p") if d.startswith("k=")]
+    assert len(dirs) == len(stats.partitions)
+    # read one partition dir back
+    one = session.read_parquet(str(tmp_path / "p" / dirs[0]))
+    assert "i" in one.columns and "k" not in one.columns
+
+
+def test_parquet_query_multifile(session, table, tmp_path):
+    os.makedirs(tmp_path / "mf")
+    pq.write_table(table.slice(0, 100), tmp_path / "mf" / "a.parquet")
+    pq.write_table(table.slice(100), tmp_path / "mf" / "b.parquet")
+    df = session.read_parquet(str(tmp_path / "mf"))
+    q = df.filter(col("i") > lit(0)).group_by("k").agg(
+        fsum(col("f")).alias("sf"))
+    assert_tpu_cpu_equal(q, rel_tol=1e-6)
+
+
+@pytest.mark.parametrize("reader", ["PERFILE", "COALESCING", "MULTITHREADED"])
+def test_parquet_reader_types(session, table, tmp_path, reader):
+    os.makedirs(tmp_path / "rt")
+    for i in range(4):
+        pq.write_table(table.slice(i * 75, 75), tmp_path / "rt" / f"{i}.parquet")
+    s2 = type(session)(session.conf.set(
+        "spark.rapids.sql.format.parquet.reader.type", reader))
+    df = s2.read_parquet(str(tmp_path / "rt"))
+    out = df.collect()
+    assert out.num_rows == 300
+
+
+def test_csv_roundtrip(session, tmp_path):
+    t = pa.table({"a": [1, 2, 3], "b": [1.5, 2.5, None], "s": ["x", "y", "z"]})
+    df = session.create_dataframe(t)
+    df.write_csv(str(tmp_path / "c"))
+    back = session.read_csv(str(tmp_path / "c") + "/*.csv")
+    out = back.collect()
+    assert out.column("a").to_pylist() == [1, 2, 3]
+    assert out.column("b").to_pylist() == [1.5, 2.5, None]
+
+
+def test_orc_roundtrip(session, table, tmp_path):
+    df = session.create_dataframe(table)
+    df.write_orc(str(tmp_path / "o"))
+    back = session.read_orc(str(tmp_path / "o") + "/*.orc")
+    assert_tables_equal(back.collect(), table.select(back.columns))
+
+
+def test_json_read(session, tmp_path):
+    path = tmp_path / "j.jsonl"
+    with open(path, "w") as f:
+        f.write('{"a": 1, "b": "x"}\n{"a": 2, "b": null}\n')
+    df = session.read_json(str(path))
+    out = df.collect()
+    assert out.column("a").to_pylist() == [1, 2]
+    assert out.column("b").to_pylist() == ["x", None]
+
+
+def test_write_mode_error_and_overwrite(session, table, tmp_path):
+    df = session.create_dataframe(table)
+    df.write_parquet(str(tmp_path / "m"))
+    with pytest.raises(FileExistsError):
+        df.write_parquet(str(tmp_path / "m"))
+    from spark_rapids_tpu.io.writer import write_parquet
+    stats = write_parquet(df, str(tmp_path / "m"), mode="overwrite")
+    assert stats.num_rows == 300

@@ -1,0 +1,118 @@
+"""Memory/spill framework tests (reference analogues: RapidsBufferCatalogSuite,
+RapidsDeviceMemoryStoreSuite, RapidsDiskStoreSuite, GpuSemaphore tests)."""
+import threading
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.columnar import DeviceTable, HostTable
+from spark_rapids_tpu.memory import (BufferCatalog, SpillPriorities,
+                                     StorageTier, TpuSemaphore)
+
+
+def _table(n=64, seed=0):
+    rng = np.random.default_rng(seed)
+    t = pa.table({"a": rng.integers(0, 100, n), "b": rng.uniform(0, 1, n),
+                  "s": [f"str{i}" for i in range(n)]})
+    return DeviceTable.from_host(HostTable.from_arrow(t), min_bucket=8)
+
+
+def test_register_acquire_roundtrip():
+    cat = BufferCatalog(device_limit=1 << 30, host_limit=1 << 30)
+    t = _table()
+    h = cat.register(t)
+    assert h.tier == StorageTier.DEVICE
+    got = h.get()
+    assert got.to_host().to_arrow().equals(t.to_host().to_arrow())
+    h.close()
+    assert cat.stats()["buffers"] == 0
+
+
+def test_spill_to_host_and_restore():
+    t1 = _table(seed=1)
+    nbytes = t1.nbytes()
+    cat = BufferCatalog(device_limit=int(nbytes * 1.5), host_limit=1 << 30)
+    h1 = cat.register(t1, SpillPriorities.INPUT)
+    t2 = _table(seed=2)
+    h2 = cat.register(t2, SpillPriorities.ACTIVE_ON_DECK)
+    # t1 (lower priority) must have spilled to host
+    assert h1.tier == StorageTier.HOST
+    assert h2.tier == StorageTier.DEVICE
+    assert cat.spill_count[StorageTier.HOST] == 1
+    # restoring t1 pushes t2 out
+    got1 = h1.get()
+    assert got1.to_host().to_arrow().equals(t1.to_host().to_arrow())
+    assert h1.tier == StorageTier.DEVICE
+
+
+def test_spill_to_disk_and_restore(tmp_path):
+    t1 = _table(seed=3)
+    nbytes = t1.nbytes()
+    cat = BufferCatalog(device_limit=int(nbytes * 1.5),
+                        host_limit=int(nbytes * 1.5),
+                        disk_dir=str(tmp_path))
+    h1 = cat.register(t1)
+    h2 = cat.register(_table(seed=4))
+    h3 = cat.register(_table(seed=5))
+    tiers = sorted([h1.tier, h2.tier, h3.tier])
+    assert tiers == [StorageTier.DEVICE, StorageTier.HOST, StorageTier.DISK]
+    assert cat.spill_count[StorageTier.DISK] >= 1
+    got1 = h1.get()
+    assert got1.to_host().to_arrow().equals(t1.to_host().to_arrow())
+
+
+def test_priorities_respected():
+    t = _table(seed=6)
+    nbytes = t.nbytes()
+    cat = BufferCatalog(device_limit=int(nbytes * 2.5), host_limit=1 << 30)
+    low = cat.register(_table(seed=7), SpillPriorities.INPUT)
+    high = cat.register(_table(seed=8), SpillPriorities.BROADCAST)
+    cat.register(_table(seed=9), SpillPriorities.ACTIVE_ON_DECK)
+    assert low.tier == StorageTier.HOST  # lowest priority spilled first
+    assert high.tier == StorageTier.DEVICE
+
+
+def test_acquired_buffers_not_spilled():
+    t = _table(seed=10)
+    nbytes = t.nbytes()
+    cat = BufferCatalog(device_limit=int(nbytes * 1.5), host_limit=1 << 30)
+    h1 = cat.register(t)
+    with h1 as acquired:  # pinned while in use
+        cat.register(_table(seed=11))
+        assert h1.tier == StorageTier.DEVICE
+        assert acquired is not None
+
+
+def test_semaphore_admission():
+    sem = TpuSemaphore(1)
+    order = []
+
+    def worker(i):
+        with sem.held(task_id=i):
+            order.append(("in", i))
+            import time
+            time.sleep(0.02)
+            order.append(("out", i))
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(3)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    # never two tasks inside at once
+    depth = 0
+    for kind, _ in order:
+        depth += 1 if kind == "in" else -1
+        assert depth <= 1
+    assert sem.acquire_count == 3
+
+
+def test_semaphore_reentrant():
+    sem = TpuSemaphore(1)
+    sem.acquire_if_necessary(task_id=7)
+    sem.acquire_if_necessary(task_id=7)  # reentrant, no deadlock
+    sem.release_if_held(task_id=7)
+    sem.release_if_held(task_id=7)
+    sem.acquire_if_necessary(task_id=8)
+    sem.release_if_held(task_id=8)
