@@ -25,8 +25,10 @@ __all__ = ["TpuProjectExec", "TpuFilterExec", "TpuRangeExec", "TpuUnionExec",
 
 
 def eval_exprs_device(table: DeviceTable, exprs: Sequence[Expression],
-                      names: Sequence[str]) -> DeviceTable:
-    ctx = EvalContext.for_device(table)
+                      names: Sequence[str], partition_id: int = 0,
+                      batch_row_offset: int = 0) -> DeviceTable:
+    ctx = EvalContext.for_device(table, partition_id=partition_id,
+                                 batch_row_offset=batch_row_offset)
     cols: List[DeviceColumn] = []
     for e in exprs:
         c = e.eval(ctx)
@@ -64,8 +66,26 @@ class TpuProjectExec(TpuExec):
         child_schema = repr(self.children[0].schema) if self.children else ""
         return f"Project|{[repr(e) for e in self.exprs]}|{self.names}|{child_schema}"
 
+    @property
+    def fusible(self) -> bool:
+        # context-dependent exprs (partition id / monotonic id / rand) need a
+        # per-partition context, so they stay out of whole-stage fusion
+        return not any(e.tree_context_dependent() for e in self.exprs)
+
     def execute_columnar(self, pidx: int) -> Iterator[DeviceTable]:
         from ..utils.compile_cache import cached_jit
+        if not self.fusible:
+            # eager device evaluation with an explicit task context
+            offset = 0
+            for batch in self.child_device_batches(pidx):
+                with self.metrics.timed(M.OP_TIME):
+                    out = eval_exprs_device(batch, self.exprs, self.names,
+                                            partition_id=pidx,
+                                            batch_row_offset=offset)
+                offset += batch.capacity
+                self.metrics.add(M.NUM_OUTPUT_BATCHES, 1)
+                yield out
+            return
         fn = cached_jit(self.plan_signature(), self.batch_fn)
         for batch in self.child_device_batches(pidx):
             with self.metrics.timed(M.OP_TIME):
@@ -101,8 +121,27 @@ class TpuFilterExec(TpuExec):
         child_schema = repr(self.children[0].schema) if self.children else ""
         return f"Filter|{self.condition!r}|{child_schema}"
 
+    @property
+    def fusible(self) -> bool:
+        return not self.condition.tree_context_dependent()
+
     def execute_columnar(self, pidx: int) -> Iterator[DeviceTable]:
         from ..utils.compile_cache import cached_jit
+        if not self.fusible:
+            cond = self.condition
+            offset = 0
+            for batch in self.child_device_batches(pidx):
+                with self.metrics.timed(M.OP_TIME):
+                    ctx = EvalContext.for_device(batch, partition_id=pidx,
+                                                 batch_row_offset=offset)
+                    c = cond.eval(ctx)
+                    keep = c.values
+                    if c.validity is not None:
+                        keep = jnp.logical_and(keep, c.validity)
+                    out = batch.filter_mask(keep)
+                offset += batch.capacity
+                yield out
+            return
         fn = cached_jit(self.plan_signature(), self.batch_fn)
         for batch in self.child_device_batches(pidx):
             with self.metrics.timed(M.OP_TIME):

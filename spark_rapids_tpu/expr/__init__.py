@@ -17,5 +17,21 @@ from .aggregates import (  # noqa: F401
     AggregateFunction, Average, Count, CountStar, First, Last, Max, Min,
     StddevPop, StddevSamp, Sum, VariancePop, VarianceSamp,
 )
+from .strings import (  # noqa: F401
+    Ascii, BitLength, Chr, Concat, ConcatWs, Contains, EndsWith, InitCap,
+    Length, Like, Lower, OctetLength, RegExpExtract, RegExpReplace, RLike,
+    StartsWith, StringLocate, StringLpad, StringRepeat, StringReplace,
+    StringReverse, StringRpad, StringTrim, StringTrimLeft, StringTrimRight,
+    Substring, SubstringIndex, Upper,
+)
+from .datetimes import (  # noqa: F401
+    AddMonths, DateAdd, DateDiff, DateFormatClass, DateSub, DayOfMonth,
+    DayOfWeek, DayOfYear, FromUnixTime, Hour, LastDay, Minute, Month,
+    MonthsBetween, Quarter, Second, TimeAdd, TruncDate, UnixTimestamp,
+    WeekDay, WeekOfYear, Year,
+)
+from .hashing import (  # noqa: F401
+    MonotonicallyIncreasingID, Murmur3Hash, Rand, SparkPartitionID, XxHash64,
+)
 from . import math  # noqa: F401
 from . import functions  # noqa: F401

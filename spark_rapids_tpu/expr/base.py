@@ -51,25 +51,36 @@ class EvalContext:
     """Evaluation context: column lookup + array backend."""
 
     def __init__(self, is_device: bool, xp, columns: Dict[str, EvalCol],
-                 num_rows: int, row_mask=None):
+                 num_rows: int, row_mask=None, partition_id: int = 0,
+                 batch_row_offset: int = 0):
         self.is_device = is_device
         self.xp = xp
         self._columns = columns
         self.num_rows = num_rows
         self.row_mask = row_mask
+        #: task partition index (GpuSparkPartitionID / monotonic id support)
+        self.partition_id = partition_id
+        #: global row offset of this batch within the partition
+        self.batch_row_offset = batch_row_offset
 
     @staticmethod
-    def for_host(table: HostTable) -> "EvalContext":
+    def for_host(table: HostTable, partition_id: int = 0,
+                 batch_row_offset: int = 0) -> "EvalContext":
         cols = {n: EvalCol(c.values, c.validity, c.dtype)
                 for n, c in zip(table.names, table.columns)}
-        return EvalContext(False, np, cols, table.num_rows)
+        return EvalContext(False, np, cols, table.num_rows,
+                           partition_id=partition_id,
+                           batch_row_offset=batch_row_offset)
 
     @staticmethod
-    def for_device(table: DeviceTable) -> "EvalContext":
+    def for_device(table: DeviceTable, partition_id: int = 0,
+                   batch_row_offset: int = 0) -> "EvalContext":
         import jax.numpy as jnp
         cols = {n: EvalCol(c.data, c.validity, c.dtype, c.lengths)
                 for n, c in zip(table.names, table.columns)}
-        return EvalContext(True, jnp, cols, table.capacity, table.row_mask)
+        return EvalContext(True, jnp, cols, table.capacity, table.row_mask,
+                           partition_id=partition_id,
+                           batch_row_offset=batch_row_offset)
 
     def lookup(self, name: str) -> EvalCol:
         return self._columns[name]
@@ -95,6 +106,17 @@ class Expression:
     """
 
     children: Tuple["Expression", ...] = ()
+
+    #: True when eval depends on EvalContext.partition_id/batch_row_offset
+    #: (spark_partition_id, monotonically_increasing_id, rand). Such
+    #: expressions are excluded from whole-stage fusion and evaluated with an
+    #: explicitly parameterized context.
+    context_dependent: bool = False
+
+    def tree_context_dependent(self) -> bool:
+        if self.context_dependent:
+            return True
+        return any(c.tree_context_dependent() for c in self.children)
 
     @property
     def data_type(self) -> dt.DataType:
@@ -204,7 +226,17 @@ class Literal(Expression):
             values = np.empty(n, dtype=object)
             values[:] = self.value
             return EvalCol(values, None, self._dtype)
-        values = xp.full((n,), self.value, dtype=self._dtype.np_dtype())
+        v = self.value
+        import datetime
+        if isinstance(self._dtype, dt.TimestampType) \
+                and isinstance(v, datetime.datetime):
+            utc = datetime.timezone.utc
+            aware = v if v.tzinfo is not None else v.replace(tzinfo=utc)
+            epoch = datetime.datetime(1970, 1, 1, tzinfo=utc)
+            v = int((aware - epoch).total_seconds() * 1_000_000)
+        elif isinstance(self._dtype, dt.DateType) and isinstance(v, datetime.date):
+            v = (v - datetime.date(1970, 1, 1)).days
+        values = xp.full((n,), v, dtype=self._dtype.np_dtype())
         return EvalCol(values, None, self._dtype)
 
     def __repr__(self):

@@ -121,6 +121,30 @@ class Column:
     def between(self, low, high) -> "Column":
         return (self >= low) & (self <= high)
 
+    def like(self, pattern: str) -> "Column":
+        from .strings import Like
+        return Column(Like(self.expr, Literal(pattern)))
+
+    def rlike(self, pattern: str) -> "Column":
+        from .strings import RLike
+        return Column(RLike(self.expr, Literal(pattern)))
+
+    def contains(self, needle) -> "Column":
+        from .strings import Contains
+        return Column(Contains(self.expr, _to_expr(needle)))
+
+    def startswith(self, prefix) -> "Column":
+        from .strings import StartsWith
+        return Column(StartsWith(self.expr, _to_expr(prefix)))
+
+    def endswith(self, suffix) -> "Column":
+        from .strings import EndsWith
+        return Column(EndsWith(self.expr, _to_expr(suffix)))
+
+    def substr(self, pos, ln) -> "Column":
+        from .strings import Substring
+        return Column(Substring(self.expr, _to_expr(pos), _to_expr(ln)))
+
     def asc(self) -> "SortOrder":
         return SortOrder(self.expr, ascending=True)
 
@@ -286,3 +310,253 @@ def round(c, scale: int = 0) -> Column:  # noqa: A001
 def pow(c, p) -> Column:  # noqa: A001
     from .math import Pow
     return Column(Pow(_to_expr(c), _to_expr(p)))
+
+
+# -- string functions ----------------------------------------------------------
+def upper(c) -> Column:
+    from .strings import Upper
+    return Column(Upper(_to_expr(c)))
+
+
+def lower(c) -> Column:
+    from .strings import Lower
+    return Column(Lower(_to_expr(c)))
+
+
+def initcap(c) -> Column:
+    from .strings import InitCap
+    return Column(InitCap(_to_expr(c)))
+
+
+def length(c) -> Column:
+    from .strings import Length
+    return Column(Length(_to_expr(c)))
+
+
+def octet_length(c) -> Column:
+    from .strings import OctetLength
+    return Column(OctetLength(_to_expr(c)))
+
+
+def bit_length(c) -> Column:
+    from .strings import BitLength
+    return Column(BitLength(_to_expr(c)))
+
+
+def substring(c, pos, ln) -> Column:
+    from .strings import Substring
+    return Column(Substring(_to_expr(c), _to_expr(pos), _to_expr(ln)))
+
+
+def substring_index(c, delim: str, count: int) -> Column:
+    from .strings import SubstringIndex
+    return Column(SubstringIndex(_to_expr(c), Literal(delim), Literal(count)))
+
+
+def concat(*cols) -> Column:
+    from .strings import Concat
+    return Column(Concat(*[_to_expr(c) for c in cols]))
+
+
+def concat_ws(sep: str, *cols) -> Column:
+    from .strings import ConcatWs
+    return Column(ConcatWs(Literal(sep), *[_to_expr(c) for c in cols]))
+
+
+def trim(c) -> Column:
+    from .strings import StringTrim
+    return Column(StringTrim(_to_expr(c)))
+
+
+def ltrim(c) -> Column:
+    from .strings import StringTrimLeft
+    return Column(StringTrimLeft(_to_expr(c)))
+
+
+def rtrim(c) -> Column:
+    from .strings import StringTrimRight
+    return Column(StringTrimRight(_to_expr(c)))
+
+
+def lpad(c, ln: int, pad: str = " ") -> Column:
+    from .strings import StringLpad
+    return Column(StringLpad(_to_expr(c), Literal(ln), Literal(pad)))
+
+
+def rpad(c, ln: int, pad: str = " ") -> Column:
+    from .strings import StringRpad
+    return Column(StringRpad(_to_expr(c), Literal(ln), Literal(pad)))
+
+
+def repeat(c, n: int) -> Column:
+    from .strings import StringRepeat
+    return Column(StringRepeat(_to_expr(c), Literal(n)))
+
+
+def reverse(c) -> Column:
+    from .strings import StringReverse
+    return Column(StringReverse(_to_expr(c)))
+
+
+def replace(c, search: str, replacement: str) -> Column:
+    from .strings import StringReplace
+    return Column(StringReplace(_to_expr(c), Literal(search),
+                                Literal(replacement)))
+
+
+def locate(substr: str, c, pos: int = 1) -> Column:
+    from .strings import StringLocate
+    return Column(StringLocate(Literal(substr), _to_expr(c), Literal(pos)))
+
+
+def instr(c, substr: str) -> Column:
+    from .strings import StringLocate
+    return Column(StringLocate(Literal(substr), _to_expr(c), Literal(1)))
+
+
+def ascii(c) -> Column:
+    from .strings import Ascii
+    return Column(Ascii(_to_expr(c)))
+
+
+def regexp_extract(c, pattern: str, idx: int = 1) -> Column:
+    from .strings import RegExpExtract
+    return Column(RegExpExtract(_to_expr(c), Literal(pattern), Literal(idx)))
+
+
+def regexp_replace(c, pattern: str, replacement: str) -> Column:
+    from .strings import RegExpReplace
+    return Column(RegExpReplace(_to_expr(c), Literal(pattern),
+                                Literal(replacement)))
+
+
+# -- datetime functions --------------------------------------------------------
+def year(c) -> Column:
+    from .datetimes import Year
+    return Column(Year(_to_expr(c)))
+
+
+def month(c) -> Column:
+    from .datetimes import Month
+    return Column(Month(_to_expr(c)))
+
+
+def dayofmonth(c) -> Column:
+    from .datetimes import DayOfMonth
+    return Column(DayOfMonth(_to_expr(c)))
+
+
+def dayofweek(c) -> Column:
+    from .datetimes import DayOfWeek
+    return Column(DayOfWeek(_to_expr(c)))
+
+
+def weekday(c) -> Column:
+    from .datetimes import WeekDay
+    return Column(WeekDay(_to_expr(c)))
+
+
+def dayofyear(c) -> Column:
+    from .datetimes import DayOfYear
+    return Column(DayOfYear(_to_expr(c)))
+
+
+def weekofyear(c) -> Column:
+    from .datetimes import WeekOfYear
+    return Column(WeekOfYear(_to_expr(c)))
+
+
+def quarter(c) -> Column:
+    from .datetimes import Quarter
+    return Column(Quarter(_to_expr(c)))
+
+
+def hour(c) -> Column:
+    from .datetimes import Hour
+    return Column(Hour(_to_expr(c)))
+
+
+def minute(c) -> Column:
+    from .datetimes import Minute
+    return Column(Minute(_to_expr(c)))
+
+
+def second(c) -> Column:
+    from .datetimes import Second
+    return Column(Second(_to_expr(c)))
+
+
+def date_add(c, days) -> Column:
+    from .datetimes import DateAdd
+    return Column(DateAdd(_to_expr(c), _to_expr(days)))
+
+
+def date_sub(c, days) -> Column:
+    from .datetimes import DateSub
+    return Column(DateSub(_to_expr(c), _to_expr(days)))
+
+
+def datediff(end, start) -> Column:
+    from .datetimes import DateDiff
+    return Column(DateDiff(_to_expr(end), _to_expr(start)))
+
+
+def add_months(c, months) -> Column:
+    from .datetimes import AddMonths
+    return Column(AddMonths(_to_expr(c), _to_expr(months)))
+
+
+def last_day(c) -> Column:
+    from .datetimes import LastDay
+    return Column(LastDay(_to_expr(c)))
+
+
+def months_between(end, start, round_off: bool = True) -> Column:
+    from .datetimes import MonthsBetween
+    return Column(MonthsBetween(_to_expr(end), _to_expr(start), round_off))
+
+
+def unix_timestamp(c) -> Column:
+    from .datetimes import UnixTimestamp
+    return Column(UnixTimestamp(_to_expr(c)))
+
+
+def from_unixtime(c, fmt: str = "yyyy-MM-dd HH:mm:ss") -> Column:
+    from .datetimes import FromUnixTime
+    return Column(FromUnixTime(_to_expr(c), fmt))
+
+
+def date_format(c, fmt: str) -> Column:
+    from .datetimes import DateFormatClass
+    return Column(DateFormatClass(_to_expr(c), fmt))
+
+
+def trunc(c, fmt: str) -> Column:
+    from .datetimes import TruncDate
+    return Column(TruncDate(_to_expr(c), fmt))
+
+
+# -- hash / id / random --------------------------------------------------------
+def hash(*cols) -> Column:  # noqa: A001
+    from .hashing import Murmur3Hash
+    return Column(Murmur3Hash(*[_to_expr(c) for c in cols]))
+
+
+def xxhash64(*cols) -> Column:
+    from .hashing import XxHash64
+    return Column(XxHash64(*[_to_expr(c) for c in cols]))
+
+
+def spark_partition_id() -> Column:
+    from .hashing import SparkPartitionID
+    return Column(SparkPartitionID())
+
+
+def monotonically_increasing_id() -> Column:
+    from .hashing import MonotonicallyIncreasingID
+    return Column(MonotonicallyIncreasingID())
+
+
+def rand(seed=None) -> Column:
+    from .hashing import Rand
+    return Column(Rand(seed))

@@ -1,0 +1,440 @@
+"""Hash expressions (reference: sql-plugin/.../HashFunctions.scala).
+
+Spark-bit-exact Murmur3 (seed 42) and XxHash64 (seed 42), vectorized:
+fixed-width types are pure elementwise uint32/uint64 arithmetic; strings run a
+``lax.scan`` over the padded byte matrix's 4-byte blocks + tail bytes with
+per-row length masking — one fused device program, no per-row control flow.
+
+Exactness matters here: ``hash()`` output is user-visible and is also the
+partitioning function, so host and device must agree bit-for-bit with each
+other (and with Spark) or differential tests and shuffle placement break.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..columnar import dtypes as dt
+from .base import EvalCol, EvalContext, Expression
+
+__all__ = ["Murmur3Hash", "XxHash64", "SparkPartitionID",
+           "MonotonicallyIncreasingID", "Rand"]
+
+_U32 = 0xFFFFFFFF
+_C1 = 0xCC9E2D51
+_C2 = 0x1B873593
+
+
+def _u32(xp, x):
+    return x.astype(xp.uint32) if hasattr(x, "astype") else xp.uint32(x)
+
+
+def _rotl32(xp, x, r):
+    return (x << xp.uint32(r)) | (x >> xp.uint32(32 - r))
+
+
+def _mix_k1(xp, k1):
+    k1 = k1 * xp.uint32(_C1)
+    k1 = _rotl32(xp, k1, 15)
+    return k1 * xp.uint32(_C2)
+
+
+def _mix_h1(xp, h1, k1):
+    h1 = h1 ^ k1
+    h1 = _rotl32(xp, h1, 13)
+    return h1 * xp.uint32(5) + xp.uint32(0xE6546B64)
+
+
+def _fmix(xp, h1, length):
+    h1 = h1 ^ xp.uint32(length) if np.isscalar(length) else h1 ^ length
+    h1 = h1 ^ (h1 >> xp.uint32(16))
+    h1 = h1 * xp.uint32(0x85EBCA6B)
+    h1 = h1 ^ (h1 >> xp.uint32(13))
+    h1 = h1 * xp.uint32(0xC2B2AE35)
+    return h1 ^ (h1 >> xp.uint32(16))
+
+
+def _hash_int(xp, k, seed):
+    """Murmur3_x86_32.hashInt(k, seed) — k: uint32 array, seed: uint32 array."""
+    h1 = _mix_h1(xp, seed, _mix_k1(xp, k))
+    return _fmix(xp, h1, xp.uint32(4))
+
+
+def _hash_long(xp, v, seed):
+    """hashLong: low word then high word, fmix with length 8."""
+    v = v.astype(xp.int64).view(xp.uint64) if hasattr(v, "view") else v
+    low = (v & xp.uint64(_U32)).astype(xp.uint32)
+    high = (v >> xp.uint64(32)).astype(xp.uint32)
+    h1 = _mix_h1(xp, seed, _mix_k1(xp, low))
+    h1 = _mix_h1(xp, h1, _mix_k1(xp, high))
+    return _fmix(xp, h1, xp.uint32(8))
+
+
+def _normalize_float(xp, vals):
+    """Spark normalizes -0.0 -> 0.0 and NaN -> canonical NaN before hashing."""
+    vals = xp.where(vals == 0.0, xp.zeros_like(vals), vals)
+    return xp.where(vals != vals, xp.full_like(vals, float("nan")), vals)
+
+
+def _view_u64(xp, x):
+    if xp is np:
+        return x.view(np.uint64)
+    import jax.numpy as jnp
+    return jnp.asarray(x).view(jnp.uint64)
+
+
+def _murmur3_fixed(xp, col: EvalCol, seed):
+    d = col.dtype
+    v = col.values
+    if isinstance(d, dt.BooleanType):
+        return _hash_int(xp, v.astype(xp.uint32), seed)
+    if isinstance(d, (dt.ByteType, dt.ShortType, dt.IntegerType, dt.DateType)):
+        return _hash_int(xp, v.astype(xp.int32).view(xp.uint32)
+                         if xp is np else v.astype(xp.int32).astype(xp.uint32),
+                         seed)
+    if isinstance(d, (dt.LongType, dt.TimestampType, dt.DecimalType)):
+        return _hash_long(xp, _view_u64(xp, v.astype(xp.int64)), seed)
+    if isinstance(d, dt.FloatType):
+        f = _normalize_float(xp, v.astype(xp.float32))
+        bits = f.view(xp.uint32) if xp is np else f.view(xp.int32).astype(xp.uint32)
+        return _hash_int(xp, bits, seed)
+    if isinstance(d, dt.DoubleType):
+        f = _normalize_float(xp, v.astype(xp.float64))
+        return _hash_long(xp, _view_u64(xp, f), seed)
+    raise TypeError(f"murmur3 of {d!r} not supported")
+
+
+def _sext_byte(xp, b):
+    """sign-extend a uint8 byte to uint32 (Java byte semantics)."""
+    b32 = b.astype(xp.uint32)
+    return xp.where(b32 >= 128, b32 | xp.uint32(0xFFFFFF00), b32)
+
+
+def _murmur3_string_device(xp, col: EvalCol, seed):
+    from jax import lax
+    v, lengths = col.values, col.lengths
+    n, w = v.shape
+    aligned = (lengths - lengths % 4).astype(xp.int32)
+    nblocks = w // 4
+
+    def block_step(h1, bi):
+        off = bi * 4
+        b0 = v[:, off].astype(xp.uint32)
+        b1 = v[:, off + 1].astype(xp.uint32)
+        b2 = v[:, off + 2].astype(xp.uint32)
+        b3 = v[:, off + 3].astype(xp.uint32)
+        k = b0 | (b1 << 8) | (b2 << 16) | (b3 << 24)
+        nh = _mix_h1(xp, h1, _mix_k1(xp, k))
+        return xp.where(off + 4 <= aligned, nh, h1), None
+
+    h1 = seed
+    if nblocks:
+        h1, _ = lax.scan(block_step, h1, xp.arange(nblocks, dtype=xp.int32))
+
+    def tail_step(h1, j):
+        k = _mix_k1(xp, _sext_byte(xp, xp.take(v, j, axis=1)))
+        nh = _mix_h1(xp, h1, k)
+        use = xp.logical_and(j >= aligned, j < lengths)
+        return xp.where(use, nh, h1), None
+
+    h1, _ = lax.scan(tail_step, h1, xp.arange(w, dtype=xp.int32))
+    return _fmix(xp, h1, lengths.astype(xp.uint32))
+
+
+def _murmur3_string_host(col: EvalCol, seed):
+    out = np.empty(len(col.values), dtype=np.uint32)
+    for i, s in enumerate(col.values):
+        b = s.encode() if isinstance(s, str) else bytes(s)
+        h1 = int(seed[i])
+        la = len(b) - len(b) % 4
+        for off in range(0, la, 4):
+            k = int.from_bytes(b[off:off + 4], "little")
+            k = (k * _C1) & _U32
+            k = ((k << 15) | (k >> 17)) & _U32
+            k = (k * _C2) & _U32
+            h1 ^= k
+            h1 = ((h1 << 13) | (h1 >> 19)) & _U32
+            h1 = (h1 * 5 + 0xE6546B64) & _U32
+        for off in range(la, len(b)):
+            byte = b[off]
+            k = byte | 0xFFFFFF00 if byte >= 128 else byte
+            k = (k * _C1) & _U32
+            k = ((k << 15) | (k >> 17)) & _U32
+            k = (k * _C2) & _U32
+            h1 ^= k
+            h1 = ((h1 << 13) | (h1 >> 19)) & _U32
+            h1 = (h1 * 5 + 0xE6546B64) & _U32
+        h1 ^= len(b)
+        h1 ^= h1 >> 16
+        h1 = (h1 * 0x85EBCA6B) & _U32
+        h1 ^= h1 >> 13
+        h1 = (h1 * 0xC2B2AE35) & _U32
+        h1 ^= h1 >> 16
+        out[i] = h1
+    return out
+
+
+class Murmur3Hash(Expression):
+    """hash(...) — Spark's Murmur3, folding seed 42 across columns."""
+
+    def __init__(self, *children: Expression, seed: int = 42):
+        self.children = tuple(children)
+        self.seed = seed
+
+    def with_children(self, children):
+        return Murmur3Hash(*children, seed=self.seed)
+
+    @property
+    def data_type(self):
+        return dt.INT
+
+    @property
+    def nullable(self):
+        return False
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        xp = ctx.xp
+        n = ctx.num_rows
+        h = xp.full((n,), self.seed, dtype=xp.uint32)
+        for child in self.children:
+            c = child.eval(ctx)
+            if isinstance(c.dtype, (dt.StringType, dt.BinaryType)):
+                if ctx.is_device:
+                    nh = _murmur3_string_device(xp, c, h)
+                else:
+                    nh = _murmur3_string_host(c, h)
+            else:
+                nh = _murmur3_fixed(xp, c, h)
+            # null input leaves the running hash unchanged (Spark semantics)
+            h = xp.where(c.valid_mask(ctx), nh, h)
+        return EvalCol(h.view(xp.int32), None, dt.INT)
+
+
+# ---------------------------------------------------------------------------
+# XxHash64
+# ---------------------------------------------------------------------------
+
+_XXP1 = 0x9E3779B185EBCA87
+_XXP2 = 0xC2B2AE3D27D4EB4F
+_XXP3 = 0x165667B19E3779F9
+_XXP5 = 0x27D4EB2F165667C5
+_U64 = 0xFFFFFFFFFFFFFFFF
+
+
+def _rotl64(xp, x, r):
+    return (x << xp.uint64(r)) | (x >> xp.uint64(64 - r))
+
+
+def _xx_fmix(xp, h):
+    h = h ^ (h >> xp.uint64(33))
+    h = h * xp.uint64(_XXP2)
+    h = h ^ (h >> xp.uint64(29))
+    h = h * xp.uint64(_XXP3)
+    return h ^ (h >> xp.uint64(32))
+
+
+def _xx_long(xp, v, seed):
+    """XXH64.hashLong(l, seed) — Spark's XxHash64 for 8-byte values."""
+    hash_ = seed + xp.uint64(_XXP5) + xp.uint64(8)
+    k1 = _rotl64(xp, v * xp.uint64(_XXP2), 31) * xp.uint64(_XXP1)
+    hash_ = hash_ ^ k1
+    hash_ = _rotl64(xp, hash_, 27) * xp.uint64(_XXP1) + xp.uint64(_XXP4)
+    return _xx_fmix(xp, hash_)
+
+
+_XXP4 = 0x85EBCA77C2B2AE63
+
+
+def _xx_int(xp, v, seed):
+    """XXH64.hashInt(i, seed): 4-byte values."""
+    hash_ = seed + xp.uint64(_XXP5) + xp.uint64(4)
+    hash_ = hash_ ^ (v.astype(xp.uint64) * xp.uint64(_XXP1))
+    hash_ = _rotl64(xp, hash_, 23) * xp.uint64(_XXP2) + xp.uint64(_XXP3)
+    return _xx_fmix(xp, hash_)
+
+
+class XxHash64(Expression):
+    """xxhash64(...) — Spark's XxHash64, seed 42, folding across columns."""
+
+    def __init__(self, *children: Expression, seed: int = 42):
+        self.children = tuple(children)
+        self.seed = seed
+
+    def with_children(self, children):
+        return XxHash64(*children, seed=self.seed)
+
+    @property
+    def data_type(self):
+        return dt.LONG
+
+    @property
+    def nullable(self):
+        return False
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        xp = ctx.xp
+        n = ctx.num_rows
+        h = xp.full((n,), self.seed, dtype=xp.uint64)
+        for child in self.children:
+            c = child.eval(ctx)
+            d = c.dtype
+            if isinstance(d, (dt.StringType, dt.BinaryType)):
+                # host-only (tagged not-device at planning time)
+                nh = np.asarray([_xx_bytes_host(
+                    s.encode() if isinstance(s, str) else bytes(s), int(sd))
+                    for s, sd in zip(c.values, np.asarray(h))], dtype=np.uint64)
+            elif isinstance(d, dt.BooleanType):
+                nh = _xx_int(xp, c.values.astype(xp.uint32), h)
+            elif isinstance(d, (dt.ByteType, dt.ShortType, dt.IntegerType,
+                                dt.DateType)):
+                v32 = c.values.astype(xp.int32)
+                nh = _xx_int(xp, v32.view(xp.uint32) if xp is np
+                             else v32.astype(xp.uint32), h)
+            elif isinstance(d, (dt.LongType, dt.TimestampType, dt.DecimalType)):
+                nh = _xx_long(xp, _view_u64(xp, c.values.astype(xp.int64)), h)
+            elif isinstance(d, dt.FloatType):
+                f = _normalize_float(xp, c.values.astype(xp.float32))
+                bits = f.view(np.uint32) if xp is np \
+                    else f.view(xp.int32).astype(xp.uint32)
+                nh = _xx_int(xp, bits, h)
+            elif isinstance(d, dt.DoubleType):
+                f = _normalize_float(xp, c.values.astype(xp.float64))
+                nh = _xx_long(xp, _view_u64(xp, f), h)
+            else:
+                raise TypeError(f"xxhash64 of {d!r} not supported")
+            h = xp.where(c.valid_mask(ctx), nh, h)
+        return EvalCol(h.view(xp.int64), None, dt.LONG)
+
+
+def _xx_bytes_host(b: bytes, seed: int) -> int:
+    """Spark XXH64.hashUnsafeBytes (scalar reference implementation)."""
+    def rotl(x, r):
+        return ((x << r) | (x >> (64 - r))) & _U64
+
+    length = len(b)
+    off = 0
+    if length >= 32:
+        v1 = (seed + _XXP1 + _XXP2) & _U64
+        v2 = (seed + _XXP2) & _U64
+        v3 = seed & _U64
+        v4 = (seed - _XXP1) & _U64
+        while off + 32 <= length:
+            for _ in range(1):
+                k1 = int.from_bytes(b[off:off + 8], "little")
+                v1 = (rotl((v1 + k1 * _XXP2) & _U64, 31) * _XXP1) & _U64
+                k2 = int.from_bytes(b[off + 8:off + 16], "little")
+                v2 = (rotl((v2 + k2 * _XXP2) & _U64, 31) * _XXP1) & _U64
+                k3 = int.from_bytes(b[off + 16:off + 24], "little")
+                v3 = (rotl((v3 + k3 * _XXP2) & _U64, 31) * _XXP1) & _U64
+                k4 = int.from_bytes(b[off + 24:off + 32], "little")
+                v4 = (rotl((v4 + k4 * _XXP2) & _U64, 31) * _XXP1) & _U64
+            off += 32
+        h = (rotl(v1, 1) + rotl(v2, 7) + rotl(v3, 12) + rotl(v4, 18)) & _U64
+        for v in (v1, v2, v3, v4):
+            h ^= (rotl((v * _XXP2) & _U64, 31) * _XXP1) & _U64
+            h = ((h * _XXP1) + _XXP4) & _U64
+    else:
+        h = (seed + _XXP5) & _U64
+    h = (h + length) & _U64
+    while off + 8 <= length:
+        k1 = int.from_bytes(b[off:off + 8], "little")
+        h ^= (rotl((k1 * _XXP2) & _U64, 31) * _XXP1) & _U64
+        h = ((rotl(h, 27) * _XXP1) + _XXP4) & _U64
+        off += 8
+    if off + 4 <= length:
+        k1 = int.from_bytes(b[off:off + 4], "little")
+        h ^= (k1 * _XXP1) & _U64
+        h = ((rotl(h, 23) * _XXP2) + _XXP3) & _U64
+        off += 4
+    while off < length:
+        h ^= ((b[off] & 0xFF) * _XXP5) & _U64
+        h = (rotl(h, 11) * _XXP1) & _U64
+        off += 1
+    h ^= h >> 33
+    h = (h * _XXP2) & _U64
+    h ^= h >> 29
+    h = (h * _XXP3) & _U64
+    h ^= h >> 32
+    return h
+
+
+# ---------------------------------------------------------------------------
+# id / random expressions
+# ---------------------------------------------------------------------------
+
+class SparkPartitionID(Expression):
+    """spark_partition_id() (reference: GpuSparkPartitionID.scala)."""
+
+    context_dependent = True
+
+    @property
+    def data_type(self):
+        return dt.INT
+
+    @property
+    def nullable(self):
+        return False
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        xp = ctx.xp
+        vals = xp.full((ctx.num_rows,), ctx.partition_id, dtype=xp.int32)
+        return EvalCol(vals, None, dt.INT)
+
+
+class MonotonicallyIncreasingID(Expression):
+    """monotonically_increasing_id(): (partition_id << 33) + row offset
+    (reference: GpuMonotonicallyIncreasingID.scala)."""
+
+    context_dependent = True
+
+    @property
+    def data_type(self):
+        return dt.LONG
+
+    @property
+    def nullable(self):
+        return False
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        xp = ctx.xp
+        base = xp.int64(ctx.partition_id) << 33 if xp is np \
+            else (xp.asarray(ctx.partition_id, dtype=xp.int64) << 33)
+        offs = xp.arange(ctx.num_rows, dtype=xp.int64) + ctx.batch_row_offset
+        return EvalCol(base + offs, None, dt.LONG)
+
+
+class Rand(Expression):
+    """rand([seed]) — per-partition-seeded uniform [0,1). Like the reference's
+    GpuRand, values differ from Spark's XORShiftRandom sequence (marked
+    incompat); device uses jax PRNG, host numpy PCG64."""
+
+    context_dependent = True
+
+    def __init__(self, seed=None):
+        if seed is None:
+            import random as _random
+            seed = _random.randrange(2 ** 31)   # fresh stream per rand() call
+        self.seed = seed
+        self.children = ()
+
+    def with_children(self, children):
+        return self
+
+    @property
+    def data_type(self):
+        return dt.DOUBLE
+
+    @property
+    def nullable(self):
+        return False
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        n = ctx.num_rows
+        if ctx.is_device:
+            import jax
+            key = jax.random.PRNGKey(self.seed + ctx.partition_id * 7919
+                                     + int(ctx.batch_row_offset))
+            vals = jax.random.uniform(key, (n,), dtype=ctx.xp.float64)
+            return EvalCol(vals, None, dt.DOUBLE)
+        rng = np.random.default_rng(self.seed + ctx.partition_id * 7919
+                                    + int(ctx.batch_row_offset))
+        return EvalCol(rng.random(n), None, dt.DOUBLE)

@@ -1,0 +1,964 @@
+"""String functions (reference: sql-plugin/.../stringFunctions.scala, 1381 LoC).
+
+TPU-first design: device strings are fixed-width padded uint8 matrices
+``(capacity, width)`` plus int32 byte ``lengths`` (columnar/device.py). Every
+string kernel below is a dense 2-D vector op over that matrix so XLA can fuse
+and tile it onto the VPU:
+
+- character-aware ops (length/substring/reverse) derive a per-byte *character
+  index* from the UTF-8 continuation-bit mask ``(b & 0xC0) != 0x80`` — exact
+  for all of UTF-8, no host round-trip;
+- variable-length outputs (substring/trim/concat) are produced by *stable
+  left-compaction*: select the surviving bytes, stable-argsort the inverted
+  selection mask per row, gather — O(w log w) per row, fully vectorized;
+- search ops (contains/instr/locate) gather sliding windows against literal
+  patterns (pattern length is static at trace time).
+
+Case mapping on device is ASCII-only (tagged with a ps-note, like the
+reference's incompat annotations); the host fallback engine is full Unicode.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..columnar import dtypes as dt
+from .arithmetic import _combine_validity
+from .base import EvalCol, EvalContext, Expression, Literal
+
+__all__ = [
+    "Upper", "Lower", "Length", "OctetLength", "BitLength", "Substring",
+    "StartsWith", "EndsWith", "Contains", "StringLocate", "Concat",
+    "ConcatWs", "StringTrim", "StringTrimLeft", "StringTrimRight",
+    "StringLpad", "StringRpad", "StringRepeat", "StringReplace",
+    "SubstringIndex", "StringReverse", "InitCap", "Ascii", "Chr",
+    "Like", "RLike", "RegExpExtract", "RegExpReplace", "literal_value",
+]
+
+
+# ---------------------------------------------------------------------------
+# device helpers (all take xp = jax.numpy)
+# ---------------------------------------------------------------------------
+
+def _pos_mask(xp, w: int, lengths):
+    """(n, w) bool — byte position is inside the string."""
+    return xp.arange(w, dtype=xp.int32)[None, :] < lengths[:, None]
+
+
+def _char_starts(xp, vals, lengths):
+    """(n, w) bool — byte begins a UTF-8 character and is inside the string."""
+    starts = (vals & 0xC0) != 0x80
+    return xp.logical_and(starts, _pos_mask(xp, vals.shape[1], lengths))
+
+
+def _stable_argsort(xp, a, axis=-1):
+    if xp is np:
+        return np.argsort(a, axis=axis, kind="stable")
+    return xp.argsort(a, axis=axis, stable=True)
+
+
+def _compact(xp, vals, sel):
+    """Stable left-compaction of selected bytes. Returns (data, lengths)."""
+    order = _stable_argsort(xp, xp.logical_not(sel), axis=1)
+    data = xp.take_along_axis(vals, order, axis=1)
+    lengths = sel.sum(axis=1).astype(xp.int32)
+    w = vals.shape[1]
+    data = xp.where(_pos_mask(xp, w, lengths), data, 0)
+    return data, lengths
+
+
+def _zero_tail(xp, vals, lengths):
+    return xp.where(_pos_mask(xp, vals.shape[1], lengths), vals, 0)
+
+
+def _pad_to(xp, m, w):
+    if m.shape[1] >= w:
+        return m
+    return xp.pad(m, ((0, 0), (0, w - m.shape[1])))
+
+
+def literal_value(e: Expression):
+    """The python value if ``e`` is a (possibly aliased) literal, else None."""
+    from .base import Alias
+    while isinstance(e, Alias):
+        e = e.child
+    if isinstance(e, Literal):
+        return e.value
+    return None
+
+
+def _utf8_len(s) -> int:
+    return len(s.encode() if isinstance(s, str) else s)
+
+
+# ---------------------------------------------------------------------------
+# unary string ops
+# ---------------------------------------------------------------------------
+
+class UnaryString(Expression):
+    def __init__(self, child: Expression):
+        self.child = child
+        self.children = (child,)
+
+    @property
+    def data_type(self):
+        return dt.STRING
+
+    @property
+    def nullable(self) -> bool:
+        return self.child.nullable
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        c = self.child.eval(ctx)
+        if ctx.is_device:
+            return self._eval_device(ctx, c)
+        vals = np.asarray([self._host_one(s) for s in c.values], dtype=object)
+        return EvalCol(vals, c.validity, self.data_type)
+
+    def _host_one(self, s: str):
+        raise NotImplementedError
+
+    def _eval_device(self, ctx, c: EvalCol) -> EvalCol:
+        raise NotImplementedError
+
+
+class Upper(UnaryString):
+    """upper() — device path is ASCII-only (ps-note), host is full Unicode."""
+
+    def _host_one(self, s):
+        return s.upper()
+
+    def _eval_device(self, ctx, c):
+        xp = ctx.xp
+        v = c.values
+        is_lower = xp.logical_and(v >= 97, v <= 122)
+        return EvalCol(xp.where(is_lower, v - 32, v), c.validity, dt.STRING,
+                       c.lengths)
+
+
+class Lower(UnaryString):
+    def _host_one(self, s):
+        return s.lower()
+
+    def _eval_device(self, ctx, c):
+        xp = ctx.xp
+        v = c.values
+        is_upper = xp.logical_and(v >= 65, v <= 90)
+        return EvalCol(xp.where(is_upper, v + 32, v), c.validity, dt.STRING,
+                       c.lengths)
+
+
+class InitCap(UnaryString):
+    """initcap() — device is ASCII-only; word boundary = space (Spark semantics)."""
+
+    def _host_one(self, s):
+        return " ".join(w[:1].upper() + w[1:].lower() for w in s.split(" "))
+
+    def _eval_device(self, ctx, c):
+        xp = ctx.xp
+        v = c.values
+        lo = xp.where(xp.logical_and(v >= 65, v <= 90), v + 32, v)
+        prev = xp.concatenate(
+            [xp.full((v.shape[0], 1), 32, dtype=v.dtype), lo[:, :-1]], axis=1)
+        first = prev == 32
+        up = xp.where(xp.logical_and(lo >= 97, lo <= 122) & first, lo - 32, lo)
+        return EvalCol(up, c.validity, dt.STRING, c.lengths)
+
+
+class StringReverse(UnaryString):
+    """reverse() — UTF-8 character-exact on device: bytes are re-ordered by
+    (reversed character index, byte offset within character)."""
+
+    def _host_one(self, s):
+        return s[::-1]
+
+    def _eval_device(self, ctx, c):
+        xp = ctx.xp
+        v, lengths = c.values, c.lengths
+        w = v.shape[1]
+        pos = xp.arange(w, dtype=xp.int32)[None, :]
+        starts = _char_starts(xp, v, lengths)
+        cidx = xp.cumsum(starts.astype(xp.int32), axis=1) - 1
+        nchars = starts.sum(axis=1).astype(xp.int32)
+        # byte offset of the character this byte belongs to
+        from jax import lax
+        start_pos = lax.cummax(xp.where(starts, pos, -1), axis=1)
+        in_char = pos - start_pos
+        valid = _pos_mask(xp, w, lengths)
+        key = xp.where(valid, (nchars[:, None] - 1 - cidx) * w + in_char,
+                       2 * w * w)
+        order = _stable_argsort(xp, key, axis=1)
+        data = xp.take_along_axis(v, order, axis=1)
+        return EvalCol(_zero_tail(xp, data, lengths), c.validity, dt.STRING,
+                       lengths)
+
+
+class Length(Expression):
+    """length() — number of characters (UTF-8-aware on both paths)."""
+
+    def __init__(self, child: Expression):
+        self.child = child
+        self.children = (child,)
+
+    @property
+    def data_type(self):
+        return dt.INT
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        c = self.child.eval(ctx)
+        if ctx.is_device:
+            xp = ctx.xp
+            n = _char_starts(xp, c.values, c.lengths).sum(axis=1)
+            return EvalCol(n.astype(xp.int32), c.validity, dt.INT)
+        vals = np.asarray([len(s) for s in c.values], dtype=np.int32)
+        return EvalCol(vals, c.validity, dt.INT)
+
+
+class OctetLength(Expression):
+    def __init__(self, child: Expression):
+        self.child = child
+        self.children = (child,)
+
+    @property
+    def data_type(self):
+        return dt.INT
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        c = self.child.eval(ctx)
+        if ctx.is_device:
+            return EvalCol(c.lengths.astype(ctx.xp.int32), c.validity, dt.INT)
+        vals = np.asarray([_utf8_len(s) for s in c.values], dtype=np.int32)
+        return EvalCol(vals, c.validity, dt.INT)
+
+
+class BitLength(OctetLength):
+    def eval(self, ctx):
+        r = super().eval(ctx)
+        return EvalCol(r.values * 8, r.validity, dt.INT)
+
+
+class Ascii(Expression):
+    """ascii() — codepoint of the first character (ASCII-exact on device)."""
+
+    def __init__(self, child: Expression):
+        self.child = child
+        self.children = (child,)
+
+    @property
+    def data_type(self):
+        return dt.INT
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        c = self.child.eval(ctx)
+        if ctx.is_device:
+            xp = ctx.xp
+            first = c.values[:, 0].astype(xp.int32)
+            return EvalCol(xp.where(c.lengths > 0, first, 0), c.validity, dt.INT)
+        vals = np.asarray([ord(s[0]) if len(s) else 0 for s in c.values],
+                          dtype=np.int32)
+        return EvalCol(vals, c.validity, dt.INT)
+
+
+class Chr(Expression):
+    """chr() — host-only (codepoint→string needs dynamic width)."""
+
+    def __init__(self, child: Expression):
+        self.child = child
+        self.children = (child,)
+
+    @property
+    def data_type(self):
+        return dt.STRING
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        c = self.child.eval(ctx)
+        vals = np.asarray([chr(int(v) & 0xFF) if int(v) >= 0 else ""
+                           for v in c.values], dtype=object)
+        return EvalCol(vals, c.validity, dt.STRING)
+
+
+# ---------------------------------------------------------------------------
+# substring family
+# ---------------------------------------------------------------------------
+
+class Substring(Expression):
+    """substring(str, pos, len) — Spark 1-based, negative pos from the end.
+
+    Device path is UTF-8 character-exact: byte selected iff its character index
+    falls in [start, start+len); survivors stable-compact left.
+    """
+
+    def __init__(self, child: Expression, pos: Expression, length: Expression):
+        self.child, self.pos, self.length = child, pos, length
+        self.children = (child, pos, length)
+
+    @property
+    def data_type(self):
+        return dt.STRING
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        c = self.child.eval(ctx)
+        p = self.pos.eval(ctx)
+        l = self.length.eval(ctx)
+        validity = _combine_validity(ctx, c, p, l)
+        if not ctx.is_device:
+            out = []
+            for s, pos, ln in zip(c.values, p.values, l.values):
+                out.append(_host_substr(s, int(pos), int(ln)))
+            return EvalCol(np.asarray(out, dtype=object), validity, dt.STRING)
+        xp = ctx.xp
+        v, lengths = c.values, c.lengths
+        w = v.shape[1]
+        starts = _char_starts(xp, v, lengths)
+        cidx = xp.cumsum(starts.astype(xp.int32), axis=1) - 1
+        nchars = starts.sum(axis=1).astype(xp.int32)
+        pos = p.values.astype(xp.int32)
+        ln = xp.maximum(l.values.astype(xp.int32), 0)
+        # 0-based start char: pos>0 -> pos-1; pos==0 -> 0; pos<0 -> nchars+pos
+        start0 = xp.where(pos > 0, pos - 1, xp.where(pos == 0, 0, nchars + pos))
+        # negative start beyond beginning shortens the result (Spark semantics)
+        ln = xp.where(start0 < 0, xp.maximum(ln + start0, 0), ln)
+        start0 = xp.maximum(start0, 0)
+        sel = xp.logical_and(cidx >= start0[:, None],
+                             cidx < (start0 + ln)[:, None])
+        sel = xp.logical_and(sel, _pos_mask(xp, w, lengths))
+        data, out_len = _compact(xp, v, sel)
+        return EvalCol(data, validity, dt.STRING, out_len)
+
+
+def _host_substr(s: str, pos: int, ln: int) -> str:
+    if ln <= 0:
+        return ""
+    n = len(s)
+    start = pos - 1 if pos > 0 else (0 if pos == 0 else n + pos)
+    if start < 0:
+        ln = max(ln + start, 0)
+        start = 0
+    return s[start:start + ln]
+
+
+class SubstringIndex(Expression):
+    """substring_index(str, delim, count) — host-only (delimiter scanning with
+    dynamic output length; device falls back via tagging)."""
+
+    def __init__(self, child: Expression, delim: Expression, count: Expression):
+        self.child, self.delim, self.count = child, delim, count
+        self.children = (child, delim, count)
+
+    @property
+    def data_type(self):
+        return dt.STRING
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        c = self.child.eval(ctx)
+        delim = literal_value(self.delim)
+        cnt = literal_value(self.count)
+        out = []
+        for s in c.values:
+            out.append(_substring_index(s, delim, int(cnt)))
+        return EvalCol(np.asarray(out, dtype=object), c.validity, dt.STRING)
+
+
+def _substring_index(s: str, delim: str, count: int) -> str:
+    if not delim or count == 0:
+        return ""
+    if count > 0:
+        parts = s.split(delim)
+        return delim.join(parts[:count])
+    parts = s.split(delim)
+    return delim.join(parts[count:])
+
+
+# ---------------------------------------------------------------------------
+# search family
+# ---------------------------------------------------------------------------
+
+class BinaryStringPredicate(Expression):
+    """Base for startswith/endswith/contains: boolean, null-propagating."""
+
+    def __init__(self, left: Expression, right: Expression):
+        self.left, self.right = left, right
+        self.children = (left, right)
+
+    @property
+    def data_type(self):
+        return dt.BOOLEAN
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        l = self.left.eval(ctx)
+        r = self.right.eval(ctx)
+        validity = _combine_validity(ctx, l, r)
+        if not ctx.is_device:
+            vals = np.asarray([self._host_one(a, b)
+                               for a, b in zip(l.values, r.values)])
+            return EvalCol(vals, validity, dt.BOOLEAN)
+        return EvalCol(self._eval_device(ctx, l, r), validity, dt.BOOLEAN)
+
+
+class StartsWith(BinaryStringPredicate):
+    def _host_one(self, a, b):
+        return a.startswith(b)
+
+    def _eval_device(self, ctx, l, r):
+        xp = ctx.xp
+        w = max(l.values.shape[1], r.values.shape[1])
+        lv = _pad_to(xp, l.values, w)
+        rv = _pad_to(xp, r.values, w)
+        inside_r = _pos_mask(xp, w, r.lengths)
+        match = xp.logical_or(lv == rv, xp.logical_not(inside_r))
+        return xp.logical_and(xp.all(match, axis=1), l.lengths >= r.lengths)
+
+
+class EndsWith(BinaryStringPredicate):
+    def _host_one(self, a, b):
+        return a.endswith(b)
+
+    def _eval_device(self, ctx, l, r):
+        xp = ctx.xp
+        w = max(l.values.shape[1], r.values.shape[1])
+        lv = _pad_to(xp, l.values, w)
+        rv = _pad_to(xp, r.values, w)
+        shift = (l.lengths - r.lengths)[:, None]
+        idx = xp.arange(w, dtype=xp.int32)[None, :] + shift
+        tail = xp.take_along_axis(lv, xp.clip(idx, 0, w - 1), axis=1)
+        inside_r = _pos_mask(xp, w, r.lengths)
+        match = xp.logical_or(tail == rv, xp.logical_not(inside_r))
+        return xp.logical_and(xp.all(match, axis=1), l.lengths >= r.lengths)
+
+
+def _device_find(ctx, l: EvalCol, pattern: bytes):
+    """First byte offset of literal ``pattern`` in each row, -1 if absent."""
+    return _device_find_from(ctx, l, pattern, 0)
+
+
+class Contains(BinaryStringPredicate):
+    """contains — device requires a literal pattern (reference: GpuContains)."""
+
+    def _host_one(self, a, b):
+        return b in a
+
+    def _eval_device(self, ctx, l, r):
+        pat = literal_value(self.right)
+        assert pat is not None, "device contains requires literal pattern"
+        return _device_find(ctx, l, pat.encode()) >= 0
+
+
+class StringLocate(Expression):
+    """locate/instr(substr, str[, start]) — 1-based char position, 0 = absent.
+
+    Device path returns byte-derived char positions via the char-index of the
+    matched byte offset (UTF-8 exact)."""
+
+    def __init__(self, substr: Expression, string: Expression,
+                 start: Expression = None):
+        self.substr, self.string = substr, string
+        self.start = start if start is not None else Literal(1)
+        self.children = (substr, string, self.start)
+
+    @property
+    def data_type(self):
+        return dt.INT
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        sub = self.substr.eval(ctx)
+        s = self.string.eval(ctx)
+        st = self.start.eval(ctx)
+        validity = _combine_validity(ctx, sub, s)
+        if not ctx.is_device:
+            out = []
+            for a, b, k in zip(s.values, sub.values, st.values):
+                k = int(k)
+                if k <= 0:
+                    out.append(0)
+                else:
+                    out.append(a.find(b, k - 1) + 1)
+            return EvalCol(np.asarray(out, dtype=np.int32), validity, dt.INT)
+        xp = ctx.xp
+        pat = literal_value(self.substr)
+        start = int(literal_value(self.start) or 1)
+        assert pat is not None, "device locate requires literal pattern"
+        # byte offset of first match at/after byte(start-1) (ASCII start col)
+        off = _device_find_from(ctx, s, pat.encode(), start - 1)
+        starts = _char_starts(xp, s.values, s.lengths)
+        cidx = xp.cumsum(starts.astype(xp.int32), axis=1) - 1
+        w = s.values.shape[1]
+        char_of = xp.take_along_axis(
+            cidx, xp.clip(off, 0, w - 1)[:, None], axis=1)[:, 0]
+        found = xp.where(off >= 0, char_of + 1, 0)
+        return EvalCol(xp.where(start <= 0, 0, found).astype(xp.int32),
+                       validity, dt.INT)
+
+
+def _device_find_from(ctx, l: EvalCol, pattern: bytes, from_byte: int):
+    xp = ctx.xp
+    v, lengths = l.values, l.lengths
+    w = v.shape[1]
+    p = len(pattern)
+    if p == 0:
+        return xp.full(v.shape[0], max(from_byte, 0), dtype=xp.int32)
+    if p > w:
+        return xp.full(v.shape[0], -1, dtype=xp.int32)
+    pat = xp.asarray(np.frombuffer(pattern, dtype=np.uint8))
+    hit = xp.ones(v.shape, dtype=bool)
+    for k in range(p):
+        shifted = v[:, k:] if k else v
+        shifted = _pad_to(xp, shifted, w)
+        hit = xp.logical_and(hit, shifted == pat[k])
+    pos = xp.arange(w, dtype=xp.int32)[None, :]
+    ok = xp.logical_and(pos <= (lengths - p)[:, None], pos >= from_byte)
+    hit = xp.logical_and(hit, ok)
+    any_hit = xp.any(hit, axis=1)
+    first = xp.argmax(hit, axis=1).astype(xp.int32)
+    return xp.where(any_hit, first, -1)
+
+
+# ---------------------------------------------------------------------------
+# concatenation / padding
+# ---------------------------------------------------------------------------
+
+class Concat(Expression):
+    """concat(s1, s2, ...) — null if any input null. Device: pairwise fold of
+    an index-select merge (out[j] = left[j] if j < len_l else right[j-len_l])."""
+
+    def __init__(self, *children: Expression):
+        self.children = tuple(children)
+
+    @property
+    def data_type(self):
+        return dt.STRING
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        cols = [c.eval(ctx) for c in self.children]
+        validity = cols[0].validity
+        for c in cols[1:]:
+            validity = _combine_validity(
+                ctx, EvalCol(None, validity, dt.STRING), c)
+        if not ctx.is_device:
+            vals = np.asarray(["".join(parts) for parts in
+                               zip(*[c.values for c in cols])], dtype=object)
+            return EvalCol(vals, validity, dt.STRING)
+        acc = cols[0]
+        for c in cols[1:]:
+            acc = _device_concat2(ctx, acc, c)
+        return EvalCol(acc.values, validity, dt.STRING, acc.lengths)
+
+
+def _device_concat2(ctx, l: EvalCol, r: EvalCol) -> EvalCol:
+    xp = ctx.xp
+    from ..columnar.device import bucket_width
+    out_w = bucket_width(l.values.shape[1] + r.values.shape[1])
+    lv = _pad_to(xp, l.values, out_w)
+    rv = _pad_to(xp, r.values, out_w)
+    j = xp.arange(out_w, dtype=xp.int32)[None, :]
+    ll = l.lengths[:, None]
+    from_l = j < ll
+    r_idx = xp.clip(j - ll, 0, out_w - 1)
+    r_sel = xp.take_along_axis(rv, r_idx, axis=1)
+    data = xp.where(from_l, lv, r_sel)
+    lengths = xp.minimum(l.lengths + r.lengths, out_w).astype(xp.int32)
+    return EvalCol(_zero_tail(xp, data, lengths), None, dt.STRING, lengths)
+
+
+class ConcatWs(Expression):
+    """concat_ws(sep, ...) — skips nulls; host-only (conditional separators
+    make the device variant dynamic; falls back via tagging)."""
+
+    def __init__(self, sep: Expression, *children: Expression):
+        self.sep = sep
+        self.children = (sep,) + tuple(children)
+
+    @property
+    def data_type(self):
+        return dt.STRING
+
+    @property
+    def nullable(self):
+        return self.sep.nullable
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        sep = self.sep.eval(ctx)
+        cols = [c.eval(ctx) for c in self.children[1:]]
+        out = []
+        n = ctx.num_rows
+        masks = [c.valid_mask(ctx) for c in cols]
+        for i in range(n):
+            parts = [c.values[i] for c, m in zip(cols, masks) if m[i]]
+            out.append(sep.values[i].join(parts))
+        return EvalCol(np.asarray(out, dtype=object), sep.validity, dt.STRING)
+
+
+class StringRpad(Expression):
+    """rpad(str, len, pad) — ASCII-exact on device (len counts bytes there)."""
+
+    pad_left = False
+
+    def __init__(self, child: Expression, length: Expression, pad: Expression):
+        self.child, self.length, self.pad = child, length, pad
+        self.children = (child, length, pad)
+
+    @property
+    def data_type(self):
+        return dt.STRING
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        c = self.child.eval(ctx)
+        ln = self.length.eval(ctx)
+        pd = self.pad.eval(ctx)
+        validity = _combine_validity(ctx, c, ln, pd)
+        if not ctx.is_device:
+            out = []
+            for s, k, p in zip(c.values, ln.values, pd.values):
+                out.append(_host_pad(s, int(k), p, self.pad_left))
+            return EvalCol(np.asarray(out, dtype=object), validity, dt.STRING)
+        xp = ctx.xp
+        pad = literal_value(self.pad)
+        tgt = int(literal_value(self.length))
+        assert pad is not None and tgt is not None, \
+            "device pad requires literal length/pad"
+        tgt = max(tgt, 0)
+        pb = pad.encode() or b" "
+        from ..columnar.device import bucket_width
+        out_w = bucket_width(max(tgt, c.values.shape[1], 1))
+        v = _pad_to(xp, c.values, out_w)
+        slen = c.lengths
+        out_len = xp.full_like(slen, tgt)
+        j = xp.arange(out_w, dtype=xp.int32)[None, :]
+        patv = xp.asarray(np.frombuffer(pb, dtype=np.uint8))
+        if self.pad_left:
+            shift = xp.maximum(tgt - slen, 0)[:, None]
+            src = xp.take_along_axis(
+                v, xp.clip(j - shift, 0, out_w - 1), axis=1)
+            fill = patv[(j % len(pb)).astype(xp.int32)]
+            data = xp.where(j < shift, fill, src)
+        else:
+            fill = patv[((j - slen[:, None]) % len(pb)).astype(xp.int32)]
+            data = xp.where(j < slen[:, None], v, fill)
+        # truncation when tgt < len
+        data = _zero_tail(xp, data, out_len)
+        return EvalCol(data, validity, dt.STRING, out_len.astype(xp.int32))
+
+
+class StringLpad(StringRpad):
+    pad_left = True
+
+
+def _host_pad(s: str, k: int, p: str, left: bool) -> str:
+    if k <= 0:
+        return ""
+    if k <= len(s):
+        return s[:k]
+    if not p:
+        return s
+    fill = (p * ((k - len(s)) // len(p) + 1))[:k - len(s)]
+    return fill + s if left else s + fill
+
+
+class StringRepeat(Expression):
+    """repeat(str, n) — device requires literal n (output width is static)."""
+
+    def __init__(self, child: Expression, times: Expression):
+        self.child, self.times = child, times
+        self.children = (child, times)
+
+    @property
+    def data_type(self):
+        return dt.STRING
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        c = self.child.eval(ctx)
+        t = self.times.eval(ctx)
+        validity = _combine_validity(ctx, c, t)
+        if not ctx.is_device:
+            vals = np.asarray([s * max(int(k), 0)
+                               for s, k in zip(c.values, t.values)], dtype=object)
+            return EvalCol(vals, validity, dt.STRING)
+        xp = ctx.xp
+        n_rep = int(literal_value(self.times))
+        if n_rep <= 0:
+            z = xp.zeros_like(c.values)
+            return EvalCol(z, validity, dt.STRING,
+                           xp.zeros_like(c.lengths))
+        from ..columnar.device import bucket_width
+        out_w = bucket_width(c.values.shape[1] * n_rep)
+        v = _pad_to(xp, c.values, out_w)
+        j = xp.arange(out_w, dtype=xp.int32)[None, :]
+        slen = xp.maximum(c.lengths, 1)[:, None]
+        data = xp.take_along_axis(v, (j % slen).astype(xp.int32), axis=1)
+        lengths = xp.minimum(c.lengths * n_rep, out_w).astype(xp.int32)
+        return EvalCol(_zero_tail(xp, data, lengths), validity, dt.STRING,
+                       lengths)
+
+
+# ---------------------------------------------------------------------------
+# trim family
+# ---------------------------------------------------------------------------
+
+class StringTrim(Expression):
+    """trim / ltrim / rtrim (space trimming, Spark default)."""
+
+    trim_left = True
+    trim_right = True
+
+    def __init__(self, child: Expression):
+        self.child = child
+        self.children = (child,)
+
+    @property
+    def data_type(self):
+        return dt.STRING
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        c = self.child.eval(ctx)
+        if not ctx.is_device:
+            if self.trim_left and self.trim_right:
+                f = lambda s: s.strip(" ")
+            elif self.trim_left:
+                f = lambda s: s.lstrip(" ")
+            else:
+                f = lambda s: s.rstrip(" ")
+            vals = np.asarray([f(s) for s in c.values], dtype=object)
+            return EvalCol(vals, c.validity, dt.STRING)
+        xp = ctx.xp
+        v, lengths = c.values, c.lengths
+        w = v.shape[1]
+        pos = xp.arange(w, dtype=xp.int32)[None, :]
+        inside = _pos_mask(xp, w, lengths)
+        nonspace = xp.logical_and(v != 32, inside)
+        any_ns = xp.any(nonspace, axis=1)
+        first_ns = xp.argmax(nonspace, axis=1).astype(xp.int32)
+        last_ns = (w - 1 - xp.argmax(nonspace[:, ::-1], axis=1)).astype(xp.int32)
+        lo = first_ns if self.trim_left else xp.zeros_like(first_ns)
+        hi = (last_ns + 1) if self.trim_right else lengths
+        lo = xp.where(any_ns, lo, 0)
+        hi = xp.where(any_ns, hi, 0)
+        sel = xp.logical_and(pos >= lo[:, None], pos < hi[:, None])
+        sel = xp.logical_and(sel, inside)
+        data, out_len = _compact(xp, v, sel)
+        return EvalCol(data, c.validity, dt.STRING, out_len)
+
+
+class StringTrimLeft(StringTrim):
+    trim_right = False
+
+
+class StringTrimRight(StringTrim):
+    trim_left = False
+
+
+# ---------------------------------------------------------------------------
+# replace (host-only) and LIKE
+# ---------------------------------------------------------------------------
+
+class StringReplace(Expression):
+    """replace(str, search, replace) — host-only (dynamic output length)."""
+
+    def __init__(self, child: Expression, search: Expression,
+                 replace: Expression):
+        self.child, self.search, self.replace = child, search, replace
+        self.children = (child, search, replace)
+
+    @property
+    def data_type(self):
+        return dt.STRING
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        c = self.child.eval(ctx)
+        s = self.search.eval(ctx)
+        r = self.replace.eval(ctx)
+        validity = _combine_validity(ctx, c, s, r)
+        out = []
+        for a, b, rep in zip(c.values, s.values, r.values):
+            out.append(a.replace(b, rep) if b else a)
+        return EvalCol(np.asarray(out, dtype=object), validity, dt.STRING)
+
+
+class Like(Expression):
+    """LIKE with literal pattern (reference: GpuLike requires literal too).
+
+    Device strategy mirrors the reference's like→cuDF transpile: simple
+    patterns (equality / prefix / suffix / contains, no ``_``) lower to the
+    vectorized search kernels above; everything else transpiles to the regex
+    NFA engine (expr/regex.py) or falls back to host at tag time.
+    """
+
+    def __init__(self, child: Expression, pattern: Expression,
+                 escape: str = "\\"):
+        self.child, self.pattern, self.escape = child, pattern, escape
+        self.children = (child, pattern)
+
+    def with_children(self, children):
+        return Like(children[0], children[1], self.escape)
+
+    @property
+    def data_type(self):
+        return dt.BOOLEAN
+
+    # -- pattern analysis (used by tagging AND execution) --------------------
+    def simple_kind(self):
+        """('equals'|'prefix'|'suffix'|'contains', needle) or None."""
+        pat = literal_value(self.pattern)
+        if pat is None:
+            return None
+        body = pat
+        lead = body.startswith("%")
+        trail = body.endswith("%") and not body.endswith(self.escape + "%")
+        core = body[1 if lead else 0: len(body) - 1 if trail else len(body)]
+        # no remaining wildcards/escapes allowed in the core
+        if any(ch in core for ch in ("%", "_", self.escape)):
+            return None
+        if lead and trail:
+            return ("contains", core)
+        if lead:
+            return ("suffix", core)
+        if trail:
+            return ("prefix", core)
+        return ("equals", core)
+
+    def to_regex(self):
+        pat = literal_value(self.pattern)
+        if pat is None:
+            return None
+        import re as _re
+        out = []
+        i = 0
+        while i < len(pat):
+            ch = pat[i]
+            if ch == self.escape and i + 1 < len(pat):
+                out.append(_re.escape(pat[i + 1]))
+                i += 2
+                continue
+            if ch == "%":
+                out.append(".*")
+            elif ch == "_":
+                out.append(".")
+            else:
+                out.append(_re.escape(ch))
+            i += 1
+        return "^" + "".join(out) + "$"
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        c = self.child.eval(ctx)
+        kind = self.simple_kind()
+        if not ctx.is_device:
+            import re as _re
+            rx = _re.compile(self.to_regex(), _re.DOTALL)
+            vals = np.asarray([rx.match(s) is not None for s in c.values])
+            return EvalCol(vals, c.validity, dt.BOOLEAN)
+        xp = ctx.xp
+        if kind is not None:
+            op, needle = kind
+            nb = needle.encode()
+            if op == "contains":
+                vals = _device_find(ctx, c, nb) >= 0
+            elif op == "prefix":
+                vals = _device_startswith(ctx, c, nb)
+            elif op == "suffix":
+                vals = _device_endswith(ctx, c, nb)
+            else:  # equals
+                vals = xp.logical_and(_device_startswith(ctx, c, nb),
+                                      c.lengths == len(nb))
+            return EvalCol(vals, c.validity, dt.BOOLEAN)
+        # general pattern: device regex NFA
+        from .regex import compile_device_nfa
+        nfa = compile_device_nfa(self.to_regex())
+        assert nfa is not None, "device LIKE on un-transpilable pattern"
+        return EvalCol(nfa.matches(ctx, c), c.validity, dt.BOOLEAN)
+
+
+class RLike(Expression):
+    """rlike — Java find() semantics. Device path runs the bitmask NFA
+    (expr/regex.py); tagging falls back to host when the pattern is outside
+    the NFA subset (reference: CudfRegexTranspiler rejection path)."""
+
+    def __init__(self, child: Expression, pattern: Expression):
+        self.child, self.pattern = child, pattern
+        self.children = (child, pattern)
+
+    @property
+    def data_type(self):
+        return dt.BOOLEAN
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        c = self.child.eval(ctx)
+        pat = literal_value(self.pattern)
+        if not ctx.is_device:
+            import re as _re
+            rx = _re.compile(pat)
+            vals = np.asarray([rx.search(s) is not None for s in c.values])
+            return EvalCol(vals, c.validity, dt.BOOLEAN)
+        from .regex import compile_device_nfa
+        nfa = compile_device_nfa(pat)
+        assert nfa is not None, "device rlike on un-transpilable pattern"
+        return EvalCol(nfa.matches(ctx, c), c.validity, dt.BOOLEAN)
+
+
+class RegExpExtract(Expression):
+    """regexp_extract(str, pattern, idx) — host-only (capture groups)."""
+
+    def __init__(self, child: Expression, pattern: Expression,
+                 idx: Expression = None):
+        self.child, self.pattern = child, pattern
+        self.idx = idx if idx is not None else Literal(1)
+        self.children = (child, pattern, self.idx)
+
+    @property
+    def data_type(self):
+        return dt.STRING
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        import re as _re
+        c = self.child.eval(ctx)
+        rx = _re.compile(literal_value(self.pattern))
+        gi = int(literal_value(self.idx))
+        out = []
+        for s in c.values:
+            m = rx.search(s)
+            out.append(m.group(gi) if m and m.group(gi) is not None else "")
+        return EvalCol(np.asarray(out, dtype=object), c.validity, dt.STRING)
+
+
+class RegExpReplace(Expression):
+    """regexp_replace(str, pattern, replacement) — host-only."""
+
+    def __init__(self, child: Expression, pattern: Expression,
+                 replacement: Expression):
+        self.child, self.pattern, self.replacement = child, pattern, replacement
+        self.children = (child, pattern, replacement)
+
+    @property
+    def data_type(self):
+        return dt.STRING
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        import re as _re
+        c = self.child.eval(ctx)
+        rx = _re.compile(literal_value(self.pattern))
+        # Java $1 group references -> Python \1
+        rep = _re.sub(r"\$(\d+)", r"\\\1", literal_value(self.replacement))
+        out = [rx.sub(rep, s) for s in c.values]
+        return EvalCol(np.asarray(out, dtype=object), c.validity, dt.STRING)
+
+
+def _device_startswith(ctx, c: EvalCol, nb: bytes):
+    xp = ctx.xp
+    w = c.values.shape[1]
+    if len(nb) > w:
+        return xp.zeros(c.values.shape[0], dtype=bool)
+    pat = xp.asarray(np.frombuffer(nb, dtype=np.uint8))
+    head = c.values[:, :len(nb)]
+    return xp.logical_and(xp.all(head == pat[None, :], axis=1),
+                          c.lengths >= len(nb))
+
+
+def _device_endswith(ctx, c: EvalCol, nb: bytes):
+    xp = ctx.xp
+    w = c.values.shape[1]
+    if len(nb) == 0:
+        return xp.ones(c.values.shape[0], dtype=bool)
+    if len(nb) > w:
+        return xp.zeros(c.values.shape[0], dtype=bool)
+    pat = xp.asarray(np.frombuffer(nb, dtype=np.uint8))
+    j = xp.arange(len(nb), dtype=xp.int32)[None, :]
+    idx = xp.clip((c.lengths - len(nb))[:, None] + j, 0, w - 1)
+    tail = xp.take_along_axis(c.values, idx, axis=1)
+    return xp.logical_and(xp.all(tail == pat[None, :], axis=1),
+                          c.lengths >= len(nb))

@@ -75,6 +75,126 @@ def _register_expr_rules():
     # their input expressions
     register_expr_rule(AggregateFunction, _device_common)
 
+    _register_string_rules()
+    _register_datetime_rules()
+    _register_misc_rules()
+
+
+def _register_string_rules():
+    from ..expr import strings as S
+
+    _string = TypeSig.of(TypeEnum.STRING, TypeEnum.BINARY, TypeEnum.INT,
+                         TypeEnum.BOOLEAN)
+    ascii_note = "device case mapping is ASCII-only (host fallback is Unicode)"
+    register_expr_rule(S.Upper, _string.with_ps_note(TypeEnum.STRING, ascii_note))
+    register_expr_rule(S.Lower, _string.with_ps_note(TypeEnum.STRING, ascii_note))
+    register_expr_rule(S.InitCap, _string.with_ps_note(TypeEnum.STRING, ascii_note))
+    register_expr_rule(S.Length, _string)
+    register_expr_rule(S.OctetLength, _string)
+    register_expr_rule(S.BitLength, _string)
+    register_expr_rule(S.StringReverse, _string)
+    register_expr_rule(S.Ascii, _string)
+    register_expr_rule(S.Substring, _string + TypeSig.integral)
+    register_expr_rule(S.StartsWith, _string)
+    register_expr_rule(S.EndsWith, _string)
+    register_expr_rule(S.Concat, _string)
+    register_expr_rule(S.StringTrim, _string)
+    register_expr_rule(S.StringTrimLeft, _string)
+    register_expr_rule(S.StringTrimRight, _string)
+
+    def _require_lit(child_attr, what):
+        def tag(meta, conf):
+            if S.literal_value(getattr(meta.expr, child_attr)) is None:
+                meta.cannot_run(f"device {what} requires a literal")
+        return tag
+
+    register_expr_rule(S.Contains, _string,
+                       tag_fn=_require_lit("right", "contains pattern"))
+    register_expr_rule(S.StringLocate, _string + TypeSig.integral,
+                       tag_fn=_require_lit("substr", "locate pattern"))
+
+    def tag_pad(meta, conf):
+        e = meta.expr
+        if S.literal_value(e.length) is None or S.literal_value(e.pad) is None:
+            meta.cannot_run("device pad requires literal length/pad")
+    register_expr_rule(S.StringLpad, _string + TypeSig.integral, tag_fn=tag_pad)
+    register_expr_rule(S.StringRpad, _string + TypeSig.integral, tag_fn=tag_pad)
+
+    def tag_repeat(meta, conf):
+        if S.literal_value(meta.expr.times) is None:
+            meta.cannot_run("device repeat requires literal count")
+    register_expr_rule(S.StringRepeat, _string + TypeSig.integral,
+                       tag_fn=tag_repeat)
+
+    def tag_like(meta, conf):
+        e: S.Like = meta.expr
+        if S.literal_value(e.pattern) is None:
+            meta.cannot_run("device LIKE requires a literal pattern")
+            return
+        if e.simple_kind() is None:
+            from ..expr.regex import compile_device_nfa
+            if compile_device_nfa(e.to_regex()) is None:
+                meta.cannot_run("LIKE pattern outside the device regex subset")
+    register_expr_rule(S.Like, _string, tag_fn=tag_like)
+
+    def tag_rlike(meta, conf):
+        e: S.RLike = meta.expr
+        pat = S.literal_value(e.pattern)
+        if pat is None:
+            meta.cannot_run("device rlike requires a literal pattern")
+            return
+        from ..expr.regex import compile_device_nfa
+        if compile_device_nfa(pat) is None:
+            meta.cannot_run(
+                f"regex {pat!r} outside the device NFA subset (transpiler "
+                "rejected it; runs on host)")
+    register_expr_rule(S.RLike, _string, tag_fn=tag_rlike)
+
+    # host-only string expressions (device falls back via transition insertion)
+    _host_only = "host-only: dynamic-width output"
+    for cls in (S.StringReplace, S.SubstringIndex, S.ConcatWs, S.Chr,
+                S.RegExpExtract, S.RegExpReplace):
+        register_expr_rule(
+            cls, TypeSig.none(),
+            note=_host_only)
+
+
+def _register_datetime_rules():
+    from ..expr import datetimes as D
+
+    _dt_sig = TypeSig.of(TypeEnum.DATE, TypeEnum.TIMESTAMP, TypeEnum.INT,
+                         TypeEnum.LONG, TypeEnum.DOUBLE)
+    for cls in (D.Year, D.Month, D.DayOfMonth, D.DayOfWeek, D.WeekDay,
+                D.DayOfYear, D.WeekOfYear, D.Quarter, D.Hour, D.Minute,
+                D.Second, D.DateAdd, D.DateSub, D.DateDiff, D.AddMonths,
+                D.LastDay, D.MonthsBetween, D.TimeAdd, D.UnixTimestamp,
+                D.TruncDate):
+        register_expr_rule(cls, _dt_sig + TypeSig.integral)
+    for cls in (D.FromUnixTime, D.DateFormatClass):
+        register_expr_rule(cls, TypeSig.none(), note="host-only: formatting")
+
+
+def _register_misc_rules():
+    from ..expr import hashing as H
+
+    _hashable = _device_common + TypeSig.of(TypeEnum.STRING)
+    register_expr_rule(H.Murmur3Hash, _hashable)
+
+    def tag_xx(meta, conf):
+        for c in meta.expr.children:
+            try:
+                ct = c.data_type
+            except Exception:
+                continue
+            if isinstance(ct, (dt.StringType, dt.BinaryType)):
+                meta.cannot_run("xxhash64 over strings runs on host only")
+    register_expr_rule(H.XxHash64, _hashable, tag_fn=tag_xx)
+    register_expr_rule(H.SparkPartitionID, _device_all)
+    register_expr_rule(H.MonotonicallyIncreasingID, _device_all)
+    register_expr_rule(H.Rand, _device_all,
+                       note="non-deterministic: sequence differs from Spark "
+                            "XORShiftRandom (reference marks GpuRand the same)")
+
 
 def _register_exec_rules():
     from ..exec.aggregate import TpuHashAggregateExec

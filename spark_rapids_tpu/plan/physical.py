@@ -80,8 +80,10 @@ def _empty_values(d: dt.DataType) -> np.ndarray:
 
 
 def host_eval_exprs(table: HostTable, exprs: Sequence[Expression],
-                    names: Sequence[str]) -> HostTable:
-    ctx = EvalContext.for_host(table)
+                    names: Sequence[str], partition_id: int = 0,
+                    batch_row_offset: int = 0) -> HostTable:
+    ctx = EvalContext.for_host(table, partition_id=partition_id,
+                               batch_row_offset=batch_row_offset)
     cols = []
     for e in exprs:
         c = e.eval(ctx)
@@ -129,8 +131,11 @@ class CpuProjectExec(PhysicalPlan):
                               for n, e in zip(names, exprs)])
 
     def execute(self, pidx: int) -> Iterator[HostTable]:
+        offset = 0
         for batch in self.child.execute(pidx):
-            yield host_eval_exprs(batch, self.exprs, self.names)
+            yield host_eval_exprs(batch, self.exprs, self.names,
+                                  partition_id=pidx, batch_row_offset=offset)
+            offset += batch.num_rows
 
     def node_desc(self):
         return ", ".join(self.names)
@@ -144,8 +149,11 @@ class CpuFilterExec(PhysicalPlan):
         self.schema = child.schema
 
     def execute(self, pidx: int) -> Iterator[HostTable]:
+        offset = 0
         for batch in self.child.execute(pidx):
-            ctx = EvalContext.for_host(batch)
+            ctx = EvalContext.for_host(batch, partition_id=pidx,
+                                       batch_row_offset=offset)
+            offset += batch.num_rows
             c = self.condition.eval(ctx)
             keep = np.asarray(c.values, dtype=np.bool_)
             if c.validity is not None:

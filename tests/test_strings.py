@@ -1,0 +1,218 @@
+"""String expression tests: device kernels vs host engine vs plain Python
+(reference analogue: StringOperatorsSuite / string tests in integration_tests)."""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.expr.functions import (
+    col, lit, upper, lower, initcap, length, octet_length, substring,
+    substring_index, concat, concat_ws, trim, ltrim, rtrim, lpad, rpad,
+    repeat, reverse, replace, locate, instr, ascii, regexp_extract,
+    regexp_replace)
+from harness import assert_tpu_cpu_equal
+
+
+ASCII_WORDS = ["", "a", "AB", "abc", "tpu", "Spark", "RAPIDS", "xyzzy",
+               "  padded  ", "MixedCase", "longer string value", "a b c",
+               "%special_", "trailing  ", "  leading"]
+UNICODE_WORDS = ["", "é", "héllo", "日本語", "mix日ed", "ünïcode", "a日b"]
+
+
+@pytest.fixture
+def sdf(session, rng):
+    n = 120
+    words = [ASCII_WORDS[i] for i in rng.integers(0, len(ASCII_WORDS), n)]
+    mask = rng.random(n) < 0.1
+    arr = pa.array(words, mask=mask)
+    other = pa.array([ASCII_WORDS[i] for i in rng.integers(0, len(ASCII_WORDS), n)])
+    return session.create_dataframe(pa.table({"s": arr, "t": other}))
+
+
+@pytest.fixture
+def udf_(session, rng):
+    n = 60
+    words = [UNICODE_WORDS[i] for i in rng.integers(0, len(UNICODE_WORDS), n)]
+    return session.create_dataframe(pa.table({"s": pa.array(words)}))
+
+
+def test_case_mapping(sdf):
+    assert_tpu_cpu_equal(sdf.select(
+        upper(col("s")).alias("u"),
+        lower(col("s")).alias("l"),
+        initcap(col("s")).alias("ic"),
+    ))
+
+
+def test_length_family_unicode(udf_):
+    # length is characters, octet_length is bytes — exact for UTF-8 on device
+    out = assert_tpu_cpu_equal(udf_.select(
+        col("s").alias("s"),
+        length(col("s")).alias("chars"),
+        octet_length(col("s")).alias("bytes"),
+    ))
+    for s, c, b in zip(out.column("s").to_pylist(),
+                       out.column("chars").to_pylist(),
+                       out.column("bytes").to_pylist()):
+        assert c == len(s)
+        assert b == len(s.encode())
+
+
+def test_substring_ascii(sdf):
+    assert_tpu_cpu_equal(sdf.select(
+        substring(col("s"), 1, 3).alias("pre"),
+        substring(col("s"), 3, 2).alias("mid"),
+        substring(col("s"), -3, 2).alias("neg"),
+        substring(col("s"), 0, 4).alias("zero"),
+        col("s").substr(2, 100).alias("tail"),
+    ))
+
+
+def test_substring_unicode_charwise(udf_):
+    out = assert_tpu_cpu_equal(udf_.select(
+        col("s").alias("s"),
+        substring(col("s"), 2, 2).alias("sub"),
+    ))
+    for s, sub in zip(out.column("s").to_pylist(),
+                      out.column("sub").to_pylist()):
+        assert sub == s[1:3]
+
+
+def test_reverse_unicode(udf_):
+    out = assert_tpu_cpu_equal(udf_.select(
+        col("s").alias("s"), reverse(col("s")).alias("r")))
+    for s, r in zip(out.column("s").to_pylist(), out.column("r").to_pylist()):
+        assert r == s[::-1]
+
+
+def test_predicates(sdf):
+    assert_tpu_cpu_equal(sdf.select(
+        col("s").startswith(lit("a")).alias("sw"),
+        col("s").endswith(lit("g")).alias("ew"),
+        col("s").contains(lit("ar")).alias("ct"),
+        col("s").startswith(col("t")).alias("sw_col"),
+        col("s").endswith(col("t")).alias("ew_col"),
+    ))
+
+
+def test_like(sdf):
+    assert_tpu_cpu_equal(sdf.select(
+        col("s").like("a%").alias("pre"),
+        col("s").like("%g").alias("suf"),
+        col("s").like("%ar%").alias("ct"),
+        col("s").like("abc").alias("eq"),
+        col("s").like("a_c").alias("underscore"),
+        col("s").like("%a_c%").alias("general"),
+    ))
+
+
+def test_concat_trim_pad(sdf):
+    assert_tpu_cpu_equal(sdf.select(
+        concat(col("s"), lit("-"), col("t")).alias("cc"),
+        trim(col("s")).alias("tr"),
+        ltrim(col("s")).alias("ltr"),
+        rtrim(col("s")).alias("rtr"),
+        lpad(col("s"), 8, "*").alias("lp"),
+        rpad(col("s"), 8, "xy").alias("rp"),
+        repeat(col("s"), 2).alias("rep"),
+    ))
+
+
+def test_concat_ws_and_replace(sdf):
+    assert_tpu_cpu_equal(sdf.select(
+        concat_ws(",", col("s"), col("t")).alias("cw"),
+        replace(col("s"), "a", "_").alias("rep"),
+        substring_index(col("s"), " ", 1).alias("si"),
+    ))
+
+
+def test_locate_instr_ascii_fn(sdf):
+    assert_tpu_cpu_equal(sdf.select(
+        locate("a", col("s")).alias("loc"),
+        locate("a", col("s"), 2).alias("loc2"),
+        instr(col("s"), "ar").alias("ins"),
+        ascii(col("s")).alias("asc"),
+    ))
+
+
+def test_rlike_device_nfa(sdf):
+    assert_tpu_cpu_equal(sdf.select(
+        col("s").rlike("^[A-Z]").alias("anch"),
+        col("s").rlike("a.c").alias("dot"),
+        col("s").rlike("ing$").alias("end"),
+        col("s").rlike("[0-9]+|[a-z]{3}").alias("alt"),
+        col("s").rlike("Spa?rk").alias("opt"),
+    ))
+
+
+def test_regexp_extract_replace(sdf):
+    assert_tpu_cpu_equal(sdf.select(
+        regexp_extract(col("s"), "([a-z]+)", 1).alias("ex"),
+        regexp_replace(col("s"), "[aeiou]", "#").alias("rr"),
+    ))
+
+
+def test_string_fallback_reasons(session):
+    """Host-only exprs must tag not-device with a recorded reason."""
+    df = session.create_dataframe(pa.table({"s": ["a-b", "c-d"]}))
+    q = df.select(regexp_replace(col("s"), "-", "+").alias("r"))
+    txt = q.explain("tpu")
+    assert "cannot run" in txt
+
+
+def test_device_regex_subset_detection():
+    from spark_rapids_tpu.expr.regex import compile_device_nfa, transpile, \
+        RegexUnsupported
+    assert compile_device_nfa("abc") is not None
+    assert compile_device_nfa("^a[bc]+d?$") is not None
+    assert compile_device_nfa("(ab|cd)*x") is not None
+    assert compile_device_nfa(r"\d{2,4}") is not None
+    # rejected: backreference, lookahead, \p class, word boundary
+    assert compile_device_nfa(r"(a)\1") is None
+    assert compile_device_nfa(r"a(?=b)") is None
+    assert compile_device_nfa(r"\p{Alpha}") is None
+    assert compile_device_nfa(r"a\b") is None
+    with pytest.raises(RegexUnsupported):
+        transpile(r"(a)\1")
+
+
+def test_rlike_unicode_char_exact(session):
+    """Device NFA steps per character: '.', negated classes, and $ anchors
+    must agree with the host engine on multi-byte UTF-8 input."""
+    df = session.create_dataframe(pa.table({
+        "s": ["xé", "é", "ab", "日本語", "aé日", ""]}))
+    assert_tpu_cpu_equal(df.select(
+        col("s").alias("s"),
+        col("s").rlike("x.").alias("dot"),
+        col("s").rlike("^.$").alias("one"),
+        col("s").rlike("^[^a]+$").alias("neg"),
+        col("s").rlike("a.$").alias("end"),
+    ), ignore_order=False)
+
+
+def test_rand_statistics(session):
+    from spark_rapids_tpu.expr.functions import rand
+    df = session.create_dataframe(
+        pa.table({"x": np.arange(2000, dtype=np.int64)}))
+    out = df.select(rand().alias("a"), rand().alias("b")).collect(device=True)
+    a = np.asarray(out.column("a").to_pylist())
+    b = np.asarray(out.column("b").to_pylist())
+    assert 0.0 <= a.min() and a.max() < 1.0
+    assert abs(a.mean() - 0.5) < 0.05
+    assert not np.array_equal(a, b)     # independent streams per rand() call
+
+
+def test_malformed_regex_falls_back(session):
+    """Malformed {m,n} must reject from the device subset, not crash planning."""
+    from spark_rapids_tpu.expr.regex import compile_device_nfa
+    assert compile_device_nfa("a{2") is None
+    assert compile_device_nfa("a{b}") is None
+
+
+def test_pad_edge_cases(session):
+    df = session.create_dataframe(pa.table({"s": ["abc", "x", ""]}))
+    out = assert_tpu_cpu_equal(df.select(
+        rpad(col("s"), 0, "*").alias("z"),
+        lpad(col("s"), 2, "*").alias("trunc_l"),
+    ), ignore_order=False)
+    assert out.column("z").to_pylist() == ["", "", ""]
+    assert out.column("trunc_l").to_pylist() == ["ab", "*x", "**"]
