@@ -65,7 +65,7 @@ def _register_expr_rules():
     def tag_cast(meta, conf):
         c: Cast = meta.expr
         src = c.child.data_type
-        if isinstance(c.to, (dt.StringType, dt.BinaryType)):
+        if isinstance(c.to, (dt.StringType, dt.BinaryType)) and src != c.to:
             meta.cannot_run("cast to string not implemented on device")
         if isinstance(src, (dt.StringType, dt.BinaryType)) and src != c.to:
             meta.cannot_run("cast from string not implemented on device")
@@ -195,6 +195,20 @@ def _register_misc_rules():
                        note="non-deterministic: sequence differs from Spark "
                             "XORShiftRandom (reference marks GpuRand the same)")
 
+    # UDFs (reference: GpuUserDefinedFunction.scala, GpuArrowEvalPythonExec)
+    from ..udf.columnar import ColumnarUDF
+    from ..udf.python_exec import PythonUDF
+
+    def tag_columnar_udf(meta, conf):
+        if not meta.expr.device_ok:
+            meta.cannot_run(
+                f"columnar UDF {meta.expr.udf_name!r} declared device_ok=False")
+    register_expr_rule(ColumnarUDF, _device_all, tag_fn=tag_columnar_udf)
+    register_expr_rule(
+        PythonUDF, _device_all,
+        note="interpreted on host via the Arrow eval operator with the device "
+             "semaphore released (GpuArrowEvalPythonExec.scala:306-332)")
+
 
 def _register_exec_rules():
     from ..exec.aggregate import TpuHashAggregateExec
@@ -202,15 +216,29 @@ def _register_exec_rules():
                               TpuRangeExec, TpuUnionExec)
     from ..exec.sort import TpuSortExec
 
+    def convert_project(p, ch, conf):
+        from ..udf import TpuArrowEvalPythonExec, tree_has_python_udf
+        if any(tree_has_python_udf(e) for e in p.exprs):
+            return TpuArrowEvalPythonExec(ch[0], p.exprs, p.names,
+                                          conf.min_bucket_rows)
+        return TpuProjectExec(ch[0], p.exprs, p.names)
+
     register_exec_rule(
-        CpuProjectExec, _device_all,
-        lambda p, ch, conf: TpuProjectExec(ch[0], p.exprs, p.names),
+        CpuProjectExec, _device_all, convert_project,
         exprs_fn=lambda p: p.exprs)
+
+    def tag_filter(meta, conf):
+        from ..udf import tree_has_python_udf
+        if tree_has_python_udf(meta.plan.condition):
+            # only Project routes interpreted UDFs through the Arrow bridge;
+            # a filter condition would land inside a device computation
+            meta.cannot_run("interpreted Python UDF in filter condition "
+                            "(project it into a column first)")
 
     register_exec_rule(
         CpuFilterExec, _device_all,
         lambda p, ch, conf: TpuFilterExec(ch[0], p.condition),
-        exprs_fn=lambda p: [p.condition])
+        exprs_fn=lambda p: [p.condition], tag_fn=tag_filter)
 
     register_exec_rule(
         CpuRangeExec, _device_all,
@@ -277,6 +305,10 @@ def _register_exec_rules():
         if p.condition is not None and p.how != "inner":
             meta.cannot_run("join residual condition only supported for "
                             "inner joins on device")
+        if p.condition is not None:
+            from ..udf import tree_has_python_udf
+            if tree_has_python_udf(p.condition):
+                meta.cannot_run("interpreted Python UDF in join condition")
 
     def _join_exprs(p):
         return [p.condition] if p.condition is not None else []
@@ -304,8 +336,11 @@ def _register_exec_rules():
                           Sum, Min, Max, Count, CountStar, Average)
 
     def tag_window(meta, conf):
+        from ..udf import tree_has_python_udf
         p = meta.plan
         for name, w in p.window_cols:
+            if any(tree_has_python_udf(c) for c in w.fn.children):
+                meta.cannot_run("interpreted Python UDF in window function")
             if not isinstance(w.fn, _DEVICE_WINDOW_FNS):
                 meta.cannot_run(
                     f"window function {type(w.fn).__name__} not supported "
@@ -342,10 +377,13 @@ def _register_exec_rules():
         tag_fn=tag_window)
 
     def tag_sort(meta, conf):
+        from ..udf import tree_has_python_udf
         p: CpuSortExec = meta.plan
         for o in p.orders:
             if isinstance(o.expr.data_type, (dt.StringType, dt.BinaryType)):
                 meta.cannot_run("string sort keys not yet supported on device")
+            if tree_has_python_udf(o.expr):
+                meta.cannot_run("interpreted Python UDF in sort key")
 
     register_exec_rule(
         CpuSortExec, _device_all,
@@ -368,6 +406,11 @@ def apply_overrides(cpu_plan: PhysicalPlan, conf: RapidsConf) -> PhysicalPlan:
     """Tag + convert + insert transitions + fuse (SURVEY §3.2 call stack)."""
     if not conf.is_sql_enabled:
         return cpu_plan
+    from ..udf import UDF_COMPILER_ENABLED, compile_plan_udfs
+    if conf.get(UDF_COMPILER_ENABLED):
+        # reference: udf-compiler's injected resolution rule, gated by
+        # spark.rapids.sql.udfCompiler.enabled (RapidsConf.scala:530)
+        compile_plan_udfs(cpu_plan)
     meta = wrap_plan(cpu_plan)
     meta.tag(conf)
     if conf.explain != "NONE":

@@ -87,6 +87,12 @@ class TpuSession:
         cpu = plan_physical(logical, self.conf)
         use_device = self.conf.is_sql_enabled if device is None else device
         if not use_device:
+            # UDF compilation is engine-independent (the compiled expression
+            # tree also runs on the host engine) — apply it here too so the
+            # CPU path matches the reference's resolution-rule placement
+            from .udf import UDF_COMPILER_ENABLED, compile_plan_udfs
+            if self.conf.get(UDF_COMPILER_ENABLED):
+                compile_plan_udfs(cpu)
             return cpu
         return apply_overrides(cpu, self.conf)
 

@@ -1,0 +1,76 @@
+"""User-defined function acceleration (reference: layer 9, SURVEY §2.8).
+
+Three tiers, best first — mirroring the reference's UDF story:
+
+1. **Compiled** (`compiler.py` ≈ udf-compiler/): simple Python UDF bytecode is
+   compiled into an Expression tree that fuses into whole-stage XLA.
+2. **Columnar** (`columnar.py` ≈ RapidsUDF.java): the user writes a
+   jax-traceable batch function; it runs on device as-is.
+3. **Interpreted** (`python_exec.py` ≈ GpuArrowEvalPythonExec): opaque Python
+   runs on host per batch with the device semaphore released.
+
+``udf()`` is the front door: it tries tier 1 and falls back to tier 3.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ..columnar import dtypes as dt
+from ..conf import register_conf
+from .columnar import ColumnarUDF, columnar_udf
+from .compiler import UdfCompileError, compile_udf
+from .plan_rewrite import compile_plan_udfs, tree_has_python_udf
+from .python_exec import PythonUDF, TpuArrowEvalPythonExec
+
+__all__ = ["udf", "columnar_udf", "compile_udf", "UdfCompileError",
+           "ColumnarUDF", "PythonUDF", "TpuArrowEvalPythonExec",
+           "compile_plan_udfs", "tree_has_python_udf",
+           "UDF_COMPILER_ENABLED"]
+
+UDF_COMPILER_ENABLED = register_conf(
+    "spark.rapids.tpu.sql.udfCompiler.enabled",
+    "When true, simple Python UDFs are compiled to device expression trees "
+    "(reference: spark.rapids.sql.udfCompiler.enabled, RapidsConf.scala:530). "
+    "UDFs outside the compilable subset fall back to interpreted host "
+    "execution via the Arrow eval operator.", True)
+
+
+def udf(fn: Optional[Callable] = None, *, return_type: dt.DataType = dt.DOUBLE,
+        name: Optional[str] = None, kind: str = "scalar",
+        try_compile: Optional[bool] = None):
+    """Wrap a Python function as a UDF usable in ``df.select``/``filter``.
+
+    >>> @udf(return_type=dt.DOUBLE)
+    ... def discount(price, pct):
+    ...     return price * (1.0 - pct)
+    >>> df.select(discount(col("price"), col("pct")))
+
+    ``kind="pandas"`` marks the fallback evaluation as one-call-per-batch on
+    ``pandas.Series`` (the pandas UDF path).
+
+    Compilation happens at **planning time** under the *session* conf
+    ``spark.rapids.tpu.sql.udfCompiler.enabled`` (see plan_rewrite.py), the
+    same hook point as the reference's injected resolution rule.
+    ``try_compile=True`` forces an eager attempt here instead;
+    ``try_compile=False`` pins the UDF to interpreted execution.
+    """
+    def wrap(f: Callable):
+        udf_name = name or f.__name__
+
+        def build(*args):
+            from ..expr.functions import Column, _to_expr
+            exprs = tuple(_to_expr(a) for a in args)
+            if try_compile:
+                try:
+                    return Column(compile_udf(f, exprs, return_type))
+                except UdfCompileError:
+                    pass
+            return Column(PythonUDF(f, udf_name, return_type, exprs, kind,
+                                    allow_compile=try_compile is not False))
+        build.__name__ = udf_name
+        build.fn = f
+        build.return_type = return_type
+        return build
+    if fn is not None:
+        return wrap(fn)
+    return wrap

@@ -1,0 +1,101 @@
+"""Columnar UDFs — user-supplied batch kernels as expressions.
+
+The reference lets users accelerate their own UDFs by implementing
+``RapidsUDF.evaluateColumnar(ColumnVector...)`` on a Scala/Hive UDF
+(sql-plugin/src/main/java/com/nvidia/spark/RapidsUDF.java:22-39,
+GpuUserDefinedFunction.scala). The TPU-native shape of that idea: the user
+writes a **jax-traceable array function**; it becomes an ``Expression`` that
+fuses into the surrounding whole-stage XLA computation — no JNI, no custom
+kernel build step (Pallas kernels slot in the same way since a Pallas call is
+jax-traceable; the udf-examples/ cosine_similarity.cu analogue is a few lines
+of jnp in tests/test_udf.py).
+
+The same function body usually runs on the CPU fallback path too because it
+receives numpy arrays there (jnp and np share the array API); a separate
+``host_fn`` can be supplied when it does not.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional, Sequence
+
+from ..columnar import dtypes as dt
+from ..expr.base import EvalCol, EvalContext, Expression
+
+__all__ = ["ColumnarUDF", "columnar_udf"]
+
+
+@dataclasses.dataclass(repr=False)
+class ColumnarUDF(Expression):
+    """fn maps value arrays -> value array (nulls handled by the framework).
+
+    Null semantics: output row is null when any input row is null (the
+    default for Spark UDFs with primitive args); ``fn`` may instead accept
+    and return (values, validity) pairs by setting ``handles_nulls``.
+    """
+    fn: Callable
+    udf_name: str
+    _dtype: dt.DataType
+    arg_exprs: Sequence[Expression]
+    host_fn: Optional[Callable] = None
+    handles_nulls: bool = False
+    #: False marks the fn as not jax-traceable -> tagged off-device
+    device_ok: bool = True
+
+    def __post_init__(self):
+        self.children = tuple(self.arg_exprs)
+
+    @property
+    def data_type(self) -> dt.DataType:
+        return self._dtype
+
+    @property
+    def name(self) -> str:
+        return self.udf_name
+
+    def with_children(self, children):
+        return ColumnarUDF(self.fn, self.udf_name, self._dtype, tuple(children),
+                           self.host_fn, self.handles_nulls, self.device_ok)
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        cols = [c.eval(ctx) for c in self.children]
+        fn = self.fn if ctx.is_device or self.host_fn is None else self.host_fn
+        if self.handles_nulls:
+            out = fn(*[(c.values, c.valid_mask(ctx)) for c in cols])
+            values, validity = out
+            return EvalCol(values, validity, self._dtype)
+        values = fn(*[c.values for c in cols])
+        validity = None
+        for c in cols:
+            if c.validity is not None:
+                validity = c.validity if validity is None \
+                    else ctx.xp.logical_and(validity, c.validity)
+        return EvalCol(values, validity, self._dtype)
+
+    def __repr__(self):
+        return f"{self.udf_name}({', '.join(map(repr, self.children))})"
+
+
+def columnar_udf(return_type: dt.DataType, name: Optional[str] = None,
+                 host_fn: Optional[Callable] = None,
+                 handles_nulls: bool = False, device_ok: bool = True):
+    """Decorator: turn an array function into a columnar UDF factory.
+
+    >>> @columnar_udf(dt.DOUBLE)
+    ... def fma(a, b, c):
+    ...     return a * b + c
+    >>> df.select(fma(col("x"), col("y"), col("z")))
+    """
+    def wrap(fn: Callable):
+        udf_name = name or fn.__name__
+
+        def build(*args):
+            from ..expr.functions import Column, _to_expr
+            exprs = tuple(_to_expr(a) for a in args)
+            return Column(ColumnarUDF(fn, udf_name, return_type, exprs,
+                                      host_fn, handles_nulls, device_ok))
+        build.__name__ = udf_name
+        build.fn = fn
+        build.return_type = return_type
+        return build
+    return wrap

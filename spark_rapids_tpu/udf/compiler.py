@@ -1,0 +1,400 @@
+"""UDF compiler: Python bytecode -> expression trees.
+
+The reference ships a whole module for this idea — ``udf-compiler/`` compiles
+*JVM* bytecode of simple Scala UDFs into Catalyst expressions so they run
+columnar with no user changes (CFG.scala:1 basic blocks, Instruction.scala:1
+opcode semantics, CatalystExpressionBuilder.scala:45 ``compile``). This module
+is the same capability for the TPU framework's host language: it symbolically
+executes *CPython* bytecode of a ``lambda``/``def`` UDF and emits an
+``Expression`` tree that runs fused on-device (and on the CPU fallback path)
+instead of row-at-a-time Python.
+
+Approach (mirrors the reference's design):
+
+- Symbolic stack machine over ``dis`` instructions. Stack cells hold either
+  ``Expression`` nodes or plain Python constants (folded lazily into
+  ``Literal`` at use sites so const-const arithmetic stays Python-evaluated).
+- Control flow: conditional jumps **fork** symbolic execution down both arms
+  under a path condition; each arm runs to its RETURN and the results merge
+  into ``If(cond, then, else)`` — the same conditional-to-expression rewrite
+  the reference does for JVM ``if``s (Instruction.scala ifelse handling).
+  Backward jumps (loops) are rejected — loops have no columnar translation.
+- Unknown opcodes / calls raise ``UdfCompileError``; the caller then falls
+  back to the interpreted Python UDF path (python_exec.py), matching the
+  reference's fall-back-to-JVM-UDF behavior when compilation bails
+  (opt-in conf ``spark.rapids.sql.udfCompiler.enabled``, RapidsConf.scala:530).
+"""
+from __future__ import annotations
+
+import dis
+import math
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..columnar import dtypes as dt
+from ..expr.base import Expression, Literal
+from ..expr import arithmetic as A
+from ..expr import conditional as C
+from ..expr import math as M
+from ..expr import predicates as P
+from ..expr import strings as S
+from ..expr.cast import Cast
+
+__all__ = ["UdfCompileError", "compile_udf", "MAX_FORKS"]
+
+#: fork budget: 2^branches paths; tiny UDFs only (the reference caps compiled
+#: UDF complexity the same way by rejecting unsupported CFG shapes)
+MAX_FORKS = 64
+
+
+class UdfCompileError(Exception):
+    """Raised when the UDF's bytecode is outside the compilable subset."""
+
+
+class _Null:
+    """Marker for CPython's NULL stack sentinel (PUSH_NULL / LOAD_GLOBAL)."""
+    __slots__ = ()
+
+
+_NULL = _Null()
+
+
+class _Method:
+    """A bound-method marker: obj.attr pending a CALL."""
+    __slots__ = ("obj", "attr")
+
+    def __init__(self, obj, attr):
+        self.obj = obj
+        self.attr = attr
+
+
+def _lit(v: Any) -> Expression:
+    if isinstance(v, Expression):
+        return v
+    return Literal(v)
+
+
+def _is_const(v: Any) -> bool:
+    return not isinstance(v, (Expression, _Null, _Method))
+
+
+_BINOPS: Dict[str, Callable[[Any, Any], Any]] = {
+    "+": lambda a, b: A.Add(_lit(a), _lit(b)),
+    "-": lambda a, b: A.Subtract(_lit(a), _lit(b)),
+    "*": lambda a, b: A.Multiply(_lit(a), _lit(b)),
+    "/": lambda a, b: A.Divide(_lit(a), _lit(b)),
+    "//": lambda a, b: A.IntegralDivide(_lit(a), _lit(b)),
+    "%": lambda a, b: A.Remainder(_lit(a), _lit(b)),
+    "**": lambda a, b: M.Pow(_lit(a), _lit(b)),
+}
+
+_CMPOPS: Dict[str, Callable[[Any, Any], Expression]] = {
+    "<": lambda a, b: P.LessThan(_lit(a), _lit(b)),
+    "<=": lambda a, b: P.LessThanOrEqual(_lit(a), _lit(b)),
+    ">": lambda a, b: P.GreaterThan(_lit(a), _lit(b)),
+    ">=": lambda a, b: P.GreaterThanOrEqual(_lit(a), _lit(b)),
+    "==": lambda a, b: P.EqualTo(_lit(a), _lit(b)),
+    "!=": lambda a, b: P.Not(P.EqualTo(_lit(a), _lit(b))),
+}
+
+# global callables -> expression constructors (reference: Instruction.scala
+# maps java.lang.Math invokestatics to Catalyst math expressions)
+_GLOBAL_FNS: Dict[Any, Callable[..., Expression]] = {
+    math.sqrt: lambda x: M.Sqrt(_lit(x)),
+    math.exp: lambda x: M.Exp(_lit(x)),
+    math.log: lambda x: M.Log(_lit(x)),
+    math.log10: lambda x: M.Log10(_lit(x)),
+    math.log2: lambda x: M.Log2(_lit(x)),
+    math.log1p: lambda x: M.Log1p(_lit(x)),
+    math.expm1: lambda x: M.Expm1(_lit(x)),
+    math.sin: lambda x: M.Sin(_lit(x)),
+    math.cos: lambda x: M.Cos(_lit(x)),
+    math.tan: lambda x: M.Tan(_lit(x)),
+    math.asin: lambda x: M.Asin(_lit(x)),
+    math.acos: lambda x: M.Acos(_lit(x)),
+    math.atan: lambda x: M.Atan(_lit(x)),
+    math.atan2: lambda a, b: M.Atan2(_lit(a), _lit(b)),
+    math.sinh: lambda x: M.Sinh(_lit(x)),
+    math.cosh: lambda x: M.Cosh(_lit(x)),
+    math.tanh: lambda x: M.Tanh(_lit(x)),
+    math.floor: lambda x: M.Floor(_lit(x)),
+    math.ceil: lambda x: M.Ceil(_lit(x)),
+    math.pow: lambda a, b: M.Pow(_lit(a), _lit(b)),
+    math.degrees: lambda x: M.ToDegrees(_lit(x)),
+    math.radians: lambda x: M.ToRadians(_lit(x)),
+    abs: lambda x: A.Abs(_lit(x)),
+    len: lambda x: S.Length(_lit(x)),
+    float: lambda x: Cast(_lit(x), dt.DOUBLE),
+    int: lambda x: Cast(_lit(x), dt.LONG),
+    bool: lambda x: Cast(_lit(x), dt.BOOLEAN),
+    # exact Python semantics incl. NaN: min(a,b) keeps a unless b < a
+    # (all NaN comparisons are False, so a NaN first arg is kept — matching
+    # CPython's reduction order)
+    min: lambda a, b: C.If(P.LessThan(_lit(b), _lit(a)), _lit(b), _lit(a)),
+    max: lambda a, b: C.If(P.GreaterThan(_lit(b), _lit(a)), _lit(b), _lit(a)),
+}
+
+# str method calls -> expression constructors
+_STR_METHODS: Dict[str, Callable[..., Expression]] = {
+    "upper": lambda s: S.Upper(_lit(s)),
+    "lower": lambda s: S.Lower(_lit(s)),
+    "strip": lambda s: S.StringTrim(_lit(s)),
+    "lstrip": lambda s: S.StringTrimLeft(_lit(s)),
+    "rstrip": lambda s: S.StringTrimRight(_lit(s)),
+    "startswith": lambda s, p: S.StartsWith(_lit(s), _lit(p)),
+    "endswith": lambda s, p: S.EndsWith(_lit(s), _lit(p)),
+    "replace": lambda s, a, b: S.StringReplace(_lit(s), _lit(a), _lit(b)),
+}
+
+
+class _State:
+    __slots__ = ("stack", "locals")
+
+    def __init__(self, stack: List[Any], local_vars: Dict[str, Any]):
+        self.stack = stack
+        self.locals = local_vars
+
+    def copy(self) -> "_State":
+        return _State(list(self.stack), dict(self.locals))
+
+
+class _Compiler:
+    def __init__(self, fn: Callable):
+        self.fn = fn
+        self.code = fn.__code__
+        insts = list(dis.get_instructions(fn))
+        self.by_offset: Dict[int, int] = {i.offset: idx
+                                          for idx, i in enumerate(insts)}
+        self.insts = insts
+        self.forks = 0
+
+    def unsupported(self, what: str):
+        raise UdfCompileError(
+            f"cannot compile UDF {self.fn.__name__!r}: {what}")
+
+    def resolve_global(self, name: str) -> Any:
+        g = self.fn.__globals__
+        if name in g:
+            return g[name]
+        builtins = g.get("__builtins__", {})
+        if isinstance(builtins, dict):
+            if name in builtins:
+                return builtins[name]
+        elif hasattr(builtins, name):
+            return getattr(builtins, name)
+        self.unsupported(f"unknown global {name!r}")
+
+    def run(self, idx: int, state: _State) -> Any:
+        """Symbolically execute from instruction ``idx`` to a RETURN."""
+        insts = self.insts
+        while True:
+            if idx >= len(insts):
+                self.unsupported("fell off the end of the bytecode")
+            inst = insts[idx]
+            op = inst.opname
+            stack = state.stack
+
+            if op in ("RESUME", "NOP", "CACHE", "PRECALL", "COPY_FREE_VARS",
+                      "MAKE_CELL", "EXTENDED_ARG"):
+                pass
+            elif op == "PUSH_NULL":
+                stack.append(_NULL)
+            elif op in ("LOAD_FAST", "LOAD_FAST_CHECK", "LOAD_DEREF",
+                        "LOAD_CLOSURE"):
+                name = inst.argval
+                if name not in state.locals:
+                    if op == "LOAD_DEREF":
+                        # closure cell: resolve the captured constant
+                        for cname, cell in zip(
+                                self.code.co_freevars,
+                                self.fn.__closure__ or ()):
+                            if cname == name:
+                                state.locals[name] = cell.cell_contents
+                                break
+                    if name not in state.locals:
+                        self.unsupported(f"unbound local {name!r}")
+                stack.append(state.locals[name])
+            elif op == "STORE_FAST":
+                state.locals[inst.argval] = stack.pop()
+            elif op == "LOAD_CONST":
+                stack.append(inst.argval)
+            elif op == "RETURN_CONST":
+                return inst.argval
+            elif op == "LOAD_GLOBAL":
+                # 3.11+: low bit of arg means "push NULL first"
+                if inst.arg is not None and (inst.arg & 1):
+                    stack.append(_NULL)
+                stack.append(self.resolve_global(inst.argval))
+            elif op in ("LOAD_ATTR", "LOAD_METHOD"):
+                obj = stack.pop()
+                if op == "LOAD_ATTR" and inst.arg is not None \
+                        and (inst.arg & 1):
+                    # method-load variant pushes (method, self)
+                    stack.append(_Method(obj, inst.argval))
+                    stack.append(obj)  # placeholder for self slot
+                elif op == "LOAD_METHOD":
+                    stack.append(_Method(obj, inst.argval))
+                    stack.append(obj)
+                else:
+                    if _is_const(obj):
+                        stack.append(getattr(obj, inst.argval))
+                    else:
+                        self.unsupported(
+                            f"attribute access .{inst.argval} on a column")
+            elif op == "BINARY_OP":
+                rhs = stack.pop()
+                lhs = stack.pop()
+                sym = inst.argrepr.rstrip("=")  # '+=' folds to '+'
+                if _is_const(lhs) and _is_const(rhs):
+                    try:
+                        stack.append(_const_binop(sym, lhs, rhs))
+                    except Exception as ex:  # noqa: BLE001
+                        self.unsupported(f"constant fold {sym}: {ex}")
+                else:
+                    builder = _BINOPS.get(sym)
+                    if builder is None:
+                        self.unsupported(f"binary operator {inst.argrepr!r}")
+                    stack.append(builder(lhs, rhs))
+            elif op == "COMPARE_OP":
+                rhs = stack.pop()
+                lhs = stack.pop()
+                sym = inst.argrepr.strip()
+                # 3.13 spells boolean-coerced compares 'a < b' via argrepr
+                sym = sym.split()[0] if " " in sym else sym
+                builder = _CMPOPS.get(sym)
+                if builder is None:
+                    self.unsupported(f"comparison {inst.argrepr!r}")
+                if _is_const(lhs) and _is_const(rhs):
+                    stack.append(_const_cmp(sym, lhs, rhs))
+                else:
+                    stack.append(builder(lhs, rhs))
+            elif op == "UNARY_NEGATIVE":
+                v = stack.pop()
+                stack.append(-v if _is_const(v) else A.UnaryMinus(v))
+            elif op == "UNARY_NOT":
+                v = stack.pop()
+                stack.append((not v) if _is_const(v) else P.Not(v))
+            elif op == "COPY":
+                stack.append(stack[-inst.arg])
+            elif op == "SWAP":
+                stack[-1], stack[-inst.arg] = stack[-inst.arg], stack[-1]
+            elif op == "POP_TOP":
+                stack.pop()
+            elif op in ("JUMP_FORWARD", "JUMP_BACKWARD_NO_INTERRUPT"):
+                idx = self.by_offset[inst.argval]
+                continue
+            elif op == "JUMP_BACKWARD":
+                self.unsupported("loops are not compilable to expressions")
+            elif op in ("POP_JUMP_IF_FALSE", "POP_JUMP_IF_TRUE",
+                        "POP_JUMP_IF_NONE", "POP_JUMP_IF_NOT_NONE"):
+                cond = stack.pop()
+                target = self.by_offset[inst.argval]
+                if op == "POP_JUMP_IF_NONE":
+                    cond = P.IsNull(_lit(cond)) if not _is_const(cond) \
+                        else (cond is None)
+                    op = "POP_JUMP_IF_TRUE"
+                elif op == "POP_JUMP_IF_NOT_NONE":
+                    cond = P.IsNotNull(_lit(cond)) if not _is_const(cond) \
+                        else (cond is not None)
+                    op = "POP_JUMP_IF_TRUE"
+                if _is_const(cond):
+                    taken = bool(cond) == (op == "POP_JUMP_IF_TRUE")
+                    idx = target if taken else idx + 1
+                    continue
+                self.forks += 1
+                if self.forks > MAX_FORKS:
+                    self.unsupported("too many branches")
+                jump_state, fall_state = state.copy(), state.copy()
+                jumped = self.run(target, jump_state)
+                fell = self.run(idx + 1, fall_state)
+                if op == "POP_JUMP_IF_TRUE":
+                    then_v, else_v = jumped, fell
+                else:
+                    then_v, else_v = fell, jumped
+                return C.If(_as_bool(cond), _lit(then_v), _lit(else_v))
+            elif op == "RETURN_VALUE":
+                return stack.pop()
+            elif op == "CALL":
+                nargs = inst.arg
+                args = [stack.pop() for _ in range(nargs)][::-1]
+                callee = stack.pop()
+                if isinstance(callee, _Method):
+                    pass  # method marker directly under args
+                elif stack and isinstance(stack[-1], _Method):
+                    # self-slot placeholder was on top: [method, self, *args]
+                    callee = stack.pop()
+                if stack and stack[-1] is _NULL:
+                    stack.pop()
+                stack.append(self.call(callee, args))
+            elif op == "KW_NAMES":
+                self.unsupported("keyword arguments in UDF body")
+            else:
+                self.unsupported(f"opcode {op}")
+            idx += 1
+
+    def call(self, callee: Any, args: List[Any]) -> Any:
+        if isinstance(callee, _Method):
+            builder = _STR_METHODS.get(callee.attr)
+            if builder is None:
+                self.unsupported(f"method .{callee.attr}()")
+            try:
+                return builder(callee.obj, *args)
+            except TypeError:
+                self.unsupported(f"arity of .{callee.attr}()")
+        if all(_is_const(a) for a in args) and callable(callee) \
+                and callee in _GLOBAL_FNS:
+            try:
+                return callee(*args)  # constant fold
+            except Exception:  # noqa: BLE001
+                pass
+        try:
+            builder = _GLOBAL_FNS.get(callee)
+        except TypeError:
+            builder = None
+        if builder is None:
+            self.unsupported(f"call to {getattr(callee, '__name__', callee)!r}")
+        try:
+            return builder(*args)
+        except TypeError:
+            self.unsupported(
+                f"arity of {getattr(callee, '__name__', callee)!r}")
+
+
+def _as_bool(cond: Expression) -> Expression:
+    return cond
+
+
+def _const_binop(sym: str, a, b):
+    return {"+": lambda: a + b, "-": lambda: a - b, "*": lambda: a * b,
+            "/": lambda: a / b, "//": lambda: a // b, "%": lambda: a % b,
+            "**": lambda: a ** b}[sym]()
+
+
+def _const_cmp(sym: str, a, b):
+    return {"<": a < b, "<=": a <= b, ">": a > b, ">=": a >= b,
+            "==": a == b, "!=": a != b}[sym]
+
+
+def compile_udf(fn: Callable, args: Sequence[Expression],
+                return_type: Optional[dt.DataType] = None) -> Expression:
+    """Compile ``fn(*args)`` into an Expression tree.
+
+    ``args`` are the column expressions bound to the UDF's positional
+    parameters. Raises :class:`UdfCompileError` when the bytecode falls
+    outside the supported subset; callers fall back to interpreted execution
+    (reference: CatalystExpressionBuilder.compile returning None,
+    CatalystExpressionBuilder.scala:66).
+    """
+    code = fn.__code__
+    if code.co_flags & 0x0C:  # *args / **kwargs
+        raise UdfCompileError("varargs UDFs are not compilable")
+    nparams = code.co_argcount
+    if nparams != len(args):
+        raise UdfCompileError(
+            f"UDF takes {nparams} args, {len(args)} columns bound")
+    comp = _Compiler(fn)
+    local_vars = {code.co_varnames[i]: args[i] for i in range(nparams)}
+    result = comp.run(0, _State([], local_vars))
+    expr = _lit(result)
+    if return_type is not None:
+        expr = Cast(expr, return_type)
+    return expr

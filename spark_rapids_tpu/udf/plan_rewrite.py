@@ -1,0 +1,65 @@
+"""Planning-time UDF compilation pass.
+
+The reference compiles UDFs at *resolution* time via an injected rule
+(udf-compiler/.../Plugin.scala:11 ``injectResolutionRule``), gated by the
+session conf ``spark.rapids.sql.udfCompiler.enabled`` (RapidsConf.scala:530).
+This pass is the same hook point for this framework: ``apply_overrides`` runs
+it over the physical plan before tagging, so the *session* conf decides
+whether interpreted ``PythonUDF`` nodes are replaced by compiled expression
+trees. UDFs that fail to compile simply remain interpreted and execute
+through ``TpuArrowEvalPythonExec``.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ..columnar import dtypes as dt
+from ..expr.base import Expression, resolve_expression
+from .compiler import UdfCompileError, compile_udf
+from .python_exec import PythonUDF
+
+__all__ = ["compile_plan_udfs", "rewrite_expr", "tree_has_python_udf"]
+
+
+def tree_has_python_udf(e: Expression) -> bool:
+    if isinstance(e, PythonUDF):
+        return True
+    return any(tree_has_python_udf(c) for c in e.children)
+
+
+def rewrite_expr(e: Expression, schema: Dict[str, dt.DataType],
+                 nullable: Optional[Dict[str, bool]] = None) -> Expression:
+    """Replace compilable PythonUDF nodes bottom-up; re-resolve replacements
+    so coercion hooks run on the new subtree."""
+    new_children = [rewrite_expr(c, schema, nullable) for c in e.children]
+    out = e.with_children(new_children) if e.children else e
+    if isinstance(out, PythonUDF) and out.allow_compile:
+        try:
+            compiled = compile_udf(out.fn, out.children, out.data_type)
+        except UdfCompileError:
+            return out
+        return resolve_expression(compiled, schema, nullable)
+    return out
+
+
+def compile_plan_udfs(plan) -> None:
+    """In-place rewrite of Project/Filter expressions across the plan tree."""
+    from ..plan.physical import CpuFilterExec, CpuProjectExec
+    from ..plan.schema import Field, Schema
+
+    for child in plan.children:
+        compile_plan_udfs(child)
+    child = plan.children[0] if plan.children else None
+    if child is None or not hasattr(child, "schema"):
+        return
+    schema = child.schema.to_dict()
+    nullable = child.schema.nullable_dict()
+    if isinstance(plan, CpuProjectExec):
+        if any(tree_has_python_udf(e) for e in plan.exprs):
+            plan.exprs = [rewrite_expr(e, schema, nullable)
+                          for e in plan.exprs]
+            plan.schema = Schema([Field(n, e.data_type, e.nullable)
+                                  for n, e in zip(plan.names, plan.exprs)])
+    elif isinstance(plan, CpuFilterExec):
+        if tree_has_python_udf(plan.condition):
+            plan.condition = rewrite_expr(plan.condition, schema, nullable)

@@ -1,0 +1,169 @@
+"""Interpreted Python UDFs + the Arrow-bridge eval operator.
+
+Reference: ``GpuArrowEvalPythonExec`` (org/.../python/GpuArrowEvalPythonExec.scala)
+streams device batches to a Python worker over Arrow IPC and — critically —
+**releases the GPU semaphore while blocked on Python** (:306-332) so the
+device isn't held idle by host-side work. This module keeps that exact
+discipline: the device admission semaphore (memory/semaphore.py) is released
+for the duration of the Python evaluation and re-acquired before the result
+is uploaded.
+
+Two UDF kinds, matching the reference's scalar-Python and pandas UDF paths:
+
+- ``kind="scalar"``: fn called row-at-a-time on Python values (None for null).
+- ``kind="pandas"``: fn called once per batch on ``pandas.Series``.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from ..columnar import dtypes as dt
+from ..columnar.device import DeviceTable
+from ..columnar.host import HostColumn, HostTable
+from ..exec.base import TpuExec
+from ..expr.base import EvalCol, EvalContext, Expression
+from ..memory.semaphore import get_semaphore
+from ..plan.physical import PhysicalPlan, host_eval_exprs
+from ..plan.schema import Field, Schema
+from ..utils import metrics as M
+
+__all__ = ["PythonUDF", "TpuArrowEvalPythonExec"]
+
+
+@dataclasses.dataclass(repr=False)
+class PythonUDF(Expression):
+    """An opaque Python function evaluated on host over batch columns.
+
+    Device plans route projects containing these through
+    :class:`TpuArrowEvalPythonExec` (download -> python -> upload) instead of
+    rejecting the whole subtree — mirroring how the reference keeps the rest
+    of the plan on device around a pandas UDF.
+    """
+    fn: Callable
+    udf_name: str
+    _dtype: dt.DataType
+    arg_exprs: Sequence[Expression]
+    kind: str = "scalar"  # or "pandas"
+    #: False = user forced interpreted execution (udf(try_compile=False));
+    #: True lets the planner attempt bytecode compilation under
+    #: spark.rapids.tpu.sql.udfCompiler.enabled (see udf/plan_rewrite.py)
+    allow_compile: bool = True
+
+    def __post_init__(self):
+        self.children = tuple(self.arg_exprs)
+        assert self.kind in ("scalar", "pandas"), self.kind
+
+    @property
+    def data_type(self) -> dt.DataType:
+        return self._dtype
+
+    @property
+    def name(self) -> str:
+        return self.udf_name
+
+    def with_children(self, children):
+        return PythonUDF(self.fn, self.udf_name, self._dtype, tuple(children),
+                         self.kind, self.allow_compile)
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        if ctx.is_device:
+            raise RuntimeError(
+                f"PythonUDF {self.udf_name!r} cannot run inside a device "
+                "computation; it must be planned under TpuArrowEvalPythonExec")
+        cols = [c.eval(ctx) for c in self.children]
+        n = ctx.num_rows
+        pylists = [_to_pylist(c, n) for c in cols]
+        if self.kind == "pandas":
+            import pandas as pd
+            series = [pd.Series(v) for v in pylists]
+            result = self.fn(*series)
+            out = list(result)
+        else:
+            out = [self.fn(*row) for row in zip(*pylists)]
+        return _from_pylist(out, self._dtype)
+
+    def __repr__(self):
+        return f"{self.udf_name}({', '.join(map(repr, self.children))})"
+
+
+def _to_pylist(c: EvalCol, n: int) -> List:
+    vals = c.values
+    valid = c.validity
+    out = []
+    for i in range(n):
+        if valid is not None and not valid[i]:
+            out.append(None)
+        else:
+            v = vals[i]
+            out.append(v.item() if isinstance(v, np.generic) else v)
+    return out
+
+
+def _from_pylist(out: List, dtype: dt.DataType) -> EvalCol:
+    n = len(out)
+    validity = np.array([v is not None for v in out], dtype=bool) \
+        if any(v is None for v in out) else None
+    if isinstance(dtype, (dt.StringType, dt.BinaryType)):
+        values = np.empty(n, dtype=object)
+        empty = "" if isinstance(dtype, dt.StringType) else b""
+        for i, v in enumerate(out):
+            values[i] = empty if v is None else v
+        return EvalCol(values, validity, dtype)
+    values = np.zeros(n, dtype=dtype.np_dtype())
+    for i, v in enumerate(out):
+        if v is not None:
+            values[i] = v
+    return EvalCol(values, validity, dtype)
+
+
+class TpuArrowEvalPythonExec(TpuExec):
+    """Project whose expressions include Python UDFs.
+
+    Per batch: download the device table to host columns, **release the
+    device semaphore**, evaluate the projection (Python UDFs interpreted,
+    other expressions on the host engine), re-acquire, upload.
+    Reference: GpuArrowEvalPythonExec.scala:306-332,356-403.
+    """
+
+    def __init__(self, child: PhysicalPlan, exprs: Sequence[Expression],
+                 names: Sequence[str], min_bucket: int = 1024):
+        super().__init__()
+        self.child = child
+        self.children = (child,)
+        self.exprs = list(exprs)
+        self.names = list(names)
+        self.min_bucket = min_bucket
+        self.schema = Schema([Field(n, e.data_type, e.nullable)
+                              for n, e in zip(names, exprs)])
+
+    @property
+    def fusible(self) -> bool:
+        return False
+
+    def execute_columnar(self, pidx: int) -> Iterator[DeviceTable]:
+        sem = get_semaphore()
+        for batch in self.child_device_batches(pidx):
+            with self.metrics.timed(M.OP_TIME):
+                host = batch.to_host()
+            sem.release_if_held()
+            try:
+                out = host_eval_exprs(host, self.exprs, self.names)
+            finally:
+                sem.acquire_if_necessary()
+            self.metrics.add(M.NUM_OUTPUT_BATCHES, 1)
+            yield DeviceTable.from_host(out, min_bucket=self.min_bucket,
+                                        capacity=batch.capacity)
+
+    def node_desc(self):
+        udfs = [repr(e) for e in self.exprs
+                if _tree_has_python_udf(e)]
+        return f"udfs={udfs}"
+
+
+def _tree_has_python_udf(e: Expression) -> bool:
+    if isinstance(e, PythonUDF):
+        return True
+    return any(_tree_has_python_udf(c) for c in e.children)

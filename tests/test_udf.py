@@ -1,0 +1,324 @@
+"""UDF subsystem tests (reference analogues: udf-compiler OpcodeSuite.scala,
+udf-examples, GpuArrowEvalPythonExec integration tests)."""
+import math
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.expr.base import AttributeReference
+from spark_rapids_tpu.expr.functions import col, lit
+from spark_rapids_tpu.udf import (UdfCompileError, columnar_udf, compile_udf,
+                                  udf)
+from spark_rapids_tpu.udf.python_exec import PythonUDF, TpuArrowEvalPythonExec
+
+from harness import assert_tpu_cpu_equal, data_gen
+
+
+@pytest.fixture
+def df(session, rng):
+    t = data_gen(rng, 150, {
+        "a": "float64", "b": "float64", "i": "int32", "s": "string",
+    })
+    return session.create_dataframe(t)
+
+
+# ---------------------------------------------------------------------------
+# Tier 1: bytecode compiler (reference: udf-compiler OpcodeSuite)
+# ---------------------------------------------------------------------------
+def test_compile_arithmetic(df):
+    @udf(return_type=dt.DOUBLE)
+    def fma(x, y):
+        return x * 2.0 + y / 3.0
+
+    out = df.select(fma(col("a"), col("b")).alias("r"))
+    _assert_compiled(out)
+    assert_tpu_cpu_equal(out)
+
+
+def test_compile_branches(df):
+    @udf(return_type=dt.DOUBLE)
+    def tiered(x):
+        if x > 50.0:
+            return x * 0.8
+        elif x > 0.0:
+            return x * 0.9
+        else:
+            return 0.0
+
+    out = df.select(tiered(col("a")).alias("r"))
+    _assert_compiled(out)
+    assert_tpu_cpu_equal(out)
+
+
+def test_compile_ternary_and_bool(df):
+    @udf(return_type=dt.DOUBLE)
+    def sign(x):
+        return 1.0 if x >= 0 else -1.0
+
+    assert_tpu_cpu_equal(df.select(sign(col("b")).alias("r")))
+
+
+def test_compile_math_calls(df):
+    @udf(return_type=dt.DOUBLE)
+    def wave(x):
+        return math.sin(x) + math.sqrt(abs(x))
+
+    out = df.select(wave(col("a")).alias("r"))
+    _assert_compiled(out)
+    assert_tpu_cpu_equal(out, rel_tol=1e-6)
+
+
+def test_compile_clamp_min_max(df):
+    @udf(return_type=dt.DOUBLE)
+    def clamp(x):
+        return min(max(x, -10.0), 10.0)
+
+    assert_tpu_cpu_equal(df.select(clamp(col("b")).alias("r")))
+
+
+def test_compile_string_methods(session):
+    # ASCII-only input: device case mapping is ASCII-only by design
+    # (see the Upper/Lower ps_note in plan/overrides.py)
+    import pyarrow as pa
+    t = pa.table({"s": pa.array(["  spark  ", "RAPIDS", "tpu", "", None,
+                                 " Mixed Case "])})
+    df = session.create_dataframe(t)
+
+    @udf(return_type=dt.STRING)
+    def shout(s):
+        return s.upper().strip()
+
+    out = df.select(shout(col("s")).alias("r"))
+    _assert_compiled(out)
+    assert_tpu_cpu_equal(out)
+
+
+def test_compile_local_variables(df):
+    @udf(return_type=dt.DOUBLE)
+    def poly(x):
+        a = x * x
+        b = a + x
+        return b * 0.5
+
+    out = df.select(poly(col("a")).alias("r"))
+    _assert_compiled(out)
+    assert_tpu_cpu_equal(out)
+
+
+def test_compiler_rejects_loops():
+    def total(x):
+        out = 0.0
+        for _ in range(3):
+            out += x
+        return out
+
+    with pytest.raises(UdfCompileError):
+        compile_udf(total, [AttributeReference("a")], dt.DOUBLE)
+
+
+def test_compiler_rejects_unknown_calls():
+    table = {1: "x"}
+
+    def lookup(x):
+        return table.get(x)
+
+    with pytest.raises(UdfCompileError):
+        compile_udf(lookup, [AttributeReference("a")], dt.STRING)
+
+
+# ---------------------------------------------------------------------------
+# Tier 2: columnar (jax-traceable) UDFs — the RapidsUDF / udf-examples analogue
+# ---------------------------------------------------------------------------
+def test_columnar_udf_device(df):
+    @columnar_udf(dt.DOUBLE)
+    def rsq(x, y):
+        return x * x + y * y
+
+    assert_tpu_cpu_equal(df.select(rsq(col("a"), col("b")).alias("r")))
+
+
+def test_columnar_udf_cosine_similarity(session, rng):
+    # the udf-examples/src/main/cpp/src/cosine_similarity.cu analogue:
+    # a user batch kernel, expressed directly in jnp, fusing on device
+    import pyarrow as pa
+    n = 64
+    t = pa.table({
+        "x1": rng.normal(size=n), "y1": rng.normal(size=n),
+        "x2": rng.normal(size=n), "y2": rng.normal(size=n),
+    })
+
+    @columnar_udf(dt.DOUBLE, name="cosine2d")
+    def cos2d(x1, y1, x2, y2):
+        num = x1 * x2 + y1 * y2
+        den = ((x1 * x1 + y1 * y1) ** 0.5) * ((x2 * x2 + y2 * y2) ** 0.5)
+        return num / den
+
+    df = session.create_dataframe(t)
+    assert_tpu_cpu_equal(
+        df.select(cos2d(col("x1"), col("y1"), col("x2"), col("y2"))
+                  .alias("cos")), rel_tol=1e-6)
+
+
+def test_columnar_udf_device_ok_false_falls_back(df, session):
+    @columnar_udf(dt.DOUBLE, device_ok=False)
+    def hostonly(x):
+        return np.asarray(x) * 3.0
+
+    out = df.select(hostonly(col("a")).alias("r"))
+    plan = session._physical(out.logical, device=True)
+    # the project must have fallen back to the CPU engine
+    assert "CpuProjectExec" in _device_nodes(plan), plan.tree_string()
+    assert not any(type(n).__name__ == "TpuProjectExec" for n in _walk(plan))
+    assert_tpu_cpu_equal(out)
+
+
+# ---------------------------------------------------------------------------
+# Tier 3: interpreted Python / pandas UDFs through the Arrow eval operator
+# ---------------------------------------------------------------------------
+def test_python_udf_fallback_runs_arrow_exec(df, session):
+    lut = {0: 10.0, 1: 20.0}
+
+    @udf(return_type=dt.DOUBLE)
+    def opaque(i):
+        if i is None:
+            return None
+        return lut.get(int(i) % 2, 0.0)
+
+    out = df.select(opaque(col("i")).alias("r"))
+    assert _has_python_udf(out.logical.exprs[0])  # compiler bailed out
+    plan = session._physical(out.logical, device=True)
+    assert any(isinstance(n, TpuArrowEvalPythonExec) for n in _walk(plan)), \
+        plan.tree_string()
+    assert_tpu_cpu_equal(out)
+
+
+def test_pandas_udf(df):
+    @udf(return_type=dt.DOUBLE, kind="pandas", try_compile=False)
+    def zscoreish(s):
+        return (s - 1.0) * 2.0
+
+    assert_tpu_cpu_equal(df.select(zscoreish(col("a")).alias("r")))
+
+
+def test_python_udf_null_handling(session):
+    import pyarrow as pa
+    t = pa.table({"v": pa.array([1.0, None, 3.0, None, 5.0])})
+    df = session.create_dataframe(t)
+
+    @udf(return_type=dt.DOUBLE, try_compile=False)
+    def plus1(v):
+        return None if v is None else v + 1.0
+
+    assert_tpu_cpu_equal(df.select(plus1(col("v")).alias("r")))
+
+
+def test_udf_mixed_with_exprs(df):
+    @udf(return_type=dt.DOUBLE)
+    def halve(x):
+        return x / 2.0
+
+    assert_tpu_cpu_equal(
+        df.select((halve(col("a")) + col("b") * 2.0).alias("r"),
+                  col("i")))
+
+
+def test_udf_in_filter(df):
+    @udf(return_type=dt.BOOLEAN)
+    def positive(x):
+        return x > 0.0
+
+    assert_tpu_cpu_equal(df.filter(positive(col("a"))).select(col("a")))
+
+
+def test_interpreted_udf_in_filter_falls_back(df, session):
+    # non-compilable UDF in a filter condition: no Arrow bridge exists for
+    # filters, so the whole filter must fall back to the CPU engine instead
+    # of crashing inside a device computation
+    flip = {True: True, False: False}
+
+    @udf(return_type=dt.BOOLEAN)
+    def opaque_pred(x):
+        return x is not None and flip.get(x > 0.0, False)
+
+    out = df.filter(opaque_pred(col("a"))).select(col("a"))
+    plan = session._physical(out.logical, device=True)
+    assert any(type(n).__name__ == "CpuFilterExec" for n in _walk(plan)), \
+        plan.tree_string()
+    assert_tpu_cpu_equal(out)
+
+
+def test_udf_compiler_conf_disables_compilation(df, rng):
+    from spark_rapids_tpu.session import TpuSession
+    import pyarrow as pa
+    sess = TpuSession({
+        "spark.rapids.tpu.batchRowsMinBucket": 8,
+        "spark.rapids.tpu.sql.udfCompiler.enabled": False,
+    })
+    t = pa.table({"a": rng.normal(size=32)})
+    df2 = sess.create_dataframe(t)
+
+    @udf(return_type=dt.DOUBLE)
+    def double_it(x):
+        return x * 2.0
+
+    out = df2.select(double_it(col("a")).alias("r"))
+    plan = sess._physical(out.logical, device=True)
+    # session conf off -> stays interpreted through the Arrow bridge
+    assert any(isinstance(n, TpuArrowEvalPythonExec) for n in _walk(plan)), \
+        plan.tree_string()
+    assert_tpu_cpu_equal(out)
+
+
+def test_compiled_min_max_nan_matches_python(session):
+    import pyarrow as pa
+    t = pa.table({"v": pa.array([float("nan"), 1.0, -20.0, 20.0, 0.5])})
+    df = session.create_dataframe(t)
+
+    def clamp(x):
+        return min(max(x, -10.0), 10.0)
+
+    cudf = udf(clamp, return_type=dt.DOUBLE)
+    out = df.select(cudf(col("v")).alias("r"))
+    _assert_compiled(out)
+    got = {i: v for i, v in enumerate(out.collect(device=True)
+                                      .column("r").to_pylist())}
+    expect = [clamp(v) for v in [float("nan"), 1.0, -20.0, 20.0, 0.5]]
+    assert math.isnan(got[0]) == math.isnan(expect[0])  # NaN passes through
+    for i in (1, 2, 3, 4):
+        assert got[i] == expect[i]
+
+
+# ---------------------------------------------------------------------------
+def _assert_compiled(df_out):
+    """Assert the planner compiled every Python UDF (no Arrow bridge left)."""
+    plan = df_out.session._physical(df_out.logical, device=True)
+    assert not any(isinstance(n, TpuArrowEvalPythonExec) for n in _walk(plan)), \
+        plan.tree_string()
+
+
+def _has_python_udf(e):
+    if isinstance(e, PythonUDF):
+        return True
+    return any(_has_python_udf(c) for c in e.children)
+
+
+def _walk(plan):
+    yield plan
+    for c in plan.children:
+        yield from _walk(c)
+
+
+def _device_nodes(plan):
+    names = set()
+    for n in _walk(plan):
+        names.add(type(n).__name__)
+        for e in getattr(n, "exprs", []):
+            _expr_names(e, names)
+    return names
+
+
+def _expr_names(e, out):
+    out.add(type(e).__name__)
+    for c in e.children:
+        _expr_names(c, out)
