@@ -30,7 +30,7 @@ def main():
         "spark.rapids.tpu.batchRowsMinBucket": 1 << 20,
     })
     df = sess.create_dataframe(lineitem, num_partitions=1).cache()
-    q = tpch.q6(df)
+    q = tpch.q6({"lineitem": df})
 
     # warm-up (XLA compile) then timed best-of-3
     q.collect(device=True)

@@ -174,7 +174,10 @@ class LogicalJoin(LogicalPlan):
         self.on = list(on) if on else None
         self.condition = None
         if condition is not None:
-            merged = _join_schema(left.schema, right.schema, self.on, how)
+            # semi/anti output only the left side, but the condition still sees
+            # both sides' columns — resolve it against the inner-join schema
+            cond_how = "inner" if how in ("left_semi", "left_anti") else how
+            merged = _join_schema(left.schema, right.schema, self.on, cond_how)
             self.condition = resolve_expression(
                 condition, merged.to_dict(), merged.nullable_dict())
 

@@ -86,6 +86,13 @@ class TpuSession:
                   device: Optional[bool] = None) -> PhysicalPlan:
         cpu = plan_physical(logical, self.conf)
         use_device = self.conf.is_sql_enabled if device is None else device
+        if self.conf.is_explain_only:
+            # reference: spark.rapids.sql.mode=explainOnly (RapidsConf.scala:515)
+            # — tag & report what would run on device, execute on the host
+            # engine only (ExplainPlan.explainPotentialGpuPlan)
+            if self.conf.explain != "NONE":
+                print(explain_plan(cpu, self.conf))
+            use_device = False
         if not use_device:
             # UDF compilation is engine-independent (the compiled expression
             # tree also runs on the host engine) — apply it here too so the
@@ -207,6 +214,14 @@ class DataFrame:
 
     def limit(self, n: int) -> "DataFrame":
         return DataFrame(self.session, LogicalLimit(self.logical, n))
+
+    def distinct(self) -> "DataFrame":
+        """Row dedup = zero-aggregate group-by over all columns (the planner
+        lowers it to the grouped-aggregate exec's key dedup)."""
+        return DataFrame(self.session,
+                         LogicalAggregate(self.logical,
+                                          [self._col_expr(n) for n in self.columns],
+                                          []))
 
     def union(self, other: "DataFrame") -> "DataFrame":
         return DataFrame(self.session, LogicalUnion([self.logical, other.logical]))

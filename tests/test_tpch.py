@@ -29,7 +29,7 @@ def customer():
 
 def test_q6(session, lineitem):
     df = session.create_dataframe(lineitem, num_partitions=2)
-    out = assert_tpu_cpu_equal(tpch.q6(df), rel_tol=1e-9)
+    out = assert_tpu_cpu_equal(tpch.q6({"lineitem": df}), rel_tol=1e-9)
     # independent pandas check
     pdf = lineitem.to_pandas()
     import pyarrow as pa
@@ -44,7 +44,7 @@ def test_q6(session, lineitem):
 
 def test_q1(session, lineitem):
     df = session.create_dataframe(lineitem, num_partitions=2)
-    out = assert_tpu_cpu_equal(tpch.q1(df), ignore_order=False, rel_tol=1e-9)
+    out = assert_tpu_cpu_equal(tpch.q1({"lineitem": df}), ignore_order=False, rel_tol=1e-9)
     pdf = lineitem.to_pandas()
     import pyarrow as pa
     sd = pd.Series(lineitem.column("l_shipdate").combine_chunks().cast(pa.int32()).to_numpy())
@@ -61,7 +61,7 @@ def test_q3(session, lineitem, orders, customer):
     li = session.create_dataframe(lineitem, num_partitions=2)
     od = session.create_dataframe(orders, num_partitions=2)
     cu = session.create_dataframe(customer)
-    out = tpch.q3(li, od, cu)
+    out = tpch.q3({"lineitem": li, "orders": od, "customer": cu})
     device = out.collect(device=True)
     cpu = out.collect(device=False)
     # top-10 by revenue with ties: compare the revenue column
