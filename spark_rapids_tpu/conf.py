@@ -56,6 +56,9 @@ class ConfEntry:
             err = self.checker(v)
             if err:
                 raise ValueError(f"{self.key}: {err}")
+            normalize = getattr(self.checker, "normalize", None)
+            if normalize is not None and isinstance(v, str):
+                v = normalize(v)
         return v
 
 
@@ -81,8 +84,16 @@ def _positive(what: str):
 
 
 def _in(*allowed: str):
+    ci = all(a == a.lower() for a in allowed)
+
     def check(v):
-        return None if v in allowed else f"must be one of {allowed}, got {v!r}"
+        norm = v.lower() if ci and isinstance(v, str) else v
+        return None if norm in allowed \
+            else f"must be one of {allowed}, got {v!r}"
+    if ci:
+        # convert() applies this so the STORED value is normalized too —
+        # consumers can compare case-sensitively
+        check.normalize = str.lower
     return check
 
 
@@ -167,8 +178,9 @@ SHUFFLE_TRANSPORT_CLASS = register_conf(
 
 SHUFFLE_COMPRESSION_CODEC = register_conf(
     "spark.rapids.shuffle.compression.codec",
-    "Codec for shuffle payloads: none or lz4-style host codec.",
-    "none", checker=_in("none", "zstd", "lz4"))
+    "Codec for shuffle payloads: lz4 (native C++ block codec, reference "
+    "nvcomp LZ4), zlib, or none.",
+    "none", checker=_in("none", "zlib", "zstd", "lz4"))
 
 TEST_ENABLED = register_conf(
     "spark.rapids.sql.test.enabled",
@@ -293,10 +305,42 @@ class RapidsConf:
     @staticmethod
     def help_markdown() -> str:
         """Generate configs documentation (reference: RapidsConf.help -> docs/configs.md)."""
-        lines = ["# spark-rapids-tpu configs", "",
+        lines = ["<!-- Generated by RapidsConf.help_markdown() — DO NOT EDIT. "
+                 "Regenerate: python -m spark_rapids_tpu.conf -->",
+                 "# spark-rapids-tpu configs", "",
+                 "Set keys via `TpuSession({...})`, `session.set_conf(k, v)`, "
+                 "or the `SPARK_RAPIDS_TPU_CONF_<key with dots as __>` "
+                 "environment override.", "",
                  "| key | default | description |", "|---|---|---|"]
         for e in conf_entries():
             if e.internal:
                 continue
-            lines.append(f"| `{e.key}` | `{e.default}` | {e.doc} |")
+            doc = " ".join(str(e.doc).split())
+            lines.append(f"| `{e.key}` | `{e.default}` | {doc} |")
         return "\n".join(lines) + "\n"
+
+
+def _write_docs(path: Optional[str] = None) -> str:
+    """python -m spark_rapids_tpu.conf [outfile] regenerates docs/configs.md
+    the way the reference wires RapidsConf.help() into its build."""
+    import importlib
+    # import the packages that register confs so the doc is complete
+    for mod in ("spark_rapids_tpu.session", "spark_rapids_tpu.memory.catalog",
+                "spark_rapids_tpu.shuffle.manager", "spark_rapids_tpu.udf",
+                "spark_rapids_tpu.io.parquet"):
+        try:
+            importlib.import_module(mod)
+        except Exception:
+            pass
+    if path is None:
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "docs", "configs.md")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        f.write(RapidsConf.help_markdown())
+    return path
+
+
+if __name__ == "__main__":  # pragma: no cover
+    import sys
+    print(_write_docs(sys.argv[1] if len(sys.argv) > 1 else None))

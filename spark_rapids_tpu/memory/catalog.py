@@ -10,13 +10,13 @@ Reference mapping (SURVEY §2.2):
 """
 from __future__ import annotations
 
-import heapq
 import itertools
 import threading
 from typing import Dict, List, Optional
 
 import jax
 
+from .. import native
 from ..columnar.device import DeviceTable
 from ..conf import RapidsConf, register_conf
 from .stores import (DeviceStore, DiskStore, HostStore, StorageTier,
@@ -60,6 +60,11 @@ class BufferCatalog:
         self.host = HostStore(host_limit)
         self.disk = DiskStore(disk_dir)
         self._buffers: Dict[int, StoredTable] = {}
+        # persistent device-tier spill queue (reference: RapidsBufferStore's
+        # HashedPriorityQueue — O(log n) membership updates instead of
+        # rebuilding a heap per spill pass); native C++ when built
+        self._spill_pq = native.HashedPriorityQueue()
+        self._pq_handles: Dict[int, int] = {}  # buffer_id -> pq handle
         self._ids = itertools.count()
         self._lock = threading.RLock()
         self._oom_spill = conf.get(OOM_SPILL_ENABLED)
@@ -79,6 +84,7 @@ class BufferCatalog:
             stored = StoredTable(bid, table, priority, nbytes)
             self._buffers[bid] = stored
             self.device.used_bytes += nbytes
+            self._pq_handles[bid] = self._spill_pq.push(priority, bid)
         return SpillableDeviceTable(self, bid)
 
     # -- spill machinery ------------------------------------------------------
@@ -87,17 +93,32 @@ class BufferCatalog:
         (reference: RapidsBufferStore.synchronousSpill)."""
         freed = 0
         with self._lock:
-            candidates = [(s.priority, s.buffer_id) for s in
-                          self._buffers.values()
-                          if s.tier == StorageTier.DEVICE and s.refcount == 0]
-            heapq.heapify(candidates)
-            while candidates and freed < target_bytes:
-                _, bid = heapq.heappop(candidates)
-                stored = self._buffers.get(bid)
-                if stored is None or stored.tier != StorageTier.DEVICE:
-                    continue
-                self._spill_one(stored)
-                freed += stored.size_bytes
+            pinned = []  # (priority, bid) popped but in use; re-pushed after
+            try:
+                while freed < target_bytes:
+                    entry = self._spill_pq.pop()
+                    if entry is None:
+                        break
+                    priority, bid = entry
+                    stored = self._buffers.get(bid)
+                    if stored is None or stored.tier != StorageTier.DEVICE:
+                        self._pq_handles.pop(bid, None)
+                        continue
+                    if stored.refcount > 0:
+                        pinned.append((priority, bid))
+                        continue
+                    self._pq_handles.pop(bid, None)
+                    try:
+                        self._spill_one(stored)
+                    except Exception:
+                        # spill target failed (e.g. disk full): keep the
+                        # buffer spillable for a later pass
+                        pinned.append((priority, bid))
+                        raise
+                    freed += stored.size_bytes
+            finally:
+                for priority, bid in pinned:
+                    self._pq_handles[bid] = self._spill_pq.push(priority, bid)
         return freed
 
     def _spill_one(self, stored: StoredTable):
@@ -156,6 +177,8 @@ class BufferCatalog:
                 stored.device_table = table
                 stored.tier = StorageTier.DEVICE
                 self.device.used_bytes += stored.size_bytes
+                self._pq_handles[stored.buffer_id] = \
+                    self._spill_pq.push(stored.priority, stored.buffer_id)
             return stored.device_table
 
     def release(self, buffer_id: int):
@@ -170,6 +193,9 @@ class BufferCatalog:
             if stored is None:
                 return
             stored.closed = True
+            handle = self._pq_handles.pop(buffer_id, None)
+            if handle is not None:
+                self._spill_pq.remove(handle)
             if stored.tier == StorageTier.DEVICE:
                 self.device.used_bytes -= stored.size_bytes
             elif stored.tier == StorageTier.HOST:

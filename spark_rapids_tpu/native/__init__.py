@@ -1,0 +1,386 @@
+"""ctypes loader for the native runtime library (srtpu_native.cpp).
+
+The reference consumes its native layer through JNI jars (SURVEY.md §2.9);
+here the C++ is built on demand with g++ into a cached .so and reached via
+ctypes (no pybind11 in the image). Every entry point has a pure-Python
+fallback so the framework works without a compiler; ``available()`` reports
+which path is active (used by tests and the shuffle codec chooser).
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["available", "get_lib", "lz4_compress", "lz4_decompress",
+           "xxhash64", "murmur3_columns", "hash_partition",
+           "HashedPriorityQueue", "HostArena"]
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "srtpu_native.cpp")
+_LOCK = threading.Lock()
+_LIB: "Optional[ctypes.CDLL]" = None
+_TRIED = False
+
+
+def _build() -> Optional[str]:
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    so = os.path.join(_HERE, f"_srtpu_native_{digest}.so")
+    if os.path.exists(so):
+        return so
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", so + ".tmp",
+           _SRC]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    os.replace(so + ".tmp", so)
+    return so
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if _LIB is not None or _TRIED:
+        return _LIB
+    with _LOCK:
+        if _LIB is not None or _TRIED:
+            return _LIB
+        _TRIED = True
+        if os.environ.get("SRTPU_DISABLE_NATIVE"):
+            return None
+        so = _build()
+        if so is None:
+            return None
+        lib = ctypes.CDLL(so)
+        c = ctypes
+        u8p, i32p = c.POINTER(c.c_uint8), c.POINTER(c.c_int32)
+        i64p, u32p = c.POINTER(c.c_int64), c.POINTER(c.c_uint32)
+        u64p = c.POINTER(c.c_uint64)
+        sigs = {
+            "srtpu_lz4_compress_bound": (c.c_int64, [c.c_int64]),
+            "srtpu_lz4_compress": (c.c_int64, [u8p, c.c_int64, u8p, c.c_int64]),
+            "srtpu_lz4_decompress": (c.c_int64, [u8p, c.c_int64, u8p, c.c_int64]),
+            "srtpu_xxhash64_buffer": (c.c_uint64, [u8p, c.c_int64, c.c_uint64]),
+            "srtpu_xxhash64_records": (None, [u8p, i32p, c.c_int64, c.c_uint64,
+                                              u64p]),
+            "srtpu_murmur3_int": (None, [i32p, c.c_int64, u32p]),
+            "srtpu_murmur3_long": (None, [i64p, c.c_int64, u32p]),
+            "srtpu_murmur3_double": (None, [c.POINTER(c.c_double), c.c_int64,
+                                            u32p]),
+            "srtpu_murmur3_bytes": (None, [u8p, i32p, c.c_int64, u32p]),
+            "srtpu_hash_partition": (None, [u32p, c.c_int64, c.c_int32, i32p,
+                                            i64p, i64p]),
+            "srtpu_pq_create": (c.c_void_p, []),
+            "srtpu_pq_destroy": (None, [c.c_void_p]),
+            "srtpu_pq_push": (c.c_int64, [c.c_void_p, c.c_int64, c.c_int64]),
+            "srtpu_pq_update": (c.c_int, [c.c_void_p, c.c_int64, c.c_int64]),
+            "srtpu_pq_remove": (c.c_int, [c.c_void_p, c.c_int64]),
+            "srtpu_pq_pop": (c.c_int, [c.c_void_p, i64p, i64p]),
+            "srtpu_pq_size": (c.c_int64, [c.c_void_p]),
+            "srtpu_arena_create": (c.c_void_p, [c.c_int64]),
+            "srtpu_arena_destroy": (None, [c.c_void_p]),
+            "srtpu_arena_alloc": (c.c_int64, [c.c_void_p, c.c_int64]),
+            "srtpu_arena_free": (c.c_int, [c.c_void_p, c.c_int64]),
+            "srtpu_arena_used": (c.c_int64, [c.c_void_p]),
+            "srtpu_arena_capacity": (c.c_int64, [c.c_void_p]),
+            "srtpu_arena_base": (c.c_void_p, [c.c_void_p]),
+        }
+        for name, (res, args) in sigs.items():
+            fn = getattr(lib, name)
+            fn.restype = res
+            fn.argtypes = args
+        _LIB = lib
+        return _LIB
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def _u8(buf) -> "ctypes.Array":
+    return (ctypes.c_uint8 * len(buf)).from_buffer_copy(buf)
+
+
+def _np_ptr(arr: np.ndarray, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+# ---------------------------------------------------------------------------
+# LZ4
+# ---------------------------------------------------------------------------
+
+def lz4_compress(data: bytes) -> bytes:
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    n = len(data)
+    bound = lib.srtpu_lz4_compress_bound(n)
+    out = (ctypes.c_uint8 * bound)()
+    src = _u8(data)
+    written = lib.srtpu_lz4_compress(src, n, out, bound)
+    if written < 0:
+        raise RuntimeError("lz4 compression failed")
+    return bytes(out[:written])
+
+
+def lz4_decompress(data: bytes, uncompressed_size: int) -> bytes:
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    out = (ctypes.c_uint8 * uncompressed_size)()
+    src = _u8(data)
+    got = lib.srtpu_lz4_decompress(src, len(data), out, uncompressed_size)
+    if got != uncompressed_size:
+        raise RuntimeError(f"lz4 decompression: {got} != {uncompressed_size}")
+    return bytes(out)
+
+
+# ---------------------------------------------------------------------------
+# Hashing
+# ---------------------------------------------------------------------------
+
+def xxhash64(data: bytes, seed: int = 0) -> int:
+    lib = get_lib()
+    if lib is None:
+        # fallback: not bit-compatible, only used for checksums
+        import zlib
+        return zlib.crc32(data) ^ (seed & 0xFFFFFFFF)
+    return int(lib.srtpu_xxhash64_buffer(_u8(data), len(data), seed))
+
+
+def murmur3_columns(columns, seed: int = 42) -> np.ndarray:
+    """Spark-style chained murmur3_x86_32 over host numpy columns.
+
+    ``columns`` is a list of (values, validity_or_None) with values either a
+    fixed-width numpy array or an object array of strings. Null values leave
+    the running hash unchanged (Spark semantics).
+    """
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native library unavailable; callers must check "
+                           "available() (the host engine has its own "
+                           "murmur3 in expr/hashing.py)")
+    n = len(columns[0][0]) if columns else 0
+    h = np.full(n, seed, dtype=np.uint32)
+    for values, validity in columns:
+        if validity is not None and not validity.all():
+            keep = h.copy()
+        else:
+            keep = None
+        if values.dtype.kind in "biu" and values.dtype not in (np.int32,
+                                                               np.int64):
+            values = values.astype(np.int32)  # Spark widens narrow ints
+        elif values.dtype == np.float32:
+            values = values.astype(np.float64)
+        if values.dtype == object:
+            encoded = [v.encode("utf-8") if isinstance(v, str) else b""
+                       for v in values]
+            offsets = np.zeros(n + 1, dtype=np.int32)
+            lens = np.fromiter((len(b) for b in encoded), dtype=np.int32,
+                               count=n)
+            np.cumsum(lens, out=offsets[1:])
+            blob = b"".join(encoded)
+            lib.srtpu_murmur3_bytes(_u8(blob), _np_ptr(offsets, ctypes.c_int32),
+                                    n, _np_ptr(h, ctypes.c_uint32))
+        elif values.dtype == np.int32:
+            v = np.ascontiguousarray(values)
+            lib.srtpu_murmur3_int(_np_ptr(v, ctypes.c_int32), n,
+                                  _np_ptr(h, ctypes.c_uint32))
+        elif values.dtype == np.int64:
+            v = np.ascontiguousarray(values)
+            lib.srtpu_murmur3_long(_np_ptr(v, ctypes.c_int64), n,
+                                   _np_ptr(h, ctypes.c_uint32))
+        elif values.dtype == np.float64:
+            v = np.ascontiguousarray(values)
+            lib.srtpu_murmur3_double(_np_ptr(v, ctypes.c_double), n,
+                                     _np_ptr(h, ctypes.c_uint32))
+        else:
+            raise TypeError(f"unhashable column dtype {values.dtype}")
+        if keep is not None:
+            h = np.where(validity, h, keep)
+    return h
+
+
+def hash_partition(hashes: np.ndarray, num_partitions: int):
+    """-> (pids, counts, order): stable grouped row order (one gather =
+    contiguous partitions; reference GpuPartitioning/contiguous_split)."""
+    h = np.ascontiguousarray(hashes, dtype=np.uint32)
+    n = len(h)
+    lib = get_lib()
+    if lib is None:
+        pids = (h.view(np.int32) % num_partitions).astype(np.int32)
+        pids[pids < 0] += num_partitions
+        order = np.argsort(pids, kind="stable").astype(np.int64)
+        counts = np.bincount(pids, minlength=num_partitions).astype(np.int64)
+        return pids, counts, order
+    pids = np.empty(n, dtype=np.int32)
+    counts = np.empty(num_partitions, dtype=np.int64)
+    order = np.empty(n, dtype=np.int64)
+    lib.srtpu_hash_partition(_np_ptr(h, ctypes.c_uint32), n, num_partitions,
+                             _np_ptr(pids, ctypes.c_int32),
+                             _np_ptr(counts, ctypes.c_int64),
+                             _np_ptr(order, ctypes.c_int64))
+    return pids, counts, order
+
+
+# ---------------------------------------------------------------------------
+# Hashed priority queue (native when possible; heapq fallback)
+# ---------------------------------------------------------------------------
+
+class HashedPriorityQueue:
+    """Pop-lowest-priority queue with O(log n) update-by-handle
+    (reference: sql-plugin HashedPriorityQueue.java used by the spill
+    stores' priority tracking)."""
+
+    def __init__(self):
+        self._lib = get_lib()
+        if self._lib is not None:
+            self._q = self._lib.srtpu_pq_create()
+        else:
+            import heapq  # noqa: F401
+            self._heap = []  # (priority, handle)
+            self._entries = {}  # handle -> priority (None = removed)
+            self._next = 1
+
+    def push(self, priority: int, payload: int = 0) -> int:
+        if self._lib is not None:
+            return int(self._lib.srtpu_pq_push(self._q, priority, payload))
+        import heapq
+        h = self._next
+        self._next += 1
+        self._entries[h] = (priority, payload)
+        heapq.heappush(self._heap, (priority, h))
+        return h
+
+    def update(self, handle: int, priority: int) -> bool:
+        if self._lib is not None:
+            return bool(self._lib.srtpu_pq_update(self._q, handle, priority))
+        import heapq
+        if handle not in self._entries:
+            return False
+        payload = self._entries[handle][1]
+        self._entries[handle] = (priority, payload)
+        heapq.heappush(self._heap, (priority, handle))
+        return True
+
+    def remove(self, handle: int) -> bool:
+        if self._lib is not None:
+            return bool(self._lib.srtpu_pq_remove(self._q, handle))
+        return self._entries.pop(handle, None) is not None
+
+    def pop(self):
+        """-> (priority, payload) of the lowest-priority entry, or None."""
+        if self._lib is not None:
+            payload = ctypes.c_int64()
+            priority = ctypes.c_int64()
+            if self._lib.srtpu_pq_pop(self._q, ctypes.byref(payload),
+                                      ctypes.byref(priority)):
+                return int(priority.value), int(payload.value)
+            return None
+        import heapq
+        while self._heap:
+            priority, h = heapq.heappop(self._heap)
+            entry = self._entries.get(h)
+            if entry is not None and entry[0] == priority:
+                del self._entries[h]
+                return priority, entry[1]
+        return None
+
+    def __len__(self) -> int:
+        if self._lib is not None:
+            return int(self._lib.srtpu_pq_size(self._q))
+        return len(self._entries)
+
+    def __del__(self):
+        if getattr(self, "_lib", None) is not None and self._q:
+            self._lib.srtpu_pq_destroy(self._q)
+            self._q = None
+
+
+# ---------------------------------------------------------------------------
+# Host arena (spill staging pool)
+# ---------------------------------------------------------------------------
+
+class HostArena:
+    """Offset-based first-fit host arena with coalescing free (reference:
+    RMM ARENA / AddressSpaceAllocator.scala). ``alloc`` returns an offset or
+    None when full — the caller runs the spill path and retries (the
+    DeviceMemoryEventHandler pattern)."""
+
+    def __init__(self, capacity: int):
+        self._lib = get_lib()
+        self.capacity = capacity
+        if self._lib is not None:
+            self._a = self._lib.srtpu_arena_create(capacity)
+            if not self._a:
+                raise MemoryError(f"arena of {capacity} bytes")
+        else:
+            self._free = [(0, (capacity + 63) // 64 * 64)]
+            self._allocs = {}
+            self._used = 0
+            self._buf = bytearray((capacity + 63) // 64 * 64)
+
+    def alloc(self, size: int):
+        if self._lib is not None:
+            off = self._lib.srtpu_arena_alloc(self._a, size)
+            return None if off < 0 else int(off)
+        size = max((size + 63) // 64 * 64, 64)
+        for i, (off, blk) in enumerate(self._free):
+            if blk >= size:
+                rest = blk - size
+                if rest:
+                    self._free[i] = (off + size, rest)
+                else:
+                    del self._free[i]
+                self._allocs[off] = size
+                self._used += size
+                return off
+        return None
+
+    def free(self, offset: int) -> bool:
+        if self._lib is not None:
+            return bool(self._lib.srtpu_arena_free(self._a, offset))
+        size = self._allocs.pop(offset, None)
+        if size is None:
+            return False
+        self._used -= size
+        self._free.append((offset, size))
+        self._free.sort()
+        merged = []
+        for off, blk in self._free:
+            if merged and merged[-1][0] + merged[-1][1] == off:
+                merged[-1] = (merged[-1][0], merged[-1][1] + blk)
+            else:
+                merged.append((off, blk))
+        self._free = merged
+        return True
+
+    @property
+    def used(self) -> int:
+        if self._lib is not None:
+            return int(self._lib.srtpu_arena_used(self._a))
+        return self._used
+
+    def write(self, offset: int, data: bytes):
+        if self._lib is not None:
+            base = self._lib.srtpu_arena_base(self._a)
+            ctypes.memmove(base + offset, data, len(data))
+        else:
+            self._buf[offset:offset + len(data)] = data
+
+    def read(self, offset: int, size: int) -> bytes:
+        if self._lib is not None:
+            base = self._lib.srtpu_arena_base(self._a)
+            return ctypes.string_at(base + offset, size)
+        return bytes(self._buf[offset:offset + size])
+
+    def __del__(self):
+        if getattr(self, "_lib", None) is not None and getattr(self, "_a", None):
+            self._lib.srtpu_arena_destroy(self._a)
+            self._a = None

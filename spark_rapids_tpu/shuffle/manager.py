@@ -109,9 +109,12 @@ class ShuffleManager:
                  transport: Optional[ShuffleTransport] = None):
         self.conf = conf or RapidsConf()
         self.transport = transport or load_transport(self.conf)
+        from .serializer import default_codec
         self.codec = self.conf.get(SHUFFLE_COMPRESSION_CODEC)
         if self.codec not in ("none", "zlib"):
-            self.codec = "zlib" if self.codec in ("zstd", "lz4") else "none"
+            # lz4 needs the native library; zstd isn't shipped — both degrade
+            # to the best available codec
+            self.codec = default_codec()
         self._ids = itertools.count()
         self.heartbeats = HeartbeatManager()
 

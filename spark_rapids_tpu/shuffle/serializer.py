@@ -26,10 +26,17 @@ from ..columnar.host import HostColumn, HostTable
 __all__ = ["serialize_table", "deserialize_table", "CODECS"]
 
 _MAGIC = b"SRTT"
-_VERSION = 1
+_VERSION = 2  # v2: codec set grew (+lz4), frame carries uncompressed length
 
-CODECS = {"none": 0, "zlib": 1}
+CODECS = {"none": 0, "zlib": 1, "lz4": 2}
 _CODEC_BY_ID = {v: k for k, v in CODECS.items()}
+
+
+def default_codec() -> str:
+    """lz4 via the native library when built (reference: nvcomp LZ4 is the
+    default shuffle codec, RapidsConf.scala:1156-1168); zlib otherwise."""
+    from .. import native
+    return "lz4" if native.available() else "zlib"
 
 
 def _dtype_tag(d: dt.DataType) -> str:
@@ -81,11 +88,15 @@ def serialize_table(table: HostTable, codec: str = "none") -> bytes:
         header["cols"].append(entry)
     hj = json.dumps(header).encode()
     body = struct.pack("<I", len(hj)) + hj + b"".join(payloads)
+    raw_len = len(body)
     if codec == "zlib":
         body = zlib.compress(body, level=1)
+    elif codec == "lz4":
+        from .. import native
+        body = native.lz4_compress(body)
     buf.write(_MAGIC)
     buf.write(struct.pack("<II", _VERSION, CODECS[codec]))
-    buf.write(struct.pack("<Q", len(body)))
+    buf.write(struct.pack("<QQ", len(body), raw_len))
     buf.write(body)
     return buf.getvalue()
 
@@ -94,10 +105,14 @@ def deserialize_table(data: bytes) -> HostTable:
     assert data[:4] == _MAGIC, "bad magic"
     version, codec_id = struct.unpack_from("<II", data, 4)
     assert version == _VERSION, version
-    (length,) = struct.unpack_from("<Q", data, 12)
-    body = data[20:20 + length]
-    if _CODEC_BY_ID[codec_id] == "zlib":
+    length, raw_len = struct.unpack_from("<QQ", data, 12)
+    body = data[28:28 + length]
+    codec = _CODEC_BY_ID[codec_id]
+    if codec == "zlib":
         body = zlib.decompress(body)
+    elif codec == "lz4":
+        from .. import native
+        body = native.lz4_decompress(body, raw_len)
     (hlen,) = struct.unpack_from("<I", body, 0)
     header = json.loads(body[4:4 + hlen])
     pos = 4 + hlen

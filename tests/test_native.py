@@ -1,0 +1,206 @@
+"""Native C++ runtime library tests: LZ4 round trips, hash kernels vs the
+device-path implementations (host and device murmur3 must agree bit-for-bit
+— they feed the same shuffle partitioning), priority queue, arena allocator.
+"""
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import native
+
+
+requires_native = pytest.mark.skipif(not native.available(),
+                                     reason="g++ unavailable")
+
+
+@requires_native
+def test_lz4_roundtrip_compressible():
+    data = (b"the quick brown fox jumps over the lazy dog; " * 4096)
+    comp = native.lz4_compress(data)
+    assert len(comp) < len(data) // 10
+    assert native.lz4_decompress(comp, len(data)) == data
+
+
+@requires_native
+def test_lz4_roundtrip_random():
+    rng = np.random.default_rng(1)
+    for n in (0, 1, 5, 12, 13, 64, 1000, 65_536, 1 << 20):
+        data = rng.bytes(n)
+        comp = native.lz4_compress(data)
+        assert native.lz4_decompress(comp, n) == data
+
+
+@requires_native
+def test_lz4_roundtrip_patterns():
+    for data in (b"", b"a", b"ab" * 10_000, b"abcabcabcabc" * 1000,
+                 bytes(range(256)) * 256,
+                 b"x" * 70_000):  # long literal/match extension paths
+        comp = native.lz4_compress(data)
+        assert native.lz4_decompress(comp, len(data)) == data
+
+
+@requires_native
+def test_xxhash64_known_vectors():
+    # Public xxh64 test vectors (seed 0 / prime seed)
+    assert native.xxhash64(b"") == 0xEF46DB3751D8E999
+    assert native.xxhash64(b"a") == 0xD24EC4F1A98C6E5B
+    assert native.xxhash64(b"abc") == 0x44BC2CF5AD770999
+    assert native.xxhash64(b"Hello, world!", seed=0) \
+        == native.xxhash64(b"Hello, world!", seed=0)
+    assert native.xxhash64(b"abc", 1) != native.xxhash64(b"abc", 2)
+
+
+@requires_native
+def test_murmur3_matches_device_path():
+    """Native murmur3 must agree with the JAX/host implementation used by the
+    device engine (they feed the same shuffle bucket choice)."""
+    from spark_rapids_tpu.expr.base import AttributeReference, EvalContext
+    from spark_rapids_tpu.expr.hashing import Murmur3Hash
+    from spark_rapids_tpu.columnar import dtypes as dt
+    from spark_rapids_tpu.columnar.host import HostColumn, HostTable
+
+    rng = np.random.default_rng(2)
+    n = 500
+    longs = rng.integers(-1 << 40, 1 << 40, n)
+    ints = rng.integers(-1 << 30, 1 << 30, n).astype(np.int32)
+    dbls = rng.normal(size=n)
+    dbls[::17] = 0.0
+    dbls[::23] = -0.0
+    strs = np.array([f"row-{i}-{'x' * (i % 9)}" for i in range(n)],
+                    dtype=object)
+
+    table = HostTable(
+        ["l", "i", "d", "s"],
+        [HostColumn(dt.LONG, longs), HostColumn(dt.INT, ints),
+         HostColumn(dt.DOUBLE, dbls), HostColumn(dt.STRING, strs)])
+    expr = Murmur3Hash(AttributeReference("l", dt.LONG),
+                       AttributeReference("i", dt.INT),
+                       AttributeReference("d", dt.DOUBLE),
+                       AttributeReference("s", dt.STRING))
+    host = expr.eval(EvalContext.for_host(table)).values.astype(np.uint32)
+
+    nat = native.murmur3_columns(
+        [(longs, None), (ints, None), (dbls, None), (strs, None)], seed=42)
+    np.testing.assert_array_equal(nat, host)
+
+
+@requires_native
+def test_murmur3_null_chaining():
+    longs = np.array([1, 2, 3, 4], dtype=np.int64)
+    validity = np.array([True, False, True, False])
+    ints = np.array([9, 9, 9, 9], dtype=np.int32)
+    h = native.murmur3_columns([(longs, validity), (ints, None)])
+    # null rows skip the first column: row1 == hash(seed->9), row3 same
+    h_ref = native.murmur3_columns([(ints[:1], None)])
+    assert h[1] == h[3] == h_ref[0]
+    assert h[0] != h[1]
+
+
+def test_hash_partition_stable_grouping():
+    rng = np.random.default_rng(3)
+    hashes = rng.integers(0, 1 << 32, 10_000, dtype=np.uint64).astype(np.uint32)
+    pids, counts, order = native.hash_partition(hashes, 7)
+    assert counts.sum() == len(hashes)
+    # signed mod matches Spark's Pmod(hash, p) on int32
+    expected_pids = (hashes.view(np.int32).astype(np.int64) % 7 + 7) % 7
+    np.testing.assert_array_equal(pids, expected_pids.astype(np.int32))
+    # order is stable within partitions and contiguous by partition
+    sorted_pids = pids[order]
+    assert (np.diff(sorted_pids) >= 0).all()
+    for p in range(7):
+        rows = order[sorted_pids == p]
+        assert (np.diff(rows) > 0).all()  # stability
+
+
+def test_priority_queue():
+    q = native.HashedPriorityQueue()
+    h1 = q.push(50, 100)
+    h2 = q.push(10, 200)
+    h3 = q.push(30, 300)
+    assert len(q) == 3
+    assert q.pop() == (10, 200)
+    assert q.update(h1, 5)
+    assert q.pop() == (5, 100)
+    assert not q.update(h2, 1)  # already popped
+    assert q.remove(h3)
+    assert q.pop() is None
+    assert len(q) == 0
+
+
+def test_priority_queue_tie_order():
+    q = native.HashedPriorityQueue()
+    q.push(7, 1)
+    q.push(7, 2)
+    q.push(7, 3)
+    assert [q.pop()[1] for _ in range(3)] == [1, 2, 3]
+
+
+def test_arena_alloc_free_coalesce():
+    a = native.HostArena(1 << 16)
+    offs = [a.alloc(1000) for _ in range(30)]
+    assert all(o is not None for o in offs)
+    used_before = a.used
+    assert used_before >= 30 * 1000
+    for o in offs[::2]:
+        assert a.free(o)
+    # freed alternating blocks can't satisfy a large alloc (fragmented)...
+    big = a.alloc(30_000)
+    # ...but freeing the rest coalesces everything
+    for o in offs[1::2]:
+        assert a.free(o)
+    if big is not None:
+        a.free(big)
+    assert a.used == 0
+    assert a.alloc(60_000) is not None
+
+
+def test_arena_oom_returns_none():
+    a = native.HostArena(4096)
+    assert a.alloc(100_000) is None  # caller runs spill path
+    o = a.alloc(1024)
+    assert o is not None
+
+
+def test_arena_read_write():
+    a = native.HostArena(1 << 12)
+    o = a.alloc(256)
+    a.write(o, b"hello spill world")
+    assert a.read(o, 17) == b"hello spill world"
+
+
+@requires_native
+def test_serializer_lz4_codec():
+    import pyarrow as pa
+    from spark_rapids_tpu.columnar.host import HostTable
+    from spark_rapids_tpu.shuffle.serializer import (deserialize_table,
+                                                     serialize_table)
+    t = pa.table({"a": list(range(1000)),
+                  "b": [f"s{i % 17}" for i in range(1000)],
+                  "c": [float(i) * 0.5 if i % 7 else None for i in range(1000)]})
+    ht = HostTable.from_arrow(t)
+    blob = serialize_table(ht, codec="lz4")
+    rt = deserialize_table(blob)
+    assert rt.to_arrow().equals(t)
+    raw = serialize_table(ht, codec="none")
+    assert len(blob) < len(raw)
+
+
+def test_pq_fallback_python(monkeypatch):
+    monkeypatch.setattr(native, "get_lib", lambda: None)
+    q = native.HashedPriorityQueue()
+    h1 = q.push(5, 10)
+    q.push(1, 20)
+    q.update(h1, 0)
+    assert q.pop() == (0, 10)
+    assert q.pop() == (1, 20)
+    assert q.pop() is None
+
+
+def test_arena_fallback_python(monkeypatch):
+    monkeypatch.setattr(native, "get_lib", lambda: None)
+    a = native.HostArena(1 << 12)
+    o1 = a.alloc(100)
+    o2 = a.alloc(100)
+    a.write(o2, b"abc")
+    assert a.read(o2, 3) == b"abc"
+    assert a.free(o1) and a.free(o2)
+    assert a.used == 0
