@@ -1,0 +1,12 @@
+"""Distributed execution: mesh/topology management (mesh.py), executor
+runtime + failure detection (executor.py), driver control plane and
+local-cluster simulation (runtime.py). The on-device GSPMD exchange lives in
+shuffle/ici.py; this package is the runtime around it."""
+from .executor import ExecutorContext, FailureDetector
+from .mesh import (MeshTopology, data_parallel_mesh, grid_mesh,
+                   virtual_cpu_mesh)
+from .runtime import DriverRuntime, LocalCluster
+
+__all__ = ["ExecutorContext", "FailureDetector", "MeshTopology",
+           "data_parallel_mesh", "grid_mesh", "virtual_cpu_mesh",
+           "DriverRuntime", "LocalCluster"]

@@ -1,0 +1,115 @@
+"""Executor-side runtime context + failure detection.
+
+Reference mapping:
+- ``ExecutorContext.initialize``  ~ RapidsExecutorPlugin.init
+  (Plugin.scala:189-241): bind device, init memory pools/catalog, init
+  semaphore, init shuffle env, register with the driver's heartbeat manager.
+- ``ExecutorContext.shutdown``    ~ Plugin.scala:269-275.
+- ``FailureDetector``             ~ the driver side of
+  RapidsShuffleHeartbeatManager.scala: peers that miss beats are declared
+  dead and listeners (shuffle manager, scheduler) are told to exclude them;
+  recovery itself is delegated to host-engine retry the way the reference
+  delegates to Spark stage retry (SURVEY §5 failure detection).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..conf import RapidsConf
+from ..memory.catalog import BufferCatalog
+from ..memory.semaphore import TpuSemaphore
+from ..shuffle.manager import ShuffleManager
+from ..shuffle.transport import ShuffleTransport
+
+__all__ = ["ExecutorContext", "FailureDetector"]
+
+
+class ExecutorContext:
+    """Everything one executor process owns: device binding, buffer catalog
+    (spill tiers), admission semaphore, shuffle manager."""
+
+    def __init__(self, executor_id: int, conf: Optional[RapidsConf] = None,
+                 transport: Optional[ShuffleTransport] = None,
+                 device_index: Optional[int] = None):
+        self.executor_id = executor_id
+        self.conf = conf or RapidsConf()
+        self.device_index = device_index if device_index is not None \
+            else executor_id
+        self._transport = transport
+        self.catalog: Optional[BufferCatalog] = None
+        self.semaphore: Optional[TpuSemaphore] = None
+        self.shuffle: Optional[ShuffleManager] = None
+        self.initialized = False
+
+    def initialize(self) -> "ExecutorContext":
+        """Fail-fast like the reference: an executor that cannot init its
+        device/memory raises immediately (Plugin.scala:233-240 hard-exits)."""
+        from ..conf import CONCURRENT_TPU_TASKS
+        self.catalog = BufferCatalog(self.conf)
+        self.semaphore = TpuSemaphore(self.conf.get(CONCURRENT_TPU_TASKS))
+        self.shuffle = ShuffleManager(self.conf, self._transport)
+        self.shuffle.heartbeats.register(self.executor_id)
+        self.initialized = True
+        return self
+
+    def heartbeat(self):
+        if self.shuffle is not None:
+            self.shuffle.heartbeats.heartbeat(self.executor_id)
+
+    def shutdown(self):
+        if self.shuffle is not None and self.shuffle.transport is not None \
+                and self._transport is None:
+            # only close transports we created ourselves
+            self.shuffle.transport.close()
+        self.initialized = False
+
+
+class FailureDetector:
+    """Declares peers dead after ``timeout_s`` without a heartbeat and
+    notifies listeners once per death. Listener errors are swallowed — failure
+    handling must not take down the control plane."""
+
+    def __init__(self, timeout_s: float = 60.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.timeout_s = timeout_s
+        self._clock = clock
+        self._last: Dict[int, float] = {}
+        self._dead: set = set()
+        self._listeners: List[Callable[[int], None]] = []
+        self._lock = threading.Lock()
+
+    def on_peer_lost(self, fn: Callable[[int], None]):
+        self._listeners.append(fn)
+
+    def heartbeat(self, executor_id: int):
+        with self._lock:
+            self._last[executor_id] = self._clock()
+            # a returning executor id is treated as recovered
+            self._dead.discard(executor_id)
+
+    def check(self) -> List[int]:
+        """Scan for newly-dead peers; fire listeners; return them."""
+        now = self._clock()
+        newly = []
+        with self._lock:
+            for e, t in self._last.items():
+                if e not in self._dead and now - t >= self.timeout_s:
+                    self._dead.add(e)
+                    newly.append(e)
+        for e in newly:
+            for fn in self._listeners:
+                try:
+                    fn(e)
+                except Exception:
+                    pass
+        return newly
+
+    def live(self) -> List[int]:
+        with self._lock:
+            return sorted(e for e in self._last if e not in self._dead)
+
+    def dead(self) -> List[int]:
+        with self._lock:
+            return sorted(self._dead)

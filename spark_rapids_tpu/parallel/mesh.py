@@ -1,0 +1,109 @@
+"""Device mesh / topology management.
+
+Reference mapping: the reference binds one GPU per executor from Spark's
+resource scheduler (GpuDeviceManager.scala:124-139) and discovers peers via
+the shuffle heartbeat control plane (Plugin.scala:149-161). On TPU the
+topology is richer: chips within a slice are connected by ICI (fast, used for
+all-to-all/all-gather), slices/hosts by DCN. This module owns constructing
+``jax.sharding.Mesh`` objects for the execution patterns the engine uses:
+
+- ``data_parallel_mesh``: 1-D ``(dp,)`` — partitions-as-shards, the analogue
+  of Spark tasks across executors (SURVEY §2.7 parallelism census).
+- ``grid_mesh``: 2-D ``(dp, ici)`` — batch rows over hosts/DCN, intra-batch
+  exchange over ICI (hash shuffles ride the fast axis).
+- ``virtual_cpu_mesh``: N-device CPU mesh for tests / the driver's
+  ``dryrun_multichip`` (xla_force_host_platform_device_count).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = ["MeshTopology", "data_parallel_mesh", "grid_mesh",
+           "virtual_cpu_mesh", "describe_devices"]
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshTopology:
+    """Physical layout summary used to pick mesh shapes.
+
+    ``process_index``/``process_count`` describe the multi-host dimension
+    (DCN); ``local_devices`` the per-host chips (ICI-connected within a
+    slice)."""
+    process_index: int
+    process_count: int
+    n_devices: int
+    n_local: int
+    platform: str
+
+    @staticmethod
+    def detect() -> "MeshTopology":
+        devs = jax.devices()
+        return MeshTopology(
+            process_index=jax.process_index(),
+            process_count=jax.process_count(),
+            n_devices=len(devs),
+            n_local=len(jax.local_devices()),
+            platform=devs[0].platform if devs else "none",
+        )
+
+    @property
+    def multi_host(self) -> bool:
+        return self.process_count > 1
+
+
+def describe_devices() -> List[dict]:
+    out = []
+    for d in jax.devices():
+        out.append({
+            "id": d.id,
+            "platform": d.platform,
+            "process_index": d.process_index,
+            "kind": getattr(d, "device_kind", "unknown"),
+        })
+    return out
+
+
+def data_parallel_mesh(n: Optional[int] = None, axis: str = "dp") -> Mesh:
+    """1-D mesh over the first ``n`` addressable devices."""
+    devs = jax.devices()
+    if n is not None:
+        if n > len(devs):
+            raise ValueError(f"need {n} devices, have {len(devs)}")
+        devs = devs[:n]
+    return Mesh(np.array(devs), (axis,))
+
+
+def grid_mesh(dp: int, ici: int, axes: Sequence[str] = ("dp", "ici")) -> Mesh:
+    """2-D mesh: ``dp`` (slow/DCN-ish) × ``ici`` (fast axis). Devices are
+    laid out so the ``ici`` axis maps to consecutive device ids — on real
+    TPU topologies consecutive ids are ICI neighbors within a slice, so
+    collectives over that axis stay off DCN (SURVEY §2.7 TPU mapping)."""
+    devs = jax.devices()
+    if dp * ici > len(devs):
+        raise ValueError(f"need {dp * ici} devices, have {len(devs)}")
+    arr = np.array(devs[:dp * ici]).reshape(dp, ici)
+    return Mesh(arr, tuple(axes))
+
+
+def virtual_cpu_mesh(n: int, axis: str = "dp") -> Mesh:
+    """CPU test mesh; requires xla_force_host_platform_device_count >= n
+    (tests/conftest.py sets 8)."""
+    devs = [d for d in jax.devices() if d.platform == "cpu"]
+    if len(devs) < n:
+        raise ValueError(
+            f"need {n} cpu devices, have {len(devs)} — set "
+            "XLA_FLAGS=--xla_force_host_platform_device_count")
+    return Mesh(np.array(devs[:n]), (axis,))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def row_sharded(mesh: Mesh, axis: str = "dp") -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec(axis))

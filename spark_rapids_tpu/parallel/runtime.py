@@ -1,0 +1,136 @@
+"""Distributed runtime: driver control plane + local-cluster simulation.
+
+Reference mapping:
+- ``DriverRuntime``  ~ RapidsDriverPlugin (Plugin.scala:146-178): owns the
+  heartbeat manager/failure detector, hands out executor ids, wires the
+  shared transport.
+- ``LocalCluster``   ~ Spark ``local-cluster[N, cores, mem]`` mode, the
+  reference's no-real-cluster distribution test vehicle
+  (integration_tests/README.md:66-86): N executor contexts in one process,
+  each running its partitions on a worker thread, exchanging shuffle blocks
+  through the shared transport. Device work is serialized per chip by each
+  executor's TpuSemaphore (SURVEY §7 hard part (d)).
+
+The GSPMD path (one jitted program over a Mesh, collectives over ICI) lives
+in shuffle/ici.py + __graft_entry__.dryrun_multichip; this module is the
+*task-parallel* path that mirrors the reference's executor model, used when
+partitions outnumber chips or when running multi-host without a shared
+program.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Dict, List, Optional
+
+import pyarrow as pa
+
+from ..columnar.host import HostTable
+from ..conf import RapidsConf
+from ..shuffle.transport import LocalShuffleTransport, ShuffleTransport
+from .executor import ExecutorContext, FailureDetector
+
+__all__ = ["DriverRuntime", "LocalCluster"]
+
+
+class DriverRuntime:
+    """Driver-side control plane."""
+
+    def __init__(self, conf: Optional[RapidsConf] = None,
+                 heartbeat_timeout_s: float = 60.0):
+        self.conf = conf or RapidsConf()
+        self.detector = FailureDetector(heartbeat_timeout_s)
+        self._ids = itertools.count()
+        self._lock = threading.Lock()
+        self.executors: Dict[int, ExecutorContext] = {}
+
+    def register_executor(self, ctx: ExecutorContext) -> int:
+        with self._lock:
+            self.executors[ctx.executor_id] = ctx
+        self.detector.heartbeat(ctx.executor_id)
+        return ctx.executor_id
+
+    def next_executor_id(self) -> int:
+        return next(self._ids)
+
+    def heartbeat(self, executor_id: int):
+        self.detector.heartbeat(executor_id)
+
+    def live_executors(self) -> List[int]:
+        self.detector.check()
+        return self.detector.live()
+
+
+class LocalCluster:
+    """N executors in-process sharing one transport; partitions of a
+    DataFrame run round-robin across executors on worker threads."""
+
+    def __init__(self, n_executors: int, conf: Optional[RapidsConf] = None,
+                 device: bool = True):
+        self.conf = conf or RapidsConf()
+        self.device = device
+        self.driver = DriverRuntime(self.conf)
+        self.transport: ShuffleTransport = LocalShuffleTransport(self.conf)
+        self.executors: List[ExecutorContext] = []
+        for _ in range(n_executors):
+            eid = self.driver.next_executor_id()
+            ctx = ExecutorContext(eid, self.conf, transport=self.transport)
+            ctx.initialize()
+            self.driver.register_executor(ctx)
+            self.executors.append(ctx)
+        self._pool = ThreadPoolExecutor(max_workers=n_executors,
+                                        thread_name_prefix="srtpu-exec")
+
+    def __enter__(self) -> "LocalCluster":
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def close(self):
+        self._pool.shutdown(wait=True)
+        for ctx in self.executors:
+            ctx.shutdown()
+        self.transport.close()
+
+    # -- execution ------------------------------------------------------------
+    def run(self, df) -> pa.Table:
+        """Execute a DataFrame's physical plan with partitions spread across
+        the executors (reference: one Spark task per partition, tasks pinned
+        to an executor's GPU via GpuSemaphore)."""
+        plan = df.session._physical(df.logical, device=self.device)
+        n_parts = plan.num_partitions
+
+        def run_partition(pidx: int) -> List[HostTable]:
+            ctx = self.executors[pidx % len(self.executors)]
+            ctx.heartbeat()
+            out: List[HostTable] = []
+            if self.device:
+                # the device plan root (DeviceToHostExec) downloads batches;
+                # the chip is held for the whole partition like a Spark task
+                # holds GpuSemaphore
+                with ctx.semaphore.held():
+                    out.extend(plan.execute(pidx))
+            else:
+                out.extend(plan.execute(pidx))
+            return out
+
+        futures = [self._pool.submit(run_partition, p) for p in range(n_parts)]
+        tables: List[HostTable] = []
+        for f in futures:
+            tables.extend(f.result())
+        if not tables:
+            from ..columnar.host import HostColumn
+            from ..plan.physical import _empty_values
+            empty = HostTable(plan.schema.names,
+                              [HostColumn(f.dtype, _empty_values(f.dtype))
+                               for f in plan.schema])
+            return empty.to_arrow()
+        merged = HostTable.concat(tables)
+        return merged.to_arrow()
+
+    def map_executors(self, fn: Callable[[ExecutorContext], object]
+                      ) -> List[object]:
+        futures = [self._pool.submit(fn, ctx) for ctx in self.executors]
+        return [f.result() for f in futures]

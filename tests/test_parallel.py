@@ -1,0 +1,113 @@
+"""parallel/ package: mesh builders on the virtual CPU mesh, failure
+detection (reference: RapidsShuffleHeartbeatManager), and local-cluster
+multi-executor execution (reference: Spark local-cluster mode tests)."""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.parallel import (DriverRuntime, ExecutorContext,
+                                       FailureDetector, LocalCluster,
+                                       MeshTopology, data_parallel_mesh,
+                                       grid_mesh, virtual_cpu_mesh)
+
+
+def test_topology_detect():
+    topo = MeshTopology.detect()
+    assert topo.n_devices >= 8  # conftest forces 8 cpu devices
+    assert topo.process_count == 1
+    assert not topo.multi_host
+
+
+def test_mesh_builders():
+    m = data_parallel_mesh(8)
+    assert m.shape == {"dp": 8}
+    g = grid_mesh(2, 4)
+    assert g.shape == {"dp": 2, "ici": 4}
+    v = virtual_cpu_mesh(4)
+    assert v.shape == {"dp": 4}
+    with pytest.raises(ValueError):
+        grid_mesh(100, 100)
+
+
+def test_failure_detector_clock():
+    t = [0.0]
+    fd = FailureDetector(timeout_s=10.0, clock=lambda: t[0])
+    lost = []
+    fd.on_peer_lost(lost.append)
+    fd.heartbeat(1)
+    fd.heartbeat(2)
+    assert fd.live() == [1, 2]
+    t[0] = 5.0
+    fd.heartbeat(2)
+    t[0] = 11.0
+    assert fd.check() == [1]
+    assert lost == [1]
+    assert fd.live() == [2]
+    assert fd.dead() == [1]
+    # peer 1 comes back (new executor with reused id): recovered
+    fd.heartbeat(1)
+    assert fd.live() == [1, 2]
+    # repeated checks don't re-fire listeners
+    t[0] = 30.0
+    assert set(fd.check()) == {1, 2}
+    t[0] = 31.0
+    assert fd.check() == []
+
+
+def test_listener_errors_swallowed():
+    t = [0.0]
+    fd = FailureDetector(timeout_s=1.0, clock=lambda: t[0])
+    calls = []
+    fd.on_peer_lost(lambda e: 1 / 0)
+    fd.on_peer_lost(calls.append)
+    fd.heartbeat(7)
+    t[0] = 2.0
+    assert fd.check() == [7]
+    assert calls == [7]
+
+
+def test_driver_runtime_registration():
+    drv = DriverRuntime(heartbeat_timeout_s=60.0)
+    e0 = ExecutorContext(drv.next_executor_id())
+    e1 = ExecutorContext(drv.next_executor_id())
+    assert (e0.executor_id, e1.executor_id) == (0, 1)
+    drv.register_executor(e0)
+    drv.register_executor(e1)
+    assert drv.live_executors() == [0, 1]
+
+
+@pytest.fixture(scope="module")
+def cluster_data():
+    rng = np.random.default_rng(11)
+    return pa.table({
+        "k": rng.integers(0, 20, 5000),
+        "v": rng.normal(size=5000),
+    })
+
+
+@pytest.mark.parametrize("device", [False, True])
+def test_local_cluster_query(session, cluster_data, device):
+    from spark_rapids_tpu.expr.functions import col, sum as fsum
+    df = session.create_dataframe(cluster_data, num_partitions=4)
+    q = df.group_by("k").agg(fsum(col("v")).alias("s"))
+    with LocalCluster(3, device=device) as cluster:
+        got = cluster.run(q)
+    exp = q.collect(device=False)
+    got = got.sort_by([("k", "ascending")])
+    exp = exp.sort_by([("k", "ascending")])
+    assert got.column("k").to_pylist() == exp.column("k").to_pylist()
+    np.testing.assert_allclose(
+        got.column("s").to_numpy(zero_copy_only=False),
+        exp.column("s").to_numpy(zero_copy_only=False), rtol=1e-9)
+
+
+def test_local_cluster_semaphore_serializes_device_work(session, cluster_data):
+    from spark_rapids_tpu.expr.functions import col, lit
+    df = session.create_dataframe(cluster_data, num_partitions=6)
+    q = df.filter(col("v") > lit(0.0))
+    with LocalCluster(2) as cluster:
+        got = cluster.run(q)
+        waits = [ctx.semaphore.acquire_count for ctx in cluster.executors]
+    assert sum(waits) == 6  # every partition acquired its executor's chip
+    exp = q.collect(device=False)
+    assert got.num_rows == exp.num_rows
