@@ -259,3 +259,22 @@ def concat_device_tables(tables: Sequence[DeviceTable], min_bucket: int = 1024
     out = DeviceTable(tuple(out_cols), row_mask, num_rows, first.names)
     del total_cap
     return out.compact()
+
+
+def pack_string_key_words(data: "jax.Array", lengths: "jax.Array"):
+    """(cap, w) uint8 + lengths -> list of 1-D uint64 words, most-significant
+    first, whose word-wise unsigned order equals lexicographic byte order;
+    the length is the final word so zero padding can't conflate "ab" with
+    "ab\\x00". Shared by the device groupby and sort kernels for string keys
+    (the reference gets native string keys from cudf)."""
+    cap, w = data.shape
+    words = []
+    for start in range(0, w, 8):
+        chunk = data[:, start:start + 8]
+        word = jnp.zeros((cap,), dtype=jnp.uint64)
+        for j in range(chunk.shape[1]):
+            word = word | (chunk[:, j].astype(jnp.uint64)
+                           << jnp.uint64(8 * (7 - j)))
+        words.append(word)
+    words.append(lengths.astype(jnp.uint64))
+    return words

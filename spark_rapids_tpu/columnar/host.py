@@ -45,6 +45,16 @@ def _arrow_to_dtype(t: pa.DataType) -> dt.DataType:
         return dt.TIMESTAMP
     if pa.types.is_decimal(t):
         return dt.DecimalType(t.precision, t.scale)
+    if pa.types.is_list(t) or pa.types.is_large_list(t):
+        return dt.ArrayType(_arrow_to_dtype(t.value_type))
+    if pa.types.is_struct(t):
+        return dt.StructType(tuple(
+            dt.StructField(t.field(i).name, _arrow_to_dtype(t.field(i).type),
+                           t.field(i).nullable)
+            for i in range(t.num_fields)))
+    if pa.types.is_map(t):
+        return dt.MapType(_arrow_to_dtype(t.key_type),
+                          _arrow_to_dtype(t.item_type))
     raise TypeError(f"unsupported arrow type {t}")
 
 
@@ -73,6 +83,13 @@ def _dtype_to_arrow(d: dt.DataType) -> pa.DataType:
         return pa.timestamp("us")
     if isinstance(d, dt.DecimalType):
         return pa.decimal128(d.precision, d.scale)
+    if isinstance(d, dt.ArrayType):
+        return pa.list_(_dtype_to_arrow(d.element_type))
+    if isinstance(d, dt.StructType):
+        return pa.struct([pa.field(f.name, _dtype_to_arrow(f.data_type),
+                                   nullable=f.nullable) for f in d.fields])
+    if isinstance(d, dt.MapType):
+        return pa.map_(_dtype_to_arrow(d.key_type), _dtype_to_arrow(d.value_type))
     raise TypeError(f"unsupported data type {d!r}")
 
 
@@ -108,7 +125,17 @@ class HostColumn:
         validity = None
         if arr.null_count:
             validity = np.asarray(arr.is_valid())
-        if isinstance(d, dt.StringType) or isinstance(d, dt.BinaryType):
+        if isinstance(d, (dt.ArrayType, dt.StructType, dt.MapType)):
+            # nested values live host-side as Python objects in an object
+            # array: list / dict / list[(k, v)] (CPU-engine representation;
+            # device lowering gates on TypeSig like the reference)
+            values = np.empty(len(arr), dtype=object)
+            values[:] = arr.to_pylist()
+            if validity is not None:
+                fill = [] if not isinstance(d, dt.StructType) else {}
+                for i in np.nonzero(~validity)[0]:
+                    values[i] = fill
+        elif isinstance(d, dt.StringType) or isinstance(d, dt.BinaryType):
             values = np.asarray(arr.to_pylist(), dtype=object)
             if validity is not None:
                 values[~validity] = "" if isinstance(d, dt.StringType) else b""
@@ -136,6 +163,11 @@ class HostColumn:
     def to_arrow(self) -> pa.Array:
         at = _dtype_to_arrow(self.dtype)
         mask = None if self.validity is None else ~self.validity
+        if isinstance(self.dtype, (dt.ArrayType, dt.StructType, dt.MapType)):
+            vals = list(self.values)
+            if mask is not None:
+                vals = [None if m else v for v, m in zip(vals, mask)]
+            return pa.array(vals, type=at)
         if isinstance(self.dtype, (dt.StringType, dt.BinaryType)):
             vals = list(self.values)
             if mask is not None:

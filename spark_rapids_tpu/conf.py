@@ -327,7 +327,7 @@ def _write_docs(path: Optional[str] = None) -> str:
     # import the packages that register confs so the doc is complete
     for mod in ("spark_rapids_tpu.session", "spark_rapids_tpu.memory.catalog",
                 "spark_rapids_tpu.shuffle.manager", "spark_rapids_tpu.udf",
-                "spark_rapids_tpu.io.parquet"):
+                "spark_rapids_tpu.io.parquet", "spark_rapids_tpu.plan.cbo"):
         try:
             importlib.import_module(mod)
         except Exception:
@@ -343,4 +343,8 @@ def _write_docs(path: Optional[str] = None) -> str:
 
 if __name__ == "__main__":  # pragma: no cover
     import sys
-    print(_write_docs(sys.argv[1] if len(sys.argv) > 1 else None))
+    # `python -m spark_rapids_tpu.conf` executes this file as __main__, a
+    # SECOND module instance with its own _REGISTRY; other modules register
+    # into the canonical instance — delegate there
+    from spark_rapids_tpu.conf import _write_docs as _canonical_write_docs
+    print(_canonical_write_docs(sys.argv[1] if len(sys.argv) > 1 else None))

@@ -53,6 +53,26 @@ def _normalize_float_key(v: jax.Array) -> jax.Array:
     return v
 
 
+def _key_code_words(kc) -> "Tuple[List[jax.Array], Optional[jax.Array]]":
+    """Column -> (1-D surrogate sort/equality words most-significant first,
+    optional NaN flag).
+
+    Strings/binary pack 8 bytes per uint64 word big-endian, plus the length
+    as the final tiebreak word — zero padding would otherwise conflate
+    "ab" with "ab\\x00". Word-wise unsigned order == lexicographic byte
+    order, so device groupby/sort accept string keys of ANY width without a
+    dictionary pass (the reference relies on cudf's native string keys;
+    SURVEY §7 hard part (b))."""
+    from ..columnar.device import pack_string_key_words
+    if isinstance(kc.dtype, (dt.StringType, dt.BinaryType)):
+        return pack_string_key_words(kc.data, kc.lengths), None
+    v = _normalize_float_key(kc.data)
+    if jnp.issubdtype(v.dtype, jnp.floating):
+        nan = jnp.isnan(v)
+        return [jnp.where(nan, jnp.full_like(v, jnp.inf), v)], nan
+    return [v], None
+
+
 def _keys_equal_prev(sv: jax.Array) -> jax.Array:
     """eq[i] = sv[i] == sv[i-1] (with NaN==NaN); eq[0] = False."""
     prev = jnp.roll(sv, 1, axis=0)
@@ -182,16 +202,15 @@ class TpuHashAggregateExec(TpuExec):
             # ---- sort so equal keys are adjacent, active rows first
             sort_keys = []
             key_cols = [table.column(k) for k in key_names]
+            # lexsort: LAST entry is most significant. Per key column the
+            # null flag dominates its value words; word lists are appended
+            # least-significant first so the big-endian word order holds.
             for kc in reversed(key_cols):
-                v = _normalize_float_key(kc.data)
-                if jnp.issubdtype(v.dtype, jnp.floating):
-                    # NaNs must sort together deterministically
-                    nan = jnp.isnan(v)
-                    v = jnp.where(nan, jnp.full_like(v, jnp.inf), v)
-                    sort_keys.append(v)
-                    sort_keys.append(nan)
-                else:
-                    sort_keys.append(v)
+                words, nan = _key_code_words(kc)
+                for wd in reversed(words):
+                    sort_keys.append(wd)
+                if nan is not None:
+                    sort_keys.append(nan)  # NaNs sort together (after inf)
                 sort_keys.append(jnp.logical_not(kc.validity))
             sort_keys.append(jnp.logical_not(active))  # primary: active first
             order = jnp.lexsort(tuple(sort_keys))
@@ -199,10 +218,16 @@ class TpuHashAggregateExec(TpuExec):
             # ---- group boundaries among sorted active rows
             same = jnp.ones(cap, dtype=bool)
             for kc in key_cols:
-                sv = jnp.take(_normalize_float_key(kc.data), order)
+                words, nan = _key_code_words(kc)
+                veq = jnp.ones(cap, dtype=bool).at[0].set(False)
+                for wd in words:
+                    veq = jnp.logical_and(
+                        veq, _keys_equal_prev(jnp.take(wd, order)))
+                if nan is not None:  # keep real inf distinct from NaN groups
+                    veq = jnp.logical_and(
+                        veq, _keys_equal_prev(jnp.take(nan, order)))
                 sn = jnp.take(jnp.logical_not(kc.validity), order)
                 prev_sn = jnp.roll(sn, 1)
-                veq = _keys_equal_prev(sv)
                 both_null = jnp.logical_and(sn, prev_sn).at[0].set(False)
                 col_same = jnp.where(jnp.logical_or(sn, prev_sn), both_null, veq)
                 same = jnp.logical_and(same, col_same)

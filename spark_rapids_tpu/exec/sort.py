@@ -40,6 +40,13 @@ def _order_keys(table: DeviceTable, orders: Sequence[SortOrder]) -> List[jax.Arr
                 nan_key = jnp.logical_not(nan)
             keys.append(nan_key)
             keys.append(v)
+        elif v.ndim == 2:  # string/binary: packed uint64 surrogate words
+            from ..columnar.device import pack_string_key_words
+            words = pack_string_key_words(v, c.lengths)
+            if not o.ascending:  # bit inversion reverses unsigned order
+                words = [~w for w in words]
+            for wd in reversed(words):  # append LSW first; MSW nearest null key
+                keys.append(wd)
         elif v.dtype == jnp.bool_:
             keys.append(v != o.ascending)
         else:

@@ -23,7 +23,8 @@ from .cast import Cast
 
 __all__ = ["AggregateFunction", "Sum", "Count", "CountStar", "Min", "Max",
            "Average", "First", "Last", "StddevPop", "StddevSamp",
-           "VariancePop", "VarianceSamp"]
+           "VariancePop", "VarianceSamp", "CollectList", "CollectSet",
+           "ApproximatePercentile"]
 
 
 class AggregateFunction(Expression):
@@ -307,3 +308,150 @@ class StddevPop(_StddevMixin):
 
 class StddevSamp(_StddevMixin):
     ddof = 1
+
+
+class CollectList(AggregateFunction):
+    """collect_list (reference: AggregateFunctions.scala GpuCollectList).
+    Host-engine op; device lowering gated by the ArrayType state TypeSig."""
+
+    @property
+    def data_type(self):
+        return dt.ArrayType(self.child.data_type)
+
+    @property
+    def nullable(self):
+        return False  # empty groups give [], not null
+
+    def update_ops(self):
+        return ["collect_list"]
+
+    def merge_ops(self):
+        return ["merge_lists"]
+
+    def state_fields(self, prefix):
+        return [(f"{prefix}_list", self.data_type, False)]
+
+    def evaluate(self, prefix):
+        return AttributeReference(f"{prefix}_list", self.data_type, False)
+
+
+class CollectSet(AggregateFunction):
+    """collect_set (reference: GpuCollectSet). Dedups at update AND merge so
+    partial states stay small."""
+
+    @property
+    def data_type(self):
+        return dt.ArrayType(self.child.data_type, False)
+
+    @property
+    def nullable(self):
+        return False
+
+    def update_ops(self):
+        return ["collect_set"]
+
+    def merge_ops(self):
+        return ["merge_sets"]
+
+    def state_fields(self, prefix):
+        return [(f"{prefix}_set", self.data_type, False)]
+
+    def evaluate(self, prefix):
+        return AttributeReference(f"{prefix}_set", self.data_type, False)
+
+
+class _PercentileEval(Expression):
+    """Final projection for ApproximatePercentile: select the data value at
+    each requested rank from the collected (partial-merged) value list."""
+
+    def __init__(self, child: Expression, percentages: Tuple[float, ...],
+                 scalar: bool):
+        self.children = (child,)
+        self.percentages = tuple(percentages)
+        self.scalar = scalar
+
+    def with_children(self, children):
+        return _PercentileEval(children[0], self.percentages, self.scalar)
+
+    @property
+    def data_type(self):
+        return dt.DOUBLE if self.scalar else dt.ArrayType(dt.DOUBLE, False)
+
+    def eval(self, ctx):
+        import numpy as np
+        from .base import EvalCol
+        col = self.children[0].eval(ctx)
+        vals = col.values
+        n = len(vals)
+        out = np.empty(n, dtype=object)
+        validity = np.ones(n, dtype=bool)
+        for i in range(n):
+            lst = [v for v in (vals[i] or []) if v is not None]
+            if not lst:
+                validity[i] = False
+                out[i] = None if self.scalar else []
+                continue
+            s = sorted(lst)
+            picks = [s[int(round(p * (len(s) - 1)))] for p in self.percentages]
+            out[i] = picks[0] if self.scalar else [float(x) for x in picks]
+        if self.scalar:
+            data = np.array([float(o) if o is not None else 0.0 for o in out])
+            return EvalCol(data, None if validity.all() else validity,
+                           dt.DOUBLE)
+        return EvalCol(out, None if validity.all() else validity,
+                       self.data_type)
+
+
+class ApproximatePercentile(AggregateFunction):
+    """approx_percentile(col, percentage[, accuracy]).
+
+    Reference: GpuApproximatePercentile.scala (t-digest sketch). This build
+    keeps the same partial/merge shape but the sketch is the exact value
+    multiset (collect + select-at-rank) — always within the accuracy
+    contract; a Pallas t-digest is a later optimization for huge groups.
+    Like Spark, the returned percentile is an actual data value (no
+    interpolation).
+    """
+
+    def __init__(self, child: Optional[Expression] = None,
+                 percentages=(0.5,), scalar: Optional[bool] = None):
+        super().__init__(child)
+        if isinstance(percentages, (int, float)):
+            if scalar is None:
+                scalar = True
+            percentages = (float(percentages),)
+        elif scalar is None:
+            scalar = False
+        for p in percentages:
+            if not 0.0 <= p <= 1.0:
+                raise ValueError(f"percentage {p} not in [0, 1]")
+        self.percentages = tuple(float(p) for p in percentages)
+        self.scalar = scalar
+
+    def with_children(self, children):
+        return ApproximatePercentile(children[0] if children else None,
+                                     self.percentages, self.scalar)
+
+    @property
+    def data_type(self):
+        return dt.DOUBLE if self.scalar else dt.ArrayType(dt.DOUBLE, False)
+
+    def input_projection(self):
+        return [Cast(self.child, dt.DOUBLE)
+                if not isinstance(self.child.data_type, dt.DoubleType)
+                else self.child]
+
+    def update_ops(self):
+        return ["collect_list"]
+
+    def merge_ops(self):
+        return ["merge_lists"]
+
+    def state_fields(self, prefix):
+        return [(f"{prefix}_values", dt.ArrayType(dt.DOUBLE), False)]
+
+    def evaluate(self, prefix):
+        return _PercentileEval(
+            AttributeReference(f"{prefix}_values", dt.ArrayType(dt.DOUBLE),
+                               False),
+            self.percentages, self.scalar)

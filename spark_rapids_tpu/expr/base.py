@@ -303,6 +303,15 @@ def resolve_expression(expr: Expression, schema: Dict[str, dt.DataType],
     child schema and lets nodes with a ``coerce`` hook rewrite their children
     (numeric promotion for arithmetic/comparison).
     """
+    from .collections import LambdaFunction, NamedLambdaVariable
+    if isinstance(expr, LambdaFunction):
+        # lambda bodies reference lambda variables, not the child schema;
+        # the enclosing higher-order function binds + resolves them with the
+        # element type (collections._bind_lambda). Outer column references
+        # inside the body are resolved there against the merged scope.
+        return expr
+    if isinstance(expr, NamedLambdaVariable):
+        return expr
     new_children = [resolve_expression(c, schema, nullable) for c in expr.children]
     if isinstance(expr, AttributeReference):
         if expr.column_name not in schema:
@@ -311,6 +320,11 @@ def resolve_expression(expr: Expression, schema: Dict[str, dt.DataType],
         is_nullable = True if nullable is None else nullable.get(expr.column_name, True)
         return AttributeReference(expr.column_name, schema[expr.column_name], is_nullable)
     out = expr.with_children(new_children) if expr.children else expr
+    bind_lambdas = getattr(out, "bind_lambdas", None)
+    if bind_lambdas is not None:
+        # higher-order functions: bind lambda variables with the (now
+        # resolved) element type and let bodies capture outer columns
+        out = bind_lambdas(schema, nullable)
     coerce = getattr(out, "coerce", None)
     if coerce is not None:
         out = coerce()

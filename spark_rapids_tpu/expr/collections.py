@@ -1,0 +1,1002 @@
+"""Complex-type expressions: arrays, structs, maps, higher-order functions.
+
+Reference mapping (SURVEY §2.5): collectionOperations.scala (653 LoC),
+complexTypeCreator.scala / complexTypeExtractors.scala (498),
+higherOrderFunctions.scala (421 — lambda transform/aggregate/filter/exists).
+
+These run on the host engine; device lowering is gated by the TypeSig system
+exactly like the reference gates nested types per-op (TypeChecks.scala:166) —
+an expression with no device rule or with nested output types tags its plan
+node `cannot_run`, and the operator falls back with a recorded reason.
+
+Host representation (columnar/host.py): object arrays of Python values —
+``list`` for ARRAY, ``dict`` for STRUCT, ``list[(k, v)]`` for MAP.
+
+Null semantics follow Spark: ``size(null) = -1`` (legacy sizeOfNull),
+``element_at`` is 1-based with negative-from-end and null on out-of-bounds,
+``array_contains`` is three-valued, ``sort_array`` puts nulls first when
+ascending / last when descending.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+
+from ..columnar import dtypes as dt
+from .base import (Alias, AttributeReference, EvalCol, EvalContext,
+                   Expression, Literal)
+
+__all__ = [
+    "CreateArray", "GetArrayItem", "ElementAt", "Size", "ArrayContains",
+    "ArrayMin", "ArrayMax", "SortArray", "Flatten", "Slice", "Sequence",
+    "ArrayRepeat", "ArrayDistinct", "ArraysOverlap", "ArrayPosition",
+    "CreateNamedStruct", "GetStructField", "CreateMap", "GetMapValue",
+    "MapKeys", "MapValues",
+    "NamedLambdaVariable", "LambdaFunction", "ArrayTransform", "ArrayFilter",
+    "ArrayExists", "ArrayAggregate",
+]
+
+
+# ---------------------------------------------------------------------------
+# helpers: object-array <-> per-row python lists
+# ---------------------------------------------------------------------------
+
+def _obj(n: int) -> np.ndarray:
+    return np.empty(n, dtype=object)
+
+
+def _rows(ctx: EvalContext, col: EvalCol) -> List[Optional[Any]]:
+    """Host column -> python list with None for nulls."""
+    vals = col.values
+    if col.validity is None:
+        return list(vals)
+    return [v if ok else None for v, ok in zip(vals, col.validity)]
+
+
+def _from_rows(rows: List[Optional[Any]], dtype: dt.DataType) -> EvalCol:
+    n = len(rows)
+    validity = np.fromiter((r is not None for r in rows), dtype=bool, count=n)
+    all_valid = bool(validity.all())
+    if isinstance(dtype, (dt.ArrayType, dt.StructType, dt.MapType,
+                          dt.StringType, dt.BinaryType)):
+        vals = _obj(n)
+        fill: Any = "" if isinstance(dtype, dt.StringType) else \
+            b"" if isinstance(dtype, dt.BinaryType) else \
+            {} if isinstance(dtype, dt.StructType) else []
+        for i, r in enumerate(rows):
+            vals[i] = r if r is not None else fill
+    elif isinstance(dtype, dt.BooleanType):
+        vals = np.fromiter((bool(r) if r is not None else False
+                            for r in rows), dtype=np.bool_, count=n)
+    else:
+        np_dt = dtype.np_dtype()
+        vals = np.fromiter((r if r is not None else 0 for r in rows),
+                           dtype=np_dt, count=n)
+    return EvalCol(vals, None if all_valid else validity, dtype)
+
+
+def _elem_col(elems: List[Optional[Any]], etype: dt.DataType) -> EvalCol:
+    """Per-row lambda binding: the row's array elements as a column."""
+    return _from_rows(elems, etype)
+
+
+def _host_only(ctx: EvalContext, what: str):
+    if ctx.is_device:
+        raise NotImplementedError(
+            f"{what} has no device kernel (TypeSig gating should have "
+            "prevented device lowering)")
+
+
+# ---------------------------------------------------------------------------
+# creators
+# ---------------------------------------------------------------------------
+
+class CreateArray(Expression):
+    """array(e1, e2, ...) — all elements coerced to a common type upstream."""
+
+    def __init__(self, *children: Expression):
+        self.children = tuple(children)
+
+    def with_children(self, children):
+        return CreateArray(*children)
+
+    @property
+    def data_type(self):
+        et = self.children[0].data_type if self.children else dt.NULL
+        return dt.ArrayType(et)
+
+    @property
+    def nullable(self):
+        return False
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        _host_only(ctx, "array()")
+        cols = [c.eval(ctx) for c in self.children]
+        per_child = [_rows(ctx, c) for c in cols]
+        n = ctx.num_rows
+        out = _obj(n)
+        for i in range(n):
+            out[i] = [pc[i] for pc in per_child]
+        return EvalCol(out, None, self.data_type)
+
+
+class CreateNamedStruct(Expression):
+    """named_struct(n1, v1, n2, v2, ...) — names are foldable literals."""
+
+    def __init__(self, *children: Expression):
+        assert len(children) % 2 == 0, "named_struct takes name/value pairs"
+        self.children = tuple(children)
+
+    def with_children(self, children):
+        return CreateNamedStruct(*children)
+
+    @property
+    def field_names(self) -> List[str]:
+        return [c.value for c in self.children[0::2]]
+
+    @property
+    def value_exprs(self):
+        return list(self.children[1::2])
+
+    @property
+    def data_type(self):
+        return dt.StructType(tuple(
+            dt.StructField(n, v.data_type, v.nullable)
+            for n, v in zip(self.field_names, self.value_exprs)))
+
+    @property
+    def nullable(self):
+        return False
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        _host_only(ctx, "named_struct()")
+        names = self.field_names
+        cols = [_rows(ctx, v.eval(ctx)) for v in self.value_exprs]
+        n = ctx.num_rows
+        out = _obj(n)
+        for i in range(n):
+            out[i] = {nm: col[i] for nm, col in zip(names, cols)}
+        return EvalCol(out, None, self.data_type)
+
+
+class CreateMap(Expression):
+    """map(k1, v1, k2, v2, ...). Later duplicate keys win (Spark LAST_WIN)."""
+
+    def __init__(self, *children: Expression):
+        assert len(children) % 2 == 0, "map takes key/value pairs"
+        self.children = tuple(children)
+
+    def with_children(self, children):
+        return CreateMap(*children)
+
+    @property
+    def data_type(self):
+        kt = self.children[0].data_type if self.children else dt.NULL
+        vt = self.children[1].data_type if self.children else dt.NULL
+        return dt.MapType(kt, vt)
+
+    @property
+    def nullable(self):
+        return False
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        _host_only(ctx, "map()")
+        keys = [_rows(ctx, k.eval(ctx)) for k in self.children[0::2]]
+        vals = [_rows(ctx, v.eval(ctx)) for v in self.children[1::2]]
+        n = ctx.num_rows
+        out = _obj(n)
+        for i in range(n):
+            d = {}
+            for kc, vc in zip(keys, vals):
+                if kc[i] is None:
+                    raise ValueError("Cannot use null as map key")
+                d[kc[i]] = vc[i]
+            out[i] = list(d.items())
+        return EvalCol(out, None, self.data_type)
+
+
+# ---------------------------------------------------------------------------
+# extractors
+# ---------------------------------------------------------------------------
+
+class GetArrayItem(Expression):
+    """arr[i] — 0-based; null on out-of-bounds/negative (non-ANSI)."""
+
+    def __init__(self, child: Expression, ordinal: Expression):
+        self.children = (child, ordinal)
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type.element_type
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        _host_only(ctx, "array index")
+        arrs = _rows(ctx, self.children[0].eval(ctx))
+        ords = _rows(ctx, self.children[1].eval(ctx))
+        out = []
+        for a, o in zip(arrs, ords):
+            if a is None or o is None or o < 0 or o >= len(a):
+                out.append(None)
+            else:
+                out.append(a[int(o)])
+        return _from_rows(out, self.data_type)
+
+
+class ElementAt(Expression):
+    """element_at(arr, i): 1-based, negative from end, null out-of-bounds;
+    element_at(map, key): value or null (shim-registered expr in the
+    reference, Spark311Shims ElementAt)."""
+
+    def __init__(self, child: Expression, key: Expression):
+        self.children = (child, key)
+
+    @property
+    def data_type(self):
+        t = self.children[0].data_type
+        return t.element_type if isinstance(t, dt.ArrayType) else t.value_type
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        _host_only(ctx, "element_at")
+        base = _rows(ctx, self.children[0].eval(ctx))
+        keys = _rows(ctx, self.children[1].eval(ctx))
+        is_map = isinstance(self.children[0].data_type, dt.MapType)
+        out = []
+        for b, k in zip(base, keys):
+            if b is None or k is None:
+                out.append(None)
+            elif is_map:
+                out.append(dict(b).get(k))
+            else:
+                i = int(k)
+                if i == 0:
+                    raise ValueError("element_at: SQL array indices start at 1")
+                if i < 0:
+                    i += len(b)
+                else:
+                    i -= 1
+                out.append(b[i] if 0 <= i < len(b) else None)
+        return _from_rows(out, self.data_type)
+
+
+class GetStructField(Expression):
+    def __init__(self, child: Expression, field: str):
+        self.children = (child,)
+        self.field = field
+
+    def with_children(self, children):
+        return GetStructField(children[0], self.field)
+
+    @property
+    def data_type(self):
+        st = self.children[0].data_type
+        for f in st.fields:
+            if f.name == self.field:
+                return f.data_type
+        raise KeyError(f"no struct field {self.field!r} in {st!r}")
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        _host_only(ctx, "struct field access")
+        rows = _rows(ctx, self.children[0].eval(ctx))
+        out = [None if r is None else r.get(self.field) for r in rows]
+        return _from_rows(out, self.data_type)
+
+
+class GetMapValue(Expression):
+    def __init__(self, child: Expression, key: Expression):
+        self.children = (child, key)
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type.value_type
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        _host_only(ctx, "map value access")
+        maps = _rows(ctx, self.children[0].eval(ctx))
+        keys = _rows(ctx, self.children[1].eval(ctx))
+        out = [None if m is None or k is None else dict(m).get(k)
+               for m, k in zip(maps, keys)]
+        return _from_rows(out, self.data_type)
+
+
+class MapKeys(Expression):
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    @property
+    def data_type(self):
+        return dt.ArrayType(self.children[0].data_type.key_type, False)
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        _host_only(ctx, "map_keys")
+        rows = _rows(ctx, self.children[0].eval(ctx))
+        out = [None if r is None else [k for k, _ in r] for r in rows]
+        return _from_rows(out, self.data_type)
+
+
+class MapValues(Expression):
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    @property
+    def data_type(self):
+        t = self.children[0].data_type
+        return dt.ArrayType(t.value_type, t.value_contains_null)
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        _host_only(ctx, "map_values")
+        rows = _rows(ctx, self.children[0].eval(ctx))
+        out = [None if r is None else [v for _, v in r] for r in rows]
+        return _from_rows(out, self.data_type)
+
+
+# ---------------------------------------------------------------------------
+# collection operations
+# ---------------------------------------------------------------------------
+
+class Size(Expression):
+    """size(arr|map); -1 for null (spark.sql.legacy.sizeOfNull default)."""
+
+    def __init__(self, child: Expression, legacy_size_of_null: bool = True):
+        self.children = (child,)
+        self.legacy = legacy_size_of_null
+
+    def with_children(self, children):
+        return Size(children[0], self.legacy)
+
+    @property
+    def data_type(self):
+        return dt.INT
+
+    @property
+    def nullable(self):
+        return not self.legacy
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        _host_only(ctx, "size")
+        rows = _rows(ctx, self.children[0].eval(ctx))
+        if self.legacy:
+            out = [-1 if r is None else len(r) for r in rows]
+        else:
+            out = [None if r is None else len(r) for r in rows]
+        return _from_rows(out, dt.INT)
+
+
+class ArrayContains(Expression):
+    """Three-valued: null if arr null; null if not found but arr has nulls."""
+
+    def __init__(self, child: Expression, value: Expression):
+        self.children = (child, value)
+
+    @property
+    def data_type(self):
+        return dt.BOOLEAN
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        _host_only(ctx, "array_contains")
+        arrs = _rows(ctx, self.children[0].eval(ctx))
+        vals = _rows(ctx, self.children[1].eval(ctx))
+        out = []
+        for a, v in zip(arrs, vals):
+            if a is None or v is None:
+                out.append(None)
+            elif any(e is not None and e == v for e in a):
+                out.append(True)
+            elif any(e is None for e in a):
+                out.append(None)
+            else:
+                out.append(False)
+        return _from_rows(out, dt.BOOLEAN)
+
+
+class ArrayPosition(Expression):
+    """1-based index of first occurrence, 0 if absent, null on null inputs."""
+
+    def __init__(self, child: Expression, value: Expression):
+        self.children = (child, value)
+
+    @property
+    def data_type(self):
+        return dt.LONG
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        _host_only(ctx, "array_position")
+        arrs = _rows(ctx, self.children[0].eval(ctx))
+        vals = _rows(ctx, self.children[1].eval(ctx))
+        out = []
+        for a, v in zip(arrs, vals):
+            if a is None or v is None:
+                out.append(None)
+                continue
+            pos = 0
+            for j, e in enumerate(a):
+                if e is not None and e == v:
+                    pos = j + 1
+                    break
+            out.append(pos)
+        return _from_rows(out, dt.LONG)
+
+
+class _ArrayMinMax(Expression):
+    IS_MIN = True
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type.element_type
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        _host_only(ctx, "array_min/max")
+        rows = _rows(ctx, self.children[0].eval(ctx))
+        out = []
+        for r in rows:
+            if r is None:
+                out.append(None)
+                continue
+            elems = [e for e in r if e is not None]
+            if not elems:
+                out.append(None)
+                continue
+            # Spark total order: NaN greatest
+            if isinstance(elems[0], float):
+                nn = [e for e in elems if not np.isnan(e)]
+                if self.IS_MIN:
+                    out.append(min(nn) if nn else np.nan)
+                else:
+                    out.append(np.nan if len(nn) < len(elems) else max(nn))
+            else:
+                out.append(min(elems) if self.IS_MIN else max(elems))
+        return _from_rows(out, self.data_type)
+
+
+class ArrayMin(_ArrayMinMax):
+    IS_MIN = True
+
+
+class ArrayMax(_ArrayMinMax):
+    IS_MIN = False
+
+
+class SortArray(Expression):
+    """sort_array(arr, asc): nulls first when asc, last when desc; NaN
+    greatest among doubles (Spark total order)."""
+
+    def __init__(self, child: Expression, ascending: Expression = None):
+        asc = ascending if ascending is not None else Literal(True)
+        self.children = (child, asc)
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        _host_only(ctx, "sort_array")
+        rows = _rows(ctx, self.children[0].eval(ctx))
+        asc_col = _rows(ctx, self.children[1].eval(ctx))
+        out = []
+
+        def key(e):
+            if isinstance(e, float) and np.isnan(e):
+                return (1, 0.0)   # NaN after all numbers
+            return (0, e)
+
+        for r, asc in zip(rows, asc_col):
+            if r is None:
+                out.append(None)
+                continue
+            nulls = [e for e in r if e is None]
+            present = sorted((e for e in r if e is not None), key=key,
+                             reverse=not asc)
+            out.append(nulls + present if asc else present + nulls)
+        return _from_rows(out, self.data_type)
+
+
+class ArrayDistinct(Expression):
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        _host_only(ctx, "array_distinct")
+        rows = _rows(ctx, self.children[0].eval(ctx))
+        out = []
+        from ..plan.host_groupby import _dedupe
+        for r in rows:
+            out.append(None if r is None else _dedupe(r))
+        return _from_rows(out, self.data_type)
+
+
+class ArraysOverlap(Expression):
+    def __init__(self, left: Expression, right: Expression):
+        self.children = (left, right)
+
+    @property
+    def data_type(self):
+        return dt.BOOLEAN
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        _host_only(ctx, "arrays_overlap")
+        ls = _rows(ctx, self.children[0].eval(ctx))
+        rs = _rows(ctx, self.children[1].eval(ctx))
+        out = []
+        for a, b in zip(ls, rs):
+            if a is None or b is None:
+                out.append(None)
+                continue
+            pa_ = [e for e in a if e is not None]
+            pb = [e for e in b if e is not None]
+            overlap = any(any(x == y for y in pb) for x in pa_)
+            if overlap:
+                out.append(True)
+            elif (len(pa_) < len(a) or len(pb) < len(b)) and pa_ and pb:
+                out.append(None)  # nulls could match
+            else:
+                out.append(False)
+        return _from_rows(out, dt.BOOLEAN)
+
+
+class Flatten(Expression):
+    """flatten(array<array<T>>); null if outer null or any inner null."""
+
+    def __init__(self, child: Expression):
+        self.children = (child,)
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type.element_type
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        _host_only(ctx, "flatten")
+        rows = _rows(ctx, self.children[0].eval(ctx))
+        out = []
+        for r in rows:
+            if r is None or any(inner is None for inner in r):
+                out.append(None)
+            else:
+                out.append([e for inner in r for e in inner])
+        return _from_rows(out, self.data_type)
+
+
+class Slice(Expression):
+    """slice(arr, start, length): 1-based; negative start counts from end;
+    start=0 or negative length raise (Spark runtime error)."""
+
+    def __init__(self, child: Expression, start: Expression,
+                 length: Expression):
+        self.children = (child, start, length)
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        _host_only(ctx, "slice")
+        arrs = _rows(ctx, self.children[0].eval(ctx))
+        starts = _rows(ctx, self.children[1].eval(ctx))
+        lens = _rows(ctx, self.children[2].eval(ctx))
+        out = []
+        for a, s, ln in zip(arrs, starts, lens):
+            if a is None or s is None or ln is None:
+                out.append(None)
+                continue
+            s, ln = int(s), int(ln)
+            if s == 0:
+                raise ValueError("slice: start index 0 is invalid (1-based)")
+            if ln < 0:
+                raise ValueError(f"slice: negative length {ln}")
+            i = s - 1 if s > 0 else len(a) + s
+            if i < 0:
+                out.append([])
+            else:
+                out.append(a[i:i + ln])
+        return _from_rows(out, self.data_type)
+
+
+class Sequence(Expression):
+    """sequence(start, stop[, step]) — inclusive bounds."""
+
+    def __init__(self, start: Expression, stop: Expression,
+                 step: Optional[Expression] = None):
+        self.children = (start, stop) if step is None \
+            else (start, stop, step)
+
+    def with_children(self, children):
+        return Sequence(*children)
+
+    @property
+    def data_type(self):
+        return dt.ArrayType(self.children[0].data_type, False)
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        _host_only(ctx, "sequence")
+        starts = _rows(ctx, self.children[0].eval(ctx))
+        stops = _rows(ctx, self.children[1].eval(ctx))
+        steps = _rows(ctx, self.children[2].eval(ctx)) \
+            if len(self.children) > 2 else [None] * len(starts)
+        out = []
+        for a, b, s in zip(starts, stops, steps):
+            if a is None or b is None:
+                out.append(None)
+                continue
+            a, b = int(a), int(b)
+            if s is None:
+                s = 1 if b >= a else -1
+            s = int(s)
+            if s == 0 or (b - a) * s < 0 and a != b:
+                raise ValueError(
+                    f"sequence: wrong step {s} for bounds {a}..{b}")
+            out.append(list(range(a, b + (1 if s > 0 else -1), s)))
+        return _from_rows(out, self.data_type)
+
+
+class ArrayRepeat(Expression):
+    def __init__(self, child: Expression, count: Expression):
+        self.children = (child, count)
+
+    @property
+    def data_type(self):
+        return dt.ArrayType(self.children[0].data_type)
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        _host_only(ctx, "array_repeat")
+        vals = _rows(ctx, self.children[0].eval(ctx))
+        cnts = _rows(ctx, self.children[1].eval(ctx))
+        out = [None if c is None else [v] * max(int(c), 0)
+               for v, c in zip(vals, cnts)]
+        return _from_rows(out, self.data_type)
+
+
+# ---------------------------------------------------------------------------
+# higher-order functions (lambdas)
+# ---------------------------------------------------------------------------
+
+class NamedLambdaVariable(Expression):
+    """A lambda parameter; bound by the enclosing HOF via the eval context
+    columns (reference: higherOrderFunctions.scala NamedLambdaVariable)."""
+
+    def __init__(self, var_name: str, var_dtype: dt.DataType = dt.NULL,
+                 var_nullable: bool = True):
+        self.children = ()
+        self.var_name = var_name
+        self._dtype = var_dtype
+        self._nullable = var_nullable
+
+    def with_children(self, children):
+        return self
+
+    def bind(self, dtype: dt.DataType, nullable: bool) -> "NamedLambdaVariable":
+        return NamedLambdaVariable(self.var_name, dtype, nullable)
+
+    @property
+    def data_type(self):
+        return self._dtype
+
+    @property
+    def nullable(self):
+        return self._nullable
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        return ctx.lookup(self.var_name)
+
+    def __repr__(self):
+        return f"λ{self.var_name}"
+
+
+class LambdaFunction(Expression):
+    """(x[, i]) -> body. Children = (body,); argument list kept aside."""
+
+    def __init__(self, body: Expression, args: Sequence[NamedLambdaVariable]):
+        self.children = (body,)
+        self.args = list(args)
+
+    def with_children(self, children):
+        return LambdaFunction(children[0], self.args)
+
+    @property
+    def body(self) -> Expression:
+        return self.children[0]
+
+    @property
+    def data_type(self):
+        return self.body.data_type
+
+    @property
+    def nullable(self):
+        return self.body.nullable
+
+
+def _bind_lambda(fn: LambdaFunction, etype: dt.DataType,
+                 extra: Sequence[dt.DataType] = (),
+                 outer_schema=None, outer_nullable=None) -> LambdaFunction:
+    """Rebind lambda variables with concrete types and resolve the body.
+    ``outer_schema`` lets bodies capture enclosing columns (lambda variables
+    shadow them)."""
+    from .base import resolve_expression
+    bound = [fn.args[0].bind(etype, True)]
+    for i, t in enumerate(extra):
+        if len(fn.args) > 1 + i:
+            bound.append(fn.args[1 + i].bind(t, False))
+    schema = dict(outer_schema or {})
+    nullable = dict(outer_nullable or {})
+    schema.update({v.var_name: v.data_type for v in bound})
+    nullable.update({v.var_name: v.nullable for v in bound})
+
+    def rewrite(e: Expression) -> Expression:
+        if isinstance(e, NamedLambdaVariable):
+            for v in bound:
+                if v.var_name == e.var_name:
+                    return v
+            return e
+        new = [rewrite(c) for c in e.children]
+        return e.with_children(new) if new else e
+
+    body = rewrite(fn.body)
+    body = resolve_expression(body, schema, nullable)
+    return LambdaFunction(body, bound)
+
+
+class _LambdaScope(EvalContext):
+    """Per-row lambda evaluation scope: lambda variables first, then outer
+    columns captured from the enclosing row (broadcast over the elements)."""
+
+    def __init__(self, lambda_cols, n_elems: int, outer: EvalContext,
+                 row_idx: int):
+        super().__init__(False, np, lambda_cols, n_elems,
+                         partition_id=outer.partition_id)
+        self._outer = outer
+        self._row = row_idx
+
+    def lookup(self, name: str) -> EvalCol:
+        if name in self._columns:
+            return self._columns[name]
+        oc = self._outer.lookup(name)
+        ok = oc.validity is None or bool(oc.validity[self._row])
+        v = oc.values[self._row] if ok else None
+        return _from_rows([v] * self.num_rows, oc.dtype)
+
+
+class _HOFBase(Expression):
+    def __init__(self, child: Expression, fn: LambdaFunction):
+        self.children = (child, fn)
+
+    def with_children(self, children):
+        return type(self)(children[0], children[1])
+
+    @property
+    def fn(self) -> LambdaFunction:
+        return self.children[1]
+
+    def bind_lambdas(self, schema, nullable) -> "Expression":
+        """Called by resolve_expression once the array child is resolved:
+        bind lambda vars with the element type, letting the body capture
+        outer columns (which lambda variables shadow)."""
+        et = self.children[0].data_type.element_type
+        bound = _bind_lambda(self.fn, et, (dt.INT,),
+                             outer_schema=schema, outer_nullable=nullable)
+        return type(self)(self.children[0], bound)
+
+    def _bound(self) -> LambdaFunction:
+        fn = self.fn
+        if fn.args and fn.args[0].data_type is not dt.NULL:
+            return fn  # bind_lambdas already ran
+        et = self.children[0].data_type.element_type
+        return _bind_lambda(fn, et, (dt.INT,))
+
+    def _eval_per_row(self, ctx: EvalContext, arr_rows, bound: LambdaFunction):
+        """Yield (row_index, elems, lambda-body EvalCol rows) per non-null row;
+        the body is evaluated VECTORIZED over the row's elements."""
+        et = self.children[0].data_type.element_type
+        for i, r in enumerate(arr_rows):
+            if r is None:
+                yield i, None, None
+                continue
+            cols = {bound.args[0].var_name: _elem_col(r, et)}
+            if len(bound.args) > 1:
+                cols[bound.args[1].var_name] = EvalCol(
+                    np.arange(len(r), dtype=np.int32), None, dt.INT)
+            sub = _LambdaScope(cols, len(r), ctx, i)
+            body = bound.body.eval(sub)
+            yield i, r, _rows(sub, body)
+
+
+class ArrayTransform(_HOFBase):
+    """transform(arr, x -> expr) / transform(arr, (x, i) -> expr)."""
+
+    @property
+    def data_type(self):
+        return dt.ArrayType(self._bound().body.data_type)
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        _host_only(ctx, "transform")
+        arrs = _rows(ctx, self.children[0].eval(ctx))
+        bound = self._bound()
+        out = []
+        for _i, r, mapped in self._eval_per_row(ctx, arrs, bound):
+            out.append(None if r is None else mapped)
+        return _from_rows(out, self.data_type)
+
+
+class ArrayFilter(_HOFBase):
+    """filter(arr, x -> pred)."""
+
+    @property
+    def data_type(self):
+        return self.children[0].data_type
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        _host_only(ctx, "filter(array)")
+        arrs = _rows(ctx, self.children[0].eval(ctx))
+        bound = self._bound()
+        out = []
+        for i, r, keep in self._eval_per_row(ctx, arrs, bound):
+            if r is None:
+                out.append(None)
+            else:
+                out.append([e for e, k in zip(r, keep) if k])
+        return _from_rows(out, self.data_type)
+
+
+class ArrayExists(_HOFBase):
+    """exists(arr, x -> pred); three-valued over null predicate results."""
+
+    @property
+    def data_type(self):
+        return dt.BOOLEAN
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        _host_only(ctx, "exists(array)")
+        arrs = _rows(ctx, self.children[0].eval(ctx))
+        bound = self._bound()
+        out = []
+        for i, r, preds in self._eval_per_row(ctx, arrs, bound):
+            if r is None:
+                out.append(None)
+                continue
+            norm = [None if p is None else bool(p) for p in preds]
+            if any(p for p in norm if p is not None):
+                out.append(True)
+            elif any(p is None for p in norm):
+                out.append(None)
+            else:
+                out.append(False)
+        return _from_rows(out, dt.BOOLEAN)
+
+
+class ArrayAggregate(Expression):
+    """aggregate(arr, zero, (acc, x) -> merge[, acc -> finish]) — a fold
+    (reference: higherOrderFunctions.scala ArrayAggregate)."""
+
+    def __init__(self, child: Expression, zero: Expression,
+                 merge: LambdaFunction,
+                 finish: Optional[LambdaFunction] = None):
+        self.children = (child, zero, merge) if finish is None else \
+            (child, zero, merge, finish)
+
+    def with_children(self, children):
+        return ArrayAggregate(*children)
+
+    @property
+    def _merge(self) -> LambdaFunction:
+        return self.children[2]
+
+    @property
+    def _finish(self) -> Optional[LambdaFunction]:
+        return self.children[3] if len(self.children) > 3 else None
+
+    def bind_lambdas(self, schema, nullable) -> "Expression":
+        zt = self.children[1].data_type
+        et = self.children[0].data_type.element_type
+        merge = _bind_lambda(self._merge, zt, (et,),
+                             outer_schema=schema, outer_nullable=nullable)
+        finish = None
+        if self._finish is not None:
+            finish = _bind_lambda(self._finish, zt,
+                                  outer_schema=schema,
+                                  outer_nullable=nullable)
+        return ArrayAggregate(self.children[0], self.children[1], merge,
+                              finish)
+
+    def _bound_merge(self) -> LambdaFunction:
+        m = self._merge
+        if m.args and m.args[0].data_type is not dt.NULL:
+            return m
+        return _bind_lambda(m, self.children[1].data_type,
+                            (self.children[0].data_type.element_type,))
+
+    def _bound_finish(self) -> Optional[LambdaFunction]:
+        f = self._finish
+        if f is None:
+            return None
+        if f.args and f.args[0].data_type is not dt.NULL:
+            return f
+        return _bind_lambda(f, self.children[1].data_type)
+
+    @property
+    def data_type(self):
+        zt = self.children[1].data_type
+        fin = self._bound_finish()
+        return zt if fin is None else fin.body.data_type
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        _host_only(ctx, "aggregate(array)")
+        arrs = _rows(ctx, self.children[0].eval(ctx))
+        zeros = _rows(ctx, self.children[1].eval(ctx))
+        zt = self.children[1].data_type
+        et = self.children[0].data_type.element_type
+        merge = self._bound_merge()
+        acc_var, elem_var = merge.args[0].var_name, merge.args[1].var_name
+        out = []
+        for i, (r, z) in enumerate(zip(arrs, zeros)):
+            if r is None:
+                out.append(None)
+                continue
+            acc = z
+            for e in r:
+                cols = {acc_var: _from_rows([acc], zt),
+                        elem_var: _from_rows([e], et)}
+                sub = _LambdaScope(cols, 1, ctx, i)
+                acc = _rows(sub, merge.body.eval(sub))[0]
+            out.append(acc)
+        fin = self._bound_finish()
+        if fin is not None:
+            fv = fin.args[0].var_name
+            res = []
+            for i, acc in enumerate(out):
+                cols = {fv: _from_rows([acc], zt)}
+                sub = _LambdaScope(cols, 1, ctx, i)
+                res.append(_rows(sub, fin.body.eval(sub))[0])
+            out = res
+        return _from_rows(out, self.data_type)
+
+
+# ---------------------------------------------------------------------------
+# generators (reference: GpuGenerateExec.scala GpuExplode/GpuPosExplode)
+# ---------------------------------------------------------------------------
+
+class Explode(Expression):
+    """Generator: one output row per array element / map entry.
+
+    Not evaluated through Expression.eval — the Generate exec consumes it
+    directly (same split as the reference: generator expressions only appear
+    under GenerateExec)."""
+
+    def __init__(self, child: Expression, pos: bool = False):
+        self.children = (child,)
+        self.pos = pos
+
+    def with_children(self, children):
+        return Explode(children[0], self.pos)
+
+    @property
+    def data_type(self):
+        # type of the "col" output (array element / map value)
+        t = self.children[0].data_type
+        return t.element_type if isinstance(t, dt.ArrayType) else t.value_type
+
+    def output_fields(self) -> List[tuple]:
+        """[(name, dtype, nullable)] appended by the Generate exec."""
+        t = self.children[0].data_type
+        out = []
+        if self.pos:
+            out.append(("pos", dt.INT, False))
+        if isinstance(t, dt.ArrayType):
+            out.append(("col", t.element_type, t.contains_null))
+        elif isinstance(t, dt.MapType):
+            out.append(("key", t.key_type, False))
+            out.append(("value", t.value_type, t.value_contains_null))
+        else:
+            raise TypeError(f"explode needs array or map, got {t!r}")
+        return out
+
+
+class PosExplode(Explode):
+    def __init__(self, child: Expression):
+        super().__init__(child, pos=True)
+
+    def with_children(self, children):
+        return PosExplode(children[0])

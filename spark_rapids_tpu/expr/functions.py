@@ -145,6 +145,21 @@ class Column:
         from .strings import Substring
         return Column(Substring(self.expr, _to_expr(pos), _to_expr(ln)))
 
+    def getItem(self, key) -> "Column":
+        """arr[int] (0-based) or map[key]. An int key selects the array
+        path; other key types the map path (PySpark getItem convention)."""
+        from .collections import GetArrayItem, GetMapValue
+        if isinstance(key, int):
+            return Column(GetArrayItem(self.expr, _to_expr(key)))
+        return Column(GetMapValue(self.expr, _to_expr(key)))
+
+    def getField(self, name: str) -> "Column":
+        from .collections import GetStructField
+        return Column(GetStructField(self.expr, name))
+
+    def __getitem__(self, key) -> "Column":
+        return self.getItem(key)
+
     def asc(self) -> "SortOrder":
         return SortOrder(self.expr, ascending=True)
 
@@ -560,3 +575,181 @@ def monotonically_increasing_id() -> Column:
 def rand(seed=None) -> Column:
     from .hashing import Rand
     return Column(Rand(seed))
+
+
+# -- collections / complex types (expr/collections.py) ------------------------
+
+def array(*cols) -> Column:
+    from .collections import CreateArray
+    return Column(CreateArray(*[_to_expr(c) for c in cols]))
+
+
+def named_struct(*name_value_pairs) -> Column:
+    from .collections import CreateNamedStruct
+    from .base import Literal
+    children = []
+    for i, v in enumerate(name_value_pairs):
+        children.append(Literal(v) if i % 2 == 0 else _to_expr(v))
+    return Column(CreateNamedStruct(*children))
+
+
+def struct(*cols) -> Column:
+    """struct(col...) — field names from column refs/aliases; computed
+    expressions get positional colN names (Spark's convention)."""
+    from .base import Alias, AttributeReference, Literal
+    from .collections import CreateNamedStruct
+    children = []
+    for i, c in enumerate(cols):
+        e = _to_expr(c)
+        if isinstance(e, (Alias, AttributeReference)):
+            name = e.name
+        else:
+            name = f"col{i + 1}"
+        children.append(Literal(name))
+        children.append(e)
+    return Column(CreateNamedStruct(*children))
+
+
+def create_map(*key_value_pairs) -> Column:
+    from .collections import CreateMap
+    return Column(CreateMap(*[_to_expr(c) for c in key_value_pairs]))
+
+
+def element_at(c, key) -> Column:
+    from .collections import ElementAt
+    return Column(ElementAt(_to_expr(c), _to_expr(key)))
+
+
+def size(c) -> Column:
+    from .collections import Size
+    return Column(Size(_to_expr(c)))
+
+
+def array_contains(c, value) -> Column:
+    from .collections import ArrayContains
+    return Column(ArrayContains(_to_expr(c), _to_expr(value)))
+
+
+def array_position(c, value) -> Column:
+    from .collections import ArrayPosition
+    return Column(ArrayPosition(_to_expr(c), _to_expr(value)))
+
+
+def array_min(c) -> Column:
+    from .collections import ArrayMin
+    return Column(ArrayMin(_to_expr(c)))
+
+
+def array_max(c) -> Column:
+    from .collections import ArrayMax
+    return Column(ArrayMax(_to_expr(c)))
+
+
+def array_distinct(c) -> Column:
+    from .collections import ArrayDistinct
+    return Column(ArrayDistinct(_to_expr(c)))
+
+
+def arrays_overlap(a, b) -> Column:
+    from .collections import ArraysOverlap
+    return Column(ArraysOverlap(_to_expr(a), _to_expr(b)))
+
+
+def array_repeat(c, count) -> Column:
+    from .collections import ArrayRepeat
+    return Column(ArrayRepeat(_to_expr(c), _to_expr(count)))
+
+
+def sort_array(c, asc: bool = True) -> Column:
+    from .collections import SortArray
+    from .base import Literal
+    return Column(SortArray(_to_expr(c), Literal(asc)))
+
+
+def flatten(c) -> Column:
+    from .collections import Flatten
+    return Column(Flatten(_to_expr(c)))
+
+
+def slice(c, start, length) -> Column:  # noqa: A001
+    from .collections import Slice
+    return Column(Slice(_to_expr(c), _to_expr(start), _to_expr(length)))
+
+
+def sequence(start, stop, step=None) -> Column:
+    from .collections import Sequence
+    return Column(Sequence(_to_expr(start), _to_expr(stop),
+                           None if step is None else _to_expr(step)))
+
+
+def map_keys(c) -> Column:
+    from .collections import MapKeys
+    return Column(MapKeys(_to_expr(c)))
+
+
+def map_values(c) -> Column:
+    from .collections import MapValues
+    return Column(MapValues(_to_expr(c)))
+
+
+def explode(c) -> Column:
+    from .collections import Explode
+    return Column(Explode(_to_expr(c)))
+
+
+def posexplode(c) -> Column:
+    from .collections import PosExplode
+    return Column(PosExplode(_to_expr(c)))
+
+
+def _lambda(fn, n_args: int):
+    """Python callable -> LambdaFunction with fresh variables."""
+    from .collections import LambdaFunction, NamedLambdaVariable
+    import inspect
+    sig_names = list(inspect.signature(fn).parameters)
+    vs = [NamedLambdaVariable(nm) for nm in sig_names]
+    body = fn(*[Column(v) for v in vs])
+    return LambdaFunction(_to_expr(body), vs)
+
+
+def transform(c, fn) -> Column:
+    """transform(arr, x -> expr) or transform(arr, (x, i) -> expr)."""
+    from .collections import ArrayTransform
+    import inspect
+    n = len(inspect.signature(fn).parameters)
+    return Column(ArrayTransform(_to_expr(c), _lambda(fn, n)))
+
+
+def filter(c, fn) -> Column:  # noqa: A001
+    from .collections import ArrayFilter
+    return Column(ArrayFilter(_to_expr(c), _lambda(fn, 1)))
+
+
+def exists(c, fn) -> Column:
+    from .collections import ArrayExists
+    return Column(ArrayExists(_to_expr(c), _lambda(fn, 1)))
+
+
+def aggregate(c, zero, merge, finish=None) -> Column:
+    """aggregate(arr, zero, (acc, x) -> ..., acc -> ...)."""
+    from .collections import ArrayAggregate
+    m = _lambda(merge, 2)
+    f = None if finish is None else _lambda(finish, 1)
+    return Column(ArrayAggregate(_to_expr(c), _to_expr(zero), m, f))
+
+
+def collect_list(c) -> Column:
+    from .aggregates import CollectList
+    return Column(CollectList(_to_expr(c)))
+
+
+def collect_set(c) -> Column:
+    from .aggregates import CollectSet
+    return Column(CollectSet(_to_expr(c)))
+
+
+def approx_percentile(c, percentage, accuracy: int = 10000) -> Column:
+    """accuracy accepted for API parity; this implementation is exact
+    (see ApproximatePercentile docstring)."""
+    from .aggregates import ApproximatePercentile
+    return Column(ApproximatePercentile(_to_expr(c), percentage))

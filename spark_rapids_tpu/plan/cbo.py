@@ -1,0 +1,105 @@
+"""Cost-based optimizer: demote device sections not worth the transitions.
+
+Reference: CostBasedOptimizer.scala:52,282,332,435 — optional pass
+(spark.rapids.sql.optimizer.enabled) comparing CPU-vs-GPU cost models with
+per-op costs and avoiding GPU sections whose speedup doesn't cover the
+row/columnar transition cost.
+
+Model here: after tagging, find maximal convertible sections (runs of
+can_run nodes). For each section compute
+``device_benefit = sum(op_weight - op_weight/speedup)`` and
+``transition_cost = boundary_count * TRANSITION_WEIGHT``; demote the whole
+section (with a recorded reason) when the benefit doesn't cover its
+transitions. Operates purely on the meta tree so explain output shows the
+decision the same way type-gating reasons appear.
+"""
+from __future__ import annotations
+
+from typing import List
+
+from ..conf import RapidsConf, register_conf
+from .meta import ExecMeta
+
+OPTIMIZER_ENABLED = register_conf(
+    "spark.rapids.sql.optimizer.enabled",
+    "Enable the cost-based pass that keeps plan sections on the host when "
+    "the device speedup would not cover the host<->device transition cost "
+    "(reference: RapidsConf.scala:1231).", False)
+
+OPTIMIZER_SPEEDUP = register_conf(
+    "spark.rapids.sql.optimizer.deviceSpeedup",
+    "Assumed device speedup factor for the cost model.", 4.0)
+
+OPTIMIZER_TRANSITION_WEIGHT = register_conf(
+    "spark.rapids.sql.optimizer.transitionWeight",
+    "Relative cost of one host<->device transition in op-weight units.", 1.0)
+
+__all__ = ["optimize", "OPTIMIZER_ENABLED"]
+
+# single cost table shared with tools/qualification.py (relative op weights;
+# reference: the per-op speedup factor data the qualification tool ships)
+OP_WEIGHTS = {
+    "CpuHashAggregateExec": 4.0,
+    "CpuSortExec": 3.0,
+    "CpuShuffledHashJoinExec": 4.0,
+    "CpuBroadcastHashJoinExec": 3.0,
+    "CpuBroadcastNestedLoopJoinExec": 2.0,
+    "CpuGenerateExec": 2.0,
+    "CpuWindowExec": 3.0,
+    "CpuProjectExec": 1.5,
+    "CpuFilterExec": 1.5,
+    "ShuffleExchangeExec": 2.0,
+    "CpuScanExec": 2.0,
+}
+DEFAULT_WEIGHT = 1.0
+
+
+def optimize(meta: ExecMeta, conf: RapidsConf) -> ExecMeta:
+    if not conf.get(OPTIMIZER_ENABLED):
+        return meta
+    speedup = conf.get(OPTIMIZER_SPEEDUP)
+    t_weight = conf.get(OPTIMIZER_TRANSITION_WEIGHT)
+
+    sections: List[List[ExecMeta]] = []
+    _find_sections(meta, sections)
+    for section in sections:
+        weight = sum(OP_WEIGHTS.get(type(m.plan).__name__, DEFAULT_WEIGHT)
+                     for m in section)
+        boundaries = _boundary_count(section)
+        benefit = weight - weight / speedup
+        cost = boundaries * t_weight
+        if benefit < cost:
+            for m in section:
+                m.cannot_run(
+                    f"cost-based optimizer: device section of {len(section)} "
+                    f"op(s) (benefit {benefit:.1f}) not worth "
+                    f"{boundaries} transition(s) (cost {cost:.1f})")
+    return meta
+
+
+def _find_sections(meta: ExecMeta, out: List[List[ExecMeta]],
+                   in_section: List[ExecMeta] = None):
+    if meta.can_run:
+        if in_section is None:
+            in_section = []
+            out.append(in_section)
+        in_section.append(meta)
+        for c in meta.children:
+            _find_sections(c, out, in_section)
+    else:
+        for c in meta.children:
+            _find_sections(c, out, None)
+
+
+def _boundary_count(section: List[ExecMeta]) -> int:
+    ids = {id(m) for m in section}
+    n = 0
+    for m in section:
+        for c in m.children:
+            if id(c) not in ids:
+                n += 1  # device->host below
+    # one host<->device boundary above the section root (unless it's the
+    # plan root, where a download happens anyway — count it: collect() pulls
+    # results to host either way, so root costs a download too)
+    n += 1
+    return n

@@ -1,0 +1,93 @@
+"""Generate exec: explode/posexplode over arrays and maps.
+
+Reference: GpuGenerateExec.scala (631 LoC; exec rule GenerateExec,
+GpuOverrides.scala:3481ff). The CPU engine implementation; device lowering is
+gated by nested input types through the TypeSig system and falls back here
+with a recorded reason, matching the reference's per-type gating.
+"""
+from __future__ import annotations
+
+from typing import Iterator, List
+
+import numpy as np
+
+from ..columnar import dtypes as dt
+from ..columnar.host import HostColumn, HostTable
+from ..expr.base import EvalContext
+from ..expr.collections import Explode, _from_rows, _rows
+from .logical import LogicalGenerate
+from .physical import PhysicalPlan
+from .schema import Schema
+
+__all__ = ["CpuGenerateExec"]
+
+
+class CpuGenerateExec(PhysicalPlan):
+    def __init__(self, child: PhysicalPlan, node: LogicalGenerate):
+        self.child = child
+        self.children = (child,)
+        self.generator: Explode = node.generator
+        self.outer = node.outer
+        self.gen_fields = node.gen_fields
+        # build from the PHYSICAL child (column pruning may have narrowed it
+        # relative to the logical node's schema)
+        from .schema import Field
+        self.schema = Schema(
+            list(child.schema.fields)
+            + [Field(n, d, nb or self.outer) for n, d, nb in node.gen_fields])
+
+    @property
+    def num_partitions(self) -> int:
+        return self.child.num_partitions
+
+    def execute(self, pidx: int) -> Iterator[HostTable]:
+        gen_input = self.generator.children[0]
+        is_map = isinstance(gen_input.data_type, dt.MapType)
+        for batch in self.child.execute(pidx):
+            ctx = EvalContext.for_host(batch, partition_id=pidx)
+            col = gen_input.eval(ctx)
+            rows = _rows(ctx, col)
+            counts = np.fromiter(
+                (len(r) if r else (1 if self.outer else 0) for r in rows),
+                dtype=np.int64, count=len(rows))
+            row_idx = np.repeat(np.arange(len(rows), dtype=np.int64), counts)
+            # passthrough child columns
+            out_cols: List[HostColumn] = [c.take(row_idx)
+                                          for c in batch.columns]
+            # generator output columns
+            pos_out, first_out, second_out = [], [], []
+            for r in rows:
+                entries = r if r else []
+                if not entries and self.outer:
+                    pos_out.append(None)
+                    first_out.append(None)
+                    second_out.append(None)
+                    continue
+                for j, e in enumerate(entries):
+                    pos_out.append(j)
+                    if is_map:
+                        k, v = e
+                        first_out.append(k)
+                        second_out.append(v)
+                    else:
+                        first_out.append(e)
+            gen_out = []
+            fi = 0
+            if self.generator.pos:
+                name, d, nb = self.gen_fields[fi]
+                fi += 1
+                gen_out.append((name, _from_rows(pos_out, dt.INT)))
+            if is_map:
+                (kn, kd, _), (vn, vd, _) = self.gen_fields[fi], self.gen_fields[fi + 1]
+                gen_out.append((kn, _from_rows(first_out, kd)))
+                gen_out.append((vn, _from_rows(second_out, vd)))
+            else:
+                name, d, nb = self.gen_fields[fi]
+                gen_out.append((name, _from_rows(first_out, d)))
+            for name, ec in gen_out:
+                out_cols.append(HostColumn(ec.dtype, ec.values, ec.validity))
+            yield HostTable(self.schema.names, out_cols)
+
+    def node_desc(self):
+        g = "posexplode" if self.generator.pos else "explode"
+        return f"{g} outer={self.outer}"

@@ -24,6 +24,23 @@ from ..columnar.host import HostColumn, HostTable
 __all__ = ["group_codes", "host_group_reduce"]
 
 
+def object_codes(vals: np.ndarray) -> np.ndarray:
+    """factorize for object arrays; falls back to a dict-based pass when
+    pandas' C-string hashtable would conflate values differing only by an
+    embedded NUL byte ("ab" vs "ab\\x00")."""
+    has_nul = any(
+        (isinstance(v, str) and "\x00" in v)
+        or (isinstance(v, bytes) and b"\x00" in v)
+        for v in vals)
+    if not has_nul:
+        return pd.factorize(vals, use_na_sentinel=False)[0].astype(np.int64)
+    table: dict = {}
+    out = np.empty(len(vals), dtype=np.int64)
+    for i, v in enumerate(vals):
+        out[i] = table.setdefault(v, len(table))
+    return out
+
+
 def _key_codes(col: HostColumn) -> np.ndarray:
     """Per-column int64 codes: equal values (Spark grouping semantics) get
     equal codes; nulls get code 0."""
@@ -33,7 +50,7 @@ def _key_codes(col: HostColumn) -> np.ndarray:
         v[v == 0] = 0.0  # -0.0 == 0.0
         codes = pd.factorize(v, use_na_sentinel=False)[0].astype(np.int64)
     elif vals.dtype == object:
-        codes = pd.factorize(vals, use_na_sentinel=False)[0].astype(np.int64)
+        codes = object_codes(vals)
     else:
         codes = vals.astype(np.int64)
     valid = col.valid_mask()
@@ -66,14 +83,31 @@ def host_group_reduce(op: str, col: HostColumn, gid: np.ndarray, ngroups: int,
     """-> (values[ngroups], validity[ngroups] or None)."""
     valid = col.valid_mask()
     vals = col.values
-    np_out = out_dtype.np_dtype() if not isinstance(
-        out_dtype, (dt.StringType, dt.BinaryType)) else object
+    np_out = object if isinstance(
+        out_dtype, (dt.StringType, dt.BinaryType, dt.ArrayType,
+                    dt.StructType, dt.MapType)) else out_dtype.np_dtype()
     vcount = np.zeros(ngroups, dtype=np.int64)
     np.add.at(vcount, gid[valid], 1)
     has = vcount > 0
 
     if op == "count":
         return vcount.astype(np.int64), None
+
+    if op in ("collect_list", "collect_set", "merge_lists", "merge_sets"):
+        # collect aggs return [] (not null) for empty groups (Spark rule)
+        out = np.empty(ngroups, dtype=object)
+        for g in range(ngroups):
+            out[g] = []
+        if op.startswith("collect"):
+            for i in np.nonzero(valid)[0]:
+                out[gid[i]].append(vals[i])
+        else:  # merge partial lists
+            for i in np.nonzero(valid)[0]:
+                out[gid[i]].extend(vals[i])
+        if op.endswith("set") or op.endswith("sets"):
+            for g in range(ngroups):
+                out[g] = _dedupe(out[g])
+        return out, None
 
     if op in ("sum", "sumsq"):
         x = vals[valid]
@@ -110,6 +144,21 @@ def host_group_reduce(op: str, col: HostColumn, gid: np.ndarray, ngroups: int,
         np.logical_and.at(acc, gid[valid], vals[valid].astype(bool))
         return acc, has.copy()
     raise ValueError(op)
+
+
+def _dedupe(seq):
+    """First-seen dedupe; falls back to equality scans for unhashable
+    elements (structs are dicts, maps are lists host-side)."""
+    seen, res = set(), []
+    for e in seq:
+        try:
+            if e not in seen:
+                seen.add(e)
+                res.append(e)
+        except TypeError:
+            if not any(e == r for r in res):
+                res.append(e)
+    return res
 
 
 def _host_minmax(op: str, vals: np.ndarray, valid: np.ndarray,

@@ -306,3 +306,39 @@ def _check_dup(names: Sequence[str]):
     if len(set(names)) != len(names):
         dupes = sorted({n for n in names if list(names).count(n) > 1})
         raise ValueError(f"duplicate output columns: {dupes}")
+
+
+class LogicalGenerate(LogicalPlan):
+    """Generate (explode/posexplode) node: child columns + generator output
+    columns (reference: GpuGenerateExec.scala; exec rule GenerateExec in
+    GpuOverrides.scala:3481ff)."""
+
+    def __init__(self, child: LogicalPlan, generator, outer: bool = False,
+                 aliases=None):
+        from ..expr.collections import Explode
+        self.child = child
+        self.children = (child,)
+        cs = child.schema
+        gen = resolve_expression(generator, cs.to_dict(), cs.nullable_dict())
+        if not isinstance(gen, Explode):
+            raise TypeError(f"unsupported generator {generator!r}")
+        self.generator = gen
+        self.outer = outer
+        fields = gen.output_fields()
+        if aliases:
+            if len(aliases) != len(fields):
+                raise ValueError(
+                    f"generator yields {len(fields)} columns, "
+                    f"{len(aliases)} aliases given")
+            fields = [(a, d, nb) for a, (_, d, nb) in zip(aliases, fields)]
+        self.gen_fields = fields
+        dup = set(cs.names) & {n for n, _, _ in fields}
+        if dup:
+            raise ValueError(f"generator output shadows child columns: {dup}")
+
+    @property
+    def schema(self) -> Schema:
+        fields = list(self.child.schema.fields)
+        # outer explode emits a null element row for empty/null input
+        fields += [Field(n, d, nb or self.outer) for n, d, nb in self.gen_fields]
+        return Schema(fields)

@@ -86,6 +86,13 @@ class ExecMeta(BaseMeta):
         for f in self.plan.schema:
             for r in self.rule.output_sig.reasons_not_supported(f.dtype):
                 self.cannot_run(f"output column {f.name}: {r}")
+        # input schema type check (reference: ExecChecks input sigs,
+        # TypeChecks.scala:702) — a host->device transition uploads the whole
+        # child batch, so unsupported child columns block device lowering
+        for child_plan in self.plan.children:
+            for f in child_plan.schema:
+                for r in self.rule.output_sig.reasons_not_supported(f.dtype):
+                    self.cannot_run(f"input column {f.name}: {r}")
         for em in self.expr_metas:
             em.tag(conf)
             if not em.can_run:

@@ -257,10 +257,9 @@ def _register_exec_rules():
         p: CpuHashAggregateExec = meta.plan
         for k in p.key_names:
             kt = p.child.schema.field(k).dtype
-            if isinstance(kt, (dt.StringType, dt.BinaryType)):
-                meta.cannot_run(
-                    f"group-by key {k}: string keys not yet supported on device")
-            elif not _device_common.is_supported(kt):
+            # string keys group via packed uint64 surrogate words
+            # (exec/aggregate.py _key_code_words)
+            if not _device_all.is_supported(kt):
                 meta.cannot_run(f"group-by key {k}: {kt!r} not supported")
         for s in p.specs:
             for (n, d, _) in s.state_fields:
@@ -379,9 +378,9 @@ def _register_exec_rules():
     def tag_sort(meta, conf):
         from ..udf import tree_has_python_udf
         p: CpuSortExec = meta.plan
+        # string keys sort via packed uint64 surrogate words
+        # (columnar/device.py pack_string_key_words)
         for o in p.orders:
-            if isinstance(o.expr.data_type, (dt.StringType, dt.BinaryType)):
-                meta.cannot_run("string sort keys not yet supported on device")
             if tree_has_python_udf(o.expr):
                 meta.cannot_run("interpreted Python UDF in sort key")
 
@@ -413,6 +412,8 @@ def apply_overrides(cpu_plan: PhysicalPlan, conf: RapidsConf) -> PhysicalPlan:
         compile_plan_udfs(cpu_plan)
     meta = wrap_plan(cpu_plan)
     meta.tag(conf)
+    from .cbo import optimize
+    optimize(meta, conf)  # reference: optional CostBasedOptimizer pass
     if conf.explain != "NONE":
         text = meta.explain(not_on_device_only=(conf.explain == "NOT_ON_GPU"))
         if text:

@@ -74,7 +74,8 @@ class PhysicalPlan:
 
 
 def _empty_values(d: dt.DataType) -> np.ndarray:
-    if isinstance(d, (dt.StringType, dt.BinaryType)):
+    if isinstance(d, (dt.StringType, dt.BinaryType, dt.ArrayType,
+                      dt.StructType, dt.MapType)):
         return np.empty(0, dtype=object)
     return np.empty(0, dtype=d.np_dtype())
 
@@ -92,6 +93,8 @@ def host_eval_exprs(table: HostTable, exprs: Sequence[Expression],
             values = np.asarray(values)
         if isinstance(c.dtype, dt.BooleanType) and values.dtype != np.bool_:
             values = values.astype(np.bool_)
+        elif isinstance(c.dtype, (dt.ArrayType, dt.StructType, dt.MapType)):
+            pass  # nested values stay python objects host-side
         elif values.dtype != c.dtype.np_dtype() and values.dtype != object:
             values = values.astype(c.dtype.np_dtype())
         cols.append(HostColumn(c.dtype, values, c.validity))
@@ -366,7 +369,9 @@ class CpuHashAggregateExec(PhysicalPlan):
         for in_col, op, out_col, out_dt in cols_ops:
             vals, validity = host_group_reduce(op, table.column(in_col), gid,
                                                ngroups, out_dt)
-            if not isinstance(out_dt, (dt.StringType, dt.BinaryType)) \
+            if not isinstance(out_dt, (dt.StringType, dt.BinaryType,
+                                       dt.ArrayType, dt.StructType,
+                                       dt.MapType)) \
                     and vals.dtype != out_dt.np_dtype():
                 with np.errstate(invalid="ignore"):
                     vals = vals.astype(out_dt.np_dtype())

@@ -41,7 +41,10 @@ def _factorize_pair(lt: HostTable, rt: HostTable, lkeys: Sequence[str],
             if combined.dtype.kind == "f":
                 combined = combined.copy()
                 combined[combined == 0] = 0.0
-            codes = pd.factorize(combined, use_na_sentinel=False)[0]
+                codes = pd.factorize(combined, use_na_sentinel=False)[0]
+            else:
+                from .host_groupby import object_codes
+                codes = object_codes(combined)
             lcodes[f"k{i}"] = codes[:lt.num_rows]
             rcodes[f"k{i}"] = codes[lt.num_rows:]
         else:

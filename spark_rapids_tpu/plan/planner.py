@@ -119,6 +119,14 @@ def _plan(node: LogicalPlan, conf: RapidsConf,
         from .joins_planner import plan_join
         return plan_join(node, conf, required, _plan, nparts)
 
+    from .logical import LogicalGenerate
+    if isinstance(node, LogicalGenerate):
+        from .generate import CpuGenerateExec
+        child_req = None if required is None \
+            else required | node.generator.references()
+        child = _plan(node.child, conf, child_req)
+        return CpuGenerateExec(child, node)
+
     raise NotImplementedError(type(node).__name__)
 
 

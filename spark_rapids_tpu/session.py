@@ -124,7 +124,52 @@ class DataFrame:
     # -- transformations -----------------------------------------------------
     def select(self, *cols) -> "DataFrame":
         exprs = [self._col_expr(c) for c in cols]
+        gen = self._split_generator(exprs)
+        if gen is not None:
+            return gen
         return self._project_with_windows(exprs)
+
+    def _split_generator(self, exprs: List[Expression]):
+        """select(..., explode(arr).alias(x), ...) -> Generate + project
+        (Spark allows one generator per select clause)."""
+        from .expr.base import Alias, AttributeReference
+        from .expr.collections import Explode
+        from .plan.logical import LogicalGenerate
+
+        def top_gen(e):
+            if isinstance(e, Explode):
+                return e, None
+            if isinstance(e, Alias) and isinstance(e.child, Explode):
+                return e.child, e.name
+            return None, None
+
+        hits = [(i, *top_gen(e)) for i, e in enumerate(exprs)]
+        hits = [(i, g, a) for i, g, a in hits if g is not None]
+        if not hits:
+            return None
+        if len(hits) > 1:
+            raise ValueError("only one generator (explode/posexplode) is "
+                             "allowed per select clause")
+        i, gen, alias = hits[0]
+        # generate under INTERNAL names so a user alias may legally shadow a
+        # source column (the final projection drops the original)
+        probe = LogicalGenerate(self.logical, gen, outer=False)
+        defaults = [n for n, _, _ in probe.gen_fields]
+        if alias is not None and len(defaults) != 1:
+            raise ValueError(
+                f"generator yields {len(defaults)} columns "
+                f"({defaults}); a single alias cannot name them")
+        internals = [f"__gen{j}_{n}" for j, n in enumerate(defaults)]
+        base = LogicalGenerate(self.logical, gen, outer=False,
+                               aliases=internals)
+        out = [Alias(AttributeReference(int_n),
+                     alias if alias is not None and len(defaults) == 1 else n)
+               for int_n, n in zip(internals, defaults)]
+        final: List[Expression] = list(exprs)
+        final[i:i + 1] = out
+        # remaining exprs may contain window expressions — route through the
+        # same splitter plain select uses
+        return DataFrame(self.session, base)._project_with_windows(final)
 
     def _project_with_windows(self, exprs: List[Expression]) -> "DataFrame":
         """Pull top-level window expressions into stacked LogicalWindow nodes
@@ -214,6 +259,18 @@ class DataFrame:
 
     def limit(self, n: int) -> "DataFrame":
         return DataFrame(self.session, LogicalLimit(self.logical, n))
+
+    def explode(self, c, *aliases, outer: bool = False,
+                pos: bool = False) -> "DataFrame":
+        """Append explode/posexplode output columns (reference:
+        GpuGenerateExec). ``outer=True`` keeps rows with null/empty input."""
+        from .expr.collections import Explode, PosExplode
+        from .plan.logical import LogicalGenerate
+        e = self._col_expr(c)
+        gen = PosExplode(e) if pos else Explode(e)
+        return DataFrame(self.session,
+                         LogicalGenerate(self.logical, gen, outer,
+                                         list(aliases) or None))
 
     def distinct(self) -> "DataFrame":
         """Row dedup = zero-aggregate group-by over all columns (the planner

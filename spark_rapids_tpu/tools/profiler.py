@@ -1,0 +1,143 @@
+"""Profiling tool: per-operator wall time + runtime health report.
+
+Reference: tools/ ProfileMain / Profiler (tools/.../profiling/Profiler.scala:
+32,436) — replays Spark event logs into executor/app/SQL-metric reports plus
+a HealthCheck. Standalone equivalent: wrap a live plan execution, time every
+physical node, and fold in the runtime's own health signals (spill counts,
+semaphore waits) — the data the reference mines from event logs, captured at
+the source instead.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from typing import Dict, List, Optional
+
+from ..conf import RapidsConf
+
+__all__ = ["profile_query", "QueryProfile"]
+
+
+@dataclasses.dataclass
+class NodeStats:
+    name: str
+    desc: str
+    depth: int
+    wall_s: float = 0.0
+    rows: int = 0
+    batches: int = 0
+
+
+@dataclasses.dataclass
+class QueryProfile:
+    nodes: List[NodeStats]
+    total_s: float
+    spill: Dict
+    semaphore: Dict
+
+    def summary(self) -> str:
+        lines = [f"total wall time: {self.total_s:.4f}s", "",
+                 f"{'op':<44}{'time_s':>9}{'rows':>12}{'batches':>9}"]
+        for n in self.nodes:
+            label = ("  " * n.depth + n.name)[:43]
+            lines.append(f"{label:<44}{n.wall_s:>9.4f}{n.rows:>12}"
+                         f"{n.batches:>9}")
+        lines.append("")
+        lines.append(f"spill: {self.spill}")
+        lines.append(f"semaphore: {self.semaphore}")
+        return "\n".join(lines)
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "total_s": self.total_s,
+            "nodes": [dataclasses.asdict(n) for n in self.nodes],
+            "spill": self.spill,
+            "semaphore": self.semaphore,
+        })
+
+    def health_check(self) -> List[str]:
+        """Reference: HealthCheck — flag suspicious signals."""
+        warnings = []
+        if self.spill.get("spill_count"):
+            sc = self.spill["spill_count"]
+            if any(sc.values()):
+                warnings.append(
+                    f"device memory pressure: spills occurred ({sc}) — "
+                    "consider a larger pool or smaller batch size")
+        wait = self.semaphore.get("total_wait_time", 0.0)
+        if self.total_s > 0 and wait > 0.25 * self.total_s:
+            warnings.append(
+                f"semaphore wait is {wait / self.total_s:.0%} of wall time — "
+                "tasks are serialized on the chip; lower parallelism or raise "
+                "concurrentGpuTasks")
+        slowest = max(self.nodes, key=lambda n: n.wall_s, default=None)
+        if slowest and self.total_s > 0 and slowest.wall_s > 0.8 * self.total_s:
+            warnings.append(
+                f"{slowest.name} dominates ({slowest.wall_s:.2f}s) — "
+                "check its explain tagging for fallback reasons")
+        return warnings
+
+
+def profile_query(df, device: Optional[bool] = None) -> QueryProfile:
+    """Execute ``df.collect(device=...)`` with every physical node's
+    ``execute``/``execute_columnar`` wrapped in timers."""
+    from ..memory.catalog import get_catalog
+    from ..memory.semaphore import get_semaphore
+
+    plan = df.session._physical(df.logical, device)
+    stats: List[NodeStats] = []
+
+    def wrap(node, depth: int):
+        ns = NodeStats(type(node).__name__,
+                       getattr(node, "node_desc", lambda: "")(), depth)
+        stats.append(ns)
+        # wrap exactly one method per node: device execs route execute()
+        # through execute_columnar(), so wrapping both would double-count
+        from ..exec.base import TpuExec
+        attrs = ("execute_columnar",) if isinstance(node, TpuExec) \
+            else ("execute",)
+        for attr in attrs:
+            fn = getattr(node, attr, None)
+            if fn is None:
+                continue
+
+            def timed(pidx, _fn=fn, _ns=ns):
+                t0 = time.perf_counter()
+                try:
+                    for batch in _fn(pidx):
+                        _ns.wall_s += time.perf_counter() - t0
+                        _ns.batches += 1
+                        _ns.rows += int(batch.num_rows)
+                        yield batch
+                        t0 = time.perf_counter()
+                finally:
+                    _ns.wall_s += time.perf_counter() - t0
+
+            setattr(node, attr, timed)
+        for c in node.children:
+            wrap(c, depth + 1)
+
+    wrap(plan, 0)
+    # snapshot the process-global counters so the report shows THIS query's
+    # deltas, not lifetime totals
+    cat = get_catalog()
+    sem = get_semaphore()
+    spill_before = dict(cat.spill_count)
+    bytes_before = dict(cat.spilled_bytes)
+    wait_before = sem.total_wait_time
+    acq_before = sem.acquire_count
+
+    t0 = time.perf_counter()
+    plan.collect()
+    total = time.perf_counter() - t0
+
+    spill = {
+        "spill_count": {str(k): v - spill_before.get(k, 0)
+                        for k, v in cat.spill_count.items()},
+        "spilled_bytes": {str(k): v - bytes_before.get(k, 0)
+                          for k, v in cat.spilled_bytes.items()},
+    }
+    semaphore = {"total_wait_time": sem.total_wait_time - wait_before,
+                 "acquire_count": sem.acquire_count - acq_before}
+    return QueryProfile(stats, total, spill, semaphore)

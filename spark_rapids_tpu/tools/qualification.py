@@ -1,0 +1,79 @@
+"""Qualification tool: score a workload's device suitability.
+
+Reference: tools/ QualificationMain / QualificationAppInfo
+(tools/.../qualification/Qualification.scala:34) — scores CPU Spark apps for
+GPU suitability using PluginTypeChecker against the supported-ops data. The
+reference replays event logs; this framework is standalone, so qualification
+walks the query plan directly through the SAME meta/tagging layer the device
+lowering uses (plan/meta.py) — the score can't drift from what the engine
+actually supports.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from ..conf import RapidsConf
+from ..plan.meta import wrap_plan
+from ..plan.planner import plan_physical
+
+__all__ = ["qualify", "QualificationReport"]
+
+# cost model shared with the cost-based optimizer so the qualification score
+# and the CBO demotion decision can't drift apart
+from ..plan.cbo import DEFAULT_WEIGHT as _DEFAULT_WEIGHT
+from ..plan.cbo import OP_WEIGHTS as _OP_WEIGHTS
+from ..plan.cbo import OPTIMIZER_SPEEDUP as _OPTIMIZER_SPEEDUP
+
+
+@dataclasses.dataclass
+class QualificationReport:
+    score: float                       # 0..1 weighted device-runnable share
+    total_ops: int
+    supported_ops: int
+    per_op: List[Tuple[str, bool, str]]   # (name, supported, reasons)
+    estimated_speedup: float
+
+    def summary(self) -> str:
+        lines = [
+            f"qualification score : {self.score:.2f}",
+            f"device-runnable ops : {self.supported_ops}/{self.total_ops}",
+            f"estimated speedup   : {self.estimated_speedup:.2f}x",
+            "",
+        ]
+        for name, ok, reasons in self.per_op:
+            mark = "+" if ok else "!"
+            lines.append(f"  {mark} {name}" + (f" — {reasons}" if reasons else ""))
+        return "\n".join(lines)
+
+
+def qualify(df, conf: Optional[RapidsConf] = None) -> QualificationReport:
+    """Score one DataFrame's plan. ``df`` may also be a logical plan."""
+    logical = getattr(df, "logical", df)
+    session_conf = getattr(getattr(df, "session", None), "conf", None)
+    conf = conf or session_conf or RapidsConf()
+    cpu = plan_physical(logical, conf)
+    meta = wrap_plan(cpu)
+    meta.tag(conf)
+
+    per_op: List[Tuple[str, bool, str]] = []
+    w_total = w_ok = 0.0
+    n_total = n_ok = 0
+    for m in meta.walk():
+        name = type(m.plan).__name__
+        w = _OP_WEIGHTS.get(name, _DEFAULT_WEIGHT)
+        ok = m.can_run
+        w_total += w
+        n_total += 1
+        if ok:
+            w_ok += w
+            n_ok += 1
+        per_op.append((name, ok, "; ".join(m.reasons)))
+
+    score = (w_ok / w_total) if w_total else 0.0
+    # crude amdahl: device section accelerated by the configured speedup
+    # (default mirrors the reference's "4x typical", docs/FAQ.md:100-106),
+    # host remainder at 1x
+    speedup = conf.get(_OPTIMIZER_SPEEDUP)
+    est = 1.0 / ((1.0 - score) + score / speedup) if w_total else 1.0
+    return QualificationReport(score, n_total, n_ok, per_op, est)

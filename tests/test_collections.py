@@ -1,0 +1,264 @@
+"""Complex types: arrays/structs/maps, collection ops, higher-order
+functions, explode, collect_list/set, approx_percentile.
+
+Reference test analogues: integration_tests array_test.py / map_test.py /
+struct_test.py / collection_ops_test.py / generate_expr_test.py.
+
+These ops are host-engine; the device plan must FALL BACK with a recorded
+reason and still produce identical results (the reference's fallback
+assertion pattern, asserts.py:361 assert_gpu_fallback_collect).
+"""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu.expr.functions as F
+from spark_rapids_tpu.expr.functions import col, lit
+from harness import assert_tpu_cpu_equal
+
+
+@pytest.fixture()
+def adf(session):
+    t = pa.table({
+        "id": [1, 2, 3, 4],
+        "arr": pa.array([[1, 2, 3], [], None, [4, None, 6]],
+                        type=pa.list_(pa.int64())),
+        "darr": pa.array([[1.5, float("nan"), 0.5], [2.0], None, []],
+                         type=pa.list_(pa.float64())),
+    })
+    return session.create_dataframe(t, num_partitions=2)
+
+
+def test_roundtrip_nested(session):
+    t = pa.table({
+        "a": pa.array([[1, 2], None, [3]], type=pa.list_(pa.int64())),
+        "s": pa.array([{"x": 1, "y": "a"}, {"x": 2, "y": None}, None],
+                      type=pa.struct([("x", pa.int64()), ("y", pa.string())])),
+        "m": pa.array([[("k1", 1)], [], None],
+                      type=pa.map_(pa.string(), pa.int64())),
+    })
+    df = session.create_dataframe(t)
+    out = df.collect(device=False)
+    assert out.column("a").to_pylist() == [[1, 2], None, [3]]
+    assert out.column("s").to_pylist()[1] == {"x": 2, "y": None}
+    assert out.column("m").to_pylist() == [[("k1", 1)], [], None]
+
+
+def test_size_and_element_at(adf):
+    q = adf.select(
+        col("id"),
+        F.size(col("arr")).alias("sz"),
+        F.element_at(col("arr"), 1).alias("e1"),
+        F.element_at(col("arr"), -1).alias("em1"),
+        F.element_at(col("arr"), 99).alias("oob"))
+    out = assert_tpu_cpu_equal(q, ignore_order=False)
+    assert out.column("sz").to_pylist() == [3, 0, -1, 3]
+    assert out.column("e1").to_pylist() == [1, None, None, 4]
+    assert out.column("em1").to_pylist() == [3, None, None, 6]
+    assert out.column("oob").to_pylist() == [None] * 4
+
+
+def test_get_item_and_contains(adf):
+    q = adf.select(
+        col("arr")[0].alias("a0"),
+        F.array_contains(col("arr"), 2).alias("has2"),
+        F.array_contains(col("arr"), 99).alias("has99"),
+        F.array_position(col("arr"), 6).alias("p6"))
+    out = assert_tpu_cpu_equal(q, ignore_order=False)
+    assert out.column("a0").to_pylist() == [1, None, None, 4]
+    assert out.column("has2").to_pylist() == [True, False, None, None]
+    # arr row 3 contains a null and no 99 -> unknown (null)
+    assert out.column("has99").to_pylist() == [False, False, None, None]
+    assert out.column("p6").to_pylist() == [0, 0, None, 3]
+
+
+def test_min_max_sort_distinct(adf):
+    q = adf.select(
+        F.array_min(col("arr")).alias("mn"),
+        F.array_max(col("arr")).alias("mx"),
+        F.array_min(col("darr")).alias("dmn"),
+        F.array_max(col("darr")).alias("dmx"),
+        F.sort_array(col("arr")).alias("sorted"),
+        F.sort_array(col("arr"), asc=False).alias("rsorted"))
+    out = assert_tpu_cpu_equal(q, ignore_order=False)
+    assert out.column("mn").to_pylist() == [1, None, None, 4]
+    assert out.column("mx").to_pylist() == [3, None, None, 6]
+    assert out.column("dmn").to_pylist() == [0.5, 2.0, None, None]
+    # NaN is greatest in Spark's total order
+    dmx = out.column("dmx").to_pylist()
+    assert np.isnan(dmx[0]) and dmx[1] == 2.0
+    assert out.column("sorted").to_pylist() == \
+        [[1, 2, 3], [], None, [None, 4, 6]]
+    assert out.column("rsorted").to_pylist() == \
+        [[3, 2, 1], [], None, [6, 4, None]]
+
+
+def test_create_array_struct_map(session):
+    t = pa.table({"a": [1, 2], "b": [10.5, 20.5], "s": ["x", "y"]})
+    df = session.create_dataframe(t)
+    q = df.select(
+        F.array(col("a"), col("a") + lit(1)).alias("arr"),
+        F.named_struct("k", col("a"), "v", col("s")).alias("st"),
+        F.create_map(col("s"), col("b")).alias("mp"))
+    out = assert_tpu_cpu_equal(q, ignore_order=False)
+    assert out.column("arr").to_pylist() == [[1, 2], [2, 3]]
+    assert out.column("st").to_pylist() == [{"k": 1, "v": "x"},
+                                            {"k": 2, "v": "y"}]
+    assert out.column("mp").to_pylist() == [[("x", 10.5)], [("y", 20.5)]]
+    q2 = df.select(F.named_struct("k", col("a"), "v", col("s")).alias("st")) \
+        .select(col("st").getField("v").alias("v"))
+    out2 = assert_tpu_cpu_equal(q2, ignore_order=False)
+    assert out2.column("v").to_pylist() == ["x", "y"]
+
+
+def test_flatten_slice_sequence_repeat(session):
+    t = pa.table({
+        "nested": pa.array([[[1, 2], [3]], [[4]], None, [[5], None]],
+                           type=pa.list_(pa.list_(pa.int64()))),
+        "n": [1, 2, 3, 4],
+    })
+    df = session.create_dataframe(t)
+    q = df.select(
+        F.flatten(col("nested")).alias("flat"),
+        F.sequence(lit(1), col("n")).alias("seq"),
+        F.array_repeat(col("n"), lit(2)).alias("rep"))
+    out = assert_tpu_cpu_equal(q, ignore_order=False)
+    assert out.column("flat").to_pylist() == [[1, 2, 3], [4], None, None]
+    assert out.column("seq").to_pylist() == [[1], [1, 2], [1, 2, 3],
+                                             [1, 2, 3, 4]]
+    assert out.column("rep").to_pylist() == [[1, 1], [2, 2], [3, 3], [4, 4]]
+    q2 = df.select(F.slice(F.sequence(lit(1), lit(10)), col("n"), lit(2))
+                   .alias("sl"))
+    out2 = assert_tpu_cpu_equal(q2, ignore_order=False)
+    assert out2.column("sl").to_pylist() == [[1, 2], [2, 3], [3, 4], [4, 5]]
+
+
+def test_higher_order_functions(adf):
+    q = adf.select(
+        col("id"),
+        F.transform(col("arr"), lambda x: x * lit(10)).alias("t"),
+        F.transform(col("arr"), lambda x, i: x + i).alias("ti"),
+        F.filter(col("arr"), lambda x: x > lit(1)).alias("f"),
+        F.exists(col("arr"), lambda x: x == lit(2)).alias("ex"),
+        F.aggregate(col("arr"), lit(0), lambda acc, x: acc + x).alias("agg"))
+    out = assert_tpu_cpu_equal(q, ignore_order=False)
+    assert out.column("t").to_pylist() == [[10, 20, 30], [], None,
+                                           [40, None, 60]]
+    assert out.column("ti").to_pylist() == [[1, 3, 5], [], None,
+                                            [4, None, 8]]
+    assert out.column("f").to_pylist() == [[2, 3], [], None, [4, 6]]
+    assert out.column("ex").to_pylist() == [True, False, None, None]
+    # null element -> null fold result (acc + null = null)
+    assert out.column("agg").to_pylist() == [6, 0, None, None]
+
+
+def test_aggregate_with_finish(adf):
+    q = adf.select(
+        F.aggregate(col("darr"), lit(0.0), lambda acc, x: acc + x,
+                    lambda acc: acc * lit(2.0)).alias("dbl"))
+    out = q.collect(device=False)
+    got = out.column("dbl").to_pylist()
+    assert got[1] == 4.0 and got[3] == 0.0
+
+
+def test_explode_method_and_select(session):
+    t = pa.table({
+        "id": [1, 2, 3],
+        "arr": pa.array([[10, 20], [], None], type=pa.list_(pa.int64())),
+    })
+    df = session.create_dataframe(t, num_partitions=2)
+    out = assert_tpu_cpu_equal(df.explode("arr", "e"), ignore_order=False)
+    assert out.column("id").to_pylist() == [1, 1]
+    assert out.column("e").to_pylist() == [10, 20]
+    # outer keeps empty/null rows with null element
+    outer = assert_tpu_cpu_equal(df.explode("arr", "e", outer=True),
+                                 ignore_order=False)
+    assert outer.column("id").to_pylist() == [1, 1, 2, 3]
+    assert outer.column("e").to_pylist() == [10, 20, None, None]
+    # posexplode
+    pos = assert_tpu_cpu_equal(df.explode("arr", pos=True),
+                               ignore_order=False)
+    assert pos.column("pos").to_pylist() == [0, 1]
+    assert pos.column("col").to_pylist() == [10, 20]
+    # select-embedded explode
+    sel = assert_tpu_cpu_equal(
+        session.create_dataframe(t).select(
+            col("id"), F.explode(col("arr")).alias("x")),
+        ignore_order=False)
+    assert sel.column_names == ["id", "x"]
+    assert sel.column("x").to_pylist() == [10, 20]
+
+
+def test_explode_map(session):
+    t = pa.table({
+        "id": [1, 2],
+        "m": pa.array([[("a", 1), ("b", 2)], []],
+                      type=pa.map_(pa.string(), pa.int64())),
+    })
+    df = session.create_dataframe(t)
+    out = assert_tpu_cpu_equal(df.explode("m"), ignore_order=False)
+    assert out.column("key").to_pylist() == ["a", "b"]
+    assert out.column("value").to_pylist() == [1, 2]
+
+
+def test_collect_list_set(session):
+    rng = np.random.default_rng(5)
+    t = pa.table({
+        "k": rng.integers(0, 4, 200),
+        "v": rng.integers(0, 10, 200),
+    })
+    df = session.create_dataframe(t, num_partitions=3)
+    q = df.group_by("k").agg(F.collect_list(col("v")).alias("lst"),
+                             F.collect_set(col("v")).alias("st"))
+    out = assert_tpu_cpu_equal(q)
+    pdf = t.to_pandas()
+    for k, lst, st in zip(out.column("k").to_pylist(),
+                          out.column("lst").to_pylist(),
+                          out.column("st").to_pylist()):
+        exp = pdf[pdf.k == k].v.tolist()
+        assert sorted(lst) == sorted(exp)
+        assert sorted(st) == sorted(set(exp))
+
+
+def test_approx_percentile(session):
+    rng = np.random.default_rng(6)
+    t = pa.table({
+        "k": rng.integers(0, 3, 500),
+        "v": rng.normal(size=500),
+    })
+    df = session.create_dataframe(t, num_partitions=2)
+    q = df.group_by("k").agg(
+        F.approx_percentile(col("v"), 0.5).alias("med"),
+        F.approx_percentile(col("v"), [0.25, 0.75]).alias("iqr"))
+    out = assert_tpu_cpu_equal(q)
+    pdf = t.to_pandas()
+    for k, med, iqr in zip(out.column("k").to_pylist(),
+                           out.column("med").to_pylist(),
+                           out.column("iqr").to_pylist()):
+        vals = np.sort(pdf[pdf.k == k].v.to_numpy())
+        assert med == pytest.approx(vals[round(0.5 * (len(vals) - 1))])
+        assert len(iqr) == 2 and iqr[0] <= med <= iqr[1]
+
+
+def test_device_plan_falls_back_with_reason(adf):
+    q = adf.select(F.size(col("arr")).alias("sz"))
+    text = q.explain("tpu")
+    assert "cannot run on TPU" in text
+    # and the device-path collect still works via fallback
+    out = q.collect(device=True)
+    assert out.column("sz").to_pylist() == [3, 0, -1, 3]
+
+
+def test_map_keys_values(session):
+    t = pa.table({
+        "m": pa.array([[("a", 1)], [("b", 2), ("c", 3)], None],
+                      type=pa.map_(pa.string(), pa.int64())),
+    })
+    df = session.create_dataframe(t)
+    q = df.select(F.map_keys(col("m")).alias("ks"),
+                  F.map_values(col("m")).alias("vs"),
+                  F.element_at(col("m"), lit("b")).alias("b"))
+    out = assert_tpu_cpu_equal(q, ignore_order=False)
+    assert out.column("ks").to_pylist() == [["a"], ["b", "c"], None]
+    assert out.column("vs").to_pylist() == [[1], [2, 3], None]
+    assert out.column("b").to_pylist() == [None, 2, None]

@@ -1,0 +1,95 @@
+"""Ops tooling: qualification scorer, profiler, cost-based optimizer
+(reference: tools/ QualificationMain + ProfileMain, CostBasedOptimizer)."""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.expr.functions import col, lit, sum as fsum
+from spark_rapids_tpu.tools.qualification import qualify
+from spark_rapids_tpu.tools.profiler import profile_query
+
+
+@pytest.fixture()
+def numeric_df(session):
+    rng = np.random.default_rng(3)
+    t = pa.table({"k": rng.integers(0, 10, 4000),
+                  "v": rng.normal(size=4000)})
+    return session.create_dataframe(t, num_partitions=2)
+
+
+def test_qualify_all_device(numeric_df):
+    q = numeric_df.filter(col("v") > lit(0.0)) \
+        .group_by("k").agg(fsum(col("v")).alias("s"))
+    rep = qualify(q)
+    assert 0.0 < rep.score <= 1.0
+    assert rep.supported_ops > 0
+    assert rep.estimated_speedup > 1.0
+    assert "qualification score" in rep.summary()
+
+
+def test_qualify_unsupported_ops(session):
+    import spark_rapids_tpu.expr.functions as F
+    t = pa.table({"arr": pa.array([[1, 2]], type=pa.list_(pa.int64()))})
+    df = session.create_dataframe(t).select(F.size(col("arr")).alias("s"))
+    rep = qualify(df)
+    assert rep.supported_ops < rep.total_ops
+    bad = [r for _, ok, r in rep.per_op if not ok]
+    assert any(r for r in bad)
+
+
+def test_profiler(numeric_df):
+    q = numeric_df.filter(col("v") > lit(0.0)) \
+        .group_by("k").agg(fsum(col("v")).alias("s"))
+    prof = profile_query(q, device=True)
+    assert prof.total_s > 0
+    assert any(n.rows > 0 for n in prof.nodes)
+    names = [n.name for n in prof.nodes]
+    assert any("Scan" in n or "Tpu" in n or "Cpu" in n for n in names)
+    assert "total wall time" in prof.summary()
+    prof.to_json()
+    assert isinstance(prof.health_check(), list)
+
+
+def test_profiler_results_still_correct(numeric_df):
+    q = numeric_df.group_by("k").agg(fsum(col("v")).alias("s"))
+    prof = profile_query(q, device=False)
+    total_rows_out = [n for n in prof.nodes if n.depth == 0][0].rows
+    assert total_rows_out == 10
+
+
+def test_cbo_demotes_small_sections(session):
+    rng = np.random.default_rng(4)
+    t = pa.table({"v": rng.normal(size=100)})
+    df = session.create_dataframe(t)
+    q = df.filter(col("v") > lit(0.0))  # one tiny device op
+    base = session.conf
+    try:
+        session.conf = session.conf.set(
+            "spark.rapids.sql.optimizer.enabled", True).set(
+            "spark.rapids.sql.optimizer.transitionWeight", 100.0)
+        text = q.explain("tpu")  # explain path doesn't run cbo; check collect
+        out = q.collect(device=True)
+        exp = q.collect(device=False)
+        assert out.num_rows == exp.num_rows
+        # with absurd transition weight, the section must be demoted: the
+        # device plan prints no Tpu nodes
+        plan = session._physical(q.logical, device=True)
+        assert "Tpu" not in plan.tree_string()
+    finally:
+        session.conf = base
+
+
+def test_cbo_keeps_big_sections(session):
+    rng = np.random.default_rng(5)
+    t = pa.table({"k": rng.integers(0, 5, 1000), "v": rng.normal(size=1000)})
+    df = session.create_dataframe(t)
+    q = df.filter(col("v") > lit(0.0)).group_by("k") \
+        .agg(fsum(col("v")).alias("s"))
+    base = session.conf
+    try:
+        session.conf = session.conf.set(
+            "spark.rapids.sql.optimizer.enabled", True)
+        plan = session._physical(q.logical, device=True)
+        assert "Tpu" in plan.tree_string()
+    finally:
+        session.conf = base
