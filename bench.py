@@ -26,18 +26,73 @@ def _best(fn, n=3):
     return min(ts)
 
 
-def main():
-    sf = float(os.environ.get("BENCH_SF", "0.5"))
-    rows = int(6_000_000 * sf)
+def _probe_tpu(timeout_s: float = 150.0) -> bool:
+    """Check TPU backend availability in a killable subprocess.
+
+    The axon tunnel can HANG (not just error) at init; probing in a
+    subprocess with a timeout keeps bench.py itself from ever blocking."""
+    import subprocess
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; jax.devices(); print(jax.default_backend())"],
+            capture_output=True, text=True, timeout=timeout_s)
+        ok = r.returncode == 0 and r.stdout.strip() not in ("", "cpu")
+        if not ok:
+            print(f"# tpu probe rc={r.returncode} "
+                  f"out={r.stdout.strip()!r} err_tail={r.stderr[-200:]!r}",
+                  file=sys.stderr)
+        return ok
+    except subprocess.TimeoutExpired:
+        print(f"# tpu probe timed out after {timeout_s}s", file=sys.stderr)
+        return False
+
+
+def _init_backend():
+    """Initialize a JAX backend, surviving flaky TPU (axon tunnel) init.
+
+    The axon tunnel admits one process; transient UNAVAILABLE/hang at
+    startup is expected under contention. Bounded subprocess probes, then
+    fall back to the CPU backend so the bench still produces a number
+    (flagged in the metric name) instead of a traceback."""
     import jax
+
     if os.environ.get("BENCH_PLATFORM"):  # e.g. cpu — env JAX_PLATFORMS is
         jax.config.update("jax_platforms",  # ignored under the axon plugin
                           os.environ["BENCH_PLATFORM"])
+        return jax.default_backend(), False
+
+    for attempt in range(2):
+        if _probe_tpu():
+            try:
+                return jax.default_backend(), False
+            except RuntimeError as e:
+                print(f"# backend init failed post-probe: {e}",
+                      file=sys.stderr)
+                try:
+                    from jax.extend import backend as _jb
+                    _jb.clear_backends()
+                except Exception:
+                    pass
+        time.sleep(15.0 * (attempt + 1))
+    print("# falling back to CPU backend after TPU init failure",
+          file=sys.stderr)
+    try:
+        from jax.extend import backend as _jb
+        _jb.clear_backends()
+    except Exception:
+        pass
+    jax.config.update("jax_platforms", "cpu")
+    return jax.default_backend(), True
+
+
+def main():
+    sf = float(os.environ.get("BENCH_SF", "0.5"))
+    rows = int(6_000_000 * sf)
+    backend, fell_back = _init_backend()
     import pyarrow as pa
     from spark_rapids_tpu.session import TpuSession
     from spark_rapids_tpu.tools import tpch
-
-    backend = jax.default_backend()
     lineitem = tpch.gen_lineitem(sf, seed=0, rows=rows)
 
     sess = TpuSession({
@@ -91,7 +146,8 @@ def main():
     geo = math.exp(sum(math.log(s) for s in speedups.values())
                    / len(speedups))
     result = {
-        "metric": f"tpch_q1_q6_rows{rows}_geomean_speedup_vs_pandas",
+        "metric": f"tpch_q1_q6_rows{rows}_geomean_speedup_vs_pandas"
+                  + ("_CPUFALLBACK" if fell_back else ""),
         "value": round(geo, 4),
         "unit": "x",
         "vs_baseline": round(geo / 4.0, 4),
@@ -102,4 +158,16 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as e:  # never exit on a traceback: emit diagnostic JSON
+        import traceback
+        traceback.print_exc()
+        print(json.dumps({
+            "metric": "bench_failed",
+            "value": 0.0,
+            "unit": "x",
+            "vs_baseline": 0.0,
+            "error": f"{type(e).__name__}: {e}"[:500],
+        }))
+        sys.exit(0)

@@ -261,6 +261,70 @@ def concat_device_tables(tables: Sequence[DeviceTable], min_bucket: int = 1024
     return out.compact()
 
 
+def slice_rows(table: DeviceTable, start, length: int) -> DeviceTable:
+    """Static-length row window [start, start+length) (start may be traced).
+
+    Rows past the table's active count are masked off. Building block for
+    out-of-core chunking (reference: GpuOutOfCoreSortIterator splitting
+    pending batches, GpuSortExec.scala:69)."""
+    start = jnp.asarray(start, jnp.int32)
+    # dynamic_slice clamps start to [0, cap-length]; pre-clamp identically so
+    # the row mask agrees with the slice actually taken
+    start = jnp.clip(start, 0, max(table.capacity - length, 0))
+
+    def slc(a: jax.Array) -> jax.Array:
+        starts = (start,) + (0,) * (a.ndim - 1)
+        sizes = (min(length, a.shape[0]),) + a.shape[1:]
+        out = jax.lax.dynamic_slice(a, starts, sizes)
+        if length > a.shape[0]:
+            pad = ((0, length - a.shape[0]),) + ((0, 0),) * (a.ndim - 1)
+            out = jnp.pad(out, pad)
+        return out
+
+    cols = tuple(DeviceColumn(slc(c.data), slc(c.validity), c.dtype,
+                              None if c.lengths is None else slc(c.lengths))
+                 for c in table.columns)
+    iota = jnp.arange(length, dtype=jnp.int32)
+    mask = jnp.logical_and(slc(table.row_mask),
+                           (iota + start) < table.num_rows)
+    return DeviceTable(cols, mask, jnp.sum(mask, dtype=jnp.int32),
+                       table.names)
+
+
+def shrink_to_fit(table: DeviceTable, min_bucket: int = 1024) -> DeviceTable:
+    """Compact and shrink capacity to the bucket of the active row count.
+
+    Syncs the row count to host (one int) — used between pipeline steps to
+    stop capacities from growing across incremental merges."""
+    n = int(table.num_rows)
+    cap = bucket_rows(max(n, 1), min_bucket)
+    if cap >= table.capacity:
+        return table
+    compacted = table.compact()
+
+    def cut(a):
+        return a[:cap]
+
+    cols = tuple(DeviceColumn(cut(c.data), cut(c.validity), c.dtype,
+                              None if c.lengths is None else cut(c.lengths))
+                 for c in compacted.columns)
+    return DeviceTable(cols, cut(compacted.row_mask),
+                       compacted.num_rows, compacted.names)
+
+
+def append_column(table: DeviceTable, name: str, col: DeviceColumn
+                  ) -> DeviceTable:
+    return DeviceTable(table.columns + (col,), table.row_mask,
+                       table.num_rows, table.names + (name,))
+
+
+def drop_column(table: DeviceTable, name: str) -> DeviceTable:
+    i = table.names.index(name)
+    return DeviceTable(table.columns[:i] + table.columns[i + 1:],
+                       table.row_mask, table.num_rows,
+                       table.names[:i] + table.names[i + 1:])
+
+
 def pack_string_key_words(data: "jax.Array", lengths: "jax.Array"):
     """(cap, w) uint8 + lengths -> list of 1-D uint64 words, most-significant
     first, whose word-wise unsigned order equals lexicographic byte order;

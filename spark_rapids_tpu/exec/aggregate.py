@@ -276,29 +276,43 @@ class TpuHashAggregateExec(TpuExec):
                 f"{self._columns_ops()!r}|{child_schema}")
 
     def execute_columnar(self, pidx: int) -> Iterator[DeviceTable]:
+        from ..columnar.device import concat_device_tables, shrink_to_fit
+        from ..memory.catalog import SpillPriorities, get_catalog
         from ..utils.compile_cache import cached_jit
         fn = cached_jit(self.plan_signature(), self.batch_fn)
-        pending = None
-        merge_fn = None
-        for batch in self.child_device_batches(pidx):
-            with self.metrics.timed(M.AGG_TIME):
-                out = fn(batch)
+        catalog = get_catalog()
+        pending = None  # SpillableDeviceTable holding the running merge state
+        try:
+            for batch in self.child_device_batches(pidx):
+                with self.metrics.timed(M.AGG_TIME):
+                    out = fn(batch)
+                if pending is None:
+                    pending = catalog.register(
+                        out, SpillPriorities.ACTIVE_ON_DECK)
+                else:
+                    # merge-as-you-go keeps one running aggregated batch;
+                    # shrink-to-groups stops its capacity growing with the
+                    # batch count, and the catalog registration lets memory
+                    # pressure spill it between input batches (reference:
+                    # aggregate.scala merge passes under targetSize)
+                    with pending as prev:
+                        both = concat_device_tables([prev, out])
+                    merge_fn = cached_jit(
+                        self.plan_signature() + f"|merge{both.capacity}",
+                        self._merge_batch_fn)
+                    merged = shrink_to_fit(merge_fn(both))
+                    pending.close()
+                    pending = catalog.register(
+                        merged, SpillPriorities.ACTIVE_ON_DECK)
             if pending is None:
-                pending = out
-            else:
-                # merge-as-you-go keeps a single running aggregated batch
-                if merge_fn is None:
-                    merge_fn = cached_jit(self.plan_signature() + "|merge",
-                                          self._merge_batch_fn)
-                from ..columnar.device import concat_device_tables
-                both = concat_device_tables([pending, out])
-                pending = merge_fn(both)
-        if pending is None:
-            if not self.key_names:
-                empty = _empty_device_table(self.child.schema, 8)
-                yield fn(empty)
-            return
-        yield pending
+                if not self.key_names:
+                    empty = _empty_device_table(self.child.schema, 8)
+                    yield fn(empty)
+                return
+            yield pending.get()
+        finally:
+            if pending is not None:
+                pending.close()
 
     def _merge_batch_fn(self):
         """Re-aggregate concatenated partial outputs (merge semantics)."""

@@ -14,14 +14,18 @@ XLA needs static shapes. Three-kernel pipeline per probe batch:
    ``searchsorted(left/right)`` gives match count + start. One scalar
    (total pairs) syncs to host.
 3. **Expand kernel**: compiled per *bucketed* output capacity chosen from the
-   true total — the static-shape answer to cuDF's dynamic gather map, playing
-   the role of the reference's oversized-gather sub-partitioning.
+   true total — the static-shape answer to cuDF's dynamic gather map.
 
-The build side is gathered to a single batch (the reference's
-RequireSingleBatch build-side contract).
+Out-of-core (reference: AbstractGpuJoinIterator + the big-join
+sub-partitioning): the build side registers with the BufferCatalog as a
+spillable; a build side over the batch budget triggers a grace-style hash
+sub-partition of BOTH sides (same key hash, independent seed) into spillable
+buckets joined pairwise; an oversized gather output is produced in probe row
+windows so no expand exceeds the budget.
 """
 from __future__ import annotations
 
+import math
 from typing import Iterator, List, Optional, Sequence, Tuple
 
 import jax
@@ -30,7 +34,8 @@ import numpy as np
 
 from ..columnar import dtypes as dt
 from ..columnar.device import (DeviceColumn, DeviceTable, bucket_rows,
-                               concat_device_tables)
+                               concat_device_tables, shrink_to_fit,
+                               slice_rows)
 from ..expr.base import EvalContext, Expression
 from ..plan.logical import _join_schema
 from ..plan.physical import PhysicalPlan
@@ -38,6 +43,11 @@ from ..plan.schema import Schema
 from ..utils import metrics as M
 from ..utils.compile_cache import cached_jit
 from .base import TpuExec
+
+# grace sub-partitioning uses its own hash seed: the upstream exchange
+# already partitioned rows by these keys with the default seed, so reusing
+# it would send every row of one shard to a single grace bucket
+_GRACE_SEED = 9001
 
 __all__ = ["TpuShuffledHashJoinExec", "TpuBroadcastHashJoinExec"]
 
@@ -190,7 +200,8 @@ class TpuShuffledHashJoinExec(TpuExec):
     def __init__(self, left: PhysicalPlan, right: PhysicalPlan,
                  left_keys: Sequence[str], right_keys: Sequence[str],
                  how: str, condition: Optional[Expression], merge_keys: bool,
-                 min_bucket: int = 1024):
+                 min_bucket: int = 1024,
+                 batch_bytes: int = 512 * 1024 * 1024):
         super().__init__()
         assert how in self.SUPPORTED, how
         self.left, self.right = left, right
@@ -201,6 +212,7 @@ class TpuShuffledHashJoinExec(TpuExec):
         self.condition = condition
         self.merge_keys = merge_keys
         self.min_bucket = min_bucket
+        self.batch_bytes = batch_bytes
         on = self.left_keys if merge_keys else None
         self.schema = _join_schema(left.schema, right.schema, on, how)
         self._kernels = _JoinKernels(self)
@@ -252,12 +264,44 @@ class TpuShuffledHashJoinExec(TpuExec):
         table = concat_device_tables(batches) if len(batches) > 1 else batches[0]
         return table
 
+    def _max_out_rows(self) -> int:
+        """Gather-output row budget derived from the byte budget."""
+        row_bytes = 0
+        for f in self.schema:
+            if isinstance(f.dtype, (dt.StringType, dt.BinaryType)):
+                row_bytes += 32  # width varies; assume a modest string
+            else:
+                row_bytes += f.dtype.np_dtype().itemsize
+            row_bytes += 1  # validity
+        return max(self.min_bucket, self.batch_bytes // max(row_bytes, 1))
+
     def execute_columnar(self, pidx: int) -> Iterator[DeviceTable]:
         build = self._build_table(pidx)
+        if build.nbytes() > self.batch_bytes:
+            yield from self._grace_join(build, pidx)
+            return
+        handle, own = self._register_build(build)
+        del build  # the catalog handle is the owner from here on
+        try:
+            yield from self._probe_join(
+                handle, _device_batches(self.left, pidx))
+        finally:
+            if own:
+                handle.close()
+
+    def _register_build(self, build: DeviceTable):
+        """-> (SpillableDeviceTable, close_when_done)."""
+        from ..memory.catalog import SpillPriorities, get_catalog
+        return (get_catalog().register(build, SpillPriorities.ACTIVE_ON_DECK),
+                True)
+
+    def _probe_join(self, build_handle, probe_batches
+                    ) -> Iterator[DeviceTable]:
+        """Join probe batches against one spillable build table."""
         counts_fn = cached_jit(self.plan_signature() + "|counts",
                                self._kernels.counts_fn)
-        for probe in _device_batches(self.left, pidx):
-            with self.metrics.timed(M.JOIN_TIME):
+        for probe in probe_batches:
+            with self.metrics.timed(M.JOIN_TIME), build_handle as build:
                 b_order, starts, counts = counts_fn(build, probe)
                 if self.how in ("left_semi", "left_anti"):
                     fn = cached_jit(
@@ -272,17 +316,105 @@ class TpuShuffledHashJoinExec(TpuExec):
                         probe.row_mask,
                         jnp.maximum(counts, 1) if outer else counts, 0)))
                 total = int(slot_counts)
+                max_out = self._max_out_rows()
+                if total > max_out:
+                    # oversized gather: emit in probe row windows (reference:
+                    # AbstractGpuJoinIterator sub-partitions the gather)
+                    yield from self._windowed_expand(build, probe, total,
+                                                     max_out, counts_fn)
+                    continue
                 out_cap = bucket_rows(max(total, 1), self.min_bucket)
                 expand = cached_jit(
                     self.plan_signature() + f"|expand{out_cap}",
                     lambda: self._kernels.expand_fn(out_cap, self.how))
                 out = expand(build, probe, b_order, starts, counts)
-                if self.condition is not None:
-                    cond_fn = cached_jit(
-                        self.plan_signature() + "|cond",
-                        lambda: _condition_filter_fn(self.condition))
-                    out = cond_fn(out)
-                yield out
+                yield self._apply_condition(out)
+
+    def _apply_condition(self, out: DeviceTable) -> DeviceTable:
+        if self.condition is None:
+            return out
+        cond_fn = cached_jit(self.plan_signature() + "|cond",
+                             lambda: _condition_filter_fn(self.condition))
+        return cond_fn(out)
+
+    def _windowed_expand(self, build: DeviceTable, probe: DeviceTable,
+                         total: int, max_out: int, counts_fn
+                         ) -> Iterator[DeviceTable]:
+        probe = probe.compact()
+        nrows = max(1, int(probe.num_rows))
+        # size windows by average multiplicity; skewed windows re-split below
+        avg_mult = max(1.0, total / nrows)
+        wsize = bucket_rows(max(self.min_bucket, int(max_out / avg_mult)),
+                            self.min_bucket)
+        outer = self.how in ("left", "full")
+        start = 0
+        while start < nrows:
+            window = slice_rows(probe, start, wsize)
+            start += wsize
+            b_order, starts, counts = counts_fn(build, window)
+            wtotal = int(np.asarray(jnp.sum(jnp.where(
+                window.row_mask,
+                jnp.maximum(counts, 1) if outer else counts, 0))))
+            if wtotal == 0 and not outer:
+                continue
+            if wtotal > 2 * max_out and wsize > self.min_bucket:
+                # skewed window: recurse with smaller windows
+                yield from self._windowed_expand(build, window, wtotal,
+                                                 max_out, counts_fn)
+                continue
+            out_cap = bucket_rows(max(wtotal, 1), self.min_bucket)
+            expand = cached_jit(
+                self.plan_signature() + f"|expand{out_cap}",
+                lambda: self._kernels.expand_fn(out_cap, self.how))
+            yield self._apply_condition(
+                expand(build, window, b_order, starts, counts))
+
+    # -- grace-style sub-partitioned join (build side over budget) -----------
+    def _grace_split(self, table: DeviceTable, keys: List[str], n_sub: int
+                     ) -> List[DeviceTable]:
+        from ..shuffle.manager import device_partition_ids
+        pid = device_partition_ids(table, keys, n_sub, seed=_GRACE_SEED)
+        return [shrink_to_fit(table.filter_mask(pid == s), self.min_bucket)
+                for s in range(n_sub)]
+
+    def _grace_build_parts(self, build: DeviceTable, n_sub: int):
+        """-> (list of build-part spill handles, close_when_done)."""
+        from ..memory.catalog import SpillPriorities, get_catalog
+        catalog = get_catalog()
+        return [catalog.register(t, SpillPriorities.INPUT)
+                for t in self._grace_split(build, self.right_keys, n_sub)], \
+            True
+
+    def _grace_join(self, build: DeviceTable, pidx: int
+                    ) -> Iterator[DeviceTable]:
+        from ..memory.catalog import SpillPriorities, get_catalog
+        catalog = get_catalog()
+        n_sub = min(64, max(2, math.ceil(build.nbytes() / self.batch_bytes)))
+        build_parts, own_build = self._grace_build_parts(build, n_sub)
+        del build
+        probe_parts: List[List] = [[] for _ in range(n_sub)]
+        try:
+            for probe in _device_batches(self.left, pidx):
+                for s, t in enumerate(self._grace_split(
+                        probe, self.left_keys, n_sub)):
+                    if int(t.num_rows):
+                        probe_parts[s].append(
+                            catalog.register(t, SpillPriorities.INPUT))
+            for s in range(n_sub):
+                def sub_batches():
+                    for h in probe_parts[s]:
+                        with h as t:
+                            yield t
+                if probe_parts[s]:
+                    yield from self._probe_join(build_parts[s],
+                                                sub_batches())
+        finally:
+            if own_build:
+                for h in build_parts:
+                    h.close()
+            for hs in probe_parts:
+                for h in hs:
+                    h.close()
 
 
 class TpuBroadcastHashJoinExec(TpuShuffledHashJoinExec):
@@ -291,21 +423,54 @@ class TpuBroadcastHashJoinExec(TpuShuffledHashJoinExec):
 
     def __init__(self, *args, **kw):
         super().__init__(*args, **kw)
-        self._broadcast: Optional[DeviceTable] = None
+        self._bc_handle = None
+        self._bc_grace_parts = None
 
-    def _build_table(self, pidx: int) -> DeviceTable:
-        if self._broadcast is None:
+    def _broadcast_handle(self):
+        """Broadcast batch registered once with the BufferCatalog at
+        BROADCAST priority — accounted and spillable rather than pinned to
+        the exec node for the plan's lifetime. A finalizer releases the
+        catalog entry when the plan is garbage-collected."""
+        if self._bc_handle is None:
+            import weakref
+            from ..memory.catalog import SpillPriorities, get_catalog
             batches = []
             for p in range(self.right.num_partitions):
                 batches.extend(_device_batches(self.right, p))
             if not batches:
                 from .aggregate import _empty_device_table
-                self._broadcast = _empty_device_table(self.right.schema,
-                                                      self.min_bucket)
+                table = _empty_device_table(self.right.schema,
+                                            self.min_bucket)
             else:
-                self._broadcast = concat_device_tables(batches) \
+                table = concat_device_tables(batches) \
                     if len(batches) > 1 else batches[0]
-        return self._broadcast
+            self._bc_handle = get_catalog().register(
+                table, SpillPriorities.BROADCAST)
+            weakref.finalize(self, _close_quietly, self._bc_handle)
+        return self._bc_handle
+
+    def _build_table(self, pidx: int) -> DeviceTable:
+        return self._broadcast_handle().get()
+
+    def _register_build(self, build: DeviceTable):
+        return self._broadcast_handle(), False
+
+    def _grace_build_parts(self, build: DeviceTable, n_sub: int):
+        """Split the broadcast once; reuse the parts for every partition."""
+        if self._bc_grace_parts is None:
+            import weakref
+            parts, _ = super()._grace_build_parts(build, n_sub)
+            self._bc_grace_parts = parts
+            for h in parts:
+                weakref.finalize(self, _close_quietly, h)
+        return self._bc_grace_parts, False
+
+
+def _close_quietly(handle):
+    try:
+        handle.close()
+    except Exception:
+        pass
 
 
 def _condition_filter_fn(condition: Expression):

@@ -1,19 +1,30 @@
 """Device sort (reference: GpuSortExec.scala — FullSortSingleBatch /
-OutOfCoreSort / SortEachBatch modes; this implements the single-batch mode,
-out-of-core splitting arrives with the spill framework).
+OutOfCoreSort / SortEachBatch modes at :39-41,69).
 
-TPU shape: one lexsort over transformed key arrays inside one jitted program.
+TPU shape: one lexsort over transformed key arrays inside one jitted program
+(FullSortSingleBatch). When the input exceeds the batch-size budget, the
+OutOfCoreSort path sorts each batch into a spillable run (registered with the
+BufferCatalog so memory pressure migrates runs to host/disk), then merges
+runs with a sentinel-sort: each round pulls a fixed-size chunk per run plus
+each run's next unconsumed row flagged as a sentinel, sorts the union, and
+emits exactly the prefix before the first sentinel — rows provably <= every
+unseen row. All comparisons happen on device; only the emitted-count scalar
+syncs to host.
+
 Spark ordering semantics: nulls first/last per order, NaN greater than all
 numbers, -0.0 == 0.0.
 """
 from __future__ import annotations
 
-from typing import Iterator, List, Sequence
+from typing import Iterator, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 
-from ..columnar.device import DeviceTable, concat_device_tables
+from ..columnar import dtypes as dt
+from ..columnar.device import (DeviceColumn, DeviceTable, append_column,
+                               bucket_rows, concat_device_tables, drop_column,
+                               shrink_to_fit, slice_rows)
 from ..expr.base import EvalContext
 from ..expr.functions import SortOrder
 from ..plan.physical import PhysicalPlan
@@ -21,6 +32,8 @@ from ..utils import metrics as M
 from .base import TpuExec
 
 __all__ = ["TpuSortExec", "device_sort_table"]
+
+_SENT = "__ooc_sentinel"
 
 
 def _order_keys(table: DeviceTable, orders: Sequence[SortOrder]) -> List[jax.Array]:
@@ -73,24 +86,106 @@ def device_sort_table(table: DeviceTable, orders: Sequence[SortOrder]) -> Device
 
 
 class TpuSortExec(TpuExec):
-    def __init__(self, child: PhysicalPlan, orders: Sequence[SortOrder]):
+    def __init__(self, child: PhysicalPlan, orders: Sequence[SortOrder],
+                 min_bucket: int = 1024,
+                 batch_bytes: int = 512 * 1024 * 1024):
         super().__init__()
         self.child = child
         self.children = (child,)
         self.orders = list(orders)
         self.schema = child.schema
+        self.min_bucket = min_bucket
+        self.batch_bytes = batch_bytes
+
+    def _sort_fn(self, cap_key: str):
+        from ..utils.compile_cache import cached_jit
+        orders = self.orders
+        return cached_jit(self.plan_signature() + cap_key,
+                          lambda: (lambda t: device_sort_table(t, orders)))
 
     def execute_columnar(self, pidx: int) -> Iterator[DeviceTable]:
         batches = list(self.child_device_batches(pidx))
         if not batches:
             return
-        table = concat_device_tables(batches) if len(batches) > 1 else batches[0]
-        from ..utils.compile_cache import cached_jit
-        orders = self.orders
-        fn = cached_jit(self.plan_signature(),
-                        lambda: (lambda t: device_sort_table(t, orders)))
-        with self.metrics.timed(M.SORT_TIME):
-            yield fn(table)
+        total_bytes = sum(b.nbytes() for b in batches)
+        if len(batches) == 1 or total_bytes <= self.batch_bytes:
+            # FullSortSingleBatch mode
+            table = concat_device_tables(batches) if len(batches) > 1 \
+                else batches[0]
+            with self.metrics.timed(M.SORT_TIME):
+                yield self._sort_fn(f"|cap{table.capacity}")(table)
+            return
+        yield from self._out_of_core(batches)
+
+    # -- OutOfCoreSort mode ---------------------------------------------------
+    def _out_of_core(self, batches: List[DeviceTable]
+                     ) -> Iterator[DeviceTable]:
+        from ..memory.catalog import SpillPriorities, get_catalog
+        catalog = get_catalog()
+        runs = []  # (SpillableDeviceTable, active_rows)
+        try:
+            with self.metrics.timed(M.SORT_TIME):
+                for b in batches:
+                    sorted_b = self._sort_fn(f"|cap{b.capacity}")(b)
+                    n = int(sorted_b.num_rows)
+                    if n:
+                        runs.append((catalog.register(
+                            sorted_b, SpillPriorities.INPUT), n))
+            yield from self._merge_runs(runs)
+        finally:
+            for run, _ in runs:
+                run.close()
+
+    def _merge_runs(self, runs) -> Iterator[DeviceTable]:
+        if not runs:
+            return
+        k = len(runs)
+        target_rows = max(r for _, r in runs)
+        chunk = bucket_rows(max(self.min_bucket, target_rows // k),
+                            self.min_bucket)
+        cursors = [0] * k
+        carry: Optional[DeviceTable] = None
+        while carry is not None or any(c < n for c, (_, n) in
+                                       zip(cursors, runs)):
+            inputs: List[DeviceTable] = []
+            flags: List[bool] = []
+            if carry is not None:
+                inputs.append(carry)
+                flags.append(False)
+            for i, (run, nrows) in enumerate(runs):
+                if cursors[i] >= nrows:
+                    continue
+                with run as t:
+                    inputs.append(slice_rows(t, cursors[i], chunk))
+                    flags.append(False)
+                    cursors[i] = min(cursors[i] + chunk, nrows)
+                    if cursors[i] < nrows:  # next unseen row = sentinel
+                        inputs.append(slice_rows(t, cursors[i], 1))
+                        flags.append(True)
+            tagged = [append_column(
+                t, _SENT, DeviceColumn(
+                    jnp.full(t.capacity, f, dtype=bool),
+                    jnp.ones(t.capacity, dtype=bool), dt.BOOLEAN, None))
+                for t, f in zip(inputs, flags)]
+            merged = concat_device_tables(tagged, self.min_bucket)
+            with self.metrics.timed(M.SORT_TIME):
+                sorted_m = self._sort_fn(f"|merge{merged.capacity}")(merged)
+            sent = jnp.logical_and(sorted_m.column(_SENT).data,
+                                   sorted_m.row_mask)
+            any_sent = bool(jnp.any(sent))
+            emit_n = int(jnp.argmax(sent)) if any_sent \
+                else int(sorted_m.num_rows)
+            iota = jnp.arange(sorted_m.capacity, dtype=jnp.int32)
+            if emit_n > 0:
+                out = drop_column(
+                    sorted_m.filter_mask(iota < emit_n), _SENT)
+                self.metrics.add(M.NUM_OUTPUT_ROWS, emit_n)
+                yield shrink_to_fit(out, self.min_bucket)
+            rest_mask = jnp.logical_and(
+                iota >= emit_n, jnp.logical_not(sorted_m.column(_SENT).data))
+            rest = drop_column(sorted_m.filter_mask(rest_mask), _SENT)
+            carry = shrink_to_fit(rest, self.min_bucket) \
+                if int(rest.num_rows) else None
 
     def node_desc(self):
         return ", ".join(f"{o.expr!r} {'ASC' if o.ascending else 'DESC'}"

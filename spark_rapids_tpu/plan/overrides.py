@@ -316,14 +316,14 @@ def _register_exec_rules():
         CpuShuffledHashJoinExec, _device_all,
         lambda p, ch, conf: TpuShuffledHashJoinExec(
             ch[0], ch[1], p.left_keys, p.right_keys, p.how, p.condition,
-            p.merge_keys, conf.min_bucket_rows),
+            p.merge_keys, conf.min_bucket_rows, conf.batch_size_bytes),
         exprs_fn=_join_exprs, tag_fn=tag_join)
 
     register_exec_rule(
         CpuBroadcastHashJoinExec, _device_all,
         lambda p, ch, conf: TpuBroadcastHashJoinExec(
             ch[0], ch[1], p.left_keys, p.right_keys, p.how, p.condition,
-            p.merge_keys, conf.min_bucket_rows),
+            p.merge_keys, conf.min_bucket_rows, conf.batch_size_bytes),
         exprs_fn=_join_exprs, tag_fn=tag_join)
 
     from ..exec.window import TpuWindowExec
@@ -386,9 +386,48 @@ def _register_exec_rules():
 
     register_exec_rule(
         CpuSortExec, _device_all,
-        lambda p, ch, conf: TpuSortExec(ch[0], p.orders),
+        lambda p, ch, conf: TpuSortExec(ch[0], p.orders,
+                                        conf.min_bucket_rows,
+                                        conf.batch_size_bytes),
         exprs_fn=lambda p: [o.expr for o in p.orders],
         tag_fn=tag_sort)
+
+    # exchange: on-device ICI all-to-all when a mesh is attached (reference:
+    # GpuShuffleExchangeExecBase.scala:146 / RapidsShuffleManager tier)
+    from .physical import HashPartitioning, ShuffleExchangeExec
+
+    def _active_mesh():
+        from ..session import TpuSession
+        sess = TpuSession._active
+        return sess.shuffle_mesh() if sess is not None else None
+
+    def tag_exchange(meta, conf):
+        p: ShuffleExchangeExec = meta.plan
+        mesh = _active_mesh()
+        if mesh is None:
+            meta.cannot_run("no device mesh attached "
+                            "(host-staged exchange tier)")
+            return
+        if not isinstance(p.partitioning, HashPartitioning):
+            meta.cannot_run(
+                f"{type(p.partitioning).__name__} stays on the host tier "
+                "(only hash partitioning exchanges over ICI)")
+            return
+        for k in p.partitioning.key_names:
+            kt = p.child.schema.field(k).dtype
+            if not _device_all.is_supported(kt):
+                meta.cannot_run(f"partition key {k}: {kt!r} not supported")
+
+    register_exec_rule(
+        ShuffleExchangeExec, _device_all,
+        lambda p, ch, conf: _convert_exchange(p, ch, conf, _active_mesh()),
+        tag_fn=tag_exchange)
+
+
+def _convert_exchange(p, ch, conf, mesh):
+    from ..exec.exchange import TpuShuffleExchangeExec
+    return TpuShuffleExchangeExec(ch[0], p.partitioning, mesh,
+                                  conf.min_bucket_rows)
 
 
 _register_expr_rules()
@@ -436,7 +475,9 @@ def apply_overrides(cpu_plan: PhysicalPlan, conf: RapidsConf) -> PhysicalPlan:
 
 
 def _always_cpu(plan: PhysicalPlan) -> bool:
-    """Nodes with no device rule by design (scans/exchanges stay host-side in
-    this round; see SURVEY §7.5)."""
+    """Nodes exempt from the test.enabled fall-off assertion: scans decode on
+    host by design (SURVEY §7.5), and exchanges legitimately stay host-side
+    whenever no mesh is attached (the always-available tier) — they DO
+    convert to the ICI exchange under a mesh (see tag_exchange above)."""
     from .physical import CpuScanExec, CpuGlobalLimitExec, ShuffleExchangeExec
     return isinstance(plan, (CpuScanExec, ShuffleExchangeExec, CpuGlobalLimitExec))

@@ -34,7 +34,33 @@ class TpuSession:
         if isinstance(conf, dict):
             conf = RapidsConf(conf)
         self.conf = conf or RapidsConf()
+        self._mesh = None
         TpuSession._active = self
+
+    # -- device mesh (accelerated shuffle tier) ------------------------------
+    def attach_mesh(self, mesh) -> "TpuSession":
+        """Attach a jax.sharding.Mesh; hash exchanges then run as on-device
+        ICI all-to-all (exec/exchange.py) instead of the host-staged tier."""
+        self._mesh = mesh
+        return self
+
+    def shuffle_mesh(self):
+        """The mesh the planner may exchange over, or None for host shuffle.
+
+        Mode 'host' disables the device tier; 'ici' builds a 1-D mesh over
+        all addressable devices on first use; 'auto' uses whatever mesh the
+        user attached (reference: choosing RapidsShuffleManager vs default
+        Spark shuffle is likewise an explicit deployment decision)."""
+        from .exec.exchange import SHUFFLE_MODE
+        mode = self.conf.get(SHUFFLE_MODE)
+        if mode == "host":
+            return None
+        if self._mesh is None and mode == "ici":
+            from .parallel.mesh import data_parallel_mesh
+            self._mesh = data_parallel_mesh()
+        if self._mesh is not None and self._mesh.size < 2:
+            return None
+        return self._mesh
 
     # -- data sources --------------------------------------------------------
     def create_dataframe(self, data, schema=None, num_partitions: int = 1

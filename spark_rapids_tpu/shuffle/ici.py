@@ -7,11 +7,11 @@ rows live as one mesh-sharded DeviceTable; a hash-partition kernel + a single
 ``jax.lax.all_to_all`` over the ``dp`` axis re-homes every row across ICI
 links inside one XLA program — no host staging, no serialization.
 
-Static-shape contract: all_to_all needs equal per-destination quotas, so each
-shard reserves ``local_capacity`` slots per destination (worst case: every
-local row targets one peer). Overflow is thus impossible; the cost is an
-n_devices× intermediate, bounded by per-shard batch capacity. A later round
-can exchange per-destination counts first and right-size quotas.
+Static-shape contract: all_to_all needs equal per-destination quotas. The
+caller may pass ``quota`` (slots per source-destination pair, from a prior
+count pass — exec/exchange.py does this) to right-size the intermediate;
+without it each shard reserves ``local_capacity`` slots per destination
+(worst case, an n_devices× blowup kept only as the safe default).
 
 Works under ``shard_map`` on any mesh — real ICI on TPU pods, XLA-emulated on
 the CPU test mesh (tests/conftest.py).
@@ -68,11 +68,15 @@ def unshard_table(table: DeviceTable) -> DeviceTable:
 
 
 def ici_all_to_all_exchange(table: DeviceTable, key_names: List[str],
-                            mesh: Mesh, axis: str = "dp") -> DeviceTable:
+                            mesh: Mesh, axis: str = "dp",
+                            quota: int | None = None) -> DeviceTable:
     """Hash-exchange a row-sharded table so rows with equal keys land on the
     same shard, as one jitted shard_map program (collectives over ICI).
 
-    Returns a row-sharded table with per-shard capacity n * local_capacity
+    ``quota`` is the per-(source, destination) slot count; it MUST be >= the
+    max rows any shard sends to any destination (callers size it from a count
+    pass; undersizing would drop rows). Defaults to local capacity (always
+    safe). Returns a row-sharded table with per-shard capacity n * quota
     (padding masked off)."""
     n = mesh.shape[axis]
     names = table.names
@@ -90,6 +94,7 @@ def ici_all_to_all_exchange(table: DeviceTable, key_names: List[str],
     def local(*arrs):
         mask = arrs[0]
         cap = mask.shape[0]
+        q = cap if quota is None else min(quota, cap)
         pos = 1
         cols = []
         for d, hl in zip(dtypes, has_lengths):
@@ -117,25 +122,25 @@ def ici_all_to_all_exchange(table: DeviceTable, key_names: List[str],
 
         def scatter(x):
             xs = jnp.take(x, order, axis=0)
-            buckets = jnp.zeros((n, cap) + xs.shape[1:], dtype=xs.dtype)
+            buckets = jnp.zeros((n, q) + xs.shape[1:], dtype=xs.dtype)
             fill = jnp.where(ok.reshape((-1,) + (1,) * (xs.ndim - 1)), xs,
                              jnp.zeros_like(xs))
             return buckets.at[dst, k].set(fill, mode="drop")
 
         out = []
-        slot_mask = jnp.zeros((n, cap), dtype=bool).at[dst, k].set(
+        slot_mask = jnp.zeros((n, q), dtype=bool).at[dst, k].set(
             ok, mode="drop")
         out.append(jax.lax.all_to_all(slot_mask, axis, 0, 0,
-                                      tiled=True).reshape(n * cap))
+                                      tiled=True).reshape(n * q))
         for c in cols:
             out.append(jax.lax.all_to_all(scatter(c.data), axis, 0, 0,
                                           tiled=True)
-                       .reshape((n * cap,) + c.data.shape[1:]))
+                       .reshape((n * q,) + c.data.shape[1:]))
             out.append(jax.lax.all_to_all(scatter(c.validity), axis, 0, 0,
-                                          tiled=True).reshape(n * cap))
+                                          tiled=True).reshape(n * q))
             if c.lengths is not None:
                 out.append(jax.lax.all_to_all(scatter(c.lengths), axis, 0, 0,
-                                              tiled=True).reshape(n * cap))
+                                              tiled=True).reshape(n * q))
         return tuple(out)
 
     in_specs = tuple(P(axis) for _ in arrays)

@@ -48,16 +48,46 @@ def _fmix_device(x: jax.Array) -> jax.Array:
     return x
 
 
+def _string_key_hash(col) -> jax.Array:
+    """Width-independent hash of a fixed-width string column.
+
+    Bytes past each row's length are zero-padded by construction
+    (columnar/device.py from_host), and words fully past the length are
+    masked out, so the result does not depend on the batch's padded width —
+    the same key hashes identically across batches (required for shuffle
+    write/read agreement, like cudf's string murmur in the reference)."""
+    data, lengths = col.data, col.lengths
+    cap, w = data.shape
+    k = jnp.zeros(cap, dtype=jnp.uint32)
+    for start in range(0, w, 8):
+        chunk = data[:, start:start + 8]
+        word = jnp.zeros((cap,), dtype=jnp.uint64)
+        for j in range(chunk.shape[1]):
+            word = word | (chunk[:, j].astype(jnp.uint64)
+                           << jnp.uint64(8 * (7 - j)))
+        kw = _fmix_device((word & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+                          ^ (word >> jnp.uint64(32)).astype(jnp.uint32)
+                          ^ jnp.uint32(start + 1))
+        overlaps = lengths > start
+        k = k ^ jnp.where(overlaps, kw, jnp.uint32(0))
+    return k ^ _fmix_device(lengths.astype(jnp.uint32))
+
+
 def device_partition_ids(table: DeviceTable, key_names: List[str],
                          num_parts: int, seed: int = 42) -> jax.Array:
     """Per-row reduce-partition ids; bitwise-identical to the host
-    murmur-style partitioner (plan/physical.py murmur_hash_columns) so host
-    and device paths agree on placement."""
+    murmur-style partitioner (plan/physical.py murmur_hash_columns) for
+    fixed-width types so host and device paths agree on placement. String
+    keys use a device-only width-independent hash (consistent across the
+    all-device shuffle write/read paths; host/device placement agreement is
+    not required for strings because placement never crosses engines)."""
     h = jnp.full(table.capacity, jnp.uint32(seed), dtype=jnp.uint32)
     for name in key_names:
         col = table.column(name)
         v = col.data
-        if v.dtype == jnp.bool_:
+        if col.lengths is not None:  # string/binary
+            k = _string_key_hash(col)
+        elif v.dtype == jnp.bool_:
             k = v.astype(jnp.uint32)
         elif jnp.issubdtype(v.dtype, jnp.floating):
             bits = v.astype(jnp.float64).view(jnp.uint64)

@@ -1,0 +1,139 @@
+"""Planner-integrated device (ICI) exchange tests — the accelerated shuffle
+tier reached through a real query plan (reference analogue: using
+RapidsShuffleManager instead of default Spark shuffle, SURVEY §2.7)."""
+import jax
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.session import TpuSession
+
+
+def _mesh_session(**extra):
+    from spark_rapids_tpu.parallel.mesh import virtual_cpu_mesh
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    sess = TpuSession({
+        "spark.rapids.tpu.batchRowsMinBucket": 8,
+        "spark.rapids.tpu.shuffle.partitions": 4,
+        **extra,
+    })
+    sess.attach_mesh(virtual_cpu_mesh(8))
+    return sess
+
+
+def _find(plan, cls):
+    if isinstance(plan, cls):
+        return plan
+    for c in plan.children:
+        r = _find(c, cls)
+        if r is not None:
+            return r
+    return None
+
+
+def test_ici_exchange_quota_rightsized():
+    """Quota from a count pass shrinks the exchange intermediate (weak #4)."""
+    from jax.sharding import Mesh
+    from spark_rapids_tpu.columnar.device import DeviceTable
+    from spark_rapids_tpu.columnar.host import HostColumn, HostTable
+    from spark_rapids_tpu.columnar import dtypes as dt
+    from spark_rapids_tpu.shuffle.ici import (ici_all_to_all_exchange,
+                                              shard_table, unshard_table)
+    devices = np.array(jax.devices()[:8])
+    if len(devices) < 8:
+        pytest.skip("needs 8 virtual devices")
+    mesh = Mesh(devices, ("dp",))
+    rng = np.random.default_rng(7)
+    k = rng.integers(0, 50, 512).astype(np.int64)
+    v = rng.uniform(0, 1, 512)
+    t = HostTable(["k", "v"], [HostColumn(dt.LONG, k),
+                               HostColumn(dt.DOUBLE, v)])
+    dtab = DeviceTable.from_host(t, min_bucket=8, capacity=512)
+    sharded = shard_table(dtab, mesh)
+    out = ici_all_to_all_exchange(sharded, ["k"], mesh, quota=32)
+    # right-sized: per-shard capacity is n*quota, not n*local_capacity
+    assert out.capacity == 8 * 8 * 32
+    assert int(out.num_rows) == 512
+    merged = unshard_table(out).to_host()
+    got = sorted(zip(merged.column("k").values.tolist(),
+                     np.round(merged.column("v").values, 9).tolist()))
+    exp = sorted(zip(k.tolist(), np.round(v, 9).tolist()))
+    assert got == exp
+
+
+def test_planner_groupby_uses_device_exchange():
+    from spark_rapids_tpu.exec.exchange import TpuShuffleExchangeExec
+    sess = _mesh_session()
+    rng = np.random.default_rng(0)
+    t = pa.table({"k": rng.integers(0, 20, 400),
+                  "v": rng.uniform(0, 10, 400)})
+    df = sess.create_dataframe(t, num_partitions=3)
+    from spark_rapids_tpu.expr.functions import col, count, sum as fsum
+    q = df.group_by("k").agg(fsum(col("v")).alias("s"),
+                             count(col("v")).alias("n"))
+    plan = sess._physical(q.logical, device=True)
+    assert _find(plan, TpuShuffleExchangeExec) is not None, plan.tree_string()
+    got = q.collect(device=True).to_pandas().sort_values("k").reset_index(drop=True)
+    exp = q.collect(device=False).to_pandas().sort_values("k").reset_index(drop=True)
+    assert np.allclose(got["s"], exp["s"])
+    assert (got["n"] == exp["n"]).all()
+    assert (got["k"] == exp["k"]).all()
+
+
+def test_planner_groupby_string_keys_device_exchange():
+    """String group keys exchange via the width-independent device hash."""
+    from spark_rapids_tpu.exec.exchange import TpuShuffleExchangeExec
+    sess = _mesh_session()
+    rng = np.random.default_rng(1)
+    keys = np.array(["alpha", "beta", "gamma", "d", "epsilon-long-key", ""])
+    t = pa.table({"k": keys[rng.integers(0, len(keys), 300)],
+                  "v": rng.uniform(0, 5, 300)})
+    df = sess.create_dataframe(t, num_partitions=2)
+    from spark_rapids_tpu.expr.functions import col, sum as fsum
+    q = df.group_by("k").agg(fsum(col("v")).alias("s"))
+    plan = sess._physical(q.logical, device=True)
+    assert _find(plan, TpuShuffleExchangeExec) is not None, plan.tree_string()
+    got = q.collect(device=True).to_pandas().sort_values("k").reset_index(drop=True)
+    exp = q.collect(device=False).to_pandas().sort_values("k").reset_index(drop=True)
+    assert (got["k"] == exp["k"]).all()
+    assert np.allclose(got["s"], exp["s"])
+
+
+def test_planner_join_uses_device_exchange():
+    from spark_rapids_tpu.exec.exchange import TpuShuffleExchangeExec
+    sess = _mesh_session()
+    rng = np.random.default_rng(2)
+    left = pa.table({"k": rng.integers(0, 30, 250),
+                     "a": rng.uniform(0, 1, 250)})
+    right = pa.table({"k": np.arange(30), "b": rng.uniform(0, 1, 30)})
+    # disable broadcast so the join plans as shuffled-hash with exchanges
+    ldf = sess.create_dataframe(left, num_partitions=3)
+    rdf = sess.create_dataframe(right, num_partitions=2)
+    sess.set_conf("spark.rapids.tpu.autoBroadcastJoinThreshold", -1)
+    try:
+        q = ldf.join(rdf, on="k", how="inner")
+        plan = sess._physical(q.logical, device=True)
+        assert _find(plan, TpuShuffleExchangeExec) is not None, \
+            plan.tree_string()
+        got = q.collect(device=True).to_pandas() \
+            .sort_values(["k", "a"]).reset_index(drop=True)
+        exp = q.collect(device=False).to_pandas() \
+            .sort_values(["k", "a"]).reset_index(drop=True)
+        assert len(got) == len(exp)
+        assert np.allclose(got["a"], exp["a"])
+        assert np.allclose(got["b"], exp["b"])
+    finally:
+        sess.set_conf("spark.rapids.tpu.autoBroadcastJoinThreshold", 10 * 1024 * 1024)
+
+
+def test_host_mode_keeps_host_exchange():
+    from spark_rapids_tpu.exec.exchange import TpuShuffleExchangeExec
+    sess = _mesh_session(**{"spark.rapids.tpu.shuffle.mode": "host"})
+    rng = np.random.default_rng(3)
+    t = pa.table({"k": rng.integers(0, 20, 100), "v": rng.uniform(0, 1, 100)})
+    df = sess.create_dataframe(t, num_partitions=2)
+    from spark_rapids_tpu.expr.functions import col, sum as fsum
+    q = df.group_by("k").agg(fsum(col("v")).alias("s"))
+    plan = sess._physical(q.logical, device=True)
+    assert _find(plan, TpuShuffleExchangeExec) is None
