@@ -5,8 +5,8 @@ shuffle/ici.py; this package is the runtime around it."""
 from .executor import ExecutorContext, FailureDetector
 from .mesh import (MeshTopology, data_parallel_mesh, grid_mesh,
                    virtual_cpu_mesh)
-from .runtime import DriverRuntime, LocalCluster
+from .runtime import DriverRuntime, LocalCluster, ProcessCluster
 
 __all__ = ["ExecutorContext", "FailureDetector", "MeshTopology",
            "data_parallel_mesh", "grid_mesh", "virtual_cpu_mesh",
-           "DriverRuntime", "LocalCluster"]
+           "DriverRuntime", "LocalCluster", "ProcessCluster"]

@@ -134,3 +134,164 @@ class LocalCluster:
                       ) -> List[object]:
         futures = [self._pool.submit(fn, ctx) for ctx in self.executors]
         return [f.result() for f in futures]
+
+
+# ---------------------------------------------------------------------------
+# Multi-process cluster: executors as OS processes over the TCP transport
+# (reference: real Spark executors + RapidsShuffleServer/Client crossing
+# process/host boundaries; LocalCluster above is the threads-only analogue of
+# local-cluster mode)
+# ---------------------------------------------------------------------------
+def _worker_main(worker_id: int, conf_values: dict, addr_q, task_q, result_q):
+    # never let a worker grab the TPU tunnel (it admits one process);
+    # jax.config is the only channel the axon plugin respects
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from ..conf import RapidsConf
+    from ..shuffle.tcp import TcpShuffleTransport
+    from .executor import ExecutorContext
+
+    conf = RapidsConf(conf_values)
+    transport = TcpShuffleTransport(conf)
+    addr_q.put((worker_id, transport.address))
+    ctx = None
+    try:
+        while True:
+            task = task_q.get()
+            if task is None:
+                return
+            tid, kind, payload = task
+            if kind == "peers":
+                for host, port in payload:
+                    transport.add_peer(host, port)
+                ctx = ExecutorContext(worker_id, conf,
+                                      transport=transport).initialize()
+                result_q.put((tid, "ok", None))
+                continue
+            fn, args = payload
+            try:
+                result_q.put((tid, "ok", fn(ctx, *args)))
+            except Exception as e:  # surface to the driver, keep serving
+                result_q.put((tid, "err", f"{type(e).__name__}: {e}"))
+    finally:
+        if ctx is not None:
+            ctx.shutdown()
+        transport.close()
+
+
+class ProcessCluster:
+    """N executor processes, each owning a TcpShuffleTransport server, all
+    peered with each other. Task functions must be module-level (pickled by
+    reference) and take the worker's ExecutorContext as first argument."""
+
+    def __init__(self, n_executors: int, conf: Optional[dict] = None,
+                 start_timeout_s: float = 120.0):
+        import multiprocessing as mp
+        self._mp = mp.get_context("spawn")
+        self._addr_q = self._mp.Queue()
+        self._result_q = self._mp.Queue()
+        self._task_qs = [self._mp.Queue() for _ in range(n_executors)]
+        self.procs = [
+            self._mp.Process(
+                target=_worker_main,
+                args=(i, conf or {}, self._addr_q, self._task_qs[i],
+                      self._result_q), daemon=True)
+            for i in range(n_executors)]
+        for p in self.procs:
+            p.start()
+        addrs: Dict[int, tuple] = {}
+        for _ in range(n_executors):
+            wid, addr = self._addr_q.get(timeout=start_timeout_s)
+            addrs[wid] = addr
+        self.addresses = [addrs[i] for i in range(n_executors)]
+        self._tids = itertools.count()
+        self._done: Dict[int, tuple] = {}
+        # peer everyone with everyone else (reference: heartbeat-driven
+        # executor discovery, Plugin.scala:149-161)
+        for i in range(n_executors):
+            peers = [a for j, a in enumerate(self.addresses) if j != i]
+            self._wait(self._submit(i, "peers", peers))
+
+    def _submit(self, worker: int, kind: str, payload) -> int:
+        tid = next(self._tids)
+        self._task_qs[worker].put((tid, kind, payload))
+        return tid
+
+    def submit(self, worker: int, fn, *args) -> int:
+        """Run ``fn(ctx, *args)`` on a worker; returns a task id."""
+        return self._submit(worker, "call", (fn, args))
+
+    def _wait(self, tid: int, timeout_s: float = 300.0):
+        while tid not in self._done:
+            got_tid, status, value = self._result_q.get(timeout=timeout_s)
+            self._done[got_tid] = (status, value)
+        status, value = self._done.pop(tid)
+        if status == "err":
+            raise RuntimeError(f"task {tid} failed on worker: {value}")
+        return value
+
+    def run_on(self, worker: int, fn, *args, timeout_s: float = 300.0):
+        return self._wait(self.submit(worker, fn, *args), timeout_s)
+
+    def kill(self, worker: int):
+        """Hard-kill one executor process (failure injection)."""
+        self.procs[worker].terminate()
+        self.procs[worker].join(timeout=30)
+
+    def close(self):
+        for i, p in enumerate(self.procs):
+            if p.is_alive():
+                try:
+                    self._task_qs[i].put(None)
+                except Exception:
+                    pass
+        for p in self.procs:
+            p.join(timeout=30)
+            if p.is_alive():
+                p.terminate()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+# -- reusable cross-process task functions (module-level => picklable) -------
+def shuffle_write_task(ctx: ExecutorContext, shuffle_id: int, map_id: int,
+                       payload: bytes, key_names: List[str],
+                       num_parts: int) -> List[int]:
+    from ..columnar.device import DeviceTable
+    from ..shuffle.serializer import deserialize_table
+    table = DeviceTable.from_host(deserialize_table(payload), min_bucket=8)
+    return ctx.shuffle.write_partition(shuffle_id, map_id, iter([table]),
+                                       key_names, num_parts)
+
+
+def shuffle_read_task(ctx: ExecutorContext, shuffle_id: int, num_maps: int,
+                      reduce_id: int) -> Optional[bytes]:
+    from ..shuffle.serializer import serialize_table
+    out = list(ctx.shuffle.read_partition(shuffle_id, num_maps, reduce_id,
+                                          min_bucket=8))
+    if not out:
+        return None
+    return serialize_table(out[0].to_host())
+
+
+def shuffle_read_recompute_task(ctx: ExecutorContext, shuffle_id: int,
+                                num_maps: int, reduce_id: int,
+                                map_payloads: Dict[int, bytes],
+                                key_names: List[str],
+                                num_parts: int) -> Optional[bytes]:
+    """Read with a recompute hook: a fetch-failed map task is re-run locally
+    from its input (the lineage-recompute analogue of Spark stage retry)."""
+    def recompute(map_id: int):
+        shuffle_write_task(ctx, shuffle_id, map_id, map_payloads[map_id],
+                           key_names, num_parts)
+
+    from ..shuffle.serializer import serialize_table
+    out = list(ctx.shuffle.read_partition(shuffle_id, num_maps, reduce_id,
+                                          min_bucket=8, recompute=recompute))
+    if not out:
+        return None
+    return serialize_table(out[0].to_host())

@@ -155,9 +155,14 @@ class ShuffleManager:
     def write_partition(self, shuffle_id: int, map_id: int,
                         batches: Iterator[DeviceTable], key_names: List[str],
                         num_parts: int) -> List[int]:
-        """Slice + publish one map task's output; returns bytes per block."""
-        sizes = [0] * num_parts
+        """Slice + publish one map task's output; returns bytes per block.
+
+        EVERY (map, reduce) block is published, including empty ones — the
+        reader treats a missing block as a fetch failure (reference: Spark's
+        MapStatus records every block; RapidsShuffleIterator fails loudly on
+        a miss rather than guessing it was empty)."""
         merged: List[List[HostTable]] = [[] for _ in range(num_parts)]
+        schema_host: Optional[HostTable] = None
         for batch in batches:
             pids = device_partition_ids(batch, key_names, num_parts)
             pids = jnp.where(batch.row_mask, pids, num_parts)  # park inactive
@@ -168,25 +173,54 @@ class ShuffleManager:
             sorted_pids = np.asarray(jnp.take(pids, order))
             bounds = np.searchsorted(sorted_pids, np.arange(num_parts + 1))
             host = sorted_tbl.to_host()  # single download, dense prefix
+            schema_host = host
             for p in range(num_parts):
                 lo, hi = int(bounds[p]), int(bounds[p + 1])
                 if hi > lo:
                     merged[p].append(host.slice(lo, hi - lo))
+        sizes = [0] * num_parts
         for p in range(num_parts):
             if merged[p]:
-                payload = serialize_table(HostTable.concat(merged[p]),
-                                          self.codec)
-                self.transport.publish(BlockId(shuffle_id, map_id, p), payload)
-                sizes[p] = len(payload)
+                table = HostTable.concat(merged[p])
+            elif schema_host is not None:
+                table = schema_host.slice(0, 0)
+            else:  # map task saw no batches at all: typed-empty marker
+                table = HostTable([], [])
+            payload = serialize_table(table, self.codec)
+            self.transport.publish(BlockId(shuffle_id, map_id, p), payload)
+            sizes[p] = len(payload)
         return sizes
 
     # -- read side ------------------------------------------------------------
     def read_partition(self, shuffle_id: int, num_maps: int, reduce_id: int,
-                       min_bucket: int = 1024) -> Iterator[DeviceTable]:
+                       min_bucket: int = 1024,
+                       recompute=None) -> Iterator[DeviceTable]:
+        """Fetch + coalesce + upload one reduce partition.
+
+        A missing block raises ShuffleFetchFailedException. When a
+        ``recompute(map_id)`` hook is provided (the stage-retry analogue —
+        reference: RapidsShuffleFetchFailedException -> Spark recomputes the
+        map task from lineage), it is invoked once for the failed map and the
+        fetch retried before giving up."""
+        from .transport import ShuffleFetchFailedException
         blocks = [BlockId(shuffle_id, m, reduce_id) for m in range(num_maps)]
         tables: List[HostTable] = []
-        for _, payload in self.transport.fetch(blocks):
-            tables.append(deserialize_table(payload))
+        pending = list(blocks)
+        retried = set()
+        while pending:
+            try:
+                for bid, payload in self.transport.fetch(pending):
+                    tables.append(deserialize_table(payload))
+                    pending = pending[pending.index(bid) + 1:]
+                break
+            except ShuffleFetchFailedException as e:
+                map_id = e.block[1]
+                if recompute is None or map_id in retried:
+                    raise
+                retried.add(map_id)
+                recompute(map_id)
+                pending = pending[pending.index(e.block):]
+        tables = [t for t in tables if t.num_columns and t.num_rows]
         if not tables:
             return
         # host-side coalesce then single upload (GpuShuffleCoalesceExec)

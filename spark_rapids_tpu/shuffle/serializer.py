@@ -40,6 +40,15 @@ def default_codec() -> str:
 
 
 def _dtype_tag(d: dt.DataType) -> str:
+    if isinstance(d, (dt.ArrayType, dt.StructType, dt.MapType)):
+        # nested host columns are Python object arrays; the raw-buffer branch
+        # would serialize object POINTERS (garbage across processes). Fail
+        # loudly until a real nested encoding (offsets + child buffers, like
+        # the reference's JCudfSerialization) lands.
+        raise TypeError(
+            f"nested type {d.simple_name} is not supported by the shuffle "
+            "serializer; keep nested-state aggregations (collect_list/"
+            "collect_set/approx_percentile) on the in-memory exchange path")
     if isinstance(d, dt.DecimalType):
         return f"decimal({d.precision},{d.scale})"
     return d.simple_name

@@ -1,0 +1,153 @@
+"""TCP socket shuffle transport — the cross-process tier of the SPI.
+
+Reference mapping (SURVEY §2.7): plays the role of the transport
+server/client pair (RapidsShuffleServer.scala:70 serving block data,
+RapidsShuffleClient.scala:88 fetching from peers) at the always-works TCP
+level; the RDMA/UCX specialization in the reference maps to ICI collectives
+(shuffle/ici.py) on TPU, so the socket tier only needs to be correct and
+portable, not zero-copy.
+
+Design: each executor process owns one ``TcpShuffleTransport``. ``publish``
+stores blocks locally; a server thread answers block requests; ``fetch``
+serves local blocks directly and asks registered peers for the rest. A block
+nobody can produce raises ShuffleFetchFailedException — never silently
+skipped.
+
+Wire protocol (little-endian), one request per connection:
+
+    request:  magic 'SRTB' | u8 op | i64 shuffle | i64 map | i64 reduce
+    response: u8 found | u64 len | payload
+    ops: 1 = GET, 2 = REMOVE_SHUFFLE (shuffle id only; map/reduce ignored)
+"""
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from ..conf import RapidsConf
+from .transport import (BlockId, ShuffleFetchFailedException,
+                        ShuffleTransport)
+
+__all__ = ["TcpShuffleTransport"]
+
+_MAGIC = b"SRTB"
+_OP_GET = 1
+_OP_REMOVE = 2
+_REQ = struct.Struct("<4sBqqq")
+_RESP_HEAD = struct.Struct("<BQ")
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed mid-message")
+        buf += chunk
+    return buf
+
+
+class TcpShuffleTransport(ShuffleTransport):
+    def __init__(self, conf: Optional[RapidsConf] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self._blocks: Dict[BlockId, bytes] = {}
+        self._lock = threading.Lock()
+        self._peers: List[Tuple[str, int]] = []
+        self.bytes_published = 0
+        self.bytes_fetched = 0
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind((host, port))
+        self._server.listen(32)
+        self._closing = False
+        self._thread = threading.Thread(target=self._serve,
+                                        name="srtpu-shuffle-server",
+                                        daemon=True)
+        self._thread.start()
+
+    # -- server side ----------------------------------------------------------
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._server.getsockname()
+
+    def _serve(self):
+        while not self._closing:
+            try:
+                conn, _ = self._server.accept()
+            except OSError:
+                return  # socket closed
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _handle(self, conn: socket.socket):
+        try:
+            with conn:
+                raw = _recv_exact(conn, _REQ.size)
+                magic, op, sid, mid, rid = _REQ.unpack(raw)
+                if magic != _MAGIC:
+                    return
+                if op == _OP_REMOVE:
+                    self.remove_shuffle(sid)
+                    conn.sendall(_RESP_HEAD.pack(1, 0))
+                    return
+                with self._lock:
+                    payload = self._blocks.get(BlockId(sid, mid, rid))
+                if payload is None:
+                    conn.sendall(_RESP_HEAD.pack(0, 0))
+                else:
+                    conn.sendall(_RESP_HEAD.pack(1, len(payload)))
+                    conn.sendall(payload)
+        except Exception:
+            pass  # a broken client connection must not kill the server
+
+    # -- client side ----------------------------------------------------------
+    def add_peer(self, host: str, port: int):
+        self._peers.append((host, port))
+
+    def _ask_peer(self, addr: Tuple[str, int], block: BlockId,
+                  timeout: float = 5.0) -> Optional[bytes]:
+        try:
+            with socket.create_connection(addr, timeout=timeout) as s:
+                s.sendall(_REQ.pack(_MAGIC, _OP_GET, *block))
+                found, length = _RESP_HEAD.unpack(
+                    _recv_exact(s, _RESP_HEAD.size))
+                if not found:
+                    return None
+                return _recv_exact(s, length)
+        except OSError:
+            return None  # dead peer == block not found here
+
+    # -- SPI ------------------------------------------------------------------
+    def publish(self, block: BlockId, payload: bytes) -> None:
+        with self._lock:
+            self._blocks[block] = payload
+            self.bytes_published += len(payload)
+
+    def fetch(self, blocks: List[BlockId]) -> Iterator[Tuple[BlockId, bytes]]:
+        for b in blocks:
+            with self._lock:
+                payload = self._blocks.get(b)
+            if payload is None:
+                for addr in self._peers:
+                    payload = self._ask_peer(addr, b)
+                    if payload is not None:
+                        break
+            if payload is None:
+                raise ShuffleFetchFailedException(
+                    b, f"not found locally or on {len(self._peers)} peers")
+            self.bytes_fetched += len(payload)
+            yield b, payload
+
+    def remove_shuffle(self, shuffle_id: int) -> None:
+        with self._lock:
+            for b in [b for b in self._blocks if b[0] == shuffle_id]:
+                del self._blocks[b]
+
+    def close(self) -> None:
+        self._closing = True
+        try:
+            self._server.close()
+        except OSError:
+            pass

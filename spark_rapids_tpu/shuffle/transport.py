@@ -21,7 +21,7 @@ MAX_INFLIGHT_BYTES = register_conf(
     "maxReceiveInflightBytes, RapidsConf.scala:1064).", 1024 * 1024 * 1024)
 
 __all__ = ["BlockId", "ShuffleTransport", "LocalShuffleTransport",
-           "load_transport"]
+           "ShuffleFetchFailedException", "load_transport"]
 
 
 class BlockId(Tuple[int, int, int]):
@@ -31,8 +31,25 @@ class BlockId(Tuple[int, int, int]):
         return super().__new__(cls, (shuffle_id, map_id, reduce_id))
 
 
+class ShuffleFetchFailedException(Exception):
+    """A shuffle block could not be fetched (reference:
+    RapidsShuffleFetchFailedException -> Spark stage retry,
+    shuffle/RapidsShuffleIterator.scala:191,371). A missing block must FAIL
+    LOUDLY — silently skipping it would produce a silently wrong answer."""
+
+    def __init__(self, block: BlockId, detail: str = ""):
+        self.block = block
+        super().__init__(
+            f"shuffle block (shuffle={block[0]}, map={block[1]}, "
+            f"reduce={block[2]}) could not be fetched"
+            + (f": {detail}" if detail else ""))
+
+
 class ShuffleTransport:
-    """SPI: store blocks on the 'server' side, fetch from the 'client'."""
+    """SPI: store blocks on the 'server' side, fetch from the 'client'.
+
+    ``fetch`` MUST raise ShuffleFetchFailedException for any requested block
+    it cannot produce — never skip."""
 
     def publish(self, block: BlockId, payload: bytes) -> None:
         raise NotImplementedError
@@ -65,9 +82,10 @@ class LocalShuffleTransport(ShuffleTransport):
         for b in blocks:
             with self._lock:
                 payload = self._blocks.get(b)
-            if payload is not None:
-                self.bytes_fetched += len(payload)
-                yield b, payload
+            if payload is None:
+                raise ShuffleFetchFailedException(b, "not in local store")
+            self.bytes_fetched += len(payload)
+            yield b, payload
 
     def remove_shuffle(self, shuffle_id: int) -> None:
         with self._lock:

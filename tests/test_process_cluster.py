@@ -1,0 +1,131 @@
+"""Cross-process shuffle: TCP transport + ProcessCluster + fetch-failed
+semantics (reference: RapidsShuffleServer/Client crossing executors,
+RapidsShuffleFetchFailedException -> stage retry)."""
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.host import HostColumn, HostTable
+from spark_rapids_tpu.shuffle.serializer import deserialize_table, \
+    serialize_table
+from spark_rapids_tpu.shuffle.transport import (BlockId,
+                                                LocalShuffleTransport,
+                                                ShuffleFetchFailedException)
+
+
+def _table(vals, keys=None):
+    cols = [HostColumn(dt.LONG, np.asarray(vals, dtype=np.int64))]
+    names = ["v"]
+    if keys is not None:
+        cols.insert(0, HostColumn(dt.LONG, np.asarray(keys, dtype=np.int64)))
+        names.insert(0, "k")
+    return HostTable(names, cols)
+
+
+def test_local_transport_missing_block_raises():
+    t = LocalShuffleTransport()
+    t.publish(BlockId(0, 0, 0), b"x")
+    with pytest.raises(ShuffleFetchFailedException):
+        list(t.fetch([BlockId(0, 0, 0), BlockId(0, 1, 0)]))
+
+
+def test_tcp_transport_roundtrip_and_fetch_failed():
+    from spark_rapids_tpu.shuffle.tcp import TcpShuffleTransport
+    a = TcpShuffleTransport()
+    b = TcpShuffleTransport()
+    try:
+        b.add_peer(*a.address)
+        payload = serialize_table(_table([1, 2, 3]))
+        a.publish(BlockId(7, 0, 0), payload)
+        b.publish(BlockId(7, 1, 0), serialize_table(_table([4])))
+        got = dict(b.fetch([BlockId(7, 0, 0), BlockId(7, 1, 0)]))
+        assert deserialize_table(got[BlockId(7, 0, 0)]) \
+            .column("v").values.tolist() == [1, 2, 3]
+        with pytest.raises(ShuffleFetchFailedException):
+            list(b.fetch([BlockId(7, 9, 9)]))
+    finally:
+        a.close()
+        b.close()
+
+
+def test_manager_recompute_hook():
+    """A dropped block fails loudly, then recovers via the recompute hook."""
+    import jax
+    from spark_rapids_tpu.columnar.device import DeviceTable
+    from spark_rapids_tpu.shuffle.manager import ShuffleManager
+    transport = LocalShuffleTransport()
+    mgr = ShuffleManager(transport=transport)
+    sid = mgr.new_shuffle_id()
+    tables = {m: _table(np.arange(m * 10, m * 10 + 10),
+                        keys=np.arange(10) % 3) for m in range(2)}
+    for m, t in tables.items():
+        mgr.write_partition(sid, m, iter([DeviceTable.from_host(
+            t, min_bucket=8)]), ["k"], 3)
+    # sabotage: drop one block
+    del transport._blocks[BlockId(sid, 1, 0)]
+    with pytest.raises(ShuffleFetchFailedException):
+        list(mgr.read_partition(sid, 2, 0, min_bucket=8))
+    # with the recompute hook the read succeeds
+    recomputed = []
+
+    def recompute(map_id):
+        recomputed.append(map_id)
+        mgr.write_partition(sid, map_id, iter([DeviceTable.from_host(
+            tables[map_id], min_bucket=8)]), ["k"], 3)
+
+    list(mgr.read_partition(sid, 2, 0, min_bucket=8, recompute=recompute))
+    assert recomputed == [1]
+    # verify the union of all reduce partitions equals the input multiset
+    all_rows = []
+    for r in range(3):
+        for d in mgr.read_partition(sid, 2, r, min_bucket=8,
+                                    recompute=recompute):
+            all_rows.extend(d.to_host().column("v").values.tolist())
+    exp = sorted(v for t in tables.values()
+                 for v in t.column("v").values.tolist())
+    assert sorted(all_rows) == exp
+
+
+@pytest.mark.slow
+def test_process_cluster_shuffle_and_recovery():
+    from spark_rapids_tpu.parallel.runtime import (
+        ProcessCluster, shuffle_read_recompute_task, shuffle_read_task,
+        shuffle_write_task)
+    rng = np.random.default_rng(0)
+    n_maps, n_parts = 2, 3
+    payloads = {}
+    expected_rows = []
+    for m in range(n_maps):
+        keys = rng.integers(0, 50, 200)
+        vals = rng.integers(0, 10_000, 200)
+        expected_rows.extend(vals.tolist())
+        payloads[m] = serialize_table(_table(vals, keys=keys))
+    with ProcessCluster(3) as cluster:
+        sid = 0
+        # map tasks on workers 0 and 1
+        for m in range(n_maps):
+            cluster.run_on(m, shuffle_write_task, sid, m, payloads[m],
+                           ["k"], n_parts)
+        # reduce on worker 2, fetching across processes over TCP
+        got_rows = []
+        for r in range(n_parts):
+            out = cluster.run_on(2, shuffle_read_task, sid, n_maps, r)
+            if out is not None:
+                got_rows.extend(
+                    deserialize_table(out).column("v").values.tolist())
+        assert sorted(got_rows) == sorted(expected_rows)
+
+        # failure injection: kill worker 0 (holds map 0's blocks).
+        cluster.kill(0)
+        # loud failure without recovery
+        with pytest.raises(RuntimeError, match="ShuffleFetchFailed"):
+            cluster.run_on(2, shuffle_read_task, sid, n_maps, 0)
+        # recovery: reduce worker recomputes map 0 from lineage, then reads
+        got_rows = []
+        for r in range(n_parts):
+            out = cluster.run_on(2, shuffle_read_recompute_task, sid,
+                                 n_maps, r, payloads, ["k"], n_parts)
+            if out is not None:
+                got_rows.extend(
+                    deserialize_table(out).column("v").values.tolist())
+        assert sorted(got_rows) == sorted(expected_rows)
