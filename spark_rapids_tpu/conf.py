@@ -221,9 +221,11 @@ class RapidsConf:
             if env_key in os.environ and k not in settings:
                 settings[k] = os.environ[env_key]
         self._values: Dict[str, Any] = {}
-        unknown = [k for k in settings
-                   if k not in _REGISTRY and k.startswith("spark.rapids.")]
-        # Unknown spark.rapids keys are kept (forward compat) but not typed.
+        # Keys not (yet) registered are kept raw: forward compat AND entries
+        # registered after this snapshot was built (lazy module import order,
+        # e.g. spark.sql.mapKeyDedupPolicy in expr/collections.py) — get()
+        # converts them on demand once the entry exists.
+        unknown = [k for k in settings if k not in _REGISTRY]
         self._extra = {k: settings[k] for k in unknown}
         for k, entry in _REGISTRY.items():
             self._values[k] = entry.convert(settings.get(k))

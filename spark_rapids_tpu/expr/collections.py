@@ -24,6 +24,7 @@ from typing import Any, List, Optional, Sequence
 import numpy as np
 
 from ..columnar import dtypes as dt
+from ..conf import _in, register_conf
 from .base import (Alias, AttributeReference, EvalCol, EvalContext,
                    Expression, Literal)
 
@@ -160,15 +161,50 @@ class CreateNamedStruct(Expression):
         return EvalCol(out, None, self.data_type)
 
 
-class CreateMap(Expression):
-    """map(k1, v1, k2, v2, ...). Later duplicate keys win (Spark LAST_WIN)."""
+# one shared NaN object: dict lookup short-circuits on identity, so all
+# normalized NaN keys collide as Spark's canonical-NaN rule requires
+_CANONICAL_NAN = float("nan")
 
-    def __init__(self, *children: Expression):
+MAP_KEY_DEDUP_POLICY = register_conf(
+    "spark.sql.mapKeyDedupPolicy",
+    "How map() handles duplicate keys: EXCEPTION throws (Spark 3.x default, "
+    "followed by the reference GpuCreateMap); LAST_WIN keeps the last value.",
+    "exception", checker=_in("exception", "last_win"))
+
+
+class CreateMap(Expression):
+    """map(k1, v1, k2, v2, ...).
+
+    Duplicate keys raise by default, matching Spark 3.x's default
+    spark.sql.mapKeyDedupPolicy=EXCEPTION (the reference GpuCreateMap follows
+    it too). The policy comes from the active session's conf at eval time
+    unless overridden via the constructor.
+    """
+
+    def __init__(self, *children: Expression,
+                 dedup_policy: Optional[str] = None):
         assert len(children) % 2 == 0, "map takes key/value pairs"
+        if dedup_policy is not None:
+            dedup_policy = dedup_policy.upper()
+            if dedup_policy not in ("EXCEPTION", "LAST_WIN"):
+                raise ValueError(
+                    f"dedup_policy must be EXCEPTION or LAST_WIN, "
+                    f"got {dedup_policy!r}")
         self.children = tuple(children)
+        self._dedup_policy = dedup_policy
+
+    @property
+    def dedup_policy(self) -> str:
+        if self._dedup_policy is not None:
+            return self._dedup_policy
+        from ..session import TpuSession
+        sess = TpuSession._active
+        if sess is not None:
+            return sess.conf.get(MAP_KEY_DEDUP_POLICY).upper()
+        return "EXCEPTION"
 
     def with_children(self, children):
-        return CreateMap(*children)
+        return CreateMap(*children, dedup_policy=self._dedup_policy)
 
     @property
     def data_type(self):
@@ -186,12 +222,29 @@ class CreateMap(Expression):
         vals = [_rows(ctx, v.eval(ctx)) for v in self.children[1::2]]
         n = ctx.num_rows
         out = _obj(n)
+        policy = self.dedup_policy  # resolved once; cannot change mid-eval
         for i in range(n):
             d = {}
             for kc, vc in zip(keys, vals):
-                if kc[i] is None:
+                k = kc[i]
+                if k is None:
                     raise ValueError("Cannot use null as map key")
-                d[kc[i]] = vc[i]
+                # Spark normalizes float keys before dedup
+                # (ArrayBasedMapBuilder FLOAT/DOUBLE_NORMALIZER): -0.0 -> 0.0,
+                # any NaN -> one canonical NaN. Python dicts treat distinct
+                # NaN objects as unequal, so canonicalize here.
+                if isinstance(k, float) or isinstance(k, np.floating):
+                    k = float(k)
+                    if k != k:
+                        k = _CANONICAL_NAN
+                    elif k == 0.0:
+                        k = 0.0
+                if k in d and policy == "EXCEPTION":
+                    raise ValueError(
+                        f"Duplicate map key {k!r} was found; set "
+                        "spark.sql.mapKeyDedupPolicy=LAST_WIN to deduplicate "
+                        "with last-wins semantics")
+                d[k] = vc[i]
             out[i] = list(d.items())
         return EvalCol(out, None, self.data_type)
 

@@ -610,9 +610,13 @@ def struct(*cols) -> Column:
     return Column(CreateNamedStruct(*children))
 
 
-def create_map(*key_value_pairs) -> Column:
+def create_map(*key_value_pairs, dedup_policy=None) -> Column:
+    """map(k1, v1, ...). Duplicate-key handling follows the session conf
+    spark.sql.mapKeyDedupPolicy (EXCEPTION default) unless dedup_policy
+    ("EXCEPTION" | "LAST_WIN") overrides it."""
     from .collections import CreateMap
-    return Column(CreateMap(*[_to_expr(c) for c in key_value_pairs]))
+    return Column(CreateMap(*[_to_expr(c) for c in key_value_pairs],
+                            dedup_policy=dedup_policy))
 
 
 def element_at(c, key) -> Column:

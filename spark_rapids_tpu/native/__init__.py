@@ -146,11 +146,19 @@ def lz4_decompress(data: bytes, uncompressed_size: int) -> bytes:
 # ---------------------------------------------------------------------------
 
 def xxhash64(data: bytes, seed: int = 0) -> int:
+    """True xxhash64 of ``data``; raises when the native library is absent.
+
+    Failing loudly beats a silent non-portable fallback: a checksum minted by
+    a native-enabled process must verify identically everywhere, so a
+    mixed-fleet exchange would see spurious corruption if some processes hash
+    with a different flavor.
+    """
     lib = get_lib()
     if lib is None:
-        # fallback: not bit-compatible, only used for checksums
-        import zlib
-        return zlib.crc32(data) ^ (seed & 0xFFFFFFFF)
+        raise RuntimeError(
+            "native library unavailable: xxhash64 checksums would not be "
+            "portable across processes; build srtpu_native or avoid "
+            "checksummed exchange")
     return int(lib.srtpu_xxhash64_buffer(_u8(data), len(data), seed))
 
 
@@ -177,7 +185,13 @@ def murmur3_columns(columns, seed: int = 42) -> np.ndarray:
                                                                np.int64):
             values = values.astype(np.int32)  # Spark widens narrow ints
         elif values.dtype == np.float32:
-            values = values.astype(np.float64)
+            # Spark hashes FloatType as its 4-byte bit pattern after
+            # normalizing -0.0 -> 0.0 and NaN -> canonical NaN; must match
+            # expr/hashing.py bit-for-bit (same shuffle bucket choice).
+            # float64 normalization lives in srtpu_murmur3_double (C++).
+            f = np.where(values == 0.0, np.float32(0.0), values)
+            f = np.where(np.isnan(f), np.float32("nan"), f).astype(np.float32)
+            values = f.view(np.int32)
         if values.dtype == object:
             encoded = [v.encode("utf-8") if isinstance(v, str) else b""
                        for v in values]

@@ -22,6 +22,7 @@
 #include <cstdint>
 #include <cstring>
 #include <cstdlib>
+#include <limits>
 #include <map>
 #include <unordered_map>
 #include <vector>
@@ -273,7 +274,10 @@ void srtpu_murmur3_long(const int64_t* v, int64_t n, uint32_t* inout) {
 
 void srtpu_murmur3_double(const double* v, int64_t n, uint32_t* inout) {
   for (int64_t i = 0; i < n; ++i) {
-    double d = (v[i] == 0.0) ? 0.0 : v[i];  // normalize -0.0 (Spark rule)
+    // normalize -0.0 and NaN bit patterns (Spark rule; must match the
+    // device path's _normalize_float in expr/hashing.py bit-for-bit)
+    double d = (v[i] == 0.0) ? 0.0 : v[i];
+    if (d != d) d = std::numeric_limits<double>::quiet_NaN();
     int64_t bits;
     std::memcpy(&bits, &d, 8);
     uint32_t lo = (uint32_t)(uint64_t)bits;

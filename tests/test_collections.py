@@ -262,3 +262,35 @@ def test_map_keys_values(session):
     assert out.column("ks").to_pylist() == [["a"], ["b", "c"], None]
     assert out.column("vs").to_pylist() == [[1], [2, 3], None]
     assert out.column("b").to_pylist() == [None, 2, None]
+
+
+def test_create_map_dedup_policy(session):
+    t = pa.table({"a": [1, 2]})
+    df = session.create_dataframe(t)
+    # Spark 3.x default spark.sql.mapKeyDedupPolicy=EXCEPTION: duplicates throw
+    q = df.select(F.create_map(lit("k"), col("a"), lit("k"), lit(9)).alias("m"))
+    with pytest.raises(ValueError, match="Duplicate map key"):
+        q.collect(device=False)
+    # explicit LAST_WIN override keeps the last value
+    q2 = df.select(F.create_map(lit("k"), col("a"), lit("k"), lit(9),
+                                dedup_policy="LAST_WIN").alias("m"))
+    out = q2.collect(device=False)
+    assert out.column("m").to_pylist() == [[("k", 9)], [("k", 9)]]
+    # session conf drives the default policy (RapidsConf is immutable)
+    saved = session.conf
+    session.conf = session.conf.set("spark.sql.mapKeyDedupPolicy", "last_win")
+    try:
+        out = q.collect(device=False)
+        assert out.column("m").to_pylist() == [[("k", 9)], [("k", 9)]]
+    finally:
+        session.conf = saved
+
+
+def test_create_map_nan_keys_dedup(session):
+    # distinct NaN objects are ONE key after Spark float-key normalization
+    t = pa.table({"f": [float("nan"), 1.0]})
+    df = session.create_dataframe(t)
+    q = df.select(F.create_map(col("f"), lit(1),
+                               lit(float("nan")), lit(2)).alias("m"))
+    with pytest.raises(ValueError, match="Duplicate map key"):
+        q.collect(device=False)

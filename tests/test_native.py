@@ -65,21 +65,28 @@ def test_murmur3_matches_device_path():
     dbls = rng.normal(size=n)
     dbls[::17] = 0.0
     dbls[::23] = -0.0
+    flts = rng.normal(size=n).astype(np.float32)
+    flts[::13] = np.float32(0.0)
+    flts[::19] = np.float32(-0.0)
+    flts[::29] = np.float32("nan")
     strs = np.array([f"row-{i}-{'x' * (i % 9)}" for i in range(n)],
                     dtype=object)
 
     table = HostTable(
-        ["l", "i", "d", "s"],
+        ["l", "i", "d", "f", "s"],
         [HostColumn(dt.LONG, longs), HostColumn(dt.INT, ints),
-         HostColumn(dt.DOUBLE, dbls), HostColumn(dt.STRING, strs)])
+         HostColumn(dt.DOUBLE, dbls), HostColumn(dt.FLOAT, flts),
+         HostColumn(dt.STRING, strs)])
     expr = Murmur3Hash(AttributeReference("l", dt.LONG),
                        AttributeReference("i", dt.INT),
                        AttributeReference("d", dt.DOUBLE),
+                       AttributeReference("f", dt.FLOAT),
                        AttributeReference("s", dt.STRING))
     host = expr.eval(EvalContext.for_host(table)).values.astype(np.uint32)
 
     nat = native.murmur3_columns(
-        [(longs, None), (ints, None), (dbls, None), (strs, None)], seed=42)
+        [(longs, None), (ints, None), (dbls, None), (flts, None),
+         (strs, None)], seed=42)
     np.testing.assert_array_equal(nat, host)
 
 
