@@ -273,7 +273,9 @@ def slice_rows(table: DeviceTable, start, length: int) -> DeviceTable:
     start = jnp.clip(start, 0, max(table.capacity - length, 0))
 
     def slc(a: jax.Array) -> jax.Array:
-        starts = (start,) + (0,) * (a.ndim - 1)
+        # all start indices must share one dtype (2-D string data would
+        # otherwise mix the int32 row start with default-int64 zeros)
+        starts = (start,) + (jnp.int32(0),) * (a.ndim - 1)
         sizes = (min(length, a.shape[0]),) + a.shape[1:]
         out = jax.lax.dynamic_slice(a, starts, sizes)
         if length > a.shape[0]:

@@ -21,7 +21,8 @@ from ..utils import metrics as M
 from .base import TpuExec
 
 __all__ = ["TpuProjectExec", "TpuFilterExec", "TpuRangeExec", "TpuUnionExec",
-           "TpuLocalLimitExec", "eval_exprs_device"]
+           "TpuLocalLimitExec", "TpuExpandExec", "TpuSampleExec",
+           "eval_exprs_device"]
 
 
 def eval_exprs_device(table: DeviceTable, exprs: Sequence[Expression],
@@ -150,6 +151,115 @@ class TpuFilterExec(TpuExec):
 
     def node_desc(self):
         return repr(self.condition)
+
+
+class TpuSampleExec(TpuExec):
+    """Device Bernoulli sample (reference: GpuPartitionwiseSampledRDD /
+    GpuPoissonSampler). Batches are compacted and the running row offset is
+    tracked by TRUE row count so the position-hash decisions match the host
+    engine row-for-row."""
+
+    def __init__(self, child: PhysicalPlan, fraction: float, seed: int):
+        super().__init__()
+        from ..expr.hashing import SampleMask
+        self.child = child
+        self.children = (child,)
+        self.fraction = fraction
+        self.seed = seed
+        self.mask_expr = SampleMask(fraction, seed)
+        self.schema = child.schema
+
+    def plan_signature(self) -> str:
+        return f"Sample|{self.fraction}|{self.seed}|{self.schema!r}"
+
+    def execute_columnar(self, pidx: int) -> Iterator[DeviceTable]:
+        from ..utils.compile_cache import cached_jit
+        mask_expr = self.mask_expr
+
+        def make():
+            def fn(table: DeviceTable, offset) -> DeviceTable:
+                ctx = EvalContext.for_device(table, partition_id=pidx,
+                                             batch_row_offset=offset)
+                c = mask_expr.eval(ctx)
+                return table.filter_mask(c.values)
+            return fn
+        fn = cached_jit(self.plan_signature() + f"|p{pidx}", make)
+        offset = 0
+        for batch in self.child_device_batches(pidx):
+            with self.metrics.timed(M.OP_TIME):
+                batch = batch.compact()
+                out = fn(batch, jnp.int64(offset))
+            offset += int(batch.num_rows)  # true rows: match host positions
+            yield out
+
+    def node_desc(self):
+        return f"fraction={self.fraction} seed={self.seed}"
+
+
+class TpuExpandExec(TpuExec):
+    """Device Expand: the P projections evaluate in ONE traced kernel and
+    stack into a (P * capacity)-row batch — fully static shapes (reference:
+    GpuExpandExec.scala emits per-projection batches; stacking suits XLA
+    better than P small launches)."""
+
+    def __init__(self, child: PhysicalPlan, projections, names, schema):
+        super().__init__()
+        self.child = child
+        self.children = (child,)
+        self.projections = projections
+        self.names = list(names)
+        self.schema = schema
+
+    def batch_fn(self) -> Callable[[DeviceTable], DeviceTable]:
+        projections, names = self.projections, self.names
+
+        def fn(table: DeviceTable) -> DeviceTable:
+            from ..columnar.device import concat_device_tables
+            parts = [eval_exprs_device(table, proj, names)
+                     for proj in projections]
+            if len(parts) == 1:
+                return parts[0]
+            return concat_device_tables(parts)
+        return fn
+
+    def plan_signature(self) -> str:
+        child_schema = repr(self.children[0].schema) if self.children else ""
+        return ("Expand|"
+                f"{[[repr(e) for e in p] for p in self.projections]}|"
+                f"{self.names}|{child_schema}")
+
+    @property
+    def fusible(self) -> bool:
+        return not any(e.tree_context_dependent()
+                       for p in self.projections for e in p)
+
+    def execute_columnar(self, pidx: int) -> Iterator[DeviceTable]:
+        from ..columnar.device import concat_device_tables
+        from ..utils.compile_cache import cached_jit
+        if not self.fusible:
+            # context-dependent projections need the real task context
+            offset = 0
+            for batch in self.child_device_batches(pidx):
+                with self.metrics.timed(M.OP_TIME):
+                    parts = [eval_exprs_device(batch, proj, self.names,
+                                               partition_id=pidx,
+                                               batch_row_offset=offset)
+                             for proj in self.projections]
+                    out = parts[0] if len(parts) == 1 \
+                        else concat_device_tables(parts)
+                offset += batch.capacity
+                self.metrics.add(M.NUM_OUTPUT_BATCHES, 1)
+                yield out
+            return
+        fn = cached_jit(self.plan_signature(), self.batch_fn)
+        for batch in self.child_device_batches(pidx):
+            with self.metrics.timed(M.OP_TIME):
+                out = fn(batch)
+            self.metrics.add(M.NUM_OUTPUT_BATCHES, 1)
+            yield out
+
+    def node_desc(self):
+        return f"{len(self.projections)} projections"
 
 
 class TpuRangeExec(TpuExec):

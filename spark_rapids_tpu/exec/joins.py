@@ -49,26 +49,42 @@ from .base import TpuExec
 # it would send every row of one shard to a single grace bucket
 _GRACE_SEED = 9001
 
-__all__ = ["TpuShuffledHashJoinExec", "TpuBroadcastHashJoinExec"]
+__all__ = ["TpuShuffledHashJoinExec", "TpuBroadcastHashJoinExec",
+           "TpuBroadcastNestedLoopJoinExec"]
 
 
-def _sort_key_arrays(cols: List[DeviceColumn], active: jax.Array):
-    """lexsort keys (minor..major) + per-row null flag for a key column set."""
-    keys = []
-    anynull = jnp.zeros(active.shape[0], dtype=bool)
-    for kc in reversed(cols):
-        v = kc.data
-        if jnp.issubdtype(v.dtype, jnp.floating):
-            nan = jnp.isnan(v)
-            v = jnp.where(v == 0, jnp.zeros_like(v), v)
-            v = jnp.where(nan, jnp.full_like(v, jnp.inf), v)
-            keys.append(v)
-            keys.append(nan)
-        else:
-            keys.append(v)
-    for kc in cols:
-        anynull = jnp.logical_or(anynull, jnp.logical_not(kc.validity))
-    return keys, anynull
+def _concat_key_col(bc: DeviceColumn, pc: DeviceColumn) -> DeviceColumn:
+    """Concatenate a build/probe key column pair (strings pad to a common
+    width so the byte matrices stack)."""
+    bdat, pdat = bc.data, pc.data
+    lengths = None
+    if bc.is_string_like:
+        w = max(bdat.shape[1], pdat.shape[1])
+        if bdat.shape[1] < w:
+            bdat = jnp.pad(bdat, ((0, 0), (0, w - bdat.shape[1])))
+        if pdat.shape[1] < w:
+            pdat = jnp.pad(pdat, ((0, 0), (0, w - pdat.shape[1])))
+        lengths = jnp.concatenate([bc.lengths, pc.lengths])
+    data = jnp.concatenate([bdat, pdat])
+    validity = jnp.concatenate([bc.validity, pc.validity])
+    return DeviceColumn(data, validity, bc.dtype, lengths)
+
+
+def _column_code_arrays(col: DeviceColumn) -> List[jax.Array]:
+    """1-D arrays whose tuple-equality equals Spark key-equality for this
+    column (NaN == NaN, -0.0 == 0.0, strings by bytes+length); lexsorting by
+    them (minor..major over the returned order) groups equal keys."""
+    from ..columnar.device import pack_string_key_words
+    v = col.data
+    if col.is_string_like:
+        return pack_string_key_words(v, col.lengths)
+    if jnp.issubdtype(v.dtype, jnp.floating):
+        nan = jnp.isnan(v)
+        v = jnp.where(v == 0, jnp.zeros_like(v), v)
+        # NaN -> +inf for a total order; the nan flag keeps real +inf distinct
+        v = jnp.where(nan, jnp.full_like(v, jnp.inf), v)
+        return [v, nan]
+    return [v]
 
 
 def _join_codes(bcols: List[DeviceColumn], bactive: jax.Array,
@@ -80,28 +96,24 @@ def _join_codes(bcols: List[DeviceColumn], bactive: jax.Array,
     """
     nb = bactive.shape[0]
     npr = pactive.shape[0]
-    cat_cols = []
+    code_arrays: List[jax.Array] = []   # major..minor
+    anynull = jnp.zeros(nb + npr, dtype=bool)
     for bc, pc in zip(bcols, pcols):
-        data = jnp.concatenate([bc.data, pc.data])
-        validity = jnp.concatenate([bc.validity, pc.validity])
-        cat_cols.append(DeviceColumn(data, validity, bc.dtype, None))
+        cat = _concat_key_col(bc, pc)
+        code_arrays.extend(_column_code_arrays(cat))
+        anynull = jnp.logical_or(anynull, jnp.logical_not(cat.validity))
     active = jnp.concatenate([bactive, pactive])
-    keys, anynull = _sort_key_arrays(cat_cols, active)
     usable = jnp.logical_and(active, jnp.logical_not(anynull))
-    keys.append(jnp.logical_not(usable))  # primary: usable rows first
+    # lexsort takes minor..major; prepend reversed codes, usable-first primary
+    keys = list(reversed(code_arrays))
+    keys.append(jnp.logical_not(usable))
     order = jnp.lexsort(tuple(keys))
     usable_s = jnp.take(usable, order)
     # boundary among sorted usable rows (same logic as aggregate kernel)
     same = jnp.ones(nb + npr, dtype=bool)
-    for kc in cat_cols:
-        v = kc.data
-        if jnp.issubdtype(v.dtype, jnp.floating):
-            v = jnp.where(v == 0, jnp.zeros_like(v), v)
-        sv = jnp.take(v, order)
+    for arr in code_arrays:
+        sv = jnp.take(arr, order)
         eq = sv == jnp.roll(sv, 1)
-        if jnp.issubdtype(sv.dtype, jnp.floating):
-            eq = jnp.logical_or(eq, jnp.logical_and(
-                jnp.isnan(sv), jnp.isnan(jnp.roll(sv, 1))))
         eq = eq.at[0].set(False)
         same = jnp.logical_and(same, eq)
     boundary = jnp.logical_and(jnp.logical_not(same), usable_s)
@@ -128,6 +140,15 @@ def _count_matches(bgid: jax.Array, pgid: jax.Array):
     return b_order, starts.astype(jnp.int64), counts.astype(jnp.int64)
 
 
+def _build_matched(bgid: jax.Array, pgid: jax.Array) -> jax.Array:
+    """Per-build-row: does any probe row share its key? (right/full outer)."""
+    p_sorted = jnp.sort(jnp.where(pgid < 0, jnp.full_like(pgid, -1), pgid))
+    b = jnp.where(bgid < 0, jnp.full_like(bgid, -2), bgid)
+    lo = jnp.searchsorted(p_sorted, b, side="left")
+    hi = jnp.searchsorted(p_sorted, b, side="right")
+    return jnp.logical_and(hi > lo, bgid >= 0)
+
+
 def _gather_columns(table: DeviceTable, idx: jax.Array, matched: jax.Array
                     ) -> List[DeviceColumn]:
     cols = []
@@ -135,6 +156,29 @@ def _gather_columns(table: DeviceTable, idx: jax.Array, matched: jax.Array
         g = c.gather(idx)
         cols.append(g.with_validity(jnp.logical_and(g.validity, matched)))
     return cols
+
+
+def _null_device_column(dtype: dt.DataType, capacity: int) -> DeviceColumn:
+    """All-null column of ``dtype`` (outer-join padding)."""
+    from ..columnar.device import bucket_width
+    if isinstance(dtype, (dt.StringType, dt.BinaryType)):
+        return DeviceColumn(
+            jnp.zeros((capacity, bucket_width(1)), dtype=jnp.uint8),
+            jnp.zeros(capacity, dtype=bool), dtype,
+            jnp.zeros(capacity, dtype=jnp.int32))
+    np_dt = dtype.np_dtype()
+    return DeviceColumn(jnp.zeros(capacity, dtype=np_dt),
+                        jnp.zeros(capacity, dtype=bool), dtype, None)
+
+
+def _condition_mask(condition: Expression, table: DeviceTable) -> jax.Array:
+    """Residual-condition boolean mask over an assembled pair table."""
+    ctx = EvalContext.for_device(table)
+    c = condition.eval(ctx)
+    keep = c.values
+    if c.validity is not None:
+        keep = jnp.logical_and(keep, c.validity)
+    return jnp.logical_and(keep, table.row_mask)
 
 
 class _JoinKernels:
@@ -153,36 +197,92 @@ class _JoinKernels:
             bgid, pgid = _join_codes(bcols, build.row_mask, pcols,
                                      probe.row_mask)
             b_order, starts, counts = _count_matches(bgid, pgid)
-            return b_order, starts, counts
+            return b_order, starts, counts, bgid, pgid
         return fn
 
+    def seen_fn(self):
+        """No-condition right/full: OR this probe batch's key matches into
+        the running per-build-row seen mask."""
+        def fn(bgid, pgid, seen):
+            return jnp.logical_or(seen, _build_matched(bgid, pgid))
+        return fn
+
+    def _slots(self, build, probe, b_order, starts, counts, out_cap, outer):
+        """Common slot math: per-output-slot probe index, build index,
+        valid/matched flags."""
+        slot_counts = jnp.maximum(counts, 1) if outer else counts
+        slot_counts = jnp.where(probe.row_mask, slot_counts, 0)
+        cum = jnp.cumsum(slot_counts)
+        total = cum[-1]
+        offsets = cum - slot_counts
+        j = jnp.arange(out_cap, dtype=jnp.int64)
+        pi = jnp.searchsorted(cum, j, side="right")
+        pi = jnp.clip(pi, 0, probe.capacity - 1)
+        k = j - jnp.take(offsets, pi)
+        has_match = jnp.take(counts, pi) > 0
+        b_sorted_pos = jnp.take(starts, pi) + k
+        b_sorted_pos = jnp.clip(b_sorted_pos, 0, build.capacity - 1)
+        bi = jnp.take(b_order, b_sorted_pos)
+        valid_slot = j < total
+        build_matched = jnp.logical_and(valid_slot, has_match)
+        return pi.astype(jnp.int32), bi.astype(jnp.int32), valid_slot, \
+            build_matched, total
+
     def expand_fn(self, out_cap: int, how: str):
+        """Expand without a residual condition. ``left``/``full`` keep
+        unmatched probe rows inline; ``right`` behaves as inner here (its
+        unmatched build rows are emitted by leftover_fn at the end)."""
         node = self.node
 
         def fn(build: DeviceTable, probe: DeviceTable, b_order, starts,
                counts):
             outer = how in ("left", "full")
-            slot_counts = jnp.maximum(counts, 1) if outer else counts
-            slot_counts = jnp.where(probe.row_mask, slot_counts, 0)
-            cum = jnp.cumsum(slot_counts)
-            total = cum[-1]
-            offsets = cum - slot_counts
-            j = jnp.arange(out_cap, dtype=jnp.int64)
-            # probe row for each output slot
-            pi = jnp.searchsorted(cum, j, side="right")
-            pi = jnp.clip(pi, 0, probe.capacity - 1)
-            k = j - jnp.take(offsets, pi)
-            has_match = jnp.take(counts, pi) > 0
-            b_sorted_pos = jnp.take(starts, pi) + k
-            b_sorted_pos = jnp.clip(b_sorted_pos, 0, build.capacity - 1)
-            bi = jnp.take(b_order, b_sorted_pos)
-            valid_slot = j < total
-            build_matched = jnp.logical_and(valid_slot, has_match)
-            pcols = _gather_columns(probe, pi.astype(jnp.int32), valid_slot)
-            bcols = _gather_columns(build, bi.astype(jnp.int32), build_matched)
+            pi, bi, valid_slot, build_matched, total = self._slots(
+                build, probe, b_order, starts, counts, out_cap, outer)
+            pcols = _gather_columns(probe, pi, valid_slot)
+            bcols = _gather_columns(build, bi, build_matched)
             out_cols, names = node.assemble(pcols, bcols, build_matched)
             return DeviceTable(tuple(out_cols), valid_slot,
                                total.astype(jnp.int32), tuple(names))
+        return fn
+
+    def expand_cond_fn(self, out_cap: int, how: str):
+        """Expand WITH a residual condition, outer-correct: candidate pairs
+        are inner-expanded, the condition filters pairs, and probe rows
+        whose every candidate failed are re-emitted null-padded (left/full)
+        — the matched-flag fixup of reference GpuHashJoin.scala:507. Returns
+        (pairs_table[, pad_table][, seen_update]) depending on ``how``."""
+        node = self.node
+        condition = node.condition
+
+        def fn(build: DeviceTable, probe: DeviceTable, b_order, starts,
+               counts):
+            pi, bi, valid_slot, _, total = self._slots(
+                build, probe, b_order, starts, counts, out_cap, outer=False)
+            pcols = _gather_columns(probe, pi, valid_slot)
+            bcols = _gather_columns(build, bi, valid_slot)
+            out_cols, names = node.assemble(pcols, bcols, valid_slot)
+            pairs = DeviceTable(tuple(out_cols), valid_slot,
+                                total.astype(jnp.int32), tuple(names))
+            keep = _condition_mask(condition, pairs)
+            pairs = pairs.filter_mask(keep)
+            keep = jnp.logical_and(keep, valid_slot)
+            any_pass = jnp.zeros(probe.capacity, dtype=bool).at[pi].max(
+                keep, mode="drop")
+            outs = [pairs]
+            if how in ("left", "full"):
+                unmatched = jnp.logical_and(probe.row_mask,
+                                            jnp.logical_not(any_pass))
+                outs.append(node.pad_probe(probe, unmatched))
+            if how in ("right", "full"):
+                seen_upd = jnp.zeros(build.capacity, dtype=bool).at[bi].max(
+                    keep, mode="drop")
+                outs.append(seen_upd)
+            if how in ("left_semi", "left_anti"):
+                keep_rows = jnp.logical_not(any_pass) \
+                    if how == "left_anti" else any_pass
+                return probe.filter_mask(keep_rows)
+            return tuple(outs)
         return fn
 
     def semi_mask_fn(self, anti: bool):
@@ -191,11 +291,27 @@ class _JoinKernels:
             return probe.filter_mask(keep)
         return fn
 
+    def leftover_fn(self):
+        """Final right/full emission: build rows no probe row matched,
+        null-padded on the probe side."""
+        node = self.node
+
+        def fn(build: DeviceTable, seen: jax.Array):
+            emit = jnp.logical_and(build.row_mask, jnp.logical_not(seen))
+            return node.pad_build(build, emit)
+        return fn
+
 
 class TpuShuffledHashJoinExec(TpuExec):
-    """Equi-join: build side = right child, probe side = left child."""
+    """Equi-join: build side = right child, probe side = left child.
 
-    SUPPORTED = ("inner", "left", "left_semi", "left_anti")
+    right/full outer track a per-build-row ``seen`` mask across probe
+    batches and emit never-matched build rows null-padded at the end —
+    sound per partition because the upstream hash exchange gives each
+    partition disjoint key ranges (reference GpuHashJoin.scala:507
+    HashedExistenceJoinIterator / buildSideTrackerOpt)."""
+
+    SUPPORTED = ("inner", "left", "right", "full", "left_semi", "left_anti")
 
     def __init__(self, left: PhysicalPlan, right: PhysicalPlan,
                  left_keys: Sequence[str], right_keys: Sequence[str],
@@ -231,15 +347,21 @@ class TpuShuffledHashJoinExec(TpuExec):
 
     # -- column assembly (traced inside expand kernel) ------------------------
     def assemble(self, pcols: List[DeviceColumn], bcols: List[DeviceColumn],
-                 build_matched: jax.Array):
+                 build_matched: jax.Array, key_from_build: bool = False):
+        """``key_from_build`` routes merged ``on=`` key columns from the
+        build side — used for right/full leftover rows whose probe side is
+        all-null (the coalesce step of the reference's full-outer key
+        handling)."""
         lnames = list(self.left.schema.names)
         rnames = list(self.right.schema.names)
         names: List[str] = []
         cols: List[DeviceColumn] = []
         if self.merge_keys:
-            for k in self.left_keys:
-                cols.append(pcols[lnames.index(k)])
-                names.append(k)
+            for lk, rk in zip(self.left_keys, self.right_keys):
+                src = bcols[rnames.index(rk)] if key_from_build \
+                    else pcols[lnames.index(lk)]
+                cols.append(src)
+                names.append(lk)
             skip_l = set(self.left_keys)
             skip_r = set(self.right_keys)
         else:
@@ -254,6 +376,29 @@ class TpuShuffledHashJoinExec(TpuExec):
                 names.append(n)
                 cols.append(c)
         return cols, names
+
+    # -- null-padded emission (outer-join fixup rows) -------------------------
+    def pad_probe(self, probe: DeviceTable, emit: jax.Array) -> DeviceTable:
+        """Probe rows with an all-null build side (left/full unmatched)."""
+        bcols = [_null_device_column(f.dtype, probe.capacity)
+                 for f in self.right.schema]
+        pcols = [c.with_validity(jnp.logical_and(c.validity, emit))
+                 for c in probe.columns]
+        out_cols, names = self.assemble(pcols, bcols,
+                                        jnp.zeros(probe.capacity, dtype=bool))
+        return DeviceTable(tuple(out_cols), emit,
+                           jnp.sum(emit, dtype=jnp.int32), tuple(names))
+
+    def pad_build(self, build: DeviceTable, emit: jax.Array) -> DeviceTable:
+        """Build rows with an all-null probe side (right/full leftover)."""
+        pcols = [_null_device_column(f.dtype, build.capacity)
+                 for f in self.left.schema]
+        bcols = [c.with_validity(jnp.logical_and(c.validity, emit))
+                 for c in build.columns]
+        out_cols, names = self.assemble(pcols, bcols, emit,
+                                        key_from_build=True)
+        return DeviceTable(tuple(out_cols), emit,
+                           jnp.sum(emit, dtype=jnp.int32), tuple(names))
 
     # -- execution ------------------------------------------------------------
     def _build_table(self, pidx: int) -> DeviceTable:
@@ -280,11 +425,19 @@ class TpuShuffledHashJoinExec(TpuExec):
         if build.nbytes() > self.batch_bytes:
             yield from self._grace_join(build, pidx)
             return
+        build_cap = build.capacity
         handle, own = self._register_build(build)
         del build  # the catalog handle is the owner from here on
+        track = self.how in ("right", "full")
+        seen_box = [jnp.zeros(build_cap, dtype=bool)] if track else None
         try:
             yield from self._probe_join(
-                handle, _device_batches(self.left, pidx))
+                handle, _device_batches(self.left, pidx), seen_box)
+            if track:
+                leftover = cached_jit(self.plan_signature() + "|leftover",
+                                      self._kernels.leftover_fn)
+                with handle as build:
+                    yield leftover(build, seen_box[0])
         finally:
             if own:
                 handle.close()
@@ -295,50 +448,84 @@ class TpuShuffledHashJoinExec(TpuExec):
         return (get_catalog().register(build, SpillPriorities.ACTIVE_ON_DECK),
                 True)
 
-    def _probe_join(self, build_handle, probe_batches
+    def _probe_join(self, build_handle, probe_batches, seen_box=None
                     ) -> Iterator[DeviceTable]:
-        """Join probe batches against one spillable build table."""
+        """Join probe batches against one spillable build table.
+
+        ``seen_box`` (right/full) is a one-element list holding the running
+        per-build-row matched mask, updated in place across batches.
+        """
         counts_fn = cached_jit(self.plan_signature() + "|counts",
                                self._kernels.counts_fn)
+        has_cond = self.condition is not None
         for probe in probe_batches:
             with self.metrics.timed(M.JOIN_TIME), build_handle as build:
-                b_order, starts, counts = counts_fn(build, probe)
-                if self.how in ("left_semi", "left_anti"):
+                b_order, starts, counts, bgid, pgid = counts_fn(build, probe)
+                if seen_box is not None and not has_cond:
+                    seen = cached_jit(self.plan_signature() + "|seen",
+                                      self._kernels.seen_fn)
+                    seen_box[0] = seen(bgid, pgid, seen_box[0])
+                if self.how in ("left_semi", "left_anti") and not has_cond:
                     fn = cached_jit(
                         self.plan_signature() + "|semi",
                         lambda: self._kernels.semi_mask_fn(
                             self.how == "left_anti"))
                     yield fn(probe, counts)
                     continue
-                outer = self.how in ("left", "full")
+                outer_slots = self.how in ("left", "full") and not has_cond
                 slot_counts = np.asarray(
                     jnp.sum(jnp.where(
                         probe.row_mask,
-                        jnp.maximum(counts, 1) if outer else counts, 0)))
+                        jnp.maximum(counts, 1) if outer_slots else counts, 0)))
                 total = int(slot_counts)
                 max_out = self._max_out_rows()
                 if total > max_out:
                     # oversized gather: emit in probe row windows (reference:
                     # AbstractGpuJoinIterator sub-partitions the gather)
                     yield from self._windowed_expand(build, probe, total,
-                                                     max_out, counts_fn)
+                                                     max_out, counts_fn,
+                                                     seen_box)
                     continue
                 out_cap = bucket_rows(max(total, 1), self.min_bucket)
-                expand = cached_jit(
-                    self.plan_signature() + f"|expand{out_cap}",
-                    lambda: self._kernels.expand_fn(out_cap, self.how))
-                out = expand(build, probe, b_order, starts, counts)
-                yield self._apply_condition(out)
+                yield from self._expand_one(build, probe, b_order, starts,
+                                            counts, out_cap, seen_box)
 
-    def _apply_condition(self, out: DeviceTable) -> DeviceTable:
+    def _expand_one(self, build, probe, b_order, starts, counts, out_cap,
+                    seen_box) -> Iterator[DeviceTable]:
+        """One expand call on a probe batch/window (post-count)."""
+        how = self.how
         if self.condition is None:
-            return out
-        cond_fn = cached_jit(self.plan_signature() + "|cond",
-                             lambda: _condition_filter_fn(self.condition))
-        return cond_fn(out)
+            # right behaves as inner here; leftover_fn emits its outer rows
+            eff = {"right": "inner", "full": "left"}.get(how, how)
+            expand = cached_jit(
+                self.plan_signature() + f"|expand{out_cap}",
+                lambda: self._kernels.expand_fn(out_cap, eff))
+            yield expand(build, probe, b_order, starts, counts)
+            return
+        if how == "inner":
+            expand = cached_jit(
+                self.plan_signature() + f"|expand{out_cap}",
+                lambda: self._kernels.expand_fn(out_cap, "inner"))
+            out = expand(build, probe, b_order, starts, counts)
+            cond_fn = cached_jit(self.plan_signature() + "|cond",
+                                 lambda: _condition_filter_fn(self.condition))
+            yield cond_fn(out)
+            return
+        fn = cached_jit(self.plan_signature() + f"|condexpand{out_cap}",
+                        lambda: self._kernels.expand_cond_fn(out_cap, how))
+        res = fn(build, probe, b_order, starts, counts)
+        if how in ("left_semi", "left_anti"):
+            yield res
+            return
+        outs = list(res) if isinstance(res, tuple) else [res]
+        if how in ("right", "full"):
+            seen_upd = outs.pop()  # last element by expand_cond_fn contract
+            seen_box[0] = jnp.logical_or(seen_box[0], seen_upd)
+        for t in outs:
+            yield t
 
     def _windowed_expand(self, build: DeviceTable, probe: DeviceTable,
-                         total: int, max_out: int, counts_fn
+                         total: int, max_out: int, counts_fn, seen_box=None
                          ) -> Iterator[DeviceTable]:
         probe = probe.compact()
         nrows = max(1, int(probe.num_rows))
@@ -346,28 +533,26 @@ class TpuShuffledHashJoinExec(TpuExec):
         avg_mult = max(1.0, total / nrows)
         wsize = bucket_rows(max(self.min_bucket, int(max_out / avg_mult)),
                             self.min_bucket)
-        outer = self.how in ("left", "full")
+        outer_slots = self.how in ("left", "full") and self.condition is None
         start = 0
         while start < nrows:
             window = slice_rows(probe, start, wsize)
             start += wsize
-            b_order, starts, counts = counts_fn(build, window)
+            b_order, starts, counts, _, _ = counts_fn(build, window)
             wtotal = int(np.asarray(jnp.sum(jnp.where(
                 window.row_mask,
-                jnp.maximum(counts, 1) if outer else counts, 0))))
-            if wtotal == 0 and not outer:
+                jnp.maximum(counts, 1) if outer_slots else counts, 0))))
+            if wtotal == 0 and not outer_slots and self.condition is None \
+                    and self.how not in ("left_semi", "left_anti"):
                 continue
             if wtotal > 2 * max_out and wsize > self.min_bucket:
                 # skewed window: recurse with smaller windows
                 yield from self._windowed_expand(build, window, wtotal,
-                                                 max_out, counts_fn)
+                                                 max_out, counts_fn, seen_box)
                 continue
             out_cap = bucket_rows(max(wtotal, 1), self.min_bucket)
-            expand = cached_jit(
-                self.plan_signature() + f"|expand{out_cap}",
-                lambda: self._kernels.expand_fn(out_cap, self.how))
-            yield self._apply_condition(
-                expand(build, window, b_order, starts, counts))
+            yield from self._expand_one(build, window, b_order, starts,
+                                        counts, out_cap, seen_box)
 
     # -- grace-style sub-partitioned join (build side over budget) -----------
     def _grace_split(self, table: DeviceTable, keys: List[str], n_sub: int
@@ -392,6 +577,7 @@ class TpuShuffledHashJoinExec(TpuExec):
         n_sub = min(64, max(2, math.ceil(build.nbytes() / self.batch_bytes)))
         build_parts, own_build = self._grace_build_parts(build, n_sub)
         del build
+        track = self.how in ("right", "full")
         probe_parts: List[List] = [[] for _ in range(n_sub)]
         try:
             for probe in _device_batches(self.left, pidx):
@@ -405,9 +591,20 @@ class TpuShuffledHashJoinExec(TpuExec):
                     for h in probe_parts[s]:
                         with h as t:
                             yield t
-                if probe_parts[s]:
+                seen_box = None
+                if track:
+                    with build_parts[s] as bt:
+                        seen_box = [jnp.zeros(bt.capacity, dtype=bool)]
+                if probe_parts[s] or track:
                     yield from self._probe_join(build_parts[s],
-                                                sub_batches())
+                                                sub_batches(), seen_box)
+                if track:
+                    # never-probed buckets still owe all their build rows
+                    leftover = cached_jit(
+                        self.plan_signature() + "|leftover",
+                        self._kernels.leftover_fn)
+                    with build_parts[s] as bt:
+                        yield leftover(bt, seen_box[0])
         finally:
             if own_build:
                 for h in build_parts:
@@ -423,6 +620,10 @@ class TpuBroadcastHashJoinExec(TpuShuffledHashJoinExec):
 
     def __init__(self, *args, **kw):
         super().__init__(*args, **kw)
+        # broadcasting the build side is unsound when its unmatched rows
+        # appear in the output (duplicated per probe partition)
+        assert self.how not in ("right", "full"), \
+            f"{self.how} join cannot broadcast the right side"
         self._bc_handle = None
         self._bc_grace_parts = None
 
@@ -471,6 +672,188 @@ def _close_quietly(handle):
         handle.close()
     except Exception:
         pass
+
+
+class TpuBroadcastNestedLoopJoinExec(TpuExec):
+    """Non-equi / cross join: the right side is broadcast once, the stream
+    (left) side crosses it in windows sized so window_rows x build_capacity
+    stays under the batch budget (reference:
+    GpuBroadcastNestedLoopJoinExec.scala + GpuCartesianProductExec.scala;
+    conditions compile into the traced kernel like the reference's AST
+    conditions).
+
+    right/full outer consume ALL stream partitions inside partition 0 so
+    unmatched build rows are emitted exactly once (the reference instead
+    requires the build side opposite the outer side; with a single
+    broadcast side this serialization is the sound equivalent).
+    """
+
+    SUPPORTED = ("inner", "cross", "left", "right", "full", "left_semi",
+                 "left_anti")
+
+    def __init__(self, left: PhysicalPlan, right: PhysicalPlan, how: str,
+                 condition: Optional[Expression], min_bucket: int = 1024,
+                 batch_bytes: int = 512 * 1024 * 1024):
+        super().__init__()
+        assert how in self.SUPPORTED, how
+        self.left, self.right = left, right
+        self.children = (left, right)
+        self.how = how
+        self.condition = condition
+        self.min_bucket = min_bucket
+        self.batch_bytes = batch_bytes
+        self.schema = _join_schema(left.schema, right.schema, None, how)
+        self._bc_handle = None
+
+    @property
+    def num_partitions(self) -> int:
+        return self.left.num_partitions
+
+    def node_desc(self):
+        return f"{self.how} condition={self.condition!r}"
+
+    def plan_signature(self) -> str:
+        return (f"BNLJ|{self.how}|{self.condition!r}|"
+                f"{self.left.schema!r}|{self.right.schema!r}")
+
+    def _broadcast_handle(self):
+        if self._bc_handle is None:
+            import weakref
+            from ..memory.catalog import SpillPriorities, get_catalog
+            batches = []
+            for p in range(self.right.num_partitions):
+                batches.extend(_device_batches(self.right, p))
+            if not batches:
+                from .aggregate import _empty_device_table
+                table = _empty_device_table(self.right.schema,
+                                            self.min_bucket)
+            else:
+                table = concat_device_tables(batches) \
+                    if len(batches) > 1 else batches[0]
+            table = shrink_to_fit(table, self.min_bucket)
+            self._bc_handle = get_catalog().register(
+                table, SpillPriorities.BROADCAST)
+            weakref.finalize(self, _close_quietly, self._bc_handle)
+        return self._bc_handle
+
+    # -- assembly & padding (stream side plays the probe role) ---------------
+    def assemble(self, scols: List[DeviceColumn], bcols: List[DeviceColumn]):
+        names = list(self.left.schema.names) + list(self.right.schema.names)
+        return list(scols) + list(bcols), names
+
+    def pad_stream(self, stream: DeviceTable, emit: jax.Array) -> DeviceTable:
+        bcols = [_null_device_column(f.dtype, stream.capacity)
+                 for f in self.right.schema]
+        scols = [c.with_validity(jnp.logical_and(c.validity, emit))
+                 for c in stream.columns]
+        cols, names = self.assemble(scols, bcols)
+        return DeviceTable(tuple(cols), emit, jnp.sum(emit, dtype=jnp.int32),
+                           tuple(names))
+
+    def pad_build(self, build: DeviceTable, emit: jax.Array) -> DeviceTable:
+        scols = [_null_device_column(f.dtype, build.capacity)
+                 for f in self.left.schema]
+        bcols = [c.with_validity(jnp.logical_and(c.validity, emit))
+                 for c in build.columns]
+        cols, names = self.assemble(scols, bcols)
+        return DeviceTable(tuple(cols), emit, jnp.sum(emit, dtype=jnp.int32),
+                           tuple(names))
+
+    # -- kernels --------------------------------------------------------------
+    def cross_fn(self, ws: int, how: str):
+        """One stream-window x build cross product with traced condition."""
+        node = self
+
+        def fn(window: DeviceTable, build: DeviceTable, seen):
+            nb = build.capacity
+            j = jnp.arange(ws * nb, dtype=jnp.int64)
+            si = (j // nb).astype(jnp.int32)
+            bi = (j % nb).astype(jnp.int32)
+            valid = jnp.logical_and(jnp.take(window.row_mask, si),
+                                    jnp.take(build.row_mask, bi))
+            scols = _gather_columns(window, si, valid)
+            bcols = _gather_columns(build, bi, valid)
+            cols, names = node.assemble(scols, bcols)
+            pairs = DeviceTable(tuple(cols), valid,
+                                jnp.sum(valid, dtype=jnp.int32), tuple(names))
+            if node.condition is not None:
+                keep = _condition_mask(node.condition, pairs)
+            else:
+                keep = valid
+            pairs = pairs.filter_mask(keep)
+            any_pass = jnp.zeros(window.capacity, dtype=bool).at[si].max(
+                keep, mode="drop")
+            outs = []
+            if how in ("inner", "cross", "left", "right", "full"):
+                outs.append(pairs)
+            if how in ("left", "full"):
+                unmatched = jnp.logical_and(window.row_mask,
+                                            jnp.logical_not(any_pass))
+                outs.append(node.pad_stream(window, unmatched))
+            if how == "left_semi":
+                outs.append(window.filter_mask(any_pass))
+            if how == "left_anti":
+                outs.append(window.filter_mask(jnp.logical_not(any_pass)))
+            if how in ("right", "full"):
+                seen = jnp.logical_or(
+                    seen,
+                    jnp.zeros(nb, dtype=bool).at[bi].max(keep, mode="drop"))
+            return tuple(outs), seen
+        return fn
+
+    def leftover_fn(self):
+        node = self
+
+        def fn(build: DeviceTable, seen):
+            emit = jnp.logical_and(build.row_mask, jnp.logical_not(seen))
+            return node.pad_build(build, emit)
+        return fn
+
+    # -- execution ------------------------------------------------------------
+    def _window_rows(self, build_cap: int) -> int:
+        row_bytes = 0
+        for f in self.schema:
+            if isinstance(f.dtype, (dt.StringType, dt.BinaryType)):
+                row_bytes += 32
+            else:
+                row_bytes += f.dtype.np_dtype().itemsize
+            row_bytes += 1
+        budget_rows = max(1, self.batch_bytes // max(row_bytes, 1))
+        return bucket_rows(max(1, budget_rows // max(build_cap, 1)),
+                           self.min_bucket)
+
+    def execute_columnar(self, pidx: int) -> Iterator[DeviceTable]:
+        track = self.how in ("right", "full")
+        if track and pidx != 0:
+            return
+        handle = self._broadcast_handle()
+        with handle as build:
+            build_cap = build.capacity
+        ws = self._window_rows(build_cap)
+        seen = jnp.zeros(build_cap, dtype=bool)
+        fn = cached_jit(self.plan_signature() + f"|cross{ws}",
+                        lambda: self.cross_fn(ws, self.how))
+        if track:
+            parts = range(self.left.num_partitions)
+        else:
+            parts = [pidx]
+        for sp in parts:
+            for batch in _device_batches(self.left, sp):
+                batch = batch.compact()
+                nrows = max(0, int(batch.num_rows))
+                start = 0
+                while start < nrows:
+                    window = slice_rows(batch, start, ws)
+                    start += ws
+                    with self.metrics.timed(M.JOIN_TIME), handle as build:
+                        outs, seen = fn(window, build, seen)
+                    for t in outs:
+                        yield t
+        if track:
+            leftover = cached_jit(self.plan_signature() + "|bnlj_leftover",
+                                  self.leftover_fn)
+            with handle as build:
+                yield leftover(build, seen)
 
 
 def _condition_filter_fn(condition: Expression):

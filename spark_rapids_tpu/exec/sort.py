@@ -85,6 +85,68 @@ def device_sort_table(table: DeviceTable, orders: Sequence[SortOrder]) -> Device
     return DeviceTable(cols, mask, table.num_rows, table.names)
 
 
+class TpuTakeOrderedExec(TpuExec):
+    """Device top-n (reference: GpuTakeOrderedAndProjectExec, limit.scala).
+
+    Folds batches through a running top-n: sort batch, truncate to n,
+    concat with state, sort, truncate — state stays at a bucketed n-row
+    capacity so the kernel shapes are stable across batches."""
+
+    def __init__(self, child, orders: Sequence[SortOrder], n: int,
+                 min_bucket: int = 1024):
+        super().__init__()
+        self.child = child
+        self.children = (child,)
+        self.orders = list(orders)
+        self.n = n
+        self.schema = child.schema
+        self.min_bucket = min_bucket
+
+    def plan_signature(self) -> str:
+        return (f"TakeOrdered|{self.n}|"
+                f"{[(repr(o.expr), o.ascending, o.nulls_first) for o in self.orders]}|"
+                f"{self.schema!r}")
+
+    def _topn_fn(self, cap_key: str):
+        from ..utils.compile_cache import cached_jit
+        orders, n = self.orders, self.n
+        cap = bucket_rows(max(n, 1), self.min_bucket)
+
+        def make():
+            def fn(table: DeviceTable) -> DeviceTable:
+                s = device_sort_table(table, orders)
+                iota = jnp.arange(s.capacity, dtype=jnp.int32)
+                keep = jnp.minimum(s.num_rows, jnp.int32(n))
+                mask = iota < keep
+                cols = tuple(
+                    DeviceColumn(c.data[:cap], jnp.logical_and(
+                        c.validity[:cap], mask[:cap]), c.dtype,
+                        None if c.lengths is None else c.lengths[:cap])
+                    for c in s.columns) if s.capacity > cap else tuple(
+                    DeviceColumn(c.data, jnp.logical_and(c.validity, mask),
+                                 c.dtype, c.lengths) for c in s.columns)
+                out_mask = mask[:cap] if s.capacity > cap else mask
+                return DeviceTable(cols, out_mask, keep, s.names)
+            return fn
+        return cached_jit(self.plan_signature() + cap_key, make)
+
+    def execute_columnar(self, pidx: int) -> Iterator[DeviceTable]:
+        state = None
+        for batch in self.child_device_batches(pidx):
+            with self.metrics.timed(M.SORT_TIME):
+                top = self._topn_fn(f"|cap{batch.capacity}")(batch)
+                if state is None:
+                    state = top
+                else:
+                    merged = concat_device_tables([state, top])
+                    state = self._topn_fn(f"|cap{merged.capacity}")(merged)
+        if state is not None:
+            yield state
+
+    def node_desc(self):
+        return f"n={self.n}"
+
+
 class TpuSortExec(TpuExec):
     def __init__(self, child: PhysicalPlan, orders: Sequence[SortOrder],
                  min_bucket: int = 1024,

@@ -211,6 +211,14 @@ class Literal(Expression):
         xp = ctx.xp
         n = ctx.num_rows
         if self.value is None:
+            if isinstance(self._dtype, (dt.StringType, dt.BinaryType)):
+                if ctx.is_device:
+                    from ..columnar.device import bucket_width
+                    mat = xp.zeros((n, bucket_width(1)), dtype=xp.uint8)
+                    return EvalCol(mat, xp.zeros(n, dtype=bool), self._dtype,
+                                   xp.zeros(n, dtype=xp.int32))
+                values = np.empty(n, dtype=object)
+                return EvalCol(values, np.zeros(n, dtype=bool), self._dtype)
             values = xp.zeros(n, dtype=self._dtype.np_dtype())
             return EvalCol(values, xp.zeros(n, dtype=bool), self._dtype)
         if isinstance(self._dtype, (dt.StringType, dt.BinaryType)):

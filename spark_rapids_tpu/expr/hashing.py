@@ -402,6 +402,52 @@ class MonotonicallyIncreasingID(Expression):
         return EvalCol(base + offs, None, dt.LONG)
 
 
+class SampleMask(Expression):
+    """Deterministic Bernoulli-sample predicate: keep a row iff
+    splitmix64(seed, partition, absolute row position) maps below
+    ``fraction``. Unlike Rand, the device and host engines produce the SAME
+    decisions, so sampling differential-tests bit-for-bit (the reference's
+    GpuPoissonSampler is likewise deterministic per seed/partition)."""
+
+    context_dependent = True
+
+    def __init__(self, fraction: float, seed: int):
+        assert 0.0 <= fraction <= 1.0, fraction
+        self.fraction = float(fraction)
+        self.seed = int(seed)
+        self.children = ()
+
+    def with_children(self, children):
+        return self
+
+    def __repr__(self):
+        return f"SampleMask({self.fraction}, seed={self.seed})"
+
+    @property
+    def data_type(self):
+        return dt.BOOLEAN
+
+    @property
+    def nullable(self):
+        return False
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        xp = ctx.xp
+        n = ctx.num_rows
+        pos = xp.arange(n, dtype=xp.int64) + ctx.batch_row_offset
+        x = pos.astype(xp.uint64)
+        x = x + xp.uint64((self.seed * 0x632BE59BD9B4E019
+                           + ctx.partition_id * 0x9E3779B97F4A7C15)
+                          & 0xFFFFFFFFFFFFFFFF)
+        # splitmix64 finalizer (wrapping uint64 arithmetic on both backends)
+        z = (x + xp.uint64(0x9E3779B97F4A7C15))
+        z = (z ^ (z >> xp.uint64(30))) * xp.uint64(0xBF58476D1CE4E5B9)
+        z = (z ^ (z >> xp.uint64(27))) * xp.uint64(0x94D049BB133111EB)
+        z = z ^ (z >> xp.uint64(31))
+        u = (z >> xp.uint64(11)).astype(xp.float64) * (2.0 ** -53)
+        return EvalCol(u < self.fraction, None, dt.BOOLEAN)
+
+
 class Rand(Expression):
     """rand([seed]) — per-partition-seeded uniform [0,1). Like the reference's
     GpuRand, values differ from Spark's XORShiftRandom sequence (marked

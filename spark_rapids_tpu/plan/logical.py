@@ -308,6 +308,59 @@ def _check_dup(names: Sequence[str]):
         raise ValueError(f"duplicate output columns: {dupes}")
 
 
+class LogicalSample(LogicalPlan):
+    """df.sample(fraction, seed): deterministic Bernoulli row sample."""
+
+    def __init__(self, child: LogicalPlan, fraction: float, seed: int):
+        if not 0.0 <= fraction <= 1.0:
+            raise ValueError(f"sample fraction must be in [0, 1], "
+                             f"got {fraction}")
+        self.child = child
+        self.children = (child,)
+        self.fraction = float(fraction)
+        self.seed = int(seed)
+
+    @property
+    def schema(self) -> Schema:
+        return self.child.schema
+
+
+class LogicalExpand(LogicalPlan):
+    """Expand: each input row becomes one output row PER projection —
+    the engine substrate for rollup/cube/grouping sets (reference:
+    GpuExpandExec.scala; exec rule ExpandExec, GpuOverrides.scala:3481ff).
+
+    ``projections`` is a list of same-length expression lists; output column
+    ``i`` carries ``names[i]`` with the common dtype of projection slot ``i``
+    (nullable if any projection can produce null there).
+    """
+
+    def __init__(self, child: LogicalPlan, projections, names):
+        self.child = child
+        self.children = (child,)
+        cs = child.schema
+        assert projections and all(len(p) == len(names) for p in projections)
+        self.projections = [
+            [resolve_expression(e, cs.to_dict(), cs.nullable_dict())
+             for e in proj]
+            for proj in projections]
+        self.names = list(names)
+        _check_dup(self.names)
+        fields = []
+        for i, n in enumerate(self.names):
+            dts = {repr(p[i].data_type) for p in self.projections}
+            if len(dts) != 1:
+                raise TypeError(
+                    f"expand slot {n}: projections disagree on dtype {dts}")
+            nullable = any(p[i].nullable for p in self.projections)
+            fields.append(Field(n, self.projections[0][i].data_type, nullable))
+        self._schema = Schema(fields)
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+
 class LogicalGenerate(LogicalPlan):
     """Generate (explode/posexplode) node: child columns + generator output
     columns (reference: GpuGenerateExec.scala; exec rule GenerateExec in

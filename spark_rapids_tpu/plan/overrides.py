@@ -253,6 +253,20 @@ def _register_exec_rules():
         CpuLocalLimitExec, _device_all,
         lambda p, ch, conf: TpuLocalLimitExec(ch[0], p.n))
 
+    from ..exec.basic import TpuExpandExec, TpuSampleExec
+    from .physical import CpuExpandExec, CpuSampleExec
+
+    register_exec_rule(
+        CpuExpandExec, _device_all,
+        lambda p, ch, conf: TpuExpandExec(ch[0], p.projections, p.names,
+                                          p.schema),
+        exprs_fn=lambda p: [e for proj in p.projections for e in proj])
+
+    # most-derived rule wins over the CpuFilterExec rule in the MRO lookup
+    register_exec_rule(
+        CpuSampleExec, _device_all,
+        lambda p, ch, conf: TpuSampleExec(ch[0], p.fraction, p.seed))
+
     def tag_agg(meta, conf):
         p: CpuHashAggregateExec = meta.plan
         for k in p.key_names:
@@ -286,8 +300,12 @@ def _register_exec_rules():
         CpuCacheExec, _device_all,
         lambda p, ch, conf: TpuCacheExec(ch[0], p.storage))
 
-    from ..exec.joins import TpuBroadcastHashJoinExec, TpuShuffledHashJoinExec
-    from .physical_joins import CpuBroadcastHashJoinExec, CpuShuffledHashJoinExec
+    from ..exec.joins import (TpuBroadcastHashJoinExec,
+                              TpuBroadcastNestedLoopJoinExec,
+                              TpuShuffledHashJoinExec)
+    from .physical_joins import (CpuBroadcastHashJoinExec,
+                                 CpuBroadcastNestedLoopJoinExec,
+                                 CpuShuffledHashJoinExec)
 
     def tag_join(meta, conf):
         p = meta.plan
@@ -296,14 +314,8 @@ def _register_exec_rules():
         for k, side in [(k, p.left) for k in p.left_keys] + \
                        [(k, p.right) for k in p.right_keys]:
             kt = side.schema.field(k).dtype
-            if isinstance(kt, (dt.StringType, dt.BinaryType)):
-                meta.cannot_run(f"join key {k}: string keys not yet supported "
-                                "on device")
-            elif not _device_common.is_supported(kt):
+            if not _device_all.is_supported(kt):
                 meta.cannot_run(f"join key {k}: {kt!r} not supported")
-        if p.condition is not None and p.how != "inner":
-            meta.cannot_run("join residual condition only supported for "
-                            "inner joins on device")
         if p.condition is not None:
             from ..udf import tree_has_python_udf
             if tree_has_python_udf(p.condition):
@@ -325,6 +337,22 @@ def _register_exec_rules():
             ch[0], ch[1], p.left_keys, p.right_keys, p.how, p.condition,
             p.merge_keys, conf.min_bucket_rows, conf.batch_size_bytes),
         exprs_fn=_join_exprs, tag_fn=tag_join)
+
+    def tag_bnlj(meta, conf):
+        p = meta.plan
+        if p.how not in TpuBroadcastNestedLoopJoinExec.SUPPORTED:
+            meta.cannot_run(f"join type {p.how} not supported on device BNLJ")
+        if p.condition is not None:
+            from ..udf import tree_has_python_udf
+            if tree_has_python_udf(p.condition):
+                meta.cannot_run("interpreted Python UDF in join condition")
+
+    register_exec_rule(
+        CpuBroadcastNestedLoopJoinExec, _device_all,
+        lambda p, ch, conf: TpuBroadcastNestedLoopJoinExec(
+            ch[0], ch[1], p.how, p.condition, conf.min_bucket_rows,
+            conf.batch_size_bytes),
+        exprs_fn=_join_exprs, tag_fn=tag_bnlj)
 
     from ..exec.window import TpuWindowExec
     from .physical_window import CpuWindowExec
@@ -391,6 +419,26 @@ def _register_exec_rules():
                                         conf.batch_size_bytes),
         exprs_fn=lambda p: [o.expr for o in p.orders],
         tag_fn=tag_sort)
+
+    from ..exec.sort import TpuTakeOrderedExec
+    from .physical import (CpuCollectLimitExec, CpuGlobalLimitExec,
+                           CpuTakeOrderedExec)
+
+    register_exec_rule(
+        CpuTakeOrderedExec, _device_all,
+        lambda p, ch, conf: TpuTakeOrderedExec(ch[0], p.orders, p.n,
+                                               conf.min_bucket_rows),
+        exprs_fn=lambda p: [o.expr for o in p.orders],
+        tag_fn=tag_sort)
+
+    # GlobalLimit/CollectLimit sit above a single-partition child, where the
+    # device local-limit semantics are exactly right (limit.scala)
+    register_exec_rule(
+        CpuGlobalLimitExec, _device_all,
+        lambda p, ch, conf: TpuLocalLimitExec(ch[0], p.n))
+    register_exec_rule(
+        CpuCollectLimitExec, _device_all,
+        lambda p, ch, conf: TpuLocalLimitExec(ch[0], p.n))
 
     # exchange: on-device ICI all-to-all when a mesh is attached (reference:
     # GpuShuffleExchangeExecBase.scala:146 / RapidsShuffleManager tier)

@@ -167,6 +167,21 @@ class CpuFilterExec(PhysicalPlan):
         return repr(self.condition)
 
 
+class CpuSampleExec(CpuFilterExec):
+    """Deterministic Bernoulli sample (reference: SampleExec rule +
+    GpuPoissonSampler; here a seeded position-hash filter so device and host
+    agree row-for-row)."""
+
+    def __init__(self, child: PhysicalPlan, fraction: float, seed: int):
+        from ..expr.hashing import SampleMask
+        super().__init__(child, SampleMask(fraction, seed))
+        self.fraction = fraction
+        self.seed = seed
+
+    def node_desc(self):
+        return f"fraction={self.fraction} seed={self.seed}"
+
+
 class CpuRangeExec(PhysicalPlan):
     def __init__(self, start: int, end: int, step: int, num_partitions: int = 1):
         self.start, self.end, self.step = start, end, step
@@ -207,6 +222,30 @@ class CpuUnionExec(PhysicalPlan):
         raise IndexError(pidx)
 
 
+class CpuExpandExec(PhysicalPlan):
+    """Each input row -> one output row per projection (grouping sets
+    substrate; reference GpuExpandExec.scala)."""
+
+    def __init__(self, child: PhysicalPlan, projections, names, schema):
+        self.child = child
+        self.children = (child,)
+        self.projections = projections
+        self.names = list(names)
+        self.schema = schema
+
+    def execute(self, pidx: int) -> Iterator[HostTable]:
+        offset = 0
+        for batch in self.child.execute(pidx):
+            for proj in self.projections:
+                yield host_eval_exprs(batch, proj, self.names,
+                                      partition_id=pidx,
+                                      batch_row_offset=offset)
+            offset += batch.num_rows
+
+    def node_desc(self):
+        return f"{len(self.projections)} projections"
+
+
 class CpuLocalLimitExec(PhysicalPlan):
     def __init__(self, child: PhysicalPlan, n: int):
         self.child = child
@@ -241,6 +280,36 @@ class CpuGlobalLimitExec(PhysicalPlan):
 
     def execute(self, pidx: int) -> Iterator[HostTable]:
         yield from CpuLocalLimitExec(self.child, self.n).execute(0)
+
+
+class CpuCollectLimitExec(CpuGlobalLimitExec):
+    """limit-for-collect: local limit per partition feeds a single-partition
+    exchange feeding this (reference: CollectLimitExec rule, limit.scala)."""
+
+
+class CpuTakeOrderedExec(PhysicalPlan):
+    """Top-n: sort each partition's batches and keep the first n rows
+    (reference: GpuTakeOrderedAndProjectExec in limit.scala — local top-n,
+    single-partition exchange, final top-n; the planner stacks two of
+    these around an exchange)."""
+
+    def __init__(self, child: PhysicalPlan, orders, n: int):
+        self.child = child
+        self.children = (child,)
+        self.orders = list(orders)
+        self.n = n
+        self.schema = child.schema
+
+    def execute(self, pidx: int) -> Iterator[HostTable]:
+        batches = list(self.child.execute(pidx))
+        if not batches:
+            return
+        t = HostTable.concat(batches) if len(batches) > 1 else batches[0]
+        idx = _sort_indices(t, self.orders)[:self.n]
+        yield t.take(idx)
+
+    def node_desc(self):
+        return f"n={self.n} orders={len(self.orders)}"
 
 
 # ---------------------------------------------------------------------------

@@ -244,6 +244,21 @@ class CpuBroadcastNestedLoopJoinExec(PhysicalPlan):
 
     def execute(self, pidx: int) -> Iterator[HostTable]:
         rt = self._right_table()
+        if self.how in ("right", "full"):
+            # unmatched BROADCAST rows must be emitted exactly once, so the
+            # whole stream side is consumed in partition 0 (per-batch outer
+            # emission would duplicate them per batch/partition)
+            if pidx != 0:
+                return
+            batches = []
+            for sp in range(self.left.num_partitions):
+                batches.extend(self.left.execute(sp))
+            lt = HostTable.concat(batches) if batches \
+                else _empty_like(self.left.schema)
+            out = join_host_tables(lt, rt, [], [], self.how, self.condition,
+                                   False)
+            yield HostTable(self.schema.names, out.columns)
+            return
         for batch in self.left.execute(pidx):
             out = join_host_tables(batch, rt, [], [], self.how, self.condition,
                                    False)

@@ -14,10 +14,10 @@ from typing import List, Optional, Sequence, Set
 
 from ..conf import RapidsConf, register_conf
 from ..expr.base import Alias, AttributeReference, Expression
-from .logical import (LogicalAggregate, LogicalCache, LogicalFilter,
-                      LogicalJoin, LogicalLimit, LogicalPlan, LogicalProject,
-                      LogicalRange, LogicalScan, LogicalSort, LogicalUnion,
-                      LogicalWindow)
+from .logical import (LogicalAggregate, LogicalCache, LogicalExpand,
+                      LogicalFilter, LogicalJoin, LogicalLimit, LogicalPlan,
+                      LogicalProject, LogicalRange, LogicalSample,
+                      LogicalScan, LogicalSort, LogicalUnion, LogicalWindow)
 from .physical import (AggSpec, CpuFilterExec, CpuGlobalLimitExec,
                        CpuHashAggregateExec, CpuLocalLimitExec, CpuProjectExec,
                        CpuRangeExec, CpuScanExec, CpuSortExec, CpuUnionExec,
@@ -75,12 +75,38 @@ def _plan(node: LogicalPlan, conf: RapidsConf,
             child = ShuffleExchangeExec(child, part)
         return CpuSortExec(child, node.orders)
 
+    if isinstance(node, LogicalSample):
+        from .physical import CpuSampleExec
+        child = _plan(node.child, conf, required)
+        return CpuSampleExec(child, node.fraction, node.seed)
+
+    if isinstance(node, LogicalExpand):
+        from .physical import CpuExpandExec
+        refs = _refs(e for p in node.projections for e in p)
+        child = _plan(node.child, conf, refs)
+        return CpuExpandExec(child, node.projections, node.names, node.schema)
+
     if isinstance(node, LogicalLimit):
+        from .physical import CpuCollectLimitExec, CpuTakeOrderedExec
+        if isinstance(node.child, LogicalSort) and node.child.global_sort:
+            # limit-over-sort fuses into TakeOrderedAndProject: only each
+            # partition's top n rows cross the exchange instead of a full
+            # range-partitioned global sort (reference: limit.scala
+            # GpuTakeOrderedAndProjectExec)
+            sort = node.child
+            child_req = None if required is None \
+                else required | _refs(o.expr for o in sort.orders)
+            child = _plan(sort.child, conf, child_req)
+            local = CpuTakeOrderedExec(child, sort.orders, node.n)
+            if child.num_partitions > 1:
+                single = ShuffleExchangeExec(local, SinglePartitioning())
+                return CpuTakeOrderedExec(single, sort.orders, node.n)
+            return local
         child = _plan(node.child, conf, required)
         local = CpuLocalLimitExec(child, node.n)
         if child.num_partitions > 1:
             single = ShuffleExchangeExec(local, SinglePartitioning())
-            return CpuGlobalLimitExec(single, node.n)
+            return CpuCollectLimitExec(single, node.n)
         return CpuGlobalLimitExec(local, node.n)
 
     if isinstance(node, LogicalUnion):

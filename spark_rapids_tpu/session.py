@@ -262,8 +262,62 @@ class DataFrame:
 
     groupBy = group_by
 
+    def rollup(self, *cols) -> "GroupedData":
+        """Hierarchical grouping sets: rollup(a, b) aggregates by (a, b),
+        (a), and () — lowered through an Expand node (reference:
+        GpuExpandExec.scala; ExpandExec rule in GpuOverrides.scala)."""
+        names = self._grouping_names(cols)
+        sets = [names[:i] for i in range(len(names), -1, -1)]
+        return GroupedData(self, [self._col_expr(c) for c in cols],
+                           grouping_sets=sets)
+
+    def cube(self, *cols) -> "GroupedData":
+        """All 2^k grouping-set combinations of the given columns."""
+        import itertools
+        names = self._grouping_names(cols)
+        sets = []
+        for r in range(len(names), -1, -1):
+            sets.extend(list(c) for c in itertools.combinations(names, r))
+        return GroupedData(self, [self._col_expr(c) for c in cols],
+                           grouping_sets=sets)
+
+    def grouping_sets(self, sets, *cols) -> "GroupedData":
+        """Explicit GROUPING SETS over ``cols``; each entry of ``sets`` is a
+        list of column names drawn from ``cols``."""
+        names = self._grouping_names(cols)
+        for s in sets:
+            unknown = set(s) - set(names)
+            if unknown:
+                raise ValueError(f"grouping set references {unknown} "
+                                 f"not in grouping columns {names}")
+        return GroupedData(self, [self._col_expr(c) for c in cols],
+                           grouping_sets=[list(s) for s in sets])
+
+    def _grouping_names(self, cols):
+        names = []
+        for c in cols:
+            e = self._col_expr(c)
+            from .expr.base import AttributeReference
+            if isinstance(e, AttributeReference):
+                names.append(e.column_name)
+            else:
+                raise TypeError(
+                    "rollup/cube/grouping_sets take column references, "
+                    f"got {e!r} (pre-project expressions with select())")
+        return names
+
     def agg(self, *aggs) -> "DataFrame":
         return GroupedData(self, []).agg(*aggs)
+
+    def sample(self, fraction: float, seed=None) -> "DataFrame":
+        """Deterministic Bernoulli row sample (reference: SampleExec /
+        GpuPoissonSampler). Same seed -> same rows on device and host."""
+        from .plan.logical import LogicalSample
+        if seed is None:
+            import random as _random
+            seed = _random.randrange(2 ** 31)
+        return DataFrame(self.session,
+                         LogicalSample(self.logical, fraction, seed))
 
     def sort(self, *orders, ascending: bool = True) -> "DataFrame":
         sos = []
@@ -364,14 +418,64 @@ class DataFrame:
 
 
 class GroupedData:
-    def __init__(self, df: DataFrame, groupings: Sequence[Expression]):
+    def __init__(self, df: DataFrame, groupings: Sequence[Expression],
+                 grouping_sets=None):
         self.df = df
         self.groupings = list(groupings)
+        self.grouping_sets = grouping_sets
 
     def agg(self, *aggs) -> DataFrame:
         exprs = [_to_expr(a) for a in aggs]
+        if self.grouping_sets is not None:
+            return self._agg_grouping_sets(exprs)
         return DataFrame(self.df.session,
                          LogicalAggregate(self.df.logical, self.groupings, exprs))
+
+    def _agg_grouping_sets(self, aggs) -> DataFrame:
+        """rollup/cube/grouping sets: Expand (one projection per set, absent
+        grouping columns nulled, plus a grouping id so (a=null) data rows
+        stay distinct from aggregated-away rows) -> aggregate -> drop the id
+        (Spark's Aggregate-over-Expand lowering; reference GpuExpandExec).
+
+        Aggregates that read a grouping column get a separate UN-nulled
+        passthrough copy, matching Spark: rollup('a').agg(sum('a')) sums the
+        real values even in rows where 'a' is aggregated away."""
+        from .expr.base import AttributeReference, Literal
+        from .expr.functions import col
+        from .plan.logical import LogicalExpand, LogicalProject
+        child = self.df.logical
+        cs = child.schema
+        gnames = [g.column_name for g in self.groupings]
+        refs = {r for a in aggs for r in a.references()}
+        others = sorted(refs - set(gnames))
+        # grouping columns read by aggregates: alias an un-nulled copy and
+        # rewrite the aggregate expressions to read it
+        copied = sorted(refs & set(gnames))
+        copy_name = {g: f"__gset_input_{g}__" for g in copied}
+        aggs = [_replace_refs(a, copy_name) for a in aggs]
+        gid_name = "__grouping_id__"
+        k = len(gnames)
+        projections = []
+        for s in self.grouping_sets:
+            # Spark grouping id: bit (k-1-i) set when column i is aggregated
+            # away in this set
+            gid = sum(1 << (k - 1 - i) for i, g in enumerate(gnames)
+                      if g not in s)
+            proj = [AttributeReference(g, cs.field(g).dtype) if g in s
+                    else Literal(None, cs.field(g).dtype) for g in gnames]
+            proj += [AttributeReference(o, cs.field(o).dtype) for o in others]
+            proj += [AttributeReference(g, cs.field(g).dtype) for g in copied]
+            proj.append(Literal(gid))
+            projections.append(proj)
+        expand = LogicalExpand(
+            child, projections,
+            gnames + others + [copy_name[g] for g in copied] + [gid_name])
+        agg = LogicalAggregate(
+            expand, [col(g).expr for g in gnames] + [col(gid_name).expr],
+            aggs)
+        out_names = [n for n in agg.schema.names if n != gid_name]
+        proj = LogicalProject(agg, [col(n).expr for n in out_names])
+        return DataFrame(self.df.session, proj)
 
     def count(self) -> DataFrame:
         from .expr.functions import count_star
@@ -382,6 +486,19 @@ def _walk_expr(e):
     yield e
     for c in e.children:
         yield from _walk_expr(c)
+
+
+def _replace_refs(e, mapping):
+    """Rename AttributeReferences per ``mapping`` throughout a tree."""
+    from .expr.base import AttributeReference
+    if isinstance(e, AttributeReference):
+        if e.column_name in mapping:
+            return AttributeReference(mapping[e.column_name], e._dtype,
+                                      e._nullable)
+        return e
+    if not e.children:
+        return e
+    return e.with_children([_replace_refs(c, mapping) for c in e.children])
 
 
 def _as_col(c):

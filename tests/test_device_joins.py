@@ -33,7 +33,8 @@ def test_device_join_in_plan(session, sides):
         or _has_node(plan, "TpuShuffledHashJoinExec"), plan.tree_string()
 
 
-@pytest.mark.parametrize("how", ["inner", "left", "left_semi", "left_anti"])
+@pytest.mark.parametrize("how", ["inner", "left", "right", "full",
+                                 "left_semi", "left_anti"])
 def test_device_join_types(sides, how):
     l, r = sides
     assert_tpu_cpu_equal(l.join(r.select("k", "b"), on="k", how=how))
@@ -49,10 +50,14 @@ def test_device_join_null_keys(session):
     rt = pa.table({"k": [1, None, 3, 4], "b": [10.0, 20.0, 30.0, 40.0]})
     l = session.create_dataframe(lt)
     r = session.create_dataframe(rt)
-    for how in ["inner", "left", "left_semi", "left_anti"]:
+    for how in ["inner", "left", "right", "full", "left_semi", "left_anti"]:
         assert_tpu_cpu_equal(l.join(r, on="k", how=how))
     out = l.join(r, on="k").collect(device=True)
     assert sorted(out.column("k").to_pylist()) == [1, 3]  # nulls never match
+    # full outer: null keys from BOTH sides appear as unmatched rows
+    out = l.join(r, on="k", how="full").collect(device=True)
+    # 2 matches (1,3) + 3 unmatched left (None,2,None) + 2 unmatched right
+    assert out.num_rows == 7
 
 
 def test_device_join_float_keys_nan_zero(session):
@@ -113,17 +118,70 @@ def test_shuffled_path_forced(session, rng):
     assert_tpu_cpu_equal(q)
 
 
-def test_string_join_keys_fall_back(session):
-    lt = pa.table({"k": ["a", "b"], "v": [1, 2]})
-    rt = pa.table({"k": ["b", "c"], "w": [3, 4]})
+def test_string_join_keys_on_device(session, rng):
+    """String join keys run on device via packed-word join codes (the
+    reference gets native string keys from cudf hash join)."""
+    lt = pa.table({"k": ["a", "b", None, "longer-key-aaaa", "b"],
+                   "v": [1, 2, 3, 4, 5]})
+    rt = pa.table({"k": ["b", "c", None, "longer-key-aaaa"],
+                   "w": [3, 4, 5, 6]})
     l = session.create_dataframe(lt)
     r = session.create_dataframe(rt)
     q = l.join(r, on="k")
     plan = session._physical(q.logical, True)
-    assert not _has_node(plan, "TpuBroadcastHashJoinExec")
-    assert not _has_node(plan, "TpuShuffledHashJoinExec")
+    assert _has_node(plan, "TpuBroadcastHashJoinExec") \
+        or _has_node(plan, "TpuShuffledHashJoinExec"), plan.tree_string()
+    for how in ["inner", "left", "right", "full", "left_semi", "left_anti"]:
+        assert_tpu_cpu_equal(l.join(r, on="k", how=how))
     out = q.collect(device=True)
-    assert out.column("k").to_pylist() == ["b"]
+    assert sorted(out.column("k").to_pylist()) == ["b", "b",
+                                                   "longer-key-aaaa"]
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "right", "full",
+                                 "left_semi", "left_anti"])
+def test_outer_residual_condition(session, rng, how):
+    """Residual conditions on outer joins: a probe row whose every candidate
+    fails the condition must still appear null-padded (matched-flag fixup,
+    reference GpuHashJoin.scala:507)."""
+    from spark_rapids_tpu.columnar import dtypes as dt
+    lt = data_gen(rng, 120, {"lk": ("int32", 0, 12), "a": "int64"})
+    rt = data_gen(rng, 90, {"rk": ("int32", 0, 12), "b": "float64"})
+    l = session.create_dataframe(lt, num_partitions=2)
+    r = session.create_dataframe(rt, num_partitions=2)
+    q = l.join(r, how=how,
+               condition=(col("lk") == col("rk"))
+               & (col("a").cast(dt.DOUBLE) > col("b")))
+    assert_tpu_cpu_equal(q)
+
+
+@pytest.mark.parametrize("how", ["inner", "cross", "left", "right", "full",
+                                 "left_semi", "left_anti"])
+def test_device_bnlj(session, rng, how):
+    """Non-equi conditions lower to the device nested-loop join."""
+    lt = data_gen(rng, 60, {"a": ("int64", 0, 40)})
+    rt = data_gen(rng, 25, {"b": ("int64", 0, 40)})
+    l = session.create_dataframe(lt, num_partitions=2)
+    r = session.create_dataframe(rt)
+    cond = None if how == "cross" else col("a") > col("b")
+    q = l.join(r, how=how, condition=cond)
+    plan = session._physical(q.logical, True)
+    assert _has_node(plan, "TpuBroadcastNestedLoopJoinExec"), \
+        plan.tree_string()
+    assert_tpu_cpu_equal(q)
+
+
+def test_bnlj_unmatched_broadcast_rows_once(session, rng):
+    """right/full BNLJ: unmatched broadcast rows appear exactly once even
+    with multiple stream partitions and batches."""
+    lt = data_gen(rng, 50, {"a": ("int64", 0, 10)}, null_prob=0.0)
+    rt = pa.table({"b": [5, 1000]})
+    l = session.create_dataframe(lt, num_partitions=3)
+    r = session.create_dataframe(rt)
+    for how in ("right", "full"):
+        q = l.join(r, how=how, condition=col("a") > col("b"))
+        out = assert_tpu_cpu_equal(q)
+        assert out.column("b").to_pylist().count(1000) == 1
 
 
 def test_right_outer_not_broadcast_with_partitions(session, rng):
