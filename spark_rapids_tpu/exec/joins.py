@@ -810,7 +810,8 @@ class TpuBroadcastNestedLoopJoinExec(TpuExec):
         return fn
 
     # -- execution ------------------------------------------------------------
-    def _window_rows(self, build_cap: int) -> int:
+    def _budget_rows(self) -> int:
+        """Cross-product pair-slot budget derived from the byte budget."""
         row_bytes = 0
         for f in self.schema:
             if isinstance(f.dtype, (dt.StringType, dt.BinaryType)):
@@ -818,9 +819,20 @@ class TpuBroadcastNestedLoopJoinExec(TpuExec):
             else:
                 row_bytes += f.dtype.np_dtype().itemsize
             row_bytes += 1
-        budget_rows = max(1, self.batch_bytes // max(row_bytes, 1))
-        return bucket_rows(max(1, budget_rows // max(build_cap, 1)),
-                           self.min_bucket)
+        return max(self.min_bucket, self.batch_bytes // max(row_bytes, 1))
+
+    def _window_shape(self, build_cap: int):
+        """(stream_window_rows, build_window_rows): both sides window so
+        stream_ws x build_ws pair slots stay under the budget even when the
+        broadcast side alone exceeds it (fixes the reference-scale case
+        where GpuBroadcastNestedLoopJoinExec streams the build side too)."""
+        budget = self._budget_rows()
+        build_ws = bucket_rows(
+            min(build_cap, max(self.min_bucket, budget // self.min_bucket)),
+            self.min_bucket)
+        stream_ws = bucket_rows(max(1, budget // build_ws), self.min_bucket)
+        return stream_ws, min(build_ws, bucket_rows(build_cap,
+                                                    self.min_bucket))
 
     def execute_columnar(self, pidx: int) -> Iterator[DeviceTable]:
         track = self.how in ("right", "full")
@@ -829,14 +841,24 @@ class TpuBroadcastNestedLoopJoinExec(TpuExec):
         handle = self._broadcast_handle()
         with handle as build:
             build_cap = build.capacity
-        ws = self._window_rows(build_cap)
-        seen = jnp.zeros(build_cap, dtype=bool)
-        fn = cached_jit(self.plan_signature() + f"|cross{ws}",
+        ws, bws = self._window_shape(build_cap)
+        n_bslices = max(1, math.ceil(build_cap / bws))
+        semi_like = self.how in ("left_semi", "left_anti")
+        # per-build-slice semantics: pairs emit per slice; stream-side
+        # outer/semi decisions need the OR across slices, so single-slice
+        # keeps the fast path and multi-slice accumulates per window
+        fn = cached_jit(self.plan_signature() + f"|cross{ws}x{bws}",
                         lambda: self.cross_fn(ws, self.how))
-        if track:
-            parts = range(self.left.num_partitions)
-        else:
-            parts = [pidx]
+        # multi-slice variant: pairs only ("right" also threads the seen
+        # update for right/full; stream-side fixup happens after all slices)
+        pairs_how = "right" if track else (
+            "cross" if self.how == "cross" else "inner")
+        pairs_fn = cached_jit(
+            self.plan_signature() + f"|crosspairs{pairs_how}{ws}x{bws}",
+            lambda: self.cross_fn(ws, pairs_how))
+        seen_slices = [jnp.zeros(min(bws, build_cap), dtype=bool)
+                       for _ in range(n_bslices)] if track else None
+        parts = range(self.left.num_partitions) if track else [pidx]
         for sp in parts:
             for batch in _device_batches(self.left, sp):
                 batch = batch.compact()
@@ -845,15 +867,60 @@ class TpuBroadcastNestedLoopJoinExec(TpuExec):
                 while start < nrows:
                     window = slice_rows(batch, start, ws)
                     start += ws
-                    with self.metrics.timed(M.JOIN_TIME), handle as build:
-                        outs, seen = fn(window, build, seen)
-                    for t in outs:
-                        yield t
+                    yield from self._cross_window(
+                        window, handle, n_bslices, bws, fn, pairs_fn,
+                        seen_slices, semi_like)
         if track:
             leftover = cached_jit(self.plan_signature() + "|bnlj_leftover",
                                   self.leftover_fn)
-            with handle as build:
-                yield leftover(build, seen)
+            for bi in range(n_bslices):
+                with handle as build:
+                    bslice = slice_rows(build, bi * bws, min(bws, build_cap))
+                    yield leftover(bslice, seen_slices[bi])
+
+    def _cross_window(self, window, handle, n_bslices, bws, fn, pairs_fn,
+                      seen_slices, semi_like) -> Iterator[DeviceTable]:
+        track = seen_slices is not None
+        if n_bslices == 1:
+            with self.metrics.timed(M.JOIN_TIME), handle as build:
+                outs, seen = fn(window, build, seen_slices[0] if track
+                                else jnp.zeros(build.capacity, dtype=bool))
+            if track:
+                seen_slices[0] = seen
+            yield from outs
+            return
+        # multi-slice: emit inner pairs per slice; accumulate per-stream-row
+        # any_pass across slices for outer/semi fixup at the end
+        any_pass = jnp.zeros(window.capacity, dtype=bool)
+        for bi in range(n_bslices):
+            with self.metrics.timed(M.JOIN_TIME), handle as build:
+                bslice = slice_rows(build, bi * bws,
+                                    min(bws, build.capacity))
+                outs, seen = pairs_fn(
+                    window, bslice,
+                    seen_slices[bi] if track
+                    else jnp.zeros(bslice.capacity, dtype=bool))
+                pairs = outs[0]
+                matched = jnp.zeros(window.capacity, dtype=bool)
+                if self.how not in ("inner", "cross"):
+                    # recompute stream-row matches from the pair mask
+                    nb = bslice.capacity
+                    si = (jnp.arange(pairs.capacity, dtype=jnp.int32) // nb)
+                    matched = jnp.zeros(window.capacity, dtype=bool).at[
+                        si].max(pairs.row_mask, mode="drop")
+            if track:
+                seen_slices[bi] = seen
+            any_pass = jnp.logical_or(any_pass, matched)
+            if self.how in ("inner", "cross", "left", "right", "full"):
+                yield pairs
+        if self.how in ("left", "full"):
+            unmatched = jnp.logical_and(window.row_mask,
+                                        jnp.logical_not(any_pass))
+            yield self.pad_stream(window, unmatched)
+        elif self.how == "left_semi":
+            yield window.filter_mask(any_pass)
+        elif self.how == "left_anti":
+            yield window.filter_mask(jnp.logical_not(any_pass))
 
 
 def _condition_filter_fn(condition: Expression):

@@ -4,6 +4,8 @@ string-cast directions are layered on in strings.py / later rounds).
 """
 from __future__ import annotations
 
+import numpy as np
+
 from ..columnar import dtypes as dt
 from .base import EvalCol, EvalContext, Expression
 
@@ -34,6 +36,29 @@ class Cast(Expression):
         if src == to:
             return c
         xp = ctx.xp
+        # string casts dispatch first: every generic branch below assumes a
+        # scalar (1-D) representation, not the string byte matrix (and e.g.
+        # decimal->string must not fall into the decimal->numeric branch)
+        if isinstance(src, (dt.StringType, dt.BinaryType)) \
+                and not isinstance(to, (dt.StringType, dt.BinaryType)):
+            return self._cast_from_string(ctx, c)
+        if isinstance(to, dt.StringType) \
+                and not isinstance(src, (dt.StringType, dt.BinaryType)):
+            return self._cast_to_string(ctx, c)
+        if isinstance(src, (dt.StringType, dt.BinaryType)) \
+                and isinstance(to, (dt.StringType, dt.BinaryType)):
+            # binary<->string reinterpret: same byte representation
+            if ctx.is_device:
+                return EvalCol(c.values, c.validity, to, c.lengths)
+            if isinstance(to, dt.BinaryType):
+                vals = np.asarray([v.encode() if isinstance(v, str) else v
+                                   for v in c.values], dtype=object)
+            else:
+                vals = np.asarray(
+                    [v.decode("utf-8", "replace")
+                     if isinstance(v, (bytes, bytearray)) else v
+                     for v in c.values], dtype=object)
+            return EvalCol(vals, c.validity, to)
         if isinstance(to, dt.BooleanType):
             values = c.values != 0
             return EvalCol(values, c.validity, to)
@@ -77,22 +102,221 @@ class Cast(Expression):
             return EvalCol(values, xp.zeros(c.shape0(ctx), dtype=bool), to)
         if isinstance(to, dt.StringType):
             return self._cast_to_string(ctx, c)
+        if isinstance(src, dt.StringType):
+            return self._cast_from_string(ctx, c)
         raise TypeError(f"cast {src!r} -> {to!r} not supported")
 
+    # -- to string ------------------------------------------------------------
     def _cast_to_string(self, ctx: EvalContext, c: EvalCol) -> EvalCol:
-        if ctx.is_device:
-            # Device-side number->string needs a digit-emission kernel; tagged
-            # unsupported at planning time for now so this never traces.
-            raise TypeError("cast to string not supported on device yet")
-        import numpy as np
         src = c.dtype
+        if ctx.is_device:
+            from . import cast_kernels as K
+            if isinstance(src, dt.BooleanType):
+                data, lengths = K.bool_to_string_device(c.values)
+            elif isinstance(src, dt.DateType):
+                data, lengths = K.date_to_string_device(c.values)
+            elif isinstance(src, dt.DecimalType):
+                data, lengths = K.decimal_to_string_device(c.values, src.scale)
+            elif src.is_numeric and src not in (dt.FLOAT, dt.DOUBLE):
+                data, lengths = K.int_to_string_device(c.values)
+            else:
+                # float formatting (shortest-roundtrip) has no closed-form
+                # kernel; tag_cast keeps this off device
+                raise TypeError(f"device cast {src!r} -> string unsupported")
+            return EvalCol(data, c.validity, dt.STRING, lengths)
         if isinstance(src, dt.BooleanType):
-            vals = np.asarray(["true" if v else "false" for v in c.values], dtype=object)
+            vals = np.asarray(["true" if v else "false" for v in c.values],
+                              dtype=object)
+        elif isinstance(src, dt.DateType):
+            import datetime
+            vals = np.asarray(
+                [datetime.date.fromordinal(int(v) + 719163).isoformat()
+                 for v in c.values], dtype=object)
+        elif isinstance(src, dt.TimestampType):
+            vals = np.asarray([_format_timestamp(int(v)) for v in c.values],
+                              dtype=object)
+        elif isinstance(src, dt.DecimalType):
+            vals = np.asarray([_format_decimal(int(v), src.scale)
+                               for v in c.values], dtype=object)
         elif src in (dt.FLOAT, dt.DOUBLE):
             vals = np.asarray([repr(float(v)) for v in c.values], dtype=object)
         else:
             vals = np.asarray([str(int(v)) for v in c.values], dtype=object)
         return EvalCol(vals, c.validity, dt.STRING)
 
+    # -- from string ----------------------------------------------------------
+    def _cast_from_string(self, ctx: EvalContext, c: EvalCol) -> EvalCol:
+        to = self.to
+        if ctx.is_device:
+            from . import cast_kernels as K
+            if isinstance(to, dt.BooleanType):
+                vals, ok = K.string_to_bool_device(c.values, c.lengths)
+            elif isinstance(to, dt.DateType):
+                vals, ok = K.string_to_date_device(c.values, c.lengths)
+            elif to in (dt.FLOAT, dt.DOUBLE):
+                vals, ok = K.string_to_double_device(c.values, c.lengths)
+                vals = vals.astype(to.np_dtype())
+            elif to.is_numeric and not isinstance(to, dt.DecimalType):
+                vals, ok = K.string_to_long_device(c.values, c.lengths)
+                if to != dt.LONG:
+                    import jax.numpy as jnp
+                    info = np.iinfo(to.np_dtype())
+                    ok = jnp.logical_and(
+                        ok, jnp.logical_and(vals >= info.min,
+                                            vals <= info.max))
+                    vals = vals.astype(to.np_dtype())
+            else:
+                raise TypeError(f"device cast string -> {to!r} unsupported")
+            import jax.numpy as jnp
+            validity = ok if c.validity is None \
+                else jnp.logical_and(c.validity, ok)
+            return EvalCol(vals, validity, to)
+        n = len(c.values)
+        out = np.zeros(n, dtype=to.np_dtype()
+                       if not isinstance(to, dt.DecimalType) else np.int64)
+        ok = np.zeros(n, dtype=bool)
+        valid_in = c.validity if c.validity is not None \
+            else np.ones(n, dtype=bool)
+        for i, s in enumerate(c.values):
+            if not valid_in[i] or not isinstance(s, str):
+                continue
+            v = _py_parse(s, to)
+            if v is not None:
+                out[i] = v
+                ok[i] = True
+        return EvalCol(out, ok, to)
+
     def __repr__(self):
         return f"cast({self.child!r} as {self.to!r})"
+
+
+# ---------------------------------------------------------------------------
+# host-side parse/format helpers (must agree with cast_kernels rules so the
+# two engines differential-match; Spark non-ANSI: malformed -> null)
+# ---------------------------------------------------------------------------
+_WS = " \t\n\r\f\v"
+_TRUE_TOKENS = frozenset(("true", "t", "yes", "y", "1"))
+_FALSE_TOKENS = frozenset(("false", "f", "no", "n", "0"))
+
+
+def _format_decimal(unscaled: int, scale: int) -> str:
+    if scale <= 0:
+        return str(unscaled)
+    sign = "-" if unscaled < 0 else ""
+    digits = str(abs(unscaled)).rjust(scale + 1, "0")
+    return f"{sign}{digits[:-scale]}.{digits[-scale:]}"
+
+
+def _format_timestamp(micros: int) -> str:
+    import datetime
+    ts = datetime.datetime(1970, 1, 1) + datetime.timedelta(microseconds=micros)
+    base = ts.strftime("%Y-%m-%d %H:%M:%S")
+    if ts.microsecond:
+        return base + f".{ts.microsecond:06d}".rstrip("0")
+    return base
+
+
+def _py_parse(s: str, to: dt.DataType):
+    s = s.strip(_WS)
+    if not s:
+        return None
+    if isinstance(to, dt.BooleanType):
+        low = s.lower()
+        if low in _TRUE_TOKENS:
+            return True
+        if low in _FALSE_TOKENS:
+            return False
+        return None
+    if isinstance(to, dt.DateType):
+        return _py_parse_date(s)
+    if isinstance(to, dt.TimestampType):
+        return _py_parse_timestamp(s)
+    if to in (dt.FLOAT, dt.DOUBLE):
+        if "_" in s:           # python float() allows underscores; Spark no
+            return None
+        low = s.lower()
+        if low in ("nan",):
+            return float("nan")
+        try:
+            v = float(s)
+        except ValueError:
+            return None
+        # python accepts '-nan'; Spark only unsigned NaN
+        if v != v and low != "nan":
+            return None
+        return np.float32(v) if to == dt.FLOAT else v
+    if isinstance(to, dt.DecimalType):
+        import decimal
+        try:
+            d = decimal.Decimal(s)
+        except decimal.InvalidOperation:
+            return None
+        scaled = int((d * (10 ** to.scale)).to_integral_value(
+            rounding=decimal.ROUND_HALF_UP))
+        if abs(scaled) >= 10 ** min(to.precision, 18):
+            return None
+        return scaled
+    # integral: [+-]digits[.digits], fraction truncated, overflow -> null
+    sign = 1
+    body = s
+    if body[0] in "+-":
+        sign = -1 if body[0] == "-" else 1
+        body = body[1:]
+    ip, point, fp = body.partition(".")
+    if not ip.isdigit() or (point and fp and not fp.isdigit()):
+        return None
+    if not ip.isascii() or (fp and not fp.isascii()):
+        return None
+    v = sign * int(ip)
+    info = np.iinfo(to.np_dtype())
+    if v < info.min or v > info.max:
+        return None
+    return v
+
+
+def _py_parse_date(s: str):
+    parts = s.split("-")
+    # leading '-' (negative year) would make parts[0] empty: reject
+    if not 1 <= len(parts) <= 3 or not all(parts):
+        return None
+    if not all(p.isdigit() and p.isascii() for p in parts):
+        return None
+    if len(parts[0]) != 4:
+        return None
+    y = int(parts[0])
+    m = int(parts[1]) if len(parts) > 1 else 1
+    d = int(parts[2]) if len(parts) > 2 else 1
+    if len(parts) > 1 and len(parts[1]) > 2:
+        return None
+    if len(parts) > 2 and len(parts[2]) > 2:
+        return None
+    import datetime
+    try:
+        return datetime.date(y, m, d).toordinal() - 719163
+    except ValueError:
+        return None
+
+
+def _py_parse_timestamp(s: str):
+    import datetime
+    for sep in (" ", "T"):
+        if sep in s:
+            ds, _, ts = s.partition(sep)
+            days = _py_parse_date(ds)
+            if days is None:
+                return None
+            try:
+                t = datetime.time.fromisoformat(ts)
+            except ValueError:
+                return None
+            micros = ((t.hour * 60 + t.minute) * 60 + t.second) * 1_000_000 \
+                + t.microsecond
+            if t.tzinfo is not None:
+                # honor a zone offset: shift to UTC (Spark's behavior)
+                off = t.utcoffset()
+                micros -= int(off.total_seconds() * 1_000_000)
+            return days * 86_400_000_000 + micros
+    days = _py_parse_date(s)
+    if days is None:
+        return None
+    return days * 86_400_000_000

@@ -1,0 +1,383 @@
+"""Device string-cast kernels over the (rows, width) uint8 byte-matrix
+string representation (reference: sql-plugin/.../GpuCast.scala:1513 — the
+cast matrix the reference delegates to cuDF's device casts; here each
+direction is a closed-form jax kernel over the padded byte matrix, so casts
+trace into whole-stage fusion like any other expression).
+
+All kernels are shape-static: output width is a function of the TARGET type
+only, and malformed input produces null (non-ANSI Spark semantics).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "int_to_string_device", "bool_to_string_device", "date_to_string_device",
+    "decimal_to_string_device", "string_to_long_device",
+    "string_to_double_device", "string_to_bool_device",
+    "string_to_date_device",
+]
+
+_POW10_U64 = np.array([10 ** i for i in range(20)], dtype=np.uint64)
+_LONG_MAX = np.uint64(0x7FFFFFFFFFFFFFFF)
+
+
+# ---------------------------------------------------------------------------
+# number/date/bool -> string
+# ---------------------------------------------------------------------------
+def int_to_string_device(vals: jax.Array, width: int = 32):
+    """int64 -> left-aligned decimal bytes. -> (data(n, width), lengths)."""
+    vals = vals.astype(jnp.int64)
+    neg = vals < 0
+    # INT64_MIN-safe magnitude
+    mag = jnp.where(neg, (-(vals + 1)).astype(jnp.uint64) + jnp.uint64(1),
+                    vals.astype(jnp.uint64))
+    pow10 = jnp.asarray(_POW10_U64)
+    ndig = jnp.sum(mag[:, None] >= pow10[None, 1:], axis=1).astype(jnp.int32) + 1
+    length = ndig + neg.astype(jnp.int32)
+    j = jnp.arange(width, dtype=jnp.int32)
+    p = j[None, :] - neg[:, None].astype(jnp.int32)    # digit position
+    exp = ndig[:, None] - 1 - p
+    digit = (mag[:, None] // pow10[jnp.clip(exp, 0, 19)]) % jnp.uint64(10)
+    ch = jnp.where(jnp.logical_and(neg[:, None], j[None, :] == 0),
+                   np.uint8(ord("-")),
+                   (jnp.uint8(ord("0")) + digit.astype(jnp.uint8)))
+    data = jnp.where(j[None, :] < length[:, None], ch, 0).astype(jnp.uint8)
+    return data, length
+
+
+def bool_to_string_device(vals: jax.Array, width: int = 8):
+    t = np.zeros(width, dtype=np.uint8)
+    t[:4] = np.frombuffer(b"true", dtype=np.uint8)
+    f = np.zeros(width, dtype=np.uint8)
+    f[:5] = np.frombuffer(b"false", dtype=np.uint8)
+    b = vals.astype(bool)
+    data = jnp.where(b[:, None], jnp.asarray(t)[None, :],
+                     jnp.asarray(f)[None, :])
+    return data, jnp.where(b, 4, 5).astype(jnp.int32)
+
+
+def _civil_from_days(days: jax.Array):
+    """days since 1970-01-01 -> (y, m, d) (Howard Hinnant's algorithm)."""
+    z = days.astype(jnp.int64) + 719468
+    era = jnp.floor_divide(z, 146097)
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = mp + jnp.where(mp < 10, 3, -9)
+    y = y + (m <= 2)
+    return y, m, d
+
+
+def _days_from_civil(y: jax.Array, m: jax.Array, d: jax.Array):
+    y = y.astype(jnp.int64) - (m <= 2)
+    era = jnp.floor_divide(y, 400)
+    yoe = y - era * 400
+    mp = jnp.where(m > 2, m - 3, m + 9)
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+def date_to_string_device(days: jax.Array, width: int = 16):
+    """days-since-epoch -> 'YYYY-MM-DD' bytes (years clipped to 0..9999)."""
+    y, m, d = _civil_from_days(days)
+    y = jnp.clip(y, 0, 9999)
+    digs = jnp.stack([y // 1000 % 10, y // 100 % 10, y // 10 % 10, y % 10,
+                      jnp.full_like(y, -1),
+                      m // 10 % 10, m % 10,
+                      jnp.full_like(y, -1),
+                      d // 10 % 10, d % 10], axis=1)
+    ch = jnp.where(digs < 0, np.uint8(ord("-")),
+                   jnp.uint8(ord("0")) + digs.astype(jnp.uint8))
+    data = jnp.zeros((days.shape[0], width), dtype=jnp.uint8)
+    data = data.at[:, :10].set(ch.astype(jnp.uint8))
+    return data, jnp.full(days.shape[0], 10, dtype=jnp.int32)
+
+
+def decimal_to_string_device(unscaled: jax.Array, scale: int,
+                             width: int = 32):
+    """scaled-int64 decimal -> '[-]intpart[.fraction]' bytes."""
+    vals = unscaled.astype(jnp.int64)
+    neg = vals < 0
+    mag = jnp.where(neg, (-(vals + 1)).astype(jnp.uint64) + jnp.uint64(1),
+                    vals.astype(jnp.uint64))
+    pow10 = jnp.asarray(_POW10_U64)
+    ndig = jnp.sum(mag[:, None] >= pow10[None, 1:], axis=1).astype(jnp.int32) + 1
+    ndig = jnp.maximum(ndig, scale + 1)       # '0.05' keeps a leading zero
+    point = 1 if scale > 0 else 0
+    length = ndig + point + neg.astype(jnp.int32)
+    j = jnp.arange(width, dtype=jnp.int32)
+    p = j[None, :] - neg[:, None].astype(jnp.int32)    # 0-based char pos
+    int_digits = ndig - scale                          # digits before point
+    is_point = jnp.logical_and(point == 1, p == int_digits[:, None])
+    # digit index skipping the point
+    di = jnp.where(p > int_digits[:, None], p - 1, p) if point else p
+    exp = ndig[:, None] - 1 - di
+    digit = (mag[:, None] // pow10[jnp.clip(exp, 0, 19)]) % jnp.uint64(10)
+    ch = jnp.where(is_point, np.uint8(ord(".")),
+                   jnp.uint8(ord("0")) + digit.astype(jnp.uint8))
+    ch = jnp.where(jnp.logical_and(neg[:, None], j[None, :] == 0),
+                   np.uint8(ord("-")), ch)
+    data = jnp.where(j[None, :] < length[:, None], ch, 0).astype(jnp.uint8)
+    return data, length
+
+
+# ---------------------------------------------------------------------------
+# string -> number/bool/date
+# ---------------------------------------------------------------------------
+def _trim_bounds(data: jax.Array, lengths: jax.Array):
+    """-> (start, end) per row after trimming ASCII whitespace."""
+    n, w = data.shape
+    j = jnp.arange(w, dtype=jnp.int32)
+    in_str = j[None, :] < lengths[:, None]
+    ws = (data == 32) | ((data >= 9) & (data <= 13))
+    content = jnp.logical_and(in_str, jnp.logical_not(ws))
+    any_content = jnp.any(content, axis=1)
+    start = jnp.argmax(content, axis=1).astype(jnp.int32)
+    end = (w - jnp.argmax(content[:, ::-1], axis=1)).astype(jnp.int32)
+    start = jnp.where(any_content, start, 0)
+    end = jnp.where(any_content, end, 0)
+    return start, end
+
+
+def _parse_digits_u64(data, sel):
+    """Accumulate selected digit chars left-to-right into uint64 per row,
+    tracking count; caller guards overflow. sel: bool (n, w) digit mask in
+    positional order (non-selected columns contribute nothing)."""
+    def step(carry, cols):
+        acc, cnt = carry
+        byte, pick = cols
+        d = (byte - np.uint8(ord("0"))).astype(jnp.uint64)
+        acc = jnp.where(pick, acc * jnp.uint64(10) + d, acc)
+        cnt = jnp.where(pick, cnt + 1, cnt)
+        return (acc, cnt), None
+
+    n = data.shape[0]
+    (acc, cnt), _ = jax.lax.scan(
+        step,
+        (jnp.zeros(n, dtype=jnp.uint64), jnp.zeros(n, dtype=jnp.int32)),
+        (data.T, sel.T))
+    return acc, cnt
+
+
+def string_to_long_device(data: jax.Array, lengths: jax.Array):
+    """bytes -> (int64 values, ok mask). Accepts [+-]digits[.digits]
+    (fraction truncated), Spark non-ANSI: malformed/overflow -> null."""
+    n, w = data.shape
+    j = jnp.arange(w, dtype=jnp.int32)
+    start, end = _trim_bounds(data, lengths)
+    first = jnp.take_along_axis(data, start[:, None], axis=1)[:, 0]
+    has_sign = (first == ord("-")) | (first == ord("+"))
+    neg = first == ord("-")
+    dstart = start + has_sign.astype(jnp.int32)
+    in_tok = (j[None, :] >= dstart[:, None]) & (j[None, :] < end[:, None])
+    is_digit = (data >= ord("0")) & (data <= ord("9"))
+    is_point = data == ord(".")
+    # integer part: digits before the first point
+    point_pos = jnp.where(jnp.any(is_point & in_tok, axis=1),
+                          jnp.argmax(is_point & in_tok, axis=1),
+                          end).astype(jnp.int32)
+    int_sel = in_tok & is_digit & (j[None, :] < point_pos[:, None])
+    frac_sel = in_tok & is_digit & (j[None, :] > point_pos[:, None])
+    # every token char must be digit or the single point
+    valid_chars = jnp.all(
+        jnp.logical_or(jnp.logical_not(in_tok),
+                       is_digit | (is_point & (j[None, :] == point_pos[:, None]))),
+        axis=1)
+    acc, cnt = _parse_digits_u64(data, int_sel)
+    _, fcnt = _parse_digits_u64(data, frac_sel)
+    del fcnt
+    # overflow: uint64 accumulation wraps silently, so a float64 shadow
+    # accumulation detects magnitudes past the int64 range (leading zeros
+    # keep >19-digit strings legal, so digit COUNT alone cannot decide)
+    facc, _ = _parse_digits_float(data, int_sel)
+    limit = _LONG_MAX + neg.astype(jnp.uint64)
+    # at least one integer digit required ('.5' casts to null for integrals)
+    ok = valid_chars & (cnt > 0) & (facc <= 9.3e18) & (acc <= limit)
+    vals = jnp.where(neg, -(acc.astype(jnp.int64)), acc.astype(jnp.int64))
+    return jnp.where(ok, vals, 0), ok
+
+
+def _parse_digits_float(data, sel):
+    def step(carry, cols):
+        acc, cnt = carry
+        byte, pick = cols
+        d = (byte - np.uint8(ord("0"))).astype(jnp.float64)
+        acc = jnp.where(pick, acc * 10.0 + d, acc)
+        cnt = jnp.where(pick, cnt + 1, cnt)
+        return (acc, cnt), None
+
+    n = data.shape[0]
+    (acc, cnt), _ = jax.lax.scan(
+        step,
+        (jnp.zeros(n, dtype=jnp.float64), jnp.zeros(n, dtype=jnp.int32)),
+        (data.T, sel.T))
+    return acc, cnt
+
+
+def _lower(data: jax.Array) -> jax.Array:
+    up = (data >= ord("A")) & (data <= ord("Z"))
+    return jnp.where(up, data + 32, data).astype(jnp.uint8)
+
+
+def string_to_double_device(data: jax.Array, lengths: jax.Array):
+    """bytes -> (float64, ok). [+-]digits[.digits][eE[+-]digits] plus the
+    Spark special tokens Infinity/-Infinity/NaN (case-insensitive)."""
+    n, w = data.shape
+    j = jnp.arange(w, dtype=jnp.int32)
+    start, end = _trim_bounds(data, lengths)
+    low = _lower(data)
+    first = jnp.take_along_axis(data, start[:, None], axis=1)[:, 0]
+    has_sign = (first == ord("-")) | (first == ord("+"))
+    neg = first == ord("-")
+    dstart = start + has_sign.astype(jnp.int32)
+    tok_len = end - dstart
+
+    def _matches(token: bytes):
+        t = np.zeros(w, dtype=np.uint8)
+        t[:len(token)] = np.frombuffer(token, dtype=np.uint8)
+        # compare low[dstart + k] with t[k] for k < len(token)
+        idx = jnp.clip(dstart[:, None] + j[None, :], 0, w - 1)
+        shifted = jnp.take_along_axis(low, idx, axis=1)
+        want = jnp.asarray(t)[None, :]
+        k_in = j[None, :] < len(token)
+        return jnp.all(jnp.logical_or(jnp.logical_not(k_in), shifted == want),
+                       axis=1) & (tok_len == len(token))
+
+    is_inf = _matches(b"infinity") | _matches(b"inf")
+    is_nan = _matches(b"nan") & jnp.logical_not(has_sign)
+
+    in_tok = (j[None, :] >= dstart[:, None]) & (j[None, :] < end[:, None])
+    is_digit = (data >= ord("0")) & (data <= ord("9"))
+    is_point = data == ord(".")
+    is_e = low == ord("e")
+    e_pos = jnp.where(jnp.any(is_e & in_tok, axis=1),
+                      jnp.argmax(is_e & in_tok, axis=1),
+                      end).astype(jnp.int32)
+    before_e = j[None, :] < e_pos[:, None]
+    point_first = jnp.argmax(is_point & in_tok & before_e, axis=1)
+    has_point = jnp.any(is_point & in_tok & before_e, axis=1)
+    point_pos = jnp.where(has_point, point_first, e_pos).astype(jnp.int32)
+
+    mant_int = in_tok & is_digit & before_e & (j[None, :] < point_pos[:, None])
+    mant_frac = in_tok & is_digit & before_e & (j[None, :] > point_pos[:, None])
+    # exponent part: [+-]digits after e
+    es = e_pos + 1
+    efirst_idx = jnp.clip(es[:, None], 0, w - 1)
+    echar = jnp.take_along_axis(data, efirst_idx, axis=1)[:, 0]
+    e_sign = (echar == ord("-")) | (echar == ord("+"))
+    e_neg = echar == ord("-")
+    e_dstart = es + e_sign.astype(jnp.int32)
+    exp_sel = (j[None, :] >= e_dstart[:, None]) & (j[None, :] < end[:, None]) \
+        & is_digit
+    has_e = e_pos < end
+
+    mant, icnt = _parse_digits_float(data, mant_int)
+    frac, fcnt = _parse_digits_float(data, mant_frac)
+    expv, ecnt = _parse_digits_float(data, exp_sel)
+
+    # structural validity: all token chars classified
+    classified = jnp.logical_or(
+        jnp.logical_not(in_tok),
+        is_digit
+        | (is_point & (j[None, :] == point_pos[:, None]) & before_e)
+        | (is_e & (j[None, :] == e_pos[:, None]))
+        | (((data == ord("-")) | (data == ord("+")))
+           & (j[None, :] == es[:, None]) & has_e[:, None]))
+    valid = jnp.all(classified, axis=1) & ((icnt + fcnt) > 0) \
+        & jnp.logical_or(jnp.logical_not(has_e), ecnt > 0)
+
+    expo = jnp.where(e_neg, -expv, expv)
+    value = (mant + frac * jnp.power(10.0, -fcnt.astype(jnp.float64))) \
+        * jnp.power(10.0, expo)
+    value = jnp.where(neg, -value, value)
+    value = jnp.where(is_inf, jnp.where(neg, -jnp.inf, jnp.inf), value)
+    value = jnp.where(is_nan, jnp.nan, value)
+    ok = (valid | is_inf | is_nan) & ((end - start) > 0)
+    return jnp.where(ok, value, 0.0), ok
+
+
+_TRUE_TOKENS = (b"true", b"t", b"yes", b"y", b"1")
+_FALSE_TOKENS = (b"false", b"f", b"no", b"n", b"0")
+
+
+def string_to_bool_device(data: jax.Array, lengths: jax.Array):
+    n, w = data.shape
+    j = jnp.arange(w, dtype=jnp.int32)
+    start, end = _trim_bounds(data, lengths)
+    low = _lower(data)
+    tok_len = end - start
+
+    def _matches(token: bytes):
+        t = np.zeros(w, dtype=np.uint8)
+        t[:len(token)] = np.frombuffer(token, dtype=np.uint8)
+        idx = jnp.clip(start[:, None] + j[None, :], 0, w - 1)
+        shifted = jnp.take_along_axis(low, idx, axis=1)
+        k_in = j[None, :] < len(token)
+        return jnp.all(jnp.logical_or(jnp.logical_not(k_in),
+                                      shifted == jnp.asarray(t)[None, :]),
+                       axis=1) & (tok_len == len(token))
+
+    is_true = jnp.zeros(n, dtype=bool)
+    for tk in _TRUE_TOKENS:
+        is_true = is_true | _matches(tk)
+    is_false = jnp.zeros(n, dtype=bool)
+    for tk in _FALSE_TOKENS:
+        is_false = is_false | _matches(tk)
+    return is_true, is_true | is_false
+
+
+def string_to_date_device(data: jax.Array, lengths: jax.Array):
+    """'yyyy[-m[m][-d[d]]]' -> (days-since-epoch int32, ok)."""
+    n, w = data.shape
+    j = jnp.arange(w, dtype=jnp.int32)
+    start, end = _trim_bounds(data, lengths)
+    in_tok = (j[None, :] >= start[:, None]) & (j[None, :] < end[:, None])
+    is_digit = (data >= ord("0")) & (data <= ord("9"))
+    is_dash = data == ord("-")
+    dash = is_dash & in_tok
+    ndash = jnp.sum(dash, axis=1)
+    d1 = jnp.where(jnp.any(dash, axis=1), jnp.argmax(dash, axis=1),
+                   end).astype(jnp.int32)
+    after1 = dash & (j[None, :] > d1[:, None])
+    d2 = jnp.where(jnp.any(after1, axis=1), jnp.argmax(after1, axis=1),
+                   end).astype(jnp.int32)
+    ysel = in_tok & is_digit & (j[None, :] < d1[:, None])
+    msel = in_tok & is_digit & (j[None, :] > d1[:, None]) \
+        & (j[None, :] < d2[:, None])
+    dsel = in_tok & is_digit & (j[None, :] > d2[:, None])
+    yv, ycnt = _parse_digits_u64(data, ysel)
+    mv, mcnt = _parse_digits_u64(data, msel)
+    dv, dcnt = _parse_digits_u64(data, dsel)
+    # all token chars must be digits or the (up to two) dashes
+    classified = jnp.logical_or(
+        jnp.logical_not(in_tok),
+        is_digit | (is_dash & ((j[None, :] == d1[:, None])
+                               | (j[None, :] == d2[:, None]))))
+    yv = yv.astype(jnp.int64)
+    mv = jnp.where(ndash >= 1, mv.astype(jnp.int64), 1)
+    dv = jnp.where(ndash >= 2, dv.astype(jnp.int64), 1)
+    mcnt_ok = jnp.where(ndash >= 1, (mcnt >= 1) & (mcnt <= 2), True)
+    dcnt_ok = jnp.where(ndash >= 2, (dcnt >= 1) & (dcnt <= 2), True)
+    dim = _days_in_month(yv, mv)
+    # year >= 1: python's datetime (the host engine) has no year 0
+    ok = jnp.all(classified, axis=1) & (ndash <= 2) & (ycnt == 4) \
+        & mcnt_ok & dcnt_ok & (yv >= 1) \
+        & (mv >= 1) & (mv <= 12) & (dv >= 1) & (dv <= dim) \
+        & ((end - start) > 0)
+    days = _days_from_civil(yv, mv, dv).astype(jnp.int32)
+    return jnp.where(ok, days, 0), ok
+
+
+def _days_in_month(y, m):
+    leap = ((y % 4 == 0) & (y % 100 != 0)) | (y % 400 == 0)
+    base = jnp.asarray(np.array([0, 31, 28, 31, 30, 31, 30, 31, 31, 30, 31,
+                                 30, 31], dtype=np.int64))
+    dim = base[jnp.clip(m, 0, 12)]
+    return jnp.where((m == 2) & leap, 29, dim)

@@ -95,6 +95,9 @@ class RegexParser:
     def __init__(self, pattern: str):
         self.p = pattern
         self.i = 0
+        #: lazy quantifiers seen — harmless for boolean matching, but they
+        #: change SPAN lengths, so span-based ops must stay on host
+        self.saw_lazy = False
 
     def parse(self) -> RNode:
         node = self._alt()
@@ -140,6 +143,7 @@ class RegexParser:
             if nxt in ("+",):   # possessive quantifiers: Java-only semantics
                 raise RegexUnsupported("possessive quantifier")
             if nxt == "?":      # lazy: irrelevant for pure matching, consume
+                self.saw_lazy = True
                 self.i += 1
         return atom
 
@@ -379,6 +383,62 @@ class DeviceNfa:
         self.anchored_start = anchored_start
         self.anchored_end = anchored_end
         self.nullable = nullable
+        #: every matchable byte < 0x80 — match spans are then char-aligned
+        #: on any UTF-8 subject, enabling span extraction/replacement
+        self.ascii_only = False
+        #: alternation present: NFA longest-match may diverge from Java's
+        #: first-alternative backtracking order, so spans stay host-only
+        self.has_alt = True
+        #: shortest non-empty accepted length (bounds replace output growth)
+        self.min_len = 0
+
+    @property
+    def spans_supported(self) -> bool:
+        """Span extraction (regexp_replace/extract) supported: ASCII-only
+        byte classes (char-aligned spans), no alternation (NFA longest ==
+        Java greedy order for the remaining subset), non-nullable (no
+        empty-match insertion semantics)."""
+        return self.ascii_only and not self.has_alt and not self.nullable
+
+    def match_ends(self, xp, values, lengths):
+        """Per (row, start byte): longest match END (exclusive), or -1.
+
+        Byte-level stepping — requires ``ascii_only`` so spans cannot split
+        a UTF-8 character. O(w^2 * states) work, the static-shape price of
+        dynamic match spans (the reference pays the same inside cuDF)."""
+        from jax import lax
+        v, w = values, values.shape[1]
+        n = v.shape[0]
+        cls = xp.asarray(self.class_of_byte)[v.astype(xp.int32)]   # (n, w)
+        masks = xp.asarray(self.masks)                             # (c, S)
+        S = self.masks.shape[1]
+        bit = (xp.uint32(1) << xp.arange(S, dtype=xp.uint32))
+        accept = xp.uint32(self.accept_bits)
+        pos = xp.arange(w, dtype=xp.int32)
+        in_str = pos[None, :] < lengths[:, None]
+
+        def step(carry, j):
+            states, ends = carry               # (n, w) uint32 / int32
+            # open a new match at start position j (column j)
+            can_start = in_str[:, j] & ((not self.anchored_start) | (j == 0))
+            states = states.at[:, j].set(
+                xp.where(can_start, xp.uint32(self.start_bits),
+                         xp.uint32(0)))
+            m = masks[cls[:, j]]                                 # (n, S)
+            hits = (states[:, :, None] & m[:, None, :]) != 0     # (n, w, S)
+            nxt = (hits.astype(xp.uint32)
+                   * bit[None, None, :]).sum(axis=2, dtype=xp.uint32)
+            states = xp.where(in_str[:, j][:, None], nxt, xp.uint32(0))
+            done = (states & accept) != 0
+            if self.anchored_end:
+                done = done & (j == (lengths - 1))[:, None]
+            ends = xp.where(done & in_str[:, j][:, None], j + 1, ends)
+            return (states, ends), None
+
+        init = (xp.zeros((n, w), dtype=xp.uint32),
+                xp.full((n, w), -1, dtype=xp.int32))
+        (_, ends), _ = lax.scan(step, init, pos)
+        return ends
 
     def matches(self, ctx, col):
         """col: device EvalCol (string). Returns (n,) bool of find() matches."""
@@ -443,7 +503,8 @@ class DeviceNfa:
 def compile_device_nfa(pattern: str) -> Optional[DeviceNfa]:
     """Compile ``pattern`` to a DeviceNfa, or None when outside the subset."""
     try:
-        ast = RegexParser(pattern).parse()
+        parser = RegexParser(pattern)
+        ast = parser.parse()
     except RegexUnsupported:
         return None
     # peel top-level anchors
@@ -496,7 +557,133 @@ def compile_device_nfa(pattern: str) -> Optional[DeviceNfa]:
     accept_bits = 0
     for s in frag.last:
         accept_bits |= (1 << s)
-    return DeviceNfa(class_of_byte.astype(np.int32), masks,
-                     start_bits=1, accept_bits=accept_bits,
-                     anchored_start=anchored_start, anchored_end=anchored_end,
-                     nullable=frag.nullable)
+    nfa = DeviceNfa(class_of_byte.astype(np.int32), masks,
+                    start_bits=1, accept_bits=accept_bits,
+                    anchored_start=anchored_start, anchored_end=anchored_end,
+                    nullable=frag.nullable)
+    nfa.ascii_only = all(max(bs, default=0) < 0x80 for bs in sets)
+    nfa.has_alt = _contains_alt(ast) or parser.saw_lazy
+    nfa.min_len = _nfa_min_len(frag, len(sets))
+    return nfa
+
+
+def _contains_alt(node: RNode) -> bool:
+    if isinstance(node, RAlt):
+        return True
+    if isinstance(node, RSeq):
+        return any(_contains_alt(i) for i in node.items)
+    if isinstance(node, RRepeat):
+        return _contains_alt(node.child)
+    return False
+
+
+def _nfa_min_len(frag: _Frag, n_positions: int) -> int:
+    """Shortest accepted string length (Bellman-Ford over follow pairs)."""
+    if frag.nullable:
+        return 0
+    INF = n_positions + 2
+    dist = [INF] * (n_positions + 1)
+    for s in frag.first:
+        dist[s] = 1
+    for _ in range(n_positions):
+        changed = False
+        for (a, b) in frag.pairs:
+            if dist[a] + 1 < dist[b]:
+                dist[b] = dist[a] + 1
+                changed = True
+        if not changed:
+            break
+    best = min((dist[s] for s in frag.last), default=INF)
+    return max(1, best if best < INF else 1)
+
+
+# ---------------------------------------------------------------------------
+# Match-span machinery (device regexp_replace / regexp_extract / replace):
+# select leftmost non-overlapping spans, then re-emit bytes around them.
+# ---------------------------------------------------------------------------
+def select_leftmost_spans(xp, ends, lengths):
+    """ends: (n, w) longest-match end per start (or -1). Returns
+    (start_mask, in_match): leftmost non-overlapping selection, the order
+    Java Matcher.find() visits matches."""
+    from jax import lax
+    n, w = ends.shape
+    pos = xp.arange(w, dtype=xp.int32)
+
+    def step(carry, j):
+        next_allowed = carry
+        start = xp.logical_and(ends[:, j] >= 0, j >= next_allowed)
+        next_allowed = xp.where(start, ends[:, j], next_allowed)
+        in_match = j < next_allowed
+        return next_allowed, (start, in_match)
+
+    _, (starts, in_match) = lax.scan(
+        step, xp.zeros(n, dtype=xp.int32), pos)
+    return starts.T, in_match.T        # scan stacks along axis 0
+
+
+def replace_by_spans(xp, values, lengths, start_mask, in_match,
+                     repl: bytes, out_w: int):
+    """Emit input bytes with each selected span replaced by ``repl``.
+    -> (out (n, out_w) uint8, out_lengths). Spans must be non-empty."""
+    from jax import lax
+    n, w = values.shape
+    rows = xp.arange(n)
+    pos = xp.arange(w, dtype=xp.int32)
+    in_str = pos[None, :] < lengths[:, None]
+    L = len(repl)
+
+    def step(carry, j):
+        out, cursor = carry
+        start = start_mask[:, j]
+        # replacement emission: writes land at >= cursor, which is beyond
+        # any finalized content, so non-start rows' dummy writes are
+        # overwritten by their later real writes (or stay as padding)
+        for k in range(L):
+            idx = xp.clip(cursor + k, 0, out_w - 1)
+            byte = xp.where(start, xp.uint8(repl[k]), out[rows, idx])
+            out = out.at[rows, idx].set(byte)
+        cursor = xp.where(start, cursor + L, cursor)
+        copy = xp.logical_and(in_str[:, j],
+                              xp.logical_not(in_match[:, j]))
+        idx = xp.clip(cursor, 0, out_w - 1)
+        byte = xp.where(copy, values[:, j], out[rows, idx])
+        out = out.at[rows, idx].set(byte)
+        cursor = xp.where(copy, cursor + 1, cursor)
+        return (out, cursor), None
+
+    init = (xp.zeros((n, out_w), dtype=xp.uint8),
+            xp.zeros(n, dtype=xp.int32))
+    (out, cursor), _ = lax.scan(step, init, pos)
+    return out, cursor
+
+
+def extract_first_span(xp, values, lengths, ends):
+    """First (leftmost) match span copied to column 0; no match -> ''.
+    -> (out (n, w) uint8, out_lengths)."""
+    n, w = values.shape
+    valid = ends >= 0
+    found = xp.any(valid, axis=1)
+    s = xp.argmax(valid, axis=1).astype(xp.int32)
+    e = xp.take_along_axis(ends, s[:, None], axis=1)[:, 0]
+    out_len = xp.where(found, e - s, 0)
+    k = xp.arange(w, dtype=xp.int32)
+    idx = xp.clip(s[:, None] + k[None, :], 0, w - 1)
+    out = xp.take_along_axis(values, idx, axis=1)
+    out = xp.where(k[None, :] < out_len[:, None], out, 0).astype(xp.uint8)
+    return out, out_len
+
+
+def literal_match_ends(xp, values, lengths, search: bytes):
+    """ends matrix for a literal byte-string search (StringReplace)."""
+    n, w = values.shape
+    L = len(search)
+    pos = xp.arange(w, dtype=xp.int32)
+    match = xp.ones((n, w), dtype=bool)
+    for k in range(L):
+        idx = xp.clip(pos[None, :] + k, 0, w - 1)
+        byte = xp.take_along_axis(values, xp.broadcast_to(idx, (n, w)),
+                                  axis=1)
+        match = xp.logical_and(match, byte == search[k])
+    fits = (pos[None, :] + L) <= lengths[:, None]
+    match = xp.logical_and(match, fits)
+    return xp.where(match, pos[None, :] + L, -1).astype(xp.int32)

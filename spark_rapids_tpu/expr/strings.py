@@ -749,7 +749,9 @@ class StringTrimRight(StringTrim):
 # ---------------------------------------------------------------------------
 
 class StringReplace(Expression):
-    """replace(str, search, replace) — host-only (dynamic output length)."""
+    """replace(str, search, replace). Device path: literal-span emission
+    kernel (reference: GpuStringReplace in stringFunctions.scala delegates
+    to cudf replace; here regex.py replace_by_spans)."""
 
     def __init__(self, child: Expression, search: Expression,
                  replace: Expression):
@@ -762,6 +764,14 @@ class StringReplace(Expression):
 
     def eval(self, ctx: EvalContext) -> EvalCol:
         c = self.child.eval(ctx)
+        if ctx.is_device:
+            search = literal_value(self.search)
+            repl = literal_value(self.replace)
+            if search is None or repl is None:
+                raise TypeError("device replace requires literal "
+                                "search/replacement (tag_fn gates this)")
+            return _device_replace_spans(ctx, c, search.encode(),
+                                         repl.encode(), literal_search=True)
         s = self.search.eval(ctx)
         r = self.replace.eval(ctx)
         validity = _combine_validity(ctx, c, s, r)
@@ -907,6 +917,17 @@ class RegExpExtract(Expression):
     def eval(self, ctx: EvalContext) -> EvalCol:
         import re as _re
         c = self.child.eval(ctx)
+        if ctx.is_device:
+            from .regex import compile_device_nfa, extract_first_span
+            nfa = compile_device_nfa(literal_value(self.pattern))
+            if nfa is None or not nfa.spans_supported \
+                    or int(literal_value(self.idx)) != 0:
+                raise TypeError("device regexp_extract outside the span "
+                                "subset (tag_fn gates this)")
+            xp = ctx.xp
+            ends = nfa.match_ends(xp, c.values, c.lengths)
+            out, out_len = extract_first_span(xp, c.values, c.lengths, ends)
+            return EvalCol(out, c.validity, dt.STRING, out_len)
         rx = _re.compile(literal_value(self.pattern))
         gi = int(literal_value(self.idx))
         out = []
@@ -931,11 +952,47 @@ class RegExpReplace(Expression):
     def eval(self, ctx: EvalContext) -> EvalCol:
         import re as _re
         c = self.child.eval(ctx)
+        if ctx.is_device:
+            repl = literal_value(self.replacement)
+            if repl is None or _re.search(r"\$\d", repl):
+                raise TypeError("device regexp_replace: group references "
+                                "stay on host (tag_fn gates this)")
+            return _device_replace_spans(
+                ctx, c, literal_value(self.pattern).encode(), repl.encode(),
+                literal_search=False)
         rx = _re.compile(literal_value(self.pattern))
         # Java $1 group references -> Python \1
         rep = _re.sub(r"\$(\d+)", r"\\\1", literal_value(self.replacement))
         out = [rx.sub(rep, s) for s in c.values]
         return EvalCol(np.asarray(out, dtype=object), c.validity, dt.STRING)
+
+
+def _device_replace_spans(ctx, c: EvalCol, search: bytes, repl: bytes,
+                          literal_search: bool) -> EvalCol:
+    """Shared device replace: literal or NFA match spans -> re-emission."""
+    from ..columnar.device import bucket_width
+    from .regex import (compile_device_nfa, literal_match_ends,
+                        replace_by_spans, select_leftmost_spans)
+    xp = ctx.xp
+    if literal_search and not search:
+        return c          # Spark replace('', x) is the identity
+    w = c.values.shape[1]
+    if literal_search:
+        ends = literal_match_ends(xp, c.values, c.lengths, search)
+        min_len = len(search)
+    else:
+        nfa = compile_device_nfa(search.decode())
+        if nfa is None or not nfa.spans_supported:
+            raise TypeError("device regexp_replace outside the span subset "
+                            "(tag_fn gates this)")
+        ends = nfa.match_ends(xp, c.values, c.lengths)
+        min_len = nfa.min_len
+    starts, in_match = select_leftmost_spans(xp, ends, c.lengths)
+    grow = max(len(repl) - min_len, 0)
+    out_w = bucket_width(w + (w // max(min_len, 1)) * grow)
+    out, out_len = replace_by_spans(xp, c.values, c.lengths, starts,
+                                    in_match, repl, out_w)
+    return EvalCol(out, c.validity, dt.STRING, out_len)
 
 
 def _device_startswith(ctx, c: EvalCol, nb: bytes):

@@ -63,12 +63,32 @@ def _register_expr_rules():
     register_expr_rule(Atan2, TypeSig.fp + TypeSig.integral)
 
     def tag_cast(meta, conf):
+        """Device cast matrix (reference: GpuCast.scala:1513). String casts
+        run through the byte-matrix kernels in expr/cast_kernels.py; the
+        directions with no closed-form kernel (float->string shortest-
+        roundtrip formatting, string->timestamp/decimal parsing) fall back."""
         c: Cast = meta.expr
         src = c.child.data_type
-        if isinstance(c.to, (dt.StringType, dt.BinaryType)) and src != c.to:
-            meta.cannot_run("cast to string not implemented on device")
-        if isinstance(src, (dt.StringType, dt.BinaryType)) and src != c.to:
-            meta.cannot_run("cast from string not implemented on device")
+        if src == c.to:
+            return
+        if isinstance(c.to, (dt.StringType, dt.BinaryType)):
+            if src in (dt.FLOAT, dt.DOUBLE):
+                meta.cannot_run("float->string (shortest-roundtrip "
+                                "formatting) runs on host")
+            elif isinstance(src, dt.TimestampType):
+                meta.cannot_run("timestamp->string runs on host")
+            elif isinstance(src, (dt.StringType, dt.BinaryType)):
+                pass  # binary<->string reinterpret
+            elif not (src.is_numeric or isinstance(
+                    src, (dt.BooleanType, dt.DateType, dt.DecimalType))):
+                meta.cannot_run(f"cast {src!r} -> string not on device")
+        if isinstance(src, (dt.StringType, dt.BinaryType)) \
+                and not isinstance(c.to, (dt.StringType, dt.BinaryType)):
+            if isinstance(c.to, (dt.TimestampType, dt.DecimalType)):
+                meta.cannot_run(f"string -> {c.to!r} parse runs on host")
+            elif not (c.to.is_numeric or isinstance(
+                    c.to, (dt.BooleanType, dt.DateType))):
+                meta.cannot_run(f"cast string -> {c.to!r} not on device")
     register_expr_rule(Cast, _device_all, tag_fn=tag_cast)
 
     # aggregate functions: checked inside aggregate exec rule; sig covers
@@ -150,10 +170,58 @@ def _register_string_rules():
                 "rejected it; runs on host)")
     register_expr_rule(S.RLike, _string, tag_fn=tag_rlike)
 
+    def tag_replace(meta, conf):
+        e: S.StringReplace = meta.expr
+        if S.literal_value(e.search) is None \
+                or S.literal_value(e.replace) is None:
+            meta.cannot_run("device replace requires literal "
+                            "search/replacement")
+            return
+        if any(ord(ch) > 127 for ch in S.literal_value(e.search)):
+            meta.cannot_run("non-ASCII search runs on host (byte-span "
+                            "alignment)")
+    register_expr_rule(S.StringReplace, _string, tag_fn=tag_replace)
+
+    def _span_nfa(meta, pattern):
+        if pattern is None:
+            meta.cannot_run("device regex requires a literal pattern")
+            return None
+        from ..expr.regex import compile_device_nfa
+        nfa = compile_device_nfa(pattern)
+        if nfa is None:
+            meta.cannot_run(f"regex {pattern!r} outside the device NFA "
+                            "subset")
+            return None
+        if not nfa.spans_supported:
+            meta.cannot_run(
+                f"regex {pattern!r} matches but spans are host-only "
+                "(alternation/lazy/nullable/non-ASCII patterns)")
+            return None
+        return nfa
+
+    def tag_regexp_replace(meta, conf):
+        import re as _re
+        e: S.RegExpReplace = meta.expr
+        if _span_nfa(meta, S.literal_value(e.pattern)) is None:
+            return
+        repl = S.literal_value(e.replacement)
+        if repl is None or _re.search(r"\$\d", repl):
+            meta.cannot_run("group references in replacement run on host")
+    register_expr_rule(S.RegExpReplace, _string, tag_fn=tag_regexp_replace)
+
+    def tag_regexp_extract(meta, conf):
+        e: S.RegExpExtract = meta.expr
+        if _span_nfa(meta, S.literal_value(e.pattern)) is None:
+            return
+        idx = S.literal_value(e.idx)
+        if idx is None or int(idx) != 0:
+            meta.cannot_run("regexp_extract group index != 0 (capture "
+                            "groups) runs on host")
+    register_expr_rule(S.RegExpExtract, _string, tag_fn=tag_regexp_extract)
+
     # host-only string expressions (device falls back via transition insertion)
     _host_only = "host-only: dynamic-width output"
-    for cls in (S.StringReplace, S.SubstringIndex, S.ConcatWs, S.Chr,
-                S.RegExpExtract, S.RegExpReplace):
+    for cls in (S.SubstringIndex, S.ConcatWs, S.Chr):
         register_expr_rule(
             cls, TypeSig.none(),
             note=_host_only)

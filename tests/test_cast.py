@@ -1,0 +1,112 @@
+"""Cast matrix differential tests (reference: GpuCast.scala:1513 +
+CastOpSuite; device kernels in expr/cast_kernels.py)."""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu.expr.functions as F
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.expr.functions import col
+from harness import assert_tpu_cpu_equal
+
+
+def _assert_col(session, table, expr, expected):
+    df = session.create_dataframe(table)
+    q = df.select(expr.alias("out"))
+    out = assert_tpu_cpu_equal(q, ignore_order=False)
+    assert out.column("out").to_pylist() == expected, \
+        out.column("out").to_pylist()
+    return q
+
+
+def test_int_to_string_device(session):
+    t = pa.table({"i": [0, 7, -13, 123456789, None,
+                        -9223372036854775808, 9223372036854775807]})
+    q = _assert_col(session, t, col("i").cast(dt.STRING),
+                    ["0", "7", "-13", "123456789", None,
+                     "-9223372036854775808", "9223372036854775807"])
+    bad = [l for l in q.explain("tpu").splitlines()
+           if "!" in l and "cast" in l.lower()]
+    assert not bad, bad
+
+
+def test_bool_date_to_string(session):
+    t = pa.table({"b": [True, False, None],
+                  "d": pa.array([0, 18628, -719162], type=pa.date32())})
+    _assert_col(session, t, col("b").cast(dt.STRING),
+                ["true", "false", None])
+    _assert_col(session, t, col("d").cast(dt.STRING),
+                ["1970-01-01", "2021-01-01", "0001-01-01"])
+
+
+def test_string_to_integrals(session):
+    t = pa.table({"s": ["42", " -17 ", "+8", "12.9", "abc", "", None,
+                        "9223372036854775807", "9223372036854775808",
+                        "007", ".5", "1e3", "300"]})
+    _assert_col(session, t, col("s").cast(dt.LONG),
+                [42, -17, 8, 12, None, None, None,
+                 9223372036854775807, None, 7, None, None, 300])
+    # overflow to narrower types -> null
+    _assert_col(session, t, col("s").cast(dt.BYTE),
+                [42, -17, 8, 12, None, None, None, None, None, 7, None,
+                 None, None])
+
+
+def test_string_to_floats(session):
+    t = pa.table({"s": ["3.5", "-2e3", " 1.5E-2 ", "Infinity", "-infinity",
+                        "NaN", "x", "1.", ".5", "1e", "+4", None]})
+    df = session.create_dataframe(t)
+    q = df.select(col("s").cast(dt.DOUBLE).alias("out"))
+    out = assert_tpu_cpu_equal(q, ignore_order=False)
+    got = out.column("out").to_pylist()
+    assert got[:3] == [3.5, -2000.0, 0.015]
+    assert got[3] == float("inf") and got[4] == float("-inf")
+    assert got[5] != got[5]              # NaN
+    assert got[6] is None and got[9] is None and got[11] is None
+    assert got[7] == 1.0 and got[8] == 0.5 and got[10] == 4.0
+
+
+def test_string_to_bool_and_date(session):
+    t = pa.table({"s": ["true", "FALSE", " Y ", "0", "maybe", None]})
+    _assert_col(session, t, col("s").cast(dt.BOOLEAN),
+                [True, False, True, False, None, None])
+    t2 = pa.table({"s": ["2021-01-01", "1970-1-1", "2020-02-29",
+                         "2019-02-29", "2021", "2021-7", "2021-13-01",
+                         "01-01-2021", "x", None]})
+    import datetime
+    _assert_col(session, t2, col("s").cast(dt.DATE),
+                [datetime.date(2021, 1, 1), datetime.date(1970, 1, 1),
+                 datetime.date(2020, 2, 29), None, datetime.date(2021, 1, 1),
+                 datetime.date(2021, 7, 1), None, None, None, None])
+
+
+def test_decimal_to_string(session):
+    t = pa.table({"x": pa.array([1.20, -0.05, 0.0, 10.0])})
+    df = session.create_dataframe(t)
+    q = df.select(col("x").cast(dt.DecimalType(9, 2)).cast(dt.STRING)
+                  .alias("out"))
+    out = assert_tpu_cpu_equal(q, ignore_order=False)
+    assert out.column("out").to_pylist() == ["1.20", "-0.05", "0.00", "10.00"]
+
+
+def test_roundtrip_long_string_long(session, rng):
+    vals = rng.integers(-1 << 62, 1 << 62, 200)
+    t = pa.table({"i": vals})
+    df = session.create_dataframe(t)
+    q = df.select(col("i").cast(dt.STRING).cast(dt.LONG).alias("out"))
+    out = assert_tpu_cpu_equal(q, ignore_order=False)
+    assert out.column("out").to_pylist() == vals.tolist()
+
+
+def test_host_only_directions_fall_back(session):
+    t = pa.table({"f": [1.5, None], "s": ["2021-01-01 10:30:00", None]})
+    df = session.create_dataframe(t)
+    q = df.select(col("f").cast(dt.STRING).alias("f2s"),
+                  col("s").cast(dt.TIMESTAMP).alias("s2t"))
+    text = q.explain("tpu")
+    assert "cannot run on TPU" in text, text
+    out = assert_tpu_cpu_equal(q, ignore_order=False)
+    assert out.column("f2s").to_pylist() == ["1.5", None]
+    import datetime
+    assert out.column("s2t").to_pylist() == \
+        [datetime.datetime(2021, 1, 1, 10, 30), None]

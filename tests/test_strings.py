@@ -216,3 +216,43 @@ def test_pad_edge_cases(session):
     ), ignore_order=False)
     assert out.column("z").to_pylist() == ["", "", ""]
     assert out.column("trunc_l").to_pylist() == ["ab", "*x", "**"]
+
+
+def test_device_replace_and_regex_spans(session):
+    """StringReplace / RegExpReplace / RegExpExtract(0) lower to the device
+    span kernels (regex.py match_ends + replace_by_spans) for literal and
+    NFA-subset patterns; UTF-8 subjects stay byte-aligned."""
+    import pyarrow as pa
+    t = pa.table({"s": ["hello world", "aaa", "", "ab-12-xy", None,
+                        "nums 123 456", "héllo wörld", "aa11bb22"]})
+    df = session.create_dataframe(t)
+    q = df.select(
+        replace(col("s"), "l", "LL").alias("lit_grow"),
+        replace(col("s"), "aa", "").alias("lit_shrink"),
+        regexp_replace(col("s"), "[0-9]+", "#").alias("re_num"),
+        regexp_replace(col("s"), "l+o?", "L").alias("re_greedy"),
+        regexp_extract(col("s"), "[0-9]+", 0).alias("ex0"),
+    )
+    out = assert_tpu_cpu_equal(q, ignore_order=False)
+    assert out.column("re_num").to_pylist()[5] == "nums # #"
+    assert out.column("ex0").to_pylist()[7] == "11"
+    # explain: these expressions must NOT fall back
+    bad = [l for l in q.explain("tpu").splitlines()
+           if "!" in l and ("replace" in l.lower() or "regexp" in l.lower())]
+    assert not bad, bad
+
+
+def test_regex_span_fallbacks_gate(session):
+    """Alternation / lazy / group-reference patterns stay on host with a
+    recorded reason (reference: CudfRegexTranspiler reject-and-fallback)."""
+    import pyarrow as pa
+    df = session.create_dataframe(pa.table({"s": ["ab 12", "zz"]}))
+    for q in [
+        df.select(regexp_replace(col("s"), "a|b", "#").alias("r")),
+        df.select(regexp_replace(col("s"), "[0-9]+?", "#").alias("r")),
+        df.select(regexp_replace(col("s"), "([0-9])", "$1!").alias("r")),
+        df.select(regexp_extract(col("s"), "([a-z]+)", 1).alias("r")),
+    ]:
+        text = q.explain("tpu")
+        assert "cannot run on TPU" in text, text
+        assert_tpu_cpu_equal(q, ignore_order=False)  # falls back correctly
