@@ -110,7 +110,13 @@ class TpuSession:
     # -- execution -----------------------------------------------------------
     def _physical(self, logical: LogicalPlan,
                   device: Optional[bool] = None) -> PhysicalPlan:
+        # the executing session is the active one (mesh discovery); conf-
+        # sensitive expressions are BOUND at plan time below so lazily
+        # consumed iterators keep this session's semantics even if another
+        # session plans meanwhile
+        TpuSession._active = self
         cpu = plan_physical(logical, self.conf)
+        _bind_conf_exprs(cpu, self.conf)
         use_device = self.conf.is_sql_enabled if device is None else device
         if self.conf.is_explain_only:
             # reference: spark.rapids.sql.mode=explainOnly (RapidsConf.scala:515)
@@ -389,6 +395,74 @@ class DataFrame:
     def to_pandas(self, device: Optional[bool] = None):
         return self.collect(device).to_pandas()
 
+    # -- ML-framework handoff (reference: ColumnarRdd.scala:42,51 +
+    # InternalColumnarRddConverter — zero-copy DataFrame -> device tables
+    # for XGBoost-style consumers; here DataFrame -> jax.Array) ------------
+    def _batches_from_plan(self, plan, pidx: int):
+        from .exec.transitions import DeviceToHostExec
+        from .columnar.device import DeviceTable as _DT
+        if isinstance(plan, DeviceToHostExec):
+            yield from plan.child.execute_columnar(pidx)
+            return
+        # plan fell back to host: upload each host batch
+        mb = self.session.conf.min_bucket_rows
+        for ht in plan.execute(pidx):
+            yield _DT.from_host(ht, mb)
+
+    def to_device_batches(self, pidx: int):
+        """Iterator of DeviceTable batches for one partition — the
+        ColumnarRdd analogue: results stay on device, no host round trip."""
+        yield from self._batches_from_plan(
+            self.session._physical(self.logical, True), pidx)
+
+    def num_partitions(self) -> int:
+        return self.session._physical(self.logical, True).num_partitions
+
+    def to_jax(self, columns=None, allow_nulls: bool = False):
+        """Materialize as a dict of ``jax.Array``s sliced to the exact row
+        count (device-resident; feeds jax ML training directly).
+
+        Numeric/bool/date/timestamp columns map to one array each; decimal
+        columns unscale to float64; string columns map to
+        ``(bytes_matrix, lengths)``. Raises on null values unless
+        ``allow_nulls`` (then a ``<name>__validity`` mask is added).
+        """
+        from .columnar import dtypes as dt_
+        from .columnar.device import concat_device_tables, shrink_to_fit
+        plan = self.session._physical(self.logical, True)   # plan ONCE
+        batches = []
+        for p in range(plan.num_partitions):
+            batches.extend(self._batches_from_plan(plan, p))
+        if not batches:
+            raise ValueError("empty DataFrame")
+        table = concat_device_tables(batches) if len(batches) > 1 \
+            else batches[0].compact()
+        table = shrink_to_fit(table, self.session.conf.min_bucket_rows)
+        n = int(table.num_rows)
+        import numpy as _np
+        out = {}
+        for name, c in zip(table.names, table.columns):
+            if columns is not None and name not in columns:
+                continue
+            valid = _np.asarray(c.validity[:n])
+            if not valid.all():
+                if not allow_nulls:
+                    raise ValueError(
+                        f"column {name!r} contains nulls; pass "
+                        "allow_nulls=True to receive a validity mask")
+                out[f"{name}__validity"] = c.validity[:n]
+            if isinstance(c.dtype, (dt_.StringType, dt_.BinaryType)):
+                out[name] = (c.data[:n], c.lengths[:n])
+            elif isinstance(c.dtype, dt_.DecimalType):
+                # device decimals are scale-shifted int64; hand ML consumers
+                # the real values
+                import jax.numpy as _jnp
+                out[name] = c.data[:n].astype(_jnp.float64) \
+                    / (10.0 ** c.dtype.scale)
+            else:
+                out[name] = c.data[:n]
+        return out
+
     def count(self) -> int:
         from .expr.functions import count_star
         t = self.agg(count_star().alias("n")).collect()
@@ -486,6 +560,44 @@ def _walk_expr(e):
     yield e
     for c in e.children:
         yield from _walk_expr(c)
+
+
+def _bind_conf_exprs(plan, conf) -> None:
+    """Freeze conf-dependent expression semantics into the plan at planning
+    time (spark.sql.mapKeyDedupPolicy today): evaluation must not re-read
+    the active session, which can change before a lazy iterator drains."""
+    from .expr.collections import MAP_KEY_DEDUP_POLICY, CreateMap
+
+    policy = str(conf.get(MAP_KEY_DEDUP_POLICY)).upper()
+
+    def bind(e):
+        if not isinstance(e, Expression):
+            return e
+        if e.children:
+            new = [bind(c) for c in e.children]
+            if any(n is not o for n, o in zip(new, e.children)):
+                e = e.with_children(new)
+        if isinstance(e, CreateMap) and e._dedup_policy is None:
+            e = CreateMap(*e.children, dedup_policy=policy)
+        return e
+
+    for node in _walk_plan(plan):
+        for attr in ("exprs", "condition", "projections"):
+            v = getattr(node, attr, None)
+            if v is None:
+                continue
+            if isinstance(v, Expression):
+                setattr(node, attr, bind(v))
+            elif isinstance(v, list) and v and isinstance(v[0], list):
+                setattr(node, attr, [[bind(e) for e in p] for p in v])
+            elif isinstance(v, list):
+                setattr(node, attr, [bind(e) for e in v])
+
+
+def _walk_plan(plan):
+    yield plan
+    for c in plan.children:
+        yield from _walk_plan(c)
 
 
 def _replace_refs(e, mapping):

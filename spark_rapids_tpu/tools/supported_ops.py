@@ -1,0 +1,112 @@
+"""supported_ops.md generator (reference: SupportedOpsDocs in
+TypeChecks.scala:1638, which emits the 17.7k-line docs/supported_ops.md).
+
+Walks the live rule registries so the document can never drift from the
+planner: every exec/expression rule row shows its per-type support matrix
+(S supported / PS partial with note / NS not supported), the per-op conf
+kill switch, and the rule's note. Regenerate:
+``python -m spark_rapids_tpu.tools.supported_ops``.
+"""
+from __future__ import annotations
+
+import os
+from typing import List
+
+from ..columnar.dtypes import TypeEnum, TypeSig
+from ..columnar import dtypes as dt
+
+__all__ = ["supported_ops_markdown", "write_supported_ops"]
+
+# one concrete probe type per TypeEnum column
+_PROBE = {
+    TypeEnum.BOOLEAN: dt.BOOLEAN, TypeEnum.BYTE: dt.BYTE,
+    TypeEnum.SHORT: dt.SHORT, TypeEnum.INT: dt.INT, TypeEnum.LONG: dt.LONG,
+    TypeEnum.FLOAT: dt.FLOAT, TypeEnum.DOUBLE: dt.DOUBLE,
+    TypeEnum.STRING: dt.STRING, TypeEnum.BINARY: dt.BINARY,
+    TypeEnum.DATE: dt.DATE, TypeEnum.TIMESTAMP: dt.TIMESTAMP,
+    TypeEnum.NULL: dt.NULL, TypeEnum.DECIMAL: dt.DecimalType(10, 2),
+    TypeEnum.ARRAY: dt.ArrayType(dt.LONG),
+    TypeEnum.STRUCT: dt.StructType((dt.StructField("f", dt.LONG),)),
+    TypeEnum.MAP: dt.MapType(dt.LONG, dt.LONG),
+}
+
+
+def _cell(sig: TypeSig, enum: str) -> str:
+    probe = _PROBE[enum]
+    if sig.is_supported(probe):
+        note = sig.note_for(probe)
+        return "PS" if note else "S"
+    return "NS"
+
+
+def _sig_row(sig: TypeSig) -> List[str]:
+    return [_cell(sig, e) for e in TypeEnum.ALL]
+
+
+def _notes_of(sig: TypeSig) -> List[str]:
+    out = []
+    for e in TypeEnum.ALL:
+        note = sig.note_for(_PROBE[e])
+        if note and sig.is_supported(_PROBE[e]):
+            out.append(f"{e}: {note}")
+    return out
+
+
+def supported_ops_markdown() -> str:
+    # import triggers rule registration
+    from ..plan import overrides  # noqa: F401
+    from ..plan.meta import EXEC_RULES, EXPR_RULES
+
+    header = "| op | conf key | " + " | ".join(TypeEnum.ALL) + " | notes |"
+    rule = "|" + "---|" * (len(TypeEnum.ALL) + 3)
+    lines = [
+        "<!-- Generated from the live rule registries — DO NOT EDIT. "
+        "Regenerate: python -m spark_rapids_tpu.tools.supported_ops -->",
+        "# Supported operators and expressions",
+        "",
+        "`S` = supported, `PS` = partial (see note), `NS` = not supported.",
+        "Each op can be force-disabled by setting its conf key to `false` "
+        "(reference: the auto-derived `spark.rapids.sql.exec.*` / "
+        "`expression.*` keys of GpuOverrides.scala:211-303).",
+        "",
+        "## Execs",
+        "",
+        header, rule,
+    ]
+    for cls in sorted(EXEC_RULES, key=lambda c: c.__name__):
+        r = EXEC_RULES[cls]
+        notes = _notes_of(r.output_sig)
+        if r.note:
+            notes.insert(0, r.note)
+        lines.append(
+            f"| {cls.__name__.replace('Cpu', '')} | `{r.conf_key}` | "
+            + " | ".join(_sig_row(r.output_sig))
+            + " | " + "; ".join(notes) + " |")
+    lines += ["", "## Expressions", "", header, rule]
+    for cls in sorted(EXPR_RULES, key=lambda c: c.__name__):
+        r = EXPR_RULES[cls]
+        notes = _notes_of(r.sig)
+        if r.note:
+            notes.insert(0, r.note)
+        lines.append(
+            f"| {cls.__name__} | `{r.conf_key}` | "
+            + " | ".join(_sig_row(r.sig))
+            + " | " + "; ".join(notes) + " |")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def write_supported_ops(path: str = None) -> str:
+    if path is None:
+        path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))), "docs", "supported_ops.md")
+    text = supported_ops_markdown()
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w") as f:
+        f.write(text)
+    return path
+
+
+if __name__ == "__main__":
+    print(write_supported_ops())

@@ -294,3 +294,19 @@ def test_create_map_nan_keys_dedup(session):
                                lit(float("nan")), lit(2)).alias("m"))
     with pytest.raises(ValueError, match="Duplicate map key"):
         q.collect(device=False)
+
+
+def test_map_dedup_policy_bound_at_plan_time(session):
+    """Conf-sensitive expressions freeze their semantics when the plan is
+    built: a lazily-executed plan keeps ITS session's policy even after
+    another session plans in the meantime."""
+    import spark_rapids_tpu.expr.functions as F
+    a = type(session)({"spark.sql.mapKeyDedupPolicy": "last_win",
+                       "spark.rapids.tpu.batchRowsMinBucket": 8})
+    dfa = a.create_dataframe(pa.table({"v": [1]})).select(
+        F.create_map(lit("k"), col("v"), lit("k"), lit(9)).alias("m"))
+    plan = a._physical(dfa.logical, False)
+    b = type(session)({"spark.rapids.tpu.batchRowsMinBucket": 8})
+    b.create_dataframe(pa.table({"z": [1]})).collect()   # b becomes active
+    out = list(plan.execute(0))
+    assert out[0].column("m").values[0] == [("k", 9)]    # A's LAST_WIN

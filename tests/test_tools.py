@@ -93,3 +93,76 @@ def test_cbo_keeps_big_sections(session):
         assert "Tpu" in plan.tree_string()
     finally:
         session.conf = base
+
+
+def test_to_jax_ml_handoff(session, rng):
+    """DataFrame -> jax.Array export (reference: ColumnarRdd.scala:42 +
+    InternalColumnarRddConverter, the XGBoost handoff)."""
+    import jax.numpy as jnp
+    import pyarrow as pa
+    t = pa.table({"x": rng.normal(size=100), "y": rng.integers(0, 2, 100),
+                  "s": [f"r{i}" for i in range(100)]})
+    df = session.create_dataframe(t, num_partitions=2)
+    arrs = df.to_jax()
+    assert arrs["x"].shape == (100,) and arrs["y"].shape == (100,)
+    assert isinstance(arrs["s"], tuple)            # (bytes matrix, lengths)
+    assert float(jnp.sum(arrs["x"])) == pytest.approx(
+        float(t.column("x").to_pandas().sum()), rel=1e-6)
+    # nulls guarded
+    df2 = session.create_dataframe(pa.table({"a": [1.0, None]}))
+    with pytest.raises(ValueError, match="nulls"):
+        df2.to_jax()
+    m = df2.to_jax(allow_nulls=True)
+    assert "a__validity" in m
+    # ColumnarRdd analogue: device batches per partition
+    from spark_rapids_tpu.columnar.device import DeviceTable
+    assert all(isinstance(b, DeviceTable)
+               for p in range(df.num_partitions())
+               for b in df.to_device_batches(p))
+
+
+def test_exec_kill_switch_forces_fallback(session, rng):
+    """Per-op conf keys (auto-derived from rule registries, reference
+    GpuOverrides.scala:211-303) force device fallback with a reason."""
+    t = pa.table({"k": [1, 2, 1], "v": [1.0, 2.0, 3.0]})
+    s2 = type(session)({"spark.rapids.sql.exec.HashAggregateExec": False,
+                        "spark.rapids.tpu.batchRowsMinBucket": 8})
+    df = s2.create_dataframe(t)
+    q = df.group_by("k").agg(fsum(col("v")).alias("s"))
+    text = q.explain("tpu")
+    assert "disabled by spark.rapids.sql.exec.HashAggregateExec" in text, text
+    out = q.collect(device=True)        # falls back, still correct
+    assert sorted(out.column("s").to_pylist()) == [2.0, 4.0]
+
+
+def test_expression_kill_switch(session):
+    t = pa.table({"s": ["ab", "cd"]})
+    s2 = type(session)({"spark.rapids.sql.expression.Upper": False,
+                        "spark.rapids.tpu.batchRowsMinBucket": 8})
+    from spark_rapids_tpu.expr.functions import upper
+    df = s2.create_dataframe(t)
+    q = df.select(upper(col("s")).alias("u"))
+    text = q.explain("tpu")
+    assert "disabled by spark.rapids.sql.expression.Upper" in text, text
+    assert q.collect(device=True).column("u").to_pylist() == ["AB", "CD"]
+
+
+def test_supported_ops_doc_generates(tmp_path):
+    """docs/supported_ops.md regenerates from the live registries
+    (reference: SupportedOpsDocs, TypeChecks.scala:1638)."""
+    from spark_rapids_tpu.tools.supported_ops import (supported_ops_markdown,
+                                                      write_supported_ops)
+    text = supported_ops_markdown()
+    assert "| ShuffledHashJoinExec |" in text
+    assert "`spark.rapids.sql.exec.ShuffledHashJoinExec`" in text
+    assert "## Expressions" in text
+    p = write_supported_ops(str(tmp_path / "ops.md"))
+    assert (tmp_path / "ops.md").read_text() == text
+    # the committed doc must be current
+    import os
+    committed = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "supported_ops.md")
+    if os.path.exists(committed):
+        assert open(committed).read() == text, \
+            "docs/supported_ops.md is stale; regenerate with " \
+            "python -m spark_rapids_tpu.tools.supported_ops"
