@@ -1,6 +1,12 @@
 #!/usr/bin/env python
-"""Benchmark driver: TPC-H Q6 + Q1 (BASELINE.md ladder) on the device path vs
-a single-process pandas CPU baseline (the Spark-CPU stand-in).
+"""Benchmark driver (BASELINE.md ladder).
+
+Modes (env BENCH_MODE):
+  tpch22 (default) — ladder step 2: all 22 TPC-H queries at BENCH_SF
+    (default 1.0) with multi-batch partitions, device engine vs the host
+    engine (the Spark-CPU stand-in), per-query correctness asserted,
+    compile-cache hit rate reported.
+  q1q6 — ladder step 1: Q1+Q6 vs a raw pandas baseline.
 
 Prints ONE JSON line:
   {"metric": ..., "value": geomean_speedup_x, "unit": "x", "vs_baseline": ...}
@@ -86,10 +92,115 @@ def _init_backend():
     return jax.default_backend(), True
 
 
+def _tables_equal(dev, cpu) -> float:
+    """Max relative error between two (small) result tables, order-free."""
+    import pandas as pd
+    d = dev.to_pandas()
+    c = cpu.to_pandas()
+    if len(d) != len(c):
+        return float("inf")
+    if len(d) == 0:
+        return 0.0
+    cols = list(d.columns)
+    d = d.sort_values(cols).reset_index(drop=True)
+    c = c.sort_values(cols).reset_index(drop=True)
+    worst = 0.0
+    for col in cols:
+        dv, cv = d[col], c[col]
+        if pd.api.types.is_numeric_dtype(dv) \
+                and pd.api.types.is_numeric_dtype(cv):
+            dn = dv.to_numpy(dtype=float, na_value=np.nan)
+            cn = cv.to_numpy(dtype=float, na_value=np.nan)
+            both_nan = np.isnan(dn) & np.isnan(cn)
+            denom = np.maximum(np.abs(cn), 1e-9)
+            rel = np.where(both_nan, 0.0, np.abs(dn - cn) / denom)
+            if np.isnan(rel).any():       # nan on one side only
+                return float("inf")
+            worst = max(worst, float(rel.max()) if len(rel) else 0.0)
+        else:
+            if not (dv.astype(str).values == cv.astype(str).values).all():
+                return float("inf")
+    return worst
+
+
+def run_tpch22(backend, fell_back):
+    """Ladder step 2: all 22 queries, device engine vs host engine."""
+    from spark_rapids_tpu.session import TpuSession
+    from spark_rapids_tpu.tools import tpch
+    from spark_rapids_tpu.utils.compile_cache import cache_stats
+
+    sf = float(os.environ.get("BENCH_SF", "1.0"))
+    nparts = int(os.environ.get("BENCH_PARTITIONS", "4"))
+    budget = float(os.environ.get("BENCH_BUDGET_S", "2400"))
+    t_start = time.monotonic()
+
+    tables = tpch.gen_all(sf)
+    rows = tables["lineitem"].num_rows
+    sess = TpuSession({
+        # small min bucket: tiny dimension tables (nation=25 rows) must not
+        # pad to fact-table capacities; big tables bucket by their own size
+        "spark.rapids.tpu.batchRowsMinBucket": 8192,
+        "spark.rapids.tpu.shuffle.partitions": nparts,
+    })
+    dfs = tpch.build_dataframes(sess, tables, num_partitions=nparts)
+
+    speedups = {}
+    details = []
+    worst_err = 0.0
+    for i in range(1, 23):
+        name = f"q{i}"
+        if time.monotonic() - t_start > budget:
+            print(f"# budget exhausted before {name}", file=sys.stderr)
+            break
+        q = getattr(tpch, name)(dfs)
+        dev_tbl = q.collect(device=True)          # warm-up: XLA compile
+        t0 = time.perf_counter()
+        dev_tbl = q.collect(device=True)
+        dev_t = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        cpu_tbl = q.collect(device=False)
+        cpu_t = time.perf_counter() - t0
+        err = _tables_equal(dev_tbl, cpu_tbl)
+        assert err < 1e-6, f"{name} device != host (rel err {err})"
+        worst_err = max(worst_err, err)
+        speedups[name] = cpu_t / dev_t
+        details.append(f"{name}: dev={dev_t:.3f}s cpu={cpu_t:.3f}s "
+                       f"x{speedups[name]:.2f}")
+
+    if not speedups:
+        print(json.dumps({
+            "metric": f"tpch22_sf{sf:g}_no_queries_within_budget",
+            "value": 0.0, "unit": "x", "vs_baseline": 0.0}))
+        return
+    geo = math.exp(sum(math.log(s) for s in speedups.values())
+                   / len(speedups))
+    stats = cache_stats()
+    hit_rate = stats["hits"] / max(stats["hits"] + stats["misses"], 1)
+    partial = "" if len(speedups) == 22 else f"_partial{len(speedups)}"
+    result = {
+        "metric": f"tpch22_sf{sf:g}_rows{rows}_geomean_speedup_vs_hostengine"
+                  + partial + ("_CPUFALLBACK" if fell_back else ""),
+        "value": round(geo, 4),
+        "unit": "x",
+        "vs_baseline": round(geo / 4.0, 4),
+    }
+    print(json.dumps(result))
+    print(f"# backend={backend} compile_cache_hit_rate={hit_rate:.3f} "
+          f"({stats}) worst_rel_err={worst_err:.2e}", file=sys.stderr)
+    print("# " + " | ".join(details), file=sys.stderr)
+
+
 def main():
+    backend, fell_back = _init_backend()
+    if os.environ.get("BENCH_MODE", "tpch22") == "tpch22":
+        run_tpch22(backend, fell_back)
+        return
+    run_q1q6(backend, fell_back)
+
+
+def run_q1q6(backend, fell_back):
     sf = float(os.environ.get("BENCH_SF", "0.5"))
     rows = int(6_000_000 * sf)
-    backend, fell_back = _init_backend()
     import pyarrow as pa
     from spark_rapids_tpu.session import TpuSession
     from spark_rapids_tpu.tools import tpch

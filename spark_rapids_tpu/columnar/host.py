@@ -99,6 +99,10 @@ class HostColumn:
     dtype: dt.DataType
     values: np.ndarray          # fixed width: typed array; string: object array of str
     validity: Optional[np.ndarray] = None   # bool array, None means all-valid
+    #: original arrow array for string/binary columns straight off a scan —
+    #: lets the device upload read arrow varlen buffers directly instead of
+    #: re-encoding the object array (hot-path; any host transform drops it)
+    _arrow: Optional[pa.Array] = None
 
     def __post_init__(self):
         if self.validity is not None and self.validity.dtype != np.bool_:
@@ -139,6 +143,8 @@ class HostColumn:
             values = np.asarray(arr.to_pylist(), dtype=object)
             if validity is not None:
                 values[~validity] = "" if isinstance(d, dt.StringType) else b""
+            if pa.types.is_string(arr.type) or pa.types.is_binary(arr.type):
+                return HostColumn(d, values, validity, _arrow=arr)
         elif isinstance(d, dt.DateType):
             values = np.asarray(arr.cast(pa.int32()).fill_null(0))
         elif isinstance(d, dt.TimestampType):

@@ -315,16 +315,133 @@ def _agg_window_device(scratch, w, new_seg, seg_start, pos, pos_in_seg, mask
         idx = jnp.clip(hi - 1, 0, cap - 1).astype(jnp.int32)
         return DeviceColumn(jnp.take(run_v, idx).astype(np_out),
                             jnp.take(run_has, idx) & mask, out_dt, None)
-    if frame.kind == "rows" and isinstance(fn, (Sum, Count, CountStar, Average)):
+    seg_end = seg_start + seg_len
+    if frame.kind == "rows":
         s = seg_start if frame.start is None else jnp.maximum(
             pos + frame.start, seg_start)
-        e = (seg_start + seg_len) if frame.end is None else jnp.minimum(
-            pos + frame.end + 1, seg_start + seg_len)
-        e = jnp.maximum(e, s)
+        e = seg_end if frame.end is None else jnp.minimum(
+            pos + frame.end + 1, seg_end)
+    elif frame.kind == "range" and len(w.spec.orders) == 1:
+        sk, null_mask, scale = _device_range_sort_key(scratch,
+                                                      w.spec.orders[0])
+
+        def tgt(offset):
+            t = sk + offset
+            return t if null_mask is None else jnp.where(null_mask, sk, t)
+
+        s = seg_start if frame.start is None else _device_bsearch(
+            sk, tgt(frame.start * scale), seg_start, seg_end, strict=False)
+        e = seg_end if frame.end is None else _device_bsearch(
+            sk, tgt(frame.end * scale), seg_start, seg_end, strict=True)
+    else:
+        raise NotImplementedError(
+            f"{type(fn).__name__} over {frame.describe()} on device")
+    e = jnp.maximum(e, s)
+    if isinstance(fn, (Sum, Count, CountStar, Average)):
         csum, ccnt = prefix_pair()
         return finish(csum[e] - csum[s], ccnt[e] - ccnt[s])
+    if isinstance(fn, (Min, Max)):
+        return _device_range_minmax(isinstance(fn, Min), vals, valid,
+                                    s, e, out_dt, cap)
     raise NotImplementedError(
         f"{type(fn).__name__} over {frame.describe()} on device")
+
+
+def _device_range_sort_key(scratch: DeviceTable, order: SortOrder):
+    """Sort-axis key for bounded RANGE frames -> (sk, null_mask, scale);
+    identical rules to the host engine's _range_sort_key: integral/date/
+    decimal keys stay int64 (decimal offsets scale to value units), float
+    keys use float64 with NaN at the top; DESC negates; null keys collapse
+    to a +-extreme sentinel peer window."""
+    ctx = EvalContext.for_device(scratch)
+    c = order.expr.eval(ctx)
+    scale = 1
+    if isinstance(c.dtype, dt.DecimalType):
+        scale = 10 ** c.dtype.scale
+    if jnp.issubdtype(c.values.dtype, jnp.floating):
+        sk = c.values.astype(jnp.float64)
+        sk = jnp.where(jnp.isnan(sk), jnp.inf, sk)
+        lo_sent, hi_sent = -jnp.inf, jnp.inf
+    else:
+        sk = c.values.astype(jnp.int64)
+        lo_sent = jnp.iinfo(jnp.int64).min
+        hi_sent = jnp.iinfo(jnp.int64).max
+    if not order.ascending:
+        sk = -sk
+    null_mask = None
+    if c.validity is not None:
+        null_mask = jnp.logical_not(c.validity)
+        sent = lo_sent if order.nulls_first else hi_sent
+        sk = jnp.where(null_mask, jnp.asarray(sent, sk.dtype), sk)
+    return sk, null_mask, scale
+
+
+def _device_bsearch(sk, target, lo0, hi0, strict: bool):
+    """First pos in [lo0, hi0) with sk[pos] >= target (> when strict);
+    fixed-depth vectorized binary search (static log2(cap) iterations)."""
+    cap = sk.shape[0]
+    lo = lo0.astype(jnp.int64)
+    hi = hi0.astype(jnp.int64)
+    for _ in range(max(1, cap.bit_length())):
+        active = lo < hi
+        mid = (lo + hi) // 2
+        mv = jnp.take(sk, jnp.clip(mid, 0, cap - 1))
+        go_right = (mv <= target) if strict else (mv < target)
+        lo = jnp.where(jnp.logical_and(active, go_right), mid + 1, lo)
+        hi = jnp.where(jnp.logical_and(active,
+                                       jnp.logical_not(go_right)), mid, hi)
+    return lo
+
+
+def _device_range_minmax(is_min: bool, vals, valid, lo, hi, out_dt, cap
+                         ) -> DeviceColumn:
+    """Per-row [lo, hi) min/max via a power-of-two sparse table (the device
+    mirror of the host engine's _range_minmax), Spark NaN total order."""
+    np_out = jnp.dtype(out_dt.np_dtype())
+    isfloat = jnp.issubdtype(vals.dtype, jnp.floating)
+    if isfloat:
+        nan_mask = jnp.isnan(vals)
+        work = jnp.where(nan_mask, jnp.inf if is_min else -jnp.inf, vals)
+        ident = jnp.asarray(jnp.inf if is_min else -jnp.inf, work.dtype)
+    else:
+        nan_mask = jnp.zeros(cap, dtype=bool)
+        work = vals.astype(jnp.int64)
+        ident = jnp.asarray(jnp.iinfo(jnp.int64).max if is_min
+                            else jnp.iinfo(jnp.int64).min, jnp.int64)
+    work = jnp.where(valid, work, ident)
+    op = jnp.minimum if is_min else jnp.maximum
+    tables = [work]
+    k = 1
+    while (1 << k) <= cap:
+        prev = tables[-1]
+        half = 1 << (k - 1)
+        shifted = jnp.concatenate(
+            [prev[half:], jnp.full(half, ident, prev.dtype)])
+        tables.append(op(prev, shifted))
+        k += 1
+    T = jnp.stack(tables)                                # (levels, cap)
+    wlen = jnp.maximum(hi - lo, 0)
+    kk = jnp.where(wlen > 0,
+                   jnp.floor(jnp.log2(jnp.maximum(wlen, 1))), 0
+                   ).astype(jnp.int32)
+    a = T[kk, jnp.clip(lo, 0, cap - 1).astype(jnp.int32)]
+    b_idx = hi - jnp.left_shift(jnp.int64(1), kk.astype(jnp.int64))
+    b = T[kk, jnp.clip(b_idx, 0, cap - 1).astype(jnp.int32)]
+    out = op(a, b)
+    ccnt = jnp.concatenate([jnp.zeros(1, jnp.int64),
+                            jnp.cumsum(valid.astype(jnp.int64))])
+    cnt = ccnt[jnp.clip(hi, 0, cap)] - ccnt[jnp.clip(lo, 0, cap)]
+    has = cnt > 0
+    if isfloat:
+        cnan = jnp.concatenate([
+            jnp.zeros(1, jnp.int64),
+            jnp.cumsum(jnp.logical_and(valid, nan_mask).astype(jnp.int64))])
+        nnan = cnan[jnp.clip(hi, 0, cap)] - cnan[jnp.clip(lo, 0, cap)]
+        if is_min:
+            out = jnp.where(jnp.logical_and(has, cnt == nnan), jnp.nan, out)
+        else:
+            out = jnp.where(nnan > 0, jnp.nan, out)
+    return DeviceColumn(out.astype(np_out), has, out_dt, None)
 
 
 def _running_minmax(fn, vals, valid, new_seg):

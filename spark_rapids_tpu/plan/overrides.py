@@ -444,10 +444,18 @@ def _register_exec_rules():
             frame = w.spec.frame
             running_or_entire = frame.is_unbounded_entire or frame.is_running
             if frame.kind == "range" and not running_or_entire:
-                meta.cannot_run("bounded RANGE frames not supported on device")
-            if isinstance(w.fn, (Min, Max)) and not running_or_entire:
-                meta.cannot_run("min/max over bounded ROWS frames not "
-                                "supported on device")
+                # bounded RANGE: offsets apply along ONE numeric sort axis
+                # (device binary-search bounds; reference GpuWindowExpression
+                # range frames need a single orderable key the same way)
+                if len(w.spec.orders) != 1:
+                    meta.cannot_run("bounded RANGE frames need exactly one "
+                                    "order key")
+                else:
+                    kt = w.spec.orders[0].expr.data_type
+                    if not (kt.is_numeric or isinstance(
+                            kt, (dt.DateType, dt.TimestampType))):
+                        meta.cannot_run(f"bounded RANGE order key {kt!r} "
+                                        "not numeric")
             for e in w.spec.partition_exprs:
                 if isinstance(e.data_type, (dt.StringType, dt.BinaryType)):
                     meta.cannot_run("string partition keys not supported on "

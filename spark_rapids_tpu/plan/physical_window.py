@@ -238,7 +238,83 @@ def _agg_window(sorted_t: HostTable, w: WindowExpression, gid, seg_start,
             pos + frame.end + 1, seg_end)
         e = np.maximum(e, s)
         return _range_reduce(fn, vals, valid, s, e, out_dt)
+    if frame.kind == "range" and len(w.spec.orders) == 1:
+        sk, null_mask, scale = _range_sort_key(sorted_t, w.spec.orders[0])
+        s = seg_start if frame.start is None else _bsearch_ge(
+            sk, _range_target(sk, frame.start * scale, null_mask),
+            seg_start, seg_end)
+        e = seg_end if frame.end is None else _bsearch_gt(
+            sk, _range_target(sk, frame.end * scale, null_mask),
+            seg_start, seg_end)
+        e = np.maximum(e, s)
+        return _range_reduce(fn, vals, valid, s, e, out_dt)
     raise NotImplementedError(f"frame {frame.describe()}")
+
+
+def _range_sort_key(sorted_t, order):
+    """Sort-axis key for bounded RANGE frames -> (sk, null_mask, scale).
+
+    Integral/date/decimal keys stay int64 (no 2^53 float precision loss;
+    decimal offsets scale by 10^scale so frame bounds are in VALUE units);
+    float keys use float64 with NaN joining the top of the total order.
+    DESC negates so offsets apply along the sort direction; null keys
+    collapse to a +-extreme sentinel so they form one peer window (Spark:
+    a null-key row's RANGE window is its null peer group)."""
+    ctx = EvalContext.for_host(sorted_t)
+    c = order.expr.eval(ctx)
+    vals = np.asarray(c.values)
+    scale = 1
+    if isinstance(c.dtype, dt.DecimalType):
+        scale = 10 ** c.dtype.scale
+    if vals.dtype.kind == "f":
+        sk = vals.astype(np.float64)
+        sk = np.where(np.isnan(sk), np.inf, sk)   # NaN: greatest (peers)
+        lo_sent, hi_sent = -np.inf, np.inf
+    else:
+        sk = vals.astype(np.int64)
+        lo_sent = np.iinfo(np.int64).min
+        hi_sent = np.iinfo(np.int64).max
+    if not order.ascending:
+        sk = -sk
+    null_mask = None
+    if c.validity is not None and not c.validity.all():
+        null_mask = ~c.validity
+        sent = lo_sent if order.nulls_first else hi_sent
+        sk = np.where(null_mask, sent, sk)
+    return sk, null_mask, scale
+
+
+def _range_target(sk, offset, null_mask):
+    """sk + offset, except null-sentinel rows keep the sentinel (sentinel
+    arithmetic would overflow/shift the null peer window)."""
+    t = sk + offset
+    if null_mask is not None:
+        t = np.where(null_mask, sk, t)
+    return t
+
+
+def _bsearch(sk, target, lo0, hi0, strict: bool):
+    """Per-row first pos in [lo0, hi0) with sk[pos] >= target (or > when
+    ``strict``); sk is ascending within each segment. Fixed-depth
+    vectorized binary search."""
+    lo, hi = lo0.astype(np.int64).copy(), hi0.astype(np.int64).copy()
+    n = len(sk)
+    for _ in range(max(1, int(np.ceil(np.log2(max(n, 2)))) + 1)):
+        active = lo < hi
+        mid = (lo + hi) // 2
+        mv = sk[np.clip(mid, 0, n - 1)]
+        go_right = (mv <= target) if strict else (mv < target)
+        lo = np.where(active & go_right, mid + 1, lo)
+        hi = np.where(active & ~go_right, mid, hi)
+    return lo
+
+
+def _bsearch_ge(sk, target, lo0, hi0):
+    return _bsearch(sk, target, lo0, hi0, strict=False)
+
+
+def _bsearch_gt(sk, target, lo0, hi0):
+    return _bsearch(sk, target, lo0, hi0, strict=True)
 
 
 def _backward_min(last_idx, is_last):

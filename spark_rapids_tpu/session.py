@@ -409,14 +409,23 @@ class DataFrame:
         for ht in plan.execute(pidx):
             yield _DT.from_host(ht, mb)
 
+    def _device_plan(self):
+        """Physical device plan, cached per conf snapshot (planning is
+        pure given logical+conf, so iterating partitions must not re-plan)."""
+        cached = getattr(self, "_dev_plan_cache", None)
+        if cached is not None and cached[0] is self.session.conf:
+            return cached[1]
+        plan = self.session._physical(self.logical, True)
+        self._dev_plan_cache = (self.session.conf, plan)
+        return plan
+
     def to_device_batches(self, pidx: int):
         """Iterator of DeviceTable batches for one partition — the
         ColumnarRdd analogue: results stay on device, no host round trip."""
-        yield from self._batches_from_plan(
-            self.session._physical(self.logical, True), pidx)
+        yield from self._batches_from_plan(self._device_plan(), pidx)
 
     def num_partitions(self) -> int:
-        return self.session._physical(self.logical, True).num_partitions
+        return self._device_plan().num_partitions
 
     def to_jax(self, columns=None, allow_nulls: bool = False):
         """Materialize as a dict of ``jax.Array``s sliced to the exact row
@@ -581,17 +590,29 @@ def _bind_conf_exprs(plan, conf) -> None:
             e = CreateMap(*e.children, dedup_policy=policy)
         return e
 
+    def bind_any(v):
+        """Bind expressions wherever they sit in a node attribute: bare,
+        lists (possibly nested), SortOrders, (name, expr) pairs,
+        WindowExpressions."""
+        if isinstance(v, Expression):
+            return bind(v)
+        if isinstance(v, list):
+            return [bind_any(x) for x in v]
+        if isinstance(v, tuple) and len(v) == 2 \
+                and isinstance(v[1], Expression):
+            return (v[0], bind(v[1]))
+        from .expr.functions import SortOrder
+        if isinstance(v, SortOrder):
+            v.expr = bind(v.expr)
+            return v
+        return v
+
     for node in _walk_plan(plan):
-        for attr in ("exprs", "condition", "projections"):
+        for attr in ("exprs", "condition", "projections", "orders",
+                     "window_cols", "aggregates"):
             v = getattr(node, attr, None)
-            if v is None:
-                continue
-            if isinstance(v, Expression):
-                setattr(node, attr, bind(v))
-            elif isinstance(v, list) and v and isinstance(v[0], list):
-                setattr(node, attr, [[bind(e) for e in p] for p in v])
-            elif isinstance(v, list):
-                setattr(node, attr, [bind(e) for e in v])
+            if v is not None:
+                setattr(node, attr, bind_any(v))
 
 
 def _walk_plan(plan):

@@ -35,3 +35,16 @@ def session():
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_per_module():
+    """Compiled-program caches accumulate across the whole suite (every
+    jitted kernel x shape combo); XLA's CPU compiler can exhaust memory and
+    segfault near the end. Dropping caches between modules keeps peak
+    memory bounded while preserving within-module reuse."""
+    yield
+    import jax
+    jax.clear_caches()
+    from spark_rapids_tpu.utils.compile_cache import clear_cache
+    clear_cache()

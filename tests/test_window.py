@@ -140,3 +140,104 @@ def test_cache_under_limit_no_leak(session):
     df.limit(5).collect(device=True)
     after = get_catalog().stats()["buffers"]
     assert after - before <= 1  # at most the fully-drained cache entry
+
+
+def test_bounded_rows_minmax(df):
+    """min/max over bounded ROWS frames run on device via the sparse-table
+    kernel (was a host fallback; reference GpuWindowExpression rolling)."""
+    w = _w().rows_between(-2, 1)
+    q = (df.with_column("mn", fmin(col("x")).over(w))
+           .with_column("mx", fmax(col("v")).over(w)))
+    assert_tpu_cpu_equal(q, rel_tol=1e-6)
+
+
+def test_bounded_range_frame(session, rng):
+    """Bounded RANGE frames: value-offset windows along one numeric order
+    key, all aggregate kinds, ASC and DESC."""
+    t = data_gen(rng, 150, {"k": ("int32", 0, 4), "o": ("int64", 0, 40),
+                            "v": "float64"}, null_prob=0.1)
+    df = session.create_dataframe(t, num_partitions=2)
+    from spark_rapids_tpu.expr.window import Window
+    w = Window.partition_by("k").order_by(col("o").asc()).range_between(-5, 5)
+    q = (df.with_column("s", fsum(col("v")).over(w))
+           .with_column("c", count_star().over(w))
+           .with_column("mn", fmin(col("v")).over(w))
+           .with_column("mx", fmax(col("v")).over(w)))
+    assert_tpu_cpu_equal(q, rel_tol=1e-6)
+    wd = Window.partition_by("k").order_by(col("o").desc()) \
+        .range_between(-5, 2)
+    q2 = df.with_column("s", fsum(col("v")).over(wd)) \
+        .with_column("mx", fmax(col("v")).over(wd))
+    assert_tpu_cpu_equal(q2, rel_tol=1e-6)
+
+
+def test_bounded_range_device_in_plan(session, rng):
+    t = data_gen(rng, 60, {"k": ("int32", 0, 3), "o": ("int64", 0, 20),
+                           "v": "float64"}, null_prob=0.0)
+    df = session.create_dataframe(t)
+    from spark_rapids_tpu.expr.window import Window
+    w = Window.partition_by("k").order_by(col("o").asc()).range_between(-3, 3)
+    q = df.with_column("s", fsum(col("v")).over(w))
+    text = q.explain("tpu")
+    assert "bounded RANGE" not in text, text   # no fallback reason anymore
+
+    # two order keys: invalid in Spark (AnalysisException) — tagged off
+    # device, and the host engine rejects it too
+    w2 = Window.partition_by("k").order_by(col("o").asc(), col("v").asc()) \
+        .range_between(-3, 3)
+    q2 = df.with_column("s", fsum(col("v")).over(w2))
+    assert "bounded RANGE frames need exactly one order key" \
+        in q2.explain("tpu")
+    with pytest.raises(NotImplementedError):
+        q2.collect(device=False)
+
+
+def test_bounded_range_manual_check(session):
+    """Hand-computed RANGE window on a tiny example."""
+    t = pa.table({"o": [1, 2, 4, 7, 8], "v": [1.0, 2.0, 3.0, 4.0, 5.0]})
+    df = session.create_dataframe(t)
+    from spark_rapids_tpu.expr.window import Window
+    w = Window.order_by(col("o").asc()).range_between(-1, 1)
+    out = assert_tpu_cpu_equal(
+        df.with_column("s", fsum(col("v")).over(w)), ignore_order=False,
+        rel_tol=1e-9)
+    # windows: o=1:[1,2] o=2:[1,2] o=4:[4] o=7:[7,8] o=8:[7,8]
+    assert out.column("s").to_pylist() == [3.0, 3.0, 3.0, 9.0, 9.0]
+
+
+def test_bounded_range_decimal_and_nan_keys(session):
+    """RANGE offsets on decimal keys are VALUE units (not scaled-int64
+    units); NaN keys form one peer group at the top of the total order."""
+    from spark_rapids_tpu.columnar import dtypes as dtm
+    from spark_rapids_tpu.expr.window import Window
+    t = pa.table({"o": [1.00, 2.00, 8.00], "v": [1.0, 2.0, 4.0]})
+    df = session.create_dataframe(t)
+    df = df.select(col("o").cast(dtm.DecimalType(10, 2)).alias("o"),
+                   col("v"))
+    w = Window.order_by(col("o").asc()).range_between(-1, 1)
+    out = assert_tpu_cpu_equal(df.with_column("s", fsum(col("v")).over(w)),
+                               ignore_order=False)
+    assert out.column("s").to_pylist() == [3.0, 3.0, 4.0]
+
+    t2 = pa.table({"o": [1.0, 2.0, float("nan"), float("nan")],
+                   "v": [1.0, 2.0, 4.0, 8.0]})
+    df2 = session.create_dataframe(t2)
+    w2 = Window.order_by(col("o").asc()).range_between(0, 0)
+    out2 = assert_tpu_cpu_equal(
+        df2.with_column("c", count_star().over(w2)), ignore_order=False)
+    got = dict(zip(out2.column("v").to_pylist(),
+                   out2.column("c").to_pylist()))
+    assert got[4.0] == 2 and got[8.0] == 2   # NaN rows are peers
+
+
+def test_bounded_range_large_long_keys(session):
+    """int64 RANGE keys beyond 2^53 stay distinct (no float64 collapse)."""
+    from spark_rapids_tpu.expr.window import Window
+    base = 1 << 53
+    t = pa.table({"o": [base, base + 1, base + 3],
+                  "v": [1.0, 2.0, 4.0]})
+    df = session.create_dataframe(t)
+    w = Window.order_by(col("o").asc()).range_between(0, 1)
+    out = assert_tpu_cpu_equal(df.with_column("s", fsum(col("v")).over(w)),
+                               ignore_order=False)
+    assert out.column("s").to_pylist() == [3.0, 2.0, 4.0]
