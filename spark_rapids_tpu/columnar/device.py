@@ -163,8 +163,8 @@ class DeviceTable:
         Jitted when called eagerly (one fused program instead of ~3 eager
         dispatches per column); inlines when already under a trace.
         """
-        import jax.core
-        if isinstance(self.num_rows, jax.core.Tracer):
+        from ..shims import get_shims
+        if get_shims().is_tracer(self.num_rows):
             return _compact_impl(self)
         return _compact_jitted(self)
 
@@ -303,16 +303,22 @@ def concat_device_tables(tables: Sequence[DeviceTable], min_bucket: int = 1024
     Compacts each input then concatenates into a bucketed output capacity.
     Jitted when called eagerly (per input-structure cache in jax.jit).
     """
-    import jax.core
     assert tables, "cannot concat zero device tables"
     if len(tables) == 1:
         return tables[0]
-    if any(isinstance(t.num_rows, jax.core.Tracer) for t in tables):
+    from ..shims import get_shims
+    if any(get_shims().is_tracer(t.num_rows) for t in tables):
         return _concat_impl(tuple(tables))
+    # inputs may live on different chips (ICI-exchange shards read across
+    # partitions, e.g. AQE coalesced stage reads): co-locate before the jit
+    devs = set()
+    for t in tables:
+        if hasattr(t.row_mask, "devices"):
+            devs |= t.row_mask.devices()
+    if len(devs) > 1:
+        target = next(iter(tables[0].row_mask.devices()))
+        tables = [jax.device_put(t, target) for t in tables]
     return _concat_jitted(tuple(tables))
-
-
-_concat_jitted = None  # set below (forward ref to _concat_impl)
 
 
 def _concat_impl(tables) -> DeviceTable:
@@ -340,7 +346,7 @@ def _concat_impl(tables) -> DeviceTable:
     return out.compact()
 
 
-globals()["_concat_jitted"] = jax.jit(_concat_impl)
+_concat_jitted = jax.jit(_concat_impl)
 
 
 def slice_rows(table: DeviceTable, start, length: int) -> DeviceTable:
@@ -349,9 +355,8 @@ def slice_rows(table: DeviceTable, start, length: int) -> DeviceTable:
     Rows past the table's active count are masked off. Building block for
     out-of-core chunking (reference: GpuOutOfCoreSortIterator splitting
     pending batches, GpuSortExec.scala:69). Jitted when called eagerly."""
-    import jax.core
-    if isinstance(start, jax.core.Tracer) \
-            or isinstance(table.num_rows, jax.core.Tracer):
+    from ..shims import get_shims
+    if get_shims().is_tracer(start) or get_shims().is_tracer(table.num_rows):
         return _slice_rows_impl(table, start, length)
     return _slice_rows_jitted(table, start, length)
 

@@ -329,7 +329,13 @@ def _write_docs(path: Optional[str] = None) -> str:
     # import the packages that register confs so the doc is complete
     for mod in ("spark_rapids_tpu.session", "spark_rapids_tpu.memory.catalog",
                 "spark_rapids_tpu.shuffle.manager", "spark_rapids_tpu.udf",
-                "spark_rapids_tpu.io.parquet", "spark_rapids_tpu.plan.cbo"):
+                "spark_rapids_tpu.io.parquet", "spark_rapids_tpu.plan.cbo",
+                "spark_rapids_tpu.plan.aqe", "spark_rapids_tpu.plan.planner",
+                "spark_rapids_tpu.plan.joins_planner",
+                "spark_rapids_tpu.exec.exchange", "spark_rapids_tpu.exec.cache",
+                "spark_rapids_tpu.io.csv", "spark_rapids_tpu.io.orc",
+                "spark_rapids_tpu.io.dump",
+                "spark_rapids_tpu.tools.eventlog"):
         try:
             importlib.import_module(mod)
         except Exception:

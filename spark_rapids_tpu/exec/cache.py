@@ -12,21 +12,36 @@ from typing import Dict, Iterator, List, Optional
 
 from ..columnar.device import DeviceTable
 from ..columnar.host import HostTable
+from ..conf import register_conf
 from ..plan.physical import PhysicalPlan
 from ..utils import metrics as M
 from .base import TpuExec
 
-__all__ = ["CacheStorage", "CpuCacheExec", "TpuCacheExec"]
+__all__ = ["CacheStorage", "CpuCacheExec", "TpuCacheExec",
+           "CACHE_COMPRESS_CODEC"]
+
+CACHE_COMPRESS_CODEC = register_conf(
+    "spark.rapids.tpu.cache.compressionCodec",
+    "Codec for the HOST-side df.cache() storage: 'none' keeps live tables, "
+    "'zlib'/'lz4' store compressed serialized frames (reference: "
+    "ParquetCachedBatchSerializer's compressed columnar cache format). The "
+    "device cache is spillable either way.", "none",
+    checker=lambda v: None if v in ("none", "zlib", "lz4")
+    else f"must be one of none/zlib/lz4, got {v!r}")
 
 
 class CacheStorage:
     def __init__(self):
         self.host: Dict[int, List[HostTable]] = {}
+        # compressed host cache: serialized frames (ParquetCachedBatch
+        # analogue — a compact wire format instead of live objects)
+        self.host_blobs: Dict[int, List[bytes]] = {}
         # device entries are SpillableDeviceTable handles (memory/catalog.py)
         self.device: Dict[int, list] = {}
 
     def clear(self):
         self.host.clear()
+        self.host_blobs.clear()
         for handles in self.device.values():
             for h in handles:
                 h.close()
@@ -34,13 +49,25 @@ class CacheStorage:
 
 
 class CpuCacheExec(PhysicalPlan):
-    def __init__(self, child: PhysicalPlan, storage: CacheStorage):
+    """``codec`` != 'none' stores the host cache as compressed serialized
+    frames instead of live tables (reference: ParquetCachedBatchSerializer
+    keeps df.cache() in a compressed columnar format, SURVEY §2.8)."""
+
+    def __init__(self, child: PhysicalPlan, storage: CacheStorage,
+                 codec: str = "none"):
         self.child = child
         self.children = (child,)
         self.storage = storage
+        self.codec = codec
         self.schema = child.schema
 
     def execute(self, pidx: int) -> Iterator[HostTable]:
+        from ..shuffle.serializer import deserialize_table, serialize_table
+        blobs = self.storage.host_blobs.get(pidx)
+        if blobs is not None:
+            for blob in blobs:
+                yield deserialize_table(blob)
+            return
         cached = self.storage.host.get(pidx)
         if cached is not None:
             yield from cached
@@ -49,6 +76,15 @@ class CpuCacheExec(PhysicalPlan):
         for b in self.child.execute(pidx):
             acc.append(b)
             yield b
+        if self.codec != "none":
+            try:
+                self.storage.host_blobs[pidx] = [
+                    serialize_table(b, self.codec) for b in acc]
+                return
+            except Exception:
+                # unserializable column type (NullType object buffers etc.):
+                # caching live tables is always a safe fallback
+                self.storage.host_blobs.pop(pidx, None)
         self.storage.host[pidx] = acc
 
 

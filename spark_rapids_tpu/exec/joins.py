@@ -126,6 +126,19 @@ def _join_codes(bcols: List[DeviceColumn], bactive: jax.Array,
     return gid[:nb], gid[nb:]
 
 
+def _co_locate(table: DeviceTable, ref: DeviceTable) -> DeviceTable:
+    """Move ``table`` to ``ref``'s device when they differ (probe shards of
+    an ICI exchange live one-per-chip; a jit cannot mix devices)."""
+    try:
+        td = next(iter(table.row_mask.devices()))
+        rd = next(iter(ref.row_mask.devices()))
+    except (AttributeError, TypeError):
+        return table
+    if td == rd:
+        return table
+    return jax.device_put(table, rd)
+
+
 def _count_matches(bgid: jax.Array, pgid: jax.Array):
     """-> (b_order, b_sorted, starts, counts) for probe rows."""
     b_order = jnp.argsort(bgid)
@@ -460,6 +473,12 @@ class TpuShuffledHashJoinExec(TpuExec):
         has_cond = self.condition is not None
         for probe in probe_batches:
             with self.metrics.timed(M.JOIN_TIME), build_handle as build:
+                probe = _co_locate(probe, build)
+                if seen_box is not None and hasattr(seen_box[0], "devices") \
+                        and hasattr(build.row_mask, "devices") \
+                        and seen_box[0].devices() != build.row_mask.devices():
+                    seen_box[0] = jax.device_put(
+                        seen_box[0], next(iter(build.row_mask.devices())))
                 b_order, starts, counts, bgid, pgid = counts_fn(build, probe)
                 if seen_box is not None and not has_cond:
                     seen = cached_jit(self.plan_signature() + "|seen",
@@ -883,6 +902,7 @@ class TpuBroadcastNestedLoopJoinExec(TpuExec):
         track = seen_slices is not None
         if n_bslices == 1:
             with self.metrics.timed(M.JOIN_TIME), handle as build:
+                window = _co_locate(window, build)
                 outs, seen = fn(window, build, seen_slices[0] if track
                                 else jnp.zeros(build.capacity, dtype=bool))
             if track:
@@ -894,6 +914,7 @@ class TpuBroadcastNestedLoopJoinExec(TpuExec):
         any_pass = jnp.zeros(window.capacity, dtype=bool)
         for bi in range(n_bslices):
             with self.metrics.timed(M.JOIN_TIME), handle as build:
+                window = _co_locate(window, build)
                 bslice = slice_rows(build, bi * bws,
                                     min(bws, build.capacity))
                 outs, seen = pairs_fn(

@@ -263,14 +263,45 @@ def _agg_window_device(scratch, w, new_seg, seg_start, pos, pos_in_seg, mask
     out_dt = fn.data_type
     np_out = jnp.dtype(out_dt.np_dtype())
 
+    _is_float = jnp.issubdtype(vals.dtype, jnp.floating)
+
     def prefix_pair():
         x = jnp.where(valid, vals, jnp.zeros_like(vals)).astype(
-            jnp.float64 if jnp.issubdtype(vals.dtype, jnp.floating)
-            else jnp.int64)
+            jnp.float64 if _is_float else jnp.int64)
+        # non-finite-aware prefix sums: a NaN/±inf in the running sum would
+        # poison every LATER frame (csum[hi]-csum[lo] = nan-nan or inf-inf)
+        # even when the frame excludes that row; sum zeros instead and
+        # re-derive the float-sum result per frame from non-finite counts
+        if _is_float:
+            def ccount(m):
+                return jnp.concatenate([jnp.zeros(1, jnp.int64),
+                                        jnp.cumsum(m.astype(jnp.int64))])
+            nanm = valid & jnp.isnan(vals)
+            posm = valid & (vals == jnp.inf)
+            negm = valid & (vals == -jnp.inf)
+            x = jnp.where(nanm | posm | negm, jnp.float64(0), x)
+            specials = (ccount(nanm), ccount(posm), ccount(negm))
+        else:
+            specials = None
         csum = jnp.concatenate([jnp.zeros(1, x.dtype), jnp.cumsum(x)])
         ccnt = jnp.concatenate([jnp.zeros(1, jnp.int64),
                                 jnp.cumsum(valid.astype(jnp.int64))])
-        return csum, ccnt
+        return csum, ccnt, specials
+
+    def reduce_frame(lo, hi):
+        csum, ccnt, specials = prefix_pair()
+        s = csum[hi] - csum[lo]
+        if specials is not None:
+            cnan, cpos, cneg = specials
+            nn = cnan[hi] - cnan[lo]
+            pp = cpos[hi] - cpos[lo]
+            gg = cneg[hi] - cneg[lo]
+            s = jnp.where((nn > 0) | ((pp > 0) & (gg > 0)),
+                          jnp.float64(jnp.nan),
+                          jnp.where(pp > 0, jnp.float64(jnp.inf),
+                                    jnp.where(gg > 0, jnp.float64(-jnp.inf),
+                                              s)))
+        return finish(s, ccnt[hi] - ccnt[lo])
 
     def finish(s, cnt):
         if isinstance(fn, (Count, CountStar)):
@@ -284,10 +315,7 @@ def _agg_window_device(scratch, w, new_seg, seg_start, pos, pos_in_seg, mask
     seg_len = _seg_len(new_seg, seg_start, pos, cap)
     if frame.is_unbounded_entire or (not w.spec.orders and frame.is_running):
         if isinstance(fn, (Sum, Count, CountStar, Average)):
-            csum, ccnt = prefix_pair()
-            lo = seg_start
-            hi = seg_start + seg_len
-            return finish(csum[hi] - csum[lo], ccnt[hi] - ccnt[lo])
+            return reduce_frame(seg_start, seg_start + seg_len)
         # min/max entire partition: forward + effectively segment reduce;
         # do running scan then take value at segment end
         col = _running_minmax(fn, vals, valid, new_seg)
@@ -308,9 +336,7 @@ def _agg_window_device(scratch, w, new_seg, seg_start, pos, pos_in_seg, mask
         else:
             hi = pos + 1
         if isinstance(fn, (Sum, Count, CountStar, Average)):
-            csum, ccnt = prefix_pair()
-            return finish(csum[hi] - csum[seg_start],
-                          ccnt[hi] - ccnt[seg_start])
+            return reduce_frame(seg_start, hi)
         run_v, run_has = _running_minmax(fn, vals, valid, new_seg)
         idx = jnp.clip(hi - 1, 0, cap - 1).astype(jnp.int32)
         return DeviceColumn(jnp.take(run_v, idx).astype(np_out),
@@ -338,8 +364,7 @@ def _agg_window_device(scratch, w, new_seg, seg_start, pos, pos_in_seg, mask
             f"{type(fn).__name__} over {frame.describe()} on device")
     e = jnp.maximum(e, s)
     if isinstance(fn, (Sum, Count, CountStar, Average)):
-        csum, ccnt = prefix_pair()
-        return finish(csum[e] - csum[s], ccnt[e] - ccnt[s])
+        return reduce_frame(s, e)
     if isinstance(fn, (Min, Max)):
         return _device_range_minmax(isinstance(fn, Min), vals, valid,
                                     s, e, out_dt, cap)

@@ -379,6 +379,7 @@ class _PercentileEval(Expression):
 
     def eval(self, ctx):
         import numpy as np
+        from ..utils.tdigest import digest_quantiles
         from .base import EvalCol
         col = self.children[0].eval(ctx)
         vals = col.values
@@ -386,13 +387,12 @@ class _PercentileEval(Expression):
         out = np.empty(n, dtype=object)
         validity = np.ones(n, dtype=bool)
         for i in range(n):
-            lst = [v for v in (vals[i] or []) if v is not None]
-            if not lst:
+            dig = vals[i] if vals[i] is not None else []
+            if not len(dig):
                 validity[i] = False
                 out[i] = None if self.scalar else []
                 continue
-            s = sorted(lst)
-            picks = [s[int(round(p * (len(s) - 1)))] for p in self.percentages]
+            picks = digest_quantiles(dig, self.percentages)
             out[i] = picks[0] if self.scalar else [float(x) for x in picks]
         if self.scalar:
             data = np.array([float(o) if o is not None else 0.0 for o in out])
@@ -405,16 +405,18 @@ class _PercentileEval(Expression):
 class ApproximatePercentile(AggregateFunction):
     """approx_percentile(col, percentage[, accuracy]).
 
-    Reference: GpuApproximatePercentile.scala (t-digest sketch). This build
-    keeps the same partial/merge shape but the sketch is the exact value
-    multiset (collect + select-at-rank) — always within the accuracy
-    contract; a Pallas t-digest is a later optimization for huge groups.
-    Like Spark, the returned percentile is an actual data value (no
-    interpolation).
+    Reference: GpuApproximatePercentile.scala (cuDF t-digest sketch). The
+    aggregation state is a bounded merging t-digest (utils/tdigest.py):
+    partial batches sketch into at most ~accuracy/2 centroids, partials
+    merge by centroid concat + recompress, and evaluation interpolates
+    between centroids — the same partial/merge/evaluate split and the same
+    documented divergence from Spark CPU's exact-value pick as the
+    reference (which also interpolates).
     """
 
     def __init__(self, child: Optional[Expression] = None,
-                 percentages=(0.5,), scalar: Optional[bool] = None):
+                 percentages=(0.5,), scalar: Optional[bool] = None,
+                 accuracy: int = 10000):
         super().__init__(child)
         if isinstance(percentages, (int, float)):
             if scalar is None:
@@ -425,12 +427,16 @@ class ApproximatePercentile(AggregateFunction):
         for p in percentages:
             if not 0.0 <= p <= 1.0:
                 raise ValueError(f"percentage {p} not in [0, 1]")
+        if accuracy <= 0:
+            raise ValueError(f"accuracy must be positive, got {accuracy}")
         self.percentages = tuple(float(p) for p in percentages)
         self.scalar = scalar
+        self.accuracy = int(accuracy)
 
     def with_children(self, children):
         return ApproximatePercentile(children[0] if children else None,
-                                     self.percentages, self.scalar)
+                                     self.percentages, self.scalar,
+                                     self.accuracy)
 
     @property
     def data_type(self):
@@ -442,10 +448,10 @@ class ApproximatePercentile(AggregateFunction):
                 else self.child]
 
     def update_ops(self):
-        return ["collect_list"]
+        return [f"tdigest:{self.accuracy}"]
 
     def merge_ops(self):
-        return ["merge_lists"]
+        return [f"tdigest_merge:{self.accuracy}"]
 
     def state_fields(self, prefix):
         return [(f"{prefix}_values", dt.ArrayType(dt.DOUBLE), False)]

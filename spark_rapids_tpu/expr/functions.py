@@ -753,7 +753,8 @@ def collect_set(c) -> Column:
 
 
 def approx_percentile(c, percentage, accuracy: int = 10000) -> Column:
-    """accuracy accepted for API parity; this implementation is exact
-    (see ApproximatePercentile docstring)."""
+    """Bounded t-digest sketch honoring ``accuracy`` (state holds at most
+    ~accuracy/2 centroids; see ApproximatePercentile docstring)."""
     from .aggregates import ApproximatePercentile
-    return Column(ApproximatePercentile(_to_expr(c), percentage))
+    return Column(ApproximatePercentile(_to_expr(c), percentage,
+                                        accuracy=accuracy))

@@ -532,7 +532,8 @@ def compile_device_nfa(pattern: str) -> Optional[DeviceNfa]:
     for si, bs in enumerate(sets):
         for b in bs:
             sig[b, si] = True
-    _, class_of_byte = np.unique(sig, axis=0, return_inverse=True)
+    from ..shims import get_shims
+    _, _, class_of_byte = get_shims().unique_rows(sig)
     n_classes = class_of_byte.max() + 1
     # transition masks: masks[c, t] = bitmask of source states from which we
     # reach state t on a byte of class c

@@ -23,7 +23,29 @@ CSV_ENABLED = register_conf(
     "spark.rapids.sql.format.csv.enabled",
     "Enable CSV scans (reference: RapidsConf.scala csv flags).", True)
 
+# per-type enable flags (reference: RapidsConf.scala:877-917 csv read type
+# flags — a disabled type is read as raw strings instead of parsed values,
+# the conservative fallback the reference achieves by keeping the scan on
+# the CPU for those columns)
+_CSV_TYPE_FLAGS = {}
+for _t, _pa_check in (("bool", "is_boolean"), ("int", "is_integer"),
+                      ("float", "is_float32"), ("double", "is_float64"),
+                      ("date", "is_date"), ("timestamp", "is_timestamp")):
+    _CSV_TYPE_FLAGS[_t] = (register_conf(
+        f"spark.rapids.sql.csv.read.{_t}.enabled",
+        f"Parse {_t} columns in CSV scans; when false, inferred {_t} "
+        "columns are read as strings (reference: csv per-type read flags, "
+        "RapidsConf.scala:877-917).", True), _pa_check)
+
 __all__ = ["CsvSource"]
+
+
+def _type_disabled(conf: RapidsConf, t: pa.DataType) -> bool:
+    import pyarrow.types as pat
+    for flag, check in _CSV_TYPE_FLAGS.values():
+        if getattr(pat, check)(t) and not conf.get(flag):
+            return True
+    return False
 
 
 def _expand(paths) -> List[str]:
@@ -55,7 +77,13 @@ class CsvSource(DataSource):
         self.sep = sep
         self.batch_rows = batch_rows
         self._explicit_schema = schema
-        first = self._read_file(self.files[0], nrows=1000)
+        self._forced_strings: List[str] = []
+        sample = self._read_file(self.files[0], nrows=1000)
+        self._forced_strings = [
+            f.name for f in sample.schema
+            if _type_disabled(self.conf, f.type)]
+        first = self._read_file(self.files[0], nrows=1000) \
+            if self._forced_strings else sample
         ht = HostTable.from_arrow(first.slice(0, 0))
         self._schema = Schema([Field(n, c.dtype, True)
                                for n, c in zip(ht.names, ht.columns)])
@@ -68,11 +96,13 @@ class CsvSource(DataSource):
     def _read_options(self, nrows=None):
         ro = pacsv.ReadOptions(autogenerate_column_names=not self.header)
         po = pacsv.ParseOptions(delimiter=self.sep)
-        column_types = None
+        column_types = {}
         if self._explicit_schema:
             column_types = {k: _dtype_to_arrow(v)
                             for k, v in self._explicit_schema.items()}
-        co = pacsv.ConvertOptions(column_types=column_types,
+        for name in self._forced_strings:
+            column_types.setdefault(name, pa.string())
+        co = pacsv.ConvertOptions(column_types=column_types or None,
                                   strings_can_be_null=True)
         return ro, po, co
 

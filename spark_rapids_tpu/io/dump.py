@@ -1,0 +1,30 @@
+"""Debug batch dumps (reference: DumpUtils.scala +
+spark.rapids.sql.debug.dumpPrefix — persist operator input batches as
+parquet so a failing query's exact data can be replayed offline)."""
+from __future__ import annotations
+
+import os
+import re
+
+from ..conf import register_conf
+from ..columnar.host import HostTable
+
+__all__ = ["DEBUG_DUMP_PATH", "dump_scan_batch"]
+
+DEBUG_DUMP_PATH = register_conf(
+    "spark.rapids.tpu.debug.dumpPath",
+    "When set, every scan batch is also written to this directory as "
+    "parquet (scan-<source>-p<partition>-b<batch>.parquet) for offline "
+    "repro (reference: DumpUtils / spark.rapids.sql.debug.dumpPrefix). "
+    "Empty disables.", "")
+
+
+def dump_scan_batch(directory: str, source_name: str, pidx: int,
+                    batch_idx: int, table: HostTable) -> str:
+    import pyarrow.parquet as pq
+    os.makedirs(directory, exist_ok=True)
+    safe = re.sub(r"[^A-Za-z0-9_.-]+", "_", source_name)[:64]
+    path = os.path.join(directory,
+                        f"scan-{safe}-p{pidx}-b{batch_idx}.parquet")
+    pq.write_table(table.to_arrow(), path)
+    return path

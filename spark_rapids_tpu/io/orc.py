@@ -1,7 +1,9 @@
 """ORC scan (reference: GpuOrcScanBase.scala — multithread/coalescing readers
-with predicate pushdown via OrcFilters; pyarrow.orc host decode here)."""
+with predicate pushdown via OrcFilters search-arguments; here the pushdown
+rides the pyarrow dataset reader and files decode on a thread pool)."""
 from __future__ import annotations
 
+import concurrent.futures as cf
 import math
 import os
 import glob as _glob
@@ -10,7 +12,7 @@ from typing import Iterator, List, Optional
 import pyarrow as pa
 import pyarrow.orc as paorc
 
-from ..conf import RapidsConf, register_conf
+from ..conf import MULTITHREAD_READ_NUM_THREADS, RapidsConf, register_conf
 from ..columnar.host import HostTable
 from ..plan.logical import DataSource
 from ..plan.schema import Field, Schema
@@ -43,6 +45,7 @@ class OrcSource(DataSource):
         self.files = files
         self.conf = conf or RapidsConf()
         self.batch_rows = batch_rows
+        self.filter_expr = None  # pyarrow dataset pushdown (OrcFilters)
         first = paorc.ORCFile(self.files[0]).schema
         ht = HostTable.from_arrow(first.empty_table())
         self._schema = Schema([Field(n, c.dtype, True)
@@ -56,19 +59,49 @@ class OrcSource(DataSource):
     def schema(self) -> Schema:
         return self._schema
 
+    def push_filter(self, arrow_expr) -> None:
+        """Planner pushdown hook (io/pushdown.py) — the OrcFilters
+        search-argument analogue, applied through the dataset reader."""
+        self.filter_expr = arrow_expr if self.filter_expr is None \
+            else (self.filter_expr & arrow_expr)
+
     def partitions(self) -> int:
         return len(self._file_parts)
 
+    def _read_file(self, f: str, columns) -> pa.Table:
+        if self.filter_expr is not None:
+            import pyarrow.dataset as pads
+            ds = pads.dataset(f, format="orc")
+            return ds.to_table(columns=columns, filter=self.filter_expr)
+        return paorc.ORCFile(f).read(columns=columns)
+
     def read_partition(self, pidx: int, columns: Optional[List[str]] = None
                        ) -> Iterator[HostTable]:
-        for f in self._file_parts[pidx]:
-            t = paorc.ORCFile(f).read(columns=columns)
-            pos = 0
-            while pos < t.num_rows or (pos == 0 and t.num_rows == 0):
-                yield HostTable.from_arrow(t.slice(pos, self.batch_rows))
-                pos += self.batch_rows
-                if t.num_rows == 0:
+        from collections import deque
+        nthreads = self.conf.get(MULTITHREAD_READ_NUM_THREADS)
+        files = self._file_parts[pidx]
+        with cf.ThreadPoolExecutor(max_workers=nthreads) as pool:
+            # bounded prefetch window: at most nthreads decoded tables
+            # resident at once (whole-partition submission would pin every
+            # file's table until the generator drains)
+            pending = deque()
+            it = iter(files)
+            for f in it:
+                pending.append(pool.submit(self._read_file, f, columns))
+                if len(pending) >= nthreads:
                     break
+            while pending:
+                t = pending.popleft().result()
+                nxt = next(it, None)
+                if nxt is not None:
+                    pending.append(pool.submit(self._read_file, nxt, columns))
+                pos = 0
+                while pos < t.num_rows or (pos == 0 and t.num_rows == 0):
+                    yield HostTable.from_arrow(t.slice(pos, self.batch_rows))
+                    pos += self.batch_rows
+                    if t.num_rows == 0:
+                        break
+                del t
 
     def name(self) -> str:
         return f"ORC[{len(self.files)} files]"

@@ -75,6 +75,13 @@ class ParquetSource(DataSource):
     def schema(self) -> Schema:
         return self._schema
 
+    def push_filter(self, arrow_expr) -> None:
+        """Planner pushdown hook (io/pushdown.py): AND into any existing
+        filter; row groups whose statistics exclude the predicate are
+        skipped (reference: GpuParquetScanBase filter pushdown)."""
+        self.filter_expr = arrow_expr if self.filter_expr is None \
+            else (self.filter_expr & arrow_expr)
+
     def partitions(self) -> int:
         return len(self._file_parts)
 

@@ -1,0 +1,143 @@
+"""Scan predicate pushdown.
+
+Reference: the plugin forwards Spark's pushed filters into its readers —
+parquet row-group pruning via statistics (GpuParquetScanBase) and ORC
+search-arguments (OrcFilters → GpuOrcScanBase). Here the planner translates
+supported conjuncts of a Filter-over-Scan into a ``pyarrow.dataset``
+expression attached to the source; parquet prunes row groups by statistics,
+ORC prunes via the dataset reader. The full filter stays in the plan (the
+pushdown is a may-skip-data optimization, exactly like the reference).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..expr.base import AttributeReference, Expression, Literal
+
+__all__ = ["to_arrow_filter", "push_filter_into_scan"]
+
+
+def _is_widening(src, dst) -> bool:
+    """Value-preserving numeric widening only: every src value maps to the
+    SAME logical value in dst (so stripping the cast cannot change a
+    comparison). Narrowing casts (double->int truncation etc.) must NOT be
+    stripped — the cast changes the compared value."""
+    from ..columnar import dtypes as dt
+    if src == dst:
+        return True
+    int_rank = {dt.BYTE: 1, dt.SHORT: 2, dt.INT: 3, dt.LONG: 4}
+    fp_rank = {dt.FLOAT: 1, dt.DOUBLE: 2}
+    if src in int_rank and dst in int_rank:
+        return int_rank[src] <= int_rank[dst]
+    if src in fp_rank and dst in fp_rank:
+        return fp_rank[src] <= fp_rank[dst]
+    # int -> float is exact only within the mantissa
+    if src in int_rank and dst in fp_rank:
+        bits = {dt.BYTE: 8, dt.SHORT: 16, dt.INT: 32, dt.LONG: 64}[src]
+        mant = {dt.FLOAT: 24, dt.DOUBLE: 53}[dst]
+        return bits <= mant
+    return False
+
+
+def to_arrow_filter(e: Expression, strict: bool = False):
+    """Translate a supported predicate subtree into a pyarrow.dataset
+    expression; None when any part is untranslatable. Non-strict mode may
+    return a PARTIAL conjunction (sound for positive pushdown: it only
+    over-approximates the kept rows); under Not the child must translate in
+    ``strict`` mode — negating a partial conjunction would DROP rows."""
+    import pyarrow.dataset as pads
+
+    from ..expr.predicates import (And, EqualTo, GreaterThan,
+                                   GreaterThanOrEqual, In, IsNotNull, IsNull,
+                                   LessThan, LessThanOrEqual, Not, Or)
+
+    def unwrap(x):
+        # type coercion wraps operands in value-preserving widening casts
+        # (int literal vs long column etc.); only those may be stripped
+        from ..expr.cast import Cast
+        while isinstance(x, Cast):
+            try:
+                src = x.child.data_type
+            except Exception:
+                break
+            if _is_widening(src, x.to):
+                x = x.child
+            else:
+                break
+        return x
+
+    def field_lit(a, b):
+        a, b = unwrap(a), unwrap(b)
+        if isinstance(a, AttributeReference) and isinstance(b, Literal):
+            return pads.field(a.column_name), b.value
+        return None, None
+
+    if isinstance(e, And):
+        l = to_arrow_filter(e.left, strict)
+        r = to_arrow_filter(e.right, strict)
+        if l is not None and r is not None:
+            return l & r
+        if strict:
+            return None  # a negation context needs FULL fidelity
+        return l if r is None else r  # partial conjunction is still sound
+    if isinstance(e, Or):
+        l = to_arrow_filter(e.left, strict)
+        r = to_arrow_filter(e.right, strict)
+        # a partial disjunction would DROP rows; need both sides
+        return (l | r) if l is not None and r is not None else None
+    if isinstance(e, Not):
+        inner = to_arrow_filter(e.children[0], strict=True)
+        return ~inner if inner is not None else None
+    if isinstance(e, IsNull):
+        c = e.children[0]
+        if isinstance(c, AttributeReference):
+            import pyarrow.dataset as pads
+            return pads.field(c.column_name).is_null()
+        return None
+    if isinstance(e, IsNotNull):
+        c = e.children[0]
+        if isinstance(c, AttributeReference):
+            return ~pads.field(c.column_name).is_null()
+        return None
+    if isinstance(e, In):
+        c = e.children[0]
+        opts = e.children[1:]
+        if isinstance(c, AttributeReference) \
+                and all(isinstance(o, Literal) for o in opts):
+            vals = [o.value for o in opts]
+            if any(v is None for v in vals):
+                return None
+            return pads.field(c.column_name).isin(vals)
+        return None
+    ops = {EqualTo: "==", LessThan: "<", LessThanOrEqual: "<=",
+           GreaterThan: ">", GreaterThanOrEqual: ">="}
+    for cls, op in ops.items():
+        if type(e) is cls:
+            f, v = field_lit(e.left, e.right)
+            flipped = {"==": "==", "<": ">", "<=": ">=", ">": "<", ">=": "<="}
+            if f is None:
+                f, v = field_lit(e.right, e.left)
+                op = flipped[op]
+            if f is None or v is None:
+                return None
+            import datetime as _dt
+            if isinstance(v, (_dt.date, _dt.datetime, int, float, str, bool,
+                              bytes)):
+                return {"==": f.__eq__, "<": f.__lt__, "<=": f.__le__,
+                        ">": f.__gt__, ">=": f.__ge__}[op](v)
+            return None
+    return None
+
+
+def push_filter_into_scan(scan_source, condition: Expression) -> bool:
+    """Attach the translatable part of ``condition`` to a source that
+    supports it (ParquetSource/OrcSource ``push_filter``); returns True if
+    anything was pushed."""
+    push = getattr(scan_source, "push_filter", None)
+    if push is None:
+        return False
+    arrow_expr = to_arrow_filter(condition)
+    if arrow_expr is None:
+        return False
+    push(arrow_expr)
+    return True

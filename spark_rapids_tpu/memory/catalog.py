@@ -33,8 +33,21 @@ OOM_SPILL_ENABLED = register_conf(
     "Spill lowest-priority buffers when the device budget is exceeded "
     "(reference: DeviceMemoryEventHandler).", True)
 
+MEMORY_DEBUG = register_conf(
+    "spark.rapids.tpu.memory.debug",
+    "Sanitizer mode for the buffer catalog (reference: RMM debug allocator / "
+    "spark.rapids.memory.gpu.debug): double-free and release-underflow "
+    "raise, freed host buffers are poisoned (0xDD), buffer creation sites "
+    "are recorded, and accounting invariants are checked after every "
+    "operation.", False)
+
 __all__ = ["SpillPriorities", "BufferCatalog", "SpillableDeviceTable",
-           "get_catalog", "set_catalog"]
+           "DebugMemoryError", "get_catalog", "set_catalog"]
+
+
+class DebugMemoryError(RuntimeError):
+    """Raised by the debug allocator on misuse (double free, underflow,
+    use-after-close, accounting drift)."""
 
 
 class SpillPriorities:
@@ -70,6 +83,9 @@ class BufferCatalog:
         self._oom_spill = conf.get(OOM_SPILL_ENABLED)
         self.spill_count = {StorageTier.HOST: 0, StorageTier.DISK: 0}
         self.spilled_bytes = {StorageTier.HOST: 0, StorageTier.DISK: 0}
+        self._debug = bool(conf.get(MEMORY_DEBUG))
+        self._sites: Dict[int, str] = {}    # buffer_id -> creation site
+        self._closed_ids: set = set()       # debug: double-free detection
 
     # -- registration ---------------------------------------------------------
     def register(self, table: DeviceTable,
@@ -85,6 +101,11 @@ class BufferCatalog:
             self._buffers[bid] = stored
             self.device.used_bytes += nbytes
             self._pq_handles[bid] = self._spill_pq.push(priority, bid)
+            if self._debug:
+                import traceback
+                frame = traceback.extract_stack(limit=4)[0]
+                self._sites[bid] = f"{frame.filename}:{frame.lineno}"
+                self._check_invariants()
         return SpillableDeviceTable(self, bid)
 
     # -- spill machinery ------------------------------------------------------
@@ -130,6 +151,12 @@ class BufferCatalog:
             self.device.used_bytes -= stored.size_bytes
             self.spill_count[StorageTier.HOST] += 1
             self.spilled_bytes[StorageTier.HOST] += stored.size_bytes
+            if self._debug and stored.host_arrays is not None:
+                # jax-backed views are read-only; debug mode owns writable
+                # copies so close can poison them (use-after-free detection)
+                import numpy as _np
+                stored.host_arrays = {k: _np.array(v)
+                                      for k, v in stored.host_arrays.items()}
         else:  # straight to disk (host tier full even after its own spills)
             from .stores import _table_to_host_arrays
             arrays, meta = _table_to_host_arrays(stored.device_table)
@@ -158,6 +185,10 @@ class BufferCatalog:
     # -- access ---------------------------------------------------------------
     def acquire(self, buffer_id: int) -> DeviceTable:
         with self._lock:
+            if self._debug and buffer_id in self._closed_ids:
+                raise DebugMemoryError(
+                    f"use-after-close of buffer {buffer_id} "
+                    f"(created at {self._sites.get(buffer_id, '?')})")
             stored = self._buffers[buffer_id]
             assert not stored.closed, "buffer already closed"
             # pin first so spill passes triggered below can't victimize the
@@ -184,15 +215,37 @@ class BufferCatalog:
     def release(self, buffer_id: int):
         with self._lock:
             stored = self._buffers.get(buffer_id)
-            if stored is not None:
-                stored.refcount = max(0, stored.refcount - 1)
+            if stored is None:
+                if self._debug:
+                    raise DebugMemoryError(
+                        f"release of unknown/closed buffer {buffer_id}")
+                return
+            if self._debug and stored.refcount <= 0:
+                raise DebugMemoryError(
+                    f"refcount underflow on buffer {buffer_id} "
+                    f"(created at {self._sites.get(buffer_id, '?')})")
+            stored.refcount = max(0, stored.refcount - 1)
 
     def close_buffer(self, buffer_id: int):
         with self._lock:
             stored = self._buffers.pop(buffer_id, None)
             if stored is None:
+                if self._debug and buffer_id in self._closed_ids:
+                    raise DebugMemoryError(
+                        f"double free of buffer {buffer_id} "
+                        f"(created at {self._sites.get(buffer_id, '?')})")
                 return
             stored.closed = True
+            if self._debug:
+                self._closed_ids.add(buffer_id)
+                # poison freed host-tier memory so use-after-free reads are
+                # deterministic garbage (RMM debug allocator 0xDD pattern)
+                if stored.host_arrays is not None:
+                    for arr in stored.host_arrays.values():
+                        try:
+                            arr.view("uint8").fill(0xDD)
+                        except (ValueError, AttributeError):
+                            pass  # read-only views can't be poisoned
             handle = self._pq_handles.pop(buffer_id, None)
             if handle is not None:
                 self._spill_pq.remove(handle)
@@ -202,9 +255,42 @@ class BufferCatalog:
                 self.host.drop(stored)
             else:
                 self.disk.drop(stored)
+            if self._debug:
+                self._check_invariants()
 
     def tier_of(self, buffer_id: int) -> int:
         return self._buffers[buffer_id].tier
+
+    # -- sanitizers (debug allocator mode) ------------------------------------
+    def _check_invariants(self):
+        """Accounting drift check: per-tier used_bytes must equal the sum of
+        resident buffer sizes (called after mutations in debug mode)."""
+        dev = sum(s.size_bytes for s in self._buffers.values()
+                  if s.tier == StorageTier.DEVICE)
+        host = sum(s.size_bytes for s in self._buffers.values()
+                   if s.tier == StorageTier.HOST)
+        if dev != self.device.used_bytes:
+            raise DebugMemoryError(
+                f"device accounting drift: tracked {self.device.used_bytes} "
+                f"!= resident {dev}")
+        if host != self.host.used_bytes:
+            raise DebugMemoryError(
+                f"host accounting drift: tracked {self.host.used_bytes} "
+                f"!= resident {host}")
+
+    def assert_no_leaks(self):
+        """End-of-scope leak check: every registered buffer must have been
+        closed and no pins outstanding (reference: RMM debug allocator's
+        outstanding-allocations report)."""
+        with self._lock:
+            leaks = [(bid, s.refcount, self._sites.get(bid, "?"))
+                     for bid, s in self._buffers.items()]
+            if leaks:
+                detail = "; ".join(
+                    f"buffer {bid} refcount={rc} created at {site}"
+                    for bid, rc, site in leaks[:10])
+                raise DebugMemoryError(
+                    f"{len(leaks)} leaked buffer(s): {detail}")
 
     def stats(self) -> dict:
         with self._lock:

@@ -59,6 +59,10 @@ class ExecutorContext:
             self.shuffle.heartbeats.heartbeat(self.executor_id)
 
     def shutdown(self):
+        if self.shuffle is not None:
+            # free device-resident shuffle blocks (the catalog would
+            # otherwise hold them for the process lifetime)
+            self.shuffle.unregister_all()
         if self.shuffle is not None and self.shuffle.transport is not None \
                 and self._transport is None:
             # only close transports we created ourselves

@@ -33,7 +33,8 @@ def object_codes(vals: np.ndarray) -> np.ndarray:
         or (isinstance(v, bytes) and b"\x00" in v)
         for v in vals)
     if not has_nul:
-        return pd.factorize(vals, use_na_sentinel=False)[0].astype(np.int64)
+        from ..shims import get_shims
+        return get_shims().factorize(vals)[0].astype(np.int64)
     table: dict = {}
     out = np.empty(len(vals), dtype=np.int64)
     for i, v in enumerate(vals):
@@ -48,7 +49,8 @@ def _key_codes(col: HostColumn) -> np.ndarray:
     if vals.dtype.kind == "f":
         v = vals.copy()
         v[v == 0] = 0.0  # -0.0 == 0.0
-        codes = pd.factorize(v, use_na_sentinel=False)[0].astype(np.int64)
+        from ..shims import get_shims
+        codes = get_shims().factorize(v)[0].astype(np.int64)
     elif vals.dtype == object:
         codes = object_codes(vals)
     else:
@@ -64,10 +66,10 @@ def group_codes(table: HostTable, key_names: Sequence[str]
     n = table.num_rows
     if not key_names:
         return np.zeros(n, dtype=np.int64), 1, np.zeros(1, dtype=np.int64)
+    from ..shims import get_shims
     mats = np.stack([_key_codes(table.column(k)) for k in key_names], axis=1)
-    _, first_idx, gid = np.unique(mats, axis=0, return_index=True,
-                                  return_inverse=True)
-    gid = gid.reshape(-1)
+    # flat-inverse contract handled by the shim (numpy 2.0 changed it)
+    _, first_idx, gid = get_shims().unique_rows(mats)
     # renumber groups by first appearance for deterministic output order
     order = np.argsort(first_idx, kind="stable")
     remap = np.empty(len(order), dtype=np.int64)
@@ -107,6 +109,37 @@ def host_group_reduce(op: str, col: HostColumn, gid: np.ndarray, ngroups: int,
         if op.endswith("set") or op.endswith("sets"):
             for g in range(ngroups):
                 out[g] = _dedupe(out[g])
+        return out, None
+
+    if op.startswith("tdigest"):
+        # approx_percentile sketch ops (utils/tdigest.py; reference:
+        # GpuApproximatePercentile -> cuDF t-digest). op encodes the
+        # accuracy: "tdigest:<delta>" builds from raw values,
+        # "tdigest_merge:<delta>" merges partial sketches.
+        from ..utils.tdigest import build_digest, merge_digests
+        kind, _, acc = op.partition(":")
+        delta = int(acc) if acc else 10000
+        out = np.empty(ngroups, dtype=object)
+        for g in range(ngroups):
+            out[g] = []
+        idx = np.nonzero(valid)[0]
+        if kind == "tdigest":
+            if len(idx):
+                order = idx[np.argsort(gid[idx], kind="stable")]
+                gs = gid[order]
+                bounds = np.nonzero(np.diff(gs))[0] + 1
+                starts = np.concatenate([[0], bounds])
+                ends = np.concatenate([bounds, [len(order)]])
+                for s, e in zip(starts, ends):
+                    out[gs[s]] = build_digest(
+                        vals[order[s:e]].astype(np.float64), delta)
+        else:
+            parts: List[list] = [[] for _ in range(ngroups)]
+            for i in idx:
+                parts[gid[i]].append(vals[i])
+            for g in range(ngroups):
+                if parts[g]:
+                    out[g] = merge_digests(parts[g], delta)
         return out, None
 
     if op in ("sum", "sumsq"):
@@ -164,7 +197,8 @@ def _dedupe(seq):
 def _host_minmax(op: str, vals: np.ndarray, valid: np.ndarray,
                  gid: np.ndarray, ngroups: int, has: np.ndarray):
     if vals.dtype == object:  # strings: order via sorted factorize codes
-        codes, uniques = pd.factorize(vals, use_na_sentinel=False, sort=True)
+        from ..shims import get_shims
+        codes, uniques = get_shims().factorize(vals, sort=True)
         red, rhas = _host_minmax(op, codes.astype(np.int64), valid, gid,
                                  ngroups, has)
         idx = np.clip(red, 0, max(len(uniques) - 1, 0)).astype(np.int64)

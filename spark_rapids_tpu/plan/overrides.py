@@ -602,6 +602,13 @@ def _always_cpu(plan: PhysicalPlan) -> bool:
     """Nodes exempt from the test.enabled fall-off assertion: scans decode on
     host by design (SURVEY §7.5), and exchanges legitimately stay host-side
     whenever no mesh is attached (the always-available tier) — they DO
-    convert to the ICI exchange under a mesh (see tag_exchange above)."""
+    convert to the ICI exchange under a mesh (see tag_exchange above).
+    AQE stage leaves/readers likewise stay host-side when their stage
+    materialized on the host tier."""
+    from .aqe import (CoalescedStageReader, MappedStageReader,
+                      ShuffleStageExec, SplitStageReader)
     from .physical import CpuScanExec, CpuGlobalLimitExec, ShuffleExchangeExec
-    return isinstance(plan, (CpuScanExec, ShuffleExchangeExec, CpuGlobalLimitExec))
+    return isinstance(plan, (CpuScanExec, ShuffleExchangeExec,
+                             CpuGlobalLimitExec, ShuffleStageExec,
+                             CoalescedStageReader, SplitStageReader,
+                             MappedStageReader))

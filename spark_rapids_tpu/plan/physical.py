@@ -117,7 +117,17 @@ class CpuScanExec(PhysicalPlan):
         return self.source.partitions()
 
     def execute(self, pidx: int) -> Iterator[HostTable]:
-        yield from self.source.read_partition(pidx, self.columns)
+        conf = getattr(self.source, "conf", None)
+        dump_dir = ""
+        if conf is not None:
+            from ..io.dump import DEBUG_DUMP_PATH
+            dump_dir = conf.get(DEBUG_DUMP_PATH)
+        for i, batch in enumerate(
+                self.source.read_partition(pidx, self.columns)):
+            if dump_dir:
+                from ..io.dump import dump_scan_batch
+                dump_scan_batch(dump_dir, self.source.name(), pidx, i, batch)
+            yield batch
 
     def node_desc(self):
         return f"{self.source.name()} cols={self.columns or '*'}"

@@ -41,7 +41,8 @@ def _factorize_pair(lt: HostTable, rt: HostTable, lkeys: Sequence[str],
             if combined.dtype.kind == "f":
                 combined = combined.copy()
                 combined[combined == 0] = 0.0
-                codes = pd.factorize(combined, use_na_sentinel=False)[0]
+                from ..shims import get_shims
+                codes = get_shims().factorize(combined)[0]
             else:
                 from .host_groupby import object_codes
                 codes = object_codes(combined)

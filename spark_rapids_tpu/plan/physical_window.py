@@ -329,13 +329,31 @@ def _range_reduce(fn, vals, valid, lo, hi, out_dt) -> HostColumn:
     n = len(vals)
     if isinstance(fn, (Sum, Average, Count, CountStar)):
         x = np.where(valid, vals, 0)
+        specials = None
         if vals.dtype.kind == "f":
             x = np.where(valid, vals, 0.0)
+            # non-finite-aware prefix sums: NaN/±inf would poison every later
+            # frame's csum difference; sum zeros and re-derive per frame
+            nanm = valid & np.isnan(vals)
+            posm = valid & (vals == np.inf)
+            negm = valid & (vals == -np.inf)
+            x = np.where(nanm | posm | negm, 0.0, x)
+            specials = tuple(
+                np.concatenate([[0], np.cumsum(m.astype(np.int64))])
+                for m in (nanm, posm, negm))
         csum = np.concatenate([[0], np.cumsum(x.astype(np.float64)
                                               if vals.dtype.kind == "f"
                                               else x.astype(np.int64))])
         ccnt = np.concatenate([[0], np.cumsum(valid.astype(np.int64))])
         s = csum[hi] - csum[lo]
+        if specials is not None:
+            cnan, cpos, cneg = specials
+            nn = cnan[hi] - cnan[lo]
+            pp = cpos[hi] - cpos[lo]
+            gg = cneg[hi] - cneg[lo]
+            s = np.where((nn > 0) | ((pp > 0) & (gg > 0)), np.nan,
+                         np.where(pp > 0, np.inf,
+                                  np.where(gg > 0, -np.inf, s)))
         cnt = ccnt[hi] - ccnt[lo]
         if isinstance(fn, (Count, CountStar)):
             return HostColumn(dt.LONG, cnt.astype(np.int64))

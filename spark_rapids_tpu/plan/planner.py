@@ -29,6 +29,12 @@ SHUFFLE_PARTITIONS = register_conf(
     "Number of output partitions for hash/range exchanges (Spark's "
     "spark.sql.shuffle.partitions analogue).", 8)
 
+SCAN_PUSHDOWN = register_conf(
+    "spark.rapids.tpu.scan.filterPushdown.enabled",
+    "Push translatable Filter conjuncts into parquet/ORC scans (row-group "
+    "statistics pruning / ORC search arguments; reference: "
+    "GpuParquetScanBase + OrcFilters pushdown).", True)
+
 __all__ = ["plan_physical", "SHUFFLE_PARTITIONS"]
 
 
@@ -57,6 +63,23 @@ def _plan(node: LogicalPlan, conf: RapidsConf,
         child_req = None if required is None \
             else required | node.condition.references()
         child = _plan(node.child, conf, child_req)
+        # scan predicate pushdown (reference: pushed filters -> parquet
+        # row-group pruning / ORC search arguments). The full filter stays
+        # above the scan; pushdown only lets the reader skip data. The
+        # source is COPIED per plan — the logical DataFrame's source must
+        # not accumulate filters across queries.
+        if isinstance(child, CpuScanExec) and conf.get(SCAN_PUSHDOWN) \
+                and hasattr(child.source, "push_filter"):
+            from ..io.pushdown import to_arrow_filter
+            try:
+                arrow_expr = to_arrow_filter(node.condition)
+            except Exception:
+                arrow_expr = None  # best-effort; the filter still applies
+            if arrow_expr is not None:
+                import copy
+                src = copy.copy(child.source)
+                src.push_filter(arrow_expr)
+                child.source = src
         return CpuFilterExec(child, node.condition)
 
     if isinstance(node, LogicalAggregate):
@@ -136,10 +159,11 @@ def _plan(node: LogicalPlan, conf: RapidsConf,
         return CpuWindowExec(child, node.window_cols)
 
     if isinstance(node, LogicalCache):
-        from ..exec.cache import CpuCacheExec
+        from ..exec.cache import CACHE_COMPRESS_CODEC, CpuCacheExec
         # caches materialize every column; no pruning through them
         child = _plan(node.child, conf, None)
-        return CpuCacheExec(child, node.storage)
+        return CpuCacheExec(child, node.storage,
+                            conf.get(CACHE_COMPRESS_CODEC))
 
     if isinstance(node, LogicalJoin):
         from .joins_planner import plan_join

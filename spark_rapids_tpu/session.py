@@ -133,11 +133,41 @@ class TpuSession:
             if self.conf.get(UDF_COMPILER_ENABLED):
                 compile_plan_udfs(cpu)
             return cpu
+        from .plan.aqe import AQE_ENABLED, AdaptiveExec
+        from .plan.physical import ShuffleExchangeExec
+        if self.conf.get(AQE_ENABLED) \
+                and any(isinstance(n, ShuffleExchangeExec)
+                        for n in _walk_plan(cpu)):
+            # adaptive: stages materialize + re-plan at exchange boundaries
+            # (reference: GpuQueryStagePrepOverrides on AdaptiveSparkPlanExec)
+            return AdaptiveExec(cpu, self.conf, use_device=True)
         return apply_overrides(cpu, self.conf)
 
     def set_conf(self, key: str, value) -> "TpuSession":
         self.conf = self.conf.set(key, value)
         return self
+
+    # -- event log (reference: Spark event logs consumed by the plugin's
+    # profiling tools; here the session writes its own JSONL log that
+    # tools/eventlog.py replays) ------------------------------------------
+    def _event_logger(self):
+        from .tools.eventlog import EVENT_LOG_DIR, EventLogWriter
+        directory = self.conf.get(EVENT_LOG_DIR)
+        if not directory:
+            return None
+        if getattr(self, "_eventlog", None) is None:
+            import os
+            import time as _time
+            app_id = f"app-{os.getpid()}-{int(_time.time() * 1000)}"
+            snap = {k: repr(v) for k, v in self.conf._values.items()}
+            self._eventlog = EventLogWriter(directory, app_id, snap)
+        return self._eventlog
+
+    def close(self) -> None:
+        log = getattr(self, "_eventlog", None)
+        if log is not None:
+            log.close()
+            self._eventlog = None
 
 
 class DataFrame:
@@ -390,6 +420,9 @@ class DataFrame:
     # -- actions -------------------------------------------------------------
     def collect(self, device: Optional[bool] = None) -> pa.Table:
         plan = self.session._physical(self.logical, device)
+        logger = self.session._event_logger()
+        if logger is not None:
+            return logger.run_query(plan, plan.collect).to_arrow()
         return plan.collect().to_arrow()
 
     def to_pandas(self, device: Optional[bool] = None):
@@ -401,6 +434,9 @@ class DataFrame:
     def _batches_from_plan(self, plan, pidx: int):
         from .exec.transitions import DeviceToHostExec
         from .columnar.device import DeviceTable as _DT
+        from .plan.aqe import AdaptiveExec
+        if isinstance(plan, AdaptiveExec):
+            plan = plan.final_plan()
         if isinstance(plan, DeviceToHostExec):
             yield from plan.child.execute_columnar(pidx)
             return

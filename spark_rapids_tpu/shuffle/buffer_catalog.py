@@ -1,0 +1,62 @@
+"""Shuffle buffer catalog — device-resident shuffle blocks.
+
+Reference: ShuffleBufferCatalog.scala + RapidsCachingWriter
+(RapidsShuffleInternalManagerBase.scala:92-155): written shuffle partitions
+stay in the device store as spillable buffers keyed by
+(shuffle, map, reduce); readers on the same executor consume them directly
+(no serialize/deserialize round trip) and the spill framework migrates them
+to host/disk under memory pressure. ``unregisterShuffle`` frees a whole
+shuffle's blocks when the stage is done.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ..columnar.device import DeviceTable
+from ..memory.catalog import SpillPriorities, SpillableDeviceTable, get_catalog
+
+__all__ = ["ShuffleBufferCatalog"]
+
+BlockKey = Tuple[int, int, int]  # (shuffle_id, map_id, reduce_id)
+
+
+class ShuffleBufferCatalog:
+    def __init__(self):
+        self._blocks: Dict[BlockKey, SpillableDeviceTable] = {}
+        self._lock = threading.Lock()
+
+    def put(self, key: BlockKey, table: DeviceTable) -> SpillableDeviceTable:
+        handle = get_catalog().register(table,
+                                        SpillPriorities.OUTPUT_FOR_SHUFFLE)
+        with self._lock:
+            old = self._blocks.get(key)
+            self._blocks[key] = handle
+        if old is not None:  # map-task re-run overwrites its old output
+            old.close()
+        return handle
+
+    def get(self, key: BlockKey) -> Optional[SpillableDeviceTable]:
+        with self._lock:
+            return self._blocks.get(key)
+
+    def has(self, key: BlockKey) -> bool:
+        with self._lock:
+            return key in self._blocks
+
+    def blocks_for(self, shuffle_id: int) -> List[BlockKey]:
+        with self._lock:
+            return [k for k in self._blocks if k[0] == shuffle_id]
+
+    def remove_shuffle(self, shuffle_id: int) -> int:
+        """Close every block of a finished shuffle (unregisterShuffle)."""
+        with self._lock:
+            keys = [k for k in self._blocks if k[0] == shuffle_id]
+            handles = [self._blocks.pop(k) for k in keys]
+        for h in handles:
+            h.close()
+        return len(handles)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"blocks": len(self._blocks)}

@@ -27,11 +27,21 @@ import numpy as np
 
 from ..columnar.device import DeviceTable
 from ..columnar.host import HostTable
-from ..conf import RapidsConf, SHUFFLE_COMPRESSION_CODEC
+from ..conf import RapidsConf, SHUFFLE_COMPRESSION_CODEC, register_conf
 from .serializer import deserialize_table, serialize_table
 from .transport import BlockId, ShuffleTransport, load_transport
 
 __all__ = ["ShuffleManager", "HeartbeatManager", "device_partition_ids"]
+
+SHUFFLE_CACHE_WRITES = register_conf(
+    "spark.rapids.tpu.shuffle.cacheWrites",
+    "Cache written shuffle partitions in the device store as spillable "
+    "buffers (reference: RapidsCachingWriter + ShuffleBufferCatalog): same-"
+    "process readers consume them with no serialize/upload round trip. "
+    "'auto' enables it for the in-process transport only; 'on'/'off' force.",
+    "auto",
+    checker=lambda v: None if v in ("auto", "on", "off")
+    else f"must be one of auto/on/off, got {v!r}")
 
 
 _MURMUR_C1 = np.uint32(0x85EBCA6B)
@@ -147,9 +157,35 @@ class ShuffleManager:
             self.codec = default_codec()
         self._ids = itertools.count()
         self.heartbeats = HeartbeatManager()
+        from .buffer_catalog import ShuffleBufferCatalog
+        self.buffer_catalog = ShuffleBufferCatalog()
+        mode = self.conf.get(SHUFFLE_CACHE_WRITES)
+        if mode == "auto":
+            from .transport import LocalShuffleTransport
+            self.cache_writes = isinstance(self.transport,
+                                           LocalShuffleTransport)
+        else:
+            self.cache_writes = mode == "on"
 
     def new_shuffle_id(self) -> int:
         return next(self._ids)
+
+    def unregister_shuffle(self, shuffle_id: int) -> None:
+        """Free a finished shuffle's blocks in BOTH stores — device-resident
+        catalog buffers and transport payloads (reference:
+        unregisterShuffle releasing the ShuffleBufferCatalog's buffers).
+        Callers own the shuffle lifecycle: invoke when the consuming stage
+        has fully drained the reduce partitions."""
+        self.buffer_catalog.remove_shuffle(shuffle_id)
+        try:
+            self.transport.remove_shuffle(shuffle_id)
+        except NotImplementedError:
+            pass
+
+    def unregister_all(self) -> None:
+        """Executor shutdown: free every cached shuffle block."""
+        for sid in {k[0] for k in list(self.buffer_catalog._blocks)}:
+            self.buffer_catalog.remove_shuffle(sid)
 
     # -- write side -----------------------------------------------------------
     def write_partition(self, shuffle_id: int, map_id: int,
@@ -160,7 +196,15 @@ class ShuffleManager:
         EVERY (map, reduce) block is published, including empty ones — the
         reader treats a missing block as a fetch failure (reference: Spark's
         MapStatus records every block; RapidsShuffleIterator fails loudly on
-        a miss rather than guessing it was empty)."""
+        a miss rather than guessing it was empty).
+
+        With ``cache_writes`` the slices stay DEVICE-resident in the shuffle
+        buffer catalog (RapidsCachingWriter): no download, no serialization;
+        same-process readers concat the device blocks directly and the spill
+        framework owns the memory."""
+        if self.cache_writes:
+            return self._write_partition_cached(shuffle_id, map_id, batches,
+                                                key_names, num_parts)
         merged: List[List[HostTable]] = [[] for _ in range(num_parts)]
         schema_host: Optional[HostTable] = None
         for batch in batches:
@@ -191,6 +235,54 @@ class ShuffleManager:
             sizes[p] = len(payload)
         return sizes
 
+    def _write_partition_cached(self, shuffle_id: int, map_id: int,
+                                batches: Iterator[DeviceTable],
+                                key_names: List[str],
+                                num_parts: int) -> List[int]:
+        """Device-resident write path (RapidsCachingWriter analogue)."""
+        from ..columnar.device import bucket_rows, concat_device_tables
+
+        def gather_window(tbl: DeviceTable, lo: int, hi: int) -> DeviceTable:
+            # explicit gather (NOT slice_rows: its start clamp would shift
+            # windows whose bucketed length overruns the capacity)
+            length = bucket_rows(max(hi - lo, 1), 256)
+            idx = jnp.clip(lo + jnp.arange(length, dtype=jnp.int32),
+                           0, tbl.capacity - 1)
+            mask = jnp.arange(length, dtype=jnp.int32) < (hi - lo)
+            cols = tuple(c.gather(idx).with_validity(
+                jnp.take(c.validity, idx) & mask) for c in tbl.columns)
+            return DeviceTable(cols, mask, jnp.int32(hi - lo), tbl.names)
+
+        per_part: List[List[DeviceTable]] = [[] for _ in range(num_parts)]
+        schema_tbl: Optional[DeviceTable] = None
+        for batch in batches:
+            pids = device_partition_ids(batch, key_names, num_parts)
+            pids = jnp.where(batch.row_mask, pids, num_parts)
+            order = jnp.argsort(pids, stable=True)
+            sorted_tbl = DeviceTable(
+                tuple(c.gather(order) for c in batch.columns),
+                jnp.take(batch.row_mask, order), batch.num_rows, batch.names)
+            schema_tbl = sorted_tbl
+            # count download only (4B/row), like the ICI exchange count pass
+            sorted_pids = np.asarray(jnp.take(pids, order))
+            bounds = np.searchsorted(sorted_pids, np.arange(num_parts + 1))
+            for p in range(num_parts):
+                lo, hi = int(bounds[p]), int(bounds[p + 1])
+                if hi > lo:
+                    per_part[p].append(gather_window(sorted_tbl, lo, hi))
+        sizes = [0] * num_parts
+        for p in range(num_parts):
+            if per_part[p]:
+                table = concat_device_tables(per_part[p], 256)
+            elif schema_tbl is not None:
+                table = gather_window(schema_tbl, 0, 0)
+            else:  # map task saw no batches at all
+                table = DeviceTable((), jnp.zeros(0, dtype=bool),
+                                    jnp.int32(0), ())
+            self.buffer_catalog.put((shuffle_id, map_id, p), table)
+            sizes[p] = table.nbytes()
+        return sizes
+
     # -- read side ------------------------------------------------------------
     def read_partition(self, shuffle_id: int, num_maps: int, reduce_id: int,
                        min_bucket: int = 1024,
@@ -203,6 +295,10 @@ class ShuffleManager:
         map task from lineage), it is invoked once for the failed map and the
         fetch retried before giving up."""
         from .transport import ShuffleFetchFailedException
+        if self.cache_writes:
+            yield from self._read_partition_cached(
+                shuffle_id, num_maps, reduce_id, min_bucket, recompute)
+            return
         blocks = [BlockId(shuffle_id, m, reduce_id) for m in range(num_maps)]
         tables: List[HostTable] = []
         pending = list(blocks)
@@ -226,3 +322,29 @@ class ShuffleManager:
         # host-side coalesce then single upload (GpuShuffleCoalesceExec)
         merged = HostTable.concat(tables)
         yield DeviceTable.from_host(merged, min_bucket)
+
+    def _read_partition_cached(self, shuffle_id: int, num_maps: int,
+                               reduce_id: int, min_bucket: int,
+                               recompute=None) -> Iterator[DeviceTable]:
+        """Catalog-backed read: blocks never left the device (or come back
+        via the spill framework); a miss is a fetch failure with the same
+        recompute-once semantics as the transport path."""
+        from ..columnar.device import concat_device_tables
+        from .transport import ShuffleFetchFailedException
+        parts: List[DeviceTable] = []
+        for m in range(num_maps):
+            key = (shuffle_id, m, reduce_id)
+            handle = self.buffer_catalog.get(key)
+            if handle is None and recompute is not None:
+                recompute(m)
+                handle = self.buffer_catalog.get(key)
+            if handle is None:
+                raise ShuffleFetchFailedException(
+                    BlockId(shuffle_id, m, reduce_id),
+                    "block not in the shuffle buffer catalog")
+            t = handle.get()
+            if t.num_columns and int(t.num_rows):
+                parts.append(t)
+        if not parts:
+            return
+        yield concat_device_tables(parts, min_bucket)

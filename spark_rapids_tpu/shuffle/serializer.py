@@ -26,7 +26,7 @@ from ..columnar.host import HostColumn, HostTable
 __all__ = ["serialize_table", "deserialize_table", "CODECS"]
 
 _MAGIC = b"SRTT"
-_VERSION = 2  # v2: codec set grew (+lz4), frame carries uncompressed length
+_VERSION = 3  # v3: nested columns ship as embedded Arrow IPC streams
 
 CODECS = {"none": 0, "zlib": 1, "lz4": 2}
 _CODEC_BY_ID = {v: k for k, v in CODECS.items()}
@@ -41,14 +41,9 @@ def default_codec() -> str:
 
 def _dtype_tag(d: dt.DataType) -> str:
     if isinstance(d, (dt.ArrayType, dt.StructType, dt.MapType)):
-        # nested host columns are Python object arrays; the raw-buffer branch
-        # would serialize object POINTERS (garbage across processes). Fail
-        # loudly until a real nested encoding (offsets + child buffers, like
-        # the reference's JCudfSerialization) lands.
-        raise TypeError(
-            f"nested type {d.simple_name} is not supported by the shuffle "
-            "serializer; keep nested-state aggregations (collect_list/"
-            "collect_set/approx_percentile) on the in-memory exchange path")
+        # nested columns take the Arrow IPC branch in serialize_table —
+        # offsets + child buffers, the JCudfSerialization nested layout
+        return "arrow"
     if isinstance(d, dt.DecimalType):
         return f"decimal({d.precision},{d.scale})"
     return d.simple_name
@@ -75,6 +70,23 @@ def serialize_table(table: HostTable, codec: str = "none") -> bytes:
     for name, col in zip(table.names, table.columns):
         entry = {"name": name, "dtype": _dtype_tag(col.dtype),
                  "has_validity": col.validity is not None}
+        if isinstance(col.dtype, (dt.ArrayType, dt.StructType, dt.MapType)):
+            # nested encoding = one-column Arrow IPC stream (offsets + child
+            # buffers; validity rides inside the arrow array). Reference:
+            # JCudfSerialization writes nested via offset+child buffers.
+            import pyarrow as pa
+            # HostColumn.to_arrow already nullifies masked rows
+            arr = col.to_arrow()
+            batch = pa.record_batch([arr], names=[name])
+            sink = pa.BufferOutputStream()
+            with pa.ipc.new_stream(sink, batch.schema) as w:
+                w.write_batch(batch)
+            blob = sink.getvalue().to_pybytes()
+            entry["has_validity"] = False  # nulls live in the arrow stream
+            entry["nbytes"] = [len(blob)]
+            payloads.append(blob)
+            header["cols"].append(entry)
+            continue
         if isinstance(col.dtype, (dt.StringType, dt.BinaryType)):
             encoded = [v.encode("utf-8") if isinstance(v, str) else bytes(v)
                        for v in col.values]
@@ -128,6 +140,17 @@ def deserialize_table(data: bytes) -> HostTable:
     n = header["n"]
     names, cols = [], []
     for entry in header["cols"]:
+        if entry["dtype"] == "arrow":
+            import pyarrow as pa
+            from ..columnar.host import HostColumn as _HC
+            (blen,) = entry["nbytes"]
+            blob = body[pos:pos + blen]
+            pos += blen
+            with pa.ipc.open_stream(blob) as reader:
+                batch = reader.read_all()
+            names.append(entry["name"])
+            cols.append(_HC.from_arrow(batch.column(0)))
+            continue
         d = _tag_dtype(entry["dtype"])
         if isinstance(d, (dt.StringType, dt.BinaryType)):
             olen, blen = entry["nbytes"]

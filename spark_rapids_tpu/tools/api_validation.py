@@ -1,0 +1,138 @@
+"""API-validation tool.
+
+Reference: api_validation/ (ApiValidation.scala) reflects over every Gpu exec
+and compares its constructor signature against the corresponding Spark exec,
+printing a drift report — it catches silent API skew between the plugin and
+the engine it overrides.
+
+Here the two surfaces that can skew are (a) the CPU physical operator set vs
+the exec rule registry (a new Cpu exec with no rule and no documented
+host-only reason silently never lowers) and (b) the expression library vs
+the expression rule registry. ``validate()`` reflects over the plan/expr
+modules, resolves each class through the same MRO lookup the planner uses,
+and reports anything unaccounted for; ``report()`` renders the
+ApiValidation-style table.
+"""
+from __future__ import annotations
+
+import inspect
+from typing import Dict, List
+
+__all__ = ["validate", "report"]
+
+# Cpu execs that intentionally have no device rule, with the documented
+# reason (the reference likewise documents known-unsupported operators).
+KNOWN_HOST_ONLY_EXECS: Dict[str, str] = {
+    "CpuScanExec": "scans decode host-side by design (SURVEY §7.5)",
+    "CpuGenerateExec": "explode lowers through plan/generate.py host path "
+                       "with a device Expand for array columns",
+    "PhysicalPlan": "abstract base",
+}
+
+# Expression base classes that are deliberately host-only or abstract.
+KNOWN_HOST_ONLY_EXPRS: Dict[str, str] = {
+    "Expression": "abstract base",
+    "AggregateFunction": "checked inside the aggregate exec rule",
+    "WindowExpression": "lowered by the window exec, not expression rules",
+    "SortOrder": "operator argument, not a standalone expression",
+}
+
+
+def _plan_classes():
+    from ..plan import generate, physical, physical_joins, physical_window
+    from ..exec import cache
+    mods = [physical, physical_joins, physical_window, generate, cache]
+    seen = {}
+    for mod in mods:
+        for name, obj in vars(mod).items():
+            if inspect.isclass(obj) and obj.__module__ == mod.__name__ \
+                    and name.startswith("Cpu"):
+                seen[name] = obj
+    return seen
+
+
+def _rule_for(cls, registry):
+    for c in cls.__mro__:
+        if c in registry:
+            return registry[c]
+    return None
+
+
+def validate() -> List[str]:
+    """-> list of violations (empty = registries and operator sets agree)."""
+    from ..plan import aqe, overrides  # noqa: F401 — populates the registries
+    from ..plan.meta import EXEC_RULES, EXPR_RULES
+    violations: List[str] = []
+
+    for name, cls in _plan_classes().items():
+        rule = _rule_for(cls, EXEC_RULES)
+        if rule is None and name not in KNOWN_HOST_ONLY_EXECS:
+            violations.append(
+                f"exec {name} has no device rule and no documented "
+                "host-only reason")
+        if rule is not None and not callable(rule.convert_fn):
+            violations.append(f"exec {name}: rule convert_fn not callable")
+
+    # every registered exec rule must point at a real, constructible class
+    for cls, rule in EXEC_RULES.items():
+        if not inspect.isclass(cls):
+            violations.append(f"exec rule key {cls!r} is not a class")
+        if not rule.conf_key.startswith("spark.rapids.sql.exec."):
+            violations.append(f"exec rule {cls.__name__}: bad conf key "
+                              f"{rule.conf_key}")
+
+    from ..expr.base import Expression
+    import spark_rapids_tpu.expr as expr_pkg
+    import pkgutil
+    import importlib
+    expr_classes = {}
+    for info in pkgutil.iter_modules(expr_pkg.__path__):
+        mod = importlib.import_module(f"{expr_pkg.__name__}.{info.name}")
+        for name, obj in vars(mod).items():
+            if inspect.isclass(obj) and issubclass(obj, Expression) \
+                    and obj.__module__ == mod.__name__:
+                expr_classes[name] = obj
+
+    unruled = []
+    for name, cls in sorted(expr_classes.items()):
+        if name.startswith("_") or name in KNOWN_HOST_ONLY_EXPRS:
+            continue
+        if _rule_for(cls, EXPR_RULES) is None:
+            unruled.append(name)
+    # expressions with no rule DO fall back gracefully (tagged
+    # "no device implementation"), so drift here is informational until it
+    # regresses: fail only if coverage drops below the recorded floor
+    coverage = 1.0 - len(unruled) / max(1, len(expr_classes))
+    if coverage < 0.55:
+        violations.append(
+            f"expression rule coverage regressed to {coverage:.0%} "
+            f"({len(unruled)}/{len(expr_classes)} unruled): "
+            + ", ".join(unruled[:10]))
+
+    for cls, rule in EXPR_RULES.items():
+        if not issubclass(cls, Expression):
+            violations.append(
+                f"expr rule key {cls.__name__} is not an Expression")
+    return violations
+
+
+def report() -> str:
+    """ApiValidation-style drift report."""
+    from ..plan import aqe, overrides  # noqa: F401 — populates the registries
+    from ..plan.meta import EXEC_RULES, EXPR_RULES
+    lines = ["api validation report", "====================="]
+    plan_classes = _plan_classes()
+    lines.append(f"cpu execs: {len(plan_classes)}; exec rules: "
+                 f"{len(EXEC_RULES)}; expr rules: {len(EXPR_RULES)}")
+    for name, cls in sorted(plan_classes.items()):
+        rule = _rule_for(cls, EXEC_RULES)
+        if rule is not None:
+            via = next(c.__name__ for c in cls.__mro__ if c in EXEC_RULES)
+            note = f"rule via {via}" if via != name else "rule"
+        else:
+            note = "host-only: " + KNOWN_HOST_ONLY_EXECS.get(name, "MISSING")
+        lines.append(f"  {name:<36} {note}")
+    v = validate()
+    lines.append(f"violations: {len(v)}")
+    lines.extend(f"  ! {x}" for x in v)
+    return "\n".join(lines)

@@ -1,0 +1,265 @@
+"""Event-log persistence + post-hoc replay.
+
+Reference: the plugin tools replay *Spark event logs* into profiling and
+qualification reports (tools/.../profiling/Profiler.scala:32,436 and
+EventLogPathProcessor) — the whole point is analyzing a run after the fact.
+This framework owns its runtime, so it writes its own event log: one JSONL
+file per session (``spark.rapids.tpu.eventLog.dir``), one record per event:
+
+- ``app_start``: conf snapshot
+- ``query_start``: query id + plan tree
+- ``node``: one per physical operator — name/desc/depth/parent, wall time,
+  rows/batches, first/last activity offsets, operator metrics snapshot
+- ``query_end``: wall time, spill/semaphore deltas, AQE events
+- ``app_end``
+
+``load_event_log`` replays a file into ``AppReplay``: per-query summaries,
+aggregated operator hot list, HealthCheck warnings, a timeline SVG, and a
+plan DOT graph — the Profiler.scala report set, rebuilt from our log.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Optional
+
+from ..conf import register_conf
+
+__all__ = ["EventLogWriter", "load_event_log", "AppReplay", "QueryReplay",
+           "EVENT_LOG_DIR"]
+
+EVENT_LOG_DIR = register_conf(
+    "spark.rapids.tpu.eventLog.dir",
+    "Directory for the session event log (JSONL; one file per session). "
+    "Empty disables logging. Spark's spark.eventLog.dir analogue — feeds "
+    "the replay tools (tools/eventlog.py load_event_log).", "")
+
+
+class EventLogWriter:
+    """Append-only JSONL writer; one per session."""
+
+    def __init__(self, directory: str, app_id: str, conf_snapshot: Dict):
+        os.makedirs(directory, exist_ok=True)
+        self.path = os.path.join(directory, f"{app_id}.jsonl")
+        self._f = open(self.path, "a", encoding="utf-8")
+        self._query_seq = 0
+        self.write({"event": "app_start", "app_id": app_id,
+                    "ts": time.time(), "conf": conf_snapshot})
+
+    def write(self, record: Dict) -> None:
+        self._f.write(json.dumps(record) + "\n")
+        self._f.flush()
+
+    def next_query_id(self) -> int:
+        self._query_seq += 1
+        return self._query_seq
+
+    def run_query(self, plan, collect_fn):
+        """Instrument ``plan``, run ``collect_fn()``, persist the events."""
+        from ..memory.catalog import get_catalog
+        from ..memory.semaphore import get_semaphore
+        from .profiler import instrument_plan
+
+        qid = self.next_query_id()
+        epoch = time.perf_counter()
+        stats: List = []
+        from ..plan.aqe import AdaptiveExec
+        if isinstance(plan, AdaptiveExec):
+            # AQE finalizes lazily: each stage segment + the final segment
+            # get instrumented as the adaptive loop creates them
+            plan._instrument_hook = \
+                lambda p: instrument_plan(p, epoch, into=stats)
+        else:
+            instrument_plan(plan, epoch, into=stats)
+        cat = get_catalog()
+        sem = get_semaphore()
+        spill_before = dict(cat.spill_count)
+        wait_before = sem.total_wait_time
+        self.write({"event": "query_start", "query_id": qid,
+                    "ts": time.time(), "plan": plan.tree_string()})
+        t0 = time.perf_counter()
+        try:
+            result = collect_fn()
+        except Exception as e:
+            self.write({"event": "query_end", "query_id": qid,
+                        "ts": time.time(),
+                        "wall_s": time.perf_counter() - t0,
+                        "error": f"{type(e).__name__}: {e}"})
+            raise
+        wall = time.perf_counter() - t0
+        for ns in stats:
+            self.write({"event": "node", "query_id": qid,
+                        "node_id": ns.node_id, "parent_id": ns.parent_id,
+                        "name": ns.name, "desc": ns.desc, "depth": ns.depth,
+                        "wall_s": ns.wall_s, "rows": ns.rows,
+                        "batches": ns.batches, "t_first": ns.t_first,
+                        "t_last": ns.t_last,
+                        "metrics": _node_metrics(ns)})
+        aqe_events: List[str] = list(getattr(plan, "events", []))
+        self.write({
+            "event": "query_end", "query_id": qid, "ts": time.time(),
+            "wall_s": wall, "final_plan": plan.tree_string(),
+            "aqe_events": aqe_events,
+            "spill_count": {str(k): v - spill_before.get(k, 0)
+                            for k, v in cat.spill_count.items()},
+            "semaphore_wait_s": sem.total_wait_time - wait_before,
+        })
+        return result
+
+    def close(self) -> None:
+        self.write({"event": "app_end", "ts": time.time()})
+        self._f.close()
+
+
+def _node_metrics(ns) -> Dict:
+    """Snapshot the live node's operator metrics (TpuExec registries)."""
+    reg = getattr(getattr(ns, "_node", None), "metrics", None)
+    snap = reg.snapshot() if reg is not None and hasattr(reg, "snapshot") \
+        else {}
+    return {k: v for k, v in snap.items() if v}
+
+
+# ---------------------------------------------------------------------------
+# Replay
+# ---------------------------------------------------------------------------
+class QueryReplay:
+    def __init__(self, qid: int):
+        self.query_id = qid
+        self.plan: str = ""
+        self.final_plan: str = ""
+        self.wall_s: float = 0.0
+        self.error: Optional[str] = None
+        self.nodes: List[Dict] = []
+        self.aqe_events: List[str] = []
+        self.spill_count: Dict = {}
+        self.semaphore_wait_s: float = 0.0
+
+    def summary(self) -> str:
+        lines = [f"query {self.query_id}: wall={self.wall_s:.4f}s"
+                 + (f" ERROR {self.error}" if self.error else ""),
+                 f"{'op':<44}{'time_s':>9}{'rows':>12}{'batches':>9}"]
+        for n in self.nodes:
+            label = ("  " * n["depth"] + n["name"])[:43]
+            lines.append(f"{label:<44}{n['wall_s']:>9.4f}{n['rows']:>12}"
+                         f"{n['batches']:>9}")
+        if self.aqe_events:
+            lines.append("aqe: " + "; ".join(self.aqe_events))
+        return "\n".join(lines)
+
+    def timeline_svg(self) -> str:
+        """One bar per operator from first to last activity — the
+        reference profiler's generateTimeline analogue."""
+        nodes = [n for n in self.nodes if n["batches"] > 0]
+        if not nodes:
+            return "<svg xmlns='http://www.w3.org/2000/svg'/>"
+        t_max = max(max(n["t_last"] for n in nodes), self.wall_s, 1e-9)
+        row_h, label_w, width = 22, 260, 900
+        height = row_h * (len(nodes) + 1) + 10
+        scale = (width - label_w - 20) / t_max
+        parts = [f"<svg xmlns='http://www.w3.org/2000/svg' "
+                 f"width='{width}' height='{height}' "
+                 f"font-family='monospace' font-size='11'>"]
+        for i, n in enumerate(sorted(nodes, key=lambda x: x["t_first"])):
+            y = 5 + i * row_h
+            x0 = label_w + n["t_first"] * scale
+            w = max(1.0, (n["t_last"] - n["t_first"]) * scale)
+            label = ("  " * n["depth"] + n["name"])[:38]
+            parts.append(f"<text x='4' y='{y + 14}'>{label}</text>")
+            parts.append(
+                f"<rect x='{x0:.1f}' y='{y + 3}' width='{w:.1f}' "
+                f"height='{row_h - 8}' fill='#4C78A8'>"
+                f"<title>{n['name']}: {n['wall_s']:.4f}s, "
+                f"{n['rows']} rows</title></rect>")
+        axis_y = 5 + len(nodes) * row_h + 12
+        parts.append(f"<text x='{label_w}' y='{axis_y}'>0s</text>")
+        parts.append(f"<text x='{width - 60}' y='{axis_y}'>"
+                     f"{t_max:.3f}s</text>")
+        parts.append("</svg>")
+        return "".join(parts)
+
+    def to_dot(self) -> str:
+        """Graphviz DOT of the executed plan with per-node metrics
+        (reference: GenerateDot.scala)."""
+        lines = ["digraph plan {", "  node [shape=box fontname=monospace];"]
+        for n in self.nodes:
+            label = (f"{n['name']}\\n{n['desc'][:40]}\\n"
+                     f"{n['wall_s']:.4f}s  {n['rows']} rows")
+            lines.append(f"  n{n['node_id']} [label=\"{label}\"];")
+        for n in self.nodes:
+            if n["parent_id"] >= 0:
+                lines.append(f"  n{n['node_id']} -> n{n['parent_id']};")
+        lines.append("}")
+        return "\n".join(lines)
+
+
+class AppReplay:
+    def __init__(self, path: str):
+        self.path = path
+        self.app_id: str = ""
+        self.conf: Dict = {}
+        self.queries: Dict[int, QueryReplay] = {}
+
+    def query(self, qid: int) -> QueryReplay:
+        return self.queries[qid]
+
+    def summary(self) -> str:
+        lines = [f"app {self.app_id}: {len(self.queries)} queries"]
+        for q in self.queries.values():
+            lines.append(f"  q{q.query_id}: {q.wall_s:.4f}s"
+                         + (" ERROR" if q.error else ""))
+        hot: Dict[str, float] = {}
+        for q in self.queries.values():
+            for n in q.nodes:
+                hot[n["name"]] = hot.get(n["name"], 0.0) + n["wall_s"]
+        lines.append("hottest operators:")
+        for name, t in sorted(hot.items(), key=lambda kv: -kv[1])[:10]:
+            lines.append(f"  {name:<40}{t:>9.4f}s")
+        return "\n".join(lines)
+
+    def health_check(self) -> List[str]:
+        warnings = []
+        for q in self.queries.values():
+            if q.error:
+                warnings.append(f"q{q.query_id} failed: {q.error}")
+            if any(q.spill_count.values()):
+                warnings.append(
+                    f"q{q.query_id}: device memory pressure "
+                    f"(spills {q.spill_count})")
+            if q.wall_s > 0 and q.semaphore_wait_s > 0.25 * q.wall_s:
+                warnings.append(
+                    f"q{q.query_id}: semaphore wait is "
+                    f"{q.semaphore_wait_s / q.wall_s:.0%} of wall time")
+        return warnings
+
+
+def load_event_log(path: str) -> AppReplay:
+    app = AppReplay(path)
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            ev = rec.get("event")
+            if ev == "app_start":
+                app.app_id = rec.get("app_id", "")
+                app.conf = rec.get("conf", {})
+            elif ev == "query_start":
+                q = app.queries.setdefault(rec["query_id"],
+                                           QueryReplay(rec["query_id"]))
+                q.plan = rec.get("plan", "")
+            elif ev == "node":
+                q = app.queries.setdefault(rec["query_id"],
+                                           QueryReplay(rec["query_id"]))
+                q.nodes.append(rec)
+            elif ev == "query_end":
+                q = app.queries.setdefault(rec["query_id"],
+                                           QueryReplay(rec["query_id"]))
+                q.wall_s = rec.get("wall_s", 0.0)
+                q.error = rec.get("error")
+                q.final_plan = rec.get("final_plan", "")
+                q.aqe_events = rec.get("aqe_events", [])
+                q.spill_count = rec.get("spill_count", {})
+                q.semaphore_wait_s = rec.get("semaphore_wait_s", 0.0)
+    return app

@@ -16,7 +16,7 @@ from typing import Dict, List, Optional
 
 from ..conf import RapidsConf
 
-__all__ = ["profile_query", "QueryProfile"]
+__all__ = ["profile_query", "QueryProfile", "NodeStats", "instrument_plan"]
 
 
 @dataclasses.dataclass
@@ -24,9 +24,13 @@ class NodeStats:
     name: str
     desc: str
     depth: int
+    node_id: int = 0
+    parent_id: int = -1
     wall_s: float = 0.0
     rows: int = 0
     batches: int = 0
+    t_first: float = 0.0   # offset of first activity from query start
+    t_last: float = 0.0    # offset of last activity
 
 
 @dataclasses.dataclass
@@ -79,18 +83,25 @@ class QueryProfile:
         return warnings
 
 
-def profile_query(df, device: Optional[bool] = None) -> QueryProfile:
-    """Execute ``df.collect(device=...)`` with every physical node's
-    ``execute``/``execute_columnar`` wrapped in timers."""
-    from ..memory.catalog import get_catalog
-    from ..memory.semaphore import get_semaphore
+def instrument_plan(plan, epoch: Optional[float] = None,
+                    annotate: bool = False,
+                    into: Optional[List[NodeStats]] = None) -> List[NodeStats]:
+    """Wrap every physical node's ``execute``/``execute_columnar`` in timers
+    (shared by the live profiler and the event-log writer). ``annotate``
+    additionally scopes each node's work in a
+    ``jax.profiler.TraceAnnotation`` so XLA trace captures show query
+    operators by name — the NvtxWithMetrics analogue (reference:
+    NvtxWithMetrics.scala). ``into`` appends to an existing stats list with
+    continuing node ids (AQE instruments each stage segment as it forms)."""
+    stats: List[NodeStats] = [] if into is None else into
+    if epoch is None:
+        epoch = time.perf_counter()
 
-    plan = df.session._physical(df.logical, device)
-    stats: List[NodeStats] = []
-
-    def wrap(node, depth: int):
+    def wrap(node, depth: int, parent: int):
         ns = NodeStats(type(node).__name__,
-                       getattr(node, "node_desc", lambda: "")(), depth)
+                       getattr(node, "node_desc", lambda: "")(), depth,
+                       node_id=len(stats), parent_id=parent)
+        ns._node = node  # live reference for metric snapshots (not serialized)
         stats.append(ns)
         # wrap exactly one method per node: device execs route execute()
         # through execute_columnar(), so wrapping both would double-count
@@ -102,23 +113,62 @@ def profile_query(df, device: Optional[bool] = None) -> QueryProfile:
             if fn is None:
                 continue
 
-            def timed(pidx, _fn=fn, _ns=ns):
+            def timed(pidx, _fn=fn, _ns=ns, _node=node):
+                import contextlib
+                scope = contextlib.nullcontext()
+                if annotate:
+                    import jax.profiler
+                    scope = jax.profiler.TraceAnnotation(
+                        f"{_ns.name}[{pidx}]")
                 t0 = time.perf_counter()
+                if not _ns.batches:
+                    _ns.t_first = t0 - epoch
                 try:
-                    for batch in _fn(pidx):
-                        _ns.wall_s += time.perf_counter() - t0
-                        _ns.batches += 1
-                        _ns.rows += int(batch.num_rows)
-                        yield batch
-                        t0 = time.perf_counter()
+                    with scope:
+                        for batch in _fn(pidx):
+                            now = time.perf_counter()
+                            _ns.wall_s += now - t0
+                            _ns.t_last = now - epoch
+                            _ns.batches += 1
+                            _ns.rows += int(batch.num_rows)
+                            yield batch
+                            t0 = time.perf_counter()
                 finally:
-                    _ns.wall_s += time.perf_counter() - t0
+                    now = time.perf_counter()
+                    _ns.wall_s += now - t0
+                    _ns.t_last = now - epoch
 
             setattr(node, attr, timed)
+        me = ns.node_id
         for c in node.children:
-            wrap(c, depth + 1)
+            wrap(c, depth + 1, me)
 
-    wrap(plan, 0)
+    wrap(plan, 0, -1)
+    return stats
+
+
+def profile_query(df, device: Optional[bool] = None,
+                  xla_trace_dir: Optional[str] = None) -> QueryProfile:
+    """Execute ``df.collect(device=...)`` with every physical node's
+    ``execute``/``execute_columnar`` wrapped in timers. With
+    ``xla_trace_dir`` the whole execution also runs under
+    ``jax.profiler.trace`` with per-operator TraceAnnotations, producing a
+    TensorBoard-loadable XLA trace."""
+    from ..memory.catalog import get_catalog
+    from ..memory.semaphore import get_semaphore
+
+    plan = df.session._physical(df.logical, device)
+    annotate = xla_trace_dir is not None
+    stats: List[NodeStats] = []
+    epoch = time.perf_counter()
+    from ..plan.aqe import AdaptiveExec
+    if isinstance(plan, AdaptiveExec):
+        # AQE finalizes lazily: instrument each stage segment + the final
+        # segment as the adaptive loop creates them
+        plan._instrument_hook = \
+            lambda p: instrument_plan(p, epoch, annotate, into=stats)
+    else:
+        instrument_plan(plan, epoch, annotate, into=stats)
     # snapshot the process-global counters so the report shows THIS query's
     # deltas, not lifetime totals
     cat = get_catalog()
@@ -128,9 +178,16 @@ def profile_query(df, device: Optional[bool] = None) -> QueryProfile:
     wait_before = sem.total_wait_time
     acq_before = sem.acquire_count
 
-    t0 = time.perf_counter()
-    plan.collect()
-    total = time.perf_counter() - t0
+    if xla_trace_dir is not None:
+        import jax.profiler
+        t0 = time.perf_counter()
+        with jax.profiler.trace(xla_trace_dir):
+            plan.collect()
+        total = time.perf_counter() - t0
+    else:
+        t0 = time.perf_counter()
+        plan.collect()
+        total = time.perf_counter() - t0
 
     spill = {
         "spill_count": {str(k): v - spill_before.get(k, 0)

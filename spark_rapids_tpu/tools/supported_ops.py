@@ -53,8 +53,10 @@ def _notes_of(sig: TypeSig) -> List[str]:
 
 
 def supported_ops_markdown() -> str:
-    # import triggers rule registration
-    from ..plan import overrides  # noqa: F401
+    # imports trigger rule registration (aqe adds the stage-reader rules;
+    # importing both keeps the doc deterministic regardless of what else
+    # the process already loaded)
+    from ..plan import aqe, overrides  # noqa: F401
     from ..plan.meta import EXEC_RULES, EXPR_RULES
 
     header = "| op | conf key | " + " | ".join(TypeEnum.ALL) + " | notes |"

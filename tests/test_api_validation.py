@@ -1,0 +1,26 @@
+"""api_validation tool tests (reference: api_validation/ ApiValidation.scala
+signature-drift checks)."""
+from spark_rapids_tpu.tools.api_validation import (KNOWN_HOST_ONLY_EXECS,
+                                                   report, validate)
+
+
+def test_no_violations():
+    assert validate() == []
+
+
+def test_report_accounts_for_every_exec():
+    r = report()
+    assert "violations: 0" in r
+    assert "MISSING" not in r
+    # the one documented host-only exec appears with its reason
+    assert "CpuScanExec" in r and "host-side by design" in r
+
+
+def test_detects_unregistered_exec():
+    """A Cpu exec with no rule and no documented reason is a violation."""
+    removed = KNOWN_HOST_ONLY_EXECS.pop("CpuScanExec")
+    try:
+        v = validate()
+        assert any("CpuScanExec" in x for x in v), v
+    finally:
+        KNOWN_HOST_ONLY_EXECS["CpuScanExec"] = removed

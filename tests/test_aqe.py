@@ -1,0 +1,270 @@
+"""Adaptive query execution tests (reference: GpuOverrides.scala:4010
+AQE re-entry + GpuCustomShuffleReaderExec coalesce/skew specs).
+
+Each scenario runs the same query on the host engine and through AQE on the
+device engine and compares, then asserts the specific adaptive event fired.
+"""
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu.plan.aqe import AdaptiveExec
+from spark_rapids_tpu.session import TpuSession
+
+from harness import assert_tables_equal
+
+
+def _session(**extra):
+    conf = {
+        "spark.rapids.tpu.shuffle.partitions": 6,
+        "spark.rapids.tpu.shuffle.mode": "host",
+    }
+    conf.update(extra)
+    return TpuSession(conf)
+
+
+def _expected(df):
+    return df.collect(device=False)
+
+
+def _adaptive_plan(df):
+    plan = df.session._physical(df.logical, True)
+    assert isinstance(plan, AdaptiveExec), type(plan).__name__
+    return plan
+
+
+def _tables(sess, n_left=4000, n_right=40):
+    rng = np.random.default_rng(7)
+    left = pd.DataFrame({
+        "k": rng.integers(0, n_right, n_left).astype(np.int64),
+        "v": rng.normal(size=n_left),
+    })
+    right = pd.DataFrame({
+        "k": np.arange(n_right, dtype=np.int64),
+        "name": [f"name_{i}" for i in range(n_right)],
+    })
+    return (sess.create_dataframe(left, num_partitions=3),
+            sess.create_dataframe(right, num_partitions=2))
+
+
+# ---------------------------------------------------------------------------
+# join demotion
+# ---------------------------------------------------------------------------
+def test_join_demotes_to_broadcast_and_strips_probe_exchange():
+    sess = _session(**{
+        # static planner must NOT broadcast (else AQE has nothing to do)
+        "spark.rapids.tpu.autoBroadcastJoinThreshold": -1,
+        "spark.rapids.tpu.aqe.autoBroadcastJoinThreshold": 10 << 20,
+    })
+    ldf, rdf = _tables(sess)
+    q = ldf.join(rdf, on="k").select("k", "v", "name")
+    expected = _expected(q)
+
+    plan = _adaptive_plan(q)
+    got = plan.collect().to_arrow()
+    assert_tables_equal(got, expected)
+    assert any("demoted" in e for e in plan.events), plan.events
+    assert any("removed probe-side exchange" in e for e in plan.events), \
+        plan.events
+    final = plan.final_plan().tree_string()
+    assert "BroadcastHashJoin" in final, final
+    assert "ShuffledHashJoin" not in final, final
+
+
+def test_join_demotion_side_swap_right_join():
+    sess = _session(**{
+        "spark.rapids.tpu.autoBroadcastJoinThreshold": -1,
+        "spark.rapids.tpu.aqe.autoBroadcastJoinThreshold": 10 << 20,
+        # keep the left side the small one -> swap path
+    })
+    rng = np.random.default_rng(3)
+    small = pd.DataFrame({"k": np.arange(30, dtype=np.int64),
+                          "s": rng.normal(size=30)})
+    big = pd.DataFrame({"k": rng.integers(0, 30, 5000).astype(np.int64),
+                        "v": rng.normal(size=5000)})
+    sdf = sess.create_dataframe(small, num_partitions=2)
+    bdf = sess.create_dataframe(big, num_partitions=3)
+    q = sdf.join(bdf, on="k", how="right").select("k", "s", "v")
+    expected = _expected(q)
+    plan = _adaptive_plan(q)
+    got = plan.collect().to_arrow()
+    assert_tables_equal(got, expected)
+    assert any("side swap" in e for e in plan.events), plan.events
+
+
+def test_no_demotion_when_build_side_large():
+    sess = _session(**{
+        "spark.rapids.tpu.autoBroadcastJoinThreshold": -1,
+        "spark.rapids.tpu.aqe.autoBroadcastJoinThreshold": 64,  # tiny
+    })
+    ldf, rdf = _tables(sess)
+    q = ldf.join(rdf, on="k").select("k", "v", "name")
+    expected = _expected(q)
+    plan = _adaptive_plan(q)
+    got = plan.collect().to_arrow()
+    assert_tables_equal(got, expected)
+    assert not any("demoted" in e for e in plan.events), plan.events
+
+
+# ---------------------------------------------------------------------------
+# partition coalescing
+# ---------------------------------------------------------------------------
+def test_groupby_partitions_coalesce():
+    sess = _session()
+    rng = np.random.default_rng(11)
+    df = sess.create_dataframe(pd.DataFrame({
+        "g": rng.integers(0, 50, 3000).astype(np.int64),
+        "x": rng.normal(size=3000),
+    }), num_partitions=4)
+    from spark_rapids_tpu.expr.functions import col, sum as f_sum
+    q = df.group_by("g").agg(f_sum(col("x")).alias("sx"))
+    expected = _expected(q)
+    plan = _adaptive_plan(q)
+    got = plan.collect().to_arrow()
+    assert_tables_equal(got, expected)
+    assert any("coalesced" in e for e in plan.events), plan.events
+    # tiny data under a 64MB advisory size -> everything merges to 1 read
+    assert plan.final_plan().num_partitions == 1
+
+
+def test_coalescing_respects_min_partition_num():
+    sess = _session(**{
+        "spark.rapids.tpu.aqe.coalescePartitions.minPartitionNum": 3,
+    })
+    rng = np.random.default_rng(13)
+    df = sess.create_dataframe(pd.DataFrame({
+        "g": rng.integers(0, 50, 3000).astype(np.int64),
+        "x": rng.normal(size=3000),
+    }), num_partitions=4)
+    from spark_rapids_tpu.expr.functions import col, sum as f_sum
+    q = df.group_by("g").agg(f_sum(col("x")).alias("sx"))
+    plan = _adaptive_plan(q)
+    expected = _expected(q)
+    got = plan.collect().to_arrow()
+    assert_tables_equal(got, expected)
+    assert plan.final_plan().num_partitions >= 3
+
+
+def test_join_coalescing_keeps_co_partitioning():
+    sess = _session(**{
+        "spark.rapids.tpu.autoBroadcastJoinThreshold": -1,
+        "spark.rapids.tpu.aqe.autoBroadcastJoinThreshold": -1,  # no demotion
+    })
+    ldf, rdf = _tables(sess, n_left=3000, n_right=500)
+    q = ldf.join(rdf, on="k").select("k", "v", "name")
+    expected = _expected(q)
+    plan = _adaptive_plan(q)
+    got = plan.collect().to_arrow()
+    assert_tables_equal(got, expected)
+    assert any("coalesced join inputs" in e for e in plan.events), plan.events
+
+
+# ---------------------------------------------------------------------------
+# skew split
+# ---------------------------------------------------------------------------
+def test_skew_join_splits_oversized_partition():
+    sess = _session(**{
+        "spark.rapids.tpu.autoBroadcastJoinThreshold": -1,
+        "spark.rapids.tpu.aqe.autoBroadcastJoinThreshold": -1,
+        "spark.rapids.tpu.aqe.coalescePartitions.enabled": False,
+        "spark.rapids.tpu.aqe.skewJoin.skewedPartitionThresholdBytes": 2048,
+        "spark.rapids.tpu.aqe.skewJoin.skewedPartitionFactor": 2,
+        "spark.rapids.tpu.aqe.advisoryPartitionSizeBytes": 2048,
+    })
+    rng = np.random.default_rng(5)
+    # one giant key -> one skewed partition
+    k = np.concatenate([np.zeros(8000, dtype=np.int64),
+                        rng.integers(1, 40, 500).astype(np.int64)])
+    left = pd.DataFrame({"k": k, "v": rng.normal(size=len(k))})
+    right = pd.DataFrame({"k": np.arange(40, dtype=np.int64),
+                          "w": rng.normal(size=40)})
+    ldf = sess.create_dataframe(left, num_partitions=3)
+    rdf = sess.create_dataframe(right, num_partitions=2)
+    q = ldf.join(rdf, on="k").select("k", "v", "w")
+    expected = _expected(q)
+    plan = _adaptive_plan(q)
+    got = plan.collect().to_arrow()
+    assert_tables_equal(got, expected)
+    assert any("skew split" in e for e in plan.events), plan.events
+
+
+def test_skew_split_left_outer():
+    sess = _session(**{
+        "spark.rapids.tpu.autoBroadcastJoinThreshold": -1,
+        "spark.rapids.tpu.aqe.autoBroadcastJoinThreshold": -1,
+        "spark.rapids.tpu.aqe.coalescePartitions.enabled": False,
+        "spark.rapids.tpu.aqe.skewJoin.skewedPartitionThresholdBytes": 2048,
+        "spark.rapids.tpu.aqe.skewJoin.skewedPartitionFactor": 2,
+        "spark.rapids.tpu.aqe.advisoryPartitionSizeBytes": 2048,
+    })
+    rng = np.random.default_rng(9)
+    k = np.concatenate([np.zeros(6000, dtype=np.int64),
+                        rng.integers(1, 60, 400).astype(np.int64)])
+    left = pd.DataFrame({"k": k, "v": rng.normal(size=len(k))})
+    # right side misses half the keys -> exercises unmatched-left emission
+    right = pd.DataFrame({"k": np.arange(0, 60, 2, dtype=np.int64),
+                          "w": rng.normal(size=30)})
+    ldf = sess.create_dataframe(left, num_partitions=3)
+    rdf = sess.create_dataframe(right, num_partitions=2)
+    q = ldf.join(rdf, on="k", how="left").select("k", "v", "w")
+    expected = _expected(q)
+    plan = _adaptive_plan(q)
+    got = plan.collect().to_arrow()
+    assert_tables_equal(got, expected)
+    assert any("skew split" in e for e in plan.events), plan.events
+
+
+# ---------------------------------------------------------------------------
+# toggles & integration
+# ---------------------------------------------------------------------------
+def test_aqe_disabled_returns_plain_plan():
+    sess = _session(**{"spark.rapids.tpu.aqe.enabled": False})
+    ldf, rdf = _tables(sess)
+    q = ldf.join(rdf, on="k").select("k", "v", "name")
+    plan = sess._physical(q.logical, True)
+    assert not isinstance(plan, AdaptiveExec)
+
+
+def test_aqe_on_device_stage_tier():
+    """Under a mesh, stages materialize on the ICI tier and downstream device
+    operators read the shards without a host bounce."""
+    import jax
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the multi-device CPU mesh")
+    from spark_rapids_tpu.parallel.mesh import data_parallel_mesh
+    sess = TpuSession({
+        "spark.rapids.tpu.shuffle.partitions": 8,
+        "spark.rapids.tpu.shuffle.mode": "auto",
+    })
+    sess.attach_mesh(data_parallel_mesh())
+    rng = np.random.default_rng(17)
+    df = sess.create_dataframe(pd.DataFrame({
+        "g": rng.integers(0, 30, 4000).astype(np.int64),
+        "x": rng.normal(size=4000),
+    }), num_partitions=2)
+    from spark_rapids_tpu.expr.functions import col, sum as f_sum
+    q = df.group_by("g").agg(f_sum(col("x")).alias("sx"))
+    expected = _expected(q)
+    plan = _adaptive_plan(q)
+    got = plan.collect().to_arrow()
+    assert_tables_equal(got, expected)
+    final = plan.final_plan().tree_string()
+    assert "TpuStageReaderExec" in final or "ShuffleStageExec" in final, final
+
+
+def test_aqe_multi_stage_query():
+    """groupby -> join -> sort: three exchange layers materialize in
+    dependency order."""
+    sess = _session(**{
+        "spark.rapids.tpu.autoBroadcastJoinThreshold": -1,
+    })
+    ldf, rdf = _tables(sess, n_left=2500, n_right=80)
+    from spark_rapids_tpu.expr.functions import col, sum as f_sum
+    agg = ldf.group_by("k").agg(f_sum(col("v")).alias("sv"))
+    q = agg.join(rdf, on="k").sort("sv").select("k", "sv", "name")
+    expected = q.collect(device=False)
+    plan = _adaptive_plan(q)
+    got = plan.collect().to_arrow()
+    assert_tables_equal(got, expected, ignore_order=False)
+    assert sum("materialized stage" in e for e in plan.events) >= 2, \
+        plan.events

@@ -10,9 +10,17 @@ from harness import assert_tpu_cpu_equal, data_gen
 
 
 def _has_node(plan, cls_name: str) -> bool:
+    from spark_rapids_tpu.plan.aqe import AdaptiveExec
+    if isinstance(plan, AdaptiveExec):
+        plan = plan.final_plan()
     if type(plan).__name__ == cls_name:
         return True
-    return any(_has_node(c, cls_name) for c in plan.children)
+    kids = list(plan.children)
+    for attr in ("inner", "stage"):  # AQE stage leaves/readers hide subtrees
+        sub = getattr(plan, attr, None)
+        if sub is not None:
+            kids.append(sub)
+    return any(_has_node(c, cls_name) for c in kids)
 
 
 @pytest.fixture
@@ -107,7 +115,8 @@ def test_device_join_residual_condition(session, rng):
 def test_shuffled_path_forced(session, rng):
     # disable broadcast -> shuffled hash join with exchanges
     s2 = type(session)(session.conf.set(
-        "spark.rapids.tpu.autoBroadcastJoinThreshold", -1))
+        "spark.rapids.tpu.autoBroadcastJoinThreshold", -1).set(
+        "spark.rapids.tpu.aqe.autoBroadcastJoinThreshold", -1))
     lt = data_gen(rng, 100, {"k": ("int32", 0, 10), "a": "int64"})
     rt = data_gen(rng, 80, {"k": ("int32", 0, 10), "b": "float64"})
     l = s2.create_dataframe(lt, num_partitions=2)

@@ -1,0 +1,91 @@
+"""Event-log persistence + replay tests (reference: Profiler.scala event-log
+analytics; here the engine writes its own JSONL log, tools/eventlog.py
+replays it post-hoc)."""
+import glob
+import os
+
+import numpy as np
+import pandas as pd
+
+from spark_rapids_tpu.session import TpuSession
+from spark_rapids_tpu.tools.eventlog import load_event_log
+from spark_rapids_tpu.expr.functions import col, sum as f_sum
+
+
+def _run_app(tmp_path):
+    sess = TpuSession({
+        "spark.rapids.tpu.eventLog.dir": str(tmp_path),
+        "spark.rapids.tpu.shuffle.partitions": 4,
+        "spark.rapids.tpu.shuffle.mode": "host",
+    })
+    rng = np.random.default_rng(1)
+    df = sess.create_dataframe(pd.DataFrame({
+        "g": rng.integers(0, 10, 2000).astype(np.int64),
+        "x": rng.normal(size=2000),
+    }), num_partitions=3)
+    df.filter(col("x") > 0).select("g", "x").collect()
+    df.group_by("g").agg(f_sum(col("x")).alias("sx")).collect()
+    sess.close()
+    (path,) = glob.glob(os.path.join(str(tmp_path), "*.jsonl"))
+    return path
+
+
+def test_event_log_round_trip(tmp_path):
+    path = _run_app(tmp_path)
+    app = load_event_log(path)
+    assert len(app.queries) == 2
+    assert app.conf, "conf snapshot missing"
+    q1 = app.query(1)
+    assert q1.wall_s > 0
+    assert q1.nodes, "no node events"
+    names = {n["name"] for n in q1.nodes}
+    assert any("Filter" in n or "WholeStage" in n or "Fused" in n
+               for n in names), names
+    # every node carries timing + row counts
+    assert all("wall_s" in n and "rows" in n for n in q1.nodes)
+    assert "query 1" in q1.summary()
+
+
+def test_event_log_aqe_events_and_final_plan(tmp_path):
+    path = _run_app(tmp_path)
+    app = load_event_log(path)
+    q2 = app.query(2)  # the group-by runs through AQE
+    assert q2.final_plan, "final plan missing"
+    assert any("materialized stage" in e for e in q2.aqe_events), \
+        q2.aqe_events
+
+
+def test_timeline_svg_and_dot(tmp_path):
+    path = _run_app(tmp_path)
+    app = load_event_log(path)
+    q = app.query(2)
+    svg = q.timeline_svg()
+    assert svg.startswith("<svg") and "<rect" in svg
+    dot = q.to_dot()
+    assert dot.startswith("digraph") and "->" in dot
+    # app-level report aggregates operators across queries
+    s = app.summary()
+    assert "hottest operators" in s and "2 queries" in s
+    assert isinstance(app.health_check(), list)
+
+
+def test_event_log_disabled_by_default(tmp_path):
+    sess = TpuSession({"spark.rapids.tpu.shuffle.mode": "host"})
+    df = sess.create_dataframe(pd.DataFrame({"a": [1, 2, 3]}))
+    df.collect()
+    assert sess._event_logger() is None
+
+
+def test_profile_query_xla_trace(tmp_path):
+    """NvtxWithMetrics analogue: profiling under jax.profiler.trace with
+    per-operator annotations produces a TensorBoard trace dir."""
+    from spark_rapids_tpu.tools.profiler import profile_query
+    sess = TpuSession({"spark.rapids.tpu.shuffle.mode": "host"})
+    df = sess.create_dataframe(pd.DataFrame({
+        "a": np.arange(100, dtype=np.int64)}))
+    q = df.filter(col("a") % 2 == 0)
+    trace_dir = str(tmp_path / "xla_trace")
+    prof = profile_query(q, device=True, xla_trace_dir=trace_dir)
+    assert prof.total_s > 0
+    assert os.path.isdir(trace_dir)
+    assert glob.glob(os.path.join(trace_dir, "**", "*"), recursive=True)

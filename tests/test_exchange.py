@@ -16,6 +16,9 @@ def _mesh_session(**extra):
     sess = TpuSession({
         "spark.rapids.tpu.batchRowsMinBucket": 8,
         "spark.rapids.tpu.shuffle.partitions": 4,
+        # these tests assert the STATIC planner lowering (exchange nodes in
+        # the plan tree); AQE replaces exchanges with materialized stages
+        "spark.rapids.tpu.aqe.enabled": False,
         **extra,
     })
     sess.attach_mesh(virtual_cpu_mesh(8))

@@ -11,9 +11,17 @@ from harness import assert_tpu_cpu_equal, data_gen
 
 
 def _has_node(plan, cls_name: str) -> bool:
+    from spark_rapids_tpu.plan.aqe import AdaptiveExec
+    if isinstance(plan, AdaptiveExec):
+        plan = plan.final_plan()
     if type(plan).__name__ == cls_name:
         return True
-    return any(_has_node(c, cls_name) for c in plan.children)
+    kids = list(plan.children)
+    for attr in ("inner", "stage"):  # AQE stage leaves/readers hide subtrees
+        sub = getattr(plan, attr, None)
+        if sub is not None:
+            kids.append(sub)
+    return any(_has_node(c, cls_name) for c in kids)
 
 
 @pytest.fixture

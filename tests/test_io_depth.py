@@ -1,0 +1,198 @@
+"""IO depth tests: scan predicate pushdown, ORC multithread+pushdown, CSV
+per-type flags, debug dumps, compressed host cache (reference:
+GpuParquetScanBase pushdown, OrcFilters, RapidsConf csv flags, DumpUtils,
+ParquetCachedBatchSerializer)."""
+import glob
+import os
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.orc as paorc
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.session import TpuSession
+from spark_rapids_tpu.expr.functions import col
+
+from harness import assert_tpu_cpu_equal
+
+
+@pytest.fixture
+def sess():
+    return TpuSession({"spark.rapids.tpu.shuffle.mode": "host"})
+
+
+def _write_parquet(tmp_path, n=2000, files=2):
+    rng = np.random.default_rng(3)
+    paths = []
+    for i in range(files):
+        t = pa.table({
+            "a": pa.array(np.arange(i * n, (i + 1) * n, dtype=np.int64)),
+            "b": pa.array(rng.normal(size=n)),
+            "s": pa.array([f"x{j % 50}" for j in range(n)]),
+        })
+        p = str(tmp_path / f"part-{i}.parquet")
+        pq.write_table(t, p, row_group_size=256)
+        paths.append(p)
+    return paths
+
+
+def test_parquet_filter_pushdown_attaches_and_is_correct(sess, tmp_path):
+    paths = _write_parquet(tmp_path)
+    df = sess.read_parquet(paths)
+    q = df.filter((col("a") >= 100) & (col("a") < 300)).select("a", "b")
+    plan = sess._physical(q.logical, False)
+
+    def find_scan(p):
+        from spark_rapids_tpu.plan.physical import CpuScanExec
+        if isinstance(p, CpuScanExec):
+            return p
+        for c in p.children:
+            s = find_scan(c)
+            if s is not None:
+                return s
+        return None
+
+    scan = find_scan(plan)
+    assert scan is not None and scan.source.filter_expr is not None
+    out = q.collect(device=False)
+    assert out.num_rows == 200
+    assert sorted(out.column("a").to_pylist()) == list(range(100, 300))
+    # the shared DataFrame source must NOT have accumulated the filter
+    assert df.session is sess
+    base_scan_count = df.count()
+    assert base_scan_count == 4000
+    assert_tpu_cpu_equal(q)
+
+
+def test_pushdown_handles_or_in_isnull(sess, tmp_path):
+    paths = _write_parquet(tmp_path, n=500, files=1)
+    df = sess.read_parquet(paths)
+    q = df.filter((col("a") < 10) | (col("a") > 490))
+    assert q.collect(device=False).num_rows == 19
+    q2 = df.filter(col("s").isin("x1", "x2") & (col("a") < 100))
+    got = q2.collect(device=False)
+    assert got.num_rows == 4
+    assert_tpu_cpu_equal(q2)
+
+
+def test_pushdown_never_strips_narrowing_casts(sess, tmp_path):
+    """filter(col('v').cast(INT) == 3) keeps 3.7 (truncation); the pushed
+    filter must NOT become v == 3 (exact row-level pyarrow filtering would
+    drop 3.7)."""
+    from spark_rapids_tpu.columnar import dtypes as dt
+    p = str(tmp_path / "narrow.parquet")
+    pq.write_table(pa.table({"v": [3.7, 3.0, 4.2]}), p)
+    df = sess.read_parquet(p)
+    q = df.filter(col("v").cast(dt.INT) == 3)
+    got = sorted(q.collect(device=False).column("v").to_pylist())
+    assert got == [3.0, 3.7], got
+    assert_tpu_cpu_equal(q)
+
+
+def test_pushdown_not_over_partial_and_is_not_pushed(sess, tmp_path):
+    """~(A & B) with only A translatable must not push ~A (it would drop
+    rows where A holds but B fails)."""
+    p = str(tmp_path / "notand.parquet")
+    pq.write_table(pa.table({"v": [1.0, 3.4, 10.0]}), p)
+    df = sess.read_parquet(p)
+    q = df.filter(~((col("v") > 3.0) & (col("v") * 2 > 7.0)))
+    got = sorted(q.collect(device=False).column("v").to_pylist())
+    assert got == [1.0, 3.4], got
+    assert_tpu_cpu_equal(q)
+
+
+def test_compressed_cache_falls_back_on_unserializable(monkeypatch):
+    """Any serializer failure (exotic column repr) must degrade to live-
+    table caching, never crash the query."""
+    import spark_rapids_tpu.shuffle.serializer as ser
+    s = TpuSession({
+        "spark.rapids.tpu.shuffle.mode": "host",
+        "spark.rapids.tpu.cache.compressionCodec": "zlib",
+    })
+    def boom(table, codec="none"):
+        raise ValueError("cannot create an OBJECT array from memory buffer")
+    monkeypatch.setattr(ser, "serialize_table", boom)
+    df = s.create_dataframe(pd.DataFrame({"a": [1, 2, 3]})).cache()
+    first = df.collect(device=False)
+    second = df.collect(device=False)
+    assert first.equals(second) and first.num_rows == 3
+    storage = df.logical.storage
+    assert storage.host and not storage.host_blobs  # live-table fallback
+
+
+def test_orc_pushdown_and_multithread(sess, tmp_path):
+    rng = np.random.default_rng(5)
+    paths = []
+    for i in range(3):
+        t = pa.table({
+            "k": pa.array(np.arange(i * 100, (i + 1) * 100, dtype=np.int64)),
+            "v": pa.array(rng.normal(size=100)),
+        })
+        p = str(tmp_path / f"f{i}.orc")
+        paorc.write_table(t, p)
+        paths.append(p)
+    df = sess.read_orc(paths)
+    q = df.filter(col("k") >= 250)
+    out = q.collect(device=False)
+    assert sorted(out.column("k").to_pylist()) == list(range(250, 300))
+    plan = sess._physical(q.logical, False)
+    text = plan.tree_string()
+    assert "ORC" in text
+    assert_tpu_cpu_equal(q)
+
+
+def test_csv_type_flag_demotes_to_string(tmp_path):
+    p = str(tmp_path / "t.csv")
+    pd.DataFrame({"f": [1.5, 2.5, 3.5], "i": [1, 2, 3]}).to_csv(
+        p, index=False)
+    on = TpuSession({"spark.rapids.tpu.shuffle.mode": "host"})
+    t1 = on.read_csv(p).collect()
+    assert pa.types.is_float64(t1.schema.field("f").type)
+    off = TpuSession({
+        "spark.rapids.tpu.shuffle.mode": "host",
+        "spark.rapids.sql.csv.read.double.enabled": False,
+    })
+    t2 = off.read_csv(p).collect()
+    assert pa.types.is_string(t2.schema.field("f").type)
+    assert t2.column("f").to_pylist() == ["1.5", "2.5", "3.5"]
+    assert pa.types.is_int64(t2.schema.field("i").type)  # ints still parsed
+
+
+def test_debug_dump_scan_batches(tmp_path):
+    dump_dir = str(tmp_path / "dumps")
+    sess = TpuSession({
+        "spark.rapids.tpu.shuffle.mode": "host",
+        "spark.rapids.tpu.debug.dumpPath": dump_dir,
+    })
+    src = str(tmp_path / "in.parquet")
+    t = pa.table({"a": list(range(50))})
+    pq.write_table(t, src)
+    out = sess.read_parquet(src).filter(col("a") < 10).collect()
+    assert out.num_rows == 10
+    dumps = glob.glob(os.path.join(dump_dir, "scan-*.parquet"))
+    assert dumps, "no dump files written"
+    dumped = pq.read_table(dumps[0])
+    assert dumped.num_rows > 0
+    assert "a" in dumped.column_names
+
+
+def test_compressed_host_cache(sess):
+    sess2 = TpuSession({
+        "spark.rapids.tpu.shuffle.mode": "host",
+        "spark.rapids.tpu.cache.compressionCodec": "zlib",
+    })
+    rng = np.random.default_rng(9)
+    df = sess2.create_dataframe(pd.DataFrame({
+        "a": rng.integers(0, 100, 1000).astype(np.int64),
+        "s": [f"str{i % 17}" for i in range(1000)],
+    }), num_partitions=2).cache()
+    first = df.collect(device=False)
+    second = df.collect(device=False)
+    assert first.equals(second)
+    storage = df.logical.storage
+    assert storage.host_blobs and not storage.host
+    blob_bytes = sum(len(b) for blobs in storage.host_blobs.values()
+                     for b in blobs)
+    assert blob_bytes > 0

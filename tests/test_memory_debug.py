@@ -1,0 +1,118 @@
+"""Debug-allocator sanitizers + catalog/spill concurrency stress
+(reference: RMM debug allocator, spark.rapids.memory.gpu.debug; the
+reference also races its stores under the ThreadedShuffle tests)."""
+import threading
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.columnar.device import DeviceTable
+from spark_rapids_tpu.columnar.host import HostTable
+from spark_rapids_tpu.conf import RapidsConf
+from spark_rapids_tpu.memory.catalog import (BufferCatalog, DebugMemoryError,
+                                             SpillPriorities)
+
+
+def _table(seed: int, rows: int = 256) -> DeviceTable:
+    rng = np.random.default_rng(seed)
+    ht = HostTable.from_arrow(pa.table({
+        "a": rng.integers(0, 1 << 30, rows).astype(np.int64),
+        "b": rng.normal(size=rows),
+    }))
+    return DeviceTable.from_host(ht, 256)
+
+
+def _debug_catalog(**kw) -> BufferCatalog:
+    conf = RapidsConf({"spark.rapids.tpu.memory.debug": True})
+    return BufferCatalog(conf, **kw)
+
+
+def test_double_free_detected():
+    cat = _debug_catalog(device_limit=1 << 24)
+    h = cat.register(_table(1))
+    h.close()
+    with pytest.raises(DebugMemoryError, match="double free"):
+        h.close()
+
+
+def test_release_underflow_detected():
+    cat = _debug_catalog(device_limit=1 << 24)
+    h = cat.register(_table(2))
+    with pytest.raises(DebugMemoryError, match="underflow"):
+        cat.release(h.buffer_id)
+    h.close()
+
+
+def test_use_after_close_detected():
+    cat = _debug_catalog(device_limit=1 << 24)
+    h = cat.register(_table(3))
+    h.close()
+    with pytest.raises(DebugMemoryError, match="use-after-close"):
+        h.get()
+
+
+def test_leak_check_reports_creation_site():
+    cat = _debug_catalog(device_limit=1 << 24)
+    h = cat.register(_table(4))
+    with pytest.raises(DebugMemoryError, match="leaked buffer"):
+        cat.assert_no_leaks()
+    h.close()
+    cat.assert_no_leaks()
+
+
+def test_poison_on_free():
+    """Freed host-tier buffers are filled with 0xDD so stale readers see
+    deterministic garbage, not silently-valid data."""
+    cat = _debug_catalog(device_limit=1)  # everything spills to host
+    h = cat.register(_table(5))
+    stored = cat._buffers[h.buffer_id]
+    cat.synchronous_spill(1 << 20)
+    assert stored.host_arrays is not None
+    arrays = stored.host_arrays
+    h.close()
+    poisoned = arrays["data0"].view("uint8")
+    assert (poisoned == 0xDD).all()
+
+
+def test_non_debug_mode_keeps_lenient_semantics():
+    cat = BufferCatalog(RapidsConf(), device_limit=1 << 24)
+    h = cat.register(_table(6))
+    h.close()
+    h.close()               # silent no-op outside debug mode
+    cat.release(12345)      # unknown release tolerated
+
+
+def test_concurrent_register_spill_close_stress():
+    """Many threads hammer register/acquire/release/close against a pool
+    small enough to force constant spilling; accounting must stay exact and
+    every buffer must round-trip its own data."""
+    cat = _debug_catalog(device_limit=200_000, host_limit=400_000)
+    errors = []
+
+    def worker(tid: int):
+        try:
+            rng = np.random.default_rng(tid)
+            for i in range(12):
+                t = _table(tid * 1000 + i)
+                expect = np.asarray(t.columns[0].data)
+                h = cat.register(t, SpillPriorities.INPUT)
+                if rng.random() < 0.5:
+                    cat.synchronous_spill(50_000)
+                with h as back:
+                    got = np.asarray(back.columns[0].data)
+                    if not (got == expect).all():
+                        errors.append(f"t{tid} i{i}: data corrupted")
+                h.close()
+        except Exception as e:  # pragma: no cover - failure reporting
+            errors.append(f"t{tid}: {type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=worker, args=(t,)) for t in range(8)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=120)
+    assert not errors, errors[:5]
+    cat.assert_no_leaks()
+    cat._check_invariants()
+    assert sum(cat.spill_count.values()) > 0, "stress never spilled"

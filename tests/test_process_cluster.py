@@ -53,8 +53,12 @@ def test_manager_recompute_hook():
     import jax
     from spark_rapids_tpu.columnar.device import DeviceTable
     from spark_rapids_tpu.shuffle.manager import ShuffleManager
+    from spark_rapids_tpu.conf import RapidsConf
     transport = LocalShuffleTransport()
-    mgr = ShuffleManager(transport=transport)
+    # this test exercises the TRANSPORT tier; device-store caching would
+    # short-circuit it (covered by test_shuffle_cache.py)
+    mgr = ShuffleManager(RapidsConf(
+        {"spark.rapids.tpu.shuffle.cacheWrites": "off"}), transport=transport)
     sid = mgr.new_shuffle_id()
     tables = {m: _table(np.arange(m * 10, m * 10 + 10),
                         keys=np.arange(10) % 3) for m in range(2)}

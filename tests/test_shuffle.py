@@ -43,6 +43,35 @@ def test_serializer_empty_and_nulls():
     assert deserialize_table(serialize_table(t2)).to_arrow().equals(t2.to_arrow())
 
 
+def test_serializer_nested_types_roundtrip():
+    """Nested columns ship as embedded Arrow IPC (offsets + child buffers —
+    JCudfSerialization nested layout analogue), so collect_list/set partial
+    states survive a real cross-process shuffle."""
+    t = HostTable.from_arrow(pa.table({
+        "k": pa.array([1, 2, 3, 4], type=pa.int64()),
+        "arr": pa.array([[1, 2], [], None, [5, None, 7]],
+                        type=pa.list_(pa.int64())),
+        "st": pa.array([{"a": 1, "b": "x"}, {"a": 2, "b": None},
+                        None, {"a": 4, "b": "w"}],
+                       type=pa.struct([("a", pa.int64()), ("b", pa.string())])),
+        "m": pa.array([[("k1", 1.5)], [], None, [("k2", 2.5), ("k3", 3.5)]],
+                      type=pa.map_(pa.string(), pa.float64())),
+    }))
+    for codec in ("none", "zlib"):
+        back = deserialize_table(serialize_table(t, codec))
+        assert back.column("arr").values.tolist()[0] == [1, 2]
+        assert back.to_arrow().equals(t.to_arrow()), codec
+
+
+def test_serializer_nested_deep():
+    t = HostTable.from_arrow(pa.table({
+        "nested": pa.array([[[1], [2, 3]], None, [[4]]],
+                           type=pa.list_(pa.list_(pa.int64()))),
+    }))
+    back = deserialize_table(serialize_table(t))
+    assert back.to_arrow().equals(t.to_arrow())
+
+
 def test_transport_reflective_load():
     conf = RapidsConf()
     tr = load_transport(conf)

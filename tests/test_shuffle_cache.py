@@ -1,0 +1,132 @@
+"""Shuffle buffer catalog tests (reference: RapidsCachingWriter +
+ShuffleBufferCatalog — device-resident shuffle blocks, spillable, freed on
+unregisterShuffle)."""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.columnar.device import DeviceTable
+from spark_rapids_tpu.columnar.host import HostTable
+from spark_rapids_tpu.conf import RapidsConf
+from spark_rapids_tpu.memory.catalog import (BufferCatalog, get_catalog,
+                                             set_catalog)
+from spark_rapids_tpu.shuffle.manager import ShuffleManager
+from spark_rapids_tpu.shuffle.transport import (BlockId,
+                                                LocalShuffleTransport,
+                                                ShuffleFetchFailedException)
+
+
+def _table(vals, keys):
+    return HostTable.from_arrow(pa.table({
+        "k": pa.array(np.asarray(keys, dtype=np.int64)),
+        "v": pa.array(np.asarray(vals, dtype=np.int64)),
+    }))
+
+
+class _ExplodingTransport(LocalShuffleTransport):
+    """Proves reads never touch the transport when blocks are cached."""
+
+    def fetch(self, blocks):
+        raise AssertionError("transport fetch used despite cached blocks")
+
+
+def _write(mgr, sid, n_maps=2, n_parts=3):
+    inputs = {}
+    for m in range(n_maps):
+        t = _table(np.arange(m * 100, m * 100 + 20), np.arange(20) % 7)
+        inputs[m] = t
+        mgr.write_partition(sid, m, iter([DeviceTable.from_host(t, 8)]),
+                            ["k"], n_parts)
+    return inputs
+
+
+def test_cached_write_read_skips_transport():
+    mgr = ShuffleManager(transport=_ExplodingTransport())
+    assert mgr.cache_writes  # auto mode: on for the in-process transport
+    sid = mgr.new_shuffle_id()
+    inputs = _write(mgr, sid)
+    got = []
+    for r in range(3):
+        for t in mgr.read_partition(sid, 2, r, min_bucket=8):
+            ht = t.to_host()
+            got.extend(ht.column("v").values.tolist())
+    expect = sorted(v for t in inputs.values()
+                    for v in t.column("v").values.tolist())
+    assert sorted(got) == expect
+    assert mgr.buffer_catalog.stats()["blocks"] == 6
+
+
+def test_cached_blocks_spill_and_restore():
+    prev = get_catalog()
+    small = BufferCatalog(RapidsConf(), device_limit=6000, host_limit=1 << 20)
+    set_catalog(small)
+    try:
+        mgr = ShuffleManager(transport=LocalShuffleTransport())
+        sid = mgr.new_shuffle_id()
+        inputs = _write(mgr, sid, n_maps=4)
+        assert sum(small.spill_count.values()) > 0, small.stats()
+        got = []
+        for r in range(3):
+            for t in mgr.read_partition(sid, 4, r, min_bucket=8):
+                got.extend(t.to_host().column("v").values.tolist())
+        expect = sorted(v for t in inputs.values()
+                        for v in t.column("v").values.tolist())
+        assert sorted(got) == expect
+    finally:
+        set_catalog(prev)
+
+
+def test_cached_missing_block_fetch_failed_and_recompute():
+    mgr = ShuffleManager(transport=LocalShuffleTransport())
+    sid = mgr.new_shuffle_id()
+    inputs = _write(mgr, sid)
+    # sabotage: drop map 1's block for reduce partition 0
+    mgr.buffer_catalog.remove_shuffle(sid + 1000)  # no-op on other shuffles
+    handle = mgr.buffer_catalog._blocks.pop((sid, 1, 0))
+    handle.close()
+    with pytest.raises(ShuffleFetchFailedException):
+        list(mgr.read_partition(sid, 2, 0, min_bucket=8))
+
+    recomputed = []
+
+    def recompute(map_id):
+        recomputed.append(map_id)
+        mgr.write_partition(sid, map_id, iter([DeviceTable.from_host(
+            inputs[map_id], 8)]), ["k"], 3)
+
+    out = list(mgr.read_partition(sid, 2, 0, min_bucket=8, recompute=recompute))
+    assert recomputed == [1] and out
+
+
+def test_remove_shuffle_frees_catalog_entries():
+    prev = get_catalog()
+    cat = BufferCatalog(RapidsConf(), device_limit=1 << 24)
+    set_catalog(cat)
+    try:
+        mgr = ShuffleManager(transport=LocalShuffleTransport())
+        sid = mgr.new_shuffle_id()
+        _write(mgr, sid)
+        before = cat.stats()["buffers"]
+        assert before >= 6
+        freed = mgr.buffer_catalog.remove_shuffle(sid)
+        assert freed == 6
+        assert cat.stats()["buffers"] == before - 6
+    finally:
+        set_catalog(prev)
+
+
+def test_cache_writes_off_uses_transport():
+    mgr = ShuffleManager(RapidsConf(
+        {"spark.rapids.tpu.shuffle.cacheWrites": "off"}),
+        transport=LocalShuffleTransport())
+    assert not mgr.cache_writes
+    sid = mgr.new_shuffle_id()
+    inputs = _write(mgr, sid)
+    assert BlockId(sid, 0, 0) in mgr.transport._blocks
+    got = []
+    for r in range(3):
+        for t in mgr.read_partition(sid, 2, r, min_bucket=8):
+            got.extend(t.to_host().column("v").values.tolist())
+    expect = sorted(v for t in inputs.values()
+                    for v in t.column("v").values.tolist())
+    assert sorted(got) == expect

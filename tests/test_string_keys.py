@@ -10,7 +10,11 @@ from harness import assert_tpu_cpu_equal
 
 
 def _plan_text(df, device=True):
-    return df.session._physical(df.logical, device=device).tree_string()
+    from spark_rapids_tpu.plan.aqe import AdaptiveExec
+    plan = df.session._physical(df.logical, device=device)
+    if isinstance(plan, AdaptiveExec):
+        plan = plan.final_plan()
+    return plan.tree_string()
 
 
 def test_string_groupby_runs_on_device(session):

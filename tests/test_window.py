@@ -98,6 +98,10 @@ def test_window_device_in_plan(session, df):
     q = df.with_column("rn", row_number().over(_w()))
     plan = session._physical(q.logical, True)
 
+    from spark_rapids_tpu.plan.aqe import AdaptiveExec
+    if isinstance(plan, AdaptiveExec):
+        plan = plan.final_plan()
+
     def has(p, name):
         return type(p).__name__ == name or any(has(c, name) for c in p.children)
     assert has(plan, "TpuWindowExec"), plan.tree_string()
