@@ -169,6 +169,12 @@ DEVICE_POOL_FRACTION = register_conf(
     "Fraction of device HBM the buffer pool may use.", 0.9,
     conf_type=float)
 
+READER_BATCH_SIZE_ROWS = register_conf(
+    "spark.rapids.sql.reader.batchSizeRows",
+    "Soft cap on rows per batch produced by file scans (reference: "
+    "RapidsConf READER_BATCH_SIZE_ROWS).", 1 << 21,
+    checker=_positive("reader batch rows"))
+
 SHUFFLE_TRANSPORT_CLASS = register_conf(
     "spark.rapids.shuffle.transport.class",
     "Fully-qualified class name of the shuffle transport implementation; "

@@ -88,6 +88,8 @@ class TpuShuffleExchangeExec(TpuExec):
 
     def execute_columnar(self, pidx: int) -> Iterator[DeviceTable]:
         self._materialize()
+        from ..io.file_block import clear_input_file
+        clear_input_file()  # post-shuffle rows have no single source file
         handle = self._shards[pidx]
         if handle is not None:
             yield handle.get()

@@ -1,0 +1,59 @@
+"""Device parquet scan operator.
+
+Reference: GpuFileSourceScanExec + GpuParquetScanBase — the scan itself is a
+device operator whose output is already columnar device memory. Here each
+row group decodes through io/parquet_device.py (host byte plumbing + device
+run-expansion/dictionary-gather kernels); columns outside the device subset
+ride along via per-column host decode + upload, so the scan's output is one
+DeviceTable per row group either way.
+"""
+from __future__ import annotations
+
+import io as _io
+from typing import Iterator, List, Optional
+
+from ..columnar.device import DeviceTable
+from ..plan.physical import PhysicalPlan
+from ..utils import metrics as M
+from .base import TpuExec
+
+__all__ = ["TpuParquetScanExec"]
+
+
+class TpuParquetScanExec(TpuExec):
+    def __init__(self, source, columns: Optional[List[str]],
+                 schema, min_bucket: int):
+        super().__init__()
+        self.source = source
+        self.columns = list(columns) if columns else None
+        self.children = ()
+        self.schema = schema
+        self.min_bucket = min_bucket
+
+    @property
+    def num_partitions(self) -> int:
+        return self.source.partitions()
+
+    def node_desc(self) -> str:
+        return (f"{self.source.name()} device-decode "
+                f"cols={self.columns or '*'}")
+
+    def execute_columnar(self, pidx: int) -> Iterator[DeviceTable]:
+        import pyarrow.parquet as pq
+        from ..io.parquet_device import decode_row_group
+        cols = self.columns or self.schema.names
+        from ..io.file_block import set_input_file
+        for path in self.source._file_parts[pidx]:
+            with open(path, "rb") as f:
+                raw = f.read()
+            set_input_file(path, 0, len(raw))
+            pf = pq.ParquetFile(_io.BytesIO(raw))
+            for rg in range(pf.metadata.num_row_groups):
+                with self.metrics.timed(M.OP_TIME):
+                    table, n_dev = decode_row_group(
+                        raw, pf.metadata, rg, pf.schema_arrow, cols,
+                        self.min_bucket)
+                self.metrics.add(M.NUM_OUTPUT_BATCHES, 1)
+                self.metrics.add(M.NUM_OUTPUT_ROWS, int(table.num_rows))
+                self.metrics.add("deviceDecodedColumns", n_dev)
+                yield table

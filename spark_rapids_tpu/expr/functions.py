@@ -752,6 +752,31 @@ def collect_set(c) -> Column:
     return Column(CollectSet(_to_expr(c)))
 
 
+def scalar_subquery(df) -> Column:
+    """One-row one-column subquery, executed before the main query and
+    injected as a scalar (reference: GpuScalarSubquery; enables TPC-H
+    q11/q15/q17/q22 shapes without one-row cross joins)."""
+    from .subquery import ScalarSubquery
+    return Column(ScalarSubquery(df.logical))
+
+
+def input_file_name() -> Column:
+    """Source file of the current batch (reference: GpuInputFileName; ""
+    when unattributable — in-memory data or coalesced multi-file batches)."""
+    from .hashing import InputFileName
+    return Column(InputFileName())
+
+
+def input_file_block_start() -> Column:
+    from .hashing import InputFileBlockStart
+    return Column(InputFileBlockStart())
+
+
+def input_file_block_length() -> Column:
+    from .hashing import InputFileBlockLength
+    return Column(InputFileBlockLength())
+
+
 def approx_percentile(c, percentage, accuracy: int = 10000) -> Column:
     """Bounded t-digest sketch honoring ``accuracy`` (state holds at most
     ~accuracy/2 centroids; see ApproximatePercentile docstring)."""

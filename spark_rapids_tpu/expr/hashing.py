@@ -361,6 +361,63 @@ def _xx_bytes_host(b: bytes, seed: int) -> int:
 # id / random expressions
 # ---------------------------------------------------------------------------
 
+class InputFileName(Expression):
+    """input_file_name() (reference: GpuInputFileName + Spark's
+    InputFileBlockHolder; sources populate io/file_block.py's holder right
+    before yielding each batch). Empty string when the batch has no single
+    source file (in-memory data, coalesced multi-file batches)."""
+
+    context_dependent = True
+
+    @property
+    def data_type(self):
+        return dt.STRING
+
+    @property
+    def nullable(self):
+        return False
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        import numpy as np
+
+        from ..io.file_block import current_input_file
+        name, _, _ = current_input_file()
+        vals = np.empty(ctx.num_rows, dtype=object)
+        vals[:] = name
+        return EvalCol(vals, None, dt.STRING)
+
+
+class _InputFileBlockField(Expression):
+    context_dependent = True
+    _field = 1
+
+    @property
+    def data_type(self):
+        return dt.LONG
+
+    @property
+    def nullable(self):
+        return False
+
+    def eval(self, ctx: EvalContext) -> EvalCol:
+        import numpy as np
+
+        from ..io.file_block import current_input_file
+        info = current_input_file()
+        vals = np.full(ctx.num_rows, info[self._field], dtype=np.int64)
+        return EvalCol(vals, None, dt.LONG)
+
+
+class InputFileBlockStart(_InputFileBlockField):
+    """input_file_block_start() (reference: GpuInputFileBlockStart)."""
+    _field = 1
+
+
+class InputFileBlockLength(_InputFileBlockField):
+    """input_file_block_length() (reference: GpuInputFileBlockLength)."""
+    _field = 2
+
+
 class SparkPartitionID(Expression):
     """spark_partition_id() (reference: GpuSparkPartitionID.scala)."""
 

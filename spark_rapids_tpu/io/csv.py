@@ -70,12 +70,14 @@ class CsvSource(DataSource):
     def __init__(self, paths, conf: Optional[RapidsConf] = None, schema=None,
                  header: bool = True, sep: str = ",",
                  num_partitions: Optional[int] = None,
-                 batch_rows: int = 1 << 21):
+                 batch_rows: Optional[int] = None):
         self.files = _expand(paths)
         self.conf = conf or RapidsConf()
         self.header = header
         self.sep = sep
-        self.batch_rows = batch_rows
+        from ..conf import READER_BATCH_SIZE_ROWS
+        self.batch_rows = batch_rows if batch_rows is not None \
+            else self.conf.get(READER_BATCH_SIZE_ROWS)
         self._explicit_schema = schema
         self._forced_strings: List[str] = []
         sample = self._read_file(self.files[0], nrows=1000)
@@ -122,9 +124,11 @@ class CsvSource(DataSource):
         nthreads = self.conf.get(MULTITHREAD_READ_NUM_THREADS)
         files = self._file_parts[pidx]
         with cf.ThreadPoolExecutor(max_workers=nthreads) as pool:
+            from .file_block import set_input_file
             futures = [pool.submit(self._read_file, f) for f in files]
-            for fut in futures:
+            for f, fut in zip(files, futures):
                 t = fut.result()
+                set_input_file(f, 0, os.path.getsize(f))
                 if columns:
                     t = t.select([c for c in columns if c in t.column_names])
                 pos = 0

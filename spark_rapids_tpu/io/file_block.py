@@ -1,0 +1,32 @@
+"""Input-file block context.
+
+Reference: Spark's InputFileBlockHolder thread-local, which readers populate
+and input_file_name()/input_file_block_start()/input_file_block_length()
+read; the plugin's InputFileBlockRule additionally forces the PERFILE reader
+when these expressions appear, because the coalescing reader merges many
+files into one batch and loses attribution (GpuParquetScanBase docs).
+
+Same design here: sources set the holder right before yielding each batch;
+expression evaluation happens while the generator frame is suspended, so the
+holder still describes the batch being processed.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Tuple
+
+__all__ = ["set_input_file", "clear_input_file", "current_input_file"]
+
+_TL = threading.local()
+
+
+def set_input_file(name: str, start: int = 0, length: int = -1) -> None:
+    _TL.info = (name, int(start), int(length))
+
+
+def clear_input_file() -> None:
+    _TL.info = ("", 0, -1)
+
+
+def current_input_file() -> Tuple[str, int, int]:
+    return getattr(_TL, "info", ("", 0, -1))

@@ -25,7 +25,7 @@ __all__ = ["JsonSource"]
 class JsonSource(DataSource):
     def __init__(self, paths, conf: Optional[RapidsConf] = None,
                  num_partitions: Optional[int] = None,
-                 batch_rows: int = 1 << 21):
+                 batch_rows: Optional[int] = None):
         if isinstance(paths, (str, os.PathLike)):
             paths = [paths]
         files: List[str] = []
@@ -42,7 +42,9 @@ class JsonSource(DataSource):
             raise FileNotFoundError(f"no json files for {paths}")
         self.files = files
         self.conf = conf or RapidsConf()
-        self.batch_rows = batch_rows
+        from ..conf import READER_BATCH_SIZE_ROWS
+        self.batch_rows = batch_rows if batch_rows is not None \
+            else self.conf.get(READER_BATCH_SIZE_ROWS)
         first = pajson.read_json(self.files[0])
         ht = HostTable.from_arrow(first.slice(0, 0))
         self._schema = Schema([Field(n, c.dtype, True)
@@ -61,8 +63,10 @@ class JsonSource(DataSource):
 
     def read_partition(self, pidx: int, columns: Optional[List[str]] = None
                        ) -> Iterator[HostTable]:
+        from .file_block import set_input_file
         for f in self._file_parts[pidx]:
             t = pajson.read_json(f)
+            set_input_file(f, 0, os.path.getsize(f))
             if columns:
                 t = t.select([c for c in columns if c in t.column_names])
             pos = 0

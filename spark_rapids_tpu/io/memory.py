@@ -32,6 +32,8 @@ class InMemorySource(DataSource):
 
     def read_partition(self, pidx: int, columns: Optional[List[str]] = None
                        ) -> Iterator[HostTable]:
+        from .file_block import clear_input_file
+        clear_input_file()  # in-memory data has no source file
         n = self.table.num_rows
         per = math.ceil(n / self._parts) if n else 0
         lo = min(n, pidx * per)

@@ -27,7 +27,7 @@ __all__ = ["OrcSource"]
 class OrcSource(DataSource):
     def __init__(self, paths, conf: Optional[RapidsConf] = None,
                  num_partitions: Optional[int] = None,
-                 batch_rows: int = 1 << 21):
+                 batch_rows: Optional[int] = None):
         if isinstance(paths, (str, os.PathLike)):
             paths = [paths]
         files: List[str] = []
@@ -44,7 +44,9 @@ class OrcSource(DataSource):
             raise FileNotFoundError(f"no orc files for {paths}")
         self.files = files
         self.conf = conf or RapidsConf()
-        self.batch_rows = batch_rows
+        from ..conf import READER_BATCH_SIZE_ROWS
+        self.batch_rows = batch_rows if batch_rows is not None \
+            else self.conf.get(READER_BATCH_SIZE_ROWS)
         self.filter_expr = None  # pyarrow dataset pushdown (OrcFilters)
         first = paorc.ORCFile(self.files[0]).schema
         ht = HostTable.from_arrow(first.empty_table())
@@ -84,17 +86,21 @@ class OrcSource(DataSource):
             # bounded prefetch window: at most nthreads decoded tables
             # resident at once (whole-partition submission would pin every
             # file's table until the generator drains)
-            pending = deque()
+            from .file_block import set_input_file
+            pending = deque()  # (file, future) pairs keep attribution exact
             it = iter(files)
             for f in it:
-                pending.append(pool.submit(self._read_file, f, columns))
+                pending.append((f, pool.submit(self._read_file, f, columns)))
                 if len(pending) >= nthreads:
                     break
             while pending:
-                t = pending.popleft().result()
+                fname, fut = pending.popleft()
+                t = fut.result()
+                set_input_file(fname, 0, os.path.getsize(fname))
                 nxt = next(it, None)
                 if nxt is not None:
-                    pending.append(pool.submit(self._read_file, nxt, columns))
+                    pending.append(
+                        (nxt, pool.submit(self._read_file, nxt, columns)))
                 pos = 0
                 while pos < t.num_rows or (pos == 0 and t.num_rows == 0):
                     yield HostTable.from_arrow(t.slice(pos, self.batch_rows))

@@ -55,12 +55,14 @@ def _expand_paths(paths) -> List[str]:
 class ParquetSource(DataSource):
     def __init__(self, paths, conf: Optional[RapidsConf] = None,
                  num_partitions: Optional[int] = None,
-                 batch_rows: int = 1 << 21,
+                 batch_rows: Optional[int] = None,
                  filter_expr=None):
         self.files = _expand_paths(paths)
         self.conf = conf or RapidsConf()
         self.reader_type = str(self.conf.get(PARQUET_READER_TYPE)).upper()
-        self.batch_rows = batch_rows
+        from ..conf import READER_BATCH_SIZE_ROWS
+        self.batch_rows = batch_rows if batch_rows is not None \
+            else self.conf.get(READER_BATCH_SIZE_ROWS)
         self.filter_expr = filter_expr  # pyarrow dataset filter (pushdown)
         first = pq.read_schema(self.files[0])
         ht = HostTable.from_arrow(first.empty_table())
@@ -105,7 +107,9 @@ class ParquetSource(DataSource):
         return pq.read_table(path, columns=columns, use_threads=True)
 
     def _read_file_batches(self, path: str, columns) -> Iterator[HostTable]:
+        from .file_block import set_input_file
         t = self._read_file(path, columns)
+        set_input_file(path, 0, os.path.getsize(path))
         pos = 0
         while pos < t.num_rows:
             yield HostTable.from_arrow(t.slice(pos, self.batch_rows))
@@ -115,6 +119,11 @@ class ParquetSource(DataSource):
 
     def _read_coalescing(self, files: Sequence[str], columns
                          ) -> Iterator[HostTable]:
+        # merged batches span files: no single-file attribution (the
+        # InputFileBlockRule analogue selects PERFILE when file-info
+        # expressions appear, exactly like the reference's readers)
+        from .file_block import clear_input_file
+        clear_input_file()
         pending: List[pa.Table] = []
         pending_rows = 0
         for f in files:
@@ -143,9 +152,11 @@ class ParquetSource(DataSource):
                             ) -> Iterator[HostTable]:
         nthreads = self.conf.get(MULTITHREAD_READ_NUM_THREADS)
         with cf.ThreadPoolExecutor(max_workers=nthreads) as pool:
+            from .file_block import set_input_file
             futures = [pool.submit(self._read_file, f, columns) for f in files]
-            for fut in futures:  # preserve file order, reads overlap
+            for f, fut in zip(files, futures):  # file order kept, reads overlap
                 t = fut.result()
+                set_input_file(f, 0, os.path.getsize(f))
                 yield from self._slice_out(t, allow_empty=True)
 
     def estimated_size_bytes(self):

@@ -1,0 +1,434 @@
+"""Device-side parquet decode.
+
+Reference: the GPU plugin's biggest IO win is decoding parquet ON the
+accelerator — raw column chunks go to the device and cuDF kernels expand
+them (GpuParquetScanBase.scala:995,1194). The TPU-native shape of that
+design, mapped onto XLA's static-shape world:
+
+- HOST does the byte plumbing: file reads, page-header parsing
+  (io/parquet_thrift.py), page decompression, and a one-pass scan of the
+  RLE/bit-packed hybrid streams into *run tables* (a few entries per run,
+  NOT per value — the classic GPU decoder split).
+- DEVICE does the per-value work, one fused jit per column chunk:
+  run-table expansion (searchsorted over run starts), bit-field extraction
+  of dictionary indices from the packed blob, dictionary gather, and
+  null-scatter of the dense non-null values into row slots via a validity
+  cumsum.
+
+Supported (everything else falls back per COLUMN to pyarrow + upload):
+flat columns (no repetition), physical BOOLEAN/INT32/INT64/FLOAT/DOUBLE,
+data-page v1 with PLAIN or RLE_DICTIONARY values, any pyarrow-
+decompressible codec. Output is bit-identical to the host path
+(DeviceTable.from_host of the pyarrow read).
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from ..columnar import dtypes as dt
+from ..columnar.host import _arrow_to_dtype
+from ..conf import register_conf
+from .parquet_thrift import Encoding, PageType, read_page_header
+
+__all__ = ["PARQUET_DEVICE_DECODE", "chunk_supported", "decode_row_group",
+           "UnsupportedChunk"]
+
+PARQUET_DEVICE_DECODE = register_conf(
+    "spark.rapids.tpu.parquet.deviceDecode.enabled",
+    "Decode supported parquet columns on the device (run-table expansion + "
+    "dictionary gather kernels; reference: GpuParquetScanBase device "
+    "decode). Unsupported columns fall back to host decode per column.",
+    True)
+
+_PHYS_OK = {"BOOLEAN", "INT32", "INT64", "FLOAT", "DOUBLE"}
+_ENC_OK = {"PLAIN", "RLE", "RLE_DICTIONARY", "PLAIN_DICTIONARY",
+           "BIT_PACKED"}
+
+
+class UnsupportedChunk(Exception):
+    """Column chunk outside the device decoder's subset."""
+
+
+def chunk_supported(col_meta, arrow_field) -> bool:
+    """Static (metadata-only) eligibility of one column chunk."""
+    import pyarrow as pa
+    if col_meta.physical_type not in _PHYS_OK:
+        return False
+    if any(e not in _ENC_OK for e in col_meta.encodings):
+        return False
+    t = arrow_field.type
+    if pa.types.is_nested(t) or pa.types.is_dictionary(t):
+        return False
+    try:
+        d = _arrow_to_dtype(t)
+    except Exception:
+        return False
+    if isinstance(d, (dt.StringType, dt.BinaryType, dt.DecimalType)):
+        return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# Host side: pages -> merged run tables
+# ---------------------------------------------------------------------------
+def _decompress(buf: bytes, codec: str, uncompressed_size: int) -> bytes:
+    if codec in ("UNCOMPRESSED", None):
+        return buf
+    import pyarrow as pa
+    return pa.decompress(buf, decompressed_size=uncompressed_size,
+                         codec=codec.lower()).to_pybytes()
+
+
+class _RunTable:
+    """Accumulated RLE/bit-packed runs across a chunk's pages."""
+
+    def __init__(self):
+        self.out_start: List[int] = []
+        self.count: List[int] = []
+        self.is_rle: List[bool] = []
+        self.rle_value: List[int] = []
+        self.bit_base: List[int] = []   # absolute first-bit into self.packed
+        self.packed = bytearray()
+        self.total = 0
+
+    def parse_hybrid(self, buf: bytes, pos: int, end: int, width: int,
+                     max_count: int) -> None:
+        """One RLE-hybrid stream (parquet format spec): header varint LSB
+        selects bit-packed groups vs RLE run."""
+        if width == 0:
+            # zero-width stream: max_count zeros, no bytes
+            self._push_rle(max_count, 0)
+            return
+        produced = 0
+        vbytes = (width + 7) // 8
+        while pos < end and produced < max_count:
+            header = 0
+            shift = 0
+            while True:
+                b = buf[pos]
+                pos += 1
+                header |= (b & 0x7F) << shift
+                if not b & 0x80:
+                    break
+                shift += 7
+            if header & 1:  # bit-packed groups
+                groups = header >> 1
+                nvals = min(groups * 8, max_count - produced)
+                nbytes = groups * width  # groups*8 values * width/8 bits
+                self.out_start.append(self.total)
+                self.count.append(nvals)
+                self.is_rle.append(False)
+                self.rle_value.append(0)
+                self.bit_base.append(len(self.packed) * 8)
+                self.packed.extend(buf[pos:pos + nbytes])
+                pos += nbytes
+                self.total += nvals
+                produced += nvals
+            else:           # RLE run
+                run = min(header >> 1, max_count - produced)
+                v = int.from_bytes(buf[pos:pos + vbytes], "little")
+                pos += vbytes
+                self._push_rle(run, v)
+                produced += run
+
+    def _push_rle(self, run: int, v: int) -> None:
+        if run <= 0:
+            return
+        self.out_start.append(self.total)
+        self.count.append(run)
+        self.is_rle.append(True)
+        self.rle_value.append(v)
+        self.bit_base.append(0)
+        self.total += run
+
+    def arrays(self) -> Tuple[np.ndarray, ...]:
+        # pow2-pad entry count and packed blob so XLA sees a bounded shape
+        # set across chunks (padding runs have out_start == total -> the
+        # searchsorted expansion never selects them)
+        n = _pow2(max(1, len(self.out_start)))
+        pad = n - len(self.out_start)
+        out_start = np.asarray(self.out_start + [self.total] * pad, np.int64)
+        packed = np.frombuffer(bytes(self.packed) or b"\0", np.uint8)
+        packed = np.pad(packed, (0, _pow2(len(packed)) - len(packed)))
+        return (out_start,
+                np.asarray(self.is_rle + [True] * pad, np.bool_),
+                np.asarray(self.rle_value + [0] * pad, np.int64),
+                np.asarray(self.bit_base + [0] * pad, np.int64),
+                packed)
+
+
+class _Chunk:
+    """Parsed column chunk: run tables + dense plain values + dictionary."""
+
+    def __init__(self):
+        self.defs = _RunTable()      # definition levels (width 1)
+        self.idx = _RunTable()       # dictionary indices (width per page)
+        self.idx_width: int = 0
+        self.plain_parts: List[bytes] = []
+        self.dictionary: Optional[np.ndarray] = None
+        self.num_rows = 0
+        self.nullable = False
+        self.bool_plain: List[Tuple[bytes, int]] = []  # packed bits, count
+        self.uses_dict = False
+        self.uses_plain = False
+
+
+def _parse_chunk(raw: bytes, col_meta, nullable: bool) -> _Chunk:
+    ch = _Chunk()
+    ch.nullable = nullable
+    phys = col_meta.physical_type
+    codec = col_meta.compression
+    off = col_meta.dictionary_page_offset
+    if off is None:
+        off = col_meta.data_page_offset
+    end = off + col_meta.total_compressed_size
+    pos = off
+    while pos < end:
+        hdr = read_page_header(raw, pos)
+        data_start = pos + hdr.header_bytes
+        page = raw[data_start:data_start + hdr.compressed_size]
+        pos = data_start + hdr.compressed_size
+        if hdr.page_type == PageType.DICTIONARY_PAGE:
+            page = _decompress(page, codec, hdr.uncompressed_size)
+            ch.dictionary = _plain_values(page, phys, hdr.num_values)
+            continue
+        if hdr.page_type != PageType.DATA_PAGE:
+            raise UnsupportedChunk(f"page type {hdr.page_type}")
+        page = _decompress(page, codec, hdr.uncompressed_size)
+        p = 0
+        nvals = hdr.num_values
+        # flat columns: no repetition levels; definition levels only when
+        # the column is nullable (length-prefixed RLE at bit width 1)
+        n_nonnull = nvals
+        if nullable:
+            (dl_len,) = np.frombuffer(page, np.uint32, 1, p)
+            p += 4
+            before = ch.defs.total
+            ch.defs.parse_hybrid(page, p, p + int(dl_len), 1, nvals)
+            if ch.defs.total - before < nvals:   # stream may omit the tail
+                ch.defs._push_rle(nvals - (ch.defs.total - before), 1)
+            p += int(dl_len)
+            n_nonnull = _count_defined(ch.defs, before)
+        else:
+            ch.defs._push_rle(nvals, 1)
+        if hdr.encoding in (Encoding.RLE_DICTIONARY,
+                            Encoding.PLAIN_DICTIONARY):
+            width = page[p]
+            p += 1
+            if width > 24:
+                raise UnsupportedChunk(f"dict index width {width}")
+            ch.idx_width = max(ch.idx_width, width)
+            ch.idx.parse_hybrid(page, p, len(page), width, n_nonnull)
+            ch.uses_dict = True
+        elif hdr.encoding == Encoding.PLAIN:
+            if phys == "BOOLEAN":
+                ch.bool_plain.append((page[p:], n_nonnull))
+            else:
+                ch.plain_parts.append(page[p:])
+            ch.uses_plain = True
+        else:
+            raise UnsupportedChunk(f"encoding {hdr.encoding}")
+        ch.num_rows += nvals
+    if ch.uses_dict and ch.uses_plain:
+        raise UnsupportedChunk("mixed dict+plain pages")  # rare; host path
+    return ch
+
+
+def _count_defined(rt: _RunTable, from_entry_total: int) -> int:
+    """Non-null count contributed by def-level entries after a checkpoint —
+    needed because dictionary index streams hold only non-null values."""
+    # walk entries added since the checkpoint
+    total = 0
+    acc = 0
+    for i in range(len(rt.out_start)):
+        if rt.out_start[i] < from_entry_total:
+            continue
+        if rt.is_rle[i]:
+            total += rt.count[i] * (1 if rt.rle_value[i] else 0)
+        else:
+            # bit-packed def levels at width 1: count set bits in the run
+            base = rt.bit_base[i] // 8
+            nbits = rt.count[i]
+            blob = bytes(rt.packed[base:base + (nbits + 7) // 8])
+            bits = np.unpackbits(np.frombuffer(blob, np.uint8),
+                                 bitorder="little")[:nbits]
+            total += int(bits.sum())
+        acc += rt.count[i]
+    return total
+
+
+_NP_BY_PHYS = {"INT32": np.int32, "INT64": np.int64,
+               "FLOAT": np.float32, "DOUBLE": np.float64}
+
+
+def _plain_values(buf: bytes, phys: str, n: int) -> np.ndarray:
+    if phys == "BOOLEAN":
+        bits = np.unpackbits(np.frombuffer(buf, np.uint8, (n + 7) // 8),
+                             bitorder="little")[:n]
+        return bits.astype(np.bool_)
+    npdt = _NP_BY_PHYS[phys]
+    return np.frombuffer(buf, npdt, n)
+
+
+# ---------------------------------------------------------------------------
+# Device side: one fused kernel per chunk
+# ---------------------------------------------------------------------------
+def _pow2(n: int) -> int:
+    c = 1
+    while c < n:
+        c *= 2
+    return c
+
+
+def _expand_hybrid_device(out_start, is_rle, rle_value, bit_base, packed,
+                          width, iota):
+    """values[i] for each output position in ``iota``: expand the run table
+    on device (searchsorted for run id + LSB-first bit-field extraction for
+    bit-packed runs). ``width`` may be a traced scalar."""
+    import jax.numpy as jnp
+    i = iota.astype(jnp.int64)
+    run = jnp.clip(jnp.searchsorted(out_start, i, side="right") - 1,
+                   0, out_start.shape[0] - 1)
+    within = i - out_start[run]
+    bit = bit_base[run] + within * width.astype(jnp.int64)
+    byte0 = bit >> 3
+    shift = (bit & 7).astype(jnp.uint32)
+    nb = packed.shape[0]
+    g = lambda k: packed[jnp.clip(byte0 + k, 0, nb - 1)].astype(jnp.uint32)
+    dword = g(0) | (g(1) << 8) | (g(2) << 16) | (g(3) << 24)
+    # width <= 24 enforced at parse time, so 4 gathered bytes always cover
+    mask = (jnp.uint32(1) << width.astype(jnp.uint32)) - jnp.uint32(1)
+    bp_val = (dword >> shift) & mask
+    return jnp.where(is_rle[run], rle_value[run].astype(jnp.int64),
+                     bp_val.astype(jnp.int64))
+
+
+def _dict_kernel_builder(npdt_str: str):
+    def fn(v_start, v_rle, v_val, v_bit, v_packed,
+           d_start, d_rle, d_val, d_bit, d_packed, dvals,
+           n, width, iota_cap, iota_nv):
+        import jax.numpy as jnp
+        validity = _expand_hybrid_device(
+            v_start, v_rle, v_val, v_bit, v_packed,
+            jnp.uint32(1), iota_cap) > 0
+        validity = jnp.logical_and(validity, iota_cap < n)
+        pos = jnp.cumsum(validity.astype(jnp.int32)) - 1
+        idx = _expand_hybrid_device(d_start, d_rle, d_val, d_bit, d_packed,
+                                    width, iota_nv)
+        dense = dvals[jnp.clip(idx, 0, dvals.shape[0] - 1)]
+        vals = dense[jnp.clip(pos, 0, dense.shape[0] - 1)]
+        vals = jnp.where(validity, vals, jnp.zeros((), vals.dtype))
+        return vals.astype(jnp.dtype(npdt_str)), validity
+    return lambda: fn
+
+
+def _plain_kernel_builder(npdt_str: str):
+    def fn(v_start, v_rle, v_val, v_bit, v_packed, dense, n, iota_cap):
+        import jax.numpy as jnp
+        validity = _expand_hybrid_device(
+            v_start, v_rle, v_val, v_bit, v_packed,
+            jnp.uint32(1), iota_cap) > 0
+        validity = jnp.logical_and(validity, iota_cap < n)
+        pos = jnp.cumsum(validity.astype(jnp.int32)) - 1
+        vals = dense[jnp.clip(pos, 0, dense.shape[0] - 1)]
+        vals = jnp.where(validity, vals, jnp.zeros((), vals.dtype))
+        return vals.astype(jnp.dtype(npdt_str)), validity
+    return lambda: fn
+
+
+def _decode_column_device(ch: _Chunk, out_dtype: dt.DataType, cap: int):
+    """-> DeviceColumn with row capacity ``cap`` (device kernels; compiled
+    callables shared via the global compile cache, shapes pow2-bucketed)."""
+    import numpy as _np
+
+    from ..columnar.device import DeviceColumn
+    from ..utils.compile_cache import cached_jit
+
+    n = ch.num_rows
+    npdt = out_dtype.np_dtype()
+    npdt_str = _np.dtype(npdt).str
+    v_tables = ch.defs.arrays()
+    iota_cap = _np.arange(cap, dtype=_np.int64)
+
+    if ch.uses_dict:
+        d_tables = ch.idx.arrays()
+        dict_vals = ch.dictionary
+        dv = _np.pad(dict_vals, (0, _pow2(len(dict_vals)) - len(dict_vals)))
+        nvcap = _pow2(max(1, ch.idx.total))
+        fn = cached_jit(f"pq_dict|{npdt_str}", _dict_kernel_builder(npdt_str))
+        data, validity = fn(*v_tables, *d_tables, dv,
+                            _np.int64(n), _np.uint32(ch.idx_width),
+                            iota_cap, _np.arange(nvcap, dtype=_np.int64))
+    else:
+        if ch.bool_plain:
+            parts = [_plain_values(b, "BOOLEAN", c) for b, c in ch.bool_plain]
+            dense = _np.concatenate(parts) if parts \
+                else _np.zeros(0, _np.bool_)
+        else:
+            blob = b"".join(ch.plain_parts)
+            d_ = _np.dtype(npdt)
+            if d_.kind == "f":
+                phys = "FLOAT" if d_.itemsize == 4 else "DOUBLE"
+            else:  # ints + date32/timestamp storage types
+                phys = "INT32" if d_.itemsize == 4 else "INT64"
+            count = len(blob) // _np.dtype(_NP_BY_PHYS[phys]).itemsize
+            dense = _plain_values(blob, phys, count)
+        dense = _np.pad(dense, (0, _pow2(max(1, len(dense))) - len(dense)))
+        fn = cached_jit(f"pq_plain|{npdt_str}",
+                        _plain_kernel_builder(npdt_str))
+        data, validity = fn(*v_tables, dense, _np.int64(n), iota_cap)
+    return DeviceColumn(data, validity, out_dtype, None)
+
+
+def decode_row_group(raw: bytes, pf_metadata, rg: int, arrow_schema,
+                     columns: List[str], min_bucket: int):
+    """Decode one row group into a DeviceTable; per-column fallback to
+    pyarrow host decode + upload for unsupported chunks. Returns
+    (DeviceTable, n_device_decoded_columns)."""
+    from ..columnar.device import DeviceTable, bucket_rows
+    rg_meta = pf_metadata.row_group(rg)
+    n = rg_meta.num_rows
+    cap = bucket_rows(max(n, 1), min_bucket)
+    name_to_ci = {pf_metadata.schema.column(i).path: i
+                  for i in range(pf_metadata.num_columns)}
+    cols = {}
+    fallback: List[str] = []
+    n_device = 0
+    for name in columns:
+        ci = name_to_ci.get(name)
+        field = arrow_schema.field(name)
+        col_meta = rg_meta.column(ci) if ci is not None else None
+        if col_meta is None or not chunk_supported(col_meta, field):
+            fallback.append(name)
+            continue
+        try:
+            ch = _parse_chunk(raw, col_meta, field.nullable)
+            if ch.num_rows != n:
+                raise UnsupportedChunk("row count mismatch")
+            cols[name] = _decode_column_device(
+                ch, _arrow_to_dtype(field.type), cap)
+            n_device += 1
+        except UnsupportedChunk:
+            fallback.append(name)
+    if fallback:
+        # per-column host decode for the leftovers (reference: the plugin
+        # likewise keeps unsupported columns on the CPU decode path)
+        import io as _io
+
+        import pyarrow.parquet as pq
+
+        from ..columnar.host import HostTable
+        t = pq.ParquetFile(_io.BytesIO(raw)).read_row_group(
+            rg, columns=fallback)
+        ht = HostTable.from_arrow(t)
+        host_dt = DeviceTable.from_host(ht, min_bucket, capacity=cap)
+        for cname, c in zip(host_dt.names, host_dt.columns):
+            cols[cname] = c
+    import jax.numpy as jnp
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    mask = iota < n
+    ordered = tuple(cols[c] for c in columns)
+    return (DeviceTable(ordered, mask, jnp.int32(n), tuple(columns)),
+            n_device)

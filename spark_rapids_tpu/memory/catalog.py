@@ -33,6 +33,12 @@ OOM_SPILL_ENABLED = register_conf(
     "Spill lowest-priority buffers when the device budget is exceeded "
     "(reference: DeviceMemoryEventHandler).", True)
 
+DEVICE_POOL_MAX_FRACTION = register_conf(
+    "spark.rapids.memory.gpu.maxAllocFraction",
+    "Upper bound on the fraction of device HBM the spillable pool may "
+    "claim (reference: RapidsConf RMM_ALLOC_MAX_FRACTION).", 1.0,
+    conf_type=float)
+
 MEMORY_DEBUG = register_conf(
     "spark.rapids.tpu.memory.debug",
     "Sanitizer mode for the buffer catalog (reference: RMM debug allocator / "
@@ -65,7 +71,14 @@ class BufferCatalog:
                  disk_dir: Optional[str] = None):
         conf = conf or RapidsConf()
         if device_limit is None:
-            device_limit = conf.get(DEVICE_POOL_BYTES) or _device_memory_bytes()
+            device_limit = conf.get(DEVICE_POOL_BYTES)
+            if not device_limit:
+                # pool = allocFraction of detected HBM, capped by
+                # maxAllocFraction (reference: GpuDeviceManager pool sizing)
+                from ..conf import DEVICE_POOL_FRACTION
+                frac = float(conf.get(DEVICE_POOL_FRACTION))
+                frac = min(frac, float(conf.get(DEVICE_POOL_MAX_FRACTION)))
+                device_limit = int(_device_memory_bytes() * frac)
         from ..conf import HOST_SPILL_STORAGE_SIZE
         if host_limit is None:
             host_limit = conf.get(HOST_SPILL_STORAGE_SIZE)

@@ -81,6 +81,18 @@ AQE_SKEW_THRESHOLD = register_conf(
     "Minimum bytes for a partition to be considered skewed.",
     256 * 1024 * 1024)
 
+AQE_RUNTIME_FILTER = register_conf(
+    "spark.rapids.tpu.aqe.runtimeFilter.enabled",
+    "When a join demotes to broadcast, push the build side's distinct join "
+    "keys into the probe side's scan as an IN filter (the dynamic-partition-"
+    "pruning / GpuSubqueryBroadcastExec analogue: the reader skips row "
+    "groups whose statistics exclude every build key).", True)
+
+AQE_RUNTIME_FILTER_MAX_KEYS = register_conf(
+    "spark.rapids.tpu.aqe.runtimeFilter.maxKeys",
+    "Skip the runtime IN-filter when the build side has more distinct keys "
+    "than this.", 10_000)
+
 
 class PartitionStats:
     """Per-partition rows/bytes of a materialized stage (the
@@ -125,9 +137,13 @@ class ShuffleStageExec(PhysicalPlan):
         return self.inner.num_partitions
 
     def execute(self, pidx: int) -> Iterator[HostTable]:
+        from ..io.file_block import clear_input_file
+        clear_input_file()  # stage output crossed a shuffle
         yield from self.inner.execute(pidx)
 
     def execute_columnar(self, pidx: int):
+        from ..io.file_block import clear_input_file
+        clear_input_file()
         yield from self.inner.execute_columnar(pidx)
 
     def node_desc(self) -> str:
@@ -454,6 +470,13 @@ class AdaptiveExec(PhysicalPlan):
                 self.events.append(
                     f"demoted {node.how} join to broadcast (build side "
                     f"{node.right.stats.total_bytes}B <= {threshold}B)")
+                if node.how in ("inner", "left_semi") \
+                        and self.conf.get(AQE_RUNTIME_FILTER):
+                    # dynamic filter (GpuSubqueryBroadcastExec/DPP analogue):
+                    # probe rows whose key is absent from the build side can
+                    # never join; the reader prunes them by statistics
+                    self._push_runtime_filter(probe, node.left_keys,
+                                              node.right, node.right_keys)
                 return CpuBroadcastHashJoinExec(
                     probe, node.right, node.left_keys, node.right_keys,
                     node.how, node.condition, node.merge_keys)
@@ -481,6 +504,64 @@ class AdaptiveExec(PhysicalPlan):
             return node
 
         return rewrite(plan)
+
+    def _push_runtime_filter(self, probe: PhysicalPlan, lkeys, build_stage,
+                             rkeys) -> None:
+        """Push the build side's distinct keys into probe-side scans as an
+        IN filter — only through nodes that provably preserve the key
+        column (filters and identity projections)."""
+        from ..expr.base import AttributeReference
+        from .physical import CpuFilterExec, CpuProjectExec, CpuScanExec
+        max_keys = self.conf.get(AQE_RUNTIME_FILTER_MAX_KEYS)
+
+        def scan_for(node, key):
+            """The scan below ``node`` if every step preserves ``key``."""
+            if isinstance(node, CpuScanExec):
+                return node if hasattr(node.source, "push_filter") else None
+            if isinstance(node, CpuFilterExec):
+                return scan_for(node.child, key)
+            if isinstance(node, CpuProjectExec):
+                for e, n in zip(node.exprs, node.names):
+                    if n == key:
+                        inner = e.child if type(e).__name__ == "Alias" else e
+                        if isinstance(inner, AttributeReference) \
+                                and inner.column_name == key:
+                            return scan_for(node.child, key)
+                        return None
+                return None
+            return None
+
+        import numpy as _np
+        for lk, rk in zip(lkeys, rkeys):
+            scan = scan_for(probe, lk)
+            if scan is None:
+                continue
+            values = set()
+            too_many = False
+            for p in range(build_stage.num_partitions):
+                if too_many:
+                    break
+                for ht in build_stage.execute(p):
+                    col = ht.column(rk)
+                    uniq = _np.unique(col.values[col.valid_mask()])
+                    values.update(uniq.tolist())
+                    if len(values) > max_keys:
+                        too_many = True  # this key only; try the next pair
+                        break
+            if too_many or not values:
+                continue
+            try:
+                import copy
+
+                import pyarrow.dataset as pads
+                src = copy.copy(scan.source)
+                src.push_filter(pads.field(lk).isin(sorted(values)))
+                scan.source = src
+                self.events.append(
+                    f"pushed runtime IN-filter on {lk} "
+                    f"({len(values)} keys) into probe scan")
+            except Exception:
+                return  # best-effort; the join itself is unaffected
 
     # -- rule: skew split -----------------------------------------------------
     def _apply_skew(self, plan: PhysicalPlan) -> PhysicalPlan:

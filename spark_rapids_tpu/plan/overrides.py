@@ -258,6 +258,12 @@ def _register_misc_rules():
                 meta.cannot_run("xxhash64 over strings runs on host only")
     register_expr_rule(H.XxHash64, _hashable, tag_fn=tag_xx)
     register_expr_rule(H.SparkPartitionID, _device_all)
+    for cls in (H.InputFileName, H.InputFileBlockStart,
+                H.InputFileBlockLength):
+        register_expr_rule(
+            cls, TypeSig.none(),
+            note="host-only: reads the per-batch input-file holder "
+                 "(InputFileBlockRule keeps the PERFILE reader selected)")
     register_expr_rule(H.MonotonicallyIncreasingID, _device_all)
     register_expr_rule(H.Rand, _device_all,
                        note="non-deterministic: sequence differs from Spark "
@@ -312,6 +318,34 @@ def _register_exec_rules():
         CpuRangeExec, _device_all,
         lambda p, ch, conf: TpuRangeExec(p.start, p.end, p.step, p.num_partitions,
                                          conf.min_bucket_rows))
+
+    # parquet scans decode ON DEVICE (io/parquet_device.py kernels) when the
+    # source qualifies; other sources and pushed-filter scans stay on the
+    # host reader (reference: GpuFileSourceScanExec + GpuParquetScanBase)
+    from ..exec.scan import TpuParquetScanExec
+    from .physical import CpuScanExec
+
+    def tag_scan(meta, conf):
+        from ..io.parquet import ParquetSource
+        from ..io.parquet_device import PARQUET_DEVICE_DECODE
+        p: CpuScanExec = meta.plan
+        if not isinstance(p.source, ParquetSource):
+            meta.cannot_run(f"{p.source.name()} decodes host-side "
+                            "(only parquet has a device decoder)")
+            return
+        if not conf.get(PARQUET_DEVICE_DECODE):
+            meta.cannot_run("device parquet decode disabled by "
+                            "spark.rapids.tpu.parquet.deviceDecode.enabled")
+            return
+        if p.source.filter_expr is not None:
+            meta.cannot_run("pushed filter uses the host reader's "
+                            "row-group statistics pruning")
+
+    register_exec_rule(
+        CpuScanExec, _device_all,
+        lambda p, ch, conf: TpuParquetScanExec(
+            p.source, p.columns, p.schema, conf.min_bucket_rows),
+        tag_fn=tag_scan)
 
     register_exec_rule(
         CpuUnionExec, _device_all,

@@ -39,6 +39,12 @@ __all__ = [
 
 DEFAULT_BATCH_ROWS = 1 << 20
 
+#: every attribute a physical node may hold expressions in — the single
+#: source of truth for expression walkers (planner InputFileBlockRule,
+#: session conf-binding); extend HERE when adding a new expression slot
+PLAN_EXPR_ATTRS = ("exprs", "condition", "projections", "orders",
+                   "window_cols", "aggregates")
+
 
 class PhysicalPlan:
     children: Tuple["PhysicalPlan", ...] = ()
@@ -204,6 +210,8 @@ class CpuRangeExec(PhysicalPlan):
         return self._parts
 
     def execute(self, pidx: int) -> Iterator[HostTable]:
+        from ..io.file_block import clear_input_file
+        clear_input_file()  # generated rows have no source file
         total = max(0, math.ceil((self.end - self.start) / self.step))
         per = math.ceil(total / self._parts) if total else 0
         lo = pidx * per
@@ -629,6 +637,10 @@ class ShuffleExchangeExec(PhysicalPlan):
 
     def execute(self, pidx: int) -> Iterator[HostTable]:
         self._materialize()
+        # rows of a shuffled partition come from many input files: file
+        # attribution ends here (Spark: input_file_name() is "" post-shuffle)
+        from ..io.file_block import clear_input_file
+        clear_input_file()
         yield from self._materialized[pidx]
 
     def node_desc(self):

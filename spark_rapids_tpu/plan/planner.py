@@ -39,7 +39,56 @@ __all__ = ["plan_physical", "SHUFFLE_PARTITIONS"]
 
 
 def plan_physical(logical: LogicalPlan, conf: RapidsConf) -> PhysicalPlan:
-    return _plan(logical, conf, required=None)
+    plan = _plan(logical, conf, required=None)
+    _apply_input_file_block_rule(plan)
+    return plan
+
+
+def _walk_plan_exprs(plan):
+    from .physical import PLAN_EXPR_ATTRS
+    for attr in PLAN_EXPR_ATTRS:
+        v = getattr(plan, attr, None)
+        if v is None:
+            continue
+        stack = [v]
+        while stack:
+            x = stack.pop()
+            if isinstance(x, (list, tuple)):
+                stack.extend(x)
+            elif hasattr(x, "expr"):       # SortOrder / (name, expr) pairs
+                stack.append(x.expr)
+            elif hasattr(x, "children"):   # Expression
+                yield x
+                stack.extend(x.children)
+    for c in plan.children:
+        yield from _walk_plan_exprs(c)
+
+
+def _apply_input_file_block_rule(plan: PhysicalPlan) -> None:
+    """InputFileBlockRule analogue (reference: InputFileBlockRule.scala):
+    input_file_name()/block_start()/block_length() need per-file batch
+    attribution, which the COALESCING parquet reader loses by stitching
+    files together — switch affected scans to the PERFILE reader."""
+    from ..expr.hashing import (InputFileBlockLength, InputFileBlockStart,
+                                InputFileName)
+    has_file_expr = any(isinstance(
+        e, (InputFileName, InputFileBlockStart, InputFileBlockLength))
+        for e in _walk_plan_exprs(plan))
+    if not has_file_expr:
+        return
+    import copy
+
+    def fix(node):
+        if isinstance(node, CpuScanExec) \
+                and getattr(node.source, "reader_type", None) \
+                not in (None, "PERFILE"):
+            src = copy.copy(node.source)
+            src.reader_type = "PERFILE"
+            node.source = src
+        for c in node.children:
+            fix(c)
+
+    fix(plan)
 
 
 def _plan(node: LogicalPlan, conf: RapidsConf,

@@ -116,7 +116,7 @@ class TpuSession:
         # session plans meanwhile
         TpuSession._active = self
         cpu = plan_physical(logical, self.conf)
-        _bind_conf_exprs(cpu, self.conf)
+        _bind_conf_exprs(cpu, self.conf, self, device)
         use_device = self.conf.is_sql_enabled if device is None else device
         if self.conf.is_explain_only:
             # reference: spark.rapids.sql.mode=explainOnly (RapidsConf.scala:515)
@@ -607,17 +607,24 @@ def _walk_expr(e):
         yield from _walk_expr(c)
 
 
-def _bind_conf_exprs(plan, conf) -> None:
+def _bind_conf_exprs(plan, conf, session=None, device=None) -> None:
     """Freeze conf-dependent expression semantics into the plan at planning
     time (spark.sql.mapKeyDedupPolicy today): evaluation must not re-read
-    the active session, which can change before a lazy iterator drains."""
+    the active session, which can change before a lazy iterator drains.
+    Scalar subqueries execute here too (driver-side, before the main
+    query — reference: ExecSubqueryExpression / GpuScalarSubquery)."""
     from .expr.collections import MAP_KEY_DEDUP_POLICY, CreateMap
+    from .expr.subquery import ScalarSubquery
 
     policy = str(conf.get(MAP_KEY_DEDUP_POLICY)).upper()
 
     def bind(e):
         if not isinstance(e, Expression):
             return e
+        if isinstance(e, ScalarSubquery):
+            if session is None:
+                raise RuntimeError("scalar subquery outside a session")
+            return e.to_literal(session, device)
         if e.children:
             new = [bind(c) for c in e.children]
             if any(n is not o for n, o in zip(new, e.children)):
@@ -643,9 +650,9 @@ def _bind_conf_exprs(plan, conf) -> None:
             return v
         return v
 
+    from .plan.physical import PLAN_EXPR_ATTRS
     for node in _walk_plan(plan):
-        for attr in ("exprs", "condition", "projections", "orders",
-                     "window_cols", "aggregates"):
+        for attr in PLAN_EXPR_ATTRS:
             v = getattr(node, attr, None)
             if v is not None:
                 setattr(node, attr, bind_any(v))

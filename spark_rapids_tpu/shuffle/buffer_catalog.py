@@ -48,6 +48,10 @@ class ShuffleBufferCatalog:
         with self._lock:
             return [k for k in self._blocks if k[0] == shuffle_id]
 
+    def shuffle_ids(self) -> List[int]:
+        with self._lock:
+            return sorted({k[0] for k in self._blocks})
+
     def remove_shuffle(self, shuffle_id: int) -> int:
         """Close every block of a finished shuffle (unregisterShuffle)."""
         with self._lock:

@@ -184,7 +184,7 @@ class ShuffleManager:
 
     def unregister_all(self) -> None:
         """Executor shutdown: free every cached shuffle block."""
-        for sid in {k[0] for k in list(self.buffer_catalog._blocks)}:
+        for sid in self.buffer_catalog.shuffle_ids():
             self.buffer_catalog.remove_shuffle(sid)
 
     # -- write side -----------------------------------------------------------

@@ -23,7 +23,6 @@ __all__ = ["validate", "report"]
 # Cpu execs that intentionally have no device rule, with the documented
 # reason (the reference likewise documents known-unsupported operators).
 KNOWN_HOST_ONLY_EXECS: Dict[str, str] = {
-    "CpuScanExec": "scans decode host-side by design (SURVEY §7.5)",
     "CpuGenerateExec": "explode lowers through plan/generate.py host path "
                        "with a device Expand for array columns",
     "PhysicalPlan": "abstract base",

@@ -12,15 +12,15 @@ def test_report_accounts_for_every_exec():
     r = report()
     assert "violations: 0" in r
     assert "MISSING" not in r
-    # the one documented host-only exec appears with its reason
-    assert "CpuScanExec" in r and "host-side by design" in r
+    # a documented host-only exec appears with its reason
+    assert "CpuGenerateExec" in r and "host path" in r
 
 
 def test_detects_unregistered_exec():
     """A Cpu exec with no rule and no documented reason is a violation."""
-    removed = KNOWN_HOST_ONLY_EXECS.pop("CpuScanExec")
+    removed = KNOWN_HOST_ONLY_EXECS.pop("CpuGenerateExec")
     try:
         v = validate()
-        assert any("CpuScanExec" in x for x in v), v
+        assert any("CpuGenerateExec" in x for x in v), v
     finally:
-        KNOWN_HOST_ONLY_EXECS["CpuScanExec"] = removed
+        KNOWN_HOST_ONLY_EXECS["CpuGenerateExec"] = removed

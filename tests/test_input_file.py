@@ -1,0 +1,107 @@
+"""input_file_name()/input_file_block_start()/length() tests
+(reference: GpuInputFileName + InputFileBlockRule.scala — the rule forces
+the PERFILE reader because coalesced batches lose file attribution)."""
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.session import TpuSession
+from spark_rapids_tpu.expr.functions import (col, input_file_block_length,
+                                             input_file_block_start,
+                                             input_file_name)
+
+
+@pytest.fixture
+def files(tmp_path):
+    paths = []
+    for i in range(3):
+        t = pa.table({"k": np.arange(i * 10, (i + 1) * 10, dtype=np.int64)})
+        p = str(tmp_path / f"part-{i}.parquet")
+        pq.write_table(t, p)
+        paths.append(p)
+    return paths
+
+
+def _sess(**extra):
+    conf = {"spark.rapids.tpu.shuffle.mode": "host"}
+    conf.update(extra)
+    return TpuSession(conf)
+
+
+def test_input_file_name_per_file(files):
+    sess = _sess()
+    df = _sess().read_parquet(files)
+    q = df.select(col("k"), input_file_name().alias("f"))
+    for device in (False, True):
+        out = q.collect(device=device).to_pandas()
+        assert len(out) == 30
+        for _, row in out.iterrows():
+            expected_file = files[int(row.k) // 10]
+            assert row.f == expected_file, (device, row.k, row.f)
+
+
+def test_input_file_block_fields(files):
+    df = _sess().read_parquet(files[0])
+    q = df.select(input_file_name().alias("f"),
+                  input_file_block_start().alias("s"),
+                  input_file_block_length().alias("l"))
+    out = q.collect(device=False)
+    assert set(out.column("f").to_pylist()) == {files[0]}
+    assert set(out.column("s").to_pylist()) == {0}
+    assert set(out.column("l").to_pylist()) == {os.path.getsize(files[0])}
+
+
+def test_rule_forces_perfile_reader(files):
+    """COALESCING would merge the three files into one batch; the
+    InputFileBlockRule analogue must switch the scan to PERFILE."""
+    sess = _sess(**{"spark.rapids.sql.format.parquet.reader.type":
+                    "COALESCING"})
+    df = sess.read_parquet(files)
+    q = df.select(col("k"), input_file_name().alias("f"))
+    plan = sess._physical(q.logical, False)
+    text = plan.tree_string()
+    assert "PERFILE" in text, text
+    out = q.collect(device=False).to_pandas()
+    assert all(out.f[i] == files[int(out.k[i]) // 10]
+               for i in range(len(out)))
+    # without the file expr the reader choice is untouched
+    plan2 = sess._physical(df.select("k").logical, False)
+    assert "COALESCING" in plan2.tree_string()
+
+
+def test_in_memory_source_yields_empty_name():
+    sess = _sess()
+    df = sess.create_dataframe(pa.table({"a": [1, 2, 3]}))
+    out = df.select(input_file_name().alias("f")).collect(device=False)
+    assert out.column("f").to_pylist() == ["", "", ""]
+
+
+def test_post_shuffle_attribution_is_cleared(files):
+    """Rows of a shuffled partition come from many files: Spark's
+    input_file_name() returns "" after an exchange, and so does ours."""
+    sess = _sess(**{"spark.rapids.tpu.shuffle.partitions": 4})
+    df = sess.read_parquet(files)
+    q = df.group_by("k").count().select(input_file_name().alias("f"))
+    for device in (False, True):
+        out = q.collect(device=device)
+        assert set(out.column("f").to_pylist()) == {""}, (device, out)
+
+
+def test_range_source_has_no_file(files):
+    sess = _sess()
+    # poison the holder via a prior scan, then read from range
+    list(sess.read_parquet(files[0]).collect(device=False).column("k"))
+    out = sess.range(5).select(input_file_name().alias("f")) \
+        .collect(device=False)
+    assert set(out.column("f").to_pylist()) == {""}
+
+
+def test_filter_on_input_file_name(files):
+    sess = _sess()
+    df = sess.read_parquet(files)
+    q = df.filter(input_file_name() == files[1]).select("k")
+    out = sorted(q.collect(device=False).column("k").to_pylist())
+    assert out == list(range(10, 20))

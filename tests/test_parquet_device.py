@@ -1,0 +1,152 @@
+"""Device parquet decode tests (reference: GpuParquetScanBase.scala:995,1194
+device decode; this path is io/parquet_thrift.py + io/parquet_device.py +
+exec/scan.py TpuParquetScanExec)."""
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.session import TpuSession
+from spark_rapids_tpu.expr.functions import col, sum as f_sum
+
+from harness import assert_tables_equal, assert_tpu_cpu_equal
+
+
+def _write(tmp_path, n=4000, codec="snappy", use_dictionary=True,
+           row_group_size=1500, nulls=True, with_strings=True):
+    rng = np.random.default_rng(7)
+    data = {
+        "i64": pa.array(rng.integers(-10**12, 10**12, n), type=pa.int64()),
+        "i32": pa.array(rng.integers(-2**30, 2**30, n).astype(np.int32)),
+        "f64": pa.array(rng.normal(size=n)),
+        "f32": pa.array(rng.normal(size=n).astype(np.float32)),
+        "b": pa.array(rng.integers(0, 2, n).astype(bool)),
+        "lowcard": pa.array(rng.integers(0, 40, n), type=pa.int64()),
+        "date": pa.array(rng.integers(0, 20000, n).astype(np.int32)).cast(
+            pa.date32()),
+        "ts": pa.array(rng.integers(0, 2**48, n), type=pa.int64()).cast(
+            pa.timestamp("us")),
+    }
+    if with_strings:
+        data["s"] = pa.array([f"str{i % 11}" for i in range(n)])
+    t = pa.table(data)
+    if nulls:
+        cols = {}
+        for name in t.column_names:
+            mask = rng.random(n) < 0.12
+            arr = t.column(name).combine_chunks()
+            cols[name] = pa.array(arr.to_pylist(), type=arr.type, mask=mask)
+        t = pa.table(cols)
+    p = str(tmp_path / "data.parquet")
+    pq.write_table(t, p, row_group_size=row_group_size, compression=codec,
+                   use_dictionary=use_dictionary)
+    return p, t
+
+
+@pytest.fixture
+def sess():
+    return TpuSession({"spark.rapids.tpu.shuffle.mode": "host",
+                       "spark.rapids.tpu.batchRowsMinBucket": 64})
+
+
+@pytest.mark.parametrize("codec,use_dict", [("snappy", True),
+                                            ("none", False),
+                                            ("zstd", True),
+                                            ("gzip", False)])
+def test_device_scan_differential(sess, tmp_path, codec, use_dict):
+    p, t = _write(tmp_path, codec=codec, use_dictionary=use_dict)
+    df = sess.read_parquet(p)
+    dev = df.collect(device=True)
+    cpu = df.collect(device=False)
+    assert_tables_equal(dev, cpu, ignore_order=False)
+    assert_tables_equal(dev, t, ignore_order=False)
+
+
+def test_device_scan_in_plan_and_kill_switch(sess, tmp_path):
+    p, _ = _write(tmp_path)
+    df = sess.read_parquet(p)
+    plan = sess._physical(df.logical, True)
+    assert "TpuParquetScanExec" in plan.tree_string(), plan.tree_string()
+    off = TpuSession({
+        "spark.rapids.tpu.shuffle.mode": "host",
+        "spark.rapids.tpu.parquet.deviceDecode.enabled": False,
+    })
+    plan2 = off._physical(off.read_parquet(p).logical, True)
+    assert "TpuParquetScanExec" not in plan2.tree_string()
+    assert_tables_equal(off.read_parquet(p).collect(device=True),
+                        off.read_parquet(p).collect(device=False),
+                        ignore_order=False)
+
+
+def test_pushed_filter_keeps_host_reader(sess, tmp_path):
+    """Row-group statistics pruning lives in the host reader; a pushed
+    filter therefore keeps the scan there (and stays correct)."""
+    p, _ = _write(tmp_path, with_strings=False, nulls=False)
+    df = sess.read_parquet(p)
+    q = df.filter(col("i64") > 0)
+    plan = sess._physical(q.logical, True)
+    text = plan.tree_string()
+    assert "TpuParquetScanExec" not in text, text
+    assert_tpu_cpu_equal(q)
+
+
+def test_device_scan_feeds_aggregate(sess, tmp_path):
+    p, t = _write(tmp_path)
+    df = sess.read_parquet(p)
+    q = df.group_by("lowcard").agg(f_sum(col("f64")).alias("sf"))
+    out = assert_tpu_cpu_equal(q, rel_tol=1e-9)
+    pdf = t.to_pandas()
+    exp = pdf.groupby("lowcard", dropna=False).f64.sum()
+    assert out.num_rows == len(exp)
+
+
+def test_string_columns_ride_the_fallback(sess, tmp_path):
+    """Strings decode host-side per column but the scan output is still one
+    device batch; metrics record how many columns decoded on device."""
+    p, t = _write(tmp_path)
+    df = sess.read_parquet(p)
+    plan = sess._physical(df.logical, True)
+    from spark_rapids_tpu.exec.scan import TpuParquetScanExec
+
+    def find(n):
+        if isinstance(n, TpuParquetScanExec):
+            return n
+        for c in n.children:
+            r = find(c)
+            if r is not None:
+                return r
+        return None
+
+    scan = find(plan)
+    assert scan is not None
+    batches = list(scan.execute_columnar(0))
+    assert batches
+    snap = scan.metrics.snapshot()
+    # 8 of 9 columns decode on device per row group
+    assert snap.get("deviceDecodedColumns", 0) >= 8
+    got = pa.concat_tables([b.to_host().to_arrow() for b in batches])
+    assert got.column("s").to_pylist()[:5] == t.column("s").to_pylist()[:5]
+
+
+def test_column_pruning_through_device_scan(sess, tmp_path):
+    p, t = _write(tmp_path)
+    df = sess.read_parquet(p).select("i64", "f64")
+    dev = df.collect(device=True)
+    assert dev.column_names == ["i64", "f64"]
+    assert_tables_equal(dev, df.collect(device=False), ignore_order=False)
+
+
+def test_empty_and_single_row_groups(sess, tmp_path):
+    t = pa.table({"a": pa.array([], type=pa.int64()),
+                  "b": pa.array([], type=pa.float64())})
+    p = str(tmp_path / "empty.parquet")
+    pq.write_table(t, p)
+    df = sess.read_parquet(p)
+    assert df.collect(device=True).num_rows == 0
+    t2 = pa.table({"a": pa.array([42], type=pa.int64())})
+    p2 = str(tmp_path / "one.parquet")
+    pq.write_table(t2, p2)
+    out = sess.read_parquet(p2).collect(device=True)
+    assert out.column("a").to_pylist() == [42]

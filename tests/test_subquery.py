@@ -1,0 +1,119 @@
+"""Scalar subquery + runtime-filter (DPP analogue) tests (reference:
+GpuScalarSubquery / ExecSubqueryExpression and GpuSubqueryBroadcastExec)."""
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.session import TpuSession
+from spark_rapids_tpu.expr.functions import (avg, col, scalar_subquery,
+                                             sum as f_sum)
+
+from harness import assert_tables_equal, assert_tpu_cpu_equal
+
+
+@pytest.fixture
+def sess():
+    return TpuSession({"spark.rapids.tpu.shuffle.mode": "host",
+                       "spark.rapids.tpu.shuffle.partitions": 4})
+
+
+def test_scalar_subquery_in_filter(sess):
+    """TPC-H q17 shape: quantity < 0.2 * avg(quantity) — no cross join."""
+    rng = np.random.default_rng(3)
+    df = sess.create_dataframe(pd.DataFrame({
+        "q": rng.uniform(0, 100, 2000)}), num_partitions=3)
+    threshold = scalar_subquery(df.agg(avg(col("q")).alias("a")))
+    out = df.filter(col("q") < 0.2 * threshold)
+    expected = df.collect(device=False).to_pandas()
+    cut = 0.2 * expected.q.mean()
+    exp_rows = int((expected.q < cut).sum())
+    got = assert_tpu_cpu_equal(out)
+    assert got.num_rows == exp_rows
+
+
+def test_scalar_subquery_in_projection(sess):
+    df = sess.create_dataframe(pd.DataFrame({"v": [1.0, 2.0, 3.0]}))
+    total = scalar_subquery(df.agg(f_sum(col("v")).alias("s")))
+    q = df.select((col("v") / total).alias("share"))
+    out = q.collect(device=False)
+    assert out.column("share").to_pylist() == pytest.approx(
+        [1 / 6, 2 / 6, 3 / 6])
+    assert_tpu_cpu_equal(q)
+
+
+def test_scalar_subquery_empty_is_null(sess):
+    df = sess.create_dataframe(pd.DataFrame({"v": [1.0, 2.0]}))
+    empty = sess.create_dataframe(pd.DataFrame({"v": [1.0]})) \
+        .filter(col("v") > 100).select("v")
+    q = df.select((col("v") + scalar_subquery(empty)).alias("x"))
+    out = q.collect(device=False)
+    assert out.column("x").to_pylist() == [None, None]
+
+
+def test_scalar_subquery_multi_row_raises(sess):
+    df = sess.create_dataframe(pd.DataFrame({"v": [1.0, 2.0]}))
+    with pytest.raises(ValueError, match="returned 2 rows"):
+        df.select((col("v") + scalar_subquery(df.select("v"))).alias("x")) \
+            .collect(device=False)
+
+
+def test_scalar_subquery_requires_one_column(sess):
+    df = sess.create_dataframe(pd.DataFrame({"a": [1], "b": [2]}))
+    with pytest.raises(ValueError, match="exactly one column"):
+        scalar_subquery(df)
+
+
+def test_runtime_filter_pushes_build_keys_into_probe_scan(sess, tmp_path):
+    """A demoted broadcast join pushes the build side's distinct keys into
+    the probe parquet scan as an IN filter (DPP analogue)."""
+    rng = np.random.default_rng(5)
+    n = 4000
+    t = pa.table({
+        "k": pa.array(rng.integers(0, 1000, n), type=pa.int64()),
+        "v": pa.array(rng.normal(size=n)),
+    })
+    p = str(tmp_path / "probe.parquet")
+    pq.write_table(t, p, row_group_size=500)
+    s = TpuSession({
+        "spark.rapids.tpu.shuffle.mode": "host",
+        "spark.rapids.tpu.shuffle.partitions": 4,
+        "spark.rapids.tpu.autoBroadcastJoinThreshold": -1,  # force SHJ
+        "spark.rapids.tpu.aqe.autoBroadcastJoinThreshold": 1 << 20,
+    })
+    probe = s.read_parquet(p)
+    build = s.create_dataframe(pd.DataFrame({
+        "k": np.arange(5, dtype=np.int64),
+        "w": np.ones(5)}), num_partitions=2)
+    q = probe.join(build, on="k").select("k", "v", "w")
+    plan = s._physical(q.logical, True)
+    got = plan.collect().to_arrow()
+    exp = q.collect(device=False)
+    assert_tables_equal(got, exp)
+    assert any("runtime IN-filter" in e for e in plan.events), plan.events
+    pdf = t.to_pandas()
+    assert got.num_rows == int(pdf.k.isin(range(5)).sum())
+
+
+def test_runtime_filter_skipped_for_outer_join(sess, tmp_path):
+    t = pa.table({"k": pa.array(np.arange(100, dtype=np.int64)),
+                  "v": pa.array(np.ones(100))})
+    p = str(tmp_path / "probe2.parquet")
+    pq.write_table(t, p)
+    s = TpuSession({
+        "spark.rapids.tpu.shuffle.mode": "host",
+        "spark.rapids.tpu.shuffle.partitions": 4,
+        "spark.rapids.tpu.autoBroadcastJoinThreshold": -1,
+        "spark.rapids.tpu.aqe.autoBroadcastJoinThreshold": 1 << 20,
+    })
+    probe = s.read_parquet(p)
+    build = s.create_dataframe(pd.DataFrame({
+        "k": np.arange(3, dtype=np.int64), "w": np.ones(3)}),
+        num_partitions=2)
+    q = probe.join(build, on="k", how="left").select("k", "v", "w")
+    plan = s._physical(q.logical, True)
+    got = plan.collect().to_arrow()
+    # every probe row must survive the left join
+    assert got.num_rows == 100
+    assert not any("runtime IN-filter" in e for e in plan.events), plan.events
