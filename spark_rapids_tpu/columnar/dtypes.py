@@ -52,6 +52,10 @@ class DataType:
         return isinstance(self, (IntegralType, FractionalType, DecimalType))
 
     @property
+    def is_integral(self) -> bool:
+        return isinstance(self, IntegralType)
+
+    @property
     def is_nested(self) -> bool:
         return isinstance(self, (ArrayType, StructType, MapType))
 

@@ -39,21 +39,35 @@ class TpuParquetScanExec(TpuExec):
                 f"cols={self.columns or '*'}")
 
     def execute_columnar(self, pidx: int) -> Iterator[DeviceTable]:
-        import pyarrow.parquet as pq
-        from ..io.parquet_device import decode_row_group
+        from ..conf import MULTITHREAD_READ_NUM_THREADS
+        from ..io.prefetch import prefetched
         cols = self.columns or self.schema.names
+        files = self.source._file_parts[pidx]
+        nthreads = self.source.conf.get(MULTITHREAD_READ_NUM_THREADS)
+
+        def read_bytes(p):
+            with open(p, "rb") as f:
+                return f.read()
+
+        # bounded file read-ahead overlapping IO with device decode
+        # (reference: MultiFileCloudParquetPartitionReader's read pool)
+        for path, raw in prefetched(files, read_bytes, max(2, nthreads)):
+            yield from self._decode_file(path, raw, cols)
+
+    def _decode_file(self, path: str, raw: bytes,
+                     cols) -> Iterator[DeviceTable]:
+        import pyarrow.parquet as pq
+
         from ..io.file_block import set_input_file
-        for path in self.source._file_parts[pidx]:
-            with open(path, "rb") as f:
-                raw = f.read()
-            set_input_file(path, 0, len(raw))
-            pf = pq.ParquetFile(_io.BytesIO(raw))
-            for rg in range(pf.metadata.num_row_groups):
-                with self.metrics.timed(M.OP_TIME):
-                    table, n_dev = decode_row_group(
-                        raw, pf.metadata, rg, pf.schema_arrow, cols,
-                        self.min_bucket)
-                self.metrics.add(M.NUM_OUTPUT_BATCHES, 1)
-                self.metrics.add(M.NUM_OUTPUT_ROWS, int(table.num_rows))
-                self.metrics.add("deviceDecodedColumns", n_dev)
-                yield table
+        from ..io.parquet_device import decode_row_group
+        set_input_file(path, 0, len(raw))
+        pf = pq.ParquetFile(_io.BytesIO(raw))
+        for rg in range(pf.metadata.num_row_groups):
+            with self.metrics.timed(M.OP_TIME):
+                table, n_dev = decode_row_group(
+                    raw, pf.metadata, rg, pf.schema_arrow, cols,
+                    self.min_bucket)
+            self.metrics.add(M.NUM_OUTPUT_BATCHES, 1)
+            self.metrics.add(M.NUM_OUTPUT_ROWS, int(table.num_rows))
+            self.metrics.add("deviceDecodedColumns", n_dev)
+            yield table

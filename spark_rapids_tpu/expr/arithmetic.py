@@ -209,3 +209,113 @@ class Abs(Expression):
     def eval(self, ctx: EvalContext) -> EvalCol:
         c = self.child.eval(ctx)
         return EvalCol(ctx.xp.abs(c.values), c.validity, self.data_type)
+
+
+# ---------------------------------------------------------------------------
+# Bitwise expressions (reference: bitwise.scala — GpuBitwiseAnd/Or/Xor/Not,
+# GpuShiftLeft/Right/RightUnsigned). Integer-only; fully device-traceable.
+# ---------------------------------------------------------------------------
+class _BitwiseBinary(BinaryArithmetic):
+    def result_type(self, lt, rt) -> dt.DataType:
+        out = numeric_promote(lt, rt)
+        if not out.is_integral:
+            raise TypeError(f"{type(self).__name__} needs integral operands, "
+                            f"got {lt!r}, {rt!r}")
+        return out
+
+
+class BitwiseAnd(_BitwiseBinary):
+    symbol = "&"
+
+    def _compute(self, ctx, lv, rv):
+        return lv & rv, None
+
+
+class BitwiseOr(_BitwiseBinary):
+    symbol = "|"
+
+    def _compute(self, ctx, lv, rv):
+        return lv | rv, None
+
+
+class BitwiseXor(_BitwiseBinary):
+    symbol = "^"
+
+    def _compute(self, ctx, lv, rv):
+        return lv ^ rv, None
+
+
+class BitwiseNot(Expression):
+    def __init__(self, child: Expression):
+        self.child = child
+        self.children = (child,)
+
+    @property
+    def data_type(self) -> dt.DataType:
+        t = self.child.data_type
+        if not t.is_integral:
+            raise TypeError(f"bitwise_not needs an integral operand, got {t!r}")
+        return t
+
+    def with_children(self, children):
+        return BitwiseNot(children[0])
+
+    def eval(self, ctx):
+        c = self.child.eval(ctx)
+        return EvalCol(~c.values, c.validity, self.data_type)
+
+    def __repr__(self):
+        return f"~{self.child!r}"
+
+
+class _ShiftBase(Expression):
+    """Shift amount masks to the value width like Java/Spark (x << 65 on a
+    long shifts by 1)."""
+
+    def __init__(self, left: Expression, right: Expression):
+        self.left, self.right = left, right
+        self.children = (left, right)
+
+    @property
+    def data_type(self) -> dt.DataType:
+        t = self.left.data_type
+        if t not in (dt.INT, dt.LONG):
+            raise TypeError(f"shift needs int/bigint value, got {t!r}")
+        return t
+
+    def with_children(self, children):
+        return type(self)(children[0], children[1])
+
+    def _width_mask(self):
+        return 63 if self.left.data_type == dt.LONG else 31
+
+    def eval(self, ctx):
+        xp = ctx.xp
+        lc = self.left.eval(ctx)
+        rc = self.right.eval(ctx)
+        sh = (rc.values & self._width_mask()).astype(lc.values.dtype)
+        vals = self._shift(xp, lc.values, sh)
+        validity = lc.validity
+        if rc.validity is not None:
+            validity = rc.validity if validity is None \
+                else xp.logical_and(validity, rc.validity)
+        return EvalCol(vals, validity, self.data_type)
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.left!r}, {self.right!r})"
+
+
+class ShiftLeft(_ShiftBase):
+    def _shift(self, xp, v, sh):
+        return v << sh
+
+
+class ShiftRight(_ShiftBase):
+    def _shift(self, xp, v, sh):
+        return v >> sh  # arithmetic (sign-propagating) on signed ints
+
+
+class ShiftRightUnsigned(_ShiftBase):
+    def _shift(self, xp, v, sh):
+        u = xp.uint64 if self.left.data_type == dt.LONG else xp.uint32
+        return (v.astype(u) >> sh.astype(u)).astype(v.dtype)

@@ -89,7 +89,8 @@ class Column:
     def eq_null_safe(self, other):
         return Column(EqualNullSafe(self.expr, _to_expr(other)))
 
-    # boolean
+    # boolean (PySpark convention: &/|/~ are logical; bitwise ops are the
+    # explicit bitwiseAND/bitwiseOR/bitwiseXOR methods)
     def __and__(self, other):
         return Column(And(self.expr, _to_expr(other)))
 
@@ -98,6 +99,18 @@ class Column:
 
     def __invert__(self):
         return Column(Not(self.expr))
+
+    def bitwiseAND(self, other):
+        from .arithmetic import BitwiseAnd
+        return Column(BitwiseAnd(self.expr, _to_expr(other)))
+
+    def bitwiseOR(self, other):
+        from .arithmetic import BitwiseOr
+        return Column(BitwiseOr(self.expr, _to_expr(other)))
+
+    def bitwiseXOR(self, other):
+        from .arithmetic import BitwiseXor
+        return Column(BitwiseXor(self.expr, _to_expr(other)))
 
     # misc
     def alias(self, name: str) -> "Column":
@@ -750,6 +763,33 @@ def collect_list(c) -> Column:
 def collect_set(c) -> Column:
     from .aggregates import CollectSet
     return Column(CollectSet(_to_expr(c)))
+
+
+def get_json_object(c, path: str) -> Column:
+    """JSONPath extraction over string columns (reference: GpuGetJsonObject;
+    supports the $.field and [index] subset)."""
+    from .strings import GetJsonObject
+    return Column(GetJsonObject(_to_expr(c), Literal(path)))
+
+
+def shiftleft(c, n) -> Column:
+    from .arithmetic import ShiftLeft
+    return Column(ShiftLeft(_to_expr(c), _to_expr(n)))
+
+
+def shiftright(c, n) -> Column:
+    from .arithmetic import ShiftRight
+    return Column(ShiftRight(_to_expr(c), _to_expr(n)))
+
+
+def shiftrightunsigned(c, n) -> Column:
+    from .arithmetic import ShiftRightUnsigned
+    return Column(ShiftRightUnsigned(_to_expr(c), _to_expr(n)))
+
+
+def bitwise_not(c) -> Column:
+    from .arithmetic import BitwiseNot
+    return Column(BitwiseNot(_to_expr(c)))
 
 
 def scalar_subquery(df) -> Column:

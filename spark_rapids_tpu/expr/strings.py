@@ -1019,3 +1019,68 @@ def _device_endswith(ctx, c: EvalCol, nb: bytes):
     tail = xp.take_along_axis(c.values, idx, axis=1)
     return xp.logical_and(xp.all(tail == pat[None, :], axis=1),
                           c.lengths >= len(nb))
+
+
+class GetJsonObject(Expression):
+    """get_json_object(json, path) with the $.a.b[0] JSONPath subset
+    (reference: GpuGetJsonObject.scala; host evaluation here)."""
+
+    def __init__(self, json: Expression, path: Expression):
+        self.json, self.path = json, path
+        self.children = (json, path)
+
+    @property
+    def data_type(self):
+        return dt.STRING
+
+    def with_children(self, children):
+        return GetJsonObject(children[0], children[1])
+
+    @staticmethod
+    def _extract(doc: str, path: str):
+        import json as _json
+        import re as _re
+        if not isinstance(doc, str) or not path.startswith("$"):
+            return None
+        try:
+            cur = _json.loads(doc)
+        except Exception:
+            return None
+        for tok in _re.findall(r"\.([A-Za-z0-9_]+)|\[(\d+)\]", path):
+            key, idx = tok
+            if key:
+                if not isinstance(cur, dict) or key not in cur:
+                    return None
+                cur = cur[key]
+            else:
+                i = int(idx)
+                if not isinstance(cur, list) or i >= len(cur):
+                    return None
+                cur = cur[i]
+        if cur is None:
+            return None
+        if isinstance(cur, str):
+            return cur
+        import json as _json
+        return _json.dumps(cur, separators=(",", ":"))
+
+    def eval(self, ctx):
+        import numpy as np
+        jc = self.json.eval(ctx)
+        pv = literal_value(self.path)
+        n = len(jc.values)
+        out = np.empty(n, dtype=object)
+        validity = np.ones(n, dtype=bool)
+        jvalid = jc.validity if jc.validity is not None \
+            else np.ones(n, dtype=bool)
+        for i in range(n):
+            r = self._extract(jc.values[i], pv) if jvalid[i] and pv else None
+            if r is None:
+                validity[i] = False
+                out[i] = ""
+            else:
+                out[i] = r
+        return EvalCol(out, validity, dt.STRING)
+
+    def __repr__(self):
+        return f"get_json_object({self.json!r}, {self.path!r})"

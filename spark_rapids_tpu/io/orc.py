@@ -79,35 +79,21 @@ class OrcSource(DataSource):
 
     def read_partition(self, pidx: int, columns: Optional[List[str]] = None
                        ) -> Iterator[HostTable]:
-        from collections import deque
+        from .file_block import set_input_file
+        from .prefetch import prefetched
         nthreads = self.conf.get(MULTITHREAD_READ_NUM_THREADS)
         files = self._file_parts[pidx]
-        with cf.ThreadPoolExecutor(max_workers=nthreads) as pool:
-            # bounded prefetch window: at most nthreads decoded tables
-            # resident at once (whole-partition submission would pin every
-            # file's table until the generator drains)
-            from .file_block import set_input_file
-            pending = deque()  # (file, future) pairs keep attribution exact
-            it = iter(files)
-            for f in it:
-                pending.append((f, pool.submit(self._read_file, f, columns)))
-                if len(pending) >= nthreads:
+        # bounded read-ahead: at most nthreads decoded tables resident
+        for fname, t in prefetched(
+                files, lambda f: self._read_file(f, columns), nthreads):
+            set_input_file(fname, 0, os.path.getsize(fname))
+            pos = 0
+            while pos < t.num_rows or (pos == 0 and t.num_rows == 0):
+                yield HostTable.from_arrow(t.slice(pos, self.batch_rows))
+                pos += self.batch_rows
+                if t.num_rows == 0:
                     break
-            while pending:
-                fname, fut = pending.popleft()
-                t = fut.result()
-                set_input_file(fname, 0, os.path.getsize(fname))
-                nxt = next(it, None)
-                if nxt is not None:
-                    pending.append(
-                        (nxt, pool.submit(self._read_file, nxt, columns)))
-                pos = 0
-                while pos < t.num_rows or (pos == 0 and t.num_rows == 0):
-                    yield HostTable.from_arrow(t.slice(pos, self.batch_rows))
-                    pos += self.batch_rows
-                    if t.num_rows == 0:
-                        break
-                del t
+            del t
 
     def name(self) -> str:
         return f"ORC[{len(self.files)} files]"

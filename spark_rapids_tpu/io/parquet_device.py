@@ -90,6 +90,8 @@ class _RunTable:
         self.is_rle: List[bool] = []
         self.rle_value: List[int] = []
         self.bit_base: List[int] = []   # absolute first-bit into self.packed
+        self.width: List[int] = []      # PER-RUN bit width (pages with a
+        # growing dictionary are written at increasing widths!)
         self.packed = bytearray()
         self.total = 0
 
@@ -122,6 +124,7 @@ class _RunTable:
                 self.is_rle.append(False)
                 self.rle_value.append(0)
                 self.bit_base.append(len(self.packed) * 8)
+                self.width.append(width)
                 self.packed.extend(buf[pos:pos + nbytes])
                 pos += nbytes
                 self.total += nvals
@@ -141,6 +144,7 @@ class _RunTable:
         self.is_rle.append(True)
         self.rle_value.append(v)
         self.bit_base.append(0)
+        self.width.append(0)
         self.total += run
 
     def arrays(self) -> Tuple[np.ndarray, ...]:
@@ -156,6 +160,7 @@ class _RunTable:
                 np.asarray(self.is_rle + [True] * pad, np.bool_),
                 np.asarray(self.rle_value + [0] * pad, np.int64),
                 np.asarray(self.bit_base + [0] * pad, np.int64),
+                np.asarray(self.width + [0] * pad, np.int64),
                 packed)
 
 
@@ -203,6 +208,11 @@ def _parse_chunk(raw: bytes, col_meta, nullable: bool) -> _Chunk:
         # the column is nullable (length-prefixed RLE at bit width 1)
         n_nonnull = nvals
         if nullable:
+            if hdr.def_level_encoding != Encoding.RLE:
+                # legacy BIT_PACKED levels have no length prefix; parsing
+                # them as RLE would read garbage "plausibly"
+                raise UnsupportedChunk(
+                    f"definition-level encoding {hdr.def_level_encoding}")
             (dl_len,) = np.frombuffer(page, np.uint32, 1, p)
             p += 4
             before = ch.defs.total
@@ -282,41 +292,42 @@ def _pow2(n: int) -> int:
     return c
 
 
-def _expand_hybrid_device(out_start, is_rle, rle_value, bit_base, packed,
-                          width, iota):
+def _expand_hybrid_device(out_start, is_rle, rle_value, bit_base, widths,
+                          packed, iota):
     """values[i] for each output position in ``iota``: expand the run table
     on device (searchsorted for run id + LSB-first bit-field extraction for
-    bit-packed runs). ``width`` may be a traced scalar."""
+    bit-packed runs). ``widths`` is PER RUN — successive pages of one chunk
+    may bit-pack at different widths as the dictionary grows."""
     import jax.numpy as jnp
     i = iota.astype(jnp.int64)
     run = jnp.clip(jnp.searchsorted(out_start, i, side="right") - 1,
                    0, out_start.shape[0] - 1)
     within = i - out_start[run]
-    bit = bit_base[run] + within * width.astype(jnp.int64)
+    w = widths[run]
+    bit = bit_base[run] + within * w
     byte0 = bit >> 3
     shift = (bit & 7).astype(jnp.uint32)
     nb = packed.shape[0]
     g = lambda k: packed[jnp.clip(byte0 + k, 0, nb - 1)].astype(jnp.uint32)
     dword = g(0) | (g(1) << 8) | (g(2) << 16) | (g(3) << 24)
     # width <= 24 enforced at parse time, so 4 gathered bytes always cover
-    mask = (jnp.uint32(1) << width.astype(jnp.uint32)) - jnp.uint32(1)
+    mask = (jnp.uint32(1) << w.astype(jnp.uint32)) - jnp.uint32(1)
     bp_val = (dword >> shift) & mask
     return jnp.where(is_rle[run], rle_value[run].astype(jnp.int64),
                      bp_val.astype(jnp.int64))
 
 
 def _dict_kernel_builder(npdt_str: str):
-    def fn(v_start, v_rle, v_val, v_bit, v_packed,
-           d_start, d_rle, d_val, d_bit, d_packed, dvals,
-           n, width, iota_cap, iota_nv):
+    def fn(v_start, v_rle, v_val, v_bit, v_width, v_packed,
+           d_start, d_rle, d_val, d_bit, d_width, d_packed, dvals,
+           n, iota_cap, iota_nv):
         import jax.numpy as jnp
         validity = _expand_hybrid_device(
-            v_start, v_rle, v_val, v_bit, v_packed,
-            jnp.uint32(1), iota_cap) > 0
+            v_start, v_rle, v_val, v_bit, v_width, v_packed, iota_cap) > 0
         validity = jnp.logical_and(validity, iota_cap < n)
         pos = jnp.cumsum(validity.astype(jnp.int32)) - 1
-        idx = _expand_hybrid_device(d_start, d_rle, d_val, d_bit, d_packed,
-                                    width, iota_nv)
+        idx = _expand_hybrid_device(d_start, d_rle, d_val, d_bit, d_width,
+                                    d_packed, iota_nv)
         dense = dvals[jnp.clip(idx, 0, dvals.shape[0] - 1)]
         vals = dense[jnp.clip(pos, 0, dense.shape[0] - 1)]
         vals = jnp.where(validity, vals, jnp.zeros((), vals.dtype))
@@ -325,11 +336,11 @@ def _dict_kernel_builder(npdt_str: str):
 
 
 def _plain_kernel_builder(npdt_str: str):
-    def fn(v_start, v_rle, v_val, v_bit, v_packed, dense, n, iota_cap):
+    def fn(v_start, v_rle, v_val, v_bit, v_width, v_packed, dense, n,
+           iota_cap):
         import jax.numpy as jnp
         validity = _expand_hybrid_device(
-            v_start, v_rle, v_val, v_bit, v_packed,
-            jnp.uint32(1), iota_cap) > 0
+            v_start, v_rle, v_val, v_bit, v_width, v_packed, iota_cap) > 0
         validity = jnp.logical_and(validity, iota_cap < n)
         pos = jnp.cumsum(validity.astype(jnp.int32)) - 1
         vals = dense[jnp.clip(pos, 0, dense.shape[0] - 1)]
@@ -358,8 +369,7 @@ def _decode_column_device(ch: _Chunk, out_dtype: dt.DataType, cap: int):
         dv = _np.pad(dict_vals, (0, _pow2(len(dict_vals)) - len(dict_vals)))
         nvcap = _pow2(max(1, ch.idx.total))
         fn = cached_jit(f"pq_dict|{npdt_str}", _dict_kernel_builder(npdt_str))
-        data, validity = fn(*v_tables, *d_tables, dv,
-                            _np.int64(n), _np.uint32(ch.idx_width),
+        data, validity = fn(*v_tables, *d_tables, dv, _np.int64(n),
                             iota_cap, _np.arange(nvcap, dtype=_np.int64))
     else:
         if ch.bool_plain:
@@ -410,7 +420,11 @@ def decode_row_group(raw: bytes, pf_metadata, rg: int, arrow_schema,
             cols[name] = _decode_column_device(
                 ch, _arrow_to_dtype(field.type), cap)
             n_device += 1
-        except UnsupportedChunk:
+        except Exception:
+            # ANY decode problem (unsupported feature, codec pa.decompress
+            # can't handle — e.g. hadoop-framed LZ4 — or a parse error)
+            # falls back to the per-column host decode, never crashes the
+            # query: the host reader is the always-correct tier
             fallback.append(name)
     if fallback:
         # per-column host decode for the leftovers (reference: the plugin

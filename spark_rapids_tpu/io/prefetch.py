@@ -1,0 +1,41 @@
+"""Bounded read-ahead over an ordered work list.
+
+Shared by the file scanners (reference: the multithreaded readers'
+read-pool pipelining, GpuMultiFileReader.scala:934): submit up to
+``window`` items to a thread pool, yield results in ORDER as
+``(item, result)`` pairs, and keep the window full as items complete.
+Bounding the window caps resident decoded data (a whole-partition submit
+would pin every file's result until the consumer drains).
+"""
+from __future__ import annotations
+
+import concurrent.futures as cf
+from collections import deque
+from typing import Callable, Iterable, Iterator, Tuple, TypeVar
+
+__all__ = ["prefetched"]
+
+T = TypeVar("T")
+R = TypeVar("R")
+
+
+def prefetched(items: Iterable[T], fn: Callable[[T], R],
+               window: int) -> Iterator[Tuple[T, R]]:
+    items = list(items)
+    if not items:
+        return
+    window = max(1, window)
+    with cf.ThreadPoolExecutor(max_workers=window) as pool:
+        pending: deque = deque()  # (item, future): pairing stays exact
+        it = iter(items)
+        for x in it:
+            pending.append((x, pool.submit(fn, x)))
+            if len(pending) >= window:
+                break
+        while pending:
+            item, fut = pending.popleft()
+            result = fut.result()
+            nxt = next(it, None)
+            if nxt is not None:
+                pending.append((nxt, pool.submit(fn, nxt)))
+            yield item, result

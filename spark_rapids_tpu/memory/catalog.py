@@ -33,6 +33,13 @@ OOM_SPILL_ENABLED = register_conf(
     "Spill lowest-priority buffers when the device budget is exceeded "
     "(reference: DeviceMemoryEventHandler).", True)
 
+DISK_SPILL_DIRECT = register_conf(
+    "spark.rapids.tpu.memory.disk.direct",
+    "Restore disk-spilled buffers through read-only memory maps so the "
+    "device upload streams straight from the file (the GPUDirect-Storage "
+    "analogue; reference: RapidsGdsStore). false uses compact npz files.",
+    True)
+
 DEVICE_POOL_MAX_FRACTION = register_conf(
     "spark.rapids.memory.gpu.maxAllocFraction",
     "Upper bound on the fraction of device HBM the spillable pool may "
@@ -84,7 +91,7 @@ class BufferCatalog:
             host_limit = conf.get(HOST_SPILL_STORAGE_SIZE)
         self.device = DeviceStore(device_limit)
         self.host = HostStore(host_limit)
-        self.disk = DiskStore(disk_dir)
+        self.disk = DiskStore(disk_dir, direct=bool(conf.get(DISK_SPILL_DIRECT)))
         self._buffers: Dict[int, StoredTable] = {}
         # persistent device-tier spill queue (reference: RapidsBufferStore's
         # HashedPriorityQueue — O(log n) membership updates instead of

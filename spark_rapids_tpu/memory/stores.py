@@ -63,8 +63,10 @@ def _host_arrays_to_table(arrays: dict, meta: dict) -> DeviceTable:
         cols.append(DeviceColumn(jnp.asarray(arrays[f"data{i}"]),
                                  jnp.asarray(arrays[f"validity{i}"]),
                                  d, lengths))
+    # num_rows must restore as a 0-d scalar (memory-mapped .npy loads
+    # promote 0-d arrays to shape (1,))
     return DeviceTable(tuple(cols), jnp.asarray(arrays["row_mask"]),
-                       jnp.asarray(arrays["num_rows"]),
+                       jnp.asarray(arrays["num_rows"]).reshape(()),
                        tuple(meta["names"]))
 
 
@@ -121,28 +123,62 @@ class HostStore:
 
 
 class DiskStore:
-    """Disk tier (reference: RapidsDiskStore + RapidsDiskBlockManager)."""
+    """Disk tier (reference: RapidsDiskStore + RapidsDiskBlockManager).
 
-    def __init__(self, directory: Optional[str] = None):
+    ``direct`` mode is the GDS (GPUDirect Storage) analogue: each array is a
+    raw ``.npy`` restored as a read-only memory map, so the device upload
+    streams pages file -> transfer buffer without materializing a heap copy
+    — the closest a host runtime gets to storage->accelerator DMA. Non-
+    direct mode keeps the compact one-file ``.npz`` layout."""
+
+    def __init__(self, directory: Optional[str] = None, direct: bool = True):
         self.dir = directory or tempfile.mkdtemp(prefix="srt_spill_")
+        self.direct = direct
         os.makedirs(self.dir, exist_ok=True)
         self.used_bytes = 0
 
     def put(self, stored: StoredTable):
         assert stored.host_arrays is not None
-        path = os.path.join(self.dir, f"buf{stored.buffer_id}.npz")
-        np.savez(path, **stored.host_arrays)
-        stored.disk_path = path
+        if self.direct:
+            d = os.path.join(self.dir, f"buf{stored.buffer_id}")
+            os.makedirs(d, exist_ok=True)
+            size = 0
+            for k, arr in stored.host_arrays.items():
+                fp = os.path.join(d, f"{k}.npy")
+                np.save(fp, np.ascontiguousarray(arr))
+                size += os.path.getsize(fp)
+            stored.disk_path = d
+        else:
+            path = os.path.join(self.dir, f"buf{stored.buffer_id}.npz")
+            np.savez(path, **stored.host_arrays)
+            stored.disk_path = path
+            size = os.path.getsize(path)
         stored.host_arrays = None
         stored.tier = StorageTier.DISK
-        self.used_bytes += os.path.getsize(path)
+        self.used_bytes += size
 
     def load(self, stored: StoredTable) -> dict:
+        if os.path.isdir(stored.disk_path):
+            out = {}
+            for fn in os.listdir(stored.disk_path):
+                out[fn[:-4]] = np.load(os.path.join(stored.disk_path, fn),
+                                       mmap_mode="r", allow_pickle=False)
+            return out
         with np.load(stored.disk_path, allow_pickle=False) as z:
             return {k: z[k] for k in z.files}
 
+    def _size_of(self, path: str) -> int:
+        if os.path.isdir(path):
+            return sum(os.path.getsize(os.path.join(path, f))
+                       for f in os.listdir(path))
+        return os.path.getsize(path)
+
     def drop(self, stored: StoredTable):
         if stored.disk_path and os.path.exists(stored.disk_path):
-            self.used_bytes -= os.path.getsize(stored.disk_path)
-            os.unlink(stored.disk_path)
+            self.used_bytes -= self._size_of(stored.disk_path)
+            if os.path.isdir(stored.disk_path):
+                import shutil
+                shutil.rmtree(stored.disk_path, ignore_errors=True)
+            else:
+                os.unlink(stored.disk_path)
         stored.disk_path = None

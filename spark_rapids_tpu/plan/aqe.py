@@ -532,36 +532,41 @@ class AdaptiveExec(PhysicalPlan):
             return None
 
         import numpy as _np
-        for lk, rk in zip(lkeys, rkeys):
-            scan = scan_for(probe, lk)
-            if scan is None:
-                continue
-            values = set()
-            too_many = False
-            for p in range(build_stage.num_partitions):
-                if too_many:
-                    break
-                for ht in build_stage.execute(p):
+        candidates = [(lk, rk, scan_for(probe, lk))
+                      for lk, rk in zip(lkeys, rkeys)]
+        candidates = [(lk, rk, s) for lk, rk, s in candidates if s is not None]
+        if not candidates:
+            return
+        # ONE pass over the build stage collects every key column's values
+        values = {lk: set() for lk, _, _ in candidates}
+        live = {lk for lk, _, _ in candidates}
+        for p in range(build_stage.num_partitions):
+            if not live:
+                break
+            for ht in build_stage.execute(p):
+                for lk, rk, _ in candidates:
+                    if lk not in live:
+                        continue
                     col = ht.column(rk)
                     uniq = _np.unique(col.values[col.valid_mask()])
-                    values.update(uniq.tolist())
-                    if len(values) > max_keys:
-                        too_many = True  # this key only; try the next pair
-                        break
-            if too_many or not values:
+                    values[lk].update(uniq.tolist())
+                    if len(values[lk]) > max_keys:
+                        live.discard(lk)  # this key only; others continue
+        for lk, rk, scan in candidates:
+            if lk not in live or not values[lk]:
                 continue
             try:
                 import copy
 
                 import pyarrow.dataset as pads
                 src = copy.copy(scan.source)
-                src.push_filter(pads.field(lk).isin(sorted(values)))
+                src.push_filter(pads.field(lk).isin(sorted(values[lk])))
                 scan.source = src
                 self.events.append(
                     f"pushed runtime IN-filter on {lk} "
-                    f"({len(values)} keys) into probe scan")
+                    f"({len(values[lk])} keys) into probe scan")
             except Exception:
-                return  # best-effort; the join itself is unaffected
+                continue  # best-effort per key; the join is unaffected
 
     # -- rule: skew split -----------------------------------------------------
     def _apply_skew(self, plan: PhysicalPlan) -> PhysicalPlan:

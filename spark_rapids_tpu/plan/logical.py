@@ -395,3 +395,19 @@ class LogicalGenerate(LogicalPlan):
         # outer explode emits a null element row for empty/null input
         fields += [Field(n, d, nb or self.outer) for n, d, nb in self.gen_fields]
         return Schema(fields)
+
+
+class LogicalMapInPandas(LogicalPlan):
+    """mapInPandas: an opaque pandas DataFrame -> DataFrame function with a
+    declared output schema (reference: GpuMapInPandasExec; host-evaluated
+    with the device semaphore released like the Arrow eval bridge)."""
+
+    def __init__(self, child: LogicalPlan, fn, out_schema: Schema):
+        self.child = child
+        self.children = (child,)
+        self.fn = fn
+        self._schema = out_schema
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema

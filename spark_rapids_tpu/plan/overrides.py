@@ -257,6 +257,17 @@ def _register_misc_rules():
             if isinstance(ct, (dt.StringType, dt.BinaryType)):
                 meta.cannot_run("xxhash64 over strings runs on host only")
     register_expr_rule(H.XxHash64, _hashable, tag_fn=tag_xx)
+    # bitwise family (reference: bitwise.scala rules) — And/Or/Xor inherit
+    # the BinaryArithmetic rule via MRO; Not + shifts register explicitly
+    from ..expr.arithmetic import (BitwiseNot, ShiftLeft, ShiftRight,
+                                   ShiftRightUnsigned)
+    for cls in (BitwiseNot, ShiftLeft, ShiftRight, ShiftRightUnsigned):
+        register_expr_rule(cls, TypeSig.integral)
+
+    from ..expr.strings import GetJsonObject
+    register_expr_rule(GetJsonObject, TypeSig.none(),
+                       note="host-only: JSON parsing")
+
     register_expr_rule(H.SparkPartitionID, _device_all)
     for cls in (H.InputFileName, H.InputFileBlockStart,
                 H.InputFileBlockLength):

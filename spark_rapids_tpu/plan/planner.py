@@ -218,6 +218,13 @@ def _plan(node: LogicalPlan, conf: RapidsConf,
         from .joins_planner import plan_join
         return plan_join(node, conf, required, _plan, nparts)
 
+    from .logical import LogicalMapInPandas
+    if isinstance(node, LogicalMapInPandas):
+        from ..udf.python_exec import CpuMapInPandasExec
+        # opaque fn: no pruning through it
+        child = _plan(node.child, conf, None)
+        return CpuMapInPandasExec(child, node.fn, node.schema)
+
     from .logical import LogicalGenerate
     if isinstance(node, LogicalGenerate):
         from .generate import CpuGenerateExec

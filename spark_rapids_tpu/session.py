@@ -116,15 +116,17 @@ class TpuSession:
         # session plans meanwhile
         TpuSession._active = self
         cpu = plan_physical(logical, self.conf)
-        _bind_conf_exprs(cpu, self.conf, self, device)
         use_device = self.conf.is_sql_enabled if device is None else device
         if self.conf.is_explain_only:
             # reference: spark.rapids.sql.mode=explainOnly (RapidsConf.scala:515)
             # — tag & report what would run on device, execute on the host
-            # engine only (ExplainPlan.explainPotentialGpuPlan)
+            # engine only (ExplainPlan.explainPotentialGpuPlan). Printed
+            # BEFORE the bind pass: binding executes scalar subqueries, and
+            # the explain output must not wait on (or be blamed for) that.
             if self.conf.explain != "NONE":
                 print(explain_plan(cpu, self.conf))
             use_device = False
+        _bind_conf_exprs(cpu, self.conf, self, device)
         if not use_device:
             # UDF compilation is engine-independent (the compiled expression
             # tree also runs on the host engine) — apply it here too so the
@@ -375,6 +377,20 @@ class DataFrame:
 
     def limit(self, n: int) -> "DataFrame":
         return DataFrame(self.session, LogicalLimit(self.logical, n))
+
+    def map_in_pandas(self, fn, schema) -> "DataFrame":
+        """Apply ``fn(iterator_of_pandas_DataFrames) -> iterator of pandas
+        DataFrames`` per batch with a declared output schema (PySpark
+        mapInPandas; reference: GpuMapInPandasExec keeps the surrounding
+        plan columnar around the Python bridge). ``schema`` is a dict of
+        column name -> DataType."""
+        from .plan.logical import LogicalMapInPandas
+        from .plan.schema import Field, Schema
+        out = Schema([Field(n, d, True) for n, d in schema.items()])
+        return DataFrame(self.session,
+                         LogicalMapInPandas(self.logical, fn, out))
+
+    mapInPandas = map_in_pandas
 
     def explode(self, c, *aliases, outer: bool = False,
                 pos: bool = False) -> "DataFrame":

@@ -25,6 +25,8 @@ __all__ = ["validate", "report"]
 KNOWN_HOST_ONLY_EXECS: Dict[str, str] = {
     "CpuGenerateExec": "explode lowers through plan/generate.py host path "
                        "with a device Expand for array columns",
+    "CpuMapInPandasExec": "opaque Python bridge; runs host-side with the "
+                          "device semaphore released",
     "PhysicalPlan": "abstract base",
 }
 
@@ -40,7 +42,9 @@ KNOWN_HOST_ONLY_EXPRS: Dict[str, str] = {
 def _plan_classes():
     from ..plan import generate, physical, physical_joins, physical_window
     from ..exec import cache
-    mods = [physical, physical_joins, physical_window, generate, cache]
+    from ..udf import python_exec
+    mods = [physical, physical_joins, physical_window, generate, cache,
+            python_exec]
     seen = {}
     for mod in mods:
         for name, obj in vars(mod).items():

@@ -537,7 +537,7 @@ def q10(t):
 
 def q11(t):
     """TPC-H Q11: important stock identification (global-scalar HAVING via
-    cross join of a one-row aggregate)."""
+    scalar subquery, the SQL formulation's shape)."""
     F = _f()
     col, lit = F.col, F.lit
     base = (t["partsupp"]
@@ -546,11 +546,10 @@ def q11(t):
             .filter(col("n_name") == lit("GERMANY"))
             .with_column("value", col("ps_supplycost")
                          * col("ps_availqty").cast(_dt().DOUBLE)))
-    total = base.agg(F.sum(col("value")).alias("total_value"))
+    total = F.scalar_subquery(base.agg(F.sum(col("value")).alias("tv")))
     return (base.group_by("ps_partkey")
             .agg(F.sum(col("value")).alias("value"))
-            .cross_join(total)
-            .filter(col("value") > col("total_value") * lit(0.0001))
+            .filter(col("value") > total * lit(0.0001))
             .select("ps_partkey", "value")
             .sort(col("value").desc(), col("ps_partkey").asc()))
 
@@ -615,7 +614,7 @@ def q14(t):
 
 
 def q15(t):
-    """TPC-H Q15: top supplier (max-scalar via cross join of one-row agg)."""
+    """TPC-H Q15: top supplier (max-scalar via scalar subquery)."""
     F = _f()
     col, lit = F.col, F.lit
     sd = col("l_shipdate").cast(_dt().INT)
@@ -625,11 +624,11 @@ def q15(t):
                .with_column("rev", rev)
                .group_by("l_suppkey")
                .agg(F.sum(col("rev")).alias("total_revenue")))
-    maxrev = revenue.agg(F.max(col("total_revenue")).alias("max_revenue"))
+    maxrev = F.scalar_subquery(
+        revenue.agg(F.max(col("total_revenue")).alias("max_revenue")))
     return (t["supplier"]
             .join(revenue, condition=col("s_suppkey") == col("l_suppkey"))
-            .cross_join(maxrev)
-            .filter(col("total_revenue") == col("max_revenue"))
+            .filter(col("total_revenue") == maxrev)
             .select("s_suppkey", "s_name", "s_address", "s_phone",
                     "total_revenue")
             .sort("s_suppkey"))
@@ -789,18 +788,19 @@ def q21(t):
 
 def q22(t):
     """TPC-H Q22: global sales opportunity (substring country codes, global
-    avg scalar, NOT EXISTS -> anti join)."""
+    avg via scalar subquery, NOT EXISTS -> anti join)."""
     F = _f()
     col, lit = F.col, F.lit
     codes = ("13", "31", "23", "29", "30", "18", "17")
     cust = (t["customer"]
             .with_column("cntrycode", F.substring(col("c_phone"), 1, 2))
             .filter(col("cntrycode").isin(*codes)))
-    avg_bal = (cust.filter(col("c_acctbal") > lit(0.0))
-               .agg(F.avg(col("c_acctbal")).alias("avg_bal")))
+    avg_bal = F.scalar_subquery(
+        cust.filter(col("c_acctbal") > lit(0.0))
+            .agg(F.avg(col("c_acctbal")).alias("avg_bal")))
     ord_keys = t["orders"].select(col("o_custkey").alias("ord_custkey"))
-    return (cust.cross_join(avg_bal)
-            .filter(col("c_acctbal") > col("avg_bal"))
+    return (cust
+            .filter(col("c_acctbal") > avg_bal)
             .join(ord_keys, how="left_anti",
                   condition=col("c_custkey") == col("ord_custkey"))
             .group_by("cntrycode")

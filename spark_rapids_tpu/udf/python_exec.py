@@ -30,7 +30,7 @@ from ..plan.physical import PhysicalPlan, host_eval_exprs
 from ..plan.schema import Field, Schema
 from ..utils import metrics as M
 
-__all__ = ["PythonUDF", "TpuArrowEvalPythonExec"]
+__all__ = ["PythonUDF", "TpuArrowEvalPythonExec", "CpuMapInPandasExec"]
 
 
 @dataclasses.dataclass(repr=False)
@@ -167,3 +167,47 @@ def _tree_has_python_udf(e: Expression) -> bool:
     if isinstance(e, PythonUDF):
         return True
     return any(_tree_has_python_udf(c) for c in e.children)
+
+
+class CpuMapInPandasExec(PhysicalPlan):
+    """mapInPandas over host batches (reference: GpuMapInPandasExec — the
+    plugin keeps the surrounding plan columnar and bridges to Python per
+    batch; here each input batch converts to pandas, the user fn yields
+    output frames, and the device admission semaphore is RELEASED for the
+    duration of the Python call like the Arrow eval operator)."""
+
+    def __init__(self, child: PhysicalPlan, fn, schema: Schema):
+        self.child = child
+        self.children = (child,)
+        self.fn = fn
+        self.schema = schema
+
+    def execute(self, pidx: int) -> Iterator[HostTable]:
+        import pyarrow as pa
+        sem = get_semaphore()
+        for batch in self.child.execute(pidx):
+            pdf = batch.to_arrow().to_pandas()
+            sem.release_if_held()
+            try:
+                outs = list(self.fn(iter([pdf])))
+            finally:
+                sem.acquire_if_necessary()
+            from ..columnar.host import _dtype_to_arrow
+            for out in outs:
+                table = pa.Table.from_pandas(out, preserve_index=False)
+                # conform to the DECLARED schema: order AND dtypes (an
+                # int64 frame against a DOUBLE schema must upload float64,
+                # or downstream device kernels see the wrong dtype)
+                arrays = []
+                for f in self.schema:
+                    arr = table.column(f.name)
+                    want = _dtype_to_arrow(f.dtype)
+                    if arr.type != want:
+                        arr = arr.cast(want)
+                    arrays.append(arr)
+                ht = HostTable.from_arrow(
+                    pa.table(dict(zip(self.schema.names, arrays))))
+                yield ht
+
+    def node_desc(self):
+        return getattr(self.fn, "__name__", "fn")

@@ -1,0 +1,132 @@
+"""Bitwise expressions, get_json_object, mapInPandas (reference:
+bitwise.scala, GpuGetJsonObject.scala, GpuMapInPandasExec)."""
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.session import TpuSession
+from spark_rapids_tpu.expr.functions import (bitwise_not, col,
+                                             get_json_object, lit, shiftleft,
+                                             shiftright, shiftrightunsigned)
+
+from harness import assert_tpu_cpu_equal
+
+
+@pytest.fixture
+def sess():
+    return TpuSession({"spark.rapids.tpu.shuffle.mode": "host"})
+
+
+def test_bitwise_and_or_xor_not(sess):
+    rng = np.random.default_rng(4)
+    df = sess.create_dataframe(pd.DataFrame({
+        "a": rng.integers(-1000, 1000, 500).astype(np.int64),
+        "b": rng.integers(-1000, 1000, 500).astype(np.int64),
+    }), num_partitions=2)
+    q = df.select(
+        col("a").bitwiseAND(col("b")).alias("band"),
+        col("a").bitwiseOR(col("b")).alias("bor"),
+        col("a").bitwiseXOR(col("b")).alias("bxor"),
+        bitwise_not(col("a")).alias("bnot"),
+    )
+    out = assert_tpu_cpu_equal(q, ignore_order=False)
+    pdf = df.collect().to_pandas()
+    assert out.column("band").to_pylist() == (pdf.a & pdf.b).tolist()
+    assert out.column("bor").to_pylist() == (pdf.a | pdf.b).tolist()
+    assert out.column("bxor").to_pylist() == (pdf.a ^ pdf.b).tolist()
+    assert out.column("bnot").to_pylist() == (~pdf.a).tolist()
+    # boolean & stays logical AND
+    qb = df.select(((col("a") > 0) & (col("b") > 0)).alias("both"))
+    got = assert_tpu_cpu_equal(qb, ignore_order=False)
+    assert got.column("both").to_pylist() == \
+        ((pdf.a > 0) & (pdf.b > 0)).tolist()
+
+
+def test_shifts_mask_like_java(sess):
+    df = sess.create_dataframe(pd.DataFrame({
+        "v": np.array([1, -8, 1 << 40, -1], dtype=np.int64),
+        "s": np.array([1, 2, 65, 63], dtype=np.int32),
+    }))
+    q = df.select(shiftleft(col("v"), col("s")).alias("sl"),
+                  shiftright(col("v"), col("s")).alias("sr"),
+                  shiftrightunsigned(col("v"), col("s")).alias("sru"))
+    out = assert_tpu_cpu_equal(q, ignore_order=False)
+    # shift 65 on a long masks to 1 (Java semantics)
+    assert out.column("sl").to_pylist()[2] == (1 << 40) << 1
+    assert out.column("sr").to_pylist()[1] == -8 >> 2
+    assert out.column("sru").to_pylist()[3] == 1  # -1 >>> 63
+
+
+def test_get_json_object(sess):
+    docs = ['{"a": {"b": 1}, "arr": [10, 20]}',
+            '{"a": "plain"}',
+            'not json',
+            None,
+            '{"a": {"b": {"c": "deep"}}}']
+    df = sess.create_dataframe(pa.table({"j": docs}))
+    q = df.select(get_json_object(col("j"), "$.a.b").alias("ab"),
+                  get_json_object(col("j"), "$.arr[1]").alias("a1"),
+                  get_json_object(col("j"), "$.a.b.c").alias("abc"))
+    out = q.collect(device=True)
+    assert out.column("ab").to_pylist() == ["1", None, None, None,
+                                            '{"c":"deep"}']
+    assert out.column("a1").to_pylist() == ["20", None, None, None, None]
+    assert out.column("abc").to_pylist() == [None, None, None, None, "deep"]
+    assert_tpu_cpu_equal(q, ignore_order=False)
+
+
+def test_map_in_pandas(sess):
+    rng = np.random.default_rng(6)
+    df = sess.create_dataframe(pd.DataFrame({
+        "k": rng.integers(0, 5, 300).astype(np.int64),
+        "v": rng.normal(size=300),
+    }), num_partitions=3)
+
+    def double_v(frames):
+        for pdf in frames:
+            out = pdf.copy()
+            out["v2"] = out.v * 2
+            yield out[["k", "v2"]]
+
+    q = df.map_in_pandas(double_v, {"k": dt.LONG, "v2": dt.DOUBLE})
+    out = assert_tpu_cpu_equal(q)
+    pdf = df.collect().to_pandas()
+    assert out.num_rows == 300
+    assert sorted(out.column("v2").to_pylist()) == pytest.approx(
+        sorted((pdf.v * 2).tolist()))
+
+
+def test_map_in_pandas_casts_to_declared_schema(sess):
+    """fn may yield int64 where the declared schema says DOUBLE; the exec
+    must cast so downstream device kernels see the declared dtype."""
+    df = sess.create_dataframe(pd.DataFrame({"a": [1, 2, 3]}))
+
+    def ints(frames):
+        for pdf in frames:
+            yield pd.DataFrame({"x": pdf.a * 10})  # int64, schema says DOUBLE
+
+    q = df.map_in_pandas(ints, {"x": dt.DOUBLE})
+    out = q.collect(device=True)
+    assert str(out.schema.field("x").type) == "double"
+    assert out.column("x").to_pylist() == [10.0, 20.0, 30.0]
+    assert_tpu_cpu_equal(q)
+
+
+def test_map_in_pandas_composes_with_engine_ops(sess):
+    df = sess.create_dataframe(pd.DataFrame({
+        "x": np.arange(100, dtype=np.int64)}), num_partitions=2)
+
+    def add_flag(frames):
+        for pdf in frames:
+            pdf = pdf.copy()
+            pdf["flag"] = pdf.x % 3 == 0
+            yield pdf
+
+    q = (df.map_in_pandas(add_flag, {"x": dt.LONG, "flag": dt.BOOLEAN})
+           .filter(col("flag"))
+           .agg(__import__("spark_rapids_tpu.expr.functions",
+                           fromlist=["count_star"]).count_star().alias("n")))
+    out = q.collect(device=True)
+    assert out.column("n").to_pylist() == [34]

@@ -83,6 +83,42 @@ def test_non_debug_mode_keeps_lenient_semantics():
     cat.release(12345)      # unknown release tolerated
 
 
+def test_direct_disk_spill_roundtrip():
+    """GDS-analogue direct mode: disk restores are read-only memory maps
+    (the device upload streams from the file) and data survives the full
+    device->host->disk->device cycle."""
+    from spark_rapids_tpu.memory.stores import StorageTier
+    cat = BufferCatalog(RapidsConf(), device_limit=4000, host_limit=4000)
+    assert cat.disk.direct
+    t = _table(11, rows=512)
+    expect = np.asarray(t.columns[0].data).copy()
+    h = cat.register(t)
+    cat.synchronous_spill(1 << 20)   # -> host
+    cat._spill_host_to_disk(1 << 30)  # force -> disk
+    stored = cat._buffers[h.buffer_id]
+    assert stored.tier == StorageTier.DISK
+    loaded = cat.disk.load(stored)
+    assert any(isinstance(a, np.memmap) for a in loaded.values()), \
+        {k: type(v) for k, v in loaded.items()}
+    back = h.get()
+    assert (np.asarray(back.columns[0].data) == expect).all()
+    h.close()
+
+
+def test_npz_disk_mode_still_works():
+    conf = RapidsConf({"spark.rapids.tpu.memory.disk.direct": False})
+    cat = BufferCatalog(conf, device_limit=4000, host_limit=4000)
+    assert not cat.disk.direct
+    t = _table(12, rows=512)
+    expect = np.asarray(t.columns[0].data).copy()
+    h = cat.register(t)
+    cat.synchronous_spill(1 << 20)
+    cat._spill_host_to_disk(1 << 30)
+    back = h.get()
+    assert (np.asarray(back.columns[0].data) == expect).all()
+    h.close()
+
+
 def test_concurrent_register_spill_close_stress():
     """Many threads hammer register/acquire/release/close against a pool
     small enough to force constant spilling; accounting must stay exact and

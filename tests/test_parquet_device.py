@@ -138,6 +138,40 @@ def test_column_pruning_through_device_scan(sess, tmp_path):
     assert_tables_equal(dev, df.collect(device=False), ignore_order=False)
 
 
+def test_mixed_width_dictionary_pages(sess, tmp_path):
+    """A growing dictionary makes successive pages bit-pack at DIFFERENT
+    widths; the run table records width per run (a single chunk-wide width
+    silently corrupted 60%+ of values)."""
+    rng = np.random.default_rng(11)
+    n = 200_000
+    # values appear progressively so the dictionary (and index width) grows
+    vals = np.minimum(rng.integers(0, 200, n).cumsum() % 120,
+                      np.arange(n) // 500)
+    t = pa.table({"v": pa.array(vals, type=pa.int64())})
+    p = str(tmp_path / "growdict.parquet")
+    pq.write_table(t, p, row_group_size=n, data_page_size=8 * 1024,
+                   compression="snappy")
+    df = sess.read_parquet(p)
+    plan = sess._physical(df.logical, True)
+    assert "TpuParquetScanExec" in plan.tree_string()
+    dev = df.collect(device=True)
+    assert dev.column("v").to_pylist() == t.column("v").to_pylist()
+
+
+def test_unsupported_codec_falls_back_to_host(sess, tmp_path):
+    """Hadoop-framed LZ4 is unreadable by pa.decompress; the device decoder
+    must fall back per column, never crash (host pyarrow reads it fine)."""
+    t = pa.table({"a": pa.array(np.arange(5000, dtype=np.int64)),
+                  "b": pa.array(np.random.default_rng(1).normal(size=5000))})
+    p = str(tmp_path / "lz4.parquet")
+    pq.write_table(t, p, compression="lz4")
+    df = sess.read_parquet(p)
+    dev = df.collect(device=True)
+    cpu = df.collect(device=False)
+    assert_tables_equal(dev, cpu, ignore_order=False)
+    assert_tables_equal(dev, t, ignore_order=False)
+
+
 def test_empty_and_single_row_groups(sess, tmp_path):
     t = pa.table({"a": pa.array([], type=pa.int64()),
                   "b": pa.array([], type=pa.float64())})
