@@ -1040,7 +1040,11 @@ class GetJsonObject(Expression):
     def _extract(doc: str, path: str):
         import json as _json
         import re as _re
-        if not isinstance(doc, str) or not path.startswith("$"):
+        if not isinstance(doc, str) or not isinstance(path, str):
+            return None
+        # the WHOLE path must match the grammar: Spark returns null for
+        # malformed paths ("$x", "$.a??") rather than best-effort parsing
+        if not _re.fullmatch(r"\$(?:\.[A-Za-z0-9_]+|\[\d+\])*", path):
             return None
         try:
             cur = _json.loads(doc)

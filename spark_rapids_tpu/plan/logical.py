@@ -411,3 +411,47 @@ class LogicalMapInPandas(LogicalPlan):
     @property
     def schema(self) -> Schema:
         return self._schema
+
+
+class LogicalGroupedMapPandas(LogicalPlan):
+    """groupBy(...).applyInPandas: one pandas DataFrame per key group through
+    an opaque function with a declared output schema (reference:
+    GpuFlatMapGroupsInPandasExec)."""
+
+    def __init__(self, child: LogicalPlan, keys, fn, out_schema: Schema):
+        self.child = child
+        self.children = (child,)
+        self.keys = list(keys)
+        cs = child.schema
+        for k in self.keys:
+            cs.field(k)  # raises on unknown key
+        self.fn = fn
+        self._schema = out_schema
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+
+class LogicalCoGroupedMapPandas(LogicalPlan):
+    """cogroup(...).applyInPandas: per matching key group, fn(left_frame,
+    right_frame) -> frame (reference: GpuFlatMapCoGroupsInPandasExec)."""
+
+    def __init__(self, left: LogicalPlan, right: LogicalPlan,
+                 lkeys, rkeys, fn, out_schema: Schema):
+        self.left, self.right = left, right
+        self.children = (left, right)
+        self.lkeys = list(lkeys)
+        self.rkeys = list(rkeys)
+        if len(self.lkeys) != len(self.rkeys):
+            raise ValueError("cogroup key lists differ in length")
+        for k in self.lkeys:
+            left.schema.field(k)
+        for k in self.rkeys:
+            right.schema.field(k)
+        self.fn = fn
+        self._schema = out_schema
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema

@@ -218,6 +218,28 @@ def _plan(node: LogicalPlan, conf: RapidsConf,
         from .joins_planner import plan_join
         return plan_join(node, conf, required, _plan, nparts)
 
+    from .logical import LogicalCoGroupedMapPandas
+    if isinstance(node, LogicalCoGroupedMapPandas):
+        from ..udf.python_exec import CpuCoGroupedMapPandasExec
+        left = _plan(node.left, conf, None)
+        right = _plan(node.right, conf, None)
+        # both sides must agree on partition placement of matching keys
+        left = ShuffleExchangeExec(left, HashPartitioning(node.lkeys, nparts))
+        right = ShuffleExchangeExec(right, HashPartitioning(node.rkeys, nparts))
+        return CpuCoGroupedMapPandasExec(left, right, node.lkeys, node.rkeys,
+                                         node.fn, node.schema)
+
+    from .logical import LogicalGroupedMapPandas
+    if isinstance(node, LogicalGroupedMapPandas):
+        from ..udf.python_exec import CpuGroupedMapPandasExec
+        child = _plan(node.child, conf, None)
+        if child.num_partitions > 1:
+            # co-locate each key group in one partition (Spark plans the
+            # same exchange under FlatMapGroupsInPandas)
+            child = ShuffleExchangeExec(
+                child, HashPartitioning(node.keys, nparts))
+        return CpuGroupedMapPandasExec(child, node.keys, node.fn, node.schema)
+
     from .logical import LogicalMapInPandas
     if isinstance(node, LogicalMapInPandas):
         from ..udf.python_exec import CpuMapInPandasExec

@@ -616,6 +616,58 @@ class GroupedData:
         from .expr.functions import count_star
         return self.agg(count_star().alias("count"))
 
+    def apply_in_pandas(self, fn, schema) -> DataFrame:
+        """``fn(pandas.DataFrame) -> pandas.DataFrame`` once per key group
+        (PySpark applyInPandas; reference: GpuFlatMapGroupsInPandasExec).
+        ``schema`` is a dict of output column name -> DataType."""
+        from .expr.base import AttributeReference
+        from .plan.logical import LogicalGroupedMapPandas
+        from .plan.schema import Field, Schema
+        keys = []
+        for g in self.groupings:
+            if not isinstance(g, AttributeReference):
+                raise TypeError("applyInPandas grouping must be plain "
+                                f"column references, got {g!r}")
+            keys.append(g.column_name)
+        out = Schema([Field(n, d, True) for n, d in schema.items()])
+        return DataFrame(self.df.session, LogicalGroupedMapPandas(
+            self.df.logical, keys, fn, out))
+
+    applyInPandas = apply_in_pandas
+
+    def cogroup(self, other: "GroupedData") -> "CoGroupedData":
+        """Pair this grouping with another DataFrame's grouping (PySpark
+        cogroup; reference: GpuFlatMapCoGroupsInPandasExec)."""
+        return CoGroupedData(self, other)
+
+    def _key_names(self):
+        from .expr.base import AttributeReference
+        keys = []
+        for g in self.groupings:
+            if not isinstance(g, AttributeReference):
+                raise TypeError("cogroup grouping must be plain column "
+                                f"references, got {g!r}")
+            keys.append(g.column_name)
+        return keys
+
+
+class CoGroupedData:
+    def __init__(self, left: "GroupedData", right: "GroupedData"):
+        self.left = left
+        self.right = right
+
+    def apply_in_pandas(self, fn, schema) -> "DataFrame":
+        """``fn(left_frame, right_frame) -> pandas.DataFrame`` once per key
+        present on either side (missing side passes an empty frame)."""
+        from .plan.logical import LogicalCoGroupedMapPandas
+        from .plan.schema import Field, Schema
+        out = Schema([Field(n, d, True) for n, d in schema.items()])
+        return DataFrame(self.left.df.session, LogicalCoGroupedMapPandas(
+            self.left.df.logical, self.right.df.logical,
+            self.left._key_names(), self.right._key_names(), fn, out))
+
+    applyInPandas = apply_in_pandas
+
 
 def _walk_expr(e):
     yield e

@@ -27,6 +27,10 @@ KNOWN_HOST_ONLY_EXECS: Dict[str, str] = {
                        "with a device Expand for array columns",
     "CpuMapInPandasExec": "opaque Python bridge; runs host-side with the "
                           "device semaphore released",
+    "CpuGroupedMapPandasExec": "opaque per-group Python bridge; host-side "
+                               "with the device semaphore released",
+    "CpuCoGroupedMapPandasExec": "opaque co-grouped Python bridge; "
+                                 "host-side with the semaphore released",
     "PhysicalPlan": "abstract base",
 }
 

@@ -30,7 +30,8 @@ from ..plan.physical import PhysicalPlan, host_eval_exprs
 from ..plan.schema import Field, Schema
 from ..utils import metrics as M
 
-__all__ = ["PythonUDF", "TpuArrowEvalPythonExec", "CpuMapInPandasExec"]
+__all__ = ["PythonUDF", "TpuArrowEvalPythonExec", "CpuMapInPandasExec",
+           "CpuGroupedMapPandasExec", "CpuCoGroupedMapPandasExec"]
 
 
 @dataclasses.dataclass(repr=False)
@@ -169,6 +170,41 @@ def _tree_has_python_udf(e: Expression) -> bool:
     return any(_tree_has_python_udf(c) for c in e.children)
 
 
+def _conform_to_schema(out_frame, schema: Schema) -> HostTable:
+    """Reorder AND cast a user-produced pandas frame to the declared output
+    schema (shared by every pandas-bridge exec)."""
+    import pyarrow as pa
+
+    from ..columnar.host import _dtype_to_arrow
+    table = pa.Table.from_pandas(out_frame, preserve_index=False)
+    arrays = []
+    for f in schema:
+        arr = table.column(f.name)
+        want = _dtype_to_arrow(f.dtype)
+        if arr.type != want:
+            arr = arr.cast(want)
+        arrays.append(arr)
+    return HostTable.from_arrow(pa.table(dict(zip(schema.names, arrays))))
+
+
+def _empty_frame_for(schema: Schema):
+    """Empty pandas frame with the FULL column set + dtypes of a schema
+    (Spark passes full-schema empty frames to cogroup fns)."""
+    import pyarrow as pa
+
+    from ..columnar.host import _dtype_to_arrow
+    return pa.table({f.name: pa.array([], type=_dtype_to_arrow(f.dtype))
+                     for f in schema}).to_pandas()
+
+
+def _norm_group_key(k):
+    """Group keys comparable across sides: pandas NaN keys (from nulls)
+    don't equal each other; map them to None (Spark matches null keys)."""
+    parts = k if isinstance(k, tuple) else (k,)
+    return tuple(None if (isinstance(x, float) and x != x) else x
+                 for x in parts)
+
+
 class CpuMapInPandasExec(PhysicalPlan):
     """mapInPandas over host batches (reference: GpuMapInPandasExec — the
     plugin keeps the surrounding plan columnar and bridges to Python per
@@ -183,31 +219,127 @@ class CpuMapInPandasExec(PhysicalPlan):
         self.schema = schema
 
     def execute(self, pidx: int) -> Iterator[HostTable]:
-        import pyarrow as pa
+        # PySpark contract: fn is called ONCE per partition with an iterator
+        # over ALL of the partition's frames (a stateful fn draining the
+        # iterator must see the whole partition). Frames materialize first
+        # so the engine work happens while the semaphore is still held.
+        frames = [b.to_arrow().to_pandas()
+                  for b in self.child.execute(pidx)]
+        if not frames:
+            return
         sem = get_semaphore()
-        for batch in self.child.execute(pidx):
-            pdf = batch.to_arrow().to_pandas()
-            sem.release_if_held()
-            try:
-                outs = list(self.fn(iter([pdf])))
-            finally:
-                sem.acquire_if_necessary()
-            from ..columnar.host import _dtype_to_arrow
-            for out in outs:
-                table = pa.Table.from_pandas(out, preserve_index=False)
-                # conform to the DECLARED schema: order AND dtypes (an
-                # int64 frame against a DOUBLE schema must upload float64,
-                # or downstream device kernels see the wrong dtype)
-                arrays = []
-                for f in self.schema:
-                    arr = table.column(f.name)
-                    want = _dtype_to_arrow(f.dtype)
-                    if arr.type != want:
-                        arr = arr.cast(want)
-                    arrays.append(arr)
-                ht = HostTable.from_arrow(
-                    pa.table(dict(zip(self.schema.names, arrays))))
-                yield ht
+        sem.release_if_held()
+        try:
+            outs = list(self.fn(iter(frames)))
+        finally:
+            sem.acquire_if_necessary()
+        for out in outs:
+            if out is None or not len(out):
+                continue
+            yield _conform_to_schema(out, self.schema)
 
     def node_desc(self):
         return getattr(self.fn, "__name__", "fn")
+
+
+class CpuGroupedMapPandasExec(PhysicalPlan):
+    """applyInPandas: the planner hash-exchanges on the grouping keys so
+    each group lands wholly in one partition; here the partition's batches
+    concatenate, pandas groups them, and the user fn maps each group frame
+    to an output frame (reference: GpuFlatMapGroupsInPandasExec — Python
+    runs host-side with the device semaphore released)."""
+
+    def __init__(self, child: PhysicalPlan, keys, fn, schema: Schema):
+        self.child = child
+        self.children = (child,)
+        self.keys = list(keys)
+        self.fn = fn
+        self.schema = schema
+
+    def execute(self, pidx: int) -> Iterator[HostTable]:
+        import pandas as pd
+        batches = list(self.child.execute(pidx))
+        if not batches:
+            return
+        pdf = pd.concat([b.to_arrow().to_pandas() for b in batches],
+                        ignore_index=True)
+        if not len(pdf):
+            return
+        sem = get_semaphore()
+        outs = []
+        sem.release_if_held()
+        try:
+            for _, group in pdf.groupby(self.keys, sort=False, dropna=False):
+                outs.append(self.fn(group))
+        finally:
+            sem.acquire_if_necessary()
+        for out in outs:
+            if out is None or not len(out):
+                continue
+            yield _conform_to_schema(out, self.schema)
+
+    def node_desc(self):
+        return f"keys={self.keys} fn={getattr(self.fn, '__name__', 'fn')}"
+
+
+class CpuCoGroupedMapPandasExec(PhysicalPlan):
+    """cogroup-applyInPandas: both sides hash-exchange on their keys with
+    the SAME partitioning, so matching groups co-locate; fn is called once
+    per key present on EITHER side with that side's (possibly empty) frame
+    (reference: GpuFlatMapCoGroupsInPandasExec)."""
+
+    def __init__(self, left: PhysicalPlan, right: PhysicalPlan,
+                 lkeys, rkeys, fn, schema: Schema):
+        self.left, self.right = left, right
+        self.children = (left, right)
+        self.lkeys = list(lkeys)
+        self.rkeys = list(rkeys)
+        self.fn = fn
+        self.schema = schema
+
+    @property
+    def num_partitions(self) -> int:
+        return self.left.num_partitions
+
+    def execute(self, pidx: int) -> Iterator[HostTable]:
+        import pandas as pd
+
+        def side(child, keys):
+            batches = list(child.execute(pidx))
+            if not batches:
+                return None, {}
+            f = pd.concat([b.to_arrow().to_pandas() for b in batches],
+                          ignore_index=True)
+            if not len(f):
+                return f, {}
+            # normalize keys so null (NaN) groups MATCH across sides
+            groups = {_norm_group_key(k): g
+                      for k, g in f.groupby(keys, sort=False, dropna=False)}
+            return f, groups
+
+        lf, lgroups = side(self.left, self.lkeys)
+        rf, rgroups = side(self.right, self.rkeys)
+        if lf is None and rf is None:
+            return
+        # empty placeholders carry the FULL side schema (Spark passes
+        # full-schema empty frames), even when the side had no batches
+        lempty = lf.iloc[0:0] if lf is not None             else _empty_frame_for(self.left.schema)
+        rempty = rf.iloc[0:0] if rf is not None             else _empty_frame_for(self.right.schema)
+        keys = list(lgroups)
+        keys += [k for k in rgroups if k not in lgroups]
+        sem = get_semaphore()
+        outs = []
+        sem.release_if_held()
+        try:
+            for k in keys:
+                outs.append(self.fn(lgroups.get(k, lempty),
+                                    rgroups.get(k, rempty)))
+        finally:
+            sem.acquire_if_necessary()
+        for out in outs:
+            if out is None or not len(out):
+                continue
+            yield _conform_to_schema(out, self.schema)
+
+    def node_desc(self):
+        return f"keys={self.lkeys}/{self.rkeys}"

@@ -114,6 +114,153 @@ def test_map_in_pandas_casts_to_declared_schema(sess):
     assert_tpu_cpu_equal(q)
 
 
+def test_apply_in_pandas_per_group(sess):
+    """applyInPandas: fn sees each key group whole (the planner hash-
+    exchanges on the keys), across multiple input partitions."""
+    rng = np.random.default_rng(8)
+    df = sess.create_dataframe(pd.DataFrame({
+        "g": rng.integers(0, 6, 600).astype(np.int64),
+        "v": rng.normal(size=600),
+    }), num_partitions=3)
+
+    def summarize(group):
+        return pd.DataFrame({"g": [group.g.iloc[0]],
+                             "n": [len(group)],
+                             "s": [group.v.sum()]})
+
+    q = df.group_by("g").apply_in_pandas(
+        summarize, {"g": dt.LONG, "n": dt.LONG, "s": dt.DOUBLE})
+    out = assert_tpu_cpu_equal(q)
+    pdf = df.collect().to_pandas()
+    exp = pdf.groupby("g").v.agg(["count", "sum"])
+    got = {r["g"]: (r["n"], r["s"]) for r in out.to_pylist()}
+    assert len(got) == len(exp)
+    for g, row in exp.iterrows():
+        n, s = got[g]
+        assert n == row["count"] and s == pytest.approx(row["sum"])
+
+
+def test_apply_in_pandas_group_integrity(sess):
+    """Every group must arrive in ONE fn call even with many partitions."""
+    df = sess.create_dataframe(pd.DataFrame({
+        "g": np.arange(40, dtype=np.int64) % 4,
+        "v": np.ones(40),
+    }), num_partitions=4)
+    sizes = []
+
+    def record(group):
+        sizes.append(len(group))
+        return pd.DataFrame({"g": [group.g.iloc[0]]})
+
+    q = df.group_by("g").apply_in_pandas(record, {"g": dt.LONG})
+    out = q.collect(device=False)
+    assert out.num_rows == 4
+    assert sorted(sizes) == [10, 10, 10, 10], sizes
+
+
+def test_map_in_pandas_iterator_spans_whole_partition(sess):
+    """PySpark contract: fn runs ONCE per partition and its iterator covers
+    every batch — a stateful fn must see whole-partition counts."""
+    df = sess.create_dataframe(pd.DataFrame({
+        "a": np.arange(100, dtype=np.int64)}), num_partitions=2)
+    calls = []
+
+    def summarize(frames):
+        n = 0
+        for pdf in frames:
+            n += len(pdf)
+        calls.append(n)
+        yield pd.DataFrame({"n": [n]})
+
+    q = df.map_in_pandas(summarize, {"n": dt.LONG})
+    out = q.collect(device=False)
+    assert out.num_rows == 2            # one summary row per PARTITION
+    assert sum(out.column("n").to_pylist()) == 100
+    assert sorted(calls) == sorted(out.column("n").to_pylist())
+
+
+def test_cogroup_matches_null_keys(sess):
+    """Null keys become pandas NaN; both sides' null groups must meet in
+    ONE fn call (NaN != NaN would split them)."""
+    import pyarrow as pa
+    a = sess.create_dataframe(pa.table({
+        "k": pa.array([1, None, None], type=pa.int64()),
+        "x": pa.array([1.0, 2.0, 3.0])}))
+    b = sess.create_dataframe(pa.table({
+        "k": pa.array([None, 2], type=pa.int64()),
+        "y": pa.array([10.0, 20.0])}))
+    seen = []
+
+    def pair(l, r):
+        seen.append((len(l), len(r)))
+        return pd.DataFrame({"nl": [len(l)], "nr": [len(r)]})
+
+    q = a.group_by("k").cogroup(b.group_by("k")).apply_in_pandas(
+        pair, {"nl": dt.LONG, "nr": dt.LONG})
+    out = q.collect(device=False)
+    rows = sorted((r["nl"], r["nr"]) for r in out.to_pylist())
+    # groups: k=1 -> (1,0); k=null -> (2,1) TOGETHER; k=2 -> (0,1)
+    assert rows == [(0, 1), (1, 0), (2, 1)], rows
+
+
+def test_cogroup_empty_side_has_full_schema(sess):
+    """A side with no rows at all still hands fn a frame with its FULL
+    column set (Spark semantics), not just the key columns."""
+    a = sess.create_dataframe(pd.DataFrame({
+        "k": np.array([1], dtype=np.int64), "x": [5.0]}))
+    b = sess.create_dataframe(pd.DataFrame({
+        "k": np.array([], dtype=np.int64), "y": np.array([], dtype=np.float64)}))
+
+    def probe(l, r):
+        return pd.DataFrame({"k": [l.k.iloc[0] if len(l) else r.k.iloc[0]],
+                             "ysum": [float(r.y.sum())]})  # touches r.y
+
+    q = a.group_by("k").cogroup(b.group_by("k")).apply_in_pandas(
+        probe, {"k": dt.LONG, "ysum": dt.DOUBLE})
+    out = q.collect(device=False)
+    assert out.to_pylist() == [{"k": 1, "ysum": 0.0}]
+
+
+def test_get_json_object_rejects_malformed_paths(sess):
+    import pyarrow as pa
+    df = sess.create_dataframe(pa.table({"j": ['{"a": 1}']}))
+    q = df.select(get_json_object(col("j"), "$x").alias("bad1"),
+                  get_json_object(col("j"), "$.a??").alias("bad2"),
+                  get_json_object(col("j"), "$").alias("whole"))
+    out = q.collect(device=False)
+    assert out.column("bad1").to_pylist() == [None]
+    assert out.column("bad2").to_pylist() == [None]
+    assert out.column("whole").to_pylist() == ['{"a":1}']
+
+
+def test_cogroup_apply_in_pandas(sess):
+    """cogroup: fn sees both sides' frames per key; keys present on only
+    one side get an empty frame for the other."""
+    rng = np.random.default_rng(9)
+    a = sess.create_dataframe(pd.DataFrame({
+        "k": np.array([0, 0, 1, 1, 2], dtype=np.int64),
+        "x": np.arange(5, dtype=np.float64)}), num_partitions=2)
+    b = sess.create_dataframe(pd.DataFrame({
+        "k": np.array([1, 2, 2, 3], dtype=np.int64),
+        "y": np.arange(4, dtype=np.float64) * 10}), num_partitions=3)
+
+    def merge(l, r):
+        k = l.k.iloc[0] if len(l) else r.k.iloc[0]
+        return pd.DataFrame({"k": [k], "nx": [len(l)], "ny": [len(r)],
+                             "sx": [l.x.sum() if len(l) else 0.0],
+                             "sy": [r.y.sum() if len(r) else 0.0]})
+
+    q = a.group_by("k").cogroup(b.group_by("k")).apply_in_pandas(
+        merge, {"k": dt.LONG, "nx": dt.LONG, "ny": dt.LONG,
+                "sx": dt.DOUBLE, "sy": dt.DOUBLE})
+    out = q.collect(device=False)
+    got = {r["k"]: (r["nx"], r["ny"], r["sx"], r["sy"])
+           for r in out.to_pylist()}
+    assert got == {0: (2, 0, 1.0, 0.0), 1: (2, 1, 5.0, 0.0),
+                   2: (1, 2, 4.0, 30.0), 3: (0, 1, 0.0, 30.0)}
+    assert_tpu_cpu_equal(q)
+
+
 def test_map_in_pandas_composes_with_engine_ops(sess):
     df = sess.create_dataframe(pd.DataFrame({
         "x": np.arange(100, dtype=np.int64)}), num_partitions=2)
