@@ -48,6 +48,23 @@ def _segmented_scan(vals: jax.Array, new_seg: jax.Array, op) -> jax.Array:
     return out
 
 
+def _eq_prev_values(values, lengths=None) -> jax.Array:
+    """Per-row equality with the previous row (Spark grouping semantics:
+    NaN == NaN, -0.0 == 0.0); string columns compare the full byte row +
+    length so zero padding can't conflate "ab" with "ab\x00"."""
+    v = values
+    if v.ndim == 2:  # string/binary byte matrix
+        eq = jnp.all(v == jnp.roll(v, 1, axis=0), axis=1)
+        if lengths is not None:
+            eq = jnp.logical_and(eq, lengths == jnp.roll(lengths, 1))
+        return eq
+    if jnp.issubdtype(v.dtype, jnp.floating):
+        v = jnp.where(v == 0, jnp.zeros_like(v), v)
+        return (v == jnp.roll(v, 1)) \
+            | (jnp.isnan(v) & jnp.isnan(jnp.roll(v, 1)))
+    return v == jnp.roll(v, 1)
+
+
 def _seg_info(table: DeviceTable, part_names: List[str]):
     """Assumes rows already sorted by partition keys: returns
     (new_seg flags, seg_start index per row, pos, pos_in_seg)."""
@@ -57,12 +74,7 @@ def _seg_info(table: DeviceTable, part_names: List[str]):
     new_seg = jnp.zeros(cap, dtype=bool).at[0].set(True)
     for k in part_names:
         c = table.column(k)
-        v = c.data
-        if jnp.issubdtype(v.dtype, jnp.floating):
-            v = jnp.where(v == 0, jnp.zeros_like(v), v)
-            eq = (v == jnp.roll(v, 1)) | (jnp.isnan(v) & jnp.isnan(jnp.roll(v, 1)))
-        else:
-            eq = v == jnp.roll(v, 1)
+        eq = _eq_prev_values(c.data, c.lengths)
         null = jnp.logical_not(c.validity)
         eq = jnp.where(null | jnp.roll(null, 1), null & jnp.roll(null, 1), eq)
         new_seg = jnp.logical_or(new_seg, jnp.logical_not(eq).at[0].set(True))
@@ -86,12 +98,7 @@ def _peer_flags(table: DeviceTable, orders: Sequence[SortOrder],
     neq = jnp.zeros(table.capacity, dtype=bool)
     for o in orders:
         c = o.expr.eval(ctx)
-        v = c.values
-        if jnp.issubdtype(v.dtype, jnp.floating):
-            v = jnp.where(v == 0, jnp.zeros_like(v), v)
-            eq = (v == jnp.roll(v, 1)) | (jnp.isnan(v) & jnp.isnan(jnp.roll(v, 1)))
-        else:
-            eq = v == jnp.roll(v, 1)
+        eq = _eq_prev_values(c.values, getattr(c, "lengths", None))
         valid = c.validity if c.validity is not None \
             else jnp.ones(table.capacity, dtype=bool)
         null = jnp.logical_not(valid)

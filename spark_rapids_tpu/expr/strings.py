@@ -1037,48 +1037,54 @@ class GetJsonObject(Expression):
         return GetJsonObject(children[0], children[1])
 
     @staticmethod
-    def _extract(doc: str, path: str):
-        import json as _json
+    def _parse_path(path):
+        """Validate + tokenize ONCE (the path is a literal; per-row
+        re-parsing was pure waste). -> token list or None for malformed
+        paths (Spark returns null rather than best-effort parsing)."""
         import re as _re
-        if not isinstance(doc, str) or not isinstance(path, str):
+        if not isinstance(path, str) \
+                or not _re.fullmatch(r"\$(?:\.[A-Za-z0-9_]+|\[\d+\])*", path):
             return None
-        # the WHOLE path must match the grammar: Spark returns null for
-        # malformed paths ("$x", "$.a??") rather than best-effort parsing
-        if not _re.fullmatch(r"\$(?:\.[A-Za-z0-9_]+|\[\d+\])*", path):
+        return [(key if key else None, int(idx) if idx else None)
+                for key, idx in
+                _re.findall(r"\.([A-Za-z0-9_]+)|\[(\d+)\]", path)]
+
+    @staticmethod
+    def _extract(doc, tokens):
+        import json as _json
+        if not isinstance(doc, str):
             return None
         try:
             cur = _json.loads(doc)
         except Exception:
             return None
-        for tok in _re.findall(r"\.([A-Za-z0-9_]+)|\[(\d+)\]", path):
-            key, idx = tok
-            if key:
+        for key, idx in tokens:
+            if key is not None:
                 if not isinstance(cur, dict) or key not in cur:
                     return None
                 cur = cur[key]
             else:
-                i = int(idx)
-                if not isinstance(cur, list) or i >= len(cur):
+                if not isinstance(cur, list) or idx >= len(cur):
                     return None
-                cur = cur[i]
+                cur = cur[idx]
         if cur is None:
             return None
         if isinstance(cur, str):
             return cur
-        import json as _json
         return _json.dumps(cur, separators=(",", ":"))
 
     def eval(self, ctx):
         import numpy as np
         jc = self.json.eval(ctx)
-        pv = literal_value(self.path)
+        tokens = self._parse_path(literal_value(self.path))
         n = len(jc.values)
         out = np.empty(n, dtype=object)
         validity = np.ones(n, dtype=bool)
         jvalid = jc.validity if jc.validity is not None \
             else np.ones(n, dtype=bool)
         for i in range(n):
-            r = self._extract(jc.values[i], pv) if jvalid[i] and pv else None
+            r = self._extract(jc.values[i], tokens) \
+                if jvalid[i] and tokens is not None else None
             if r is None:
                 validity[i] = False
                 out[i] = ""

@@ -501,14 +501,10 @@ def _register_exec_rules():
                             kt, (dt.DateType, dt.TimestampType))):
                         meta.cannot_run(f"bounded RANGE order key {kt!r} "
                                         "not numeric")
-            for e in w.spec.partition_exprs:
-                if isinstance(e.data_type, (dt.StringType, dt.BinaryType)):
-                    meta.cannot_run("string partition keys not supported on "
-                                    "device window")
-            for o in w.spec.orders:
-                if isinstance(o.expr.data_type, (dt.StringType, dt.BinaryType)):
-                    meta.cannot_run("string order keys not supported on "
-                                    "device window")
+            # string partition/order keys run on device: sorting packs them
+            # into uint64 key words (columnar/device.py
+            # pack_string_key_words) and segment/peer detection compares
+            # byte rows (exec/window.py _eq_prev_values)
             if isinstance(w.fn, (Sum, Min, Max, Count, Average)) \
                     and w.fn.children:
                 if isinstance(w.fn.children[0].data_type,

@@ -223,9 +223,13 @@ def _plan(node: LogicalPlan, conf: RapidsConf,
         from ..udf.python_exec import CpuCoGroupedMapPandasExec
         left = _plan(node.left, conf, None)
         right = _plan(node.right, conf, None)
-        # both sides must agree on partition placement of matching keys
-        left = ShuffleExchangeExec(left, HashPartitioning(node.lkeys, nparts))
-        right = ShuffleExchangeExec(right, HashPartitioning(node.rkeys, nparts))
+        # both sides must agree on partition placement of matching keys;
+        # two single-partition inputs are trivially co-located already
+        if left.num_partitions > 1 or right.num_partitions > 1:
+            left = ShuffleExchangeExec(
+                left, HashPartitioning(node.lkeys, nparts))
+            right = ShuffleExchangeExec(
+                right, HashPartitioning(node.rkeys, nparts))
         return CpuCoGroupedMapPandasExec(left, right, node.lkeys, node.rkeys,
                                          node.fn, node.schema)
 

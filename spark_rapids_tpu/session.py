@@ -620,15 +620,9 @@ class GroupedData:
         """``fn(pandas.DataFrame) -> pandas.DataFrame`` once per key group
         (PySpark applyInPandas; reference: GpuFlatMapGroupsInPandasExec).
         ``schema`` is a dict of output column name -> DataType."""
-        from .expr.base import AttributeReference
         from .plan.logical import LogicalGroupedMapPandas
         from .plan.schema import Field, Schema
-        keys = []
-        for g in self.groupings:
-            if not isinstance(g, AttributeReference):
-                raise TypeError("applyInPandas grouping must be plain "
-                                f"column references, got {g!r}")
-            keys.append(g.column_name)
+        keys = self._key_names("applyInPandas")
         out = Schema([Field(n, d, True) for n, d in schema.items()])
         return DataFrame(self.df.session, LogicalGroupedMapPandas(
             self.df.logical, keys, fn, out))
@@ -640,12 +634,12 @@ class GroupedData:
         cogroup; reference: GpuFlatMapCoGroupsInPandasExec)."""
         return CoGroupedData(self, other)
 
-    def _key_names(self):
+    def _key_names(self, what: str = "cogroup"):
         from .expr.base import AttributeReference
         keys = []
         for g in self.groupings:
             if not isinstance(g, AttributeReference):
-                raise TypeError("cogroup grouping must be plain column "
+                raise TypeError(f"{what} grouping must be plain column "
                                 f"references, got {g!r}")
             keys.append(g.column_name)
         return keys

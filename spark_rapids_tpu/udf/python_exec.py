@@ -219,24 +219,27 @@ class CpuMapInPandasExec(PhysicalPlan):
         self.schema = schema
 
     def execute(self, pidx: int) -> Iterator[HostTable]:
-        # PySpark contract: fn is called ONCE per partition with an iterator
-        # over ALL of the partition's frames (a stateful fn draining the
-        # iterator must see the whole partition). Frames materialize first
-        # so the engine work happens while the semaphore is still held.
+        # PySpark contract: fn is called ONCE per partition — INCLUDING
+        # empty partitions (it may emit per-partition rows) — with an
+        # iterator over ALL of the partition's frames. Frames materialize
+        # first so the engine work happens while the semaphore is held;
+        # OUTPUT frames stream one at a time (the semaphore is re-acquired
+        # only around the conform/yield of each output).
         frames = [b.to_arrow().to_pandas()
                   for b in self.child.execute(pidx)]
-        if not frames:
-            return
         sem = get_semaphore()
         sem.release_if_held()
         try:
-            outs = list(self.fn(iter(frames)))
+            for out in self.fn(iter(frames)):
+                if out is None or not len(out):
+                    continue
+                sem.acquire_if_necessary()
+                try:
+                    yield _conform_to_schema(out, self.schema)
+                finally:
+                    sem.release_if_held()
         finally:
             sem.acquire_if_necessary()
-        for out in outs:
-            if out is None or not len(out):
-                continue
-            yield _conform_to_schema(out, self.schema)
 
     def node_desc(self):
         return getattr(self.fn, "__name__", "fn")
@@ -266,17 +269,20 @@ class CpuGroupedMapPandasExec(PhysicalPlan):
         if not len(pdf):
             return
         sem = get_semaphore()
-        outs = []
         sem.release_if_held()
         try:
+            # one fn call per group, outputs streamed (not accumulated)
             for _, group in pdf.groupby(self.keys, sort=False, dropna=False):
-                outs.append(self.fn(group))
+                out = self.fn(group)
+                if out is None or not len(out):
+                    continue
+                sem.acquire_if_necessary()
+                try:
+                    yield _conform_to_schema(out, self.schema)
+                finally:
+                    sem.release_if_held()
         finally:
             sem.acquire_if_necessary()
-        for out in outs:
-            if out is None or not len(out):
-                continue
-            yield _conform_to_schema(out, self.schema)
 
     def node_desc(self):
         return f"keys={self.keys} fn={getattr(self.fn, '__name__', 'fn')}"
@@ -328,18 +334,20 @@ class CpuCoGroupedMapPandasExec(PhysicalPlan):
         keys = list(lgroups)
         keys += [k for k in rgroups if k not in lgroups]
         sem = get_semaphore()
-        outs = []
         sem.release_if_held()
         try:
             for k in keys:
-                outs.append(self.fn(lgroups.get(k, lempty),
-                                    rgroups.get(k, rempty)))
+                out = self.fn(lgroups.get(k, lempty),
+                              rgroups.get(k, rempty))
+                if out is None or not len(out):
+                    continue
+                sem.acquire_if_necessary()
+                try:
+                    yield _conform_to_schema(out, self.schema)
+                finally:
+                    sem.release_if_held()
         finally:
             sem.acquire_if_necessary()
-        for out in outs:
-            if out is None or not len(out):
-                continue
-            yield _conform_to_schema(out, self.schema)
 
     def node_desc(self):
         return f"keys={self.lkeys}/{self.rkeys}"

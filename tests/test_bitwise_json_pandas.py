@@ -179,6 +179,24 @@ def test_map_in_pandas_iterator_spans_whole_partition(sess):
     assert sorted(calls) == sorted(out.column("n").to_pylist())
 
 
+def test_map_in_pandas_runs_on_empty_partitions(sess):
+    """PySpark calls the fn for EMPTY partitions too — it may emit
+    per-partition rows (headers/sentinels)."""
+    # 3 rows over 4 partitions -> at least one empty partition
+    df = sess.create_dataframe(pd.DataFrame({
+        "a": np.arange(3, dtype=np.int64)}), num_partitions=4)
+
+    def sentinel(frames):
+        n = sum(len(f) for f in frames)
+        yield pd.DataFrame({"n": [n]})
+
+    q = df.map_in_pandas(sentinel, {"n": dt.LONG})
+    out = q.collect(device=False)
+    assert out.num_rows == 4            # one row per partition, empty incl.
+    assert sum(out.column("n").to_pylist()) == 3
+    assert 0 in out.column("n").to_pylist()
+
+
 def test_cogroup_matches_null_keys(sess):
     """Null keys become pandas NaN; both sides' null groups must meet in
     ONE fn call (NaN != NaN would split them)."""

@@ -245,3 +245,43 @@ def test_bounded_range_large_long_keys(session):
     out = assert_tpu_cpu_equal(df.with_column("s", fsum(col("v")).over(w)),
                                ignore_order=False)
     assert out.column("s").to_pylist() == [3.0, 2.0, 4.0]
+
+
+def test_string_partition_keys_on_device(session, rng):
+    """String partition keys run on device: the sort packs them to uint64
+    key words and segment detection compares byte rows (+ length, so "ab"
+    and "ab\\x00" stay distinct partitions)."""
+    t = data_gen(rng, 300, {"k": "string", "v": ("int64", 0, 50),
+                            "x": "float64"}, null_prob=0.1)
+    df = session.create_dataframe(t, num_partitions=2)
+    w = Window.partition_by("k").order_by(col("v").asc(), col("x").asc())
+    q = (df.with_column("rn", row_number().over(w))
+           .with_column("s", fsum(col("v")).over(Window.partition_by("k"))))
+    assert_tpu_cpu_equal(q)
+    plan = session._physical(q.logical, True)
+    from spark_rapids_tpu.plan.aqe import AdaptiveExec
+    if isinstance(plan, AdaptiveExec):
+        plan = plan.final_plan()
+
+    def has(p, name):
+        subs = list(p.children)
+        for a in ("inner", "stage"):
+            sub = getattr(p, a, None)
+            if sub is not None:
+                subs.append(sub)
+        return type(p).__name__ == name or any(has(c, name) for c in subs)
+    assert has(plan, "TpuWindowExec"), plan.tree_string()
+
+
+def test_string_order_keys_peer_groups(session):
+    """String ORDER keys: rank/dense_rank peer groups split on byte-row
+    equality, including the embedded-NUL edge."""
+    t = pa.table({
+        "k": [1, 1, 1, 1, 1, 2, 2],
+        "s": ["ab", "ab", "ab\x00", "b", None, "z", "z"],
+    })
+    df = session.create_dataframe(t, num_partitions=2)
+    w = Window.partition_by("k").order_by(col("s").asc())
+    q = df.with_column("r", rank().over(w)) \
+          .with_column("dr", dense_rank().over(w))
+    assert_tpu_cpu_equal(q)
