@@ -278,10 +278,15 @@ class XxHash64(Expression):
             c = child.eval(ctx)
             d = c.dtype
             if isinstance(d, (dt.StringType, dt.BinaryType)):
-                # host-only (tagged not-device at planning time)
-                nh = np.asarray([_xx_bytes_host(
-                    s.encode() if isinstance(s, str) else bytes(s), int(sd))
-                    for s, sd in zip(c.values, np.asarray(h))], dtype=np.uint64)
+                if ctx.is_device:
+                    # vectorized device kernel over the byte matrix
+                    nh = _xx_bytes_device(c.values, c.lengths, h)
+                else:
+                    nh = np.asarray([_xx_bytes_host(
+                        s.encode() if isinstance(s, str) else bytes(s),
+                        int(sd))
+                        for s, sd in zip(c.values, np.asarray(h))],
+                        dtype=np.uint64)
             elif isinstance(d, dt.BooleanType):
                 nh = _xx_int(xp, c.values.astype(xp.uint32), h)
             elif isinstance(d, (dt.ByteType, dt.ShortType, dt.IntegerType,
@@ -303,6 +308,98 @@ class XxHash64(Expression):
                 raise TypeError(f"xxhash64 of {d!r} not supported")
             h = xp.where(c.valid_mask(ctx), nh, h)
         return EvalCol(h.view(xp.int64), None, dt.LONG)
+
+
+def _xx_bytes_device(data, lengths, seeds):
+    """Vectorized XXH64.hashUnsafeBytes over a (cap, w) uint8 byte matrix
+    with per-row lengths — bit-identical to ``_xx_bytes_host`` (asserted by
+    tests). Every loop below is STATIC over the padded width; per-row
+    participation is masked, so one jit handles all lengths in the batch:
+
+    - stripe phase: 32-byte stripes = 4 consecutive u64 words; stripe t is
+      active for rows with t < len//32
+    - 8-byte phase: word j participates when 32*(len//32) <= 8j and
+      8j+8 <= len
+    - 4-byte chunk at 8*(len//8) when len%8 >= 4 (word-aligned: the low
+      half of word len//8)
+    - <=3 tail bytes, gathered per row by dynamic index
+    """
+    import jax.numpy as jnp
+    cap, w = data.shape
+    n = lengths.astype(jnp.uint64)
+    u = jnp.uint64
+    seeds = seeds.astype(jnp.uint64)
+
+    def rotl(x, r):
+        return _rotl64(jnp, x, r)
+
+    # little-endian u64 words; zero padding beyond each row's length is
+    # masked out by the phase conditions below
+    nwords = max(1, (w + 7) // 8)
+    padded = jnp.pad(data, ((0, 0), (0, nwords * 8 - w)))
+    words = jnp.zeros((cap, nwords), dtype=jnp.uint64)
+    for byte in range(8):
+        words = words | (padded[:, byte::8].astype(jnp.uint64)
+                         << u(8 * byte))
+
+    # stripe phase
+    nstripes = (n // u(32)).astype(jnp.uint64)
+    v1 = seeds + u(_XXP1) + u(_XXP2)
+    v2 = seeds + u(_XXP2)
+    v3 = seeds
+    v4 = seeds - u(_XXP1)
+    for t in range(nwords // 4):
+        active = u(t) < nstripes
+
+        def lane(v, k):
+            upd = rotl(v + k * u(_XXP2), 31) * u(_XXP1)
+            return jnp.where(active, upd, v)
+        v1 = lane(v1, words[:, 4 * t])
+        v2 = lane(v2, words[:, 4 * t + 1])
+        v3 = lane(v3, words[:, 4 * t + 2])
+        v4 = lane(v4, words[:, 4 * t + 3])
+    merged = rotl(v1, 1) + rotl(v2, 7) + rotl(v3, 12) + rotl(v4, 18)
+    for v in (v1, v2, v3, v4):
+        merged = merged ^ (rotl(v * u(_XXP2), 31) * u(_XXP1))
+        merged = merged * u(_XXP1) + u(_XXP4)
+    h = jnp.where(n >= u(32), merged, seeds + u(_XXP5))
+    h = h + n
+
+    # 8-byte phase: words past the stripes, fully inside the length
+    base_word = u(4) * nstripes
+    for j in range(nwords):
+        active = (u(j) >= base_word) & (u(8 * j + 8) <= n)
+        k1 = words[:, j]
+        upd = h ^ (rotl(k1 * u(_XXP2), 31) * u(_XXP1))
+        upd = rotl(upd, 27) * u(_XXP1) + u(_XXP4)
+        h = jnp.where(active, upd, h)
+
+    # 4-byte chunk (word-aligned low half of word len//8)
+    has4 = (n % u(8)) >= u(4)
+    jj = jnp.clip(n // u(8), 0, nwords - 1).astype(jnp.int32)
+    word_jj = jnp.take_along_axis(words, jj[:, None], axis=1)[:, 0]
+    k32 = word_jj & u(0xFFFFFFFF)
+    upd = h ^ (k32 * u(_XXP1))
+    upd = rotl(upd, 23) * u(_XXP2) + u(_XXP3)
+    h = jnp.where(has4, upd, h)
+
+    # tail bytes (at most 3)
+    tail_start = u(8) * (n // u(8)) + jnp.where(has4, u(4), u(0))
+    for t in range(3):
+        p = tail_start + u(t)
+        active = p < n
+        idx = jnp.clip(p, 0, max(w - 1, 0)).astype(jnp.int32)
+        byte = jnp.take_along_axis(data, idx[:, None], axis=1)[:, 0] \
+            .astype(jnp.uint64) if w else jnp.zeros(cap, jnp.uint64)
+        upd = rotl(h ^ (byte * u(_XXP5)), 11) * u(_XXP1)
+        h = jnp.where(active, upd, h)
+
+    # final avalanche
+    h = h ^ (h >> u(33))
+    h = h * u(_XXP2)
+    h = h ^ (h >> u(29))
+    h = h * u(_XXP3)
+    return h ^ (h >> u(32))
 
 
 def _xx_bytes_host(b: bytes, seed: int) -> int:

@@ -248,15 +248,10 @@ def _register_misc_rules():
     _hashable = _device_common + TypeSig.of(TypeEnum.STRING)
     register_expr_rule(H.Murmur3Hash, _hashable)
 
-    def tag_xx(meta, conf):
-        for c in meta.expr.children:
-            try:
-                ct = c.data_type
-            except Exception:
-                continue
-            if isinstance(ct, (dt.StringType, dt.BinaryType)):
-                meta.cannot_run("xxhash64 over strings runs on host only")
-    register_expr_rule(H.XxHash64, _hashable, tag_fn=tag_xx)
+    # strings hash on device via the vectorized byte-matrix XXH64 kernel
+    # (expr/hashing.py _xx_bytes_device; bit-identical to the host scalar)
+    register_expr_rule(H.XxHash64,
+                       _hashable + TypeSig.of(TypeEnum.BINARY))
     # bitwise family (reference: bitwise.scala rules) — And/Or/Xor inherit
     # the BinaryArithmetic rule via MRO; Not + shifts register explicitly
     from ..expr.arithmetic import (BitwiseNot, ShiftLeft, ShiftRight,

@@ -140,8 +140,32 @@ def test_xxhash64(session, rng):
     assert_tpu_cpu_equal(df.select(
         xxhash64(col("i64")).alias("x1"),
         xxhash64(col("i64"), col("f64")).alias("x2"),
-        xxhash64(col("s")).alias("xs"),       # host-only path
+        xxhash64(col("s")).alias("xs"),       # device byte-matrix kernel
+        xxhash64(col("s"), col("i64")).alias("xf"),  # fold across types
     ), ignore_order=False)
+
+
+def test_xxhash64_string_device_bit_identical(session):
+    """The device byte-matrix XXH64 kernel must match the scalar host
+    implementation bit-for-bit across every phase boundary of the
+    algorithm (stripe 32, word 8, chunk 4, tail bytes)."""
+    import numpy as _np
+    rng = _np.random.default_rng(7)
+    strs = []
+    for L in (0, 1, 3, 4, 5, 7, 8, 9, 15, 16, 31, 32, 33, 39, 40, 47, 48,
+              63, 64, 65, 100):
+        strs.append(bytes(rng.integers(32, 127, L,
+                                       dtype=_np.uint8)).decode("ascii"))
+    df = session.create_dataframe(pa.table({"s": strs}), num_partitions=2)
+    out = assert_tpu_cpu_equal(
+        df.select(col("s"), xxhash64(col("s")).alias("h")))
+    from spark_rapids_tpu.expr.hashing import _xx_bytes_host
+    got = {r["s"]: r["h"] for r in out.to_pylist()}
+    for s in strs:
+        expect = _xx_bytes_host(s.encode(), 42)
+        if expect >= 2 ** 63:
+            expect -= 2 ** 64
+        assert got[s] == expect, (len(s), got[s], expect)
 
 
 def test_ids_and_partitions(session):
