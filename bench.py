@@ -63,6 +63,21 @@ def _init_backend():
     (flagged in the metric name) instead of a traceback."""
     import jax
 
+    # persistent XLA compilation cache: repeat bench runs on the same
+    # workspace (and later rounds) skip recompiles of unchanged programs —
+    # the warm-up pass per query still keeps compiles out of timed runs
+    try:
+        cache_dir = os.environ.get(
+            "BENCH_XLA_CACHE",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         ".jax_compile_cache"))
+        if cache_dir:
+            os.makedirs(cache_dir, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception as e:  # cache is an optimization, never a failure
+        print(f"# compilation cache disabled: {e}", file=sys.stderr)
+
     if os.environ.get("BENCH_PLATFORM"):  # e.g. cpu — env JAX_PLATFORMS is
         jax.config.update("jax_platforms",  # ignored under the axon plugin
                           os.environ["BENCH_PLATFORM"])

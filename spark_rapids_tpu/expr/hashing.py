@@ -342,22 +342,32 @@ def _xx_bytes_device(data, lengths, seeds):
         words = words | (padded[:, byte::8].astype(jnp.uint64)
                          << u(8 * byte))
 
-    # stripe phase
+    # stripe phase as lax.scan (O(1) graph in the padded width, like
+    # _murmur3_string_device — unrolled loops would trace hundreds of ops
+    # for wide buckets and recompile per width)
+    import jax as _jax
     nstripes = (n // u(32)).astype(jnp.uint64)
-    v1 = seeds + u(_XXP1) + u(_XXP2)
-    v2 = seeds + u(_XXP2)
-    v3 = seeds
-    v4 = seeds - u(_XXP1)
-    for t in range(nwords // 4):
-        active = u(t) < nstripes
+    nstripe_max = max(1, (nwords + 3) // 4)
+    words4 = jnp.pad(words, ((0, 0), (0, nstripe_max * 4 - nwords)))
+    # (nstripe_max, 4, cap): scan consumes one stripe of 4 lanes per step
+    stripes = jnp.moveaxis(words4.reshape(cap, nstripe_max, 4), 0, -1)
+
+    def stripe_step(carry, xs):
+        v1, v2, v3, v4 = carry
+        t, ks = xs
+        active = t < nstripes
 
         def lane(v, k):
             upd = rotl(v + k * u(_XXP2), 31) * u(_XXP1)
             return jnp.where(active, upd, v)
-        v1 = lane(v1, words[:, 4 * t])
-        v2 = lane(v2, words[:, 4 * t + 1])
-        v3 = lane(v3, words[:, 4 * t + 2])
-        v4 = lane(v4, words[:, 4 * t + 3])
+        return (lane(v1, ks[0]), lane(v2, ks[1]),
+                lane(v3, ks[2]), lane(v4, ks[3])), None
+
+    init = (seeds + u(_XXP1) + u(_XXP2), seeds + u(_XXP2),
+            seeds, seeds - u(_XXP1))
+    (v1, v2, v3, v4), _ = _jax.lax.scan(
+        stripe_step, init,
+        (jnp.arange(nstripe_max, dtype=jnp.uint64), stripes))
     merged = rotl(v1, 1) + rotl(v2, 7) + rotl(v3, 12) + rotl(v4, 18)
     for v in (v1, v2, v3, v4):
         merged = merged ^ (rotl(v * u(_XXP2), 31) * u(_XXP1))
@@ -367,12 +377,17 @@ def _xx_bytes_device(data, lengths, seeds):
 
     # 8-byte phase: words past the stripes, fully inside the length
     base_word = u(4) * nstripes
-    for j in range(nwords):
-        active = (u(j) >= base_word) & (u(8 * j + 8) <= n)
-        k1 = words[:, j]
+
+    def word_step(h, xs):
+        j, k1 = xs
+        active = (j >= base_word) & (u(8) * j + u(8) <= n)
         upd = h ^ (rotl(k1 * u(_XXP2), 31) * u(_XXP1))
         upd = rotl(upd, 27) * u(_XXP1) + u(_XXP4)
-        h = jnp.where(active, upd, h)
+        return jnp.where(active, upd, h), None
+
+    h, _ = _jax.lax.scan(
+        word_step, h,
+        (jnp.arange(nwords, dtype=jnp.uint64), jnp.moveaxis(words, 0, -1)))
 
     # 4-byte chunk (word-aligned low half of word len//8)
     has4 = (n % u(8)) >= u(4)
@@ -394,12 +409,7 @@ def _xx_bytes_device(data, lengths, seeds):
         upd = rotl(h ^ (byte * u(_XXP5)), 11) * u(_XXP1)
         h = jnp.where(active, upd, h)
 
-    # final avalanche
-    h = h ^ (h >> u(33))
-    h = h * u(_XXP2)
-    h = h ^ (h >> u(29))
-    h = h * u(_XXP3)
-    return h ^ (h >> u(32))
+    return _xx_fmix(jnp, h)
 
 
 def _xx_bytes_host(b: bytes, seed: int) -> int:
