@@ -66,6 +66,26 @@ class Cast(Expression):
             return EvalCol(c.values.astype(to.np_dtype()), c.validity, to)
         if src.is_numeric and to.is_numeric and not isinstance(src, dt.DecimalType) \
                 and not isinstance(to, dt.DecimalType):
+            if src in (dt.FLOAT, dt.DOUBLE) and to.is_integral:
+                # Spark (Scala Double.toInt/toLong) semantics: truncate
+                # toward zero, SATURATE at the target range, NaN -> 0. Raw
+                # astype is undefined here and numpy/jax disagree (a fuzzer
+                # caught the divergence: numpy NaN->INT_MIN, jax NaN->0).
+                # Saturation happens in INTEGER space: float(INT64_MAX)
+                # rounds UP to 2^63, so a float clip alone still overflows.
+                np_to = to.np_dtype()
+                info = np.iinfo(np_to)
+                f = c.values.astype(xp.float64)
+                v = xp.trunc(f)
+                nan = xp.isnan(f)
+                big = v >= float(info.max)
+                small = v <= float(info.min)
+                safe = xp.where(nan | big | small, xp.zeros_like(v), v)
+                out = safe.astype(np_to)
+                out = xp.where(big, np.asarray(info.max, dtype=np_to), out)
+                out = xp.where(small, np.asarray(info.min, dtype=np_to), out)
+                return EvalCol(xp.where(nan, np.asarray(0, dtype=np_to),
+                                        out), c.validity, to)
             return EvalCol(c.values.astype(to.np_dtype()), c.validity, to)
         if isinstance(src, dt.DecimalType) and not isinstance(to, dt.DecimalType):
             scaled = c.values.astype(xp.float64) / (10.0 ** src.scale)

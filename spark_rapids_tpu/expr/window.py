@@ -175,6 +175,11 @@ class NTile(WindowFunction):
     def nullable(self):
         return False
 
+    def __repr__(self):
+        # n keys the compiled kernel (plan_signature); ntile(2) and
+        # ntile(4) must not share a cache entry
+        return f"NTile({self.n})"
+
 
 class Lag(WindowFunction):
     def __init__(self, child: Expression, offset: int = 1, default=None):
@@ -189,6 +194,12 @@ class Lag(WindowFunction):
     @property
     def data_type(self):
         return self.child.data_type
+
+    def __repr__(self):
+        # offset/default key the compiled kernel (plan_signature); lag(v,1)
+        # and lag(v,2) must not share a cache entry
+        return (f"{type(self).__name__}({self.child!r}, {self.offset}, "
+                f"{self.default!r})")
 
 
 class Lead(Lag):
@@ -227,7 +238,17 @@ class WindowExpression(Expression):
         return self.fn.nullable
 
     def __repr__(self):
-        return f"{self.fn!r} OVER ({self.spec.frame.describe()})"
+        # the FULL spec must appear: this repr keys the whole-stage compile
+        # cache (exec/window.py plan_signature), and two windows with the
+        # same function/frame but different partition/order columns are
+        # different kernels (a fuzzer caught the collision)
+        parts = ", ".join(repr(e) for e in self.spec.partition_exprs)
+        orders = ", ".join(
+            f"{o.expr!r} {'ASC' if o.ascending else 'DESC'} "
+            f"{'NF' if o.nulls_first else 'NL'}"
+            for o in self.spec.orders)
+        return (f"{self.fn!r} OVER (PARTITION BY [{parts}] "
+                f"ORDER BY [{orders}] {self.spec.frame.describe()})")
 
 
 def row_number() -> WindowFunction:

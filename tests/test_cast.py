@@ -110,3 +110,23 @@ def test_host_only_directions_fall_back(session):
     import datetime
     assert out.column("s2t").to_pylist() == \
         [datetime.datetime(2021, 1, 1, 10, 30), None]
+
+
+def test_float_to_int_cast_spark_semantics(session):
+    """cast(double as int/long): truncate toward zero, SATURATE at the
+    target range, NaN -> 0 (Scala Double.toInt semantics; raw astype is
+    platform-dependent — numpy maps NaN to INT_MIN, jax to 0)."""
+    t = pa.table({"v": [3.7, -3.7, float("nan"), float("inf"),
+                        float("-inf"), 1e18, -1e18, 0.0]})
+    df = session.create_dataframe(t)
+    q = df.select(col("v").cast(dt.INT).alias("i"),
+                  col("v").cast(dt.LONG).alias("l"),
+                  col("v").cast(dt.SHORT).alias("sh"))
+    out = assert_tpu_cpu_equal(q, ignore_order=False)
+    imin, imax = -2**31, 2**31 - 1
+    assert out.column("i").to_pylist() == [3, -3, 0, imax, imin, imax,
+                                           imin, 0]
+    lmax = 2**63 - 1
+    got_l = out.column("l").to_pylist()
+    assert got_l[2] == 0 and got_l[3] == lmax and got_l[4] == -2**63
+    assert out.column("sh").to_pylist()[3] == 2**15 - 1

@@ -285,3 +285,22 @@ def test_string_order_keys_peer_groups(session):
     q = df.with_column("r", rank().over(w)) \
           .with_column("dr", dense_rank().over(w))
     assert_tpu_cpu_equal(q)
+
+
+def test_lag_offsets_do_not_share_compiled_kernels(session):
+    """lag(v,1) and lag(v,2) (and ntile(2) vs ntile(4)) bake their
+    parameters into the compiled kernel closure; their plan signatures
+    must differ or the compile cache would serve the wrong kernel."""
+    t = pa.table({"k": [1, 1, 1, 1, 1], "v": [10.0, 20.0, 30.0, 40.0, 50.0]})
+    df = session.create_dataframe(t)
+    w = Window.partition_by("k").order_by(col("v").asc())
+    out1 = df.with_column("l", lag(col("v"), 1).over(w)) \
+        .collect(device=True).to_pandas().sort_values("v")
+    out2 = df.with_column("l", lag(col("v"), 2).over(w)) \
+        .collect(device=True).to_pandas().sort_values("v")
+    assert out1.l.tolist()[1:] == [10.0, 20.0, 30.0, 40.0]
+    assert out2.l.tolist()[2:] == [10.0, 20.0, 30.0]
+    n2 = df.with_column("nt", ntile(2).over(w)).collect(device=True)
+    n4 = df.with_column("nt", ntile(4).over(w)).collect(device=True)
+    assert max(n2.column("nt").to_pylist()) == 2
+    assert max(n4.column("nt").to_pylist()) == 4
