@@ -73,19 +73,24 @@ class Cast(Expression):
                 # caught the divergence: numpy NaN->INT_MIN, jax NaN->0).
                 # Saturation happens in INTEGER space: float(INT64_MAX)
                 # rounds UP to 2^63, so a float clip alone still overflows.
+                # SHORT/BYTE go through toInt then BIT-TRUNCATE (Scala
+                # Double.toShort == toInt.toShort): 1e9 -> short is -13824,
+                # not a saturated 32767.
                 np_to = to.np_dtype()
-                info = np.iinfo(np_to)
+                sat_np = np_to if to in (dt.INT, dt.LONG) else np.int32
+                info = np.iinfo(sat_np)
                 f = c.values.astype(xp.float64)
                 v = xp.trunc(f)
                 nan = xp.isnan(f)
                 big = v >= float(info.max)
                 small = v <= float(info.min)
                 safe = xp.where(nan | big | small, xp.zeros_like(v), v)
-                out = safe.astype(np_to)
-                out = xp.where(big, np.asarray(info.max, dtype=np_to), out)
-                out = xp.where(small, np.asarray(info.min, dtype=np_to), out)
-                return EvalCol(xp.where(nan, np.asarray(0, dtype=np_to),
-                                        out), c.validity, to)
+                out = safe.astype(sat_np)
+                out = xp.where(big, np.asarray(info.max, dtype=sat_np), out)
+                out = xp.where(small, np.asarray(info.min, dtype=sat_np),
+                               out)
+                out = xp.where(nan, np.asarray(0, dtype=sat_np), out)
+                return EvalCol(out.astype(np_to), c.validity, to)
             return EvalCol(c.values.astype(to.np_dtype()), c.validity, to)
         if isinstance(src, dt.DecimalType) and not isinstance(to, dt.DecimalType):
             scaled = c.values.astype(xp.float64) / (10.0 ** src.scale)

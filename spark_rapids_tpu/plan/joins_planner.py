@@ -10,7 +10,8 @@ from ..conf import RapidsConf
 from ..expr.base import AttributeReference, Expression
 from ..expr.predicates import And, EqualTo
 from .logical import LogicalJoin
-from .physical import HashPartitioning, PhysicalPlan, ShuffleExchangeExec
+from .physical import (CpuProjectExec, HashPartitioning, PhysicalPlan,
+                       ShuffleExchangeExec)
 from .physical_joins import (CpuBroadcastHashJoinExec,
                              CpuBroadcastNestedLoopJoinExec,
                              CpuShuffledHashJoinExec)
@@ -72,6 +73,61 @@ def extract_equi_keys(condition: Optional[Expression], lnames: Set[str],
     return lkeys, rkeys, res
 
 
+def _coerce_join_keys(left: PhysicalPlan, right: PhysicalPlan,
+                      lkeys, rkeys):
+    """Spark inserts implicit casts so both sides' join keys share one
+    type BEFORE hashing — without this, an int64 key and a float64 key with
+    equal values hash to DIFFERENT shuffle partitions and the co-partitioned
+    join silently drops matches (a fuzzer caught it: a dimension table that
+    round-tripped through pandas turned its int key into float64).
+
+    The casts live in HIDDEN ``__jk*`` columns so user-visible column types
+    are untouched (semi/anti return the left side's original types;
+    expression joins keep both originals) — USING joins coerce VISIBLY at
+    the logical layer instead (plan/logical.py _coerce_using_keys). Returns
+    (left, right, lkeys, rkeys, hidden): ``hidden`` names the temp columns
+    the caller must project away above the join."""
+    from ..columnar import dtypes as dt
+    from ..expr.arithmetic import numeric_promote
+    from ..expr.base import Alias, AttributeReference
+    from ..expr.cast import Cast
+
+    commons = {}
+    for i, (lk, rk) in enumerate(zip(lkeys, rkeys)):
+        lt = left.schema.field(lk).dtype
+        rt = right.schema.field(rk).dtype
+        if lt == rt or not (lt.is_numeric and rt.is_numeric) \
+                or isinstance(lt, dt.DecimalType) \
+                or isinstance(rt, dt.DecimalType):
+            continue
+        commons[i] = numeric_promote(lt, rt)
+    if not commons:
+        return left, right, list(lkeys), list(rkeys), []
+
+    def add_temps(plan: PhysicalPlan, keys, side):
+        exprs, names = [], []
+        for f in plan.schema:
+            exprs.append(AttributeReference(f.name, f.dtype, f.nullable))
+            names.append(f.name)
+        for i, common in commons.items():
+            k = keys[i]
+            f = plan.schema.field(k)
+            exprs.append(Alias(
+                Cast(AttributeReference(k, f.dtype, f.nullable), common),
+                f"__jk{side}{i}"))
+            names.append(f"__jk{side}{i}")
+        return CpuProjectExec(plan, exprs, names)
+
+    new_l = add_temps(left, lkeys, "l")
+    new_r = add_temps(right, rkeys, "r")
+    lkeys2 = [f"__jkl{i}" if i in commons else k
+              for i, k in enumerate(lkeys)]
+    rkeys2 = [f"__jkr{i}" if i in commons else k
+              for i, k in enumerate(rkeys)]
+    hidden = [f"__jk{s}{i}" for i in commons for s in ("l", "r")]
+    return new_l, new_r, lkeys2, rkeys2, hidden
+
+
 def plan_join(node: LogicalJoin, conf: RapidsConf,
               required: Optional[Set[str]], plan_fn, nparts: int) -> PhysicalPlan:
     lnames = set(node.left.schema.names)
@@ -91,6 +147,18 @@ def plan_join(node: LogicalJoin, conf: RapidsConf,
         rreq = refs & rnames
     left = plan_fn(node.left, conf, lreq)
     right = plan_fn(node.right, conf, rreq)
+    left, right, lkeys, rkeys, hidden = _coerce_join_keys(
+        left, right, lkeys, rkeys)
+
+    def strip_hidden(join: PhysicalPlan) -> PhysicalPlan:
+        if not hidden:
+            return join
+        from ..expr.base import AttributeReference
+        keep = [f for f in join.schema if f.name not in hidden]
+        return CpuProjectExec(
+            join, [AttributeReference(f.name, f.dtype, f.nullable)
+                   for f in keep], [f.name for f in keep])
+
     if lkeys:
         threshold = conf.get(BROADCAST_THRESHOLD)
         rsize = _estimate_subtree_bytes(node.right)
@@ -99,11 +167,11 @@ def plan_join(node: LogicalJoin, conf: RapidsConf,
         broadcastable = node.how in ("inner", "left", "left_semi", "left_anti")
         if broadcastable and threshold >= 0 and rsize is not None \
                 and rsize <= threshold:
-            return CpuBroadcastHashJoinExec(left, right, lkeys, rkeys,
-                                            node.how, residual, merge_keys)
+            return strip_hidden(CpuBroadcastHashJoinExec(
+                left, right, lkeys, rkeys, node.how, residual, merge_keys))
         if left.num_partitions > 1 or right.num_partitions > 1:
             left = ShuffleExchangeExec(left, HashPartitioning(lkeys, nparts))
             right = ShuffleExchangeExec(right, HashPartitioning(rkeys, nparts))
-        return CpuShuffledHashJoinExec(left, right, lkeys, rkeys, node.how,
-                                       residual, merge_keys)
+        return strip_hidden(CpuShuffledHashJoinExec(
+            left, right, lkeys, rkeys, node.how, residual, merge_keys))
     return CpuBroadcastNestedLoopJoinExec(left, right, node.how, node.condition)

@@ -168,6 +168,13 @@ class LogicalJoin(LogicalPlan):
         how = aliases.get(how, how)
         if how not in self.VALID_TYPES:
             raise ValueError(f"bad join type {how!r}")
+        if on and how not in ("left_semi", "left_anti"):
+            # Spark USING-join semantics: mismatched key types coerce BOTH
+            # sides to the common type and the OUTPUT key column carries it.
+            # Doing it here keeps the logical schema, the physical plan, and
+            # the shuffle hashing consistent (semi/anti keep the left side's
+            # original types — they coerce with hidden keys at plan time)
+            left, right = _coerce_using_keys(left, right, on)
         self.left, self.right = left, right
         self.children = (left, right)
         self.how = how
@@ -184,6 +191,41 @@ class LogicalJoin(LogicalPlan):
     @property
     def schema(self) -> Schema:
         return _join_schema(self.left.schema, self.right.schema, self.on, self.how)
+
+
+def _coerce_using_keys(left: LogicalPlan, right: LogicalPlan, on):
+    """Cast mismatched NUMERIC ``on=`` key columns on both sides to their
+    common type (Spark implicit cast insertion for USING joins)."""
+    from ..expr.arithmetic import numeric_promote
+    from ..expr.base import Alias, AttributeReference
+    from ..expr.cast import Cast
+    from ..columnar import dtypes as dt
+
+    casts_l, casts_r = {}, {}
+    for k in on:
+        lt = left.schema.field(k).dtype
+        rt = right.schema.field(k).dtype
+        if lt == rt or not (lt.is_numeric and rt.is_numeric) \
+                or isinstance(lt, dt.DecimalType) \
+                or isinstance(rt, dt.DecimalType):
+            continue
+        common = numeric_promote(lt, rt)
+        if lt != common:
+            casts_l[k] = common
+        if rt != common:
+            casts_r[k] = common
+
+    def apply(plan: LogicalPlan, casts):
+        if not casts:
+            return plan
+        exprs = []
+        for f in plan.schema:
+            ref = AttributeReference(f.name, f.dtype, f.nullable)
+            exprs.append(Alias(Cast(ref, casts[f.name]), f.name)
+                         if f.name in casts else ref)
+        return LogicalProject(plan, exprs)
+
+    return apply(left, casts_l), apply(right, casts_r)
 
 
 def _join_schema(ls: Schema, rs: Schema, on, how: str) -> Schema:

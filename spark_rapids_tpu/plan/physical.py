@@ -345,17 +345,27 @@ def _sort_indices(table: HostTable, orders: Sequence[SortOrder]) -> np.ndarray:
         if vals.dtype == object:
             codes = pd.factorize(vals, sort=True)[0].astype(np.int64) + 1
         elif vals.dtype.kind == "f":
-            # NaN sorts last among valid values (Spark)
-            order = np.argsort(vals, kind="stable")
-            codes = np.empty(len(vals), dtype=np.int64)
-            codes[order] = np.arange(len(vals))
-            nan = np.isnan(vals)
+            # DENSE codes: equal values MUST share a code, or a tied float
+            # key never defers to the later sort keys (argsort ranks are
+            # unique per row — a fuzzer caught multi-key sorts ignoring
+            # every key after a tied float). NaN sorts last (Spark);
+            # -0.0 == 0.0.
+            v = vals.copy()
+            v[v == 0] = 0.0
+            nan = np.isnan(v)
+            _, inv = np.unique(np.where(nan, np.inf, v),
+                               return_inverse=True)
+            codes = inv.reshape(-1).astype(np.int64)
             codes = np.where(nan, np.int64(2**62), codes)
         else:
             codes = vals.astype(np.int64) if vals.dtype != np.int64 else vals
         if not o.ascending:
             codes = -codes
-        null_code = np.int64(-(2**62)) if o.nulls_first else np.int64(2**62 + 1)
+        # null sentinel strictly beyond the NaN code EVEN AFTER negation:
+        # desc+nulls_first used to collide (-(2**62) == negated NaN code),
+        # interleaving NULL and NaN rows (Spark: NULL strictly outside)
+        null_code = np.int64(-(2**62) - 2) if o.nulls_first \
+            else np.int64(2**62 + 2)
         codes = np.where(valid, codes, null_code)
         keys.append(codes)
     return np.lexsort(keys) if keys else np.arange(table.num_rows)

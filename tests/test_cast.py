@@ -129,4 +129,10 @@ def test_float_to_int_cast_spark_semantics(session):
     lmax = 2**63 - 1
     got_l = out.column("l").to_pylist()
     assert got_l[2] == 0 and got_l[3] == lmax and got_l[4] == -2**63
-    assert out.column("sh").to_pylist()[3] == 2**15 - 1
+    # SHORT goes through toInt then BIT-TRUNCATES (Scala Double.toShort ==
+    # toInt.toShort): inf -> INT_MAX -> low 16 bits -> -1
+    assert out.column("sh").to_pylist()[3] == -1
+    q2 = df.select(col("v").cast(dt.SHORT).alias("sh2"))
+    big = session.create_dataframe(pa.table({"v": [1e9]})) \
+        .select(col("v").cast(dt.SHORT).alias("sh")).collect(device=False)
+    assert big.column("sh").to_pylist() == [-13824]  # 1e9.toInt.toShort

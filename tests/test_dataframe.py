@@ -99,3 +99,22 @@ def test_chained_query(df):
            .agg(fsum(col("v")).alias("sv"))
            .sort("k"))
     assert_tpu_cpu_equal(q, rel_tol=1e-6)
+
+
+def test_multi_key_sort_tied_float_defers_to_later_keys(session):
+    """A tied float PRIMARY key must defer to the secondary keys (dense
+    equal-value codes; per-row argsort ranks silently ignored every key
+    after a tied float — found by the plan fuzzer)."""
+    t = pa.table({
+        "f": pa.array([1.5, 1.5, 1.5, 0.5, 0.5, float("nan"), None]),
+        "i": pa.array([3, 1, 2, 9, 8, 1, 2], type=pa.int64()),
+    })
+    df = session.create_dataframe(t, num_partitions=2)
+    q = df.sort(col("f").asc(), col("i").asc())
+    for device in (False, True):
+        out = q.collect(device=device)
+        assert out.column("i").to_pylist() == [2, 8, 9, 1, 2, 3, 1], \
+            (device, out.column("i").to_pylist())
+        # null f first, then 0.5s (i asc), then 1.5s (i asc), NaN last
+        fs = out.column("f").to_pylist()
+        assert fs[0] is None and fs[-1] != fs[-1]

@@ -237,3 +237,34 @@ def test_bnlj_build_side_windowing(session, rng):
         c = cpu.to_pandas().sort_values(list(cpu.column_names)).reset_index(drop=True)
         import pandas.testing as pdt
         pdt.assert_frame_equal(d, c, check_dtype=False)
+
+
+def test_mixed_type_join_keys_coerce(session):
+    """int64 vs float64 join keys must hash to the same partitions (Spark
+    inserts implicit casts): USING joins output the COMMON type, semi/anti
+    keep the left side's ORIGINAL type (hidden-key coercion)."""
+    import pandas as pd
+    s2 = type(session)(session.conf.set(
+        "spark.rapids.tpu.autoBroadcastJoinThreshold", -1))
+    fact = s2.create_dataframe(pa.table({
+        "k": pa.array(np.arange(40, dtype=np.int64) % 10),
+        "v": pa.array(np.ones(40))}), num_partitions=3)
+    dim = s2.create_dataframe(pa.table({
+        "k": pa.array(np.arange(0, 10, 2, dtype=np.float64)),
+        "w": pa.array(np.arange(5, dtype=np.float64))}), num_partitions=2)
+    # USING inner join: every k in {0,2,4,6,8} matches (4 rows each)
+    j = fact.join(dim, on="k")
+    assert str(j.schema.field("k").dtype) == "double"  # common type
+    out = assert_tpu_cpu_equal(j)
+    assert out.num_rows == 20
+    # full join: 20 matches + 20 unmatched fact rows
+    jf = fact.join(dim, on="k", how="full")
+    assert assert_tpu_cpu_equal(jf).num_rows == 40
+    # semi/anti: left types preserved, matching still works
+    js = fact.join(dim, on="k", how="left_semi")
+    assert str(js.schema.field("k").dtype) == "bigint"
+    out_s = assert_tpu_cpu_equal(js)
+    assert out_s.num_rows == 20
+    assert str(out_s.schema.field("k").type) == "int64"
+    ja = fact.join(dim, on="k", how="left_anti")
+    assert assert_tpu_cpu_equal(ja).num_rows == 20
