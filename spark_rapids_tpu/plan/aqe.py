@@ -208,10 +208,16 @@ class SplitStageReader(PhysicalPlan):
         # chunks remaining per sliced partition: the concat cache drops as
         # soon as its last chunk is consumed (only SKEWED partitions are
         # cached; pass-through entries stream straight from the stage)
-        self._remaining = {}
-        for orig, lo, hi in entries:
+        # chunk indices per sliced partition + the set consumed since the
+        # last eviction: the cache is evicted exactly when every chunk has
+        # been read at least once (a full pass), so re-execution passes
+        # (a join probe re-reading its build side) reuse the concat
+        # instead of thrashing, and a partial retry can't double-evict
+        self._chunk_ids: dict = {}
+        for idx, (orig, lo, hi) in enumerate(entries):
             if not (lo == 0 and hi < 0):
-                self._remaining[orig] = self._remaining.get(orig, 0) + 1
+                self._chunk_ids.setdefault(orig, set()).add(idx)
+        self._consumed: dict = {}
 
     @property
     def num_partitions(self) -> int:
@@ -229,9 +235,11 @@ class SplitStageReader(PhysicalPlan):
             yield from self.stage.execute(orig)
             return
         t = self._partition_table(orig)
-        self._remaining[orig] -= 1
-        if self._remaining[orig] <= 0:
+        seen = self._consumed.setdefault(orig, set())
+        seen.add(pidx)
+        if seen >= self._chunk_ids[orig]:  # full pass complete → evict
             self._cache.pop(orig, None)
+            seen.clear()
         if t is None:
             return
         hi = t.num_rows if hi < 0 else min(hi, t.num_rows)
@@ -289,8 +297,17 @@ def materialize_stage(cpu_exchange: ShuffleExchangeExec, conf: RapidsConf,
                 nbytes.append(0)
                 continue
             t = h.get()
-            rows.append(int(t.num_rows))
-            nbytes.append(sum(int(c.data.nbytes) for c in t.columns))
+            nrows = int(t.num_rows)
+            rows.append(nrows)
+            # buffers are capacity-padded (pow2 buckets, min 1024 rows);
+            # scale to the compacted row count so device-tier stats are
+            # comparable with the host tier's true bytes — otherwise tiny
+            # build sides look big and suppress AQE broadcast demotion
+            est = 0
+            for c in t.columns:
+                cap = max(int(c.data.shape[0]), 1)
+                est += int(c.data.nbytes) * nrows // cap
+            nbytes.append(est)
         stats = PartitionStats(rows, nbytes)
     else:
         assert isinstance(converted, ShuffleExchangeExec), type(converted)

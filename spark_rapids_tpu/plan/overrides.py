@@ -256,8 +256,12 @@ def _register_misc_rules():
     # the BinaryArithmetic rule via MRO; Not + shifts register explicitly
     from ..expr.arithmetic import (BitwiseNot, ShiftLeft, ShiftRight,
                                    ShiftRightUnsigned)
-    for cls in (BitwiseNot, ShiftLeft, ShiftRight, ShiftRightUnsigned):
-        register_expr_rule(cls, TypeSig.integral)
+    register_expr_rule(BitwiseNot, TypeSig.integral)
+    # shifts accept only INT/LONG values (Spark's ShiftLeft input types;
+    # _ShiftBase.data_type rejects byte/short) — narrower sig keeps
+    # docs/supported_ops.md honest
+    for cls in (ShiftLeft, ShiftRight, ShiftRightUnsigned):
+        register_expr_rule(cls, TypeSig.of(TypeEnum.INT, TypeEnum.LONG))
 
     from ..expr.strings import GetJsonObject
     register_expr_rule(GetJsonObject, TypeSig.none(),

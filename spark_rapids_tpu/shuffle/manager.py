@@ -316,11 +316,16 @@ class ShuffleManager:
                 retried.add(map_id)
                 recompute(map_id)
                 pending = pending[pending.index(e.block):]
-        tables = [t for t in tables if t.num_columns and t.num_rows]
-        if not tables:
+        non_empty = [t for t in tables if t.num_columns and t.num_rows]
+        if not non_empty:
+            # all blocks empty: match the cached tier — yield a zero-row
+            # table with the schema when any schema-bearing block exists
+            schema_t = next((t for t in tables if t.num_columns), None)
+            if schema_t is not None:
+                yield DeviceTable.from_host(schema_t.slice(0, 0), min_bucket)
             return
         # host-side coalesce then single upload (GpuShuffleCoalesceExec)
-        merged = HostTable.concat(tables)
+        merged = HostTable.concat(non_empty)
         yield DeviceTable.from_host(merged, min_bucket)
 
     def _read_partition_cached(self, shuffle_id: int, num_maps: int,
@@ -332,6 +337,7 @@ class ShuffleManager:
         from ..columnar.device import concat_device_tables
         from .transport import ShuffleFetchFailedException
         parts: List[DeviceTable] = []
+        schema_holder: Optional[DeviceTable] = None
         for m in range(num_maps):
             key = (shuffle_id, m, reduce_id)
             handle = self.buffer_catalog.get(key)
@@ -343,8 +349,17 @@ class ShuffleManager:
                     BlockId(shuffle_id, m, reduce_id),
                     "block not in the shuffle buffer catalog")
             t = handle.get()
-            if t.num_columns and int(t.num_rows):
-                parts.append(t)
-        if not parts:
-            return
-        yield concat_device_tables(parts, min_bucket)
+            if t.num_columns:
+                if int(t.num_rows):
+                    parts.append(t)
+                elif schema_holder is None:
+                    schema_holder = t
+        if parts:
+            yield concat_device_tables(parts, min_bucket)
+        elif schema_holder is not None:
+            # all blocks empty: yield a zero-row table with the schema so
+            # this tier matches the transport tier's empty-partition shape;
+            # re-bucket to the READER's min_bucket (the stored block keeps
+            # the map-side write capacity, a one-off shape downstream)
+            yield DeviceTable.from_host(
+                schema_holder.to_host().slice(0, 0), min_bucket)
