@@ -44,6 +44,16 @@ SHUFFLE_MODE = register_conf(
     checker=lambda v: None if v in ("auto", "host", "ici")
     else f"must be one of auto/host/ici, got {v!r}")
 
+EXCHANGE_CHUNK_ROWS = register_conf(
+    "spark.rapids.tpu.shuffle.exchangeChunkRows",
+    "Max staged row capacity per device-exchange chunk. Child batches "
+    "stream through the ICI all-to-all in bounded chunks instead of one "
+    "concat of the entire input, so the exchange stays out-of-core: only "
+    "one chunk is staged on devices at a time and finished output shards "
+    "can spill (reference: the streaming per-batch exchange, "
+    "GpuShuffleExchangeExecBase.scala:146).", 1 << 19,
+    checker=lambda v: None if int(v) > 0 else "must be positive")
+
 
 def pad_table_capacity(table: DeviceTable, capacity: int) -> DeviceTable:
     """Grow a table's padded capacity (new slots masked off)."""
@@ -67,7 +77,8 @@ class TpuShuffleExchangeExec(TpuExec):
     """Hash exchange as a mesh collective; output partition = mesh shard."""
 
     def __init__(self, child: PhysicalPlan, partitioning: HashPartitioning,
-                 mesh, min_bucket: int = 1024, axis: str = "dp"):
+                 mesh, min_bucket: int = 1024, axis: str = "dp",
+                 chunk_rows: int = 1 << 19):
         super().__init__()
         self.child = child
         self.children = (child,)
@@ -75,8 +86,10 @@ class TpuShuffleExchangeExec(TpuExec):
         self.mesh = mesh
         self.axis = axis
         self.min_bucket = min_bucket
+        self.chunk_rows = max(int(chunk_rows), 1)
         self.schema = child.schema
-        self._shards: Optional[List] = None  # spill handles per partition
+        # spill handles per partition, one per exchanged chunk
+        self._shards: Optional[List[List]] = None
 
     @property
     def num_partitions(self) -> int:
@@ -90,60 +103,99 @@ class TpuShuffleExchangeExec(TpuExec):
         self._materialize()
         from ..io.file_block import clear_input_file
         clear_input_file()  # post-shuffle rows have no single source file
-        handle = self._shards[pidx]
-        if handle is not None:
+        for handle in self._shards[pidx]:
             yield handle.get()
 
     # -- the exchange ---------------------------------------------------------
     def _materialize(self) -> None:
+        """Stream child batches through the all-to-all in bounded chunks.
+
+        Only one chunk's input is staged on devices at a time (the in-
+        flight chunk is catalog-registered at ACTIVE priority so earlier
+        output shards spill first when the budget tightens), keeping the
+        exchange out-of-core — the operator that sees the most data must
+        not require the whole input resident (reference: per-batch
+        streaming in GpuShuffleExchangeExecBase.scala:146)."""
         if self._shards is not None:
             return
+        n = self.num_partitions
+        shards: List[List] = [[] for _ in range(n)]
+        total_rows = 0
+        # NOTE: child batch consumption stays OUTSIDE the op timer — the
+        # upstream pipeline accounts its own opTime; only the exchange
+        # work (concat/count/all-to-all, inside _exchange_chunk) is ours
+        pending: List[DeviceTable] = []
+        staged = 0
+        for p in range(self.child.num_partitions):
+            for b in self.child_device_batches(p):
+                if not int(b.num_rows):
+                    continue
+                pending.append(b)
+                staged += b.capacity
+                if staged >= self.chunk_rows:
+                    total_rows += self._exchange_chunk(pending, shards)
+                    pending, staged = [], 0
+        if pending:
+            total_rows += self._exchange_chunk(pending, shards)
+        self._shards = shards
+        self.metrics.add(M.NUM_OUTPUT_BATCHES,
+                         sum(len(s) for s in shards))
+        self.metrics.add(M.NUM_OUTPUT_ROWS, total_rows)
+
+    def _exchange_chunk(self, batches: List[DeviceTable],
+                        shards: List[List]) -> int:
+        """All-to-all one bounded chunk; append per-partition spill handles.
+
+        Only this method sits inside the op timer — child batch
+        production accounts its own opTime upstream."""
+        import weakref
+
+        from ..memory.catalog import SpillPriorities, get_catalog
         from ..shuffle.ici import ici_all_to_all_exchange, shard_table
+        from ..shuffle.manager import device_partition_ids
 
         n = self.num_partitions
-        batches: List[DeviceTable] = []
-        for p in range(self.child.num_partitions):
-            batches.extend(self.child_device_batches(p))
-        if not batches:
-            self._shards = [None] * n
-            return
+        catalog = get_catalog()
         with self.metrics.timed(M.OP_TIME):
             table = concat_device_tables(batches, self.min_bucket)
             per_shard = bucket_rows(
                 max(1, -(-table.capacity // n)), self.min_bucket)
             table = pad_table_capacity(table, per_shard * n)
+            # account the in-flight chunk: registration's budget check
+            # spills already-finished output shards down-tier to make room
+            inflight = catalog.register(table,
+                                        SpillPriorities.ACTIVE_ON_DECK)
+            try:
+                # count pass: partition ids only (4 bytes/row) -> quota
+                keys = self.partitioning.key_names
+                pid = jax.jit(lambda t: jnp.where(
+                    t.row_mask, device_partition_ids(t, keys, n), n))(table)
+                pid_host = np.asarray(jax.device_get(pid))
+                src = np.arange(table.capacity) // per_shard
+                active = pid_host < n
+                counts = np.zeros((n, n), dtype=np.int64)
+                np.add.at(counts, (src[active], pid_host[active]), 1)
+                max_cnt = int(counts.max()) if active.any() else 1
+                quota = min(per_shard, bucket_rows(max_cnt, self.min_bucket))
 
-            # count pass: partition ids only (4 bytes/row) -> quota
-            from ..shuffle.manager import device_partition_ids
-            keys = self.partitioning.key_names
-            pid = jax.jit(lambda t: jnp.where(
-                t.row_mask, device_partition_ids(t, keys, n), n))(table)
-            pid_host = np.asarray(jax.device_get(pid))
-            src = np.arange(table.capacity) // per_shard
-            active = pid_host < n
-            counts = np.zeros((n, n), dtype=np.int64)
-            np.add.at(counts, (src[active], pid_host[active]), 1)
-            max_cnt = int(counts.max()) if active.any() else 1
-            quota = min(per_shard, bucket_rows(max_cnt, self.min_bucket))
-
-            sharded = shard_table(table, self.mesh, self.axis)
-            del table, batches
-            exchanged = ici_all_to_all_exchange(
-                sharded, keys, self.mesh, self.axis, quota=quota)
-            # register output shards so the catalog accounts for them and can
-            # spill them after downstream consumption; finalizer releases the
-            # entries when the plan is garbage-collected
-            import weakref
-            from ..memory.catalog import SpillPriorities, get_catalog
-            catalog = get_catalog()
-            shards = []
-            for t in _split_sharded(exchanged, n):
-                h = catalog.register(t, SpillPriorities.OUTPUT_FOR_SHUFFLE)
-                weakref.finalize(self, _close_quietly, h)
-                shards.append(h)
-            self._shards = shards
-        self.metrics.add(M.NUM_OUTPUT_BATCHES, n)
-        self.metrics.add(M.NUM_OUTPUT_ROWS, int(jnp.sum(exchanged.row_mask)))
+                sharded = shard_table(table, self.mesh, self.axis)
+                del table, batches
+                exchanged = ici_all_to_all_exchange(
+                    sharded, keys, self.mesh, self.axis, quota=quota)
+                # register output shards so the catalog accounts for them
+                # and can spill them until downstream consumption;
+                # finalizer releases the entries when the plan is
+                # garbage-collected
+                for i, t in enumerate(_split_sharded(exchanged, n)):
+                    if not int(t.num_rows):
+                        continue
+                    h = catalog.register(
+                        t, SpillPriorities.OUTPUT_FOR_SHUFFLE)
+                    weakref.finalize(self, _close_quietly, h)
+                    shards[i].append(h)
+                return int(jnp.sum(exchanged.row_mask))
+            finally:
+                inflight.close()
 
 
 def _close_quietly(handle):

@@ -16,10 +16,12 @@ design, mapped onto XLA's static-shape world:
   cumsum.
 
 Supported (everything else falls back per COLUMN to pyarrow + upload):
-flat columns (no repetition), physical BOOLEAN/INT32/INT64/FLOAT/DOUBLE,
-data-page v1 with PLAIN or RLE_DICTIONARY values, any pyarrow-
-decompressible codec. Output is bit-identical to the host path
-(DeviceTable.from_host of the pyarrow read).
+flat columns (no repetition), physical BOOLEAN/INT32/INT64/FLOAT/DOUBLE/
+BYTE_ARRAY (strings/binary via the bucketed byte-matrix layout), data-page
+v1 AND v2, PLAIN or RLE_DICTIONARY values including chunks whose pages
+switch dictionary->plain mid-chunk (the pyarrow dictionary-overflow
+fallback), any pyarrow-decompressible codec. Output is bit-identical to
+the host path (DeviceTable.from_host of the pyarrow read).
 """
 from __future__ import annotations
 
@@ -42,7 +44,7 @@ PARQUET_DEVICE_DECODE = register_conf(
     "decode). Unsupported columns fall back to host decode per column.",
     True)
 
-_PHYS_OK = {"BOOLEAN", "INT32", "INT64", "FLOAT", "DOUBLE"}
+_PHYS_OK = {"BOOLEAN", "INT32", "INT64", "FLOAT", "DOUBLE", "BYTE_ARRAY"}
 _ENC_OK = {"PLAIN", "RLE", "RLE_DICTIONARY", "PLAIN_DICTIONARY",
            "BIT_PACKED"}
 
@@ -65,9 +67,11 @@ def chunk_supported(col_meta, arrow_field) -> bool:
         d = _arrow_to_dtype(t)
     except Exception:
         return False
-    if isinstance(d, (dt.StringType, dt.BinaryType, dt.DecimalType)):
+    if isinstance(d, dt.DecimalType):
         return False
-    return True
+    if isinstance(d, (dt.StringType, dt.BinaryType)):
+        return col_meta.physical_type == "BYTE_ARRAY"
+    return col_meta.physical_type != "BYTE_ARRAY"
 
 
 # ---------------------------------------------------------------------------
@@ -165,7 +169,13 @@ class _RunTable:
 
 
 class _Chunk:
-    """Parsed column chunk: run tables + dense plain values + dictionary."""
+    """Parsed column chunk: run tables + dense plain values + dictionary.
+
+    The dense non-null value stream of a chunk is [dictionary-encoded
+    pages' values] ++ [plain pages' values] — parquet writers that
+    overflow their dictionary (pyarrow's 1MB default) switch to PLAIN for
+    the REST of the chunk, never back, so segment order is statically
+    dict-then-plain."""
 
     def __init__(self):
         self.defs = _RunTable()      # definition levels (width 1)
@@ -173,11 +183,61 @@ class _Chunk:
         self.idx_width: int = 0
         self.plain_parts: List[bytes] = []
         self.dictionary: Optional[np.ndarray] = None
+        # BYTE_ARRAY: dictionary entries + per-page plain streams, kept as
+        # (starts, lengths, blob) triples until the matrix assembly
+        self.ba_dict: Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]] = None
+        self.ba_plain: List[Tuple[np.ndarray, np.ndarray, np.ndarray]] = []
         self.num_rows = 0
         self.nullable = False
         self.bool_plain: List[Tuple[bytes, int]] = []  # packed bits, count
         self.uses_dict = False
         self.uses_plain = False
+
+
+def _parse_byte_array_stream(buf: bytes, n: int
+                             ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Walk a PLAIN BYTE_ARRAY stream (u32 length prefix per value) ->
+    (starts, lengths, blob) without copying the value bytes. The walk is
+    sequential; the native C helper does it at memory speed, with a
+    Python loop as the compiler-less fallback."""
+    from .. import native
+    walked = native.ba_walk(buf, n)
+    if walked is not None:
+        starts, lens, pos = walked
+        return starts, lens, np.frombuffer(buf, np.uint8, pos)
+    import struct as _struct
+    starts = np.empty(n, np.int64)
+    lens = np.empty(n, np.int64)
+    pos = 0
+    unpack = _struct.unpack_from
+    for i in range(n):
+        (ln,) = unpack("<I", buf, pos)
+        pos += 4
+        starts[i] = pos
+        lens[i] = ln
+        pos += ln
+    return starts, lens, np.frombuffer(buf, np.uint8, pos)
+
+
+def _ba_matrix(parts: List[Tuple[np.ndarray, np.ndarray, np.ndarray]],
+               width: int) -> Tuple[np.ndarray, np.ndarray]:
+    """(starts, lens, blob) segments -> dense (n, width) matrix + lengths
+    via one vectorized scatter (same trick as _encode_string_matrix)."""
+    n = sum(len(p[1]) for p in parts)
+    mat = np.zeros((max(n, 1), width), dtype=np.uint8)
+    out_lens = np.zeros(max(n, 1), dtype=np.int32)
+    row0 = 0
+    for starts, lens, blob in parts:
+        k = len(lens)
+        total = int(lens.sum())
+        if total:
+            rows = row0 + np.repeat(np.arange(k, dtype=np.int64), lens)
+            prefix = np.cumsum(lens) - lens
+            cols = np.arange(total, dtype=np.int64) - np.repeat(prefix, lens)
+            mat[rows, cols] = blob[np.repeat(starts, lens) + cols]
+        out_lens[row0:row0 + k] = lens
+        row0 += k
+    return mat, out_lens
 
 
 def _parse_chunk(raw: bytes, col_meta, nullable: bool) -> _Chunk:
@@ -197,34 +257,61 @@ def _parse_chunk(raw: bytes, col_meta, nullable: bool) -> _Chunk:
         pos = data_start + hdr.compressed_size
         if hdr.page_type == PageType.DICTIONARY_PAGE:
             page = _decompress(page, codec, hdr.uncompressed_size)
-            ch.dictionary = _plain_values(page, phys, hdr.num_values)
+            if phys == "BYTE_ARRAY":
+                ch.ba_dict = _parse_byte_array_stream(page, hdr.num_values)
+            else:
+                ch.dictionary = _plain_values(page, phys, hdr.num_values)
             continue
-        if hdr.page_type != PageType.DATA_PAGE:
-            raise UnsupportedChunk(f"page type {hdr.page_type}")
-        page = _decompress(page, codec, hdr.uncompressed_size)
-        p = 0
         nvals = hdr.num_values
-        # flat columns: no repetition levels; definition levels only when
-        # the column is nullable (length-prefixed RLE at bit width 1)
-        n_nonnull = nvals
-        if nullable:
-            if hdr.def_level_encoding != Encoding.RLE:
-                # legacy BIT_PACKED levels have no length prefix; parsing
-                # them as RLE would read garbage "plausibly"
-                raise UnsupportedChunk(
-                    f"definition-level encoding {hdr.def_level_encoding}")
-            (dl_len,) = np.frombuffer(page, np.uint32, 1, p)
-            p += 4
+        if hdr.page_type == PageType.DATA_PAGE:
+            page = _decompress(page, codec, hdr.uncompressed_size)
+            p = 0
+            # flat columns: no repetition levels; definition levels only
+            # when the column is nullable (length-prefixed RLE, width 1)
+            n_nonnull = nvals
+            if nullable:
+                if hdr.def_level_encoding != Encoding.RLE:
+                    # legacy BIT_PACKED levels have no length prefix;
+                    # parsing them as RLE would read garbage "plausibly"
+                    raise UnsupportedChunk(
+                        f"definition-level encoding {hdr.def_level_encoding}")
+                (dl_len,) = np.frombuffer(page, np.uint32, 1, p)
+                p += 4
+                before = ch.defs.total
+                ch.defs.parse_hybrid(page, p, p + int(dl_len), 1, nvals)
+                if ch.defs.total - before < nvals:  # stream may omit tail
+                    ch.defs._push_rle(nvals - (ch.defs.total - before), 1)
+                p += int(dl_len)
+                n_nonnull = _count_defined(ch.defs, before)
+            else:
+                ch.defs._push_rle(nvals, 1)
+        elif hdr.page_type == PageType.DATA_PAGE_V2:
+            # v2 layout: [rep levels][def levels] UNCOMPRESSED, then the
+            # values section (compressed iff is_compressed); levels are
+            # RLE with NO length prefix (lengths live in the header)
+            if hdr.rep_levels_byte_length:
+                raise UnsupportedChunk("v2 repetition levels on flat column")
+            dl = hdr.def_levels_byte_length
+            levels = page[:dl]
+            vals = page[dl:]
+            if hdr.v2_is_compressed:
+                vals = _decompress(vals, codec,
+                                   hdr.uncompressed_size - dl)
+            n_nonnull = nvals - hdr.num_nulls
             before = ch.defs.total
-            ch.defs.parse_hybrid(page, p, p + int(dl_len), 1, nvals)
-            if ch.defs.total - before < nvals:   # stream may omit the tail
+            if dl:
+                ch.defs.parse_hybrid(levels, 0, dl, 1, nvals)
+            if ch.defs.total - before < nvals:
                 ch.defs._push_rle(nvals - (ch.defs.total - before), 1)
-            p += int(dl_len)
-            n_nonnull = _count_defined(ch.defs, before)
+            page = vals
+            p = 0
         else:
-            ch.defs._push_rle(nvals, 1)
+            raise UnsupportedChunk(f"page type {hdr.page_type}")
         if hdr.encoding in (Encoding.RLE_DICTIONARY,
                             Encoding.PLAIN_DICTIONARY):
+            if ch.uses_plain:
+                # dense-stream order would break (plain segment sits last)
+                raise UnsupportedChunk("dictionary page after plain page")
             width = page[p]
             p += 1
             if width > 24:
@@ -235,14 +322,17 @@ def _parse_chunk(raw: bytes, col_meta, nullable: bool) -> _Chunk:
         elif hdr.encoding == Encoding.PLAIN:
             if phys == "BOOLEAN":
                 ch.bool_plain.append((page[p:], n_nonnull))
+            elif phys == "BYTE_ARRAY":
+                ch.ba_plain.append(
+                    _parse_byte_array_stream(page[p:], n_nonnull))
             else:
                 ch.plain_parts.append(page[p:])
             ch.uses_plain = True
         else:
             raise UnsupportedChunk(f"encoding {hdr.encoding}")
         ch.num_rows += nvals
-    if ch.uses_dict and ch.uses_plain:
-        raise UnsupportedChunk("mixed dict+plain pages")  # rare; host path
+    if ch.uses_dict and ch.bool_plain:
+        raise UnsupportedChunk("mixed dict+plain boolean pages")
     return ch
 
 
@@ -317,36 +407,68 @@ def _expand_hybrid_device(out_start, is_rle, rle_value, bit_base, widths,
                      bp_val.astype(jnp.int64))
 
 
-def _dict_kernel_builder(npdt_str: str):
+def _mixed_kernel_builder(npdt_str: str):
+    """Fixed-width decode: dense stream = dict segment ++ plain segment.
+
+    Row r's dense position ``pos[r]`` reads from the dictionary gather
+    while pos < n_dict (the count of dictionary-encoded non-null values)
+    and from the host-parsed plain array after — one kernel covers
+    dict-only (plain is a 1-slot dummy), plain-only (n_dict = 0), and the
+    pyarrow dictionary-overflow mixed chunk."""
     def fn(v_start, v_rle, v_val, v_bit, v_width, v_packed,
            d_start, d_rle, d_val, d_bit, d_width, d_packed, dvals,
-           n, iota_cap, iota_nv):
+           plain, n_dict, n, iota_cap, iota_nv):
         import jax.numpy as jnp
         validity = _expand_hybrid_device(
             v_start, v_rle, v_val, v_bit, v_width, v_packed, iota_cap) > 0
         validity = jnp.logical_and(validity, iota_cap < n)
-        pos = jnp.cumsum(validity.astype(jnp.int32)) - 1
+        pos = (jnp.cumsum(validity.astype(jnp.int32)) - 1).astype(jnp.int64)
         idx = _expand_hybrid_device(d_start, d_rle, d_val, d_bit, d_width,
                                     d_packed, iota_nv)
-        dense = dvals[jnp.clip(idx, 0, dvals.shape[0] - 1)]
-        vals = dense[jnp.clip(pos, 0, dense.shape[0] - 1)]
+        dense_dict = dvals[jnp.clip(idx, 0, dvals.shape[0] - 1)]
+        from_dict = pos < n_dict
+        v_dict = dense_dict[jnp.clip(pos, 0, dense_dict.shape[0] - 1)]
+        v_plain = plain[jnp.clip(pos - n_dict, 0, plain.shape[0] - 1)]
+        vals = jnp.where(from_dict, v_dict, v_plain)
         vals = jnp.where(validity, vals, jnp.zeros((), vals.dtype))
         return vals.astype(jnp.dtype(npdt_str)), validity
     return lambda: fn
 
 
-def _plain_kernel_builder(npdt_str: str):
-    def fn(v_start, v_rle, v_val, v_bit, v_width, v_packed, dense, n,
-           iota_cap):
+def _ba_kernel_builder():
+    """BYTE_ARRAY decode into the bucketed (rows, width) byte-matrix +
+    lengths layout — dictionary rows gather as whole matrix rows (an
+    MXU-friendly 2D gather), plain rows come from the host-assembled
+    matrix, segment choice as in _mixed_kernel_builder."""
+    def fn(v_start, v_rle, v_val, v_bit, v_width, v_packed,
+           d_start, d_rle, d_val, d_bit, d_width, d_packed,
+           dict_mat, dict_lens, plain_mat, plain_lens,
+           n_dict, n, iota_cap, iota_nv):
         import jax.numpy as jnp
         validity = _expand_hybrid_device(
             v_start, v_rle, v_val, v_bit, v_width, v_packed, iota_cap) > 0
         validity = jnp.logical_and(validity, iota_cap < n)
-        pos = jnp.cumsum(validity.astype(jnp.int32)) - 1
-        vals = dense[jnp.clip(pos, 0, dense.shape[0] - 1)]
-        vals = jnp.where(validity, vals, jnp.zeros((), vals.dtype))
-        return vals.astype(jnp.dtype(npdt_str)), validity
+        pos = (jnp.cumsum(validity.astype(jnp.int32)) - 1).astype(jnp.int64)
+        idx = _expand_hybrid_device(d_start, d_rle, d_val, d_bit, d_width,
+                                    d_packed, iota_nv)
+        from_dict = pos < n_dict
+        didx = idx[jnp.clip(pos, 0, idx.shape[0] - 1)]
+        row_dict = dict_mat[jnp.clip(didx, 0, dict_mat.shape[0] - 1)]
+        len_dict = dict_lens[jnp.clip(didx, 0, dict_lens.shape[0] - 1)]
+        ppos = jnp.clip(pos - n_dict, 0, plain_mat.shape[0] - 1)
+        row_plain = plain_mat[ppos]
+        len_plain = plain_lens[ppos]
+        data = jnp.where(from_dict[:, None], row_dict, row_plain)
+        lengths = jnp.where(from_dict, len_dict, len_plain)
+        ok = validity[:, None]
+        data = jnp.where(ok, data, jnp.zeros((), jnp.uint8))
+        lengths = jnp.where(validity, lengths, 0).astype(jnp.int32)
+        return data, lengths, validity
     return lambda: fn
+
+
+def _empty_run_tables() -> Tuple[np.ndarray, ...]:
+    return _RunTable().arrays()
 
 
 def _decode_column_device(ch: _Chunk, out_dtype: dt.DataType, cap: int):
@@ -354,41 +476,77 @@ def _decode_column_device(ch: _Chunk, out_dtype: dt.DataType, cap: int):
     callables shared via the global compile cache, shapes pow2-bucketed)."""
     import numpy as _np
 
-    from ..columnar.device import DeviceColumn
+    from ..columnar.device import DeviceColumn, bucket_width
     from ..utils.compile_cache import cached_jit
 
     n = ch.num_rows
-    npdt = out_dtype.np_dtype()
-    npdt_str = _np.dtype(npdt).str
     v_tables = ch.defs.arrays()
     iota_cap = _np.arange(cap, dtype=_np.int64)
+    d_tables = ch.idx.arrays() if ch.uses_dict else _empty_run_tables()
+    n_dict = ch.idx.total if ch.uses_dict else 0
+    nvcap = _pow2(max(1, n_dict))
+    iota_nv = _np.arange(nvcap, dtype=_np.int64)
 
-    if ch.uses_dict:
-        d_tables = ch.idx.arrays()
-        dict_vals = ch.dictionary
-        dv = _np.pad(dict_vals, (0, _pow2(len(dict_vals)) - len(dict_vals)))
-        nvcap = _pow2(max(1, ch.idx.total))
-        fn = cached_jit(f"pq_dict|{npdt_str}", _dict_kernel_builder(npdt_str))
-        data, validity = fn(*v_tables, *d_tables, dv, _np.int64(n),
-                            iota_cap, _np.arange(nvcap, dtype=_np.int64))
-    else:
-        if ch.bool_plain:
-            parts = [_plain_values(b, "BOOLEAN", c) for b, c in ch.bool_plain]
-            dense = _np.concatenate(parts) if parts \
-                else _np.zeros(0, _np.bool_)
+    if isinstance(out_dtype, (dt.StringType, dt.BinaryType)):
+        max_len = 1
+        if ch.ba_dict is not None and len(ch.ba_dict[1]):
+            max_len = max(max_len, int(ch.ba_dict[1].max()))
+        for _, lens, _b in ch.ba_plain:
+            if len(lens):
+                max_len = max(max_len, int(lens.max()))
+        width = bucket_width(max_len)
+        if ch.uses_dict:
+            if ch.ba_dict is None:
+                raise UnsupportedChunk("dict-encoded pages, no dict page")
+            dm, dlens = _ba_matrix([ch.ba_dict], width)
+            pad_to = _pow2(dm.shape[0])
+            dm = _np.pad(dm, ((0, pad_to - dm.shape[0]), (0, 0)))
+            dlens = _np.pad(dlens, (0, pad_to - len(dlens)))
         else:
-            blob = b"".join(ch.plain_parts)
-            d_ = _np.dtype(npdt)
-            if d_.kind == "f":
-                phys = "FLOAT" if d_.itemsize == 4 else "DOUBLE"
-            else:  # ints + date32/timestamp storage types
-                phys = "INT32" if d_.itemsize == 4 else "INT64"
-            count = len(blob) // _np.dtype(_NP_BY_PHYS[phys]).itemsize
-            dense = _plain_values(blob, phys, count)
-        dense = _np.pad(dense, (0, _pow2(max(1, len(dense))) - len(dense)))
-        fn = cached_jit(f"pq_plain|{npdt_str}",
-                        _plain_kernel_builder(npdt_str))
-        data, validity = fn(*v_tables, dense, _np.int64(n), iota_cap)
+            dm = _np.zeros((1, width), _np.uint8)
+            dlens = _np.zeros(1, _np.int32)
+        if ch.ba_plain:
+            pm, plens = _ba_matrix(ch.ba_plain, width)
+            pad_to = _pow2(pm.shape[0])
+            pm = _np.pad(pm, ((0, pad_to - pm.shape[0]), (0, 0)))
+            plens = _np.pad(plens, (0, pad_to - len(plens)))
+        else:
+            pm = _np.zeros((1, width), _np.uint8)
+            plens = _np.zeros(1, _np.int32)
+        fn = cached_jit("pq_ba", _ba_kernel_builder())
+        data, lengths, validity = fn(
+            *v_tables, *d_tables, dm, dlens.astype(_np.int32),
+            pm, plens.astype(_np.int32), _np.int64(n_dict), _np.int64(n),
+            iota_cap, iota_nv)
+        return DeviceColumn(data, validity, out_dtype, lengths)
+
+    npdt = out_dtype.np_dtype()
+    npdt_str = _np.dtype(npdt).str
+    if ch.bool_plain and not ch.uses_dict:
+        parts = [_plain_values(b, "BOOLEAN", c) for b, c in ch.bool_plain]
+        plain = _np.concatenate(parts) if parts else _np.zeros(0, _np.bool_)
+    elif ch.plain_parts:
+        blob = b"".join(ch.plain_parts)
+        d_ = _np.dtype(npdt)
+        if d_.kind == "f":
+            phys = "FLOAT" if d_.itemsize == 4 else "DOUBLE"
+        else:  # ints + date32/timestamp storage types
+            phys = "INT32" if d_.itemsize == 4 else "INT64"
+        count = len(blob) // _np.dtype(_NP_BY_PHYS[phys]).itemsize
+        plain = _plain_values(blob, phys, count)
+    else:
+        plain = _np.zeros(0, npdt)
+    plain = _np.asarray(plain, npdt)
+    plain = _np.pad(plain, (0, _pow2(max(1, len(plain))) - len(plain)))
+    if ch.uses_dict:
+        dict_vals = _np.asarray(ch.dictionary, npdt)
+    else:
+        dict_vals = _np.zeros(1, npdt)
+    dv = _np.pad(dict_vals,
+                 (0, _pow2(max(1, len(dict_vals))) - len(dict_vals)))
+    fn = cached_jit(f"pq_mix|{npdt_str}", _mixed_kernel_builder(npdt_str))
+    data, validity = fn(*v_tables, *d_tables, dv, plain,
+                        _np.int64(n_dict), _np.int64(n), iota_cap, iota_nv)
     return DeviceColumn(data, validity, out_dtype, None)
 
 

@@ -46,6 +46,12 @@ class PageHeader:
     def_level_encoding: int = Encoding.RLE
     rep_level_encoding: int = Encoding.RLE
     header_bytes: int = 0  # length of the serialized header itself
+    # DataPageHeaderV2 extras (parquet.thrift): levels sit uncompressed in
+    # front of the (optionally compressed) values section
+    num_nulls: int = 0
+    def_levels_byte_length: int = 0
+    rep_levels_byte_length: int = 0
+    v2_is_compressed: bool = True
 
 
 # -- compact protocol primitives --------------------------------------------
@@ -195,6 +201,25 @@ def read_page_header(buf: bytes, pos: int = 0) -> PageHeader:
                     hdr.num_values = dr.read_i32()
                 elif dfid == 2:
                     hdr.encoding = dr.read_i32()
+                else:
+                    dr.skip(dctype)
+            r.pos = dr.pos
+        elif fid == 8 and ctype == _CT_STRUCT:   # DataPageHeaderV2
+            dr = _StructReader(r.buf, r.pos)
+            for dfid, dctype in dr.fields():
+                if dfid == 1:
+                    hdr.num_values = dr.read_i32()
+                elif dfid == 2:
+                    hdr.num_nulls = dr.read_i32()
+                elif dfid == 4:
+                    hdr.encoding = dr.read_i32()
+                elif dfid == 5:
+                    hdr.def_levels_byte_length = dr.read_i32()
+                elif dfid == 6:
+                    hdr.rep_levels_byte_length = dr.read_i32()
+                elif dfid == 7:
+                    # bool lives in the field-header type nibble
+                    hdr.v2_is_compressed = (dctype == _CT_TRUE)
                 else:
                     dr.skip(dctype)
             r.pos = dr.pos

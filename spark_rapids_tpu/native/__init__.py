@@ -19,7 +19,7 @@ import numpy as np
 
 __all__ = ["available", "get_lib", "lz4_compress", "lz4_decompress",
            "xxhash64", "murmur3_columns", "hash_partition",
-           "HashedPriorityQueue", "HostArena"]
+           "HashedPriorityQueue", "HostArena", "ba_walk"]
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "srtpu_native.cpp")
@@ -90,6 +90,8 @@ def get_lib() -> Optional[ctypes.CDLL]:
             "srtpu_arena_used": (c.c_int64, [c.c_void_p]),
             "srtpu_arena_capacity": (c.c_int64, [c.c_void_p]),
             "srtpu_arena_base": (c.c_void_p, [c.c_void_p]),
+            "srtpu_ba_walk": (c.c_int64, [u8p, c.c_int64, c.c_int64,
+                                          i64p, i64p]),
         }
         for name, (res, args) in sigs.items():
             fn = getattr(lib, name)
@@ -398,3 +400,25 @@ class HostArena:
         if getattr(self, "_lib", None) is not None and getattr(self, "_a", None):
             self._lib.srtpu_arena_destroy(self._a)
             self._a = None
+
+
+# ---------------------------------------------------------------------------
+# Parquet helpers
+# ---------------------------------------------------------------------------
+
+def ba_walk(buf, n: int):
+    """Walk a parquet PLAIN BYTE_ARRAY stream -> (starts, lens) int64
+    arrays, or None when the native library is absent (callers fall back
+    to the Python loop). Raises ValueError on a malformed stream."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    starts = np.empty(max(n, 1), np.int64)
+    lens = np.empty(max(n, 1), np.int64)
+    src = _np_ptr(np.frombuffer(buf, np.uint8), ctypes.c_uint8)  # zero-copy
+    consumed = lib.srtpu_ba_walk(src, len(buf), n,
+                                 _np_ptr(starts, ctypes.c_int64),
+                                 _np_ptr(lens, ctypes.c_int64))
+    if consumed < 0:
+        raise ValueError("malformed BYTE_ARRAY stream")
+    return starts[:n], lens[:n], consumed

@@ -476,4 +476,27 @@ int64_t srtpu_arena_used(void* ap) { return ((SrtpuArena*)ap)->used; }
 int64_t srtpu_arena_capacity(void* ap) { return ((SrtpuArena*)ap)->capacity; }
 uint8_t* srtpu_arena_base(void* ap) { return ((SrtpuArena*)ap)->base; }
 
+// ---------------------------------------------------------------------------
+// Parquet PLAIN BYTE_ARRAY stream walk (parquet format spec: each value is a
+// u32 little-endian length prefix followed by that many bytes). The walk is
+// inherently sequential, so it lives here instead of a per-value Python
+// loop. Returns bytes consumed, or -1 when a length overruns the buffer.
+// ---------------------------------------------------------------------------
+int64_t srtpu_ba_walk(const uint8_t* buf, int64_t nbytes, int64_t n,
+                      int64_t* starts, int64_t* lens) {
+  int64_t pos = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    if (pos + 4 > nbytes) return -1;
+    uint32_t ln = (uint32_t)buf[pos] | ((uint32_t)buf[pos + 1] << 8) |
+                  ((uint32_t)buf[pos + 2] << 16) |
+                  ((uint32_t)buf[pos + 3] << 24);
+    pos += 4;
+    if (pos + (int64_t)ln > nbytes) return -1;
+    starts[i] = pos;
+    lens[i] = (int64_t)ln;
+    pos += (int64_t)ln;
+  }
+  return pos;
+}
+
 }  // extern "C"

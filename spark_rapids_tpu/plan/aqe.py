@@ -291,23 +291,22 @@ def materialize_stage(cpu_exchange: ShuffleExchangeExec, conf: RapidsConf,
     if isinstance(converted, TpuShuffleExchangeExec):
         converted._materialize()
         rows, nbytes = [], []
-        for h in converted._shards:
-            if h is None:
-                rows.append(0)
-                nbytes.append(0)
-                continue
-            t = h.get()
-            nrows = int(t.num_rows)
-            rows.append(nrows)
-            # buffers are capacity-padded (pow2 buckets, min 1024 rows);
-            # scale to the compacted row count so device-tier stats are
-            # comparable with the host tier's true bytes — otherwise tiny
-            # build sides look big and suppress AQE broadcast demotion
-            est = 0
-            for c in t.columns:
-                cap = max(int(c.data.shape[0]), 1)
-                est += int(c.data.nbytes) * nrows // cap
-            nbytes.append(est)
+        for handles in converted._shards:
+            prows = pbytes = 0
+            for h in handles:
+                t = h.get()
+                nrows = int(t.num_rows)
+                prows += nrows
+                # buffers are capacity-padded (pow2 buckets, min 1024
+                # rows); scale to the compacted row count so device-tier
+                # stats are comparable with the host tier's true bytes —
+                # otherwise tiny build sides look big and suppress AQE
+                # broadcast demotion
+                for c in t.columns:
+                    cap = max(int(c.data.shape[0]), 1)
+                    pbytes += int(c.data.nbytes) * nrows // cap
+            rows.append(prows)
+            nbytes.append(pbytes)
         stats = PartitionStats(rows, nbytes)
     else:
         assert isinstance(converted, ShuffleExchangeExec), type(converted)

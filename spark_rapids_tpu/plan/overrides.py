@@ -589,9 +589,10 @@ def _register_exec_rules():
 
 
 def _convert_exchange(p, ch, conf, mesh):
-    from ..exec.exchange import TpuShuffleExchangeExec
+    from ..exec.exchange import EXCHANGE_CHUNK_ROWS, TpuShuffleExchangeExec
     return TpuShuffleExchangeExec(ch[0], p.partitioning, mesh,
-                                  conf.min_bucket_rows)
+                                  conf.min_bucket_rows,
+                                  chunk_rows=conf.get(EXCHANGE_CHUNK_ROWS))
 
 
 _register_expr_rules()

@@ -140,3 +140,44 @@ def test_host_mode_keeps_host_exchange():
     q = df.group_by("k").agg(fsum(col("v")).alias("s"))
     plan = sess._physical(q.logical, device=True)
     assert _find(plan, TpuShuffleExchangeExec) is None
+
+
+def test_exchange_streams_chunks_out_of_core():
+    """The device exchange must NOT stage its whole input at once: child
+    batches stream through the all-to-all in bounded chunks, and finished
+    output shards spill when the device budget tightens (round-2 weak #3;
+    reference: per-batch streaming, GpuShuffleExchangeExecBase.scala:146)."""
+    from spark_rapids_tpu.exec.exchange import TpuShuffleExchangeExec
+    from spark_rapids_tpu.expr.functions import col, sum as fsum
+    from spark_rapids_tpu.memory.catalog import BufferCatalog, set_catalog
+
+    sess = _mesh_session(**{
+        # tiny chunks: a 4-partition input becomes multiple chunks/shard
+        "spark.rapids.tpu.shuffle.exchangeChunkRows": 512,
+    })
+    rng = np.random.default_rng(11)
+    nrows = 8000
+    t = pa.table({"k": rng.integers(0, 40, nrows).astype("int64"),
+                  "v": rng.uniform(0, 10, nrows)})
+    df = sess.create_dataframe(t, num_partitions=4)
+    q = df.group_by("k").agg(fsum(col("v")).alias("s"))
+
+    # device pool far below the ~128KB input -> output shards must spill
+    cat = BufferCatalog(device_limit=100_000, host_limit=60_000)
+    set_catalog(cat)
+    try:
+        plan = sess._physical(q.logical, device=True)
+        ex = _find(plan, TpuShuffleExchangeExec)
+        assert ex is not None, plan.tree_string()
+        got = plan.collect().to_arrow().to_pandas() \
+            .sort_values("k").reset_index(drop=True)
+        # streamed: at least one partition saw more than one chunk
+        assert any(len(s) > 1 for s in ex._shards), \
+            [len(s) for s in ex._shards]
+        assert sum(cat.spill_count.values()) > 0, cat.spill_count
+    finally:
+        set_catalog(None)
+    exp = t.to_pandas().groupby("k").v.sum().reset_index() \
+        .sort_values("k").reset_index(drop=True)
+    assert (got["k"] == exp["k"]).all()
+    assert np.allclose(got["s"], exp["v"])

@@ -102,12 +102,7 @@ def test_device_scan_feeds_aggregate(sess, tmp_path):
     assert out.num_rows == len(exp)
 
 
-def test_string_columns_ride_the_fallback(sess, tmp_path):
-    """Strings decode host-side per column but the scan output is still one
-    device batch; metrics record how many columns decoded on device."""
-    p, t = _write(tmp_path)
-    df = sess.read_parquet(p)
-    plan = sess._physical(df.logical, True)
+def _find_scan(plan):
     from spark_rapids_tpu.exec.scan import TpuParquetScanExec
 
     def find(n):
@@ -118,16 +113,26 @@ def test_string_columns_ride_the_fallback(sess, tmp_path):
             if r is not None:
                 return r
         return None
+    return find(plan)
 
-    scan = find(plan)
+
+def test_string_columns_decode_on_device(sess, tmp_path):
+    """BYTE_ARRAY columns decode on device too (round-2 missing #1;
+    reference: GpuParquetScanBase.scala:995,1194) — every column of the
+    scan lands in the device-decoded metric, none ride the fallback."""
+    p, t = _write(tmp_path)
+    df = sess.read_parquet(p)
+    plan = sess._physical(df.logical, True)
+    scan = _find_scan(plan)
     assert scan is not None
     batches = list(scan.execute_columnar(0))
     assert batches
     snap = scan.metrics.snapshot()
-    # 8 of 9 columns decode on device per row group
-    assert snap.get("deviceDecodedColumns", 0) >= 8
+    # ALL 9 columns (incl. the string one) decode on device per row group
+    assert snap.get("deviceDecodedColumns", 0) == 9 * len(batches)
     got = pa.concat_tables([b.to_host().to_arrow() for b in batches])
-    assert got.column("s").to_pylist()[:5] == t.column("s").to_pylist()[:5]
+    assert got.column("s").to_pylist() == \
+        t.column("s").to_pylist()[:got.num_rows]
 
 
 def test_column_pruning_through_device_scan(sess, tmp_path):
@@ -184,3 +189,68 @@ def test_empty_and_single_row_groups(sess, tmp_path):
     pq.write_table(t2, p2)
     out = sess.read_parquet(p2).collect(device=True)
     assert out.column("a").to_pylist() == [42]
+
+
+@pytest.mark.parametrize("label,kw", [
+    ("plain-v1", dict(use_dictionary=False)),
+    ("mixed-v1", dict(use_dictionary=True,
+                      dictionary_pagesize_limit=4096, data_page_size=2048)),
+    ("dict-v2", dict(data_page_version="2.0")),
+    ("plain-v2", dict(use_dictionary=False, data_page_version="2.0")),
+    ("mixed-v2", dict(use_dictionary=True, dictionary_pagesize_limit=4096,
+                      data_page_size=2048, data_page_version="2.0")),
+])
+def test_string_and_v2_page_matrix(sess, tmp_path, label, kw):
+    """Strings + numerics across PLAIN / dictionary-overflow-mixed chunks
+    and data-page v1/v2 — all decode on DEVICE, bit-identical to host
+    (reference: GpuParquetScanBase.scala:995 handles the same page matrix)."""
+    import io as _io
+    from spark_rapids_tpu.io.parquet_device import decode_row_group
+    rng = np.random.default_rng(5)
+    n = 4000
+    raw_s = ["s" + str(rng.integers(0, 10**9)) * rng.integers(1, 4)
+             for _ in range(n)]
+    mask = rng.random(n) < 0.1
+    t = pa.table({
+        "s": pa.array(raw_s, type=pa.string(), mask=mask),
+        "i": pa.array(rng.integers(-2**40, 2**40, n), type=pa.int64()),
+        "f": pa.array(rng.normal(size=n)),
+    })
+    buf = _io.BytesIO()
+    pq.write_table(t, buf, row_group_size=n, compression="snappy", **kw)
+    raw = buf.getvalue()
+    pf = pq.ParquetFile(_io.BytesIO(raw))
+    dt_, ndev = decode_row_group(raw, pf.metadata, 0, pf.schema_arrow,
+                                 ["s", "i", "f"], 64)
+    assert ndev == 3, f"{label}: only {ndev}/3 columns decoded on device"
+    got = dt_.to_host().to_arrow()
+    host = pf.read_row_group(0)
+    for c in ("s", "i", "f"):
+        assert got.column(c).to_pylist() == host.column(c).to_pylist(), \
+            f"{label}: column {c} diverged"
+
+
+def test_tpch_lineitem_orders_full_device_decode(sess, tmp_path):
+    """The round-2 'done' criterion: every column of TPC-H lineitem and
+    orders (strings included) decodes on device, differential vs host."""
+    import io as _io
+    from spark_rapids_tpu.io.parquet_device import decode_row_group
+    from spark_rapids_tpu.tools import tpch
+    tables = tpch.gen_all(0.01)
+    for tname in ("lineitem", "orders"):
+        t = tables[tname]
+        buf = _io.BytesIO()
+        pq.write_table(t, buf, row_group_size=t.num_rows,
+                       compression="snappy")
+        raw = buf.getvalue()
+        pf = pq.ParquetFile(_io.BytesIO(raw))
+        names = list(t.column_names)
+        dt_, ndev = decode_row_group(raw, pf.metadata, 0, pf.schema_arrow,
+                                     names, 64)
+        assert ndev == len(names), \
+            f"{tname}: {ndev}/{len(names)} columns on device"
+        got = dt_.to_host().to_arrow()
+        host = pf.read_row_group(0)
+        for c in names:
+            assert got.column(c).to_pylist() == host.column(c).to_pylist(), \
+                f"{tname}.{c} diverged"
