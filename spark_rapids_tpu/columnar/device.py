@@ -205,6 +205,12 @@ class DeviceTable:
                 out = _decode_string_matrix(data, lengths, c.dtype)
                 cols.append(HostColumn(c.dtype, out,
                                        None if validity.all() else validity))
+            elif isinstance(c.dtype, dt.ArrayType):
+                data = np.asarray(c.data)[mask][:n]
+                lengths = np.asarray(c.lengths)[mask][:n]
+                out = _decode_list_matrix(data, lengths, c.dtype)
+                cols.append(HostColumn(c.dtype, out,
+                                       None if validity.all() else validity))
             else:
                 vals = np.asarray(c.data)[mask][:n]
                 if isinstance(c.dtype, dt.BooleanType):
@@ -280,6 +286,74 @@ def _decode_string_matrix(data: np.ndarray, lengths: np.ndarray,
     return out
 
 
+def _encode_list_matrix(hc: HostColumn, capacity: int):
+    """ARRAY<fixed-width> column -> (capacity, W) element matrix + lengths
+    — the string byte-matrix layout generalized to typed elements
+    (reference: cuDF list columns, SURVEY §2.9; inner nulls are excluded
+    statically by TypeSig.with_arrays, containsNull=false)."""
+    et: dt.DataType = hc.dtype.element_type
+    np_dt = np.bool_ if isinstance(et, dt.BooleanType) else et.np_dtype()
+    n = len(hc)
+    arr = getattr(hc, "_arrow", None)
+    if arr is not None:
+        child = arr.values
+        if child.null_count:
+            raise TypeError(f"array column with null elements cannot use "
+                            f"the device list layout: {hc.dtype!r}")
+        offsets = np.frombuffer(arr.buffers()[1], dtype=np.int32,
+                                count=n + 1 + arr.offset)[arr.offset:] \
+            .astype(np.int64)
+        childvals = np.asarray(child)
+        lengths32 = (offsets[1:] - offsets[:-1]).astype(np.int32)
+        # null rows keep offsets; force their length to 0
+        vm = hc.valid_mask()
+        lengths32 = np.where(vm, lengths32, 0).astype(np.int32)
+        width = bucket_width(max(int(lengths32.max()) if n else 0, 1),
+                             min_width=4)
+        mat = np.zeros((capacity, width), dtype=np_dt)
+        starts = offsets[:-1]
+        total = int(lengths32.sum())
+        if total:
+            rows = np.repeat(np.arange(n, dtype=np.int64), lengths32)
+            prefix = np.cumsum(lengths32.astype(np.int64)) - lengths32
+            cols = np.arange(total, dtype=np.int64) \
+                - np.repeat(prefix, lengths32)
+            mat[rows, cols] = childvals.astype(np_dt, copy=False)[
+                np.repeat(starts, lengths32) + cols]
+        out_lengths = np.zeros(capacity, dtype=np.int32)
+        out_lengths[:n] = lengths32
+        return mat, out_lengths
+    # object-array path (post-transform columns): per-row encode
+    vm = hc.valid_mask()
+    lens = np.zeros(capacity, dtype=np.int32)
+    rows_np = []
+    for i in range(n):
+        v = hc.values[i]
+        if not vm[i] or v is None:
+            rows_np.append(None)
+            continue
+        a = np.asarray(v, dtype=np_dt)  # raises on inner None: gated away
+        rows_np.append(a)
+        lens[i] = len(a)
+    width = bucket_width(max(int(lens.max()) if n else 0, 1), min_width=4)
+    mat = np.zeros((capacity, width), dtype=np_dt)
+    for i, a in enumerate(rows_np):
+        if a is not None and len(a):
+            mat[i, :len(a)] = a
+    return mat, lens
+
+
+def _decode_list_matrix(data: np.ndarray, lengths: np.ndarray,
+                        dtype: dt.DataType) -> np.ndarray:
+    """(n, W) element matrix + lengths -> object array of Python lists
+    (the host engine's nested representation)."""
+    n = len(lengths)
+    out = np.empty(n, dtype=object)
+    for i in range(n):
+        out[i] = data[i, :lengths[i]].tolist()
+    return out
+
+
 def _upload_column(hc: HostColumn, capacity: int) -> DeviceColumn:
     n = len(hc)
     validity = np.zeros(capacity, dtype=np.bool_)
@@ -288,6 +362,10 @@ def _upload_column(hc: HostColumn, capacity: int) -> DeviceColumn:
         mat, lengths = _encode_string_matrix(
             hc.values, capacity, isinstance(hc.dtype, dt.BinaryType),
             arrow=getattr(hc, "_arrow", None))
+        return DeviceColumn(jnp.asarray(mat), jnp.asarray(validity), hc.dtype,
+                            jnp.asarray(lengths))
+    if isinstance(hc.dtype, dt.ArrayType):
+        mat, lengths = _encode_list_matrix(hc, capacity)
         return DeviceColumn(jnp.asarray(mat), jnp.asarray(validity), hc.dtype,
                             jnp.asarray(lengths))
     np_dt = hc.dtype.np_dtype()
@@ -328,7 +406,7 @@ def _concat_impl(tables) -> DeviceTable:
     out_cols: List[DeviceColumn] = []
     for ci in range(first.num_columns):
         parts = [t.columns[ci] for t in compacted]
-        if parts[0].is_string_like:
+        if parts[0].lengths is not None:    # strings AND fixed-width lists
             width = max(p.data.shape[1] for p in parts)
             datas = [jnp.pad(p.data, ((0, 0), (0, width - p.data.shape[1])))
                      for p in parts]

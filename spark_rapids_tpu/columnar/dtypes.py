@@ -324,16 +324,21 @@ class TypeSig:
     ``is_supported``/``reasons_not_supported`` used at tag time.
     """
 
-    __slots__ = ("_types", "_notes", "_max_decimal_precision", "_child_sig")
+    __slots__ = ("_types", "_notes", "_max_decimal_precision", "_child_sig",
+                 "_array_no_inner_nulls")
 
     def __init__(self, types: Iterable[str] = (), notes: Optional[dict] = None,
                  max_decimal_precision: int = DecimalType.MAX_INT64_PRECISION,
-                 child_sig: "Optional[TypeSig]" = None):
+                 child_sig: "Optional[TypeSig]" = None,
+                 array_no_inner_nulls: bool = False):
         self._types = frozenset(types)
         self._notes = dict(notes or {})
         self._max_decimal_precision = max_decimal_precision
         # signature allowed for nested children (arrays/structs/maps)
         self._child_sig = child_sig
+        # device list layout has values+lengths but no element-validity
+        # plane: ARRAY support may require containsNull=false statically
+        self._array_no_inner_nulls = array_no_inner_nulls
 
     # -- constructors ---------------------------------------------------------
     @staticmethod
@@ -350,24 +355,41 @@ class TypeSig:
         notes.update(other._notes)
         return TypeSig(self._types | other._types, notes,
                        max(self._max_decimal_precision, other._max_decimal_precision),
-                       self._child_sig or other._child_sig)
+                       self._child_sig or other._child_sig,
+                       self._array_no_inner_nulls or other._array_no_inner_nulls)
 
     def __sub__(self, other: "TypeSig") -> "TypeSig":
         notes = {k: v for k, v in self._notes.items() if k not in other._types}
         return TypeSig(self._types - other._types, notes,
-                       self._max_decimal_precision, self._child_sig)
+                       self._max_decimal_precision, self._child_sig,
+                       self._array_no_inner_nulls)
 
     def with_ps_note(self, type_enum: str, note: str) -> "TypeSig":
         notes = dict(self._notes)
         notes[type_enum] = note
         return TypeSig(self._types | {type_enum}, notes,
-                       self._max_decimal_precision, self._child_sig)
+                       self._max_decimal_precision, self._child_sig,
+                       self._array_no_inner_nulls)
 
     def nested(self, child_sig: "Optional[TypeSig]" = None) -> "TypeSig":
         """Allow nested types whose children satisfy ``child_sig`` (default: self)."""
         return TypeSig(self._types | {TypeEnum.ARRAY, TypeEnum.STRUCT, TypeEnum.MAP},
                        self._notes, self._max_decimal_precision,
-                       child_sig or self)
+                       child_sig or self, self._array_no_inner_nulls)
+
+    def with_arrays(self, element_sig: "TypeSig",
+                    note: Optional[str] = None) -> "TypeSig":
+        """Allow ARRAY columns whose elements satisfy ``element_sig`` AND
+        whose type declares containsNull=false — the device list layout is
+        (values matrix, lengths) with no element-validity plane, so inner
+        nullability must be excluded statically (the reference gates
+        per-op nesting support the same way, TypeChecks.scala:166)."""
+        notes = dict(self._notes)
+        notes[TypeEnum.ARRAY] = note or (
+            "arrays of fixed-width elements with containsNull=false; "
+            "others fall back to host")
+        return TypeSig(self._types | {TypeEnum.ARRAY}, notes,
+                       self._max_decimal_precision, element_sig, True)
 
     # -- checks ---------------------------------------------------------------
     def is_supported(self, dt: DataType) -> bool:
@@ -384,6 +406,10 @@ class TypeSig:
                 f"{self._max_decimal_precision}")
         child = self._child_sig or self
         if isinstance(dt, ArrayType):
+            if self._array_no_inner_nulls and dt.contains_null:
+                reasons.append(
+                    f"{dt!r} may contain null elements (containsNull=true); "
+                    "the device list layout requires containsNull=false")
             reasons += [f"array child: {r}" for r in child.reasons_not_supported(dt.element_type)]
         elif isinstance(dt, StructType):
             for f in dt.fields:

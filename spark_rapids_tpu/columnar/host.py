@@ -46,7 +46,8 @@ def _arrow_to_dtype(t: pa.DataType) -> dt.DataType:
     if pa.types.is_decimal(t):
         return dt.DecimalType(t.precision, t.scale)
     if pa.types.is_list(t) or pa.types.is_large_list(t):
-        return dt.ArrayType(_arrow_to_dtype(t.value_type))
+        return dt.ArrayType(_arrow_to_dtype(t.value_type),
+                            contains_null=t.value_field.nullable)
     if pa.types.is_struct(t):
         return dt.StructType(tuple(
             dt.StructField(t.field(i).name, _arrow_to_dtype(t.field(i).type),
@@ -84,7 +85,8 @@ def _dtype_to_arrow(d: dt.DataType) -> pa.DataType:
     if isinstance(d, dt.DecimalType):
         return pa.decimal128(d.precision, d.scale)
     if isinstance(d, dt.ArrayType):
-        return pa.list_(_dtype_to_arrow(d.element_type))
+        return pa.list_(pa.field("item", _dtype_to_arrow(d.element_type),
+                                 nullable=d.contains_null))
     if isinstance(d, dt.StructType):
         return pa.struct([pa.field(f.name, _dtype_to_arrow(f.data_type),
                                    nullable=f.nullable) for f in d.fields])
@@ -139,6 +141,10 @@ class HostColumn:
                 fill = [] if not isinstance(d, dt.StructType) else {}
                 for i in np.nonzero(~validity)[0]:
                     values[i] = fill
+            if isinstance(d, dt.ArrayType) and pa.types.is_list(arr.type):
+                # keep the arrow array: the device upload reads the list
+                # offsets/values buffers directly (as with strings)
+                return HostColumn(d, values, validity, _arrow=arr)
         elif isinstance(d, dt.StringType) or isinstance(d, dt.BinaryType):
             values = np.asarray(arr.to_pylist(), dtype=object)
             if validity is not None:

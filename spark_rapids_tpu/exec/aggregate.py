@@ -139,6 +139,184 @@ def _reduce_segment(op: str, vals: jax.Array, contrib: jax.Array,
     raise ValueError(op)
 
 
+_COLLECT_OPS = frozenset(
+    {"collect_list", "collect_set", "merge_lists", "merge_sets"})
+_BIG32 = np.int32(2**31 - 1)
+
+
+def _sorted_group_ids(table: "DeviceTable", key_names: List[str]):
+    """Lexsort rows so equal keys are adjacent (active first) and label
+    groups. -> (order, active_s, gid, boundary, num_groups)."""
+    cap = table.capacity
+    active = table.row_mask
+    sort_keys = []
+    key_cols = [table.column(k) for k in key_names]
+    # lexsort: LAST entry is most significant. Per key column the null
+    # flag dominates its value words; word lists are appended least-
+    # significant first so the big-endian word order holds.
+    for kc in reversed(key_cols):
+        words, nan = _key_code_words(kc)
+        for wd in reversed(words):
+            sort_keys.append(wd)
+        if nan is not None:
+            sort_keys.append(nan)  # NaNs sort together (after inf)
+        sort_keys.append(jnp.logical_not(kc.validity))
+    sort_keys.append(jnp.logical_not(active))  # primary: active first
+    order = jnp.lexsort(tuple(sort_keys))
+    active_s = jnp.take(active, order)
+    same = jnp.ones(cap, dtype=bool)
+    for kc in key_cols:
+        words, nan = _key_code_words(kc)
+        veq = jnp.ones(cap, dtype=bool).at[0].set(False)
+        for wd in words:
+            veq = jnp.logical_and(
+                veq, _keys_equal_prev(jnp.take(wd, order)))
+        if nan is not None:  # keep real inf distinct from NaN groups
+            veq = jnp.logical_and(
+                veq, _keys_equal_prev(jnp.take(nan, order)))
+        sn = jnp.take(jnp.logical_not(kc.validity), order)
+        prev_sn = jnp.roll(sn, 1)
+        both_null = jnp.logical_and(sn, prev_sn).at[0].set(False)
+        col_same = jnp.where(jnp.logical_or(sn, prev_sn), both_null, veq)
+        same = jnp.logical_and(same, col_same)
+    boundary = jnp.logical_and(jnp.logical_not(same), active_s)
+    boundary = boundary.at[0].set(active_s[0])
+    gid = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    gid = jnp.clip(gid, 0, cap - 1)
+    num_groups = jnp.sum(boundary.astype(jnp.int32))
+    return order, active_s, gid, boundary, num_groups
+
+
+def _first_occurrence_in_group(sv: jax.Array, gid: jax.Array,
+                               contrib: jax.Array) -> jax.Array:
+    """True for the first contributing row of each (group, value) pair —
+    collect_set dedup that preserves first-insertion row order."""
+    v = sv
+    if v.dtype == jnp.bool_:
+        v = v.astype(jnp.int32)
+    if jnp.issubdtype(v.dtype, jnp.floating):
+        # total order for grouping equal values adjacently
+        v = _normalize_float_key(v)
+    order2 = jnp.lexsort((v, gid, jnp.logical_not(contrib)))
+    v2 = jnp.take(v, order2)
+    g2 = jnp.take(gid, order2)
+    c2 = jnp.take(contrib, order2)
+    dup = jnp.logical_and(v2 == jnp.roll(v2, 1), g2 == jnp.roll(g2, 1))
+    dup = jnp.logical_and(dup, jnp.logical_and(c2, jnp.roll(c2, 1)))
+    dup = dup.at[0].set(False)
+    first2 = jnp.logical_and(c2, jnp.logical_not(dup))
+    return jnp.zeros_like(contrib).at[order2].set(first2)
+
+
+def _row_dedup_sorted(mat: jax.Array, lens: jax.Array):
+    """Per-row: sort elements, drop adjacent duplicates, compact left
+    (merge_sets — partial states may repeat values across map sides).
+
+    Sorting happens on an integer surrogate key (floats via the monotone
+    bit trick, NaN greatest) with an int64-max pad sentinel, and the
+    ORIGINAL values are gathered by that order — so NaN dedups against
+    NaN, no pad value can leak into the data, and bool/float dtypes come
+    back unchanged."""
+    W = mat.shape[1]
+    j = jnp.arange(W, dtype=jnp.int32)
+    in_len = j[None, :] < lens[:, None]
+    is_float = jnp.issubdtype(mat.dtype, jnp.floating)
+    if is_float:
+        # monotone bit surrogate (IEEE trick): order-preserving injection
+        # into uint64, with -0.0 normalized so it dedups against +0.0
+        v = jnp.where(mat == 0, jnp.zeros_like(mat), mat)
+        if mat.dtype == jnp.float32:
+            u = jax.lax.bitcast_convert_type(v, jnp.uint32)
+            top = jnp.uint32(1) << jnp.uint32(31)
+        else:
+            u = jax.lax.bitcast_convert_type(v, jnp.uint64)
+            top = jnp.uint64(1) << jnp.uint64(63)
+        key = jnp.where((u & top) != 0, ~u, u | top).astype(jnp.uint64)
+    elif mat.dtype == jnp.bool_:
+        key = mat.astype(jnp.int64)
+    else:
+        key = mat.astype(jnp.int64)
+    # exact pads-last ordering: stable sort by key, then stable sort by
+    # the pad flag — composition = lexsort((key, is_pad)) per row, with
+    # no sentinel that could collide with a real extreme value
+    pad_flag = jnp.logical_not(in_len)
+    order1 = jnp.argsort(key, axis=1, stable=True)
+    p1 = jnp.take_along_axis(pad_flag, order1, axis=1)
+    order2 = jnp.argsort(p1, axis=1, stable=True)
+    order = jnp.take_along_axis(order1, order2, axis=1)
+    sk = jnp.take_along_axis(key, order, axis=1)
+    spad = jnp.take_along_axis(pad_flag, order, axis=1)
+    sv = jnp.take_along_axis(mat, order, axis=1)
+    dup = jnp.logical_and(sk == jnp.roll(sk, 1, axis=1),
+                          jnp.logical_not(
+                              jnp.logical_or(spad,
+                                             jnp.roll(spad, 1, axis=1))))
+    if is_float:
+        # `==` dedup semantics (the host engine's): NaN never equals NaN,
+        # so same-bit NaNs must NOT merge at the merge pass either
+        nan_s = jnp.isnan(sv)
+        dup = jnp.logical_and(dup, jnp.logical_not(
+            jnp.logical_or(nan_s, jnp.roll(nan_s, 1, axis=1))))
+    dup = dup.at[:, 0].set(False)
+    # pads sort strictly last, so the first ``lens`` slots are the reals
+    keep = jnp.logical_and(j[None, :] < lens[:, None],
+                           jnp.logical_not(dup))
+    order2 = jnp.argsort(jnp.logical_not(keep), axis=1, stable=True)
+    out = jnp.take_along_axis(sv, order2, axis=1)
+    newlens = keep.sum(axis=1).astype(jnp.int32)
+    out = jnp.where(j[None, :] < newlens[:, None], out,
+                    jnp.zeros((), out.dtype))
+    return out, newlens
+
+
+def _collect_segment(op: str, sv: jax.Array, slen, contrib: jax.Array,
+                     gid: jax.Array, cap: int, width: int):
+    """Per-group collect into a (cap, width) list matrix + lengths.
+
+    Update ops scatter scalar rows by within-group rank; merge ops scatter
+    whole element runs by within-group element offset. Callers size
+    ``width`` from a host-synced size pass (the dynamic-width escape
+    hatch; reference: cuDF list columns size their child dynamically)."""
+    if op == "collect_set":
+        contrib = jnp.logical_and(
+            contrib, _first_occurrence_in_group(sv, gid, contrib))
+        op = "collect_list"
+    if op == "collect_list":
+        c32 = contrib.astype(jnp.int32)
+        prefix = jnp.cumsum(c32) - c32      # contributing rows before this
+        base = jax.ops.segment_min(
+            jnp.where(contrib, prefix, _BIG32), gid, num_segments=cap)
+        within = jnp.where(contrib, prefix - base[gid], 0)
+        r_idx = jnp.where(contrib, gid, cap)        # trash row for skips
+        c_idx = jnp.where(contrib, jnp.clip(within, 0, width), width)
+        out = jnp.zeros((cap + 1, width + 1), sv.dtype)
+        out = out.at[r_idx, c_idx].set(sv)
+        lens = jax.ops.segment_sum(c32, gid, num_segments=cap) \
+            .astype(jnp.int32)
+        return out[:cap, :width], jnp.minimum(lens, width)
+    # merge_lists / merge_sets: sv is (n, Win) + per-row lengths
+    lens_eff = jnp.where(contrib, slen.astype(jnp.int32), 0)
+    prefix = jnp.cumsum(lens_eff) - lens_eff
+    base = jax.ops.segment_min(
+        jnp.where(contrib, prefix, _BIG32), gid, num_segments=cap)
+    elem_base = prefix - base[gid]
+    win = sv.shape[1]
+    j = jnp.arange(win, dtype=jnp.int32)[None, :]
+    valid_e = j < lens_eff[:, None]
+    r_idx = jnp.where(valid_e, gid[:, None], cap)
+    c_idx = jnp.where(valid_e,
+                      jnp.clip(elem_base[:, None] + j, 0, width), width)
+    out = jnp.zeros((cap + 1, width + 1), sv.dtype)
+    out = out.at[r_idx, c_idx].set(sv)
+    lens = jnp.minimum(
+        jax.ops.segment_sum(lens_eff, gid, num_segments=cap), width) \
+        .astype(jnp.int32)
+    out = out[:cap, :width]
+    if op == "merge_sets":
+        return _row_dedup_sorted(out, lens)
+    return out, lens
+
+
 class TpuHashAggregateExec(TpuExec):
     """Same pre-projected input contract as CpuHashAggregateExec."""
 
@@ -159,8 +337,10 @@ class TpuHashAggregateExec(TpuExec):
     @property
     def fusible(self) -> bool:
         # partial mode may emit one state-batch per input batch (downstream
-        # merge reduces them); final mode must merge across batches itself
-        return self.mode == "partial"
+        # merge reduces them); final mode must merge across batches itself.
+        # collect_* needs a per-batch host-synced width pass, so it cannot
+        # join a whole-stage program
+        return self.mode == "partial" and not self._has_collect()
 
     def _columns_ops(self) -> List[Tuple[str, str, str, dt.DataType]]:
         out = []
@@ -172,8 +352,13 @@ class TpuHashAggregateExec(TpuExec):
                 out.append((in_col, op, out_col, out_dt))
         return out
 
+    def _has_collect(self) -> bool:
+        return any(op in _COLLECT_OPS
+                   for (_, op, _, _) in self._columns_ops())
+
     # -- kernels -------------------------------------------------------------
-    def batch_fn(self) -> Callable[[DeviceTable], DeviceTable]:
+    def batch_fn(self, list_width: int = 0
+                 ) -> Callable[[DeviceTable], DeviceTable]:
         cols_ops = self._columns_ops()
         key_names = self.key_names
         out_names = tuple(self.schema.names)
@@ -186,6 +371,17 @@ class TpuHashAggregateExec(TpuExec):
                 col = table.column(in_col)
                 contrib = jnp.logical_and(col.validity, table.row_mask)
                 gid = jnp.zeros(table.capacity, dtype=jnp.int32)
+                if op in _COLLECT_OPS:
+                    data1, lens1 = _collect_segment(
+                        op, col.data, col.lengths, contrib, gid, 1,
+                        list_width)
+                    data = jnp.zeros((cap_out, list_width), data1.dtype) \
+                        .at[0].set(data1[0])
+                    lens = jnp.zeros(cap_out, jnp.int32).at[0].set(lens1[0])
+                    validity = jnp.zeros(cap_out, bool).at[0].set(True)
+                    out_cols.append(
+                        DeviceColumn(data, validity, out_dt, lens))
+                    continue
                 vals1, has1 = _reduce_segment(
                     op, col.data, contrib, gid, 1, pos,
                     jnp.dtype(out_dt.np_dtype()))
@@ -198,44 +394,9 @@ class TpuHashAggregateExec(TpuExec):
 
         def grouped(table: DeviceTable) -> DeviceTable:
             cap = table.capacity
-            active = table.row_mask
-            # ---- sort so equal keys are adjacent, active rows first
-            sort_keys = []
+            order, active_s, gid, boundary, num_groups = \
+                _sorted_group_ids(table, key_names)
             key_cols = [table.column(k) for k in key_names]
-            # lexsort: LAST entry is most significant. Per key column the
-            # null flag dominates its value words; word lists are appended
-            # least-significant first so the big-endian word order holds.
-            for kc in reversed(key_cols):
-                words, nan = _key_code_words(kc)
-                for wd in reversed(words):
-                    sort_keys.append(wd)
-                if nan is not None:
-                    sort_keys.append(nan)  # NaNs sort together (after inf)
-                sort_keys.append(jnp.logical_not(kc.validity))
-            sort_keys.append(jnp.logical_not(active))  # primary: active first
-            order = jnp.lexsort(tuple(sort_keys))
-            active_s = jnp.take(active, order)
-            # ---- group boundaries among sorted active rows
-            same = jnp.ones(cap, dtype=bool)
-            for kc in key_cols:
-                words, nan = _key_code_words(kc)
-                veq = jnp.ones(cap, dtype=bool).at[0].set(False)
-                for wd in words:
-                    veq = jnp.logical_and(
-                        veq, _keys_equal_prev(jnp.take(wd, order)))
-                if nan is not None:  # keep real inf distinct from NaN groups
-                    veq = jnp.logical_and(
-                        veq, _keys_equal_prev(jnp.take(nan, order)))
-                sn = jnp.take(jnp.logical_not(kc.validity), order)
-                prev_sn = jnp.roll(sn, 1)
-                both_null = jnp.logical_and(sn, prev_sn).at[0].set(False)
-                col_same = jnp.where(jnp.logical_or(sn, prev_sn), both_null, veq)
-                same = jnp.logical_and(same, col_same)
-            boundary = jnp.logical_and(jnp.logical_not(same), active_s)
-            boundary = boundary.at[0].set(active_s[0])
-            gid = jnp.cumsum(boundary.astype(jnp.int32)) - 1
-            gid = jnp.clip(gid, 0, cap - 1)
-            num_groups = jnp.sum(boundary.astype(jnp.int32))
             pos = jnp.arange(cap, dtype=jnp.int64)
             # ---- representative sorted-row per group for key output
             rep_src = jnp.where(active_s, pos, jnp.full_like(pos, _BIG))
@@ -259,6 +420,15 @@ class TpuHashAggregateExec(TpuExec):
                 sv = jnp.take(col.data, order, axis=0)
                 svalid = jnp.take(col.validity, order)
                 contrib = jnp.logical_and(svalid, active_s)
+                if op in _COLLECT_OPS:
+                    slen = None if col.lengths is None \
+                        else jnp.take(col.lengths, order)
+                    data, lens = _collect_segment(
+                        op, sv, slen, contrib, gid, cap, list_width)
+                    lens = jnp.where(group_mask, lens, 0)
+                    out_cols.append(
+                        DeviceColumn(data, group_mask, out_dt, lens))
+                    continue
                 vals, has = _reduce_segment(op, sv, contrib, gid, cap, pos,
                                             jnp.dtype(out_dt.np_dtype()))
                 validity = jnp.logical_and(has, group_mask) if op != "count" \
@@ -275,11 +445,55 @@ class TpuHashAggregateExec(TpuExec):
         return (f"HashAgg|{self.mode}|{self.key_names}|"
                 f"{self._columns_ops()!r}|{child_schema}")
 
+    def _sizes_fn(self) -> Callable[[DeviceTable], jax.Array]:
+        """Max list width any collect op needs for one batch (the host
+        syncs this one int to pick a bucketed static width)."""
+        cols_ops = [co for co in self._columns_ops() if co[1] in _COLLECT_OPS]
+        key_names = self.key_names
+
+        def sizes(table: DeviceTable) -> jax.Array:
+            cap = table.capacity
+            if key_names:
+                order, active_s, gid, _, _ = _sorted_group_ids(
+                    table, key_names)
+            else:
+                order = jnp.arange(cap, dtype=jnp.int32)
+                active_s = table.row_mask
+                gid = jnp.zeros(cap, dtype=jnp.int32)
+            w = jnp.asarray(1, jnp.int32)
+            for in_col, op, _, _ in cols_ops:
+                col = table.column(in_col)
+                contrib = jnp.logical_and(
+                    jnp.take(col.validity, order), active_s)
+                if op in ("collect_list", "collect_set"):
+                    per = jax.ops.segment_sum(
+                        contrib.astype(jnp.int32), gid, num_segments=cap)
+                else:
+                    lens = jnp.take(col.lengths, order).astype(jnp.int32)
+                    per = jax.ops.segment_sum(
+                        jnp.where(contrib, lens, 0), gid, num_segments=cap)
+                w = jnp.maximum(w, per.max())
+            return w
+        return sizes
+
+    def _collect_width(self, table: DeviceTable) -> int:
+        from ..columnar.device import bucket_width
+        from ..utils.compile_cache import cached_jit
+        sizes = cached_jit(self.plan_signature() + "|sizes", self._sizes_fn)
+        return bucket_width(max(int(sizes(table)), 1), min_width=4)
+
     def execute_columnar(self, pidx: int) -> Iterator[DeviceTable]:
         from ..columnar.device import concat_device_tables, shrink_to_fit
         from ..memory.catalog import SpillPriorities, get_catalog
         from ..utils.compile_cache import cached_jit
-        fn = cached_jit(self.plan_signature(), self.batch_fn)
+        has_collect = self._has_collect()
+        if not has_collect:
+            fn = cached_jit(self.plan_signature(), self.batch_fn)
+        else:
+            def fn(batch):     # per-batch static width, cached per bucket
+                w = self._collect_width(batch)
+                return cached_jit(self.plan_signature() + f"|W{w}",
+                                  lambda: self.batch_fn(list_width=w))(batch)
         catalog = get_catalog()
         pending = None  # SpillableDeviceTable holding the running merge state
         try:
@@ -297,9 +511,17 @@ class TpuHashAggregateExec(TpuExec):
                     # aggregate.scala merge passes under targetSize)
                     with pending as prev:
                         both = concat_device_tables([prev, out])
-                    merge_fn = cached_jit(
-                        self.plan_signature() + f"|merge{both.capacity}",
-                        self._merge_batch_fn)
+                    merged_exec = self._merged_exec()
+                    if has_collect:
+                        w = merged_exec._collect_width(both)
+                        merge_fn = cached_jit(
+                            self.plan_signature()
+                            + f"|merge{both.capacity}|W{w}",
+                            lambda: merged_exec.batch_fn(list_width=w))
+                    else:
+                        merge_fn = cached_jit(
+                            self.plan_signature() + f"|merge{both.capacity}",
+                            merged_exec.batch_fn)
                     merged = shrink_to_fit(merge_fn(both))
                     pending.close()
                     pending = catalog.register(
@@ -314,8 +536,8 @@ class TpuHashAggregateExec(TpuExec):
             if pending is not None:
                 pending.close()
 
-    def _merge_batch_fn(self):
-        """Re-aggregate concatenated partial outputs (merge semantics)."""
+    def _merged_exec(self) -> "TpuHashAggregateExec":
+        """Exec that re-aggregates concatenated partial outputs."""
         merged = TpuHashAggregateExec.__new__(TpuHashAggregateExec)
         TpuExec.__init__(merged)
         merged.key_names = self.key_names
@@ -329,7 +551,11 @@ class TpuHashAggregateExec(TpuExec):
         merged.child = _SchemaOnly(self.schema)
         merged.children = (merged.child,)
         merged.schema = self.schema
-        return merged.batch_fn()
+        return merged
+
+    def _merge_batch_fn(self):
+        """Re-aggregate concatenated partial outputs (merge semantics)."""
+        return self._merged_exec().batch_fn()
 
     def node_desc(self):
         return f"mode={self.mode} keys={self.key_names}"

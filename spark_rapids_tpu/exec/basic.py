@@ -37,10 +37,11 @@ def eval_exprs_device(table: DeviceTable, exprs: Sequence[Expression],
         if validity is None:
             validity = jnp.ones(table.capacity, dtype=bool)
         values = c.values
-        want = c.dtype.np_dtype()
-        if not isinstance(c.dtype, (dt.StringType, dt.BinaryType)) \
-                and values.dtype != want:
-            values = values.astype(want)
+        if not isinstance(c.dtype, (dt.StringType, dt.BinaryType,
+                                    dt.ArrayType)):
+            want = c.dtype.np_dtype()
+            if values.dtype != want:
+                values = values.astype(want)
         cols.append(DeviceColumn(values, validity, c.dtype, c.lengths))
     return DeviceTable(tuple(cols), table.row_mask, table.num_rows, tuple(names))
 

@@ -1,0 +1,105 @@
+"""TpuGenerateExec — device explode/posexplode over fixed-width arrays.
+
+Reference: GpuGenerateExec.scala:631 (exec rule GenerateExec,
+GpuOverrides.scala:3481). TPU-native shape: the output row count is data-
+dependent, so each batch syncs one int (the exploded total) to pick a
+bucketed output capacity, then a single gather program expands rows —
+``src_row = searchsorted(cumsum(counts), k)`` — with no per-row Python.
+Map explode and arrays outside the device list layout stay on the host
+``CpuGenerateExec`` via TypeSig gating, like the reference's per-type
+nesting checks (TypeChecks.scala:166).
+"""
+from __future__ import annotations
+
+from typing import Iterator, List
+
+import jax.numpy as jnp
+
+from ..columnar import dtypes as dt
+from ..columnar.device import DeviceColumn, DeviceTable, bucket_rows
+from ..expr.base import EvalContext
+from ..expr.collections import PosExplode
+from ..plan.physical import PhysicalPlan
+from ..plan.schema import Field, Schema
+from ..utils import metrics as M
+from .base import TpuExec
+
+__all__ = ["TpuGenerateExec"]
+
+
+class TpuGenerateExec(TpuExec):
+    def __init__(self, child: PhysicalPlan, generator, outer: bool,
+                 gen_fields, min_bucket: int):
+        super().__init__()
+        self.child = child
+        self.children = (child,)
+        self.generator = generator
+        self.outer = outer
+        self.gen_fields = gen_fields
+        self.min_bucket = min_bucket
+        self.schema = Schema(
+            list(child.schema.fields)
+            + [Field(n, d, nb or outer) for n, d, nb in gen_fields])
+
+    @property
+    def fusible(self) -> bool:
+        return False        # output capacity is data-dependent
+
+    def node_desc(self) -> str:
+        kind = "posexplode" if isinstance(self.generator, PosExplode) \
+            else "explode"
+        return f"{kind} outer={self.outer}"
+
+    def execute_columnar(self, pidx: int) -> Iterator[DeviceTable]:
+        for batch in self.child_device_batches(pidx):
+            with self.metrics.timed(M.OP_TIME):
+                out = self._explode_batch(batch, pidx)
+            self.metrics.add(M.NUM_OUTPUT_BATCHES, 1)
+            self.metrics.add(M.NUM_OUTPUT_ROWS, int(out.num_rows))
+            yield out
+
+    def _explode_batch(self, batch: DeviceTable, pidx: int) -> DeviceTable:
+        ctx = EvalContext.for_device(batch, partition_id=pidx)
+        col = self.generator.children[0].eval(ctx)
+        cap = batch.capacity
+        active = batch.row_mask
+        valid = jnp.logical_and(col.valid_mask(ctx), active)
+        lens = jnp.where(valid, col.lengths.astype(jnp.int32), 0)
+        if self.outer:
+            # null/empty arrays still emit one row (with a null element)
+            counts = jnp.where(active, jnp.maximum(lens, 1), 0)
+        else:
+            counts = lens
+        total = int(jnp.sum(counts))
+        out_cap = bucket_rows(max(total, 1), self.min_bucket)
+
+        cum = jnp.cumsum(counts)
+        k = jnp.arange(out_cap, dtype=jnp.int32)
+        src = jnp.searchsorted(cum, k, side="right")
+        src_c = jnp.clip(src, 0, cap - 1).astype(jnp.int32)
+        start = cum[src_c] - counts[src_c]
+        eidx = (k - start).astype(jnp.int32)
+        row_ok = k < total
+        elem_valid = jnp.logical_and(row_ok, eidx < lens[src_c])
+
+        out_cols: List[DeviceColumn] = []
+        for c in batch.columns:
+            g = c.gather(src_c)
+            out_cols.append(g.with_validity(
+                jnp.logical_and(g.validity, row_ok)))
+        names = list(batch.names)
+        gen_names = [n for n, _, _ in self.gen_fields]
+        if isinstance(self.generator, PosExplode):
+            out_cols.append(DeviceColumn(
+                jnp.where(elem_valid, eidx, 0), elem_valid, dt.INT, None))
+        w = col.values.shape[1]
+        elem_dt = self.gen_fields[-1][1]
+        # gather the source rows of the list matrix, then pick the element
+        row_vals = jnp.take(col.values, src_c, axis=0)
+        evals = jnp.take_along_axis(
+            row_vals, jnp.clip(eidx, 0, w - 1)[:, None], axis=1)[:, 0]
+        evals = jnp.where(elem_valid, evals, jnp.zeros((), evals.dtype))
+        out_cols.append(DeviceColumn(evals, elem_valid, elem_dt, None))
+        return DeviceTable(tuple(out_cols), row_ok,
+                           jnp.asarray(total, jnp.int32),
+                           tuple(names + gen_names))

@@ -316,7 +316,9 @@ class CollectList(AggregateFunction):
 
     @property
     def data_type(self):
-        return dt.ArrayType(self.child.data_type)
+        # collect_list skips nulls, so the result never contains them —
+        # which also admits the device list layout (containsNull=false)
+        return dt.ArrayType(self.child.data_type, False)
 
     @property
     def nullable(self):

@@ -89,6 +89,12 @@ def _host_only(ctx: EvalContext, what: str):
             "prevented device lowering)")
 
 
+# Device list layout (first nested slice; reference: cuDF list columns,
+# TypeChecks.scala:166 per-op nesting): EvalCol.values is a (rows, W)
+# element matrix, EvalCol.lengths the per-row list length; element nulls
+# are excluded statically (TypeSig.with_arrays -> containsNull=false).
+
+
 # ---------------------------------------------------------------------------
 # creators
 # ---------------------------------------------------------------------------
@@ -264,7 +270,19 @@ class GetArrayItem(Expression):
         return self.children[0].data_type.element_type
 
     def eval(self, ctx: EvalContext) -> EvalCol:
-        _host_only(ctx, "array index")
+        if ctx.is_device:
+            xp = ctx.xp
+            arr = self.children[0].eval(ctx)
+            o = self.children[1].eval(ctx)
+            idx = o.values.astype(xp.int32)
+            in_range = xp.logical_and(idx >= 0, idx < arr.lengths)
+            w = arr.values.shape[1]
+            vals = xp.take_along_axis(
+                arr.values, xp.clip(idx, 0, w - 1)[:, None], axis=1)[:, 0]
+            valid = xp.logical_and(arr.valid_mask(ctx), o.valid_mask(ctx))
+            valid = xp.logical_and(valid, in_range)
+            vals = xp.where(valid, vals, xp.zeros((), vals.dtype))
+            return EvalCol(vals, valid, self.data_type)
         arrs = _rows(ctx, self.children[0].eval(ctx))
         ords = _rows(ctx, self.children[1].eval(ctx))
         out = []
@@ -290,7 +308,22 @@ class ElementAt(Expression):
         return t.element_type if isinstance(t, dt.ArrayType) else t.value_type
 
     def eval(self, ctx: EvalContext) -> EvalCol:
-        _host_only(ctx, "element_at")
+        if ctx.is_device:
+            # ARRAY only (maps gated to host); literal key != 0 enforced at
+            # tag time (k == 0 raises data-dependently on the host path)
+            xp = ctx.xp
+            arr = self.children[0].eval(ctx)
+            k = self.children[1].eval(ctx)
+            kv = k.values.astype(xp.int32)
+            idx = xp.where(kv < 0, kv + arr.lengths, kv - 1)
+            in_range = xp.logical_and(idx >= 0, idx < arr.lengths)
+            w = arr.values.shape[1]
+            vals = xp.take_along_axis(
+                arr.values, xp.clip(idx, 0, w - 1)[:, None], axis=1)[:, 0]
+            valid = xp.logical_and(arr.valid_mask(ctx), k.valid_mask(ctx))
+            valid = xp.logical_and(valid, in_range)
+            vals = xp.where(valid, vals, xp.zeros((), vals.dtype))
+            return EvalCol(vals, valid, self.data_type)
         base = _rows(ctx, self.children[0].eval(ctx))
         keys = _rows(ctx, self.children[1].eval(ctx))
         is_map = isinstance(self.children[0].data_type, dt.MapType)
@@ -406,7 +439,14 @@ class Size(Expression):
         return not self.legacy
 
     def eval(self, ctx: EvalContext) -> EvalCol:
-        _host_only(ctx, "size")
+        if ctx.is_device:
+            xp = ctx.xp
+            arr = self.children[0].eval(ctx)
+            valid = arr.valid_mask(ctx)
+            lens = arr.lengths.astype(xp.int32)
+            if self.legacy:
+                return EvalCol(xp.where(valid, lens, -1), None, dt.INT)
+            return EvalCol(xp.where(valid, lens, 0), valid, dt.INT)
         rows = _rows(ctx, self.children[0].eval(ctx))
         if self.legacy:
             out = [-1 if r is None else len(r) for r in rows]
@@ -426,7 +466,19 @@ class ArrayContains(Expression):
         return dt.BOOLEAN
 
     def eval(self, ctx: EvalContext) -> EvalCol:
-        _host_only(ctx, "array_contains")
+        if ctx.is_device:
+            # containsNull=false on device, so the "found nothing but the
+            # array has nulls -> null" branch cannot arise
+            xp = ctx.xp
+            arr = self.children[0].eval(ctx)
+            v = self.children[1].eval(ctx)
+            w = arr.values.shape[1]
+            in_len = xp.arange(w, dtype=xp.int32)[None, :] \
+                < arr.lengths[:, None]
+            eq = arr.values == v.values[:, None].astype(arr.values.dtype)
+            found = xp.any(xp.logical_and(eq, in_len), axis=1)
+            valid = xp.logical_and(arr.valid_mask(ctx), v.valid_mask(ctx))
+            return EvalCol(xp.logical_and(found, valid), valid, dt.BOOLEAN)
         arrs = _rows(ctx, self.children[0].eval(ctx))
         vals = _rows(ctx, self.children[1].eval(ctx))
         out = []
@@ -481,7 +533,44 @@ class _ArrayMinMax(Expression):
         return self.children[0].data_type.element_type
 
     def eval(self, ctx: EvalContext) -> EvalCol:
-        _host_only(ctx, "array_min/max")
+        if ctx.is_device:
+            xp = ctx.xp
+            arr = self.children[0].eval(ctx)
+            w = arr.values.shape[1]
+            in_len = xp.arange(w, dtype=xp.int32)[None, :] \
+                < arr.lengths[:, None]
+            v = arr.values
+            if v.dtype == xp.bool_:
+                v = v.astype(xp.int32)
+            isfloat = xp.issubdtype(v.dtype, xp.floating)
+            if isfloat:
+                # Spark total order: NaN greatest — min skips NaN unless
+                # all-NaN; max returns NaN when any NaN present
+                nan = xp.isnan(v)
+                sub = xp.where(nan, xp.inf if self.IS_MIN else -xp.inf, v)
+            else:
+                sub = v
+            ident = xp.asarray(
+                xp.iinfo(v.dtype).max if not isfloat else xp.inf, v.dtype) \
+                if self.IS_MIN else xp.asarray(
+                    xp.iinfo(v.dtype).min if not isfloat else -xp.inf,
+                    v.dtype)
+            masked = xp.where(in_len, sub, ident)
+            red = masked.min(axis=1) if self.IS_MIN else masked.max(axis=1)
+            if isfloat:
+                nan_in = xp.any(xp.logical_and(nan, in_len), axis=1)
+                n_nonnan = xp.sum(
+                    xp.logical_and(in_len, xp.logical_not(nan)), axis=1)
+                if self.IS_MIN:
+                    red = xp.where(xp.logical_and(nan_in, n_nonnan == 0),
+                                   xp.nan, red)
+                else:
+                    red = xp.where(nan_in, xp.nan, red)
+            valid = xp.logical_and(arr.valid_mask(ctx), arr.lengths > 0)
+            red = xp.where(valid, red, xp.zeros((), red.dtype))
+            if isinstance(self.data_type, dt.BooleanType):
+                red = red.astype(xp.bool_)
+            return EvalCol(red, valid, self.data_type)
         rows = _rows(ctx, self.children[0].eval(ctx))
         out = []
         for r in rows:

@@ -391,6 +391,12 @@ def concat_ws(sep: str, *cols) -> Column:
     return Column(ConcatWs(Literal(sep), *[_to_expr(c) for c in cols]))
 
 
+def char(c) -> Column:
+    """chr(n) — the character for code n & 0xFF (Spark's `chr`)."""
+    from .strings import Chr
+    return Column(Chr(_to_expr(c)))
+
+
 def trim(c) -> Column:
     from .strings import StringTrim
     return Column(StringTrim(_to_expr(c)))

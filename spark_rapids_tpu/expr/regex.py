@@ -75,6 +75,13 @@ class RRepeat(RNode):
 
 
 @dataclasses.dataclass
+class RGroup(RNode):
+    """Capturing group (index is 1-based, Java numbering)."""
+    child: RNode
+    index: int
+
+
+@dataclasses.dataclass
 class RStartAnchor(RNode):
     pass
 
@@ -98,6 +105,8 @@ class RegexParser:
         #: lazy quantifiers seen — harmless for boolean matching, but they
         #: change SPAN lengths, so span-based ops must stay on host
         self.saw_lazy = False
+        #: capturing groups seen (Java numbering)
+        self.ngroups = 0
 
     def parse(self) -> RNode:
         node = self._alt()
@@ -165,16 +174,21 @@ class RegexParser:
     def _atom(self) -> RNode:
         ch = self._next()
         if ch == "(":
+            capturing = True
             if self._peek() == "?":
                 # (?:...) ok; lookaround/named groups unsupported
                 if self.p[self.i:self.i + 2] == "?:":
                     self.i += 2
+                    capturing = False
                 else:
                     raise RegexUnsupported("special group")
+            if capturing:
+                self.ngroups += 1
+                gidx = self.ngroups
             node = self._alt()
             if self._next() != ")":
                 raise RegexUnsupported("unbalanced group")
-            return node
+            return RGroup(node, gidx) if capturing else node
         if ch == "[":
             return self._char_class()
         if ch == ".":
@@ -313,6 +327,8 @@ class _Frag:
 
 
 def _build(node: RNode, nb: _NfaBuilder) -> _Frag:
+    if isinstance(node, RGroup):    # transparent for matching
+        return _build(node.child, nb)
     if isinstance(node, RChars):
         if not node.bytes_:
             raise RegexUnsupported("empty char class")
@@ -575,6 +591,8 @@ def _contains_alt(node: RNode) -> bool:
         return any(_contains_alt(i) for i in node.items)
     if isinstance(node, RRepeat):
         return _contains_alt(node.child)
+    if isinstance(node, RGroup):
+        return _contains_alt(node.child)
     return False
 
 
@@ -688,3 +706,130 @@ def literal_match_ends(xp, values, lengths, search: bytes):
     fits = (pos[None, :] + L) <= lengths[:, None]
     match = xp.logical_and(match, fits)
     return xp.where(match, pos[None, :] + L, -1).astype(xp.int32)
+
+
+# ---------------------------------------------------------------------------
+# Capture groups (reference: CudfRegexTranspiler keeps capture groups in the
+# transpiled pattern, RegexParser.scala:414; cuDF extracts them natively).
+# The TPU-native equivalent: for the deterministic no-alternation subset the
+# pattern linearizes into charset items; after the NFA finds the match span,
+# a vectorized greedy walk over the items recovers every group boundary —
+# no per-row control flow, one (rows x width) pass per item.
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class GroupPlan:
+    """Linearized pattern: items are (charset, lo, hi); groups maps group
+    index -> [first_item, end_item) ranges over ``items``."""
+    items: List[Tuple[frozenset, int, Optional[int]]]
+    groups: dict
+    ngroups: int
+
+
+def _linearize(node: RNode, items: List, groups: dict,
+               in_group: Optional[int]) -> None:
+    if isinstance(node, RSeq):
+        for it in node.items:
+            _linearize(it, items, groups, in_group)
+        return
+    if isinstance(node, RGroup):
+        if in_group is not None:
+            raise RegexUnsupported("nested capture group")
+        start = len(items)
+        _linearize(node.child, items, groups, node.index)
+        groups[node.index] = (start, len(items))
+        return
+    if isinstance(node, RChars):
+        items.append((node.bytes_, 1, 1))
+        return
+    if isinstance(node, RRepeat):
+        if not isinstance(node.child, RChars):
+            raise RegexUnsupported("repeat over a non-class in group plan")
+        items.append((node.child.bytes_, node.lo, node.hi))
+        return
+    raise RegexUnsupported(f"group plan: {type(node).__name__}")
+
+
+def compile_group_plan(pattern: str) -> Optional[GroupPlan]:
+    """Linearize ``pattern`` for device capture-group extraction, or None.
+
+    Subset: no alternation/lazy, ASCII-only classes (char-aligned spans),
+    non-nullable, groups flat (not nested, not repeated), and greedy
+    consumption DETERMINISTIC: every variable-length item's charset is
+    disjoint from the first-sets of the items that may follow it up to and
+    including the next mandatory item — under that condition the greedy
+    left-to-right walk reproduces Java's backtracking parse exactly."""
+    try:
+        parser = RegexParser(pattern)
+        ast = parser.parse()
+    except RegexUnsupported:
+        return None
+    if parser.saw_lazy or parser.ngroups == 0 or _contains_alt(ast):
+        return None
+    if isinstance(ast, RSeq):
+        its = list(ast.items)
+        if its and isinstance(its[0], RStartAnchor):
+            its = its[1:]
+        if its and isinstance(its[-1], REndAnchor):
+            its = its[:-1]
+        ast = RSeq(its)
+    items: List[Tuple[frozenset, int, Optional[int]]] = []
+    groups: dict = {}
+    try:
+        _linearize(ast, items, groups, None)
+    except RegexUnsupported:
+        return None
+    if not items or all(lo == 0 for _, lo, _ in items):
+        return None                       # nullable: empty-match semantics
+    for cs, _, _ in items:
+        if not cs or max(cs) >= 0x80:
+            return None                   # spans must stay char-aligned
+    # determinism of greedy consumption
+    for i, (cs, lo, hi) in enumerate(items):
+        if hi is not None and hi == lo:
+            continue                      # fixed width: nothing to choose
+        for cs2, lo2, _ in items[i + 1:]:
+            if cs & cs2:
+                return None
+            if lo2 >= 1:
+                break                     # first mandatory follower reached
+    return GroupPlan(items, groups, parser.ngroups)
+
+
+def extract_group_span(xp, values, lengths, ends, plan: GroupPlan,
+                       gidx: int):
+    """Extract capture group ``gidx`` of the leftmost match per row.
+    -> (out (n, w) uint8, out_lengths). No match -> ''."""
+    from jax import lax
+    n, w = values.shape
+    valid = ends >= 0
+    found = xp.any(valid, axis=1)
+    start = xp.argmax(valid, axis=1).astype(xp.int32)
+    pos = start
+    idxs = xp.arange(w, dtype=xp.int32)
+    bounds: List = [pos]                 # pos after item k at bounds[k+1]
+    vi = values.astype(xp.int32)
+    in_str = idxs[None, :] < lengths[:, None]
+    for cs, lo, hi in plan.items:
+        lut = np.zeros(256, dtype=bool)
+        lut[list(cs)] = True
+        member = xp.logical_and(xp.asarray(lut)[vi], in_str)
+        # next non-member position at or after j (suffix min of bad indices)
+        bad_at = xp.where(member, w, idxs[None, :])
+        nb = lax.associative_scan(xp.minimum, bad_at[:, ::-1],
+                                  axis=1)[:, ::-1]
+        next_bad = xp.take_along_axis(
+            nb, xp.clip(pos, 0, w - 1)[:, None], axis=1)[:, 0]
+        avail = xp.maximum(next_bad - pos, 0)
+        take = avail if hi is None else xp.minimum(avail, hi)
+        pos = (pos + take).astype(xp.int32)
+        bounds.append(pos)
+    lo_i, hi_i = plan.groups[gidx]
+    gs = bounds[lo_i]
+    ge = bounds[hi_i]
+    out_len = xp.where(found, xp.maximum(ge - gs, 0), 0).astype(xp.int32)
+    k = xp.arange(w, dtype=xp.int32)
+    src = xp.clip(gs[:, None] + k[None, :], 0, w - 1)
+    out = xp.take_along_axis(values, src, axis=1)
+    out = xp.where(k[None, :] < out_len[:, None], out, 0).astype(xp.uint8)
+    return out, out_len

@@ -259,7 +259,10 @@ class Ascii(Expression):
 
 
 class Chr(Expression):
-    """chr() — host-only (codepoint→string needs dynamic width)."""
+    """chr(n): the character for n & 0xFF (empty for n < 0).
+
+    Device: the output is at most 2 UTF-8 bytes (codepoints 0-255), so the
+    "dynamic" width is a static 2-byte matrix with computed lengths."""
 
     def __init__(self, child: Expression):
         self.child = child
@@ -271,6 +274,20 @@ class Chr(Expression):
 
     def eval(self, ctx: EvalContext) -> EvalCol:
         c = self.child.eval(ctx)
+        if ctx.is_device:
+            xp = ctx.xp
+            from ..columnar.device import bucket_width
+            iv = c.values.astype(xp.int64)   # sign check BEFORE narrowing
+            b = (iv & 0xFF).astype(xp.int32)
+            one = b < 0x80
+            byte0 = xp.where(one, b, 0xC0 | (b >> 6)).astype(xp.uint8)
+            byte1 = xp.where(one, 0, 0x80 | (b & 0x3F)).astype(xp.uint8)
+            data = _pad_to(xp, xp.stack([byte0, byte1], axis=1),
+                           bucket_width(2))
+            lengths = xp.where(iv < 0, 0, xp.where(one, 1, 2)) \
+                .astype(xp.int32)
+            return EvalCol(_zero_tail(xp, data, lengths), c.validity,
+                           dt.STRING, lengths)
         vals = np.asarray([chr(int(v) & 0xFF) if int(v) >= 0 else ""
                            for v in c.values], dtype=object)
         return EvalCol(vals, c.validity, dt.STRING)
@@ -337,8 +354,13 @@ def _host_substr(s: str, pos: int, ln: int) -> str:
 
 
 class SubstringIndex(Expression):
-    """substring_index(str, delim, count) — host-only (delimiter scanning with
-    dynamic output length; device falls back via tagging)."""
+    """substring_index(str, delim, count) with literal delim/count.
+
+    Device: delimiter occurrences found by unrolled shifted-byte compares
+    (UTF-8 is self-synchronizing, so byte matching is character-exact);
+    multi-byte delimiters resolve overlaps with a left-to-right lax.scan;
+    count>0 keeps a prefix (tail zeroed), count<0 a suffix (left-shift
+    gather). Reference: GpuSubstringIndex in stringFunctions.scala."""
 
     def __init__(self, child: Expression, delim: Expression, count: Expression):
         self.child, self.delim, self.count = child, delim, count
@@ -351,11 +373,70 @@ class SubstringIndex(Expression):
     def eval(self, ctx: EvalContext) -> EvalCol:
         c = self.child.eval(ctx)
         delim = literal_value(self.delim)
-        cnt = literal_value(self.count)
+        cnt = int(literal_value(self.count))
+        if ctx.is_device:
+            return self._eval_device(ctx, c, delim, cnt)
         out = []
         for s in c.values:
-            out.append(_substring_index(s, delim, int(cnt)))
+            out.append(_substring_index(s, delim, cnt))
         return EvalCol(np.asarray(out, dtype=object), c.validity, dt.STRING)
+
+    def _eval_device(self, ctx, c, delim: str, cnt: int) -> EvalCol:
+        xp = ctx.xp
+        v, lengths = c.values, c.lengths
+        n, w = v.shape
+        db = delim.encode() if delim else b""
+        dlen = len(db)
+        if dlen == 0 or cnt == 0 or dlen > w:
+            empty_ok = dlen == 0 or cnt == 0  # no-delim/0-count -> ""
+            out_len = xp.zeros(n, xp.int32) if empty_ok else lengths
+            data = _zero_tail(xp, v, out_len)
+            return EvalCol(data, c.validity, dt.STRING, out_len)
+        j = xp.arange(w, dtype=xp.int32)[None, :]
+        # occ[r, j]: delim bytes match starting at byte j (unrolled: dlen is
+        # a host literal, typically 1-3)
+        occ = xp.ones((n, w), dtype=bool)
+        for k, bk in enumerate(db):
+            shifted = xp.roll(v, -k, axis=1) if k else v
+            # roll wraps; positions past w-k are invalidated by the length
+            # bound below (j + dlen <= len <= w)
+            occ = xp.logical_and(occ, shifted == xp.uint8(bk))
+        occ = xp.logical_and(occ, (j + dlen) <= lengths[:, None])
+        if dlen == 1:
+            keep = occ
+        else:
+            from jax import lax
+
+            def step(next_ok, col):
+                o = occ[:, col]
+                k_ = xp.logical_and(o, col >= next_ok)
+                next_ok = xp.where(k_, col + dlen, next_ok)
+                return next_ok, k_
+
+            _, keep_t = lax.scan(step, xp.zeros(n, xp.int32),
+                                 xp.arange(w, dtype=xp.int32))
+            keep = keep_t.T  # scan stacks per-column results on axis 0
+        kcum = xp.cumsum(keep.astype(xp.int32), axis=1)
+        total = kcum[:, -1]
+        if cnt > 0:
+            found = total >= cnt
+            hit = xp.logical_and(keep, kcum == cnt)
+            cut = xp.argmax(hit, axis=1).astype(xp.int32)
+            out_len = xp.where(found, cut, lengths).astype(xp.int32)
+            data = _zero_tail(xp, v, out_len)
+        else:
+            kneg = -cnt
+            found = total >= kneg
+            target = (total - kneg + 1)[:, None]
+            hit = xp.logical_and(keep, kcum == target)
+            start = xp.where(found,
+                             xp.argmax(hit, axis=1).astype(xp.int32) + dlen,
+                             0).astype(xp.int32)
+            src = xp.clip(j + start[:, None], 0, w - 1)
+            data = xp.take_along_axis(v, src, axis=1)
+            out_len = (lengths - start).astype(xp.int32)
+            data = _zero_tail(xp, data, out_len)
+        return EvalCol(data, c.validity, dt.STRING, out_len)
 
 
 def _substring_index(s: str, delim: str, count: int) -> str:
@@ -559,8 +640,13 @@ def _device_concat2(ctx, l: EvalCol, r: EvalCol) -> EvalCol:
 
 
 class ConcatWs(Expression):
-    """concat_ws(sep, ...) — skips nulls; host-only (conditional separators
-    make the device variant dynamic; falls back via tagging)."""
+    """concat_ws(sep, ...) — skips null inputs; null only when sep is null.
+
+    Device: fold of the Concat index-select merge, with per-row effective
+    lengths zeroed for null inputs and for separators that precede the
+    first non-null part — the output width is statically bounded by the
+    sum of input widths, so "dynamic" width is just length arithmetic
+    (reference: GpuConcatWs in stringFunctions.scala)."""
 
     def __init__(self, sep: Expression, *children: Expression):
         self.sep = sep
@@ -577,6 +663,8 @@ class ConcatWs(Expression):
     def eval(self, ctx: EvalContext) -> EvalCol:
         sep = self.sep.eval(ctx)
         cols = [c.eval(ctx) for c in self.children[1:]]
+        if ctx.is_device:
+            return self._eval_device(ctx, sep, cols)
         out = []
         n = ctx.num_rows
         masks = [c.valid_mask(ctx) for c in cols]
@@ -584,6 +672,26 @@ class ConcatWs(Expression):
             parts = [c.values[i] for c, m in zip(cols, masks) if m[i]]
             out.append(sep.values[i].join(parts))
         return EvalCol(np.asarray(out, dtype=object), sep.validity, dt.STRING)
+
+    def _eval_device(self, ctx, sep, cols) -> EvalCol:
+        xp = ctx.xp
+        n = sep.shape0(ctx)
+        acc = EvalCol(xp.zeros((n, 1), dtype=xp.uint8), None, dt.STRING,
+                      xp.zeros(n, dtype=xp.int32))
+        started = xp.zeros(n, dtype=bool)
+        for c in cols:
+            valid = c.valid_mask(ctx)
+            need_sep = xp.logical_and(started, valid)
+            sep_eff = EvalCol(
+                sep.values, None, dt.STRING,
+                xp.where(need_sep, sep.lengths, 0).astype(xp.int32))
+            part = EvalCol(
+                c.values, None, dt.STRING,
+                xp.where(valid, c.lengths, 0).astype(xp.int32))
+            acc = _device_concat2(ctx, acc, sep_eff)
+            acc = _device_concat2(ctx, acc, part)
+            started = xp.logical_or(started, valid)
+        return EvalCol(acc.values, sep.validity, dt.STRING, acc.lengths)
 
 
 class StringRpad(Expression):
@@ -918,15 +1026,25 @@ class RegExpExtract(Expression):
         import re as _re
         c = self.child.eval(ctx)
         if ctx.is_device:
-            from .regex import compile_device_nfa, extract_first_span
+            from .regex import (compile_device_nfa, compile_group_plan,
+                                extract_first_span, extract_group_span)
             nfa = compile_device_nfa(literal_value(self.pattern))
-            if nfa is None or not nfa.spans_supported \
-                    or int(literal_value(self.idx)) != 0:
+            gi = int(literal_value(self.idx))
+            if nfa is None or not nfa.spans_supported:
                 raise TypeError("device regexp_extract outside the span "
                                 "subset (tag_fn gates this)")
             xp = ctx.xp
             ends = nfa.match_ends(xp, c.values, c.lengths)
-            out, out_len = extract_first_span(xp, c.values, c.lengths, ends)
+            if gi == 0:
+                out, out_len = extract_first_span(
+                    xp, c.values, c.lengths, ends)
+            else:
+                plan = compile_group_plan(literal_value(self.pattern))
+                if plan is None or gi > plan.ngroups:
+                    raise TypeError("device regexp_extract: capture group "
+                                    "outside the plan subset (tag_fn gates)")
+                out, out_len = extract_group_span(
+                    xp, c.values, c.lengths, ends, plan, gi)
             return EvalCol(out, c.validity, dt.STRING, out_len)
         rx = _re.compile(literal_value(self.pattern))
         gi = int(literal_value(self.idx))

@@ -101,6 +101,7 @@ class BufferCatalog:
         self._ids = itertools.count()
         self._lock = threading.RLock()
         self._oom_spill = conf.get(OOM_SPILL_ENABLED)
+        self.oom_events = 0  # runtime RESOURCE_EXHAUSTED recoveries
         self.spill_count = {StorageTier.HOST: 0, StorageTier.DISK: 0}
         self.spilled_bytes = {StorageTier.HOST: 0, StorageTier.DISK: 0}
         self._debug = bool(conf.get(MEMORY_DEBUG))
@@ -311,6 +312,35 @@ class BufferCatalog:
                     for bid, rc, site in leaks[:10])
                 raise DebugMemoryError(
                     f"{len(leaks)} leaked buffer(s): {detail}")
+
+    def handle_device_oom(self, context: str = "") -> int:
+        """Runtime-OOM callback (reference: DeviceMemoryEventHandler.scala:33
+        — RMM allocation failure -> synchronous spill -> retry alloc).
+
+        XLA/PJRT exposes no alloc hook, so callers invoke this when a
+        device computation raises RESOURCE_EXHAUSTED and retry once. The
+        needed allocation size is unknown, so everything spillable moves
+        down-tier. Returns bytes freed (0 = nothing left to spill)."""
+        with self._lock:
+            target = self.device.used_bytes
+        freed = self.synchronous_spill(max(target, 1))
+        self.oom_events += 1
+        return freed
+
+    def oom_dump(self) -> str:
+        """Diagnostic snapshot for a spill-couldn't-save-it failure
+        (reference: spark.rapids.memory.gpu.oomDumpDir state dumps)."""
+        s = self.stats()
+        with self._lock:
+            top = sorted(self._buffers.values(),
+                         key=lambda b: -b.size_bytes)[:10]
+            rows = [f"  buffer {b.buffer_id} tier="
+                    f"{StorageTier.NAMES[b.tier]} bytes={b.size_bytes} "
+                    f"refcount={b.refcount} priority={b.priority} "
+                    f"site={self._sites.get(b.buffer_id, '?')}"
+                    for b in top]
+        return ("device OOM after spill retry; catalog state: "
+                f"{s}\nlargest buffers:\n" + "\n".join(rows))
 
     def stats(self) -> dict:
         with self._lock:

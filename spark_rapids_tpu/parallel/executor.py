@@ -51,6 +51,13 @@ class ExecutorContext:
         self.semaphore = TpuSemaphore(self.conf.get(CONCURRENT_TPU_TASKS))
         self.shuffle = ShuffleManager(self.conf, self._transport)
         self.shuffle.heartbeats.register(self.executor_id)
+        # broadcast relations materialize once and re-materialize from the
+        # transport per executor (reference:
+        # GpuBroadcastExchangeExec.scala:336-345)
+        from ..shuffle.broadcast import BroadcastManager
+        self.broadcast = BroadcastManager(
+            self.shuffle.transport, self.catalog,
+            self.conf.min_bucket_rows)
         self.initialized = True
         return self
 

@@ -22,7 +22,7 @@ from __future__ import annotations
 import itertools
 import threading
 from concurrent.futures import ThreadPoolExecutor
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 import pyarrow as pa
 
@@ -295,3 +295,40 @@ def shuffle_read_recompute_task(ctx: ExecutorContext, shuffle_id: int,
     if not out:
         return None
     return serialize_table(out[0].to_host())
+
+
+def broadcast_build_task(ctx: ExecutorContext, bcast_id: int,
+                         payload: bytes) -> Tuple[int, int]:
+    """Designated-builder side of a cross-process broadcast (reference:
+    the driver-side relationFuture, GpuBroadcastExchangeExec.scala:336)."""
+    from ..columnar.device import DeviceTable
+    from ..shuffle.serializer import deserialize_table
+
+    def build():
+        return DeviceTable.from_host(deserialize_table(payload),
+                                     min_bucket=8)
+    ctx.broadcast.build_and_publish(bcast_id, build)
+    return ctx.broadcast.builds, ctx.broadcast.fetches
+
+
+def broadcast_probe_task(ctx: ExecutorContext, bcast_id: int,
+                         probe_payload: bytes, key: str
+                         ) -> Tuple[bytes, int, int]:
+    """Probe side: re-materialize the broadcast build table from the
+    transport (never re-executing the build) and hash-join the local probe
+    partition against it on ``key``."""
+    import numpy as np
+
+    from ..shuffle.serializer import deserialize_table, serialize_table
+    build = ctx.broadcast.get(bcast_id).to_host()
+    probe = deserialize_table(probe_payload)
+    bk = np.sort(build.column(key).values)
+    pk = probe.column(key).values
+    if len(bk):
+        pos = np.clip(np.searchsorted(bk, pk), 0, len(bk) - 1)
+        hit = bk[pos] == pk
+    else:
+        hit = np.zeros(len(pk), dtype=bool)
+    joined = probe.take(np.nonzero(hit)[0])
+    return (serialize_table(joined), ctx.broadcast.builds,
+            ctx.broadcast.fetches)

@@ -23,8 +23,6 @@ __all__ = ["validate", "report"]
 # Cpu execs that intentionally have no device rule, with the documented
 # reason (the reference likewise documents known-unsupported operators).
 KNOWN_HOST_ONLY_EXECS: Dict[str, str] = {
-    "CpuGenerateExec": "explode lowers through plan/generate.py host path "
-                       "with a device Expand for array columns",
     "CpuMapInPandasExec": "opaque Python bridge; runs host-side with the "
                           "device semaphore released",
     "CpuGroupedMapPandasExec": "opaque per-group Python bridge; host-side "

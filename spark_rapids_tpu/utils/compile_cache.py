@@ -1,4 +1,4 @@
-"""Global XLA compile cache.
+"""Global XLA compile cache (+ the runtime-OOM recovery chokepoint).
 
 Plans are rebuilt per query execution, but the traced computations repeat
 (same operator chains over the same shape buckets). jax.jit caches on the
@@ -7,20 +7,63 @@ recompile every run (~1s each). This cache keys jitted callables by a
 canonical plan signature so repeated queries hit steady-state dispatch
 (~0.1ms). The reference relies on cuDF's precompiled kernels; on TPU the
 compile-once-run-many discipline is ours to enforce.
+
+Every jitted device computation flows through here, which makes it the
+TPU-native stand-in for RMM's allocation-failure callback (reference:
+DeviceMemoryEventHandler.scala:33): a RESOURCE_EXHAUSTED from the runtime
+triggers a synchronous catalog spill and ONE retry; a second failure
+re-raises with the catalog's OOM dump attached.
 """
 from __future__ import annotations
 
+import functools
+import sys
 import threading
 from typing import Callable, Dict
 
 import jax
 
-__all__ = ["cached_jit", "cache_stats", "clear_cache"]
+__all__ = ["cached_jit", "cache_stats", "clear_cache", "oom_retry"]
 
 _CACHE: Dict[str, Callable] = {}
 _LOCK = threading.Lock()
 _HITS = 0
 _MISSES = 0
+
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "RESOURCE EXHAUSTED", "Out of memory",
+                "out of memory", "OOM")
+
+
+def _is_device_oom(e: BaseException) -> bool:
+    msg = str(e)
+    return isinstance(e, (RuntimeError, MemoryError)) \
+        and any(m in msg for m in _OOM_MARKERS)
+
+
+def oom_retry(fn: Callable) -> Callable:
+    """Wrap a device-invoking callable with spill-and-retry-once OOM
+    recovery (reference: DeviceMemoryEventHandler.scala:33)."""
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        try:
+            return fn(*args, **kwargs)
+        except Exception as e:
+            if not _is_device_oom(e):
+                raise
+            from ..memory.catalog import get_catalog
+            catalog = get_catalog()
+            freed = catalog.handle_device_oom(context=repr(e)[:200])
+            print(f"# device OOM: spilled {freed} bytes, retrying once "
+                  f"({type(e).__name__})", file=sys.stderr)
+            if freed <= 0:
+                raise RuntimeError(catalog.oom_dump()) from e
+            try:
+                return fn(*args, **kwargs)
+            except Exception as e2:
+                if _is_device_oom(e2):
+                    raise RuntimeError(catalog.oom_dump()) from e2
+                raise
+    return wrapped
 
 
 def cached_jit(key: str, builder: Callable[[], Callable]) -> Callable:
@@ -32,7 +75,7 @@ def cached_jit(key: str, builder: Callable[[], Callable]) -> Callable:
             _HITS += 1
             return fn
         _MISSES += 1
-    built = jax.jit(builder())
+    built = oom_retry(jax.jit(builder()))
     with _LOCK:
         return _CACHE.setdefault(key, built)
 

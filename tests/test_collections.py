@@ -210,14 +210,19 @@ def test_collect_list_set(session):
     df = session.create_dataframe(t, num_partitions=3)
     q = df.group_by("k").agg(F.collect_list(col("v")).alias("lst"),
                              F.collect_set(col("v")).alias("st"))
-    out = assert_tpu_cpu_equal(q)
+    # element ORDER is engine-specific (Spark guarantees none for
+    # collect_*; the device merge dedups sets by value sort) — compare
+    # per-group multisets against both engines and pandas
+    dev = q.collect(device=True).to_pandas().sort_values("k") \
+        .reset_index(drop=True)
+    cpu = q.collect(device=False).to_pandas().sort_values("k") \
+        .reset_index(drop=True)
     pdf = t.to_pandas()
-    for k, lst, st in zip(out.column("k").to_pylist(),
-                          out.column("lst").to_pylist(),
-                          out.column("st").to_pylist()):
-        exp = pdf[pdf.k == k].v.tolist()
-        assert sorted(lst) == sorted(exp)
-        assert sorted(st) == sorted(set(exp))
+    assert (dev.k == cpu.k).all()
+    for i in range(len(dev)):
+        exp = pdf[pdf.k == dev.k[i]].v.tolist()
+        assert sorted(dev.lst[i]) == sorted(cpu.lst[i]) == sorted(exp)
+        assert sorted(dev.st[i]) == sorted(cpu.st[i]) == sorted(set(exp))
 
 
 def test_approx_percentile(session):
@@ -348,3 +353,143 @@ def test_map_dedup_policy_bound_at_plan_time(session):
     b.create_dataframe(pa.table({"z": [1]})).collect()   # b becomes active
     out = list(plan.execute(0))
     assert out[0].column("m").values[0] == [("k", 9)]    # A's LAST_WIN
+
+
+# ---------------------------------------------------------------------------
+# Device list layout (round-2 missing #2-#4): ARRAY<fixed-width> with
+# containsNull=false runs ON DEVICE — values matrix + lengths, the string
+# trick generalized (reference: per-op nesting support TypeChecks.scala:166,
+# GpuGenerateExec.scala:631, GpuCollectList/Set AggregateFunctions.scala).
+# ---------------------------------------------------------------------------
+
+def _nn_list(elem=pa.int64()):
+    return pa.list_(pa.field("item", elem, nullable=False))
+
+
+@pytest.fixture()
+def devarr(session, rng):
+    n = 300
+    lists = [rng.integers(0, 50, rng.integers(0, 7)).tolist()
+             for _ in range(n)]
+    mask = rng.random(n) < 0.15
+    t = pa.table({
+        "a": pa.array([None if m else l for l, m in zip(lists, mask)],
+                      type=_nn_list()),
+        "f": pa.array([rng.normal(size=rng.integers(0, 5)).tolist()
+                       for _ in range(n)], type=_nn_list(pa.float64())),
+        "k": pa.array(rng.integers(0, 8, n), type=pa.int64()),
+        "v": pa.array(np.where(rng.random(n) < 0.1, None,
+                               rng.integers(0, 25, n)), type=pa.int64()),
+    })
+    return session.create_dataframe(t, num_partitions=2), t
+
+
+def test_device_array_passthrough_roundtrip(devarr):
+    df, t = devarr
+    dev = df.collect(device=True)
+    cpu = df.collect(device=False)
+    assert dev.column("a").to_pylist() == cpu.column("a").to_pylist() \
+        == t.column("a").to_pylist()
+    assert dev.column("f").to_pylist() == t.column("f").to_pylist()
+
+
+def test_device_array_scalar_ops(devarr):
+    df, t = devarr
+    from spark_rapids_tpu.expr.collections import (
+        ArrayContains, ArrayMax, ArrayMin, ElementAt, GetArrayItem, Size)
+    from spark_rapids_tpu.expr.functions import Column
+    q = df.select(
+        Column(Size(col("a").expr)).alias("sz"),
+        Column(GetArrayItem(col("a").expr, lit(1).expr)).alias("g1"),
+        Column(ElementAt(col("a").expr, lit(-1).expr)).alias("em1"),
+        Column(ElementAt(col("a").expr, lit(2).expr)).alias("e2"),
+        Column(ArrayContains(col("a").expr, lit(25).expr)).alias("ct"),
+        Column(ArrayMin(col("a").expr)).alias("mn"),
+        Column(ArrayMax(col("a").expr)).alias("mx"),
+        Column(ArrayMin(col("f").expr)).alias("fmn"),
+        Column(ArrayMax(col("f").expr)).alias("fmx"),
+    )
+    ex = q.explain("tpu")
+    assert "CpuProjectExec will run on TPU" in ex, ex
+    d = q.collect(device=True)
+    c = q.collect(device=False)
+    for name in d.column_names:
+        got, exp = d.column(name).to_pylist(), c.column(name).to_pylist()
+        for g, e in zip(got, exp):
+            same = (g == e) or (isinstance(g, float) and isinstance(e, float)
+                                and np.isnan(g) and np.isnan(e))
+            assert same, (name, g, e)
+
+
+def test_device_explode_posexplode_matrix(devarr):
+    df, t = devarr
+    for outer in (False, True):
+        for pos in (False, True):
+            q = df.explode("a", *(["p", "e"] if pos else ["e"]),
+                           outer=outer, pos=pos)
+            ex = q.explain("tpu")
+            assert "CpuGenerateExec will run on TPU" in ex, ex
+            d = q.collect(device=True)
+            c = q.collect(device=False)
+            assert d.num_rows == c.num_rows, (outer, pos)
+            for name in d.column_names:
+                assert d.column(name).to_pylist() == \
+                    c.column(name).to_pylist(), (outer, pos, name)
+
+
+def test_device_collect_list_set(devarr):
+    df, t = devarr
+    q = df.group_by("k").agg(F.collect_list(col("v")).alias("cl"),
+                             F.collect_set(col("v")).alias("cs"))
+    d = q.collect(device=True).to_pandas().sort_values("k") \
+        .reset_index(drop=True)
+    c = q.collect(device=False).to_pandas().sort_values("k") \
+        .reset_index(drop=True)
+    assert (d.k == c.k).all()
+    pdf = t.to_pandas().dropna(subset=["v"])
+    exp = pdf.groupby("k").v.apply(
+        lambda s: sorted(s.astype(int))).to_dict()
+    for i in range(len(d)):
+        # element ORDER is engine-specific (as in Spark); compare multisets
+        assert sorted(d.cl[i]) == sorted(c.cl[i]) == exp.get(d.k[i], [])
+        assert sorted(d.cs[i]) == sorted(c.cs[i]) == \
+            sorted(set(exp.get(d.k[i], [])))
+        assert len(d.cs[i]) == len(set(d.cs[i]))
+
+
+def test_device_collect_feeds_explode(devarr):
+    """collect_list output (device list layout) flows on into explode."""
+    df, t = devarr
+    q = df.group_by("k").agg(F.collect_list(col("v")).alias("cl")) \
+        .explode("cl", "e")
+    d = q.collect(device=True).to_pandas().sort_values(["k", "e"]) \
+        .reset_index(drop=True)
+    c = q.collect(device=False).to_pandas().sort_values(["k", "e"]) \
+        .reset_index(drop=True)
+    assert (d.k == c.k).all() and (d.e == c.e).all()
+
+
+def test_inner_null_arrays_fall_back_with_reason(session):
+    """containsNull=true arrays stay on host — the device list layout has
+    no element-validity plane; the fallback reason must say so."""
+    t = pa.table({"a": pa.array([[1, None, 3], [4]],
+                                type=pa.list_(pa.int64()))})
+    df = session.create_dataframe(t)
+    from spark_rapids_tpu.expr.collections import Size
+    from spark_rapids_tpu.expr.functions import Column
+    q = df.select(Column(Size(col("a").expr)).alias("sz"))
+    ex = q.explain("tpu")
+    assert "containsNull" in ex, ex
+    d = q.collect(device=True)
+    assert d.column("sz").to_pylist() == [3, 1]
+
+
+def test_supported_ops_shows_array_support():
+    from spark_rapids_tpu.tools.supported_ops import supported_ops_markdown
+    md = supported_ops_markdown()
+    for op in ("Size", "GetArrayItem", "ElementAt", "ArrayContains",
+               "ArrayMin", "ArrayMax"):
+        row = next((l for l in md.splitlines()
+                    if l.startswith(f"| {op} ")), None)
+        assert row is not None, op
+        assert "PS" in row or " S " in row, row

@@ -225,3 +225,66 @@ def test_tpch_query_under_memory_pressure(small_catalog):
                                exp[c].values.astype(float)), c
         else:
             assert (got[c].values == exp[c].values).all(), c
+
+
+# ---------------------------------------------------------------------------
+# Runtime OOM -> spill -> retry (reference: DeviceMemoryEventHandler.scala:33)
+# ---------------------------------------------------------------------------
+def _spillable_tables(cat, n=4, rows=512):
+    rng = np.random.default_rng(0)
+    handles = []
+    for i in range(n):
+        ht = HostTable(["a"], [HostColumn(dt.DOUBLE, rng.normal(size=rows))])
+        handles.append(cat.register(DeviceTable.from_host(ht, 64)))
+    return handles
+
+
+def test_runtime_oom_spills_and_retries():
+    """A RESOURCE_EXHAUSTED from the runtime triggers one synchronous
+    spill + retry at the jit chokepoint — the query completes."""
+    from spark_rapids_tpu.memory.catalog import BufferCatalog, set_catalog
+    from spark_rapids_tpu.utils.compile_cache import oom_retry
+    cat = BufferCatalog(device_limit=10**9, host_limit=10**9)
+    set_catalog(cat)
+    try:
+        handles = _spillable_tables(cat)
+        calls = {"n": 0}
+
+        def flaky(x):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError(
+                    "RESOURCE_EXHAUSTED: Out of memory while trying to "
+                    "allocate 123456 bytes.")
+            return x + 1
+
+        out = oom_retry(flaky)(41)
+        assert out == 42 and calls["n"] == 2
+        assert cat.oom_events == 1
+        assert sum(cat.spill_count.values()) > 0, cat.spill_count
+        # spilled buffers restore transparently on next access
+        assert handles[0].get().num_rows == 512
+    finally:
+        set_catalog(None)
+
+
+def test_runtime_oom_second_failure_dumps_diagnostics():
+    from spark_rapids_tpu.memory.catalog import BufferCatalog, set_catalog
+    from spark_rapids_tpu.utils.compile_cache import oom_retry
+    cat = BufferCatalog(device_limit=10**9, host_limit=10**9)
+    set_catalog(cat)
+    try:
+        _spillable_tables(cat, n=2)
+
+        def always_oom(_):
+            raise RuntimeError("RESOURCE_EXHAUSTED: Out of memory")
+
+        with pytest.raises(RuntimeError, match="catalog state"):
+            oom_retry(always_oom)(0)
+        # non-OOM errors pass through untouched
+        def boom(_):
+            raise ValueError("unrelated")
+        with pytest.raises(ValueError, match="unrelated"):
+            oom_retry(boom)(0)
+    finally:
+        set_catalog(None)

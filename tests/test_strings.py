@@ -256,3 +256,97 @@ def test_regex_span_fallbacks_gate(session):
         text = q.explain("tpu")
         assert "cannot run on TPU" in text, text
         assert_tpu_cpu_equal(q, ignore_order=False)  # falls back correctly
+
+
+def test_concat_ws_substring_index_chr_on_device(session):
+    """Round-2 gap: ConcatWs/SubstringIndex/Chr ran host-only; now their
+    device kernels must be SELECTED (not just correct via fallback)."""
+    from spark_rapids_tpu.expr.functions import char
+    t = pa.table({"s": pa.array(["a,b,c", "", "x", "no-delim", None,
+                                 "a,,b", ",lead", "trail,"] * 4),
+                  "u": pa.array(["α,β", "日,本,語", "a日,b", "é"] * 8),
+                  "n": pa.array([65, 0, 200, 255, -1, 128, 1000, 10] * 4,
+                                type=pa.int64())})
+    df = session.create_dataframe(t)
+    q = df.select(
+        concat_ws("|", col("s"), col("u")).alias("cw"),
+        substring_index(col("s"), ",", 2).alias("si2"),
+        substring_index(col("s"), ",", -1).alias("sim1"),
+        char(col("n")).alias("ch"),
+    )
+    ex = df.select(concat_ws("|", col("s"), col("u")).alias("cw")) \
+        .explain("tpu")
+    assert "CpuProjectExec will run on TPU" in ex, ex
+    assert "ConcatWs" not in ex, ex  # no fallback reason names it
+    got = assert_tpu_cpu_equal(q)
+    # independent python check
+    pdf = t.to_pandas()
+    for i, (s, u, n) in enumerate(zip(pdf.s, pdf.u, pdf.n)):
+        parts = [p for p in (s, u) if isinstance(p, str)]
+        assert got.column("cw")[i].as_py() == "|".join(parts)
+        if isinstance(s, str):
+            assert got.column("si2")[i].as_py() == \
+                ",".join(s.split(",")[:2])
+            assert got.column("sim1")[i].as_py() == s.split(",")[-1]
+        assert got.column("ch")[i].as_py() == \
+            (chr(int(n) & 0xFF) if n >= 0 else "")
+
+
+def test_substring_index_multibyte_delim_overlap(session):
+    """Multi-byte delimiters must match non-overlapping left-to-right
+    (the lax.scan path): 'aaaa' split by 'aa' has exactly 2 occurrences."""
+    t = pa.table({"s": pa.array(["aaaa", "aaa", "abababa", "xaax", "aa",
+                                 "", "ab日ab日ab"] * 4)})
+    df = session.create_dataframe(t)
+    for cnt in (1, 2, -1, -2, 3, 0):
+        q = df.select(substring_index(col("s"), "aa", cnt).alias("a"),
+                      substring_index(col("s"), "ab", cnt).alias("b"),
+                      substring_index(col("s"), "ab日", cnt).alias("c"))
+        got = assert_tpu_cpu_equal(q)
+        pdf = t.to_pandas()
+        for i, s in enumerate(pdf.s):
+            for cname, d in (("a", "aa"), ("b", "ab"), ("c", "ab日")):
+                if cnt == 0:
+                    exp = ""
+                elif cnt > 0:
+                    exp = d.join(s.split(d)[:cnt])
+                else:
+                    exp = d.join(s.split(d)[cnt:])
+                assert got.column(cname)[i].as_py() == exp, \
+                    (s, d, cnt, got.column(cname)[i].as_py(), exp)
+
+
+def test_concat_ws_all_null_and_empty(session):
+    t = pa.table({"a": pa.array([None, None, "x"], type=pa.string()),
+                  "b": pa.array([None, "y", None], type=pa.string())})
+    df = session.create_dataframe(t)
+    got = assert_tpu_cpu_equal(
+        df.select(concat_ws("-", col("a"), col("b")).alias("c")))
+    assert got.column("c").to_pylist() == ["", "y", "x"]
+
+
+def test_regexp_extract_capture_groups_on_device(session):
+    """Round-2 gap #4: capture groups (idx>0) extract on device for the
+    deterministic linearizable subset (reference: RegexParser.scala:414
+    transpiles capture groups; cuDF extracts natively)."""
+    import re as _re
+    strs = ["ab 12-345 x", "7-8", "no match", "-", "99-", "1-2-3",
+            "mail bob@site.com x", "v12.34 v999.1", "key:123", ""] * 3
+    t = pa.table({"s": pa.array(strs)})
+    df = session.create_dataframe(t)
+    cases = [(r"(\d+)-(\d+)", 1), (r"(\d+)-(\d+)", 2),
+             (r"([a-z]+)@([a-z]+)\.com", 2), (r"v(\d{1,3})\.(\d+)", 1)]
+    for pat, gi in cases:
+        q = df.select(regexp_extract(col("s"), pat, gi).alias("g"))
+        ex = q.explain("tpu")
+        assert "RegExpExtract" not in ex, (pat, gi, ex)  # no fallback
+        got = assert_tpu_cpu_equal(q)
+        for i, s in enumerate(strs):
+            m = _re.search(pat, s)
+            exp = m.group(gi) if m and m.group(gi) is not None else ""
+            assert got.column("g")[i].as_py() == exp, (pat, gi, s)
+    # outside the subset -> falls back (still correct)
+    q = df.select(regexp_extract(col("s"), r"(\d+)(\d*)", 1).alias("g"))
+    ex = q.explain("tpu")
+    assert "RegExpExtract" in ex and "capture-group subset" in ex, ex
+    assert_tpu_cpu_equal(q)
