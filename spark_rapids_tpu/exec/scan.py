@@ -66,7 +66,7 @@ class TpuParquetScanExec(TpuExec):
             with self.metrics.timed(M.OP_TIME):
                 table, n_dev = decode_row_group(
                     raw, pf.metadata, rg, pf.schema_arrow, cols,
-                    self.min_bucket)
+                    self.min_bucket, conf=self.source.conf)
             self.metrics.add(M.NUM_OUTPUT_BATCHES, 1)
             self.metrics.add(M.NUM_OUTPUT_ROWS, int(table.num_rows))
             self.metrics.add("deviceDecodedColumns", n_dev)

@@ -44,6 +44,18 @@ PARQUET_DEVICE_DECODE = register_conf(
     "decode). Unsupported columns fall back to host decode per column.",
     True)
 
+# per-type device-decode gates (reference: the per-type read enables of
+# RapidsConf.scala:877-917 — risky parses get their own kill switch)
+PARQUET_DEVICE_DECODE_STRINGS = register_conf(
+    "spark.rapids.tpu.parquet.deviceDecode.strings.enabled",
+    "Decode BYTE_ARRAY (string/binary) parquet columns on device; false "
+    "keeps strings on the per-column host decode.", True)
+
+PARQUET_DEVICE_DECODE_BOOLEANS = register_conf(
+    "spark.rapids.tpu.parquet.deviceDecode.booleans.enabled",
+    "Decode BOOLEAN parquet columns on device; false keeps booleans on "
+    "the per-column host decode.", True)
+
 _PHYS_OK = {"BOOLEAN", "INT32", "INT64", "FLOAT", "DOUBLE", "BYTE_ARRAY"}
 _ENC_OK = {"PLAIN", "RLE", "RLE_DICTIONARY", "PLAIN_DICTIONARY",
            "BIT_PACKED"}
@@ -53,11 +65,18 @@ class UnsupportedChunk(Exception):
     """Column chunk outside the device decoder's subset."""
 
 
-def chunk_supported(col_meta, arrow_field) -> bool:
+def chunk_supported(col_meta, arrow_field, conf=None) -> bool:
     """Static (metadata-only) eligibility of one column chunk."""
     import pyarrow as pa
     if col_meta.physical_type not in _PHYS_OK:
         return False
+    if conf is not None:
+        if col_meta.physical_type == "BYTE_ARRAY" \
+                and not conf.get(PARQUET_DEVICE_DECODE_STRINGS):
+            return False
+        if col_meta.physical_type == "BOOLEAN" \
+                and not conf.get(PARQUET_DEVICE_DECODE_BOOLEANS):
+            return False
     if any(e not in _ENC_OK for e in col_meta.encodings):
         return False
     t = arrow_field.type
@@ -551,7 +570,7 @@ def _decode_column_device(ch: _Chunk, out_dtype: dt.DataType, cap: int):
 
 
 def decode_row_group(raw: bytes, pf_metadata, rg: int, arrow_schema,
-                     columns: List[str], min_bucket: int):
+                     columns: List[str], min_bucket: int, conf=None):
     """Decode one row group into a DeviceTable; per-column fallback to
     pyarrow host decode + upload for unsupported chunks. Returns
     (DeviceTable, n_device_decoded_columns)."""
@@ -568,7 +587,7 @@ def decode_row_group(raw: bytes, pf_metadata, rg: int, arrow_schema,
         ci = name_to_ci.get(name)
         field = arrow_schema.field(name)
         col_meta = rg_meta.column(ci) if ci is not None else None
-        if col_meta is None or not chunk_supported(col_meta, field):
+        if col_meta is None or not chunk_supported(col_meta, field, conf):
             fallback.append(name)
             continue
         try:

@@ -74,6 +74,27 @@ def _write_table(df, path: str, fmt: str,
     os.makedirs(path, exist_ok=True)
     stats = WriteStats()
     job_id = uuid.uuid4().hex[:8]
+    if fmt == "parquet" and not partition_by and not kw:
+        from .parquet_encode import (PARQUET_DEVICE_WRITE, schema_supported,
+                                     write_device_parquet)
+        conf = df.session.conf
+        if conf.get(PARQUET_DEVICE_WRITE) and conf.is_sql_enabled \
+                and schema_supported(df.logical.schema):
+            # device encode path (reference: GpuParquetFileFormat.scala:351
+            # — device packs column chunks, host assembles framing)
+            plan = df.session._physical(df.logical, device=True)
+            for pidx in range(plan.num_partitions):
+                batches = [b for b in df._batches_from_plan(plan, pidx)
+                           if int(b.num_rows)]
+                if not batches:
+                    continue
+                fpath = os.path.join(path,
+                                     f"part-{pidx:05d}-{job_id}.parquet")
+                rows = write_device_parquet(batches, fpath,
+                                            df.logical.schema)
+                stats.record(fpath, rows)
+            open(os.path.join(path, "_SUCCESS"), "w").close()
+            return stats
     plan = df.session._physical(df.logical)
     for pidx in range(plan.num_partitions):
         batches = list(plan.execute(pidx))

@@ -28,6 +28,17 @@ DEVICE_POOL_BYTES = register_conf(
     "sizing, GpuDeviceManager.scala:176-222). 0 = derive from device.",
     0)
 
+DEVICE_POOL_MODE = register_conf(
+    "spark.rapids.tpu.memory.pool.mode",
+    "Buffer-pool accounting mode (reference: the RMM DEFAULT/POOL/ARENA/"
+    "ASYNC selection, GpuDeviceManager.scala:224): 'logical' enforces the "
+    "budget by spilling lowest-priority buffers; 'none' disables budget "
+    "accounting (XLA's own allocator arbitrates, like RMM DEFAULT); "
+    "'strict' raises when a registration cannot fit even after spilling "
+    "(surface OOM early instead of overcommitting).", "logical",
+    checker=lambda v: None if v in ("logical", "none", "strict")
+    else f"must be one of logical/none/strict, got {v!r}")
+
 OOM_SPILL_ENABLED = register_conf(
     "spark.rapids.memory.gpu.oomSpill.enabled",
     "Spill lowest-priority buffers when the device budget is exceeded "
@@ -101,6 +112,7 @@ class BufferCatalog:
         self._ids = itertools.count()
         self._lock = threading.RLock()
         self._oom_spill = conf.get(OOM_SPILL_ENABLED)
+        self._pool_mode = conf.get(DEVICE_POOL_MODE)
         self.oom_events = 0  # runtime RESOURCE_EXHAUSTED recoveries
         self.spill_count = {StorageTier.HOST: 0, StorageTier.DISK: 0}
         self.spilled_bytes = {StorageTier.HOST: 0, StorageTier.DISK: 0}
@@ -114,9 +126,15 @@ class BufferCatalog:
                  ) -> "SpillableDeviceTable":
         nbytes = table.nbytes()
         with self._lock:
-            if not self.device.fits(nbytes) and self._oom_spill:
+            if self._pool_mode != "none" and not self.device.fits(nbytes) \
+                    and self._oom_spill:
                 self.synchronous_spill(
                     nbytes - (self.device.limit_bytes - self.device.used_bytes))
+            if self._pool_mode == "strict" and not self.device.fits(nbytes):
+                raise MemoryError(
+                    f"strict pool mode: {nbytes} bytes cannot fit "
+                    f"(used={self.device.used_bytes}, "
+                    f"limit={self.device.limit_bytes})")
             bid = next(self._ids)
             stored = StoredTable(bid, table, priority, nbytes)
             self._buffers[bid] = stored

@@ -7,36 +7,77 @@ level; the RDMA/UCX specialization in the reference maps to ICI collectives
 (shuffle/ici.py) on TPU, so the socket tier only needs to be correct and
 portable, not zero-copy.
 
-Design: each executor process owns one ``TcpShuffleTransport``. ``publish``
-stores blocks locally; a server thread answers block requests; ``fetch``
-serves local blocks directly and asks registered peers for the rest. A block
-nobody can produce raises ShuffleFetchFailedException — never silently
-skipped.
+Round-3 rework (round-2 weak #4): blocks no longer live as whole ``bytes``
+in a dict served in one send —
+
+- published blocks go into a **spill-backed host store**: an in-memory
+  budget (``spark.rapids.tpu.shuffle.host.storeBytes``), overflow spills
+  oldest-first to local disk files and is served straight from disk
+  (the spillable serving behind BufferSendState.scala).
+- the server streams **fixed-size windows** of a block (ranged GET),
+  never materializing more than a chunk per connection
+  (``spark.rapids.tpu.shuffle.tcp.chunkBytes`` ~ WindowedBlockIterator).
+- the client fetches blocks through a small worker pool under a
+  **receive-inflight byte cap**
+  (``spark.rapids.shuffle.transport.maxReceiveInflightBytes`` — the
+  reference's throttle, RapidsConf.scala:1064): a block reserves its
+  size before its chunks stream in, and the reservation releases when
+  the consumer takes the block.
 
 Wire protocol (little-endian), one request per connection:
 
     request:  magic 'SRTB' | u8 op | i64 shuffle | i64 map | i64 reduce
-    response: u8 found | u64 len | payload
-    ops: 1 = GET, 2 = REMOVE_SHUFFLE (shuffle id only; map/reduce ignored)
+              (op GET_RANGE only) | i64 offset | i64 max_len
+    response: u8 found | u64 total_len | (GET_RANGE only) u64 chunk_len |
+              payload
+    ops: 1 = GET (whole block), 2 = REMOVE_SHUFFLE, 3 = GET_RANGE
 """
 from __future__ import annotations
 
+import os
 import socket
 import struct
+import tempfile
 import threading
+from collections import OrderedDict
+from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, Iterator, List, Optional, Tuple
 
-from ..conf import RapidsConf
+from ..conf import RapidsConf, register_conf
 from .transport import (BlockId, ShuffleFetchFailedException,
                         ShuffleTransport)
 
 __all__ = ["TcpShuffleTransport"]
 
+TCP_CHUNK_BYTES = register_conf(
+    "spark.rapids.tpu.shuffle.tcp.chunkBytes",
+    "Window size for serving shuffle blocks over the TCP transport: a "
+    "block streams in fixed-size chunks instead of one send (reference: "
+    "BufferSendState bounce-buffer windows, RapidsShuffleServer.scala:70).",
+    1 << 20, checker=lambda v: None if int(v) > 0 else "must be positive")
+
+MAX_RECEIVE_INFLIGHT = register_conf(
+    "spark.rapids.shuffle.transport.maxReceiveInflightBytes",
+    "Receive-side throttle: total bytes of shuffle blocks in flight "
+    "(being fetched or fetched-but-unconsumed) at one time (reference: "
+    "RapidsConf.scala:1064).", 64 << 20,
+    checker=lambda v: None if int(v) > 0 else "must be positive")
+
+HOST_STORE_BYTES = register_conf(
+    "spark.rapids.tpu.shuffle.host.storeBytes",
+    "In-memory budget for published shuffle blocks on the TCP transport; "
+    "overflow spills oldest-first to local disk and is served from there "
+    "(reference: spillable shuffle buffers backing BufferSendState).",
+    256 << 20, checker=lambda v: None if int(v) > 0 else "must be positive")
+
 _MAGIC = b"SRTB"
 _OP_GET = 1
 _OP_REMOVE = 2
+_OP_GET_RANGE = 3
 _REQ = struct.Struct("<4sBqqq")
+_RANGE_EXT = struct.Struct("<qq")
 _RESP_HEAD = struct.Struct("<BQ")
+_RESP_CHUNK = struct.Struct("<Q")
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -49,10 +90,173 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return buf
 
 
+class _HostBlockStore:
+    """Budgeted in-memory block store with oldest-first disk spill."""
+
+    def __init__(self, budget_bytes: int):
+        self._budget = budget_bytes
+        self._mem: "OrderedDict[BlockId, bytes]" = OrderedDict()
+        self._disk: Dict[BlockId, Tuple[str, int]] = {}   # path, length
+        self._spilling: set = set()   # victims mid-write, still in _mem
+        self._lock = threading.Lock()
+        self._dir: Optional[str] = None
+        self.mem_bytes = 0
+        self.spilled_blocks = 0
+        self.spilled_bytes = 0
+
+    def _spill_dir(self) -> str:
+        if self._dir is None:
+            self._dir = tempfile.mkdtemp(prefix="srtpu-shuffle-blocks-")
+        return self._dir
+
+    def put(self, block: BlockId, payload: bytes) -> None:
+        with self._lock:
+            old = self._mem.pop(block, None)
+            if old is not None:
+                self.mem_bytes -= len(old)
+            disk_old = self._disk.pop(block, None)
+            self._mem[block] = payload
+            self.mem_bytes += len(payload)
+            # choose spill victims but KEEP them readable in _mem until
+            # their disk entry exists — a concurrent read during the file
+            # write must never see the block in neither map
+            victims = []
+            excess = self.mem_bytes - self._budget
+            for b in list(self._mem.keys()):            # oldest first
+                if excess <= 0 or \
+                        len(self._mem) - len(self._spilling) <= 1:
+                    break
+                if b in self._spilling or b == block:
+                    continue
+                self._spilling.add(b)
+                victims.append((b, self._mem[b]))
+                excess -= len(self._mem[b])
+        if disk_old is not None:
+            _unlink_quietly(disk_old[0])
+        for victim, data in victims:
+            path = os.path.join(
+                self._spill_dir(),
+                f"b{victim[0]}_{victim[1]}_{victim[2]}.blk")
+            with open(path, "wb") as f:
+                f.write(data)
+            with self._lock:
+                self._spilling.discard(victim)
+                if self._mem.get(victim) is data:   # not replaced/removed
+                    self._disk[victim] = (path, len(data))
+                    del self._mem[victim]
+                    self.mem_bytes -= len(data)
+                    self.spilled_blocks += 1
+                    self.spilled_bytes += len(data)
+                    continue
+            _unlink_quietly(path)
+
+    def length(self, block: BlockId) -> Optional[int]:
+        with self._lock:
+            data = self._mem.get(block)
+            if data is not None:
+                return len(data)
+            entry = self._disk.get(block)
+            return None if entry is None else entry[1]
+
+    def read(self, block: BlockId, offset: int, n: int) -> Optional[bytes]:
+        with self._lock:
+            data = self._mem.get(block)
+            entry = self._disk.get(block) if data is None else None
+        if data is not None:
+            return data[offset:offset + n]
+        if entry is None:
+            return None
+        path, _ = entry
+        try:
+            with open(path, "rb") as f:
+                f.seek(offset)
+                return f.read(n)
+        except OSError:
+            return None
+
+    def remove_shuffle(self, shuffle_id: int) -> None:
+        with self._lock:
+            for b in [b for b in self._mem if b[0] == shuffle_id]:
+                self.mem_bytes -= len(self._mem.pop(b))
+            doomed = [self._disk.pop(b)[0]
+                      for b in [b for b in self._disk if b[0] == shuffle_id]]
+        for path in doomed:
+            _unlink_quietly(path)
+
+    def close(self) -> None:
+        with self._lock:
+            paths = [p for (p, _) in self._disk.values()]
+            self._disk.clear()
+            self._mem.clear()
+            self.mem_bytes = 0
+            spill_dir, self._dir = self._dir, None
+        for p in paths:
+            _unlink_quietly(p)
+        if spill_dir is not None:
+            try:
+                os.rmdir(spill_dir)
+            except OSError:
+                pass
+
+
+def _unlink_quietly(path: str) -> None:
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
+
+
+class _Turnstile:
+    """Orders inflight-budget acquisitions by ticket: ticket k proceeds
+    only after tickets < k have acquired (or bailed). Idempotent advance."""
+
+    def __init__(self):
+        self._next = 0
+        self._cv = threading.Condition()
+
+    def wait_turn(self, ticket: int) -> None:
+        with self._cv:
+            while self._next < ticket:
+                self._cv.wait()
+
+    def advance(self, ticket: int) -> None:
+        with self._cv:
+            if ticket + 1 > self._next:
+                self._next = ticket + 1
+                self._cv.notify_all()
+
+
+class _InflightBudget:
+    """Counting byte semaphore for the receive throttle."""
+
+    def __init__(self, limit: int):
+        self._limit = limit
+        self._used = 0
+        self._cv = threading.Condition()
+        self.peak = 0
+
+    def acquire(self, n: int) -> None:
+        n = min(n, self._limit)  # one oversized block must not deadlock
+        with self._cv:
+            while self._used + n > self._limit:
+                self._cv.wait()
+            self._used += n
+            self.peak = max(self.peak, self._used)
+
+    def release(self, n: int) -> None:
+        n = min(n, self._limit)
+        with self._cv:
+            self._used -= n
+            self._cv.notify_all()
+
+
 class TcpShuffleTransport(ShuffleTransport):
     def __init__(self, conf: Optional[RapidsConf] = None,
                  host: str = "127.0.0.1", port: int = 0):
-        self._blocks: Dict[BlockId, bytes] = {}
+        conf = conf or RapidsConf()
+        self.chunk_bytes = int(conf.get(TCP_CHUNK_BYTES))
+        self.store = _HostBlockStore(int(conf.get(HOST_STORE_BYTES)))
+        self.inflight = _InflightBudget(int(conf.get(MAX_RECEIVE_INFLIGHT)))
         self._lock = threading.Lock()
         self._peers: List[Tuple[str, int]] = []
         self.bytes_published = 0
@@ -92,13 +296,35 @@ class TcpShuffleTransport(ShuffleTransport):
                     self.remove_shuffle(sid)
                     conn.sendall(_RESP_HEAD.pack(1, 0))
                     return
-                with self._lock:
-                    payload = self._blocks.get(BlockId(sid, mid, rid))
-                if payload is None:
-                    conn.sendall(_RESP_HEAD.pack(0, 0))
-                else:
-                    conn.sendall(_RESP_HEAD.pack(1, len(payload)))
+                block = BlockId(sid, mid, rid)
+                if op == _OP_GET_RANGE:
+                    off, max_len = _RANGE_EXT.unpack(
+                        _recv_exact(conn, _RANGE_EXT.size))
+                    total = self.store.length(block)
+                    if total is None:
+                        conn.sendall(_RESP_HEAD.pack(0, 0))
+                        return
+                    n = max(0, min(max_len, self.chunk_bytes, total - off))
+                    payload = self.store.read(block, off, n) or b""
+                    conn.sendall(_RESP_HEAD.pack(1, total)
+                                 + _RESP_CHUNK.pack(len(payload)))
                     conn.sendall(payload)
+                    return
+                # whole-block GET (compat): stream it in windows anyway so
+                # the server never materializes more than a chunk per send
+                total = self.store.length(block)
+                if total is None:
+                    conn.sendall(_RESP_HEAD.pack(0, 0))
+                    return
+                conn.sendall(_RESP_HEAD.pack(1, total))
+                off = 0
+                while off < total:
+                    n = min(self.chunk_bytes, total - off)
+                    piece = self.store.read(block, off, n)
+                    if not piece:
+                        return  # store lost the block mid-stream
+                    conn.sendall(piece)
+                    off += len(piece)
         except Exception:
             pass  # a broken client connection must not kill the server
 
@@ -106,44 +332,125 @@ class TcpShuffleTransport(ShuffleTransport):
     def add_peer(self, host: str, port: int):
         self._peers.append((host, port))
 
-    def _ask_peer(self, addr: Tuple[str, int], block: BlockId,
-                  timeout: float = 5.0) -> Optional[bytes]:
+    def _range_from_peer(self, addr: Tuple[str, int], block: BlockId,
+                         offset: int, timeout: float = 10.0
+                         ) -> Optional[Tuple[int, bytes]]:
+        """One ranged request -> (total_len, chunk) or None if absent."""
         try:
             with socket.create_connection(addr, timeout=timeout) as s:
-                s.sendall(_REQ.pack(_MAGIC, _OP_GET, *block))
-                found, length = _RESP_HEAD.unpack(
+                s.sendall(_REQ.pack(_MAGIC, _OP_GET_RANGE, *block)
+                          + _RANGE_EXT.pack(offset, self.chunk_bytes))
+                found, total = _RESP_HEAD.unpack(
                     _recv_exact(s, _RESP_HEAD.size))
                 if not found:
                     return None
-                return _recv_exact(s, length)
+                (clen,) = _RESP_CHUNK.unpack(_recv_exact(s, _RESP_CHUNK.size))
+                return int(total), _recv_exact(s, clen)
         except OSError:
             return None  # dead peer == block not found here
 
+    def _fetch_remote(self, block: BlockId, turnstile: "_Turnstile",
+                      ticket: int) -> Optional[Tuple[bytes, int]]:
+        """Assemble a block from a peer chunk by chunk.
+
+        The inflight reservation is acquired in STRICT consumer order via
+        the turnstile (ticket = position in the fetch list): ticket k's
+        acquire can only ever wait on releases of blocks < k, so the
+        budget can never deadlock head-of-line. Returns
+        (payload, reserved_bytes) — the caller owns the release."""
+        try:
+            for addr in self._peers:
+                first = self._range_from_peer(addr, block, 0)
+                if first is None:
+                    continue
+                total, chunk = first
+                turnstile.wait_turn(ticket)
+                self.inflight.acquire(total)
+                turnstile.advance(ticket)
+                try:
+                    parts = [chunk]
+                    got = len(chunk)
+                    while got < total:
+                        nxt = self._range_from_peer(addr, block, got)
+                        if nxt is None or not nxt[1]:
+                            break
+                        parts.append(nxt[1])
+                        got += len(nxt[1])
+                    if got != total:
+                        self.inflight.release(total)
+                        continue  # torn block; try the next peer
+                    return b"".join(parts), total
+                except BaseException:
+                    self.inflight.release(total)
+                    raise
+            return None
+        finally:
+            turnstile.advance(ticket)  # idempotent: never block later tickets
+
     # -- SPI ------------------------------------------------------------------
     def publish(self, block: BlockId, payload: bytes) -> None:
+        self.store.put(block, payload)
         with self._lock:
-            self._blocks[block] = payload
             self.bytes_published += len(payload)
 
     def fetch(self, blocks: List[BlockId]) -> Iterator[Tuple[BlockId, bytes]]:
+        """Local blocks served from the store; remote blocks prefetched by
+        a small pool under the receive-inflight cap, yielded in order."""
+        local: Dict[BlockId, bool] = {}
         for b in blocks:
-            with self._lock:
-                payload = self._blocks.get(b)
-            if payload is None:
-                for addr in self._peers:
-                    payload = self._ask_peer(addr, b)
-                    if payload is not None:
-                        break
-            if payload is None:
-                raise ShuffleFetchFailedException(
-                    b, f"not found locally or on {len(self._peers)} peers")
-            self.bytes_fetched += len(payload)
-            yield b, payload
+            local[b] = self.store.length(b) is not None
+        remote = [b for b in blocks if not local[b]]
+        pool = ThreadPoolExecutor(max_workers=4,
+                                  thread_name_prefix="srtpu-shuffle-fetch") \
+            if remote else None
+        turnstile = _Turnstile()
+        futures = {}
+        consumed: set = set()
+        try:
+            for ticket, b in enumerate(remote):
+                futures[b] = pool.submit(self._fetch_remote, b, turnstile,
+                                         ticket)
+            for b in blocks:
+                if local[b]:
+                    total = self.store.length(b)
+                    payload = self.store.read(b, 0, total) \
+                        if total is not None else None
+                    if payload is None or len(payload) != total:
+                        raise ShuffleFetchFailedException(
+                            b, "local block vanished from the store")
+                else:
+                    res = futures[b].result()
+                    consumed.add(b)
+                    if res is None:
+                        raise ShuffleFetchFailedException(
+                            b, f"not found locally or on "
+                               f"{len(self._peers)} peers")
+                    payload, reserved = res
+                    self.inflight.release(reserved)
+                with self._lock:
+                    self.bytes_fetched += len(payload)
+                yield b, payload
+        finally:
+            # abandoned/errored: reservations of unconsumed prefetches must
+            # not leak (they would poison every later fetch) — release as
+            # each outstanding future completes
+            for b, fut in futures.items():
+                if b in consumed:
+                    continue
+                fut.add_done_callback(self._release_unconsumed)
+            if pool is not None:
+                pool.shutdown(wait=False)
+
+    def _release_unconsumed(self, fut) -> None:
+        try:
+            res = fut.result()
+        except BaseException:
+            return  # worker already released on its error path
+        if res is not None:
+            self.inflight.release(res[1])
 
     def remove_shuffle(self, shuffle_id: int) -> None:
-        with self._lock:
-            for b in [b for b in self._blocks if b[0] == shuffle_id]:
-                del self._blocks[b]
+        self.store.remove_shuffle(shuffle_id)
 
     def close(self) -> None:
         self._closing = True
@@ -151,3 +458,4 @@ class TcpShuffleTransport(ShuffleTransport):
             self._server.close()
         except OSError:
             pass
+        self.store.close()

@@ -95,3 +95,63 @@ def test_write_mode_error_and_overwrite(session, table, tmp_path):
     from spark_rapids_tpu.io.writer import write_parquet
     stats = write_parquet(df, str(tmp_path / "m"), mode="overwrite")
     assert stats.num_rows == 300
+
+
+def test_device_parquet_write_roundtrip(session, tmp_path):
+    """Device write path (round-2 missing #7; reference:
+    GpuParquetFileFormat.scala:351): device packs dense column chunks,
+    host assembles PLAIN v1 pages + thrift framing; pyarrow reads the
+    file back bit-identical, incl. nulls/strings/dates/timestamps."""
+    import numpy as np
+    import pyarrow.parquet as pq
+    rng = np.random.default_rng(4)
+    n = 3000
+    mask = rng.random(n) < 0.2
+    t = pa.table({
+        "i": pa.array(rng.integers(-2**40, 2**40, n), type=pa.int64(),
+                      mask=mask),
+        "f": pa.array(rng.normal(size=n)),
+        "b": pa.array(rng.integers(0, 2, n).astype(bool)),
+        "s": pa.array([None if m else f"v{rng.integers(0, 10**6)}"
+                       for m in mask]),
+        "d": pa.array(rng.integers(0, 20000, n).astype(np.int32)).cast(
+            pa.date32()),
+        "ts": pa.array(rng.integers(0, 2**48, n), type=pa.int64()).cast(
+            pa.timestamp("us")),
+    })
+    df = session.create_dataframe(t, num_partitions=2)
+    out = str(tmp_path / "devwrite")
+    df.write_parquet(out)
+    back = pq.read_table(out).combine_chunks()
+    # written across partitions: compare as multisets keyed by row tuple
+    def rows(tab):
+        return sorted(zip(*[tab.column(c).to_pylist()
+                            for c in t.column_names]),
+                      key=lambda r: (str(r),))
+    assert rows(back) == rows(t)
+    import os
+    assert os.path.exists(os.path.join(out, "_SUCCESS"))
+    # the device writer ran (files carry its created_by marker)
+    one = [f for f in os.listdir(out) if f.endswith(".parquet")][0]
+    meta = pq.ParquetFile(os.path.join(out, one)).metadata
+    assert b"device writer" in meta.created_by.encode() or \
+        "device writer" in meta.created_by
+
+
+def test_device_write_falls_back_for_unsupported_schema(session, tmp_path):
+    """Decimal columns stay on the pyarrow writer (and stay correct)."""
+    import decimal
+    t = pa.table({"x": pa.array([decimal.Decimal("1.23"),
+                                 decimal.Decimal("4.56")],
+                                type=pa.decimal128(10, 2))})
+    df = session.create_dataframe(t)
+    out = str(tmp_path / "fallback")
+    from spark_rapids_tpu.io.writer import write_parquet
+    write_parquet(df, out)
+    import pyarrow.parquet as pq
+    back = pq.read_table(out)
+    assert back.column("x").to_pylist() == t.column("x").to_pylist()
+    import os
+    one = [f for f in os.listdir(out) if f.endswith(".parquet")][0]
+    meta = pq.ParquetFile(os.path.join(out, one)).metadata
+    assert "device writer" not in (meta.created_by or "")

@@ -116,3 +116,35 @@ def test_semaphore_reentrant():
     sem.release_if_held(task_id=7)
     sem.acquire_if_necessary(task_id=8)
     sem.release_if_held(task_id=8)
+
+
+def test_pool_mode_none_and_strict():
+    """Pool-mode selection (reference: RMM mode selection,
+    GpuDeviceManager.scala:224): 'none' never spills on budget, 'strict'
+    raises when a registration cannot fit after spilling."""
+    import numpy as np
+    from spark_rapids_tpu.columnar.host import HostColumn, HostTable
+    from spark_rapids_tpu.columnar import dtypes as dt
+    from spark_rapids_tpu.columnar.device import DeviceTable
+    from spark_rapids_tpu.conf import RapidsConf
+    from spark_rapids_tpu.memory.catalog import BufferCatalog
+
+    def tbl(rows=2048):
+        return DeviceTable.from_host(HostTable(
+            ["a"], [HostColumn(dt.DOUBLE,
+                               np.random.default_rng(0).normal(size=rows))]),
+            64)
+
+    none_cat = BufferCatalog(RapidsConf(
+        {"spark.rapids.tpu.memory.pool.mode": "none"}),
+        device_limit=1000, host_limit=10**6)
+    for _ in range(3):
+        none_cat.register(tbl())
+    assert sum(none_cat.spill_count.values()) == 0  # over budget, no spill
+
+    strict_cat = BufferCatalog(RapidsConf(
+        {"spark.rapids.tpu.memory.pool.mode": "strict"}),
+        device_limit=1000, host_limit=10**6)
+    import pytest as _pytest
+    with _pytest.raises(MemoryError, match="strict pool mode"):
+        strict_cat.register(tbl())

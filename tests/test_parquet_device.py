@@ -254,3 +254,31 @@ def test_tpch_lineitem_orders_full_device_decode(sess, tmp_path):
         for c in names:
             assert got.column(c).to_pylist() == host.column(c).to_pylist(), \
                 f"{tname}.{c} diverged"
+
+
+def test_per_type_device_decode_gates(sess, tmp_path):
+    """Per-type kill switches (reference: per-type read enables,
+    RapidsConf.scala:877-917): strings/booleans can be forced back to the
+    host column decode independently."""
+    from spark_rapids_tpu.conf import RapidsConf
+    import io as _io
+    from spark_rapids_tpu.io.parquet_device import decode_row_group
+    t = pa.table({"s": pa.array(["a", "bb", "ccc"] * 10),
+                  "b": pa.array([True, False, True] * 10),
+                  "i": pa.array(np.arange(30, dtype=np.int64))})
+    buf = _io.BytesIO()
+    pq.write_table(t, buf, compression="none")
+    raw = buf.getvalue()
+    pf = pq.ParquetFile(_io.BytesIO(raw))
+    base = RapidsConf()
+    dt_, nd = decode_row_group(raw, pf.metadata, 0, pf.schema_arrow,
+                               ["s", "b", "i"], 8, conf=base)
+    assert nd == 3
+    off = RapidsConf({
+        "spark.rapids.tpu.parquet.deviceDecode.strings.enabled": False,
+        "spark.rapids.tpu.parquet.deviceDecode.booleans.enabled": False})
+    dt2, nd2 = decode_row_group(raw, pf.metadata, 0, pf.schema_arrow,
+                                ["s", "b", "i"], 8, conf=off)
+    assert nd2 == 1  # only the int column stayed on device
+    assert dt2.to_host().to_arrow().column("s").to_pylist() == \
+        t.column("s").to_pylist()

@@ -167,3 +167,79 @@ def test_cross_process_broadcast_single_build():
             exp = [k for k in probes[w].column("k").values.tolist()
                    if k in build_keys]
             assert got == exp
+
+
+def test_tcp_chunked_spill_backed_serving():
+    """Large blocks under a small host budget: publishes spill to disk and
+    are served back in fixed windows; the receive-inflight cap bounds
+    fetched-but-unconsumed bytes (round-2 weak #4; reference:
+    RapidsShuffleServer.scala:70 BufferSendState windows + the
+    maxReceiveInflightBytes throttle, RapidsConf.scala:1064)."""
+    from spark_rapids_tpu.conf import RapidsConf
+    from spark_rapids_tpu.shuffle.tcp import TcpShuffleTransport
+    conf = RapidsConf({
+        "spark.rapids.tpu.shuffle.tcp.chunkBytes": 64 * 1024,
+        "spark.rapids.tpu.shuffle.host.storeBytes": 300 * 1024,
+        "spark.rapids.shuffle.transport.maxReceiveInflightBytes": 700 * 1024,
+    })
+    a = TcpShuffleTransport(conf)
+    b = TcpShuffleTransport(conf)
+    try:
+        b.add_peer(*a.address)
+        rng = np.random.default_rng(0)
+        payloads = {m: rng.integers(0, 256, 256 * 1024, dtype=np.uint8)
+                    .tobytes() for m in range(6)}  # 1.5MB >> 300KB budget
+        for m, p in payloads.items():
+            a.publish(BlockId(5, m, 0), p)
+        # the store kept at most its budget in memory; the rest hit disk
+        assert a.store.spilled_blocks >= 4, a.store.spilled_blocks
+        assert a.store.mem_bytes <= 300 * 1024 + 256 * 1024
+        got = dict(b.fetch([BlockId(5, m, 0) for m in range(6)]))
+        for m, p in payloads.items():
+            assert got[BlockId(5, m, 0)] == p, f"block {m} corrupted"
+        # throttle: in-flight reservations never exceeded the cap
+        assert 0 < b.inflight.peak <= 700 * 1024, b.inflight.peak
+        # spilled blocks serve correctly after removal of another shuffle
+        a.publish(BlockId(6, 0, 0), b"tiny")
+        a.remove_shuffle(5)
+        with pytest.raises(ShuffleFetchFailedException):
+            list(b.fetch([BlockId(5, 0, 0)]))
+        assert dict(b.fetch([BlockId(6, 0, 0)]))[BlockId(6, 0, 0)] == b"tiny"
+    finally:
+        a.close()
+        b.close()
+
+
+def test_tcp_fetch_failed_releases_inflight_budget():
+    """A fetch-failed mid-list must not leak inflight reservations for
+    already-prefetched blocks (a leak would deadlock the retry fetch)."""
+    from spark_rapids_tpu.conf import RapidsConf
+    from spark_rapids_tpu.shuffle.tcp import TcpShuffleTransport
+    conf = RapidsConf({
+        "spark.rapids.tpu.shuffle.tcp.chunkBytes": 8 * 1024,
+        "spark.rapids.shuffle.transport.maxReceiveInflightBytes": 64 * 1024,
+    })
+    a = TcpShuffleTransport(conf)
+    b = TcpShuffleTransport(conf)
+    try:
+        b.add_peer(*a.address)
+        a.publish(BlockId(3, 1, 0), b"x" * 30000)
+        with pytest.raises(ShuffleFetchFailedException):
+            # missing block first; block 1's prefetch completes and holds
+            # a reservation that MUST be released on abandonment
+            list(b.fetch([BlockId(3, 0, 0), BlockId(3, 1, 0)]))
+        import time
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            with b.inflight._cv:
+                if b.inflight._used == 0:
+                    break
+            time.sleep(0.05)
+        with b.inflight._cv:
+            assert b.inflight._used == 0, b.inflight._used
+        # the retry fetch works (no poisoned budget)
+        got = dict(b.fetch([BlockId(3, 1, 0)]))
+        assert got[BlockId(3, 1, 0)] == b"x" * 30000
+    finally:
+        a.close()
+        b.close()

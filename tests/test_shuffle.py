@@ -171,3 +171,49 @@ def test_ici_exchange_cpu_mesh():
     for i in np.nonzero(mask)[0]:
         key_shards.setdefault(int(flat_k[i]), set()).add(int(shard_of[i]))
     assert all(len(s) == 1 for s in key_shards.values())
+
+
+def test_dcn_mock_transport_device_to_device():
+    """Cross-host accelerated tier, mocked (round-2 missing #6; reference:
+    UCX.scala:69 device-to-device block movement; protocol testing via
+    mocks as in RapidsShuffleTestHelper): blocks stay device-resident,
+    fetch lands them on the consumer's device, per-link bytes are
+    accounted, and a missing block raises fetch-failed."""
+    import jax
+    import numpy as np
+    from spark_rapids_tpu.columnar import dtypes as dt
+    from spark_rapids_tpu.columnar.device import DeviceTable
+    from spark_rapids_tpu.columnar.host import HostColumn, HostTable
+    from spark_rapids_tpu.shuffle.dcn import DcnShuffleTransport, \
+        MockDcnFabric
+    from spark_rapids_tpu.shuffle.transport import BlockId, \
+        ShuffleFetchFailedException
+    devs = jax.devices()
+    if len(devs) < 2:
+        pytest.skip("needs >=2 virtual devices")
+    fabric = MockDcnFabric()
+    a = DcnShuffleTransport(fabric, "host-a", device=devs[0])
+    b = DcnShuffleTransport(fabric, "host-b", device=devs[1])
+    rng = np.random.default_rng(0)
+    t = DeviceTable.from_host(HostTable(
+        ["k", "v"], [HostColumn(dt.LONG, rng.integers(0, 9, 64)),
+                     HostColumn(dt.DOUBLE, rng.normal(size=64))]), 8)
+    t = jax.device_put(t, devs[0])
+    a.publish_table(BlockId(1, 0, 0), t)
+    got = dict(b.fetch_tables([BlockId(1, 0, 0)]))[BlockId(1, 0, 0)]
+    # landed on the CONSUMER's device, no host serialization in between
+    assert devs[1] in got.row_mask.devices()
+    assert got.to_host().column("v").values.tolist() == \
+        t.to_host().column("v").values.tolist()
+    assert fabric.link_bytes[("host-a", "host-b")] > 0
+    with pytest.raises(ShuffleFetchFailedException):
+        list(b.fetch_tables([BlockId(1, 9, 9)]))
+    # failure injection hook (transport-mock testing surface)
+    calls = []
+    def fault(src, dst, blk):
+        calls.append(blk)
+        raise ShuffleFetchFailedException(blk, "injected DCN fault")
+    fabric.fault = fault
+    with pytest.raises(ShuffleFetchFailedException, match="injected"):
+        list(b.fetch_tables([BlockId(1, 0, 0)]))
+    assert calls == [BlockId(1, 0, 0)]
