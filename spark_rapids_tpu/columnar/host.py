@@ -156,13 +156,20 @@ class HostColumn:
         elif isinstance(d, dt.TimestampType):
             values = np.asarray(arr.cast(pa.timestamp("us")).cast(pa.int64()).fill_null(0))
         elif isinstance(d, dt.DecimalType):
-            if d.precision > dt.DecimalType.MAX_INT64_PRECISION:
-                raise TypeError(f"decimal precision > 18 not supported: {d!r}")
-            # scaled int64 representation
+            # scaled-integer representation: int64 up to 18 digits (the
+            # device bound, DecimalType.MAX_INT64_PRECISION); wider
+            # decimals use python ints in an object array — exact host
+            # arithmetic with no overflow, device lowering gated by
+            # TypeSig max_decimal_precision (reference: DECIMAL_64 vs
+            # DECIMAL_128 tiers, GpuCast.scala:1513)
             ints = arr.cast(pa.decimal128(38, d.scale)).fill_null(0)
-            values = np.asarray(
-                [int(x.as_py().scaleb(d.scale)) if x.is_valid else 0 for x in ints],
-                dtype=np.int64)
+            py = [int(x.as_py().scaleb(d.scale)) if x.is_valid else 0
+                  for x in ints]
+            if d.precision > dt.DecimalType.MAX_INT64_PRECISION:
+                values = np.empty(len(py), dtype=object)
+                values[:] = py
+            else:
+                values = np.asarray(py, dtype=np.int64)
         else:
             fill = False if pa.types.is_boolean(arr.type) else 0
             values = np.asarray(arr.fill_null(fill))

@@ -59,8 +59,9 @@ class AggregateFunction(Expression):
 
 def _sum_result_type(t: dt.DataType) -> dt.DataType:
     if isinstance(t, dt.DecimalType):
-        return dt.DecimalType(min(t.precision + 10, dt.DecimalType.MAX_INT64_PRECISION),
-                              t.scale)
+        cap = dt.DecimalType.MAX_INT64_PRECISION \
+            if t.precision <= dt.DecimalType.MAX_INT64_PRECISION else 38
+        return dt.DecimalType(min(t.precision + 10, cap), t.scale)
     if isinstance(t, (dt.FloatType, dt.DoubleType)):
         return dt.DOUBLE
     return dt.LONG

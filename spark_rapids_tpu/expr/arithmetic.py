@@ -26,8 +26,14 @@ def numeric_promote(a: dt.DataType, b: dt.DataType) -> dt.DataType:
         # simplified: decimal op decimal/int -> widest decimal; decimal op fp -> double
         if isinstance(a, dt.DecimalType) and isinstance(b, dt.DecimalType):
             scale = max(a.scale, b.scale)
-            prec = min(max(a.precision - a.scale, b.precision - b.scale) + scale + 1,
-                       dt.DecimalType.MAX_INT64_PRECISION)
+            # inputs within the device int64 tier keep the 18-digit cap
+            # (device placement unchanged); wider inputs may grow to 38
+            # (host object-int arithmetic, exact)
+            cap = dt.DecimalType.MAX_INT64_PRECISION \
+                if max(a.precision, b.precision) <= \
+                dt.DecimalType.MAX_INT64_PRECISION else 38
+            prec = min(max(a.precision - a.scale, b.precision - b.scale)
+                       + scale + 1, cap)
             return dt.DecimalType(prec, scale)
         other = b if isinstance(a, dt.DecimalType) else a
         if other in (dt.FLOAT, dt.DOUBLE):

@@ -236,6 +236,7 @@ class Literal(Expression):
             return EvalCol(values, None, self._dtype)
         v = self.value
         import datetime
+        import decimal as _decimal
         if isinstance(self._dtype, dt.TimestampType) \
                 and isinstance(v, datetime.datetime):
             utc = datetime.timezone.utc
@@ -244,6 +245,14 @@ class Literal(Expression):
             v = int((aware - epoch).total_seconds() * 1_000_000)
         elif isinstance(self._dtype, dt.DateType) and isinstance(v, datetime.date):
             v = (v - datetime.date(1970, 1, 1)).days
+        elif isinstance(self._dtype, dt.DecimalType) \
+                and isinstance(v, _decimal.Decimal):
+            # scaled-integer representation, matching decimal columns
+            v = int(v.scaleb(self._dtype.scale))
+            if self._dtype.precision > dt.DecimalType.MAX_INT64_PRECISION:
+                values = np.empty(n, dtype=object)
+                values[:] = v
+                return EvalCol(values, None, self._dtype)
         values = xp.full((n,), v, dtype=self._dtype.np_dtype())
         return EvalCol(values, None, self._dtype)
 
@@ -300,6 +309,12 @@ def _infer_literal_type(value: Any) -> dt.DataType:
         return dt.TIMESTAMP
     if isinstance(value, datetime.date):
         return dt.DATE
+    import decimal
+    if isinstance(value, decimal.Decimal):
+        sign, digits, exp = value.as_tuple()
+        scale = max(-exp, 0) if isinstance(exp, int) else 0
+        precision = max(len(digits), scale + 1)
+        return dt.DecimalType(min(precision, 38), min(scale, 38))
     raise TypeError(f"cannot infer literal type for {value!r}")
 
 

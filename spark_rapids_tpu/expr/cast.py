@@ -105,10 +105,15 @@ class Cast(Expression):
                 v = c.values.astype(xp.int64) * int(scale_f)
             return EvalCol(v, c.validity, to)
         if isinstance(src, dt.DecimalType) and isinstance(to, dt.DecimalType):
+            wide = max(src.precision, to.precision) \
+                > dt.DecimalType.MAX_INT64_PRECISION
+            vals = c.values if wide else c.values.astype(xp.int64)
+            # wide decimals are host-only object arrays of exact python
+            # ints: keep object dtype (int64 would overflow)
             if to.scale >= src.scale:
-                v = c.values.astype(xp.int64) * (10 ** (to.scale - src.scale))
+                v = vals * (10 ** (to.scale - src.scale))
             else:
-                v = c.values.astype(xp.int64) // (10 ** (src.scale - to.scale))
+                v = vals // (10 ** (src.scale - to.scale))
             return EvalCol(v, c.validity, to)
         if isinstance(src, dt.DateType) and to.is_numeric:
             # days-since-epoch as integer (engine-internal; Spark exposes

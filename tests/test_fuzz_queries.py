@@ -16,6 +16,7 @@ import pytest
 
 from spark_rapids_tpu.session import TpuSession
 from spark_rapids_tpu.expr.functions import (avg, col, count_star, lit,
+                                             collect_list as F_collect_list,
                                              max as f_max, min as f_min,
                                              sum as f_sum)
 
@@ -50,7 +51,42 @@ def _rand_predicate(rng):
 
 def _apply_random_op(rng, df, other):
     """One random transformation; returns (df, grouped_flag)."""
-    op = rng.integers(0, 8)
+    op = rng.integers(0, 11)
+    if op == 8:   # round-3 string kernels: concat_ws / substring_index
+        from spark_rapids_tpu.expr.functions import concat_ws, \
+            substring_index
+        if "s" not in df.columns:   # right/full joins drop the string col
+            df = df.with_column("s", lit("zz-a"))
+        which = rng.integers(0, 2)
+        if which == 0:
+            return df.with_column(
+                "s", concat_ws(str(rng.choice([",", "-", ""])),
+                               col("s"), col("s")))
+        return df.with_column(
+            "s", substring_index(col("s"), str(rng.choice(["a", "-", "e"])),
+                                 int(rng.integers(-2, 3))))
+    if op == 9:   # round-3 nested slice: collect_list -> explode round trip
+        agg = df.group_by("k").agg(
+            F_collect_list(col("i64")).alias("arr"),
+            f_sum(col("f64")).alias("f64"))
+        ex = agg.explode("arr", "i64", outer=bool(rng.integers(0, 2)))
+        # restore the fuzz schema so later ops keep resolving
+        return ex.select("k", col("i64"),
+                         col("i64").cast(dtypes_mod.INT).alias("i32"),
+                         col("f64"), lit("x").alias("s"))
+    if op == 10:  # array scalar ops over a collected list
+        from spark_rapids_tpu.expr.collections import (ArrayContains,
+                                                       ArrayMax, Size)
+        from spark_rapids_tpu.expr.functions import Column
+        agg = df.group_by("k").agg(
+            F_collect_list(col("i32")).alias("arr"),
+            f_sum(col("f64")).alias("f64"))
+        return agg.select(
+            "k",
+            Column(Size(col("arr").expr)).alias("i32"),
+            Column(ArrayMax(col("arr").expr))
+            .cast(dtypes_mod.LONG).alias("i64"),
+            col("f64"), lit("y").alias("s"))
     if op == 0:
         return df.filter(_rand_predicate(rng))
     if op == 1:
