@@ -38,7 +38,12 @@ import numpy as np
 from . import dtypes as dt
 from .host import HostColumn, HostTable
 
-__all__ = ["DeviceColumn", "DeviceTable", "bucket_rows", "bucket_width"]
+__all__ = ["DeviceColumn", "DeviceTable", "bucket_rows", "bucket_width",
+           "canonical_names"]
+
+
+def canonical_names(n: int) -> Tuple[str, ...]:
+    return tuple(f"c{i}" for i in range(n))
 
 
 def _compact_impl(table: "DeviceTable") -> "DeviceTable":
@@ -147,6 +152,18 @@ class DeviceTable:
     def with_columns(self, names: Sequence[str], columns: Sequence[DeviceColumn]
                      ) -> "DeviceTable":
         return DeviceTable(tuple(columns), self.row_mask, self.num_rows, tuple(names))
+
+    def with_names(self, names: Sequence[str]) -> "DeviceTable":
+        """Rename columns (free: names are pytree aux data, no device op)."""
+        assert len(names) == len(self.columns)
+        return DeviceTable(self.columns, self.row_mask, self.num_rows,
+                           tuple(names))
+
+    def canonical(self) -> "DeviceTable":
+        """Positional names c0..cN — the schema-erased view that lets
+        structurally identical kernels share one compiled program across
+        queries (cache keys in utils/compile_cache.py stay name-free)."""
+        return self.with_names(canonical_names(len(self.columns)))
 
     def filter_mask(self, keep: jax.Array) -> "DeviceTable":
         """AND a predicate into the row mask (no data movement)."""
@@ -386,7 +403,7 @@ def concat_device_tables(tables: Sequence[DeviceTable], min_bucket: int = 1024
         return tables[0]
     from ..shims import get_shims
     if any(get_shims().is_tracer(t.num_rows) for t in tables):
-        return _concat_impl(tuple(tables))
+        return _concat_impl(tuple(tables), min_bucket)
     # inputs may live on different chips (ICI-exchange shards read across
     # partitions, e.g. AQE coalesced stage reads): co-locate before the jit
     devs = set()
@@ -396,12 +413,17 @@ def concat_device_tables(tables: Sequence[DeviceTable], min_bucket: int = 1024
     if len(devs) > 1:
         target = next(iter(tables[0].row_mask.devices()))
         tables = [jax.device_put(t, target) for t in tables]
-    return _concat_jitted(tuple(tables))
+    return _concat_jitted(tuple(tables), min_bucket)
 
 
-def _concat_impl(tables) -> DeviceTable:
+def _concat_impl(tables, min_bucket: int = 1024) -> DeviceTable:
     first = tables[0]
     total_cap = sum(t.capacity for t in tables)
+    # pad the output to a power-of-two bucket: incremental merges would
+    # otherwise see arbitrary capacity sums (8192+1024=9216, ...) and
+    # compile a fresh program per sum; bucketing collapses them
+    out_cap = bucket_rows(total_cap, min_bucket)
+    tail = out_cap - total_cap
     compacted = [t.compact() for t in tables]
     out_cols: List[DeviceColumn] = []
     for ci in range(first.num_columns):
@@ -412,19 +434,27 @@ def _concat_impl(tables) -> DeviceTable:
                      for p in parts]
             data = jnp.concatenate(datas, axis=0)
             lengths = jnp.concatenate([p.lengths for p in parts])
+            if tail:
+                data = jnp.pad(data, ((0, tail), (0, 0)))
+                lengths = jnp.pad(lengths, (0, tail))
         else:
             data = jnp.concatenate([p.data for p in parts])
+            if tail:
+                data = jnp.pad(data, [(0, tail)] + [(0, 0)] * (data.ndim - 1))
             lengths = None
         validity = jnp.concatenate([p.validity for p in parts])
+        if tail:
+            validity = jnp.pad(validity, (0, tail))
         out_cols.append(DeviceColumn(data, validity, parts[0].dtype, lengths))
     row_mask = jnp.concatenate([t.row_mask for t in compacted])
+    if tail:
+        row_mask = jnp.pad(row_mask, (0, tail))
     num_rows = sum((t.num_rows for t in tables), jnp.asarray(0, jnp.int32))
     out = DeviceTable(tuple(out_cols), row_mask, num_rows, first.names)
-    del total_cap
     return out.compact()
 
 
-_concat_jitted = jax.jit(_concat_impl)
+_concat_jitted = jax.jit(_concat_impl, static_argnums=(1,))
 
 
 def slice_rows(table: DeviceTable, start, length: int) -> DeviceTable:

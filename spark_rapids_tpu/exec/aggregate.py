@@ -445,6 +445,35 @@ class TpuHashAggregateExec(TpuExec):
         return (f"HashAgg|{self.mode}|{self.key_names}|"
                 f"{self._columns_ops()!r}|{child_schema}")
 
+    def _canon_exec(self) -> Tuple["TpuHashAggregateExec", str]:
+        """Schema-erased clone + cache key: column names become positional
+        (c0..cN in, o0..oM out) so structurally identical aggregations in
+        DIFFERENT queries share one compiled program. Shapes/dtypes that
+        remain distinct retrace inside the shared jax.jit wrapper — the key
+        only needs what the *builder closure* captures (mode, positions,
+        ops, output dtypes)."""
+        child_fields = list(self.child.schema.fields)
+        pos = {f.name: i for i, f in enumerate(child_fields)}
+        ops = self._columns_ops()
+        nk = len(self.key_names)
+        canon_ops = [(f"c{pos[in_col]}", op, f"o{nk + j}", out_dt)
+                     for j, (in_col, op, _, out_dt) in enumerate(ops)]
+        clone = TpuHashAggregateExec.__new__(TpuHashAggregateExec)
+        TpuExec.__init__(clone)
+        clone.mode = self.mode
+        clone.key_names = [f"c{pos[k]}" for k in self.key_names]
+        clone.specs = []
+        clone._columns_ops = lambda: canon_ops      # instance-level override
+        clone.schema = Schema([Field(f"o{j}", f.dtype, f.nullable)
+                               for j, f in enumerate(self.schema.fields)])
+        clone.child = _SchemaOnly(Schema(
+            [Field(f"c{i}", f.dtype, f.nullable)
+             for i, f in enumerate(child_fields)]))
+        clone.children = (clone.child,)
+        key = (f"HashAggC|{self.mode}|k{[pos[k] for k in self.key_names]}|"
+               f"{[(pos[i], op, repr(odt)) for (i, op, _, odt) in ops]}")
+        return clone, key
+
     def _sizes_fn(self) -> Callable[[DeviceTable], jax.Array]:
         """Max list width any collect op needs for one batch (the host
         syncs this one int to pick a bucketed static width)."""
@@ -476,24 +505,38 @@ class TpuHashAggregateExec(TpuExec):
             return w
         return sizes
 
-    def _collect_width(self, table: DeviceTable) -> int:
+    def _collect_width(self, table: DeviceTable, key: str) -> int:
         from ..columnar.device import bucket_width
         from ..utils.compile_cache import cached_jit
-        sizes = cached_jit(self.plan_signature() + "|sizes", self._sizes_fn)
+        sizes = cached_jit(key + "|sizes", self._sizes_fn)
         return bucket_width(max(int(sizes(table)), 1), min_width=4)
+
+    def _canon_fn(self) -> Callable[[DeviceTable], DeviceTable]:
+        """Schema-erased cached aggregate callable: canonical-rename in,
+        run the shared program, rename out."""
+        from ..utils.compile_cache import cached_jit
+        canon, ckey = self._canon_exec()
+        out_names = tuple(self.schema.names)
+        if not self._has_collect():
+            base = cached_jit(ckey, canon.batch_fn)
+
+            def fn(batch: DeviceTable) -> DeviceTable:
+                return base(batch.canonical()).with_names(out_names)
+            return fn
+
+        def fn(batch: DeviceTable) -> DeviceTable:
+            bc = batch.canonical()  # per-batch static width, cached per bucket
+            w = canon._collect_width(bc, ckey)
+            out = cached_jit(ckey + f"|W{w}",
+                             lambda: canon.batch_fn(list_width=w))(bc)
+            return out.with_names(out_names)
+        return fn
 
     def execute_columnar(self, pidx: int) -> Iterator[DeviceTable]:
         from ..columnar.device import concat_device_tables, shrink_to_fit
         from ..memory.catalog import SpillPriorities, get_catalog
-        from ..utils.compile_cache import cached_jit
-        has_collect = self._has_collect()
-        if not has_collect:
-            fn = cached_jit(self.plan_signature(), self.batch_fn)
-        else:
-            def fn(batch):     # per-batch static width, cached per bucket
-                w = self._collect_width(batch)
-                return cached_jit(self.plan_signature() + f"|W{w}",
-                                  lambda: self.batch_fn(list_width=w))(batch)
+        fn = self._canon_fn()
+        merge_fn = None  # built lazily, loop-invariant
         catalog = get_catalog()
         pending = None  # SpillableDeviceTable holding the running merge state
         try:
@@ -508,20 +551,13 @@ class TpuHashAggregateExec(TpuExec):
                     # shrink-to-groups stops its capacity growing with the
                     # batch count, and the catalog registration lets memory
                     # pressure spill it between input batches (reference:
-                    # aggregate.scala merge passes under targetSize)
+                    # aggregate.scala merge passes under targetSize).
+                    # concat pads to a pow2 bucket, so the merge program
+                    # compiles for one or two capacities, not per sum.
                     with pending as prev:
                         both = concat_device_tables([prev, out])
-                    merged_exec = self._merged_exec()
-                    if has_collect:
-                        w = merged_exec._collect_width(both)
-                        merge_fn = cached_jit(
-                            self.plan_signature()
-                            + f"|merge{both.capacity}|W{w}",
-                            lambda: merged_exec.batch_fn(list_width=w))
-                    else:
-                        merge_fn = cached_jit(
-                            self.plan_signature() + f"|merge{both.capacity}",
-                            merged_exec.batch_fn)
+                    if merge_fn is None:
+                        merge_fn = self._merged_exec()._canon_fn()
                     merged = shrink_to_fit(merge_fn(both))
                     pending.close()
                     pending = catalog.register(

@@ -39,7 +39,7 @@ from ..columnar.device import (DeviceColumn, DeviceTable, bucket_rows,
 from ..expr.base import EvalContext, Expression
 from ..plan.logical import _join_schema
 from ..plan.physical import PhysicalPlan
-from ..plan.schema import Schema
+from ..plan.schema import Field, Schema
 from ..utils import metrics as M
 from ..utils.compile_cache import cached_jit
 from .base import TpuExec
@@ -184,6 +184,20 @@ def _null_device_column(dtype: dt.DataType, capacity: int) -> DeviceColumn:
                         jnp.zeros(capacity, dtype=bool), dtype, None)
 
 
+def _key_view(table: DeviceTable, keys: Sequence[str]) -> DeviceTable:
+    """Table of only the join-key columns under canonical names — the
+    schema-erased input of the shared count kernel."""
+    from ..columnar.device import canonical_names
+    cols = tuple(table.column(k) for k in keys)
+    return DeviceTable(cols, table.row_mask, table.num_rows,
+                       canonical_names(len(cols)))
+
+
+class _JoinSchemaOnly:
+    def __init__(self, schema: Schema):
+        self.schema = schema
+
+
 def _condition_mask(condition: Expression, table: DeviceTable) -> jax.Array:
     """Residual-condition boolean mask over an assembled pair table."""
     ctx = EvalContext.for_device(table)
@@ -201,14 +215,13 @@ class _JoinKernels:
         self.node = exec_node
 
     def counts_fn(self):
-        lkeys = self.node.left_keys
-        rkeys = self.node.right_keys
-
-        def fn(build: DeviceTable, probe: DeviceTable):
-            bcols = [build.column(k) for k in rkeys]
-            pcols = [probe.column(k) for k in lkeys]
-            bgid, pgid = _join_codes(bcols, build.row_mask, pcols,
-                                     probe.row_mask)
+        """Key-view based: takes tables holding ONLY the join-key columns
+        (canonical names), so one compiled count program serves every join
+        with the same key layout, regardless of payload schema."""
+        def fn(build_keys: DeviceTable, probe_keys: DeviceTable):
+            bgid, pgid = _join_codes(
+                list(build_keys.columns), build_keys.row_mask,
+                list(probe_keys.columns), probe_keys.row_mask)
             b_order, starts, counts = _count_matches(bgid, pgid)
             return b_order, starts, counts, bgid, pgid
         return fn
@@ -358,6 +371,41 @@ class TpuShuffledHashJoinExec(TpuExec):
                 f"{self.merge_keys}|{self.condition!r}|"
                 f"{self.left.schema!r}|{self.right.schema!r}")
 
+    def _canon(self) -> Tuple["TpuShuffledHashJoinExec", str]:
+        """Schema-erased clone + cache key (see aggregate._canon_exec):
+        left columns a0..aN, right b0..bM, keys by position. Gather/assemble
+        kernels built from the clone are shared by every join with the same
+        (how, key positions, merge, column counts); dtype/shape differences
+        retrace inside the shared jax.jit wrapper. Residual-condition
+        kernels keep name-based keys (conditions reference real names)."""
+        if getattr(self, "_canon_cache", None) is not None:
+            return self._canon_cache
+        lf = list(self.left.schema.fields)
+        rf = list(self.right.schema.fields)
+        lpos = {f.name: i for i, f in enumerate(lf)}
+        rpos = {f.name: i for i, f in enumerate(rf)}
+        clone = TpuShuffledHashJoinExec.__new__(TpuShuffledHashJoinExec)
+        TpuExec.__init__(clone)
+        clone.left = _JoinSchemaOnly(Schema(
+            [Field(f"a{i}", f.dtype, f.nullable) for i, f in enumerate(lf)]))
+        clone.right = _JoinSchemaOnly(Schema(
+            [Field(f"b{i}", f.dtype, f.nullable) for i, f in enumerate(rf)]))
+        clone.children = (clone.left, clone.right)
+        clone.left_keys = [f"a{lpos[k]}" for k in self.left_keys]
+        clone.right_keys = [f"b{rpos[k]}" for k in self.right_keys]
+        clone.how = self.how
+        clone.condition = None
+        clone.merge_keys = self.merge_keys
+        clone.min_bucket = self.min_bucket
+        clone.batch_bytes = self.batch_bytes
+        clone.schema = self.schema
+        clone._kernels = _JoinKernels(clone)
+        key = (f"JoinC|{self.how}|{[lpos[k] for k in self.left_keys]}|"
+               f"{[rpos[k] for k in self.right_keys]}|{self.merge_keys}|"
+               f"nl{len(lf)}|nr{len(rf)}")
+        self._canon_cache = (clone, key)
+        return self._canon_cache
+
     # -- column assembly (traced inside expand kernel) ------------------------
     def assemble(self, pcols: List[DeviceColumn], bcols: List[DeviceColumn],
                  build_matched: jax.Array, key_from_build: bool = False):
@@ -447,19 +495,43 @@ class TpuShuffledHashJoinExec(TpuExec):
             yield from self._probe_join(
                 handle, _device_batches(self.left, pidx), seen_box)
             if track:
-                leftover = cached_jit(self.plan_signature() + "|leftover",
-                                      self._kernels.leftover_fn)
+                leftover = self._leftover_fn()
                 with handle as build:
                     yield leftover(build, seen_box[0])
         finally:
             if own:
                 handle.close()
 
+    def _leftover_fn(self):
+        """Cached canonical leftover kernel (right/full build-side rows).
+        Left-side dtypes go in the key: the null probe columns are built
+        from them at trace time."""
+        clone, ckey = self._canon()
+        lkey = (ckey + "|leftover|"
+                + ",".join(repr(f.dtype) for f in self.left.schema.fields))
+        fn = cached_jit(lkey, clone._kernels.leftover_fn)
+        out_names = tuple(self.schema.names)
+
+        def run(build: DeviceTable, seen) -> DeviceTable:
+            return fn(build.canonical(), seen).with_names(out_names)
+        return run
+
     def _register_build(self, build: DeviceTable):
         """-> (SpillableDeviceTable, close_when_done)."""
         from ..memory.catalog import SpillPriorities, get_catalog
         return (get_catalog().register(build, SpillPriorities.ACTIVE_ON_DECK),
                 True)
+
+    def _counts_fn(self):
+        """Shared count kernel over key views: one program per key LAYOUT
+        (count of keys), retraced per key dtype/capacity inside the jit."""
+        lkeys, rkeys = self.left_keys, self.right_keys
+        fn = cached_jit(f"JoinC|counts|k{len(lkeys)}",
+                        self._kernels.counts_fn)
+
+        def run(build: DeviceTable, probe: DeviceTable):
+            return fn(_key_view(build, rkeys), _key_view(probe, lkeys))
+        return run
 
     def _probe_join(self, build_handle, probe_batches, seen_box=None
                     ) -> Iterator[DeviceTable]:
@@ -468,8 +540,7 @@ class TpuShuffledHashJoinExec(TpuExec):
         ``seen_box`` (right/full) is a one-element list holding the running
         per-build-row matched mask, updated in place across batches.
         """
-        counts_fn = cached_jit(self.plan_signature() + "|counts",
-                               self._kernels.counts_fn)
+        counts_fn = self._counts_fn()
         has_cond = self.condition is not None
         for probe in probe_batches:
             with self.metrics.timed(M.JOIN_TIME), build_handle as build:
@@ -481,15 +552,16 @@ class TpuShuffledHashJoinExec(TpuExec):
                         seen_box[0], next(iter(build.row_mask.devices())))
                 b_order, starts, counts, bgid, pgid = counts_fn(build, probe)
                 if seen_box is not None and not has_cond:
-                    seen = cached_jit(self.plan_signature() + "|seen",
+                    seen = cached_jit("JoinC|seen",  # array-only: global
                                       self._kernels.seen_fn)
                     seen_box[0] = seen(bgid, pgid, seen_box[0])
                 if self.how in ("left_semi", "left_anti") and not has_cond:
+                    anti = self.how == "left_anti"
                     fn = cached_jit(
-                        self.plan_signature() + "|semi",
-                        lambda: self._kernels.semi_mask_fn(
-                            self.how == "left_anti"))
-                    yield fn(probe, counts)
+                        f"JoinC|semi|{anti}",
+                        lambda: self._kernels.semi_mask_fn(anti))
+                    yield fn(probe.canonical(), counts) \
+                        .with_names(probe.names)
                     continue
                 outer_slots = self.how in ("left", "full") and not has_cond
                 slot_counts = np.asarray(
@@ -513,19 +585,24 @@ class TpuShuffledHashJoinExec(TpuExec):
                     seen_box) -> Iterator[DeviceTable]:
         """One expand call on a probe batch/window (post-count)."""
         how = self.how
+        out_names = tuple(self.schema.names)
         if self.condition is None:
             # right behaves as inner here; leftover_fn emits its outer rows
             eff = {"right": "inner", "full": "left"}.get(how, how)
+            clone, ckey = self._canon()
             expand = cached_jit(
-                self.plan_signature() + f"|expand{out_cap}",
-                lambda: self._kernels.expand_fn(out_cap, eff))
-            yield expand(build, probe, b_order, starts, counts)
+                ckey + f"|expand{out_cap}|{eff}",
+                lambda: clone._kernels.expand_fn(out_cap, eff))
+            yield expand(build.canonical(), probe.canonical(), b_order,
+                         starts, counts).with_names(out_names)
             return
         if how == "inner":
+            clone, ckey = self._canon()
             expand = cached_jit(
-                self.plan_signature() + f"|expand{out_cap}",
-                lambda: self._kernels.expand_fn(out_cap, "inner"))
-            out = expand(build, probe, b_order, starts, counts)
+                ckey + f"|expand{out_cap}|inner",
+                lambda: clone._kernels.expand_fn(out_cap, "inner"))
+            out = expand(build.canonical(), probe.canonical(), b_order,
+                         starts, counts).with_names(out_names)
             cond_fn = cached_jit(self.plan_signature() + "|cond",
                                  lambda: _condition_filter_fn(self.condition))
             yield cond_fn(out)
@@ -619,9 +696,7 @@ class TpuShuffledHashJoinExec(TpuExec):
                                                 sub_batches(), seen_box)
                 if track:
                     # never-probed buckets still owe all their build rows
-                    leftover = cached_jit(
-                        self.plan_signature() + "|leftover",
-                        self._kernels.leftover_fn)
+                    leftover = self._leftover_fn()
                     with build_parts[s] as bt:
                         yield leftover(bt, seen_box[0])
         finally:
