@@ -86,6 +86,7 @@ def _write_partial():
             "smoke": _STATE["smoke"],
             "tpch": _STATE["tpch"],
             "ablation": _STATE.get("ablation", {}),
+            "compile_cache": _STATE.get("compile_cache", {}),
             "errors": _STATE["errors"],
             "notes": _STATE["notes"],
         }, f, indent=1)
@@ -470,6 +471,7 @@ def run_tpch22(fell_back):
 
     stats = cache_stats()
     hit_rate = stats["hits"] / max(stats["hits"] + stats["misses"], 1)
+    _STATE["compile_cache"] = dict(stats)
     _log(f"compile_cache_hit_rate={hit_rate:.3f} ({stats}) "
          f"worst_rel_err={worst_err:.2e}")
 
@@ -517,6 +519,7 @@ def run_ablation(fell_back):
                                             rows=int(6_000_000 * sf))}
     configs = {
         "baseline": {},
+        "host_shuffle_tier": {"spark.rapids.tpu.shuffle.mode": "host"},
         "aqe_off": {"spark.rapids.tpu.aqe.enabled": False},
         "sql_off_hostengine": {"spark.rapids.sql.enabled": False},
     }

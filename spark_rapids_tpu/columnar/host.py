@@ -285,6 +285,9 @@ class HostTable:
         return HostTable(list(first.names), cols)
 
     def nbytes(self) -> int:
+        cached = getattr(self, "_nbytes", None)
+        if cached is not None:
+            return cached
         total = 0
         for c in self.columns:
             if c.values.dtype == object:
@@ -293,4 +296,5 @@ class HostTable:
                 total += c.values.nbytes
             if c.validity is not None:
                 total += c.validity.nbytes
+        self._nbytes = total  # columns are never mutated in place
         return total

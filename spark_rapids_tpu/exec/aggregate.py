@@ -539,10 +539,33 @@ class TpuHashAggregateExec(TpuExec):
         merge_fn = None  # built lazily, loop-invariant
         catalog = get_catalog()
         pending = None  # SpillableDeviceTable holding the running merge state
+
+        def chunked_inputs():
+            """Stage child batches and aggregate one CONCAT per ~1M-row
+            chunk: one sort-based groupby over the chunk replaces a
+            per-batch aggregate + pairwise merge cascade (4 batches would
+            otherwise cost 7 lexsorts; chunking costs 1). The chunk bound
+            keeps the concat out-of-core-safe; anything beyond one chunk
+            still reduces through the pairwise merge below."""
+            staged: List[DeviceTable] = []
+            cap = 0
+            for b in self.child_device_batches(pidx):
+                staged.append(b)
+                cap += b.capacity
+                if cap >= (1 << 20):
+                    yield staged[0] if len(staged) == 1 \
+                        else concat_device_tables(staged)
+                    staged, cap = [], 0
+            if staged:
+                yield staged[0] if len(staged) == 1 \
+                    else concat_device_tables(staged)
+
         try:
-            for batch in self.child_device_batches(pidx):
+            for batch in chunked_inputs():
                 with self.metrics.timed(M.AGG_TIME):
-                    out = fn(batch)
+                    # shrink to the group bucket: the running state must
+                    # not scale with input capacity (out-of-core bound)
+                    out = shrink_to_fit(fn(batch))
                 if pending is None:
                     pending = catalog.register(
                         out, SpillPriorities.ACTIVE_ON_DECK)

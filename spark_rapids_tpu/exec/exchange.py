@@ -32,17 +32,19 @@ from ..plan.physical import HashPartitioning, PhysicalPlan
 from ..utils import metrics as M
 from .base import TpuExec
 
-__all__ = ["TpuShuffleExchangeExec", "SHUFFLE_MODE", "pad_table_capacity"]
+__all__ = ["TpuShuffleExchangeExec", "TpuLocalExchangeExec", "SHUFFLE_MODE",
+           "pad_table_capacity"]
 
 SHUFFLE_MODE = register_conf(
     "spark.rapids.tpu.shuffle.mode",
     "Shuffle exchange tier: 'auto' uses the on-device ICI all-to-all when "
-    "the session has a device mesh attached, else the host-staged exchange; "
-    "'ici' builds a mesh over all addressable devices; 'host' forces the "
-    "host-staged tier (reference: rapids shuffle manager vs default Spark "
-    "shuffle, SURVEY §2.7).", "auto",
-    checker=lambda v: None if v in ("auto", "host", "ici")
-    else f"must be one of auto/host/ici, got {v!r}")
+    "the session has a device mesh attached and the device-local coalesce "
+    "when it does not (single chip); 'ici' builds a mesh over all "
+    "addressable devices; 'local' forces the single-device coalesce tier; "
+    "'host' forces the host-staged tier (reference: rapids shuffle manager "
+    "vs default Spark shuffle, SURVEY §2.7).", "auto",
+    checker=lambda v: None if v in ("auto", "host", "ici", "local")
+    else f"must be one of auto/host/ici/local, got {v!r}")
 
 EXCHANGE_CHUNK_ROWS = register_conf(
     "spark.rapids.tpu.shuffle.exchangeChunkRows",
@@ -203,6 +205,78 @@ def _close_quietly(handle):
         handle.close()
     except Exception:
         pass
+
+
+class TpuLocalExchangeExec(TpuExec):
+    """Single-chip device-resident exchange: the whole input coalesces into
+    ONE spill-registered output partition, never leaving the device.
+
+    With one addressable chip there is no locality to exploit and no
+    transport to ride: hash, range and single partitioning contracts are
+    all trivially satisfied by a single output partition (all rows of any
+    key land together; global order is whatever the downstream sort makes
+    of its one partition). The host-staged tier's download-partition-upload
+    round trip — the single largest overhead of single-chip plans — is
+    gone; out-of-core pressure is handled downstream (grace join, OOC
+    sort/agg) and by the catalog spill handles held here.
+
+    The local analogue of Spark AQE's local shuffle reader; tier selection
+    mirrors the reference's RapidsShuffleManager vs default-Spark-shuffle
+    split (SURVEY §2.7; GpuShuffleExchangeExecBase.scala:146)."""
+
+    def __init__(self, child: PhysicalPlan, partitioning,
+                 min_bucket: int = 1024):
+        super().__init__()
+        self.child = child
+        self.children = (child,)
+        self.partitioning = partitioning
+        self.min_bucket = min_bucket
+        self.schema = child.schema
+        self._handles: Optional[List] = None
+
+    @property
+    def num_partitions(self) -> int:
+        return 1
+
+    def node_desc(self) -> str:
+        return "local n=1"
+
+    def _materialize(self) -> None:
+        if self._handles is not None:
+            return
+        import weakref
+
+        from ..memory.catalog import SpillPriorities, get_catalog
+        catalog = get_catalog()
+        handles: List = []
+        rows = 0
+        from ..columnar.device import shrink_to_fit
+        for p in range(self.child.num_partitions):
+            for b in self.child_device_batches(p):
+                n = int(b.num_rows)
+                if not n:
+                    continue
+                rows += n
+                with self.metrics.timed(M.OP_TIME):
+                    # the exchange is a compaction point (design rule 2 in
+                    # columnar/device.py): post-filter / fused-partial-agg
+                    # batches can be mostly masked slack — forwarding full
+                    # capacity would inflate every downstream kernel
+                    h = catalog.register(
+                        shrink_to_fit(b, self.min_bucket),
+                        SpillPriorities.OUTPUT_FOR_SHUFFLE)
+                weakref.finalize(self, _close_quietly, h)
+                handles.append(h)
+        self._handles = handles
+        self.metrics.add(M.NUM_OUTPUT_BATCHES, len(handles))
+        self.metrics.add(M.NUM_OUTPUT_ROWS, rows)
+
+    def execute_columnar(self, pidx: int) -> Iterator[DeviceTable]:
+        self._materialize()
+        from ..io.file_block import clear_input_file
+        clear_input_file()  # post-shuffle rows have no single source file
+        for handle in self._handles:
+            yield handle.get()
 
 
 def _split_sharded(table: DeviceTable, n: int) -> List[Optional[DeviceTable]]:

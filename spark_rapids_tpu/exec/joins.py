@@ -184,6 +184,26 @@ def _null_device_column(dtype: dt.DataType, capacity: int) -> DeviceColumn:
                         jnp.zeros(capacity, dtype=bool), dtype, None)
 
 
+_I64_MAX = np.int64(2**63 - 1)
+
+
+def _monotone_i64(v: jax.Array) -> jax.Array:
+    """Order- and equality-preserving map of a key column into int64
+    (Spark key semantics: NaN == NaN, -0.0 == 0.0). Integers/bool/date/
+    timestamp widen; floats use the IEEE monotone bit trick after
+    canonicalizing -0.0 and NaN."""
+    if v.dtype == jnp.bool_ or jnp.issubdtype(v.dtype, jnp.integer):
+        return v.astype(jnp.int64)
+    if v.dtype == jnp.float32:
+        v = v.astype(jnp.float64)  # lossless widen
+    v = jnp.where(v == 0, jnp.zeros_like(v), v)          # -0.0 -> +0.0
+    v = jnp.where(jnp.isnan(v), jnp.full_like(v, jnp.nan), v)  # one NaN
+    u = jax.lax.bitcast_convert_type(v, jnp.uint64)
+    top = jnp.uint64(1) << jnp.uint64(63)
+    mono = jnp.where((u & top) != 0, ~u, u | top)        # monotone uint64
+    return jax.lax.bitcast_convert_type(mono ^ top, jnp.int64)
+
+
 def _key_view(table: DeviceTable, keys: Sequence[str]) -> DeviceTable:
     """Table of only the join-key columns under canonical names — the
     schema-erased input of the shared count kernel."""
@@ -226,11 +246,62 @@ class _JoinKernels:
             return b_order, starts, counts, bgid, pgid
         return fn
 
-    def seen_fn(self):
-        """No-condition right/full: OR this probe batch's key matches into
-        the running per-build-row seen mask."""
-        def fn(bgid, pgid, seen):
-            return jnp.logical_or(seen, _build_matched(bgid, pgid))
+    def matched_fn(self):
+        """No-condition right/full general path: this probe batch's
+        per-build-row key-match mask (ORed into the running seen mask by
+        the caller)."""
+        def fn(bgid, pgid):
+            return _build_matched(bgid, pgid)
+        return fn
+
+    def build_prep_fn(self):
+        """Direct single-key fast path, build half: map keys into the
+        monotone int64 domain and sort ONCE per build table
+        (invalid/masked rows pushed to a +max tail). Probe batches then
+        only pay searchsorted — no build+probe concat, no per-batch
+        build re-sort, exact (no hash)."""
+        def fn(build_keys: DeviceTable):
+            bc = build_keys.columns[0]
+            bmask = jnp.logical_and(bc.validity, build_keys.row_mask)
+            bv = _monotone_i64(bc.data)
+            inv_b = jnp.logical_not(bmask)
+            b_order = jnp.lexsort((bv, inv_b))
+            sv = jnp.where(jnp.take(inv_b, b_order), _I64_MAX,
+                           jnp.take(bv, b_order))
+            nvalid = jnp.sum(bmask.astype(jnp.int64))
+            return b_order, sv, nvalid
+        return fn
+
+    def probe_count_fn(self, track: bool):
+        """Direct path, probe half: two searchsorted passes clamped to the
+        valid build prefix. Clamping makes sentinel collisions exact: for
+        a probe key equal to the +max sentinel, the count still equals the
+        number of VALID build rows holding that key (the tie region's
+        valid entries all sit below ``nvalid``). ``track`` adds the
+        per-build-row matched mask (right/full) from a probe-side sort."""
+        def fn(b_order, sv, nvalid, probe_keys: DeviceTable):
+            pc = probe_keys.columns[0]
+            pmask = jnp.logical_and(pc.validity, probe_keys.row_mask)
+            pv = _monotone_i64(pc.data)
+            starts = jnp.minimum(
+                jnp.searchsorted(sv, pv, side="left"), nvalid)
+            ends = jnp.minimum(
+                jnp.searchsorted(sv, pv, side="right"), nvalid)
+            counts = jnp.where(pmask, ends - starts, 0)
+            if track:
+                pinv = jnp.logical_not(pmask)
+                ps = jnp.sort(jnp.where(pinv, _I64_MAX, pv))
+                pn = jnp.sum(pmask.astype(jnp.int64))
+                lo = jnp.minimum(jnp.searchsorted(ps, sv, side="left"), pn)
+                hi = jnp.minimum(jnp.searchsorted(ps, sv, side="right"), pn)
+                iota = jnp.arange(sv.shape[0], dtype=jnp.int64)
+                matched_s = jnp.logical_and(hi > lo, iota < nvalid)
+                matched = jnp.zeros(sv.shape[0], dtype=bool) \
+                    .at[b_order].set(matched_s)
+            else:
+                matched = jnp.zeros(sv.shape[0], dtype=bool)
+            return starts.astype(jnp.int64), counts.astype(jnp.int64), \
+                matched
         return fn
 
     def _slots(self, build, probe, b_order, starts, counts, out_cap, outer):
@@ -522,16 +593,85 @@ class TpuShuffledHashJoinExec(TpuExec):
         return (get_catalog().register(build, SpillPriorities.ACTIVE_ON_DECK),
                 True)
 
-    def _counts_fn(self):
-        """Shared count kernel over key views: one program per key LAYOUT
-        (count of keys), retraced per key dtype/capacity inside the jit."""
+    def _direct_key_ok(self) -> bool:
+        """Single-key joins on identical non-nested, non-string dtypes use
+        the sort-build-once searchsorted count path."""
+        if len(self.left_keys) != 1:
+            return False
+        lt = self.left.schema.field(self.left_keys[0]).dtype
+        rt = self.right.schema.field(self.right_keys[0]).dtype
+        bad = (dt.StringType, dt.BinaryType, dt.ArrayType)
+        return lt == rt and not isinstance(lt, bad)
+
+    def _counts_fn(self, track: bool = False):
+        """Shared count kernel over key views -> (b_order, starts, counts,
+        matched_or_None). One program per key LAYOUT (count of keys +
+        direct/general + track), retraced per dtype/capacity inside the
+        shared jit."""
         lkeys, rkeys = self.left_keys, self.right_keys
+        if self._direct_key_ok():
+            prep = cached_jit("JoinC|prepD", self._kernels.build_prep_fn)
+            cnt = cached_jit(f"JoinC|probeD|t{int(track)}",
+                             lambda: self._kernels.probe_count_fn(track))
+            # node-level: broadcast joins re-enter _probe_join once per
+            # probe partition with the SAME build table — the prep must
+            # survive across those entries. The sorted-key arrays live in
+            # a catalog-registered spillable so memory pressure can evict
+            # them; single entry, replaced on build change, race-safe
+            # (each thread uses the tuple it computed or read, never a
+            # second dict lookup).
+            lock = self.__dict__.setdefault("_prep_lock",
+                                            __import__("threading").Lock())
+
+            def run(build: DeviceTable, probe: DeviceTable):
+                bkey = id(build.row_mask)
+                with lock:
+                    hit = self.__dict__.get("_prep_cache")
+                    if hit is None or hit[0] is not build.row_mask:
+                        pr = self._register_prep(
+                            prep(_key_view(build, rkeys)))
+                        hit = (build.row_mask, pr)
+                        old = self.__dict__.get("_prep_cache")
+                        if old is not None:
+                            _close_quietly(old[1][0])
+                        self.__dict__["_prep_cache"] = hit
+                handle, nvalid = hit[1]
+                pt = handle.get()
+                b_order, sv = pt.columns[0].data, pt.columns[1].data
+                starts, counts, matched = cnt(b_order, sv, nvalid,
+                                              _key_view(probe, lkeys))
+                return b_order, starts, counts, (matched if track else None)
+            return run
         fn = cached_jit(f"JoinC|counts|k{len(lkeys)}",
                         self._kernels.counts_fn)
+        matched_fn = cached_jit("JoinC|matched", self._kernels.matched_fn) \
+            if track else None
 
         def run(build: DeviceTable, probe: DeviceTable):
-            return fn(_key_view(build, rkeys), _key_view(probe, lkeys))
+            b_order, starts, counts, bgid, pgid = fn(
+                _key_view(build, rkeys), _key_view(probe, lkeys))
+            matched = matched_fn(bgid, pgid) if track else None
+            return b_order, starts, counts, matched
         return run
+
+    def _register_prep(self, pr):
+        """(b_order, sv, nvalid) -> ((spill handle, nvalid)): the sorted
+        build-key arrays go through the BufferCatalog so memory pressure
+        can evict them like any other device buffer."""
+        import weakref
+
+        from ..columnar.device import canonical_names
+        from ..memory.catalog import SpillPriorities, get_catalog
+        b_order, sv, nvalid = pr
+        cap = sv.shape[0]
+        ones = jnp.ones(cap, dtype=bool)
+        cols = (DeviceColumn(b_order, ones, dt.LongType(), None),
+                DeviceColumn(sv, ones, dt.LongType(), None))
+        t = DeviceTable(cols, ones, jnp.asarray(cap, jnp.int32),
+                        canonical_names(2))
+        h = get_catalog().register(t, SpillPriorities.ACTIVE_ON_DECK)
+        weakref.finalize(self, _close_quietly, h)
+        return (h, nvalid)
 
     def _probe_join(self, build_handle, probe_batches, seen_box=None
                     ) -> Iterator[DeviceTable]:
@@ -540,8 +680,9 @@ class TpuShuffledHashJoinExec(TpuExec):
         ``seen_box`` (right/full) is a one-element list holding the running
         per-build-row matched mask, updated in place across batches.
         """
-        counts_fn = self._counts_fn()
         has_cond = self.condition is not None
+        track = seen_box is not None and not has_cond
+        counts_fn = self._counts_fn(track=track)
         for probe in probe_batches:
             with self.metrics.timed(M.JOIN_TIME), build_handle as build:
                 probe = _co_locate(probe, build)
@@ -550,11 +691,9 @@ class TpuShuffledHashJoinExec(TpuExec):
                         and seen_box[0].devices() != build.row_mask.devices():
                     seen_box[0] = jax.device_put(
                         seen_box[0], next(iter(build.row_mask.devices())))
-                b_order, starts, counts, bgid, pgid = counts_fn(build, probe)
-                if seen_box is not None and not has_cond:
-                    seen = cached_jit("JoinC|seen",  # array-only: global
-                                      self._kernels.seen_fn)
-                    seen_box[0] = seen(bgid, pgid, seen_box[0])
+                b_order, starts, counts, matched = counts_fn(build, probe)
+                if matched is not None:
+                    seen_box[0] = jnp.logical_or(seen_box[0], matched)
                 if self.how in ("left_semi", "left_anti") and not has_cond:
                     anti = self.how == "left_anti"
                     fn = cached_jit(
@@ -634,7 +773,7 @@ class TpuShuffledHashJoinExec(TpuExec):
         while start < nrows:
             window = slice_rows(probe, start, wsize)
             start += wsize
-            b_order, starts, counts, _, _ = counts_fn(build, window)
+            b_order, starts, counts, _ = counts_fn(build, window)
             wtotal = int(np.asarray(jnp.sum(jnp.where(
                 window.row_mask,
                 jnp.maximum(counts, 1) if outer_slots else counts, 0))))

@@ -147,7 +147,9 @@ class ShuffleStageExec(PhysicalPlan):
         yield from self.inner.execute_columnar(pidx)
 
     def node_desc(self) -> str:
-        tier = "ici" if self.device_resident else "host"
+        from ..exec.exchange import TpuLocalExchangeExec
+        tier = ("local" if isinstance(self.inner, TpuLocalExchangeExec)
+                else "ici" if self.device_resident else "host")
         return (f"{tier} n={self.num_partitions} rows={self.stats.total_rows} "
                 f"bytes={self.stats.total_bytes}")
 
@@ -287,24 +289,37 @@ def materialize_stage(cpu_exchange: ShuffleExchangeExec, conf: RapidsConf,
         converted = converted.child
     if hook is not None:
         hook(converted)  # event-log instrumentation of the stage segment
-    from ..exec.exchange import TpuShuffleExchangeExec
-    if isinstance(converted, TpuShuffleExchangeExec):
+    from ..exec.exchange import TpuLocalExchangeExec, TpuShuffleExchangeExec
+
+    def _scaled_device_bytes(t) -> int:
+        # buffers are capacity-padded (pow2 buckets, min 1024 rows); scale
+        # to the compacted row count so device-tier stats are comparable
+        # with the host tier's true bytes — otherwise tiny build sides
+        # look big and suppress AQE broadcast demotion
+        nrows = int(t.num_rows)
+        total = 0
+        for c in t.columns:
+            cap = max(int(c.data.shape[0]), 1)
+            total += int(c.data.nbytes) * nrows // cap
+        return total
+
+    if isinstance(converted, TpuLocalExchangeExec):
+        converted._materialize()
+        prows = pbytes = 0
+        for h in converted._handles:
+            t = h.get()
+            prows += int(t.num_rows)
+            pbytes += _scaled_device_bytes(t)
+        stats = PartitionStats([prows], [pbytes])
+    elif isinstance(converted, TpuShuffleExchangeExec):
         converted._materialize()
         rows, nbytes = [], []
         for handles in converted._shards:
             prows = pbytes = 0
             for h in handles:
                 t = h.get()
-                nrows = int(t.num_rows)
-                prows += nrows
-                # buffers are capacity-padded (pow2 buckets, min 1024
-                # rows); scale to the compacted row count so device-tier
-                # stats are comparable with the host tier's true bytes —
-                # otherwise tiny build sides look big and suppress AQE
-                # broadcast demotion
-                for c in t.columns:
-                    cap = max(int(c.data.shape[0]), 1)
-                    pbytes += int(c.data.nbytes) * nrows // cap
+                prows += int(t.num_rows)
+                pbytes += _scaled_device_bytes(t)
             rows.append(prows)
             nbytes.append(pbytes)
         stats = PartitionStats(rows, nbytes)
@@ -719,6 +734,14 @@ def _register_reader_rules():
 
         def node_desc(self) -> str:
             return self.stage.node_desc()
+
+        def tree_string(self, indent: int = 0) -> str:
+            # show the materialized stage subtree (explain parity with
+            # ShuffleStageExec.tree_string)
+            pad = "  " * indent
+            return "\n".join([f"{pad}{self.node_name()} "
+                              f"[{self.node_desc()}]",
+                              self.stage.inner.tree_string(indent + 1)])
 
     def tag_stage(meta, conf):
         if not meta.plan.device_resident:

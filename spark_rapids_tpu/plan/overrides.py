@@ -708,11 +708,19 @@ def _register_exec_rules():
         return sess.shuffle_mesh() if sess is not None else None
 
     def tag_exchange(meta, conf):
+        from ..exec.exchange import SHUFFLE_MODE
         p: ShuffleExchangeExec = meta.plan
-        mesh = _active_mesh()
+        mode = conf.get(SHUFFLE_MODE)
+        if mode == "host":
+            meta.cannot_run("host tier forced (spark.rapids.tpu.shuffle.mode)")
+            return
+        mesh = _active_mesh() if mode in ("auto", "ici") else None
         if mesh is None:
-            meta.cannot_run("no device mesh attached "
-                            "(host-staged exchange tier)")
+            if mode == "ici":
+                meta.cannot_run("shuffle.mode=ici but no device mesh could "
+                                "be attached")
+            # local tier: any partitioning is satisfied by one device-
+            # resident partition — no key-type constraints
             return
         if not isinstance(p.partitioning, HashPartitioning):
             meta.cannot_run(
@@ -731,7 +739,12 @@ def _register_exec_rules():
 
 
 def _convert_exchange(p, ch, conf, mesh):
-    from ..exec.exchange import EXCHANGE_CHUNK_ROWS, TpuShuffleExchangeExec
+    from ..exec.exchange import (EXCHANGE_CHUNK_ROWS, SHUFFLE_MODE,
+                                 TpuLocalExchangeExec, TpuShuffleExchangeExec)
+    mode = conf.get(SHUFFLE_MODE)
+    if mode == "local" or mesh is None:
+        return TpuLocalExchangeExec(ch[0], p.partitioning,
+                                    conf.min_bucket_rows)
     return TpuShuffleExchangeExec(ch[0], p.partitioning, mesh,
                                   conf.min_bucket_rows,
                                   chunk_rows=conf.get(EXCHANGE_CHUNK_ROWS))

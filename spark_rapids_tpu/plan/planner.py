@@ -104,9 +104,17 @@ def _plan(node: LogicalPlan, conf: RapidsConf,
         return CpuScanExec(node.source, cols)
 
     if isinstance(node, LogicalProject):
-        refs = _refs(e for e in node.exprs)
+        exprs = list(node.exprs)
+        if required is not None:
+            # column pruning through pass-through projections (the
+            # with_column DataFrame idiom projects every input column):
+            # outputs nobody above needs are dropped, which narrows joins,
+            # exchanges and scans below (Spark's ColumnPruning rule)
+            kept = [e for e in exprs if e.name in required]
+            exprs = kept or exprs[:1]  # count(*)-style: keep one column
+        refs = _refs(e for e in exprs)
         child = _plan(node.child, conf, refs)
-        return CpuProjectExec(child, node.exprs, [e.name for e in node.exprs])
+        return CpuProjectExec(child, exprs, [e.name for e in exprs])
 
     if isinstance(node, LogicalFilter):
         child_req = None if required is None \

@@ -21,6 +21,7 @@ def _has_node(plan, cls_name: str) -> bool:
         sub = getattr(plan, attr, None)
         if sub is not None:
             kids.append(sub)
+    kids.extend(getattr(plan, "chain", ()))  # whole-stage fused nodes
     return any(_has_node(c, cls_name) for c in kids)
 
 
