@@ -228,6 +228,14 @@ class DeviceTable:
                 out = _decode_list_matrix(data, lengths, c.dtype)
                 cols.append(HostColumn(c.dtype, out,
                                        None if validity.all() else validity))
+            elif dt.is_d128(c.dtype):
+                from ..expr.decimal128 import limbs_to_py_ints
+                limbs = np.asarray(c.data)[mask][:n]
+                # hi limb is signed: the composition is already the signed
+                # 128-bit value
+                vals = limbs_to_py_ints(limbs)
+                cols.append(HostColumn(c.dtype, vals,
+                                       None if validity.all() else validity))
             else:
                 vals = np.asarray(c.data)[mask][:n]
                 if isinstance(c.dtype, dt.BooleanType):
@@ -385,6 +393,12 @@ def _upload_column(hc: HostColumn, capacity: int) -> DeviceColumn:
         mat, lengths = _encode_list_matrix(hc, capacity)
         return DeviceColumn(jnp.asarray(mat), jnp.asarray(validity), hc.dtype,
                             jnp.asarray(lengths))
+    if dt.is_d128(hc.dtype):
+        # wide decimals: host object ints -> (capacity, 2) int64 limbs
+        from ..expr.decimal128 import limbs_from_py_ints
+        limbs = limbs_from_py_ints(hc.values, capacity)
+        return DeviceColumn(jnp.asarray(limbs), jnp.asarray(validity),
+                            hc.dtype, None)
     np_dt = hc.dtype.np_dtype()
     vals = np.zeros(capacity, dtype=np_dt)
     vals[:n] = hc.values.astype(np_dt, copy=False)

@@ -174,6 +174,7 @@ class DecimalType(DataType):
     scale: int = 0
 
     MAX_INT64_PRECISION = 18
+    MAX_PRECISION_128 = 38
 
     def __post_init__(self):
         if not (1 <= self.precision <= 38):
@@ -190,6 +191,13 @@ class DecimalType(DataType):
 
     def __repr__(self):
         return self.simple_name
+
+
+def is_d128(t: DataType) -> bool:
+    """True for decimals stored as two-limb int64 columns on device
+    (precision beyond the scaled-int64 tier)."""
+    return isinstance(t, DecimalType) \
+        and t.precision > DecimalType.MAX_INT64_PRECISION
 
 
 @dataclasses.dataclass(frozen=True, eq=True)
@@ -362,6 +370,13 @@ class TypeSig:
         notes = {k: v for k, v in self._notes.items() if k not in other._types}
         return TypeSig(self._types - other._types, notes,
                        self._max_decimal_precision, self._child_sig,
+                       self._array_no_inner_nulls)
+
+    def with_decimal128(self) -> "TypeSig":
+        """Raise the decimal gate to 38 digits (the DECIMAL_128 tier,
+        reference TypeChecks.scala:465): applied per-rule to the ops whose
+        device kernels handle two-limb columns (expr/decimal128.py)."""
+        return TypeSig(self._types, self._notes, 38, self._child_sig,
                        self._array_no_inner_nulls)
 
     def with_ps_note(self, type_enum: str, note: str) -> "TypeSig":

@@ -66,6 +66,9 @@ def _key_code_words(kc) -> "Tuple[List[jax.Array], Optional[jax.Array]]":
     from ..columnar.device import pack_string_key_words
     if isinstance(kc.dtype, (dt.StringType, dt.BinaryType)):
         return pack_string_key_words(kc.data, kc.lengths), None
+    if dt.is_d128(kc.dtype):
+        from ..expr.decimal128 import d128_key_words
+        return d128_key_words(kc.data), None
     v = _normalize_float_key(kc.data)
     if jnp.issubdtype(v.dtype, jnp.floating):
         nan = jnp.isnan(v)
@@ -84,12 +87,29 @@ def _keys_equal_prev(sv: jax.Array) -> jax.Array:
 
 def _reduce_segment(op: str, vals: jax.Array, contrib: jax.Array,
                     gid: jax.Array, cap: int, pos: jax.Array,
-                    out_dtype) -> Tuple[jax.Array, jax.Array]:
+                    out_dt: dt.DataType) -> Tuple[jax.Array, jax.Array]:
     """Per-group reduction -> (values[cap], validity[cap])."""
+    out_dtype = jnp.dtype(np.bool_ if isinstance(out_dt, dt.BooleanType)
+                          else out_dt.np_dtype())
     counts = jax.ops.segment_sum(contrib.astype(jnp.int64), gid, num_segments=cap)
     has = counts > 0
     if op == "count":
         return counts.astype(out_dtype), jnp.ones(cap, dtype=bool)
+    if dt.is_d128(out_dt):
+        from ..expr.decimal128 import d128_from_i64, d128_segment_sum
+        if op == "sum":
+            limbs = vals if vals.ndim == 2 else d128_from_i64(vals)
+            out, over = d128_segment_sum(limbs, contrib, gid, cap,
+                                         out_dt.precision)
+            return out, jnp.logical_and(has, jnp.logical_not(over))
+        if op in ("first", "last"):
+            p = jnp.where(contrib, -pos if op == "last" else pos,
+                          jnp.full_like(pos, _BIG))
+            best = jax.ops.segment_min(p, gid, num_segments=cap)
+            idx = -best if op == "last" else best
+            idx = jnp.clip(idx, 0, vals.shape[0] - 1).astype(jnp.int32)
+            return jnp.take(vals, idx, axis=0), has
+        raise TypeError(f"decimal128 aggregate op {op!r} is host-only")
     if op in ("sum", "sumsq"):
         x = vals.astype(out_dtype)
         if op == "sumsq":
@@ -383,9 +403,9 @@ class TpuHashAggregateExec(TpuExec):
                         DeviceColumn(data, validity, out_dt, lens))
                     continue
                 vals1, has1 = _reduce_segment(
-                    op, col.data, contrib, gid, 1, pos,
-                    jnp.dtype(out_dt.np_dtype()))
-                vals = jnp.zeros(cap_out, dtype=vals1.dtype).at[0].set(vals1[0])
+                    op, col.data, contrib, gid, 1, pos, out_dt)
+                vals = jnp.zeros((cap_out,) + vals1.shape[1:],
+                                 dtype=vals1.dtype).at[0].set(vals1[0])
                 validity = jnp.zeros(cap_out, dtype=bool).at[0].set(has1[0])
                 out_cols.append(DeviceColumn(vals, validity, out_dt, None))
             iota = jnp.arange(cap_out, dtype=jnp.int32)
@@ -430,7 +450,7 @@ class TpuHashAggregateExec(TpuExec):
                         DeviceColumn(data, group_mask, out_dt, lens))
                     continue
                 vals, has = _reduce_segment(op, sv, contrib, gid, cap, pos,
-                                            jnp.dtype(out_dt.np_dtype()))
+                                            out_dt)
                 validity = jnp.logical_and(has, group_mask) if op != "count" \
                     else group_mask
                 out_cols.append(DeviceColumn(vals, validity, out_dt, None))
@@ -631,6 +651,9 @@ def _empty_device_table(schema: Schema, cap: int) -> DeviceTable:
         if isinstance(f.dtype, (dt.StringType, dt.BinaryType)):
             data = jnp.zeros((cap, 8), dtype=jnp.uint8)
             lengths = jnp.zeros(cap, dtype=jnp.int32)
+        elif dt.is_d128(f.dtype):
+            data = jnp.zeros((cap, 2), dtype=jnp.int64)
+            lengths = None
         else:
             data = jnp.zeros(cap, dtype=f.dtype.np_dtype())
             lengths = None

@@ -78,6 +78,9 @@ def _column_code_arrays(col: DeviceColumn) -> List[jax.Array]:
     v = col.data
     if col.is_string_like:
         return pack_string_key_words(v, col.lengths)
+    if dt.is_d128(col.dtype):
+        from ..expr.decimal128 import d128_key_words
+        return d128_key_words(v)
     if jnp.issubdtype(v.dtype, jnp.floating):
         nan = jnp.isnan(v)
         v = jnp.where(v == 0, jnp.zeros_like(v), v)
@@ -179,6 +182,9 @@ def _null_device_column(dtype: dt.DataType, capacity: int) -> DeviceColumn:
             jnp.zeros((capacity, bucket_width(1)), dtype=jnp.uint8),
             jnp.zeros(capacity, dtype=bool), dtype,
             jnp.zeros(capacity, dtype=jnp.int32))
+    if dt.is_d128(dtype):
+        return DeviceColumn(jnp.zeros((capacity, 2), dtype=jnp.int64),
+                            jnp.zeros(capacity, dtype=bool), dtype, None)
     np_dt = dtype.np_dtype()
     return DeviceColumn(jnp.zeros(capacity, dtype=np_dt),
                         jnp.zeros(capacity, dtype=bool), dtype, None)
@@ -601,7 +607,7 @@ class TpuShuffledHashJoinExec(TpuExec):
         lt = self.left.schema.field(self.left_keys[0]).dtype
         rt = self.right.schema.field(self.right_keys[0]).dtype
         bad = (dt.StringType, dt.BinaryType, dt.ArrayType)
-        return lt == rt and not isinstance(lt, bad)
+        return lt == rt and not isinstance(lt, bad) and not dt.is_d128(lt)
 
     def _counts_fn(self, track: bool = False):
         """Shared count kernel over key views -> (b_order, starts, counts,

@@ -53,6 +53,13 @@ def _order_keys(table: DeviceTable, orders: Sequence[SortOrder]) -> List[jax.Arr
                 nan_key = jnp.logical_not(nan)
             keys.append(nan_key)
             keys.append(v)
+        elif dt.is_d128(c.dtype):  # two-limb decimal: biased uint64 words
+            from ..expr.decimal128 import d128_key_words
+            words = d128_key_words(v)
+            if not o.ascending:  # bit inversion reverses unsigned order
+                words = [~w for w in words]
+            for wd in reversed(words):
+                keys.append(wd)
         elif v.ndim == 2:  # string/binary: packed uint64 surrogate words
             from ..columnar.device import pack_string_key_words
             words = pack_string_key_words(v, c.lengths)

@@ -59,9 +59,10 @@ class AggregateFunction(Expression):
 
 def _sum_result_type(t: dt.DataType) -> dt.DataType:
     if isinstance(t, dt.DecimalType):
-        cap = dt.DecimalType.MAX_INT64_PRECISION \
-            if t.precision <= dt.DecimalType.MAX_INT64_PRECISION else 38
-        return dt.DecimalType(min(t.precision + 10, cap), t.scale)
+        # Spark: sum(decimal(p,s)) = decimal(min(p+10, 38), s); crossing
+        # 18 digits moves the state to the two-limb device representation
+        return dt.DecimalType(
+            min(t.precision + 10, dt.DecimalType.MAX_PRECISION_128), t.scale)
     if isinstance(t, (dt.FloatType, dt.DoubleType)):
         return dt.DOUBLE
     return dt.LONG

@@ -7,6 +7,8 @@ from __future__ import annotations
 
 from typing import Optional
 
+import numpy as np
+
 from ..columnar import dtypes as dt
 from .base import EvalCol, EvalContext, Expression
 from .cast import Cast
@@ -18,23 +20,29 @@ __all__ = ["BinaryArithmetic", "Add", "Subtract", "Multiply", "Divide",
 _NUMERIC_ORDER = [dt.BYTE, dt.SHORT, dt.INT, dt.LONG, dt.FLOAT, dt.DOUBLE]
 
 
+def adjust_decimal(precision: int, scale: int) -> dt.DecimalType:
+    """Spark's DecimalPrecision.adjustPrecisionScale: cap at 38 digits,
+    sacrificing scale down to min(scale, 6) to keep integral digits."""
+    if precision <= dt.DecimalType.MAX_PRECISION_128:
+        return dt.DecimalType(precision, scale)
+    int_digits = precision - scale
+    min_scale = min(scale, 6)
+    adj_scale = max(dt.DecimalType.MAX_PRECISION_128 - int_digits, min_scale)
+    return dt.DecimalType(dt.DecimalType.MAX_PRECISION_128, adj_scale)
+
+
 def numeric_promote(a: dt.DataType, b: dt.DataType) -> dt.DataType:
     """Least common numeric type (Spark's binary arithmetic coercion)."""
     if a == b:
         return a
     if isinstance(a, dt.DecimalType) or isinstance(b, dt.DecimalType):
-        # simplified: decimal op decimal/int -> widest decimal; decimal op fp -> double
         if isinstance(a, dt.DecimalType) and isinstance(b, dt.DecimalType):
+            # Spark add/sub rule: s = max(s1,s2),
+            # p = max(p1-s1, p2-s2) + s + 1, adjusted to the 38 cap
             scale = max(a.scale, b.scale)
-            # inputs within the device int64 tier keep the 18-digit cap
-            # (device placement unchanged); wider inputs may grow to 38
-            # (host object-int arithmetic, exact)
-            cap = dt.DecimalType.MAX_INT64_PRECISION \
-                if max(a.precision, b.precision) <= \
-                dt.DecimalType.MAX_INT64_PRECISION else 38
-            prec = min(max(a.precision - a.scale, b.precision - b.scale)
-                       + scale + 1, cap)
-            return dt.DecimalType(prec, scale)
+            prec = max(a.precision - a.scale, b.precision - b.scale) \
+                + scale + 1
+            return adjust_decimal(prec, scale)
         other = b if isinstance(a, dt.DecimalType) else a
         if other in (dt.FLOAT, dt.DOUBLE):
             return dt.DOUBLE
@@ -108,10 +116,32 @@ class BinaryArithmetic(Expression):
         return f"({self.left!r} {self.symbol} {self.right!r})"
 
 
+def _obj_array(py):
+    out = np.empty(len(py), dtype=object)
+    out[:] = py
+    return out
+
+
+def _d128_addsub(ctx, lv, rv, out: dt.DecimalType, sub: bool):
+    """Two-limb add/sub with overflow->null (operands pre-cast to ``out``
+    by coerce; |a|,|b| < 10^38 keeps the 128-bit sum wrap-free)."""
+    if ctx.is_device:
+        from .decimal128 import d128_add, d128_overflows, d128_sub
+        s = d128_sub(lv, rv) if sub else d128_add(lv, rv)
+        return s, d128_overflows(s, out.precision)
+    py = [int(a) - int(b) if sub else int(a) + int(b)
+          for a, b in zip(lv, rv)]
+    over = np.array([abs(v) >= 10 ** out.precision for v in py], dtype=bool)
+    return _obj_array(py), over
+
+
 class Add(BinaryArithmetic):
     symbol = "+"
 
     def _compute(self, ctx, lv, rv):
+        out = self.data_type
+        if dt.is_d128(out):
+            return _d128_addsub(ctx, lv, rv, out, sub=False)
         return lv + rv, None
 
 
@@ -119,13 +149,63 @@ class Subtract(BinaryArithmetic):
     symbol = "-"
 
     def _compute(self, ctx, lv, rv):
+        out = self.data_type
+        if dt.is_d128(out):
+            return _d128_addsub(ctx, lv, rv, out, sub=True)
         return lv - rv, None
 
 
 class Multiply(BinaryArithmetic):
     symbol = "*"
 
+    def result_type(self, lt, rt):
+        if isinstance(lt, dt.DecimalType) and isinstance(rt, dt.DecimalType):
+            # Spark multiply rule: p = p1 + p2 + 1, s = s1 + s2, adjusted
+            return adjust_decimal(lt.precision + rt.precision + 1,
+                                  lt.scale + rt.scale)
+        return numeric_promote(lt, rt)
+
+    def coerce(self) -> "Expression":
+        lt, rt = self.left.data_type, self.right.data_type
+        if isinstance(lt, dt.DecimalType) and isinstance(rt, dt.DecimalType):
+            # decimal multiply keeps its operands at their own scales (the
+            # product's scale is s1+s2 naturally); casting them to the
+            # output scale first — the generic coerce — would square the
+            # scale into the product
+            node = type(self)(self.left, self.right)
+            node._out_type = self.result_type(lt, rt)
+            return node
+        return super().coerce()
+
     def _compute(self, ctx, lv, rv):
+        out = self.data_type
+        lt, rt = self.left.data_type, self.right.data_type
+        if isinstance(out, dt.DecimalType) and isinstance(lt, dt.DecimalType) \
+                and isinstance(rt, dt.DecimalType):
+            drop = lt.scale + rt.scale - out.scale
+            if ctx.is_device:
+                if not (dt.is_d128(out) or dt.is_d128(lt) or dt.is_d128(rt)) \
+                        and lt.precision + rt.precision <= 18:
+                    return lv * rv, None    # product < 10^18: exact int64
+                from .decimal128 import (d128_from_i64, d128_mul_rescaled,
+                                         d128_to_i64)
+                la = lv if dt.is_d128(lt) else d128_from_i64(lv)
+                ra = rv if dt.is_d128(rt) else d128_from_i64(rv)
+                limbs, over = d128_mul_rescaled(la, ra, max(drop, 0),
+                                                out.precision)
+                if dt.is_d128(out):
+                    return limbs, over
+                v64, over2 = d128_to_i64(limbs)
+                return v64, ctx.xp.logical_or(over, over2)
+            from .cast import _rescale_py_half_up
+            py = [_rescale_py_half_up(int(a) * int(b), max(drop, 0), 0)
+                  for a, b in zip(lv, rv)]
+            over = np.array([abs(v) >= 10 ** out.precision for v in py],
+                            dtype=bool)
+            if dt.is_d128(out):
+                return _obj_array(py), over
+            return np.array([0 if o else v for v, o in zip(py, over)],
+                            dtype=np.int64), over
         return lv * rv, None
 
 
@@ -200,6 +280,12 @@ class UnaryMinus(Expression):
 
     def eval(self, ctx: EvalContext) -> EvalCol:
         c = self.child.eval(ctx)
+        if dt.is_d128(self.data_type):
+            if ctx.is_device:
+                from .decimal128 import d128_neg
+                return EvalCol(d128_neg(c.values), c.validity, self.data_type)
+            return EvalCol(_obj_array([-int(v) for v in c.values]),
+                           c.validity, self.data_type)
         return EvalCol(-c.values, c.validity, self.data_type)
 
 
@@ -214,6 +300,12 @@ class Abs(Expression):
 
     def eval(self, ctx: EvalContext) -> EvalCol:
         c = self.child.eval(ctx)
+        if dt.is_d128(self.data_type):
+            if ctx.is_device:
+                from .decimal128 import d128_abs
+                return EvalCol(d128_abs(c.values), c.validity, self.data_type)
+            return EvalCol(_obj_array([abs(int(v)) for v in c.values]),
+                           c.validity, self.data_type)
         return EvalCol(ctx.xp.abs(c.values), c.validity, self.data_type)
 
 

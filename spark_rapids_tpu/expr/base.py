@@ -219,6 +219,9 @@ class Literal(Expression):
                                    xp.zeros(n, dtype=xp.int32))
                 values = np.empty(n, dtype=object)
                 return EvalCol(values, np.zeros(n, dtype=bool), self._dtype)
+            if dt.is_d128(self._dtype) and ctx.is_device:
+                return EvalCol(xp.zeros((n, 2), dtype=xp.int64),
+                               xp.zeros(n, dtype=bool), self._dtype)
             values = xp.zeros(n, dtype=self._dtype.np_dtype())
             return EvalCol(values, xp.zeros(n, dtype=bool), self._dtype)
         if isinstance(self._dtype, (dt.StringType, dt.BinaryType)):
@@ -250,6 +253,11 @@ class Literal(Expression):
             # scaled-integer representation, matching decimal columns
             v = int(v.scaleb(self._dtype.scale))
             if self._dtype.precision > dt.DecimalType.MAX_INT64_PRECISION:
+                if ctx.is_device:
+                    from .decimal128 import limbs_from_py_ints
+                    limb = limbs_from_py_ints([v], 1)
+                    arr = xp.broadcast_to(xp.asarray(limb), (n, 2))
+                    return EvalCol(arr, None, self._dtype)
                 values = np.empty(n, dtype=object)
                 values[:] = v
                 return EvalCol(values, None, self._dtype)

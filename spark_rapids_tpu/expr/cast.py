@@ -93,28 +93,65 @@ class Cast(Expression):
                 return EvalCol(out.astype(np_to), c.validity, to)
             return EvalCol(c.values.astype(to.np_dtype()), c.validity, to)
         if isinstance(src, dt.DecimalType) and not isinstance(to, dt.DecimalType):
-            scaled = c.values.astype(xp.float64) / (10.0 ** src.scale)
+            vals = c.values
+            if dt.is_d128(src):
+                if ctx.is_device:
+                    from .decimal128 import d128_to_f64
+                    fvals = d128_to_f64(vals)
+                else:
+                    fvals = np.asarray([float(int(v)) for v in vals],
+                                       dtype=np.float64)
+            else:
+                fvals = vals.astype(xp.float64)
+            scaled = fvals / (10.0 ** src.scale)
             if to in (dt.FLOAT, dt.DOUBLE):
                 return EvalCol(scaled.astype(to.np_dtype()), c.validity, to)
             return EvalCol(xp.trunc(scaled).astype(to.np_dtype()), c.validity, to)
         if isinstance(to, dt.DecimalType) and not isinstance(src, dt.DecimalType):
             scale_f = 10.0 ** to.scale
+            if dt.is_d128(to):
+                if ctx.is_device:
+                    from .decimal128 import (d128_from_f64, d128_from_i64,
+                                             d128_overflows, d128_rescale)
+                    if src in (dt.FLOAT, dt.DOUBLE):
+                        limbs, over = d128_from_f64(
+                            xp.round(c.values.astype(xp.float64) * scale_f))
+                    else:
+                        limbs, over = d128_rescale(
+                            d128_from_i64(c.values.astype(xp.int64)),
+                            0, to.scale, to.precision)
+                    over = xp.logical_or(over,
+                                         d128_overflows(limbs, to.precision))
+                    return EvalCol(limbs, _and_valid(ctx, c.validity,
+                                                     xp.logical_not(over)), to)
+                # host: exact ints; non-finite floats and values beyond
+                # the precision -> null (matches the device overflow flag)
+                import math as _math
+                py = []
+                bad = []
+                for v in c.values:
+                    if src in (dt.FLOAT, dt.DOUBLE):
+                        f = float(v)
+                        if not _math.isfinite(f):
+                            py.append(0)
+                            bad.append(True)
+                            continue
+                        u = int(round(f * scale_f))
+                    else:
+                        u = int(v) * 10 ** to.scale
+                    py.append(u)
+                    bad.append(abs(u) >= 10 ** to.precision)
+                vals = np.empty(len(py), dtype=object)
+                vals[:] = py
+                ok = np.logical_not(np.array(bad, dtype=bool))
+                return EvalCol(vals, _and_valid(ctx, c.validity, ok), to)
             if src in (dt.FLOAT, dt.DOUBLE):
                 v = xp.round(c.values.astype(xp.float64) * scale_f).astype(xp.int64)
             else:
                 v = c.values.astype(xp.int64) * int(scale_f)
             return EvalCol(v, c.validity, to)
         if isinstance(src, dt.DecimalType) and isinstance(to, dt.DecimalType):
-            wide = max(src.precision, to.precision) \
-                > dt.DecimalType.MAX_INT64_PRECISION
-            vals = c.values if wide else c.values.astype(xp.int64)
-            # wide decimals are host-only object arrays of exact python
-            # ints: keep object dtype (int64 would overflow)
-            if to.scale >= src.scale:
-                v = vals * (10 ** (to.scale - src.scale))
-            else:
-                v = vals // (10 ** (src.scale - to.scale))
-            return EvalCol(v, c.validity, to)
+            return _cast_decimal_decimal(ctx, c, src, to)
         if isinstance(src, dt.DateType) and to.is_numeric:
             # days-since-epoch as integer (engine-internal; Spark exposes
             # datediff/unix_date for this)
@@ -227,6 +264,77 @@ class Cast(Expression):
 _WS = " \t\n\r\f\v"
 _TRUE_TOKENS = frozenset(("true", "t", "yes", "y", "1"))
 _FALSE_TOKENS = frozenset(("false", "f", "no", "n", "0"))
+
+
+def _and_valid(ctx, validity, extra):
+    if validity is None:
+        return extra
+    return ctx.xp.logical_and(validity, extra)
+
+
+def _rescale_py_half_up(v: int, from_s: int, to_s: int) -> int:
+    """Exact python-int rescale with BigDecimal HALF_UP rounding."""
+    if to_s >= from_s:
+        return v * 10 ** (to_s - from_s)
+    f = 10 ** (from_s - to_s)
+    q, r = divmod(abs(v), f)
+    if 2 * r >= f:
+        q += 1
+    return -q if v < 0 else q
+
+
+def _cast_decimal_decimal(ctx, c, src: dt.DecimalType,
+                          to: dt.DecimalType) -> EvalCol:
+    """decimal -> decimal: exact rescale, HALF_UP on scale reduction,
+    overflow -> null (Spark non-ANSI CheckOverflow; GpuCast.scala:1513).
+    Crossing the 18-digit boundary switches between scaled-int64 and
+    two-limb storage (expr/decimal128.py)."""
+    xp = ctx.xp
+    src128, to128 = dt.is_d128(src), dt.is_d128(to)
+    if ctx.is_device:
+        from .decimal128 import d128_from_i64, d128_rescale, d128_to_i64
+        if not src128 and not to128:
+            vals = c.values.astype(xp.int64)
+            bound = 10 ** to.precision          # p <= 18: fits int64
+            if to.scale >= src.scale:
+                f = 10 ** (to.scale - src.scale)
+                # overflow test BEFORE the multiply (the product could
+                # wrap int64 silently)
+                over = xp.abs(vals) >= (bound + f - 1) // f
+                v = vals * f
+            else:
+                f = 10 ** (src.scale - to.scale)
+                av = xp.abs(vals)
+                q = av // f
+                r = av - q * f
+                q = q + (2 * r >= f)
+                v = xp.where(vals < 0, -q, q)
+                over = xp.abs(v) >= bound
+            return EvalCol(v, _and_valid(ctx, c.validity,
+                                         xp.logical_not(over)), to)
+        limbs = c.values if src128 \
+            else d128_from_i64(c.values.astype(xp.int64))
+        out_limbs, over = d128_rescale(limbs, src.scale, to.scale,
+                                       to.precision)
+        if to128:
+            return EvalCol(out_limbs, _and_valid(ctx, c.validity,
+                                                 xp.logical_not(over)), to)
+        v64, over2 = d128_to_i64(out_limbs)
+        over = xp.logical_or(over, over2)
+        return EvalCol(v64, _and_valid(ctx, c.validity,
+                                       xp.logical_not(over)), to)
+    # host engine: exact python-int arithmetic (object arrays when wide)
+    py = [_rescale_py_half_up(int(v), src.scale, to.scale)
+          for v in c.values]
+    over = np.array([abs(v) >= 10 ** to.precision for v in py], dtype=bool)
+    if to128:
+        vals = np.empty(len(py), dtype=object)
+        vals[:] = py
+    else:
+        vals = np.array([0 if o else v for v, o in zip(py, over)],
+                        dtype=np.int64)
+    return EvalCol(vals, _and_valid(ctx, c.validity, np.logical_not(over)),
+                   to)
 
 
 def _format_decimal(unscaled: int, scale: int) -> str:

@@ -74,6 +74,10 @@ class BinaryComparison(Expression):
         if ctx.is_device and isinstance(l.dtype, (dt.StringType, dt.BinaryType)):
             eq, lt_ = _device_string_cmp(ctx, l.values, r.values)
             values = self._from_eq_lt(ctx, eq, lt_)
+        elif ctx.is_device and dt.is_d128(l.dtype):
+            from .decimal128 import d128_eq, d128_lt
+            values = self._from_eq_lt(ctx, d128_eq(l.values, r.values),
+                                      d128_lt(l.values, r.values))
         else:
             values = self._compute(ctx, l.values, r.values)
         return EvalCol(values, validity, dt.BOOLEAN)

@@ -796,37 +796,165 @@ def compile_group_plan(pattern: str) -> Optional[GroupPlan]:
     return GroupPlan(items, groups, parser.ngroups)
 
 
-def extract_group_span(xp, values, lengths, ends, plan: GroupPlan,
-                       gidx: int):
-    """Extract capture group ``gidx`` of the leftmost match per row.
-    -> (out (n, w) uint8, out_lengths). No match -> ''."""
+def parse_replacement_template(repl: str, ngroups: int):
+    """Java Matcher.appendReplacement template -> segment list
+    [('lit', bytes) | ('grp', int)], or None if un-parsable.
+
+    ``$`` followed by digits is a group reference (digits consumed
+    greedily while the number still names an existing group, Java
+    semantics); ``\\`` escapes the next character (``\\$`` is a literal
+    dollar). Group 0 is the whole match. (reference:
+    GpuRegExpReplace with group refs, stringFunctions.scala:895.)"""
+    segs = []
+    lit = bytearray()
+    i = 0
+    while i < len(repl):
+        ch = repl[i]
+        if ch == "\\":
+            if i + 1 >= len(repl):
+                return None
+            lit += repl[i + 1].encode()
+            i += 2
+            continue
+        if ch == "$":
+            j = i + 1
+            if j >= len(repl) or not repl[j].isdigit():
+                return None               # bare $: Java throws
+            g = 0
+            k = j
+            while k < len(repl) and repl[k].isdigit():
+                cand = g * 10 + int(repl[k])
+                if cand > ngroups and k > j:
+                    break
+                if cand > ngroups:
+                    return None           # first digit already invalid
+                g = cand
+                k += 1
+            if lit:
+                segs.append(("lit", bytes(lit)))
+                lit = bytearray()
+            segs.append(("grp", g))
+            i = k
+            continue
+        lit += ch.encode()
+        i += 1
+    if lit:
+        segs.append(("lit", bytes(lit)))
+    return segs
+
+
+def _greedy_walk_bounds(xp, values, lengths, plan: GroupPlan, pos):
+    """Vectorized greedy item walk from start positions ``pos`` (n, k).
+    Returns the bounds list: bounds[i] is the position after item i-1.
+    The ONE implementation of the deterministic greedy consumption —
+    extract_group_span (k=1) and the all-starts replace path (k=w) both
+    run through it."""
     from jax import lax
     n, w = values.shape
-    valid = ends >= 0
-    found = xp.any(valid, axis=1)
-    start = xp.argmax(valid, axis=1).astype(xp.int32)
-    pos = start
     idxs = xp.arange(w, dtype=xp.int32)
-    bounds: List = [pos]                 # pos after item k at bounds[k+1]
     vi = values.astype(xp.int32)
     in_str = idxs[None, :] < lengths[:, None]
+    bounds = [pos]
     for cs, lo, hi in plan.items:
         lut = np.zeros(256, dtype=bool)
         lut[list(cs)] = True
         member = xp.logical_and(xp.asarray(lut)[vi], in_str)
-        # next non-member position at or after j (suffix min of bad indices)
         bad_at = xp.where(member, w, idxs[None, :])
         nb = lax.associative_scan(xp.minimum, bad_at[:, ::-1],
                                   axis=1)[:, ::-1]
-        next_bad = xp.take_along_axis(
-            nb, xp.clip(pos, 0, w - 1)[:, None], axis=1)[:, 0]
+        next_bad = xp.take_along_axis(nb, xp.clip(pos, 0, w - 1), axis=1)
         avail = xp.maximum(next_bad - pos, 0)
         take = avail if hi is None else xp.minimum(avail, hi)
         pos = (pos + take).astype(xp.int32)
         bounds.append(pos)
+    return bounds
+
+
+def group_bounds_all_starts(xp, values, lengths, plan: GroupPlan):
+    """Greedy-walk group bounds for EVERY potential match start j.
+    -> {g: (GS, GE)} with (n, w) int32 matrices: the bounds of group g
+    for a match beginning at column j. Only meaningful where the NFA
+    reported a match at j (same deterministic-subset contract as
+    extract_group_span)."""
+    n, w = values.shape
+    idxs = xp.arange(w, dtype=xp.int32)
+    pos = xp.broadcast_to(idxs[None, :], (n, w))
+    bounds = _greedy_walk_bounds(xp, values, lengths, plan, pos)
+    return {g: (bounds[lo_i], bounds[hi_i])
+            for g, (lo_i, hi_i) in plan.groups.items()}
+
+
+def replace_by_template(xp, values, lengths, start_mask, in_match, ends,
+                        segments, group_bounds, out_w: int):
+    """replace_by_spans generalized to a segment template: literals are
+    emitted verbatim, group segments copy that match's captured span from
+    the input. -> (out (n, out_w) uint8, out_lengths)."""
+    from jax import lax
+    n, w = values.shape
+    rows = xp.arange(n)
+    pos = xp.arange(w, dtype=xp.int32)
+    in_str = pos[None, :] < lengths[:, None]
+
+    def emit_group(out, cursor, start, gs, ge):
+        glen = xp.where(start, xp.maximum(ge - gs, 0), 0)
+
+        def body(k, out_):
+            src = xp.clip(gs + k, 0, w - 1)
+            byte = values[rows, src]
+            idx = xp.clip(cursor + k, 0, out_w - 1)
+            keep = xp.logical_and(start, k < glen)
+            return out_.at[rows, idx].set(
+                xp.where(keep, byte, out_[rows, idx]))
+        out = lax.fori_loop(0, w, body, out)
+        return out, cursor + glen
+
+    def step(carry, j):
+        out, cursor = carry
+        start = start_mask[:, j]
+        for kind, payload in segments:
+            if kind == "lit":
+                for k in range(len(payload)):
+                    idx = xp.clip(cursor + k, 0, out_w - 1)
+                    byte = xp.where(start, xp.uint8(payload[k]),
+                                    out[rows, idx])
+                    out = out.at[rows, idx].set(byte)
+                cursor = xp.where(start, cursor + len(payload), cursor)
+            else:
+                g = payload
+                if g == 0:                 # whole match: [j, ends[:, j])
+                    gs = xp.broadcast_to(j, (n,)).astype(xp.int32)
+                    ge = xp.maximum(ends[:, j], 0)
+                else:
+                    gs = group_bounds[g][0][:, j]
+                    ge = group_bounds[g][1][:, j]
+                out, cursor = emit_group(out, cursor, start, gs, ge)
+        copy = xp.logical_and(in_str[:, j],
+                              xp.logical_not(in_match[:, j]))
+        idx = xp.clip(cursor, 0, out_w - 1)
+        byte = xp.where(copy, values[:, j], out[rows, idx])
+        out = out.at[rows, idx].set(byte)
+        cursor = xp.where(copy, cursor + 1, cursor)
+        return (out, cursor), None
+
+    init = (xp.zeros((n, out_w), dtype=xp.uint8),
+            xp.zeros(n, dtype=xp.int32))
+    (out, cursor), _ = lax.scan(step, init, pos)
+    return out, cursor
+
+
+def extract_group_span(xp, values, lengths, ends, plan: GroupPlan,
+                       gidx: int):
+    """Extract capture group ``gidx`` of the leftmost match per row.
+    -> (out (n, w) uint8, out_lengths). No match -> ''."""
+    n, w = values.shape
+    valid = ends >= 0
+    found = xp.any(valid, axis=1)
+    start = xp.argmax(valid, axis=1).astype(xp.int32)
+    bounds = _greedy_walk_bounds(xp, values, lengths, plan,
+                                 start[:, None])
     lo_i, hi_i = plan.groups[gidx]
-    gs = bounds[lo_i]
-    ge = bounds[hi_i]
+    gs = bounds[lo_i][:, 0]
+    ge = bounds[hi_i][:, 0]
     out_len = xp.where(found, xp.maximum(ge - gs, 0), 0).astype(xp.int32)
     k = xp.arange(w, dtype=xp.int32)
     src = xp.clip(gs[:, None] + k[None, :], 0, w - 1)

@@ -1072,17 +1072,88 @@ class RegExpReplace(Expression):
         c = self.child.eval(ctx)
         if ctx.is_device:
             repl = literal_value(self.replacement)
-            if repl is None or _re.search(r"\$\d", repl):
-                raise TypeError("device regexp_replace: group references "
-                                "stay on host (tag_fn gates this)")
+            if repl is None:
+                raise TypeError("device regexp_replace: null replacement "
+                                "stays on host (tag_fn gates this)")
+            if _re.search(r"\$\d", repl):
+                # $n group references: template re-emission over the
+                # deterministic group-plan subset (reference:
+                # GpuRegExpReplace, stringFunctions.scala:895)
+                return _device_replace_template(
+                    ctx, c, literal_value(self.pattern), repl)
             return _device_replace_spans(
                 ctx, c, literal_value(self.pattern).encode(), repl.encode(),
                 literal_search=False)
         rx = _re.compile(literal_value(self.pattern))
-        # Java $1 group references -> Python \1
-        rep = _re.sub(r"\$(\d+)", r"\\\1", literal_value(self.replacement))
+        rep = _java_repl_to_python(literal_value(self.replacement))
         out = [rx.sub(rep, s) for s in c.values]
         return EvalCol(np.asarray(out, dtype=object), c.validity, dt.STRING)
+
+
+def _java_repl_to_python(repl: str) -> str:
+    """Java Matcher replacement -> python re template: ``$n`` becomes
+    ``\\n``, ``\\x`` escapes stay literal, lone python-special backslashes
+    get escaped."""
+    out = []
+    i = 0
+    while i < len(repl):
+        ch = repl[i]
+        if ch == "\\" and i + 1 < len(repl):
+            nxt = repl[i + 1]
+            out.append("\\\\" if nxt == "\\" else _re_escape_lit(nxt))
+            i += 2
+            continue
+        if ch == "$" and i + 1 < len(repl) and repl[i + 1].isdigit():
+            j = i + 1
+            while j < len(repl) and repl[j].isdigit():
+                j += 1
+            # \g<n> form: unambiguous for $0 and when digits follow
+            out.append("\\g<" + repl[i + 1:j] + ">")
+            i = j
+            continue
+        out.append("\\\\" if ch == "\\" else ch)
+        i += 1
+    return "".join(out)
+
+
+def _re_escape_lit(ch: str) -> str:
+    return "\\\\" if ch == "\\" else ch
+
+
+def _device_replace_template(ctx, c: EvalCol, pattern: str,
+                             repl: str) -> EvalCol:
+    """Device regexp_replace with ``$n`` group references: NFA match
+    spans + all-starts group-bounds walk + template re-emission."""
+    from ..columnar.device import bucket_width
+    from .regex import (compile_device_nfa, compile_group_plan,
+                        group_bounds_all_starts, parse_replacement_template,
+                        replace_by_template, select_leftmost_spans)
+    xp = ctx.xp
+    nfa = compile_device_nfa(pattern)
+    plan = compile_group_plan(pattern)
+    if nfa is None or not nfa.spans_supported or plan is None:
+        raise TypeError("device regexp_replace with group refs outside the "
+                        "group-plan subset (tag_fn gates this)")
+    segments = parse_replacement_template(repl, plan.ngroups)
+    if segments is None:
+        raise TypeError("device regexp_replace: un-parsable replacement "
+                        "template (tag_fn gates this)")
+    w = c.values.shape[1]
+    ends = nfa.match_ends(xp, c.values, c.lengths)
+    starts, in_match = select_leftmost_spans(xp, ends, c.lengths)
+    bounds = group_bounds_all_starts(xp, c.values, c.lengths, plan)
+    lit_total = sum(len(p) for k, p in segments if k == "lit")
+    n_refs = sum(1 for k, _ in segments if k == "grp")
+    # worst case: every non-match byte copies (<= w), each group ref's
+    # emissions total <= w across all matches ('$1$1' doubles), plus one
+    # literal block per match (<= w // min_len matches)
+    out_w = bucket_width(w * (1 + n_refs)
+                         + (w // max(nfa.min_len, 1)) * lit_total
+                         + lit_total)
+    out, out_len = replace_by_template(xp, c.values, c.lengths, starts,
+                                       in_match, ends, segments, bounds,
+                                       out_w)
+    return EvalCol(out, c.validity, dt.STRING, out_len)
 
 
 def _device_replace_spans(ctx, c: EvalCol, search: bytes, repl: bytes,

@@ -709,7 +709,8 @@ def _register_reader_rules():
 
     sig = (TypeSig.gpuNumeric
            + TypeSig.of(TypeEnum.BOOLEAN, TypeEnum.DATE, TypeEnum.TIMESTAMP,
-                        TypeEnum.NULL, TypeEnum.STRING, TypeEnum.BINARY))
+                        TypeEnum.NULL, TypeEnum.STRING, TypeEnum.BINARY)
+           ).with_decimal128()
 
     class TpuStageReaderExec(TpuExec):
         """Device-resident stage shard reader."""

@@ -143,6 +143,19 @@ def host_group_reduce(op: str, col: HostColumn, gid: np.ndarray, ngroups: int,
         return out, None
 
     if op in ("sum", "sumsq"):
+        if dt.is_d128(out_dtype):
+            # exact python-int accumulation; overflow beyond the result
+            # precision -> null (Spark non-ANSI; matches the device's
+            # d128_segment_sum overflow flag)
+            accs = [0] * ngroups
+            for i in np.nonzero(valid)[0]:
+                v = int(vals[i])
+                accs[gid[i]] += v * v if op == "sumsq" else v
+            out = np.empty(ngroups, dtype=object)
+            out[:] = accs
+            bound = 10 ** out_dtype.precision
+            over = np.array([abs(a) >= bound for a in accs], dtype=bool)
+            return out, np.logical_and(has, np.logical_not(over))
         x = vals[valid]
         if op == "sumsq":
             x = x * x

@@ -286,11 +286,29 @@ def _register_string_rules():
     def tag_regexp_replace(meta, conf):
         import re as _re
         e: S.RegExpReplace = meta.expr
-        if _span_nfa(meta, S.literal_value(e.pattern)) is None:
+        pat = S.literal_value(e.pattern)
+        if _span_nfa(meta, pat) is None:
             return
         repl = S.literal_value(e.replacement)
-        if repl is None or _re.search(r"\$\d", repl):
-            meta.cannot_run("group references in replacement run on host")
+        if repl is None:
+            meta.cannot_run("null replacement runs on host")
+            return
+        if _re.search(r"\$\d", repl):
+            # $n group refs run on device over the deterministic
+            # group-plan subset (reference: GpuRegExpReplace group refs,
+            # stringFunctions.scala:895 + RegexParser.scala:414)
+            from ..expr.regex import (compile_group_plan,
+                                      parse_replacement_template)
+            plan = compile_group_plan(pat)
+            if plan is None:
+                meta.cannot_run(
+                    f"regexp_replace: pattern {pat!r} outside the device "
+                    "capture-group subset (non-deterministic greedy walk)")
+                return
+            if parse_replacement_template(repl, plan.ngroups) is None:
+                meta.cannot_run(
+                    f"replacement {repl!r} is not a valid Java group-ref "
+                    "template for this pattern")
     register_expr_rule(S.RegExpReplace, _string, tag_fn=tag_regexp_replace)
 
     def tag_regexp_extract(meta, conf):
@@ -511,18 +529,22 @@ def _register_exec_rules():
         from ..expr.aggregates import CollectList, CollectSet
         p: CpuHashAggregateExec = meta.plan
         _collect_state = _device_common.with_arrays(_array_elem)
+        # two-limb decimal128 states/keys are device-capable for
+        # sum/count/first/last (expr/decimal128.py; op-level gating in
+        # the decimal128 rule section below)
+        _fixed_state = _device_common.with_decimal128()
         for k in p.key_names:
             kt = p.child.schema.field(k).dtype
             # string keys group via packed uint64 surrogate words
             # (exec/aggregate.py _key_code_words)
-            if not _device_all.is_supported(kt):
+            if not _device_all.with_decimal128().is_supported(kt):
                 meta.cannot_run(f"group-by key {k}: {kt!r} not supported")
         for s in p.specs:
             # collect_list/collect_set produce device list-layout arrays
             # (reference: GpuCollectList/GpuCollectSet,
             # AggregateFunctions.scala); other aggs stay fixed-width
             sig = _collect_state if isinstance(
-                s.fn, (CollectList, CollectSet)) else _device_common
+                s.fn, (CollectList, CollectSet)) else _fixed_state
             for (n, d, _) in s.state_fields:
                 if not sig.is_supported(d):
                     meta.cannot_run(f"aggregate state {n}: {d!r} not supported "
@@ -752,6 +774,162 @@ def _convert_exchange(p, ch, conf, mesh):
 
 _register_expr_rules()
 _register_exec_rules()
+
+
+# ---------------------------------------------------------------------------
+# DECIMAL_128 tier (reference: TypeChecks.scala:465,544 DECIMAL_128 gating,
+# decimalExpressions.scala, GpuCast.scala:1513). Decimals beyond 18 digits
+# run on device as two-limb int64 columns (expr/decimal128.py); the rules
+# below opt specific ops into the 38-digit gate, mirroring how the
+# reference marks each op's TypeSig with DECIMAL_128.
+# ---------------------------------------------------------------------------
+from ..conf import register_conf as _register_conf  # noqa: E402
+
+DECIMAL128_ENABLED = _register_conf(
+    "spark.rapids.sql.decimal128.enabled",
+    "Run DECIMAL(19..38) on the device as two-limb int64 columns "
+    "(add/sub/mul, comparisons, sum/count/first/last aggregates, sort and "
+    "group-by keys, casts). When off, wide decimals fall back to the host "
+    "engine's exact object-int path (reference: the DECIMAL_128 TypeSig "
+    "tier, TypeChecks.scala:465).", True)
+
+
+def _plan_has_d128(meta) -> bool:
+    from ..columnar import dtypes as _dt
+    try:
+        if any(_dt.is_d128(f.dtype) for f in meta.plan.schema):
+            return True
+        return any(_dt.is_d128(f.dtype) for ch in meta.plan.children
+                   for f in ch.schema)
+    except Exception:
+        return False
+
+
+def _expr_has_d128(meta) -> bool:
+    from ..columnar import dtypes as _dt
+    try:
+        if _dt.is_d128(meta.expr.data_type):
+            return True
+        return any(_dt.is_d128(c.data_type) for c in meta.expr.children)
+    except Exception:
+        return False
+
+
+def _upgrade_decimal128_rules():
+    from ..expr.arithmetic import (Abs, Add, BinaryArithmetic, Multiply,
+                                   Subtract, UnaryMinus)
+    from ..expr.base import Alias, AttributeReference, Literal
+    from ..expr.cast import Cast
+    from ..expr.predicates import BinaryComparison, IsNotNull, IsNull
+    from .meta import EXEC_RULES, EXPR_RULES
+
+    def chain_expr(cls, extra=None):
+        rule = EXPR_RULES[cls]
+        rule.sig = rule.sig.with_decimal128()
+        prev = rule.tag_fn
+
+        def tag(meta, conf):
+            if _expr_has_d128(meta):
+                if not conf.get(DECIMAL128_ENABLED):
+                    meta.cannot_run("decimal128 disabled by "
+                                    "spark.rapids.sql.decimal128.enabled")
+                elif extra is not None:
+                    extra(meta, conf)
+            if prev is not None:
+                prev(meta, conf)
+        rule.tag_fn = tag
+
+    def arith_ok(meta, conf):
+        if not isinstance(meta.expr, (Add, Subtract, Multiply)):
+            meta.cannot_run(f"{type(meta.expr).__name__} on decimal128 "
+                            "is host-only")
+
+    def agg_fn_ok(meta, conf):
+        from ..expr import aggregates as A
+        if not isinstance(meta.expr, (A.Sum, A.Count, A.CountStar,
+                                      A.Average, A.First, A.Last)):
+            meta.cannot_run(f"{type(meta.expr).__name__} over decimal128 "
+                            "is host-only")
+
+    def cast_ok(meta, conf):
+        from ..columnar import dtypes as _dt
+        e = meta.expr
+        src = e.children[0].data_type
+        to = e.data_type
+        if isinstance(src, _dt.StringType) and _dt.is_d128(to):
+            meta.cannot_run("string -> decimal128 parses on the host")
+        if _dt.is_d128(src) and isinstance(to, (_dt.StringType,
+                                                _dt.BinaryType)):
+            meta.cannot_run("decimal128 -> string formats on the host")
+
+    from ..expr import aggregates as A
+    from ..expr import arithmetic as AR
+    from ..expr import predicates as P
+    chain_expr(AttributeReference)
+    chain_expr(Alias)
+    chain_expr(Literal)
+    chain_expr(Cast, cast_ok)
+    chain_expr(BinaryArithmetic, arith_ok)  # fallback rule for subclasses
+    for cls in (AR.Add, AR.Subtract, AR.Multiply):
+        chain_expr(cls)
+    chain_expr(UnaryMinus)
+    chain_expr(Abs)
+    chain_expr(BinaryComparison)
+    for cls in (P.EqualTo, P.GreaterThan, P.GreaterThanOrEqual, P.LessThan,
+                P.LessThanOrEqual):
+        chain_expr(cls)
+    chain_expr(IsNull)
+    chain_expr(IsNotNull)
+    for cls in (A.Sum, A.Count, A.CountStar, A.Average, A.First, A.Last):
+        chain_expr(cls, agg_fn_ok)
+
+    def chain_exec(cls, extra=None):
+        rule = EXEC_RULES.get(cls)
+        if rule is None:
+            return
+        rule.output_sig = rule.output_sig.with_decimal128()
+        prev = rule.tag_fn
+
+        def tag(meta, conf):
+            if _plan_has_d128(meta):
+                if not conf.get(DECIMAL128_ENABLED):
+                    meta.cannot_run("decimal128 disabled by "
+                                    "spark.rapids.sql.decimal128.enabled")
+                elif extra is not None:
+                    extra(meta, conf)
+            if prev is not None:
+                prev(meta, conf)
+        rule.tag_fn = tag
+
+    def agg_ok(meta, conf):
+        from ..columnar import dtypes as _dt
+        p = meta.plan
+        allowed = {"sum", "count", "first", "last"}
+        for s in p.specs:
+            for ops in (s.update_ops, s.merge_ops):
+                for op, (n, d, _) in zip(ops, s.state_fields):
+                    if _dt.is_d128(d) and op not in allowed:
+                        meta.cannot_run(
+                            f"aggregate op {op!r} over decimal128 state "
+                            f"{n} is host-only")
+
+    from .physical import (CpuExpandExec, CpuFilterExec, CpuGlobalLimitExec,
+                           CpuHashAggregateExec, CpuLocalLimitExec,
+                           CpuProjectExec, CpuScanExec, CpuSortExec,
+                           CpuUnionExec, ShuffleExchangeExec)
+    from .physical import CpuCollectLimitExec, CpuTakeOrderedExec
+    from .physical_joins import (CpuBroadcastHashJoinExec,
+                                 CpuShuffledHashJoinExec)
+    for cls in (CpuScanExec, CpuProjectExec, CpuFilterExec, CpuSortExec,
+                CpuTakeOrderedExec, CpuGlobalLimitExec, CpuLocalLimitExec,
+                CpuCollectLimitExec, CpuUnionExec, CpuExpandExec,
+                ShuffleExchangeExec, CpuShuffledHashJoinExec,
+                CpuBroadcastHashJoinExec):
+        chain_exec(cls)
+    chain_exec(CpuHashAggregateExec, agg_ok)
+
+
+_upgrade_decimal128_rules()
 
 
 def explain_plan(cpu_plan: PhysicalPlan, conf: RapidsConf) -> str:

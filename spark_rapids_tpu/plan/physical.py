@@ -469,6 +469,7 @@ class CpuHashAggregateExec(PhysicalPlan):
             if not isinstance(out_dt, (dt.StringType, dt.BinaryType,
                                        dt.ArrayType, dt.StructType,
                                        dt.MapType)) \
+                    and not dt.is_d128(out_dt) \
                     and vals.dtype != out_dt.np_dtype():
                 with np.errstate(invalid="ignore"):
                     vals = vals.astype(out_dt.np_dtype())

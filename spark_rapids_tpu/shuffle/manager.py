@@ -97,6 +97,12 @@ def device_partition_ids(table: DeviceTable, key_names: List[str],
         v = col.data
         if col.lengths is not None:  # string/binary
             k = _string_key_hash(col)
+        elif v.ndim == 2:  # decimal128 two-limb columns: fold both limbs
+            hi = v[:, 0].view(jnp.uint64)
+            lo = v[:, 1].view(jnp.uint64)
+            bits = hi ^ (lo * jnp.uint64(0x9E3779B97F4A7C15))
+            k = (bits & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32) \
+                ^ (bits >> jnp.uint64(32)).astype(jnp.uint32)
         elif v.dtype == jnp.bool_:
             k = v.astype(jnp.uint32)
         elif jnp.issubdtype(v.dtype, jnp.floating):

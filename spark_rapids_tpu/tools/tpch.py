@@ -66,6 +66,18 @@ def _sentences(rng: np.random.Generator, n: int, words: int = 6,
     return out
 
 
+def decimal_lineitem(table: pa.Table) -> pa.Table:
+    """Money/quantity columns re-typed to DECIMAL(12,2) — Spark's TPC-H
+    schema semantics (the reference runs these as DECIMAL_128 intermediates:
+    decimalExpressions.scala; sum/avg states exceed 18 digits)."""
+    out = table
+    for name in ("l_quantity", "l_extendedprice", "l_discount", "l_tax"):
+        i = out.schema.get_field_index(name)
+        out = out.set_column(
+            i, name, out.column(name).cast(pa.decimal128(12, 2)))
+    return out
+
+
 def gen_lineitem(sf: float, seed: int = 0, rows: int | None = None) -> pa.Table:
     n = rows if rows is not None else int(6_000_000 * sf)
     rng = np.random.default_rng(seed)
@@ -334,6 +346,44 @@ def q1(t):
                  F.avg(col("l_discount")).alias("avg_disc"),
                  F.count_star().alias("count_order"))
             .sort("l_returnflag", "l_linestatus"))
+
+
+def q1_decimal(t):
+    """Q1 over DECIMAL(12,2) money columns: disc_price is decimal(26,4),
+    charge decimal(38,6), their sums decimal(36,4)/decimal(38,6) — the
+    DECIMAL_128 device tier end-to-end (expr/decimal128.py; reference:
+    decimalExpressions.scala)."""
+    import decimal as _dec
+    F = _f()
+    col, lit = F.col, F.lit
+    sd = col("l_shipdate").cast(_dt().INT)
+    one = lit(_dec.Decimal("1.00"))
+    disc_price = col("l_extendedprice") * (one - col("l_discount"))
+    charge = disc_price * (one + col("l_tax"))
+    return (t["lineitem"]
+            .filter(sd <= lit(_D["1998-09-02"]))
+            .group_by("l_returnflag", "l_linestatus")
+            .agg(F.sum(col("l_quantity")).alias("sum_qty"),
+                 F.sum(col("l_extendedprice")).alias("sum_base_price"),
+                 F.sum(disc_price).alias("sum_disc_price"),
+                 F.sum(charge).alias("sum_charge"),
+                 F.count_star().alias("count_order"))
+            .sort("l_returnflag", "l_linestatus"))
+
+
+def q6_decimal(t):
+    """Q6 over DECIMAL(12,2): revenue = sum(price * disc) as decimal(35,4)."""
+    import decimal as _dec
+    F = _f()
+    col, lit = F.col, F.lit
+    sd = col("l_shipdate").cast(_dt().INT)
+    return (t["lineitem"]
+            .filter((sd >= lit(_D["1994-01-01"])) & (sd < lit(_D["1995-01-01"]))
+                    & (col("l_discount") >= lit(_dec.Decimal("0.05")))
+                    & (col("l_discount") <= lit(_dec.Decimal("0.07")))
+                    & (col("l_quantity") < lit(_dec.Decimal("24.00"))))
+            .agg(F.sum(col("l_extendedprice") * col("l_discount"))
+                 .alias("revenue")))
 
 
 def q2(t):

@@ -66,6 +66,33 @@ def oom_retry(fn: Callable) -> Callable:
     return wrapped
 
 
+_EXEC_MISMATCH_MARKERS = ("but got buffer with incompatible size",
+                          "buffers but compiled program expected")
+
+
+def _rebuild_on_mismatch(key: str, builder: Callable[[], Callable],
+                         fn: Callable) -> Callable:
+    """jax 0.9 workaround: a jit wrapper's dispatch cache can resolve to a
+    stale executable for inputs whose treedef+avals are IDENTICAL to a
+    previously successful call (observed with (n, 2) two-limb decimal128
+    columns — no-lengths 2-D data planes). A fresh jax.jit of the same
+    builder always works, so on that specific INVALID_ARGUMENT signature
+    the entry is rebuilt once and the call retried."""
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        try:
+            return fn(*args, **kwargs)
+        except ValueError as e:
+            msg = str(e)
+            if not any(m in msg for m in _EXEC_MISMATCH_MARKERS):
+                raise
+            fresh = oom_retry(jax.jit(builder()))
+            with _LOCK:
+                _CACHE[key] = _rebuild_on_mismatch(key, builder, fresh)
+            return fresh(*args, **kwargs)
+    return wrapped
+
+
 def cached_jit(key: str, builder: Callable[[], Callable]) -> Callable:
     """Return a jitted callable for ``key``, building it on first use."""
     global _HITS, _MISSES
@@ -75,7 +102,7 @@ def cached_jit(key: str, builder: Callable[[], Callable]) -> Callable:
             _HITS += 1
             return fn
         _MISSES += 1
-    built = oom_retry(jax.jit(builder()))
+    built = _rebuild_on_mismatch(key, builder, oom_retry(jax.jit(builder())))
     with _LOCK:
         return _CACHE.setdefault(key, built)
 

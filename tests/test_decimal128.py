@@ -1,0 +1,204 @@
+"""Differential tests for the decimal128 device kernels: every operation is
+checked bit-exactly against arbitrary-precision python ints / Decimal
+(the reference validates its DECIMAL_128 tier against Spark's BigDecimal —
+decimalExpressions.scala, DecimalUtil.scala)."""
+import decimal
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.expr import decimal128 as d128
+
+import jax.numpy as jnp
+
+
+def _rand_ints(rng, n, bits):
+    out = np.empty(n, dtype=object)
+    for i in range(n):
+        b = int(rng.integers(0, bits))
+        v = int(rng.integers(0, 2 ** 62)) | (int(rng.integers(0, 2 ** 62)) << 62)
+        v &= (1 << b) - 1 if b else 0
+        out[i] = -v if rng.random() < 0.5 else v
+    # pin edge cases
+    edges = [0, 1, -1, 2 ** 63 - 1, -2 ** 63, 2 ** 64, -(2 ** 64),
+             10 ** 18, -(10 ** 18), 10 ** 37, -(10 ** 37),
+             (1 << 126) - 1, -((1 << 126) - 1)]
+    out[:len(edges)] = edges[:len(out)]
+    return out
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(1234)
+
+
+def test_limb_roundtrip(rng):
+    vals = _rand_ints(rng, 64, 126)
+    limbs = d128.limbs_from_py_ints(vals, 64)
+    back = d128.limbs_to_py_ints(limbs)
+    # limbs_to_py_ints returns the unsigned composition; compare mod 2^128
+    for v, b in zip(vals, back):
+        assert (int(b) - int(v)) % (1 << 128) == 0
+
+
+def _to_dev(vals):
+    return jnp.asarray(d128.limbs_from_py_ints(vals, len(vals)))
+
+
+def _signed(limbs):
+    out = d128.limbs_to_py_ints(np.asarray(limbs))
+    res = []
+    for v in out:
+        v = int(v) % (1 << 128)
+        res.append(v - (1 << 128) if v >= (1 << 127) else v)
+    return res
+
+
+def test_add_sub_neg(rng):
+    a = _rand_ints(rng, 128, 126)
+    b = _rand_ints(rng, 128, 126)
+    da, db = _to_dev(a), _to_dev(b)
+    got_add = _signed(d128.d128_add(da, db))
+    got_sub = _signed(d128.d128_sub(da, db))
+    got_neg = _signed(d128.d128_neg(da))
+    for i in range(128):
+        m = 1 << 128
+
+        def wrap(v):
+            v %= m
+            return v - m if v >= (1 << 127) else v
+        assert got_add[i] == wrap(int(a[i]) + int(b[i])), i
+        assert got_sub[i] == wrap(int(a[i]) - int(b[i])), i
+        assert got_neg[i] == wrap(-int(a[i])), i
+
+
+def test_cmp_eq_lt_sign_abs(rng):
+    a = _rand_ints(rng, 128, 126)
+    b = _rand_ints(rng, 128, 126)
+    b[:16] = a[:16]  # equal pairs
+    da, db = _to_dev(a), _to_dev(b)
+    cmp = np.asarray(d128.d128_cmp(da, db))
+    eq = np.asarray(d128.d128_eq(da, db))
+    lt = np.asarray(d128.d128_lt(da, db))
+    sign = np.asarray(d128.d128_sign(da))
+    ab = _signed(d128.d128_abs(da))
+    for i in range(128):
+        x, y = int(a[i]), int(b[i])
+        assert cmp[i] == (-1 if x < y else (1 if x > y else 0)), i
+        assert eq[i] == (x == y), i
+        assert lt[i] == (x < y), i
+        assert sign[i] == (0 if x == 0 else (1 if x > 0 else -1)), i
+        assert ab[i] == abs(x), i
+
+
+def test_key_words_order(rng):
+    a = _rand_ints(rng, 200, 126)
+    da = _to_dev(a)
+    w = d128.d128_key_words(da)
+    keys = list(zip(np.asarray(w[0]).tolist(), np.asarray(w[1]).tolist()))
+    order_words = sorted(range(200), key=lambda i: keys[i])
+    order_true = sorted(range(200), key=lambda i: int(a[i]))
+    assert [int(a[i]) for i in order_words] == [int(a[i]) for i in order_true]
+
+
+def test_mul_rescaled_exact(rng):
+    # decimal(38,*) x decimal(38,*) with scale drops, vs python Decimal
+    for bits_a, bits_b, drop in [(60, 60, 0), (80, 40, 6), (100, 20, 10),
+                                 (120, 6, 18), (63, 63, 4)]:
+        a = _rand_ints(rng, 64, bits_a)
+        b = _rand_ints(rng, 64, bits_b)
+        da, db = _to_dev(a), _to_dev(b)
+        limbs, over = d128.d128_mul_rescaled(da, db, drop, 38)
+        got = _signed(limbs)
+        overflow = np.asarray(over)
+        for i in range(64):
+            prod = int(a[i]) * int(b[i])
+            q, r = divmod(abs(prod), 10 ** drop) if drop else (abs(prod), 0)
+            if 2 * r >= 10 ** drop and drop:
+                q += 1
+            expect = -q if prod < 0 else q
+            if abs(expect) >= 10 ** 38:
+                assert overflow[i], (i, expect)
+            else:
+                assert not overflow[i], (i, expect, got[i])
+                assert got[i] == expect, (i, bits_a, bits_b, drop)
+
+
+def test_rescale_up_down(rng):
+    a = _rand_ints(rng, 64, 90)
+    da = _to_dev(a)
+    up, over_u = d128.d128_rescale(da, 2, 6, 38)
+    got_u = _signed(up)
+    for i in range(64):
+        expect = int(a[i]) * 10 ** 4
+        if abs(expect) >= 10 ** 38:
+            assert np.asarray(over_u)[i]
+        else:
+            assert got_u[i] == expect, i
+    down, over_d = d128.d128_rescale(da, 6, 2, 38)
+    got_d = _signed(down)
+    for i in range(64):
+        v = int(a[i])
+        q, r = divmod(abs(v), 10 ** 4)
+        if 2 * r >= 10 ** 4:
+            q += 1
+        expect = -q if v < 0 else q
+        assert got_d[i] == expect, i
+        assert not np.asarray(over_d)[i]
+
+
+def test_round_half_up_exact_half():
+    # exact .5 boundaries round AWAY from zero (BigDecimal HALF_UP)
+    vals = np.array([15, -15, 25, -25, 5, -5, 149, -149, 150, -150],
+                    dtype=object)
+    da = _to_dev(vals)
+    down, _ = d128.d128_rescale(da, 1, 0, 38)
+    assert _signed(down) == [2, -2, 3, -3, 1, -1, 15, -15, 15, -15]
+
+
+def test_i64_f64_conversions(rng):
+    a = np.array([0, 1, -1, 2 ** 63 - 1, -2 ** 63, 10 ** 18, -(10 ** 18)]
+                 + [int(rng.integers(-2 ** 62, 2 ** 62)) for _ in range(57)],
+                 dtype=object)
+    da = jnp.asarray(np.array([int(v) for v in a], dtype=np.int64))
+    limbs = d128.d128_from_i64(da)
+    assert _signed(limbs) == [int(v) for v in a]
+    back, over = d128.d128_to_i64(limbs)
+    assert not np.asarray(over).any()
+    assert np.asarray(back).tolist() == [int(v) for v in a]
+    wide = _to_dev(np.array([2 ** 64 + 5, -(2 ** 64 + 5)], dtype=object))
+    _, over_w = d128.d128_to_i64(wide)
+    assert np.asarray(over_w).all()
+    f = np.asarray(d128.d128_to_f64(_to_dev(np.array([10 ** 30, -(10 ** 30)],
+                                                     dtype=object))))
+    assert f[0] == pytest.approx(1e30, rel=1e-12)
+    assert f[1] == pytest.approx(-1e30, rel=1e-12)
+    fl, over_f = d128.d128_from_f64(jnp.asarray(np.array([1e30, -1e30, 1e40])))
+    assert _signed(fl)[0] == pytest.approx(10 ** 30, rel=1e-12)
+    assert np.asarray(over_f).tolist() == [False, False, True]
+
+
+def test_overflow_flag(rng):
+    vals = np.array([10 ** 38 - 1, -(10 ** 38 - 1), 10 ** 38, -(10 ** 38)],
+                    dtype=object)
+    over = np.asarray(d128.d128_overflows(_to_dev(vals), 38))
+    assert over.tolist() == [False, False, True, True]
+
+
+def test_segment_sum(rng):
+    n, cap = 256, 16
+    vals = _rand_ints(rng, n, 120)
+    gid = rng.integers(0, cap, n)
+    contrib = rng.random(n) < 0.8
+    limbs, over = d128.d128_segment_sum(
+        _to_dev(vals), jnp.asarray(contrib), jnp.asarray(gid), cap, 38)
+    got = _signed(limbs)
+    overflow = np.asarray(over)
+    for g in range(cap):
+        expect = sum(int(v) for v, gi, c in zip(vals, gid, contrib)
+                     if gi == g and c)
+        if abs(expect) >= 10 ** 38:
+            assert overflow[g], g
+        else:
+            assert not overflow[g], (g, expect, got[g])
+            assert got[g] == expect, g

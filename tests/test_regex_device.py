@@ -1,0 +1,31 @@
+
+
+def test_regexp_replace_group_refs(session):
+    """$n group references run on device over the group-plan subset
+    (reference: GpuRegExpReplace, stringFunctions.scala:895)."""
+    import re as _re
+
+    import pyarrow as pa
+
+    from spark_rapids_tpu.expr.functions import col, regexp_replace
+    data = ["abc-123 def-456", "x-1", "nope", "", "zz-99 a-1 b-22",
+            "tail abc-7", "-", "ab-12cd-34"]
+    df = session.create_dataframe(pa.table({"s": data}))
+    cases = [(r"([a-z]+)-(\d+)", "$2:$1"),
+             (r"([a-z]+)-(\d+)", "[$0]"),
+             (r"([a-z]+)-(\d+)", "$1"),
+             (r"([a-z]+)-(\d+)", r"\$$2"),
+             (r"([a-z]+)-(\d+)", "<$1-$2>")]
+    for pat, repl in cases:
+        q = df.select(regexp_replace(col("s"), pat, repl).alias("r"))
+        dev = q.collect(device=True).column("r").to_pylist()
+        cpu = q.collect(device=False).column("r").to_pylist()
+        pyrep = _re.sub(r"\$(\d+)", r"\\g<\1>",
+                        repl.replace("\\$", "\0")).replace("\0", "$")
+        exp = [_re.sub(pat, pyrep, s) for s in data]
+        assert dev == exp, (pat, repl, dev, exp)
+        assert cpu == exp, (pat, repl)
+    # alternation pattern + refs: falls back, still correct
+    q = df.select(regexp_replace(col("s"), r"(ab|zz)-(\d+)", "$2").alias("r"))
+    assert q.collect(device=True).column("r").to_pylist() \
+        == q.collect(device=False).column("r").to_pylist()
