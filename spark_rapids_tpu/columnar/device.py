@@ -78,26 +78,32 @@ def bucket_width(w: int, min_width: int = 8, max_width: int = 4096) -> int:
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass
 class DeviceColumn:
-    """One device column: padded values + validity (+ lengths for strings)."""
+    """One device column: padded values + validity (+ lengths for strings
+    and arrays, + per-element validity for arrays with containsNull)."""
     data: jax.Array                   # (capacity,) or (capacity, width) uint8
     validity: jax.Array               # (capacity,) bool — True = non-null
     dtype: dt.DataType                # static
     lengths: Optional[jax.Array] = None  # (capacity,) int32 for string/binary
+    elem_validity: Optional[jax.Array] = None  # (capacity, width) bool, arrays
 
     # -- pytree protocol ------------------------------------------------------
     def tree_flatten(self):
-        if self.lengths is None:
-            return (self.data, self.validity), (self.dtype, False)
-        return (self.data, self.validity, self.lengths), (self.dtype, True)
+        children = [self.data, self.validity]
+        if self.lengths is not None:
+            children.append(self.lengths)
+        if self.elem_validity is not None:
+            children.append(self.elem_validity)
+        return tuple(children), (self.dtype, self.lengths is not None,
+                                 self.elem_validity is not None)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        dtype, has_len = aux
-        if has_len:
-            data, validity, lengths = children
-            return cls(data, validity, dtype, lengths)
-        data, validity = children
-        return cls(data, validity, dtype, None)
+        dtype, has_len, has_ev = (aux if len(aux) == 3 else (*aux, False))
+        it = iter(children)
+        data, validity = next(it), next(it)
+        lengths = next(it) if has_len else None
+        ev = next(it) if has_ev else None
+        return cls(data, validity, dtype, lengths, ev)
 
     @property
     def capacity(self) -> int:
@@ -108,13 +114,15 @@ class DeviceColumn:
         return isinstance(self.dtype, (dt.StringType, dt.BinaryType))
 
     def gather(self, idx: jax.Array) -> "DeviceColumn":
-        lengths = None if self.lengths is None else jnp.take(self.lengths, idx, axis=0)
+        take = lambda a: None if a is None else jnp.take(a, idx, axis=0)
         return DeviceColumn(jnp.take(self.data, idx, axis=0),
                             jnp.take(self.validity, idx, axis=0),
-                            self.dtype, lengths)
+                            self.dtype, take(self.lengths),
+                            take(self.elem_validity))
 
     def with_validity(self, validity: jax.Array) -> "DeviceColumn":
-        return DeviceColumn(self.data, validity, self.dtype, self.lengths)
+        return DeviceColumn(self.data, validity, self.dtype, self.lengths,
+                            self.elem_validity)
 
 
 @jax.tree_util.register_pytree_node_class
@@ -191,6 +199,8 @@ class DeviceTable:
             total += int(c.data.nbytes) + int(c.validity.nbytes)
             if c.lengths is not None:
                 total += int(c.lengths.nbytes)
+            if c.elem_validity is not None:
+                total += int(c.elem_validity.nbytes)
         return total
 
     # -- host <-> device ------------------------------------------------------
@@ -225,7 +235,9 @@ class DeviceTable:
             elif isinstance(c.dtype, dt.ArrayType):
                 data = np.asarray(c.data)[mask][:n]
                 lengths = np.asarray(c.lengths)[mask][:n]
-                out = _decode_list_matrix(data, lengths, c.dtype)
+                ev = None if c.elem_validity is None \
+                    else np.asarray(c.elem_validity)[mask][:n]
+                out = _decode_list_matrix(data, lengths, c.dtype, ev)
                 cols.append(HostColumn(c.dtype, out,
                                        None if validity.all() else validity))
             elif dt.is_d128(c.dtype):
@@ -313,22 +325,27 @@ def _decode_string_matrix(data: np.ndarray, lengths: np.ndarray,
 
 def _encode_list_matrix(hc: HostColumn, capacity: int):
     """ARRAY<fixed-width> column -> (capacity, W) element matrix + lengths
-    — the string byte-matrix layout generalized to typed elements
-    (reference: cuDF list columns, SURVEY §2.9; inner nulls are excluded
-    statically by TypeSig.with_arrays, containsNull=false)."""
+    (+ element-validity plane when the array has null elements) — the
+    string byte-matrix layout generalized to typed elements (reference:
+    cuDF list columns, SURVEY §2.9; containsNull rides the optional
+    elem_validity plane)."""
+    import pyarrow as pa
     et: dt.DataType = hc.dtype.element_type
     np_dt = np.bool_ if isinstance(et, dt.BooleanType) else et.np_dtype()
     n = len(hc)
     arr = getattr(hc, "_arrow", None)
     if arr is not None:
         child = arr.values
-        if child.null_count:
-            raise TypeError(f"array column with null elements cannot use "
-                            f"the device list layout: {hc.dtype!r}")
         offsets = np.frombuffer(arr.buffers()[1], dtype=np.int32,
                                 count=n + 1 + arr.offset)[arr.offset:] \
             .astype(np.int64)
-        childvals = np.asarray(child)
+        child_valid = None
+        if child.null_count:
+            child_valid = np.asarray(child.is_valid())
+            fill = False if pa.types.is_boolean(child.type) else 0
+            childvals = np.asarray(child.fill_null(fill))
+        else:
+            childvals = np.asarray(child)
         lengths32 = (offsets[1:] - offsets[:-1]).astype(np.int32)
         # null rows keep offsets; force their length to 0
         vm = hc.valid_mask()
@@ -336,6 +353,7 @@ def _encode_list_matrix(hc: HostColumn, capacity: int):
         width = bucket_width(max(int(lengths32.max()) if n else 0, 1),
                              min_width=4)
         mat = np.zeros((capacity, width), dtype=np_dt)
+        ev = None
         starts = offsets[:-1]
         total = int(lengths32.sum())
         if total:
@@ -343,39 +361,62 @@ def _encode_list_matrix(hc: HostColumn, capacity: int):
             prefix = np.cumsum(lengths32.astype(np.int64)) - lengths32
             cols = np.arange(total, dtype=np.int64) \
                 - np.repeat(prefix, lengths32)
-            mat[rows, cols] = childvals.astype(np_dt, copy=False)[
-                np.repeat(starts, lengths32) + cols]
+            src = np.repeat(starts, lengths32) + cols
+            mat[rows, cols] = childvals.astype(np_dt, copy=False)[src]
+            if child_valid is not None:
+                ev = np.zeros((capacity, width), dtype=np.bool_)
+                ev[rows, cols] = child_valid[src]
+                # rows without inner nulls keep ev=True over their extent
+                if ev[rows, cols].all():
+                    ev = None
         out_lengths = np.zeros(capacity, dtype=np.int32)
         out_lengths[:n] = lengths32
-        return mat, out_lengths
+        return mat, out_lengths, ev
     # object-array path (post-transform columns): per-row encode
     vm = hc.valid_mask()
     lens = np.zeros(capacity, dtype=np.int32)
     rows_np = []
+    any_inner_null = False
     for i in range(n):
         v = hc.values[i]
         if not vm[i] or v is None:
             rows_np.append(None)
             continue
-        a = np.asarray(v, dtype=np_dt)  # raises on inner None: gated away
-        rows_np.append(a)
-        lens[i] = len(a)
+        if any(e is None for e in v):
+            any_inner_null = True
+            a = np.asarray([0 if e is None else e for e in v], dtype=np_dt)
+            m = np.asarray([e is not None for e in v], dtype=np.bool_)
+            rows_np.append((a, m))
+        else:
+            rows_np.append((np.asarray(v, dtype=np_dt), None))
+        lens[i] = len(v)
     width = bucket_width(max(int(lens.max()) if n else 0, 1), min_width=4)
     mat = np.zeros((capacity, width), dtype=np_dt)
-    for i, a in enumerate(rows_np):
-        if a is not None and len(a):
+    ev = np.ones((capacity, width), dtype=np.bool_) if any_inner_null else None
+    for i, am in enumerate(rows_np):
+        if am is None:
+            continue
+        a, m = am
+        if len(a):
             mat[i, :len(a)] = a
-    return mat, lens
+            if ev is not None and m is not None:
+                ev[i, :len(m)] = m
+    return mat, lens, ev
 
 
 def _decode_list_matrix(data: np.ndarray, lengths: np.ndarray,
-                        dtype: dt.DataType) -> np.ndarray:
-    """(n, W) element matrix + lengths -> object array of Python lists
-    (the host engine's nested representation)."""
+                        dtype: dt.DataType, ev: Optional[np.ndarray] = None
+                        ) -> np.ndarray:
+    """(n, W) element matrix + lengths (+ element validity) -> object array
+    of Python lists (the host engine's nested representation)."""
     n = len(lengths)
     out = np.empty(n, dtype=object)
     for i in range(n):
-        out[i] = data[i, :lengths[i]].tolist()
+        row = data[i, :lengths[i]].tolist()
+        if ev is not None:
+            m = ev[i, :lengths[i]]
+            row = [v if ok else None for v, ok in zip(row, m)]
+        out[i] = row
     return out
 
 
@@ -390,9 +431,10 @@ def _upload_column(hc: HostColumn, capacity: int) -> DeviceColumn:
         return DeviceColumn(jnp.asarray(mat), jnp.asarray(validity), hc.dtype,
                             jnp.asarray(lengths))
     if isinstance(hc.dtype, dt.ArrayType):
-        mat, lengths = _encode_list_matrix(hc, capacity)
+        mat, lengths, ev = _encode_list_matrix(hc, capacity)
         return DeviceColumn(jnp.asarray(mat), jnp.asarray(validity), hc.dtype,
-                            jnp.asarray(lengths))
+                            jnp.asarray(lengths),
+                            None if ev is None else jnp.asarray(ev))
     if dt.is_d128(hc.dtype):
         # wide decimals: host object ints -> (capacity, 2) int64 limbs
         from ..expr.decimal128 import limbs_from_py_ints
@@ -442,15 +484,25 @@ def _concat_impl(tables, min_bucket: int = 1024) -> DeviceTable:
     out_cols: List[DeviceColumn] = []
     for ci in range(first.num_columns):
         parts = [t.columns[ci] for t in compacted]
+        ev = None
         if parts[0].lengths is not None:    # strings AND fixed-width lists
             width = max(p.data.shape[1] for p in parts)
             datas = [jnp.pad(p.data, ((0, 0), (0, width - p.data.shape[1])))
                      for p in parts]
             data = jnp.concatenate(datas, axis=0)
             lengths = jnp.concatenate([p.lengths for p in parts])
+            if any(p.elem_validity is not None for p in parts):
+                evs = [jnp.pad(p.elem_validity
+                               if p.elem_validity is not None
+                               else jnp.ones(p.data.shape, dtype=bool),
+                               ((0, 0), (0, width - p.data.shape[1])))
+                       for p in parts]
+                ev = jnp.concatenate(evs, axis=0)
             if tail:
                 data = jnp.pad(data, ((0, tail), (0, 0)))
                 lengths = jnp.pad(lengths, (0, tail))
+                if ev is not None:
+                    ev = jnp.pad(ev, ((0, tail), (0, 0)))
         else:
             data = jnp.concatenate([p.data for p in parts])
             if tail:
@@ -459,7 +511,8 @@ def _concat_impl(tables, min_bucket: int = 1024) -> DeviceTable:
         validity = jnp.concatenate([p.validity for p in parts])
         if tail:
             validity = jnp.pad(validity, (0, tail))
-        out_cols.append(DeviceColumn(data, validity, parts[0].dtype, lengths))
+        out_cols.append(DeviceColumn(data, validity, parts[0].dtype, lengths,
+                                     ev))
     row_mask = jnp.concatenate([t.row_mask for t in compacted])
     if tail:
         row_mask = jnp.pad(row_mask, (0, tail))
@@ -501,7 +554,9 @@ def _slice_rows_impl(table: DeviceTable, start, length: int) -> DeviceTable:
         return out
 
     cols = tuple(DeviceColumn(slc(c.data), slc(c.validity), c.dtype,
-                              None if c.lengths is None else slc(c.lengths))
+                              None if c.lengths is None else slc(c.lengths),
+                              None if c.elem_validity is None
+                              else slc(c.elem_validity))
                  for c in table.columns)
     iota = jnp.arange(length, dtype=jnp.int32)
     mask = jnp.logical_and(slc(table.row_mask),
@@ -528,7 +583,9 @@ def shrink_to_fit(table: DeviceTable, min_bucket: int = 1024) -> DeviceTable:
         return a[:cap]
 
     cols = tuple(DeviceColumn(cut(c.data), cut(c.validity), c.dtype,
-                              None if c.lengths is None else cut(c.lengths))
+                              None if c.lengths is None else cut(c.lengths),
+                              None if c.elem_validity is None
+                              else cut(c.elem_validity))
                  for c in compacted.columns)
     return DeviceTable(cols, cut(compacted.row_mask),
                        compacted.num_rows, compacted.names)

@@ -393,18 +393,20 @@ class TypeSig:
                        child_sig or self, self._array_no_inner_nulls)
 
     def with_arrays(self, element_sig: "TypeSig",
-                    note: Optional[str] = None) -> "TypeSig":
-        """Allow ARRAY columns whose elements satisfy ``element_sig`` AND
-        whose type declares containsNull=false — the device list layout is
-        (values matrix, lengths) with no element-validity plane, so inner
-        nullability must be excluded statically (the reference gates
-        per-op nesting support the same way, TypeChecks.scala:166)."""
+                    note: Optional[str] = None,
+                    allow_inner_nulls: bool = True) -> "TypeSig":
+        """Allow ARRAY columns whose elements satisfy ``element_sig``. The
+        device list layout is (values matrix, lengths, optional element-
+        validity plane); ops whose kernels don't consult the element-
+        validity plane pass allow_inner_nulls=False to keep the static
+        containsNull=false gate (the reference gates per-op nesting
+        support the same way, TypeChecks.scala:166)."""
         notes = dict(self._notes)
         notes[TypeEnum.ARRAY] = note or (
-            "arrays of fixed-width elements with containsNull=false; "
-            "others fall back to host")
+            "arrays of fixed-width elements; others fall back to host")
         return TypeSig(self._types | {TypeEnum.ARRAY}, notes,
-                       self._max_decimal_precision, element_sig, True)
+                       self._max_decimal_precision, element_sig,
+                       not allow_inner_nulls)
 
     # -- checks ---------------------------------------------------------------
     def is_supported(self, dt: DataType) -> bool:

@@ -42,7 +42,8 @@ def eval_exprs_device(table: DeviceTable, exprs: Sequence[Expression],
             want = c.dtype.np_dtype()
             if values.dtype != want:
                 values = values.astype(want)
-        cols.append(DeviceColumn(values, validity, c.dtype, c.lengths))
+        cols.append(DeviceColumn(values, validity, c.dtype, c.lengths,
+                                 c.elem_validity))
     return DeviceTable(tuple(cols), table.row_mask, table.num_rows, tuple(names))
 
 

@@ -68,7 +68,9 @@ def pad_table_capacity(table: DeviceTable, capacity: int) -> DeviceTable:
         return DeviceColumn(
             jnp.pad(c.data, pad_width),
             jnp.pad(c.validity, (0, extra)), c.dtype,
-            None if c.lengths is None else jnp.pad(c.lengths, (0, extra)))
+            None if c.lengths is None else jnp.pad(c.lengths, (0, extra)),
+            None if c.elem_validity is None
+            else jnp.pad(c.elem_validity, ((0, extra), (0, 0))))
 
     return DeviceTable(tuple(pad_col(c) for c in table.columns),
                        jnp.pad(table.row_mask, (0, extra)),
@@ -293,12 +295,15 @@ def _split_sharded(table: DeviceTable, n: int) -> List[Optional[DeviceTable]]:
     col_parts = []
     for c in table.columns:
         col_parts.append((parts(c.data), parts(c.validity),
-                          None if c.lengths is None else parts(c.lengths)))
+                          None if c.lengths is None else parts(c.lengths),
+                          None if c.elem_validity is None
+                          else parts(c.elem_validity)))
     out: List[Optional[DeviceTable]] = []
     for i in range(n):
         cols = tuple(
-            DeviceColumn(d[i], v[i], c.dtype, None if l is None else l[i])
-            for (d, v, l), c in zip(col_parts, table.columns))
+            DeviceColumn(d[i], v[i], c.dtype, None if l is None else l[i],
+                         None if e is None else e[i])
+            for (d, v, l, e), c in zip(col_parts, table.columns))
         mask = mask_parts[i]
         out.append(DeviceTable(cols, mask, jnp.sum(mask, dtype=jnp.int32),
                                table.names))

@@ -96,8 +96,13 @@ class TpuGenerateExec(TpuExec):
         elem_dt = self.gen_fields[-1][1]
         # gather the source rows of the list matrix, then pick the element
         row_vals = jnp.take(col.values, src_c, axis=0)
-        evals = jnp.take_along_axis(
-            row_vals, jnp.clip(eidx, 0, w - 1)[:, None], axis=1)[:, 0]
+        pick = jnp.clip(eidx, 0, w - 1)[:, None]
+        evals = jnp.take_along_axis(row_vals, pick, axis=1)[:, 0]
+        if col.elem_validity is not None:
+            # containsNull arrays: a null element explodes to a null row value
+            row_ev = jnp.take(col.elem_validity, src_c, axis=0)
+            elem_valid = jnp.logical_and(
+                elem_valid, jnp.take_along_axis(row_ev, pick, axis=1)[:, 0])
         evals = jnp.where(elem_valid, evals, jnp.zeros((), evals.dtype))
         out_cols.append(DeviceColumn(evals, elem_valid, elem_dt, None))
         return DeviceTable(tuple(out_cols), row_ok,

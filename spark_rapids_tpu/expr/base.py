@@ -36,7 +36,8 @@ class EvalCol:
     values: Any                 # np.ndarray | jax.Array; strings: obj array (host) / (n,w) u8 (device)
     validity: Any               # bool array or None (all valid)
     dtype: dt.DataType
-    lengths: Any = None         # device strings only
+    lengths: Any = None         # device strings/arrays only
+    elem_validity: Any = None   # device arrays with null elements only
 
     def valid_mask(self, ctx: "EvalContext"):
         if self.validity is None:
@@ -76,7 +77,8 @@ class EvalContext:
     def for_device(table: DeviceTable, partition_id: int = 0,
                    batch_row_offset: int = 0) -> "EvalContext":
         import jax.numpy as jnp
-        cols = {n: EvalCol(c.data, c.validity, c.dtype, c.lengths)
+        cols = {n: EvalCol(c.data, c.validity, c.dtype, c.lengths,
+                           c.elem_validity)
                 for n, c in zip(table.names, table.columns)}
         return EvalContext(True, jnp, cols, table.capacity, table.row_mask,
                            partition_id=partition_id,
@@ -94,7 +96,8 @@ class EvalContext:
         validity = col.validity
         if validity is None:
             validity = self.xp.ones(col.values.shape[0], dtype=bool)
-        return DeviceColumn(col.values, validity, col.dtype, col.lengths)
+        return DeviceColumn(col.values, validity, col.dtype, col.lengths,
+                            col.elem_validity)
 
 
 class Expression:

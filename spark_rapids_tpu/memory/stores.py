@@ -41,7 +41,8 @@ class StorageTier:
 def _table_to_host_arrays(table: DeviceTable) -> Tuple[dict, dict]:
     """Flatten a DeviceTable into numpy arrays + static metadata."""
     arrays = {}
-    meta = {"names": list(table.names), "dtypes": [], "has_lengths": []}
+    meta = {"names": list(table.names), "dtypes": [], "has_lengths": [],
+            "has_ev": []}
     arrays["row_mask"] = np.asarray(table.row_mask)
     arrays["num_rows"] = np.asarray(table.num_rows)
     for i, c in enumerate(table.columns):
@@ -49,20 +50,25 @@ def _table_to_host_arrays(table: DeviceTable) -> Tuple[dict, dict]:
         arrays[f"validity{i}"] = np.asarray(c.validity)
         meta["dtypes"].append(c.dtype)
         meta["has_lengths"].append(c.lengths is not None)
+        meta["has_ev"].append(c.elem_validity is not None)
         if c.lengths is not None:
             arrays[f"lengths{i}"] = np.asarray(c.lengths)
+        if c.elem_validity is not None:
+            arrays[f"ev{i}"] = np.asarray(c.elem_validity)
     return arrays, meta
 
 
 def _host_arrays_to_table(arrays: dict, meta: dict) -> DeviceTable:
     import jax.numpy as jnp
     cols = []
+    has_ev = meta.get("has_ev", [False] * len(meta["dtypes"]))
     for i, d in enumerate(meta["dtypes"]):
         lengths = jnp.asarray(arrays[f"lengths{i}"]) \
             if meta["has_lengths"][i] else None
+        ev = jnp.asarray(arrays[f"ev{i}"]) if has_ev[i] else None
         cols.append(DeviceColumn(jnp.asarray(arrays[f"data{i}"]),
                                  jnp.asarray(arrays[f"validity{i}"]),
-                                 d, lengths))
+                                 d, lengths, ev))
     # num_rows must restore as a 0-d scalar (memory-mapped .npy loads
     # promote 0-d arrays to shape (1,))
     return DeviceTable(tuple(cols), jnp.asarray(arrays["row_mask"]),

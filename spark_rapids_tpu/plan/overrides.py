@@ -145,6 +145,35 @@ def _register_collection_rules():
     register_expr_rule(C.ArrayMin, _arr_ops, tag_fn=tag_arr_only)
     register_expr_rule(C.ArrayMax, _arr_ops, tag_fn=tag_arr_only)
 
+    # higher-order functions: lambdas run columnar over the flattened
+    # element axis (round-4 VERDICT item 6; reference:
+    # higherOrderFunctions.scala:209 GpuArrayTransform et al.). The lambda
+    # body is part of the expression tree, so the recursive ExprMeta walk
+    # gates it with the same per-op rules as any projection.
+    _hof_sig = _device_all.with_arrays(_array_elem)
+    register_expr_rule(C.NamedLambdaVariable, _device_all)
+    register_expr_rule(C.LambdaFunction, _hof_sig)
+
+    def tag_transform(meta, conf):
+        if not _arr_input(meta):
+            return
+        out_et = meta.expr.data_type.element_type
+        if not _array_elem.is_supported(out_et):
+            meta.cannot_run(
+                f"transform result element {out_et!r} is not storable in "
+                "the device list layout")
+    register_expr_rule(C.ArrayTransform, _hof_sig, tag_fn=tag_transform)
+    register_expr_rule(C.ArrayFilter, _hof_sig, tag_fn=tag_arr_only)
+    register_expr_rule(C.ArrayExists, _hof_sig, tag_fn=tag_arr_only)
+
+    def tag_aggregate(meta, conf):
+        if not _arr_input(meta):
+            return
+        zt = meta.expr.children[1].data_type
+        if not _device_common.is_supported(zt):
+            meta.cannot_run(f"aggregate accumulator {zt!r} runs on host")
+    register_expr_rule(C.ArrayAggregate, _hof_sig, tag_fn=tag_aggregate)
+
 
 def _register_concrete_rules():
     """Per-class rules for expressions that previously rode base-class

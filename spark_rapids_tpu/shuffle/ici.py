@@ -49,7 +49,9 @@ def shard_table(table: DeviceTable, mesh: Mesh, axis: str = "dp"
         return DeviceColumn(
             jax.device_put(c.data, sharding),
             jax.device_put(c.validity, sharding), c.dtype,
-            None if c.lengths is None else jax.device_put(c.lengths, sharding))
+            None if c.lengths is None else jax.device_put(c.lengths, sharding),
+            None if c.elem_validity is None
+            else jax.device_put(c.elem_validity, sharding))
 
     return DeviceTable(tuple(put_col(c) for c in table.columns),
                        jax.device_put(table.row_mask, sharding),
@@ -61,7 +63,9 @@ def unshard_table(table: DeviceTable) -> DeviceTable:
     cols = tuple(DeviceColumn(jnp.asarray(np.asarray(c.data)),
                               jnp.asarray(np.asarray(c.validity)), c.dtype,
                               None if c.lengths is None
-                              else jnp.asarray(np.asarray(c.lengths)))
+                              else jnp.asarray(np.asarray(c.lengths)),
+                              None if c.elem_validity is None
+                              else jnp.asarray(np.asarray(c.elem_validity)))
                  for c in table.columns)
     mask = jnp.asarray(np.asarray(table.row_mask))
     return DeviceTable(cols, mask, jnp.sum(mask, dtype=jnp.int32), table.names)
@@ -82,14 +86,17 @@ def ici_all_to_all_exchange(table: DeviceTable, key_names: List[str],
     names = table.names
     dtypes = [c.dtype for c in table.columns]
     has_lengths = [c.lengths is not None for c in table.columns]
+    has_ev = [c.elem_validity is not None for c in table.columns]
 
-    # flatten to arrays: mask, then per column: data, validity, (lengths)
+    # flatten to arrays: mask, then per column: data, validity, (lengths, ev)
     arrays = [table.row_mask]
     for c in table.columns:
         arrays.append(c.data)
         arrays.append(c.validity)
         if c.lengths is not None:
             arrays.append(c.lengths)
+        if c.elem_validity is not None:
+            arrays.append(c.elem_validity)
 
     def local(*arrs):
         mask = arrs[0]
@@ -97,15 +104,19 @@ def ici_all_to_all_exchange(table: DeviceTable, key_names: List[str],
         q = cap if quota is None else min(quota, cap)
         pos = 1
         cols = []
-        for d, hl in zip(dtypes, has_lengths):
+        for d, hl, hev in zip(dtypes, has_lengths, has_ev):
             data = arrs[pos]
             validity = arrs[pos + 1]
             pos_inc = 2
             lengths = None
+            ev = None
             if hl:
-                lengths = arrs[pos + 2]
-                pos_inc = 3
-            cols.append(DeviceColumn(data, validity, d, lengths))
+                lengths = arrs[pos + pos_inc]
+                pos_inc += 1
+            if hev:
+                ev = arrs[pos + pos_inc]
+                pos_inc += 1
+            cols.append(DeviceColumn(data, validity, d, lengths, ev))
             pos += pos_inc
         local_tbl = DeviceTable(tuple(cols), mask,
                                 jnp.sum(mask, dtype=jnp.int32), names)
@@ -141,10 +152,14 @@ def ici_all_to_all_exchange(table: DeviceTable, key_names: List[str],
             if c.lengths is not None:
                 out.append(jax.lax.all_to_all(scatter(c.lengths), axis, 0, 0,
                                               tiled=True).reshape(n * q))
+            if c.elem_validity is not None:
+                out.append(jax.lax.all_to_all(scatter(c.elem_validity), axis,
+                                              0, 0, tiled=True)
+                           .reshape((n * q,) + c.elem_validity.shape[1:]))
         return tuple(out)
 
     in_specs = tuple(P(axis) for _ in arrays)
-    n_out = 1 + sum(2 + int(h) for h in has_lengths)
+    n_out = 1 + sum(2 + int(h) + int(e) for h, e in zip(has_lengths, has_ev))
     out_specs = tuple(P(axis) for _ in range(n_out))
     fn = jax.jit(shard_map(local, mesh=mesh, in_specs=in_specs,
                            out_specs=out_specs, check_vma=False))
@@ -153,11 +168,18 @@ def ici_all_to_all_exchange(table: DeviceTable, key_names: List[str],
     mask = results[0]
     pos = 1
     out_cols = []
-    for d, hl in zip(dtypes, has_lengths):
+    for d, hl, hev in zip(dtypes, has_lengths, has_ev):
         data = results[pos]
         validity = results[pos + 1]
-        lengths = results[pos + 2] if hl else None
-        pos += 3 if hl else 2
-        out_cols.append(DeviceColumn(data, validity, d, lengths))
+        pos += 2
+        lengths = None
+        ev = None
+        if hl:
+            lengths = results[pos]
+            pos += 1
+        if hev:
+            ev = results[pos]
+            pos += 1
+        out_cols.append(DeviceColumn(data, validity, d, lengths, ev))
     total = jnp.sum(mask, dtype=jnp.int32)
     return DeviceTable(tuple(out_cols), mask, total, names)
