@@ -79,31 +79,47 @@ def bucket_width(w: int, min_width: int = 8, max_width: int = 4096) -> int:
 @dataclasses.dataclass
 class DeviceColumn:
     """One device column: padded values + validity (+ lengths for strings
-    and arrays, + per-element validity for arrays with containsNull)."""
+    and arrays, + per-element validity for arrays with containsNull,
+    + child columns for STRUCT/MAP).
+
+    STRUCT layout is struct-of-planes: one child DeviceColumn per field
+    (field order = dtype.fields order), the parent holding only the struct
+    validity; ``data`` is a zero-byte placeholder so every column has a
+    capacity-bearing plane. MAP reuses it: exactly two children — the keys
+    as an ARRAY column and the values as an ARRAY column with shared
+    per-row lengths (reference: cuDF's LIST<STRUCT<K,V>> map layout,
+    re-cut for static shapes; SURVEY §2.2)."""
     data: jax.Array                   # (capacity,) or (capacity, width) uint8
     validity: jax.Array               # (capacity,) bool — True = non-null
     dtype: dt.DataType                # static
     lengths: Optional[jax.Array] = None  # (capacity,) int32 for string/binary
     elem_validity: Optional[jax.Array] = None  # (capacity, width) bool, arrays
+    children: Optional[Tuple["DeviceColumn", ...]] = None  # struct/map
 
     # -- pytree protocol ------------------------------------------------------
     def tree_flatten(self):
-        children = [self.data, self.validity]
+        leaves = [self.data, self.validity]
         if self.lengths is not None:
-            children.append(self.lengths)
+            leaves.append(self.lengths)
         if self.elem_validity is not None:
-            children.append(self.elem_validity)
-        return tuple(children), (self.dtype, self.lengths is not None,
-                                 self.elem_validity is not None)
+            leaves.append(self.elem_validity)
+        if self.children is not None:
+            leaves.append(self.children)
+        return tuple(leaves), (self.dtype, self.lengths is not None,
+                               self.elem_validity is not None,
+                               self.children is not None)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        dtype, has_len, has_ev = (aux if len(aux) == 3 else (*aux, False))
+        if len(aux) == 3:
+            aux = (*aux, False)
+        dtype, has_len, has_ev, has_kids = aux
         it = iter(children)
         data, validity = next(it), next(it)
         lengths = next(it) if has_len else None
         ev = next(it) if has_ev else None
-        return cls(data, validity, dtype, lengths, ev)
+        kids = tuple(next(it)) if has_kids else None
+        return cls(data, validity, dtype, lengths, ev, kids)
 
     @property
     def capacity(self) -> int:
@@ -113,16 +129,22 @@ class DeviceColumn:
     def is_string_like(self) -> bool:
         return isinstance(self.dtype, (dt.StringType, dt.BinaryType))
 
+    @property
+    def is_nested(self) -> bool:
+        return self.children is not None
+
     def gather(self, idx: jax.Array) -> "DeviceColumn":
         take = lambda a: None if a is None else jnp.take(a, idx, axis=0)
+        kids = None if self.children is None \
+            else tuple(c.gather(idx) for c in self.children)
         return DeviceColumn(jnp.take(self.data, idx, axis=0),
                             jnp.take(self.validity, idx, axis=0),
                             self.dtype, take(self.lengths),
-                            take(self.elem_validity))
+                            take(self.elem_validity), kids)
 
     def with_validity(self, validity: jax.Array) -> "DeviceColumn":
         return DeviceColumn(self.data, validity, self.dtype, self.lengths,
-                            self.elem_validity)
+                            self.elem_validity, self.children)
 
 
 @jax.tree_util.register_pytree_node_class
@@ -195,12 +217,18 @@ class DeviceTable:
 
     def nbytes(self) -> int:
         total = int(self.row_mask.nbytes) + 4
-        for c in self.columns:
-            total += int(c.data.nbytes) + int(c.validity.nbytes)
+        def col_bytes(c: DeviceColumn) -> int:
+            b = int(c.data.nbytes) + int(c.validity.nbytes)
             if c.lengths is not None:
-                total += int(c.lengths.nbytes)
+                b += int(c.lengths.nbytes)
             if c.elem_validity is not None:
-                total += int(c.elem_validity.nbytes)
+                b += int(c.elem_validity.nbytes)
+            for k in (c.children or ()):
+                b += col_bytes(k)
+            return b
+
+        for c in self.columns:
+            total += col_bytes(c)
         return total
 
     # -- host <-> device ------------------------------------------------------
@@ -223,38 +251,61 @@ class DeviceTable:
         mask = np.asarray(self.row_mask)
         n = int(np.asarray(self.num_rows))
         # row_mask may be non-prefix (post-filter); boolean-index on host
-        cols: List[HostColumn] = []
-        for c in self.columns:
-            validity = np.asarray(c.validity)[mask][:n]
-            if c.is_string_like:
-                data = np.asarray(c.data)[mask][:n]
-                lengths = np.asarray(c.lengths)[mask][:n]
-                out = _decode_string_matrix(data, lengths, c.dtype)
-                cols.append(HostColumn(c.dtype, out,
-                                       None if validity.all() else validity))
-            elif isinstance(c.dtype, dt.ArrayType):
-                data = np.asarray(c.data)[mask][:n]
-                lengths = np.asarray(c.lengths)[mask][:n]
-                ev = None if c.elem_validity is None \
-                    else np.asarray(c.elem_validity)[mask][:n]
-                out = _decode_list_matrix(data, lengths, c.dtype, ev)
-                cols.append(HostColumn(c.dtype, out,
-                                       None if validity.all() else validity))
-            elif dt.is_d128(c.dtype):
-                from ..expr.decimal128 import limbs_to_py_ints
-                limbs = np.asarray(c.data)[mask][:n]
-                # hi limb is signed: the composition is already the signed
-                # 128-bit value
-                vals = limbs_to_py_ints(limbs)
-                cols.append(HostColumn(c.dtype, vals,
-                                       None if validity.all() else validity))
-            else:
-                vals = np.asarray(c.data)[mask][:n]
-                if isinstance(c.dtype, dt.BooleanType):
-                    vals = vals.astype(np.bool_)
-                cols.append(HostColumn(c.dtype, vals,
-                                       None if validity.all() else validity))
+        cols = [_download_column(c, mask, n) for c in self.columns]
         return HostTable(list(self.names), cols)
+
+
+def _download_column(c: DeviceColumn, mask: np.ndarray, n: int) -> HostColumn:
+    """One column's device->host decode over the active-row mask."""
+    validity = np.asarray(c.validity)[mask][:n]
+    opt_valid = None if validity.all() else validity
+    if c.is_string_like:
+        data = np.asarray(c.data)[mask][:n]
+        lengths = np.asarray(c.lengths)[mask][:n]
+        return HostColumn(c.dtype, _decode_string_matrix(data, lengths,
+                                                         c.dtype), opt_valid)
+    if isinstance(c.dtype, dt.ArrayType):
+        data = np.asarray(c.data)[mask][:n]
+        lengths = np.asarray(c.lengths)[mask][:n]
+        ev = None if c.elem_validity is None \
+            else np.asarray(c.elem_validity)[mask][:n]
+        return HostColumn(c.dtype, _decode_list_matrix(data, lengths,
+                                                       c.dtype, ev), opt_valid)
+    if isinstance(c.dtype, dt.StructType):
+        kids = [_download_column(k, mask, n) for k in c.children]
+        names = [f.name for f in c.dtype.fields]
+        kvms = [k.valid_mask() for k in kids]      # hoisted: O(1) per row
+        out = _obj_array(n)
+        for i in range(n):
+            if validity[i]:
+                out[i] = {nm: (k.values[i] if vm[i] else None)
+                          for nm, k, vm in zip(names, kids, kvms)}
+        return HostColumn(c.dtype, out, opt_valid)
+    if isinstance(c.dtype, dt.MapType):
+        kc = _download_column(c.children[0], mask, n)
+        vc = _download_column(c.children[1], mask, n)
+        kvm, vvm = kc.valid_mask(), vc.valid_mask()
+        out = _obj_array(n)
+        for i in range(n):
+            if validity[i]:
+                ks = kc.values[i] if kvm[i] else []
+                vs = vc.values[i] if vvm[i] else []
+                out[i] = list(zip(ks, vs))
+        return HostColumn(c.dtype, out, opt_valid)
+    if dt.is_d128(c.dtype):
+        from ..expr.decimal128 import limbs_to_py_ints
+        limbs = np.asarray(c.data)[mask][:n]
+        # hi limb is signed: the composition is already the signed
+        # 128-bit value
+        return HostColumn(c.dtype, limbs_to_py_ints(limbs), opt_valid)
+    vals = np.asarray(c.data)[mask][:n]
+    if isinstance(c.dtype, dt.BooleanType):
+        vals = vals.astype(np.bool_)
+    return HostColumn(c.dtype, vals, opt_valid)
+
+
+def _obj_array(n: int) -> np.ndarray:
+    return np.empty(n, dtype=object)
 
 
 def _encode_string_matrix(values: np.ndarray, capacity: int, is_binary: bool,
@@ -420,10 +471,79 @@ def _decode_list_matrix(data: np.ndarray, lengths: np.ndarray,
     return out
 
 
+def _host_field_column(hc: HostColumn, index: int) -> HostColumn:
+    """Struct HostColumn -> one field's HostColumn (arrow fast path or
+    per-row dict extraction)."""
+    import pyarrow as pa
+    f = hc.dtype.fields[index]
+    arr = getattr(hc, "_arrow", None)
+    if arr is not None:
+        child = arr.field(index)
+        if isinstance(child, pa.ChunkedArray):
+            child = child.combine_chunks()
+        return HostColumn.from_arrow(child)
+    from .host import _dtype_to_arrow
+    vm = hc.valid_mask()
+    vals = [hc.values[i].get(f.name) if vm[i] and hc.values[i] is not None
+            else None for i in range(len(hc))]
+    return HostColumn.from_arrow(
+        pa.array(vals, type=_dtype_to_arrow(f.data_type), from_pandas=True))
+
+
+def _host_map_entry_columns(hc: HostColumn):
+    """Map HostColumn -> (keys ARRAY HostColumn, values ARRAY HostColumn)
+    with shared per-row lengths."""
+    import pyarrow as pa
+    from .host import _dtype_to_arrow
+    mt: dt.MapType = hc.dtype
+    arr = getattr(hc, "_arrow", None)
+    if arr is not None and pa.types.is_map(arr.type):
+        offsets = arr.offsets
+        keys = pa.ListArray.from_arrays(offsets, arr.keys)
+        items = pa.ListArray.from_arrays(offsets, arr.items)
+        # propagate row validity (map offsets keep entries for null rows)
+        if arr.null_count:
+            vm = np.asarray(arr.is_valid())
+            kc = HostColumn.from_arrow(keys)
+            vc = HostColumn.from_arrow(items)
+            kc.validity = vm if kc.validity is None else (kc.validity & vm)
+            vc.validity = vm if vc.validity is None else (vc.validity & vm)
+            return kc, vc
+        return HostColumn.from_arrow(keys), HostColumn.from_arrow(items)
+    vm = hc.valid_mask()
+    krows, vrows = [], []
+    for i in range(len(hc)):
+        row = hc.values[i]
+        if not vm[i] or row is None:
+            krows.append(None)
+            vrows.append(None)
+        else:
+            pairs = row.items() if isinstance(row, dict) else row
+            pairs = list(pairs)
+            krows.append([k for k, _ in pairs])
+            vrows.append([v for _, v in pairs])
+    ktype = pa.list_(_dtype_to_arrow(mt.key_type))
+    vtype = pa.list_(_dtype_to_arrow(mt.value_type))
+    return (HostColumn.from_arrow(pa.array(krows, type=ktype,
+                                           from_pandas=True)),
+            HostColumn.from_arrow(pa.array(vrows, type=vtype,
+                                           from_pandas=True)))
+
+
 def _upload_column(hc: HostColumn, capacity: int) -> DeviceColumn:
     n = len(hc)
     validity = np.zeros(capacity, dtype=np.bool_)
     validity[:n] = hc.valid_mask()
+    if isinstance(hc.dtype, dt.StructType):
+        kids = tuple(_upload_column(_host_field_column(hc, i), capacity)
+                     for i in range(len(hc.dtype.fields)))
+        return DeviceColumn(jnp.zeros(capacity, jnp.uint8),
+                            jnp.asarray(validity), hc.dtype, None, None, kids)
+    if isinstance(hc.dtype, dt.MapType):
+        kc, vc = _host_map_entry_columns(hc)
+        kids = (_upload_column(kc, capacity), _upload_column(vc, capacity))
+        return DeviceColumn(jnp.zeros(capacity, jnp.uint8),
+                            jnp.asarray(validity), hc.dtype, None, None, kids)
     if isinstance(hc.dtype, (dt.StringType, dt.BinaryType)):
         mat, lengths = _encode_string_matrix(
             hc.values, capacity, isinstance(hc.dtype, dt.BinaryType),
@@ -483,42 +603,55 @@ def _concat_impl(tables, min_bucket: int = 1024) -> DeviceTable:
     compacted = [t.compact() for t in tables]
     out_cols: List[DeviceColumn] = []
     for ci in range(first.num_columns):
-        parts = [t.columns[ci] for t in compacted]
-        ev = None
-        if parts[0].lengths is not None:    # strings AND fixed-width lists
-            width = max(p.data.shape[1] for p in parts)
-            datas = [jnp.pad(p.data, ((0, 0), (0, width - p.data.shape[1])))
-                     for p in parts]
-            data = jnp.concatenate(datas, axis=0)
-            lengths = jnp.concatenate([p.lengths for p in parts])
-            if any(p.elem_validity is not None for p in parts):
-                evs = [jnp.pad(p.elem_validity
-                               if p.elem_validity is not None
-                               else jnp.ones(p.data.shape, dtype=bool),
-                               ((0, 0), (0, width - p.data.shape[1])))
-                       for p in parts]
-                ev = jnp.concatenate(evs, axis=0)
-            if tail:
-                data = jnp.pad(data, ((0, tail), (0, 0)))
-                lengths = jnp.pad(lengths, (0, tail))
-                if ev is not None:
-                    ev = jnp.pad(ev, ((0, tail), (0, 0)))
-        else:
-            data = jnp.concatenate([p.data for p in parts])
-            if tail:
-                data = jnp.pad(data, [(0, tail)] + [(0, 0)] * (data.ndim - 1))
-            lengths = None
-        validity = jnp.concatenate([p.validity for p in parts])
-        if tail:
-            validity = jnp.pad(validity, (0, tail))
-        out_cols.append(DeviceColumn(data, validity, parts[0].dtype, lengths,
-                                     ev))
+        out_cols.append(_concat_columns([t.columns[ci] for t in compacted],
+                                        tail))
     row_mask = jnp.concatenate([t.row_mask for t in compacted])
     if tail:
         row_mask = jnp.pad(row_mask, (0, tail))
     num_rows = sum((t.num_rows for t in tables), jnp.asarray(0, jnp.int32))
     out = DeviceTable(tuple(out_cols), row_mask, num_rows, first.names)
     return out.compact()
+
+
+def _concat_columns(parts: List[DeviceColumn], tail: int) -> DeviceColumn:
+    """Concatenate one column's parts along rows, padding ``tail`` extra
+    masked-off rows; recurses into struct/map children."""
+    ev = None
+    kids = None
+    if parts[0].children is not None:
+        kids = tuple(_concat_columns([p.children[i] for p in parts], tail)
+                     for i in range(len(parts[0].children)))
+        data = jnp.concatenate([p.data for p in parts])
+        if tail:
+            data = jnp.pad(data, (0, tail))
+        lengths = None
+    elif parts[0].lengths is not None:    # strings AND fixed-width lists
+        width = max(p.data.shape[1] for p in parts)
+        datas = [jnp.pad(p.data, ((0, 0), (0, width - p.data.shape[1])))
+                 for p in parts]
+        data = jnp.concatenate(datas, axis=0)
+        lengths = jnp.concatenate([p.lengths for p in parts])
+        if any(p.elem_validity is not None for p in parts):
+            evs = [jnp.pad(p.elem_validity
+                           if p.elem_validity is not None
+                           else jnp.ones(p.data.shape, dtype=bool),
+                           ((0, 0), (0, width - p.data.shape[1])))
+                   for p in parts]
+            ev = jnp.concatenate(evs, axis=0)
+        if tail:
+            data = jnp.pad(data, ((0, tail), (0, 0)))
+            lengths = jnp.pad(lengths, (0, tail))
+            if ev is not None:
+                ev = jnp.pad(ev, ((0, tail), (0, 0)))
+    else:
+        data = jnp.concatenate([p.data for p in parts])
+        if tail:
+            data = jnp.pad(data, [(0, tail)] + [(0, 0)] * (data.ndim - 1))
+        lengths = None
+    validity = jnp.concatenate([p.validity for p in parts])
+    if tail:
+        validity = jnp.pad(validity, (0, tail))
+    return DeviceColumn(data, validity, parts[0].dtype, lengths, ev, kids)
 
 
 _concat_jitted = jax.jit(_concat_impl, static_argnums=(1,))
@@ -553,11 +686,15 @@ def _slice_rows_impl(table: DeviceTable, start, length: int) -> DeviceTable:
             out = jnp.pad(out, pad)
         return out
 
-    cols = tuple(DeviceColumn(slc(c.data), slc(c.validity), c.dtype,
-                              None if c.lengths is None else slc(c.lengths),
-                              None if c.elem_validity is None
-                              else slc(c.elem_validity))
-                 for c in table.columns)
+    def slc_col(c: DeviceColumn) -> DeviceColumn:
+        return DeviceColumn(
+            slc(c.data), slc(c.validity), c.dtype,
+            None if c.lengths is None else slc(c.lengths),
+            None if c.elem_validity is None else slc(c.elem_validity),
+            None if c.children is None
+            else tuple(slc_col(k) for k in c.children))
+
+    cols = tuple(slc_col(c) for c in table.columns)
     iota = jnp.arange(length, dtype=jnp.int32)
     mask = jnp.logical_and(slc(table.row_mask),
                            (iota + start) < table.num_rows)
@@ -582,11 +719,15 @@ def shrink_to_fit(table: DeviceTable, min_bucket: int = 1024) -> DeviceTable:
     def cut(a):
         return a[:cap]
 
-    cols = tuple(DeviceColumn(cut(c.data), cut(c.validity), c.dtype,
-                              None if c.lengths is None else cut(c.lengths),
-                              None if c.elem_validity is None
-                              else cut(c.elem_validity))
-                 for c in compacted.columns)
+    def cut_col(c: DeviceColumn) -> DeviceColumn:
+        return DeviceColumn(cut(c.data), cut(c.validity), c.dtype,
+                            None if c.lengths is None else cut(c.lengths),
+                            None if c.elem_validity is None
+                            else cut(c.elem_validity),
+                            None if c.children is None
+                            else tuple(cut_col(k) for k in c.children))
+
+    cols = tuple(cut_col(c) for c in compacted.columns)
     return DeviceTable(cols, cut(compacted.row_mask),
                        compacted.num_rows, compacted.names)
 

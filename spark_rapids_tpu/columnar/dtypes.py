@@ -333,12 +333,14 @@ class TypeSig:
     """
 
     __slots__ = ("_types", "_notes", "_max_decimal_precision", "_child_sig",
-                 "_array_no_inner_nulls")
+                 "_array_no_inner_nulls", "_struct_sig", "_map_sig")
 
     def __init__(self, types: Iterable[str] = (), notes: Optional[dict] = None,
                  max_decimal_precision: int = DecimalType.MAX_INT64_PRECISION,
                  child_sig: "Optional[TypeSig]" = None,
-                 array_no_inner_nulls: bool = False):
+                 array_no_inner_nulls: bool = False,
+                 struct_sig: "Optional[TypeSig]" = None,
+                 map_sig: "Optional[TypeSig]" = None):
         self._types = frozenset(types)
         self._notes = dict(notes or {})
         self._max_decimal_precision = max_decimal_precision
@@ -347,6 +349,11 @@ class TypeSig:
         # device list layout has values+lengths but no element-validity
         # plane: ARRAY support may require containsNull=false statically
         self._array_no_inner_nulls = array_no_inner_nulls
+        # per-kind child signatures (fall back to child_sig):
+        # struct fields may be wider than array elements (e.g. strings
+        # store as byte-matrix planes), maps narrower (fixed-width only)
+        self._struct_sig = struct_sig
+        self._map_sig = map_sig
 
     # -- constructors ---------------------------------------------------------
     @staticmethod
@@ -358,39 +365,46 @@ class TypeSig:
         return TypeSig(enums)
 
     # -- algebra --------------------------------------------------------------
+    def _clone(self, **kw) -> "TypeSig":
+        base = dict(types=self._types, notes=self._notes,
+                    max_decimal_precision=self._max_decimal_precision,
+                    child_sig=self._child_sig,
+                    array_no_inner_nulls=self._array_no_inner_nulls,
+                    struct_sig=self._struct_sig, map_sig=self._map_sig)
+        base.update(kw)
+        return TypeSig(**base)
+
     def __add__(self, other: "TypeSig") -> "TypeSig":
         notes = dict(self._notes)
         notes.update(other._notes)
         return TypeSig(self._types | other._types, notes,
                        max(self._max_decimal_precision, other._max_decimal_precision),
                        self._child_sig or other._child_sig,
-                       self._array_no_inner_nulls or other._array_no_inner_nulls)
+                       self._array_no_inner_nulls or other._array_no_inner_nulls,
+                       self._struct_sig or other._struct_sig,
+                       self._map_sig or other._map_sig)
 
     def __sub__(self, other: "TypeSig") -> "TypeSig":
         notes = {k: v for k, v in self._notes.items() if k not in other._types}
-        return TypeSig(self._types - other._types, notes,
-                       self._max_decimal_precision, self._child_sig,
-                       self._array_no_inner_nulls)
+        return self._clone(types=self._types - other._types, notes=notes)
 
     def with_decimal128(self) -> "TypeSig":
         """Raise the decimal gate to 38 digits (the DECIMAL_128 tier,
         reference TypeChecks.scala:465): applied per-rule to the ops whose
         device kernels handle two-limb columns (expr/decimal128.py)."""
-        return TypeSig(self._types, self._notes, 38, self._child_sig,
-                       self._array_no_inner_nulls)
+        return self._clone(max_decimal_precision=38)
 
     def with_ps_note(self, type_enum: str, note: str) -> "TypeSig":
         notes = dict(self._notes)
         notes[type_enum] = note
-        return TypeSig(self._types | {type_enum}, notes,
-                       self._max_decimal_precision, self._child_sig,
-                       self._array_no_inner_nulls)
+        return self._clone(types=self._types | {type_enum}, notes=notes)
 
     def nested(self, child_sig: "Optional[TypeSig]" = None) -> "TypeSig":
         """Allow nested types whose children satisfy ``child_sig`` (default: self)."""
-        return TypeSig(self._types | {TypeEnum.ARRAY, TypeEnum.STRUCT, TypeEnum.MAP},
-                       self._notes, self._max_decimal_precision,
-                       child_sig or self, self._array_no_inner_nulls)
+        return self._clone(
+            types=self._types | {TypeEnum.ARRAY, TypeEnum.STRUCT,
+                                 TypeEnum.MAP},
+            child_sig=child_sig or self)
 
     def with_arrays(self, element_sig: "TypeSig",
                     note: Optional[str] = None,
@@ -404,9 +418,30 @@ class TypeSig:
         notes = dict(self._notes)
         notes[TypeEnum.ARRAY] = note or (
             "arrays of fixed-width elements; others fall back to host")
-        return TypeSig(self._types | {TypeEnum.ARRAY}, notes,
-                       self._max_decimal_precision, element_sig,
-                       not allow_inner_nulls)
+        return self._clone(types=self._types | {TypeEnum.ARRAY}, notes=notes,
+                           child_sig=element_sig,
+                           array_no_inner_nulls=not allow_inner_nulls)
+
+    def with_structs(self, field_sig: "TypeSig",
+                     note: Optional[str] = None) -> "TypeSig":
+        """Allow STRUCT columns whose fields (recursively) satisfy
+        ``field_sig`` — the struct-of-planes device layout (reference:
+        TypeChecks.scala:166 per-op STRUCT nesting)."""
+        notes = dict(self._notes)
+        if note:
+            notes[TypeEnum.STRUCT] = note
+        return self._clone(types=self._types | {TypeEnum.STRUCT},
+                           notes=notes, struct_sig=field_sig)
+
+    def with_maps(self, entry_sig: "TypeSig",
+                  note: Optional[str] = None) -> "TypeSig":
+        """Allow MAP columns whose key/value types satisfy ``entry_sig``
+        (two parallel device list planes with shared lengths)."""
+        notes = dict(self._notes)
+        if note:
+            notes[TypeEnum.MAP] = note
+        return self._clone(types=self._types | {TypeEnum.MAP},
+                           notes=notes, map_sig=entry_sig)
 
     # -- checks ---------------------------------------------------------------
     def is_supported(self, dt: DataType) -> bool:
@@ -429,12 +464,14 @@ class TypeSig:
                     "the device list layout requires containsNull=false")
             reasons += [f"array child: {r}" for r in child.reasons_not_supported(dt.element_type)]
         elif isinstance(dt, StructType):
+            fs = self._struct_sig or child
             for f in dt.fields:
                 reasons += [f"struct field {f.name}: {r}"
-                            for r in child.reasons_not_supported(f.data_type)]
+                            for r in fs.reasons_not_supported(f.data_type)]
         elif isinstance(dt, MapType):
-            reasons += [f"map key: {r}" for r in child.reasons_not_supported(dt.key_type)]
-            reasons += [f"map value: {r}" for r in child.reasons_not_supported(dt.value_type)]
+            ms = self._map_sig or child
+            reasons += [f"map key: {r}" for r in ms.reasons_not_supported(dt.key_type)]
+            reasons += [f"map value: {r}" for r in ms.reasons_not_supported(dt.value_type)]
         return reasons
 
     def note_for(self, dt: DataType) -> Optional[str]:

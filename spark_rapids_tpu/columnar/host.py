@@ -145,6 +145,10 @@ class HostColumn:
                 # keep the arrow array: the device upload reads the list
                 # offsets/values buffers directly (as with strings)
                 return HostColumn(d, values, validity, _arrow=arr)
+            if isinstance(d, (dt.StructType, dt.MapType)):
+                # keep the arrow array: the device upload recurses into the
+                # struct field / map key+item child arrays directly
+                return HostColumn(d, values, validity, _arrow=arr)
         elif isinstance(d, dt.StringType) or isinstance(d, dt.BinaryType):
             values = np.asarray(arr.to_pylist(), dtype=object)
             if validity is not None:

@@ -66,6 +66,22 @@ def _key_code_words(kc) -> "Tuple[List[jax.Array], Optional[jax.Array]]":
     from ..columnar.device import pack_string_key_words
     if isinstance(kc.dtype, (dt.StringType, dt.BinaryType)):
         return pack_string_key_words(kc.data, kc.lengths), None
+    if isinstance(kc.dtype, dt.StructType):
+        # struct keys: concatenate each field's surrogate words, folding
+        # the per-field null and NaN flags in as words of their own —
+        # equality over the flattened word list == struct equality
+        # (reference: struct group-by keys, TypeChecks.scala:166 nesting)
+        words: "List[jax.Array]" = []
+        for child in kc.children:
+            words.append(jnp.logical_not(child.validity))
+            cw, nan = _key_code_words(child)
+            # zero the value words of null fields so all null-field rows
+            # group together regardless of the plane's stale contents
+            words.extend(jnp.where(child.validity, w,
+                                   jnp.zeros_like(w)) for w in cw)
+            if nan is not None:
+                words.append(jnp.logical_and(nan, child.validity))
+        return words, None
     if dt.is_d128(kc.dtype):
         from ..expr.decimal128 import d128_key_words
         return d128_key_words(kc.data), None
@@ -426,14 +442,11 @@ class TpuHashAggregateExec(TpuExec):
             iota = jnp.arange(cap, dtype=jnp.int32)
             group_mask = iota < num_groups
             for kc in key_cols:
-                sv = jnp.take(kc.data, order, axis=0)
-                svalid = jnp.take(kc.validity, order)
-                gv = jnp.take(sv, rep, axis=0)
-                gvalid = jnp.logical_and(jnp.take(svalid, rep), group_mask)
-                glen = None
-                if kc.lengths is not None:
-                    glen = jnp.take(jnp.take(kc.lengths, order), rep)
-                out_cols.append(DeviceColumn(gv, gvalid, kc.dtype, glen))
+                # representative-row gather; DeviceColumn.gather recurses
+                # into struct children and the element-validity plane
+                g = kc.gather(order).gather(rep)
+                out_cols.append(g.with_validity(
+                    jnp.logical_and(g.validity, group_mask)))
             # ---- state reductions
             for in_col, op, out_col, out_dt in cols_ops:
                 col = table.column(in_col)
@@ -646,17 +659,34 @@ class _SchemaOnly:
 
 
 def _empty_device_table(schema: Schema, cap: int) -> DeviceTable:
-    cols = []
-    for f in schema:
-        if isinstance(f.dtype, (dt.StringType, dt.BinaryType)):
+    def empty_col(d: dt.DataType) -> DeviceColumn:
+        kids = None
+        if isinstance(d, (dt.StringType, dt.BinaryType)):
             data = jnp.zeros((cap, 8), dtype=jnp.uint8)
             lengths = jnp.zeros(cap, dtype=jnp.int32)
-        elif dt.is_d128(f.dtype):
+        elif dt.is_d128(d):
             data = jnp.zeros((cap, 2), dtype=jnp.int64)
             lengths = None
-        else:
-            data = jnp.zeros(cap, dtype=f.dtype.np_dtype())
+        elif isinstance(d, dt.ArrayType):
+            np_dt = jnp.bool_ if isinstance(d.element_type, dt.BooleanType) \
+                else d.element_type.np_dtype()
+            data = jnp.zeros((cap, 4), dtype=np_dt)
+            lengths = jnp.zeros(cap, dtype=jnp.int32)
+        elif isinstance(d, dt.StructType):
+            data = jnp.zeros(cap, dtype=jnp.uint8)
             lengths = None
-        cols.append(DeviceColumn(data, jnp.zeros(cap, dtype=bool), f.dtype, lengths))
+            kids = tuple(empty_col(f.data_type) for f in d.fields)
+        elif isinstance(d, dt.MapType):
+            data = jnp.zeros(cap, dtype=jnp.uint8)
+            lengths = None
+            kids = (empty_col(dt.ArrayType(d.key_type, False)),
+                    empty_col(dt.ArrayType(d.value_type, True)))
+        else:
+            data = jnp.zeros(cap, dtype=d.np_dtype())
+            lengths = None
+        return DeviceColumn(data, jnp.zeros(cap, dtype=bool), d, lengths,
+                            None, kids)
+
+    cols = [empty_col(f.dtype) for f in schema]
     return DeviceTable(tuple(cols), jnp.zeros(cap, dtype=bool),
                        jnp.asarray(0, jnp.int32), tuple(schema.names))

@@ -38,12 +38,15 @@ def eval_exprs_device(table: DeviceTable, exprs: Sequence[Expression],
             validity = jnp.ones(table.capacity, dtype=bool)
         values = c.values
         if not isinstance(c.dtype, (dt.StringType, dt.BinaryType,
-                                    dt.ArrayType)):
+                                    dt.ArrayType, dt.StructType,
+                                    dt.MapType)):
             want = c.dtype.np_dtype()
             if values.dtype != want:
                 values = values.astype(want)
+        kids = None if c.children is None \
+            else tuple(ctx.to_device_column(k) for k in c.children)
         cols.append(DeviceColumn(values, validity, c.dtype, c.lengths,
-                                 c.elem_validity))
+                                 c.elem_validity, kids))
     return DeviceTable(tuple(cols), table.row_mask, table.num_rows, tuple(names))
 
 

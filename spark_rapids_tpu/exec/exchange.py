@@ -70,7 +70,9 @@ def pad_table_capacity(table: DeviceTable, capacity: int) -> DeviceTable:
             jnp.pad(c.validity, (0, extra)), c.dtype,
             None if c.lengths is None else jnp.pad(c.lengths, (0, extra)),
             None if c.elem_validity is None
-            else jnp.pad(c.elem_validity, ((0, extra), (0, 0))))
+            else jnp.pad(c.elem_validity, ((0, extra), (0, 0))),
+            None if c.children is None
+            else tuple(pad_col(k) for k in c.children))
 
     return DeviceTable(tuple(pad_col(c) for c in table.columns),
                        jnp.pad(table.row_mask, (0, extra)),
@@ -292,18 +294,25 @@ def _split_sharded(table: DeviceTable, n: int) -> List[Optional[DeviceTable]]:
         return [s.data for s in shards]
 
     mask_parts = parts(table.row_mask)
-    col_parts = []
-    for c in table.columns:
-        col_parts.append((parts(c.data), parts(c.validity),
-                          None if c.lengths is None else parts(c.lengths),
-                          None if c.elem_validity is None
-                          else parts(c.elem_validity)))
+
+    def split_col(c: DeviceColumn) -> List[DeviceColumn]:
+        d = parts(c.data)
+        v = parts(c.validity)
+        l = None if c.lengths is None else parts(c.lengths)
+        e = None if c.elem_validity is None else parts(c.elem_validity)
+        kids = None if c.children is None \
+            else [split_col(k) for k in c.children]
+        return [DeviceColumn(d[i], v[i], c.dtype,
+                             None if l is None else l[i],
+                             None if e is None else e[i],
+                             None if kids is None
+                             else tuple(ks[i] for ks in kids))
+                for i in range(n)]
+
+    col_parts = [split_col(c) for c in table.columns]
     out: List[Optional[DeviceTable]] = []
     for i in range(n):
-        cols = tuple(
-            DeviceColumn(d[i], v[i], c.dtype, None if l is None else l[i],
-                         None if e is None else e[i])
-            for (d, v, l, e), c in zip(col_parts, table.columns))
+        cols = tuple(cp[i] for cp in col_parts)
         mask = mask_parts[i]
         out.append(DeviceTable(cols, mask, jnp.sum(mask, dtype=jnp.int32),
                                table.names))

@@ -38,6 +38,7 @@ class EvalCol:
     dtype: dt.DataType
     lengths: Any = None         # device strings/arrays only
     elem_validity: Any = None   # device arrays with null elements only
+    children: Any = None        # device struct/map child EvalCols (tuple)
 
     def valid_mask(self, ctx: "EvalContext"):
         if self.validity is None:
@@ -77,9 +78,14 @@ class EvalContext:
     def for_device(table: DeviceTable, partition_id: int = 0,
                    batch_row_offset: int = 0) -> "EvalContext":
         import jax.numpy as jnp
-        cols = {n: EvalCol(c.data, c.validity, c.dtype, c.lengths,
-                           c.elem_validity)
-                for n, c in zip(table.names, table.columns)}
+
+        def to_eval(c: DeviceColumn) -> EvalCol:
+            kids = None if c.children is None \
+                else tuple(to_eval(k) for k in c.children)
+            return EvalCol(c.data, c.validity, c.dtype, c.lengths,
+                           c.elem_validity, kids)
+
+        cols = {n: to_eval(c) for n, c in zip(table.names, table.columns)}
         return EvalContext(True, jnp, cols, table.capacity, table.row_mask,
                            partition_id=partition_id,
                            batch_row_offset=batch_row_offset)
@@ -96,8 +102,10 @@ class EvalContext:
         validity = col.validity
         if validity is None:
             validity = self.xp.ones(col.values.shape[0], dtype=bool)
+        kids = None if col.children is None \
+            else tuple(self.to_device_column(k) for k in col.children)
         return DeviceColumn(col.values, validity, col.dtype, col.lengths,
-                            col.elem_validity)
+                            col.elem_validity, kids)
 
 
 class Expression:

@@ -89,6 +89,30 @@ def _host_only(ctx: EvalContext, what: str):
             "prevented device lowering)")
 
 
+def _device_map_lookup(ctx: EvalContext, m: EvalCol, k: EvalCol,
+                       out_dt: dt.DataType) -> EvalCol:
+    """Vectorized map[key]: first matching key slot's value, null when
+    absent (reference: GpuGetMapValue / map-side GpuElementAt)."""
+    xp = ctx.xp
+    kc, vc = m.children
+    keys = kc.values                     # (n, W) fixed-width keys
+    w = keys.shape[1]
+    in_len = xp.arange(w, dtype=xp.int32)[None, :] < kc.lengths[:, None]
+    eq = xp.logical_and(keys == k.values[:, None].astype(keys.dtype), in_len)
+    if kc.elem_validity is not None:     # null keys never match
+        eq = xp.logical_and(eq, kc.elem_validity)
+    found = xp.any(eq, axis=1)
+    idx = xp.argmax(eq, axis=1)
+    vals = xp.take_along_axis(vc.values, idx[:, None], axis=1)[:, 0]
+    valid = xp.logical_and(m.valid_mask(ctx), k.valid_mask(ctx))
+    valid = xp.logical_and(valid, found)
+    if vc.elem_validity is not None:
+        valid = xp.logical_and(valid, xp.take_along_axis(
+            vc.elem_validity, idx[:, None], axis=1)[:, 0])
+    vals = xp.where(valid, vals, xp.zeros((), vals.dtype))
+    return EvalCol(vals, valid, out_dt)
+
+
 # Device list layout (first nested slice; reference: cuDF list columns,
 # TypeChecks.scala:166 per-op nesting): EvalCol.values is a (rows, W)
 # element matrix, EvalCol.lengths the per-row list length; element nulls
@@ -129,7 +153,19 @@ class CreateArray(Expression):
         return False
 
     def eval(self, ctx: EvalContext) -> EvalCol:
-        _host_only(ctx, "array()")
+        if ctx.is_device:
+            xp = ctx.xp
+            cols = [c.eval(ctx) for c in self.children]
+            et = self.data_type.element_type
+            np_dt = np.bool_ if isinstance(et, dt.BooleanType) \
+                else et.np_dtype()
+            mat = xp.stack([c.values.astype(np_dt) for c in cols], axis=1)
+            n = mat.shape[0]
+            lens = xp.full((n,), len(cols), dtype=xp.int32)
+            ev = None
+            if any(c.validity is not None for c in cols):
+                ev = xp.stack([c.valid_mask(ctx) for c in cols], axis=1)
+            return EvalCol(mat, None, self.data_type, lens, ev)
         cols = [c.eval(ctx) for c in self.children]
         per_child = [_rows(ctx, c) for c in cols]
         n = ctx.num_rows
@@ -168,7 +204,12 @@ class CreateNamedStruct(Expression):
         return False
 
     def eval(self, ctx: EvalContext) -> EvalCol:
-        _host_only(ctx, "named_struct()")
+        if ctx.is_device:
+            xp = ctx.xp
+            kids = tuple(e.eval(ctx) for e in self.value_exprs)
+            n = kids[0].shape0(ctx) if kids else ctx.num_rows
+            return EvalCol(xp.zeros(n, dtype=xp.uint8), None,
+                           self.data_type, children=kids)
         names = self.field_names
         cols = [_rows(ctx, v.eval(ctx)) for v in self.value_exprs]
         n = ctx.num_rows
@@ -234,7 +275,69 @@ class CreateMap(Expression):
         return False
 
     def eval(self, ctx: EvalContext) -> EvalCol:
-        _host_only(ctx, "map()")
+        if ctx.is_device:
+            # LAST_WIN only (tag-gated): EXCEPTION needs a data-dependent
+            # raise, which a traced kernel cannot express — the reference
+            # throws from inside the kernel (GpuCreateMap)
+            xp = ctx.xp
+            kcols = [k.eval(ctx) for k in self.children[0::2]]
+            vcols = [v.eval(ctx) for v in self.children[1::2]]
+            K = len(kcols)
+            n = kcols[0].shape0(ctx) if kcols else ctx.num_rows
+            mt: dt.MapType = self.data_type
+            knp = np.bool_ if isinstance(mt.key_type, dt.BooleanType) \
+                else mt.key_type.np_dtype()
+            vnp = np.bool_ if isinstance(mt.value_type, dt.BooleanType) \
+                else mt.value_type.np_dtype()
+            km = xp.stack([c.values.astype(knp) for c in kcols], axis=1)
+            vm = xp.stack([c.values.astype(vnp) for c in vcols], axis=1)
+            if xp.issubdtype(km.dtype, xp.floating):  # Spark normalizers
+                km = xp.where(km == 0, xp.zeros_like(km), km)
+            # last-wins dedup with dict semantics (host parity): a key keeps
+            # its FIRST slot's position but takes its LAST slot's value
+            # (NaN keys canonicalize: NaN == NaN here)
+            def same_key(a, b):
+                s = a == b
+                if xp.issubdtype(km.dtype, xp.floating):
+                    s = xp.logical_or(s, xp.logical_and(xp.isnan(a),
+                                                        xp.isnan(b)))
+                return s
+
+            keep = xp.ones((n, K), dtype=bool)
+            vvm_in = xp.stack([c.valid_mask(ctx) for c in vcols], axis=1)
+            vlast = vm
+            vvlast = vvm_in
+            for j in range(K):
+                for j2 in range(j):        # an earlier same key: drop j
+                    keep = keep.at[:, j].set(xp.logical_and(
+                        keep[:, j],
+                        xp.logical_not(same_key(km[:, j], km[:, j2]))))
+                for j2 in range(j + 1, K):  # a later same key: its value wins
+                    s = same_key(km[:, j], km[:, j2])
+                    vlast = vlast.at[:, j].set(
+                        xp.where(s, vlast[:, j2], vlast[:, j]))
+                    vvlast = vvlast.at[:, j].set(
+                        xp.where(s, vvlast[:, j2], vvlast[:, j]))
+            vm = vlast
+            dest = xp.cumsum(keep.astype(xp.int32), axis=1) - 1
+            dest = xp.where(keep, dest, K)
+            rix = xp.broadcast_to(
+                xp.arange(n, dtype=xp.int32)[:, None], (n, K))
+            ko = xp.zeros((n, K + 1), km.dtype).at[rix, dest] \
+                .set(km, mode="drop")[:, :K]
+            vo = xp.zeros((n, K + 1), vm.dtype).at[rix, dest] \
+                .set(vm, mode="drop")[:, :K]
+            lens = keep.sum(axis=1).astype(xp.int32)
+            vev = None
+            if any(c.validity is not None for c in vcols):
+                vev = xp.ones((n, K + 1), dtype=bool).at[rix, dest] \
+                    .set(vvlast, mode="drop")[:, :K]
+            kc = EvalCol(ko, None, dt.ArrayType(mt.key_type, False), lens)
+            vc = EvalCol(vo, None,
+                         dt.ArrayType(mt.value_type, mt.value_contains_null),
+                         lens, vev)
+            return EvalCol(xp.zeros(n, dtype=xp.uint8), None,
+                           self.data_type, children=(kc, vc))
         keys = [_rows(ctx, k.eval(ctx)) for k in self.children[0::2]]
         vals = [_rows(ctx, v.eval(ctx)) for v in self.children[1::2]]
         n = ctx.num_rows
@@ -323,8 +426,12 @@ class ElementAt(Expression):
 
     def eval(self, ctx: EvalContext) -> EvalCol:
         if ctx.is_device:
-            # ARRAY only (maps gated to host); literal key != 0 enforced at
-            # tag time (k == 0 raises data-dependently on the host path)
+            if isinstance(self.children[0].data_type, dt.MapType):
+                return _device_map_lookup(ctx, self.children[0].eval(ctx),
+                                          self.children[1].eval(ctx),
+                                          self.data_type)
+            # literal array index != 0 enforced at tag time (k == 0 raises
+            # data-dependently on the host path)
             xp = ctx.xp
             arr = self.children[0].eval(ctx)
             k = self.children[1].eval(ctx)
@@ -379,7 +486,22 @@ class GetStructField(Expression):
         raise KeyError(f"no struct field {self.field!r} in {st!r}")
 
     def eval(self, ctx: EvalContext) -> EvalCol:
-        _host_only(ctx, "struct field access")
+        if ctx.is_device:
+            # struct-of-planes: field access is a plane select + validity
+            # AND (reference: complexTypeExtractors.scala GetStructField;
+            # device layout cites GpuColumnVector nested children)
+            xp = ctx.xp
+            st = self.children[0].eval(ctx)
+            idx = [f.name for f in self.children[0].data_type.fields] \
+                .index(self.field)
+            f = st.children[idx]
+            fvalid = f.validity
+            if fvalid is None:
+                fvalid = st.valid_mask(ctx)
+            else:
+                fvalid = xp.logical_and(fvalid, st.valid_mask(ctx))
+            return EvalCol(f.values, fvalid, self.data_type, f.lengths,
+                           f.elem_validity, f.children)
         rows = _rows(ctx, self.children[0].eval(ctx))
         out = [None if r is None else r.get(self.field) for r in rows]
         return _from_rows(out, self.data_type)
@@ -394,7 +516,10 @@ class GetMapValue(Expression):
         return self.children[0].data_type.value_type
 
     def eval(self, ctx: EvalContext) -> EvalCol:
-        _host_only(ctx, "map value access")
+        if ctx.is_device:
+            return _device_map_lookup(ctx, self.children[0].eval(ctx),
+                                      self.children[1].eval(ctx),
+                                      self.data_type)
         maps = _rows(ctx, self.children[0].eval(ctx))
         keys = _rows(ctx, self.children[1].eval(ctx))
         out = [None if m is None or k is None else dict(m).get(k)
@@ -411,7 +536,11 @@ class MapKeys(Expression):
         return dt.ArrayType(self.children[0].data_type.key_type, False)
 
     def eval(self, ctx: EvalContext) -> EvalCol:
-        _host_only(ctx, "map_keys")
+        if ctx.is_device:
+            m = self.children[0].eval(ctx)
+            kc = m.children[0]
+            return EvalCol(kc.values, m.valid_mask(ctx), self.data_type,
+                           kc.lengths, kc.elem_validity)
         rows = _rows(ctx, self.children[0].eval(ctx))
         out = [None if r is None else [k for k, _ in r] for r in rows]
         return _from_rows(out, self.data_type)
@@ -427,7 +556,11 @@ class MapValues(Expression):
         return dt.ArrayType(t.value_type, t.value_contains_null)
 
     def eval(self, ctx: EvalContext) -> EvalCol:
-        _host_only(ctx, "map_values")
+        if ctx.is_device:
+            m = self.children[0].eval(ctx)
+            vc = m.children[1]
+            return EvalCol(vc.values, m.valid_mask(ctx), self.data_type,
+                           vc.lengths, vc.elem_validity)
         rows = _rows(ctx, self.children[0].eval(ctx))
         out = [None if r is None else [v for _, v in r] for r in rows]
         return _from_rows(out, self.data_type)
@@ -460,7 +593,9 @@ class Size(Expression):
             xp = ctx.xp
             arr = self.children[0].eval(ctx)
             valid = arr.valid_mask(ctx)
-            lens = arr.lengths.astype(xp.int32)
+            lengths = arr.children[0].lengths \
+                if isinstance(arr.dtype, dt.MapType) else arr.lengths
+            lens = lengths.astype(xp.int32)
             if self.legacy:
                 return EvalCol(xp.where(valid, lens, -1), None, dt.INT)
             return EvalCol(xp.where(valid, lens, 0), valid, dt.INT)

@@ -116,6 +116,12 @@ class CsvSource(DataSource):
     def schema(self) -> Schema:
         return self._schema
 
+    def sample_head(self, nbytes: int = 1 << 16) -> bytes:
+        """First bytes of the first file — quote sniffing for the device
+        decoder gate (exec/scan.py TpuCsvScanExec)."""
+        with open(self.files[0], "rb") as f:
+            return f.read(nbytes)
+
     def partitions(self) -> int:
         return len(self._file_parts)
 

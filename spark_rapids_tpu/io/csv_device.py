@@ -1,0 +1,185 @@
+"""Device CSV decode: newline split on host, field split + typed parse as
+byte-matrix kernels on the accelerator (round-4 VERDICT item 4; reference:
+GpuTextBasedPartitionReader.scala:44 host line framing + device parse,
+GpuCSVScan per-type enables RapidsConf.scala:877-917).
+
+TPU-first shape discipline: one CSV batch becomes a (rows, W) uint8 line
+matrix (W = bucketed max line width). Field k of every row is carved out by
+a cumulative separator count + one scatter, giving each column its own
+(rows, W) byte matrix that feeds the existing string->{long,double,bool,
+date} cast kernels (expr/cast_kernels.py) — the whole decode is one jitted
+program per (schema, bucket) signature.
+
+Unsupported on device (host pyarrow fallback, tag-time): quoted fields,
+timestamp columns, multi-char separators.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..columnar import dtypes as dt
+from ..conf import register_conf
+
+CSV_DEVICE_DECODE = register_conf(
+    "spark.rapids.tpu.csv.deviceDecode.enabled",
+    "Decode CSV scans on the accelerator (field split + numeric/date parse "
+    "as byte-matrix kernels). Quoted fields and timestamp columns fall "
+    "back to the host reader (reference: GpuTextBasedPartitionReader).",
+    True)
+
+__all__ = ["CSV_DEVICE_DECODE", "split_lines", "decode_lines",
+           "device_decodable_reason"]
+
+
+def device_decodable_reason(schema, sep: str, header_sample: bytes,
+                            explicit_schema: bool = False) -> Optional[str]:
+    """None when the device decoder can handle this scan, else the reason."""
+    if len(sep) != 1:
+        return f"multi-char separator {sep!r}"
+    if b'"' in header_sample:
+        return "quoted fields use the host reader"
+    if explicit_schema:
+        # the host reader RAISES on malformed cells under an explicit
+        # schema; a traced kernel cannot, so keep those scans host-side
+        return "explicit schema (host reader enforces parse errors)"
+    for f in schema:
+        d = f.dtype
+        if isinstance(d, dt.TimestampType):
+            return f"timestamp column {f.name} parses on the host"
+        if not isinstance(d, (dt.StringType, dt.BooleanType, dt.ByteType,
+                              dt.ShortType, dt.IntegerType, dt.LongType,
+                              dt.FloatType, dt.DoubleType, dt.DateType)):
+            return f"column {f.name}: {d!r} has no device CSV parser"
+    return None
+
+
+def split_lines(raw: bytes, skip_header: bool) -> Tuple[np.ndarray,
+                                                        np.ndarray]:
+    """File bytes -> (line starts, line lengths) without copying the blob.
+
+    Vectorized newline scan; \\r\\n normalized; a trailing unterminated
+    line is kept; trailing empty line dropped."""
+    buf = np.frombuffer(raw, dtype=np.uint8)
+    nl = np.flatnonzero(buf == ord("\n"))
+    starts = np.concatenate([np.zeros(1, dtype=np.int64), nl + 1])
+    ends = np.concatenate([nl, np.asarray([len(buf)], dtype=np.int64)])
+    keep = starts < ends
+    keep[-1] = keep[-1] and starts[-1] < len(buf)
+    starts, ends = starts[keep], ends[keep]
+    # strip \r
+    has_cr = np.zeros(len(starts), dtype=bool)
+    if len(starts):
+        has_cr = buf[np.clip(ends - 1, 0, len(buf) - 1)] == ord("\r")
+    lengths = ends - starts - has_cr.astype(np.int64)
+    if skip_header and len(starts):
+        starts, lengths = starts[1:], lengths[1:]
+    return starts, lengths
+
+
+def lines_to_matrix(raw: bytes, starts: np.ndarray, lengths: np.ndarray,
+                    capacity: int, width: int) -> np.ndarray:
+    """Gather line bytes into a (capacity, width) matrix (host side)."""
+    buf = np.frombuffer(raw, dtype=np.uint8)
+    n = len(starts)
+    mat = np.zeros((capacity, width), dtype=np.uint8)
+    total = int(lengths.sum())
+    if total:
+        rows = np.repeat(np.arange(n, dtype=np.int64), lengths)
+        prefix = np.cumsum(lengths) - lengths
+        cols = np.arange(total, dtype=np.int64) - np.repeat(prefix, lengths)
+        mat[rows, cols] = buf[np.repeat(starts, lengths) + cols]
+    return mat
+
+
+def _null_token_mask(fmat, flen):
+    """True where the field equals one of pyarrow's default CSV null
+    tokens ('', 'NULL', 'NaN', 'n/a', ... — exact byte match), keeping
+    host-reader parity: the host engine reads via pyarrow, which nulls
+    these for EVERY column type, including 'NaN' for doubles."""
+    import jax.numpy as jnp
+    import pyarrow.csv as pacsv
+    rows, w = fmat.shape
+    isnull = flen == 0
+    for tok in pacsv.ConvertOptions().null_values:
+        t = tok.encode()
+        if not t or len(t) > w:
+            continue
+        tv = np.zeros(w, dtype=np.uint8)
+        tv[:len(t)] = np.frombuffer(t, dtype=np.uint8)
+        eq = jnp.all(fmat[:, :len(t)] == jnp.asarray(tv[:len(t)])[None, :],
+                     axis=1)
+        isnull = jnp.logical_or(
+            isnull, jnp.logical_and(eq, flen == len(t)))
+    return isnull
+
+
+def decode_lines(mat, lengths, fields: List[Tuple[str, dt.DataType]],
+                 sep: int, col_indices: List[int]):
+    """Jit-traceable: (rows, W) line matrix -> per-column (values, validity
+    [, field matrix + lengths for strings]).
+
+    Returns a list aligned with ``col_indices``: string columns yield
+    (field matrix, validity, field lengths); scalar columns yield
+    (values, validity) — callers branch on the static dtype."""
+    import jax.numpy as jnp
+
+    from ..expr.cast_kernels import (string_to_bool_device,
+                                     string_to_date_device,
+                                     string_to_double_device,
+                                     string_to_long_device)
+    rows, w = mat.shape
+    j = jnp.arange(w, dtype=jnp.int32)
+    in_line = j[None, :] < lengths[:, None]
+    sep_mask = jnp.logical_and(mat == np.uint8(sep), in_line)
+    # field id of each byte = number of separators strictly before it
+    cum = jnp.cumsum(sep_mask.astype(jnp.int32), axis=1)
+    field_id = cum - sep_mask.astype(jnp.int32)
+    nfields = 1 + cum[:, -1]
+    rix = jnp.broadcast_to(jnp.arange(rows, dtype=jnp.int32)[:, None],
+                           (rows, w))
+
+    out = []
+    for k in col_indices:
+        name, d = fields[k]
+        content = jnp.logical_and(
+            jnp.logical_and(field_id == k, jnp.logical_not(sep_mask)),
+            in_line)
+        flen = content.sum(axis=1).astype(jnp.int32)
+        any_c = jnp.any(content, axis=1)
+        fstart = jnp.where(any_c, jnp.argmax(content, axis=1), 0) \
+            .astype(jnp.int32)
+        dest = jnp.where(content, j - fstart[:, None], w)
+        fmat = jnp.zeros((rows, w + 1), jnp.uint8) \
+            .at[rix, dest].set(mat, mode="drop")[:, :w]
+        # a row with fewer fields than k+1 yields a MISSING field -> null
+        present = nfields > k
+        not_null_tok = jnp.logical_not(_null_token_mask(fmat, flen))
+        if isinstance(d, dt.StringType):
+            # null tokens ('', 'NULL', 'NaN', ...) -> null (pyarrow
+            # strings_can_be_null=True parity with the host reader)
+            valid = jnp.logical_and(present, not_null_tok)
+            out.append((fmat, valid, flen))
+            continue
+        if isinstance(d, dt.BooleanType):
+            vals, ok = string_to_bool_device(fmat, flen)
+        elif isinstance(d, (dt.ByteType, dt.ShortType, dt.IntegerType,
+                            dt.LongType)):
+            vals, ok = string_to_long_device(fmat, flen)
+            info = np.iinfo(d.np_dtype())
+            ok = jnp.logical_and(
+                ok, jnp.logical_and(vals >= info.min, vals <= info.max))
+            vals = vals.astype(d.np_dtype())
+        elif isinstance(d, (dt.FloatType, dt.DoubleType)):
+            vals, ok = string_to_double_device(fmat, flen)
+            vals = vals.astype(d.np_dtype())
+        elif isinstance(d, dt.DateType):
+            vals, ok = string_to_date_device(fmat, flen)
+        else:  # pragma: no cover - gated by device_decodable_reason
+            raise TypeError(f"no device CSV parser for {d!r}")
+        # null tokens -> null (not a parse error); malformed -> null too
+        valid = jnp.logical_and(jnp.logical_and(present, not_null_tok), ok)
+        vals = jnp.where(valid, vals, jnp.zeros((), vals.dtype))
+        out.append((vals, valid))
+    return out

@@ -21,8 +21,12 @@ class InMemorySource(DataSource):
         self.batch_rows = batch_rows
         self._decoded = {}  # (pidx, columns) -> List[HostTable]
         ht = HostTable.from_arrow(table.slice(0, 0))
+        # trust declared nullability only when the data agrees: pyarrow
+        # does not validate nullable=False against the arrays, and device
+        # gates (e.g. map() null-key rejection) rely on this bit
         self._schema = Schema([
-            Field(n, c.dtype, table.column(i).null_count > 0 or True)
+            Field(n, c.dtype, table.schema.field(i).nullable
+                  or table.column(i).null_count > 0)
             for i, (n, c) in enumerate(zip(ht.names, ht.columns))])
 
     def schema(self) -> Schema:

@@ -38,37 +38,53 @@ class StorageTier:
     NAMES = {0: "DEVICE", 1: "HOST", 2: "DISK"}
 
 
+def _flatten_column(c: DeviceColumn, key: str, arrays: dict) -> dict:
+    """Column -> numpy planes under ``key``-prefixed names + descriptor
+    (recurses into struct/map children)."""
+    arrays[f"{key}.data"] = np.asarray(c.data)
+    arrays[f"{key}.validity"] = np.asarray(c.validity)
+    desc = {"dtype": c.dtype, "lengths": c.lengths is not None,
+            "ev": c.elem_validity is not None, "children": None}
+    if c.lengths is not None:
+        arrays[f"{key}.lengths"] = np.asarray(c.lengths)
+    if c.elem_validity is not None:
+        arrays[f"{key}.ev"] = np.asarray(c.elem_validity)
+    if c.children is not None:
+        desc["children"] = [
+            _flatten_column(k, f"{key}.c{j}", arrays)
+            for j, k in enumerate(c.children)]
+    return desc
+
+
+def _unflatten_column(desc: dict, key: str, arrays: dict) -> DeviceColumn:
+    import jax.numpy as jnp
+    lengths = jnp.asarray(arrays[f"{key}.lengths"]) if desc["lengths"] \
+        else None
+    ev = jnp.asarray(arrays[f"{key}.ev"]) if desc["ev"] else None
+    kids = None
+    if desc["children"] is not None:
+        kids = tuple(_unflatten_column(d, f"{key}.c{j}", arrays)
+                     for j, d in enumerate(desc["children"]))
+    return DeviceColumn(jnp.asarray(arrays[f"{key}.data"]),
+                        jnp.asarray(arrays[f"{key}.validity"]),
+                        desc["dtype"], lengths, ev, kids)
+
+
 def _table_to_host_arrays(table: DeviceTable) -> Tuple[dict, dict]:
     """Flatten a DeviceTable into numpy arrays + static metadata."""
     arrays = {}
-    meta = {"names": list(table.names), "dtypes": [], "has_lengths": [],
-            "has_ev": []}
+    meta = {"names": list(table.names), "cols": []}
     arrays["row_mask"] = np.asarray(table.row_mask)
     arrays["num_rows"] = np.asarray(table.num_rows)
     for i, c in enumerate(table.columns):
-        arrays[f"data{i}"] = np.asarray(c.data)
-        arrays[f"validity{i}"] = np.asarray(c.validity)
-        meta["dtypes"].append(c.dtype)
-        meta["has_lengths"].append(c.lengths is not None)
-        meta["has_ev"].append(c.elem_validity is not None)
-        if c.lengths is not None:
-            arrays[f"lengths{i}"] = np.asarray(c.lengths)
-        if c.elem_validity is not None:
-            arrays[f"ev{i}"] = np.asarray(c.elem_validity)
+        meta["cols"].append(_flatten_column(c, f"col{i}", arrays))
     return arrays, meta
 
 
 def _host_arrays_to_table(arrays: dict, meta: dict) -> DeviceTable:
     import jax.numpy as jnp
-    cols = []
-    has_ev = meta.get("has_ev", [False] * len(meta["dtypes"]))
-    for i, d in enumerate(meta["dtypes"]):
-        lengths = jnp.asarray(arrays[f"lengths{i}"]) \
-            if meta["has_lengths"][i] else None
-        ev = jnp.asarray(arrays[f"ev{i}"]) if has_ev[i] else None
-        cols.append(DeviceColumn(jnp.asarray(arrays[f"data{i}"]),
-                                 jnp.asarray(arrays[f"validity{i}"]),
-                                 d, lengths, ev))
+    cols = [_unflatten_column(d, f"col{i}", arrays)
+            for i, d in enumerate(meta["cols"])]
     # num_rows must restore as a 0-d scalar (memory-mapped .npy loads
     # promote 0-d arrays to shape (1,))
     return DeviceTable(tuple(cols), jnp.asarray(arrays["row_mask"]),

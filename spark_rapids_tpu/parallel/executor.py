@@ -61,11 +61,24 @@ class ExecutorContext:
         self.initialized = True
         return self
 
+    def dcn_transport(self):
+        """Lazily-created DCN-tier transport (device-resident blocks,
+        TCP wire between worker processes — shuffle/dcn.py
+        TcpDcnShuffleTransport)."""
+        if getattr(self, "_dcn", None) is None:
+            from ..shuffle.dcn import TcpDcnShuffleTransport
+            self._dcn = TcpDcnShuffleTransport(self.conf,
+                                               catalog=self.catalog)
+        return self._dcn
+
     def heartbeat(self):
         if self.shuffle is not None:
             self.shuffle.heartbeats.heartbeat(self.executor_id)
 
     def shutdown(self):
+        if getattr(self, "_dcn", None) is not None:
+            self._dcn.close()
+            self._dcn = None
         if self.shuffle is not None:
             # free device-resident shuffle blocks (the catalog would
             # otherwise hold them for the process lifetime)

@@ -268,6 +268,40 @@ def shuffle_write_task(ctx: ExecutorContext, shuffle_id: int, map_id: int,
                                        key_names, num_parts)
 
 
+def dcn_address_task(ctx: ExecutorContext) -> tuple:
+    """Start (if needed) the worker's DCN-tier transport; -> (host, port)."""
+    return ctx.dcn_transport().address
+
+
+def dcn_add_peer_task(ctx: ExecutorContext, host: str, port: int) -> None:
+    ctx.dcn_transport().add_peer(host, port)
+
+
+def dcn_publish_task(ctx: ExecutorContext, shuffle_id: int, map_id: int,
+                     reduce_id: int, payload: bytes) -> int:
+    """Upload the payload table and publish it DEVICE-RESIDENT on this
+    worker's DCN transport (serialization to the wire is lazy)."""
+    from ..columnar.device import DeviceTable
+    from ..shuffle.serializer import deserialize_table
+    from ..shuffle.transport import BlockId
+    table = DeviceTable.from_host(deserialize_table(payload), min_bucket=8)
+    ctx.dcn_transport().publish_table(
+        BlockId(shuffle_id, map_id, reduce_id), table)
+    return int(table.num_rows)
+
+
+def dcn_fetch_task(ctx: ExecutorContext, shuffle_id: int, map_id: int,
+                   reduce_id: int) -> bytes:
+    """Fetch one block over the DCN tier; returns its serialized rows (for
+    test verification — the table itself lands device-resident)."""
+    from ..shuffle.serializer import serialize_table
+    from ..shuffle.transport import BlockId
+    blocks = dict(ctx.dcn_transport().fetch_tables(
+        [BlockId(shuffle_id, map_id, reduce_id)]))
+    table = blocks[BlockId(shuffle_id, map_id, reduce_id)]
+    return serialize_table(table.to_host())
+
+
 def shuffle_read_task(ctx: ExecutorContext, shuffle_id: int, num_maps: int,
                       reduce_id: int) -> Optional[bytes]:
     from ..shuffle.serializer import serialize_table

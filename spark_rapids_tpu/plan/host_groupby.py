@@ -24,21 +24,39 @@ from ..columnar.host import HostColumn, HostTable
 __all__ = ["group_codes", "host_group_reduce"]
 
 
+def _hashable_key(v):
+    """Nested value -> hashable canonical form with Spark grouping
+    semantics: dict/list become tuples, NaN groups with NaN, -0.0 == 0.0."""
+    if isinstance(v, dict):
+        return tuple((k, _hashable_key(x)) for k, x in v.items())
+    if isinstance(v, (list, tuple, np.ndarray)):
+        return tuple(_hashable_key(x) for x in v)
+    if isinstance(v, (float, np.floating)):
+        v = float(v)
+        if v != v:
+            return ("__nan__",)
+        if v == 0.0:
+            return 0.0
+    return v
+
+
 def object_codes(vals: np.ndarray) -> np.ndarray:
     """factorize for object arrays; falls back to a dict-based pass when
     pandas' C-string hashtable would conflate values differing only by an
-    embedded NUL byte ("ab" vs "ab\\x00")."""
-    has_nul = any(
-        (isinstance(v, str) and "\x00" in v)
+    embedded NUL byte ("ab" vs "ab\\x00"), or when values are nested
+    (dict/list struct-map-array keys are not hashable as-is)."""
+    needs_fallback = any(
+        isinstance(v, (dict, list, np.ndarray))
+        or (isinstance(v, str) and "\x00" in v)
         or (isinstance(v, bytes) and b"\x00" in v)
         for v in vals)
-    if not has_nul:
+    if not needs_fallback:
         from ..shims import get_shims
         return get_shims().factorize(vals)[0].astype(np.int64)
     table: dict = {}
     out = np.empty(len(vals), dtype=np.int64)
     for i, v in enumerate(vals):
-        out[i] = table.setdefault(v, len(table))
+        out[i] = table.setdefault(_hashable_key(v), len(table))
     return out
 
 

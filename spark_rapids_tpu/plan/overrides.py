@@ -35,11 +35,20 @@ _device_common = (TypeSig.gpuNumeric
                                TypeEnum.TIMESTAMP, TypeEnum.NULL))
 _device_all = _device_common + TypeSig.of(TypeEnum.STRING, TypeEnum.BINARY)
 # fixed-width element types storable in the device list layout (values
-# matrix + lengths; containsNull=false — TypeSig.with_arrays enforces it)
+# matrix + lengths + optional element-validity plane)
 _array_elem = TypeSig.integral + TypeSig.of(
     TypeEnum.FLOAT, TypeEnum.DOUBLE, TypeEnum.BOOLEAN, TypeEnum.DATE,
     TypeEnum.TIMESTAMP)
-_device_all_arr = _device_all.with_arrays(_array_elem)
+# struct fields supported by the struct-of-planes layout: any scalar plane
+# type, arrays of fixed-width elements, one extra level of struct nesting
+# (deeper nests fall back; reference: per-op nesting TypeChecks.scala:166)
+_struct_field0 = (_device_all + TypeSig.of(TypeEnum.NULL)) \
+    .with_arrays(_array_elem)
+_struct_field = _struct_field0.with_structs(_struct_field0)
+_device_all_arr = _device_all.with_arrays(_array_elem) \
+    .with_structs(_struct_field) \
+    .with_maps(_array_elem, note="maps with fixed-width keys and values "
+               "(two parallel list planes); others fall back to host")
 
 
 def _register_expr_rules():
@@ -126,10 +135,18 @@ def _register_collection_rules():
 
     def tag_arr_only(meta, conf):
         _arr_input(meta)
-    register_expr_rule(C.Size, _arr_ops, tag_fn=tag_arr_only)
+
+    def tag_size(meta, conf):
+        t = meta.expr.children[0].data_type
+        if not isinstance(t, (dt.ArrayType, dt.MapType)):
+            meta.cannot_run(f"size over {t!r} runs on host")
+    register_expr_rule(C.Size, _device_all_arr, tag_fn=tag_size)
     register_expr_rule(C.GetArrayItem, _arr_ops, tag_fn=tag_arr_only)
 
     def tag_element_at(meta, conf):
+        t = meta.expr.children[0].data_type
+        if isinstance(t, dt.MapType):
+            return          # device map lookup takes any key expression
         if not _arr_input(meta):
             return
         from ..expr.strings import literal_value
@@ -139,7 +156,7 @@ def _register_collection_rules():
                             "(k == 0 must raise at eval time)")
         elif int(k) == 0:
             meta.cannot_run("element_at(_, 0) raises; host handles it")
-    register_expr_rule(C.ElementAt, _arr_ops, tag_fn=tag_element_at)
+    register_expr_rule(C.ElementAt, _device_all_arr, tag_fn=tag_element_at)
 
     register_expr_rule(C.ArrayContains, _arr_ops, tag_fn=tag_arr_only)
     register_expr_rule(C.ArrayMin, _arr_ops, tag_fn=tag_arr_only)
@@ -173,6 +190,28 @@ def _register_collection_rules():
         if not _device_common.is_supported(zt):
             meta.cannot_run(f"aggregate accumulator {zt!r} runs on host")
     register_expr_rule(C.ArrayAggregate, _hof_sig, tag_fn=tag_aggregate)
+
+    # struct/map: struct-of-planes layout (round-4 VERDICT item 5;
+    # reference: complexTypeCreator.scala / complexTypeExtractors.scala)
+    _struct_ops = _device_all_arr
+    register_expr_rule(C.GetStructField, _struct_ops)
+    register_expr_rule(C.CreateNamedStruct, _struct_ops)
+    register_expr_rule(C.CreateArray, _device_common.with_arrays(_array_elem))
+    register_expr_rule(C.GetMapValue, _device_all_arr)
+    register_expr_rule(C.MapKeys, _device_all_arr)
+    register_expr_rule(C.MapValues, _device_all_arr)
+
+    def tag_create_map(meta, conf):
+        if meta.expr.dedup_policy != "LAST_WIN":
+            meta.cannot_run(
+                "map() with mapKeyDedupPolicy=EXCEPTION needs a data-"
+                "dependent duplicate-key raise; only LAST_WIN runs in a "
+                "traced device kernel (host engine enforces EXCEPTION)")
+        for k in meta.expr.children[0::2]:
+            if k.nullable:
+                meta.cannot_run("map() with nullable keys raises on null "
+                                "keys; host engine enforces it")
+    register_expr_rule(C.CreateMap, _device_all_arr, tag_fn=tag_create_map)
 
 
 def _register_concrete_rules():
@@ -487,12 +526,25 @@ def _register_exec_rules():
     from .physical import CpuScanExec
 
     def tag_scan(meta, conf):
+        from ..io.csv import CsvSource
+        from ..io.csv_device import CSV_DEVICE_DECODE, device_decodable_reason
         from ..io.parquet import ParquetSource
         from ..io.parquet_device import PARQUET_DEVICE_DECODE
         p: CpuScanExec = meta.plan
+        if isinstance(p.source, CsvSource):
+            if not conf.get(CSV_DEVICE_DECODE):
+                meta.cannot_run("device csv decode disabled by "
+                                "spark.rapids.tpu.csv.deviceDecode.enabled")
+                return
+            reason = device_decodable_reason(
+                p.source.schema(), p.source.sep, p.source.sample_head(),
+                explicit_schema=p.source._explicit_schema is not None)
+            if reason:
+                meta.cannot_run(f"csv: {reason}")
+            return
         if not isinstance(p.source, ParquetSource):
             meta.cannot_run(f"{p.source.name()} decodes host-side "
-                            "(only parquet has a device decoder)")
+                            "(only parquet and csv have device decoders)")
             return
         if not conf.get(PARQUET_DEVICE_DECODE):
             meta.cannot_run("device parquet decode disabled by "
@@ -502,11 +554,17 @@ def _register_exec_rules():
             meta.cannot_run("pushed filter uses the host reader's "
                             "row-group statistics pruning")
 
-    register_exec_rule(
-        CpuScanExec, _device_all,
-        lambda p, ch, conf: TpuParquetScanExec(
-            p.source, p.columns, p.schema, conf.min_bucket_rows),
-        tag_fn=tag_scan)
+    def _convert_scan(p, ch, conf):
+        from ..exec.scan import TpuCsvScanExec
+        from ..io.csv import CsvSource
+        if isinstance(p.source, CsvSource):
+            return TpuCsvScanExec(p.source, p.columns, p.schema,
+                                  conf.min_bucket_rows)
+        return TpuParquetScanExec(p.source, p.columns, p.schema,
+                                  conf.min_bucket_rows)
+
+    register_exec_rule(CpuScanExec, _device_all, _convert_scan,
+                       tag_fn=tag_scan)
 
     register_exec_rule(
         CpuUnionExec, _device_all_arr,
@@ -562,11 +620,14 @@ def _register_exec_rules():
         # sum/count/first/last (expr/decimal128.py; op-level gating in
         # the decimal128 rule section below)
         _fixed_state = _device_common.with_decimal128()
+        # string keys group via packed uint64 surrogate words; struct keys
+        # flatten their field planes into the word list
+        # (exec/aggregate.py _key_code_words)
+        _key_sig = _device_all.with_decimal128() \
+            .with_structs(_device_all.with_decimal128())
         for k in p.key_names:
             kt = p.child.schema.field(k).dtype
-            # string keys group via packed uint64 surrogate words
-            # (exec/aggregate.py _key_code_words)
-            if not _device_all.with_decimal128().is_supported(kt):
+            if not _key_sig.is_supported(kt):
                 meta.cannot_run(f"group-by key {k}: {kt!r} not supported")
         for s in p.specs:
             # collect_list/collect_set produce device list-layout arrays
@@ -778,13 +839,14 @@ def _register_exec_rules():
                 f"{type(p.partitioning).__name__} stays on the host tier "
                 "(only hash partitioning exchanges over ICI)")
             return
+        _pkey = _device_all.with_structs(_device_all)
         for k in p.partitioning.key_names:
             kt = p.child.schema.field(k).dtype
-            if not _device_all.is_supported(kt):
+            if not _pkey.is_supported(kt):
                 meta.cannot_run(f"partition key {k}: {kt!r} not supported")
 
     register_exec_rule(
-        ShuffleExchangeExec, _device_all,
+        ShuffleExchangeExec, _device_all_arr,
         lambda p, ch, conf: _convert_exchange(p, ch, conf, _active_mesh()),
         tag_fn=tag_exchange)
 

@@ -45,28 +45,17 @@ def shard_table(table: DeviceTable, mesh: Mesh, axis: str = "dp"
     sharding = NamedSharding(mesh, P(axis))
     rep = NamedSharding(mesh, P())
 
-    def put_col(c: DeviceColumn) -> DeviceColumn:
-        return DeviceColumn(
-            jax.device_put(c.data, sharding),
-            jax.device_put(c.validity, sharding), c.dtype,
-            None if c.lengths is None else jax.device_put(c.lengths, sharding),
-            None if c.elem_validity is None
-            else jax.device_put(c.elem_validity, sharding))
-
-    return DeviceTable(tuple(put_col(c) for c in table.columns),
+    cols = jax.tree_util.tree_map(
+        lambda a: jax.device_put(a, sharding), table.columns)
+    return DeviceTable(cols,
                        jax.device_put(table.row_mask, sharding),
                        jax.device_put(table.num_rows, rep), table.names)
 
 
 def unshard_table(table: DeviceTable) -> DeviceTable:
     import numpy as np
-    cols = tuple(DeviceColumn(jnp.asarray(np.asarray(c.data)),
-                              jnp.asarray(np.asarray(c.validity)), c.dtype,
-                              None if c.lengths is None
-                              else jnp.asarray(np.asarray(c.lengths)),
-                              None if c.elem_validity is None
-                              else jnp.asarray(np.asarray(c.elem_validity)))
-                 for c in table.columns)
+    cols = jax.tree_util.tree_map(
+        lambda a: jnp.asarray(np.asarray(a)), table.columns)
     mask = jnp.asarray(np.asarray(table.row_mask))
     return DeviceTable(cols, mask, jnp.sum(mask, dtype=jnp.int32), table.names)
 
@@ -84,41 +73,14 @@ def ici_all_to_all_exchange(table: DeviceTable, key_names: List[str],
     (padding masked off)."""
     n = mesh.shape[axis]
     names = table.names
-    dtypes = [c.dtype for c in table.columns]
-    has_lengths = [c.lengths is not None for c in table.columns]
-    has_ev = [c.elem_validity is not None for c in table.columns]
 
-    # flatten to arrays: mask, then per column: data, validity, (lengths, ev)
-    arrays = [table.row_mask]
-    for c in table.columns:
-        arrays.append(c.data)
-        arrays.append(c.validity)
-        if c.lengths is not None:
-            arrays.append(c.lengths)
-        if c.elem_validity is not None:
-            arrays.append(c.elem_validity)
-
-    def local(*arrs):
-        mask = arrs[0]
+    # the column tuple is a pytree whose leaves are the per-column planes
+    # (data/validity/lengths/elem_validity + struct children, recursively)
+    # — tree_map applies the scatter + all_to_all to every plane uniformly
+    def local(columns, mask):
         cap = mask.shape[0]
         q = cap if quota is None else min(quota, cap)
-        pos = 1
-        cols = []
-        for d, hl, hev in zip(dtypes, has_lengths, has_ev):
-            data = arrs[pos]
-            validity = arrs[pos + 1]
-            pos_inc = 2
-            lengths = None
-            ev = None
-            if hl:
-                lengths = arrs[pos + pos_inc]
-                pos_inc += 1
-            if hev:
-                ev = arrs[pos + pos_inc]
-                pos_inc += 1
-            cols.append(DeviceColumn(data, validity, d, lengths, ev))
-            pos += pos_inc
-        local_tbl = DeviceTable(tuple(cols), mask,
+        local_tbl = DeviceTable(columns, mask,
                                 jnp.sum(mask, dtype=jnp.int32), names)
         pid = device_partition_ids(local_tbl, key_names, n)
         pid = jnp.where(mask, pid, n)  # park inactive rows past the end
@@ -131,55 +93,25 @@ def ici_all_to_all_exchange(table: DeviceTable, key_names: List[str],
         k = iota - jnp.take(start, dst).astype(jnp.int32)
         ok = sorted_pid < n
 
-        def scatter(x):
+        def xform(x):
             xs = jnp.take(x, order, axis=0)
             buckets = jnp.zeros((n, q) + xs.shape[1:], dtype=xs.dtype)
             fill = jnp.where(ok.reshape((-1,) + (1,) * (xs.ndim - 1)), xs,
                              jnp.zeros_like(xs))
-            return buckets.at[dst, k].set(fill, mode="drop")
+            scattered = buckets.at[dst, k].set(fill, mode="drop")
+            return jax.lax.all_to_all(scattered, axis, 0, 0, tiled=True) \
+                .reshape((n * q,) + x.shape[1:])
 
-        out = []
         slot_mask = jnp.zeros((n, q), dtype=bool).at[dst, k].set(
             ok, mode="drop")
-        out.append(jax.lax.all_to_all(slot_mask, axis, 0, 0,
-                                      tiled=True).reshape(n * q))
-        for c in cols:
-            out.append(jax.lax.all_to_all(scatter(c.data), axis, 0, 0,
-                                          tiled=True)
-                       .reshape((n * q,) + c.data.shape[1:]))
-            out.append(jax.lax.all_to_all(scatter(c.validity), axis, 0, 0,
-                                          tiled=True).reshape(n * q))
-            if c.lengths is not None:
-                out.append(jax.lax.all_to_all(scatter(c.lengths), axis, 0, 0,
-                                              tiled=True).reshape(n * q))
-            if c.elem_validity is not None:
-                out.append(jax.lax.all_to_all(scatter(c.elem_validity), axis,
-                                              0, 0, tiled=True)
-                           .reshape((n * q,) + c.elem_validity.shape[1:]))
-        return tuple(out)
+        out_mask = jax.lax.all_to_all(slot_mask, axis, 0, 0,
+                                      tiled=True).reshape(n * q)
+        out_cols = jax.tree_util.tree_map(xform, columns)
+        return out_cols, out_mask
 
-    in_specs = tuple(P(axis) for _ in arrays)
-    n_out = 1 + sum(2 + int(h) + int(e) for h, e in zip(has_lengths, has_ev))
-    out_specs = tuple(P(axis) for _ in range(n_out))
-    fn = jax.jit(shard_map(local, mesh=mesh, in_specs=in_specs,
-                           out_specs=out_specs, check_vma=False))
-    results = fn(*arrays)
-
-    mask = results[0]
-    pos = 1
-    out_cols = []
-    for d, hl, hev in zip(dtypes, has_lengths, has_ev):
-        data = results[pos]
-        validity = results[pos + 1]
-        pos += 2
-        lengths = None
-        ev = None
-        if hl:
-            lengths = results[pos]
-            pos += 1
-        if hev:
-            ev = results[pos]
-            pos += 1
-        out_cols.append(DeviceColumn(data, validity, d, lengths, ev))
+    col_specs = jax.tree_util.tree_map(lambda _: P(axis), table.columns)
+    fn = jax.jit(shard_map(local, mesh=mesh, in_specs=(col_specs, P(axis)),
+                           out_specs=(col_specs, P(axis)), check_vma=False))
+    out_cols, mask = fn(table.columns, table.row_mask)
     total = jnp.sum(mask, dtype=jnp.int32)
     return DeviceTable(tuple(out_cols), mask, total, names)

@@ -93,31 +93,44 @@ def device_partition_ids(table: DeviceTable, key_names: List[str],
     not required for strings because placement never crosses engines)."""
     h = jnp.full(table.capacity, jnp.uint32(seed), dtype=jnp.uint32)
     for name in key_names:
-        col = table.column(name)
-        v = col.data
-        if col.lengths is not None:  # string/binary
-            k = _string_key_hash(col)
-        elif v.ndim == 2:  # decimal128 two-limb columns: fold both limbs
-            hi = v[:, 0].view(jnp.uint64)
-            lo = v[:, 1].view(jnp.uint64)
-            bits = hi ^ (lo * jnp.uint64(0x9E3779B97F4A7C15))
-            k = (bits & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32) \
-                ^ (bits >> jnp.uint64(32)).astype(jnp.uint32)
-        elif v.dtype == jnp.bool_:
-            k = v.astype(jnp.uint32)
-        elif jnp.issubdtype(v.dtype, jnp.floating):
-            bits = v.astype(jnp.float64).view(jnp.uint64)
-            k = (bits & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32) \
-                ^ (bits >> jnp.uint64(32)).astype(jnp.uint32)
-        else:
-            bits = v.astype(jnp.int64).view(jnp.uint64)
-            k = (bits & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32) \
-                ^ (bits >> jnp.uint64(32)).astype(jnp.uint32)
-        k = _fmix_device(k)
-        k = jnp.where(col.validity, k, jnp.uint32(0))
+        k = _column_key_hash(table.column(name))
         h = h ^ k
         h = h * jnp.uint32(5) + jnp.uint32(0xE6546B64)
     return (h % jnp.uint32(num_parts)).astype(jnp.int32)
+
+
+def _column_key_hash(col) -> jax.Array:
+    """Per-row u32 hash of one key column; struct keys fold their field
+    hashes (recursively), null rows/fields hash to 0."""
+    from ..columnar import dtypes as _dt
+    if isinstance(col.dtype, _dt.StructType):
+        k = jnp.zeros(col.capacity, dtype=jnp.uint32)
+        for i, child in enumerate(col.children):
+            ck = _column_key_hash(child)
+            k = k ^ _fmix_device(ck ^ jnp.uint32(i + 1))
+            k = k * jnp.uint32(5) + jnp.uint32(0xE6546B64)
+        return jnp.where(col.validity, k, jnp.uint32(0))
+    v = col.data
+    if col.lengths is not None:  # string/binary
+        k = _string_key_hash(col)
+    elif v.ndim == 2:  # decimal128 two-limb columns: fold both limbs
+        hi = v[:, 0].view(jnp.uint64)
+        lo = v[:, 1].view(jnp.uint64)
+        bits = hi ^ (lo * jnp.uint64(0x9E3779B97F4A7C15))
+        k = (bits & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32) \
+            ^ (bits >> jnp.uint64(32)).astype(jnp.uint32)
+    elif v.dtype == jnp.bool_:
+        k = v.astype(jnp.uint32)
+    elif jnp.issubdtype(v.dtype, jnp.floating):
+        bits = v.astype(jnp.float64).view(jnp.uint64)
+        k = (bits & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32) \
+            ^ (bits >> jnp.uint64(32)).astype(jnp.uint32)
+    else:
+        bits = v.astype(jnp.int64).view(jnp.uint64)
+        k = (bits & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32) \
+            ^ (bits >> jnp.uint64(32)).astype(jnp.uint32)
+    k = _fmix_device(k)
+    return jnp.where(col.validity, k, jnp.uint32(0))
 
 
 class HeartbeatManager:

@@ -97,8 +97,11 @@ class _HostBlockStore:
         self._budget = budget_bytes
         self._mem: "OrderedDict[BlockId, bytes]" = OrderedDict()
         self._disk: Dict[BlockId, Tuple[str, int]] = {}   # path, length
+        self._providers: Dict[BlockId, object] = {}   # lazy payload fns
         self._spilling: set = set()   # victims mid-write, still in _mem
         self._lock = threading.Lock()
+        self._mat_inflight: set = set()   # blocks materializing right now
+        self._mat_cond = threading.Condition(self._lock)
         self._dir: Optional[str] = None
         self.mem_bytes = 0
         self.spilled_blocks = 0
@@ -150,7 +153,41 @@ class _HostBlockStore:
                     continue
             _unlink_quietly(path)
 
+    def put_lazy(self, block: BlockId, provider) -> None:
+        """Register a deferred payload: ``provider()`` -> bytes runs on the
+        first request for this block (DCN tier: blocks stay device-resident
+        until a remote peer actually asks — most never serialize)."""
+        with self._lock:
+            self._providers[block] = provider
+
+    def _materialize(self, block: BlockId) -> None:
+        with self._lock:
+            # a concurrent materialization of this block: wait for it to
+            # land in _mem/_disk instead of reporting the block missing
+            while block in self._mat_inflight:
+                self._mat_cond.wait()
+            provider = self._providers.pop(block, None)
+            if provider is None:
+                return
+            self._mat_inflight.add(block)
+        try:
+            payload = provider()
+        except Exception:
+            with self._lock:       # keep it requestable for a retry
+                self._providers.setdefault(block, provider)
+                self._mat_inflight.discard(block)
+                self._mat_cond.notify_all()
+            raise
+        self.put(block, payload)
+        with self._lock:
+            self._mat_inflight.discard(block)
+            self._mat_cond.notify_all()
+
     def length(self, block: BlockId) -> Optional[int]:
+        with self._lock:
+            pending = block in self._providers
+        if pending:
+            self._materialize(block)
         with self._lock:
             data = self._mem.get(block)
             if data is not None:
@@ -159,6 +196,10 @@ class _HostBlockStore:
             return None if entry is None else entry[1]
 
     def read(self, block: BlockId, offset: int, n: int) -> Optional[bytes]:
+        with self._lock:
+            pending = block in self._providers
+        if pending:
+            self._materialize(block)
         with self._lock:
             data = self._mem.get(block)
             entry = self._disk.get(block) if data is None else None
@@ -176,6 +217,8 @@ class _HostBlockStore:
 
     def remove_shuffle(self, shuffle_id: int) -> None:
         with self._lock:
+            for b in [b for b in self._providers if b[0] == shuffle_id]:
+                del self._providers[b]
             for b in [b for b in self._mem if b[0] == shuffle_id]:
                 self.mem_bytes -= len(self._mem.pop(b))
             doomed = [self._disk.pop(b)[0]

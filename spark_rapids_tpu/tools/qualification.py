@@ -17,7 +17,8 @@ from ..conf import RapidsConf
 from ..plan.meta import wrap_plan
 from ..plan.planner import plan_physical
 
-__all__ = ["qualify", "QualificationReport"]
+__all__ = ["qualify", "qualify_event_log", "QualificationReport",
+           "EventLogQualificationReport"]
 
 # cost model shared with the cost-based optimizer so the qualification score
 # and the CBO demotion decision can't drift apart
@@ -77,3 +78,89 @@ def qualify(df, conf: Optional[RapidsConf] = None) -> QualificationReport:
     speedup = conf.get(_OPTIMIZER_SPEEDUP)
     est = 1.0 / ((1.0 - score) + score / speedup) if w_total else 1.0
     return QualificationReport(score, n_total, n_ok, per_op, est)
+
+
+# ---------------------------------------------------------------------------
+# Offline qualification from a recorded event log (round-4 VERDICT item 10;
+# reference: Qualification.scala:34 scores RECORDED CPU apps from their
+# event logs without re-running them)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class EventLogQualificationReport:
+    app_path: str
+    queries: List[Tuple[int, float, float, float]]  # (qid, wall_s, score, est)
+    score: float                 # wall-time-weighted device-runnable share
+    estimated_speedup: float
+    unsupported_ops: Dict[str, float]     # op name -> host wall_s
+
+    def summary(self) -> str:
+        lines = [f"event-log qualification: {self.app_path}",
+                 f"app score (time-weighted) : {self.score:.2f}",
+                 f"estimated app speedup     : "
+                 f"{self.estimated_speedup:.2f}x", ""]
+        for qid, wall, score, est in self.queries:
+            lines.append(f"  query {qid}: wall={wall:.3f}s "
+                         f"score={score:.2f} est={est:.2f}x")
+        if self.unsupported_ops:
+            lines.append("")
+            lines.append("top host-bound operators:")
+            for name, s in sorted(self.unsupported_ops.items(),
+                                  key=lambda kv: -kv[1])[:10]:
+                lines.append(f"  ! {name}: {s:.3f}s")
+        return "\n".join(lines)
+
+
+def _supported_exec_names() -> set:
+    """Exec class names with a registered device rule (the offline stand-in
+    for PluginTypeChecker's supported-execs data file)."""
+    from ..plan import overrides  # noqa: F401  (registers rules on import)
+    from ..plan.meta import EXEC_RULES
+    names = set()
+    for cls in EXEC_RULES:
+        names.add(cls.__name__)
+        names.add(cls.__name__.replace("Cpu", "", 1))
+    return names
+
+
+def qualify_event_log(path: str,
+                      conf: Optional[RapidsConf] = None
+                      ) -> EventLogQualificationReport:
+    """Score a recorded app (tools/eventlog.py JSONL) for device
+    suitability WITHOUT re-running it: per-operator measured wall time
+    weights each op, so the estimate reflects where this app actually
+    spent its time (stronger than plan-shape weighting — the reference
+    uses recorded SQL metrics the same way)."""
+    from .eventlog import load_event_log
+    conf = conf or RapidsConf()
+    supported = _supported_exec_names()
+    speedup = conf.get(_OPTIMIZER_SPEEDUP)
+
+    app = load_event_log(path)
+    queries = []
+    unsupported: Dict[str, float] = {}
+    t_total = t_dev = 0.0
+    for qid in sorted(app.queries):
+        q = app.query(qid)
+        if q.error:
+            continue
+        w_total = w_dev = 0.0
+        for n in q.nodes:
+            name = n["name"]
+            w = max(float(n.get("wall_s", 0.0)), 0.0)
+            w_total += w
+            # Tpu* nodes RAN on device; Cpu* nodes qualify when a device
+            # rule exists for them
+            if name.startswith("Tpu") or name in supported:
+                w_dev += w
+            else:
+                unsupported[name] = unsupported.get(name, 0.0) + w
+        score = (w_dev / w_total) if w_total else 1.0
+        est = 1.0 / ((1.0 - score) + score / speedup)
+        queries.append((qid, q.wall_s, score, est))
+        t_total += w_total
+        t_dev += w_dev
+    app_score = (t_dev / t_total) if t_total else 1.0
+    app_est = 1.0 / ((1.0 - app_score) + app_score / speedup)
+    return EventLogQualificationReport(path, queries, app_score, app_est,
+                                       unsupported)

@@ -526,3 +526,111 @@ def test_supported_ops_shows_array_support():
                     if l.startswith(f"| {op} ")), None)
         assert row is not None, op
         assert "PS" in row or " S " in row, row
+
+
+# ---------------------------------------------------------------------------
+# device struct/map layout (round-4 VERDICT item 5)
+# ---------------------------------------------------------------------------
+
+def test_struct_device_roundtrip_and_field_access(session):
+    """Struct-of-planes: nested struct with string/array fields round-trips
+    through the device and field access is a plane select."""
+    t = pa.table({
+        "s": pa.array(
+            [{"x": 1, "y": "ab", "a": [1, 2], "in": {"z": 9.5}},
+             {"x": 2, "y": None, "a": [None, 3], "in": None},
+             None],
+            type=pa.struct([
+                ("x", pa.int64()), ("y", pa.string()),
+                ("a", pa.list_(pa.int64())),
+                ("in", pa.struct([("z", pa.float64())]))])),
+    })
+    df = session.create_dataframe(t)
+    rt = df.collect(device=True).column("s").to_pylist()
+    assert rt == t.column("s").to_pylist()
+    q = df.select(
+        col("s").getField("x").alias("x"),
+        col("s").getField("y").alias("y"),
+        col("s").getField("a").alias("a"),
+        col("s").getField("in").getField("z").alias("z"))
+    ex = q.explain("tpu")
+    assert "CpuProjectExec will run on TPU" in ex, ex
+    out = assert_tpu_cpu_equal(q, ignore_order=False)
+    assert out.column("x").to_pylist() == [1, 2, None]
+    assert out.column("y").to_pylist() == ["ab", None, None]
+    assert out.column("a").to_pylist() == [[1, 2], [None, 3], None]
+    assert out.column("z").to_pylist() == [9.5, None, None]
+
+
+def test_map_device_ops(session):
+    t = pa.table({
+        "m": pa.array([[(1, 10.5), (2, 20.5)], [], None, [(3, None)]],
+                      type=pa.map_(pa.int64(), pa.float64())),
+        "k": [1, 1, 1, 3],
+    })
+    df = session.create_dataframe(t)
+    q = df.select(
+        F.element_at(col("m"), 1).alias("e1"),
+        F.map_keys(col("m")).alias("mk"),
+        F.map_values(col("m")).alias("mv"),
+        F.size(col("m")).alias("sz"))
+    ex = q.explain("tpu")
+    assert "CpuProjectExec will run on TPU" in ex, ex
+    out = assert_tpu_cpu_equal(q, ignore_order=False)
+    assert out.column("e1").to_pylist() == [10.5, None, None, None]
+    assert out.column("mk").to_pylist() == [[1, 2], [], None, [3]]
+    assert out.column("mv").to_pylist() == [[10.5, 20.5], [], None, [None]]
+    assert out.column("sz").to_pylist() == [2, 0, -1, 1]
+    # map round-trip incl. a null value entry
+    rt = df.collect(device=True).column("m").to_pylist()
+    assert rt == t.column("m").to_pylist()
+
+
+def test_create_map_device_last_win(session):
+    t = pa.table({"a": [1, 2], "b": [10.0, 20.0]},
+                 schema=pa.schema([pa.field("a", pa.int64(), nullable=False),
+                                   pa.field("b", pa.float64(),
+                                            nullable=False)]))
+    sess_lw = type(session)({"spark.rapids.tpu.batchRowsMinBucket": 64,
+                             "spark.sql.mapKeyDedupPolicy": "last_win"})
+    df = sess_lw.create_dataframe(t)
+    q = df.select(F.create_map(col("a"), col("b"),
+                               col("a"), col("b") + lit(1.0),
+                               lit(99), col("b")).alias("m"))
+    ex = q.explain("tpu")
+    assert "CpuProjectExec will run on TPU" in ex, ex
+    out = assert_tpu_cpu_equal(q, ignore_order=False)
+    # duplicate key "a": first position, last value (dict semantics)
+    assert out.column("m").to_pylist() == \
+        [[(1, 11.0), (99, 10.0)], [(2, 21.0), (99, 20.0)]]
+
+
+def test_string_key_maps_fall_back(session):
+    t = pa.table({"m": pa.array([[("k", 1)]],
+                                type=pa.map_(pa.string(), pa.int64()))})
+    df = session.create_dataframe(t)
+    q = df.select(F.size(col("m")).alias("sz"))
+    ex = q.explain("tpu")
+    assert "map key" in ex, ex          # host fallback reason recorded
+    out = assert_tpu_cpu_equal(q, ignore_order=False)
+    assert out.column("sz").to_pylist() == [1]
+
+
+def test_struct_groupby_keys_on_device(session):
+    """Struct group-by keys flatten field planes into the sort-key word
+    list (round-4 VERDICT item 5; reference: TypeChecks.scala:166)."""
+    t = pa.table({
+        "s": pa.array([{"a": 1, "b": "x"}, {"a": 1, "b": "x"},
+                       {"a": 2, "b": None}, None, {"a": 2, "b": None},
+                       {"a": 1, "b": "y"}] * 3,
+                      type=pa.struct([("a", pa.int64()),
+                                      ("b", pa.string())])),
+        "v": [1.0, 2.0, 3.0, 4.0, 5.0, 6.0] * 3,
+    })
+    df = session.create_dataframe(t, num_partitions=3)
+    q = df.group_by("s").agg(F.sum(col("v")).alias("sv"),
+                             F.count(col("v")).alias("c"))
+    ex = q.explain("tpu")
+    assert "group-by key" not in ex, ex     # no struct-key fallback
+    out = assert_tpu_cpu_equal(q)
+    assert out.num_rows == 4                # 3 structs + the null row

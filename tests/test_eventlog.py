@@ -89,3 +89,27 @@ def test_profile_query_xla_trace(tmp_path):
     assert prof.total_s > 0
     assert os.path.isdir(trace_dir)
     assert glob.glob(os.path.join(trace_dir, "**", "*"), recursive=True)
+
+
+def test_qualify_event_log(session, tmp_path):
+    """Offline qualification from a recorded JSONL app (round-4 VERDICT
+    item 10; reference: Qualification.scala:34 scores recorded apps)."""
+    import os
+    import pyarrow as pa
+    import spark_rapids_tpu.expr.functions as F
+    from spark_rapids_tpu.expr.functions import col
+    from spark_rapids_tpu.session import TpuSession
+    from spark_rapids_tpu.tools.qualification import qualify_event_log
+
+    d = str(tmp_path / "evt")
+    sess = TpuSession({"spark.rapids.tpu.batchRowsMinBucket": 64,
+                       "spark.rapids.tpu.eventLog.dir": d})
+    t = pa.table({"k": [1, 2, 3] * 20, "v": [1.5] * 60})
+    df = sess.create_dataframe(t, num_partitions=2)
+    df.group_by("k").agg(F.sum(col("v")).alias("sv")).collect(device=True)
+    sess.close()
+    logs = [os.path.join(d, f) for f in os.listdir(d)]
+    rep = qualify_event_log(logs[0])
+    assert rep.queries and 0.0 <= rep.score <= 1.0
+    assert rep.estimated_speedup >= 1.0
+    assert "qualification" in rep.summary()

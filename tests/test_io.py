@@ -155,3 +155,83 @@ def test_device_write_falls_back_for_unsupported_schema(session, tmp_path):
     one = [f for f in os.listdir(out) if f.endswith(".parquet")][0]
     meta = pq.ParquetFile(os.path.join(out, one)).metadata
     assert "device writer" not in (meta.created_by or "")
+
+
+# ---------------------------------------------------------------------------
+# device CSV decode (round-4 VERDICT item 7; reference:
+# GpuTextBasedPartitionReader.scala:44)
+# ---------------------------------------------------------------------------
+
+def _write_csv(tmp_path, name, text):
+    p = tmp_path / name
+    p.write_text(text)
+    return str(p)
+
+
+def test_csv_device_decode_differential(session, tmp_path):
+    p = _write_csv(tmp_path, "t.csv",
+                   "a,b,c,d,e\n"
+                   "1,2.5,true,2021-03-04,hello\n"
+                   "-7,NaN,false,2021-12-31,\n"
+                   ",,,,x\n"
+                   "999999999999,-1e3,TRUE,2021-01-02,world\n")
+    df = session.read_csv(p)
+    ex = df.explain("tpu")
+    assert "CpuScanExec will run on TPU" in ex, ex
+    dev = df.collect(device=True).to_pylist()
+    cpu = df.collect(device=False).to_pylist()
+    assert str(dev) == str(cpu)
+    assert dev[1]["b"] is None          # 'NaN' is a pyarrow null token
+    assert dev[0]["a"] == 1 and dev[0]["c"] is True
+    assert str(dev[0]["d"]) == "2021-03-04"
+
+
+def test_csv_device_decode_downstream_agg(session, tmp_path):
+    import spark_rapids_tpu.expr.functions as F
+    from spark_rapids_tpu.expr.functions import col, lit
+    rows = "\n".join(f"{i%5},{i*1.5},k{i%3}" for i in range(500))
+    p = _write_csv(tmp_path, "big.csv", "k,v,s\n" + rows + "\n")
+    df = session.read_csv(p)
+    q = df.filter(col("k") > lit(0)) \
+        .group_by("s").agg(F.sum(col("v")).alias("sv"))
+    dev = sorted(map(str, q.collect(device=True).to_pylist()))
+    cpu = sorted(map(str, q.collect(device=False).to_pylist()))
+    assert dev == cpu
+
+
+def test_csv_quoted_falls_back(session, tmp_path):
+    p = _write_csv(tmp_path, "q.csv",
+                   'a,b\n1,"x,y"\n2,plain\n')
+    df = session.read_csv(p)
+    ex = df.explain("tpu")
+    assert "quoted fields" in ex, ex
+    dev = df.collect(device=True).to_pylist()
+    cpu = df.collect(device=False).to_pylist()
+    assert str(dev) == str(cpu)
+    assert dev[0]["b"] == "x,y"
+
+
+def test_csv_device_decode_disable_conf(tmp_path):
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from spark_rapids_tpu.session import TpuSession
+    p = _write_csv(tmp_path, "c.csv", "a\n1\n2\n")
+    sess = TpuSession({"spark.rapids.tpu.csv.deviceDecode.enabled": False,
+                       "spark.rapids.tpu.batchRowsMinBucket": 64})
+    df = sess.read_csv(p)
+    ex = df.explain("tpu")
+    assert "device csv decode disabled" in ex, ex
+    assert df.collect(device=True).column("a").to_pylist() == [1, 2]
+
+
+def test_csv_quotes_in_second_file_fall_back_per_file(session, tmp_path):
+    """The tag-time quote sniff only sees the first file's head; a quoted
+    field in a LATER file must still parse correctly (per-file host
+    fallback inside the device scan)."""
+    _write_csv(tmp_path, "a_plain.csv", "a,b\n1,x\n2,y\n")
+    _write_csv(tmp_path, "b_quoted.csv", 'a,b\n3,"p,q"\n4,z\n')
+    df = session.read_csv(str(tmp_path))
+    dev = sorted(map(str, df.collect(device=True).to_pylist()))
+    cpu = sorted(map(str, df.collect(device=False).to_pylist()))
+    assert dev == cpu
+    assert any("p,q" in r for r in dev)

@@ -71,7 +71,7 @@ def test_poison_on_free():
     assert stored.host_arrays is not None
     arrays = stored.host_arrays
     h.close()
-    poisoned = arrays["data0"].view("uint8")
+    poisoned = arrays["col0.data"].view("uint8")
     assert (poisoned == 0xDD).all()
 
 
