@@ -275,7 +275,46 @@ class _JoinKernels:
             sv = jnp.where(jnp.take(inv_b, b_order), _I64_MAX,
                            jnp.take(bv, b_order))
             nvalid = jnp.sum(bmask.astype(jnp.int64))
-            return b_order, sv, nvalid
+            # PK detection: no adjacent duplicates among the valid prefix
+            # -> every probe row matches at most one build row, unlocking
+            # the sync-free fixed-capacity join path (pk_join_fn)
+            iota = jnp.arange(sv.shape[0], dtype=jnp.int64)
+            dup = jnp.logical_and(sv[1:] == sv[:-1], (iota[1:] < nvalid))
+            unique = jnp.logical_not(jnp.any(dup))
+            return b_order, sv, nvalid, unique
+        return fn
+
+    def pk_join_fn(self, how: str):
+        """Unique-build-key (FK->PK) join in ONE program: searchsorted
+        lookup + gather, output capacity == probe capacity (counts are 0/1
+        so no count sync, no windowing, no per-size expand recompiles —
+        the hot TPC-H join shape; reference: GpuHashJoin's single-match
+        gather specialization)."""
+        node = self.node
+
+        def fn(build: DeviceTable, probe: DeviceTable,
+               probe_keys: DeviceTable, b_order, sv, nvalid):
+            pc = probe_keys.columns[0]
+            pmask = jnp.logical_and(pc.validity, probe.row_mask)
+            pv = _monotone_i64(pc.data)
+            pos = jnp.searchsorted(sv, pv, side="left")
+            safe = jnp.clip(pos, 0, sv.shape[0] - 1)
+            found = jnp.logical_and(
+                jnp.logical_and(pos < nvalid,
+                                jnp.take(sv, safe) == pv), pmask)
+            if how == "left_semi":
+                return probe.filter_mask(found)
+            if how == "left_anti":
+                return probe.filter_mask(jnp.logical_not(found))
+            bi = jnp.take(b_order, safe).astype(jnp.int32)
+            keep = found if how == "inner" else probe.row_mask
+            pcols = [c.with_validity(jnp.logical_and(c.validity, keep))
+                     for c in probe.columns]
+            bcols = _gather_columns(build, bi, found)
+            out_cols, names = node.assemble(pcols, bcols, found)
+            mask = jnp.logical_and(keep, probe.row_mask)
+            return DeviceTable(tuple(out_cols), mask,
+                               jnp.sum(mask, dtype=jnp.int32), tuple(names))
         return fn
 
     def probe_count_fn(self, track: bool):
@@ -616,34 +655,11 @@ class TpuShuffledHashJoinExec(TpuExec):
         shared jit."""
         lkeys, rkeys = self.left_keys, self.right_keys
         if self._direct_key_ok():
-            prep = cached_jit("JoinC|prepD", self._kernels.build_prep_fn)
             cnt = cached_jit(f"JoinC|probeD|t{int(track)}",
                              lambda: self._kernels.probe_count_fn(track))
-            # node-level: broadcast joins re-enter _probe_join once per
-            # probe partition with the SAME build table — the prep must
-            # survive across those entries. The sorted-key arrays live in
-            # a catalog-registered spillable so memory pressure can evict
-            # them; single entry, replaced on build change, race-safe
-            # (each thread uses the tuple it computed or read, never a
-            # second dict lookup).
-            lock = self.__dict__.setdefault("_prep_lock",
-                                            __import__("threading").Lock())
 
             def run(build: DeviceTable, probe: DeviceTable):
-                bkey = id(build.row_mask)
-                with lock:
-                    hit = self.__dict__.get("_prep_cache")
-                    if hit is None or hit[0] is not build.row_mask:
-                        pr = self._register_prep(
-                            prep(_key_view(build, rkeys)))
-                        hit = (build.row_mask, pr)
-                        old = self.__dict__.get("_prep_cache")
-                        if old is not None:
-                            _close_quietly(old[1][0])
-                        self.__dict__["_prep_cache"] = hit
-                handle, nvalid = hit[1]
-                pt = handle.get()
-                b_order, sv = pt.columns[0].data, pt.columns[1].data
+                b_order, sv, nvalid, _uniq = self._get_prep(build)
                 starts, counts, matched = cnt(b_order, sv, nvalid,
                                               _key_view(probe, lkeys))
                 return b_order, starts, counts, (matched if track else None)
@@ -660,15 +676,44 @@ class TpuShuffledHashJoinExec(TpuExec):
             return b_order, starts, counts, matched
         return run
 
+    def _get_prep(self, build: DeviceTable):
+        """Per-build-table sorted-key prep: (b_order, sv, nvalid, unique).
+
+        Node-level cache: broadcast joins re-enter _probe_join once per
+        probe partition with the SAME build table — the prep must survive
+        across those entries. The sorted-key arrays live in a catalog-
+        registered spillable so memory pressure can evict them; single
+        entry, replaced on build change, race-safe (each thread uses the
+        tuple it computed or read, never a second dict lookup). ``unique``
+        is host-synced once per build (it gates the PK fast path)."""
+        prep = cached_jit("JoinC|prepD", self._kernels.build_prep_fn)
+        lock = self.__dict__.setdefault("_prep_lock",
+                                        __import__("threading").Lock())
+        with lock:
+            hit = self.__dict__.get("_prep_cache")
+            if hit is None or hit[0] is not build.row_mask:
+                pr = self._register_prep(
+                    prep(_key_view(build, self.right_keys)))
+                hit = (build.row_mask, pr)
+                old = self.__dict__.get("_prep_cache")
+                if old is not None:
+                    _close_quietly(old[1][0])
+                self.__dict__["_prep_cache"] = hit
+        handle, nvalid, unique = hit[1]
+        pt = handle.get()
+        return pt.columns[0].data, pt.columns[1].data, nvalid, unique
+
     def _register_prep(self, pr):
-        """(b_order, sv, nvalid) -> ((spill handle, nvalid)): the sorted
-        build-key arrays go through the BufferCatalog so memory pressure
-        can evict them like any other device buffer."""
+        """(b_order, sv, nvalid, unique) -> (spill handle, nvalid,
+        unique_bool): the sorted build-key arrays go through the
+        BufferCatalog so memory pressure can evict them like any other
+        device buffer; the uniqueness flag syncs to a host bool here (one
+        tiny transfer per build table)."""
         import weakref
 
         from ..columnar.device import canonical_names
         from ..memory.catalog import SpillPriorities, get_catalog
-        b_order, sv, nvalid = pr
+        b_order, sv, nvalid, unique = pr
         cap = sv.shape[0]
         ones = jnp.ones(cap, dtype=bool)
         cols = (DeviceColumn(b_order, ones, dt.LongType(), None),
@@ -677,7 +722,7 @@ class TpuShuffledHashJoinExec(TpuExec):
                         canonical_names(2))
         h = get_catalog().register(t, SpillPriorities.ACTIVE_ON_DECK)
         weakref.finalize(self, _close_quietly, h)
-        return (h, nvalid)
+        return (h, nvalid, bool(np.asarray(unique)))
 
     def _probe_join(self, build_handle, probe_batches, seen_box=None
                     ) -> Iterator[DeviceTable]:
@@ -689,9 +734,35 @@ class TpuShuffledHashJoinExec(TpuExec):
         has_cond = self.condition is not None
         track = seen_box is not None and not has_cond
         counts_fn = self._counts_fn(track=track)
+        pk_eligible = (not has_cond and self._direct_key_ok()
+                       and self.how in ("inner", "left", "left_semi",
+                                        "left_anti"))
         for probe in probe_batches:
             with self.metrics.timed(M.JOIN_TIME), build_handle as build:
                 probe = _co_locate(probe, build)
+                if pk_eligible:
+                    b_order, sv, nvalid, unique = self._get_prep(build)
+                    if unique:
+                        # FK->PK: counts are 0/1, output fits the probe
+                        # capacity — one fused program, no count sync
+                        clone, ckey = self._canon()
+                        fused = cached_jit(
+                            ckey + f"|pk|{self.how}",
+                            lambda: clone._kernels.pk_join_fn(self.how))
+                        out_names = tuple(self.schema.names) \
+                            if self.how in ("inner", "left") \
+                            else tuple(probe.names)
+                        out = fused(build.canonical(), probe.canonical(),
+                                    _key_view(probe, self.left_keys),
+                                    b_order, sv, nvalid) \
+                            .with_names(out_names)
+                        if self.how in ("inner", "left_semi", "left_anti"):
+                            # selective joins keep the probe CAPACITY with
+                            # a mask; shrink (one int sync) so downstream
+                            # sorts/groupbys don't run over dead padding
+                            out = shrink_to_fit(out, self.min_bucket)
+                        yield out
+                        continue
                 if seen_box is not None and hasattr(seen_box[0], "devices") \
                         and hasattr(build.row_mask, "devices") \
                         and seen_box[0].devices() != build.row_mask.devices():

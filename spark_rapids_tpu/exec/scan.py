@@ -136,6 +136,17 @@ class TpuCsvScanExec(TpuExec):
         batch_rows = self.source.batch_rows
 
         starts, lengths = split_lines(raw, skip_header=self.source.header)
+        # ragged-row gate: the host reader RAISES on inconsistent field
+        # counts (pyarrow "Expected N columns"); the device splitter would
+        # silently null/ignore — route such files to the host parser so
+        # both placements fail identically
+        buf = _np.frombuffer(raw, dtype=_np.uint8)
+        sep_pos = _np.flatnonzero(buf == _np.uint8(sep))
+        nseps = (_np.searchsorted(sep_pos, starts + lengths)
+                 - _np.searchsorted(sep_pos, starts))
+        if len(starts) and not (nseps == len(fields) - 1).all():
+            yield from self._host_fallback_file(path)
+            return
         total = len(starts)
         pos = 0
         while pos < total or (pos == 0 and total == 0):
@@ -180,20 +191,12 @@ class TpuCsvScanExec(TpuExec):
 
     def _host_fallback_file(self, path: str) -> Iterator[DeviceTable]:
         """Host pyarrow parse + upload for files the device splitter cannot
-        handle (quotes discovered after the tag-time sample)."""
+        handle (quotes / ragged rows discovered after the tag-time
+        sample). Reuses the source's batching so the zero-row edge cases
+        live in one place."""
         from ..columnar.device import DeviceTable as _DT
-        cols = self.columns or None
         t = self.source._read_file(path)
-        if cols:
-            t = t.select([c for c in cols if c in t.column_names])
-        from ..columnar.host import HostTable
-        pos = 0
-        batch_rows = self.source.batch_rows
-        while pos < t.num_rows or (pos == 0 and t.num_rows == 0):
-            ht = HostTable.from_arrow(t.slice(pos, batch_rows))
+        for ht in self.source._slice_out(t, self.columns or None):
             yield _DT.from_host(ht, self.min_bucket)
             self.metrics.add(M.NUM_OUTPUT_BATCHES, 1)
             self.metrics.add(M.NUM_OUTPUT_ROWS, ht.num_rows)
-            pos += batch_rows
-            if t.num_rows == 0:
-                break

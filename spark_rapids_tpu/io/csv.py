@@ -37,6 +37,15 @@ for _t, _pa_check in (("bool", "is_boolean"), ("int", "is_integer"),
         "columns are read as strings (reference: csv per-type read flags, "
         "RapidsConf.scala:877-917).", True), _pa_check)
 
+CSV_READER_TYPE = register_conf(
+    "spark.rapids.sql.format.csv.reader.type",
+    "CSV multi-file reader strategy: PERFILE, MULTITHREADED (read pool), "
+    "COALESCING (stitch small files into full batches), or AUTO "
+    "(reference: GpuMultiFileReader.scala:126 reader selection).", "AUTO",
+    checker=lambda v: None if str(v).upper() in
+    ("AUTO", "PERFILE", "MULTITHREADED", "COALESCING")
+    else "must be AUTO|PERFILE|MULTITHREADED|COALESCING")
+
 __all__ = ["CsvSource"]
 
 
@@ -78,6 +87,7 @@ class CsvSource(DataSource):
         from ..conf import READER_BATCH_SIZE_ROWS
         self.batch_rows = batch_rows if batch_rows is not None \
             else self.conf.get(READER_BATCH_SIZE_ROWS)
+        self.reader_type = str(self.conf.get(CSV_READER_TYPE)).upper()
         self._explicit_schema = schema
         self._forced_strings: List[str] = []
         sample = self._read_file(self.files[0], nrows=1000)
@@ -110,6 +120,35 @@ class CsvSource(DataSource):
 
     def _read_file(self, path: str, nrows=None) -> pa.Table:
         ro, po, co = self._read_options(nrows)
+        if nrows is not None:
+            # bounded streaming sample for schema inference: small block
+            # size so a malformed row deep in the file neither fails nor
+            # slows source construction (full reads surface it instead)
+            ro = pacsv.ReadOptions(
+                autogenerate_column_names=not self.header,
+                block_size=1 << 12)
+            batches = []
+            got = 0
+            schema = None
+            try:
+                # malformed rows inside the sample window: schema
+                # inference is best-effort — the FULL read raises the
+                # parse error on whichever engine runs the scan
+                with pacsv.open_csv(path, read_options=ro,
+                                    parse_options=po,
+                                    convert_options=co) as reader:
+                    schema = reader.schema
+                    for b in reader:
+                        batches.append(b)
+                        got += b.num_rows
+                        if got >= nrows:
+                            break
+            except (StopIteration, pa.ArrowInvalid):
+                if schema is None and not batches:
+                    raise  # not even one clean block: surface the error
+            if not batches:
+                return schema.empty_table()
+            return pa.Table.from_batches(batches).slice(0, nrows)
         return pacsv.read_csv(path, read_options=ro, parse_options=po,
                               convert_options=co)
 
@@ -127,22 +166,43 @@ class CsvSource(DataSource):
 
     def read_partition(self, pidx: int, columns: Optional[List[str]] = None
                        ) -> Iterator[HostTable]:
-        nthreads = self.conf.get(MULTITHREAD_READ_NUM_THREADS)
         files = self._file_parts[pidx]
+        rtype = str(self.reader_type).upper()   # planner may force PERFILE
+        if rtype == "COALESCING":
+            yield from self._read_coalescing(files, columns)
+            return
+        from .file_block import set_input_file
+        if rtype == "PERFILE":
+            for f in files:
+                t = self._read_file(f)
+                set_input_file(f, 0, os.path.getsize(f))
+                yield from self._slice_out(t, columns)
+            return
+        nthreads = self.conf.get(MULTITHREAD_READ_NUM_THREADS)
         with cf.ThreadPoolExecutor(max_workers=nthreads) as pool:
-            from .file_block import set_input_file
             futures = [pool.submit(self._read_file, f) for f in files]
             for f, fut in zip(files, futures):
                 t = fut.result()
                 set_input_file(f, 0, os.path.getsize(f))
-                if columns:
-                    t = t.select([c for c in columns if c in t.column_names])
-                pos = 0
-                while pos < t.num_rows or (pos == 0 and t.num_rows == 0):
-                    yield HostTable.from_arrow(t.slice(pos, self.batch_rows))
-                    pos += self.batch_rows
-                    if t.num_rows == 0:
-                        break
+                yield from self._slice_out(t, columns)
+
+    def _read_coalescing(self, files, columns) -> Iterator[HostTable]:
+        from .file_block import clear_input_file
+        from .prefetch import coalesce_tables
+        clear_input_file()
+        for merged in coalesce_tables(files, self._read_file,
+                                      self.batch_rows):
+            yield from self._slice_out(merged, columns)
+
+    def _slice_out(self, t: pa.Table, columns) -> Iterator[HostTable]:
+        if columns:
+            t = t.select([c for c in columns if c in t.column_names])
+        pos = 0
+        while pos < t.num_rows or (pos == 0 and t.num_rows == 0):
+            yield HostTable.from_arrow(t.slice(pos, self.batch_rows))
+            pos += self.batch_rows
+            if t.num_rows == 0:
+                break
 
     def name(self) -> str:
         return f"CSV[{len(self.files)} files]"

@@ -73,6 +73,10 @@ def split_lines(raw: bytes, skip_header: bool) -> Tuple[np.ndarray,
     if len(starts):
         has_cr = buf[np.clip(ends - 1, 0, len(buf) - 1)] == ord("\r")
     lengths = ends - starts - has_cr.astype(np.int64)
+    # CRLF blank lines survive the starts<ends filter as length-0 lines
+    # after the CR strip; pyarrow (ignore_empty_lines) skips them — match
+    nonempty = lengths > 0
+    starts, lengths = starts[nonempty], lengths[nonempty]
     if skip_header and len(starts):
         starts, lengths = starts[1:], lengths[1:]
     return starts, lengths

@@ -21,6 +21,17 @@ ORC_ENABLED = register_conf(
     "spark.rapids.sql.format.orc.enabled",
     "Enable ORC scans (reference: RapidsConf orc flags).", True)
 
+ORC_READER_TYPE = register_conf(
+    "spark.rapids.sql.format.orc.reader.type",
+    "ORC multi-file reader strategy: PERFILE (stripe-at-a-time per file, "
+    "preserves input_file_name), MULTITHREADED (bounded read-ahead pool), "
+    "COALESCING (stitch small files into full batches), or AUTO "
+    "(reference: GpuOrcScanBase.scala multithread/coalescing readers, "
+    "GpuMultiFileReader.scala:126).", "AUTO",
+    checker=lambda v: None if str(v).upper() in
+    ("AUTO", "PERFILE", "MULTITHREADED", "COALESCING")
+    else "must be AUTO|PERFILE|MULTITHREADED|COALESCING")
+
 __all__ = ["OrcSource"]
 
 
@@ -47,6 +58,7 @@ class OrcSource(DataSource):
         from ..conf import READER_BATCH_SIZE_ROWS
         self.batch_rows = batch_rows if batch_rows is not None \
             else self.conf.get(READER_BATCH_SIZE_ROWS)
+        self.reader_type = str(self.conf.get(ORC_READER_TYPE)).upper()
         self.filter_expr = None  # pyarrow dataset pushdown (OrcFilters)
         first = paorc.ORCFile(self.files[0]).schema
         ht = HostTable.from_arrow(first.empty_table())
@@ -79,21 +91,66 @@ class OrcSource(DataSource):
 
     def read_partition(self, pidx: int, columns: Optional[List[str]] = None
                        ) -> Iterator[HostTable]:
+        files = self._file_parts[pidx]
+        if self.reader_type == "PERFILE":
+            yield from self._read_perfile(files, columns)
+        elif self.reader_type == "COALESCING":
+            yield from self._read_coalescing(files, columns)
+        else:  # MULTITHREADED (also AUTO)
+            yield from self._read_multithreaded(files, columns)
+
+    # -- strategies (reference: GpuOrcScanBase multithread/coalescing
+    # readers; PERFILE decodes stripe-at-a-time = stripe clipping) ----------
+    def _read_perfile(self, files, columns) -> Iterator[HostTable]:
+        from .file_block import set_input_file
+        for fname in files:
+            set_input_file(fname, 0, os.path.getsize(fname))
+            if self.filter_expr is not None:
+                yield from self._slice_out(self._read_file(fname, columns))
+                continue
+            f = paorc.ORCFile(fname)
+            if f.nstripes == 0:
+                yield HostTable.from_arrow(
+                    f.schema.empty_table() if columns is None
+                    else f.schema.empty_table().select(columns))
+                continue
+            for s in range(f.nstripes):
+                # stripe-at-a-time: bounded memory per file regardless of
+                # file size (the stripe-clipping analogue)
+                yield from self._slice_out(f.read_stripe(s, columns=columns))
+
+    def _read_multithreaded(self, files, columns) -> Iterator[HostTable]:
         from .file_block import set_input_file
         from .prefetch import prefetched
         nthreads = self.conf.get(MULTITHREAD_READ_NUM_THREADS)
-        files = self._file_parts[pidx]
         # bounded read-ahead: at most nthreads decoded tables resident
         for fname, t in prefetched(
                 files, lambda f: self._read_file(f, columns), nthreads):
             set_input_file(fname, 0, os.path.getsize(fname))
-            pos = 0
-            while pos < t.num_rows or (pos == 0 and t.num_rows == 0):
-                yield HostTable.from_arrow(t.slice(pos, self.batch_rows))
-                pos += self.batch_rows
-                if t.num_rows == 0:
-                    break
+            yield from self._slice_out(t)
             del t
 
+    def _read_coalescing(self, files, columns) -> Iterator[HostTable]:
+        # merged batches span files: no single-file attribution (the
+        # planner's InputFileBlockRule selects PERFILE when file-info
+        # expressions appear, like the reference's reader selection)
+        from .file_block import clear_input_file
+        from .prefetch import coalesce_tables
+        clear_input_file()
+        for merged in coalesce_tables(
+                files, lambda f: self._read_file(f, columns),
+                self.batch_rows):
+            yield from self._slice_out(merged)
+
+    def _slice_out(self, t: pa.Table) -> Iterator[HostTable]:
+        if isinstance(t, pa.RecordBatch):
+            t = pa.Table.from_batches([t])
+        pos = 0
+        while pos < t.num_rows or (pos == 0 and t.num_rows == 0):
+            yield HostTable.from_arrow(t.slice(pos, self.batch_rows))
+            pos += self.batch_rows
+            if t.num_rows == 0:
+                break
+
     def name(self) -> str:
-        return f"ORC[{len(self.files)} files]"
+        return f"ORC[{len(self.files)} files, {self.reader_type}]"

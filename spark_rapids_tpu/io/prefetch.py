@@ -13,7 +13,26 @@ import concurrent.futures as cf
 from collections import deque
 from typing import Callable, Iterable, Iterator, Tuple, TypeVar
 
-__all__ = ["prefetched"]
+__all__ = ["prefetched", "coalesce_tables"]
+
+
+def coalesce_tables(files, read_fn, batch_rows: int):
+    """COALESCING reader core shared by the file formats: accumulate small
+    files until at least ``batch_rows`` rows are pending, then yield ONE
+    concatenated arrow table (reference: the coalescing multi-file readers,
+    GpuMultiFileReader.scala:126 — small files stitch into full-size
+    batches so each device upload/decode sees real work)."""
+    import pyarrow as pa
+    pending, pending_rows = [], 0
+    for f in files:
+        t = read_fn(f)
+        pending.append(t)
+        pending_rows += t.num_rows
+        if pending_rows >= batch_rows:
+            yield pa.concat_tables(pending)
+            pending, pending_rows = [], 0
+    if pending:
+        yield pa.concat_tables(pending)
 
 T = TypeVar("T")
 R = TypeVar("R")

@@ -185,7 +185,8 @@ class _HostBlockStore:
 
     def length(self, block: BlockId) -> Optional[int]:
         with self._lock:
-            pending = block in self._providers
+            pending = block in self._providers \
+                or block in self._mat_inflight
         if pending:
             self._materialize(block)
         with self._lock:
@@ -197,7 +198,8 @@ class _HostBlockStore:
 
     def read(self, block: BlockId, offset: int, n: int) -> Optional[bytes]:
         with self._lock:
-            pending = block in self._providers
+            pending = block in self._providers \
+                or block in self._mat_inflight
         if pending:
             self._materialize(block)
         with self._lock:

@@ -235,3 +235,66 @@ def test_csv_quotes_in_second_file_fall_back_per_file(session, tmp_path):
     cpu = sorted(map(str, df.collect(device=False).to_pylist()))
     assert dev == cpu
     assert any("p,q" in r for r in dev)
+
+
+def test_orc_reader_strategies(session, tmp_path):
+    """PERFILE (stripe-at-a-time) / MULTITHREADED / COALESCING all return
+    identical rows (round-4 VERDICT items 5-6; reference:
+    GpuOrcScanBase.scala readers, GpuMultiFileReader.scala:126)."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import pyarrow as pa
+    import pyarrow.orc as paorc
+    from spark_rapids_tpu.session import TpuSession
+    for i in range(3):
+        paorc.write_table(
+            pa.table({"a": list(range(i * 10, i * 10 + 10)),
+                      "b": [float(x) for x in range(10)]}),
+            str(tmp_path / f"f{i}.orc"))
+    expected = None
+    for rt in ("PERFILE", "MULTITHREADED", "COALESCING"):
+        sess = TpuSession({"spark.rapids.sql.format.orc.reader.type": rt,
+                           "spark.rapids.tpu.batchRowsMinBucket": 64})
+        df = sess.read_orc(str(tmp_path))
+        got = sorted(df.collect(device=False).column("a").to_pylist())
+        if expected is None:
+            expected = got
+        assert got == expected == sorted(range(30)), (rt, got)
+
+
+def test_csv_reader_strategies(session, tmp_path):
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from spark_rapids_tpu.session import TpuSession
+    for i in range(3):
+        (tmp_path / f"f{i}.csv").write_text(
+            "a\n" + "\n".join(str(x) for x in range(i * 5, i * 5 + 5)) + "\n")
+    for rt in ("PERFILE", "MULTITHREADED", "COALESCING"):
+        sess = TpuSession({"spark.rapids.sql.format.csv.reader.type": rt,
+                           "spark.rapids.tpu.batchRowsMinBucket": 64})
+        df = sess.read_csv(str(tmp_path))
+        got = sorted(df.collect(device=True).column("a").to_pylist())
+        assert got == sorted(range(15)), (rt, got)
+
+
+def test_csv_crlf_blank_lines_and_ragged_rows(session, tmp_path):
+    """CRLF blank lines are skipped like pyarrow; ragged rows route the
+    file to the host parser so both placements fail identically."""
+    p = tmp_path / "crlf.csv"
+    p.write_bytes(b"a,b\r\n1,x\r\n\r\n2,y\r\n")
+    df = session.read_csv(str(p))
+    dev = df.collect(device=True).to_pylist()
+    cpu = df.collect(device=False).to_pylist()
+    assert str(dev) == str(cpu) and len(dev) == 2
+    # ragged: extra column appears past the schema-inference sample -> the
+    # sample passes but the full read raises; the device path must route
+    # the file to the host parser so BOTH placements raise identically
+    p2 = tmp_path / "ragged.csv"
+    rows = "\n".join(f"{i},x" for i in range(1001))
+    p2.write_text("a,b\n" + rows + "\n9999,y,z\n")
+    df2 = session.read_csv(str(p2))
+    import pytest as _pt
+    with _pt.raises(Exception, match="columns"):
+        df2.collect(device=False)
+    with _pt.raises(Exception, match="columns"):
+        df2.collect(device=True)
