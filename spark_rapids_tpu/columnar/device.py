@@ -46,8 +46,45 @@ def canonical_names(n: int) -> Tuple[str, ...]:
     return tuple(f"c{i}" for i in range(n))
 
 
+def stable_partition_order(mask: jax.Array) -> jax.Array:
+    """Sort-free stable-partition permutation: gather indices that put
+    mask=True rows first, preserving relative order in both segments —
+    identical to ``argsort(!mask, stable=True)`` but built from two
+    cumsums + one scatter (O(n) work, and no lax.sort in the program —
+    sorts are the pathological op for some TPU toolchains)."""
+    n = mask.shape[0]
+    m32 = mask.astype(jnp.int32)
+    kept_rank = jnp.cumsum(m32) - m32
+    n_keep = jnp.sum(m32)
+    drop_rank = jnp.cumsum(1 - m32) - (1 - m32)
+    dest = jnp.where(mask, kept_rank, n_keep + drop_rank)
+    iota = jnp.arange(n, dtype=jnp.int32)
+    return jnp.zeros(n, dtype=jnp.int32).at[dest].set(iota)
+
+
+def stable_counting_order(keys: jax.Array, num_vals: int) -> jax.Array:
+    """Sort-free stable permutation grouping equal small-domain keys in
+    ascending order (counting sort): ``keys`` must lie in [0, num_vals).
+    Equivalent to ``argsort(keys, stable=True)`` for partition ids — the
+    shuffle write path's sort — with O(n * num_vals) elementwise work and
+    no lax.sort. num_vals is the (small, static) partition count."""
+    n = keys.shape[0]
+    oh = (keys[:, None] == jnp.arange(num_vals, dtype=keys.dtype)[None, :]) \
+        .astype(jnp.int32)
+    within = jnp.cumsum(oh, axis=0) - oh
+    counts = jnp.sum(oh, axis=0)
+    offsets = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(counts)[:-1]])
+    my_within = jnp.take_along_axis(
+        within, jnp.clip(keys, 0, num_vals - 1)[:, None].astype(jnp.int32),
+        axis=1)[:, 0]
+    dest = jnp.take(offsets, jnp.clip(keys, 0, num_vals - 1)) + my_within
+    iota = jnp.arange(n, dtype=jnp.int32)
+    return jnp.zeros(n, dtype=jnp.int32).at[dest].set(iota)
+
+
 def _compact_impl(table: "DeviceTable") -> "DeviceTable":
-    order = jnp.argsort(jnp.logical_not(table.row_mask), stable=True)
+    order = stable_partition_order(table.row_mask)
     cols = tuple(c.gather(order) for c in table.columns)
     iota = jnp.arange(table.capacity, dtype=jnp.int32)
     mask = iota < table.num_rows

@@ -29,6 +29,7 @@ import numpy as np
 
 from ..columnar import dtypes as dt
 from ..columnar.device import DeviceColumn, DeviceTable
+from ..conf import register_conf
 from ..plan.physical import AggSpec, PhysicalPlan
 from ..plan.schema import Field, Schema
 from ..utils import metrics as M
@@ -230,6 +231,109 @@ def _reduce_segment(op: str, vals: jax.Array, contrib: jax.Array,
 _COLLECT_OPS = frozenset(
     {"collect_list", "collect_set", "merge_lists", "merge_sets"})
 _BIG32 = np.int32(2**31 - 1)
+
+
+def _word_bits_u32(w: jax.Array) -> jax.Array:
+    """Equality word -> u32 hash contribution (bit-exact per value)."""
+    if jnp.issubdtype(w.dtype, jnp.floating):
+        if w.dtype == jnp.float32:
+            u = jax.lax.bitcast_convert_type(w, jnp.uint32)
+            return u
+        u = jax.lax.bitcast_convert_type(w.astype(jnp.float64), jnp.uint64)
+    elif w.dtype == jnp.bool_:
+        return w.astype(jnp.uint32)
+    else:
+        u = w.astype(jnp.uint64)
+    return (u & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32) \
+        ^ (u >> jnp.uint64(32)).astype(jnp.uint32)
+
+
+def _hash_group_ids(table: "DeviceTable", key_names: List[str]):
+    """SORT-FREE exact grouping: hash keys into row-count buckets, resolve
+    each bucket's minimum-index candidate's whole key-class per round, and
+    rehash unresolved rows until none remain (a lax.while_loop — compile
+    cost is one body regardless of rounds; expected 2-4 rounds).
+
+    Returns the same contract as _sorted_group_ids but with the IDENTITY
+    order: every consumer (segment reductions, representative gather,
+    collect ranks) is order-agnostic, so the GROUPING contributes no
+    lax.sort to the program (collect_set/merge_sets dedup still sorts
+    elements) — the escape hatch for toolchains where sort compilation is
+    pathological (see spark.rapids.tpu.groupby.strategy), and the closest
+    analogue of the reference's cuDF HASH groupby."""
+    from ..shuffle.manager import _fmix_device
+    cap = table.capacity
+    active = table.row_mask
+    key_cols = [table.column(k) for k in key_names]
+    bit_fields = []
+    value_words: List[jax.Array] = []
+    for kc in key_cols:
+        words, smalls = _key_small_fields(kc)
+        value_words.extend(words)
+        bit_fields.extend(smalls)
+    words = value_words + _pack_meta_words(bit_fields)
+
+    h = jnp.zeros(cap, dtype=jnp.uint32)
+    for i, w in enumerate(words):
+        h = h ^ _fmix_device(_word_bits_u32(w) ^ jnp.uint32(i + 1))
+        h = h * jnp.uint32(5) + jnp.uint32(0xE6546B64)
+
+    iota = jnp.arange(cap, dtype=jnp.int32)
+
+    def cond(state):
+        r, winner, unresolved = state
+        return jnp.logical_and(jnp.any(unresolved), r < cap)
+
+    def body(state):
+        r, winner, unresolved = state
+        hr = _fmix_device(h ^ (r.astype(jnp.uint32)
+                               * jnp.uint32(2654435761)))
+        bucket = (hr % jnp.uint32(cap)).astype(jnp.int32)
+        cand_src = jnp.where(unresolved, iota, cap)
+        cand = jax.ops.segment_min(cand_src, bucket, num_segments=cap)
+        w = jnp.take(cand, bucket)
+        w_safe = jnp.clip(w, 0, cap - 1)
+        eq = jnp.logical_and(unresolved, w < cap)
+        for word in words:
+            eq = jnp.logical_and(
+                eq, word == jnp.take(word, w_safe, axis=0))
+        winner = jnp.where(eq, w_safe, winner)
+        unresolved = jnp.logical_and(unresolved, jnp.logical_not(eq))
+        return r + 1, winner, unresolved
+
+    _, winner, _ = jax.lax.while_loop(
+        cond, body, (jnp.int32(0), iota, active))
+    is_rep = jnp.logical_and(active, winner == iota)
+    rep_rank = jnp.cumsum(is_rep.astype(jnp.int32)) - 1
+    gid = jnp.clip(jnp.take(rep_rank, winner), 0, cap - 1)
+    num_groups = jnp.sum(is_rep.astype(jnp.int32))
+    boundary = is_rep
+    return iota, active, gid, boundary, num_groups
+
+
+GROUPBY_STRATEGY = register_conf(
+    "spark.rapids.tpu.groupby.strategy",
+    "Device group-by algorithm: 'sort' (lexsort + boundaries — the "
+    "static-shape default on CPU), 'hash' (bucket-resolve rounds; no "
+    "lax.sort in the GROUPING — collect_set dedup still sorts), or "
+    "'auto' (hash off-CPU, where sort "
+    "compilation can be pathologically slow; reference analogue: cuDF "
+    "hash groupby vs sort groupby).", "auto",
+    checker=lambda v: None if str(v).lower() in ("auto", "sort", "hash")
+    else "must be auto|sort|hash")
+
+
+def _resolve_groupby_strategy() -> str:
+    """sort|hash from the active session conf; AUTO picks hash off-CPU
+    (sort compilation is the pathological op for some TPU toolchains)."""
+    from ..session import TpuSession
+    sess = TpuSession._active
+    v = "auto"
+    if sess is not None and GROUPBY_STRATEGY is not None:
+        v = str(sess.conf.get(GROUPBY_STRATEGY)).lower()
+    if v == "auto":
+        return "hash" if jax.default_backend() != "cpu" else "sort"
+    return v
 
 
 def _sorted_group_ids(table: "DeviceTable", key_names: List[str]):
@@ -473,10 +577,13 @@ class TpuHashAggregateExec(TpuExec):
             return DeviceTable(tuple(out_cols), iota < 1,
                                jnp.asarray(1, jnp.int32), out_names)
 
+        group_ids = _hash_group_ids \
+            if _resolve_groupby_strategy() == "hash" else _sorted_group_ids
+
         def grouped(table: DeviceTable) -> DeviceTable:
             cap = table.capacity
             order, active_s, gid, boundary, num_groups = \
-                _sorted_group_ids(table, key_names)
+                group_ids(table, key_names)
             key_cols = [table.column(k) for k in key_names]
             pos = jnp.arange(cap, dtype=jnp.int64)
             # ---- representative sorted-row per group for key output
@@ -549,7 +656,8 @@ class TpuHashAggregateExec(TpuExec):
              for i, f in enumerate(child_fields)]))
         clone.children = (clone.child,)
         key = (f"HashAggC|{self.mode}|k{[pos[k] for k in self.key_names]}|"
-               f"{[(pos[i], op, repr(odt)) for (i, op, _, odt) in ops]}")
+               f"{[(pos[i], op, repr(odt)) for (i, op, _, odt) in ops]}|"
+               f"g={_resolve_groupby_strategy()}")
         return clone, key
 
     def _sizes_fn(self) -> Callable[[DeviceTable], jax.Array]:
@@ -558,10 +666,13 @@ class TpuHashAggregateExec(TpuExec):
         cols_ops = [co for co in self._columns_ops() if co[1] in _COLLECT_OPS]
         key_names = self.key_names
 
+        group_ids = _hash_group_ids \
+            if _resolve_groupby_strategy() == "hash" else _sorted_group_ids
+
         def sizes(table: DeviceTable) -> jax.Array:
             cap = table.capacity
             if key_names:
-                order, active_s, gid, _, _ = _sorted_group_ids(
+                order, active_s, gid, _, _ = group_ids(
                     table, key_names)
             else:
                 order = jnp.arange(cap, dtype=jnp.int32)

@@ -30,7 +30,8 @@ except ImportError:  # pragma: no cover - older jax
 
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..columnar.device import DeviceColumn, DeviceTable
+from ..columnar.device import (DeviceColumn, DeviceTable,
+                               stable_counting_order)
 from .manager import device_partition_ids
 
 __all__ = ["ici_all_to_all_exchange", "shard_table", "unshard_table"]
@@ -84,7 +85,7 @@ def ici_all_to_all_exchange(table: DeviceTable, key_names: List[str],
                                 jnp.sum(mask, dtype=jnp.int32), names)
         pid = device_partition_ids(local_tbl, key_names, n)
         pid = jnp.where(mask, pid, n)  # park inactive rows past the end
-        order = jnp.argsort(pid, stable=True)
+        order = stable_counting_order(pid, n + 1)
         sorted_pid = jnp.take(pid, order)
         iota = jnp.arange(cap, dtype=jnp.int32)
         start = jnp.searchsorted(sorted_pid,

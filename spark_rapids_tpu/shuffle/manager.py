@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..columnar.device import DeviceTable
+from ..columnar.device import DeviceTable, stable_counting_order
 from ..columnar.host import HostTable
 from ..conf import RapidsConf, SHUFFLE_COMPRESSION_CODEC, register_conf
 from .serializer import deserialize_table, serialize_table
@@ -42,6 +42,16 @@ SHUFFLE_CACHE_WRITES = register_conf(
     "auto",
     checker=lambda v: None if v in ("auto", "on", "off")
     else f"must be one of auto/on/off, got {v!r}")
+
+
+def _partition_order(pids, num_parts: int):
+    """Stable group-by-partition permutation. The sort-free counting
+    order materializes an O(rows x parts) one-hot, so it only pays off
+    for small partition counts; larger fan-outs keep the argsort (same
+    memory as before the sort-free rework)."""
+    if num_parts + 1 <= 32:
+        return stable_counting_order(pids, num_parts + 1)
+    return jnp.argsort(pids, stable=True)
 
 
 _MURMUR_C1 = np.uint32(0x85EBCA6B)
@@ -229,7 +239,7 @@ class ShuffleManager:
         for batch in batches:
             pids = device_partition_ids(batch, key_names, num_parts)
             pids = jnp.where(batch.row_mask, pids, num_parts)  # park inactive
-            order = jnp.argsort(pids, stable=True)
+            order = _partition_order(pids, num_parts)
             sorted_tbl = DeviceTable(
                 tuple(c.gather(order) for c in batch.columns),
                 jnp.take(batch.row_mask, order), batch.num_rows, batch.names)
@@ -277,7 +287,7 @@ class ShuffleManager:
         for batch in batches:
             pids = device_partition_ids(batch, key_names, num_parts)
             pids = jnp.where(batch.row_mask, pids, num_parts)
-            order = jnp.argsort(pids, stable=True)
+            order = _partition_order(pids, num_parts)
             sorted_tbl = DeviceTable(
                 tuple(c.gather(order) for c in batch.columns),
                 jnp.take(batch.row_mask, order), batch.num_rows, batch.names)
