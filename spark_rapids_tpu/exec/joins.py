@@ -192,6 +192,29 @@ def _null_device_column(dtype: dt.DataType, capacity: int) -> DeviceColumn:
 
 _I64_MAX = np.int64(2**63 - 1)
 
+from ..conf import register_conf  # noqa: E402  (grouped with sibling confs)
+
+JOIN_STRATEGY = register_conf(
+    "spark.rapids.tpu.join.strategy",
+    "Unique-build-key (FK->PK) join algorithm: 'sort' (sorted build keys "
+    "+ searchsorted), 'hash' (open-addressing slot table; no lax.sort in "
+    "build prep or probe), or 'auto' (hash off-CPU, where sort "
+    "compilation can be pathologically slow). Multi-key and non-unique "
+    "builds always use the sorted count path (reference analogue: cuDF "
+    "hash join vs sort-merge).", "auto",
+    checker=lambda v: None if str(v).lower() in ("auto", "sort", "hash")
+    else "must be auto|sort|hash")
+
+
+def _resolve_join_strategy() -> str:
+    from ..session import TpuSession
+    sess = TpuSession._active
+    v = str(sess.conf.get(JOIN_STRATEGY)).lower() if sess is not None \
+        else "auto"
+    if v == "auto":
+        return "hash" if jax.default_backend() != "cpu" else "sort"
+    return v
+
 
 def _monotone_i64(v: jax.Array) -> jax.Array:
     """Order- and equality-preserving map of a key column into int64
@@ -282,6 +305,157 @@ class _JoinKernels:
             dup = jnp.logical_and(sv[1:] == sv[:-1], (iota[1:] < nvalid))
             unique = jnp.logical_not(jnp.any(dup))
             return b_order, sv, nvalid, unique
+        return fn
+
+    def build_prep_hash_fn(self):
+        """SORT-FREE build prep: vectorized open-addressing insertion into
+        a 2x-capacity slot table (double hashing; each while_loop round
+        claims empty slots by minimum row index). Duplicate keys are
+        detected during insertion — the PK fast path only engages when the
+        build side is unique, same as the sorted prep. No lax.sort
+        anywhere (spark.rapids.tpu.join.strategy; reference analogue:
+        cuDF's hash join build)."""
+        def fn(build_keys: DeviceTable):
+            bc = build_keys.columns[0]
+            bmask = jnp.logical_and(bc.validity, build_keys.row_mask)
+            bv = _monotone_i64(bc.data)
+            cap = bv.shape[0]
+            T = 2 * cap                       # pow2 (capacity is pow2)
+            mask = jnp.uint32(T - 1)
+            u = jax.lax.bitcast_convert_type(bv, jnp.uint64)
+            lo = (u & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+            hi = (u >> jnp.uint64(32)).astype(jnp.uint32)
+            from ..shuffle.manager import _fmix_device
+            h1 = _fmix_device(lo ^ _fmix_device(hi))
+            step = (_fmix_device(h1 ^ jnp.uint32(0x9E3779B9))
+                    | jnp.uint32(1))          # odd: full cycle over pow2 T
+            iota = jnp.arange(cap, dtype=jnp.int32)
+            big = jnp.int32(cap)
+
+            def cond(state):
+                r, slot_row, placed, dup = state
+                return jnp.logical_and(jnp.logical_not(jnp.all(placed)),
+                                       r < T)
+
+            def body(state):
+                r, slot_row, placed, dup = state
+                bucket = ((h1 + r.astype(jnp.uint32) * step) & mask) \
+                    .astype(jnp.int32)
+                occ = jnp.take(slot_row, bucket)
+                occ_safe = jnp.clip(occ, 0, cap - 1)
+                same = jnp.logical_and(occ >= 0,
+                                       jnp.take(bv, occ_safe) == bv)
+                dup = jnp.logical_or(
+                    dup, jnp.logical_and(jnp.logical_not(placed), same))
+                want = jnp.logical_and(jnp.logical_not(placed), occ < 0)
+                cand = jnp.where(want, iota, big)
+                claim = jax.ops.segment_min(cand, bucket, num_segments=T)
+                won = jnp.logical_and(want,
+                                      jnp.take(claim, bucket) == iota)
+                slot_row = jnp.where(
+                    jnp.logical_and(slot_row < 0, claim < big),
+                    claim, slot_row)
+                placed = jnp.logical_or(placed, won)
+                return r + 1, slot_row, placed, dup
+
+            init = (jnp.int32(0), jnp.full(T, -1, jnp.int32),
+                    jnp.logical_not(bmask), jnp.zeros(cap, dtype=bool))
+            _, slot_row, _, _ = jax.lax.while_loop(cond, body, init)
+
+            # uniqueness via SELF-PROBE: walk each build key's chain; with
+            # duplicates the later row's walk hits the earlier row first,
+            # so found_row != self. (In-round dup insertion evades the
+            # insertion-time check: two equal keys claiming different
+            # slots in the same sweep never see each other.)
+            def pcond(state):
+                r, resolved, found_row = state
+                return jnp.logical_and(jnp.logical_not(jnp.all(resolved)),
+                                       r < T)
+
+            def pbody(state):
+                r, resolved, found_row = state
+                bucket = ((h1 + r.astype(jnp.uint32) * step) & mask) \
+                    .astype(jnp.int32)
+                row = jnp.take(slot_row, bucket)
+                empty = row < 0
+                row_safe = jnp.clip(row, 0, cap - 1)
+                eq = jnp.logical_and(jnp.logical_not(empty),
+                                     jnp.take(bv, row_safe) == bv)
+                hit = jnp.logical_and(jnp.logical_not(resolved), eq)
+                found_row = jnp.where(hit, row_safe, found_row)
+                resolved = jnp.logical_or(resolved,
+                                          jnp.logical_or(empty, eq))
+                return r + 1, resolved, found_row
+
+            pinit = (jnp.int32(0), jnp.logical_not(bmask),
+                     jnp.full(cap, -1, jnp.int32))
+            _, _, found_row = jax.lax.while_loop(pcond, pbody, pinit)
+            unique = jnp.all(jnp.logical_or(jnp.logical_not(bmask),
+                                            found_row == iota))
+            return slot_row, bv, unique
+        return fn
+
+    def pk_hash_join_fn(self, how: str):
+        """Unique-build-key join via the hash slot table: each probe row
+        walks its double-hash chain (one while_loop) until an empty slot
+        (absent) or a key match. Counts are 0/1; output capacity == probe
+        capacity; NO lax.sort in the program."""
+        node = self.node
+
+        def fn(build: DeviceTable, probe: DeviceTable,
+               probe_keys: DeviceTable, slot_row, bv):
+            pc = probe_keys.columns[0]
+            pmask = jnp.logical_and(pc.validity, probe.row_mask)
+            pv = _monotone_i64(pc.data)
+            cap_b = bv.shape[0]
+            T = slot_row.shape[0]
+            mask = jnp.uint32(T - 1)
+            u = jax.lax.bitcast_convert_type(pv, jnp.uint64)
+            lo = (u & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+            hi = (u >> jnp.uint64(32)).astype(jnp.uint32)
+            from ..shuffle.manager import _fmix_device
+            h1 = _fmix_device(lo ^ _fmix_device(hi))
+            step = (_fmix_device(h1 ^ jnp.uint32(0x9E3779B9))
+                    | jnp.uint32(1))
+
+            def cond(state):
+                r, resolved, found, bi = state
+                return jnp.logical_and(jnp.logical_not(jnp.all(resolved)),
+                                       r < T)
+
+            def body(state):
+                r, resolved, found, bi = state
+                bucket = ((h1 + r.astype(jnp.uint32) * step) & mask) \
+                    .astype(jnp.int32)
+                row = jnp.take(slot_row, bucket)
+                empty = row < 0
+                row_safe = jnp.clip(row, 0, cap_b - 1)
+                eq = jnp.logical_and(jnp.logical_not(empty),
+                                     jnp.take(bv, row_safe) == pv)
+                hit = jnp.logical_and(jnp.logical_not(resolved), eq)
+                found = jnp.logical_or(found, hit)
+                bi = jnp.where(hit, row_safe, bi)
+                resolved = jnp.logical_or(resolved,
+                                          jnp.logical_or(empty, eq))
+                return r + 1, resolved, found, bi
+
+            n = pv.shape[0]
+            init = (jnp.int32(0), jnp.logical_not(pmask),
+                    jnp.zeros(n, dtype=bool), jnp.zeros(n, jnp.int32))
+            _, _, found, bi = jax.lax.while_loop(cond, body, init)
+            if how == "left_semi":
+                return probe.filter_mask(found)
+            if how == "left_anti":
+                return probe.filter_mask(jnp.logical_not(found))
+            keep = found if how == "inner" else probe.row_mask
+            pcols = [c.with_validity(jnp.logical_and(c.validity, keep))
+                     for c in probe.columns]
+            bcols = _gather_columns(build, bi, found)
+            out_cols, names = node.assemble(pcols, bcols, found)
+            out_mask = jnp.logical_and(keep, probe.row_mask)
+            return DeviceTable(tuple(out_cols), out_mask,
+                               jnp.sum(out_mask, dtype=jnp.int32),
+                               tuple(names))
         return fn
 
     def pk_join_fn(self, how: str):
@@ -676,6 +850,44 @@ class TpuShuffledHashJoinExec(TpuExec):
             return b_order, starts, counts, matched
         return run
 
+    def _get_prep_hash(self, build: DeviceTable):
+        """Per-build-table HASH prep (slot table + key array + uniqueness),
+        cached like the sorted prep; no lax.sort in the prep program."""
+        prep = cached_jit("JoinC|prepH", self._kernels.build_prep_hash_fn)
+        lock = self.__dict__.setdefault("_prep_lock",
+                                        __import__("threading").Lock())
+        with lock:
+            hit = self.__dict__.get("_prep_cache_hash")
+            if hit is None or hit[0] is not build.row_mask:
+                slot_row, bv, unique = prep(_key_view(build,
+                                                      self.right_keys))
+                pr = self._register_prep_hash(slot_row, bv, unique)
+                hit = (build.row_mask, pr)
+                old = self.__dict__.get("_prep_cache_hash")
+                if old is not None:
+                    _close_quietly(old[1][0])
+                self.__dict__["_prep_cache_hash"] = hit
+        handle, unique = hit[1]
+        pt = handle.get()
+        cap = pt.capacity // 2
+        return pt.columns[0].data, pt.columns[1].data[:cap], unique
+
+    def _register_prep_hash(self, slot_row, bv, unique):
+        import weakref
+
+        from ..columnar.device import canonical_names
+        from ..memory.catalog import SpillPriorities, get_catalog
+        T = slot_row.shape[0]
+        bv_padded = jnp.pad(bv, (0, T - bv.shape[0]))
+        ones = jnp.ones(T, dtype=bool)
+        cols = (DeviceColumn(slot_row, ones, dt.IntegerType(), None),
+                DeviceColumn(bv_padded, ones, dt.LongType(), None))
+        t = DeviceTable(cols, ones, jnp.asarray(T, jnp.int32),
+                        canonical_names(2))
+        h = get_catalog().register(t, SpillPriorities.ACTIVE_ON_DECK)
+        weakref.finalize(self, _close_quietly, h)
+        return (h, bool(np.asarray(unique)))
+
     def _get_prep(self, build: DeviceTable):
         """Per-build-table sorted-key prep: (b_order, sv, nvalid, unique).
 
@@ -741,21 +953,39 @@ class TpuShuffledHashJoinExec(TpuExec):
             with self.metrics.timed(M.JOIN_TIME), build_handle as build:
                 probe = _co_locate(probe, build)
                 if pk_eligible:
-                    b_order, sv, nvalid, unique = self._get_prep(build)
-                    if unique:
-                        # FK->PK: counts are 0/1, output fits the probe
-                        # capacity — one fused program, no count sync
-                        clone, ckey = self._canon()
-                        fused = cached_jit(
-                            ckey + f"|pk|{self.how}",
-                            lambda: clone._kernels.pk_join_fn(self.how))
-                        out_names = tuple(self.schema.names) \
-                            if self.how in ("inner", "left") \
-                            else tuple(probe.names)
-                        out = fused(build.canonical(), probe.canonical(),
-                                    _key_view(probe, self.left_keys),
-                                    b_order, sv, nvalid) \
-                            .with_names(out_names)
+                    out_names = tuple(self.schema.names) \
+                        if self.how in ("inner", "left") \
+                        else tuple(probe.names)
+                    clone, ckey = self._canon()
+                    out = None
+                    if _resolve_join_strategy() == "hash":
+                        # sort-free tier: open-addressing slot table
+                        slot_row, bv, unique = self._get_prep_hash(build)
+                        if unique:
+                            fused = cached_jit(
+                                ckey + f"|pkh|{self.how}",
+                                lambda: clone._kernels
+                                .pk_hash_join_fn(self.how))
+                            out = fused(build.canonical(),
+                                        probe.canonical(),
+                                        _key_view(probe, self.left_keys),
+                                        slot_row, bv)
+                    else:
+                        b_order, sv, nvalid, unique = self._get_prep(build)
+                        if unique:
+                            # FK->PK: counts are 0/1, output fits the
+                            # probe capacity — one fused program, no
+                            # count sync
+                            fused = cached_jit(
+                                ckey + f"|pk|{self.how}",
+                                lambda: clone._kernels
+                                .pk_join_fn(self.how))
+                            out = fused(build.canonical(),
+                                        probe.canonical(),
+                                        _key_view(probe, self.left_keys),
+                                        b_order, sv, nvalid)
+                    if out is not None:
+                        out = out.with_names(out_names)
                         if self.how in ("inner", "left_semi", "left_anti"):
                             # selective joins keep the probe CAPACITY with
                             # a mask; shrink (one int sync) so downstream

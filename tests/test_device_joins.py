@@ -268,3 +268,39 @@ def test_mixed_type_join_keys_coerce(session):
     assert str(out_s.schema.field("k").type) == "int64"
     ja = fact.join(dim, on="k", how="left_anti")
     assert assert_tpu_cpu_equal(ja).num_rows == 20
+
+
+@pytest.mark.parametrize("strategy", ["sort", "hash"])
+def test_join_strategy_differential(strategy):
+    """The sort-free hash slot-table join (spark.rapids.tpu.join.strategy)
+    matches the sorted searchsorted path and the host engine, including
+    duplicate-key builds (which fall back to the general count path) and
+    null keys."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import pyarrow as pa
+    from spark_rapids_tpu.expr.functions import col, lit
+    from spark_rapids_tpu.session import TpuSession
+    rng = np.random.default_rng(13)
+    n = 20_000
+    kv = rng.integers(0, 3000, n)
+    kmask = np.ones(n, bool)
+    kmask[::37] = False
+    fact = pa.table({"k": pa.array(kv, mask=~kmask),
+                     "v": rng.normal(size=n)})
+    dim = pa.table({"k": np.arange(3000, dtype=np.int64),
+                    "w": rng.normal(size=3000)})
+    dup = pa.table({"k": np.repeat(np.arange(50, dtype=np.int64), 2),
+                    "w": rng.normal(size=100)})
+    sess = TpuSession({"spark.rapids.tpu.batchRowsMinBucket": 2048,
+                       "spark.rapids.tpu.join.strategy": strategy,
+                       "spark.rapids.tpu.autoBroadcastJoinThreshold": -1})
+    f = sess.create_dataframe(fact, num_partitions=2)
+    for build in (dim, dup):
+        d = sess.create_dataframe(build, num_partitions=2)
+        for how in ("inner", "left", "left_semi", "left_anti"):
+            q = f.join(d.filter(col("k") < lit(1500)), on="k", how=how)
+            dev = sorted(map(str, q.collect(device=True).to_pylist()))
+            cpu = sorted(map(str, q.collect(device=False).to_pylist()))
+            assert dev == cpu, (strategy, how, build.num_rows)

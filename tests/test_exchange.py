@@ -181,3 +181,38 @@ def test_exchange_streams_chunks_out_of_core():
         .sort_values("k").reset_index(drop=True)
     assert (got["k"] == exp["k"]).all()
     assert np.allclose(got["s"], exp["v"])
+
+
+def test_hash_strategies_over_mesh():
+    """The TPU-default (auto off-CPU) hash group-by and hash join compile
+    and run through the ICI mesh exchange under shard_map — the exact
+    program shape the real-chip bench uses."""
+    import numpy as np
+    import pyarrow as pa
+    from spark_rapids_tpu.session import TpuSession
+    from spark_rapids_tpu.expr.functions import col, count, sum as fsum
+    from spark_rapids_tpu.parallel.mesh import virtual_cpu_mesh
+
+    rng = np.random.default_rng(0)
+    sess = TpuSession({"spark.rapids.tpu.batchRowsMinBucket": 8,
+                       "spark.rapids.tpu.shuffle.partitions": 4,
+                       "spark.rapids.tpu.groupby.strategy": "hash",
+                       "spark.rapids.tpu.join.strategy": "hash",
+                       "spark.rapids.tpu.autoBroadcastJoinThreshold": -1})
+    sess.attach_mesh(virtual_cpu_mesh(8))
+    n = 2048
+    t = pa.table({"k": rng.integers(0, 16, n).astype(np.int64),
+                  "v": rng.uniform(0, 10, n)})
+    df = sess.create_dataframe(t, num_partitions=2)
+    q = df.group_by("k").agg(fsum(col("v")).alias("s"),
+                             count(col("v")).alias("n"))
+    got = q.collect(device=True)
+    assert got.num_rows == 16
+    total = sum(got.column("s").to_pylist())
+    expected = float(np.sum(t.column("v").to_numpy()))
+    assert abs(total - expected) / expected < 1e-9
+    dim = sess.create_dataframe(
+        pa.table({"k": np.arange(16, dtype=np.int64),
+                  "w": rng.uniform(0, 1, 16)}), num_partitions=2)
+    jd = df.join(dim, on="k", how="inner").collect(device=True)
+    assert jd.num_rows == n
