@@ -17,7 +17,7 @@ from ..plan.physical import PhysicalPlan
 from ..utils import metrics as M
 from .base import TpuExec
 
-__all__ = ["TpuParquetScanExec", "TpuCsvScanExec"]
+__all__ = ["TpuParquetScanExec", "TpuCsvScanExec", "TpuJsonScanExec"]
 
 
 class TpuParquetScanExec(TpuExec):
@@ -111,14 +111,10 @@ class TpuCsvScanExec(TpuExec):
             yield from self._decode_file(path, raw)
 
     def _decode_file(self, path: str, raw: bytes) -> Iterator[DeviceTable]:
-        import jax.numpy as jnp
         import numpy as _np
 
-        from ..columnar.device import (DeviceColumn, DeviceTable,
-                                       bucket_rows, bucket_width)
-        from ..io.csv_device import decode_lines, lines_to_matrix, split_lines
+        from ..io.csv_device import decode_lines, split_lines
         from ..io.file_block import set_input_file
-        from ..utils.compile_cache import cached_jit
 
         set_input_file(path, 0, len(raw))
         if b'"' in raw:
@@ -130,10 +126,9 @@ class TpuCsvScanExec(TpuExec):
             return
         full_schema = self.source.schema()
         fields = [(f.name, f.dtype) for f in full_schema]
-        names = self.schema.names
-        col_indices = [full_schema.names.index(n) for n in names]
+        col_indices = [full_schema.names.index(n)
+                       for n in self.schema.names]
         sep = ord(self.source.sep)
-        batch_rows = self.source.batch_rows
 
         starts, lengths = split_lines(raw, skip_header=self.source.header)
         # ragged-row gate: the host reader RAISES on inconsistent field
@@ -147,6 +142,31 @@ class TpuCsvScanExec(TpuExec):
         if len(starts) and not (nseps == len(fields) - 1).all():
             yield from self._host_fallback_file(path)
             return
+        key_prefix = (f"csv|{sep}|"
+                      + ",".join(f"{i}:{fields[i][1]!r}"
+                                 for i in col_indices))
+        yield from self._decode_line_batches(
+            raw, starts, lengths, fields, col_indices, key_prefix,
+            lambda: (lambda m, ln: decode_lines(m, ln, fields, sep,
+                                                col_indices)))
+
+    def _decode_line_batches(self, raw, starts, lengths, fields,
+                             col_indices, key_prefix, builder
+                             ) -> Iterator[DeviceTable]:
+        """Shared line-batch loop for the text decoders: bucket lines into
+        a byte matrix, run the cached jitted decoder, assemble the
+        DeviceTable (zero-row edge cases live here, once)."""
+        import jax.numpy as jnp
+        import numpy as _np
+
+        from ..columnar import dtypes as dt
+        from ..columnar.device import (DeviceColumn, DeviceTable,
+                                       bucket_rows, bucket_width)
+        from ..io.csv_device import lines_to_matrix
+        from ..utils.compile_cache import cached_jit
+
+        names = self.schema.names
+        batch_rows = self.source.batch_rows
         total = len(starts)
         pos = 0
         while pos < total or (pos == 0 and total == 0):
@@ -159,17 +179,11 @@ class TpuCsvScanExec(TpuExec):
                 mat = lines_to_matrix(raw, s, l, cap, width)
                 lens = _np.zeros(cap, dtype=_np.int32)
                 lens[:n] = l
-                key = (f"csv|{cap}x{width}|{sep}|"
-                       + ",".join(f"{i}:{fields[i][1]!r}"
-                                  for i in col_indices))
-                fn = cached_jit(key, lambda: (
-                    lambda m, ln: decode_lines(m, ln, fields, sep,
-                                               col_indices)))
+                fn = cached_jit(f"{key_prefix}|{cap}x{width}", builder)
                 decoded = fn(jnp.asarray(mat), jnp.asarray(lens))
                 iota = _np.arange(cap, dtype=_np.int32)
                 row_mask = jnp.asarray(iota < n)
                 cols = []
-                from ..columnar import dtypes as dt
                 for entry, idx in zip(decoded, col_indices):
                     d = fields[idx][1]
                     if isinstance(d, dt.StringType):
@@ -200,3 +214,35 @@ class TpuCsvScanExec(TpuExec):
             yield _DT.from_host(ht, self.min_bucket)
             self.metrics.add(M.NUM_OUTPUT_BATCHES, 1)
             self.metrics.add(M.NUM_OUTPUT_ROWS, ht.num_rows)
+
+
+class TpuJsonScanExec(TpuCsvScanExec):
+    """JSON-lines scan with device span-extraction + typed parse
+    (reference: GpuJsonScan.scala). Shares the line-framing/batching
+    machinery with the CSV scan; only the per-batch decode differs."""
+
+    def _decode_file(self, path: str, raw: bytes) -> Iterator[DeviceTable]:
+        from ..io.csv_device import split_lines
+        from ..io.json_device import decode_json_lines
+        from ..io.file_block import set_input_file
+
+        set_input_file(path, 0, len(raw))
+        if b"\\" in raw:
+            # escapes discovered past the tag-time sample: host parse
+            yield from self._host_fallback_file(path)
+            return
+        full_schema = self.source.schema()
+        fields = [(f.name, f.dtype) for f in full_schema]
+        col_indices = [full_schema.names.index(n)
+                       for n in self.schema.names]
+        starts, lengths = split_lines(raw, skip_header=False)
+        # JSON kernels bake field NAMES into the traced program (token
+        # matching), so the cache key must carry them — two sources with
+        # same-position dtypes but different keys may NOT share a program
+        key_prefix = ("json|"
+                      + ",".join(f"{fields[i][0]}:{fields[i][1]!r}"
+                                 for i in col_indices))
+        yield from self._decode_line_batches(
+            raw, starts, lengths, fields, col_indices, key_prefix,
+            lambda: (lambda m, ln: decode_json_lines(m, ln, fields,
+                                                     col_indices)))

@@ -194,15 +194,5 @@ class CsvSource(DataSource):
                                       self.batch_rows):
             yield from self._slice_out(merged, columns)
 
-    def _slice_out(self, t: pa.Table, columns) -> Iterator[HostTable]:
-        if columns:
-            t = t.select([c for c in columns if c in t.column_names])
-        pos = 0
-        while pos < t.num_rows or (pos == 0 and t.num_rows == 0):
-            yield HostTable.from_arrow(t.slice(pos, self.batch_rows))
-            pos += self.batch_rows
-            if t.num_rows == 0:
-                break
-
     def name(self) -> str:
         return f"CSV[{len(self.files)} files]"

@@ -61,20 +61,22 @@ class JsonSource(DataSource):
     def partitions(self) -> int:
         return len(self._file_parts)
 
+    def sample_head(self, nbytes: int = 1 << 16) -> bytes:
+        """First bytes of the first file — escape sniffing for the device
+        decoder gate (exec/scan.py TpuJsonScanExec)."""
+        with open(self.files[0], "rb") as f:
+            return f.read(nbytes)
+
+    def _read_file(self, path: str) -> pa.Table:
+        return pajson.read_json(path)
+
     def read_partition(self, pidx: int, columns: Optional[List[str]] = None
                        ) -> Iterator[HostTable]:
         from .file_block import set_input_file
         for f in self._file_parts[pidx]:
-            t = pajson.read_json(f)
+            t = self._read_file(f)
             set_input_file(f, 0, os.path.getsize(f))
-            if columns:
-                t = t.select([c for c in columns if c in t.column_names])
-            pos = 0
-            while pos < t.num_rows or (pos == 0 and t.num_rows == 0):
-                yield HostTable.from_arrow(t.slice(pos, self.batch_rows))
-                pos += self.batch_rows
-                if t.num_rows == 0:
-                    break
+            yield from self._slice_out(t, columns)
 
     def name(self) -> str:
         return f"JSON[{len(self.files)} files]"

@@ -1,0 +1,193 @@
+"""Device JSON-lines decode (reference: GpuJsonScan.scala — cuDF's device
+JSON parse with per-type gates, RapidsConf.scala:877-917).
+
+Scope (tag-gated; anything else falls back to the host pyarrow reader):
+flat schemas of bool/int/float/string/date, standard JSON-lines with NO
+backslash escapes in the sampled bytes. Within that scope the decode is
+exact and fully vectorized over the (rows, W) line byte matrix:
+
+- string state = parity of a cumulative double-quote count (valid because
+  escapes are excluded), so key tokens, value spans, and top-level
+  delimiters are all recognizable elementwise;
+- per field: match the ``"name"`` token at string-opening positions,
+  locate the colon, slice the value span (quote-delimited for strings,
+  up-to-top-level ``,``/``}`` otherwise), scatter it into a field byte
+  matrix, and feed the existing string->typed cast kernels
+  (expr/cast_kernels.py) — one jitted program per (schema, bucket).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..columnar import dtypes as dt
+from ..conf import register_conf
+
+JSON_DEVICE_DECODE = register_conf(
+    "spark.rapids.tpu.json.deviceDecode.enabled",
+    "Decode JSON-lines scans on the accelerator (quote-parity span "
+    "extraction + typed parse kernels). Escaped strings, nested values, "
+    "and timestamp columns fall back to the host reader (reference: "
+    "GpuJsonScan per-type gates).", True)
+
+__all__ = ["JSON_DEVICE_DECODE", "decode_json_lines",
+           "json_device_decodable_reason"]
+
+
+def json_device_decodable_reason(schema, sample: bytes) -> Optional[str]:
+    """None when the device decoder can handle this scan, else the reason."""
+    if b"\\" in sample:
+        return "escaped strings use the host reader"
+    for f in schema:
+        d = f.dtype
+        if isinstance(d, (dt.ArrayType, dt.StructType, dt.MapType)):
+            return f"nested column {f.name} decodes host-side"
+        if isinstance(d, dt.TimestampType):
+            return f"timestamp column {f.name} parses on the host"
+        if not isinstance(d, (dt.StringType, dt.BooleanType, dt.ByteType,
+                              dt.ShortType, dt.IntegerType, dt.LongType,
+                              dt.FloatType, dt.DoubleType, dt.DateType)):
+            return f"column {f.name}: {d!r} has no device JSON parser"
+    return None
+
+
+def _match_token(mat, token: bytes):
+    """(rows, W) bool: token starts at byte j (overruns never match)."""
+    import jax.numpy as jnp
+    rows, w = mat.shape
+    eq = jnp.ones((rows, w), dtype=bool)
+    for l, ch in enumerate(token):
+        if l == 0:
+            shifted = mat
+        else:
+            shifted = jnp.pad(mat[:, l:], ((0, 0), (0, l)))
+        eq = jnp.logical_and(eq, shifted == np.uint8(ch))
+    if len(token) > 1:
+        j = jnp.arange(w, dtype=jnp.int32)
+        eq = jnp.logical_and(eq, j[None, :] <= w - len(token))
+    return eq
+
+
+def decode_json_lines(mat, lengths,
+                      fields: List[Tuple[str, dt.DataType]],
+                      col_indices: List[int]):
+    """Jit-traceable: (rows, W) JSON-line matrix -> per-column planes,
+    same output contract as csv_device.decode_lines."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..expr.cast_kernels import (string_to_bool_device,
+                                     string_to_date_device,
+                                     string_to_double_device,
+                                     string_to_long_device)
+    rows, w = mat.shape
+    j = jnp.arange(w, dtype=jnp.int32)
+    in_line = j[None, :] < lengths[:, None]
+    quote = jnp.logical_and(mat == np.uint8(ord('"')), in_line)
+    # parity BEFORE byte j: True = byte j sits inside a string literal
+    cum_q = jnp.cumsum(quote.astype(jnp.int32), axis=1)
+    in_str = ((cum_q - quote.astype(jnp.int32)) % 2) == 1
+    is_space = jnp.logical_or(
+        mat == np.uint8(ord(" ")),
+        jnp.logical_or(mat == np.uint8(ord("\t")),
+                       mat == np.uint8(ord("\r"))))
+    top_delim = jnp.logical_and(
+        jnp.logical_and(
+            jnp.logical_or(mat == np.uint8(ord(",")),
+                           mat == np.uint8(ord("}"))),
+            jnp.logical_not(in_str)), in_line)
+    rix = jnp.broadcast_to(jnp.arange(rows, dtype=jnp.int32)[:, None],
+                           (rows, w))
+    null_tok = _match_token(mat, b"null")
+
+    out = []
+    for k in col_indices:
+        name, d = fields[k]
+        token = b'"' + name.encode() + b'"'
+        L = len(token)
+        # key token: opening quote at non-string parity, and the first
+        # non-whitespace byte after it must be a colon (any run of
+        # spaces/tabs tolerated — standard JSON formatting)
+        m = jnp.logical_and(_match_token(mat, token),
+                            jnp.logical_not(in_str))
+        m = jnp.logical_and(m, in_line)
+        nonspace_l = jnp.logical_and(jnp.logical_not(is_space), in_line)
+        # next_ns[i, jj] = first column >= jj with a non-space byte (w-1
+        # clamp; suffix-min scan) — lets every candidate validate "next
+        # non-space is ':'" so a string VALUE equal to the key token can
+        # never shadow the real key
+        ns_idx = jnp.where(nonspace_l, j[None, :], w)
+        next_ns = jax.lax.cummin(ns_idx[:, ::-1], axis=1)[:, ::-1]
+        next_ns_safe = jnp.clip(next_ns, 0, w - 1)
+        colon_at_next = jnp.take_along_axis(mat, next_ns_safe, axis=1) \
+            == np.uint8(ord(":"))
+        colon_at_next = jnp.logical_and(colon_at_next, next_ns < w)
+        # candidate at j is a real key iff colon_at_next at column j+L
+        colon_after = jnp.pad(colon_at_next[:, L:], ((0, 0), (0, L)))
+        valid_cand = jnp.logical_and(m, colon_after)
+        present = jnp.any(valid_cand, axis=1)
+        kpos = jnp.where(present, jnp.argmax(valid_cand, axis=1), 0) \
+            .astype(jnp.int32)
+        cpos = jnp.take_along_axis(
+            next_ns_safe, jnp.clip(kpos + L, 0, w - 1)[:, None],
+            axis=1)[:, 0]
+        after_colon = j[None, :] > cpos[:, None]
+        nonspace = jnp.logical_and(jnp.logical_not(is_space), in_line)
+        vstart_mask = jnp.logical_and(after_colon, nonspace)
+        has_v = jnp.any(vstart_mask, axis=1)
+        vstart = jnp.where(has_v, jnp.argmax(vstart_mask, axis=1), 0) \
+            .astype(jnp.int32)
+        first_byte = jnp.take_along_axis(mat, vstart[:, None], axis=1)[:, 0]
+        is_str_val = first_byte == np.uint8(ord('"'))
+        # string value: [vstart+1, next quote); other: [vstart, next
+        # top-level , or } )
+        after_vs = j[None, :] > vstart[:, None]
+        closeq = jnp.logical_and(quote, after_vs)
+        q_end = jnp.where(jnp.any(closeq, axis=1),
+                          jnp.argmax(closeq, axis=1),
+                          lengths).astype(jnp.int32)
+        d_end_mask = jnp.logical_and(top_delim, after_vs)
+        d_end = jnp.where(jnp.any(d_end_mask, axis=1),
+                          jnp.argmax(d_end_mask, axis=1),
+                          lengths).astype(jnp.int32)
+        start = jnp.where(is_str_val, vstart + 1, vstart)
+        end = jnp.where(is_str_val, q_end, d_end)
+        # null literal or absent key -> null
+        v_null = jnp.take_along_axis(null_tok, vstart[:, None], axis=1)[:, 0]
+        valid_span = jnp.logical_and(
+            jnp.logical_and(present, has_v),
+            jnp.logical_and(jnp.logical_not(v_null), end >= start))
+        span = jnp.logical_and(j[None, :] >= start[:, None],
+                               j[None, :] < end[:, None])
+        span = jnp.logical_and(span, in_line)
+        flen = jnp.where(valid_span, (end - start), 0).astype(jnp.int32)
+        dest = jnp.where(span, j - start[:, None], w)
+        fmat = jnp.zeros((rows, w + 1), jnp.uint8) \
+            .at[rix, dest].set(mat, mode="drop")[:, :w]
+        if isinstance(d, dt.StringType):
+            # empty strings "" stay VALID strings in JSON (unlike CSV)
+            out.append((fmat, jnp.logical_and(valid_span, is_str_val),
+                        flen))
+            continue
+        if isinstance(d, dt.BooleanType):
+            vals, ok = string_to_bool_device(fmat, flen)
+        elif isinstance(d, (dt.ByteType, dt.ShortType, dt.IntegerType,
+                            dt.LongType)):
+            vals, ok = string_to_long_device(fmat, flen)
+            info = np.iinfo(d.np_dtype())
+            ok = jnp.logical_and(
+                ok, jnp.logical_and(vals >= info.min, vals <= info.max))
+            vals = vals.astype(d.np_dtype())
+        elif isinstance(d, (dt.FloatType, dt.DoubleType)):
+            vals, ok = string_to_double_device(fmat, flen)
+            vals = vals.astype(d.np_dtype())
+        elif isinstance(d, dt.DateType):
+            # dates arrive as quoted strings
+            vals, ok = string_to_date_device(fmat, flen)
+        else:  # pragma: no cover - gated by json_device_decodable_reason
+            raise TypeError(f"no device JSON parser for {d!r}")
+        valid = jnp.logical_and(jnp.logical_and(valid_span, flen > 0), ok)
+        vals = jnp.where(valid, vals, jnp.zeros((), vals.dtype))
+        out.append((vals, valid))
+    return out

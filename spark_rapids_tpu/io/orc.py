@@ -142,15 +142,5 @@ class OrcSource(DataSource):
                 self.batch_rows):
             yield from self._slice_out(merged)
 
-    def _slice_out(self, t: pa.Table) -> Iterator[HostTable]:
-        if isinstance(t, pa.RecordBatch):
-            t = pa.Table.from_batches([t])
-        pos = 0
-        while pos < t.num_rows or (pos == 0 and t.num_rows == 0):
-            yield HostTable.from_arrow(t.slice(pos, self.batch_rows))
-            pos += self.batch_rows
-            if t.num_rows == 0:
-                break
-
     def name(self) -> str:
         return f"ORC[{len(self.files)} files, {self.reader_type}]"

@@ -43,6 +43,24 @@ class DataSource:
         """Best-effort size estimate for broadcast planning; None = unknown."""
         return None
 
+    def _slice_out(self, t, columns=None):
+        """Shared batching generator: arrow table -> HostTable batches of
+        self.batch_rows rows (the zero-row edge case lives here, once)."""
+        import pyarrow as pa
+
+        from ..columnar.host import HostTable
+        if isinstance(t, pa.RecordBatch):
+            t = pa.Table.from_batches([t])
+        if columns:
+            t = t.select([c for c in columns if c in t.column_names])
+        batch_rows = self.batch_rows
+        pos = 0
+        while pos < t.num_rows or (pos == 0 and t.num_rows == 0):
+            yield HostTable.from_arrow(t.slice(pos, batch_rows))
+            pos += batch_rows
+            if t.num_rows == 0:
+                break
+
 
 class LogicalPlan:
     children: Tuple["LogicalPlan", ...] = ()

@@ -528,9 +528,22 @@ def _register_exec_rules():
     def tag_scan(meta, conf):
         from ..io.csv import CsvSource
         from ..io.csv_device import CSV_DEVICE_DECODE, device_decodable_reason
+        from ..io.json import JsonSource
+        from ..io.json_device import (JSON_DEVICE_DECODE,
+                                      json_device_decodable_reason)
         from ..io.parquet import ParquetSource
         from ..io.parquet_device import PARQUET_DEVICE_DECODE
         p: CpuScanExec = meta.plan
+        if isinstance(p.source, JsonSource):
+            if not conf.get(JSON_DEVICE_DECODE):
+                meta.cannot_run("device json decode disabled by "
+                                "spark.rapids.tpu.json.deviceDecode.enabled")
+                return
+            reason = json_device_decodable_reason(
+                p.source.schema(), p.source.sample_head())
+            if reason:
+                meta.cannot_run(f"json: {reason}")
+            return
         if isinstance(p.source, CsvSource):
             if not conf.get(CSV_DEVICE_DECODE):
                 meta.cannot_run("device csv decode disabled by "
@@ -544,7 +557,7 @@ def _register_exec_rules():
             return
         if not isinstance(p.source, ParquetSource):
             meta.cannot_run(f"{p.source.name()} decodes host-side "
-                            "(only parquet and csv have device decoders)")
+                            "(parquet/csv/json have device decoders)")
             return
         if not conf.get(PARQUET_DEVICE_DECODE):
             meta.cannot_run("device parquet decode disabled by "
@@ -555,8 +568,12 @@ def _register_exec_rules():
                             "row-group statistics pruning")
 
     def _convert_scan(p, ch, conf):
-        from ..exec.scan import TpuCsvScanExec
+        from ..exec.scan import TpuCsvScanExec, TpuJsonScanExec
         from ..io.csv import CsvSource
+        from ..io.json import JsonSource
+        if isinstance(p.source, JsonSource):
+            return TpuJsonScanExec(p.source, p.columns, p.schema,
+                                   conf.min_bucket_rows)
         if isinstance(p.source, CsvSource):
             return TpuCsvScanExec(p.source, p.columns, p.schema,
                                   conf.min_bucket_rows)

@@ -298,3 +298,51 @@ def test_csv_crlf_blank_lines_and_ragged_rows(session, tmp_path):
         df2.collect(device=False)
     with _pt.raises(Exception, match="columns"):
         df2.collect(device=True)
+
+
+def test_json_device_decode_differential(session, tmp_path):
+    """Device JSON-lines decode (reference: GpuJsonScan.scala): quote-
+    parity span extraction + typed parse; keys in any order, delimiters
+    inside strings, null literals, missing keys."""
+    p = tmp_path / "t.jsonl"
+    p.write_text(
+        '{"a": 1, "b": 2.5, "c": true, "s": "hello"}\n'
+        '{"a": -7, "b": null, "c": false, "s": ""}\n'
+        '{"b": 1e3, "a": 99, "s": "swap, order", "c": true}\n'
+        '{"a": null, "s": null}\n'
+        '{"s": "brace } in str", "a": 5, "b": 0.25, "c": false}\n')
+    df = session.read_json(str(p))
+    ex = df.explain("tpu")
+    assert "CpuScanExec will run on TPU" in ex, ex
+    dev = df.collect(device=True).to_pylist()
+    cpu = df.collect(device=False).to_pylist()
+    assert [str(r) for r in dev] == [str(r) for r in cpu]
+    assert dev[2]["s"] == "swap, order" and dev[4]["s"] == "brace } in str"
+
+
+def test_json_whitespace_and_value_shadowing(session, tmp_path):
+    """Arbitrary space/tab runs around colons; a string VALUE equal to a
+    key token must not shadow the real key (every candidate validates
+    next-non-space == ':')."""
+    p = tmp_path / "w.jsonl"
+    p.write_text('{"a"  :  1, "s": "x"}\n'
+                 '{"s"\t: "a", "a": 2}\n'
+                 '{ "a":3 ,"s" : "y" }\n')
+    df = session.read_json(str(p))
+    assert "will run on TPU" in df.explain("tpu")
+    dev = df.collect(device=True).to_pylist()
+    cpu = df.collect(device=False).to_pylist()
+    assert [str(r) for r in dev] == [str(r) for r in cpu]
+    assert dev[1]["a"] == 2 and dev[1]["s"] == "a"
+
+
+def test_json_escapes_fall_back(session, tmp_path):
+    p = tmp_path / "esc.jsonl"
+    p.write_text('{"s": "he said \\"hi\\"", "a": 1}\n{"s": "x", "a": 2}\n')
+    df = session.read_json(str(p))
+    ex = df.explain("tpu")
+    assert "escaped strings" in ex, ex
+    dev = df.collect(device=True).to_pylist()
+    cpu = df.collect(device=False).to_pylist()
+    assert [str(r) for r in dev] == [str(r) for r in cpu]
+    assert dev[0]["s"] == 'he said "hi"'
