@@ -574,6 +574,33 @@ class _JoinKernels:
                counts):
             pi, bi, valid_slot, _, total = self._slots(
                 build, probe, b_order, starts, counts, out_cap, outer=False)
+            if how in ("left_semi", "left_anti"):
+                # pair evaluation only needs the CONDITION's referenced
+                # columns — never assemble the full pair table (q21's
+                # semi/anti pairs would otherwise gather every payload
+                # column per candidate match)
+                refs = condition.references()
+                lnames = [n for n in node.left.schema.names if n in refs]
+                rnames = [n for n in node.right.schema.names if n in refs]
+                cols = tuple(
+                    [probe.column(n).gather(pi).with_validity(
+                        jnp.logical_and(
+                            jnp.take(probe.column(n).validity, pi),
+                            valid_slot)) for n in lnames]
+                    + [build.column(n).gather(bi).with_validity(
+                        jnp.logical_and(
+                            jnp.take(build.column(n).validity, bi),
+                            valid_slot)) for n in rnames])
+                pairs = DeviceTable(cols, valid_slot,
+                                    total.astype(jnp.int32),
+                                    tuple(lnames + rnames))
+                keep = _condition_mask(condition, pairs)
+                keep = jnp.logical_and(keep, valid_slot)
+                any_pass = jnp.zeros(probe.capacity, dtype=bool) \
+                    .at[pi].max(keep, mode="drop")
+                keep_rows = jnp.logical_not(any_pass) \
+                    if how == "left_anti" else any_pass
+                return probe.filter_mask(keep_rows)
             pcols = _gather_columns(probe, pi, valid_slot)
             bcols = _gather_columns(build, bi, valid_slot)
             out_cols, names = node.assemble(pcols, bcols, valid_slot)
@@ -593,10 +620,6 @@ class _JoinKernels:
                 seen_upd = jnp.zeros(build.capacity, dtype=bool).at[bi].max(
                     keep, mode="drop")
                 outs.append(seen_upd)
-            if how in ("left_semi", "left_anti"):
-                keep_rows = jnp.logical_not(any_pass) \
-                    if how == "left_anti" else any_pass
-                return probe.filter_mask(keep_rows)
             return tuple(outs)
         return fn
 
