@@ -981,9 +981,14 @@ class TpuShuffledHashJoinExec(TpuExec):
                     clone, ckey = self._canon()
                     out = None
                     if _resolve_join_strategy() == "hash":
-                        # sort-free tier: open-addressing slot table
+                        # sort-free tier: open-addressing slot table.
+                        # semi/anti only ask EXISTENCE, so duplicate build
+                        # keys are fine (the chain walk finds any
+                        # representative); inner/left need uniqueness for
+                        # the single-match gather
                         slot_row, bv, unique = self._get_prep_hash(build)
-                        if unique:
+                        if unique or self.how in ("left_semi",
+                                                  "left_anti"):
                             fused = cached_jit(
                                 ckey + f"|pkh|{self.how}",
                                 lambda: clone._kernels
