@@ -89,7 +89,8 @@ def _compact_impl(table: "DeviceTable") -> "DeviceTable":
     iota = jnp.arange(table.capacity, dtype=jnp.int32)
     mask = iota < table.num_rows
     # masked-off tail keeps stale data; null it for hygiene
-    cols = tuple(c.with_validity(jnp.logical_and(c.validity, mask))
+    cols = tuple(c.with_validity(jnp.logical_and(c.validity, mask),
+                                 all_valid=c.all_valid)
                  for c in cols)
     return DeviceTable(cols, mask, table.num_rows, table.names)
 
@@ -132,6 +133,12 @@ class DeviceColumn:
     lengths: Optional[jax.Array] = None  # (capacity,) int32 for string/binary
     elem_validity: Optional[jax.Array] = None  # (capacity, width) bool, arrays
     children: Optional[Tuple["DeviceColumn", ...]] = None  # struct/map
+    #: STATIC null-freedom promise: every row under the table's row_mask is
+    #: valid. Kernels may then skip validity reads entirely and XLA DCEs the
+    #: unused plane (the validity array itself stays correct either way).
+    #: False is always safe. (The reference gets this from cuDF's null_count
+    #: == 0 fast paths; here it must be static to specialize the program.)
+    all_valid: bool = False
 
     # -- pytree protocol ------------------------------------------------------
     def tree_flatten(self):
@@ -144,19 +151,21 @@ class DeviceColumn:
             leaves.append(self.children)
         return tuple(leaves), (self.dtype, self.lengths is not None,
                                self.elem_validity is not None,
-                               self.children is not None)
+                               self.children is not None, self.all_valid)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
         if len(aux) == 3:
             aux = (*aux, False)
-        dtype, has_len, has_ev, has_kids = aux
+        if len(aux) == 4:
+            aux = (*aux, False)
+        dtype, has_len, has_ev, has_kids, all_valid = aux
         it = iter(children)
         data, validity = next(it), next(it)
         lengths = next(it) if has_len else None
         ev = next(it) if has_ev else None
         kids = tuple(next(it)) if has_kids else None
-        return cls(data, validity, dtype, lengths, ev, kids)
+        return cls(data, validity, dtype, lengths, ev, kids, all_valid)
 
     @property
     def capacity(self) -> int:
@@ -174,14 +183,18 @@ class DeviceColumn:
         take = lambda a: None if a is None else jnp.take(a, idx, axis=0)
         kids = None if self.children is None \
             else tuple(c.gather(idx) for c in self.children)
+        # a permutation/gather keeps the promise only when callers mask the
+        # result rows they expose; row-level gathers in this codebase do
+        # (compact, shuffle slice, join output), so the flag survives
         return DeviceColumn(jnp.take(self.data, idx, axis=0),
                             jnp.take(self.validity, idx, axis=0),
                             self.dtype, take(self.lengths),
-                            take(self.elem_validity), kids)
+                            take(self.elem_validity), kids, self.all_valid)
 
-    def with_validity(self, validity: jax.Array) -> "DeviceColumn":
+    def with_validity(self, validity: jax.Array,
+                      all_valid: bool = False) -> "DeviceColumn":
         return DeviceColumn(self.data, validity, self.dtype, self.lengths,
-                            self.elem_validity, self.children)
+                            self.elem_validity, self.children, all_valid)
 
 
 @jax.tree_util.register_pytree_node_class
@@ -571,6 +584,7 @@ def _upload_column(hc: HostColumn, capacity: int) -> DeviceColumn:
     n = len(hc)
     validity = np.zeros(capacity, dtype=np.bool_)
     validity[:n] = hc.valid_mask()
+    all_valid = hc.validity is None or bool(validity[:n].all())
     if isinstance(hc.dtype, dt.StructType):
         kids = tuple(_upload_column(_host_field_column(hc, i), capacity)
                      for i in range(len(hc.dtype.fields)))
@@ -586,22 +600,24 @@ def _upload_column(hc: HostColumn, capacity: int) -> DeviceColumn:
             hc.values, capacity, isinstance(hc.dtype, dt.BinaryType),
             arrow=getattr(hc, "_arrow", None))
         return DeviceColumn(jnp.asarray(mat), jnp.asarray(validity), hc.dtype,
-                            jnp.asarray(lengths))
+                            jnp.asarray(lengths), all_valid=all_valid)
     if isinstance(hc.dtype, dt.ArrayType):
         mat, lengths, ev = _encode_list_matrix(hc, capacity)
         return DeviceColumn(jnp.asarray(mat), jnp.asarray(validity), hc.dtype,
                             jnp.asarray(lengths),
-                            None if ev is None else jnp.asarray(ev))
+                            None if ev is None else jnp.asarray(ev),
+                            all_valid=all_valid)
     if dt.is_d128(hc.dtype):
         # wide decimals: host object ints -> (capacity, 2) int64 limbs
         from ..expr.decimal128 import limbs_from_py_ints
         limbs = limbs_from_py_ints(hc.values, capacity)
         return DeviceColumn(jnp.asarray(limbs), jnp.asarray(validity),
-                            hc.dtype, None)
+                            hc.dtype, None, all_valid=all_valid)
     np_dt = hc.dtype.np_dtype()
     vals = np.zeros(capacity, dtype=np_dt)
     vals[:n] = hc.values.astype(np_dt, copy=False)
-    return DeviceColumn(jnp.asarray(vals), jnp.asarray(validity), hc.dtype, None)
+    return DeviceColumn(jnp.asarray(vals), jnp.asarray(validity), hc.dtype,
+                        None, all_valid=all_valid)
 
 
 def concat_device_tables(tables: Sequence[DeviceTable], min_bucket: int = 1024
@@ -688,7 +704,8 @@ def _concat_columns(parts: List[DeviceColumn], tail: int) -> DeviceColumn:
     validity = jnp.concatenate([p.validity for p in parts])
     if tail:
         validity = jnp.pad(validity, (0, tail))
-    return DeviceColumn(data, validity, parts[0].dtype, lengths, ev, kids)
+    return DeviceColumn(data, validity, parts[0].dtype, lengths, ev, kids,
+                        all(p.all_valid for p in parts))
 
 
 _concat_jitted = jax.jit(_concat_impl, static_argnums=(1,))
@@ -729,7 +746,7 @@ def _slice_rows_impl(table: DeviceTable, start, length: int) -> DeviceTable:
             None if c.lengths is None else slc(c.lengths),
             None if c.elem_validity is None else slc(c.elem_validity),
             None if c.children is None
-            else tuple(slc_col(k) for k in c.children))
+            else tuple(slc_col(k) for k in c.children), c.all_valid)
 
     cols = tuple(slc_col(c) for c in table.columns)
     iota = jnp.arange(length, dtype=jnp.int32)
@@ -747,6 +764,8 @@ def shrink_to_fit(table: DeviceTable, min_bucket: int = 1024) -> DeviceTable:
 
     Syncs the row count to host (one int) — used between pipeline steps to
     stop capacities from growing across incremental merges."""
+    if table.capacity <= min_bucket:
+        return table  # cannot shrink below one bucket: skip the device sync
     n = int(table.num_rows)
     cap = bucket_rows(max(n, 1), min_bucket)
     if cap >= table.capacity:
@@ -762,7 +781,8 @@ def shrink_to_fit(table: DeviceTable, min_bucket: int = 1024) -> DeviceTable:
                             None if c.elem_validity is None
                             else cut(c.elem_validity),
                             None if c.children is None
-                            else tuple(cut_col(k) for k in c.children))
+                            else tuple(cut_col(k) for k in c.children),
+                            c.all_valid)
 
     cols = tuple(cut_col(c) for c in compacted.columns)
     return DeviceTable(cols, cut(compacted.row_mask),

@@ -154,13 +154,35 @@ def _keys_equal_prev(sv: jax.Array) -> jax.Array:
     return eq.at[0].set(False) if eq.ndim == 1 else eq
 
 
+def _seg_sum(x, gid, cap):
+    """segment_sum that lowers to a plain reduce when there is one segment
+    (a scatter-add over a single bucket is a serial loop on XLA:CPU and
+    wasted scatter traffic everywhere; the ungrouped aggregate hits this
+    on every batch)."""
+    if cap == 1:
+        return jnp.sum(x, axis=0, keepdims=True)
+    return jax.ops.segment_sum(x, gid, num_segments=cap)
+
+
+def _seg_min(x, gid, cap):
+    if cap == 1:
+        return jnp.min(x, axis=0, keepdims=True)
+    return jax.ops.segment_min(x, gid, num_segments=cap)
+
+
+def _seg_max(x, gid, cap):
+    if cap == 1:
+        return jnp.max(x, axis=0, keepdims=True)
+    return jax.ops.segment_max(x, gid, num_segments=cap)
+
+
 def _reduce_segment(op: str, vals: jax.Array, contrib: jax.Array,
                     gid: jax.Array, cap: int, pos: jax.Array,
                     out_dt: dt.DataType) -> Tuple[jax.Array, jax.Array]:
     """Per-group reduction -> (values[cap], validity[cap])."""
     out_dtype = jnp.dtype(np.bool_ if isinstance(out_dt, dt.BooleanType)
                           else out_dt.np_dtype())
-    counts = jax.ops.segment_sum(contrib.astype(jnp.int64), gid, num_segments=cap)
+    counts = _seg_sum(contrib.astype(jnp.int64), gid, cap)
     has = counts > 0
     if op == "count":
         return counts.astype(out_dtype), jnp.ones(cap, dtype=bool)
@@ -174,7 +196,7 @@ def _reduce_segment(op: str, vals: jax.Array, contrib: jax.Array,
         if op in ("first", "last"):
             p = jnp.where(contrib, -pos if op == "last" else pos,
                           jnp.full_like(pos, _BIG))
-            best = jax.ops.segment_min(p, gid, num_segments=cap)
+            best = _seg_min(p, gid, cap)
             idx = -best if op == "last" else best
             idx = jnp.clip(idx, 0, vals.shape[0] - 1).astype(jnp.int32)
             return jnp.take(vals, idx, axis=0), has
@@ -184,7 +206,7 @@ def _reduce_segment(op: str, vals: jax.Array, contrib: jax.Array,
         if op == "sumsq":
             x = x * x
         x = jnp.where(contrib, x, jnp.zeros_like(x))
-        return jax.ops.segment_sum(x, gid, num_segments=cap), has
+        return _seg_sum(x, gid, cap), has
     if op == "min" or op == "max":
         ident = _minmax_identity(vals.dtype, op == "min")
         x = vals
@@ -195,16 +217,15 @@ def _reduce_segment(op: str, vals: jax.Array, contrib: jax.Array,
             x = jnp.where(nan, jnp.full_like(vals, jnp.inf if op == "min"
                                              else -jnp.inf), vals)
         x = jnp.where(contrib, x, jnp.full_like(x, ident))
-        red = jax.ops.segment_min if op == "min" else jax.ops.segment_max
-        out = red(x, gid, num_segments=cap)
+        red = _seg_min if op == "min" else _seg_max
+        out = red(x, gid, cap)
         if isfloat:
             nan_contrib = jnp.logical_and(contrib, nan)
-            nan_counts = jax.ops.segment_sum(nan_contrib.astype(jnp.int32),
-                                             gid, num_segments=cap)
+            nan_counts = _seg_sum(nan_contrib.astype(jnp.int32), gid, cap)
             if op == "min":
-                nonnan = jax.ops.segment_sum(
+                nonnan = _seg_sum(
                     jnp.logical_and(contrib, jnp.logical_not(nan)).astype(jnp.int32),
-                    gid, num_segments=cap)
+                    gid, cap)
                 out = jnp.where(jnp.logical_and(has, nonnan == 0),
                                 jnp.full_like(out, jnp.nan), out)
             else:
@@ -213,18 +234,16 @@ def _reduce_segment(op: str, vals: jax.Array, contrib: jax.Array,
     if op in ("first", "last"):
         p = jnp.where(contrib, -pos if op == "last" else pos,
                       jnp.full_like(pos, _BIG))
-        best = jax.ops.segment_min(p, gid, num_segments=cap)
+        best = _seg_min(p, gid, cap)
         idx = -best if op == "last" else best
         idx = jnp.clip(idx, 0, vals.shape[0] - 1).astype(jnp.int32)
         return jnp.take(vals, idx, axis=0).astype(out_dtype), has
     if op == "any":
         x = jnp.where(contrib, vals, jnp.zeros_like(vals))
-        return jax.ops.segment_max(x.astype(jnp.int32), gid,
-                                   num_segments=cap).astype(bool), has
+        return _seg_max(x.astype(jnp.int32), gid, cap).astype(bool), has
     if op == "all":
         x = jnp.where(contrib, vals, jnp.ones_like(vals))
-        return jax.ops.segment_min(x.astype(jnp.int32), gid,
-                                   num_segments=cap).astype(bool), has
+        return _seg_min(x.astype(jnp.int32), gid, cap).astype(bool), has
     raise ValueError(op)
 
 
@@ -554,7 +573,8 @@ class TpuHashAggregateExec(TpuExec):
             pos = jnp.arange(table.capacity, dtype=jnp.int64)
             for in_col, op, out_col, out_dt in cols_ops:
                 col = table.column(in_col)
-                contrib = jnp.logical_and(col.validity, table.row_mask)
+                contrib = table.row_mask if col.all_valid \
+                    else jnp.logical_and(col.validity, table.row_mask)
                 gid = jnp.zeros(table.capacity, dtype=jnp.int32)
                 if op in _COLLECT_OPS:
                     data1, lens1 = _collect_segment(
@@ -609,8 +629,8 @@ class TpuHashAggregateExec(TpuExec):
             for in_col, op, out_col, out_dt in cols_ops:
                 col = table.column(in_col)
                 sv = jnp.take(col.data, order, axis=0)
-                svalid = jnp.take(col.validity, order)
-                contrib = jnp.logical_and(svalid, active_s)
+                contrib = active_s if col.all_valid else jnp.logical_and(
+                    jnp.take(col.validity, order), active_s)
                 if op in _COLLECT_OPS:
                     slen = None if col.lengths is None \
                         else jnp.take(col.lengths, order)
@@ -690,7 +710,7 @@ class TpuHashAggregateExec(TpuExec):
             w = jnp.asarray(1, jnp.int32)
             for in_col, op, _, _ in cols_ops:
                 col = table.column(in_col)
-                contrib = jnp.logical_and(
+                contrib = active_s if col.all_valid else jnp.logical_and(
                     jnp.take(col.validity, order), active_s)
                 if op in ("collect_list", "collect_set"):
                     per = jax.ops.segment_sum(

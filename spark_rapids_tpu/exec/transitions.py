@@ -10,29 +10,102 @@ sections start/end, exactly like GpuTransitionOverrides.scala:37.
 """
 from __future__ import annotations
 
+import weakref
 from typing import Iterator, List
 
 from ..columnar.device import DeviceTable, bucket_rows, concat_device_tables
 from ..columnar.host import HostTable
+from ..conf import register_conf
 from ..plan.physical import PhysicalPlan
 from ..utils import metrics as M
 from .base import TpuExec
 
-__all__ = ["HostToDeviceExec", "DeviceToHostExec", "TpuCoalesceBatchesExec"]
+__all__ = ["HostToDeviceExec", "DeviceToHostExec", "TpuCoalesceBatchesExec",
+           "clear_upload_cache"]
+
+SCAN_DEVICE_CACHE = register_conf(
+    "spark.rapids.tpu.scan.deviceCache.enabled",
+    "Keep scanned batches device-resident across executions. Sources that "
+    "re-yield identical host batches (in-memory tables, cached scans) skip "
+    "the host->device re-upload entirely; entries die with their source "
+    "batch and a device OOM drops the whole cache. (reference: "
+    "ParquetCachedBatchSerializer keeps Spark-cached data as device "
+    "batches, com/nvidia/spark/rapids/shims/ParquetCachedBatchSerializer)",
+    True)
+
+SCAN_DEVICE_CACHE_MAX_BYTES = register_conf(
+    "spark.rapids.tpu.scan.deviceCache.maxBytes",
+    "Device-byte budget for the scan upload cache; uploads past the budget "
+    "are not cached (data still flows, uncached). 0 disables caching.",
+    2 << 30)
+
+# Upload memoization keyed by host-batch IDENTITY (HostTable is mutable-ish
+# and unhashable; identity is the right equivalence anyway — sources that
+# cache decoded batches re-yield the same objects). A weakref death-callback
+# removes the entry the moment its source batch is collected, so a recycled
+# id() can never alias a stale upload.
+_UPLOAD_CACHE: dict = {}   # id(batch) -> (weakref, {min_bucket: DeviceTable})
+_OOM_HOOKED = False
+
+
+def _cached_bytes() -> int:
+    return sum(dt.nbytes() for _, per in _UPLOAD_CACHE.values()
+               for dt in per.values())
+
+
+def clear_upload_cache() -> int:
+    """Drop all device-resident scan uploads; returns bytes released."""
+    freed = _cached_bytes()
+    _UPLOAD_CACHE.clear()
+    return freed
+
+
+def _hook_oom() -> None:
+    global _OOM_HOOKED
+    if _OOM_HOOKED:
+        return
+    from ..memory.catalog import get_catalog
+    get_catalog().register_oom_callback(clear_upload_cache)
+    _OOM_HOOKED = True
 
 
 class HostToDeviceExec(TpuExec):
-    def __init__(self, child: PhysicalPlan, min_bucket: int = 1024):
+    def __init__(self, child: PhysicalPlan, min_bucket: int = 1024,
+                 cache_max_bytes: int = 0):
         super().__init__()
         self.child = child
         self.children = (child,)
         self.schema = child.schema
         self.min_bucket = min_bucket
+        self.cache_max_bytes = cache_max_bytes
+
+    def _upload(self, batch: HostTable) -> DeviceTable:
+        if not self.cache_max_bytes:
+            return DeviceTable.from_host(batch, self.min_bucket)
+        key = id(batch)
+        entry = _UPLOAD_CACHE.get(key)
+        if entry is not None and entry[0]() is batch:
+            dtb = entry[1].get(self.min_bucket)
+            if dtb is not None:
+                self.metrics.add(M.UPLOAD_CACHE_HITS, 1)
+                return dtb
+        dtb = DeviceTable.from_host(batch, self.min_bucket)
+        try:
+            if _cached_bytes() + dtb.nbytes() <= self.cache_max_bytes:
+                _hook_oom()
+                if entry is None or entry[0]() is not batch:
+                    ref = weakref.ref(
+                        batch, lambda _r, k=key: _UPLOAD_CACHE.pop(k, None))
+                    entry = _UPLOAD_CACHE[key] = (ref, {})
+                entry[1][self.min_bucket] = dtb
+        except TypeError:
+            pass  # un-weakref-able batch type: serve uncached
+        return dtb
 
     def execute_columnar(self, pidx: int) -> Iterator[DeviceTable]:
         for batch in self.child.execute(pidx):
             with self.metrics.timed(M.UPLOAD_TIME):
-                dtb = DeviceTable.from_host(batch, self.min_bucket)
+                dtb = self._upload(batch)
             self.metrics.add(M.NUM_OUTPUT_BATCHES, 1)
             self.metrics.add(M.NUM_OUTPUT_ROWS, batch.num_rows)
             yield dtb

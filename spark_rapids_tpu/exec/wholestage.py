@@ -27,6 +27,12 @@ class TpuWholeStageExec(TpuExec):
     def __init__(self, chain: List[TpuExec]):
         super().__init__()
         assert chain, "empty fusion chain"
+        # flatten nested whole-stages: the bottom-up fuse pass wraps inner
+        # chains before outer fusible parents are seen, so a parent's chain
+        # may contain an already-fused node
+        chain = [m for n in chain
+                 for m in (n.chain if isinstance(n, TpuWholeStageExec)
+                           else [n])]
         self.chain = chain
         bottom = chain[0]
         # the producer feeding the chain (transition or other non-fused exec)
@@ -45,6 +51,17 @@ class TpuWholeStageExec(TpuExec):
 
     def plan_signature(self) -> str:
         return "WS|" + "||".join(n.plan_signature() for n in self.chain)
+
+    def batch_fn(self):
+        """Composed chain function — lets an outer fusible parent absorb
+        this whole-stage into its own chain (see __init__ flattening)."""
+        fns = [n.batch_fn() for n in self.chain]
+
+        def run(table: DeviceTable) -> DeviceTable:
+            for f in fns:
+                table = f(table)
+            return table
+        return run
 
     def execute_columnar(self, pidx: int) -> Iterator[DeviceTable]:
         from ..utils.compile_cache import cached_jit

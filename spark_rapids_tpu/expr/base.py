@@ -82,7 +82,13 @@ class EvalContext:
         def to_eval(c: DeviceColumn) -> EvalCol:
             kids = None if c.children is None \
                 else tuple(to_eval(k) for k in c.children)
-            return EvalCol(c.data, c.validity, c.dtype, c.lengths,
+            # null-free flat columns enter evaluation with validity=None so
+            # every null-propagation AND drops out of the traced program and
+            # XLA DCEs the unread validity plane (nested columns keep theirs:
+            # struct/map kernels index child validity planes positionally)
+            validity = None if (c.all_valid and c.children is None) \
+                else c.validity
+            return EvalCol(c.data, validity, c.dtype, c.lengths,
                            c.elem_validity, kids)
 
         cols = {n: to_eval(c) for n, c in zip(table.names, table.columns)}
@@ -100,12 +106,13 @@ class EvalContext:
 
     def to_device_column(self, col: EvalCol) -> DeviceColumn:
         validity = col.validity
+        all_valid = validity is None
         if validity is None:
             validity = self.xp.ones(col.values.shape[0], dtype=bool)
         kids = None if col.children is None \
             else tuple(self.to_device_column(k) for k in col.children)
         return DeviceColumn(col.values, validity, col.dtype, col.lengths,
-                            col.elem_validity, kids)
+                            col.elem_validity, kids, all_valid)
 
 
 class Expression:

@@ -111,6 +111,7 @@ class BufferCatalog:
         self._pq_handles: Dict[int, int] = {}  # buffer_id -> pq handle
         self._ids = itertools.count()
         self._lock = threading.RLock()
+        self._oom_callbacks: List = []
         self._oom_spill = conf.get(OOM_SPILL_ENABLED)
         self._pool_mode = conf.get(DEVICE_POOL_MODE)
         self.oom_events = 0  # runtime RESOURCE_EXHAUSTED recoveries
@@ -331,6 +332,14 @@ class BufferCatalog:
                 raise DebugMemoryError(
                     f"{len(leaks)} leaked buffer(s): {detail}")
 
+    def register_oom_callback(self, cb) -> None:
+        """Register a zero-arg callable invoked on device OOM before the
+        catalog spill; it returns bytes it released (droppable device
+        caches — e.g. the scan upload cache — hook in here)."""
+        with self._lock:
+            if cb not in self._oom_callbacks:
+                self._oom_callbacks.append(cb)
+
     def handle_device_oom(self, context: str = "") -> int:
         """Runtime-OOM callback (reference: DeviceMemoryEventHandler.scala:33
         — RMM allocation failure -> synchronous spill -> retry alloc).
@@ -339,11 +348,19 @@ class BufferCatalog:
         device computation raises RESOURCE_EXHAUSTED and retry once. The
         needed allocation size is unknown, so everything spillable moves
         down-tier. Returns bytes freed (0 = nothing left to spill)."""
+        cb_freed = 0
+        with self._lock:
+            callbacks = list(self._oom_callbacks)
+        for cb in callbacks:
+            try:
+                cb_freed += int(cb() or 0)
+            except Exception:
+                pass
         with self._lock:
             target = self.device.used_bytes
         freed = self.synchronous_spill(max(target, 1))
         self.oom_events += 1
-        return freed
+        return freed + cb_freed
 
     def oom_dump(self) -> str:
         """Diagnostic snapshot for a spill-couldn't-save-it failure

@@ -35,7 +35,12 @@ def _walk(node: PhysicalPlan, conf: RapidsConf) -> PhysicalPlan:
     for c in node.children:
         c2 = _walk(c, conf)
         if _is_device(node) and not _is_device(c2):
-            c2 = HostToDeviceExec(c2, conf.min_bucket_rows)
+            from ..exec.transitions import (SCAN_DEVICE_CACHE,
+                                            SCAN_DEVICE_CACHE_MAX_BYTES)
+            cache_bytes = conf.get(SCAN_DEVICE_CACHE_MAX_BYTES) \
+                if conf.get(SCAN_DEVICE_CACHE) else 0
+            c2 = HostToDeviceExec(c2, conf.min_bucket_rows,
+                                  cache_max_bytes=cache_bytes)
         elif not _is_device(node) and _is_device(c2):
             c2 = DeviceToHostExec(c2)
         new_children.append(c2)

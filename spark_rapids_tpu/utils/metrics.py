@@ -31,6 +31,7 @@ NUM_OUTPUT_BATCHES = "numOutputBatches"
 OP_TIME = "opTime"
 SEMAPHORE_WAIT_TIME = "semaphoreWaitTime"
 UPLOAD_TIME = "hostToDeviceTime"
+UPLOAD_CACHE_HITS = "hostToDeviceCacheHits"
 DOWNLOAD_TIME = "deviceToHostTime"
 PEAK_DEVICE_MEMORY = "peakDevMemory"
 SPILL_BYTES = "spillBytes"
