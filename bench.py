@@ -64,6 +64,7 @@ _STATE = {
     "compile_cache": {},
     "sf": None,
     "rows": None,
+    "eventlog": {},   # phase -> event-log directory
     "notes": [],
 }
 
@@ -85,7 +86,8 @@ def _write_partial():
     with open(tmp, "w") as f:
         json.dump({k: _STATE[k] for k in
                    ("backend", "fell_back", "sf", "rows", "smoke", "tpch",
-                    "ablation", "compile_cache", "errors", "notes")}
+                    "ablation", "compile_cache", "errors", "eventlog",
+                    "notes")}
                   | {"elapsed_s": round(time.monotonic() - _T_START, 2)},
                   f, indent=1)
     os.replace(tmp, _PARTIAL_PATH)
@@ -292,6 +294,8 @@ def _consume(ev):
         for k in ("sf", "rows", "compile_cache"):
             if k in ev:
                 _STATE[k] = ev[k]
+        if "eventlog" in ev:
+            _STATE["eventlog"].update(ev["eventlog"])
     elif kind == "ablation":
         _STATE["ablation"][ev["name"]] = ev["res"]
     _write_partial()
@@ -484,6 +488,20 @@ def _worker_setup_jax():
     return jax
 
 
+def _eventlog_conf(phase: str, sink=None) -> dict:
+    """Per-run event log (BENCH trajectory gains per-operator attribution:
+    replay with tools/eventlog.py, diff rounds with tools/compare.py).
+    BENCH_EVENTLOG=0 disables; BENCH_EVENTLOG_DIR overrides the location."""
+    if os.environ.get("BENCH_EVENTLOG", "1") == "0":
+        return {}
+    d = os.path.join(
+        os.environ.get("BENCH_EVENTLOG_DIR",
+                       os.path.join(_REPO, ".bench_eventlogs")), phase)
+    if sink is not None:
+        sink.emit(ev="meta", eventlog={phase: d})
+    return {"spark.rapids.tpu.eventLog.dir": d}
+
+
 def _rel_tol() -> float:
     """TPU computes float64 at f32 precision; loosen device-vs-host float
     comparisons there (the reference marks such queries approximate_float)."""
@@ -532,7 +550,8 @@ def _worker_smoke(sink: _EventSink):
     sf = float(os.environ.get("BENCH_SMOKE_SF", default_sf))
     rows = int(6_000_000 * sf)
     lineitem = tpch.gen_lineitem(sf, seed=0, rows=rows)
-    sess = TpuSession({"spark.rapids.tpu.batchRowsMinBucket": 1 << 18})
+    sess = TpuSession({"spark.rapids.tpu.batchRowsMinBucket": 1 << 18,
+                       **_eventlog_conf("smoke", sink)})
     df = sess.create_dataframe(lineitem, num_partitions=1).cache()
     t = {"lineitem": df}
 
@@ -593,6 +612,7 @@ def _worker_smoke(sink: _EventSink):
             sink.emit(ev="error", name=name,
                       msg=f"{type(e).__name__}: {e}"[:300])
             _log(f"smoke {name} FAILED: {e}")
+    sess.close()  # flush the event log
 
 
 def _smoke_check(name, dev_res, exp):
@@ -631,6 +651,7 @@ def _worker_tpch(sink: _EventSink):
     sess = TpuSession({
         "spark.rapids.tpu.batchRowsMinBucket": 8192,
         "spark.rapids.tpu.shuffle.partitions": nparts,
+        **_eventlog_conf("tpch", sink),
     })
     dfs = tpch.build_dataframes(sess, tables, num_partitions=nparts)
 
@@ -669,6 +690,7 @@ def _worker_tpch(sink: _EventSink):
                       msg=f"{type(e).__name__}: {e}"[:300])
             _log(f"{name} FAILED: {e}")
     sink.emit(ev="meta", compile_cache=dict(cache_stats()))
+    sess.close()  # flush the event log
 
 
 def _worker_ablation(sink: _EventSink):

@@ -341,7 +341,8 @@ def _write_docs(path: Optional[str] = None) -> str:
                 "spark_rapids_tpu.exec.exchange", "spark_rapids_tpu.exec.cache",
                 "spark_rapids_tpu.io.csv", "spark_rapids_tpu.io.csv_device",
                 "spark_rapids_tpu.io.orc", "spark_rapids_tpu.io.dump",
-                "spark_rapids_tpu.tools.eventlog"):
+                "spark_rapids_tpu.tools.eventlog",
+                "spark_rapids_tpu.utils.tracing"):
         try:
             importlib.import_module(mod)
         except Exception:

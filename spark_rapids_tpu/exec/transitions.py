@@ -10,6 +10,7 @@ sections start/end, exactly like GpuTransitionOverrides.scala:37.
 """
 from __future__ import annotations
 
+import threading
 import weakref
 from typing import Iterator, List
 
@@ -18,10 +19,11 @@ from ..columnar.host import HostTable
 from ..conf import register_conf
 from ..plan.physical import PhysicalPlan
 from ..utils import metrics as M
+from ..utils.tracing import get_tracer
 from .base import TpuExec
 
 __all__ = ["HostToDeviceExec", "DeviceToHostExec", "TpuCoalesceBatchesExec",
-           "clear_upload_cache"]
+           "clear_upload_cache", "upload_cache_stats"]
 
 SCAN_DEVICE_CACHE = register_conf(
     "spark.rapids.tpu.scan.deviceCache.enabled",
@@ -44,28 +46,67 @@ SCAN_DEVICE_CACHE_MAX_BYTES = register_conf(
 # cache decoded batches re-yield the same objects). A weakref death-callback
 # removes the entry the moment its source batch is collected, so a recycled
 # id() can never alias a stale upload.
+#
+# All cache state is guarded by _UPLOAD_LOCK. It must be an RLock: the
+# weakref death-callback can fire from a GC pass triggered at any
+# allocation, including while this thread already holds the lock. Lock
+# order is catalog lock -> _UPLOAD_LOCK (the catalog reads cached bytes
+# under its own lock); nothing here calls into the catalog while holding
+# _UPLOAD_LOCK.
+_UPLOAD_LOCK = threading.RLock()
 _UPLOAD_CACHE: dict = {}   # id(batch) -> (weakref, {min_bucket: DeviceTable})
+_CACHED_BYTES = 0          # running device-byte total of cached uploads
+_CACHE_HITS = 0
+_CACHE_INSERTS = 0
+_CACHE_EVICTIONS = 0
 _OOM_HOOKED = False
 
 
 def _cached_bytes() -> int:
-    return sum(dt.nbytes() for _, per in _UPLOAD_CACHE.values()
-               for dt in per.values())
+    with _UPLOAD_LOCK:
+        return _CACHED_BYTES
+
+
+def _drop_entry(key: int) -> None:
+    """Weakref death-callback: remove a dead batch's uploads and keep the
+    running byte counter consistent."""
+    global _CACHED_BYTES, _CACHE_EVICTIONS
+    with _UPLOAD_LOCK:
+        entry = _UPLOAD_CACHE.pop(key, None)
+        if entry is not None:
+            _CACHED_BYTES -= sum(dt.nbytes() for dt in entry[1].values())
+            _CACHE_EVICTIONS += 1
 
 
 def clear_upload_cache() -> int:
     """Drop all device-resident scan uploads; returns bytes released."""
-    freed = _cached_bytes()
-    _UPLOAD_CACHE.clear()
+    global _CACHED_BYTES
+    with _UPLOAD_LOCK:
+        freed = _CACHED_BYTES
+        _UPLOAD_CACHE.clear()
+        _CACHED_BYTES = 0
     return freed
 
 
+def upload_cache_stats() -> dict:
+    """Process-wide upload-cache counters (feeds utils.metrics.StatsRegistry
+    and per-query event-log deltas)."""
+    with _UPLOAD_LOCK:
+        return {"entries": len(_UPLOAD_CACHE), "bytes": _CACHED_BYTES,
+                "hits": _CACHE_HITS, "inserts": _CACHE_INSERTS,
+                "evictions": _CACHE_EVICTIONS}
+
+
 def _hook_oom() -> None:
+    """Register the cache with the buffer catalog: droppable on device OOM,
+    and its device bytes visible to the catalog's peak/OOM accounting."""
     global _OOM_HOOKED
     if _OOM_HOOKED:
         return
     from ..memory.catalog import get_catalog
-    get_catalog().register_oom_callback(clear_upload_cache)
+    cat = get_catalog()
+    cat.register_oom_callback(clear_upload_cache)
+    cat.register_external_bytes("upload_cache", _cached_bytes)
     _OOM_HOOKED = True
 
 
@@ -80,26 +121,53 @@ class HostToDeviceExec(TpuExec):
         self.cache_max_bytes = cache_max_bytes
 
     def _upload(self, batch: HostTable) -> DeviceTable:
+        global _CACHED_BYTES, _CACHE_HITS, _CACHE_INSERTS
         if not self.cache_max_bytes:
-            return DeviceTable.from_host(batch, self.min_bucket)
+            with get_tracer().span("h2d_upload", "upload",
+                                   rows=int(batch.num_rows)):
+                return DeviceTable.from_host(batch, self.min_bucket)
         key = id(batch)
-        entry = _UPLOAD_CACHE.get(key)
-        if entry is not None and entry[0]() is batch:
-            dtb = entry[1].get(self.min_bucket)
-            if dtb is not None:
-                self.metrics.add(M.UPLOAD_CACHE_HITS, 1)
-                return dtb
-        dtb = DeviceTable.from_host(batch, self.min_bucket)
-        try:
-            if _cached_bytes() + dtb.nbytes() <= self.cache_max_bytes:
-                _hook_oom()
-                if entry is None or entry[0]() is not batch:
-                    ref = weakref.ref(
-                        batch, lambda _r, k=key: _UPLOAD_CACHE.pop(k, None))
-                    entry = _UPLOAD_CACHE[key] = (ref, {})
-                entry[1][self.min_bucket] = dtb
-        except TypeError:
-            pass  # un-weakref-able batch type: serve uncached
+        with _UPLOAD_LOCK:
+            entry = _UPLOAD_CACHE.get(key)
+            hit = None
+            if entry is not None and entry[0]() is batch:
+                hit = entry[1].get(self.min_bucket)
+                if hit is not None:
+                    _CACHE_HITS += 1
+        if hit is not None:
+            self.metrics.add(M.UPLOAD_CACHE_HITS, 1)
+            return hit
+        with get_tracer().span("h2d_upload", "upload",
+                               rows=int(batch.num_rows)):
+            dtb = DeviceTable.from_host(batch, self.min_bucket)
+        nbytes = dtb.nbytes()
+        cached = False
+        with _UPLOAD_LOCK:
+            if _CACHED_BYTES + nbytes <= self.cache_max_bytes:
+                entry = _UPLOAD_CACHE.get(key)
+                try:
+                    if entry is None or entry[0]() is not batch:
+                        if entry is not None:  # stale id-aliased entry
+                            _CACHED_BYTES -= sum(
+                                dt.nbytes() for dt in entry[1].values())
+                        ref = weakref.ref(
+                            batch, lambda _r, k=key: _drop_entry(k))
+                        entry = _UPLOAD_CACHE[key] = (ref, {})
+                    if self.min_bucket not in entry[1]:
+                        entry[1][self.min_bucket] = dtb
+                        _CACHED_BYTES += nbytes
+                        _CACHE_INSERTS += 1
+                        cached = True
+                except TypeError:
+                    pass  # un-weakref-able batch type: serve uncached
+        if cached:
+            # outside _UPLOAD_LOCK: these take the catalog lock (lock order
+            # is catalog -> upload, never the reverse)
+            _hook_oom()
+            from ..memory.catalog import peek_catalog
+            cat = peek_catalog()
+            if cat is not None:
+                cat.note_external_change()
         return dtb
 
     def execute_columnar(self, pidx: int) -> Iterator[DeviceTable]:
@@ -108,6 +176,8 @@ class HostToDeviceExec(TpuExec):
                 dtb = self._upload(batch)
             self.metrics.add(M.NUM_OUTPUT_BATCHES, 1)
             self.metrics.add(M.NUM_OUTPUT_ROWS, batch.num_rows)
+            # batchRows histograms are observed by instrument_plan (once per
+            # node) — observing here too would double-count under profiling
             yield dtb
 
 
@@ -124,7 +194,9 @@ class DeviceToHostExec(PhysicalPlan):
 
     def execute(self, pidx: int) -> Iterator[HostTable]:
         for batch in self.child.execute_columnar(pidx):
-            with self.metrics.timed(M.DOWNLOAD_TIME):
+            with self.metrics.timed(M.DOWNLOAD_TIME), \
+                    get_tracer().span("d2h_download", "download",
+                                      rows=int(batch.num_rows)):
                 ht = batch.to_host()
             yield ht
 

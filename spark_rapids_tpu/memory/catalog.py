@@ -12,7 +12,9 @@ from __future__ import annotations
 
 import itertools
 import threading
-from typing import Dict, List, Optional
+import warnings
+from collections import deque
+from typing import Callable, Dict, List, Optional
 
 import jax
 
@@ -66,7 +68,7 @@ MEMORY_DEBUG = register_conf(
     "operation.", False)
 
 __all__ = ["SpillPriorities", "BufferCatalog", "SpillableDeviceTable",
-           "DebugMemoryError", "get_catalog", "set_catalog"]
+           "DebugMemoryError", "get_catalog", "set_catalog", "peek_catalog"]
 
 
 class DebugMemoryError(RuntimeError):
@@ -117,6 +119,17 @@ class BufferCatalog:
         self.oom_events = 0  # runtime RESOURCE_EXHAUSTED recoveries
         self.spill_count = {StorageTier.HOST: 0, StorageTier.DISK: 0}
         self.spilled_bytes = {StorageTier.HOST: 0, StorageTier.DISK: 0}
+        # device memory held OUTSIDE the spill framework but accountable to
+        # this process (e.g. the scan upload cache): name -> byte-count fn,
+        # plus a cached last-known value per source so the allocation hot
+        # path (register/acquire -> _note_peak_locked) never calls out
+        # through a foreign lock; sources push updates via
+        # note_external_change(), cold paths (stats/oom_dump) refresh
+        self._external_bytes: Dict[str, Callable[[], int]] = {}
+        self._external_cache: Dict[str, int] = {}
+        self.peak_device_bytes = 0
+        self.oom_callback_errors = 0
+        self.diagnostics: deque = deque(maxlen=64)
         self._debug = bool(conf.get(MEMORY_DEBUG))
         self._sites: Dict[int, str] = {}    # buffer_id -> creation site
         self._closed_ids: set = set()       # debug: double-free detection
@@ -140,6 +153,7 @@ class BufferCatalog:
             stored = StoredTable(bid, table, priority, nbytes)
             self._buffers[bid] = stored
             self.device.used_bytes += nbytes
+            self._note_peak_locked()
             self._pq_handles[bid] = self._spill_pq.push(priority, bid)
             if self._debug:
                 import traceback
@@ -183,6 +197,12 @@ class BufferCatalog:
         return freed
 
     def _spill_one(self, stored: StoredTable):
+        from ..utils.tracing import get_tracer
+        with get_tracer().span("spill", "spill", bytes=stored.size_bytes,
+                               buffer=stored.buffer_id):
+            self._spill_one_inner(stored)
+
+    def _spill_one_inner(self, stored: StoredTable):
         # device -> host; if host full, push host's lowest priority to disk
         if not self.host.fits(stored.size_bytes):
             self._spill_host_to_disk(stored.size_bytes)
@@ -248,6 +268,7 @@ class BufferCatalog:
                 stored.device_table = table
                 stored.tier = StorageTier.DEVICE
                 self.device.used_bytes += stored.size_bytes
+                self._note_peak_locked()
                 self._pq_handles[stored.buffer_id] = \
                     self._spill_pq.push(stored.priority, stored.buffer_id)
             return stored.device_table
@@ -340,6 +361,55 @@ class BufferCatalog:
             if cb not in self._oom_callbacks:
                 self._oom_callbacks.append(cb)
 
+    # -- external device-memory accounting ------------------------------------
+    def register_external_bytes(self, name: str,
+                                fn: Callable[[], int]) -> None:
+        """Make device memory held outside the spill framework (e.g. the
+        scan upload cache) visible to peak/used accounting and OOM dumps.
+        ``fn`` returns the source's current device bytes; it may take its
+        own lock (lock order: catalog lock -> source lock)."""
+        with self._lock:
+            self._refresh_external_locked()
+            self._external_bytes[name] = fn
+            try:
+                self._external_cache[name] = int(fn() or 0)
+            except Exception:
+                self._external_cache[name] = 0
+            self._note_peak_locked()
+
+    def _refresh_external_locked(self) -> Dict[str, int]:
+        for name, fn in self._external_bytes.items():
+            try:
+                self._external_cache[name] = int(fn() or 0)
+            except Exception:
+                self._external_cache[name] = 0
+        return dict(self._external_cache)
+
+    def external_device_bytes(self) -> int:
+        with self._lock:
+            return sum(self._refresh_external_locked().values())
+
+    def device_in_use_bytes(self) -> int:
+        """Catalog-resident + externally-cached device bytes — the number
+        OOM diagnostics should reason about."""
+        with self._lock:
+            return self.device.used_bytes \
+                + sum(self._refresh_external_locked().values())
+
+    def _note_peak_locked(self) -> None:
+        # hot path (every register/unspill): cached ints only, no calls
+        # out through external sources' locks
+        used = self.device.used_bytes + sum(self._external_cache.values())
+        if used > self.peak_device_bytes:
+            self.peak_device_bytes = used
+
+    def note_external_change(self) -> None:
+        """External sources call this after growing their device footprint
+        so peak accounting reflects it (refreshes the cached counts)."""
+        with self._lock:
+            self._refresh_external_locked()
+            self._note_peak_locked()
+
     def handle_device_oom(self, context: str = "") -> int:
         """Runtime-OOM callback (reference: DeviceMemoryEventHandler.scala:33
         — RMM allocation failure -> synchronous spill -> retry alloc).
@@ -348,14 +418,26 @@ class BufferCatalog:
         device computation raises RESOURCE_EXHAUSTED and retry once. The
         needed allocation size is unknown, so everything spillable moves
         down-tier. Returns bytes freed (0 = nothing left to spill)."""
+        from ..utils.tracing import get_tracer
+        get_tracer().instant("device_oom", "spill", context=context[:200])
         cb_freed = 0
         with self._lock:
             callbacks = list(self._oom_callbacks)
         for cb in callbacks:
             try:
                 cb_freed += int(cb() or 0)
-            except Exception:
-                pass
+            except Exception as e:
+                # a broken cache-dropper must not abort OOM recovery, but it
+                # must not fail silently either: the callback's bytes stay
+                # resident, so diagnostics have to show why
+                name = getattr(cb, "__qualname__",
+                               getattr(cb, "__name__", repr(cb)))
+                msg = (f"OOM callback {name} failed: "
+                       f"{type(e).__name__}: {e}")
+                with self._lock:
+                    self.oom_callback_errors += 1
+                    self.diagnostics.append(msg)
+                warnings.warn(msg, RuntimeWarning)
         with self._lock:
             target = self.device.used_bytes
         freed = self.synchronous_spill(max(target, 1))
@@ -374,8 +456,17 @@ class BufferCatalog:
                     f"refcount={b.refcount} priority={b.priority} "
                     f"site={self._sites.get(b.buffer_id, '?')}"
                     for b in top]
-        return ("device OOM after spill retry; catalog state: "
-                f"{s}\nlargest buffers:\n" + "\n".join(rows))
+            ext = self._refresh_external_locked()
+            notes = list(self.diagnostics)
+        report = ("device OOM after spill retry; catalog state: "
+                  f"{s}\nlargest buffers:\n" + "\n".join(rows))
+        if ext:
+            report += "\nexternal device bytes: " + ", ".join(
+                f"{k}={v}" for k, v in sorted(ext.items()))
+        if notes:
+            report += "\nrecent diagnostics:\n" + "\n".join(
+                f"  {n}" for n in notes[-10:])
+        return report
 
     def stats(self) -> dict:
         with self._lock:
@@ -389,8 +480,32 @@ class BufferCatalog:
                 "device_used": self.device.used_bytes,
                 "host_used": self.host.used_bytes,
                 "disk_used": self.disk.used_bytes,
+                "external_bytes": self._refresh_external_locked(),
+                "peak_device_bytes": self.peak_device_bytes,
                 "spill_count": dict(self.spill_count),
                 "spilled_bytes": dict(self.spilled_bytes),
+                "oom_events": self.oom_events,
+                "oom_callback_errors": self.oom_callback_errors,
+            }
+
+    def counters(self) -> dict:
+        """Flat, stable-named counters for the process StatsRegistry /
+        Prometheus exposition (spill tiers by name, not enum value)."""
+        with self._lock:
+            ext = self._refresh_external_locked()
+            return {
+                "buffers": len(self._buffers),
+                "device_used_bytes": self.device.used_bytes,
+                "host_used_bytes": self.host.used_bytes,
+                "disk_used_bytes": self.disk.used_bytes,
+                "external_device_bytes": sum(ext.values()),
+                "peak_device_bytes": self.peak_device_bytes,
+                "spills_to_host": self.spill_count[StorageTier.HOST],
+                "spills_to_disk": self.spill_count[StorageTier.DISK],
+                "spilled_bytes_host": self.spilled_bytes[StorageTier.HOST],
+                "spilled_bytes_disk": self.spilled_bytes[StorageTier.DISK],
+                "oom_events": self.oom_events,
+                "oom_callback_errors": self.oom_callback_errors,
             }
 
 
@@ -448,3 +563,10 @@ def set_catalog(catalog: Optional[BufferCatalog]):
     global _GLOBAL
     with _GLOBAL_LOCK:
         _GLOBAL = catalog
+
+
+def peek_catalog() -> Optional[BufferCatalog]:
+    """The global catalog if one exists — never creates one (stats sources
+    must not side-effect a default catalog into existence)."""
+    with _GLOBAL_LOCK:
+        return _GLOBAL

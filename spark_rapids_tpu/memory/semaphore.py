@@ -16,7 +16,7 @@ from typing import Dict, Optional
 
 from ..conf import RapidsConf
 
-__all__ = ["TpuSemaphore", "get_semaphore"]
+__all__ = ["TpuSemaphore", "get_semaphore", "peek_semaphore"]
 
 
 class TpuSemaphore:
@@ -35,8 +35,10 @@ class TpuSemaphore:
             if self._holders.get(tid, 0) > 0:
                 self._holders[tid] += 1
                 return
+        from ..utils.tracing import get_tracer
         t0 = time.perf_counter()
-        self._sem.acquire()
+        with get_tracer().span("semaphore_wait", "semaphore", task=tid):
+            self._sem.acquire()
         with self._lock:
             self.total_wait_time += time.perf_counter() - t0
             self.acquire_count += 1
@@ -73,4 +75,11 @@ def get_semaphore(conf: Optional[RapidsConf] = None) -> TpuSemaphore:
         if _GLOBAL is None:
             permits = (conf or RapidsConf()).concurrent_tpu_tasks
             _GLOBAL = TpuSemaphore(permits)
+        return _GLOBAL
+
+
+def peek_semaphore() -> Optional[TpuSemaphore]:
+    """The global semaphore if one exists — never creates one (stats
+    sources must not conjure a default-permit semaphore)."""
+    with _LOCK:
         return _GLOBAL

@@ -103,17 +103,20 @@ class LocalCluster:
         n_parts = plan.num_partitions
 
         def run_partition(pidx: int) -> List[HostTable]:
+            from ..utils.tracing import get_tracer
             ctx = self.executors[pidx % len(self.executors)]
             ctx.heartbeat()
             out: List[HostTable] = []
-            if self.device:
-                # the device plan root (DeviceToHostExec) downloads batches;
-                # the chip is held for the whole partition like a Spark task
-                # holds GpuSemaphore
-                with ctx.semaphore.held():
+            with get_tracer().span("task", "task", partition=pidx,
+                                   executor=ctx.executor_id):
+                if self.device:
+                    # the device plan root (DeviceToHostExec) downloads
+                    # batches; the chip is held for the whole partition like
+                    # a Spark task holds GpuSemaphore
+                    with ctx.semaphore.held():
+                        out.extend(plan.execute(pidx))
+                else:
                     out.extend(plan.execute(pidx))
-            else:
-                out.extend(plan.execute(pidx))
             return out
 
         futures = [self._pool.submit(run_partition, p) for p in range(n_parts)]

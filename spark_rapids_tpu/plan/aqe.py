@@ -31,6 +31,7 @@ from typing import Iterator, List, Optional, Sequence
 
 from ..conf import RapidsConf, register_conf
 from ..columnar.host import HostTable
+from ..utils.tracing import get_tracer
 from .physical import (HashPartitioning, PhysicalPlan, RangePartitioning,
                        ShuffleExchangeExec, SinglePartitioning)
 from .physical_joins import CpuBroadcastHashJoinExec, CpuShuffledHashJoinExec
@@ -304,7 +305,9 @@ def materialize_stage(cpu_exchange: ShuffleExchangeExec, conf: RapidsConf,
         return total
 
     if isinstance(converted, TpuLocalExchangeExec):
-        converted._materialize()
+        with get_tracer().span("aqe_stage_materialize", "stage",
+                               exchange=type(converted).__name__):
+            converted._materialize()
         prows = pbytes = 0
         for h in converted._handles:
             t = h.get()
@@ -312,7 +315,9 @@ def materialize_stage(cpu_exchange: ShuffleExchangeExec, conf: RapidsConf,
             pbytes += _scaled_device_bytes(t)
         stats = PartitionStats([prows], [pbytes])
     elif isinstance(converted, TpuShuffleExchangeExec):
-        converted._materialize()
+        with get_tracer().span("aqe_stage_materialize", "stage",
+                               exchange=type(converted).__name__):
+            converted._materialize()
         rows, nbytes = [], []
         for handles in converted._shards:
             prows = pbytes = 0
@@ -325,7 +330,9 @@ def materialize_stage(cpu_exchange: ShuffleExchangeExec, conf: RapidsConf,
         stats = PartitionStats(rows, nbytes)
     else:
         assert isinstance(converted, ShuffleExchangeExec), type(converted)
-        converted._materialize()
+        with get_tracer().span("aqe_stage_materialize", "stage",
+                               exchange=type(converted).__name__):
+            converted._materialize()
         rows, nbytes = [], []
         for batches in converted._materialized:
             rows.append(sum(b.num_rows for b in batches))

@@ -70,9 +70,14 @@ class PhysicalPlan:
         return "\n".join([line] + [c.tree_string(indent + 1) for c in self.children])
 
     def collect(self) -> HostTable:
+        from ..utils.tracing import get_tracer
+        tracer = get_tracer()
         batches: List[HostTable] = []
         for p in range(self.num_partitions):
-            batches.extend(self.execute(p))
+            # one "task" span per partition drain (the Spark-task level of
+            # the query -> stage -> task -> operator span hierarchy)
+            with tracer.span("task", "task", partition=p):
+                batches.extend(self.execute(p))
         if not batches:
             return HostTable(self.schema.names, [
                 HostColumn(f.dtype, _empty_values(f.dtype)) for f in self.schema])

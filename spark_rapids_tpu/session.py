@@ -26,6 +26,12 @@ from .plan.schema import Schema
 
 __all__ = ["TpuSession", "DataFrame"]
 
+# per-process sequence for trace dump filenames (pid+timestamp alone can
+# collide when two sessions close within the same millisecond)
+import itertools as _itertools
+
+_TRACE_DUMP_SEQ = _itertools.count()
+
 
 class TpuSession:
     _active: "Optional[TpuSession]" = None
@@ -35,6 +41,10 @@ class TpuSession:
             conf = RapidsConf(conf)
         self.conf = conf or RapidsConf()
         self._mesh = None
+        # apply spark.rapids.tpu.trace.* to the process tracer (spans from
+        # every subsystem land in one ring buffer; close() can export it)
+        from .utils.tracing import configure_tracer
+        configure_tracer(self.conf)
         TpuSession._active = self
 
     # -- device mesh (accelerated shuffle tier) ------------------------------
@@ -170,6 +180,22 @@ class TpuSession:
         if log is not None:
             log.close()
             self._eventlog = None
+        from .utils.tracing import TRACE_DIR, get_tracer
+        trace_dir = self.conf.get(TRACE_DIR)
+        if trace_dir:
+            import os
+            tracer = get_tracer()
+            if not tracer.enabled and not tracer.events():
+                import warnings
+                warnings.warn(
+                    "spark.rapids.tpu.trace.dir is set but tracing never "
+                    "ran — set spark.rapids.tpu.trace.enabled=true",
+                    RuntimeWarning)
+                return
+            seq = next(_TRACE_DUMP_SEQ)
+            path = os.path.join(
+                trace_dir, f"trace-{os.getpid()}-{seq}.json")
+            tracer.dump(path)
 
 
 class DataFrame:

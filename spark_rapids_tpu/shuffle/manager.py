@@ -28,10 +28,35 @@ import numpy as np
 from ..columnar.device import DeviceTable, stable_counting_order
 from ..columnar.host import HostTable
 from ..conf import RapidsConf, SHUFFLE_COMPRESSION_CODEC, register_conf
+from ..utils.tracing import get_tracer
 from .serializer import deserialize_table, serialize_table
 from .transport import BlockId, ShuffleTransport, load_transport
 
-__all__ = ["ShuffleManager", "HeartbeatManager", "device_partition_ids"]
+__all__ = ["ShuffleManager", "HeartbeatManager", "device_partition_ids",
+           "shuffle_stats"]
+
+# process-wide shuffle counters (all ShuffleManager instances fold in here;
+# feeds utils.metrics.StatsRegistry and the per-query event-log deltas)
+_STATS_LOCK = threading.Lock()
+_STATS = {
+    "blocks_published": 0, "bytes_published": 0,
+    "blocks_fetched": 0, "bytes_fetched": 0,
+    "writes_cached_tier": 0, "writes_transport_tier": 0,
+    "reads_cached_tier": 0, "reads_transport_tier": 0,
+}
+
+
+def _bump(**kv) -> None:
+    with _STATS_LOCK:
+        for k, v in kv.items():
+            _STATS[k] += v
+
+
+def shuffle_stats() -> Dict[str, int]:
+    """Blocks/bytes written+fetched and which tier served them (cached
+    device-resident vs transport)."""
+    with _STATS_LOCK:
+        return dict(_STATS)
 
 SHUFFLE_CACHE_WRITES = register_conf(
     "spark.rapids.tpu.shuffle.cacheWrites",
@@ -232,8 +257,19 @@ class ShuffleManager:
         same-process readers concat the device blocks directly and the spill
         framework owns the memory."""
         if self.cache_writes:
-            return self._write_partition_cached(shuffle_id, map_id, batches,
-                                                key_names, num_parts)
+            with get_tracer().span("shuffle_write", "shuffle", tier="cached",
+                                   shuffle=shuffle_id, map=map_id):
+                return self._write_partition_cached(
+                    shuffle_id, map_id, batches, key_names, num_parts)
+        with get_tracer().span("shuffle_write", "shuffle", tier="transport",
+                               shuffle=shuffle_id, map=map_id):
+            return self._write_partition_transport(
+                shuffle_id, map_id, batches, key_names, num_parts)
+
+    def _write_partition_transport(self, shuffle_id: int, map_id: int,
+                                   batches: Iterator[DeviceTable],
+                                   key_names: List[str],
+                                   num_parts: int) -> List[int]:
         merged: List[List[HostTable]] = [[] for _ in range(num_parts)]
         schema_host: Optional[HostTable] = None
         for batch in batches:
@@ -262,6 +298,8 @@ class ShuffleManager:
             payload = serialize_table(table, self.codec)
             self.transport.publish(BlockId(shuffle_id, map_id, p), payload)
             sizes[p] = len(payload)
+        _bump(blocks_published=num_parts, bytes_published=sum(sizes),
+              writes_transport_tier=1)
         return sizes
 
     def _write_partition_cached(self, shuffle_id: int, map_id: int,
@@ -310,6 +348,8 @@ class ShuffleManager:
                                     jnp.int32(0), ())
             self.buffer_catalog.put((shuffle_id, map_id, p), table)
             sizes[p] = table.nbytes()
+        _bump(blocks_published=num_parts, bytes_published=sum(sizes),
+              writes_cached_tier=1)
         return sizes
 
     # -- read side ------------------------------------------------------------
@@ -330,21 +370,28 @@ class ShuffleManager:
             return
         blocks = [BlockId(shuffle_id, m, reduce_id) for m in range(num_maps)]
         tables: List[HostTable] = []
+        fetched_bytes = 0
         pending = list(blocks)
         retried = set()
-        while pending:
-            try:
-                for bid, payload in self.transport.fetch(pending):
-                    tables.append(deserialize_table(payload))
-                    pending = pending[pending.index(bid) + 1:]
-                break
-            except ShuffleFetchFailedException as e:
-                map_id = e.block[1]
-                if recompute is None or map_id in retried:
-                    raise
-                retried.add(map_id)
-                recompute(map_id)
-                pending = pending[pending.index(e.block):]
+        with get_tracer().span("shuffle_fetch", "shuffle", tier="transport",
+                               shuffle=shuffle_id, reduce=reduce_id,
+                               maps=num_maps):
+            while pending:
+                try:
+                    for bid, payload in self.transport.fetch(pending):
+                        tables.append(deserialize_table(payload))
+                        fetched_bytes += len(payload)
+                        pending = pending[pending.index(bid) + 1:]
+                    break
+                except ShuffleFetchFailedException as e:
+                    map_id = e.block[1]
+                    if recompute is None or map_id in retried:
+                        raise
+                    retried.add(map_id)
+                    recompute(map_id)
+                    pending = pending[pending.index(e.block):]
+        _bump(blocks_fetched=len(tables), bytes_fetched=fetched_bytes,
+              reads_transport_tier=1)
         non_empty = [t for t in tables if t.num_columns and t.num_rows]
         if not non_empty:
             # all blocks empty: match the cached tier — yield a zero-row
@@ -367,22 +414,29 @@ class ShuffleManager:
         from .transport import ShuffleFetchFailedException
         parts: List[DeviceTable] = []
         schema_holder: Optional[DeviceTable] = None
-        for m in range(num_maps):
-            key = (shuffle_id, m, reduce_id)
-            handle = self.buffer_catalog.get(key)
-            if handle is None and recompute is not None:
-                recompute(m)
+        fetched_bytes = 0
+        with get_tracer().span("shuffle_fetch", "shuffle", tier="cached",
+                               shuffle=shuffle_id, reduce=reduce_id,
+                               maps=num_maps):
+            for m in range(num_maps):
+                key = (shuffle_id, m, reduce_id)
                 handle = self.buffer_catalog.get(key)
-            if handle is None:
-                raise ShuffleFetchFailedException(
-                    BlockId(shuffle_id, m, reduce_id),
-                    "block not in the shuffle buffer catalog")
-            t = handle.get()
-            if t.num_columns:
-                if int(t.num_rows):
-                    parts.append(t)
-                elif schema_holder is None:
-                    schema_holder = t
+                if handle is None and recompute is not None:
+                    recompute(m)
+                    handle = self.buffer_catalog.get(key)
+                if handle is None:
+                    raise ShuffleFetchFailedException(
+                        BlockId(shuffle_id, m, reduce_id),
+                        "block not in the shuffle buffer catalog")
+                t = handle.get()
+                fetched_bytes += t.nbytes()
+                if t.num_columns:
+                    if int(t.num_rows):
+                        parts.append(t)
+                    elif schema_holder is None:
+                        schema_holder = t
+        _bump(blocks_fetched=num_maps, bytes_fetched=fetched_bytes,
+              reads_cached_tier=1)
         if parts:
             yield concat_device_tables(parts, min_bucket)
         elif schema_holder is not None:

@@ -1,0 +1,279 @@
+"""Compare two runs: event logs or bench result JSONs.
+
+Reference: the plugin tools' CompareApplications
+(tools/.../profiling/CompareApplications.scala) lines up several Spark
+event logs and reports matching SQL IDs / stage durations side by side so
+a regression can be localized to an operator, not just a query. Same job
+here, over our own JSONL event logs (tools/eventlog.py) or two ``bench.py``
+result JSONs:
+
+- queries align by query id (the workloads are assumed to be the same
+  script run twice — exactly the BENCH_rNN trajectory use case);
+- operators align by (name, occurrence-index) within a query, which is
+  stable across runs of the same plan even when node ids shift;
+- per-operator wall/rows deltas plus per-query counter deltas (compile
+  cache, upload cache, shuffle tiers, spill, semaphore) with regression
+  flags: candidate slower than baseline by more than ``threshold``
+  (relative) AND ``min_seconds`` (absolute floor, so microsecond noise on
+  trivial operators doesn't flag).
+
+CLI: ``python -m spark_rapids_tpu.tools.compare A B [--threshold 0.2]``
+where A/B are event-log JSONL paths or bench summary JSONs.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["OpDelta", "QueryDelta", "CompareReport", "compare_event_logs",
+           "compare_bench_results", "compare_apps"]
+
+
+@dataclasses.dataclass
+class OpDelta:
+    """One aligned operator's baseline-vs-candidate numbers. ``query_id``
+    is an int for event logs, a "phase:qN" label for bench comparisons."""
+    query_id: "int | str"
+    name: str
+    occurrence: int
+    wall_a: float
+    wall_b: float
+    rows_a: int
+    rows_b: int
+    regressed: bool = False
+    only_in: str = ""  # "a"/"b" when the op exists in one run only
+
+    @property
+    def delta_s(self) -> float:
+        return self.wall_b - self.wall_a
+
+    @property
+    def ratio(self) -> float:
+        return self.wall_b / self.wall_a if self.wall_a > 0 else float("inf")
+
+
+@dataclasses.dataclass
+class QueryDelta:
+    query_id: "int | str"
+    wall_a: float
+    wall_b: float
+    regressed: bool
+    ops: List[OpDelta]
+    metric_deltas: Dict[str, float]  # candidate minus baseline counters
+
+    @property
+    def delta_s(self) -> float:
+        return self.wall_b - self.wall_a
+
+    @property
+    def ratio(self) -> float:
+        return self.wall_b / self.wall_a if self.wall_a > 0 else float("inf")
+
+
+@dataclasses.dataclass
+class CompareReport:
+    label_a: str
+    label_b: str
+    queries: List[QueryDelta]
+    threshold: float
+    only_in_a: List[int] = dataclasses.field(default_factory=list)
+    only_in_b: List[int] = dataclasses.field(default_factory=list)
+
+    def regressions(self) -> List[OpDelta]:
+        return [op for q in self.queries for op in q.ops if op.regressed]
+
+    def regressed_queries(self) -> List[QueryDelta]:
+        return [q for q in self.queries if q.regressed]
+
+    def summary(self) -> str:
+        lines = [f"compare: A={self.label_a}  B={self.label_b}  "
+                 f"(threshold {self.threshold:.0%}; positive delta = "
+                 "B slower)"]
+        for q in self.queries:
+            flag = "  ** REGRESSED" if q.regressed else ""
+            lines.append(f"query {q.query_id}: "
+                         f"A={q.wall_a:.4f}s B={q.wall_b:.4f}s "
+                         f"delta={q.delta_s:+.4f}s "
+                         f"({q.ratio:.2f}x){flag}")
+            lines.append(f"  {'op':<40}{'A_s':>9}{'B_s':>9}"
+                         f"{'delta_s':>10}{'rows_B':>12}")
+            for op in q.ops:
+                mark = " **" if op.regressed else \
+                    (f" [only {op.only_in}]" if op.only_in else "")
+                lines.append(f"  {op.name[:39]:<40}{op.wall_a:>9.4f}"
+                             f"{op.wall_b:>9.4f}{op.delta_s:>+10.4f}"
+                             f"{op.rows_b:>12}{mark}")
+            hot = sorted((k for k, v in q.metric_deltas.items() if v),
+                         key=lambda k: -abs(q.metric_deltas[k]))[:8]
+            if hot:
+                lines.append("  counter deltas (B - A): " + ", ".join(
+                    f"{k}={q.metric_deltas[k]:+g}" for k in hot))
+        if self.only_in_a:
+            lines.append(f"queries only in A: {self.only_in_a}")
+        if self.only_in_b:
+            lines.append(f"queries only in B: {self.only_in_b}")
+        n_reg = len(self.regressions())
+        lines.append(f"{n_reg} regressed operator(s), "
+                     f"{len(self.regressed_queries())} regressed query(ies)")
+        return "\n".join(lines)
+
+
+def _op_key_counts(nodes: List[Dict]) -> List[Tuple[Tuple[str, int], Dict]]:
+    """Stable (name, occurrence) keys in node order."""
+    seen: Dict[str, int] = {}
+    out = []
+    for n in nodes:
+        idx = seen.get(n["name"], 0)
+        seen[n["name"]] = idx + 1
+        out.append(((n["name"], idx), n))
+    return out
+
+
+def compare_apps(app_a, app_b, threshold: float = 0.2,
+                 min_seconds: float = 0.001) -> CompareReport:
+    """Compare two loaded ``AppReplay``s (tools/eventlog.py)."""
+    qids_a, qids_b = set(app_a.queries), set(app_b.queries)
+    queries: List[QueryDelta] = []
+    for qid in sorted(qids_a & qids_b):
+        qa, qb = app_a.queries[qid], app_b.queries[qid]
+        ops_a = dict(_op_key_counts(qa.nodes))
+        ops_b = dict(_op_key_counts(qb.nodes))
+        ops: List[OpDelta] = []
+        for key in list(ops_a) + [k for k in ops_b if k not in ops_a]:
+            na, nb = ops_a.get(key), ops_b.get(key)
+            wall_a = na["wall_s"] if na else 0.0
+            wall_b = nb["wall_s"] if nb else 0.0
+            regressed = (na is not None and nb is not None
+                         and wall_b > wall_a * (1.0 + threshold)
+                         and wall_b - wall_a >= min_seconds)
+            ops.append(OpDelta(
+                qid, key[0], key[1], wall_a, wall_b,
+                na["rows"] if na else 0, nb["rows"] if nb else 0,
+                regressed=regressed,
+                only_in="a" if nb is None else ("b" if na is None else "")))
+        stats_delta = {k: qb.stats.get(k, 0) - qa.stats.get(k, 0)
+                       for k in set(qa.stats) | set(qb.stats)
+                       if isinstance(qa.stats.get(k, 0), (int, float))
+                       and isinstance(qb.stats.get(k, 0), (int, float))}
+        q_regressed = (qb.wall_s > qa.wall_s * (1.0 + threshold)
+                       and qb.wall_s - qa.wall_s >= min_seconds)
+        queries.append(QueryDelta(qid, qa.wall_s, qb.wall_s,
+                                  q_regressed, ops, stats_delta))
+    return CompareReport(app_a.app_id or app_a.path,
+                         app_b.app_id or app_b.path, queries, threshold,
+                         sorted(qids_a - qids_b), sorted(qids_b - qids_a))
+
+
+def compare_event_logs(path_a: str, path_b: str, threshold: float = 0.2,
+                       min_seconds: float = 0.001) -> CompareReport:
+    """Load two JSONL event logs and align them (A = baseline,
+    B = candidate)."""
+    from .eventlog import load_event_log
+    return compare_apps(load_event_log(path_a), load_event_log(path_b),
+                        threshold, min_seconds)
+
+
+def compare_bench_results(path_a: str, path_b: str, threshold: float = 0.2,
+                          min_seconds: float = 0.001) -> CompareReport:
+    """Compare two ``bench.py`` per-query result JSONs (the
+    BENCH_partial.json shape, with smoke/tpch sections): device seconds as
+    single-op queries so the same report/flagging machinery applies."""
+    with open(path_a, encoding="utf-8") as f:
+        a = json.load(f)
+    with open(path_b, encoding="utf-8") as f:
+        b = json.load(f)
+    # phases compare separately: smoke and tpch both name queries q1/q6
+    # but run at different scale factors — merging would shadow the smoke
+    # entries (or diff incomparable numbers when one run lacks a phase)
+    queries: List[QueryDelta] = []
+    only_a: List = []
+    only_b: List = []
+    for phase in ("smoke", "tpch"):
+        qs_a = a.get(phase, {})
+        qs_b = b.get(phase, {})
+        names = sorted(set(qs_a) & set(qs_b),
+                       key=lambda n: int(n.lstrip("q"))
+                       if n.lstrip("q").isdigit() else 0)
+        only_a.extend(f"{phase}:{n}" for n in sorted(set(qs_a) - set(qs_b)))
+        only_b.extend(f"{phase}:{n}" for n in sorted(set(qs_b) - set(qs_a)))
+        for name in names:
+            label = f"{phase}:{name}"
+            wall_a = float(qs_a[name].get("dev_s", 0.0))
+            wall_b = float(qs_b[name].get("dev_s", 0.0))
+            regressed = (wall_a > 0 and wall_b > wall_a * (1.0 + threshold)
+                         and wall_b - wall_a >= min_seconds)
+            deltas = {k: float(qs_b[name].get(k, 0))
+                      - float(qs_a[name].get(k, 0))
+                      for k in ("dev_s", "cpu_s", "compile_s", "speedup")
+                      if k in qs_a[name] or k in qs_b[name]}
+            queries.append(QueryDelta(
+                label, wall_a, wall_b, regressed,
+                [OpDelta(label, name, 0, wall_a, wall_b, 0, 0,
+                         regressed=regressed)], deltas))
+    return CompareReport(path_a, path_b, queries, threshold,
+                         only_a, only_b)
+
+
+def _sniff(path: str) -> str:
+    """Classify an input file: "bench" (one JSON object with smoke/tpch
+    per-query sections, i.e. BENCH_partial.json shape), "eventlog" (JSONL
+    from tools/eventlog.py), or "unknown". Note the round driver's
+    BENCH_rNN.json wrappers hold only the summary metric — no per-query
+    data to compare — so they classify as unknown."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            obj = json.load(f)
+    except json.JSONDecodeError:
+        # multi-line JSONL fails a full-file parse; check the first record
+        try:
+            with open(path, encoding="utf-8") as f:
+                first = json.loads(f.readline())
+            return "eventlog" if isinstance(first, dict) and "event" in first \
+                else "unknown"
+        except (json.JSONDecodeError, OSError):
+            return "unknown"
+    except OSError:
+        return "unknown"
+    if isinstance(obj, dict):
+        if "tpch" in obj or "smoke" in obj:
+            return "bench"
+        if "event" in obj:
+            return "eventlog"  # degenerate single-record log
+    return "unknown"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        description="Compare two event logs or bench result JSONs "
+                    "(A = baseline, B = candidate)")
+    ap.add_argument("log_a")
+    ap.add_argument("log_b")
+    ap.add_argument("--threshold", type=float, default=0.2,
+                    help="relative slowdown that flags a regression")
+    ap.add_argument("--min-seconds", type=float, default=0.001,
+                    help="absolute slowdown floor for flagging")
+    args = ap.parse_args(argv)
+    kinds = {_sniff(args.log_a), _sniff(args.log_b)}
+    if "unknown" in kinds:
+        ap.error(
+            "inputs must both be event logs (JSONL from "
+            "spark.rapids.tpu.eventLog.dir) or both bench summaries with "
+            "per-query sections (BENCH_partial.json / bench event sink); "
+            "round wrapper files like BENCH_rNN.json carry only the "
+            "summary metric and cannot be compared per operator")
+    if len(kinds) > 1:
+        ap.error("cannot compare an event log against a bench summary")
+    if kinds == {"bench"}:
+        report = compare_bench_results(args.log_a, args.log_b,
+                                       args.threshold, args.min_seconds)
+    else:
+        report = compare_event_logs(args.log_a, args.log_b, args.threshold,
+                                    args.min_seconds)
+    print(report.summary())
+    return 1 if report.regressions() else 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

@@ -27,13 +27,20 @@ from typing import Dict, List, Optional
 from ..conf import register_conf
 
 __all__ = ["EventLogWriter", "load_event_log", "AppReplay", "QueryReplay",
-           "EVENT_LOG_DIR"]
+           "EVENT_LOG_DIR", "SCHEMA_VERSION"]
+
+# Event-record schema version. Bump ONLY with a migration note in
+# docs/observability.md; tests/test_observability.py pins the current value
+# and the per-record required-key sets so replay/compare tooling can rely
+# on old logs staying loadable.
+SCHEMA_VERSION = 2
 
 EVENT_LOG_DIR = register_conf(
     "spark.rapids.tpu.eventLog.dir",
     "Directory for the session event log (JSONL; one file per session). "
     "Empty disables logging. Spark's spark.eventLog.dir analogue — feeds "
-    "the replay tools (tools/eventlog.py load_event_log).", "")
+    "the replay tools (tools/eventlog.py load_event_log and "
+    "tools/compare.py).", "")
 
 
 class EventLogWriter:
@@ -45,6 +52,7 @@ class EventLogWriter:
         self._f = open(self.path, "a", encoding="utf-8")
         self._query_seq = 0
         self.write({"event": "app_start", "app_id": app_id,
+                    "schema_version": SCHEMA_VERSION,
                     "ts": time.time(), "conf": conf_snapshot})
 
     def write(self, record: Dict) -> None:
@@ -59,6 +67,8 @@ class EventLogWriter:
         """Instrument ``plan``, run ``collect_fn()``, persist the events."""
         from ..memory.catalog import get_catalog
         from ..memory.semaphore import get_semaphore
+        from ..utils.metrics import StatsRegistry, get_stats
+        from ..utils.tracing import get_tracer
         from .profiler import instrument_plan
 
         qid = self.next_query_id()
@@ -74,13 +84,16 @@ class EventLogWriter:
             instrument_plan(plan, epoch, into=stats)
         cat = get_catalog()
         sem = get_semaphore()
+        registry = get_stats()
         spill_before = dict(cat.spill_count)
         wait_before = sem.total_wait_time
+        counters_before = registry.collect()
         self.write({"event": "query_start", "query_id": qid,
                     "ts": time.time(), "plan": plan.tree_string()})
         t0 = time.perf_counter()
         try:
-            result = collect_fn()
+            with get_tracer().span("query", "query", query_id=qid):
+                result = collect_fn()
         except Exception as e:
             self.write({"event": "query_end", "query_id": qid,
                         "ts": time.time(),
@@ -104,6 +117,11 @@ class EventLogWriter:
             "spill_count": {str(k): v - spill_before.get(k, 0)
                             for k, v in cat.spill_count.items()},
             "semaphore_wait_s": sem.total_wait_time - wait_before,
+            # per-query deltas of every process-wide counter: compile cache,
+            # upload cache, shuffle tiers, catalog spills/OOM, semaphore —
+            # the attribution BENCH needs (VERDICT layer-11 gap)
+            "stats": StatsRegistry.delta(registry.collect(),
+                                         counters_before),
         })
         return result
 
@@ -134,6 +152,7 @@ class QueryReplay:
         self.aqe_events: List[str] = []
         self.spill_count: Dict = {}
         self.semaphore_wait_s: float = 0.0
+        self.stats: Dict = {}  # per-query process-counter deltas
 
     def summary(self) -> str:
         lines = [f"query {self.query_id}: wall={self.wall_s:.4f}s"
@@ -197,6 +216,7 @@ class AppReplay:
     def __init__(self, path: str):
         self.path = path
         self.app_id: str = ""
+        self.schema_version: int = 1  # logs predating the field
         self.conf: Dict = {}
         self.queries: Dict[int, QueryReplay] = {}
 
@@ -230,6 +250,16 @@ class AppReplay:
                 warnings.append(
                     f"q{q.query_id}: semaphore wait is "
                     f"{q.semaphore_wait_s / q.wall_s:.0%} of wall time")
+            compile_s = q.stats.get("compile_cache_compile_seconds", 0.0)
+            if q.wall_s > 0 and compile_s > 0.5 * q.wall_s:
+                warnings.append(
+                    f"q{q.query_id}: XLA compile is "
+                    f"{compile_s / q.wall_s:.0%} of wall time — cold compile "
+                    "cache (warm up or enable the persistent cache)")
+            if q.stats.get("catalog_oom_callback_errors", 0):
+                warnings.append(
+                    f"q{q.query_id}: OOM cache-drop callbacks raised "
+                    "(see catalog diagnostics)")
         return warnings
 
 
@@ -244,6 +274,7 @@ def load_event_log(path: str) -> AppReplay:
             ev = rec.get("event")
             if ev == "app_start":
                 app.app_id = rec.get("app_id", "")
+                app.schema_version = rec.get("schema_version", 1)
                 app.conf = rec.get("conf", {})
             elif ev == "query_start":
                 q = app.queries.setdefault(rec["query_id"],
@@ -262,4 +293,5 @@ def load_event_log(path: str) -> AppReplay:
                 q.aqe_events = rec.get("aqe_events", [])
                 q.spill_count = rec.get("spill_count", {})
                 q.semaphore_wait_s = rec.get("semaphore_wait_s", 0.0)
+                q.stats = rec.get("stats", {})
     return app

@@ -39,6 +39,10 @@ class QueryProfile:
     total_s: float
     spill: Dict
     semaphore: Dict
+    # per-query deltas of the process-wide StatsRegistry counters: compile
+    # cache, upload cache, shuffle tiers, catalog spills/OOM, semaphore —
+    # one report with every subsystem's signal
+    stats: Dict = dataclasses.field(default_factory=dict)
 
     def summary(self) -> str:
         lines = [f"total wall time: {self.total_s:.4f}s", "",
@@ -50,6 +54,12 @@ class QueryProfile:
         lines.append("")
         lines.append(f"spill: {self.spill}")
         lines.append(f"semaphore: {self.semaphore}")
+        if self.stats:
+            lines.append("counters (this query):")
+            for k in sorted(self.stats):
+                v = self.stats[k]
+                if v:
+                    lines.append(f"  {k:<44}{v}")
         return "\n".join(lines)
 
     def to_json(self) -> str:
@@ -58,6 +68,7 @@ class QueryProfile:
             "nodes": [dataclasses.asdict(n) for n in self.nodes],
             "spill": self.spill,
             "semaphore": self.semaphore,
+            "stats": self.stats,
         })
 
     def health_check(self) -> List[str]:
@@ -80,6 +91,17 @@ class QueryProfile:
             warnings.append(
                 f"{slowest.name} dominates ({slowest.wall_s:.2f}s) — "
                 "check its explain tagging for fallback reasons")
+        compile_s = self.stats.get("compile_cache_compile_seconds", 0.0)
+        if self.total_s > 0 and compile_s > 0.5 * self.total_s:
+            warnings.append(
+                f"XLA compile is {compile_s / self.total_s:.0%} of wall "
+                "time — cold compile cache (warm up, or check for shape-"
+                "bucket churn recompiling per batch)")
+        if self.stats.get("catalog_oom_callback_errors", 0):
+            warnings.append(
+                "OOM cache-drop callbacks raised during this query — "
+                "cached device bytes may not have been released "
+                "(see catalog diagnostics)")
         return warnings
 
 
@@ -115,6 +137,11 @@ def instrument_plan(plan, epoch: Optional[float] = None,
 
             def timed(pidx, _fn=fn, _ns=ns, _node=node):
                 import contextlib
+
+                from ..utils import metrics as M
+                from ..utils.tracing import get_tracer
+                tracer = get_tracer()
+                reg = getattr(_node, "metrics", None)
                 scope = contextlib.nullcontext()
                 if annotate:
                     import jax.profiler
@@ -130,7 +157,16 @@ def instrument_plan(plan, epoch: Optional[float] = None,
                             _ns.wall_s += now - t0
                             _ns.t_last = now - epoch
                             _ns.batches += 1
-                            _ns.rows += int(batch.num_rows)
+                            rows = int(batch.num_rows)
+                            _ns.rows += rows
+                            # operator-batch span: one complete event per
+                            # batch produced (the query->stage->task->
+                            # operator level of the span hierarchy)
+                            tracer.complete(_ns.name, "operator", t0,
+                                            now - t0, partition=pidx,
+                                            rows=rows)
+                            if reg is not None and hasattr(reg, "observe"):
+                                reg.observe(M.BATCH_ROWS_HISTOGRAM, rows)
                             yield batch
                             t0 = time.perf_counter()
                 finally:
@@ -156,6 +192,8 @@ def profile_query(df, device: Optional[bool] = None,
     TensorBoard-loadable XLA trace."""
     from ..memory.catalog import get_catalog
     from ..memory.semaphore import get_semaphore
+    from ..utils.metrics import StatsRegistry, get_stats
+    from ..utils.tracing import get_tracer
 
     plan = df.session._physical(df.logical, device)
     annotate = xla_trace_dir is not None
@@ -173,20 +211,24 @@ def profile_query(df, device: Optional[bool] = None,
     # deltas, not lifetime totals
     cat = get_catalog()
     sem = get_semaphore()
+    registry = get_stats()
     spill_before = dict(cat.spill_count)
     bytes_before = dict(cat.spilled_bytes)
     wait_before = sem.total_wait_time
     acq_before = sem.acquire_count
+    counters_before = registry.collect()
 
     if xla_trace_dir is not None:
         import jax.profiler
         t0 = time.perf_counter()
-        with jax.profiler.trace(xla_trace_dir):
+        with jax.profiler.trace(xla_trace_dir), \
+                get_tracer().span("query", "query", profiled=True):
             plan.collect()
         total = time.perf_counter() - t0
     else:
         t0 = time.perf_counter()
-        plan.collect()
+        with get_tracer().span("query", "query", profiled=True):
+            plan.collect()
         total = time.perf_counter() - t0
 
     spill = {
@@ -197,4 +239,5 @@ def profile_query(df, device: Optional[bool] = None,
     }
     semaphore = {"total_wait_time": sem.total_wait_time - wait_before,
                  "acquire_count": sem.acquire_count - acq_before}
-    return QueryProfile(stats, total, spill, semaphore)
+    counters = StatsRegistry.delta(registry.collect(), counters_before)
+    return QueryProfile(stats, total, spill, semaphore, counters)

@@ -19,6 +19,7 @@ from __future__ import annotations
 import functools
 import sys
 import threading
+import time
 from typing import Callable, Dict
 
 import jax
@@ -29,6 +30,8 @@ _CACHE: Dict[str, Callable] = {}
 _LOCK = threading.Lock()
 _HITS = 0
 _MISSES = 0
+_COMPILES = 0
+_COMPILE_SECONDS = 0.0
 
 _OOM_MARKERS = ("RESOURCE_EXHAUSTED", "RESOURCE EXHAUSTED", "Out of memory",
                 "out of memory", "OOM")
@@ -93,6 +96,37 @@ def _rebuild_on_mismatch(key: str, builder: Callable[[], Callable],
     return wrapped
 
 
+def _time_first_call(key: str, fn: Callable) -> Callable:
+    """Attribute a cache entry's first invocation to XLA compile time.
+
+    jax.jit compiles lazily on first dispatch, so the first call through a
+    fresh entry is (compile + run); later calls are steady-state dispatch.
+    Timing the first call is the standard approximation for per-plan
+    compile seconds (the run part is dwarfed by the ~1s trace+compile),
+    and it scopes the call in a "compile" trace span so Perfetto shows
+    compile stalls on the query timeline."""
+    state = {"done": False}
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        global _COMPILES, _COMPILE_SECONDS
+        if state["done"]:
+            return fn(*args, **kwargs)
+        from .tracing import get_tracer
+        t0 = time.perf_counter()
+        with get_tracer().span("xla_compile", "compile", key=key[:160]):
+            out = fn(*args, **kwargs)
+        with _LOCK:
+            # check-and-set under the lock: concurrent first dispatches of
+            # one entry must attribute the compile exactly once
+            if not state["done"]:
+                state["done"] = True
+                _COMPILES += 1
+                _COMPILE_SECONDS += time.perf_counter() - t0
+        return out
+    return wrapped
+
+
 def cached_jit(key: str, builder: Callable[[], Callable]) -> Callable:
     """Return a jitted callable for ``key``, building it on first use."""
     global _HITS, _MISSES
@@ -102,17 +136,22 @@ def cached_jit(key: str, builder: Callable[[], Callable]) -> Callable:
             _HITS += 1
             return fn
         _MISSES += 1
-    built = _rebuild_on_mismatch(key, builder, oom_retry(jax.jit(builder())))
+    built = _time_first_call(key, _rebuild_on_mismatch(
+        key, builder, oom_retry(jax.jit(builder()))))
     with _LOCK:
         return _CACHE.setdefault(key, built)
 
 
-def cache_stats() -> Dict[str, int]:
-    return {"entries": len(_CACHE), "hits": _HITS, "misses": _MISSES}
+def cache_stats() -> Dict[str, float]:
+    return {"entries": len(_CACHE), "hits": _HITS, "misses": _MISSES,
+            "compiles": _COMPILES,
+            "compile_seconds": round(_COMPILE_SECONDS, 6)}
 
 
 def clear_cache():
-    global _HITS, _MISSES
+    global _HITS, _MISSES, _COMPILES, _COMPILE_SECONDS
     with _LOCK:
         _CACHE.clear()
         _HITS = _MISSES = 0
+        _COMPILES = 0
+        _COMPILE_SECONDS = 0.0

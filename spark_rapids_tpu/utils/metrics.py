@@ -1,16 +1,30 @@
-"""Tiered operator metrics (reference: GpuMetric, GpuExec.scala:30-131).
+"""Tiered operator metrics + process-wide stats registry.
 
-ESSENTIAL/MODERATE/DEBUG tiers gate collection cost by
-``spark.rapids.sql.metrics.level``; timers measure wall time around device
-dispatch (opTime), upload/download, and semaphore waits.
+Reference: GpuMetric / GpuExec.scala:30-131 for the per-exec metric sets
+(ESSENTIAL/MODERATE/DEBUG tiers gated by ``spark.rapids.sql.metrics.level``;
+timers around device dispatch, upload/download, semaphore waits), and the
+MetricsSystem-style aggregation the plugin tools mine out of Spark metrics.
+
+This module adds two observability layers on top of plain counters:
+
+- ``Histogram``: distribution metrics (latency quantiles, batch-size
+  distributions) backed by the merging t-digest in ``utils/tdigest.py`` —
+  bounded-size sketches, so per-batch observation is safe on hot paths.
+- ``StatsRegistry``: one process-global registry that aggregates counters
+  from every subsystem (buffer catalog spills/OOM, semaphore waits, XLA
+  compile cache, scan upload cache, shuffle tiers) through lazily-imported
+  source hooks, and serializes the lot as a Prometheus text exposition.
 """
 from __future__ import annotations
 
+import re
+import threading
 import time
 from contextlib import contextmanager
-from typing import Dict
+from typing import Callable, Dict, List, Optional
 
-__all__ = ["MetricLevel", "Metric", "MetricRegistry"]
+__all__ = ["MetricLevel", "Metric", "Histogram", "MetricRegistry",
+           "StatsRegistry", "get_stats", "reset_stats"]
 
 
 class MetricLevel:
@@ -39,6 +53,7 @@ SORT_TIME = "sortTime"
 AGG_TIME = "computeAggTime"
 JOIN_TIME = "joinTime"
 COMPILE_TIME = "xlaCompileTime"
+BATCH_ROWS_HISTOGRAM = "batchRows"
 
 
 class Metric:
@@ -53,12 +68,82 @@ class Metric:
         self.value += v
 
 
+class Histogram:
+    """Distribution metric backed by the merging t-digest
+    (utils/tdigest.py). Raw observations buffer in a small list and fold
+    into the bounded sketch lazily, so ``observe`` on a hot path is an
+    append + occasional vectorized compress."""
+
+    __slots__ = ("name", "level", "delta", "count", "total", "vmin", "vmax",
+                 "_buf", "_digest", "_lock")
+
+    FLUSH_AT = 1024
+    DEFAULT_QUANTILES = (0.5, 0.9, 0.99)
+
+    def __init__(self, name: str, level: int = MetricLevel.MODERATE,
+                 delta: int = 100):
+        self.name = name
+        self.level = level
+        self.delta = delta
+        self.count = 0
+        self.total = 0.0
+        self.vmin = float("inf")
+        self.vmax = float("-inf")
+        self._buf: List[float] = []
+        self._digest: List[float] = []
+        self._lock = threading.Lock()
+
+    def observe(self, v) -> None:
+        v = float(v)
+        with self._lock:
+            self.count += 1
+            self.total += v
+            if v < self.vmin:
+                self.vmin = v
+            if v > self.vmax:
+                self.vmax = v
+            self._buf.append(v)
+            if len(self._buf) >= self.FLUSH_AT:
+                self._flush_locked()
+
+    def _flush_locked(self) -> None:
+        if not self._buf:
+            return
+        from .tdigest import build_digest, merge_digests
+        part = build_digest(self._buf, self.delta)
+        self._digest = merge_digests([self._digest, part], self.delta) \
+            if self._digest else part
+        self._buf = []
+
+    def quantiles(self, qs) -> List[float]:
+        from .tdigest import digest_quantiles
+        with self._lock:
+            self._flush_locked()
+            digest = list(self._digest)
+        return digest_quantiles(digest, list(qs))
+
+    def snapshot(self) -> Dict[str, float]:
+        """Summary dict (serializes into event-log node records)."""
+        with self._lock:
+            self._flush_locked()
+            digest = list(self._digest)
+            count, total = self.count, self.total
+            vmin, vmax = self.vmin, self.vmax
+        if not count:
+            return {"count": 0, "sum": 0.0}
+        from .tdigest import digest_quantiles
+        p50, p90, p99 = digest_quantiles(digest, self.DEFAULT_QUANTILES)
+        return {"count": count, "sum": total, "min": vmin, "max": vmax,
+                "p50": p50, "p90": p90, "p99": p99}
+
+
 class MetricRegistry:
     """Per-exec metric set, filtered by the configured level."""
 
     def __init__(self, collect_level: int = MetricLevel.MODERATE):
         self.collect_level = collect_level
         self._metrics: Dict[str, Metric] = {}
+        self._histograms: Dict[str, Histogram] = {}
 
     def metric(self, name: str, level: int = MetricLevel.MODERATE) -> Metric:
         m = self._metrics.get(name)
@@ -67,9 +152,21 @@ class MetricRegistry:
             self._metrics[name] = m
         return m
 
+    def histogram(self, name: str,
+                  level: int = MetricLevel.MODERATE) -> Histogram:
+        h = self._histograms.get(name)
+        if h is None:
+            h = Histogram(name, level)
+            self._histograms[name] = h
+        return h
+
     def add(self, name: str, v, level: int = MetricLevel.MODERATE):
         if level <= self.collect_level:
             self.metric(name, level).add(v)
+
+    def observe(self, name: str, v, level: int = MetricLevel.MODERATE):
+        if level <= self.collect_level:
+            self.histogram(name, level).observe(v)
 
     @contextmanager
     def timed(self, name: str, level: int = MetricLevel.MODERATE):
@@ -82,5 +179,195 @@ class MetricRegistry:
         finally:
             self.metric(name, level).add(time.perf_counter() - t0)
 
-    def snapshot(self) -> Dict[str, float]:
-        return {k: m.value for k, m in self._metrics.items()}
+    def snapshot(self) -> Dict:
+        out: Dict = {k: m.value for k, m in self._metrics.items()}
+        for k, h in self._histograms.items():
+            out[k] = h.snapshot()
+        return out
+
+
+# ---------------------------------------------------------------------------
+# process-global stats registry
+# ---------------------------------------------------------------------------
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _sanitize(name: str) -> str:
+    name = _NAME_RE.sub("_", str(name))
+    if not name or name[0].isdigit():
+        name = "_" + name
+    return name
+
+
+def _flatten(prefix: str, value, out: Dict[str, float]) -> None:
+    """Fold nested dicts of numbers into flat snake_case keys."""
+    if isinstance(value, dict):
+        for k, v in value.items():
+            _flatten(f"{prefix}_{_sanitize(k)}", v, out)
+    elif isinstance(value, bool):
+        out[prefix] = float(value)
+    elif isinstance(value, (int, float)):
+        out[prefix] = value
+
+
+class StatsRegistry:
+    """Process-wide counters + histograms + pluggable subsystem sources.
+
+    A *source* is a zero-arg callable returning a (possibly nested) dict of
+    numbers; ``collect()`` flattens each under its source name. The default
+    sources pull from the buffer catalog, the semaphore, the XLA compile
+    cache, the scan upload cache and the shuffle manager — the counters the
+    reference's profiling tools mine out of Spark metrics, gathered at the
+    source instead."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, float] = {}
+        self._histograms: Dict[str, Histogram] = {}
+        self._sources: Dict[str, Callable[[], Dict]] = {}
+
+    # -- own metrics ----------------------------------------------------------
+    def add(self, name: str, v=1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + v
+
+    def observe(self, name: str, v) -> None:
+        self.histogram(name).observe(v)
+
+    def histogram(self, name: str) -> Histogram:
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = Histogram(name)
+                self._histograms[name] = h
+            return h
+
+    # -- sources --------------------------------------------------------------
+    def register_source(self, name: str, fn: Callable[[], Dict]) -> None:
+        with self._lock:
+            self._sources[name] = fn
+
+    # -- aggregation ----------------------------------------------------------
+    def collect(self) -> Dict[str, float]:
+        """One flat dict of every counter in the process. Source failures
+        are skipped (a half-initialized subsystem must not break stats)."""
+        with self._lock:
+            out: Dict[str, float] = dict(self._counters)
+            sources = list(self._sources.items())
+        for name, fn in sources:
+            try:
+                _flatten(_sanitize(name), fn() or {}, out)
+            except Exception:
+                continue
+        return out
+
+    @staticmethod
+    def delta(after: Dict[str, float],
+              before: Dict[str, float]) -> Dict[str, float]:
+        """Per-key difference (for per-query attribution of process-wide
+        counters). Keys only in ``after`` count from zero."""
+        return {k: v - before.get(k, 0) for k, v in after.items()}
+
+    def histograms(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            hs = list(self._histograms.items())
+        return {k: h.snapshot() for k, h in hs}
+
+    def prometheus_text(self, prefix: str = "spark_rapids_tpu") -> str:
+        """Prometheus text exposition (0.0.4): collected values as
+        ``gauge`` samples (several exported series legitimately decrease —
+        used bytes, cache entries — and a falsely-typed counter makes
+        rate()/increase() hallucinate resets), histograms as ``summary``
+        quantiles."""
+        lines: List[str] = []
+        for key, val in sorted(self.collect().items()):
+            name = f"{prefix}_{_sanitize(key)}"
+            lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name} {_fmt(val)}")
+        for key, snap in sorted(self.histograms().items()):
+            name = f"{prefix}_{_sanitize(key)}"
+            lines.append(f"# TYPE {name} summary")
+            for q, label in (("p50", "0.5"), ("p90", "0.9"),
+                             ("p99", "0.99")):
+                if q in snap:
+                    lines.append(f'{name}{{quantile="{label}"}} '
+                                 f"{_fmt(snap[q])}")
+            lines.append(f"{name}_sum {_fmt(snap.get('sum', 0.0))}")
+            lines.append(f"{name}_count {_fmt(snap.get('count', 0))}")
+        return "\n".join(lines) + "\n"
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._histograms.clear()
+
+
+def _fmt(v) -> str:
+    if isinstance(v, float) and not v.is_integer():
+        return repr(v)
+    return str(int(v))
+
+
+# -- default sources (lazy imports; subsystems may not be loaded yet) --------
+def _compile_cache_source() -> Dict:
+    from .compile_cache import cache_stats
+    return cache_stats()
+
+
+def _catalog_source() -> Dict:
+    from ..memory.catalog import peek_catalog
+    cat = peek_catalog()
+    return cat.counters() if cat is not None else {}
+
+
+def _semaphore_source() -> Dict:
+    from ..memory.semaphore import peek_semaphore
+    sem = peek_semaphore()
+    if sem is None:
+        return {}
+    return {"wait_seconds": sem.total_wait_time,
+            "acquires": sem.acquire_count}
+
+
+def _upload_cache_source() -> Dict:
+    from ..exec.transitions import upload_cache_stats
+    return upload_cache_stats()
+
+
+def _shuffle_source() -> Dict:
+    from ..shuffle.manager import shuffle_stats
+    return shuffle_stats()
+
+
+_DEFAULT_SOURCES = {
+    "compile_cache": _compile_cache_source,
+    "catalog": _catalog_source,
+    "semaphore": _semaphore_source,
+    "upload_cache": _upload_cache_source,
+    "shuffle": _shuffle_source,
+}
+
+_GLOBAL_STATS: Optional[StatsRegistry] = None
+_GLOBAL_STATS_LOCK = threading.Lock()
+
+
+def get_stats() -> StatsRegistry:
+    """The process-global registry, with the default subsystem sources
+    registered."""
+    global _GLOBAL_STATS
+    with _GLOBAL_STATS_LOCK:
+        if _GLOBAL_STATS is None:
+            reg = StatsRegistry()
+            for name, fn in _DEFAULT_SOURCES.items():
+                reg.register_source(name, fn)
+            _GLOBAL_STATS = reg
+        return _GLOBAL_STATS
+
+
+def reset_stats() -> None:
+    """Drop the global registry's own counters/histograms (sources keep
+    their subsystem state; tests reset those separately)."""
+    global _GLOBAL_STATS
+    with _GLOBAL_STATS_LOCK:
+        if _GLOBAL_STATS is not None:
+            _GLOBAL_STATS.reset()

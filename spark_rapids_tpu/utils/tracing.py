@@ -1,0 +1,229 @@
+"""Process-wide span tracer + Chrome trace-event exporter.
+
+Reference: the plugin scopes device work in NVTX ranges
+(NvtxWithMetrics.scala) and relies on Nsight for timeline analysis; the
+TPU runtime owns its execution loop, so it records its own spans instead:
+query -> AQE stage -> partition task -> operator batch, plus subsystem
+spans (shuffle write/fetch, XLA compile, host->device upload, spill,
+semaphore wait) and instant events (device OOM).
+
+Design constraints:
+- thread-safe: operators run on executor worker threads; one global ring
+  buffer collects events from all of them.
+- bounded: a ring buffer (``spark.rapids.tpu.trace.bufferSize`` events)
+  caps memory no matter how long the session runs; overflow drops the
+  OLDEST events and counts the drops.
+- near-zero cost when disabled: ``span()`` yields immediately without
+  taking the lock or reading the clock.
+
+The export format is the Chrome trace-event JSON (``ph: "X"`` complete
+events with microsecond timestamps), loadable in Perfetto / chrome://tracing
+and in TensorBoard's trace viewer.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Dict, List, Optional
+
+from ..conf import register_conf
+
+__all__ = ["TraceEvent", "Tracer", "get_tracer", "set_tracer",
+           "configure_tracer", "TRACE_ENABLED", "TRACE_BUFFER_SIZE",
+           "TRACE_DIR"]
+
+TRACE_ENABLED = register_conf(
+    "spark.rapids.tpu.trace.enabled",
+    "Record runtime spans (query/stage/task/operator plus shuffle, compile, "
+    "upload, spill and semaphore-wait events) into the process-wide tracer "
+    "(the NVTX-range analogue; reference: NvtxWithMetrics.scala). Export "
+    "with Tracer.to_chrome_trace() or spark.rapids.tpu.trace.dir.", False)
+
+TRACE_BUFFER_SIZE = register_conf(
+    "spark.rapids.tpu.trace.bufferSize",
+    "Ring-buffer capacity of the tracer in events; overflow drops the "
+    "oldest events (drop count is reported in the exported trace metadata).",
+    65536, checker=lambda v: None if v > 0 else f"must be positive, got {v}")
+
+TRACE_DIR = register_conf(
+    "spark.rapids.tpu.trace.dir",
+    "Directory to dump the Chrome trace-event JSON into on session close "
+    "(one file per session, loadable in Perfetto / chrome://tracing). "
+    "Empty disables the dump.", "")
+
+
+class TraceEvent:
+    """One recorded event. ``ts``/``dur`` are microseconds relative to the
+    tracer's epoch; ``ph`` is the Chrome trace phase ("X" complete span,
+    "i" instant)."""
+
+    __slots__ = ("name", "cat", "ph", "ts", "dur", "tid", "depth", "args")
+
+    def __init__(self, name: str, cat: str, ph: str, ts: float, dur: float,
+                 tid: int, depth: int, args: Optional[Dict] = None):
+        self.name = name
+        self.cat = cat
+        self.ph = ph
+        self.ts = ts
+        self.dur = dur
+        self.tid = tid
+        self.depth = depth
+        self.args = args or {}
+
+    def to_chrome(self, pid: int = 1) -> Dict:
+        ev: Dict = {"name": self.name, "cat": self.cat, "ph": self.ph,
+                    "ts": round(self.ts, 3), "pid": pid, "tid": self.tid}
+        if self.ph == "X":
+            ev["dur"] = round(self.dur, 3)
+        if self.ph == "i":
+            ev["s"] = "t"  # instant scope: thread
+        args = dict(self.args)
+        args["depth"] = self.depth
+        ev["args"] = args
+        return ev
+
+    def __repr__(self):
+        return (f"TraceEvent({self.name!r}, cat={self.cat!r}, ph={self.ph!r}, "
+                f"ts={self.ts:.1f}us, dur={self.dur:.1f}us, "
+                f"depth={self.depth})")
+
+
+class Tracer:
+    """Thread-safe bounded span recorder."""
+
+    def __init__(self, capacity: int = 65536, enabled: bool = False):
+        self.enabled = enabled
+        self.capacity = capacity
+        self._events: deque = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        self.epoch = time.perf_counter()
+        self.dropped = 0
+
+    # -- recording ------------------------------------------------------------
+    def _stack(self) -> List[str]:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def _record(self, ev: TraceEvent) -> None:
+        with self._lock:
+            if len(self._events) == self.capacity:
+                self.dropped += 1
+            self._events.append(ev)
+
+    @contextmanager
+    def span(self, name: str, cat: str = "misc", **args):
+        """Record a complete event around the with-block. Nesting depth is
+        tracked per thread so exported traces preserve the span hierarchy."""
+        if not self.enabled:
+            yield
+            return
+        stack = self._stack()
+        depth = len(stack)
+        stack.append(name)
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            t1 = time.perf_counter()
+            stack.pop()
+            self._record(TraceEvent(
+                name, cat, "X", (t0 - self.epoch) * 1e6, (t1 - t0) * 1e6,
+                threading.get_ident(), depth, args))
+
+    def complete(self, name: str, cat: str, start_s: float, dur_s: float,
+                 **args) -> None:
+        """Record a complete event with caller-measured times
+        (``time.perf_counter()`` domain) — for code that already owns its
+        own timers, e.g. the per-batch operator instrumentation."""
+        if not self.enabled:
+            return
+        self._record(TraceEvent(
+            name, cat, "X", (start_s - self.epoch) * 1e6, dur_s * 1e6,
+            threading.get_ident(), len(self._stack()), args))
+
+    def instant(self, name: str, cat: str = "misc", **args) -> None:
+        if not self.enabled:
+            return
+        self._record(TraceEvent(
+            name, cat, "i", (time.perf_counter() - self.epoch) * 1e6, 0.0,
+            threading.get_ident(), len(self._stack()), args))
+
+    # -- inspection / export --------------------------------------------------
+    def events(self) -> List[TraceEvent]:
+        with self._lock:
+            return list(self._events)
+
+    def categories(self) -> set:
+        return {e.cat for e in self.events()}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self.dropped = 0
+
+    def to_chrome_trace(self) -> Dict:
+        """Chrome trace-event JSON object ({"traceEvents": [...]}), loadable
+        in Perfetto/chrome://tracing."""
+        evs = self.events()
+        return {
+            "traceEvents": [e.to_chrome() for e in evs],
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "producer": "spark-rapids-tpu",
+                "dropped_events": self.dropped,
+            },
+        }
+
+    def dump(self, path: str) -> str:
+        """Write the Chrome trace JSON to ``path``; returns the path."""
+        import os
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(self.to_chrome_trace(), f)
+        return path
+
+
+_GLOBAL = Tracer()
+_GLOBAL_LOCK = threading.Lock()
+
+
+def get_tracer() -> Tracer:
+    return _GLOBAL
+
+
+def set_tracer(tracer: Tracer) -> None:
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        _GLOBAL = tracer
+
+
+def configure_tracer(conf) -> Tracer:
+    """Apply conf to the global tracer (session init chokepoint).
+
+    Sticky semantics: the tracer is process-wide and sessions come and go,
+    so a session whose conf leaves tracing at the default must NOT disable
+    a tracer another session enabled (nor shrink its buffer, dropping
+    already-recorded events). Enabling turns it on; turning it off again is
+    an explicit act: ``get_tracer().enabled = False``. The buffer resizes
+    only when this conf sets a non-default size; resizing preserves the
+    newest events."""
+    tracer = _GLOBAL
+    with _GLOBAL_LOCK:
+        if bool(conf.get(TRACE_ENABLED)):
+            tracer.enabled = True
+        capacity = int(conf.get(TRACE_BUFFER_SIZE))
+        if capacity != tracer.capacity \
+                and capacity != TRACE_BUFFER_SIZE.default:
+            with tracer._lock:
+                tracer.dropped += max(0, len(tracer._events) - capacity)
+                tracer.capacity = capacity
+                tracer._events = deque(tracer._events, maxlen=capacity)
+    return tracer

@@ -1,0 +1,560 @@
+"""Observability subsystem tests: span tracer + Chrome trace export,
+tdigest-backed histograms, the process StatsRegistry + Prometheus dump,
+event-log schema stability (versioned), and the run-compare tool.
+
+The schema-stability test is the tier-1 guard: future PRs changing the
+event-log record shape must bump SCHEMA_VERSION (with a migration note in
+docs/observability.md) or this fails."""
+import json
+import re
+import threading
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.utils.metrics import (Histogram, StatsRegistry,
+                                            get_stats)
+from spark_rapids_tpu.utils.tracing import Tracer
+
+
+# ---------------------------------------------------------------------------
+# tracer
+# ---------------------------------------------------------------------------
+def test_span_nesting_depth_and_containment():
+    tr = Tracer(capacity=100, enabled=True)
+    with tr.span("outer", "query", query_id=1):
+        with tr.span("inner", "operator"):
+            pass
+    inner, outer = tr.events()  # children pop (and record) first
+    assert inner.name == "inner" and outer.name == "outer"
+    assert outer.depth == 0 and inner.depth == 1
+    # time containment: the child span lies within the parent span
+    assert outer.ts <= inner.ts
+    assert inner.ts + inner.dur <= outer.ts + outer.dur + 1.0  # 1us slack
+    assert outer.args == {"query_id": 1}
+
+
+def test_chrome_trace_json_schema():
+    tr = Tracer(capacity=100, enabled=True)
+    with tr.span("q", "query"):
+        pass
+    tr.instant("oom", "spill", context="test")
+    text = json.dumps(tr.to_chrome_trace())
+    obj = json.loads(text)  # must be valid JSON
+    evs = obj["traceEvents"]
+    assert len(evs) == 2
+    for ev in evs:
+        assert {"name", "cat", "ph", "ts", "pid", "tid", "args"} <= set(ev)
+    spans = [e for e in evs if e["ph"] == "X"]
+    assert spans and all("dur" in e for e in spans)
+    instants = [e for e in evs if e["ph"] == "i"]
+    assert instants and instants[0]["args"]["context"] == "test"
+    assert obj["otherData"]["dropped_events"] == 0
+
+
+def test_ring_buffer_bounds_memory():
+    tr = Tracer(capacity=10, enabled=True)
+    for i in range(25):
+        tr.instant(f"e{i}", "misc")
+    evs = tr.events()
+    assert len(evs) == 10
+    assert tr.dropped == 15
+    # the NEWEST events are retained
+    assert [e.name for e in evs] == [f"e{i}" for i in range(15, 25)]
+    assert tr.to_chrome_trace()["otherData"]["dropped_events"] == 15
+
+
+def test_disabled_tracer_records_nothing():
+    tr = Tracer(capacity=10, enabled=False)
+    with tr.span("x", "query"):
+        tr.instant("y")
+        tr.complete("z", "operator", 0.0, 1.0)
+    assert tr.events() == []
+
+
+def test_tracer_thread_safety():
+    tr = Tracer(capacity=10_000, enabled=True)
+
+    def work():
+        for i in range(200):
+            with tr.span("s", "task", i=i):
+                pass
+
+    threads = [threading.Thread(target=work) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(tr.events()) == 800
+    assert all(e.depth == 0 for e in tr.events())  # per-thread stacks
+
+
+# ---------------------------------------------------------------------------
+# histograms
+# ---------------------------------------------------------------------------
+def test_histogram_quantiles_match_numpy(rng):
+    vals = rng.normal(loc=10.0, scale=2.0, size=20_000)
+    h = Histogram("lat")
+    for v in vals:
+        h.observe(v)  # > FLUSH_AT, so the digest merge path runs
+    q50, q90, q99 = h.quantiles([0.5, 0.9, 0.99])
+    e50, e90, e99 = np.quantile(vals, [0.5, 0.9, 0.99])
+    spread = vals.max() - vals.min()
+    assert abs(q50 - e50) < 0.02 * spread
+    assert abs(q90 - e90) < 0.02 * spread
+    assert abs(q99 - e99) < 0.05 * spread
+    snap = h.snapshot()
+    assert snap["count"] == 20_000
+    assert snap["min"] == pytest.approx(vals.min())
+    assert snap["max"] == pytest.approx(vals.max())
+    assert snap["sum"] == pytest.approx(vals.sum(), rel=1e-9)
+    assert {"p50", "p90", "p99"} <= set(snap)
+
+
+def test_empty_histogram_snapshot():
+    assert Histogram("empty").snapshot() == {"count": 0, "sum": 0.0}
+
+
+def test_metric_registry_histograms_serialize():
+    from spark_rapids_tpu.utils.metrics import MetricRegistry
+    reg = MetricRegistry()
+    reg.add("numOutputRows", 5)
+    for v in (1, 2, 3):
+        reg.observe("batchRows", v)
+    snap = reg.snapshot()
+    assert snap["numOutputRows"] == 5
+    assert snap["batchRows"]["count"] == 3
+    json.dumps(snap)  # event-log records must stay JSON-serializable
+
+
+# ---------------------------------------------------------------------------
+# stats registry + prometheus
+# ---------------------------------------------------------------------------
+def test_stats_registry_flatten_collect_delta():
+    reg = StatsRegistry()
+    reg.add("my_counter", 2)
+    reg.add("my_counter")
+    reg.register_source("src", lambda: {"a": 1, "nested": {"b": 2.5},
+                                        "skip": "strings-dropped"})
+    c = reg.collect()
+    assert c["my_counter"] == 3
+    assert c["src_a"] == 1
+    assert c["src_nested_b"] == 2.5
+    assert "src_skip" not in c
+    before = dict(c)
+    reg.add("my_counter", 4)
+    d = StatsRegistry.delta(reg.collect(), before)
+    assert d["my_counter"] == 4 and d["src_a"] == 0
+
+
+def test_stats_registry_broken_source_skipped():
+    reg = StatsRegistry()
+    reg.add("ok", 1)
+    reg.register_source("bad", lambda: 1 / 0)
+    assert reg.collect() == {"ok": 1}
+
+
+_PROM_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{quantile=\"0\.\d+\"\})? -?\d")
+
+
+def test_prometheus_text_exposition():
+    reg = StatsRegistry()
+    reg.add("requests_total", 7)
+    reg.register_source("cache", lambda: {"hits": 3, "bytes": 1.5})
+    for v in range(100):
+        reg.observe("latency_seconds", v / 100.0)
+    text = reg.prometheus_text()
+    lines = text.strip().split("\n")
+    assert lines, text
+    for line in lines:
+        if line.startswith("#"):
+            assert re.match(r"^# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* "
+                            r"(gauge|summary)$", line), line
+        else:
+            assert _PROM_LINE.match(line), line
+    assert "spark_rapids_tpu_requests_total 7" in text
+    assert "spark_rapids_tpu_cache_hits 3" in text
+    assert 'spark_rapids_tpu_latency_seconds{quantile="0.5"}' in text
+    assert "spark_rapids_tpu_latency_seconds_count 100" in text
+
+
+def test_global_stats_has_all_subsystem_sources(session):
+    # touch the subsystems so every default source reports (the semaphore
+    # source deliberately reports nothing until a semaphore exists)
+    from spark_rapids_tpu.memory.semaphore import get_semaphore
+    get_semaphore()
+    df = session.create_dataframe(pa.table({"a": [1.0, 2.0, 3.0]}))
+    df.collect(device=True)
+    keys = set(get_stats().collect())
+    for family in ("compile_cache_", "upload_cache_", "shuffle_",
+                   "semaphore_"):
+        assert any(k.startswith(family) for k in keys), (family, keys)
+
+
+# ---------------------------------------------------------------------------
+# upload-cache race fix (satellite: exec/transitions.py)
+# ---------------------------------------------------------------------------
+def test_upload_cache_concurrent_bookkeeping():
+    from spark_rapids_tpu.columnar.host import HostTable
+    from spark_rapids_tpu.exec import transitions as T
+
+    class _Src:
+        """Minimal child: re-yields the same decoded host batches."""
+
+        def __init__(self, batches):
+            self._batches = batches
+            self.schema = None
+            self.children = ()
+
+        @property
+        def num_partitions(self):
+            return 1
+
+        def execute(self, pidx):
+            return iter(self._batches)
+
+    T.clear_upload_cache()
+    batches = [HostTable.from_arrow(pa.table(
+        {"a": np.arange(64, dtype=np.int64) + 64 * i})) for i in range(4)]
+    h2d = T.HostToDeviceExec(_Src(batches), min_bucket=8,
+                             cache_max_bytes=1 << 30)
+    errs = []
+
+    def drain():
+        try:
+            for _ in range(5):
+                assert len(list(h2d.execute_columnar(0))) == 4
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=drain) for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    stats = T.upload_cache_stats()
+    assert stats["entries"] == 4
+    assert stats["hits"] > 0
+    # the running byte counter must equal a full recount of the cache
+    with T._UPLOAD_LOCK:
+        recount = sum(dt.nbytes() for _, per in T._UPLOAD_CACHE.values()
+                      for dt in per.values())
+    assert stats["bytes"] == recount > 0
+    freed = T.clear_upload_cache()
+    assert freed == recount
+    assert T.upload_cache_stats()["bytes"] == 0
+
+
+def test_upload_cache_entry_dies_with_batch():
+    from spark_rapids_tpu.columnar.host import HostTable
+    from spark_rapids_tpu.exec import transitions as T
+
+    class _Src:
+        def __init__(self, batches):
+            self._batches = batches
+            self.schema = None
+            self.children = ()
+
+        @property
+        def num_partitions(self):
+            return 1
+
+        def execute(self, pidx):
+            return iter(self._batches)
+
+    T.clear_upload_cache()
+    batch = HostTable.from_arrow(pa.table({"a": np.arange(32)}))
+    src = _Src([batch])
+    h2d = T.HostToDeviceExec(src, min_bucket=8, cache_max_bytes=1 << 30)
+    list(h2d.execute_columnar(0))
+    assert T.upload_cache_stats()["entries"] == 1
+    del batch
+    src._batches = []  # drop the last strong reference
+    import gc
+    gc.collect()
+    stats = T.upload_cache_stats()
+    assert stats["entries"] == 0
+    assert stats["bytes"] == 0  # running counter followed the eviction
+
+
+# ---------------------------------------------------------------------------
+# catalog satellites: OOM-callback logging + external-bytes accounting
+# ---------------------------------------------------------------------------
+def test_oom_callback_exception_is_logged():
+    from spark_rapids_tpu.memory.catalog import BufferCatalog
+
+    cat = BufferCatalog(device_limit=1 << 20, host_limit=1 << 20)
+
+    def bad_callback():
+        raise RuntimeError("boom from cache dropper")
+
+    cat.register_oom_callback(bad_callback)
+    with pytest.warns(RuntimeWarning, match="OOM callback .* failed"):
+        cat.handle_device_oom("unit test")
+    assert cat.oom_callback_errors == 1
+    assert any("boom from cache dropper" in d for d in cat.diagnostics)
+    assert cat.counters()["oom_callback_errors"] == 1
+    assert cat.stats()["oom_callback_errors"] == 1
+    # the failure shows up in the OOM dump diagnostics too
+    assert "boom from cache dropper" in cat.oom_dump()
+
+
+def test_catalog_accounts_external_device_bytes():
+    from spark_rapids_tpu.memory.catalog import BufferCatalog
+
+    cat = BufferCatalog(device_limit=1 << 20, host_limit=1 << 20)
+    cat.register_external_bytes("upload_cache_test", lambda: 1234)
+    assert cat.external_device_bytes() == 1234
+    assert cat.device_in_use_bytes() == cat.device.used_bytes + 1234
+    assert cat.peak_device_bytes >= 1234
+    assert cat.stats()["external_bytes"]["upload_cache_test"] == 1234
+    assert "upload_cache_test=1234" in cat.oom_dump()
+    # a broken source reports 0, never raises
+    cat.register_external_bytes("broken", lambda: 1 / 0)
+    assert cat.external_device_bytes() == 1234
+
+
+# ---------------------------------------------------------------------------
+# event-log schema stability (versioned) + per-query counter deltas
+# ---------------------------------------------------------------------------
+_REQUIRED_KEYS = {
+    "app_start": {"event", "app_id", "schema_version", "ts", "conf"},
+    "query_start": {"event", "query_id", "ts", "plan"},
+    "node": {"event", "query_id", "node_id", "parent_id", "name", "desc",
+             "depth", "wall_s", "rows", "batches", "t_first", "t_last",
+             "metrics"},
+    "query_end": {"event", "query_id", "ts", "wall_s", "final_plan",
+                  "aqe_events", "spill_count", "semaphore_wait_s", "stats"},
+    "app_end": {"event", "ts"},
+}
+
+
+def _run_logged_app(tmp_path):
+    from spark_rapids_tpu.session import TpuSession
+    from spark_rapids_tpu.expr.functions import col, sum as f_sum
+    sess = TpuSession({
+        "spark.rapids.tpu.eventLog.dir": str(tmp_path),
+        "spark.rapids.tpu.batchRowsMinBucket": 8,
+        "spark.rapids.tpu.shuffle.partitions": 2,
+        "spark.rapids.tpu.shuffle.mode": "host",
+    })
+    rng = np.random.default_rng(7)
+    df = sess.create_dataframe(pd.DataFrame({
+        "g": rng.integers(0, 5, 400).astype(np.int64),
+        "x": rng.normal(size=400)}), num_partitions=2)
+    df.group_by("g").agg(f_sum(col("x")).alias("sx")).collect(device=True)
+    sess.close()
+    import glob
+    import os
+    (path,) = glob.glob(os.path.join(str(tmp_path), "*.jsonl"))
+    return path
+
+
+def test_eventlog_schema_version_and_required_keys(tmp_path):
+    from spark_rapids_tpu.tools.eventlog import SCHEMA_VERSION
+    path = _run_logged_app(tmp_path)
+    records = [json.loads(line) for line in open(path, encoding="utf-8")]
+    by_type = {}
+    for rec in records:
+        by_type.setdefault(rec["event"], []).append(rec)
+    assert set(by_type) == set(_REQUIRED_KEYS)
+    # the pinned version: bump SCHEMA_VERSION (and this test + the docs)
+    # when the record shape changes
+    assert SCHEMA_VERSION == 2
+    assert by_type["app_start"][0]["schema_version"] == SCHEMA_VERSION
+    for kind, required in _REQUIRED_KEYS.items():
+        for rec in by_type[kind]:
+            missing = required - set(rec)
+            assert not missing, (kind, missing)
+
+
+def test_eventlog_query_stats_cover_all_subsystems(tmp_path):
+    from spark_rapids_tpu.tools.eventlog import load_event_log
+    path = _run_logged_app(tmp_path)
+    app = load_event_log(path)
+    assert app.schema_version == 2
+    q = app.query(1)
+    assert q.stats, "query_end stats delta missing"
+    for family in ("compile_cache_", "upload_cache_", "shuffle_",
+                   "semaphore_", "catalog_"):
+        assert any(k.startswith(family) for k in q.stats), \
+            (family, sorted(q.stats))
+    # replayed node metrics keep the operator metric snapshots
+    assert any(n.get("metrics") for n in q.nodes)
+
+
+def test_profile_query_reports_all_counter_families(session):
+    from spark_rapids_tpu.expr.functions import col, sum as f_sum
+    from spark_rapids_tpu.tools.profiler import profile_query
+    rng = np.random.default_rng(11)
+    df = session.create_dataframe(pa.table({
+        "k": rng.integers(0, 4, 500), "v": rng.normal(size=500)}),
+        num_partitions=2)
+    q = df.group_by("k").agg(f_sum(col("v")).alias("s"))
+    prof = profile_query(q, device=True)
+    for family in ("compile_cache_", "upload_cache_", "shuffle_",
+                   "semaphore_", "catalog_"):
+        assert any(k.startswith(family) for k in prof.stats), \
+            (family, sorted(prof.stats))
+    assert "counters (this query):" in prof.summary()
+    json.loads(prof.to_json())
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: query trace has the span hierarchy
+# ---------------------------------------------------------------------------
+def test_query_chrome_trace_has_span_categories(tmp_path):
+    import glob
+    import os
+    from spark_rapids_tpu.session import TpuSession
+    from spark_rapids_tpu.expr.functions import col, sum as f_sum
+    from spark_rapids_tpu.utils.tracing import get_tracer
+    trace_dir = str(tmp_path / "traces")
+    sess = TpuSession({
+        "spark.rapids.tpu.trace.enabled": True,
+        "spark.rapids.tpu.trace.dir": trace_dir,
+        "spark.rapids.tpu.eventLog.dir": str(tmp_path / "evt"),
+        "spark.rapids.tpu.batchRowsMinBucket": 8,
+        "spark.rapids.tpu.shuffle.partitions": 2,
+        "spark.rapids.tpu.shuffle.mode": "host",
+    })
+    try:
+        rng = np.random.default_rng(3)
+        df = sess.create_dataframe(pa.table({
+            "k": rng.integers(0, 4, 600), "v": rng.normal(size=600)}),
+            num_partitions=2)
+        df.group_by("k").agg(f_sum(col("v")).alias("s")).collect(device=True)
+        sess.close()
+    finally:
+        get_tracer().enabled = False  # don't leak tracing into other tests
+    (path,) = glob.glob(os.path.join(trace_dir, "*.json"))
+    with open(path, encoding="utf-8") as f:
+        obj = json.load(f)  # loadable Chrome trace-event JSON
+    evs = obj["traceEvents"]
+    cats = {e["cat"] for e in evs}
+    # the acceptance bar: >= 3 distinct span categories in one query trace
+    assert len(cats) >= 3, cats
+    assert "query" in cats and "task" in cats and "operator" in cats, cats
+    assert any(e["ph"] == "X" and e["dur"] >= 0 for e in evs)
+
+
+# ---------------------------------------------------------------------------
+# compare tool
+# ---------------------------------------------------------------------------
+def _fabricate_log(path, op_walls, wall_scale=1.0, stats=None):
+    """Write a synthetic event log: one query, given per-op wall times."""
+    records = [{"event": "app_start", "app_id": path.stem,
+                "schema_version": 2, "ts": 0.0, "conf": {}}]
+    records.append({"event": "query_start", "query_id": 1, "ts": 0.0,
+                    "plan": "plan"})
+    for i, (name, wall) in enumerate(op_walls):
+        records.append({
+            "event": "node", "query_id": 1, "node_id": i,
+            "parent_id": i - 1, "name": name, "desc": "", "depth": i,
+            "wall_s": wall, "rows": 1000, "batches": 2,
+            "t_first": 0.0, "t_last": wall, "metrics": {}})
+    records.append({
+        "event": "query_end", "query_id": 1, "ts": 1.0,
+        "wall_s": sum(w for _, w in op_walls) * wall_scale,
+        "final_plan": "plan", "aqe_events": [],
+        "spill_count": {}, "semaphore_wait_s": 0.0,
+        "stats": stats or {}})
+    records.append({"event": "app_end", "ts": 1.0})
+    with open(path, "w", encoding="utf-8") as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+    return str(path)
+
+
+def test_compare_flags_injected_operator_regression(tmp_path):
+    from spark_rapids_tpu.tools.compare import compare_event_logs
+    base = _fabricate_log(
+        tmp_path / "base.jsonl",
+        [("TpuScanExec", 0.10), ("TpuFilterExec", 0.05),
+         ("TpuHashAggregateExec", 0.20)],
+        stats={"compile_cache_misses": 3, "shuffle_bytes_fetched": 100})
+    # inject a 10x regression into the filter only
+    cand = _fabricate_log(
+        tmp_path / "cand.jsonl",
+        [("TpuScanExec", 0.10), ("TpuFilterExec", 0.50),
+         ("TpuHashAggregateExec", 0.21)],
+        stats={"compile_cache_misses": 9, "shuffle_bytes_fetched": 100})
+    rep = compare_event_logs(base, cand, threshold=0.5)
+    regs = rep.regressions()
+    assert [r.name for r in regs] == ["TpuFilterExec"]
+    assert regs[0].ratio == pytest.approx(10.0)
+    assert regs[0].delta_s == pytest.approx(0.45)
+    (q,) = rep.queries
+    assert q.regressed  # 0.35s -> 0.81s overall
+    assert q.metric_deltas["compile_cache_misses"] == 6
+    assert q.metric_deltas["shuffle_bytes_fetched"] == 0
+    s = rep.summary()
+    assert "REGRESSED" in s and "TpuFilterExec" in s
+    assert "compile_cache_misses=+6" in s
+
+
+def test_compare_handles_missing_ops_and_queries(tmp_path):
+    from spark_rapids_tpu.tools.compare import compare_event_logs
+    base = _fabricate_log(tmp_path / "a.jsonl",
+                          [("TpuScanExec", 0.1), ("TpuSortExec", 0.2)])
+    cand = _fabricate_log(tmp_path / "b.jsonl",
+                          [("TpuScanExec", 0.1), ("TpuProjectExec", 0.05)])
+    rep = compare_event_logs(base, cand, threshold=0.2)
+    (q,) = rep.queries
+    only = {op.name: op.only_in for op in q.ops if op.only_in}
+    assert only == {"TpuSortExec": "a", "TpuProjectExec": "b"}
+    assert not rep.regressions()  # ops missing on one side never flag
+
+
+def test_compare_real_event_logs_round_trip(tmp_path):
+    """Two real runs of the same workload align with no false regressions
+    at a generous threshold."""
+    from spark_rapids_tpu.tools.compare import compare_event_logs
+    a = _run_logged_app(tmp_path / "runA")
+    b = _run_logged_app(tmp_path / "runB")
+    rep = compare_event_logs(a, b, threshold=1000.0)
+    assert rep.queries and not rep.only_in_a and not rep.only_in_b
+    (q,) = rep.queries
+    assert q.ops and all(not op.only_in for op in q.ops)
+    assert q.metric_deltas  # counter deltas came from the stats records
+    assert "query 1" in rep.summary()
+
+
+def test_compare_bench_results(tmp_path):
+    from spark_rapids_tpu.tools.compare import compare_bench_results
+    a = tmp_path / "bench_a.json"
+    b = tmp_path / "bench_b.json"
+    # smoke and tpch phases both name q1/q6 (different scale factors);
+    # they must align per phase, never shadow or cross-compare
+    a.write_text(json.dumps({
+        "smoke": {"q6": {"dev_s": 0.01, "cpu_s": 0.02, "speedup": 2.0}},
+        "tpch": {"q1": {"dev_s": 1.0, "cpu_s": 4.0, "speedup": 4.0},
+                 "q6": {"dev_s": 0.5, "cpu_s": 2.0, "speedup": 4.0}}},
+        indent=1))  # pretty-printed, like BENCH_partial.json
+    b.write_text(json.dumps({
+        "smoke": {"q6": {"dev_s": 0.10, "cpu_s": 0.02, "speedup": 0.2}},
+        "tpch": {"q1": {"dev_s": 1.05, "cpu_s": 4.0, "speedup": 3.8},
+                 "q6": {"dev_s": 1.5, "cpu_s": 2.0, "speedup": 1.3}}},
+        indent=1))
+    rep = compare_bench_results(str(a), str(b), threshold=0.2)
+    regressed = [q.query_id for q in rep.regressed_queries()]
+    assert regressed == ["smoke:q6", "tpch:q6"]
+    assert "REGRESSED" in rep.summary()
+    # the CLI sniffs pretty-printed bench JSON correctly
+    from spark_rapids_tpu.tools.compare import _sniff
+    assert _sniff(str(a)) == "bench"
+
+
+def test_compare_cli(tmp_path, capsys):
+    from spark_rapids_tpu.tools.compare import main
+    base = _fabricate_log(tmp_path / "a.jsonl", [("TpuScanExec", 0.1)])
+    cand = _fabricate_log(tmp_path / "b.jsonl", [("TpuScanExec", 0.9)])
+    rc = main([base, cand, "--threshold", "0.5"])
+    out = capsys.readouterr().out
+    assert rc == 1 and "REGRESSED" in out
+    rc = main([base, base])
+    assert rc == 0
