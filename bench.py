@@ -367,6 +367,7 @@ def _run_phase(phase: str, platform: str, queries, query_timeout: float,
 def main():
     _install_emit_guards()
     signal.alarm(max(int(_budget_s()) + 20, 30))
+    _silence_xla_cpu_noise()  # probes/workers inherit the env
 
     forced = os.environ.get("BENCH_PLATFORM", "")
     if forced:
@@ -468,7 +469,21 @@ class _EventSink:
             os.fsync(f.fileno())
 
 
+def _silence_xla_cpu_noise():
+    """Silence the XLA:CPU machine-feature-mismatch warning (persistent
+    compile-cache entries built on a different host spam one line per
+    load) via the logging flag, not log scraping. Must run BEFORE jax
+    initializes its C++ logging: worker processes call it ahead of their
+    jax import, and the parent (which never imports jax) calls it so
+    probe/worker subprocesses inherit the env. BENCH_XLA_LOG overrides."""
+    os.environ.setdefault(
+        "TF_CPP_MIN_LOG_LEVEL", os.environ.get("BENCH_XLA_LOG", "2"))
+    import logging
+    logging.getLogger("jax._src.compilation_cache").setLevel(logging.ERROR)
+
+
 def _worker_setup_jax():
+    _silence_xla_cpu_noise()
     import jax
     plat = os.environ.get("BENCH_PLATFORM")
     if plat == "cpu":
@@ -486,6 +501,32 @@ def _worker_setup_jax():
         except Exception as e:
             _log(f"compilation cache disabled: {e}")
     return jax
+
+
+def _write_diagnose_report(phase: str):
+    """Run the auto-diagnosis tool over this phase's event logs and write
+    the ranked bottleneck report next to them
+    (.bench_eventlogs/<phase>/diagnose.txt) — every BENCH round carries its
+    own per-query (node, metric) attribution, not just timings."""
+    if os.environ.get("BENCH_EVENTLOG", "1") == "0":
+        return
+    d = os.path.join(
+        os.environ.get("BENCH_EVENTLOG_DIR",
+                       os.path.join(_REPO, ".bench_eventlogs")), phase)
+    try:
+        import glob as _glob
+
+        from spark_rapids_tpu.tools.diagnose import diagnose_path
+        logs = sorted(_glob.glob(os.path.join(d, "*.jsonl")))
+        if not logs:
+            return
+        text = "\n\n".join(diagnose_path(p).summary() for p in logs)
+        out = os.path.join(d, "diagnose.txt")
+        with open(out, "w", encoding="utf-8") as f:
+            f.write(text + "\n")
+        _log(f"{phase}: diagnose report -> {out}")
+    except Exception as e:  # report generation must never fail the bench
+        _log(f"{phase}: diagnose report failed: {type(e).__name__}: {e}")
 
 
 def _eventlog_conf(phase: str, sink=None) -> dict:
@@ -613,6 +654,7 @@ def _worker_smoke(sink: _EventSink):
                       msg=f"{type(e).__name__}: {e}"[:300])
             _log(f"smoke {name} FAILED: {e}")
     sess.close()  # flush the event log
+    _write_diagnose_report("smoke")
 
 
 def _smoke_check(name, dev_res, exp):
@@ -691,6 +733,7 @@ def _worker_tpch(sink: _EventSink):
             _log(f"{name} FAILED: {e}")
     sink.emit(ev="meta", compile_cache=dict(cache_stats()))
     sess.close()  # flush the event log
+    _write_diagnose_report("tpch")
 
 
 def _worker_ablation(sink: _EventSink):

@@ -342,7 +342,8 @@ def _write_docs(path: Optional[str] = None) -> str:
                 "spark_rapids_tpu.io.csv", "spark_rapids_tpu.io.csv_device",
                 "spark_rapids_tpu.io.orc", "spark_rapids_tpu.io.dump",
                 "spark_rapids_tpu.tools.eventlog",
-                "spark_rapids_tpu.utils.tracing"):
+                "spark_rapids_tpu.utils.tracing",
+                "spark_rapids_tpu.utils.compile_cache"):
         try:
             importlib.import_module(mod)
         except Exception:

@@ -524,6 +524,8 @@ def _collect_segment(op: str, sv: jax.Array, slen, contrib: jax.Array,
 class TpuHashAggregateExec(TpuExec):
     """Same pre-projected input contract as CpuHashAggregateExec."""
 
+    EXTRA_METRICS = (M.AGG_TIME,)
+
     def __init__(self, child: PhysicalPlan, key_names: List[str],
                  specs: List[AggSpec], mode: str):
         super().__init__()
@@ -806,8 +808,10 @@ class TpuHashAggregateExec(TpuExec):
             if pending is None:
                 if not self.key_names:
                     empty = _empty_device_table(self.child.schema, 8)
+                    self.account_batch()
                     yield fn(empty)
                 return
+            self.account_batch()
             yield pending.get()
         finally:
             if pending is not None:

@@ -17,16 +17,39 @@ from typing import Callable, Iterator, Optional, Tuple
 from ..columnar.device import DeviceTable
 from ..columnar.host import HostTable
 from ..plan.physical import PhysicalPlan
-from ..utils.metrics import MetricRegistry
+from ..utils import metrics as M
+from ..utils.metrics import CORE_NODE_METRICS, MetricRegistry
 
 __all__ = ["TpuExec"]
 
 
 class TpuExec(PhysicalPlan):
-    """Columnar-only device operator."""
+    """Columnar-only device operator.
+
+    Every instance carries a ``MetricRegistry`` with the core metric set
+    (rows / batches / opTime — reference: the ESSENTIAL GpuMetric tier,
+    GpuExec.scala:44-60) pre-registered; subclasses declare additional
+    always-present metrics via ``EXTRA_METRICS``. The event-log writer and
+    the profiler snapshot this registry per (query, node) — the tier-1
+    metric-lint test enforces that concrete operators actually update it.
+    """
+
+    #: extra metric names a subclass guarantees to register (e.g. sortTime)
+    EXTRA_METRICS: tuple = ()
 
     def __init__(self):
         self.metrics = MetricRegistry()
+        for name in CORE_NODE_METRICS + tuple(type(self).EXTRA_METRICS):
+            self.metrics.metric(name)
+
+    def account_batch(self, rows=None) -> None:
+        """Fold one produced batch into the core metrics. ``rows`` must be a
+        HOST int when provided — passing a device scalar would force a sync
+        on the hot path, so operators only report rows where the count is
+        already host-resident (the profiler counts exact rows externally)."""
+        self.metrics.add(M.NUM_OUTPUT_BATCHES, 1)
+        if rows is not None:
+            self.metrics.add(M.NUM_OUTPUT_ROWS, int(rows))
 
     @property
     def num_partitions(self) -> int:

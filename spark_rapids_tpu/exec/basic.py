@@ -89,14 +89,14 @@ class TpuProjectExec(TpuExec):
                                             partition_id=pidx,
                                             batch_row_offset=offset)
                 offset += batch.capacity
-                self.metrics.add(M.NUM_OUTPUT_BATCHES, 1)
+                self.account_batch()
                 yield out
             return
         fn = cached_jit(self.plan_signature(), self.batch_fn)
         for batch in self.child_device_batches(pidx):
             with self.metrics.timed(M.OP_TIME):
                 out = fn(batch)
-            self.metrics.add(M.NUM_OUTPUT_BATCHES, 1)
+            self.account_batch()
             yield out
 
     def node_desc(self):
@@ -146,12 +146,14 @@ class TpuFilterExec(TpuExec):
                         keep = jnp.logical_and(keep, c.validity)
                     out = batch.filter_mask(keep)
                 offset += batch.capacity
+                self.account_batch()
                 yield out
             return
         fn = cached_jit(self.plan_signature(), self.batch_fn)
         for batch in self.child_device_batches(pidx):
             with self.metrics.timed(M.OP_TIME):
                 out = fn(batch)
+            self.account_batch()
             yield out
 
     def node_desc(self):
@@ -195,6 +197,7 @@ class TpuSampleExec(TpuExec):
                 batch = batch.compact()
                 out = fn(batch, jnp.int64(offset))
             offset += int(batch.num_rows)  # true rows: match host positions
+            self.account_batch()
             yield out
 
     def node_desc(self):
@@ -253,14 +256,14 @@ class TpuExpandExec(TpuExec):
                     out = parts[0] if len(parts) == 1 \
                         else concat_device_tables(parts)
                 offset += batch.capacity
-                self.metrics.add(M.NUM_OUTPUT_BATCHES, 1)
+                self.account_batch()
                 yield out
             return
         fn = cached_jit(self.plan_signature(), self.batch_fn)
         for batch in self.child_device_batches(pidx):
             with self.metrics.timed(M.OP_TIME):
                 out = fn(batch)
-            self.metrics.add(M.NUM_OUTPUT_BATCHES, 1)
+            self.account_batch()
             yield out
 
     def node_desc(self):
@@ -293,12 +296,14 @@ class TpuRangeExec(TpuExec):
         while pos < hi:
             n = min(self.max_batch_rows, hi - pos)
             from ..columnar.device import bucket_rows
-            cap = bucket_rows(max(n, 1), self.min_bucket)
-            iota = jnp.arange(cap, dtype=jnp.int64)
-            values = jnp.asarray(self.start, jnp.int64) \
-                + jnp.asarray(self.step, jnp.int64) * (iota + pos)
-            mask = iota < n
-            col = DeviceColumn(values, mask, dt.LONG, None)
+            with self.metrics.timed(M.OP_TIME):
+                cap = bucket_rows(max(n, 1), self.min_bucket)
+                iota = jnp.arange(cap, dtype=jnp.int64)
+                values = jnp.asarray(self.start, jnp.int64) \
+                    + jnp.asarray(self.step, jnp.int64) * (iota + pos)
+                mask = iota < n
+                col = DeviceColumn(values, mask, dt.LONG, None)
+            self.account_batch(rows=n)
             yield DeviceTable((col,), mask, jnp.asarray(n, jnp.int32), ("id",))
             pos += n
 
@@ -317,6 +322,7 @@ class TpuUnionExec(TpuExec):
         for c in self.children:
             if pidx < c.num_partitions:
                 for b in c.execute_columnar(pidx):
+                    self.account_batch()
                     yield DeviceTable(b.columns, b.row_mask, b.num_rows,
                                       tuple(self.schema.names))
                 return
@@ -348,6 +354,9 @@ class TpuLocalLimitExec(TpuExec):
         for batch in self.child_device_batches(pidx):
             if remaining <= 0:
                 return
-            out = take(batch, jnp.asarray(remaining, jnp.int32))
-            remaining -= int(out.num_rows)
+            with self.metrics.timed(M.OP_TIME):
+                out = take(batch, jnp.asarray(remaining, jnp.int32))
+            emitted = int(out.num_rows)
+            remaining -= emitted
+            self.account_batch(rows=emitted)
             yield out

@@ -105,12 +105,14 @@ class TpuCacheExec(TpuExec):
         if cached is not None:
             self.metrics.add("cacheHits", 1)
             for handle in cached:
+                self.account_batch()
                 yield handle.get()
             return
         from ..memory import SpillPriorities, get_catalog
         acc: List[DeviceTable] = []
         for b in self.child_device_batches(pidx):
             acc.append(b)
+            self.account_batch()
             yield b
         # register only after a full drain; an abandoned generator (e.g.
         # under a limit) must not leak catalog entries

@@ -82,6 +82,8 @@ def pad_table_capacity(table: DeviceTable, capacity: int) -> DeviceTable:
 class TpuShuffleExchangeExec(TpuExec):
     """Hash exchange as a mesh collective; output partition = mesh shard."""
 
+    EXTRA_METRICS = (M.SHUFFLE_BYTES,)
+
     def __init__(self, child: PhysicalPlan, partitioning: HashPartitioning,
                  mesh, min_bucket: int = 1024, axis: str = "dp",
                  chunk_rows: int = 1 << 19):
@@ -164,6 +166,7 @@ class TpuShuffleExchangeExec(TpuExec):
         catalog = get_catalog()
         with self.metrics.timed(M.OP_TIME):
             table = concat_device_tables(batches, self.min_bucket)
+            self.metrics.add(M.SHUFFLE_BYTES, table.nbytes())
             per_shard = bucket_rows(
                 max(1, -(-table.capacity // n)), self.min_bucket)
             table = pad_table_capacity(table, per_shard * n)
@@ -228,6 +231,8 @@ class TpuLocalExchangeExec(TpuExec):
     mirrors the reference's RapidsShuffleManager vs default-Spark-shuffle
     split (SURVEY §2.7; GpuShuffleExchangeExecBase.scala:146)."""
 
+    EXTRA_METRICS = (M.SHUFFLE_BYTES,)
+
     def __init__(self, child: PhysicalPlan, partitioning,
                  min_bucket: int = 1024):
         super().__init__()
@@ -266,9 +271,10 @@ class TpuLocalExchangeExec(TpuExec):
                     # columnar/device.py): post-filter / fused-partial-agg
                     # batches can be mostly masked slack — forwarding full
                     # capacity would inflate every downstream kernel
+                    shrunk = shrink_to_fit(b, self.min_bucket)
+                    self.metrics.add(M.SHUFFLE_BYTES, shrunk.nbytes())
                     h = catalog.register(
-                        shrink_to_fit(b, self.min_bucket),
-                        SpillPriorities.OUTPUT_FOR_SHUFFLE)
+                        shrunk, SpillPriorities.OUTPUT_FOR_SHUFFLE)
                 weakref.finalize(self, _close_quietly, h)
                 handles.append(h)
         self._handles = handles

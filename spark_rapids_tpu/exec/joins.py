@@ -650,6 +650,7 @@ class TpuShuffledHashJoinExec(TpuExec):
     HashedExistenceJoinIterator / buildSideTrackerOpt)."""
 
     SUPPORTED = ("inner", "left", "right", "full", "left_semi", "left_anti")
+    EXTRA_METRICS = (M.JOIN_TIME,)
 
     def __init__(self, left: PhysicalPlan, right: PhysicalPlan,
                  left_keys: Sequence[str], right_keys: Sequence[str],
@@ -794,6 +795,11 @@ class TpuShuffledHashJoinExec(TpuExec):
         return max(self.min_bucket, self.batch_bytes // max(row_bytes, 1))
 
     def execute_columnar(self, pidx: int) -> Iterator[DeviceTable]:
+        for out in self._join_batches(pidx):
+            self.account_batch()
+            yield out
+
+    def _join_batches(self, pidx: int) -> Iterator[DeviceTable]:
         build = self._build_table(pidx)
         if build.nbytes() > self.batch_bytes:
             yield from self._grace_join(build, pidx)
@@ -1257,6 +1263,7 @@ class TpuBroadcastNestedLoopJoinExec(TpuExec):
 
     SUPPORTED = ("inner", "cross", "left", "right", "full", "left_semi",
                  "left_anti")
+    EXTRA_METRICS = (M.JOIN_TIME,)
 
     def __init__(self, left: PhysicalPlan, right: PhysicalPlan, how: str,
                  condition: Optional[Expression], min_bucket: int = 1024,
@@ -1402,6 +1409,11 @@ class TpuBroadcastNestedLoopJoinExec(TpuExec):
                                                     self.min_bucket))
 
     def execute_columnar(self, pidx: int) -> Iterator[DeviceTable]:
+        for out in self._join_batches(pidx):
+            self.account_batch()
+            yield out
+
+    def _join_batches(self, pidx: int) -> Iterator[DeviceTable]:
         track = self.how in ("right", "full")
         if track and pidx != 0:
             return

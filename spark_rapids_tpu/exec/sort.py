@@ -99,6 +99,8 @@ class TpuTakeOrderedExec(TpuExec):
     concat with state, sort, truncate — state stays at a bucketed n-row
     capacity so the kernel shapes are stable across batches."""
 
+    EXTRA_METRICS = (M.SORT_TIME,)
+
     def __init__(self, child, orders: Sequence[SortOrder], n: int,
                  min_bucket: int = 1024):
         super().__init__()
@@ -148,6 +150,7 @@ class TpuTakeOrderedExec(TpuExec):
                     merged = concat_device_tables([state, top])
                     state = self._topn_fn(f"|cap{merged.capacity}")(merged)
         if state is not None:
+            self.account_batch()
             yield state
 
     def node_desc(self):
@@ -155,6 +158,8 @@ class TpuTakeOrderedExec(TpuExec):
 
 
 class TpuSortExec(TpuExec):
+    EXTRA_METRICS = (M.SORT_TIME,)
+
     def __init__(self, child: PhysicalPlan, orders: Sequence[SortOrder],
                  min_bucket: int = 1024,
                  batch_bytes: int = 512 * 1024 * 1024):
@@ -182,7 +187,9 @@ class TpuSortExec(TpuExec):
             table = concat_device_tables(batches) if len(batches) > 1 \
                 else batches[0]
             with self.metrics.timed(M.SORT_TIME):
-                yield self._sort_fn(f"|cap{table.capacity}")(table)
+                out = self._sort_fn(f"|cap{table.capacity}")(table)
+            self.account_batch()
+            yield out
             return
         yield from self._out_of_core(batches)
 
@@ -248,7 +255,7 @@ class TpuSortExec(TpuExec):
             if emit_n > 0:
                 out = drop_column(
                     sorted_m.filter_mask(iota < emit_n), _SENT)
-                self.metrics.add(M.NUM_OUTPUT_ROWS, emit_n)
+                self.account_batch(rows=emit_n)
                 yield shrink_to_fit(out, self.min_bucket)
             rest_mask = jnp.logical_and(
                 iota >= emit_n, jnp.logical_not(sorted_m.column(_SENT).data))

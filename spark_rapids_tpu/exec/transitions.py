@@ -111,6 +111,8 @@ def _hook_oom() -> None:
 
 
 class HostToDeviceExec(TpuExec):
+    EXTRA_METRICS = (M.UPLOAD_TIME, M.UPLOAD_BYTES, M.UPLOAD_CACHE_HITS)
+
     def __init__(self, child: PhysicalPlan, min_bucket: int = 1024,
                  cache_max_bytes: int = 0):
         super().__init__()
@@ -125,7 +127,9 @@ class HostToDeviceExec(TpuExec):
         if not self.cache_max_bytes:
             with get_tracer().span("h2d_upload", "upload",
                                    rows=int(batch.num_rows)):
-                return DeviceTable.from_host(batch, self.min_bucket)
+                dtb = DeviceTable.from_host(batch, self.min_bucket)
+            self.metrics.add(M.UPLOAD_BYTES, dtb.nbytes())
+            return dtb
         key = id(batch)
         with _UPLOAD_LOCK:
             entry = _UPLOAD_CACHE.get(key)
@@ -141,6 +145,7 @@ class HostToDeviceExec(TpuExec):
                                rows=int(batch.num_rows)):
             dtb = DeviceTable.from_host(batch, self.min_bucket)
         nbytes = dtb.nbytes()
+        self.metrics.add(M.UPLOAD_BYTES, nbytes)
         cached = False
         with _UPLOAD_LOCK:
             if _CACHED_BYTES + nbytes <= self.cache_max_bytes:
@@ -198,6 +203,9 @@ class DeviceToHostExec(PhysicalPlan):
                     get_tracer().span("d2h_download", "download",
                                       rows=int(batch.num_rows)):
                 ht = batch.to_host()
+            self.metrics.add(M.DOWNLOAD_BYTES, batch.nbytes())
+            self.metrics.add(M.NUM_OUTPUT_BATCHES, 1)
+            self.metrics.add(M.NUM_OUTPUT_ROWS, ht.num_rows)
             yield ht
 
 

@@ -179,7 +179,9 @@ class TpuWindowExec(TpuExec):
         table = concat_device_tables(batches) if len(batches) > 1 else batches[0]
         fn = cached_jit(self.plan_signature(), self._kernel)
         with self.metrics.timed(M.OP_TIME):
-            yield fn(table)
+            out = fn(table)
+        self.account_batch()
+        yield out
 
 
 def _window_column(scratch: DeviceTable, w: WindowExpression,

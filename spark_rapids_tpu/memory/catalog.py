@@ -198,6 +198,14 @@ class BufferCatalog:
 
     def _spill_one(self, stored: StoredTable):
         from ..utils.tracing import get_tracer
+        # attribute the spilled bytes to whichever operator is executing
+        # (instrumented runs only): the spill fires on behalf of that node's
+        # allocation even though its victim may belong to another node
+        from ..utils.node_context import current_registry
+        reg = current_registry()
+        if reg is not None:
+            from ..utils.metrics import SPILL_BYTES
+            reg.add(SPILL_BYTES, stored.size_bytes)
         with get_tracer().span("spill", "spill", bytes=stored.size_bytes,
                                buffer=stored.buffer_id):
             self._spill_one_inner(stored)

@@ -1,0 +1,48 @@
+"""Version-portable ``shard_map`` (the per-shard SPMD entry point).
+
+jax moved ``shard_map`` out of ``jax.experimental`` and renamed its
+replication-check keyword along the way:
+
+- old jax: ``jax.experimental.shard_map.shard_map(..., check_rep=...)``
+- new jax: ``jax.shard_map(..., check_vma=...)``
+
+The engine's collectives (shuffle/ici.py all-to-all exchange, the driver
+dry run) must disable the replication checker — the exchange's output specs
+are data-dependent in ways the static checker rejects — so the keyword has
+to be spelled per version. ``shard_map`` below resolves the import path and
+the keyword once at import time; call it with ``check=False`` and forget
+which jax is installed.
+"""
+from __future__ import annotations
+
+import inspect
+
+__all__ = ["shard_map"]
+
+try:
+    from jax import shard_map as _shard_map  # jax >= 0.6
+except ImportError:  # pragma: no cover - depends on installed jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+# the replication/varying-manual-axes check kwarg: check_vma on new jax,
+# check_rep before the rename; probe the signature instead of the version
+# string (backports exist)
+_PARAMS = inspect.signature(_shard_map).parameters
+if "check_vma" in _PARAMS:
+    _CHECK_KW = "check_vma"
+elif "check_rep" in _PARAMS:
+    _CHECK_KW = "check_rep"
+else:  # pragma: no cover - future jax dropped the knob entirely
+    _CHECK_KW = None
+
+
+def shard_map(f, mesh, in_specs, out_specs, check: bool = True):
+    """Map ``f`` over shards of ``mesh`` (jax.shard_map across versions).
+
+    ``check=False`` disables the output-replication checker under whichever
+    keyword the installed jax spells it."""
+    kwargs = {}
+    if not check and _CHECK_KW is not None:
+        kwargs[_CHECK_KW] = False
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      **kwargs)

@@ -738,7 +738,9 @@ def _register_reader_rules():
         def execute_columnar(self, pidx: int):
             parts = self.groups[pidx] if self.groups is not None else [pidx]
             for p in parts:
-                yield from self.stage.execute_columnar(p)
+                for b in self.stage.execute_columnar(p):
+                    self.account_batch()
+                    yield b
 
         def node_desc(self) -> str:
             return self.stage.node_desc()

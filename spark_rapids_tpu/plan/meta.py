@@ -19,7 +19,7 @@ from .physical import PhysicalPlan
 
 __all__ = ["ExprMeta", "ExecMeta", "ExprRule", "ExecRule",
            "EXPR_RULES", "EXEC_RULES", "register_expr_rule",
-           "register_exec_rule", "wrap_plan"]
+           "register_exec_rule", "wrap_plan", "render_analyzed_plan"]
 
 
 class BaseMeta:
@@ -224,6 +224,113 @@ def wrap_plan_node(p: PhysicalPlan) -> ExecMeta:
 
 def wrap_plan(p: PhysicalPlan) -> ExecMeta:
     return wrap_plan_node(p)
+
+
+# ---------------------------------------------------------------------------
+# EXPLAIN ANALYZE rendering: the POST-OVERRIDE plan tree (what actually
+# executed — device execs, transitions, whole-stage fusions) annotated with
+# each node's runtime stats and % of query wall. The reference only tags
+# plans pre-execution (ExplainPlan); pairing the tree with measured
+# NodeStats is what makes a 0.5x-geomean regression attributable.
+# ---------------------------------------------------------------------------
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024.0
+    return f"{n:.1f}GiB"
+
+
+def _fmt_metric(name: str, v) -> Optional[str]:
+    from ..utils import metrics as M
+    if isinstance(v, dict):  # histogram summary: show the median only
+        p50 = v.get("p50")
+        return f"{name}.p50={p50:.0f}" if p50 is not None else None
+    if name in M.TIME_METRICS:
+        return f"{name}={v:.4f}s"
+    if name in M.BYTE_METRICS:
+        return f"{name}={_fmt_bytes(v)}"
+    return f"{name}={v}"
+
+
+def render_analyzed_plan(nodes, total_s: float, kernels=None) -> str:
+    """Annotate an executed plan tree with runtime metrics.
+
+    ``nodes`` are profiler NodeStats (or event-log node dicts with the same
+    keys): name/desc/depth/node_id/parent_id/wall_s/rows/batches/metrics.
+    Percentages use SELF time (wall minus direct children), so they sum to
+    at most 100% across the tree."""
+    from ..tools.profiler import compute_self_times
+    rows = [_as_node_dict(n) for n in nodes]
+    self_s = compute_self_times(rows)
+    covered = 0.0
+    for n in rows:
+        n["self_s"] = self_s[n["node_id"]]
+        covered += n["self_s"]
+    pct_cov = 100.0 * covered / total_s if total_s > 0 else 0.0
+    lines = ["== Physical Plan (EXPLAIN ANALYZE) ==",
+             f"query wall {total_s:.4f}s; {len(rows)} operators, "
+             f"self times cover {pct_cov:.0f}% of wall", ""]
+    from ..utils import metrics as M
+    for n in rows:
+        pct = 100.0 * n["self_s"] / total_s if total_s > 0 else 0.0
+        pad = "  " * n["depth"]
+        desc = f" [{n['desc'][:48]}]" if n.get("desc") else ""
+        lines.append(f"{pad}{n['name']}{desc}")
+        detail = (f"wall {n['wall_s']:.4f}s  self {n['self_s']:.4f}s "
+                  f"({pct:.1f}%)  rows {n['rows']}  batches {n['batches']}")
+        extras = []
+        metrics = n.get("metrics") or {}
+        order = [M.OP_TIME, M.SORT_TIME, M.AGG_TIME, M.JOIN_TIME,
+                 M.UPLOAD_TIME, M.UPLOAD_BYTES, M.DOWNLOAD_TIME,
+                 M.DOWNLOAD_BYTES, M.SHUFFLE_BYTES,
+                 M.SHUFFLE_PARTITION_TIME, M.COMPILE_TIME,
+                 M.COMPILE_CACHE_HITS, M.COMPILE_CACHE_MISSES,
+                 M.SPILL_BYTES]
+        seen = set()
+        for key in order:
+            if key in metrics:
+                seen.add(key)
+                s = _fmt_metric(key, metrics[key])
+                if s:
+                    extras.append(s)
+        for key in sorted(metrics):
+            if key not in seen and key not in (M.NUM_OUTPUT_ROWS,
+                                               M.NUM_OUTPUT_BATCHES,
+                                               M.BATCH_ROWS_HISTOGRAM):
+                s = _fmt_metric(key, metrics[key])
+                if s:
+                    extras.append(s)
+        lines.append(f"{pad}    {detail}")
+        if extras:
+            lines.append(f"{pad}    " + "  ".join(extras))
+    if kernels:
+        lines.append("")
+        lines.append("== XLA kernels (this query) ==")
+        for k in sorted(kernels, key=lambda e: -e.get("compile_s", 0.0))[:8]:
+            cost = k.get("cost") or {}
+            bits = [f"compile {k.get('compile_s', 0.0):.3f}s",
+                    f"hits {k.get('hits', 0)}"]
+            if k.get("node_name"):
+                bits.append(f"node {k['node_name']}")
+            if "flops" in cost:
+                bits.append(f"flops {cost['flops']:.3g}")
+            if "bytes accessed" in cost:
+                bits.append(f"bytes {_fmt_bytes(cost['bytes accessed'])}")
+            mem = k.get("memory") or {}
+            if "temp_bytes" in mem:
+                bits.append(f"temp {_fmt_bytes(mem['temp_bytes'])}")
+            lines.append(f"  {k['signature'][:72]:<74}" + "  ".join(bits))
+    return "\n".join(lines)
+
+
+def _as_node_dict(n) -> dict:
+    if isinstance(n, dict):
+        return dict(n)
+    return {"name": n.name, "desc": n.desc, "depth": n.depth,
+            "node_id": n.node_id, "parent_id": n.parent_id,
+            "wall_s": n.wall_s, "rows": n.rows, "batches": n.batches,
+            "metrics": getattr(n, "metrics", {}) or {}}
 
 
 def _replace_children(plan: PhysicalPlan, children: List[PhysicalPlan]) -> PhysicalPlan:

@@ -610,11 +610,16 @@ class ShuffleExchangeExec(PhysicalPlan):
     """
 
     def __init__(self, child: PhysicalPlan, partitioning: Partitioning):
+        from ..utils.metrics import MetricRegistry
         self.child = child
         self.children = (child,)
         self.partitioning = partitioning
         self.schema = child.schema
         self._materialized: Optional[List[List[HostTable]]] = None
+        # host-tier shuffles are the single largest single-chip overhead
+        # (download-partition-upload); the registry makes that visible to
+        # EXPLAIN ANALYZE / the diagnose tool per node
+        self.metrics = MetricRegistry()
 
     @property
     def num_partitions(self) -> int:
@@ -636,12 +641,18 @@ class ShuffleExchangeExec(PhysicalPlan):
         else:
             inputs = None
         out: List[List[HostTable]] = [[] for _ in range(self.num_partitions)]
+        from ..utils import metrics as M
+
         def feed(batch: HostTable):
-            pids = self.partitioning.partition_indices(batch)
-            for p in range(self.num_partitions):
-                sel = np.nonzero(pids == p)[0]
-                if len(sel):
-                    out[p].append(batch.take(sel))
+            with self.metrics.timed(M.SHUFFLE_PARTITION_TIME):
+                self.metrics.add(M.SHUFFLE_BYTES, batch.nbytes())
+                self.metrics.add(M.NUM_OUTPUT_ROWS, batch.num_rows)
+                pids = self.partitioning.partition_indices(batch)
+                for p in range(self.num_partitions):
+                    sel = np.nonzero(pids == p)[0]
+                    if len(sel):
+                        out[p].append(batch.take(sel))
+                        self.metrics.add(M.NUM_OUTPUT_BATCHES, 1)
         if inputs is not None:
             for b in inputs:
                 feed(b)

@@ -45,6 +45,10 @@ class TpuSession:
         # every subsystem land in one ring buffer; close() can export it)
         from .utils.tracing import configure_tracer
         configure_tracer(self.conf)
+        # apply spark.rapids.tpu.metrics.* to the compile cache's kernel
+        # table (XLA cost/memory introspection depth)
+        from .utils.compile_cache import configure_introspection
+        configure_introspection(self.conf)
         TpuSession._active = self
 
     # -- device mesh (accelerated shuffle tier) ------------------------------
@@ -556,6 +560,18 @@ class DataFrame:
         return t.column("n")[0].as_py()
 
     def explain(self, mode: str = "plan") -> str:
+        if mode == "analyze":
+            # EXPLAIN ANALYZE: EXECUTE the query under instrumentation and
+            # render the post-override plan annotated with each node's
+            # runtime metrics and % of query wall (reference: tagging-only
+            # ExplainPlan; the measured analogue is ours to provide)
+            from .plan.meta import render_analyzed_plan
+            from .tools.profiler import profile_query
+            prof = profile_query(self)
+            text = render_analyzed_plan(prof.nodes, prof.total_s,
+                                        kernels=prof.kernels)
+            print(text)
+            return text
         cpu = plan_physical(self.logical, self.session.conf)
         if mode == "tpu":
             text = explain_plan(cpu, self.session.conf)

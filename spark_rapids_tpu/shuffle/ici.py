@@ -23,12 +23,9 @@ from typing import List
 import jax
 import jax.numpy as jnp
 
-try:
-    from jax import shard_map
-except ImportError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map
-
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.shard_compat import shard_map
 
 from ..columnar.device import (DeviceColumn, DeviceTable,
                                stable_counting_order)
@@ -112,7 +109,7 @@ def ici_all_to_all_exchange(table: DeviceTable, key_names: List[str],
 
     col_specs = jax.tree_util.tree_map(lambda _: P(axis), table.columns)
     fn = jax.jit(shard_map(local, mesh=mesh, in_specs=(col_specs, P(axis)),
-                           out_specs=(col_specs, P(axis)), check_vma=False))
+                           out_specs=(col_specs, P(axis)), check=False))
     out_cols, mask = fn(table.columns, table.row_mask)
     total = jnp.sum(mask, dtype=jnp.int32)
     return DeviceTable(tuple(out_cols), mask, total, names)

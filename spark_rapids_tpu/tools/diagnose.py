@@ -1,0 +1,305 @@
+"""Auto-diagnosis: ranked bottleneck report + conf suggestions.
+
+Reference: the plugin's AutoTuner (tools/.../tuning/AutoTuner.scala) mines
+profiling output into concrete ``spark.rapids.*`` recommendations. Here the
+input is our own event log (tools/eventlog.py, schema v3): per-node wall
+times and metric snapshots, kernel records (XLA compile wall + cost
+analysis per plan signature), and per-query process-counter deltas. The
+output names, for every query, the top bottleneck (node, metric) pairs —
+"q1: 61% in ShuffleExchangeExec host serialization" — each with the conf
+knob that addresses it.
+
+CLI::
+
+    python -m spark_rapids_tpu.tools.diagnose <eventlog.jsonl | dir> \
+        [--top N] [--json] [--out report.txt]
+
+Programmatic: ``diagnose_path(path)`` / ``diagnose_app(AppReplay)`` return
+a ``DiagnoseReport`` (``.summary()``, ``.to_json()``, ``.findings``).
+"""
+from __future__ import annotations
+
+import dataclasses
+import glob
+import json
+import os
+from typing import Dict, List, Optional
+
+from ..utils import metrics as M
+
+__all__ = ["Finding", "QueryDiagnosis", "DiagnoseReport", "diagnose_app",
+           "diagnose_path", "main"]
+
+# minimum share of query wall before a signal counts as a bottleneck
+_FRACTION_FLOOR = 0.02
+
+
+@dataclasses.dataclass
+class Finding:
+    """One (node, metric) bottleneck with an actionable suggestion."""
+    node: str              # operator name, or "(query)" for query-level
+    node_id: Optional[int]
+    metric: str            # which signal ranked it (wall, xlaCompileTime...)
+    seconds: float         # attributed seconds (0 when not time-based)
+    fraction: float        # share of query wall, ranking key
+    detail: str
+    suggestion: str
+
+    def to_dict(self) -> Dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class QueryDiagnosis:
+    query_id: int
+    wall_s: float
+    findings: List[Finding]
+
+    def top(self, n: int = 3) -> List[Finding]:
+        return self.findings[:n]
+
+
+class DiagnoseReport:
+    def __init__(self, path: str, queries: List[QueryDiagnosis]):
+        self.path = path
+        self.queries = queries
+
+    def summary(self, top: int = 3) -> str:
+        lines = [f"== diagnose: {os.path.basename(self.path)} =="]
+        if not self.queries:
+            lines.append("no completed queries in the log")
+        for q in self.queries:
+            lines.append(f"q{q.query_id} (wall {q.wall_s:.4f}s) "
+                         f"top bottlenecks:")
+            if not q.findings:
+                lines.append("  no signal above the reporting floor")
+            for rank, f in enumerate(q.top(top), 1):
+                pct = f"{100.0 * f.fraction:.0f}%"
+                lines.append(f"  {rank}. ({f.node}, {f.metric}) {pct} of "
+                             f"wall — {f.detail}")
+                lines.append(f"     suggest: {f.suggestion}")
+        return "\n".join(lines)
+
+    def to_json(self, top: int = 3) -> str:
+        return json.dumps({
+            "path": self.path,
+            "queries": [{
+                "query_id": q.query_id, "wall_s": q.wall_s,
+                "findings": [f.to_dict() for f in q.top(top)],
+            } for q in self.queries],
+        }, indent=1)
+
+
+
+
+def _node_suggestion(name: str, metrics: Dict) -> str:
+    """Knob for a node whose SELF time dominates, by operator family."""
+    if "ShuffleExchange" in name and not name.startswith("Tpu"):
+        return ("host-staged shuffle serializes through the CPU — attach a "
+                "device mesh (spark.rapids.tpu.shuffle.mode=ici) or keep "
+                "single-chip exchanges device-resident (mode=local)")
+    if "LocalExchange" in name or "TpuShuffleExchange" in name:
+        return ("device exchange dominates — raise spark.rapids.tpu."
+                "shuffle.exchangeChunkRows to amortize collectives, or "
+                "lower spark.rapids.tpu.shuffle.partitions")
+    if "HostToDevice" in name:
+        return ("upload-bound — enable spark.rapids.tpu.scan.deviceCache."
+                "enabled / raise its maxBytes so repeated scans stay "
+                "device-resident; larger spark.rapids.sql.batchSizeBytes "
+                "amortizes per-batch upload overhead")
+    if "DeviceToHost" in name:
+        return ("download-bound — keep more of the plan on device (check "
+                "explain('tpu') for fallback reasons above this node)")
+    if name.startswith("Cpu") or "ArrowEval" in name:
+        return ("operator fell back to the host engine — run "
+                "df.explain('tpu') and address its NOT_ON_GPU reasons, or "
+                "accept the fallback")
+    if "Sort" in name:
+        return ("sort-bound — raise spark.rapids.sql.batchSizeBytes to "
+                "stay in the single-batch sort path, or pre-partition so "
+                "each partition sorts less data")
+    if "Join" in name:
+        return ("join-bound — check the build side fits the batch budget "
+                "(spark.rapids.sql.batchSizeBytes); a broadcast-eligible "
+                "small side avoids the shuffle entirely")
+    if "Aggregate" in name:
+        return ("aggregate-bound — more input partitions parallelize the "
+                "partial pass; check batchRows histograms for tiny batches")
+    return ("dominant operator — profile_query(df) / df.explain('analyze') "
+            "for its per-batch breakdown")
+
+
+def _diagnose_query(q) -> Optional[QueryDiagnosis]:
+    wall = getattr(q, "wall_s", 0.0)
+    if wall <= 0 or getattr(q, "error", None):
+        return None
+    from .profiler import compute_self_times
+    nodes = q.nodes
+    findings: List[Finding] = []
+    self_s = compute_self_times(nodes)
+
+    # 1. per-node self wall time — the primary (node, metric) ranking
+    for n in nodes:
+        s = self_s.get(n["node_id"], 0.0)
+        frac = s / wall
+        if frac < _FRACTION_FLOOR:
+            continue
+        metrics = n.get("metrics") or {}
+        findings.append(Finding(
+            node=n["name"], node_id=n["node_id"], metric="wall",
+            seconds=s, fraction=frac,
+            detail=f"self time {s:.4f}s over {n.get('batches', 0)} batches "
+                   f"/ {n.get('rows', 0)} rows",
+            suggestion=_node_suggestion(n["name"], metrics)))
+
+    # 2. per-node attributed signals from the metric snapshots
+    for n in nodes:
+        metrics = n.get("metrics") or {}
+        for key, label, suggest in (
+            (M.COMPILE_TIME, "XLA compile",
+             "warm the query first or enable the persistent compilation "
+             "cache (jax_compilation_cache_dir); recurring compiles mean "
+             "shape churn — raise spark.rapids.tpu.batchRowsMinBucket"),
+            (M.UPLOAD_TIME, "host->device upload",
+             "enable/raise spark.rapids.tpu.scan.deviceCache.* so "
+             "re-scanned batches skip the upload"),
+            (M.DOWNLOAD_TIME, "device->host download",
+             "keep downstream operators on device (check explain('tpu'))"),
+            (M.SHUFFLE_PARTITION_TIME, "host shuffle partitioning",
+             "attach a mesh (spark.rapids.tpu.shuffle.mode=ici) or force "
+             "the device-local tier (mode=local)"),
+        ):
+            v = metrics.get(key, 0.0)
+            if isinstance(v, dict):
+                continue
+            frac = v / wall
+            if frac >= _FRACTION_FLOOR:
+                findings.append(Finding(
+                    node=n["name"], node_id=n["node_id"], metric=key,
+                    seconds=v, fraction=frac,
+                    detail=f"{label} {v:.4f}s inside this node",
+                    suggestion=suggest))
+        spilled = metrics.get(M.SPILL_BYTES, 0)
+        if not isinstance(spilled, dict) and spilled:
+            findings.append(Finding(
+                node=n["name"], node_id=n["node_id"], metric=M.SPILL_BYTES,
+                seconds=0.0, fraction=_FRACTION_FLOOR,
+                detail=f"{spilled} bytes spilled while this node ran",
+                suggestion="raise spark.rapids.memory.gpu.allocFraction or "
+                           "lower spark.rapids.sql.batchSizeBytes"))
+
+    # 3. recompile churn from the kernel table: many unique signatures
+    # landing on one operator = per-shape recompiles
+    per_node: Dict[str, List[Dict]] = {}
+    for k in getattr(q, "kernels", []):
+        name = k.get("node_name") or "(unattributed)"
+        per_node.setdefault(name, []).append(k)
+    for name, entries in per_node.items():
+        compiles = sum(e.get("compiles", 0) for e in entries)
+        compile_s = sum(e.get("compile_s", 0.0) for e in entries)
+        frac = compile_s / wall
+        if compiles >= 4 and frac >= _FRACTION_FLOOR:
+            findings.append(Finding(
+                node=name, node_id=entries[0].get("node_id"),
+                metric="recompiles", seconds=compile_s, fraction=frac,
+                detail=f"dominated by recompiles: {len(entries)} unique "
+                       f"signatures / {compiles} compiles "
+                       f"({compile_s:.2f}s) for 1 operator",
+                suggestion="shape-bucket churn — raise spark.rapids.tpu."
+                           "batchRowsMinBucket so batch capacities collapse "
+                           "onto fewer buckets"))
+
+    # 4. query-level process-counter deltas (v2-compatible: works without
+    # node metrics or kernel records)
+    stats = getattr(q, "stats", {}) or {}
+    compile_s = stats.get("compile_cache_compile_seconds", 0.0)
+    if compile_s / wall >= 0.3:
+        findings.append(Finding(
+            node="(query)", node_id=None, metric="xlaCompileSeconds",
+            seconds=compile_s, fraction=compile_s / wall,
+            detail=f"XLA compile is {compile_s / wall:.0%} of wall — cold "
+                   "compile cache",
+            suggestion="warm up once per session or persist compiles "
+                       "(jax_compilation_cache_dir)"))
+    sem_wait = getattr(q, "semaphore_wait_s", 0.0)
+    if sem_wait / wall >= 0.25:
+        findings.append(Finding(
+            node="(query)", node_id=None, metric=M.SEMAPHORE_WAIT_TIME,
+            seconds=sem_wait, fraction=sem_wait / wall,
+            detail=f"semaphore wait is {sem_wait / wall:.0%} of wall — "
+                   "tasks serialized on the chip",
+            suggestion="raise spark.rapids.sql.concurrentGpuTasks or lower "
+                       "task parallelism"))
+    if any((getattr(q, "spill_count", {}) or {}).values()):
+        findings.append(Finding(
+            node="(query)", node_id=None, metric="spills",
+            seconds=0.0, fraction=_FRACTION_FLOOR,
+            detail=f"device memory pressure (spills {q.spill_count})",
+            suggestion="raise spark.rapids.memory.gpu.allocFraction, lower "
+                       "spark.rapids.sql.batchSizeBytes, or raise "
+                       "spark.rapids.memory.host.spillStorageSize"))
+
+    findings.sort(key=lambda f: -f.fraction)
+    return QueryDiagnosis(q.query_id, wall, findings)
+
+
+def diagnose_app(app, path: str = "") -> DiagnoseReport:
+    """Diagnose a loaded AppReplay (tools/eventlog.py)."""
+    queries = []
+    for qid in sorted(app.queries):
+        d = _diagnose_query(app.queries[qid])
+        if d is not None:
+            queries.append(d)
+    return DiagnoseReport(path or getattr(app, "path", ""), queries)
+
+
+def diagnose_path(path: str) -> DiagnoseReport:
+    """Diagnose one event-log JSONL file."""
+    from .eventlog import load_event_log
+    return diagnose_app(load_event_log(path), path)
+
+
+def _expand_paths(args: List[str]) -> List[str]:
+    out: List[str] = []
+    for a in args:
+        if os.path.isdir(a):
+            out.extend(sorted(glob.glob(os.path.join(a, "*.jsonl"))))
+        else:
+            out.append(a)
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="python -m spark_rapids_tpu.tools.diagnose",
+        description="Ranked bottleneck report from an event log "
+                    "(AutoTuner analogue)")
+    ap.add_argument("paths", nargs="+",
+                    help="event-log .jsonl file(s) or directories of them")
+    ap.add_argument("--top", type=int, default=3,
+                    help="findings reported per query (default 3)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit machine-readable JSON instead of text")
+    ap.add_argument("--out", default="",
+                    help="also write the report to this file")
+    ns = ap.parse_args(argv)
+    paths = _expand_paths(ns.paths)
+    if not paths:
+        print("no event logs found")
+        return 2
+    chunks = []
+    for p in paths:
+        rep = diagnose_path(p)
+        chunks.append(rep.to_json(ns.top) if ns.json
+                      else rep.summary(ns.top))
+    text = "\n\n".join(chunks)
+    print(text)
+    if ns.out:
+        with open(ns.out, "w", encoding="utf-8") as f:
+            f.write(text + "\n")
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

@@ -10,12 +10,21 @@ file per session (``spark.rapids.tpu.eventLog.dir``), one record per event:
 - ``query_start``: query id + plan tree
 - ``node``: one per physical operator — name/desc/depth/parent, wall time,
   rows/batches, first/last activity offsets, operator metrics snapshot
-- ``query_end``: wall time, spill/semaphore deltas, AQE events
+  (schema v3: the snapshot carries the per-node byte/compile/spill
+  attribution — upload/download bytes, shuffle bytes, xla cache hits and
+  misses, compile seconds, spill bytes)
+- ``kernel`` (schema v3): one per XLA program the query touched — plan
+  signature, owning node, compile wall, HLO cost / memory analysis
+  (utils/compile_cache.py kernel table)
+- ``query_end``: wall time, spill/semaphore deltas, AQE events, per-query
+  process-counter deltas
 - ``app_end``
 
 ``load_event_log`` replays a file into ``AppReplay``: per-query summaries,
 aggregated operator hot list, HealthCheck warnings, a timeline SVG, and a
 plan DOT graph — the Profiler.scala report set, rebuilt from our log.
+``tools/diagnose.py`` consumes the same replay for the ranked bottleneck
+report (the AutoTuner analogue).
 """
 from __future__ import annotations
 
@@ -33,7 +42,7 @@ __all__ = ["EventLogWriter", "load_event_log", "AppReplay", "QueryReplay",
 # docs/observability.md; tests/test_observability.py pins the current value
 # and the per-record required-key sets so replay/compare tooling can rely
 # on old logs staying loadable.
-SCHEMA_VERSION = 2
+SCHEMA_VERSION = 3
 
 EVENT_LOG_DIR = register_conf(
     "spark.rapids.tpu.eventLog.dir",
@@ -67,6 +76,7 @@ class EventLogWriter:
         """Instrument ``plan``, run ``collect_fn()``, persist the events."""
         from ..memory.catalog import get_catalog
         from ..memory.semaphore import get_semaphore
+        from ..utils.compile_cache import kernel_seq, kernels_since
         from ..utils.metrics import StatsRegistry, get_stats
         from ..utils.tracing import get_tracer
         from .profiler import instrument_plan
@@ -79,15 +89,17 @@ class EventLogWriter:
             # AQE finalizes lazily: each stage segment + the final segment
             # get instrumented as the adaptive loop creates them
             plan._instrument_hook = \
-                lambda p: instrument_plan(p, epoch, into=stats)
+                lambda p: instrument_plan(p, epoch, into=stats,
+                                          query_id=qid)
         else:
-            instrument_plan(plan, epoch, into=stats)
+            instrument_plan(plan, epoch, into=stats, query_id=qid)
         cat = get_catalog()
         sem = get_semaphore()
         registry = get_stats()
         spill_before = dict(cat.spill_count)
         wait_before = sem.total_wait_time
         counters_before = registry.collect()
+        kseq_before = kernel_seq()
         self.write({"event": "query_start", "query_id": qid,
                     "ts": time.time(), "plan": plan.tree_string()})
         t0 = time.perf_counter()
@@ -109,6 +121,14 @@ class EventLogWriter:
                         "batches": ns.batches, "t_first": ns.t_first,
                         "t_last": ns.t_last,
                         "metrics": _node_metrics(ns)})
+        # schema v3: one kernel record per XLA program this query touched
+        # (compile wall + cost/memory analysis keyed back to node ids)
+        for entry in kernels_since(kseq_before):
+            entry.pop("last_touch", None)
+            # the record's query_id is THIS query (the entry's own
+            # query_id field records where the program first compiled)
+            self.write({**entry, "event": "kernel", "query_id": qid,
+                        "first_query_id": entry.get("query_id")})
         aqe_events: List[str] = list(getattr(plan, "events", []))
         self.write({
             "event": "query_end", "query_id": qid, "ts": time.time(),
@@ -131,11 +151,10 @@ class EventLogWriter:
 
 
 def _node_metrics(ns) -> Dict:
-    """Snapshot the live node's operator metrics (TpuExec registries)."""
-    reg = getattr(getattr(ns, "_node", None), "metrics", None)
-    snap = reg.snapshot() if reg is not None and hasattr(reg, "snapshot") \
-        else {}
-    return {k: v for k, v in snap.items() if v}
+    """Snapshot the live node's operator metrics (TpuExec registries) —
+    the same rule QueryProfile uses (tools/profiler.py)."""
+    from .profiler import registry_snapshot
+    return registry_snapshot(getattr(ns, "_node", None))
 
 
 # ---------------------------------------------------------------------------
@@ -149,6 +168,7 @@ class QueryReplay:
         self.wall_s: float = 0.0
         self.error: Optional[str] = None
         self.nodes: List[Dict] = []
+        self.kernels: List[Dict] = []  # v3: per-XLA-program records
         self.aqe_events: List[str] = []
         self.spill_count: Dict = {}
         self.semaphore_wait_s: float = 0.0
@@ -284,6 +304,10 @@ def load_event_log(path: str) -> AppReplay:
                 q = app.queries.setdefault(rec["query_id"],
                                            QueryReplay(rec["query_id"]))
                 q.nodes.append(rec)
+            elif ev == "kernel":
+                q = app.queries.setdefault(rec["query_id"],
+                                           QueryReplay(rec["query_id"]))
+                q.kernels.append(rec)
             elif ev == "query_end":
                 q = app.queries.setdefault(rec["query_id"],
                                            QueryReplay(rec["query_id"]))

@@ -20,11 +20,15 @@ import functools
 import sys
 import threading
 import time
-from typing import Callable, Dict
+from typing import Callable, Dict, List, Optional
 
 import jax
 
-__all__ = ["cached_jit", "cache_stats", "clear_cache", "oom_retry"]
+from ..conf import register_conf
+
+__all__ = ["cached_jit", "cache_stats", "clear_cache", "oom_retry",
+           "configure_introspection", "kernel_table", "kernel_seq",
+           "kernels_since", "XLA_INTROSPECTION", "KERNEL_TABLE_SIZE"]
 
 _CACHE: Dict[str, Callable] = {}
 _LOCK = threading.Lock()
@@ -32,6 +36,152 @@ _HITS = 0
 _MISSES = 0
 _COMPILES = 0
 _COMPILE_SECONDS = 0.0
+
+# ---------------------------------------------------------------------------
+# Kernel table: one row per cache entry (= per XLA program), keyed by the
+# plan signature and attributed back to the exec node that requested it
+# (utils/node_context.py — pushed by the profiler/event-log
+# instrumentation). Flushed into event-log schema v3 ``kernel`` records and
+# mined by tools/diagnose.py ("q6 dominated by recompiles: N unique
+# signatures for 1 operator"). Flare's lesson applies: inspect what the
+# compiler actually generated instead of guessing.
+# ---------------------------------------------------------------------------
+XLA_INTROSPECTION = register_conf(
+    "spark.rapids.tpu.metrics.xlaIntrospection",
+    "What the compile cache captures about each XLA program into the "
+    "kernel table: 'off' records only compile wall/hit counts; 'lowered' "
+    "(default) additionally runs HLO cost analysis on the lowered module "
+    "(flops / bytes accessed — one cheap retrace per unique program, no "
+    "extra XLA compile); 'compiled' also AOT-compiles the captured input "
+    "shapes for memory_analysis() (argument/output/temp bytes) — one "
+    "EXTRA compile per unique program, meant for offline analysis runs.",
+    "lowered",
+    checker=lambda v: None if str(v).lower() in ("off", "lowered",
+                                                 "compiled")
+    else f"must be one of off/lowered/compiled, got {v!r}")
+
+KERNEL_TABLE_SIZE = register_conf(
+    "spark.rapids.tpu.metrics.kernelTableSize",
+    "Max kernel-table entries kept in memory; least-recently-touched "
+    "entries are dropped past the bound (the jitted callables themselves "
+    "stay cached).", 4096,
+    checker=lambda v: None if int(v) > 0 else "must be positive")
+
+_INTROSPECT_MODE = "lowered"
+_KERNEL_TABLE_MAX = 4096
+_KERNELS: "Dict[str, Dict]" = {}   # signature -> kernel entry (mutable dict)
+_KERNEL_SEQ = 0                    # bumps on every entry touch
+
+
+def configure_introspection(conf) -> None:
+    """Apply spark.rapids.tpu.metrics.* to the process kernel table
+    (called from TpuSession.__init__, like configure_tracer)."""
+    global _INTROSPECT_MODE, _KERNEL_TABLE_MAX
+    _INTROSPECT_MODE = str(conf.get(XLA_INTROSPECTION)).lower()
+    _KERNEL_TABLE_MAX = int(conf.get(KERNEL_TABLE_SIZE))
+
+
+def _touch_locked(entry: Dict) -> None:
+    global _KERNEL_SEQ
+    _KERNEL_SEQ += 1
+    entry["last_touch"] = _KERNEL_SEQ
+
+
+def _kernel_entry_locked(key: str) -> Dict:
+    entry = _KERNELS.get(key)
+    if entry is None:
+        from .node_context import current
+        ctx = current()
+        entry = _KERNELS[key] = {
+            "signature": key,
+            "node_name": ctx.name if ctx is not None else None,
+            "node_id": ctx.node_id if ctx is not None else None,
+            "query_id": ctx.query_id if ctx is not None else None,
+            "hits": 0, "misses": 0, "compiles": 0, "compile_s": 0.0,
+            "cost": {}, "memory": {}, "last_touch": 0,
+        }
+        # touch BEFORE choosing an eviction victim: a fresh entry holds
+        # last_touch=0 (the global minimum) and would otherwise evict
+        # itself, freezing the table with stale entries at capacity
+        _touch_locked(entry)
+        if len(_KERNELS) > _KERNEL_TABLE_MAX:
+            victim = min(_KERNELS, key=lambda k: _KERNELS[k]["last_touch"])
+            del _KERNELS[victim]
+    else:
+        _touch_locked(entry)
+    return entry
+
+
+def kernel_seq() -> int:
+    """Monotonic touch counter — snapshot before a query, pass to
+    ``kernels_since`` after it to get the programs that query exercised."""
+    with _LOCK:
+        return _KERNEL_SEQ
+
+
+def kernels_since(seq: int) -> List[Dict]:
+    """Kernel entries touched (hit, compiled, or created) after ``seq``."""
+    with _LOCK:
+        return [dict(e) for e in _KERNELS.values() if e["last_touch"] > seq]
+
+
+def kernel_table() -> List[Dict]:
+    """The full kernel table, hottest compile first."""
+    with _LOCK:
+        rows = [dict(e) for e in _KERNELS.values()]
+    return sorted(rows, key=lambda e: -e["compile_s"])
+
+
+def _aval_of(x):
+    """Shape/dtype skeleton of one pytree leaf (weak types collapse — fine
+    for cost analysis)."""
+    if hasattr(x, "shape") and hasattr(x, "dtype"):
+        return jax.ShapeDtypeStruct(x.shape, x.dtype)
+    return x
+
+
+def _introspect(key: str, builder: Callable[[], Callable],
+                args, kwargs) -> None:
+    """Capture cost/memory analysis for the program behind ``key``.
+
+    Re-lowers the builder against shape skeletons of the first call's
+    arguments (jit.lower accepts ShapeDtypeStruct pytrees, so nothing is
+    kept resident). Failures are recorded, never raised — introspection
+    must not break execution."""
+    mode = _INTROSPECT_MODE
+    if mode == "off":
+        return
+    entry_update: Dict = {}
+    try:
+        avals = jax.tree_util.tree_map(_aval_of, (args, kwargs))
+        lowered = jax.jit(builder()).lower(*avals[0], **avals[1])
+        cost = lowered.cost_analysis()
+        if mode == "compiled":
+            compiled = lowered.compile()
+            cca = compiled.cost_analysis()
+            if cca:
+                cost = cca[0] if isinstance(cca, list) else cca
+            mem = compiled.memory_analysis()
+            if mem is not None:
+                entry_update["memory"] = {
+                    "argument_bytes": int(mem.argument_size_in_bytes),
+                    "output_bytes": int(mem.output_size_in_bytes),
+                    "temp_bytes": int(mem.temp_size_in_bytes),
+                    "code_bytes": int(mem.generated_code_size_in_bytes),
+                }
+        if isinstance(cost, list):
+            cost = cost[0] if cost else {}
+        if cost:
+            # keep the totals; the per-operand breakdown keys ("bytes
+            # accessed0{}") would bloat every event log
+            entry_update["cost"] = {
+                k: float(v) for k, v in cost.items() if "{" not in k}
+    except Exception as e:  # pragma: no cover - backend-dependent
+        entry_update["introspection_error"] = repr(e)[:200]
+    with _LOCK:
+        entry = _KERNELS.get(key)
+        if entry is not None:
+            entry.update(entry_update)
 
 _OOM_MARKERS = ("RESOURCE_EXHAUSTED", "RESOURCE EXHAUSTED", "Out of memory",
                 "out of memory", "OOM")
@@ -96,7 +246,9 @@ def _rebuild_on_mismatch(key: str, builder: Callable[[], Callable],
     return wrapped
 
 
-def _time_first_call(key: str, fn: Callable) -> Callable:
+def _time_first_call(key: str, fn: Callable,
+                     builder: Optional[Callable[[], Callable]] = None
+                     ) -> Callable:
     """Attribute a cache entry's first invocation to XLA compile time.
 
     jax.jit compiles lazily on first dispatch, so the first call through a
@@ -104,7 +256,9 @@ def _time_first_call(key: str, fn: Callable) -> Callable:
     Timing the first call is the standard approximation for per-plan
     compile seconds (the run part is dwarfed by the ~1s trace+compile),
     and it scopes the call in a "compile" trace span so Perfetto shows
-    compile stalls on the query timeline."""
+    compile stalls on the query timeline. The first call also feeds the
+    kernel table: compile wall + (when introspection is on) the program's
+    HLO cost/memory analysis, attributed to the executing node."""
     state = {"done": False}
 
     @functools.wraps(fn)
@@ -116,28 +270,63 @@ def _time_first_call(key: str, fn: Callable) -> Callable:
         t0 = time.perf_counter()
         with get_tracer().span("xla_compile", "compile", key=key[:160]):
             out = fn(*args, **kwargs)
+        dt = time.perf_counter() - t0
+        first = False
         with _LOCK:
             # check-and-set under the lock: concurrent first dispatches of
             # one entry must attribute the compile exactly once
             if not state["done"]:
                 state["done"] = True
+                first = True
                 _COMPILES += 1
-                _COMPILE_SECONDS += time.perf_counter() - t0
+                _COMPILE_SECONDS += dt
+                entry = _KERNELS.get(key)
+                if entry is not None:
+                    entry["compiles"] += 1
+                    entry["compile_s"] += dt
+                    _touch_locked(entry)
+        if first:
+            from .node_context import current_registry
+            reg = current_registry()
+            if reg is not None:
+                from . import metrics as M
+                reg.add(M.COMPILE_TIME, dt)
+            if builder is not None:
+                _introspect(key, builder, args, kwargs)
         return out
     return wrapped
+
+
+def _attribute(metric_name: str) -> None:
+    """Count a cache hit/miss on the executing node's registry (no-op when
+    uninstrumented — process-global counters still track)."""
+    from .node_context import current_registry
+    reg = current_registry()
+    if reg is not None:
+        reg.add(metric_name, 1)
 
 
 def cached_jit(key: str, builder: Callable[[], Callable]) -> Callable:
     """Return a jitted callable for ``key``, building it on first use."""
     global _HITS, _MISSES
+    from . import metrics as M
     with _LOCK:
         fn = _CACHE.get(key)
         if fn is not None:
             _HITS += 1
-            return fn
-        _MISSES += 1
+            entry = _KERNELS.get(key)
+            if entry is not None:
+                entry["hits"] += 1
+                _touch_locked(entry)
+        else:
+            _MISSES += 1
+            _kernel_entry_locked(key)["misses"] += 1
+    if fn is not None:
+        _attribute(M.COMPILE_CACHE_HITS)
+        return fn
+    _attribute(M.COMPILE_CACHE_MISSES)
     built = _time_first_call(key, _rebuild_on_mismatch(
-        key, builder, oom_retry(jax.jit(builder()))))
+        key, builder, oom_retry(jax.jit(builder()))), builder)
     with _LOCK:
         return _CACHE.setdefault(key, built)
 
@@ -152,6 +341,7 @@ def clear_cache():
     global _HITS, _MISSES, _COMPILES, _COMPILE_SECONDS
     with _LOCK:
         _CACHE.clear()
+        _KERNELS.clear()
         _HITS = _MISSES = 0
         _COMPILES = 0
         _COMPILE_SECONDS = 0.0

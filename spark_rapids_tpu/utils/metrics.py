@@ -46,14 +46,40 @@ OP_TIME = "opTime"
 SEMAPHORE_WAIT_TIME = "semaphoreWaitTime"
 UPLOAD_TIME = "hostToDeviceTime"
 UPLOAD_CACHE_HITS = "hostToDeviceCacheHits"
+UPLOAD_BYTES = "hostToDeviceBytes"
 DOWNLOAD_TIME = "deviceToHostTime"
+DOWNLOAD_BYTES = "deviceToHostBytes"
 PEAK_DEVICE_MEMORY = "peakDevMemory"
 SPILL_BYTES = "spillBytes"
 SORT_TIME = "sortTime"
 AGG_TIME = "computeAggTime"
 JOIN_TIME = "joinTime"
 COMPILE_TIME = "xlaCompileTime"
+COMPILE_CACHE_HITS = "xlaCacheHits"
+COMPILE_CACHE_MISSES = "xlaCacheMisses"
+SHUFFLE_BYTES = "shuffleBytes"
+SHUFFLE_PARTITION_TIME = "shufflePartitionTime"
 BATCH_ROWS_HISTOGRAM = "batchRows"
+
+#: metric set every device operator registers up front (the ESSENTIAL tier
+#: of the reference's per-exec metric sets, GpuExec.scala:44-60); the
+#: tier-1 lint test (tests/test_observability.py) enforces it on every
+#: Tpu*Exec class so new operators can't ship unobservable
+CORE_NODE_METRICS = (NUM_OUTPUT_ROWS, NUM_OUTPUT_BATCHES, OP_TIME)
+
+#: metric names whose values are SECONDS — EXPLAIN ANALYZE and the
+#: diagnose tool treat these as attributable time, everything else as
+#: counters/bytes
+TIME_METRICS = frozenset({
+    OP_TIME, SEMAPHORE_WAIT_TIME, UPLOAD_TIME, DOWNLOAD_TIME, SORT_TIME,
+    AGG_TIME, JOIN_TIME, COMPILE_TIME, SHUFFLE_PARTITION_TIME,
+})
+
+#: metric names whose values are BYTES (rendered human-readable)
+BYTE_METRICS = frozenset({
+    UPLOAD_BYTES, DOWNLOAD_BYTES, SPILL_BYTES, SHUFFLE_BYTES,
+    PEAK_DEVICE_MEMORY,
+})
 
 
 class Metric:

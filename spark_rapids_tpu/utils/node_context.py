@@ -1,0 +1,73 @@
+"""Thread-local "which operator is executing" stack.
+
+Process-wide services (the XLA compile cache, the buffer catalog's spill
+path) do work *on behalf of* whatever exec node happens to be running, but
+have no reference to it. The reference plugin threads GpuMetric objects
+into those layers explicitly; here the profiler/event-log instrumentation
+(tools/profiler.py ``instrument_plan``) pushes a NodeContext around every
+resume of a node's batch generator instead, so a compile or a spill that
+fires mid-batch is attributed to the innermost node driving it — per
+(query, node_id), which is exactly the key the event log accumulates under.
+
+Uninstrumented executions (plain ``collect()`` with no event log and no
+profiler) run with an empty stack; attribution callers must tolerate
+``current() is None`` and fall back to process-global counters only.
+"""
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from typing import Optional
+
+__all__ = ["NodeContext", "node_scope", "current", "current_registry"]
+
+
+class NodeContext:
+    __slots__ = ("node_id", "name", "registry", "query_id")
+
+    def __init__(self, node_id: int, name: str, registry=None,
+                 query_id: Optional[int] = None):
+        self.node_id = node_id
+        self.name = name
+        self.registry = registry  # the node's MetricRegistry (may be None)
+        self.query_id = query_id
+
+    def __repr__(self):
+        return f"NodeContext({self.node_id}, {self.name!r})"
+
+
+_TLS = threading.local()
+
+
+def _stack():
+    st = getattr(_TLS, "stack", None)
+    if st is None:
+        st = _TLS.stack = []
+    return st
+
+
+@contextmanager
+def node_scope(node_id: int, name: str, registry=None,
+               query_id: Optional[int] = None):
+    """Mark ``node_id`` as the executing operator for the dynamic extent.
+
+    Nested scopes stack: a child generator resumed inside a parent's scope
+    pushes itself on top, so ``current()`` is always the innermost node."""
+    st = _stack()
+    st.append(NodeContext(node_id, name, registry, query_id))
+    try:
+        yield
+    finally:
+        st.pop()
+
+
+def current() -> Optional[NodeContext]:
+    """The innermost executing node's context, or None when uninstrumented."""
+    st = getattr(_TLS, "stack", None)
+    return st[-1] if st else None
+
+
+def current_registry():
+    """The innermost executing node's MetricRegistry, or None."""
+    ctx = current()
+    return ctx.registry if ctx is not None else None

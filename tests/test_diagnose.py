@@ -1,0 +1,202 @@
+"""tools/diagnose.py — the auto-diagnosis (AutoTuner analogue).
+
+A canned schema-v3 event log with engineered bottlenecks pins the report:
+the ranked (node, metric) pairs, the recompile-churn detection from kernel
+records, and the query-level signals (compile cache, semaphore, spills).
+"""
+import json
+
+import numpy as np
+import pandas as pd
+import pytest
+
+
+def _write_log(path, nodes, kernels=(), wall_s=1.0, stats=None,
+               spill_count=None, semaphore_wait_s=0.0):
+    """Fabricate one-query schema-v3 event log. ``nodes`` entries:
+    (name, depth, parent_id, wall_s, metrics)."""
+    records = [
+        {"event": "app_start", "app_id": path.stem, "schema_version": 3,
+         "ts": 0.0, "conf": {}},
+        {"event": "query_start", "query_id": 1, "ts": 0.0, "plan": "p"},
+    ]
+    for i, (name, depth, parent, wall, metrics) in enumerate(nodes):
+        records.append({
+            "event": "node", "query_id": 1, "node_id": i,
+            "parent_id": parent, "name": name, "desc": "", "depth": depth,
+            "wall_s": wall, "rows": 1000, "batches": 2,
+            "t_first": 0.0, "t_last": wall, "metrics": metrics})
+    for k in kernels:
+        records.append({
+            "event": "kernel", "query_id": 1, "first_query_id": 1,
+            "signature": k["signature"], "node_name": k.get("node_name"),
+            "node_id": k.get("node_id"), "hits": k.get("hits", 0),
+            "misses": k.get("misses", 1), "compiles": k.get("compiles", 1),
+            "compile_s": k.get("compile_s", 0.0), "cost": k.get("cost", {}),
+            "memory": k.get("memory", {})})
+    records.append({
+        "event": "query_end", "query_id": 1, "ts": 1.0, "wall_s": wall_s,
+        "final_plan": "p", "aqe_events": [],
+        "spill_count": spill_count or {},
+        "semaphore_wait_s": semaphore_wait_s, "stats": stats or {}})
+    records.append({"event": "app_end", "ts": 1.0})
+    with open(path, "w", encoding="utf-8") as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+    return str(path)
+
+
+def _golden_log(tmp_path):
+    """One query, wall 2.0s: host shuffle dominates (61%), the aggregate
+    takes 20%, the upload 10%; one operator shows recompile churn."""
+    return _write_log(
+        tmp_path / "golden.jsonl",
+        nodes=[
+            ("DeviceToHostExec", 0, -1, 1.98,
+             {"deviceToHostTime": 0.01, "deviceToHostBytes": 1 << 20}),
+            ("ShuffleExchangeExec", 1, 0, 1.96,
+             {"shufflePartitionTime": 1.1, "shuffleBytes": 1 << 26}),
+            ("TpuHashAggregateExec", 2, 1, 0.74,
+             {"computeAggTime": 0.38, "xlaCompileTime": 0.3,
+              "xlaCacheMisses": 6}),
+            ("HostToDeviceExec", 3, 2, 0.34,
+             {"hostToDeviceTime": 0.2, "hostToDeviceBytes": 1 << 24}),
+            ("CpuScanExec", 4, 3, 0.14, {}),
+        ],
+        kernels=[
+            {"signature": f"HashAggC|partial|cap{1 << (10 + i)}",
+             "node_name": "TpuHashAggregateExec", "node_id": 2,
+             "compiles": 1, "compile_s": 0.12,
+             "cost": {"flops": 1e6, "bytes accessed": 2e6}}
+            for i in range(5)
+        ],
+        wall_s=2.0,
+        stats={"compile_cache_compile_seconds": 0.9,
+               "compile_cache_misses": 6},
+        spill_count={"StorageTier.HOST": 3},
+        semaphore_wait_s=0.6,
+    )
+
+
+def test_golden_diagnose_report(tmp_path):
+    from spark_rapids_tpu.tools.diagnose import diagnose_path
+    rep = diagnose_path(_golden_log(tmp_path))
+    (q,) = rep.queries
+    assert q.query_id == 1 and q.wall_s == pytest.approx(2.0)
+
+    # the top-3 (node, metric) pairs, ranked by share of wall
+    top = q.top(3)
+    assert [(f.node, f.metric) for f in top] == [
+        ("ShuffleExchangeExec", "wall"),
+        ("ShuffleExchangeExec", "shufflePartitionTime"),
+        ("(query)", "xlaCompileSeconds"),
+    ]
+    # the host-shuffle finding carries its share and the tier suggestion
+    assert top[0].fraction == pytest.approx((1.96 - 0.74) / 2.0, abs=0.01)
+    assert "shuffle.mode" in top[0].suggestion
+
+    byname = {(f.node, f.metric): f for f in q.findings}
+    # recompile churn detected from the kernel records
+    churn = byname[("TpuHashAggregateExec", "recompiles")]
+    assert "5 unique signatures" in churn.detail
+    assert "batchRowsMinBucket" in churn.suggestion
+    # upload + semaphore + spill findings all present
+    assert ("HostToDeviceExec", "hostToDeviceTime") in byname
+    assert ("(query)", "semaphoreWaitTime") in byname
+    assert ("(query)", "spills") in byname
+
+    s = rep.summary()
+    assert "top bottlenecks" in s
+    assert "(ShuffleExchangeExec, wall) 61% of wall" in s
+    assert "suggest:" in s
+    # machine-readable form round-trips
+    obj = json.loads(rep.to_json())
+    assert obj["queries"][0]["findings"][0]["node"] == \
+        "ShuffleExchangeExec"
+
+
+def test_diagnose_errors_and_empty_queries_skipped(tmp_path):
+    from spark_rapids_tpu.tools.diagnose import diagnose_path
+    path = tmp_path / "err.jsonl"
+    records = [
+        {"event": "app_start", "app_id": "e", "schema_version": 3,
+         "ts": 0.0, "conf": {}},
+        {"event": "query_start", "query_id": 1, "ts": 0.0, "plan": "p"},
+        {"event": "query_end", "query_id": 1, "ts": 1.0, "wall_s": 0.5,
+         "final_plan": "p", "aqe_events": [], "spill_count": {},
+         "semaphore_wait_s": 0.0, "stats": {}, "error": "boom"},
+        {"event": "app_end", "ts": 1.0},
+    ]
+    with open(path, "w", encoding="utf-8") as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+    rep = diagnose_path(str(path))
+    assert rep.queries == []
+    assert "no completed queries" in rep.summary()
+
+
+def test_diagnose_v2_log_without_kernels(tmp_path):
+    """Backwards compatible: a v2 log (no kernel records, no node metric
+    attribution) still yields the wall ranking + query-level findings."""
+    from spark_rapids_tpu.tools.diagnose import diagnose_path
+    path = _write_log(
+        tmp_path / "v2.jsonl",
+        nodes=[("TpuSortExec", 0, -1, 0.9, {}),
+               ("CpuScanExec", 1, 0, 0.05, {})],
+        wall_s=1.0,
+        stats={"compile_cache_compile_seconds": 0.5})
+    rep = diagnose_path(path)
+    (q,) = rep.queries
+    assert q.findings[0].node == "TpuSortExec"
+    assert q.findings[0].metric == "wall"
+    assert any(f.metric == "xlaCompileSeconds" for f in q.findings)
+
+
+def test_diagnose_cli(tmp_path, capsys):
+    from spark_rapids_tpu.tools.diagnose import main
+    path = _golden_log(tmp_path)
+    rc = main([path])
+    out = capsys.readouterr().out
+    assert rc == 0 and "top bottlenecks" in out
+    # --json emits valid JSON; directory arguments expand to *.jsonl
+    rc = main([str(tmp_path), "--json", "--top", "2",
+               "--out", str(tmp_path / "rep.json")])
+    out = capsys.readouterr().out
+    assert rc == 0
+    obj = json.loads(out)
+    assert len(obj["queries"][0]["findings"]) == 2
+    assert (tmp_path / "rep.json").exists()
+    empty = tmp_path / "nope_dir_empty"
+    empty.mkdir()
+    rc = main([str(empty)])
+    assert rc == 2
+
+
+def test_diagnose_real_event_log(tmp_path):
+    """End-to-end: a real device run produces a diagnosable v3 log whose
+    top findings name actual plan operators."""
+    import glob
+    import os
+    from spark_rapids_tpu.session import TpuSession
+    from spark_rapids_tpu.expr.functions import col, sum as f_sum
+    from spark_rapids_tpu.tools.diagnose import diagnose_path
+    sess = TpuSession({
+        "spark.rapids.tpu.eventLog.dir": str(tmp_path),
+        "spark.rapids.tpu.batchRowsMinBucket": 8,
+        "spark.rapids.tpu.shuffle.partitions": 2,
+        "spark.rapids.tpu.shuffle.mode": "host",
+    })
+    rng = np.random.default_rng(21)
+    df = sess.create_dataframe(pd.DataFrame({
+        "g": rng.integers(0, 5, 300).astype(np.int64),
+        "x": rng.normal(size=300)}), num_partitions=2)
+    df.group_by("g").agg(f_sum(col("x")).alias("sx")).collect(device=True)
+    sess.close()
+    (path,) = glob.glob(os.path.join(str(tmp_path), "*.jsonl"))
+    rep = diagnose_path(path)
+    (q,) = rep.queries
+    assert q.findings, "real run produced no findings"
+    # every finding names a real (node, metric) pair with a suggestion
+    for f in q.top(3):
+        assert f.node and f.metric and f.suggestion
+    assert "top bottlenecks" in rep.summary()
