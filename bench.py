@@ -37,7 +37,8 @@ claim, reference docs/FAQ.md:100-106).
 Env knobs: BENCH_MODE (auto|tpch22|q1q6), BENCH_SF, BENCH_SMOKE_SF,
 BENCH_PARTITIONS, BENCH_BUDGET_S, BENCH_PROBE_BUDGET_S, BENCH_PLATFORM
 (cpu forces the CPU backend), BENCH_XLA_CACHE, BENCH_QUERY_TIMEOUT_S,
-BENCH_ABLATION.
+BENCH_ABLATION, BENCH_PIPELINE (on|off A/B knob for the pipelined
+executor, spark.rapids.tpu.pipeline.enabled; recorded in the bench JSON).
 """
 import atexit
 import hashlib
@@ -65,6 +66,7 @@ _STATE = {
     "sf": None,
     "rows": None,
     "eventlog": {},   # phase -> event-log directory
+    "pipeline": os.environ.get("BENCH_PIPELINE", "on"),  # A/B knob
     "notes": [],
 }
 
@@ -87,7 +89,7 @@ def _write_partial():
         json.dump({k: _STATE[k] for k in
                    ("backend", "fell_back", "sf", "rows", "smoke", "tpch",
                     "ablation", "compile_cache", "errors", "eventlog",
-                    "notes")}
+                    "pipeline", "notes")}
                   | {"elapsed_s": round(time.monotonic() - _T_START, 2)},
                   f, indent=1)
     os.replace(tmp, _PARTIAL_PATH)
@@ -543,6 +545,12 @@ def _eventlog_conf(phase: str, sink=None) -> dict:
     return {"spark.rapids.tpu.eventLog.dir": d}
 
 
+def _pipeline_conf() -> dict:
+    """BENCH_PIPELINE=on|off A/B knob -> session conf (default on)."""
+    return {"spark.rapids.tpu.pipeline.enabled":
+            os.environ.get("BENCH_PIPELINE", "on") != "off"}
+
+
 def _rel_tol() -> float:
     """TPU computes float64 at f32 precision; loosen device-vs-host float
     comparisons there (the reference marks such queries approximate_float)."""
@@ -592,6 +600,7 @@ def _worker_smoke(sink: _EventSink):
     rows = int(6_000_000 * sf)
     lineitem = tpch.gen_lineitem(sf, seed=0, rows=rows)
     sess = TpuSession({"spark.rapids.tpu.batchRowsMinBucket": 1 << 18,
+                       **_pipeline_conf(),
                        **_eventlog_conf("smoke", sink)})
     df = sess.create_dataframe(lineitem, num_partitions=1).cache()
     t = {"lineitem": df}
@@ -693,6 +702,7 @@ def _worker_tpch(sink: _EventSink):
     sess = TpuSession({
         "spark.rapids.tpu.batchRowsMinBucket": 8192,
         "spark.rapids.tpu.shuffle.partitions": nparts,
+        **_pipeline_conf(),
         **_eventlog_conf("tpch", sink),
     })
     dfs = tpch.build_dataframes(sess, tables, num_partitions=nparts)
@@ -749,6 +759,7 @@ def _worker_ablation(sink: _EventSink):
         "baseline": {},
         "host_shuffle_tier": {"spark.rapids.tpu.shuffle.mode": "host"},
         "aqe_off": {"spark.rapids.tpu.aqe.enabled": False},
+        "pipeline_off": {"spark.rapids.tpu.pipeline.enabled": False},
         "sql_off_hostengine": {"spark.rapids.sql.enabled": False},
     }
     for name, extra in configs.items():
@@ -756,7 +767,8 @@ def _worker_ablation(sink: _EventSink):
         try:
             sess = TpuSession({
                 "spark.rapids.tpu.batchRowsMinBucket": 8192,
-                "spark.rapids.tpu.shuffle.partitions": 2, **extra})
+                "spark.rapids.tpu.shuffle.partitions": 2,
+                **_pipeline_conf(), **extra})
             dfs = {"lineitem": sess.create_dataframe(
                 tables["lineitem"], num_partitions=2)}
             times = {}

@@ -339,6 +339,9 @@ def _write_docs(path: Optional[str] = None) -> str:
                 "spark_rapids_tpu.plan.aqe", "spark_rapids_tpu.plan.planner",
                 "spark_rapids_tpu.plan.joins_planner",
                 "spark_rapids_tpu.exec.exchange", "spark_rapids_tpu.exec.cache",
+                "spark_rapids_tpu.exec.transitions",
+                "spark_rapids_tpu.exec.wholestage",
+                "spark_rapids_tpu.parallel.pipeline",
                 "spark_rapids_tpu.io.csv", "spark_rapids_tpu.io.csv_device",
                 "spark_rapids_tpu.io.orc", "spark_rapids_tpu.io.dump",
                 "spark_rapids_tpu.tools.eventlog",

@@ -109,8 +109,14 @@ class TpuCacheExec(TpuExec):
                 yield handle.get()
             return
         from ..memory import SpillPriorities, get_catalog
+        from .transitions import take_exclusive
         acc: List[DeviceTable] = []
         for b in self.child_device_batches(pidx):
+            # this node RETAINS the batch for re-execution: consume any
+            # exclusive-ownership mark BEFORE the consumer sees it, or a
+            # donating fused stage downstream would free buffers the cache
+            # re-serves on the next collect (exec/transitions.py contract)
+            take_exclusive(b)
             acc.append(b)
             self.account_batch()
             yield b

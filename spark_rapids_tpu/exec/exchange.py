@@ -82,7 +82,7 @@ def pad_table_capacity(table: DeviceTable, capacity: int) -> DeviceTable:
 class TpuShuffleExchangeExec(TpuExec):
     """Hash exchange as a mesh collective; output partition = mesh shard."""
 
-    EXTRA_METRICS = (M.SHUFFLE_BYTES,)
+    EXTRA_METRICS = (M.SHUFFLE_BYTES, M.PIPELINE_WAIT)
 
     def __init__(self, child: PhysicalPlan, partitioning: HashPartitioning,
                  mesh, min_bucket: int = 1024, axis: str = "dp",
@@ -98,6 +98,9 @@ class TpuShuffleExchangeExec(TpuExec):
         self.schema = child.schema
         # spill handles per partition, one per exchanged chunk
         self._shards: Optional[List[List]] = None
+        # pipelined partition drains race to materialize; exactly one wins
+        # (parallel/pipeline.py pipelined_collect contract)
+        self._mat_lock = __import__("threading").Lock()
 
     @property
     def num_partitions(self) -> int:
@@ -124,8 +127,17 @@ class TpuShuffleExchangeExec(TpuExec):
         exchange out-of-core — the operator that sees the most data must
         not require the whole input resident (reference: per-batch
         streaming in GpuShuffleExchangeExecBase.scala:146)."""
-        if self._shards is not None:
-            return
+        with self._mat_lock:
+            if self._shards is not None:
+                return
+            # never block on the semaphore while holding this shared lock
+            # (parallel/pipeline.py exempt_admission invariant)
+            from ..parallel.pipeline import exempt_admission
+            with exempt_admission():
+                self._materialize_locked()
+
+    def _materialize_locked(self) -> None:
+        from ..parallel.pipeline import maybe_prefetched
         n = self.num_partitions
         shards: List[List] = [[] for _ in range(n)]
         total_rows = 0
@@ -134,15 +146,24 @@ class TpuShuffleExchangeExec(TpuExec):
         # work (concat/count/all-to-all, inside _exchange_chunk) is ours
         pending: List[DeviceTable] = []
         staged = 0
-        for p in range(self.child.num_partitions):
-            for b in self.child_device_batches(p):
-                if not int(b.num_rows):
-                    continue
-                pending.append(b)
-                staged += b.capacity
-                if staged >= self.chunk_rows:
-                    total_rows += self._exchange_chunk(pending, shards)
-                    pending, staged = [], 0
+
+        def all_child_batches():
+            """Map-side production across every input partition; the ICI
+            collective itself must stay on one thread, so the overlap is a
+            bounded prefetch of child batches under it."""
+            for p in range(self.child.num_partitions):
+                yield from self.child_device_batches(p)
+
+        batches = maybe_prefetched(all_child_batches, stage="shuffle_map",
+                                   registry=self.metrics)
+        for b in batches:
+            if not int(b.num_rows):
+                continue
+            pending.append(b)
+            staged += b.capacity
+            if staged >= self.chunk_rows:
+                total_rows += self._exchange_chunk(pending, shards)
+                pending, staged = [], 0
         if pending:
             total_rows += self._exchange_chunk(pending, shards)
         self._shards = shards
@@ -242,6 +263,7 @@ class TpuLocalExchangeExec(TpuExec):
         self.min_bucket = min_bucket
         self.schema = child.schema
         self._handles: Optional[List] = None
+        self._mat_lock = __import__("threading").Lock()
 
     @property
     def num_partitions(self) -> int:
@@ -251,21 +273,30 @@ class TpuLocalExchangeExec(TpuExec):
         return "local n=1"
 
     def _materialize(self) -> None:
-        if self._handles is not None:
-            return
+        with self._mat_lock:
+            if self._handles is not None:
+                return
+            from ..parallel.pipeline import exempt_admission
+            with exempt_admission():
+                self._materialize_locked()
+
+    def _materialize_locked(self) -> None:
         import weakref
 
         from ..memory.catalog import SpillPriorities, get_catalog
+        from ..parallel.pipeline import parallel_map
         catalog = get_catalog()
-        handles: List = []
-        rows = 0
         from ..columnar.device import shrink_to_fit
-        for p in range(self.child.num_partitions):
+
+        def drain(p: int):
+            """One map-side partition: drain, compact, spill-register.
+            Runs per-partition on the bounded task pool (parallel map-side
+            writes) — the catalog and metric registries are thread-safe."""
+            out = []
             for b in self.child_device_batches(p):
                 n = int(b.num_rows)
                 if not n:
                     continue
-                rows += n
                 with self.metrics.timed(M.OP_TIME):
                     # the exchange is a compaction point (design rule 2 in
                     # columnar/device.py): post-filter / fused-partial-agg
@@ -276,7 +307,13 @@ class TpuLocalExchangeExec(TpuExec):
                     h = catalog.register(
                         shrunk, SpillPriorities.OUTPUT_FOR_SHUFFLE)
                 weakref.finalize(self, _close_quietly, h)
-                handles.append(h)
+                out.append((h, n))
+            return out
+
+        per_part = parallel_map(drain, range(self.child.num_partitions),
+                                stage="local_exchange_map")
+        handles: List = [h for part in per_part for h, _n in part]
+        rows = sum(n for part in per_part for _h, n in part)
         self._handles = handles
         self.metrics.add(M.NUM_OUTPUT_BATCHES, len(handles))
         self.metrics.add(M.NUM_OUTPUT_ROWS, rows)

@@ -1199,12 +1199,22 @@ class TpuBroadcastHashJoinExec(TpuShuffledHashJoinExec):
             f"{self.how} join cannot broadcast the right side"
         self._bc_handle = None
         self._bc_grace_parts = None
+        self._bc_lock = __import__("threading").Lock()
 
     def _broadcast_handle(self):
         """Broadcast batch registered once with the BufferCatalog at
         BROADCAST priority — accounted and spillable rather than pinned to
         the exec node for the plan's lifetime. A finalizer releases the
-        catalog entry when the plan is garbage-collected."""
+        catalog entry when the plan is garbage-collected. The lock keeps
+        concurrent (pipelined) probe partitions from double-building.
+        Never block on the semaphore while holding it
+        (pipeline.exempt_admission invariant)."""
+        with self._bc_lock:
+            from ..parallel.pipeline import exempt_admission
+            with exempt_admission():
+                return self._broadcast_handle_locked()
+
+    def _broadcast_handle_locked(self):
         if self._bc_handle is None:
             import weakref
             from ..memory.catalog import SpillPriorities, get_catalog
@@ -1231,13 +1241,17 @@ class TpuBroadcastHashJoinExec(TpuShuffledHashJoinExec):
 
     def _grace_build_parts(self, build: DeviceTable, n_sub: int):
         """Split the broadcast once; reuse the parts for every partition."""
-        if self._bc_grace_parts is None:
-            import weakref
-            parts, _ = super()._grace_build_parts(build, n_sub)
-            self._bc_grace_parts = parts
-            for h in parts:
-                weakref.finalize(self, _close_quietly, h)
-        return self._bc_grace_parts, False
+        with self._bc_lock:
+            if self._bc_grace_parts is None:
+                import weakref
+
+                from ..parallel.pipeline import exempt_admission
+                with exempt_admission():
+                    parts, _ = super()._grace_build_parts(build, n_sub)
+                self._bc_grace_parts = parts
+                for h in parts:
+                    weakref.finalize(self, _close_quietly, h)
+            return self._bc_grace_parts, False
 
 
 def _close_quietly(handle):

@@ -23,7 +23,8 @@ from ..utils.tracing import get_tracer
 from .base import TpuExec
 
 __all__ = ["HostToDeviceExec", "DeviceToHostExec", "TpuCoalesceBatchesExec",
-           "clear_upload_cache", "upload_cache_stats"]
+           "clear_upload_cache", "upload_cache_stats", "mark_exclusive",
+           "take_exclusive"]
 
 SCAN_DEVICE_CACHE = register_conf(
     "spark.rapids.tpu.scan.deviceCache.enabled",
@@ -40,6 +41,46 @@ SCAN_DEVICE_CACHE_MAX_BYTES = register_conf(
     "Device-byte budget for the scan upload cache; uploads past the budget "
     "are not cached (data still flows, uncached). 0 disables caching.",
     2 << 30)
+
+COALESCE_AFTER_UPLOAD = register_conf(
+    "spark.rapids.tpu.coalesce.afterUpload.enabled",
+    "Insert a TpuCoalesceBatchesExec above every host->device upload so "
+    "many small scanned batches stitch into full-size device batches "
+    "before compute (reference: GpuCoalesceBatches above GpuRowToColumnar "
+    "via childrenCoalesceGoal).", False)
+
+COALESCE_TARGET_BYTES = register_conf(
+    "spark.rapids.tpu.coalesce.targetBytes",
+    "Byte-based flush target for TpuCoalesceBatchesExec, alongside the "
+    "row goal: a pending set flushes once its device bytes reach this "
+    "bound even when the row target is far away, so wide schemas cannot "
+    "accumulate an OOM-sized concat (reference: the TargetSize coalesce "
+    "goal is byte-denominated, GpuCoalesceBatches.scala:93-200). "
+    "0 disables the byte bound.", 512 * 1024 * 1024,
+    checker=lambda v: None if int(v) >= 0 else "must be >= 0")
+
+
+# ---------------------------------------------------------------------------
+# donation-safe hand-off: an uploaded batch that is NOT retained by the
+# upload cache is exclusively owned by its consumer, so a fused stage may
+# donate its buffers to XLA (exec/wholestage.py donate_argnums) — cutting
+# peak HBM per batch. Cached uploads are shared across executions and must
+# never be donated. The mark rides the DeviceTable instance (plain
+# dataclass) and is consumed exactly once.
+# ---------------------------------------------------------------------------
+def mark_exclusive(table: DeviceTable) -> DeviceTable:
+    table._tpu_exclusive = True
+    return table
+
+
+def take_exclusive(table: DeviceTable) -> bool:
+    """True once per exclusively-owned batch (clears the mark: after the
+    consumer donates — or declines to — the buffers are no longer safely
+    donatable by anyone else)."""
+    if getattr(table, "_tpu_exclusive", False):
+        table._tpu_exclusive = False
+        return True
+    return False
 
 # Upload memoization keyed by host-batch IDENTITY (HostTable is mutable-ish
 # and unhashable; identity is the right equivalence anyway — sources that
@@ -111,7 +152,8 @@ def _hook_oom() -> None:
 
 
 class HostToDeviceExec(TpuExec):
-    EXTRA_METRICS = (M.UPLOAD_TIME, M.UPLOAD_BYTES, M.UPLOAD_CACHE_HITS)
+    EXTRA_METRICS = (M.UPLOAD_TIME, M.UPLOAD_BYTES, M.UPLOAD_CACHE_HITS,
+                     M.PIPELINE_WAIT)
 
     def __init__(self, child: PhysicalPlan, min_bucket: int = 1024,
                  cache_max_bytes: int = 0):
@@ -129,7 +171,7 @@ class HostToDeviceExec(TpuExec):
                                    rows=int(batch.num_rows)):
                 dtb = DeviceTable.from_host(batch, self.min_bucket)
             self.metrics.add(M.UPLOAD_BYTES, dtb.nbytes())
-            return dtb
+            return mark_exclusive(dtb)
         key = id(batch)
         with _UPLOAD_LOCK:
             entry = _UPLOAD_CACHE.get(key)
@@ -173,10 +215,21 @@ class HostToDeviceExec(TpuExec):
             cat = peek_catalog()
             if cat is not None:
                 cat.note_external_change()
+        else:
+            # not retained by the cache: the consumer owns the only
+            # reference, so fused stages may donate it (wholestage.py)
+            mark_exclusive(dtb)
         return dtb
 
     def execute_columnar(self, pidx: int) -> Iterator[DeviceTable]:
-        for batch in self.child.execute(pidx):
+        # stage boundary: host decode/IO runs on a prefetch worker so the
+        # NEXT batch decodes while THIS one uploads (double-buffered via
+        # the bounded queue; parallel/pipeline.py)
+        from ..parallel.pipeline import maybe_prefetched, stage_name
+        child = maybe_prefetched(
+            lambda: self.child.execute(pidx),
+            stage=f"decode:{stage_name(self.child)}", registry=self.metrics)
+        for batch in child:
             with self.metrics.timed(M.UPLOAD_TIME):
                 dtb = self._upload(batch)
             self.metrics.add(M.NUM_OUTPUT_BATCHES, 1)
@@ -198,7 +251,13 @@ class DeviceToHostExec(PhysicalPlan):
         return self.child.num_partitions
 
     def execute(self, pidx: int) -> Iterator[HostTable]:
-        for batch in self.child.execute_columnar(pidx):
+        # stage boundary: jitted compute (async dispatch) keeps running on
+        # the prefetch worker while this thread blocks in to_host()
+        from ..parallel.pipeline import maybe_prefetched, stage_name
+        child = maybe_prefetched(
+            lambda: self.child.execute_columnar(pidx),
+            stage=f"compute:{stage_name(self.child)}", registry=self.metrics)
+        for batch in child:
             with self.metrics.timed(M.DOWNLOAD_TIME), \
                     get_tracer().span("d2h_download", "download",
                                       rows=int(batch.num_rows)):
@@ -210,38 +269,63 @@ class DeviceToHostExec(PhysicalPlan):
 
 
 class TpuCoalesceBatchesExec(TpuExec):
-    """Concatenate small device batches up to a target row goal.
+    """Concatenate small device batches up to a target row and/or byte goal.
 
     The reference distinguishes TargetSize vs RequireSingleBatch goals
-    (CoalesceGoal lattice, GpuCoalesceBatches.scala:93-200); here the goal is
-    expressed in rows (``target_rows``) or single-batch (``require_single``).
+    (CoalesceGoal lattice, GpuCoalesceBatches.scala:93-200); here the goal
+    is expressed in rows (``target_rows``), bytes (``target_bytes`` — the
+    TargetSize analogue, so wide schemas cannot accumulate an OOM-sized
+    flush long before the row goal fills), or single-batch
+    (``require_single``).
     """
 
+    EXTRA_METRICS = (M.COALESCED_BYTES,)
+
     def __init__(self, child: PhysicalPlan, target_rows: int = 1 << 20,
-                 require_single: bool = False, min_bucket: int = 1024):
+                 require_single: bool = False, min_bucket: int = 1024,
+                 target_bytes: int = 0):
         super().__init__()
         self.child = child
         self.children = (child,)
         self.schema = child.schema
         self.target_rows = target_rows
+        self.target_bytes = int(target_bytes)
         self.require_single = require_single
         self.min_bucket = min_bucket
+
+    def node_desc(self) -> str:
+        if self.require_single:
+            return "goal=single"
+        goal = f"rows={self.target_rows}"
+        if self.target_bytes:
+            goal += f" bytes={self.target_bytes}"
+        return goal
+
+    def _over_bytes(self, pending_bytes: int, extra: int = 0) -> bool:
+        return bool(self.target_bytes) \
+            and pending_bytes + extra > self.target_bytes
 
     def execute_columnar(self, pidx: int) -> Iterator[DeviceTable]:
         pending: List[DeviceTable] = []
         pending_rows = 0
+        pending_bytes = 0
         for batch in self.child_device_batches(pidx):
             n = int(batch.num_rows)
-            if self.require_single or pending_rows + n <= self.target_rows \
-                    or not pending:
+            nb = batch.nbytes()
+            if self.require_single:
                 pending.append(batch)
-                pending_rows += n
-                if not self.require_single and pending_rows >= self.target_rows:
-                    yield self._flush(pending)
-                    pending, pending_rows = [], 0
-            else:
+                continue
+            if pending and (pending_rows + n > self.target_rows
+                            or self._over_bytes(pending_bytes, nb)):
                 yield self._flush(pending)
-                pending, pending_rows = [batch], n
+                pending, pending_rows, pending_bytes = [], 0, 0
+            pending.append(batch)
+            pending_rows += n
+            pending_bytes += nb
+            if pending_rows >= self.target_rows \
+                    or self._over_bytes(pending_bytes):
+                yield self._flush(pending)
+                pending, pending_rows, pending_bytes = [], 0, 0
         if pending:
             yield self._flush(pending)
 
@@ -249,4 +333,5 @@ class TpuCoalesceBatchesExec(TpuExec):
         with self.metrics.timed(M.OP_TIME):
             out = concat_device_tables(pending, self.min_bucket)
         self.metrics.add(M.NUM_OUTPUT_BATCHES, 1)
+        self.metrics.add(M.COALESCED_BYTES, out.nbytes())
         return out

@@ -7,6 +7,14 @@ program so intermediate columns never hit HBM. This is the TPU analogue of
 Spark's whole-stage codegen (which the reference replaces with columnar
 exec — see GpuExec.scala docs) and is inserted by plan/transitions.py after
 lowering.
+
+Input-buffer donation: when the chain CONSUMES its input batch (the batch
+is exclusively owned — see exec/transitions.py mark_exclusive: uploads not
+retained by the scan cache), the fused program runs with
+``donate_argnums=(0,)`` so XLA may reuse the input buffers for the output,
+cutting peak HBM per batch roughly in half for projection-shaped chains.
+Shared batches (cached uploads, catalog/spill handles, broadcast tables)
+never donate. Donated bytes are accounted in the ``donatedBytes`` metric.
 """
 from __future__ import annotations
 
@@ -15,16 +23,47 @@ from typing import Iterator, List
 import jax
 
 from ..columnar.device import DeviceTable
+from ..conf import register_conf
 from ..utils import metrics as M
 from .base import TpuExec
 
-__all__ = ["TpuWholeStageExec", "fuse_stages"]
+__all__ = ["TpuWholeStageExec", "fuse_stages", "DONATION_ENABLED",
+           "donation_active"]
+
+DONATION_ENABLED = register_conf(
+    "spark.rapids.tpu.donation.enabled",
+    "Donate exclusively-owned input batches to fused XLA programs "
+    "(donate_argnums) so the output can reuse the input's HBM. Only "
+    "batches the chain provably consumes are donated (uploads not "
+    "retained by the scan device cache); cached/spillable batches are "
+    "never donated. No effect on backends without buffer donation "
+    "(XLA:CPU).", True)
+
+DONATION_FORCE = register_conf(
+    "spark.rapids.tpu.donation.force",
+    "Testing only: request donation even on backends that do not "
+    "implement it (XLA ignores the request with a warning).", False,
+    internal=True)
+
+
+def donation_active(conf) -> bool:
+    """Whether fused stages should compile a donating entry point."""
+    if not conf.get(DONATION_ENABLED):
+        return False
+    if conf.get(DONATION_FORCE):
+        return True
+    try:
+        return jax.default_backend() != "cpu"
+    except Exception:  # backend init failure: planning must not die here
+        return False
 
 
 class TpuWholeStageExec(TpuExec):
     """Wraps a linear chain of fusible TpuExecs [bottom, ..., top]."""
 
-    def __init__(self, chain: List[TpuExec]):
+    EXTRA_METRICS = (M.PIPELINE_WAIT, M.DONATED_BYTES)
+
+    def __init__(self, chain: List[TpuExec], donate_inputs: bool = False):
         super().__init__()
         assert chain, "empty fusion chain"
         # flatten nested whole-stages: the bottom-up fuse pass wraps inner
@@ -34,6 +73,7 @@ class TpuWholeStageExec(TpuExec):
                  for m in (n.chain if isinstance(n, TpuWholeStageExec)
                            else [n])]
         self.chain = chain
+        self.donate_inputs = donate_inputs
         bottom = chain[0]
         # the producer feeding the chain (transition or other non-fused exec)
         self.source = bottom.children[0]
@@ -64,7 +104,9 @@ class TpuWholeStageExec(TpuExec):
         return run
 
     def execute_columnar(self, pidx: int) -> Iterator[DeviceTable]:
+        from ..parallel.pipeline import maybe_prefetched, stage_name
         from ..utils.compile_cache import cached_jit
+        from .transitions import take_exclusive
         chain = self.chain
 
         def build():
@@ -76,22 +118,42 @@ class TpuWholeStageExec(TpuExec):
                 return table
             return run
 
-        fused = cached_jit(self.plan_signature(), build)
-        for batch in self.source.execute_columnar(pidx):
+        sig = self.plan_signature()
+        fused = cached_jit(sig, build)
+        donating = cached_jit(sig + "|donate", build,
+                              donate_argnums=(0,)) \
+            if self.donate_inputs else None
+        # stage boundary: the source (typically the upload transition)
+        # produces the NEXT batch on a prefetch worker while XLA runs the
+        # current one (parallel/pipeline.py)
+        source = maybe_prefetched(
+            lambda: self.source.execute_columnar(pidx),
+            stage=f"source:{stage_name(self.source)}",
+            registry=self.metrics)
+        for batch in source:
             with self.metrics.timed(M.OP_TIME):
-                out = fused(batch)
+                if donating is not None and take_exclusive(batch):
+                    # nbytes BEFORE the call: donated buffers may be dead
+                    # the moment dispatch returns
+                    self.metrics.add(M.DONATED_BYTES, batch.nbytes())
+                    out = donating(batch)
+                else:
+                    out = fused(batch)
             self.metrics.add(M.NUM_OUTPUT_BATCHES, 1)
             yield out
 
 
-def fuse_stages(plan):
+def fuse_stages(plan, conf=None):
     """Bottom-up pass replacing maximal fusible chains with TpuWholeStageExec.
 
     A node joins a chain when it is a TpuExec with ``batch_fn() is not None``
     and exactly one child. Chains of length 1 are left alone (plain jit in the
-    node itself is equivalent).
+    node itself is equivalent). ``conf`` (when given) decides whether fused
+    stages compile a donating entry point (see DONATION_ENABLED).
     """
     from ..plan.physical import PhysicalPlan
+
+    donate = donation_active(conf) if conf is not None else False
 
     def rebuild(node: PhysicalPlan) -> PhysicalPlan:
         new_children = [rebuild(c) for c in node.children]
@@ -103,7 +165,7 @@ def fuse_stages(plan):
                 chain.insert(0, cur)
                 cur = cur.children[0] if cur.children else None
             if len(chain) > 1:
-                return TpuWholeStageExec(chain)
+                return TpuWholeStageExec(chain, donate_inputs=donate)
         return node
 
     return rebuild(plan)

@@ -96,14 +96,23 @@ def _write_table(df, path: str, fmt: str,
             open(os.path.join(path, "_SUCCESS"), "w").close()
             return stats
     plan = df.session._physical(df.logical)
-    for pidx in range(plan.num_partitions):
-        batches = list(plan.execute(pidx))
+
+    def write_partition(pidx: int) -> List[tuple]:
+        """One map task: drain, slice by partition values, write part
+        files. Returns (file path, rows, partition dir or None) records so
+        the caller can fold WriteStats in deterministic partition order."""
+        from ..memory.semaphore import get_semaphore
+        from ..parallel.pipeline import task_admission
+        with task_admission(), \
+                get_semaphore(df.session.conf).task_scope():
+            batches = list(plan.execute(pidx))
         if not batches:
-            continue
+            return []
         from ..columnar.host import HostTable
         table = HostTable.concat(batches).to_arrow()
         if table.num_rows == 0:
-            continue
+            return []
+        written: List[tuple] = []
         if partition_by:
             # dynamic partitioning (reference: GpuFileFormatDataWriter)
             keys = [table.column(k).to_pylist() for k in partition_by]
@@ -117,17 +126,33 @@ def _write_table(df, path: str, fmt: str,
                     f"{k}={_partition_value_str(v)}"
                     for k, v in zip(partition_by, combo)])
                 os.makedirs(dirpath, exist_ok=True)
-                rel = os.path.relpath(dirpath, path)
-                if rel not in stats.partitions:
-                    stats.partitions.append(rel)
                 fpath = os.path.join(
                     dirpath, f"part-{pidx:05d}-{job_id}.{ext}")
                 _write_one(sub, fpath, fmt, **kw)
-                stats.record(fpath, sub.num_rows)
+                written.append((fpath, sub.num_rows,
+                                os.path.relpath(dirpath, path)))
         else:
             fpath = os.path.join(path, f"part-{pidx:05d}-{job_id}.{ext}")
             _write_one(table, fpath, fmt, **kw)
-            stats.record(fpath, table.num_rows)
+            written.append((fpath, table.num_rows, None))
+        return written
+
+    # pipelined write: part files are independent, so map partitions run
+    # on the bounded task pool (parallel/pipeline.py; sequential when
+    # pipeline.enabled=false); stats fold in partition order. Shed any
+    # semaphore hold earlier main-thread work left on this thread first —
+    # blocking in the pool while holding the only permit deadlocks.
+    from ..memory.semaphore import peek_semaphore
+    from ..parallel.pipeline import parallel_map
+    sem = peek_semaphore()
+    if sem is not None:
+        sem.release_all()
+    for part in parallel_map(write_partition, range(plan.num_partitions),
+                             stage="write"):
+        for fpath, rows, rel in part:
+            if rel is not None and rel not in stats.partitions:
+                stats.partitions.append(rel)
+            stats.record(fpath, rows)
     # _SUCCESS marker like Hadoop committers
     open(os.path.join(path, "_SUCCESS"), "w").close()
     return stats

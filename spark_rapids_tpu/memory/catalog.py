@@ -138,6 +138,11 @@ class BufferCatalog:
     def register(self, table: DeviceTable,
                  priority: int = SpillPriorities.INPUT
                  ) -> "SpillableDeviceTable":
+        # a catalog-registered table is shared/spillable by definition —
+        # strip any exclusive-ownership mark so no downstream fused stage
+        # donates buffers this handle re-serves (exec/transitions.py)
+        if getattr(table, "_tpu_exclusive", False):
+            table._tpu_exclusive = False
         nbytes = table.nbytes()
         with self._lock:
             if self._pool_mode != "none" and not self.device.fits(nbytes) \

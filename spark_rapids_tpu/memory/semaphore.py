@@ -29,7 +29,15 @@ class TpuSemaphore:
         self.acquire_count = 0
 
     def acquire_if_necessary(self, task_id: Optional[int] = None):
-        """Reentrant per task (reference: acquireIfNecessary semantics)."""
+        """Reentrant per task (reference: acquireIfNecessary semantics).
+
+        Pipeline worker threads are exempt: they run under their owning
+        task's admission, and a worker blocking on the permit its task
+        holds (while the task waits on the worker's queue) would deadlock
+        at concurrentGpuTasks=1 (parallel/pipeline.py semaphore_exempt)."""
+        from ..parallel.pipeline import semaphore_exempt
+        if semaphore_exempt():
+            return
         tid = task_id if task_id is not None else threading.get_ident()
         with self._lock:
             if self._holders.get(tid, 0) > 0:
@@ -45,6 +53,13 @@ class TpuSemaphore:
             self._holders[tid] = 1
 
     def release_if_held(self, task_id: Optional[int] = None):
+        # symmetric with acquire_if_necessary: inside an exempt scope a
+        # release/reacquire pair (python-UDF exec) must not really drop
+        # the owning task's permit — the reacquire would no-op and the
+        # task would finish its drain unadmitted
+        from ..parallel.pipeline import semaphore_exempt
+        if semaphore_exempt():
+            return
         tid = task_id if task_id is not None else threading.get_ident()
         with self._lock:
             depth = self._holders.get(tid, 0)
@@ -56,6 +71,19 @@ class TpuSemaphore:
             del self._holders[tid]
         self._sem.release()
 
+    def release_all(self, task_id: Optional[int] = None):
+        """Task-completion release: drop EVERY hold this task accumulated
+        (reference: GpuSemaphore's task-completion listener releases the
+        whole hold, GpuSemaphore.scala). Operators like the python-UDF
+        exec legitimately end a batch with acquire_if_necessary and rely
+        on task end to release; a pooled task thread must not carry that
+        hold into the next task — the permit would leak forever."""
+        tid = task_id if task_id is not None else threading.get_ident()
+        with self._lock:
+            depth = self._holders.pop(tid, 0)
+        if depth > 0:
+            self._sem.release()
+
     @contextmanager
     def held(self, task_id: Optional[int] = None):
         self.acquire_if_necessary(task_id)
@@ -63,6 +91,16 @@ class TpuSemaphore:
             yield
         finally:
             self.release_if_held(task_id)
+
+    @contextmanager
+    def task_scope(self, task_id: Optional[int] = None):
+        """One task's admission window: acquire on entry, release ALL
+        holds on exit (see release_all)."""
+        self.acquire_if_necessary(task_id)
+        try:
+            yield
+        finally:
+            self.release_all(task_id)
 
 
 _GLOBAL: Optional[TpuSemaphore] = None

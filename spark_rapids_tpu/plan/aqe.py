@@ -423,6 +423,10 @@ class AdaptiveExec(PhysicalPlan):
         self.schema = cpu_plan.schema
         self.events: List[str] = []
         self._final: Optional[PhysicalPlan] = None
+        import threading
+        # pipelined partition drains may race into the adaptive loop; the
+        # first caller runs it, the rest wait for the final plan
+        self._final_lock = threading.Lock()
 
     # -- PhysicalPlan surface -------------------------------------------------
     @property
@@ -443,10 +447,16 @@ class AdaptiveExec(PhysicalPlan):
 
     # -- the loop -------------------------------------------------------------
     def final_plan(self) -> PhysicalPlan:
-        if self._final is None:
-            self._final = self._run()
-            self.children = (self._final,)
-        return self._final
+        with self._final_lock:
+            if self._final is None:
+                # stage materialization may run python-UDF execs that
+                # release/reacquire the semaphore; never block on it while
+                # holding this lock (pipeline.exempt_admission invariant)
+                from ..parallel.pipeline import exempt_admission
+                with exempt_admission():
+                    self._final = self._run()
+                self.children = (self._final,)
+            return self._final
 
     def _run(self) -> PhysicalPlan:
         hook = getattr(self, "_instrument_hook", None)

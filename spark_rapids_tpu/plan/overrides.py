@@ -1077,7 +1077,7 @@ def apply_overrides(cpu_plan: PhysicalPlan, conf: RapidsConf) -> PhysicalPlan:
     from .transitions import insert_transitions
     from ..exec.wholestage import fuse_stages
     with_transitions = insert_transitions(converted, conf)
-    return fuse_stages(with_transitions)
+    return fuse_stages(with_transitions, conf)
 
 
 def _always_cpu(plan: PhysicalPlan) -> bool:

@@ -79,9 +79,16 @@ class PhysicalPlan:
             with tracer.span("task", "task", partition=p):
                 batches.extend(self.execute(p))
         if not batches:
-            return HostTable(self.schema.names, [
-                HostColumn(f.dtype, _empty_values(f.dtype)) for f in self.schema])
+            return empty_result_table(self.schema)
         return HostTable.concat(batches)
+
+
+def empty_result_table(schema: Schema) -> HostTable:
+    """Typed zero-row result — the ONE construction shared by sequential
+    collect and the pipelined executor (they are correctness-oracle pairs
+    and must agree on empty results)."""
+    return HostTable(schema.names, [
+        HostColumn(f.dtype, _empty_values(f.dtype)) for f in schema])
 
 
 def _empty_values(d: dt.DataType) -> np.ndarray:
@@ -610,12 +617,15 @@ class ShuffleExchangeExec(PhysicalPlan):
     """
 
     def __init__(self, child: PhysicalPlan, partitioning: Partitioning):
+        import threading
+
         from ..utils.metrics import MetricRegistry
         self.child = child
         self.children = (child,)
         self.partitioning = partitioning
         self.schema = child.schema
         self._materialized: Optional[List[List[HostTable]]] = None
+        self._mat_lock = threading.Lock()
         # host-tier shuffles are the single largest single-chip overhead
         # (download-partition-upload); the registry makes that visible to
         # EXPLAIN ANALYZE / the diagnose tool per node
@@ -626,8 +636,17 @@ class ShuffleExchangeExec(PhysicalPlan):
         return self.partitioning.num_parts
 
     def _materialize(self):
-        if self._materialized is not None:
-            return
+        # pipelined partition drains race to materialize; exactly one wins.
+        # The winner must never block on the TpuSemaphore while holding
+        # this lock (pipeline.exempt_admission invariant)
+        with self._mat_lock:
+            if self._materialized is not None:
+                return
+            from ..parallel.pipeline import exempt_admission
+            with exempt_admission():
+                self._materialize_locked()
+
+    def _materialize_locked(self):
         if isinstance(self.partitioning, RangePartitioning) \
                 and self.partitioning._bounds is None:
             samples = []
@@ -643,23 +662,37 @@ class ShuffleExchangeExec(PhysicalPlan):
         out: List[List[HostTable]] = [[] for _ in range(self.num_partitions)]
         from ..utils import metrics as M
 
-        def feed(batch: HostTable):
+        def feed(batch: HostTable) -> List:
             with self.metrics.timed(M.SHUFFLE_PARTITION_TIME):
                 self.metrics.add(M.SHUFFLE_BYTES, batch.nbytes())
                 self.metrics.add(M.NUM_OUTPUT_ROWS, batch.num_rows)
                 pids = self.partitioning.partition_indices(batch)
+                slices = []
                 for p in range(self.num_partitions):
                     sel = np.nonzero(pids == p)[0]
                     if len(sel):
-                        out[p].append(batch.take(sel))
+                        slices.append((p, batch.take(sel)))
                         self.metrics.add(M.NUM_OUTPUT_BATCHES, 1)
+                return slices
+
         if inputs is not None:
             for b in inputs:
-                feed(b)
+                for p, sl in feed(b):
+                    out[p].append(sl)
         else:
-            for p in range(self.child.num_partitions):
-                for b in self.child.execute(p):
-                    feed(b)
+            # parallel map-side writes: each input partition decodes,
+            # hashes and slices on the bounded task pool; results merge in
+            # partition order so output batch order stays deterministic
+            from ..parallel.pipeline import parallel_map
+
+            def map_side(p: int) -> List:
+                return [s for b in self.child.execute(p) for s in feed(b)]
+
+            for part in parallel_map(map_side,
+                                     range(self.child.num_partitions),
+                                     stage="shuffle_map_write"):
+                for p, sl in part:
+                    out[p].append(sl)
         self._materialized = out
 
     def execute(self, pidx: int) -> Iterator[HostTable]:

@@ -35,12 +35,24 @@ def _walk(node: PhysicalPlan, conf: RapidsConf) -> PhysicalPlan:
     for c in node.children:
         c2 = _walk(c, conf)
         if _is_device(node) and not _is_device(c2):
-            from ..exec.transitions import (SCAN_DEVICE_CACHE,
-                                            SCAN_DEVICE_CACHE_MAX_BYTES)
+            from ..exec.transitions import (COALESCE_AFTER_UPLOAD,
+                                            COALESCE_TARGET_BYTES,
+                                            SCAN_DEVICE_CACHE,
+                                            SCAN_DEVICE_CACHE_MAX_BYTES,
+                                            TpuCoalesceBatchesExec)
             cache_bytes = conf.get(SCAN_DEVICE_CACHE_MAX_BYTES) \
                 if conf.get(SCAN_DEVICE_CACHE) else 0
             c2 = HostToDeviceExec(c2, conf.min_bucket_rows,
                                   cache_max_bytes=cache_bytes)
+            if conf.get(COALESCE_AFTER_UPLOAD):
+                # stitch many small scanned batches into full-size device
+                # batches, bounded by rows AND bytes (wide schemas hit the
+                # byte goal first — reference: TargetSize coalesce goal)
+                from .physical import DEFAULT_BATCH_ROWS
+                c2 = TpuCoalesceBatchesExec(
+                    c2, target_rows=DEFAULT_BATCH_ROWS,
+                    min_bucket=conf.min_bucket_rows,
+                    target_bytes=conf.get(COALESCE_TARGET_BYTES))
         elif not _is_device(node) and _is_device(c2):
             c2 = DeviceToHostExec(c2)
         new_children.append(c2)

@@ -49,6 +49,10 @@ class TpuSession:
         # table (XLA cost/memory introspection depth)
         from .utils.compile_cache import configure_introspection
         configure_introspection(self.conf)
+        # apply spark.rapids.tpu.pipeline.* to the pipelined executor
+        # (prefetch depth / task pool; parallel/pipeline.py)
+        from .parallel.pipeline import configure_pipeline
+        configure_pipeline(self.conf)
         TpuSession._active = self
 
     # -- device mesh (accelerated shuffle tier) ------------------------------
@@ -180,6 +184,11 @@ class TpuSession:
         return self._eventlog
 
     def close(self) -> None:
+        # cancel + join any straggling pipeline prefetch workers (queries
+        # that drained fully already left none; this is the abandoned-
+        # iterator backstop, and the no-leaked-threads test contract)
+        from .parallel.pipeline import shutdown_workers
+        shutdown_workers()
         log = getattr(self, "_eventlog", None)
         if log is not None:
             log.close()
@@ -466,10 +475,18 @@ class DataFrame:
     # -- actions -------------------------------------------------------------
     def collect(self, device: Optional[bool] = None) -> pa.Table:
         plan = self.session._physical(self.logical, device)
+        # pipelined executor: partitions drain concurrently under
+        # TpuSemaphore admission (parallel/pipeline.py); sequential
+        # PhysicalPlan.collect when pipeline.enabled=false or 1 partition
+        from .parallel.pipeline import pipelined_collect
+
+        def run():
+            return pipelined_collect(plan, self.session.conf)
+
         logger = self.session._event_logger()
         if logger is not None:
-            return logger.run_query(plan, plan.collect).to_arrow()
-        return plan.collect().to_arrow()
+            return logger.run_query(plan, run).to_arrow()
+        return run().to_arrow()
 
     def to_pandas(self, device: Optional[bool] = None):
         return self.collect(device).to_pandas()

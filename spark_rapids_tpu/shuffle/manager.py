@@ -287,8 +287,7 @@ class ShuffleManager:
                 lo, hi = int(bounds[p]), int(bounds[p + 1])
                 if hi > lo:
                     merged[p].append(host.slice(lo, hi - lo))
-        sizes = [0] * num_parts
-        for p in range(num_parts):
+        def publish(p: int) -> int:
             if merged[p]:
                 table = HostTable.concat(merged[p])
             elif schema_host is not None:
@@ -297,7 +296,13 @@ class ShuffleManager:
                 table = HostTable([], [])
             payload = serialize_table(table, self.codec)
             self.transport.publish(BlockId(shuffle_id, map_id, p), payload)
-            sizes[p] = len(payload)
+            return len(payload)
+
+        # parallel map-side writes: per-block concat+serialize (+codec) is
+        # pure CPU work; the transport guards its own store
+        from ..parallel.pipeline import parallel_map
+        sizes = parallel_map(publish, range(num_parts),
+                             stage="shuffle_serialize")
         _bump(blocks_published=num_parts, bytes_published=sum(sizes),
               writes_transport_tier=1)
         return sizes

@@ -168,16 +168,29 @@ def _diagnose_query(q) -> Optional[QueryDiagnosis]:
             (M.SHUFFLE_PARTITION_TIME, "host shuffle partitioning",
              "attach a mesh (spark.rapids.tpu.shuffle.mode=ici) or force "
              "the device-local tier (mode=local)"),
+            (M.PIPELINE_WAIT, "pipeline stall (starved prefetch queue)",
+             "the upstream stage cannot keep this operator fed — raise "
+             "spark.rapids.tpu.pipeline.prefetchDepth / taskPool, or "
+             "speed up the producing stage (see its own findings); check "
+             "the prefetchQueueDepth histogram: p50 of 0 means the "
+             "producer is the bottleneck"),
         ):
             v = metrics.get(key, 0.0)
             if isinstance(v, dict):
                 continue
             frac = v / wall
             if frac >= _FRACTION_FLOOR:
+                detail = f"{label} {v:.4f}s inside this node"
+                if key == M.PIPELINE_WAIT:
+                    depth = metrics.get(M.PREFETCH_QUEUE_DEPTH)
+                    if isinstance(depth, dict) and depth.get("count"):
+                        detail += (f" (queue depth p50="
+                                   f"{depth.get('p50', 0):.0f} over "
+                                   f"{depth['count']} polls)")
                 findings.append(Finding(
                     node=n["name"], node_id=n["node_id"], metric=key,
                     seconds=v, fraction=frac,
-                    detail=f"{label} {v:.4f}s inside this node",
+                    detail=detail,
                     suggestion=suggest))
         spilled = metrics.get(M.SPILL_BYTES, 0)
         if not isinstance(spilled, dict) and spilled:

@@ -219,6 +219,29 @@ def oom_retry(fn: Callable) -> Callable:
     return wrapped
 
 
+def oom_spill_noretry(fn: Callable) -> Callable:
+    """OOM handling for DONATING entries (donate_argnums): a failed
+    dispatch may already have invalidated the donated input buffers, so
+    re-calling with the same arguments — oom_retry's recovery — is
+    unsound. Spill to relieve pressure for SUBSEQUENT batches, then
+    re-raise with the catalog's OOM dump attached."""
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        try:
+            return fn(*args, **kwargs)
+        except Exception as e:
+            if not _is_device_oom(e):
+                raise
+            from ..memory.catalog import get_catalog
+            catalog = get_catalog()
+            freed = catalog.handle_device_oom(context=repr(e)[:200])
+            print(f"# device OOM in donating dispatch: spilled {freed} "
+                  f"bytes for later batches (input was donated — no "
+                  f"retry)", file=sys.stderr)
+            raise RuntimeError(catalog.oom_dump()) from e
+    return wrapped
+
+
 _EXEC_MISMATCH_MARKERS = ("but got buffer with incompatible size",
                           "buffers but compiled program expected")
 
@@ -306,8 +329,14 @@ def _attribute(metric_name: str) -> None:
         reg.add(metric_name, 1)
 
 
-def cached_jit(key: str, builder: Callable[[], Callable]) -> Callable:
-    """Return a jitted callable for ``key``, building it on first use."""
+def cached_jit(key: str, builder: Callable[[], Callable],
+               donate_argnums=None) -> Callable:
+    """Return a jitted callable for ``key``, building it on first use.
+
+    ``donate_argnums`` requests XLA input-buffer donation for the jitted
+    entry (exec/wholestage.py input donation — callers MUST key donating
+    and non-donating variants differently: the option is baked into the
+    compiled executable)."""
     global _HITS, _MISSES
     from . import metrics as M
     with _LOCK:
@@ -325,8 +354,15 @@ def cached_jit(key: str, builder: Callable[[], Callable]) -> Callable:
         _attribute(M.COMPILE_CACHE_HITS)
         return fn
     _attribute(M.COMPILE_CACHE_MISSES)
-    built = _time_first_call(key, _rebuild_on_mismatch(
-        key, builder, oom_retry(jax.jit(builder()))), builder)
+    if donate_argnums is None:
+        built = _time_first_call(key, _rebuild_on_mismatch(
+            key, builder, oom_retry(jax.jit(builder()))), builder)
+    else:
+        # donating entries get NO call-again recovery (oom_retry or the
+        # mismatch rebuild): the failed dispatch may have consumed the
+        # donated input, so the only sound OOM response is spill-and-raise
+        built = _time_first_call(key, oom_spill_noretry(
+            jax.jit(builder(), donate_argnums=donate_argnums)), builder)
     with _LOCK:
         return _CACHE.setdefault(key, built)
 

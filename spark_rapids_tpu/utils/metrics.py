@@ -60,6 +60,10 @@ COMPILE_CACHE_MISSES = "xlaCacheMisses"
 SHUFFLE_BYTES = "shuffleBytes"
 SHUFFLE_PARTITION_TIME = "shufflePartitionTime"
 BATCH_ROWS_HISTOGRAM = "batchRows"
+PIPELINE_WAIT = "pipelineWait"
+PREFETCH_QUEUE_DEPTH = "prefetchQueueDepth"
+DONATED_BYTES = "donatedBytes"
+COALESCED_BYTES = "coalescedBytes"
 
 #: metric set every device operator registers up front (the ESSENTIAL tier
 #: of the reference's per-exec metric sets, GpuExec.scala:44-60); the
@@ -73,12 +77,13 @@ CORE_NODE_METRICS = (NUM_OUTPUT_ROWS, NUM_OUTPUT_BATCHES, OP_TIME)
 TIME_METRICS = frozenset({
     OP_TIME, SEMAPHORE_WAIT_TIME, UPLOAD_TIME, DOWNLOAD_TIME, SORT_TIME,
     AGG_TIME, JOIN_TIME, COMPILE_TIME, SHUFFLE_PARTITION_TIME,
+    PIPELINE_WAIT,
 })
 
 #: metric names whose values are BYTES (rendered human-readable)
 BYTE_METRICS = frozenset({
     UPLOAD_BYTES, DOWNLOAD_BYTES, SPILL_BYTES, SHUFFLE_BYTES,
-    PEAK_DEVICE_MEMORY,
+    PEAK_DEVICE_MEMORY, DONATED_BYTES, COALESCED_BYTES,
 })
 
 
@@ -164,31 +169,42 @@ class Histogram:
 
 
 class MetricRegistry:
-    """Per-exec metric set, filtered by the configured level."""
+    """Per-exec metric set, filtered by the configured level.
+
+    Thread-safe: pipelined execution (parallel/pipeline.py) drives one
+    node's registry from concurrent partition drains and map-side pools,
+    so counter updates and first-touch creation are locked — an unlocked
+    ``value += v`` would silently undercount the very metrics EXPLAIN
+    ANALYZE and tools/diagnose.py rank by."""
 
     def __init__(self, collect_level: int = MetricLevel.MODERATE):
         self.collect_level = collect_level
         self._metrics: Dict[str, Metric] = {}
         self._histograms: Dict[str, Histogram] = {}
+        self._lock = threading.Lock()
 
     def metric(self, name: str, level: int = MetricLevel.MODERATE) -> Metric:
-        m = self._metrics.get(name)
-        if m is None:
-            m = Metric(name, level)
-            self._metrics[name] = m
-        return m
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = Metric(name, level)
+                self._metrics[name] = m
+            return m
 
     def histogram(self, name: str,
                   level: int = MetricLevel.MODERATE) -> Histogram:
-        h = self._histograms.get(name)
-        if h is None:
-            h = Histogram(name, level)
-            self._histograms[name] = h
-        return h
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = Histogram(name, level)
+                self._histograms[name] = h
+            return h
 
     def add(self, name: str, v, level: int = MetricLevel.MODERATE):
         if level <= self.collect_level:
-            self.metric(name, level).add(v)
+            m = self.metric(name, level)
+            with self._lock:
+                m.add(v)
 
     def observe(self, name: str, v, level: int = MetricLevel.MODERATE):
         if level <= self.collect_level:
@@ -203,11 +219,13 @@ class MetricRegistry:
         try:
             yield
         finally:
-            self.metric(name, level).add(time.perf_counter() - t0)
+            self.add(name, time.perf_counter() - t0, level)
 
     def snapshot(self) -> Dict:
-        out: Dict = {k: m.value for k, m in self._metrics.items()}
-        for k, h in self._histograms.items():
+        with self._lock:
+            out: Dict = {k: m.value for k, m in self._metrics.items()}
+            hists = list(self._histograms.items())
+        for k, h in hists:
             out[k] = h.snapshot()
         return out
 
@@ -365,12 +383,18 @@ def _shuffle_source() -> Dict:
     return shuffle_stats()
 
 
+def _pipeline_source() -> Dict:
+    from ..parallel.pipeline import pipeline_stats
+    return pipeline_stats()
+
+
 _DEFAULT_SOURCES = {
     "compile_cache": _compile_cache_source,
     "catalog": _catalog_source,
     "semaphore": _semaphore_source,
     "upload_cache": _upload_cache_source,
     "shuffle": _shuffle_source,
+    "pipeline": _pipeline_source,
 }
 
 _GLOBAL_STATS: Optional[StatsRegistry] = None
