@@ -38,7 +38,10 @@ Env knobs: BENCH_MODE (auto|tpch22|q1q6), BENCH_SF, BENCH_SMOKE_SF,
 BENCH_PARTITIONS, BENCH_BUDGET_S, BENCH_PROBE_BUDGET_S, BENCH_PLATFORM
 (cpu forces the CPU backend), BENCH_XLA_CACHE, BENCH_QUERY_TIMEOUT_S,
 BENCH_ABLATION, BENCH_PIPELINE (on|off A/B knob for the pipelined
-executor, spark.rapids.tpu.pipeline.enabled; recorded in the bench JSON).
+executor, spark.rapids.tpu.pipeline.enabled; recorded in the bench JSON),
+BENCH_HEALTH (1|0: live health monitor per phase — /status snapshot +
+peak HBM watermark into the bench JSON, stall forensics appended to
+diagnose.txt), BENCH_STALL_TIMEOUT_S (watchdog threshold).
 """
 import atexit
 import hashlib
@@ -51,6 +54,7 @@ import sys
 import time
 
 _T_START = time.monotonic()
+_WALL_START = time.time()  # for filtering files produced by THIS run
 _REPO = os.path.dirname(os.path.abspath(__file__))
 _PARTIAL_PATH = os.path.join(_REPO, "BENCH_partial.json")
 
@@ -66,6 +70,7 @@ _STATE = {
     "sf": None,
     "rows": None,
     "eventlog": {},   # phase -> event-log directory
+    "health": {},     # phase -> /status snapshot + peak HBM watermark
     "pipeline": os.environ.get("BENCH_PIPELINE", "on"),  # A/B knob
     "notes": [],
 }
@@ -89,7 +94,7 @@ def _write_partial():
         json.dump({k: _STATE[k] for k in
                    ("backend", "fell_back", "sf", "rows", "smoke", "tpch",
                     "ablation", "compile_cache", "errors", "eventlog",
-                    "pipeline", "notes")}
+                    "health", "pipeline", "notes")}
                   | {"elapsed_s": round(time.monotonic() - _T_START, 2)},
                   f, indent=1)
     os.replace(tmp, _PARTIAL_PATH)
@@ -298,6 +303,8 @@ def _consume(ev):
                 _STATE[k] = ev[k]
         if "eventlog" in ev:
             _STATE["eventlog"].update(ev["eventlog"])
+        if "health" in ev:
+            _STATE["health"].update(ev["health"])
     elif kind == "ablation":
         _STATE["ablation"][ev["name"]] = ev["res"]
     _write_partial()
@@ -509,23 +516,36 @@ def _write_diagnose_report(phase: str):
     """Run the auto-diagnosis tool over this phase's event logs and write
     the ranked bottleneck report next to them
     (.bench_eventlogs/<phase>/diagnose.txt) — every BENCH round carries its
-    own per-query (node, metric) attribution, not just timings."""
-    if os.environ.get("BENCH_EVENTLOG", "1") == "0":
-        return
+    own per-query (node, metric) attribution, not just timings. Any
+    watchdog stall forensics (stall-<ts>.txt, written by the health
+    monitor into the same directory) are appended so a hung round
+    explains itself."""
     d = os.path.join(
         os.environ.get("BENCH_EVENTLOG_DIR",
                        os.path.join(_REPO, ".bench_eventlogs")), phase)
     try:
         import glob as _glob
 
-        from spark_rapids_tpu.tools.diagnose import diagnose_path
-        logs = sorted(_glob.glob(os.path.join(d, "*.jsonl")))
-        if not logs:
+        chunks = []
+        if os.environ.get("BENCH_EVENTLOG", "1") != "0":
+            from spark_rapids_tpu.tools.diagnose import diagnose_path
+            logs = sorted(_glob.glob(os.path.join(d, "*.jsonl")))
+            chunks = [diagnose_path(p).summary() for p in logs]
+        # stall forensics come from the health monitor (BENCH_HEALTH),
+        # which runs independently of the event-log knob; mtime filter
+        # keeps a previous round's stall files out of THIS round's report
+        if os.environ.get("BENCH_HEALTH", "1") != "0":
+            for sp in sorted(_glob.glob(os.path.join(d, "stall-*.txt"))):
+                if os.path.getmtime(sp) < _WALL_START:
+                    continue
+                with open(sp, encoding="utf-8") as f:
+                    chunks.append(f"== stall forensics: "
+                                  f"{os.path.basename(sp)} ==\n" + f.read())
+        if not chunks:
             return
-        text = "\n\n".join(diagnose_path(p).summary() for p in logs)
         out = os.path.join(d, "diagnose.txt")
         with open(out, "w", encoding="utf-8") as f:
-            f.write(text + "\n")
+            f.write("\n\n".join(chunks) + "\n")
         _log(f"{phase}: diagnose report -> {out}")
     except Exception as e:  # report generation must never fail the bench
         _log(f"{phase}: diagnose report failed: {type(e).__name__}: {e}")
@@ -549,6 +569,40 @@ def _pipeline_conf() -> dict:
     """BENCH_PIPELINE=on|off A/B knob -> session conf (default on)."""
     return {"spark.rapids.tpu.pipeline.enabled":
             os.environ.get("BENCH_PIPELINE", "on") != "off"}
+
+
+def _health_conf(phase: str) -> dict:
+    """Enable the live health monitor per phase: heartbeats land in the
+    phase event log, stall forensics land next to it (appended to
+    diagnose.txt), and the end-of-phase /status snapshot + peak HBM
+    watermark land in the bench JSON. BENCH_HEALTH=0 disables."""
+    if os.environ.get("BENCH_HEALTH", "1") == "0":
+        return {}
+    d = os.path.join(
+        os.environ.get("BENCH_EVENTLOG_DIR",
+                       os.path.join(_REPO, ".bench_eventlogs")), phase)
+    return {"spark.rapids.tpu.health.enabled": True,
+            "spark.rapids.tpu.health.intervalMs": 500,
+            "spark.rapids.tpu.health.stallTimeout": float(os.environ.get(
+                "BENCH_STALL_TIMEOUT_S", "120")),
+            "spark.rapids.tpu.health.reportDir": d}
+
+
+def _emit_health_snapshot(sink: "_EventSink", phase: str, sess) -> None:
+    """Capture the live /status snapshot + peak HBM watermark for the
+    bench JSON (never fails the bench)."""
+    if os.environ.get("BENCH_HEALTH", "1") == "0":
+        return
+    try:
+        snap = sess.health_status()
+        cat = snap.get("catalog") or {}
+        sink.emit(ev="meta", health={phase: {
+            "peak_device_bytes": cat.get("device_peak_bytes", 0),
+            "device_limit_bytes": cat.get("device_limit_bytes", 0),
+            "stalls_detected": snap.get("stalls_detected", 0),
+            "status": snap}})
+    except Exception as e:
+        _log(f"{phase}: health snapshot failed: {type(e).__name__}: {e}")
 
 
 def _rel_tol() -> float:
@@ -601,7 +655,8 @@ def _worker_smoke(sink: _EventSink):
     lineitem = tpch.gen_lineitem(sf, seed=0, rows=rows)
     sess = TpuSession({"spark.rapids.tpu.batchRowsMinBucket": 1 << 18,
                        **_pipeline_conf(),
-                       **_eventlog_conf("smoke", sink)})
+                       **_eventlog_conf("smoke", sink),
+                       **_health_conf("smoke")})
     df = sess.create_dataframe(lineitem, num_partitions=1).cache()
     t = {"lineitem": df}
 
@@ -662,6 +717,7 @@ def _worker_smoke(sink: _EventSink):
             sink.emit(ev="error", name=name,
                       msg=f"{type(e).__name__}: {e}"[:300])
             _log(f"smoke {name} FAILED: {e}")
+    _emit_health_snapshot(sink, "smoke", sess)
     sess.close()  # flush the event log
     _write_diagnose_report("smoke")
 
@@ -704,6 +760,7 @@ def _worker_tpch(sink: _EventSink):
         "spark.rapids.tpu.shuffle.partitions": nparts,
         **_pipeline_conf(),
         **_eventlog_conf("tpch", sink),
+        **_health_conf("tpch"),
     })
     dfs = tpch.build_dataframes(sess, tables, num_partitions=nparts)
 
@@ -742,6 +799,7 @@ def _worker_tpch(sink: _EventSink):
                       msg=f"{type(e).__name__}: {e}"[:300])
             _log(f"{name} FAILED: {e}")
     sink.emit(ev="meta", compile_cache=dict(cache_stats()))
+    _emit_health_snapshot(sink, "tpch", sess)
     sess.close()  # flush the event log
     _write_diagnose_report("tpch")
 

@@ -39,7 +39,24 @@ from . import dtypes as dt
 from .host import HostColumn, HostTable
 
 __all__ = ["DeviceColumn", "DeviceTable", "bucket_rows", "bucket_width",
-           "canonical_names"]
+           "canonical_names", "configure_debug", "debug_assertions_enabled"]
+
+# spark.rapids.tpu.debug.assertions snapshot (session-init chokepoint,
+# like parallel/pipeline.configure_pipeline — columns have no conf at
+# kernel-build time). Governs the gather all-valid guard below.
+_DEBUG_ASSERTIONS = False
+
+
+def configure_debug(conf) -> None:
+    """Apply spark.rapids.tpu.debug.* (called from TpuSession.__init__;
+    the most recent session wins)."""
+    global _DEBUG_ASSERTIONS
+    from ..conf import DEBUG_ASSERTIONS
+    _DEBUG_ASSERTIONS = bool(conf.get(DEBUG_ASSERTIONS))
+
+
+def debug_assertions_enabled() -> bool:
+    return _DEBUG_ASSERTIONS
 
 
 def canonical_names(n: int) -> Tuple[str, ...]:
@@ -85,7 +102,9 @@ def stable_counting_order(keys: jax.Array, num_vals: int) -> jax.Array:
 
 def _compact_impl(table: "DeviceTable") -> "DeviceTable":
     order = stable_partition_order(table.row_mask)
-    cols = tuple(c.gather(order) for c in table.columns)
+    # permutation + re-mask below: only real rows stay exposed
+    cols = tuple(c.gather(order, keep_all_valid=True)
+                 for c in table.columns)
     iota = jnp.arange(table.capacity, dtype=jnp.int32)
     mask = iota < table.num_rows
     # masked-off tail keeps stale data; null it for hygiene
@@ -179,17 +198,29 @@ class DeviceColumn:
     def is_nested(self) -> bool:
         return self.children is not None
 
-    def gather(self, idx: jax.Array) -> "DeviceColumn":
+    def gather(self, idx: jax.Array,
+               keep_all_valid: Optional[bool] = None) -> "DeviceColumn":
+        """Row gather. ``keep_all_valid`` is the caller's explicit
+        statement about the static ``all_valid`` promise (ADVICE #3):
+        a gather only preserves it when every row the caller EXPOSES
+        under the result's row mask maps to a real source row
+        (permutations, compaction, shuffle slices, join outputs that
+        re-mask) — ``True`` asserts that and keeps the promise; ``False``
+        drops it (always safe). ``None`` (implicit legacy call sites)
+        preserves it too, EXCEPT under spark.rapids.tpu.debug.assertions,
+        where the promise is dropped so an un-audited new call site
+        cannot silently expose padding garbage as non-null data."""
+        if keep_all_valid is None:
+            keep_all_valid = not _DEBUG_ASSERTIONS
         take = lambda a: None if a is None else jnp.take(a, idx, axis=0)
         kids = None if self.children is None \
-            else tuple(c.gather(idx) for c in self.children)
-        # a permutation/gather keeps the promise only when callers mask the
-        # result rows they expose; row-level gathers in this codebase do
-        # (compact, shuffle slice, join output), so the flag survives
+            else tuple(c.gather(idx, keep_all_valid=keep_all_valid)
+                       for c in self.children)
         return DeviceColumn(jnp.take(self.data, idx, axis=0),
                             jnp.take(self.validity, idx, axis=0),
                             self.dtype, take(self.lengths),
-                            take(self.elem_validity), kids, self.all_valid)
+                            take(self.elem_validity), kids,
+                            self.all_valid and keep_all_valid)
 
     def with_validity(self, validity: jax.Array,
                       all_valid: bool = False) -> "DeviceColumn":

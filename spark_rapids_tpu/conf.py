@@ -215,6 +215,16 @@ PARQUET_READER_TYPE = register_conf(
     "(reference: RapidsConf.scala:721).", "COALESCING",
     checker=_in("PERFILE", "COALESCING", "MULTITHREADED", "AUTO"))
 
+DEBUG_ASSERTIONS = register_conf(
+    "spark.rapids.tpu.debug.assertions",
+    "Enable extra runtime invariant guards on the columnar layer "
+    "(reference: spark.rapids.sql.debug assertions in GpuColumnVector): "
+    "today, DeviceColumn.gather drops the static all_valid promise for "
+    "call sites that did not explicitly pass keep_all_valid=True, so an "
+    "un-audited gather cannot expose padding garbage as non-null data. "
+    "Costs recompiles/extra validity reads; keep off in production.",
+    False)
+
 
 class RapidsConf:
     """An immutable snapshot of config values (reference ``RapidsConf`` class)."""
@@ -328,29 +338,29 @@ class RapidsConf:
         return "\n".join(lines) + "\n"
 
 
+def import_conf_modules() -> None:
+    """Import every module in the package (best effort) so all lazily
+    registered conf entries exist in the registry. Used before generating
+    docs/configs.md and by the tier-1 conf-docs lint (tests/test_health.py)
+    — a package walk, not a hand-maintained module list, because the list
+    version silently omitted whole registration sites (9 keys were missing
+    from the doc when the lint first ran)."""
+    import importlib
+    import pkgutil
+
+    import spark_rapids_tpu
+    for mod in pkgutil.walk_packages(spark_rapids_tpu.__path__,
+                                     "spark_rapids_tpu."):
+        try:
+            importlib.import_module(mod.name)
+        except Exception:
+            pass  # optional native/extension modules may not load
+
+
 def _write_docs(path: Optional[str] = None) -> str:
     """python -m spark_rapids_tpu.conf [outfile] regenerates docs/configs.md
     the way the reference wires RapidsConf.help() into its build."""
-    import importlib
-    # import the packages that register confs so the doc is complete
-    for mod in ("spark_rapids_tpu.session", "spark_rapids_tpu.memory.catalog",
-                "spark_rapids_tpu.shuffle.manager", "spark_rapids_tpu.udf",
-                "spark_rapids_tpu.io.parquet", "spark_rapids_tpu.plan.cbo",
-                "spark_rapids_tpu.plan.aqe", "spark_rapids_tpu.plan.planner",
-                "spark_rapids_tpu.plan.joins_planner",
-                "spark_rapids_tpu.exec.exchange", "spark_rapids_tpu.exec.cache",
-                "spark_rapids_tpu.exec.transitions",
-                "spark_rapids_tpu.exec.wholestage",
-                "spark_rapids_tpu.parallel.pipeline",
-                "spark_rapids_tpu.io.csv", "spark_rapids_tpu.io.csv_device",
-                "spark_rapids_tpu.io.orc", "spark_rapids_tpu.io.dump",
-                "spark_rapids_tpu.tools.eventlog",
-                "spark_rapids_tpu.utils.tracing",
-                "spark_rapids_tpu.utils.compile_cache"):
-        try:
-            importlib.import_module(mod)
-        except Exception:
-            pass
+    import_conf_modules()
     if path is None:
         path = os.path.join(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))), "docs", "configs.md")

@@ -624,7 +624,8 @@ class TpuHashAggregateExec(TpuExec):
             for kc in key_cols:
                 # representative-row gather; DeviceColumn.gather recurses
                 # into struct children and the element-validity plane
-                g = kc.gather(order).gather(rep)
+                g = kc.gather(order, keep_all_valid=True) \
+                    .gather(rep, keep_all_valid=True)
                 out_cols.append(g.with_validity(
                     jnp.logical_and(g.validity, group_mask)))
             # ---- state reductions

@@ -16,6 +16,7 @@ from typing import Callable, Iterator, Optional, Tuple
 
 from ..columnar.device import DeviceTable
 from ..columnar.host import HostTable
+from ..parallel.pipeline import note_progress
 from ..plan.physical import PhysicalPlan
 from ..utils import metrics as M
 from ..utils.metrics import CORE_NODE_METRICS, MetricRegistry
@@ -46,10 +47,17 @@ class TpuExec(PhysicalPlan):
         """Fold one produced batch into the core metrics. ``rows`` must be a
         HOST int when provided — passing a device scalar would force a sync
         on the hot path, so operators only report rows where the count is
-        already host-resident (the profiler counts exact rows externally)."""
+        already host-resident (the profiler counts exact rows externally).
+
+        Also bumps the engine-wide progress marker the health watchdog
+        compares across ticks (parallel/pipeline.py): without this,
+        sequential execution (pipeline.enabled=false) never touches a
+        prefetch queue or a pooled task and a long healthy drain would
+        read as a stall."""
         self.metrics.add(M.NUM_OUTPUT_BATCHES, 1)
         if rows is not None:
             self.metrics.add(M.NUM_OUTPUT_ROWS, int(rows))
+        note_progress()
 
     @property
     def num_partitions(self) -> int:

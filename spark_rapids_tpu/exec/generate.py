@@ -84,7 +84,7 @@ class TpuGenerateExec(TpuExec):
 
         out_cols: List[DeviceColumn] = []
         for c in batch.columns:
-            g = c.gather(src_c)
+            g = c.gather(src_c, keep_all_valid=True)
             out_cols.append(g.with_validity(
                 jnp.logical_and(g.validity, row_ok)))
         names = list(batch.names)

@@ -169,7 +169,7 @@ def _gather_columns(table: DeviceTable, idx: jax.Array, matched: jax.Array
                     ) -> List[DeviceColumn]:
     cols = []
     for c in table.columns:
-        g = c.gather(idx)
+        g = c.gather(idx, keep_all_valid=True)
         cols.append(g.with_validity(jnp.logical_and(g.validity, matched)))
     return cols
 
@@ -583,11 +583,13 @@ class _JoinKernels:
                 lnames = [n for n in node.left.schema.names if n in refs]
                 rnames = [n for n in node.right.schema.names if n in refs]
                 cols = tuple(
-                    [probe.column(n).gather(pi).with_validity(
+                    [probe.column(n).gather(pi, keep_all_valid=True)
+                     .with_validity(
                         jnp.logical_and(
                             jnp.take(probe.column(n).validity, pi),
                             valid_slot)) for n in lnames]
-                    + [build.column(n).gather(bi).with_validity(
+                    + [build.column(n).gather(bi, keep_all_valid=True)
+                       .with_validity(
                         jnp.logical_and(
                             jnp.take(build.column(n).validity, bi),
                             valid_slot)) for n in rnames])

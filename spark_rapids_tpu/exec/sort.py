@@ -86,7 +86,10 @@ def _order_keys(table: DeviceTable, orders: Sequence[SortOrder]) -> List[jax.Arr
 def device_sort_table(table: DeviceTable, orders: Sequence[SortOrder]) -> DeviceTable:
     keys = _order_keys(table, orders)
     order = jnp.lexsort(tuple(keys))
-    cols = tuple(c.gather(order) for c in table.columns)
+    # sort permutation parks masked-off rows past num_rows; the dense
+    # prefix mask below exposes only real rows (all_valid survives)
+    cols = tuple(c.gather(order, keep_all_valid=True)
+                 for c in table.columns)
     iota = jnp.arange(table.capacity, dtype=jnp.int32)
     mask = iota < table.num_rows
     return DeviceTable(cols, mask, table.num_rows, table.names)

@@ -144,7 +144,8 @@ class TpuWindowExec(TpuExec):
             keys = _order_keys(table, orders) if orders else \
                 [jnp.logical_not(table.row_mask)]
             order = jnp.lexsort(tuple(keys))
-            cols = tuple(c.gather(order) for c in table.columns)
+            cols = tuple(c.gather(order, keep_all_valid=True)
+                         for c in table.columns)
             iota = jnp.arange(table.capacity, dtype=jnp.int32)
             mask = iota < table.num_rows
             sorted_t = DeviceTable(cols, mask, table.num_rows, table.names)

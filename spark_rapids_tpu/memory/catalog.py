@@ -481,6 +481,56 @@ class BufferCatalog:
                 f"  {n}" for n in notes[-10:])
         return report
 
+    def watermarks(self, timeout_s: Optional[float] = None
+                   ) -> Optional[dict]:
+        """O(1) HBM used/peak snapshot for the health monitor's per-tick
+        sampling (utils/health.py). Uses the CACHED external byte counts —
+        a once-a-second tick must not call out through foreign locks the
+        way the cold stats()/oom_dump() paths may. With ``timeout_s``,
+        returns None instead of blocking when the catalog lock is held
+        past the timeout: the wedged lock-holder the watchdog reports on
+        must never wedge the watchdog itself."""
+        if timeout_s is None:
+            self._lock.acquire()
+        elif not self._lock.acquire(timeout=timeout_s):
+            return None
+        try:
+            ext = sum(self._external_cache.values())
+            return {
+                "device_used_bytes": self.device.used_bytes + ext,
+                "device_peak_bytes": self.peak_device_bytes,
+                "device_limit_bytes": self.device.limit_bytes,
+                "host_used_bytes": self.host.used_bytes,
+                "host_limit_bytes": self.host.limit_bytes,
+                "disk_used_bytes": self.disk.used_bytes,
+                "external_device_bytes": ext,
+                "buffers": len(self._buffers),
+            }
+        finally:
+            self._lock.release()
+
+    def watchdog_dump(self, timeout_s: float = 1.0) -> Optional[dict]:
+        """Stall-forensics snapshot that can never hang: bounded lock
+        acquire and NO calls out through external sources' locks (cached
+        bytes only) — unlike stats()/oom_dump(), which may block exactly
+        when the engine is wedged. None = lock unavailable (and that fact
+        itself belongs in the report)."""
+        if not self._lock.acquire(timeout=timeout_s):
+            return None
+        try:
+            tiers: Dict[str, int] = {}
+            for s in self._buffers.values():
+                name = StorageTier.NAMES[s.tier]
+                tiers[name] = tiers.get(name, 0) + 1
+            wm = self.watermarks()  # RLock: re-entrant, still bounded
+            return {**wm, "tiers": tiers,
+                    "spill_count": dict(self.spill_count),
+                    "spilled_bytes": dict(self.spilled_bytes),
+                    "oom_events": self.oom_events,
+                    "oom_callback_errors": self.oom_callback_errors}
+        finally:
+            self._lock.release()
+
     def stats(self) -> dict:
         with self._lock:
             tiers = {}

@@ -6,27 +6,50 @@ program at a time, so concurrent dispatch only adds queueing (SURVEY §7 hard
 part (d): the semaphore is mandatory, not advisory). Tasks acquire before
 their first device dispatch and release when blocked on host work (the
 python-worker pattern, GpuArrowEvalPythonExec.scala:306-332) or done.
+
+Every permit hold is attributed: the holder's thread name and acquire
+timestamp are recorded per task, final releases feed a held-duration
+histogram, and ``dump()`` snapshots holders + the wait queue — the health
+watchdog's stall forensics (utils/health.py) name the stuck thread instead
+of reporting an anonymous missing permit.
 """
 from __future__ import annotations
 
 import threading
 import time
 from contextlib import contextmanager
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 
 from ..conf import RapidsConf
+from ..utils.metrics import Histogram
 
 __all__ = ["TpuSemaphore", "get_semaphore", "peek_semaphore"]
+
+
+class _Hold:
+    """One task's live permit hold (reentrant depth + attribution)."""
+
+    __slots__ = ("depth", "thread_name", "thread_id", "acquired_at")
+
+    def __init__(self, thread_name: str, thread_id: int, acquired_at: float):
+        self.depth = 1
+        self.thread_name = thread_name
+        self.thread_id = thread_id
+        self.acquired_at = acquired_at
 
 
 class TpuSemaphore:
     def __init__(self, permits: int = 1):
         self.permits = permits
         self._sem = threading.BoundedSemaphore(permits)
-        self._holders: Dict[int, int] = {}  # task/thread id -> depth
+        self._holders: Dict[int, _Hold] = {}  # task/thread id -> hold
+        self._waiters: Dict[int, Tuple[str, float]] = {}  # id -> (name, t0)
         self._lock = threading.Lock()
         self.total_wait_time = 0.0
         self.acquire_count = 0
+        #: distribution of full-hold durations (acquire -> final release);
+        #: a fat tail here is the first hint of a permit-hogging operator
+        self.held_histogram = Histogram("semaphoreHeldSeconds")
 
     def acquire_if_necessary(self, task_id: Optional[int] = None):
         """Reentrant per task (reference: acquireIfNecessary semantics).
@@ -40,17 +63,26 @@ class TpuSemaphore:
             return
         tid = task_id if task_id is not None else threading.get_ident()
         with self._lock:
-            if self._holders.get(tid, 0) > 0:
-                self._holders[tid] += 1
+            hold = self._holders.get(tid)
+            if hold is not None:
+                hold.depth += 1
                 return
         from ..utils.tracing import get_tracer
+        thread = threading.current_thread()
         t0 = time.perf_counter()
-        with get_tracer().span("semaphore_wait", "semaphore", task=tid):
-            self._sem.acquire()
+        with self._lock:
+            self._waiters[tid] = (thread.name, time.monotonic())
+        try:
+            with get_tracer().span("semaphore_wait", "semaphore", task=tid):
+                self._sem.acquire()
+        finally:
+            with self._lock:
+                self._waiters.pop(tid, None)
         with self._lock:
             self.total_wait_time += time.perf_counter() - t0
             self.acquire_count += 1
-            self._holders[tid] = 1
+            self._holders[tid] = _Hold(thread.name, thread.ident or 0,
+                                       time.monotonic())
 
     def release_if_held(self, task_id: Optional[int] = None):
         # symmetric with acquire_if_necessary: inside an exempt scope a
@@ -62,13 +94,15 @@ class TpuSemaphore:
             return
         tid = task_id if task_id is not None else threading.get_ident()
         with self._lock:
-            depth = self._holders.get(tid, 0)
-            if depth == 0:
+            hold = self._holders.get(tid)
+            if hold is None:
                 return
-            if depth > 1:
-                self._holders[tid] = depth - 1
+            if hold.depth > 1:
+                hold.depth -= 1
                 return
             del self._holders[tid]
+            held_s = time.monotonic() - hold.acquired_at
+        self.held_histogram.observe(held_s)
         self._sem.release()
 
     def release_all(self, task_id: Optional[int] = None):
@@ -80,8 +114,9 @@ class TpuSemaphore:
         hold into the next task — the permit would leak forever."""
         tid = task_id if task_id is not None else threading.get_ident()
         with self._lock:
-            depth = self._holders.pop(tid, 0)
-        if depth > 0:
+            hold = self._holders.pop(tid, None)
+        if hold is not None:
+            self.held_histogram.observe(time.monotonic() - hold.acquired_at)
             self._sem.release()
 
     @contextmanager
@@ -101,6 +136,35 @@ class TpuSemaphore:
             yield
         finally:
             self.release_all(task_id)
+
+    # -- introspection (health watchdog / stats registry) ---------------------
+    def holder_count(self) -> int:
+        with self._lock:
+            return len(self._holders)
+
+    def waiter_count(self) -> int:
+        with self._lock:
+            return len(self._waiters)
+
+    def dump(self) -> Dict:
+        """Live admission state: per-holder thread name/depth/held-duration
+        and the wait queue — the watchdog report's semaphore section."""
+        now = time.monotonic()
+        with self._lock:
+            holders = [{"task_id": tid, "thread": h.thread_name,
+                        "thread_id": h.thread_id, "depth": h.depth,
+                        "held_s": round(now - h.acquired_at, 3)}
+                       for tid, h in self._holders.items()]
+            waiters = [{"task_id": tid, "thread": name,
+                        "waiting_s": round(now - since, 3)}
+                       for tid, (name, since) in self._waiters.items()]
+            out = {"permits": self.permits,
+                   "available": max(0, self.permits - len(holders)),
+                   "holders": holders, "waiters": waiters,
+                   "total_wait_s": round(self.total_wait_time, 6),
+                   "acquires": self.acquire_count}
+        out["held_seconds"] = self.held_histogram.snapshot()
+        return out
 
 
 _GLOBAL: Optional[TpuSemaphore] = None

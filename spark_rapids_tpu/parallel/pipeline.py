@@ -56,7 +56,7 @@ __all__ = ["PIPELINE_ENABLED", "PIPELINE_PREFETCH_DEPTH",
            "prefetch_depth", "task_pool_size", "prefetched",
            "maybe_prefetched", "pipelined_collect", "parallel_map",
            "active_workers", "shutdown_workers", "pipeline_stats",
-           "stage_name"]
+           "pipeline_snapshot", "note_progress", "stage_name"]
 
 
 def stage_name(node) -> str:
@@ -169,6 +169,55 @@ _WORKERS_LOCK = threading.Lock()
 _WORKERS: dict = {}            # thread -> cancel Event
 _STATS = {"workers_started": 0, "items_queued": 0, "stage_errors": 0,
           "tasks_run": 0}
+
+# live introspection for the health watchdog (utils/health.py): every
+# bounded prefetch queue and every in-flight pooled task registers here so
+# a stalled engine can report WHICH stage is wedged and for how long, and
+# a monotonically increasing progress marker distinguishes "slow" from
+# "stuck" (the stall detector compares tokens across ticks).
+import itertools as _it
+
+_QUEUE_IDS = _it.count()
+_QUEUES: dict = {}             # qid -> {"stage", "queue", "created"}
+_INFLIGHT_IDS = _it.count()
+_INFLIGHT: dict = {}           # token -> {"stage", "thread", "started"}
+_PROGRESS = {"counter": 0, "ts": time.monotonic()}
+
+
+def note_progress() -> None:
+    """Bump the engine-wide progress marker (an operator accounted a
+    batch, a batch crossed a stage boundary, or a task finished). The
+    stall detector treats an unchanged marker with work in flight as a
+    hang candidate.
+
+    Deliberately LOCK-FREE: this runs on the hottest per-batch paths
+    (exec/base.py account_batch, every queue hop), and the detector only
+    needs "did it move" — a racing increment that loses an update still
+    moves the counter, so taking _WORKERS_LOCK here would buy nothing
+    but cross-operator contention."""
+    _PROGRESS["counter"] += 1
+    _PROGRESS["ts"] = time.monotonic()
+
+
+def pipeline_snapshot() -> dict:
+    """Live pipeline state for /status and the watchdog report: per-queue
+    stage/depth/bound/age, in-flight pooled tasks with ages, worker count,
+    and the progress marker + its age."""
+    now = time.monotonic()
+    with _WORKERS_LOCK:
+        queues = [{"stage": info["stage"],
+                   "depth": info["queue"].qsize(),
+                   "bound": info["queue"].maxsize,
+                   "age_s": round(now - info["created"], 3)}
+                  for info in _QUEUES.values()]
+        in_flight = [{"stage": e["stage"], "thread": e["thread"],
+                      "age_s": round(now - e["started"], 3)}
+                     for e in _INFLIGHT.values()]
+        return {"queues": queues, "in_flight": in_flight,
+                "active_workers": sum(1 for t in _WORKERS if t.is_alive()),
+                "stats": dict(_STATS),
+                "progress_counter": _PROGRESS["counter"],
+                "last_progress_age_s": round(now - _PROGRESS["ts"], 3)}
 
 
 def configure_pipeline(conf) -> None:
@@ -318,6 +367,7 @@ def prefetched(make_iter: Callable[[], Iterator], *, stage: str,
                 for item in it:
                     with _WORKERS_LOCK:
                         _STATS["items_queued"] += 1
+                    note_progress()
                     # carry the thread-local input-file holder across the
                     # thread hop (io/file_block.py contract)
                     if not _put((item, current_input_file())):
@@ -339,9 +389,12 @@ def prefetched(make_iter: Callable[[], Iterator], *, stage: str,
 
     t = threading.Thread(target=produce, daemon=True,
                          name=f"tpu-prefetch:{stage}")
+    qid = next(_QUEUE_IDS)
     with _WORKERS_LOCK:
         _WORKERS[t] = cancel
         _STATS["workers_started"] += 1
+        _QUEUES[qid] = {"stage": stage, "queue": q,
+                        "created": time.monotonic()}
         # opportunistic GC of finished workers so the registry stays small
         for dead in [w for w in _WORKERS if not w.is_alive() and w is not t]:
             _WORKERS.pop(dead, None)
@@ -363,9 +416,12 @@ def prefetched(make_iter: Callable[[], Iterator], *, stage: str,
             if isinstance(item, _Failure):
                 raise item.exc
             batch, file_info = item
+            note_progress()
             set_input_file(*file_info)
             yield batch
     finally:
+        with _WORKERS_LOCK:
+            _QUEUES.pop(qid, None)
         cancel.set()
         # unblock a producer stuck in put()
         try:
@@ -410,9 +466,23 @@ def parallel_map(fn: Callable[[T], R], items: Sequence[T],
 
     def run_exempt(x):
         # pool threads run under the submitting task's admission (see
-        # semaphore_exempt); pipelined_collect re-opts into admission
-        with _worker_scope():
-            return fn(x)
+        # semaphore_exempt); pipelined_collect re-opts into admission.
+        # Register the task in the in-flight table (watchdog forensics:
+        # a wedged task shows its stage + age) and mark progress when it
+        # completes — either way — so the stall detector sees liveness.
+        token = next(_INFLIGHT_IDS)
+        with _WORKERS_LOCK:
+            _INFLIGHT[token] = {
+                "stage": stage,
+                "thread": threading.current_thread().name,
+                "started": time.monotonic()}
+        try:
+            with _worker_scope():
+                return fn(x)
+        finally:
+            with _WORKERS_LOCK:
+                _INFLIGHT.pop(token, None)
+            note_progress()
 
     with cf.ThreadPoolExecutor(
             max_workers=workers,

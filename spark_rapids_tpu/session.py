@@ -53,6 +53,16 @@ class TpuSession:
         # (prefetch depth / task pool; parallel/pipeline.py)
         from .parallel.pipeline import configure_pipeline
         configure_pipeline(self.conf)
+        # apply spark.rapids.tpu.debug.* to the columnar layer
+        # (gather all-valid guard; columnar/device.py)
+        from .columnar.device import configure_debug
+        configure_debug(self.conf)
+        # live health subsystem: watchdog monitor thread + optional HTTP
+        # status endpoints (utils/health.py + tools/statusd.py); None when
+        # health.enabled is false and health.port < 0 (the default)
+        from .utils.health import configure_health
+        self._health = configure_health(
+            self.conf, eventlog_fn=lambda: getattr(self, "_eventlog", None))
         TpuSession._active = self
 
     # -- device mesh (accelerated shuffle tier) ------------------------------
@@ -183,7 +193,24 @@ class TpuSession:
             self._eventlog = EventLogWriter(directory, app_id, snap)
         return self._eventlog
 
+    def health_status(self) -> Dict:
+        """The live /status snapshot as a dict (works whether or not the
+        monitor thread / HTTP server are running — bench.py captures one
+        per phase into the bench JSON)."""
+        health = getattr(self, "_health", None)
+        if health is not None:
+            return health.monitor.snapshot()
+        from .utils.health import HealthMonitor
+        return HealthMonitor(self.conf).snapshot()
+
     def close(self) -> None:
+        # stop the health subsystem FIRST: its monitor thread writes
+        # heartbeats into the event log closed below, and its HTTP server
+        # snapshots the runtime being shut down
+        health = getattr(self, "_health", None)
+        if health is not None:
+            health.close()
+            self._health = None
         # cancel + join any straggling pipeline prefetch workers (queries
         # that drained fully already left none; this is the abandoned-
         # iterator backstop, and the no-leaked-threads test contract)

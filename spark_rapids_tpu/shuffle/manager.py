@@ -277,7 +277,8 @@ class ShuffleManager:
             pids = jnp.where(batch.row_mask, pids, num_parts)  # park inactive
             order = _partition_order(pids, num_parts)
             sorted_tbl = DeviceTable(
-                tuple(c.gather(order) for c in batch.columns),
+                tuple(c.gather(order, keep_all_valid=True)
+                      for c in batch.columns),
                 jnp.take(batch.row_mask, order), batch.num_rows, batch.names)
             sorted_pids = np.asarray(jnp.take(pids, order))
             bounds = np.searchsorted(sorted_pids, np.arange(num_parts + 1))
@@ -321,7 +322,7 @@ class ShuffleManager:
             idx = jnp.clip(lo + jnp.arange(length, dtype=jnp.int32),
                            0, tbl.capacity - 1)
             mask = jnp.arange(length, dtype=jnp.int32) < (hi - lo)
-            cols = tuple(c.gather(idx).with_validity(
+            cols = tuple(c.gather(idx, keep_all_valid=True).with_validity(
                 jnp.take(c.validity, idx) & mask) for c in tbl.columns)
             return DeviceTable(cols, mask, jnp.int32(hi - lo), tbl.names)
 
@@ -332,7 +333,8 @@ class ShuffleManager:
             pids = jnp.where(batch.row_mask, pids, num_parts)
             order = _partition_order(pids, num_parts)
             sorted_tbl = DeviceTable(
-                tuple(c.gather(order) for c in batch.columns),
+                tuple(c.gather(order, keep_all_valid=True)
+                      for c in batch.columns),
                 jnp.take(batch.row_mask, order), batch.num_rows, batch.names)
             schema_tbl = sorted_tbl
             # count download only (4B/row), like the ICI exchange count pass

@@ -129,7 +129,55 @@ def _node_suggestion(name: str, metrics: Dict) -> str:
             "for its per-batch breakdown")
 
 
-def _diagnose_query(q) -> Optional[QueryDiagnosis]:
+#: heartbeat device_used / device_limit fraction above which a query is
+#: "in OOM territory" — spills/OOM are one bad batch away
+_HBM_PRESSURE_FLOOR = 0.9
+
+
+def _heartbeat_findings(q, heartbeats, wall: float) -> List[Finding]:
+    """v4 live-health signals: stall windows the watchdog flagged while
+    this query ran, and queries that heartbeated into OOM territory."""
+    hbs = q.heartbeats_in_window(heartbeats) \
+        if hasattr(q, "heartbeats_in_window") else []
+    findings: List[Finding] = []
+    stalled = [h for h in hbs if h.get("stalled")]
+    if stalled:
+        age = max(h.get("last_progress_age_s", 0.0) for h in stalled)
+        findings.append(Finding(
+            node="(query)", node_id=None, metric="stall",
+            seconds=age, fraction=min(1.0, age / wall) if wall else 1.0,
+            detail=f"watchdog stall window: {len(stalled)} heartbeat(s) "
+                   f"with zero engine progress (max no-progress age "
+                   f"{age:.1f}s)",
+            suggestion="read the stall-<ts>.txt forensics report "
+                       "(spark.rapids.tpu.health.reportDir) — it names "
+                       "the semaphore holder thread and its stack; a "
+                       "holder blocked on host work should release via "
+                       "task_scope/release_all"))
+    pressured = [h for h in hbs
+                 if h.get("device_limit_bytes", 0)
+                 and h.get("device_used_bytes", 0)
+                 >= _HBM_PRESSURE_FLOOR * h["device_limit_bytes"]]
+    if pressured:
+        worst = max(pressured,
+                    key=lambda h: h["device_used_bytes"]
+                    / h["device_limit_bytes"])
+        frac_used = worst["device_used_bytes"] / worst["device_limit_bytes"]
+        findings.append(Finding(
+            node="(query)", node_id=None, metric="hbmPressure",
+            seconds=0.0,
+            fraction=max(_FRACTION_FLOOR,
+                         frac_used - _HBM_PRESSURE_FLOOR),
+            detail=f"heartbeated into OOM territory: HBM at "
+                   f"{frac_used:.0%} of the pool limit on "
+                   f"{len(pressured)} of {len(hbs)} heartbeats",
+            suggestion="lower spark.rapids.sql.batchSizeBytes or raise "
+                       "spark.rapids.memory.gpu.allocFraction before "
+                       "this becomes a spill storm or an OOM"))
+    return findings
+
+
+def _diagnose_query(q, heartbeats=None) -> Optional[QueryDiagnosis]:
     wall = getattr(q, "wall_s", 0.0)
     if wall <= 0 or getattr(q, "error", None):
         return None
@@ -252,6 +300,9 @@ def _diagnose_query(q) -> Optional[QueryDiagnosis]:
                        "spark.rapids.sql.batchSizeBytes, or raise "
                        "spark.rapids.memory.host.spillStorageSize"))
 
+    # 5. live-health heartbeats (schema v4): stall windows + HBM pressure
+    findings.extend(_heartbeat_findings(q, heartbeats or [], wall))
+
     findings.sort(key=lambda f: -f.fraction)
     return QueryDiagnosis(q.query_id, wall, findings)
 
@@ -259,8 +310,9 @@ def _diagnose_query(q) -> Optional[QueryDiagnosis]:
 def diagnose_app(app, path: str = "") -> DiagnoseReport:
     """Diagnose a loaded AppReplay (tools/eventlog.py)."""
     queries = []
+    heartbeats = getattr(app, "heartbeats", [])
     for qid in sorted(app.queries):
-        d = _diagnose_query(app.queries[qid])
+        d = _diagnose_query(app.queries[qid], heartbeats)
         if d is not None:
             queries.append(d)
     return DiagnoseReport(path or getattr(app, "path", ""), queries)

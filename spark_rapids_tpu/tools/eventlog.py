@@ -16,6 +16,12 @@ file per session (``spark.rapids.tpu.eventLog.dir``), one record per event:
 - ``kernel`` (schema v3): one per XLA program the query touched — plan
   signature, owning node, compile wall, HLO cost / memory analysis
   (utils/compile_cache.py kernel table)
+- ``heartbeat`` (schema v4): periodic live-engine sample from the health
+  monitor (utils/health.py) — HBM used/peak/limit, semaphore
+  holders/waiters, pipeline queue depths + in-flight tasks, progress age
+  and the watchdog's stalled verdict; written from the monitor thread
+  (the writer is locked), so ``tools/diagnose.py`` can rank stall
+  windows and flag queries that heartbeated into OOM territory
 - ``query_end``: wall time, spill/semaphore deltas, AQE events, per-query
   process-counter deltas
 - ``app_end``
@@ -30,6 +36,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 from typing import Dict, List, Optional
 
@@ -42,7 +49,7 @@ __all__ = ["EventLogWriter", "load_event_log", "AppReplay", "QueryReplay",
 # docs/observability.md; tests/test_observability.py pins the current value
 # and the per-record required-key sets so replay/compare tooling can rely
 # on old logs staying loadable.
-SCHEMA_VERSION = 3
+SCHEMA_VERSION = 4
 
 EVENT_LOG_DIR = register_conf(
     "spark.rapids.tpu.eventLog.dir",
@@ -60,13 +67,23 @@ class EventLogWriter:
         self.path = os.path.join(directory, f"{app_id}.jsonl")
         self._f = open(self.path, "a", encoding="utf-8")
         self._query_seq = 0
+        # v4: the health monitor thread appends heartbeats while the query
+        # thread writes node/query records — serialize whole lines
+        self._lock = threading.Lock()
         self.write({"event": "app_start", "app_id": app_id,
                     "schema_version": SCHEMA_VERSION,
                     "ts": time.time(), "conf": conf_snapshot})
 
     def write(self, record: Dict) -> None:
-        self._f.write(json.dumps(record) + "\n")
-        self._f.flush()
+        line = json.dumps(record) + "\n"
+        with self._lock:
+            self._f.write(line)
+            self._f.flush()
+
+    def write_heartbeat(self, record: Dict) -> None:
+        """One schema-v4 heartbeat record (utils/health.py supplies the
+        flat sample dict; event type + wall-clock stamp added here)."""
+        self.write({"event": "heartbeat", "ts": time.time(), **record})
 
     def next_query_id(self) -> int:
         self._query_seq += 1
@@ -173,6 +190,19 @@ class QueryReplay:
         self.spill_count: Dict = {}
         self.semaphore_wait_s: float = 0.0
         self.stats: Dict = {}  # per-query process-counter deltas
+        # v4: wall-clock window (query_start.ts .. query_end.ts) so
+        # app-level heartbeats can be attributed to the running query
+        self.ts_start: float = 0.0
+        self.ts_end: float = 0.0
+
+    def heartbeats_in_window(self, heartbeats: List[Dict]) -> List[Dict]:
+        """App heartbeats whose timestamp falls inside this query's run
+        (v4; empty for pre-v4 logs — ts_start is 0)."""
+        if not self.ts_start:
+            return []
+        end = self.ts_end or float("inf")
+        return [h for h in heartbeats
+                if self.ts_start <= h.get("ts", 0.0) <= end]
 
     def summary(self) -> str:
         lines = [f"query {self.query_id}: wall={self.wall_s:.4f}s"
@@ -239,6 +269,7 @@ class AppReplay:
         self.schema_version: int = 1  # logs predating the field
         self.conf: Dict = {}
         self.queries: Dict[int, QueryReplay] = {}
+        self.heartbeats: List[Dict] = []  # v4: app-level monitor samples
 
     def query(self, qid: int) -> QueryReplay:
         return self.queries[qid]
@@ -280,6 +311,13 @@ class AppReplay:
                 warnings.append(
                     f"q{q.query_id}: OOM cache-drop callbacks raised "
                     "(see catalog diagnostics)")
+        stalled = [h for h in self.heartbeats if h.get("stalled")]
+        if stalled:
+            age = max(h.get("last_progress_age_s", 0.0) for h in stalled)
+            warnings.append(
+                f"watchdog: {len(stalled)} heartbeat(s) reported a stalled "
+                f"engine (max no-progress age {age:.1f}s) — see the "
+                "stall-<ts>.txt forensics reports")
         return warnings
 
 
@@ -300,6 +338,9 @@ def load_event_log(path: str) -> AppReplay:
                 q = app.queries.setdefault(rec["query_id"],
                                            QueryReplay(rec["query_id"]))
                 q.plan = rec.get("plan", "")
+                q.ts_start = rec.get("ts", 0.0)
+            elif ev == "heartbeat":
+                app.heartbeats.append(rec)
             elif ev == "node":
                 q = app.queries.setdefault(rec["query_id"],
                                            QueryReplay(rec["query_id"]))
@@ -313,6 +354,7 @@ def load_event_log(path: str) -> AppReplay:
                                            QueryReplay(rec["query_id"]))
                 q.wall_s = rec.get("wall_s", 0.0)
                 q.error = rec.get("error")
+                q.ts_end = rec.get("ts", 0.0)
                 q.final_plan = rec.get("final_plan", "")
                 q.aqe_events = rec.get("aqe_events", [])
                 q.spill_count = rec.get("spill_count", {})

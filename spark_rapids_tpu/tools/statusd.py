@@ -1,0 +1,121 @@
+"""HTTP status endpoints for the live health subsystem.
+
+The Spark live-UI analogue, cut down to what a load balancer and an
+operator actually poll (ROADMAP north star: serve heavy traffic — a
+fleet needs a liveness probe per process):
+
+- ``GET /healthz`` — liveness JSON; **503** while the watchdog considers
+  the engine stalled (work in flight, no progress past
+  ``spark.rapids.tpu.health.stallTimeout``), 200 otherwise. Load
+  balancers key off the status code alone.
+- ``GET /metrics`` — the process StatsRegistry as Prometheus text
+  exposition 0.0.4 (utils/metrics.py), same payload
+  ``prometheus_text()`` returns programmatically.
+- ``GET /status`` — the full live JSON snapshot
+  (``HealthMonitor.snapshot()``): semaphore holders/waiters, pipeline
+  queue depths + in-flight task ages, HBM watermarks, active operator
+  contexts, recent watermark history.
+
+stdlib ``http.server`` only (no new dependencies); a
+``ThreadingHTTPServer`` on 127.0.0.1 whose serve loop runs on a
+``tpu-health-httpd`` daemon thread — ``StatusServer.stop()`` (from
+``session.close()``) shuts it down, which the no-leaked-threads test
+asserts. Port 0 binds an ephemeral port (``StatusServer.port`` reports
+the bound one) so tests and multi-session hosts never collide.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import urlparse
+
+__all__ = ["StatusServer"]
+
+
+class _StatusHandler(BaseHTTPRequestHandler):
+    server_version = "spark-rapids-tpu-statusd"
+
+    def log_message(self, fmt, *args):  # no stderr chatter per request
+        pass
+
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+        monitor = self.server.monitor  # type: ignore[attr-defined]
+        path = urlparse(self.path).path.rstrip("/") or "/"
+        try:
+            if path == "/healthz":
+                if not monitor.ticking():
+                    # no monitor thread (health.port set without
+                    # health.enabled): sample on the probe itself so the
+                    # 503-while-stalled contract still holds; no
+                    # heartbeat — liveness polls must not flood the log
+                    monitor.tick(emit_heartbeat=False)
+                # cheap probe path: no full snapshot() — load balancers
+                # poll this every few seconds
+                body = {
+                    "status": "stalled" if monitor.stalled else "ok",
+                    "uptime_s": round(monitor.uptime_s(), 3),
+                    "stalls_detected": monitor.stalls_detected,
+                    "last_progress_age_s": round(
+                        monitor.last_progress_age_s(), 3),
+                }
+                self._send(503 if monitor.stalled else 200,
+                           json.dumps(body), "application/json")
+            elif path == "/metrics":
+                from ..utils.metrics import get_stats
+                self._send(200, get_stats().prometheus_text(),
+                           "text/plain; version=0.0.4")
+            elif path == "/status":
+                self._send(200,
+                           json.dumps(monitor.snapshot(), default=str),
+                           "application/json")
+            else:
+                self._send(404, json.dumps(
+                    {"error": "not found",
+                     "endpoints": ["/healthz", "/metrics", "/status"]}),
+                    "application/json")
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client went away mid-response
+
+    def _send(self, code: int, body: str, ctype: str) -> None:
+        payload = body.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+
+class StatusServer:
+    """Background HTTP server bound to 127.0.0.1 serving one monitor's
+    snapshots. Request handling is threaded (daemon threads), so /healthz
+    answers even while a long /status snapshot or a query runs."""
+
+    def __init__(self, monitor, port: int = 0, host: str = "127.0.0.1"):
+        self._httpd = ThreadingHTTPServer((host, port), _StatusHandler)
+        self._httpd.daemon_threads = True
+        self._httpd.monitor = monitor  # type: ignore[attr-defined]
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "StatusServer":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever, daemon=True,
+                name="tpu-health-httpd")
+            self._thread.start()
+        return self
+
+    def stop(self, timeout_s: float = 5.0) -> None:
+        t, self._thread = self._thread, None
+        if t is None:
+            return
+        self._httpd.shutdown()
+        t.join(timeout=timeout_s)
+        self._httpd.server_close()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"

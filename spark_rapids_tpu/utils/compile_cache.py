@@ -309,6 +309,11 @@ def _time_first_call(key: str, fn: Callable,
                     entry["compile_s"] += dt
                     _touch_locked(entry)
         if first:
+            # a finished compile IS engine progress: without this, a
+            # compile-heavy warm-up phase (many first dispatches, no
+            # batches accounted yet) looks frozen to the health watchdog
+            from ..parallel.pipeline import note_progress
+            note_progress()
             from .node_context import current_registry
             reg = current_registry()
             if reg is not None:

@@ -1,0 +1,446 @@
+"""Live engine health: watchdog monitor, stall forensics, heartbeats.
+
+PRs 1-2 built *post-hoc* observability (spans, event logs, EXPLAIN
+ANALYZE, diagnose) and PR 3 made the engine heavily concurrent (task
+pools, bounded prefetch queues, semaphore admission, materialize locks) —
+but nothing watched a *running* engine: a lock/semaphore interaction bug
+looked like a silent hang with zero forensics, and there was no endpoint
+an operator or load balancer could poll. This module is the Spark
+live-UI / executor-heartbeat analogue (reference: the plugin leans on
+Spark's heartbeats + live UI; Theseus, arxiv 2508.05029, treats runtime
+introspection of a pipelined engine as first-class):
+
+- ``HealthMonitor``: samples, on every tick, the TpuSemaphore state
+  (holders with thread names + held durations, wait queue), pipeline
+  queue depths and in-flight task ages (parallel/pipeline.py
+  introspection API), buffer-catalog HBM used/peak watermarks, and the
+  active (query, operator) context of every live thread.
+- **Heartbeats**: each tick appends a ``heartbeat`` record to the
+  session event log (schema v4, tools/eventlog.py) so post-hoc tools can
+  reconstruct the engine's live trajectory — ``tools/diagnose.py`` ranks
+  stall windows and flags queries that heartbeated into OOM territory.
+- **Stall detector**: if work is in flight but the engine-wide progress
+  marker has not moved for ``spark.rapids.tpu.health.stallTimeout``
+  seconds, a full forensics report — all-thread stacks via
+  ``sys._current_frames``, the semaphore dump (named holders +
+  held-durations), per-queue depths, in-flight task ages, active
+  operator contexts, and the catalog dump — goes to the diagnostics
+  channel and a ``stall-<ts>.txt`` file.
+- The HTTP surface (``/healthz``, ``/metrics``, ``/status``) lives in
+  ``tools/statusd.py`` and serves this monitor's snapshots.
+
+The monitor thread is off by default and every sample is driven by
+``tick()``, which takes an explicit ``now`` — tests inject stalls and
+advance time deterministically without sleeping.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+import traceback
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+from ..conf import register_conf
+
+__all__ = ["HEALTH_ENABLED", "HEALTH_INTERVAL_MS", "HEALTH_STALL_TIMEOUT",
+           "HEALTH_PORT", "HEALTH_REPORT_DIR", "HealthMonitor",
+           "HealthSubsystem", "configure_health"]
+
+HEALTH_ENABLED = register_conf(
+    "spark.rapids.tpu.health.enabled",
+    "Run the background health monitor thread: per-tick heartbeat records "
+    "into the event log (schema v4), HBM watermark sampling, and the stall "
+    "watchdog (no-progress-with-work-in-flight dumps all-thread stacks, "
+    "semaphore holders and queue states to the diagnostics channel and a "
+    "stall-<ts>.txt file). The Spark executor-heartbeat / live-UI "
+    "analogue. Off by default; tests drive HealthMonitor.tick() "
+    "deterministically instead.", False)
+
+HEALTH_INTERVAL_MS = register_conf(
+    "spark.rapids.tpu.health.intervalMs",
+    "Health monitor tick interval in milliseconds (heartbeat cadence and "
+    "stall-detection resolution).", 1000,
+    checker=lambda v: None if int(v) > 0 else "must be positive")
+
+HEALTH_STALL_TIMEOUT = register_conf(
+    "spark.rapids.tpu.health.stallTimeout",
+    "Seconds of zero engine progress (no operator batch accounted, no "
+    "batch crossed a stage boundary, no task completed, no semaphore "
+    "admission) while work is in flight before the watchdog declares a "
+    "stall and dumps the forensics report. Progress is observed at "
+    "batch/queue/task granularity, so this must exceed the longest "
+    "single device dispatch your workload legitimately runs. Detection "
+    "resolution is one tick (health.intervalMs).", 120.0,
+    conf_type=float,
+    checker=lambda v: None if float(v) > 0 else "must be positive")
+
+HEALTH_PORT = register_conf(
+    "spark.rapids.tpu.health.port",
+    "HTTP status endpoint port serving /healthz (liveness; 503 while "
+    "stalled), /metrics (Prometheus text exposition of the process stats "
+    "registry) and /status (live JSON snapshot: semaphore, pipeline "
+    "queues, HBM watermarks, active operators). -1 disables the server; "
+    "0 binds an ephemeral port (tests); >0 binds that port on 127.0.0.1.",
+    -1)
+
+HEALTH_REPORT_DIR = register_conf(
+    "spark.rapids.tpu.health.reportDir",
+    "Directory for watchdog stall forensics files (stall-<ts>.txt). Empty "
+    "keeps reports in memory + the catalog diagnostics channel only "
+    "(reference: spark.rapids.memory.gpu.oomDumpDir state dumps).", "")
+
+
+class HealthMonitor:
+    """Samples live engine state; detects stalls; emits heartbeats.
+
+    ``tick(now=None)`` performs exactly one sample and is safe to call
+    from tests with a fabricated clock; ``start()``/``stop()`` run the
+    same tick on a daemon thread at ``health.intervalMs``.
+    """
+
+    def __init__(self, conf, eventlog_fn: Optional[Callable] = None):
+        self.interval_s = int(conf.get(HEALTH_INTERVAL_MS)) / 1000.0
+        self.stall_timeout_s = float(conf.get(HEALTH_STALL_TIMEOUT))
+        self.report_dir = str(conf.get(HEALTH_REPORT_DIR) or "")
+        # returns the session's EventLogWriter or None (heartbeats must
+        # not conjure a writer: no eventLog.dir -> no log)
+        self._eventlog_fn = eventlog_fn or (lambda: None)
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._tick_lock = threading.Lock()
+        self.started_at = time.monotonic()
+        self.ticks = 0
+        self.tick_errors = 0
+        self.heartbeats_emitted = 0
+        self._seq = 0
+        # stall-detector state: token = engine-wide progress marker;
+        # unchanged token + work in flight + timeout elapsed => stall
+        self._last_token = None
+        self._last_progress = time.monotonic()
+        self._stall_active = False
+        self._was_in_flight = False
+        self.stalled = False
+        self.stalls_detected = 0
+        self.last_stall_report: Optional[str] = None
+        self.last_stall_report_path: Optional[str] = None
+        #: per-tick HBM watermark samples (catalog.watermarks())
+        self.watermark_history: deque = deque(maxlen=256)
+
+    # -- lifecycle ------------------------------------------------------------
+    def start(self) -> "HealthMonitor":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.tick()
+                except Exception:
+                    # the watchdog must never die of its own bug; count
+                    # and keep ticking
+                    self.tick_errors += 1
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="tpu-health-monitor")
+        self._thread.start()
+        return self
+
+    def stop(self, timeout_s: float = 5.0) -> None:
+        t, self._thread = self._thread, None
+        if t is None:
+            return
+        self._stop.set()
+        t.join(timeout=timeout_s)
+
+    # -- sampling -------------------------------------------------------------
+    def tick(self, now: Optional[float] = None,
+             emit_heartbeat: bool = True) -> Optional[str]:
+        """One watchdog sample. ``now`` is in the ``time.monotonic()``
+        domain (tests pass fabricated values to cross the stall timeout
+        without sleeping). ``emit_heartbeat=False`` skips the event-log
+        record (the /healthz probe-driven path, tools/statusd.py: liveness
+        polls must not flood the log). Returns the forensics report text
+        when THIS tick fired the stall detector, else None."""
+        with self._tick_lock:
+            return self._tick_locked(
+                time.monotonic() if now is None else now, emit_heartbeat)
+
+    def _tick_locked(self, now: float, emit_heartbeat: bool) -> Optional[str]:
+        from ..memory.catalog import peek_catalog
+        from ..memory.semaphore import peek_semaphore
+        from ..parallel.pipeline import pipeline_snapshot
+        from .metrics import get_stats
+        self.ticks += 1
+        get_stats().add("health_ticks")
+        # sample every subsystem ONCE per tick: the progress token, the
+        # in-flight check and the heartbeat all read this one sample, so
+        # they agree with each other and each tick takes each subsystem
+        # lock exactly once
+        sem = peek_semaphore()
+        snap = pipeline_snapshot()
+        cat = peek_catalog()
+        # bounded acquire: if a wedged thread holds the catalog lock (the
+        # very hang this monitor exists to report), the tick skips the
+        # watermark sample instead of joining the hang
+        wm = (cat.watermarks(timeout_s=0.5) if cat is not None else None) \
+            or {}
+        # token: changes whenever the engine demonstrably moved — a batch
+        # was accounted (exec/base.py), crossed a prefetch queue, a pooled
+        # task finished, or a task was admitted (signals a wedged engine
+        # cannot fake)
+        token = (snap["progress_counter"],
+                 sem.acquire_count if sem is not None else 0)
+        if self._last_token is None or token != self._last_token:
+            self._last_token = token
+            self._last_progress = now
+            self._stall_active = False
+        age = max(0.0, now - self._last_progress)
+        if wm:
+            self.watermark_history.append({"ts": time.time(), **wm})
+        in_flight = bool(snap["in_flight"] or snap["active_workers"]
+                         or (sem is not None
+                             and (sem.holder_count() > 0
+                                  or sem.waiter_count() > 0)))
+        if in_flight and not self._was_in_flight:
+            # idle -> busy transition: the progress clock was legitimately
+            # frozen while idle; restart it or the first slow stage of a
+            # new query after a long quiet gap reads as an instant stall
+            self._last_progress = now
+            age = 0.0
+            self._stall_active = False
+        self._was_in_flight = in_flight
+        # stall detection: once per stall episode (re-arms on progress)
+        report = None
+        self.stalled = False
+        if in_flight and age >= self.stall_timeout_s:
+            self.stalled = True
+            if not self._stall_active:
+                self._stall_active = True
+                self.stalls_detected += 1
+                report = self._emit_stall_report(age)
+        # heartbeat AFTER detection so the record carries this tick's
+        # stalled verdict
+        log = self._eventlog_fn() if emit_heartbeat else None
+        if log is not None:
+            try:
+                log.write_heartbeat(
+                    self._heartbeat_from(age, snap, wm, sem))
+                self.heartbeats_emitted += 1
+                get_stats().add("health_heartbeats")
+            except Exception:
+                self.tick_errors += 1
+        return report
+
+    # -- records / snapshots ---------------------------------------------------
+    def _heartbeat_from(self, age: float, snap: Dict, wm: Dict, sem) -> Dict:
+        """One schema-v4 heartbeat dict from tick()'s single per-tick
+        sample (required keys pinned by tests/test_health.py)."""
+        queues: Dict[str, int] = {}
+        for q in snap["queues"]:
+            # concurrent partition drains open one queue per partition
+            # under the SAME stage label — sum them so no depth is lost
+            queues[q["stage"]] = queues.get(q["stage"], 0) + q["depth"]
+        self._seq += 1
+        return {
+            "seq": self._seq,
+            "uptime_s": round(self.uptime_s(), 3),
+            "device_used_bytes": wm.get("device_used_bytes", 0),
+            "device_peak_bytes": wm.get("device_peak_bytes", 0),
+            "device_limit_bytes": wm.get("device_limit_bytes", 0),
+            "semaphore_holders":
+                sem.holder_count() if sem is not None else 0,
+            "semaphore_waiters":
+                sem.waiter_count() if sem is not None else 0,
+            "queues": queues,
+            "queue_depth": sum(q["depth"] for q in snap["queues"]),
+            "in_flight": len(snap["in_flight"]),
+            "active_workers": snap["active_workers"],
+            "last_progress_age_s": round(age, 3),
+            "stalled": self.stalled,
+        }
+
+    def uptime_s(self) -> float:
+        return max(0.0, time.monotonic() - self.started_at)
+
+    def ticking(self) -> bool:
+        """True when the monitor thread is running (health.enabled); False
+        means samples only happen on explicit tick() calls — the status
+        server then ticks on /healthz probes so stall detection still
+        works with only health.port set."""
+        return self._thread is not None
+
+    def last_progress_age_s(self, now: Optional[float] = None) -> float:
+        now = time.monotonic() if now is None else now
+        return max(0.0, now - self._last_progress)
+
+    def snapshot(self) -> Dict:
+        """The /status payload: full live engine state as one JSON-able
+        dict (also captured per phase into the bench JSON)."""
+        from ..memory.catalog import peek_catalog
+        from ..memory.semaphore import peek_semaphore
+        from ..parallel.pipeline import pipeline_snapshot
+        from .node_context import active_contexts
+        cat = peek_catalog()
+        sem = peek_semaphore()
+        return {
+            "ts": time.time(),
+            "uptime_s": round(time.monotonic() - self.started_at, 3),
+            "ticks": self.ticks,
+            "tick_errors": self.tick_errors,
+            "heartbeats_emitted": self.heartbeats_emitted,
+            "stalled": self.stalled,
+            "stalls_detected": self.stalls_detected,
+            "last_stall_report_path": self.last_stall_report_path,
+            "last_progress_age_s": round(self.last_progress_age_s(), 3),
+            "semaphore": sem.dump() if sem is not None else None,
+            "pipeline": pipeline_snapshot(),
+            "catalog":
+                cat.watermarks(timeout_s=0.5) if cat is not None else None,
+            "active_operators": active_contexts(),
+            "watermark_history": list(self.watermark_history)[-32:],
+        }
+
+    # -- stall forensics -------------------------------------------------------
+    def stall_report(self, age: float) -> str:
+        """Full forensics text: every thread's stack, the semaphore dump
+        (named holders + wait queue), per-queue depths + in-flight task
+        ages, active operator contexts, and the catalog dump."""
+        from ..memory.catalog import peek_catalog
+        from ..memory.semaphore import peek_semaphore
+        from ..parallel.pipeline import pipeline_snapshot
+        from .node_context import active_contexts
+        lines: List[str] = [
+            "== spark-rapids-tpu stall report ==",
+            time.strftime("time: %Y-%m-%dT%H:%M:%S%z"),
+            f"no engine progress for {age:.1f}s with work in flight "
+            f"(stallTimeout={self.stall_timeout_s:.1f}s)",
+        ]
+        sem = peek_semaphore()
+        lines.append("\n-- semaphore --")
+        if sem is None:
+            lines.append("(no semaphore created yet)")
+        else:
+            d = sem.dump()
+            lines.append(f"permits={d['permits']} available={d['available']}"
+                         f" acquires={d['acquires']}"
+                         f" total_wait_s={d['total_wait_s']}")
+            for h in d["holders"]:
+                lines.append(
+                    f"holder: thread={h['thread']!r} (id {h['thread_id']}) "
+                    f"task={h['task_id']} depth={h['depth']} "
+                    f"held for {h['held_s']:.1f}s")
+            for w in d["waiters"]:
+                lines.append(f"waiter: thread={w['thread']!r} "
+                             f"task={w['task_id']} "
+                             f"waiting for {w['waiting_s']:.1f}s")
+        snap = pipeline_snapshot()
+        lines.append("\n-- pipeline --")
+        lines.append(f"active_workers={snap['active_workers']} "
+                     f"progress_counter={snap['progress_counter']} "
+                     f"last_progress_age_s={snap['last_progress_age_s']}")
+        for q in snap["queues"]:
+            lines.append(f"queue: stage={q['stage']!r} depth={q['depth']}/"
+                         f"{q['bound']} age={q['age_s']:.1f}s")
+        if not snap["queues"]:
+            lines.append("(no live prefetch queues)")
+        for tsk in snap["in_flight"]:
+            lines.append(f"in-flight task: stage={tsk['stage']!r} "
+                         f"thread={tsk['thread']!r} "
+                         f"running for {tsk['age_s']:.1f}s")
+        lines.append("\n-- active operator contexts --")
+        ctxs = active_contexts()
+        lines.extend(f"{name}: {desc}" for name, desc in sorted(ctxs.items()))
+        if not ctxs:
+            lines.append("(no instrumented operators executing)")
+        lines.append("\n-- thread stacks --")
+        names = {t.ident: t.name for t in threading.enumerate()}
+        for tid, frame in sorted(sys._current_frames().items()):
+            lines.append(f"thread {names.get(tid, '?')!r} (id {tid}):")
+            lines.append("".join(traceback.format_stack(frame)).rstrip())
+        lines.append("\n-- catalog --")
+        cat = peek_catalog()
+        if cat is None:
+            lines.append("(no buffer catalog created yet)")
+        else:
+            # bounded, no-foreign-locks dump: stats()/oom_dump() can block
+            # on the very lock the wedged thread holds
+            dump = cat.watchdog_dump(timeout_s=1.0)
+            if dump is None:
+                lines.append("catalog lock UNAVAILABLE after 1s — a "
+                             "holder is likely wedged (see stacks above)")
+            else:
+                lines.append(f"dump: {dump}")
+        return "\n".join(lines) + "\n"
+
+    def _emit_stall_report(self, age: float) -> str:
+        from ..memory.catalog import peek_catalog
+        from .metrics import get_stats
+        from .tracing import get_tracer
+        report = self.stall_report(age)
+        self.last_stall_report = report
+        get_stats().add("health_stalls_detected")
+        get_tracer().instant("stall_detected", "health",
+                             age_s=round(age, 1))
+        path = None
+        if self.report_dir:
+            try:
+                os.makedirs(self.report_dir, exist_ok=True)
+                path = os.path.join(self.report_dir,
+                                    f"stall-{int(time.time() * 1000)}.txt")
+                with open(path, "w", encoding="utf-8") as f:
+                    f.write(report)
+                self.last_stall_report_path = path
+            except OSError:
+                path = None
+        cat = peek_catalog()
+        if cat is not None:
+            cat.diagnostics.append(
+                f"watchdog stall: no progress for {age:.1f}s"
+                + (f" (report: {path})" if path else ""))
+        import warnings
+        warnings.warn(
+            f"spark-rapids-tpu watchdog: engine stalled (no progress for "
+            f"{age:.1f}s with work in flight)"
+            + (f"; forensics at {path}" if path else ""),
+            RuntimeWarning)
+        return report
+
+
+class HealthSubsystem:
+    """One session's live-health wiring: the monitor plus the optional
+    HTTP status server; ``close()`` tears both down (the no-leaked-threads
+    contract extends to tpu-health-* threads)."""
+
+    def __init__(self, monitor: HealthMonitor, server=None):
+        self.monitor = monitor
+        self.server = server
+
+    def close(self) -> None:
+        self.monitor.stop()
+        if self.server is not None:
+            self.server.stop()
+            self.server = None
+
+
+def configure_health(conf, eventlog_fn: Optional[Callable] = None
+                     ) -> Optional[HealthSubsystem]:
+    """Session-init chokepoint (TpuSession.__init__): start the monitor
+    thread when ``health.enabled`` and the HTTP server when ``health.port``
+    >= 0. Returns None when both are off — the common case costs nothing."""
+    enabled = bool(conf.get(HEALTH_ENABLED))
+    port = int(conf.get(HEALTH_PORT))
+    if not enabled and port < 0:
+        return None
+    monitor = HealthMonitor(conf, eventlog_fn=eventlog_fn)
+    server = None
+    if port >= 0:
+        from ..tools.statusd import StatusServer
+        server = StatusServer(monitor, port=port)
+        server.start()
+    if enabled:
+        monitor.start()
+    return HealthSubsystem(monitor, server)

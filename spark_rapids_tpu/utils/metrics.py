@@ -370,7 +370,10 @@ def _semaphore_source() -> Dict:
     if sem is None:
         return {}
     return {"wait_seconds": sem.total_wait_time,
-            "acquires": sem.acquire_count}
+            "acquires": sem.acquire_count,
+            "holders": sem.holder_count(),
+            "waiters": sem.waiter_count(),
+            "held_seconds": sem.held_histogram.snapshot()}
 
 
 def _upload_cache_source() -> Dict:
@@ -388,6 +391,11 @@ def _pipeline_source() -> Dict:
     return pipeline_stats()
 
 
+def _tracer_source() -> Dict:
+    from .tracing import tracer_stats
+    return tracer_stats()
+
+
 _DEFAULT_SOURCES = {
     "compile_cache": _compile_cache_source,
     "catalog": _catalog_source,
@@ -395,6 +403,7 @@ _DEFAULT_SOURCES = {
     "upload_cache": _upload_cache_source,
     "shuffle": _shuffle_source,
     "pipeline": _pipeline_source,
+    "tracer": _tracer_source,
 }
 
 _GLOBAL_STATS: Optional[StatsRegistry] = None

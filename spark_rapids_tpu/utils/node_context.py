@@ -19,7 +19,8 @@ import threading
 from contextlib import contextmanager
 from typing import Optional
 
-__all__ = ["NodeContext", "node_scope", "current", "current_registry"]
+__all__ = ["NodeContext", "node_scope", "current", "current_registry",
+           "active_contexts"]
 
 
 class NodeContext:
@@ -38,11 +39,19 @@ class NodeContext:
 
 _TLS = threading.local()
 
+# cross-thread registry of every thread's context stack, so the health
+# watchdog (utils/health.py) can report which (query, operator) each live
+# thread is executing — a thread-local alone is invisible from the monitor
+_ALL_LOCK = threading.Lock()
+_ALL_STACKS: dict = {}  # thread ident -> that thread's stack list
+
 
 def _stack():
     st = getattr(_TLS, "stack", None)
     if st is None:
         st = _TLS.stack = []
+        with _ALL_LOCK:
+            _ALL_STACKS[threading.get_ident()] = st
     return st
 
 
@@ -71,3 +80,25 @@ def current_registry():
     """The innermost executing node's MetricRegistry, or None."""
     ctx = current()
     return ctx.registry if ctx is not None else None
+
+
+def active_contexts() -> dict:
+    """Best-effort {thread name: innermost context} across ALL live
+    threads (the watchdog's "what was everyone doing" section). Reads
+    other threads' stacks racily — a context may pop mid-read — so stale
+    or missing entries are tolerated, never an error."""
+    alive = {t.ident: t.name for t in threading.enumerate()}
+    with _ALL_LOCK:
+        # GC stacks of threads that have exited
+        for tid in [tid for tid in _ALL_STACKS if tid not in alive]:
+            del _ALL_STACKS[tid]
+        items = list(_ALL_STACKS.items())
+    out = {}
+    for tid, st in items:
+        try:
+            ctx = st[-1]
+        except IndexError:
+            continue
+        out[alive.get(tid, str(tid))] = (
+            f"query={ctx.query_id} node={ctx.node_id} {ctx.name}")
+    return out

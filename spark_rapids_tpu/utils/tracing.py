@@ -32,8 +32,8 @@ from typing import Dict, List, Optional
 from ..conf import register_conf
 
 __all__ = ["TraceEvent", "Tracer", "get_tracer", "set_tracer",
-           "configure_tracer", "TRACE_ENABLED", "TRACE_BUFFER_SIZE",
-           "TRACE_DIR"]
+           "configure_tracer", "tracer_stats", "TRACE_ENABLED",
+           "TRACE_BUFFER_SIZE", "TRACE_DIR"]
 
 TRACE_ENABLED = register_conf(
     "spark.rapids.tpu.trace.enabled",
@@ -102,6 +102,7 @@ class Tracer:
         self._tls = threading.local()
         self.epoch = time.perf_counter()
         self.dropped = 0
+        self._drop_warned = False
 
     # -- recording ------------------------------------------------------------
     def _stack(self) -> List[str]:
@@ -111,10 +112,23 @@ class Tracer:
         return st
 
     def _record(self, ev: TraceEvent) -> None:
+        warn = False
         with self._lock:
             if len(self._events) == self.capacity:
                 self.dropped += 1
+                if not self._drop_warned:
+                    self._drop_warned = True
+                    warn = True
             self._events.append(ev)
+        if warn:
+            # once per session of drops: a wrapped ring buffer means the
+            # exported Chrome trace is silently truncated at the front
+            import warnings
+            warnings.warn(
+                "tracer ring buffer wrapped — oldest spans are being "
+                "dropped and the exported trace will be truncated; raise "
+                "spark.rapids.tpu.trace.bufferSize "
+                f"(currently {self.capacity})", RuntimeWarning)
 
     @contextmanager
     def span(self, name: str, cat: str = "misc", **args):
@@ -166,6 +180,7 @@ class Tracer:
         with self._lock:
             self._events.clear()
             self.dropped = 0
+            self._drop_warned = False
 
     def to_chrome_trace(self) -> Dict:
         """Chrome trace-event JSON object ({"traceEvents": [...]}), loadable
@@ -189,6 +204,17 @@ class Tracer:
         with open(path, "w", encoding="utf-8") as f:
             json.dump(self.to_chrome_trace(), f)
         return path
+
+
+def tracer_stats() -> Dict:
+    """Flat tracer counters for the process StatsRegistry (utils/metrics.py)
+    — ``spans_dropped`` > 0 flags a truncated Perfetto trace that would
+    otherwise silently mislead."""
+    t = get_tracer()
+    with t._lock:
+        return {"enabled": t.enabled, "capacity": t.capacity,
+                "events_buffered": len(t._events),
+                "spans_dropped": t.dropped}
 
 
 _GLOBAL = Tracer()

@@ -1,0 +1,611 @@
+"""Live engine health subsystem (utils/health.py + tools/statusd.py).
+
+Covers the PR 4 acceptance contract:
+- an injected stall (semaphore holder sleeping past health.stallTimeout)
+  is detected by a deterministic manual tick and the forensics report
+  names the holder thread, per-queue depths and the catalog dump,
+- /healthz, /metrics and /status respond while a query runs (probed from
+  inside a mapInPandas UDF) and die with session.close(),
+- event-log schema v4: heartbeat records round-trip through
+  load_event_log and tools/diagnose.py (stall windows ranked, queries
+  that heartbeated into OOM territory flagged),
+- no monitor/HTTP threads leak after session.close(),
+- the tier-1 conf-docs lint: every registered spark.rapids.* conf key
+  appears in docs/configs.md,
+- satellites: semaphore holder attribution + held-duration histogram,
+  tracer spans_dropped counting (warn-once), and the explicit
+  DeviceColumn.gather keep_all_valid contract.
+"""
+import glob
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+import warnings
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.conf import RapidsConf
+from spark_rapids_tpu.session import TpuSession
+from spark_rapids_tpu.utils.health import HealthMonitor
+
+
+# ---------------------------------------------------------------------------
+# semaphore attribution (satellite): named holders, wait queue, held hist
+# ---------------------------------------------------------------------------
+def test_semaphore_dump_names_holders_and_waiters():
+    from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+
+    sem = TpuSemaphore(1)
+    ready, release = threading.Event(), threading.Event()
+
+    def holder():
+        sem.acquire_if_necessary()
+        sem.acquire_if_necessary()  # reentrant: depth 2, one permit
+        ready.set()
+        release.wait(10)
+        sem.release_all()
+
+    t = threading.Thread(target=holder, name="permit-hog", daemon=True)
+    t.start()
+    assert ready.wait(5)
+    waiter_going = threading.Event()
+
+    def waiter():
+        waiter_going.set()
+        with sem.task_scope():
+            pass
+
+    w = threading.Thread(target=waiter, name="permit-waiter", daemon=True)
+    w.start()
+    assert waiter_going.wait(5)
+    time.sleep(0.1)  # let the waiter block in acquire
+    d = sem.dump()
+    hogs = [h for h in d["holders"] if h["thread"] == "permit-hog"]
+    assert hogs and hogs[0]["depth"] == 2 and hogs[0]["held_s"] >= 0
+    assert d["available"] == 0
+    assert [x for x in d["waiters"] if x["thread"] == "permit-waiter"]
+    release.set()
+    t.join(5)
+    w.join(5)
+    d = sem.dump()
+    assert not d["holders"] and not d["waiters"] and d["available"] == 1
+    # both full holds landed in the held-duration histogram
+    assert d["held_seconds"]["count"] == 2
+
+
+# ---------------------------------------------------------------------------
+# stall detection: injected stall -> deterministic tick -> forensics
+# ---------------------------------------------------------------------------
+def test_watchdog_detects_injected_stall(tmp_path):
+    from spark_rapids_tpu.memory.catalog import get_catalog
+    from spark_rapids_tpu.memory.semaphore import get_semaphore
+    from spark_rapids_tpu.parallel import pipeline as P
+
+    conf = RapidsConf({
+        "spark.rapids.tpu.health.stallTimeout": 5.0,
+        "spark.rapids.tpu.health.reportDir": str(tmp_path),
+    })
+    mon = HealthMonitor(conf)
+    get_catalog()  # the report's catalog section needs one to exist
+    sem = get_semaphore()
+    ready, release = threading.Event(), threading.Event()
+
+    def stuck_holder():
+        sem.acquire_if_necessary()
+        ready.set()
+        release.wait(30)  # the injected "lock-holder sleep"
+        sem.release_all()
+
+    t = threading.Thread(target=stuck_holder, name="stuck-holder",
+                         daemon=True)
+    # a live (starved) prefetch queue so the report shows per-queue depth
+    feed = threading.Event()
+
+    def slow_iter():
+        yield 0
+        feed.wait(30)
+        yield 1
+
+    it = P.prefetched(slow_iter, stage="unit:stalled-scan", depth=1)
+    try:
+        assert next(it) == 0  # generator body runs: queue registered
+        t.start()
+        assert ready.wait(5)
+        t0 = time.monotonic()
+        assert mon.tick(now=t0) is None  # baseline: progress just observed
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            report = mon.tick(now=t0 + 6.0)
+        assert report is not None, "stall not detected"
+        assert mon.stalled and mon.stalls_detected == 1
+        # forensics: named semaphore holder with held-duration + stack
+        assert "thread='stuck-holder'" in report
+        assert "held for" in report
+        assert "stuck_holder" in report  # its frame in the stack section
+        assert "-- thread stacks --" in report
+        # per-queue depths
+        assert "stage='unit:stalled-scan' depth=0/1" in report
+        # catalog dump
+        assert "-- catalog --" in report and "device_used_bytes" in report
+        # stall-<ts>.txt written and identical in content
+        (path,) = glob.glob(os.path.join(str(tmp_path), "stall-*.txt"))
+        with open(path, encoding="utf-8") as f:
+            assert "stuck-holder" in f.read()
+        assert mon.last_stall_report_path == path
+        # once per stall episode: no re-dump while still stuck
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            assert mon.tick(now=t0 + 7.0) is None
+        assert mon.stalls_detected == 1
+        # the catalog diagnostics channel carries the stall note
+        assert any("watchdog stall" in n for n in get_catalog().diagnostics)
+    finally:
+        release.set()
+        feed.set()
+        t.join(5)
+        for _ in it:  # drain the queue so the worker exits
+            pass
+    # progress (queue drain) re-arms the detector
+    assert mon.tick(now=t0 + 8.0) is None
+    assert not mon.stalled
+
+
+def test_watchdog_survives_wedged_catalog_lock():
+    """The stall may BE a thread stuck holding the catalog lock; the
+    monitor tick and the forensics dump must time-bound their acquires
+    instead of joining the hang."""
+    from spark_rapids_tpu.memory.catalog import get_catalog
+
+    cat = get_catalog()
+    mon = HealthMonitor(RapidsConf({
+        "spark.rapids.tpu.health.stallTimeout": 1.0}))
+    acquired, release = threading.Event(), threading.Event()
+
+    def wedge():
+        with cat._lock:
+            acquired.set()
+            release.wait(30)
+
+    t = threading.Thread(target=wedge, name="catalog-wedger", daemon=True)
+    t.start()
+    assert acquired.wait(5)
+    try:
+        t0 = time.monotonic()
+        mon.tick()  # watermark sample skipped, not blocked
+        assert time.monotonic() - t0 < 5
+        report = mon.stall_report(99.0)
+        assert "catalog lock UNAVAILABLE" in report
+    finally:
+        release.set()
+        t.join(5)
+    assert "dump:" in mon.stall_report(1.0)  # lock free again
+
+
+def test_monitor_ignores_idle_engine():
+    """No work in flight -> never a stall, however old the progress."""
+    mon = HealthMonitor(RapidsConf({
+        "spark.rapids.tpu.health.stallTimeout": 1.0}))
+    t0 = time.monotonic()
+    assert mon.tick(now=t0) is None
+    assert mon.tick(now=t0 + 1e6) is None
+    assert not mon.stalled and mon.stalls_detected == 0
+
+
+def test_no_false_stall_after_idle_gap():
+    """Idle gap longer than stallTimeout, then new work: the first busy
+    tick must restart the progress clock, not read the idle age as a
+    stall — while a genuine post-transition freeze still detects."""
+    from spark_rapids_tpu.parallel import pipeline as P
+
+    P.configure_pipeline(RapidsConf())  # pipeline on (sticky settings)
+    mon = HealthMonitor(RapidsConf({
+        "spark.rapids.tpu.health.stallTimeout": 5.0}))
+    t0 = time.monotonic()
+    mon.tick(now=t0)
+    mon.tick(now=t0 + 100)  # long idle: no work, no stall
+    assert not mon.stalled
+    hold = threading.Event()
+
+    def task(x):
+        hold.wait(30)
+        return x
+
+    runner = threading.Thread(
+        target=lambda: P.parallel_map(task, [1, 2], max_workers=2,
+                                      stage="unit:idlegap"),
+        daemon=True)
+    runner.start()
+    deadline = time.monotonic() + 5
+    while not P.pipeline_snapshot()["in_flight"] \
+            and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert P.pipeline_snapshot()["in_flight"]
+    try:
+        # first busy tick after the gap: transition reset, no stall
+        assert mon.tick(now=t0 + 101) is None
+        assert not mon.stalled and mon.stalls_detected == 0
+        # a genuine freeze measured FROM the transition still fires
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            assert mon.tick(now=t0 + 107) is not None
+        assert mon.stalls_detected == 1
+    finally:
+        hold.set()
+        runner.join(5)
+
+
+# ---------------------------------------------------------------------------
+# HTTP endpoints: respond while a query runs, die with the session
+# ---------------------------------------------------------------------------
+def test_status_endpoints_respond_while_query_runs():
+    from spark_rapids_tpu.columnar import dtypes as dt
+
+    sess = TpuSession({
+        "spark.rapids.tpu.batchRowsMinBucket": 8,
+        "spark.rapids.tpu.health.enabled": True,
+        "spark.rapids.tpu.health.intervalMs": 50,
+        "spark.rapids.tpu.health.port": 0,  # ephemeral
+    })
+    base = sess._health.server.url
+    try:
+        seen = {}
+
+        def probe(batches):
+            # executes mid-query, with the semaphore held by this task
+            for pdf in batches:
+                with urllib.request.urlopen(base + "/healthz",
+                                            timeout=10) as r:
+                    seen["healthz"] = (r.status, json.loads(r.read()))
+                with urllib.request.urlopen(base + "/status",
+                                            timeout=10) as r:
+                    seen["status"] = json.loads(r.read())
+                yield pdf
+
+        df = sess.create_dataframe(
+            pa.table({"x": np.arange(64.0)}), num_partitions=2)
+        out = df.map_in_pandas(probe, {"x": dt.DOUBLE}).collect()
+        assert out.num_rows == 64
+        code, hz = seen["healthz"]
+        assert code == 200 and hz["status"] == "ok"
+        snap = seen["status"]
+        for key in ("semaphore", "pipeline", "catalog", "active_operators",
+                    "stalled", "last_progress_age_s"):
+            assert key in snap, key
+        assert snap["semaphore"]["permits"] >= 1
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+            text = r.read().decode()
+        assert "# TYPE spark_rapids_tpu_" in text
+        assert "spark_rapids_tpu_tracer_spans_dropped" in text
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(base + "/nope", timeout=10)
+    finally:
+        sess.close()
+    with pytest.raises(OSError):  # server gone after close
+        urllib.request.urlopen(base + "/healthz", timeout=2)
+
+
+# ---------------------------------------------------------------------------
+# event-log schema v4: heartbeats round-trip through replay + diagnose
+# ---------------------------------------------------------------------------
+HEARTBEAT_REQUIRED_KEYS = {
+    "event", "ts", "seq", "uptime_s", "device_used_bytes",
+    "device_peak_bytes", "device_limit_bytes", "semaphore_holders",
+    "semaphore_waiters", "queues", "queue_depth", "in_flight",
+    "active_workers", "last_progress_age_s", "stalled",
+}
+
+
+def test_heartbeat_schema_v4_roundtrip(tmp_path):
+    from spark_rapids_tpu.expr.functions import col, sum as f_sum
+    from spark_rapids_tpu.tools.diagnose import diagnose_path
+    from spark_rapids_tpu.tools.eventlog import (SCHEMA_VERSION,
+                                                 load_event_log)
+
+    assert SCHEMA_VERSION == 4
+    sess = TpuSession({
+        "spark.rapids.tpu.eventLog.dir": str(tmp_path),
+        "spark.rapids.tpu.batchRowsMinBucket": 8,
+        "spark.rapids.tpu.health.enabled": True,
+        # interval so large the thread never ticks on its own: the ticks
+        # below are manual, so the heartbeat count is deterministic
+        "spark.rapids.tpu.health.intervalMs": 3_600_000,
+    })
+    try:
+        rng = np.random.default_rng(9)
+        df = sess.create_dataframe(pa.table({
+            "g": rng.integers(0, 4, 200), "x": rng.normal(size=200)}),
+            num_partitions=2)
+        df.group_by("g").agg(f_sum(col("x")).alias("sx")).collect()
+        sess._health.monitor.tick()
+        sess._health.monitor.tick()
+    finally:
+        sess.close()
+    (path,) = glob.glob(os.path.join(str(tmp_path), "*.jsonl"))
+    records = [json.loads(line) for line in open(path, encoding="utf-8")]
+    hbs = [r for r in records if r["event"] == "heartbeat"]
+    assert len(hbs) == 2
+    for hb in hbs:
+        missing = HEARTBEAT_REQUIRED_KEYS - set(hb)
+        assert not missing, missing
+    assert [hb["seq"] for hb in hbs] == [1, 2]
+    # replay: heartbeats surface on the app, version pinned
+    app = load_event_log(path)
+    assert app.schema_version == 4
+    assert len(app.heartbeats) == 2
+    # query window timestamps replay (heartbeats here fired after the
+    # query, so the window is empty — attribution, not accidental capture)
+    q = app.query(1)
+    assert q.ts_start > 0 and q.ts_end >= q.ts_start
+    assert q.heartbeats_in_window(app.heartbeats) == []
+    # diagnose consumes a v4 log cleanly
+    diagnose_path(path).summary()
+
+
+def test_diagnose_ranks_stall_window_and_oom_territory(tmp_path):
+    """Synthetic v4 log: a stalled heartbeat + HBM at 95% inside the
+    query window -> ranked stall finding + 'OOM territory' flag."""
+    from spark_rapids_tpu.tools.diagnose import diagnose_app
+    from spark_rapids_tpu.tools.eventlog import load_event_log
+
+    hb = {"event": "heartbeat", "ts": 15.0, "seq": 1, "uptime_s": 5.0,
+          "device_used_bytes": 95, "device_peak_bytes": 95,
+          "device_limit_bytes": 100, "semaphore_holders": 1,
+          "semaphore_waiters": 2, "queues": {"decode": 0},
+          "queue_depth": 0, "in_flight": 1, "active_workers": 2,
+          "last_progress_age_s": 8.0, "stalled": True}
+    records = [
+        {"event": "app_start", "app_id": "h", "schema_version": 4,
+         "ts": 0.0, "conf": {}},
+        {"event": "query_start", "query_id": 1, "ts": 10.0, "plan": "p"},
+        hb,
+        {"event": "query_end", "query_id": 1, "ts": 20.0, "wall_s": 10.0,
+         "final_plan": "p", "aqe_events": [], "spill_count": {},
+         "semaphore_wait_s": 0.0, "stats": {}},
+        {"event": "app_end", "ts": 21.0},
+    ]
+    path = tmp_path / "hb.jsonl"
+    path.write_text("".join(json.dumps(r) + "\n" for r in records))
+    app = load_event_log(str(path))
+    assert app.query(1).heartbeats_in_window(app.heartbeats) == [hb]
+    rep = diagnose_app(app, str(path))
+    (qd,) = rep.queries
+    metrics = {f.metric for f in qd.findings}
+    assert "stall" in metrics and "hbmPressure" in metrics
+    text = rep.summary(top=5)
+    assert "watchdog stall window" in text
+    assert "OOM territory" in text
+    # the stall window ranks by its no-progress share of wall
+    stall = next(f for f in qd.findings if f.metric == "stall")
+    assert stall.fraction == pytest.approx(0.8)
+    # replay-level health check flags it too
+    assert any("stalled engine" in w for w in app.health_check())
+
+
+# ---------------------------------------------------------------------------
+# no leaked threads: monitor + HTTP server die with session.close()
+# ---------------------------------------------------------------------------
+def test_no_leaked_threads_after_close_with_health_enabled():
+    from spark_rapids_tpu.expr.functions import col, sum as f_sum
+    from spark_rapids_tpu.parallel import pipeline as P
+
+    before = {t.name for t in threading.enumerate()}
+    sess = TpuSession({
+        "spark.rapids.tpu.batchRowsMinBucket": 8,
+        "spark.rapids.tpu.health.enabled": True,
+        "spark.rapids.tpu.health.intervalMs": 20,
+        "spark.rapids.tpu.health.port": 0,
+    })
+    rng = np.random.default_rng(2)
+    df = sess.create_dataframe(pa.table({
+        "k": rng.integers(0, 3, 300), "v": rng.normal(size=300)}),
+        num_partitions=2)
+    df.group_by("k").agg(f_sum(col("v")).alias("s")).collect(device=True)
+    time.sleep(0.1)  # let the monitor tick at least once
+    assert sess._health.monitor.ticks >= 1
+    sess.close()
+    deadline = time.monotonic() + 10
+    while P.active_workers() and time.monotonic() < deadline:
+        time.sleep(0.05)
+    lingering = {t.name for t in threading.enumerate()} - before
+    leaked = [n for n in lingering
+              if n.startswith(("tpu-health", "tpu-prefetch",
+                               "tpu-pipeline"))]
+    assert not leaked, leaked
+
+
+# ---------------------------------------------------------------------------
+# tier-1 lint: every registered conf key appears in docs/configs.md
+# ---------------------------------------------------------------------------
+def test_every_conf_key_documented():
+    """Keeps the doc regen honest: a conf registered anywhere in the
+    package must appear in docs/configs.md (regenerate with
+    `python -m spark_rapids_tpu.conf`)."""
+    import pathlib
+
+    import spark_rapids_tpu
+    from spark_rapids_tpu.conf import conf_entries, import_conf_modules
+
+    import_conf_modules()
+    docs = (pathlib.Path(spark_rapids_tpu.__file__).parent.parent
+            / "docs" / "configs.md").read_text(encoding="utf-8")
+    missing = [e.key for e in conf_entries()
+               if not e.internal and f"`{e.key}`" not in docs]
+    assert not missing, (
+        f"conf keys missing from docs/configs.md — regenerate with "
+        f"`python -m spark_rapids_tpu.conf`: {missing}")
+    # the lint is live: the health keys this PR added are in scope
+    keys = {e.key for e in conf_entries()}
+    assert "spark.rapids.tpu.health.stallTimeout" in keys
+
+
+# ---------------------------------------------------------------------------
+# tracer satellite: spans_dropped counted + warn-once on ring wrap
+# ---------------------------------------------------------------------------
+def test_tracer_counts_dropped_spans_and_warns_once():
+    from spark_rapids_tpu.utils.metrics import get_stats
+    from spark_rapids_tpu.utils.tracing import (Tracer, get_tracer,
+                                                set_tracer, tracer_stats)
+
+    tr = Tracer(capacity=4, enabled=True)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        for i in range(10):
+            tr.instant(f"e{i}")
+    assert tr.dropped == 6
+    wraps = [w for w in caught if issubclass(w.category, RuntimeWarning)
+             and "ring buffer wrapped" in str(w.message)]
+    assert len(wraps) == 1, "wrap warning must fire exactly once"
+    old = get_tracer()
+    set_tracer(tr)
+    try:
+        assert tracer_stats()["spans_dropped"] == 6
+        # surfaces through the process stats registry (and /metrics)
+        assert get_stats().collect()["tracer_spans_dropped"] == 6
+    finally:
+        set_tracer(old)
+    tr.clear()
+    assert tr.dropped == 0
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        for i in range(10):
+            tr.instant(f"f{i}")
+    assert len([w for w in caught
+                if "ring buffer wrapped" in str(w.message)]) == 1
+
+
+# ---------------------------------------------------------------------------
+# gather all-valid contract satellite (ADVICE #3)
+# ---------------------------------------------------------------------------
+def test_gather_keep_all_valid_contract():
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu.columnar import device as D
+    from spark_rapids_tpu.columnar import dtypes as dt
+
+    col = D.DeviceColumn(jnp.arange(8.0), jnp.ones(8, bool), dt.DOUBLE,
+                         all_valid=True)
+    idx = jnp.arange(8, dtype=jnp.int32)
+    # default (implicit legacy) preserves the promise
+    assert col.gather(idx).all_valid
+    assert col.gather(idx, keep_all_valid=True).all_valid
+    # explicit opt-out always drops it
+    assert not col.gather(idx, keep_all_valid=False).all_valid
+    # a non-promising column never gains the promise
+    plain = D.DeviceColumn(jnp.arange(8.0), jnp.ones(8, bool), dt.DOUBLE)
+    assert not plain.gather(idx, keep_all_valid=True).all_valid
+    # debug assertions: implicit call sites lose the promise (an
+    # un-audited gather cannot expose padding garbage as non-null)
+    D.configure_debug(RapidsConf({"spark.rapids.tpu.debug.assertions": True}))
+    try:
+        assert D.debug_assertions_enabled()
+        assert not col.gather(idx).all_valid
+        assert col.gather(idx, keep_all_valid=True).all_valid
+    finally:
+        D.configure_debug(RapidsConf())
+    assert not D.debug_assertions_enabled()
+
+
+def test_debug_assertions_query_parity():
+    """End-to-end guard: a sort+filter query returns identical results
+    with debug assertions on (the promise drop is semantic-neutral)."""
+    from spark_rapids_tpu.expr.functions import col
+
+    def run(extra):
+        sess = TpuSession({"spark.rapids.tpu.batchRowsMinBucket": 8,
+                           **extra})
+        try:
+            df = sess.create_dataframe(pa.table({
+                "x": [3.0, 1.0, None, 2.0, 5.0, 4.0] * 4}))
+            return df.filter(col("x") > 1.0).sort("x") \
+                .collect(device=True).to_pandas()
+        finally:
+            sess.close()
+
+    base = run({})
+    debug = run({"spark.rapids.tpu.debug.assertions": True})
+    assert base.equals(debug)
+
+
+# ---------------------------------------------------------------------------
+# pipeline introspection API
+# ---------------------------------------------------------------------------
+def test_pipeline_snapshot_tracks_queues_and_progress():
+    from spark_rapids_tpu.parallel import pipeline as P
+
+    before = P.pipeline_snapshot()
+    gate = threading.Event()
+
+    def producer():
+        yield 1
+        gate.wait(30)
+        yield 2
+
+    it = P.prefetched(producer, stage="unit:snap", depth=2)
+    try:
+        assert next(it) == 1
+        snap = P.pipeline_snapshot()
+        stages = [q["stage"] for q in snap["queues"]]
+        assert "unit:snap" in stages
+        assert snap["progress_counter"] > before["progress_counter"]
+        assert snap["last_progress_age_s"] >= 0
+    finally:
+        gate.set()
+        for _ in it:
+            pass
+    # queue unregisters once the consumer drains
+    stages = [q["stage"] for q in P.pipeline_snapshot()["queues"]]
+    assert "unit:snap" not in stages
+
+
+def test_sequential_mode_bumps_progress_marker():
+    """pipeline.enabled=false never touches a prefetch queue or pooled
+    task; operator batch accounting (exec/base.py) must still move the
+    progress marker or a healthy sequential drain reads as a stall."""
+    from spark_rapids_tpu.parallel import pipeline as P
+
+    sess = TpuSession({"spark.rapids.tpu.batchRowsMinBucket": 8,
+                       "spark.rapids.tpu.pipeline.enabled": False})
+    try:
+        before = P.pipeline_snapshot()["progress_counter"]
+        df = sess.create_dataframe(pa.table({"x": [1.0] * 64}),
+                                   num_partitions=2)
+        assert df.count() == 64
+        assert P.pipeline_snapshot()["progress_counter"] > before
+    finally:
+        sess.close()
+
+
+def test_healthz_probe_ticks_without_monitor_thread():
+    """health.port without health.enabled: the 503-while-stalled contract
+    must still hold, so /healthz samples on the probe itself (without
+    flooding the event log with heartbeats)."""
+    sess = TpuSession({"spark.rapids.tpu.batchRowsMinBucket": 8,
+                       "spark.rapids.tpu.health.port": 0})
+    try:
+        mon = sess._health.monitor
+        assert not mon.ticking()
+        base = sess._health.server.url
+        t0, hb0 = mon.ticks, mon.heartbeats_emitted
+        with urllib.request.urlopen(base + "/healthz", timeout=10) as r:
+            assert r.status == 200
+        assert mon.ticks == t0 + 1
+        assert mon.heartbeats_emitted == hb0  # probe ticks emit no heartbeat
+    finally:
+        sess.close()
+
+
+def test_health_status_without_monitor():
+    """session.health_status() works with the subsystem fully off (the
+    bench snapshot path must never require the monitor thread)."""
+    sess = TpuSession({"spark.rapids.tpu.batchRowsMinBucket": 8})
+    try:
+        assert sess._health is None
+        snap = sess.health_status()
+        assert "pipeline" in snap and "stalled" in snap
+    finally:
+        sess.close()

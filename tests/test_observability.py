@@ -367,8 +367,10 @@ def test_eventlog_schema_version_and_required_keys(tmp_path):
         by_type.setdefault(rec["event"], []).append(rec)
     assert set(by_type) == set(_REQUIRED_KEYS)
     # the pinned version: bump SCHEMA_VERSION (and this test + the docs)
-    # when the record shape changes
-    assert SCHEMA_VERSION == 3
+    # when the record shape changes. v4 added heartbeat records (health
+    # monitor off in this run, so none appear here; tests/test_health.py
+    # pins the heartbeat record keys)
+    assert SCHEMA_VERSION == 4
     assert by_type["app_start"][0]["schema_version"] == SCHEMA_VERSION
     for kind, required in _REQUIRED_KEYS.items():
         for rec in by_type[kind]:
@@ -569,7 +571,7 @@ def test_eventlog_query_stats_cover_all_subsystems(tmp_path):
     from spark_rapids_tpu.tools.eventlog import load_event_log
     path = _run_logged_app(tmp_path)
     app = load_event_log(path)
-    assert app.schema_version == 3
+    assert app.schema_version == 4
     q = app.query(1)
     assert q.stats, "query_end stats delta missing"
     for family in ("compile_cache_", "upload_cache_", "shuffle_",
