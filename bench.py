@@ -72,8 +72,25 @@ _STATE = {
     "eventlog": {},   # phase -> event-log directory
     "health": {},     # phase -> /status snapshot + peak HBM watermark
     "pipeline": os.environ.get("BENCH_PIPELINE", "on"),  # A/B knob
+    "analyze": {},    # srtpu-analyze baseline summary (sync-site debt)
     "notes": [],
 }
+
+
+def _load_analyze_summary():
+    """The committed srtpu-analyze baseline summary, read as plain JSON
+    (the parent process must never import jax, so no tools.analyze
+    import). Sync-site count lands in the bench JSON as a tracked
+    trajectory metric next to the measured sync waits."""
+    path = os.path.join(_REPO, "spark_rapids_tpu", "tools", "analyze",
+                        "baseline.json")
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        return {"initial_inventory": data.get("initial_inventory", {}),
+                "summary": data.get("summary", {})}
+    except (OSError, ValueError):
+        return {}
 
 
 def _log(msg):
@@ -94,7 +111,7 @@ def _write_partial():
         json.dump({k: _STATE[k] for k in
                    ("backend", "fell_back", "sf", "rows", "smoke", "tpch",
                     "ablation", "compile_cache", "errors", "eventlog",
-                    "health", "pipeline", "notes")}
+                    "health", "pipeline", "analyze", "notes")}
                   | {"elapsed_s": round(time.monotonic() - _T_START, 2)},
                   f, indent=1)
     os.replace(tmp, _PARTIAL_PATH)
@@ -392,6 +409,7 @@ def main():
             platform, fell_back = "cpu", True
     _STATE["backend"] = platform
     _STATE["fell_back"] = fell_back
+    _STATE["analyze"] = _load_analyze_summary()
     _log(f"backend={platform} fell_back={fell_back} "
          f"budget={_budget_s():.0f}s")
     _write_partial()

@@ -329,8 +329,8 @@ class DeviceTable:
 
     def to_host(self) -> HostTable:
         """Download and compact to exactly num_rows host rows."""
-        mask = np.asarray(self.row_mask)
-        n = int(np.asarray(self.num_rows))
+        mask = np.asarray(self.row_mask)  # srtpu: sync-ok(result materialization: the deliberate D2H funnel)
+        n = int(np.asarray(self.num_rows))  # srtpu: sync-ok(result materialization: the deliberate D2H funnel)
         # row_mask may be non-prefix (post-filter); boolean-index on host
         cols = [_download_column(c, mask, n) for c in self.columns]
         return HostTable(list(self.names), cols)
@@ -338,18 +338,18 @@ class DeviceTable:
 
 def _download_column(c: DeviceColumn, mask: np.ndarray, n: int) -> HostColumn:
     """One column's device->host decode over the active-row mask."""
-    validity = np.asarray(c.validity)[mask][:n]
+    validity = np.asarray(c.validity)[mask][:n]  # srtpu: sync-ok(deliberate D2H download path, called from to_host)
     opt_valid = None if validity.all() else validity
     if c.is_string_like:
-        data = np.asarray(c.data)[mask][:n]
-        lengths = np.asarray(c.lengths)[mask][:n]
+        data = np.asarray(c.data)[mask][:n]  # srtpu: sync-ok(deliberate D2H download path, called from to_host)
+        lengths = np.asarray(c.lengths)[mask][:n]  # srtpu: sync-ok(deliberate D2H download path, called from to_host)
         return HostColumn(c.dtype, _decode_string_matrix(data, lengths,
                                                          c.dtype), opt_valid)
     if isinstance(c.dtype, dt.ArrayType):
-        data = np.asarray(c.data)[mask][:n]
-        lengths = np.asarray(c.lengths)[mask][:n]
+        data = np.asarray(c.data)[mask][:n]  # srtpu: sync-ok(deliberate D2H download path, called from to_host)
+        lengths = np.asarray(c.lengths)[mask][:n]  # srtpu: sync-ok(deliberate D2H download path, called from to_host)
         ev = None if c.elem_validity is None \
-            else np.asarray(c.elem_validity)[mask][:n]
+            else np.asarray(c.elem_validity)[mask][:n]  # srtpu: sync-ok(deliberate D2H download path, called from to_host)
         return HostColumn(c.dtype, _decode_list_matrix(data, lengths,
                                                        c.dtype, ev), opt_valid)
     if isinstance(c.dtype, dt.StructType):
@@ -375,11 +375,11 @@ def _download_column(c: DeviceColumn, mask: np.ndarray, n: int) -> HostColumn:
         return HostColumn(c.dtype, out, opt_valid)
     if dt.is_d128(c.dtype):
         from ..expr.decimal128 import limbs_to_py_ints
-        limbs = np.asarray(c.data)[mask][:n]
+        limbs = np.asarray(c.data)[mask][:n]  # srtpu: sync-ok(deliberate D2H download path, called from to_host)
         # hi limb is signed: the composition is already the signed
         # 128-bit value
         return HostColumn(c.dtype, limbs_to_py_ints(limbs), opt_valid)
-    vals = np.asarray(c.data)[mask][:n]
+    vals = np.asarray(c.data)[mask][:n]  # srtpu: sync-ok(deliberate D2H download path, called from to_host)
     if isinstance(c.dtype, dt.BooleanType):
         vals = vals.astype(np.bool_)
     return HostColumn(c.dtype, vals, opt_valid)
@@ -445,7 +445,7 @@ def _decode_string_matrix(data: np.ndarray, lengths: np.ndarray,
             pa.string() if is_str else pa.binary(), n,
             [None, pa.py_buffer(offsets.tobytes()),
              pa.py_buffer(blob.tobytes())])
-        out = np.asarray(arr.to_pylist(), dtype=object)
+        out = np.asarray(arr.to_pylist(), dtype=object)  # srtpu: sync-ok(host pyarrow decode; no device value)
     except (pa.ArrowInvalid, UnicodeDecodeError):
         # invalid utf-8 bytes: per-row fallback with replacement
         out = np.empty(n, dtype=object)
@@ -473,11 +473,11 @@ def _encode_list_matrix(hc: HostColumn, capacity: int):
             .astype(np.int64)
         child_valid = None
         if child.null_count:
-            child_valid = np.asarray(child.is_valid())
+            child_valid = np.asarray(child.is_valid())  # srtpu: sync-ok(host-side encode for upload; no device value)
             fill = False if pa.types.is_boolean(child.type) else 0
-            childvals = np.asarray(child.fill_null(fill))
+            childvals = np.asarray(child.fill_null(fill))  # srtpu: sync-ok(host-side encode for upload; no device value)
         else:
-            childvals = np.asarray(child)
+            childvals = np.asarray(child)  # srtpu: sync-ok(host-side encode for upload; no device value)
         lengths32 = (offsets[1:] - offsets[:-1]).astype(np.int32)
         # null rows keep offsets; force their length to 0
         vm = hc.valid_mask()
@@ -516,11 +516,11 @@ def _encode_list_matrix(hc: HostColumn, capacity: int):
             continue
         if any(e is None for e in v):
             any_inner_null = True
-            a = np.asarray([0 if e is None else e for e in v], dtype=np_dt)
-            m = np.asarray([e is not None for e in v], dtype=np.bool_)
+            a = np.asarray([0 if e is None else e for e in v], dtype=np_dt)  # srtpu: sync-ok(host-side encode for upload; no device value)
+            m = np.asarray([e is not None for e in v], dtype=np.bool_)  # srtpu: sync-ok(host-side encode for upload; no device value)
             rows_np.append((a, m))
         else:
-            rows_np.append((np.asarray(v, dtype=np_dt), None))
+            rows_np.append((np.asarray(v, dtype=np_dt), None))  # srtpu: sync-ok(host-side encode for upload; no device value)
         lens[i] = len(v)
     width = bucket_width(max(int(lens.max()) if n else 0, 1), min_width=4)
     mat = np.zeros((capacity, width), dtype=np_dt)
@@ -584,7 +584,7 @@ def _host_map_entry_columns(hc: HostColumn):
         items = pa.ListArray.from_arrays(offsets, arr.items)
         # propagate row validity (map offsets keep entries for null rows)
         if arr.null_count:
-            vm = np.asarray(arr.is_valid())
+            vm = np.asarray(arr.is_valid())  # srtpu: sync-ok(host arrow buffers; no device value)
             kc = HostColumn.from_arrow(keys)
             vc = HostColumn.from_arrow(items)
             kc.validity = vm if kc.validity is None else (kc.validity & vm)
@@ -790,14 +790,17 @@ def _slice_rows_impl(table: DeviceTable, start, length: int) -> DeviceTable:
 _slice_rows_jitted = jax.jit(_slice_rows_impl, static_argnums=(2,))
 
 
-def shrink_to_fit(table: DeviceTable, min_bucket: int = 1024) -> DeviceTable:
+def shrink_to_fit(table: DeviceTable, min_bucket: int = 1024,
+                  num_rows: Optional[int] = None) -> DeviceTable:
     """Compact and shrink capacity to the bucket of the active row count.
 
     Syncs the row count to host (one int) — used between pipeline steps to
-    stop capacities from growing across incremental merges."""
+    stop capacities from growing across incremental merges. Callers that
+    already hold the host count pass ``num_rows`` to skip the sync."""
     if table.capacity <= min_bucket:
         return table  # cannot shrink below one bucket: skip the device sync
-    n = int(table.num_rows)
+    n = num_rows if num_rows is not None \
+        else int(table.num_rows)  # srtpu: sync-ok(capacity choice needs the host count; callers with one pass it in)
     cap = bucket_rows(max(n, 1), min_bucket)
     if cap >= table.capacity:
         return table

@@ -130,7 +130,7 @@ class HostColumn:
         d = _arrow_to_dtype(arr.type)
         validity = None
         if arr.null_count:
-            validity = np.asarray(arr.is_valid())
+            validity = np.asarray(arr.is_valid())  # srtpu: sync-ok(host arrow buffers; no device value)
         if isinstance(d, (dt.ArrayType, dt.StructType, dt.MapType)):
             # nested values live host-side as Python objects in an object
             # array: list / dict / list[(k, v)] (CPU-engine representation;
@@ -150,15 +150,15 @@ class HostColumn:
                 # struct field / map key+item child arrays directly
                 return HostColumn(d, values, validity, _arrow=arr)
         elif isinstance(d, dt.StringType) or isinstance(d, dt.BinaryType):
-            values = np.asarray(arr.to_pylist(), dtype=object)
+            values = np.asarray(arr.to_pylist(), dtype=object)  # srtpu: sync-ok(host arrow buffers; no device value)
             if validity is not None:
                 values[~validity] = "" if isinstance(d, dt.StringType) else b""
             if pa.types.is_string(arr.type) or pa.types.is_binary(arr.type):
                 return HostColumn(d, values, validity, _arrow=arr)
         elif isinstance(d, dt.DateType):
-            values = np.asarray(arr.cast(pa.int32()).fill_null(0))
+            values = np.asarray(arr.cast(pa.int32()).fill_null(0))  # srtpu: sync-ok(host arrow buffers; no device value)
         elif isinstance(d, dt.TimestampType):
-            values = np.asarray(arr.cast(pa.timestamp("us")).cast(pa.int64()).fill_null(0))
+            values = np.asarray(arr.cast(pa.timestamp("us")).cast(pa.int64()).fill_null(0))  # srtpu: sync-ok(host arrow buffers; no device value)
         elif isinstance(d, dt.DecimalType):
             # scaled-integer representation: int64 up to 18 digits (the
             # device bound, DecimalType.MAX_INT64_PRECISION); wider
@@ -173,10 +173,10 @@ class HostColumn:
                 values = np.empty(len(py), dtype=object)
                 values[:] = py
             else:
-                values = np.asarray(py, dtype=np.int64)
+                values = np.asarray(py, dtype=np.int64)  # srtpu: sync-ok(host arrow buffers; no device value)
         else:
             fill = False if pa.types.is_boolean(arr.type) else 0
-            values = np.asarray(arr.fill_null(fill))
+            values = np.asarray(arr.fill_null(fill))  # srtpu: sync-ok(host arrow buffers; no device value)
             if values.dtype != d.np_dtype() and not isinstance(d, dt.BooleanType):
                 values = values.astype(d.np_dtype())
         if isinstance(d, dt.BooleanType):

@@ -157,7 +157,11 @@ class TpuShuffleExchangeExec(TpuExec):
         batches = maybe_prefetched(all_child_batches, stage="shuffle_map",
                                    registry=self.metrics)
         for b in batches:
-            if not int(b.num_rows):
+            # no per-batch row-count sync here: int(b.num_rows) would
+            # block the map loop on every upstream batch (ROADMAP item
+            # 1). All-masked batches flow through — the count pass parks
+            # their rows and the quota ignores them.
+            if not b.capacity:
                 continue
             pending.append(b)
             staged += b.capacity
@@ -216,14 +220,19 @@ class TpuShuffleExchangeExec(TpuExec):
                 # and can spill them until downstream consumption;
                 # finalizer releases the entries when the plan is
                 # garbage-collected
-                for i, t in enumerate(_split_sharded(exchanged, n)):
-                    if not int(t.num_rows):
+                parts = _split_sharded(exchanged, n)
+                # ONE bulk D2H of n 4-byte scalars replaces a blocking
+                # round trip per shard plus one more for the row total
+                shard_rows = jax.device_get(  # srtpu: sync-ok(batched count sync, 4B per shard once per chunk)
+                    [t.num_rows for t in parts])
+                for i, (t, cnt) in enumerate(zip(parts, shard_rows)):
+                    if not int(cnt):
                         continue
                     h = catalog.register(
                         t, SpillPriorities.OUTPUT_FOR_SHUFFLE)
                     weakref.finalize(self, _close_quietly, h)
                     shards[i].append(h)
-                return int(jnp.sum(exchanged.row_mask))
+                return int(sum(shard_rows))
             finally:
                 inflight.close()
 
@@ -294,7 +303,7 @@ class TpuLocalExchangeExec(TpuExec):
             writes) — the catalog and metric registries are thread-safe."""
             out = []
             for b in self.child_device_batches(p):
-                n = int(b.num_rows)
+                n = int(b.num_rows)  # srtpu: sync-ok(shared with shrink_to_fit below — one 4B sync per map batch, not two)
                 if not n:
                     continue
                 with self.metrics.timed(M.OP_TIME):
@@ -302,7 +311,7 @@ class TpuLocalExchangeExec(TpuExec):
                     # columnar/device.py): post-filter / fused-partial-agg
                     # batches can be mostly masked slack — forwarding full
                     # capacity would inflate every downstream kernel
-                    shrunk = shrink_to_fit(b, self.min_bucket)
+                    shrunk = shrink_to_fit(b, self.min_bucket, num_rows=n)
                     self.metrics.add(M.SHUFFLE_BYTES, shrunk.nbytes())
                     h = catalog.register(
                         shrunk, SpillPriorities.OUTPUT_FOR_SHUFFLE)

@@ -168,7 +168,7 @@ class HostToDeviceExec(TpuExec):
         global _CACHED_BYTES, _CACHE_HITS, _CACHE_INSERTS
         if not self.cache_max_bytes:
             with get_tracer().span("h2d_upload", "upload",
-                                   rows=int(batch.num_rows)):
+                                   rows=int(batch.num_rows)):  # srtpu: sync-ok(HostTable.num_rows is a host int on the upload side)
                 dtb = DeviceTable.from_host(batch, self.min_bucket)
             self.metrics.add(M.UPLOAD_BYTES, dtb.nbytes())
             return mark_exclusive(dtb)
@@ -184,7 +184,7 @@ class HostToDeviceExec(TpuExec):
             self.metrics.add(M.UPLOAD_CACHE_HITS, 1)
             return hit
         with get_tracer().span("h2d_upload", "upload",
-                               rows=int(batch.num_rows)):
+                               rows=int(batch.num_rows)):  # srtpu: sync-ok(HostTable.num_rows is a host int on the upload side)
             dtb = DeviceTable.from_host(batch, self.min_bucket)
         nbytes = dtb.nbytes()
         self.metrics.add(M.UPLOAD_BYTES, nbytes)
@@ -260,7 +260,7 @@ class DeviceToHostExec(PhysicalPlan):
         for batch in child:
             with self.metrics.timed(M.DOWNLOAD_TIME), \
                     get_tracer().span("d2h_download", "download",
-                                      rows=int(batch.num_rows)):
+                                      rows=int(batch.num_rows)):  # srtpu: sync-ok(trace-span rows at the deliberate download boundary)
                 ht = batch.to_host()
             self.metrics.add(M.DOWNLOAD_BYTES, batch.nbytes())
             self.metrics.add(M.NUM_OUTPUT_BATCHES, 1)

@@ -60,7 +60,7 @@ class ScalarSubquery(Expression):
             return Literal(None, self._dtype)
         v = col.values[0]
         if hasattr(v, "item"):
-            v = v.item()
+            v = v.item()  # srtpu: sync-ok(plan-time scalar subquery result, once per query)
         return Literal(v, self._dtype)
 
     def eval(self, ctx: EvalContext):

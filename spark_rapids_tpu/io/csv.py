@@ -179,7 +179,9 @@ class CsvSource(DataSource):
                 yield from self._slice_out(t, columns)
             return
         nthreads = self.conf.get(MULTITHREAD_READ_NUM_THREADS)
-        with cf.ThreadPoolExecutor(max_workers=nthreads) as pool:
+        with cf.ThreadPoolExecutor(max_workers=nthreads,
+                                   thread_name_prefix="srtpu-csv-read") \
+                as pool:
             futures = [pool.submit(self._read_file, f) for f in files]
             for f, fut in zip(files, futures):
                 t = fut.result()

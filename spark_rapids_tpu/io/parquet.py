@@ -151,7 +151,9 @@ class ParquetSource(DataSource):
     def _read_multithreaded(self, files: Sequence[str], columns
                             ) -> Iterator[HostTable]:
         nthreads = self.conf.get(MULTITHREAD_READ_NUM_THREADS)
-        with cf.ThreadPoolExecutor(max_workers=nthreads) as pool:
+        with cf.ThreadPoolExecutor(max_workers=nthreads,
+                                   thread_name_prefix="srtpu-pq-read") \
+                as pool:
             from .file_block import set_input_file
             futures = [pool.submit(self._read_file, f, columns) for f in files]
             for f, fut in zip(files, futures):  # file order kept, reads overlap

@@ -44,7 +44,9 @@ def prefetched(items: Iterable[T], fn: Callable[[T], R],
     if not items:
         return
     window = max(1, window)
-    with cf.ThreadPoolExecutor(max_workers=window) as pool:
+    with cf.ThreadPoolExecutor(max_workers=window,
+                               thread_name_prefix="srtpu-io-prefetch") \
+            as pool:
         pending: deque = deque()  # (item, future): pairing stays exact
         it = iter(items)
         for x in it:

@@ -85,7 +85,7 @@ def _write_table(df, path: str, fmt: str,
             plan = df.session._physical(df.logical, device=True)
             for pidx in range(plan.num_partitions):
                 batches = [b for b in df._batches_from_plan(plan, pidx)
-                           if int(b.num_rows)]
+                           if int(b.num_rows)]  # srtpu: sync-ok(file write path; the parquet encode downloads anyway)
                 if not batches:
                     continue
                 fpath = os.path.join(path,

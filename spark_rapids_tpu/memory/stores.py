@@ -41,14 +41,14 @@ class StorageTier:
 def _flatten_column(c: DeviceColumn, key: str, arrays: dict) -> dict:
     """Column -> numpy planes under ``key``-prefixed names + descriptor
     (recurses into struct/map children)."""
-    arrays[f"{key}.data"] = np.asarray(c.data)
-    arrays[f"{key}.validity"] = np.asarray(c.validity)
+    arrays[f"{key}.data"] = np.asarray(c.data)  # srtpu: sync-ok(spill to the host tier is a deliberate download)
+    arrays[f"{key}.validity"] = np.asarray(c.validity)  # srtpu: sync-ok(spill to the host tier is a deliberate download)
     desc = {"dtype": c.dtype, "lengths": c.lengths is not None,
             "ev": c.elem_validity is not None, "children": None}
     if c.lengths is not None:
-        arrays[f"{key}.lengths"] = np.asarray(c.lengths)
+        arrays[f"{key}.lengths"] = np.asarray(c.lengths)  # srtpu: sync-ok(spill to the host tier is a deliberate download)
     if c.elem_validity is not None:
-        arrays[f"{key}.ev"] = np.asarray(c.elem_validity)
+        arrays[f"{key}.ev"] = np.asarray(c.elem_validity)  # srtpu: sync-ok(spill to the host tier is a deliberate download)
     if c.children is not None:
         desc["children"] = [
             _flatten_column(k, f"{key}.c{j}", arrays)
@@ -74,8 +74,8 @@ def _table_to_host_arrays(table: DeviceTable) -> Tuple[dict, dict]:
     """Flatten a DeviceTable into numpy arrays + static metadata."""
     arrays = {}
     meta = {"names": list(table.names), "cols": []}
-    arrays["row_mask"] = np.asarray(table.row_mask)
-    arrays["num_rows"] = np.asarray(table.num_rows)
+    arrays["row_mask"] = np.asarray(table.row_mask)  # srtpu: sync-ok(spill to the host tier is a deliberate download)
+    arrays["num_rows"] = np.asarray(table.num_rows)  # srtpu: sync-ok(spill to the host tier is a deliberate download)
     for i, c in enumerate(table.columns):
         meta["cols"].append(_flatten_column(c, f"col{i}", arrays))
     return arrays, meta

@@ -290,7 +290,7 @@ def dcn_publish_task(ctx: ExecutorContext, shuffle_id: int, map_id: int,
     table = DeviceTable.from_host(deserialize_table(payload), min_bucket=8)
     ctx.dcn_transport().publish_table(
         BlockId(shuffle_id, map_id, reduce_id), table)
-    return int(table.num_rows)
+    return int(table.num_rows)  # srtpu: sync-ok(cross-process DCN publish requires host bytes)
 
 
 def dcn_fetch_task(ctx: ExecutorContext, shuffle_id: int, map_id: int,

@@ -297,7 +297,7 @@ def materialize_stage(cpu_exchange: ShuffleExchangeExec, conf: RapidsConf,
         # to the compacted row count so device-tier stats are comparable
         # with the host tier's true bytes — otherwise tiny build sides
         # look big and suppress AQE broadcast demotion
-        nrows = int(t.num_rows)
+        nrows = int(t.num_rows)  # srtpu: sync-ok(per-stage AQE statistics at materialization, not per-batch)
         total = 0
         for c in t.columns:
             cap = max(int(c.data.shape[0]), 1)
@@ -311,7 +311,7 @@ def materialize_stage(cpu_exchange: ShuffleExchangeExec, conf: RapidsConf,
         prows = pbytes = 0
         for h in converted._handles:
             t = h.get()
-            prows += int(t.num_rows)
+            prows += int(t.num_rows)  # srtpu: sync-ok(per-stage AQE statistics at materialization, not per-batch)
             pbytes += _scaled_device_bytes(t)
         stats = PartitionStats([prows], [pbytes])
     elif isinstance(converted, TpuShuffleExchangeExec):
@@ -323,7 +323,7 @@ def materialize_stage(cpu_exchange: ShuffleExchangeExec, conf: RapidsConf,
             prows = pbytes = 0
             for h in handles:
                 t = h.get()
-                prows += int(t.num_rows)
+                prows += int(t.num_rows)  # srtpu: sync-ok(per-stage AQE statistics at materialization, not per-batch)
                 pbytes += _scaled_device_bytes(t)
             rows.append(prows)
             nbytes.append(pbytes)

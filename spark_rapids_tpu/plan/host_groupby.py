@@ -233,6 +233,7 @@ def _host_minmax(op: str, vals: np.ndarray, valid: np.ndarray,
         red, rhas = _host_minmax(op, codes.astype(np.int64), valid, gid,
                                  ngroups, has)
         idx = np.clip(red, 0, max(len(uniques) - 1, 0)).astype(np.int64)
+        # srtpu: sync-ok(host engine fallback over host data)
         out = np.asarray(uniques, dtype=object)[idx] if len(uniques) \
             else np.full(ngroups, "", dtype=object)
         return out, rhas

@@ -108,7 +108,7 @@ def host_eval_exprs(table: HostTable, exprs: Sequence[Expression],
         c = e.eval(ctx)
         values = c.values
         if not isinstance(values, np.ndarray):
-            values = np.asarray(values)
+            values = np.asarray(values)  # srtpu: sync-ok(host engine path over host tables)
         if isinstance(c.dtype, dt.BooleanType) and values.dtype != np.bool_:
             values = values.astype(np.bool_)
         elif isinstance(c.dtype, (dt.ArrayType, dt.StructType, dt.MapType)):
@@ -186,7 +186,7 @@ class CpuFilterExec(PhysicalPlan):
                                        batch_row_offset=offset)
             offset += batch.num_rows
             c = self.condition.eval(ctx)
-            keep = np.asarray(c.values, dtype=np.bool_)
+            keep = np.asarray(c.values, dtype=np.bool_)  # srtpu: sync-ok(host engine path over host tables)
             if c.validity is not None:
                 keep = keep & c.validity
             yield batch.take(np.nonzero(keep)[0])
@@ -351,7 +351,7 @@ def _sort_indices(table: HostTable, orders: Sequence[SortOrder]) -> np.ndarray:
     ctx = EvalContext.for_host(table)
     for o in reversed(list(orders)):  # lexsort: last key is primary
         c = o.expr.eval(ctx)
-        vals = np.asarray(c.values)
+        vals = np.asarray(c.values)  # srtpu: sync-ok(host engine path over host tables)
         valid = c.validity if c.validity is not None \
             else np.ones(len(vals), dtype=bool)
         if vals.dtype == object:
@@ -505,7 +505,7 @@ def murmur_hash_columns(table: HostTable, key_names: Sequence[str],
     for name in key_names:
         col = table.column(name)
         if col.values.dtype == object:
-            k = np.asarray([_murmur_bytes(str(v).encode()) for v in col.values],
+            k = np.asarray([_murmur_bytes(str(v).encode()) for v in col.values],  # srtpu: sync-ok(host partitioner over host tables)
                            dtype=np.uint32)
         else:
             k = _murmur_fmix(col.values)
@@ -593,7 +593,7 @@ class RangePartitioning(Partitioning):
             return
         picks = [idx[int(n * (i + 1) / self.num_parts) - 1]
                  for i in range(self.num_parts - 1)]
-        self._bounds = sample.take(np.asarray(picks, dtype=np.int64))
+        self._bounds = sample.take(np.asarray(picks, dtype=np.int64))  # srtpu: sync-ok(driver-side range-bounds sampling, once per exchange)
 
     def partition_indices(self, table: HostTable) -> np.ndarray:
         if self._bounds is None or table.num_rows == 0:

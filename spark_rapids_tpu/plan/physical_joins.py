@@ -94,7 +94,7 @@ def join_host_tables(lt: HostTable, rt: HostTable, lkeys: Sequence[str],
         pairs = _combine(lt, rt, li, ri, lkeys, rkeys, "inner", False)
         ctx = EvalContext.for_host(pairs)
         c = condition.eval(ctx)
-        keep = np.asarray(c.values, dtype=np.bool_)
+        keep = np.asarray(c.values, dtype=np.bool_)  # srtpu: sync-ok(host engine join over host tables)
         if c.validity is not None:
             keep &= c.validity
         li, ri = li[keep], ri[keep]

@@ -118,7 +118,7 @@ def _order_key_codes(sorted_t: HostTable, spec) -> np.ndarray:
     eq = np.ones(sorted_t.num_rows, dtype=bool)
     for o in spec.orders:
         c = o.expr.eval(ctx)
-        v = np.asarray(c.values)
+        v = np.asarray(c.values)  # srtpu: sync-ok(host window fallback over host data)
         valid = c.validity if c.validity is not None \
             else np.ones(len(v), dtype=bool)
         if v.dtype.kind == "f":
@@ -161,7 +161,7 @@ def _compute_window(sorted_t: HostTable, w: WindowExpression, gid: np.ndarray,
             in_seg = (pos + off >= seg_start) & (pos + off < seg_end)
             ctx = EvalContext.for_host(sorted_t)
             c = fn.child.eval(ctx)
-            vals = np.asarray(c.values)[src] if n else np.asarray(c.values)
+            vals = np.asarray(c.values)[src] if n else np.asarray(c.values)  # srtpu: sync-ok(host window fallback over host data)
             valid = (c.validity[src] if c.validity is not None
                      else np.ones(n, dtype=bool)) & in_seg
             if fn.default is not None:
@@ -202,7 +202,7 @@ def _agg_window(sorted_t: HostTable, w: WindowExpression, gid, seg_start,
         in_dtype = dt.LONG
     else:
         c = fn.children[0].eval(ctx)
-        vals = np.asarray(c.values)
+        vals = np.asarray(c.values)  # srtpu: sync-ok(host window fallback over host data)
         valid = c.validity if c.validity is not None \
             else np.ones(n, dtype=bool)
         in_dtype = c.dtype
@@ -262,7 +262,7 @@ def _range_sort_key(sorted_t, order):
     a null-key row's RANGE window is its null peer group)."""
     ctx = EvalContext.for_host(sorted_t)
     c = order.expr.eval(ctx)
-    vals = np.asarray(c.values)
+    vals = np.asarray(c.values)  # srtpu: sync-ok(host window fallback over host data)
     scale = 1
     if isinstance(c.dtype, dt.DecimalType):
         scale = 10 ** c.dtype.scale

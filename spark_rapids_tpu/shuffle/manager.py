@@ -280,7 +280,7 @@ class ShuffleManager:
                 tuple(c.gather(order, keep_all_valid=True)
                       for c in batch.columns),
                 jnp.take(batch.row_mask, order), batch.num_rows, batch.names)
-            sorted_pids = np.asarray(jnp.take(pids, order))
+            sorted_pids = np.asarray(jnp.take(pids, order))  # srtpu: sync-ok(count pass: partition-id vector only, 4B/row, before the bulk download)
             bounds = np.searchsorted(sorted_pids, np.arange(num_parts + 1))
             host = sorted_tbl.to_host()  # single download, dense prefix
             schema_host = host
@@ -338,7 +338,7 @@ class ShuffleManager:
                 jnp.take(batch.row_mask, order), batch.num_rows, batch.names)
             schema_tbl = sorted_tbl
             # count download only (4B/row), like the ICI exchange count pass
-            sorted_pids = np.asarray(jnp.take(pids, order))
+            sorted_pids = np.asarray(jnp.take(pids, order))  # srtpu: sync-ok(count pass: partition-id vector only, 4B/row; slices stay on device)
             bounds = np.searchsorted(sorted_pids, np.arange(num_parts + 1))
             for p in range(num_parts):
                 lo, hi = int(bounds[p]), int(bounds[p + 1])
@@ -425,6 +425,7 @@ class ShuffleManager:
         with get_tracer().span("shuffle_fetch", "shuffle", tier="cached",
                                shuffle=shuffle_id, reduce=reduce_id,
                                maps=num_maps):
+            tables: List[DeviceTable] = []
             for m in range(num_maps):
                 key = (shuffle_id, m, reduce_id)
                 handle = self.buffer_catalog.get(key)
@@ -438,10 +439,16 @@ class ShuffleManager:
                 t = handle.get()
                 fetched_bytes += t.nbytes()
                 if t.num_columns:
-                    if int(t.num_rows):
-                        parts.append(t)
-                    elif schema_holder is None:
-                        schema_holder = t
+                    tables.append(t)
+            # ONE bulk D2H of all block row counts instead of a blocking
+            # 4-byte round trip per map block (ROADMAP item 1)
+            counts = jax.device_get(  # srtpu: sync-ok(batched count sync, 4B per block once per reduce partition)
+                [t.num_rows for t in tables])
+            for t, cnt in zip(tables, counts):
+                if int(cnt):
+                    parts.append(t)
+                elif schema_holder is None:
+                    schema_holder = t
         _bump(blocks_fetched=num_maps, bytes_fetched=fetched_bytes,
               reads_cached_tier=1)
         if parts:

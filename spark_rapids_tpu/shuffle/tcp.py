@@ -328,6 +328,7 @@ class TcpShuffleTransport(ShuffleTransport):
             except OSError:
                 return  # socket closed
             threading.Thread(target=self._handle, args=(conn,),
+                             name="srtpu-shuffle-conn",
                              daemon=True).start()
 
     def _handle(self, conn: socket.socket):

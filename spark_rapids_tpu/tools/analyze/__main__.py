@@ -1,0 +1,94 @@
+"""CLI: python -m spark_rapids_tpu.tools.analyze [paths...]
+
+Default invocation (no args) analyzes the installed ``spark_rapids_tpu``
+package against the committed baseline and exits non-zero on any NEW
+violation — the same contract the tier-1 test (tests/test_analyze.py)
+enforces, exposed for pre-commit use.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from . import (CHECKS, analyze_paths, compare_to_baseline,
+               default_baseline_path, load_baseline, write_baseline)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m spark_rapids_tpu.tools.analyze",
+        description="srtpu-analyze: AST static-analysis pass suite "
+                    "(host syncs, lock discipline, thread hygiene, "
+                    "jit purity)")
+    ap.add_argument("paths", nargs="*",
+                    help="files/directories to analyze (default: the "
+                         "spark_rapids_tpu package)")
+    ap.add_argument("--checks", default="",
+                    help=f"comma-separated subset of {','.join(CHECKS)}")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--baseline", default="",
+                    help="baseline file (default: the committed "
+                         "tools/analyze/baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report the full inventory; exit 0 regardless")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write the current findings as the new baseline "
+                         "(initial_inventory is preserved)")
+    ap.add_argument("--top", type=int, default=0,
+                    help="cap listed findings in the text report "
+                         "(0 = all)")
+    ns = ap.parse_args(argv)
+
+    paths = ns.paths
+    if not paths:
+        import spark_rapids_tpu
+        paths = [os.path.dirname(os.path.abspath(
+            spark_rapids_tpu.__file__))]
+    checks = [c for c in ns.checks.split(",") if c] or None
+    if ns.write_baseline and checks:
+        # a subset rewrite would erase every OTHER category's recorded
+        # allowances from the shared baseline file
+        print("--write-baseline requires the full checker set; drop "
+              "--checks", file=sys.stderr)
+        return 2
+    report = analyze_paths(paths, checks=checks)
+
+    if ns.write_baseline:
+        path = ns.baseline or default_baseline_path()
+        data = write_baseline(report, path)
+        print(f"baseline written: {path} "
+              f"({sum(v['count'] for v in data['counts'].values())} "
+              f"finding(s) across {len(data['counts'])} key(s))")
+        return 0
+
+    if ns.json:
+        print(report.to_json())
+    else:
+        print(report.render(top=ns.top))
+
+    if ns.no_baseline:
+        return 0
+    baseline_path = ns.baseline or default_baseline_path()
+    if not os.path.exists(baseline_path):
+        print(f"no baseline at {baseline_path} — run with "
+              f"--write-baseline to create one", file=sys.stderr)
+        return 2
+    regressions = compare_to_baseline(report, load_baseline(baseline_path))
+    if regressions:
+        print(f"\n{len(regressions)} NEW violation(s) vs baseline "
+              f"{baseline_path}:", file=sys.stderr)
+        for f in regressions:
+            print("  " + f.render(), file=sys.stderr)
+        print("fix the site, add '# srtpu: <check>-ok(reason)' with a "
+              "real reason, or (for accepted debt) regenerate the "
+              "baseline with --write-baseline", file=sys.stderr)
+        return 1
+    print("clean vs baseline: no new violations")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

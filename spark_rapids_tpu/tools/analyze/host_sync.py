@@ -1,0 +1,91 @@
+"""host-sync checker: blocking device->host syncs on the hot path.
+
+ROADMAP item 1: sync wait rivals device compute (q4: 4.13s wait vs
+3.57s dev) and Theseus (PAPERS.md) treats data movement as THE thing a
+distributed accelerator engine must minimize. This checker inventories
+the call patterns that force the host to block on device state:
+
+- ``sync-item``              ``x.item()`` — one scalar per round trip
+- ``sync-asarray``           ``np.asarray(x)`` (numpy resolved through
+                             imports, so ``jnp.asarray`` never matches)
+- ``sync-device-get``        ``jax.device_get(x)``
+- ``sync-block-until-ready`` ``x.block_until_ready()``
+- ``sync-int-scalar``        ``int(x.num_rows)`` / ``int(jnp.sum(...))``
+                             — device scalars by convention in this
+                             codebase (DeviceTable.num_rows is a traced
+                             int32), so ``int()`` blocks on the device;
+                             the exchange row-count syncs ROADMAP item 1
+                             calls out are exactly this shape
+
+Only ``hot`` and ``warm`` packages are scanned (exec/, expr/,
+columnar/, shuffle/, memory/ + the per-partition tier); tools and
+session setup may sync freely. Statically we cannot prove an
+``np.asarray`` argument is device-resident — sites that are host-only
+or genuinely cold carry ``# srtpu: sync-ok(reason)`` so the baseline
+reflects real hot-path debt (ISSUE 6 audit satellite).
+
+``np.array`` literal construction is deliberately NOT flagged: in this
+codebase device->host conversion goes through asarray/device_get/
+to_host, while ``np.array([...])`` builds host constants.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from . import Finding, Project, ScopedVisitor
+
+__all__ = ["check"]
+
+#: severities the sync checker reports on (cold packages sync by design)
+REPORTED_SEVERITIES = ("hot", "warm")
+
+
+class _SyncVisitor(ScopedVisitor):
+    def __init__(self, ctx):
+        super().__init__()
+        self.ctx = ctx
+        self.findings: List[Finding] = []
+
+    def _hit(self, node: ast.Call, rule: str, what: str) -> None:
+        self.findings.append(self.ctx.finding(
+            "sync", rule, node, self.symbol,
+            f"blocking device->host sync: {what}"))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        q = self.ctx.qualify(node.func)
+        # .item()/.block_until_ready() match on the RAW attribute, not
+        # the qualified chain: the receiver may be a computed expression
+        # ((a - b).item()) that qualify() cannot name
+        attr = node.func.attr if isinstance(node.func, ast.Attribute) \
+            else None
+        if attr == "item" and not node.args and not node.keywords:
+            self._hit(node, "sync-item", f"{_tail(q) or '.item'}()")
+        elif q in ("numpy.asarray", "numpy.ndarray.__array__"):
+            self._hit(node, "sync-asarray", "np.asarray(...)")
+        elif q == "jax.device_get" or q.endswith(".device_get"):
+            self._hit(node, "sync-device-get", "jax.device_get(...)")
+        elif attr == "block_until_ready":
+            self._hit(node, "sync-block-until-ready",
+                      f"{_tail(q) or '.block_until_ready'}()")
+        elif q == "int" and len(node.args) == 1 and not node.keywords:
+            aq = self.ctx.qualify(node.args[0])
+            if aq.endswith(".num_rows") or aq.startswith("jax.numpy."):
+                self._hit(node, "sync-int-scalar",
+                          f"int({_tail(aq)}) on a device scalar")
+        self.generic_visit(node)
+
+
+def _tail(q: str, n: int = 2) -> str:
+    return ".".join(q.split(".")[-n:])
+
+
+def check(project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    for ctx in project.modules:
+        if ctx.severity not in REPORTED_SEVERITIES:
+            continue
+        v = _SyncVisitor(ctx)
+        v.visit(ctx.tree)
+        out.extend(v.findings)
+    return out

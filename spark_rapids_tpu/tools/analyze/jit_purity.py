@@ -1,0 +1,285 @@
+"""jit checker: purity at the XLA trace boundary + donation safety.
+
+Flare (PAPERS.md) is the canary: a compiled query engine only works if
+the functions handed to the compiler are pure. In this engine a side
+effect inside a traced function fires ONCE at trace time and never
+again — a metric incremented inside a ``batch_fn`` closure counts one
+batch per compile, not per batch; a tracer span measures tracing, not
+execution; a conf read freezes the first session's value into the
+cached executable (utils/compile_cache.py caches across sessions).
+
+Traced contexts are discovered project-wide:
+
+- a function passed directly to ``jax.jit`` / ``shard_map`` is traced;
+- the 2nd argument of ``cached_jit(key, builder)`` is a BUILDER: the
+  builder body runs host-side exactly once, but every function DEFINED
+  INSIDE it (the closure it returns) is traced. Builder references are
+  resolved by name across the project, so ``cached_jit(sig,
+  self.batch_fn)`` marks every ``batch_fn``'s nested defs as traced.
+
+Rules:
+
+- ``jit-side-effect``      — print / tracer spans / metric registry
+  writes / ``note_progress`` / ``time.*`` reads / conf reads /
+  ``os.environ`` / ``open`` inside a traced context.
+- ``jit-use-after-donate`` — an argument variable passed at a donated
+  position (``donate_argnums``) is read again after the donating call:
+  XLA may already have reused its buffers (exec/wholestage.py nbytes-
+  before-call comment is this rule by hand). The analysis is lexical
+  within one function body — sibling branches of the same ``if`` do
+  not count, and a KNOWN LIMITATION is that loop-carried uses (the
+  same variable re-donated on the next iteration) are not seen either;
+  only reads in statements lexically after the donating call flag.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from . import Finding, Project, ScopedVisitor
+
+__all__ = ["check"]
+
+#: call names that enter a traced context with the function as arg 0
+_DIRECT_JIT = ("jax.jit", "shard_map")
+#: qualified-name suffixes that are side effects inside a trace
+_TIME_CALLS = frozenset({"time.time", "time.perf_counter",
+                         "time.monotonic", "time.sleep",
+                         "time.process_time"})
+
+
+def _bare(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def _collect_jit_entries(project: Project) -> Tuple[Set[str], Set[str]]:
+    """(builder names, directly-jitted function names) project-wide."""
+    builders: Set[str] = set()
+    direct: Set[str] = set()
+    for ctx in project.modules:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            q = ctx.qualify(node.func)
+            if q.endswith("cached_jit") and len(node.args) >= 2:
+                name = _bare(node.args[1])
+                if name:
+                    builders.add(name)
+            elif (q in _DIRECT_JIT or q.endswith(".jit")
+                  or q.endswith(".shard_map")
+                  or q.endswith(".pjit")) and node.args:
+                name = _bare(node.args[0])
+                if name:
+                    direct.add(name)
+    return builders, direct
+
+
+def _traced_defs(tree: ast.AST, builders: Set[str],
+                 direct: Set[str]) -> List[ast.FunctionDef]:
+    """FunctionDef nodes whose BODY executes under an XLA trace."""
+    traced: List[ast.FunctionDef] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name in direct:
+            traced.append(node)
+        elif node.name in builders:
+            traced.extend(
+                inner for stmt in ast.walk(node)
+                for inner in [stmt]
+                if isinstance(inner, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))
+                and inner is not node)
+    return traced
+
+
+class _EffectVisitor(ScopedVisitor):
+    """Flags side-effectful calls inside one traced function body."""
+
+    def __init__(self, ctx, owner: str):
+        super().__init__()
+        self.ctx = ctx
+        self.owner = owner
+        self.findings: List[Finding] = []
+
+    def _hit(self, node, what: str) -> None:
+        self.findings.append(self.ctx.finding(
+            "jit", "jit-side-effect", node, self.owner,
+            f"{what} inside a traced function — runs once at trace "
+            f"time, never per batch (and is baked into the cached "
+            f"executable)"))
+
+    def visit_FunctionDef(self, node):
+        # nested defs inside a traced fn are traced too; keep walking
+        self._scoped(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node: ast.Call) -> None:
+        q = self.ctx.qualify(node.func)
+        bare = _bare(node.func) or ""
+        chain = q.lower()
+        if q == "print":
+            self._hit(node, "print()")
+        elif q in _TIME_CALLS:
+            self._hit(node, f"{q}()")
+        elif bare == "note_progress":
+            self._hit(node, "note_progress()")
+        elif bare == "get_tracer" or ".span" in chain and "tracer" in chain:
+            self._hit(node, "tracer span")
+        elif bare in ("add", "observe", "timed") and (
+                "metrics" in chain or chain.startswith(("registry.",
+                                                        "reg."))):
+            self._hit(node, f"metric registry write ({q})")
+        elif bare == "get" and ("conf" in chain.split(".")[0]
+                                or ".conf." in chain):
+            self._hit(node, f"conf read ({q})")
+        elif q == "RapidsConf" or q.endswith(".RapidsConf"):
+            self._hit(node, "RapidsConf construction")
+        elif q == "open":
+            self._hit(node, "open()")
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if self.ctx.qualify(node).startswith("os.environ"):
+            self._hit(node, "os.environ read")
+        self.generic_visit(node)
+
+
+# ---------------------------------------------------------------------------
+# use-after-donation
+# ---------------------------------------------------------------------------
+def _donated_positions(call: ast.Call) -> List[int]:
+    kw = next((k for k in call.keywords if k.arg == "donate_argnums"),
+              None)
+    if kw is None:
+        return []
+    v = kw.value
+    if isinstance(v, ast.Constant) and isinstance(v.value, int):
+        return [v.value]
+    if isinstance(v, (ast.Tuple, ast.List)):
+        return [e.value for e in v.elts
+                if isinstance(e, ast.Constant)
+                and isinstance(e.value, int)]
+    return []
+
+
+def _later_statements(fn: ast.AST, target: ast.stmt) -> List[ast.stmt]:
+    """Statements lexically AFTER the one containing ``target``, at the
+    containing block and every enclosing block — sibling branches of the
+    same if/try never count."""
+
+    def walk(body: Sequence[ast.stmt]) -> Optional[List[ast.stmt]]:
+        for i, stmt in enumerate(body):
+            if stmt is target or any(n is target for n in ast.walk(stmt)):
+                later = list(body[i + 1:])
+                for field in ("body", "orelse", "finalbody", "handlers"):
+                    sub = getattr(stmt, field, None)
+                    if isinstance(sub, list) and stmt is not target:
+                        blocks = [h.body for h in sub] \
+                            if field == "handlers" else [sub]
+                        for blk in blocks:
+                            deeper = walk(blk)
+                            if deeper is not None:
+                                return deeper + later
+                return later
+        return None
+
+    return walk(getattr(fn, "body", [])) or []
+
+
+def _walk_own_scope(stmt: ast.stmt):
+    """ast.walk that does NOT descend into nested function/lambda
+    bodies — a nested def's donation is ITS scope's concern (it gets
+    its own _check_function pass), and attributing it to the enclosing
+    function would flag the outer function's unrelated same-named
+    variables."""
+    stack = [stmt]
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue  # nested scope: don't expand its body
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class _DonationVisitor(ScopedVisitor):
+    def __init__(self, ctx):
+        super().__init__()
+        self.ctx = ctx
+        self.findings: List[Finding] = []
+
+    def _scoped_fn(self, node):
+        self._check_function(node)
+        self._scoped(node)
+
+    visit_FunctionDef = _scoped_fn
+    visit_AsyncFunctionDef = _scoped_fn
+
+    def _check_function(self, fn: ast.FunctionDef) -> None:
+        donating_vars: Dict[str, List[int]] = {}
+        for stmt in fn.body:
+            for node in _walk_own_scope(stmt):
+                if isinstance(node, ast.Assign) \
+                        and isinstance(node.value, ast.Call):
+                    pos = _donated_positions(node.value)
+                    if pos:
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                donating_vars[t.id] = pos
+        if not donating_vars:
+            return
+        symbol = ".".join(self._scope + [fn.name])
+        for stmt in fn.body:
+            for call in _walk_own_scope(stmt):
+                if not (isinstance(call, ast.Call)
+                        and isinstance(call.func, ast.Name)
+                        and call.func.id in donating_vars):
+                    continue
+                donated = [call.args[i].id
+                           for i in donating_vars[call.func.id]
+                           if i < len(call.args)
+                           and isinstance(call.args[i], ast.Name)]
+                if not donated:
+                    continue
+                for later in _later_statements(fn, stmt):
+                    for node in ast.walk(later):
+                        if isinstance(node, ast.Name) \
+                                and isinstance(node.ctx, ast.Load) \
+                                and node.id in donated:
+                            self.findings.append(self.ctx.finding(
+                                "jit", "jit-use-after-donate", node,
+                                symbol,
+                                f"'{node.id}' is read after being "
+                                f"passed at a donated position to "
+                                f"'{call.func.id}' — XLA may have "
+                                f"already reused its buffers"))
+                            donated = [d for d in donated
+                                       if d != node.id]
+
+
+def check(project: Project) -> List[Finding]:
+    builders, direct = _collect_jit_entries(project)
+    out: List[Finding] = []
+    seen: Set[Tuple[str, str, int, int]] = set()
+    for ctx in project.modules:
+        # traced defs can nest (a builder's closure defining a helper):
+        # visiting the outer body covers the inner, so dedupe by site
+        for fn in _traced_defs(ctx.tree, builders, direct):
+            v = _EffectVisitor(ctx, fn.name)
+            for stmt in fn.body:
+                v.visit(stmt)
+            for f in v.findings:
+                site = (f.rule, f.path, f.line, f.col)
+                if site not in seen:
+                    seen.add(site)
+                    out.append(f)
+        dv = _DonationVisitor(ctx)
+        dv.visit(ctx.tree)
+        out.extend(dv.findings)
+    return out

@@ -1,0 +1,230 @@
+"""lock checker: TpuSemaphore discipline under materialize locks.
+
+The PR-3 deadlock class: pipelined partition drains race to materialize
+a shared node (exchange, AQE stage, broadcast build) behind a
+``_mat_lock``; if the lock holder then BLOCKS acquiring the TpuSemaphore
+while an admitted task waits on that same lock, the engine wedges at
+``concurrentGpuTasks=1`` (parallel/pipeline.py ``exempt_admission``
+invariant). PR-3 fixed it by convention only — every materialize body
+wraps itself in ``exempt_admission()``. This checker enforces the
+convention with a project-wide call-graph walk:
+
+- ``lock-sem-under-materialize`` — inside a ``with <x>._mat_lock:``
+  body, a call that (transitively) reaches semaphore acquisition
+  (``acquire_if_necessary`` / ``held`` / ``task_scope``) and is not
+  wrapped in ``exempt_admission()`` / ``_worker_scope()``.
+- ``lock-bare-contextmanager`` — ``sem.task_scope()`` / ``sem.held()``
+  / ``exempt_admission()`` as a bare expression statement: the context
+  manager is created but never entered, so the call silently does
+  nothing (or leaks a hold when entered manually).
+- ``lock-release-all-in-scope`` — ``release_all()`` lexically inside a
+  ``with sem.held()/task_scope():`` body: it drops the scope's own hold
+  mid-scope, so the scope exit releases a permit it no longer owns.
+
+The call graph is name-based (a call or function-reference argument to
+``f`` links to every analyzed def named ``f``) — deliberately coarse:
+false positives are cheap to suppress with ``# srtpu: lock-ok(reason)``,
+while a missed edge would hide a deadlock.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import Finding, Project, ScopedVisitor
+
+__all__ = ["check"]
+
+#: attribute calls that acquire (or may block on) the semaphore
+_ACQUIRING_ATTRS = frozenset({"acquire_if_necessary", "held", "task_scope"})
+#: context managers inside which semaphore acquires are no-ops
+_EXEMPT_NAMES = frozenset({"exempt_admission", "_worker_scope"})
+#: with-context attribute names that mark a shared materialize lock
+_MAT_LOCK_MARKERS = ("_mat_lock", "materialize_lock")
+
+
+def _bare_name(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def _is_exempt_with(item: ast.withitem) -> bool:
+    cm = item.context_expr
+    return isinstance(cm, ast.Call) \
+        and _bare_name(cm.func) in _EXEMPT_NAMES
+
+
+def _is_mat_lock_with(item: ast.withitem) -> bool:
+    name = _bare_name(item.context_expr)
+    return name is not None \
+        and any(m in name for m in _MAT_LOCK_MARKERS)
+
+
+def _is_scope_with(item: ast.withitem) -> bool:
+    cm = item.context_expr
+    return isinstance(cm, ast.Call) \
+        and _bare_name(cm.func) in ("held", "task_scope")
+
+
+class _GraphBuilder(ScopedVisitor):
+    """Per-function: does it directly acquire, and which names does it
+    call (or pass around as a function reference)?"""
+
+    def __init__(self):
+        super().__init__()
+        self.direct_acquirers: Set[str] = set()
+        self.edges: Dict[str, Set[str]] = {}
+        self.known_defs: Set[str] = set()
+        self._fn_stack: List[str] = []
+        self._exempt_depth = 0
+
+    def _scoped_fn(self, node):
+        self.known_defs.add(node.name)
+        self._fn_stack.append(node.name)
+        self._scope.append(node.name)
+        try:
+            self.generic_visit(node)
+        finally:
+            self._scope.pop()
+            self._fn_stack.pop()
+
+    visit_FunctionDef = _scoped_fn
+    visit_AsyncFunctionDef = _scoped_fn
+
+    def visit_With(self, node: ast.With) -> None:
+        exempt = any(_is_exempt_with(i) for i in node.items)
+        acquiring = any(_is_scope_with(i) for i in node.items)
+        if acquiring and self._fn_stack and not self._exempt_depth:
+            self.direct_acquirers.add(self._fn_stack[-1])
+        if exempt:
+            self._exempt_depth += 1
+        try:
+            self.generic_visit(node)
+        finally:
+            if exempt:
+                self._exempt_depth -= 1
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = _bare_name(node.func)
+        if self._fn_stack:
+            cur = self._fn_stack[-1]
+            if name in _ACQUIRING_ATTRS and not self._exempt_depth:
+                self.direct_acquirers.add(cur)
+            if name:
+                self.edges.setdefault(cur, set()).add(name)
+            # a function passed BY REFERENCE may be invoked downstream
+            # (parallel_map(drain, ...)): link it too
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                ref = _bare_name(arg)
+                if ref:
+                    self.edges.setdefault(cur, set()).add(ref)
+        self.generic_visit(node)
+
+
+def _transitive_acquirers(builders: List[_GraphBuilder]) -> Set[str]:
+    acquirers: Set[str] = set()
+    edges: Dict[str, Set[str]] = {}
+    known: Set[str] = set()
+    for b in builders:
+        acquirers |= b.direct_acquirers
+        known |= b.known_defs
+        for k, v in b.edges.items():
+            edges.setdefault(k, set()).update(v)
+    # only propagate through names that are actual defs somewhere in the
+    # project (a call to e.g. list() must not become an edge)
+    changed = True
+    while changed:
+        changed = False
+        for fn, callees in edges.items():
+            if fn in acquirers:
+                continue
+            if any(c in acquirers and c in (known | _ACQUIRING_ATTRS)
+                   for c in callees):
+                acquirers.add(fn)
+                changed = True
+    return acquirers
+
+
+class _SiteVisitor(ScopedVisitor):
+    """Flag the three rules, given the project-wide acquirer set."""
+
+    def __init__(self, ctx, acquirers: Set[str]):
+        super().__init__()
+        self.ctx = ctx
+        self.acquirers = acquirers
+        self.findings: List[Finding] = []
+        self._mat_depth = 0
+        self._exempt_depth = 0
+        self._scope_with_depth = 0
+
+    def visit_With(self, node: ast.With) -> None:
+        mat = any(_is_mat_lock_with(i) for i in node.items)
+        exempt = any(_is_exempt_with(i) for i in node.items)
+        scope = any(_is_scope_with(i) for i in node.items)
+        if mat:
+            self._mat_depth += 1
+        if exempt:
+            self._exempt_depth += 1
+        if scope:
+            self._scope_with_depth += 1
+        try:
+            self.generic_visit(node)
+        finally:
+            if mat:
+                self._mat_depth -= 1
+            if exempt:
+                self._exempt_depth -= 1
+            if scope:
+                self._scope_with_depth -= 1
+
+    def visit_Expr(self, node: ast.Expr) -> None:
+        call = node.value
+        if isinstance(call, ast.Call):
+            name = _bare_name(call.func)
+            if name in ("task_scope", "held") or name in _EXEMPT_NAMES:
+                self.findings.append(self.ctx.finding(
+                    "lock", "lock-bare-contextmanager", node, self.symbol,
+                    f"'{name}(...)' creates a context manager that is "
+                    f"never entered — use 'with {name}(...):'"))
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = _bare_name(node.func)
+        if self._mat_depth and not self._exempt_depth:
+            reaches = name in _ACQUIRING_ATTRS or name in self.acquirers
+            refs = [] if reaches else [
+                _bare_name(a) for a in
+                list(node.args) + [k.value for k in node.keywords]]
+            via = name if reaches else next(
+                (r for r in refs if r in self.acquirers), None)
+            if reaches or via:
+                self.findings.append(self.ctx.finding(
+                    "lock", "lock-sem-under-materialize", node, self.symbol,
+                    f"'{via or name}' may block on the TpuSemaphore while "
+                    f"holding a materialize lock — wrap the locked body in "
+                    f"exempt_admission() (PR-3 deadlock class)"))
+        if name == "release_all" and self._scope_with_depth:
+            self.findings.append(self.ctx.finding(
+                "lock", "lock-release-all-in-scope", node, self.symbol,
+                "release_all() inside a held()/task_scope() body drops "
+                "the scope's own hold; the scope exit then releases a "
+                "permit it no longer owns"))
+        self.generic_visit(node)
+
+
+def check(project: Project) -> List[Finding]:
+    builders: List[_GraphBuilder] = []
+    for ctx in project.modules:
+        b = _GraphBuilder()
+        b.visit(ctx.tree)
+        builders.append(b)
+    acquirers = _transitive_acquirers(builders)
+    out: List[Finding] = []
+    for ctx in project.modules:
+        v = _SiteVisitor(ctx, acquirers)
+        v.visit(ctx.tree)
+        out.extend(v.findings)
+    return out

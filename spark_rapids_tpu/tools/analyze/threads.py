@@ -1,0 +1,96 @@
+"""thread checker: queue bounds, thread hygiene, and engine sleeps.
+
+Subsumes the ad-hoc regex lint that lived in tests/test_pipeline.py
+(PR 3): every queue at a pipeline stage boundary must be bounded, or a
+slow consumer silently re-materializes whole partitions in memory. The
+AST version also enforces the no-leaked-threads contract statically —
+a thread the shutdown tests cannot NAME cannot be reaped or attributed
+in watchdog forensics (utils/health.py dumps stacks by thread name).
+
+- ``thread-unbounded-queue`` — ``queue.Queue()`` / ``LifoQueue()`` /
+  ``PriorityQueue()`` with no bound (positional or ``maxsize=``), and
+  any ``queue.SimpleQueue()`` (unbounded by construction).
+- ``thread-unnamed``         — ``threading.Thread`` without ``name=``,
+  or a ``ThreadPoolExecutor`` without ``thread_name_prefix=``.
+- ``thread-non-daemon``      — ``threading.Thread`` without
+  ``daemon=True``: a non-daemon engine thread blocks interpreter exit
+  if any shutdown path misses it.
+- ``thread-sleep``           — ``time.sleep`` in engine code; polling
+  belongs on ``Event.wait``/queue timeouts. The health watchdog
+  (utils/health.py) and the tools tree are exempt by path.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from . import Finding, Project, ScopedVisitor
+
+__all__ = ["check"]
+
+_QUEUE_CLASSES = frozenset({"queue.Queue", "queue.LifoQueue",
+                            "queue.PriorityQueue"})
+#: paths (relpath substrings) where time.sleep is legitimate
+_SLEEP_EXEMPT = ("spark_rapids_tpu/tools/", "spark_rapids_tpu/utils/health")
+
+
+def _kw(node: ast.Call, name: str) -> Optional[ast.keyword]:
+    return next((k for k in node.keywords if k.arg == name), None)
+
+
+class _ThreadVisitor(ScopedVisitor):
+    def __init__(self, ctx):
+        super().__init__()
+        self.ctx = ctx
+        self.findings: List[Finding] = []
+
+    def _hit(self, node, rule: str, msg: str) -> None:
+        self.findings.append(self.ctx.finding(
+            "thread", rule, node, self.symbol, msg))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        q = self.ctx.qualify(node.func)
+        if q in _QUEUE_CLASSES:
+            if not node.args and _kw(node, "maxsize") is None:
+                self._hit(node, "thread-unbounded-queue",
+                          f"{q}() has no maxsize bound — an unbounded "
+                          f"queue re-materializes whole partitions in "
+                          f"memory")
+        elif q == "queue.SimpleQueue":
+            self._hit(node, "thread-unbounded-queue",
+                      "queue.SimpleQueue is unbounded by construction")
+        elif q == "threading.Thread":
+            if _kw(node, "name") is None:
+                self._hit(node, "thread-unnamed",
+                          "threading.Thread without name= — unnamed "
+                          "threads cannot be reaped by the shutdown "
+                          "tests or attributed in stall forensics")
+            daemon = _kw(node, "daemon")
+            if daemon is None or (isinstance(daemon.value, ast.Constant)
+                                  and daemon.value.value is not True):
+                self._hit(node, "thread-non-daemon",
+                          "threading.Thread without daemon=True — a "
+                          "non-daemon engine thread blocks interpreter "
+                          "exit when a shutdown path misses it")
+        elif q.endswith("ThreadPoolExecutor"):
+            if _kw(node, "thread_name_prefix") is None:
+                self._hit(node, "thread-unnamed",
+                          "ThreadPoolExecutor without thread_name_prefix= "
+                          "— pool workers show up as ThreadPoolExecutor-N "
+                          "in watchdog stack dumps")
+        elif q == "time.sleep":
+            if not any(x in self.ctx.relpath for x in _SLEEP_EXEMPT):
+                self._hit(node, "thread-sleep",
+                          "time.sleep in engine code — poll with "
+                          "Event.wait()/queue timeouts so shutdown can "
+                          "interrupt the wait")
+        self.generic_visit(node)
+
+
+def check(project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    for ctx in project.modules:
+        v = _ThreadVisitor(ctx)
+        v.visit(ctx.tree)
+        out.extend(v.findings)
+    return out

@@ -78,6 +78,7 @@ class DiagnoseReport:
                 lines.append(f"  {rank}. ({f.node}, {f.metric}) {pct} of "
                              f"wall — {f.detail}")
                 lines.append(f"     suggest: {f.suggestion}")
+        lines.extend(_sync_debt_lines())
         return "\n".join(lines)
 
     def to_json(self, top: int = 3) -> str:
@@ -87,7 +88,47 @@ class DiagnoseReport:
                 "query_id": q.query_id, "wall_s": q.wall_s,
                 "findings": [f.to_dict() for f in q.top(top)],
             } for q in self.queries],
+            "sync_debt": _sync_debt_info(),
         }, indent=1)
+
+
+def _sync_debt_info() -> Dict:
+    """The srtpu-analyze baseline's sync inventory (see tools/analyze):
+    which FILES statically carry blocking-sync debt. Cross-referencing it
+    against the dynamic findings above ranks ROADMAP-item-1 work — an
+    operator with a hot pipelineWait/d2h signal whose source file is near
+    the top of this inventory is the highest-leverage fix. {} when no
+    baseline is committed (never fails the report)."""
+    try:
+        from .analyze import baseline_summary
+        return baseline_summary()
+    except Exception:
+        return {}
+
+
+def _sync_debt_lines() -> List[str]:
+    info = _sync_debt_info()
+    checks = (info.get("summary") or {}).get("checks") or {}
+    sync = checks.get("sync")
+    if not sync:
+        return []
+    initial = (info.get("initial_inventory") or {}).get("sync")
+    head = (f"static sync-site debt (srtpu-analyze baseline): "
+            f"{sync.get('total', 0)} site(s), hot={sync.get('hot', 0)} "
+            f"warm={sync.get('warm', 0)}")
+    if initial:
+        head += f" (initial inventory {initial})"
+    lines = [head]
+    top = (info.get("summary") or {}).get("top_sync_files") or []
+    if top:
+        lines.append("  top hot-sync files: " + ", ".join(
+            f"{t['path'].rsplit('/', 1)[-1]}({t['hot_syncs']})"
+            for t in top[:5]))
+        lines.append("  operators above with pipelineWait / d2h signals "
+                     "that live in these files are the ROADMAP item 1 "
+                     "targets (python -m spark_rapids_tpu.tools.analyze "
+                     "for exact lines)")
+    return lines
 
 
 

@@ -59,8 +59,8 @@ def cosine_similarity(a, b):
     cosine_similarity native kernel analogue; arrays are host columns)."""
     out = np.empty(len(a), dtype=np.float64)
     for i in range(len(a)):
-        x = np.asarray(a[i], dtype=np.float64)
-        y = np.asarray(b[i], dtype=np.float64)
+        x = np.asarray(a[i], dtype=np.float64)  # srtpu: sync-ok(host-side example UDF)
+        y = np.asarray(b[i], dtype=np.float64)  # srtpu: sync-ok(host-side example UDF)
         denom = np.linalg.norm(x) * np.linalg.norm(y)
         out[i] = float(np.dot(x, y) / denom) if denom else float("nan")
     return out
@@ -120,8 +120,8 @@ def _pallas_axpy_device(a, x, y):
 
 
 def _pallas_axpy_host(a, x, y):
-    return (np.asarray(a, dtype=np.float32) * np.asarray(x, dtype=np.float32)
-            + np.asarray(y, dtype=np.float32))
+    return (np.asarray(a, dtype=np.float32) * np.asarray(x, dtype=np.float32)  # srtpu: sync-ok(host-side example UDF)
+            + np.asarray(y, dtype=np.float32))  # srtpu: sync-ok(host-side example UDF)
 
 
 @columnar_udf(dt.FLOAT, name="pallas_axpy", host_fn=_pallas_axpy_host)

@@ -99,7 +99,7 @@ def _to_pylist(c: EvalCol, n: int) -> List:
             out.append(None)
         else:
             v = vals[i]
-            out.append(v.item() if isinstance(v, np.generic) else v)
+            out.append(v.item() if isinstance(v, np.generic) else v)  # srtpu: sync-ok(python UDF row bridge requires host rows)
     return out
 
 

@@ -1,0 +1,470 @@
+"""srtpu-analyze static-analysis suite (spark_rapids_tpu/tools/analyze).
+
+Covers the ISSUE 6 acceptance contract:
+- fixture snippets trip each of the four checkers (sync / lock /
+  thread / jit) and the known-clean variants stay clean,
+- suppression syntax + baseline round-trip (sticky initial_inventory,
+  regression detection on a seeded new violation),
+- the tier-1 gate: the full package analyzes CLEAN against the
+  committed baseline, a seeded violation in ANY checker category is
+  flagged as new, and the host-sync baseline is strictly below the
+  initial inventory (real fixes landed, not just suppressions).
+"""
+import json
+import pathlib
+import textwrap
+
+import pytest
+
+from spark_rapids_tpu.tools.analyze import (analyze_paths, baseline_summary,
+                                            compare_to_baseline,
+                                            default_baseline_path,
+                                            load_baseline, severity_for,
+                                            write_baseline)
+
+PKG = pathlib.Path(__file__).resolve().parent.parent / "spark_rapids_tpu"
+
+
+def _write(tmp_path, name, body):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(body))
+    return str(p)
+
+
+def _rules(report, check=None):
+    return sorted({f.rule for f in report.findings
+                   if check is None or f.check == check})
+
+
+# ---------------------------------------------------------------------------
+# checker fixtures: each rule trips on a minimal snippet
+# ---------------------------------------------------------------------------
+def test_sync_checker_rules(tmp_path):
+    path = _write(tmp_path, "sync_fixture.py", """\
+        import numpy as np
+        import jax
+        import jax.numpy as jnp
+
+        def hot_path(table, col):
+            n = col.sum().item()
+            host = np.asarray(col)
+            got = jax.device_get(col)
+            col.block_until_ready()
+            rows = int(table.num_rows)
+            total = int(jnp.sum(table.row_mask))
+            return n, host, got, rows, total
+
+        def fine(col, rows):
+            dev = jnp.asarray(rows)        # stays on device: NOT a sync
+            arr = np.array([1, 2, 3])      # host literal: NOT flagged
+            return dev, arr, int(rows)     # plain int on host value
+        """)
+    report = analyze_paths([path], checks=["sync"])
+    assert _rules(report) == ["sync-asarray", "sync-block-until-ready",
+                              "sync-device-get", "sync-int-scalar",
+                              "sync-item"]
+    assert report.count("sync") == 6  # int() hits twice (num_rows + jnp)
+    assert all(f.symbol == "hot_path" for f in report.findings)
+
+
+def test_sync_checker_computed_receivers(tmp_path):
+    """.item()/.block_until_ready() on computed expressions — the
+    receiver has no qualifiable name but the sync is just as blocking."""
+    path = _write(tmp_path, "computed.py", """\
+        def f(a, b, mask, valid):
+            n = (a - b).item()
+            (mask & valid).block_until_ready()
+            return n
+        """)
+    report = analyze_paths([path], checks=["sync"])
+    assert _rules(report) == ["sync-block-until-ready", "sync-item"]
+
+
+def test_sync_checker_skips_cold_packages(tmp_path):
+    cold = tmp_path / "spark_rapids_tpu" / "tools"
+    cold.mkdir(parents=True)
+    (cold / "coldmod.py").write_text(
+        "import numpy as np\n\ndef f(x):\n    return np.asarray(x)\n")
+    report = analyze_paths([str(tmp_path)], checks=["sync"])
+    assert report.count("sync") == 0
+    assert severity_for(str(cold / "coldmod.py")) == "cold"
+    assert severity_for(str(PKG / "exec" / "exchange.py")) == "hot"
+    assert severity_for(str(PKG / "plan" / "aqe.py")) == "warm"
+
+
+def test_lock_checker_deadlock_class(tmp_path):
+    path = _write(tmp_path, "lock_fixture.py", """\
+        class Node:
+            def _materialize(self):
+                with self._mat_lock:
+                    self._materialize_locked()   # BAD: reaches semaphore
+
+            def _materialize_locked(self):
+                with self.sem.task_scope():
+                    pass
+
+        class GoodNode:
+            def _materialize(self):
+                with self._mat_lock:
+                    with exempt_admission():
+                        self._materialize_locked()
+
+            def _materialize_locked(self):
+                with self.sem.task_scope():
+                    pass
+        """)
+    report = analyze_paths([path], checks=["lock"])
+    hits = [f for f in report.findings
+            if f.rule == "lock-sem-under-materialize"]
+    assert len(hits) == 1
+    assert hits[0].symbol == "Node._materialize"
+
+
+def test_lock_checker_call_graph_is_transitive(tmp_path):
+    path = _write(tmp_path, "lock_transitive.py", """\
+        def leaf(sem):
+            sem.acquire_if_necessary()
+
+        def middle(sem):
+            leaf(sem)
+
+        def bad(self, sem):
+            with self._mat_lock:
+                middle(sem)
+
+        def also_bad(self, sem):
+            with self._mat_lock:
+                run_tasks(middle)    # function reference, not a call
+        """)
+    report = analyze_paths([path], checks=["lock"])
+    syms = sorted(f.symbol for f in report.findings
+                  if f.rule == "lock-sem-under-materialize")
+    assert syms == ["also_bad", "bad"]
+
+
+def test_lock_checker_misuse_rules(tmp_path):
+    path = _write(tmp_path, "lock_misuse.py", """\
+        def bare(sem):
+            sem.task_scope()          # never entered: does nothing
+
+        def release_inside(sem):
+            with sem.held():
+                sem.release_all()     # drops the scope's own hold
+        """)
+    report = analyze_paths([path], checks=["lock"])
+    assert _rules(report) == ["lock-bare-contextmanager",
+                              "lock-release-all-in-scope"]
+
+
+def test_thread_checker_rules(tmp_path):
+    path = _write(tmp_path, "thread_fixture.py", """\
+        import queue
+        import threading
+        import time
+        from concurrent.futures import ThreadPoolExecutor
+
+        q1 = queue.Queue()                       # unbounded
+        q2 = queue.SimpleQueue()                 # unbounded by design
+        q3 = queue.Queue(maxsize=4)              # fine
+        q4 = queue.Queue(8)                      # fine (positional bound)
+        t1 = threading.Thread(target=print)      # unnamed + non-daemon
+        t2 = threading.Thread(target=print, name="x", daemon=True)  # fine
+        p1 = ThreadPoolExecutor(max_workers=2)   # unnamed workers
+        p2 = ThreadPoolExecutor(max_workers=2, thread_name_prefix="x")
+
+        def poll():
+            time.sleep(0.1)                      # engine sleep
+        """)
+    report = analyze_paths([path], checks=["thread"])
+    rules = [f.rule for f in report.findings]
+    assert rules.count("thread-unbounded-queue") == 2
+    assert rules.count("thread-unnamed") == 2
+    assert rules.count("thread-non-daemon") == 1
+    assert rules.count("thread-sleep") == 1
+
+
+def test_jit_checker_side_effects(tmp_path):
+    path = _write(tmp_path, "jit_fixture.py", """\
+        from spark_rapids_tpu.utils.compile_cache import cached_jit
+
+        class Op:
+            def batch_fn(self):
+                conf_val = self.conf.get(KEY)     # build-time: fine
+                def run(table):
+                    print("tracing")              # BAD: effect in trace
+                    self.metrics.add("rows", 1)   # BAD
+                    return table.scale(conf_val)
+                return run
+
+            def execute(self):
+                fn = cached_jit(self.plan_signature(), self.batch_fn)
+                return fn
+        """)
+    report = analyze_paths([path], checks=["jit"])
+    effects = [f for f in report.findings if f.rule == "jit-side-effect"]
+    assert len(effects) == 2
+    msgs = " ".join(f.message for f in effects)
+    assert "print" in msgs and "metric registry" in msgs
+
+
+def test_jit_checker_use_after_donate(tmp_path):
+    path = _write(tmp_path, "donate_fixture.py", """\
+        from spark_rapids_tpu.utils.compile_cache import cached_jit
+
+        def bad(batch, build):
+            fn = cached_jit("k|donate", build, donate_argnums=(0,))
+            out = fn(batch)
+            return batch.nbytes()     # BAD: donated buffers may be dead
+
+        def good(batch, build):
+            fn = cached_jit("k|donate", build, donate_argnums=(0,))
+            size = batch.nbytes()     # before the call: fine
+            if size:
+                out = fn(batch)
+            else:
+                out = other(batch)    # sibling branch: fine
+            return out
+        """)
+    report = analyze_paths([path], checks=["jit"])
+    hits = [f for f in report.findings if f.rule == "jit-use-after-donate"]
+    assert len(hits) == 1
+    assert hits[0].symbol == "bad"
+
+
+def test_jit_checker_donation_scopes_do_not_leak(tmp_path):
+    """A donating call inside a nested def belongs to THAT scope: the
+    outer function's same-named variable must not be flagged."""
+    path = _write(tmp_path, "donate_nested.py", """\
+        from spark_rapids_tpu.utils.compile_cache import cached_jit
+
+        def outer(batch, build):
+            def helper(batch):
+                fn = cached_jit("k", build, donate_argnums=(0,))
+                return fn(batch)
+            out = helper(batch)
+            return batch.nbytes()     # helper's param, not a donation
+        """)
+    report = analyze_paths([path], checks=["jit"])
+    assert not [f for f in report.findings
+                if f.rule == "jit-use-after-donate"]
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+def test_suppression_same_line_and_standalone(tmp_path):
+    path = _write(tmp_path, "supp.py", """\
+        import numpy as np
+
+        def f(col):
+            a = np.asarray(col)  # srtpu: sync-ok(host-only helper)
+            # srtpu: sync-ok(cold error path)
+            b = np.asarray(col)
+            c = np.asarray(col)
+            return a, b, c
+        """)
+    report = analyze_paths([path], checks=["sync"])
+    assert report.count("sync") == 1          # only the unsuppressed one
+    assert len(report.suppressed) == 2
+    assert {f.line for f in report.findings} == {7}
+
+
+def test_suppression_requires_reason(tmp_path):
+    path = _write(tmp_path, "supp_empty.py", """\
+        import numpy as np
+
+        def f(col):
+            return np.asarray(col)  # srtpu: sync-ok()
+        """)
+    report = analyze_paths([path], checks=["sync"])
+    # empty reason: suppression inert AND reported as a meta finding
+    assert report.count("sync") == 1
+    assert any(f.rule == "meta-empty-suppression-reason"
+               for f in report.findings)
+
+
+def test_suppression_is_check_scoped(tmp_path):
+    path = _write(tmp_path, "supp_scope.py", """\
+        import queue
+
+        q = queue.Queue()  # srtpu: sync-ok(wrong check name)
+        """)
+    report = analyze_paths([path], checks=["thread"])
+    assert report.count("thread") == 1        # sync-ok does not cover it
+
+
+# ---------------------------------------------------------------------------
+# baseline round-trip
+# ---------------------------------------------------------------------------
+def test_baseline_roundtrip_and_regression(tmp_path):
+    src = _write(tmp_path, "base.py", """\
+        import numpy as np
+
+        def f(col):
+            return np.asarray(col)
+        """)
+    report = analyze_paths([src], checks=["sync"])
+    assert report.count("sync") == 1
+    bl_path = str(tmp_path / "baseline.json")
+    write_baseline(report, bl_path)
+    # clean against its own baseline
+    assert compare_to_baseline(report, load_baseline(bl_path)) == []
+    # a second occurrence in the SAME function is a new violation
+    pathlib.Path(src).write_text(pathlib.Path(src).read_text().replace(
+        "return np.asarray(col)",
+        "x = np.asarray(col)\n    return np.asarray(x)"))
+    grown = analyze_paths([src], checks=["sync"])
+    regs = compare_to_baseline(grown, load_baseline(bl_path))
+    assert len(regs) == 1 and regs[0].rule == "sync-asarray"
+    # initial_inventory is sticky across regeneration
+    first = load_baseline(bl_path)["initial_inventory"]
+    write_baseline(grown, bl_path)
+    again = load_baseline(bl_path)
+    assert again["initial_inventory"] == first
+    assert again["counts"][regs[0].key()]["count"] == 2
+
+
+def test_baseline_key_survives_line_drift(tmp_path):
+    src = _write(tmp_path, "drift.py", """\
+        import numpy as np
+
+        def f(col):
+            return np.asarray(col)
+        """)
+    report = analyze_paths([src], checks=["sync"])
+    bl_path = str(tmp_path / "baseline.json")
+    write_baseline(report, bl_path)
+    # unrelated code above shifts the line; the key (path+rule+symbol)
+    # still matches, so no new violation is reported
+    pathlib.Path(src).write_text(
+        "import numpy as np\n\nPAD = 1\nPAD2 = 2\n\n\ndef f(col):\n"
+        "    return np.asarray(col)\n")
+    drifted = analyze_paths([src], checks=["sync"])
+    assert compare_to_baseline(drifted, load_baseline(bl_path)) == []
+
+
+# ---------------------------------------------------------------------------
+# tier-1 gate: the package is clean vs the committed baseline
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def package_report():
+    return analyze_paths([str(PKG)])
+
+
+def test_tier1_package_clean_vs_committed_baseline(package_report):
+    baseline = load_baseline(default_baseline_path())
+    regressions = compare_to_baseline(package_report, baseline)
+    assert not regressions, (
+        "NEW static-analysis violation(s) — fix the site, suppress with "
+        "'# srtpu: <check>-ok(reason)', or (for accepted debt) regenerate "
+        "via python -m spark_rapids_tpu.tools.analyze --write-baseline:\n"
+        + "\n".join(f.render() for f in regressions))
+
+
+def test_tier1_seeded_violation_fails_each_category(tmp_path,
+                                                    package_report):
+    """A new violation in ANY checker category must be flagged as new
+    against the committed baseline (the package findings all match the
+    baseline, so the seeded file's findings are exactly the delta)."""
+    seeds = {
+        "sync": "import numpy as np\n\ndef f(c):\n"
+                "    return np.asarray(c)\n",
+        "lock": "def f(self, sem):\n    with self._mat_lock:\n"
+                "        with sem.task_scope():\n            pass\n",
+        "thread": "import queue\n\nq = queue.Queue()\n",
+        "jit": "from spark_rapids_tpu.utils.compile_cache import "
+               "cached_jit\n\ndef f(x, build):\n"
+               "    fn = cached_jit('k', build, donate_argnums=(0,))\n"
+               "    out = fn(x)\n    return x.sum()\n",
+    }
+    baseline = load_baseline(default_baseline_path())
+    for check, body in seeds.items():
+        seeded_file = _write(tmp_path, f"seed_{check}.py", body)
+        report = analyze_paths([str(PKG), seeded_file], checks=[check])
+        regs = compare_to_baseline(report, baseline)
+        assert regs and all(f.check == check for f in regs), \
+            f"seeded {check} violation not detected"
+        pathlib.Path(seeded_file).unlink()
+
+
+def test_tier1_sync_debt_strictly_below_initial_inventory(package_report):
+    """The acceptance criterion that forbids pure baselining: the live
+    sync count must be strictly below the initial (pre-fix) inventory
+    recorded when the analyzer first ran (137 sites)."""
+    baseline = load_baseline(default_baseline_path())
+    initial = baseline["initial_inventory"]["sync"]
+    assert package_report.count("sync") < initial
+    assert baseline["summary"]["checks"]["sync"]["total"] < initial
+
+
+def test_tier1_thread_and_lock_and_jit_clean(package_report):
+    """Conventions the engine already follows stay absolutely clean —
+    these checks carry no baseline allowance at all."""
+    assert package_report.count("thread") == 0
+    assert package_report.count("lock") == 0
+    assert package_report.count("jit") == 0
+    assert package_report.count("meta") == 0
+
+
+def test_baseline_summary_matches_committed_file(package_report):
+    """bench.py copies baseline_summary() into the bench JSON; it must
+    agree with a live analyzer run so the trajectory metric is honest."""
+    info = baseline_summary()
+    assert info, "committed baseline missing"
+    live = package_report.summary()["checks"].get("sync", {})
+    committed = info["summary"]["checks"].get("sync", {})
+    assert committed == live, (
+        "committed baseline is stale — regenerate with "
+        "python -m spark_rapids_tpu.tools.analyze --write-baseline")
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+def test_cli_json_and_exit_codes(tmp_path, capsys):
+    from spark_rapids_tpu.tools.analyze.__main__ import main
+
+    src = _write(tmp_path, "climod.py",
+                 "import numpy as np\n\ndef f(c):\n"
+                 "    return np.asarray(c)\n")
+    bl = str(tmp_path / "bl.json")
+    # no baseline yet -> exit 2
+    assert main([src, "--baseline", bl]) == 2
+    capsys.readouterr()
+    assert main([src, "--baseline", bl, "--write-baseline"]) == 0
+    assert main([src, "--baseline", bl]) == 0
+    out = capsys.readouterr().out
+    assert "clean vs baseline" in out
+    # grow a violation -> exit 1
+    pathlib.Path(src).write_text(
+        "import numpy as np\n\ndef f(c):\n"
+        "    a = np.asarray(c)\n    return np.asarray(a)\n")
+    assert main([src, "--baseline", bl]) == 1
+    capsys.readouterr()
+    # JSON mode round-trips
+    assert main([src, "--baseline", bl, "--json", "--no-baseline"]) == 0
+    obj = json.loads(capsys.readouterr().out)
+    assert obj["summary"]["checks"]["sync"]["total"] == 2
+
+
+def test_diagnose_renders_sync_debt(tmp_path):
+    """tools/diagnose.py cross-references the committed baseline."""
+    from spark_rapids_tpu.tools.diagnose import diagnose_path
+
+    records = [
+        {"event": "app_start", "app_id": "a", "schema_version": 3,
+         "ts": 0.0, "conf": {}},
+        {"event": "query_start", "query_id": 1, "ts": 0.0, "plan": "p"},
+        {"event": "query_end", "query_id": 1, "ts": 1.0, "wall_s": 1.0,
+         "final_plan": "p", "aqe_events": [], "spill_count": {},
+         "semaphore_wait_s": 0.0, "stats": {}},
+        {"event": "app_end", "ts": 1.0},
+    ]
+    p = tmp_path / "log.jsonl"
+    p.write_text("\n".join(json.dumps(r) for r in records) + "\n")
+    rep = diagnose_path(str(p))
+    text = rep.summary()
+    assert "static sync-site debt" in text
+    assert "initial inventory 137" in text
+    obj = json.loads(rep.to_json())
+    assert obj["sync_debt"]["initial_inventory"]["sync"] == 137

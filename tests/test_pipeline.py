@@ -13,7 +13,6 @@ Covers the PR 3 acceptance contract:
 - input donation (donate_argnums) and the byte-based coalesce goal.
 """
 import json
-import re
 import threading
 import time
 import warnings
@@ -217,47 +216,29 @@ def test_no_leaked_threads_after_close(lineitem):
 # tier-1 lint: every prefetch queue in the package must be bounded
 # ---------------------------------------------------------------------------
 def test_lint_no_unbounded_queues():
-    """queue.Queue()/LifoQueue()/PriorityQueue() without maxsize (or any
-    SimpleQueue) silently re-materializes whole partitions in memory —
-    every queue at a pipeline stage boundary must carry a bound."""
+    """Migrated into the srtpu-analyze framework (PR 6): the AST-based
+    thread checker subsumes the old regex lint. The queue-bound contract
+    stays ABSOLUTE — no baseline allowance, no suppressions: an unbounded
+    queue at a stage boundary silently re-materializes whole partitions
+    in memory."""
     import pathlib
 
     import spark_rapids_tpu
+    from spark_rapids_tpu.tools.analyze import analyze_paths
 
     pkg = pathlib.Path(spark_rapids_tpu.__file__).parent
-    offenders = []
-    call_re = re.compile(
-        r"(?:\bqueue\s*\.\s*|^\s*from\s+queue\s+import\b.*\n(?s:.*?))?"
-        r"\b(Queue|LifoQueue|PriorityQueue|SimpleQueue)\s*\(")
-    for path in sorted(pkg.rglob("*.py")):
-        src = path.read_text(encoding="utf-8")
-        uses_queue_mod = re.search(
-            r"^\s*(import queue\b|from queue import)", src, re.M)
-        if not uses_queue_mod:
-            continue
-        for m in re.finditer(
-                r"\b(?:queue\s*\.\s*)?"
-                r"(Queue|LifoQueue|PriorityQueue|SimpleQueue)\s*\(", src):
-            if m.group(1) == "SimpleQueue":
-                offenders.append(f"{path.name}: SimpleQueue is unbounded")
-                continue
-            # the call's argument text up to the matching close paren
-            tail = src[m.end():m.end() + 200]
-            depth, args = 1, ""
-            for ch in tail:
-                if ch == "(":
-                    depth += 1
-                elif ch == ")":
-                    depth -= 1
-                    if depth == 0:
-                        break
-                args += ch
-            if "maxsize" not in args:
-                offenders.append(
-                    f"{path.name}: {m.group(0)}{args[:40]}...) has no "
-                    f"maxsize bound")
+    report = analyze_paths([str(pkg)], checks=["thread"])
+    offenders = [f.render() for f in report.findings + report.suppressed
+                 if f.rule == "thread-unbounded-queue"]
     assert not offenders, offenders
-    # the lint is live: pipeline.py itself must be in scope
+    # the lint is live: a seeded unbounded queue must be caught
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        bad = pathlib.Path(d) / "bad.py"
+        bad.write_text("import queue\nq = queue.Queue()\n")
+        seeded = analyze_paths([str(bad)], checks=["thread"])
+        assert any(f.rule == "thread-unbounded-queue"
+                   for f in seeded.findings)
     assert "maxsize" in (pkg / "parallel" / "pipeline.py").read_text()
 
 
