@@ -41,10 +41,13 @@ BENCH_ABLATION, BENCH_PIPELINE (on|off A/B knob for the pipelined
 executor, spark.rapids.tpu.pipeline.enabled; recorded in the bench JSON),
 BENCH_HEALTH (1|0: live health monitor per phase — /status snapshot +
 peak HBM watermark into the bench JSON, stall forensics appended to
-diagnose.txt), BENCH_STALL_TIMEOUT_S (watchdog threshold).
+diagnose.txt), BENCH_STALL_TIMEOUT_S (watchdog threshold),
+BENCH_WARM=restart (cold-process re-run phase: after smoke populates the
+persistent compile tier, a FRESH worker process replays Q6+Q1 through the
+warm pool and records its second-run compile count — the zero-compiles
+trajectory metric, "restart" + per-phase "compile_cache" in the JSON).
 """
 import atexit
-import hashlib
 import json
 import math
 import os
@@ -66,7 +69,8 @@ _STATE = {
     "tpch": {},
     "errors": {},
     "ablation": {},
-    "compile_cache": {},
+    "restart": {},
+    "compile_cache": {},   # phase -> cache_stats() snapshot
     "sf": None,
     "rows": None,
     "eventlog": {},   # phase -> event-log directory
@@ -110,7 +114,7 @@ def _write_partial():
     with open(tmp, "w") as f:
         json.dump({k: _STATE[k] for k in
                    ("backend", "fell_back", "sf", "rows", "smoke", "tpch",
-                    "ablation", "compile_cache", "errors", "eventlog",
+                    "ablation", "restart", "compile_cache", "errors", "eventlog",
                     "health", "pipeline", "analyze", "notes")}
                   | {"elapsed_s": round(time.monotonic() - _T_START, 2)},
                   f, indent=1)
@@ -193,33 +197,12 @@ def _install_emit_guards():
     signal.signal(signal.SIGALRM, _on_signal)
 
 
-def _machine_fingerprint() -> str:
-    """Stable id for 'programs compiled here run here' (XLA:CPU bakes host
-    CPU features into code; a foreign cache recompiles + SIGILLs)."""
-    import platform
-    parts = [platform.system(), platform.machine()]
-    try:
-        want = ("flags", "features", "model name", "cpu model")
-        seen = set()
-        with open("/proc/cpuinfo") as f:
-            for line in f:
-                key = line.split(":", 1)[0].strip().lower()
-                if key in want and key not in seen:
-                    seen.add(key)
-                    parts.append(" ".join(sorted(line.split(":", 1)[1].split())))
-                if len(seen) == len(want):
-                    break
-    except OSError:
-        pass
-    return hashlib.sha256("|".join(parts).encode()).hexdigest()[:12]
-
-
 def _cache_dir() -> str:
-    base = os.environ.get(
+    """Base dir of the persistent compile tier; the ENGINE scopes it by
+    machine fingerprint + jax version (utils/compile_cache.py), so the
+    parent never needs to compute fingerprints itself."""
+    return os.environ.get(
         "BENCH_XLA_CACHE", os.path.join(_REPO, ".jax_compile_cache"))
-    if not base:
-        return ""
-    return os.path.join(base, _machine_fingerprint())
 
 
 # ---------------------------------------------------------------------------
@@ -315,9 +298,13 @@ def _consume(ev):
     elif kind == "error":
         _STATE["errors"][ev["name"]] = ev["msg"]
     elif kind == "meta":
-        for k in ("sf", "rows", "compile_cache"):
+        for k in ("sf", "rows"):
             if k in ev:
                 _STATE[k] = ev[k]
+        if "compile_cache" in ev:
+            # phase-keyed cache_stats snapshots (incl. the persistent-tier
+            # persist_* hit/miss counters)
+            _STATE["compile_cache"].update(ev["compile_cache"])
         if "eventlog" in ev:
             _STATE["eventlog"].update(ev["eventlog"])
         if "health" in ev:
@@ -474,6 +461,11 @@ def main():
 
     if mode in ("auto", "q1q6"):
         phase_with_retries("smoke", [6, 1])
+        if os.environ.get("BENCH_WARM", "") == "restart" \
+                and _cache_dir() and _remaining() > 60:
+            # cold-process re-run: the smoke worker exited, so this phase
+            # measures second-run compiles across a real process boundary
+            phase_with_retries("restart", [6, 1])
     if mode in ("auto", "tpch22") and _remaining() > 60:
         phase_with_retries("tpch", _TPCH_ORDER)
     if os.environ.get("BENCH_ABLATION", "1") != "0" and _remaining() > 120:
@@ -518,16 +510,17 @@ def _worker_setup_jax():
         # (the axon tunnel registers as "axon", not "tpu") so the default
         # resolution order is the only portable way to pick it
         jax.config.update("jax_platforms", "cpu")
-    cd = _cache_dir()
-    if cd:
-        try:
-            os.makedirs(cd, exist_ok=True)
-            jax.config.update("jax_compilation_cache_dir", cd)
-            jax.config.update(
-                "jax_persistent_cache_min_compile_time_secs", 1.0)
-        except Exception as e:
-            _log(f"compilation cache disabled: {e}")
     return jax
+
+
+def _compile_cache_conf() -> dict:
+    """Persistent-compile-tier session conf (engine wires
+    jax_compilation_cache_dir, the plan-signature manifest and the warm
+    pool under this directory; BENCH_XLA_CACHE='' disables)."""
+    cd = _cache_dir()
+    if not cd:
+        return {}
+    return {"spark.rapids.tpu.compile.cacheDir": cd}
 
 
 def _write_diagnose_report(phase: str):
@@ -673,6 +666,7 @@ def _worker_smoke(sink: _EventSink):
     lineitem = tpch.gen_lineitem(sf, seed=0, rows=rows)
     sess = TpuSession({"spark.rapids.tpu.batchRowsMinBucket": 1 << 18,
                        **_pipeline_conf(),
+                       **_compile_cache_conf(),
                        **_eventlog_conf("smoke", sink),
                        **_health_conf("smoke")})
     df = sess.create_dataframe(lineitem, num_partitions=1).cache()
@@ -735,8 +729,10 @@ def _worker_smoke(sink: _EventSink):
             sink.emit(ev="error", name=name,
                       msg=f"{type(e).__name__}: {e}"[:300])
             _log(f"smoke {name} FAILED: {e}")
+    from spark_rapids_tpu.utils.compile_cache import cache_stats
+    sink.emit(ev="meta", compile_cache={"smoke": dict(cache_stats())})
     _emit_health_snapshot(sink, "smoke", sess)
-    sess.close()  # flush the event log
+    sess.close()  # flush the event log + persist the compile tier
     _write_diagnose_report("smoke")
 
 
@@ -777,6 +773,7 @@ def _worker_tpch(sink: _EventSink):
         "spark.rapids.tpu.batchRowsMinBucket": 8192,
         "spark.rapids.tpu.shuffle.partitions": nparts,
         **_pipeline_conf(),
+        **_compile_cache_conf(),
         **_eventlog_conf("tpch", sink),
         **_health_conf("tpch"),
     })
@@ -816,9 +813,9 @@ def _worker_tpch(sink: _EventSink):
             sink.emit(ev="error", name=name,
                       msg=f"{type(e).__name__}: {e}"[:300])
             _log(f"{name} FAILED: {e}")
-    sink.emit(ev="meta", compile_cache=dict(cache_stats()))
+    sink.emit(ev="meta", compile_cache={"tpch": dict(cache_stats())})
     _emit_health_snapshot(sink, "tpch", sess)
-    sess.close()  # flush the event log
+    sess.close()  # flush the event log + persist the compile tier
     _write_diagnose_report("tpch")
 
 
@@ -844,7 +841,7 @@ def _worker_ablation(sink: _EventSink):
             sess = TpuSession({
                 "spark.rapids.tpu.batchRowsMinBucket": 8192,
                 "spark.rapids.tpu.shuffle.partitions": 2,
-                **_pipeline_conf(), **extra})
+                **_pipeline_conf(), **_compile_cache_conf(), **extra})
             dfs = {"lineitem": sess.create_dataframe(
                 tables["lineitem"], num_partitions=2)}
             times = {}
@@ -860,6 +857,65 @@ def _worker_ablation(sink: _EventSink):
             sink.emit(ev="ablation", name=name,
                       res={"error": f"{type(e).__name__}: {e}"[:200]})
             _log(f"ablation {name} FAILED: {e}")
+    from spark_rapids_tpu.utils.compile_cache import cache_stats
+    sink.emit(ev="meta", compile_cache={"ablation": dict(cache_stats())})
+
+
+def _worker_restart(sink: _EventSink):
+    """BENCH_WARM=restart: the zero-compiles acceptance phase. A FRESH
+    process (the smoke worker that populated the persistent tier is gone)
+    builds the same session/data, waits for the warm pool to replay the
+    persisted exports, runs each query ONCE and records how many XLA
+    compiles that first-in-process run needed — the tracked trajectory
+    number (target: 0)."""
+    _worker_setup_jax()
+    fell_back = os.environ.get("BENCH_PLATFORM") == "cpu"
+    from spark_rapids_tpu.session import TpuSession
+    from spark_rapids_tpu.tools import tpch
+    from spark_rapids_tpu.utils.compile_cache import (cache_stats,
+                                                      warm_pool_wait)
+    default_sf = "0.05" if fell_back else "0.25"
+    sf = float(os.environ.get("BENCH_SMOKE_SF", default_sf))
+    rows = int(6_000_000 * sf)
+    lineitem = tpch.gen_lineitem(sf, seed=0, rows=rows)
+    # conf MUST mirror the smoke phase: same bucket ladder -> same plan
+    # signatures + shapes -> warmed executables match
+    sess = TpuSession({"spark.rapids.tpu.batchRowsMinBucket": 1 << 18,
+                       **_pipeline_conf(),
+                       **_compile_cache_conf(),
+                       **_eventlog_conf("restart", sink),
+                       **_health_conf("restart")})
+    warmed = warm_pool_wait()
+    df = sess.create_dataframe(lineitem, num_partitions=1).cache()
+    t = {"lineitem": df}
+    queries = os.environ.get("BENCH_WORKER_QUERIES", "6,1").split(",")
+    for qn in queries:
+        name = f"q{qn}"
+        sink.emit(ev="start", name=name)
+        try:
+            before = cache_stats()
+            q = getattr(tpch, name)(t)
+            t0 = time.perf_counter()
+            q.collect(device=True)
+            run_s = time.perf_counter() - t0
+            after = cache_stats()
+            res = {"run_s": round(run_s, 4),
+                   "compiles": after["compiles"] - before["compiles"],
+                   "persist_hits": after["persist_hits"]
+                   - before["persist_hits"],
+                   "warm_pool_settled": warmed}
+            sink.emit(ev="done", phase="restart", name=name, res=res)
+            _log(f"restart {name}: run={run_s:.4f}s "
+                 f"second_run_compiles={res['compiles']} "
+                 f"persist_hits={res['persist_hits']}")
+        except Exception as e:
+            sink.emit(ev="error", name=name,
+                      msg=f"{type(e).__name__}: {e}"[:300])
+            _log(f"restart {name} FAILED: {e}")
+    sink.emit(ev="meta", compile_cache={"restart": dict(cache_stats())})
+    _emit_health_snapshot(sink, "restart", sess)
+    sess.close()
+    _write_diagnose_report("restart")
 
 
 def worker_main(phase: str):
@@ -870,6 +926,8 @@ def worker_main(phase: str):
         _worker_tpch(sink)
     elif phase == "ablation":
         _worker_ablation(sink)
+    elif phase == "restart":
+        _worker_restart(sink)
     else:
         raise SystemExit(f"unknown worker phase {phase!r}")
 

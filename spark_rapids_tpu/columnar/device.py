@@ -38,8 +38,10 @@ import numpy as np
 from . import dtypes as dt
 from .host import HostColumn, HostTable
 
-__all__ = ["DeviceColumn", "DeviceTable", "bucket_rows", "bucket_width",
-           "canonical_names", "configure_debug", "debug_assertions_enabled"]
+__all__ = ["BucketPolicy", "DeviceColumn", "DeviceTable", "bucket_rows",
+           "bucket_width", "canonical_names", "configure_buckets",
+           "configure_debug", "current_bucket_policy",
+           "debug_assertions_enabled", "resolve_min_bucket"]
 
 # spark.rapids.tpu.debug.assertions snapshot (session-init chokepoint,
 # like parallel/pipeline.configure_pipeline — columns have no conf at
@@ -117,12 +119,74 @@ def _compact_impl(table: "DeviceTable") -> "DeviceTable":
 _compact_jitted = jax.jit(_compact_impl)
 
 
-def bucket_rows(n: int, min_bucket: int = 1024) -> int:
-    """Round row count up to a power-of-two multiple of ``min_bucket``."""
-    cap = min_bucket
-    while cap < n:
-        cap *= 2
-    return cap
+# ---------------------------------------------------------------------------
+# Canonical shape-bucket policy. XLA compiles one program per shape, so the
+# set of row capacities the engine ever exposes IS the set of programs it
+# ever compiles; one process-wide geometric ladder (instead of per-node
+# ad-hoc bucket choices) keeps that set small and — critically for the
+# persistent compile tier (utils/compile_cache.py) — REPEATABLE: the same
+# query over the same data lands on the same capacities in every process,
+# so a persisted executable serves every rerun.
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass(frozen=True)
+class BucketPolicy:
+    """The process-wide bucket ladder (spark.rapids.tpu.shapeBuckets.*).
+
+    Rungs are ``min_rows * growth^k``; within a rung, capacities quantize
+    down toward the row count in steps of ``growth * rung * max_waste_frac``
+    (never below ``min_rows``), bounding padded-row waste. The defaults
+    (growth=2.0, max_waste_frac=0.5) reproduce the original power-of-two
+    ladder exactly."""
+    min_rows: int = 1024
+    growth: float = 2.0
+    max_waste_frac: float = 0.5
+
+    def bucket(self, n: int, min_bucket: Optional[int] = None) -> int:
+        base = int(min_bucket) if min_bucket is not None else self.min_rows
+        cap = max(base, 1)
+        while cap < n:
+            # max(+1): a growth factor rounding to itself must still climb
+            cap = max(cap + 1, int(cap * self.growth))
+        if cap > base:
+            # quantize down toward n in canonical steps derived from the
+            # rung (NOT from n — a data-dependent quantum would make the
+            # shape set unbounded)
+            step = max(base, int(cap * self.max_waste_frac))
+            cap = min(cap, -(-n // step) * step)
+        return cap
+
+
+_POLICY = BucketPolicy()
+
+
+def configure_buckets(conf) -> None:
+    """Apply spark.rapids.tpu.shapeBuckets.* to the process bucket ladder
+    (called from TpuSession.__init__, like configure_debug; the most
+    recent session wins)."""
+    global _POLICY
+    from ..conf import SHAPE_BUCKET_GROWTH, SHAPE_BUCKET_MAX_WASTE
+    _POLICY = BucketPolicy(
+        min_rows=int(conf.min_bucket_rows),
+        growth=float(conf.get(SHAPE_BUCKET_GROWTH)),
+        max_waste_frac=float(conf.get(SHAPE_BUCKET_MAX_WASTE)))
+
+
+def current_bucket_policy() -> BucketPolicy:
+    return _POLICY
+
+
+def resolve_min_bucket(min_bucket: Optional[int]) -> int:
+    """The bucket floor a node should use: an explicit value wins (planner
+    threads conf.min_bucket_rows; tests pass tiny buckets), ``None`` falls
+    back to the central policy — the one replacement for the per-node
+    ``= 1024`` defaults that used to scatter the ladder."""
+    return int(min_bucket) if min_bucket is not None else _POLICY.min_rows
+
+
+def bucket_rows(n: int, min_bucket: Optional[int] = None) -> int:
+    """Canonical row capacity for ``n`` rows: the central ladder's bucket,
+    floored at ``min_bucket`` when given (policy floor otherwise)."""
+    return _POLICY.bucket(n, min_bucket)
 
 
 def bucket_width(w: int, min_width: int = 8, max_width: int = 4096) -> int:
@@ -314,7 +378,7 @@ class DeviceTable:
 
     # -- host <-> device ------------------------------------------------------
     @staticmethod
-    def from_host(table: HostTable, min_bucket: int = 1024,
+    def from_host(table: HostTable, min_bucket: Optional[int] = None,
                   capacity: Optional[int] = None) -> "DeviceTable":
         n = table.num_rows
         cap = capacity if capacity is not None else bucket_rows(max(n, 1), min_bucket)
@@ -651,14 +715,15 @@ def _upload_column(hc: HostColumn, capacity: int) -> DeviceColumn:
                         None, all_valid=all_valid)
 
 
-def concat_device_tables(tables: Sequence[DeviceTable], min_bucket: int = 1024
-                         ) -> DeviceTable:
+def concat_device_tables(tables: Sequence[DeviceTable],
+                         min_bucket: Optional[int] = None) -> DeviceTable:
     """Device-side concatenation (reference: GpuCoalesceBatches concat).
 
     Compacts each input then concatenates into a bucketed output capacity.
     Jitted when called eagerly (per input-structure cache in jax.jit).
     """
     assert tables, "cannot concat zero device tables"
+    min_bucket = resolve_min_bucket(min_bucket)
     if len(tables) == 1:
         return tables[0]
     from ..shims import get_shims
@@ -676,7 +741,7 @@ def concat_device_tables(tables: Sequence[DeviceTable], min_bucket: int = 1024
     return _concat_jitted(tuple(tables), min_bucket)
 
 
-def _concat_impl(tables, min_bucket: int = 1024) -> DeviceTable:
+def _concat_impl(tables, min_bucket: int) -> DeviceTable:
     first = tables[0]
     total_cap = sum(t.capacity for t in tables)
     # pad the output to a power-of-two bucket: incremental merges would
@@ -790,13 +855,14 @@ def _slice_rows_impl(table: DeviceTable, start, length: int) -> DeviceTable:
 _slice_rows_jitted = jax.jit(_slice_rows_impl, static_argnums=(2,))
 
 
-def shrink_to_fit(table: DeviceTable, min_bucket: int = 1024,
+def shrink_to_fit(table: DeviceTable, min_bucket: Optional[int] = None,
                   num_rows: Optional[int] = None) -> DeviceTable:
     """Compact and shrink capacity to the bucket of the active row count.
 
     Syncs the row count to host (one int) — used between pipeline steps to
     stop capacities from growing across incremental merges. Callers that
     already hold the host count pass ``num_rows`` to skip the sync."""
+    min_bucket = resolve_min_bucket(min_bucket)
     if table.capacity <= min_bucket:
         return table  # cannot shrink below one bucket: skip the device sync
     n = num_rows if num_rows is not None \

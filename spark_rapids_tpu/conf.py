@@ -131,6 +131,36 @@ BATCH_ROWS_MIN_BUCKET = register_conf(
     "up to power-of-two multiples of this so XLA sees a bounded set of shapes.",
     1024, checker=_positive("bucket"))
 
+# -- canonical shape-bucket ladder (columnar/device.py BucketPolicy). One
+# policy serves every node: ad-hoc per-node bucket choices proliferate XLA
+# shapes, and compile time dominates the bench (ROADMAP item 2) --------------
+SHAPE_BUCKET_MIN_ROWS = register_conf(
+    "spark.rapids.tpu.shapeBuckets.minRows",
+    "Smallest rung of the canonical shape-bucket ladder (row capacities "
+    "every device batch is padded to). 0 (default) inherits "
+    "spark.rapids.tpu.batchRowsMinBucket so existing deployments keep "
+    "their bucket floor; set explicitly to size the ladder independently.",
+    0, checker=lambda v: None if int(v) >= 0 else "must be >= 0")
+
+SHAPE_BUCKET_GROWTH = register_conf(
+    "spark.rapids.tpu.shapeBuckets.growth",
+    "Geometric growth factor between bucket-ladder rungs. 2.0 (default) is "
+    "the power-of-two ladder; smaller factors (> 1.0) add rungs, trading "
+    "more compiled shapes for less padding waste.",
+    2.0, conf_type=float,
+    checker=lambda v: None if float(v) > 1.0 else "growth must be > 1.0")
+
+SHAPE_BUCKET_MAX_WASTE = register_conf(
+    "spark.rapids.tpu.shapeBuckets.maxWasteFrac",
+    "Padding-waste quantum as a fraction of the geometric rung: capacities "
+    "quantize down from the rung in steps of growth*rung*maxWasteFrac, "
+    "bounding wasted (padded) rows at the cost of extra canonical shapes. "
+    "0.5 (default) with growth=2.0 degenerates to the plain power-of-two "
+    "ladder (no extra shapes).",
+    0.5, conf_type=float,
+    checker=lambda v: None if 0.0 < float(v) <= 1.0
+    else "maxWasteFrac must be in (0, 1]")
+
 CONCURRENT_TPU_TASKS = register_conf(
     "spark.rapids.sql.concurrentGpuTasks",
     "Number of tasks that may submit device work concurrently per TPU chip "
@@ -292,7 +322,8 @@ class RapidsConf:
 
     @property
     def min_bucket_rows(self) -> int:
-        return self.get(BATCH_ROWS_MIN_BUCKET)
+        v = int(self.get(SHAPE_BUCKET_MIN_ROWS))
+        return v if v > 0 else self.get(BATCH_ROWS_MIN_BUCKET)
 
     @property
     def concurrent_tpu_tasks(self) -> int:

@@ -13,7 +13,8 @@ import jax
 import jax.numpy as jnp
 
 from ..columnar import dtypes as dt
-from ..columnar.device import DeviceColumn, DeviceTable
+from ..columnar.device import (DeviceColumn, DeviceTable,
+                               resolve_min_bucket)
 from ..expr.base import EvalContext, Expression
 from ..plan.physical import PhysicalPlan
 from ..plan.schema import Field, Schema
@@ -272,12 +273,12 @@ class TpuExpandExec(TpuExec):
 
 class TpuRangeExec(TpuExec):
     def __init__(self, start: int, end: int, step: int, num_partitions: int = 1,
-                 min_bucket: int = 1024, max_batch_rows: int = 1 << 22):
+                 min_bucket: Optional[int] = None, max_batch_rows: int = 1 << 22):
         super().__init__()
         import math
         self.start, self.end, self.step = start, end, step
         self._parts = num_partitions
-        self.min_bucket = min_bucket
+        self.min_bucket = resolve_min_bucket(min_bucket)
         self.max_batch_rows = max_batch_rows
         self.children = ()
         self.schema = Schema([Field("id", dt.LONG, False)])

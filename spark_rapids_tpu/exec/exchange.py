@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..columnar.device import (DeviceColumn, DeviceTable, bucket_rows,
+                               resolve_min_bucket,
                                concat_device_tables)
 from ..conf import register_conf
 from ..plan.physical import HashPartitioning, PhysicalPlan
@@ -85,7 +86,7 @@ class TpuShuffleExchangeExec(TpuExec):
     EXTRA_METRICS = (M.SHUFFLE_BYTES, M.PIPELINE_WAIT)
 
     def __init__(self, child: PhysicalPlan, partitioning: HashPartitioning,
-                 mesh, min_bucket: int = 1024, axis: str = "dp",
+                 mesh, min_bucket: Optional[int] = None, axis: str = "dp",
                  chunk_rows: int = 1 << 19):
         super().__init__()
         self.child = child
@@ -93,7 +94,7 @@ class TpuShuffleExchangeExec(TpuExec):
         self.partitioning = partitioning
         self.mesh = mesh
         self.axis = axis
-        self.min_bucket = min_bucket
+        self.min_bucket = resolve_min_bucket(min_bucket)
         self.chunk_rows = max(int(chunk_rows), 1)
         self.schema = child.schema
         # spill handles per partition, one per exchanged chunk
@@ -264,12 +265,12 @@ class TpuLocalExchangeExec(TpuExec):
     EXTRA_METRICS = (M.SHUFFLE_BYTES,)
 
     def __init__(self, child: PhysicalPlan, partitioning,
-                 min_bucket: int = 1024):
+                 min_bucket: Optional[int] = None):
         super().__init__()
         self.child = child
         self.children = (child,)
         self.partitioning = partitioning
-        self.min_bucket = min_bucket
+        self.min_bucket = resolve_min_bucket(min_bucket)
         self.schema = child.schema
         self._handles: Optional[List] = None
         self._mat_lock = __import__("threading").Lock()

@@ -16,7 +16,8 @@ from typing import Iterator, List
 import jax.numpy as jnp
 
 from ..columnar import dtypes as dt
-from ..columnar.device import DeviceColumn, DeviceTable, bucket_rows
+from ..columnar.device import (DeviceColumn, DeviceTable, bucket_rows,
+                               resolve_min_bucket)
 from ..expr.base import EvalContext
 from ..expr.collections import PosExplode
 from ..plan.physical import PhysicalPlan
@@ -29,14 +30,14 @@ __all__ = ["TpuGenerateExec"]
 
 class TpuGenerateExec(TpuExec):
     def __init__(self, child: PhysicalPlan, generator, outer: bool,
-                 gen_fields, min_bucket: int):
+                 gen_fields, min_bucket: Optional[int] = None):
         super().__init__()
         self.child = child
         self.children = (child,)
         self.generator = generator
         self.outer = outer
         self.gen_fields = gen_fields
-        self.min_bucket = min_bucket
+        self.min_bucket = resolve_min_bucket(min_bucket)
         self.schema = Schema(
             list(child.schema.fields)
             + [Field(n, d, nb or outer) for n, d, nb in gen_fields])

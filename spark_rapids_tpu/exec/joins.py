@@ -34,6 +34,7 @@ import numpy as np
 
 from ..columnar import dtypes as dt
 from ..columnar.device import (DeviceColumn, DeviceTable, bucket_rows,
+                               resolve_min_bucket,
                                concat_device_tables, shrink_to_fit,
                                slice_rows)
 from ..expr.base import EvalContext, Expression
@@ -657,7 +658,7 @@ class TpuShuffledHashJoinExec(TpuExec):
     def __init__(self, left: PhysicalPlan, right: PhysicalPlan,
                  left_keys: Sequence[str], right_keys: Sequence[str],
                  how: str, condition: Optional[Expression], merge_keys: bool,
-                 min_bucket: int = 1024,
+                 min_bucket: Optional[int] = None,
                  batch_bytes: int = 512 * 1024 * 1024):
         super().__init__()
         assert how in self.SUPPORTED, how
@@ -668,7 +669,7 @@ class TpuShuffledHashJoinExec(TpuExec):
         self.how = how
         self.condition = condition
         self.merge_keys = merge_keys
-        self.min_bucket = min_bucket
+        self.min_bucket = resolve_min_bucket(min_bucket)
         self.batch_bytes = batch_bytes
         on = self.left_keys if merge_keys else None
         self.schema = _join_schema(left.schema, right.schema, on, how)
@@ -1282,7 +1283,7 @@ class TpuBroadcastNestedLoopJoinExec(TpuExec):
     EXTRA_METRICS = (M.JOIN_TIME,)
 
     def __init__(self, left: PhysicalPlan, right: PhysicalPlan, how: str,
-                 condition: Optional[Expression], min_bucket: int = 1024,
+                 condition: Optional[Expression], min_bucket: Optional[int] = None,
                  batch_bytes: int = 512 * 1024 * 1024):
         super().__init__()
         assert how in self.SUPPORTED, how
@@ -1290,7 +1291,7 @@ class TpuBroadcastNestedLoopJoinExec(TpuExec):
         self.children = (left, right)
         self.how = how
         self.condition = condition
-        self.min_bucket = min_bucket
+        self.min_bucket = resolve_min_bucket(min_bucket)
         self.batch_bytes = batch_bytes
         self.schema = _join_schema(left.schema, right.schema, None, how)
         self._bc_handle = None

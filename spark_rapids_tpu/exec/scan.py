@@ -12,7 +12,7 @@ from __future__ import annotations
 import io as _io
 from typing import Iterator, List, Optional
 
-from ..columnar.device import DeviceTable
+from ..columnar.device import DeviceTable, resolve_min_bucket
 from ..plan.physical import PhysicalPlan
 from ..utils import metrics as M
 from .base import TpuExec
@@ -22,13 +22,13 @@ __all__ = ["TpuParquetScanExec", "TpuCsvScanExec", "TpuJsonScanExec"]
 
 class TpuParquetScanExec(TpuExec):
     def __init__(self, source, columns: Optional[List[str]],
-                 schema, min_bucket: int):
+                 schema, min_bucket: Optional[int] = None):
         super().__init__()
         self.source = source
         self.columns = list(columns) if columns else None
         self.children = ()
         self.schema = schema
-        self.min_bucket = min_bucket
+        self.min_bucket = resolve_min_bucket(min_bucket)
 
     @property
     def num_partitions(self) -> int:
@@ -80,13 +80,13 @@ class TpuCsvScanExec(TpuExec):
     and numeric/date parsing run as one jitted byte-matrix program."""
 
     def __init__(self, source, columns: Optional[List[str]],
-                 schema, min_bucket: int):
+                 schema, min_bucket: Optional[int] = None):
         super().__init__()
         self.source = source
         self.columns = list(columns) if columns else None
         self.children = ()
         self.schema = schema        # already column-pruned by the planner
-        self.min_bucket = min_bucket
+        self.min_bucket = resolve_min_bucket(min_bucket)
 
     @property
     def num_partitions(self) -> int:

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 
 from ..columnar import dtypes as dt
 from ..columnar.device import (DeviceColumn, DeviceTable, append_column,
+                               resolve_min_bucket,
                                bucket_rows, concat_device_tables, drop_column,
                                shrink_to_fit, slice_rows)
 from ..expr.base import EvalContext
@@ -105,14 +106,14 @@ class TpuTakeOrderedExec(TpuExec):
     EXTRA_METRICS = (M.SORT_TIME,)
 
     def __init__(self, child, orders: Sequence[SortOrder], n: int,
-                 min_bucket: int = 1024):
+                 min_bucket: Optional[int] = None):
         super().__init__()
         self.child = child
         self.children = (child,)
         self.orders = list(orders)
         self.n = n
         self.schema = child.schema
-        self.min_bucket = min_bucket
+        self.min_bucket = resolve_min_bucket(min_bucket)
 
     def plan_signature(self) -> str:
         return (f"TakeOrdered|{self.n}|"
@@ -164,14 +165,14 @@ class TpuSortExec(TpuExec):
     EXTRA_METRICS = (M.SORT_TIME,)
 
     def __init__(self, child: PhysicalPlan, orders: Sequence[SortOrder],
-                 min_bucket: int = 1024,
+                 min_bucket: Optional[int] = None,
                  batch_bytes: int = 512 * 1024 * 1024):
         super().__init__()
         self.child = child
         self.children = (child,)
         self.orders = list(orders)
         self.schema = child.schema
-        self.min_bucket = min_bucket
+        self.min_bucket = resolve_min_bucket(min_bucket)
         self.batch_bytes = batch_bytes
 
     def _sort_fn(self, cap_key: str):

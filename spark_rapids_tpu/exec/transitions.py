@@ -14,7 +14,8 @@ import threading
 import weakref
 from typing import Iterator, List
 
-from ..columnar.device import DeviceTable, bucket_rows, concat_device_tables
+from ..columnar.device import (DeviceTable, bucket_rows,
+                               concat_device_tables, resolve_min_bucket)
 from ..columnar.host import HostTable
 from ..conf import register_conf
 from ..plan.physical import PhysicalPlan
@@ -155,13 +156,13 @@ class HostToDeviceExec(TpuExec):
     EXTRA_METRICS = (M.UPLOAD_TIME, M.UPLOAD_BYTES, M.UPLOAD_CACHE_HITS,
                      M.PIPELINE_WAIT)
 
-    def __init__(self, child: PhysicalPlan, min_bucket: int = 1024,
+    def __init__(self, child: PhysicalPlan, min_bucket: Optional[int] = None,
                  cache_max_bytes: int = 0):
         super().__init__()
         self.child = child
         self.children = (child,)
         self.schema = child.schema
-        self.min_bucket = min_bucket
+        self.min_bucket = resolve_min_bucket(min_bucket)
         self.cache_max_bytes = cache_max_bytes
 
     def _upload(self, batch: HostTable) -> DeviceTable:
@@ -282,7 +283,7 @@ class TpuCoalesceBatchesExec(TpuExec):
     EXTRA_METRICS = (M.COALESCED_BYTES,)
 
     def __init__(self, child: PhysicalPlan, target_rows: int = 1 << 20,
-                 require_single: bool = False, min_bucket: int = 1024,
+                 require_single: bool = False, min_bucket: Optional[int] = None,
                  target_bytes: int = 0):
         super().__init__()
         self.child = child
@@ -291,7 +292,7 @@ class TpuCoalesceBatchesExec(TpuExec):
         self.target_rows = target_rows
         self.target_bytes = int(target_bytes)
         self.require_single = require_single
-        self.min_bucket = min_bucket
+        self.min_bucket = resolve_min_bucket(min_bucket)
 
     def node_desc(self) -> str:
         if self.require_single:

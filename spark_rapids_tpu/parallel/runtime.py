@@ -266,6 +266,7 @@ def shuffle_write_task(ctx: ExecutorContext, shuffle_id: int, map_id: int,
                        num_parts: int) -> List[int]:
     from ..columnar.device import DeviceTable
     from ..shuffle.serializer import deserialize_table
+    # srtpu: bucket-ok(cross-process wire protocol: payloads re-bucket at the tiny fixed floor so worker shard shapes never depend on the driver's session ladder)
     table = DeviceTable.from_host(deserialize_table(payload), min_bucket=8)
     return ctx.shuffle.write_partition(shuffle_id, map_id, iter([table]),
                                        key_names, num_parts)
@@ -287,6 +288,7 @@ def dcn_publish_task(ctx: ExecutorContext, shuffle_id: int, map_id: int,
     from ..columnar.device import DeviceTable
     from ..shuffle.serializer import deserialize_table
     from ..shuffle.transport import BlockId
+    # srtpu: bucket-ok(cross-process wire protocol: fixed floor keeps published block shapes driver-independent)
     table = DeviceTable.from_host(deserialize_table(payload), min_bucket=8)
     ctx.dcn_transport().publish_table(
         BlockId(shuffle_id, map_id, reduce_id), table)
@@ -308,6 +310,7 @@ def dcn_fetch_task(ctx: ExecutorContext, shuffle_id: int, map_id: int,
 def shuffle_read_task(ctx: ExecutorContext, shuffle_id: int, num_maps: int,
                       reduce_id: int) -> Optional[bytes]:
     from ..shuffle.serializer import serialize_table
+    # srtpu: bucket-ok(cross-process wire protocol: result is serialized back to exact rows, bucket only pads transient upload)
     out = list(ctx.shuffle.read_partition(shuffle_id, num_maps, reduce_id,
                                           min_bucket=8))
     if not out:
@@ -327,6 +330,7 @@ def shuffle_read_recompute_task(ctx: ExecutorContext, shuffle_id: int,
                            key_names, num_parts)
 
     from ..shuffle.serializer import serialize_table
+    # srtpu: bucket-ok(cross-process wire protocol: result is serialized back to exact rows, bucket only pads transient upload)
     out = list(ctx.shuffle.read_partition(shuffle_id, num_maps, reduce_id,
                                           min_bucket=8, recompute=recompute))
     if not out:
@@ -342,6 +346,7 @@ def broadcast_build_task(ctx: ExecutorContext, bcast_id: int,
     from ..shuffle.serializer import deserialize_table
 
     def build():
+        # srtpu: bucket-ok(cross-process wire protocol: broadcast build shape must match across workers regardless of session ladder)
         return DeviceTable.from_host(deserialize_table(payload),
                                      min_bucket=8)
     ctx.broadcast.build_and_publish(bcast_id, build)

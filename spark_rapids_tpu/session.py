@@ -49,6 +49,15 @@ class TpuSession:
         # table (XLA cost/memory introspection depth)
         from .utils.compile_cache import configure_introspection
         configure_introspection(self.conf)
+        # canonical shape-bucket ladder (spark.rapids.tpu.shapeBuckets.*):
+        # one process-wide policy instead of per-node bucket defaults, so
+        # repeated queries land on repeatable XLA shapes
+        from .columnar.device import configure_buckets
+        configure_buckets(self.conf)
+        # persistent compilation tier (spark.rapids.tpu.compile.*): XLA
+        # disk cache + plan-signature manifest + warm-pool precompiler
+        from .utils.compile_cache import configure_compile_cache
+        configure_compile_cache(self.conf)
         # apply spark.rapids.tpu.pipeline.* to the pipelined executor
         # (prefetch depth / task pool; parallel/pipeline.py)
         from .parallel.pipeline import configure_pipeline
@@ -211,6 +220,13 @@ class TpuSession:
         if health is not None:
             health.close()
             self._health = None
+        # stop the warm-pool precompiler, then flush the persistent
+        # compile tier (manifest + program exports) while builders for
+        # this session's programs are still retained
+        from .utils.compile_cache import (persist_compile_cache,
+                                          stop_warm_pool)
+        stop_warm_pool()
+        persist_compile_cache()
         # cancel + join any straggling pipeline prefetch workers (queries
         # that drained fully already left none; this is the abandoned-
         # iterator backstop, and the no-leaked-threads test contract)

@@ -20,7 +20,7 @@ from __future__ import annotations
 import threading
 from typing import Callable, Dict, Optional
 
-from ..columnar.device import DeviceTable
+from ..columnar.device import DeviceTable, resolve_min_bucket
 from .serializer import deserialize_table, serialize_table
 from .transport import BlockId, ShuffleFetchFailedException, ShuffleTransport
 
@@ -34,10 +34,10 @@ class BroadcastManager:
     """Per-executor broadcast cache backed by the shuffle transport."""
 
     def __init__(self, transport: ShuffleTransport, catalog=None,
-                 min_bucket: int = 1024):
+                 min_bucket: Optional[int] = None):
         self.transport = transport
         self.catalog = catalog
-        self.min_bucket = min_bucket
+        self.min_bucket = resolve_min_bucket(min_bucket)
         self._handles: Dict[int, object] = {}   # bcast_id -> spill handle
         self._lock = threading.Lock()
         self.builds = 0          # local build-side executions (test hook)

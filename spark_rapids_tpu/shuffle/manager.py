@@ -318,7 +318,7 @@ class ShuffleManager:
         def gather_window(tbl: DeviceTable, lo: int, hi: int) -> DeviceTable:
             # explicit gather (NOT slice_rows: its start clamp would shift
             # windows whose bucketed length overruns the capacity)
-            length = bucket_rows(max(hi - lo, 1), 256)
+            length = bucket_rows(max(hi - lo, 1), 256)  # srtpu: bucket-ok(cached-block slice quantum: 256 keys the window kernels independently of the session ladder, so reader and writer agree on stored shard shapes)
             idx = jnp.clip(lo + jnp.arange(length, dtype=jnp.int32),
                            0, tbl.capacity - 1)
             mask = jnp.arange(length, dtype=jnp.int32) < (hi - lo)
@@ -347,7 +347,7 @@ class ShuffleManager:
         sizes = [0] * num_parts
         for p in range(num_parts):
             if per_part[p]:
-                table = concat_device_tables(per_part[p], 256)
+                table = concat_device_tables(per_part[p], 256)  # srtpu: bucket-ok(stored cached-tier blocks share the 256-row write quantum above; readers re-bucket to their own ladder)
             elif schema_tbl is not None:
                 table = gather_window(schema_tbl, 0, 0)
             else:  # map task saw no batches at all
@@ -361,7 +361,7 @@ class ShuffleManager:
 
     # -- read side ------------------------------------------------------------
     def read_partition(self, shuffle_id: int, num_maps: int, reduce_id: int,
-                       min_bucket: int = 1024,
+                       min_bucket: Optional[int] = None,
                        recompute=None) -> Iterator[DeviceTable]:
         """Fetch + coalesce + upload one reduce partition.
 

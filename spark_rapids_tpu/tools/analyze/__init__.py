@@ -21,6 +21,9 @@ Checkers (see the sibling modules):
 - ``jit``    — side effects inside functions traced by ``cached_jit`` /
                ``jax.jit`` / ``shard_map``; use-after-donation of
                ``donate_argnums`` arguments.
+- ``bucket`` — hardcoded shape-bucket floors (``min_bucket`` literals /
+               ad-hoc numeric defaults) bypassing the central
+               ``shapeBuckets`` policy in columnar/device.py.
 
 Workflow: findings are compared against a COMMITTED baseline
 (``tools/analyze/baseline.json``) so pre-existing debt is inventoried
@@ -295,12 +298,12 @@ def load_project(paths: Sequence[str]) -> Project:
 
 
 def _checkers() -> Dict[str, object]:
-    from . import host_sync, jit_purity, locks, threads
+    from . import buckets, host_sync, jit_purity, locks, threads
     return {"sync": host_sync, "lock": locks,
-            "thread": threads, "jit": jit_purity}
+            "thread": threads, "jit": jit_purity, "bucket": buckets}
 
 
-CHECKS = ("sync", "lock", "thread", "jit")
+CHECKS = ("sync", "lock", "thread", "jit", "bucket")
 
 
 def analyze_paths(paths: Sequence[str],

@@ -1,0 +1,112 @@
+"""bucket checker: shape-bucket choices bypassing the central policy.
+
+The canonical bucket ladder (``columnar/device.py`` ``BucketPolicy``,
+``spark.rapids.tpu.shapeBuckets.*``) exists so every device batch lands on
+a small, REPEATABLE set of row capacities — the precondition for both
+bounded XLA compile counts and the persistent compile tier (a persisted
+executable only re-hits when a rerun reproduces the same shapes). A
+hardcoded bucket literal forks the ladder: that call site compiles its own
+shape family that no conf can steer and no other site shares.
+
+- ``bucket-literal``       — a numeric literal passed as the bucket floor:
+  ``min_bucket=<int>`` at any call site, or the ``min_bucket`` positional
+  of ``bucket_rows`` / ``shrink_to_fit`` / ``concat_device_tables`` /
+  ``DeviceTable.from_host``. Thread ``conf.min_bucket_rows`` (planner
+  nodes) or pass ``None`` to inherit the policy.
+- ``bucket-adhoc-default`` — a function parameter named ``min_bucket``
+  with a numeric literal default (the pre-policy ``= 1024`` pattern);
+  default ``None`` and resolve through ``resolve_min_bucket``.
+
+Hot + warm packages only (tools/doc generators may hardcode freely);
+deliberate protocol constants carry ``# srtpu: bucket-ok(reason)``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from . import Finding, Project, ScopedVisitor
+
+__all__ = ["check"]
+
+#: callables whose second positional argument is the bucket floor
+_BUCKET_CALLS = ("bucket_rows", "shrink_to_fit", "concat_device_tables",
+                 "from_host")
+
+
+def _is_num(node) -> bool:
+    return isinstance(node, ast.Constant) \
+        and isinstance(node.value, (int, float)) \
+        and not isinstance(node.value, bool)
+
+
+class _BucketVisitor(ScopedVisitor):
+    def __init__(self, ctx):
+        super().__init__()
+        self.ctx = ctx
+        self.findings: List[Finding] = []
+
+    def _hit(self, node, rule: str, msg: str) -> None:
+        self.findings.append(self.ctx.finding(
+            "bucket", rule, node, self.symbol, msg))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        kw = next((k for k in node.keywords if k.arg == "min_bucket"), None)
+        if kw is not None and _is_num(kw.value):
+            self._hit(node, "bucket-literal",
+                      f"min_bucket={kw.value.value!r} hardcodes a bucket "
+                      f"floor outside the central shape-bucket policy — "
+                      f"thread conf.min_bucket_rows or pass None")
+        else:
+            q = self.ctx.qualify(node.func)
+            name = q.rsplit(".", 1)[-1]
+            if name in _BUCKET_CALLS and len(node.args) >= 2 \
+                    and _is_num(node.args[1]):
+                self._hit(node, "bucket-literal",
+                          f"{name}(..., {node.args[1].value!r}) hardcodes "
+                          f"a bucket floor outside the central shape-"
+                          f"bucket policy — thread conf.min_bucket_rows "
+                          f"or pass None")
+        self.generic_visit(node)
+
+    def _check_defaults(self, node) -> None:
+        args = node.args
+        pos = args.posonlyargs + args.args
+        defaults = args.defaults
+        for a, d in zip(pos[len(pos) - len(defaults):], defaults):
+            if a.arg == "min_bucket" and _is_num(d):
+                self._hit(d, "bucket-adhoc-default",
+                          f"parameter min_bucket defaults to {d.value!r} — "
+                          f"ad-hoc per-node bucket defaults scatter the "
+                          f"ladder; default None and resolve through "
+                          f"resolve_min_bucket()")
+        for a, d in zip(args.kwonlyargs, args.kw_defaults):
+            if d is not None and a.arg == "min_bucket" and _is_num(d):
+                self._hit(d, "bucket-adhoc-default",
+                          f"parameter min_bucket defaults to {d.value!r} — "
+                          f"default None and resolve through "
+                          f"resolve_min_bucket()")
+
+    def _visit_def(self, node) -> None:
+        # enter the function scope BEFORE checking its defaults so the
+        # finding keys on the def itself (line drift immunity)
+        self._scope.append(node.name)
+        try:
+            self._check_defaults(node)
+            self.generic_visit(node)
+        finally:
+            self._scope.pop()
+
+    visit_FunctionDef = _visit_def
+    visit_AsyncFunctionDef = _visit_def
+
+
+def check(project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    for ctx in project.modules:
+        if ctx.severity == "cold":
+            continue
+        v = _BucketVisitor(ctx)
+        v.visit(ctx.tree)
+        out.extend(v.findings)
+    return out

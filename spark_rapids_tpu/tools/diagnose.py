@@ -23,6 +23,7 @@ import dataclasses
 import glob
 import json
 import os
+import re
 from typing import Dict, List, Optional
 
 from ..utils import metrics as M
@@ -170,6 +171,51 @@ def _node_suggestion(name: str, metrics: Dict) -> str:
             "for its per-batch breakdown")
 
 
+def _shape_blind(signature: str) -> str:
+    """A plan signature with every numeral collapsed — signatures sharing
+    a blind form differ only in shape (capacities, widths, chunk sizes)."""
+    return re.sub(r"\d+", "#", signature or "")
+
+
+def _bucket_churn_findings(per_node: Dict[str, List[Dict]],
+                           wall: float) -> List[Finding]:
+    """Per operator: groups of kernel-table signatures that differ only
+    in shape. One group with >1 member means the same traced computation
+    compiled more than once because batches arrived at different
+    capacities — a shape-bucket-policy miss (the canonical ladder exists
+    so one compiled kernel serves every partition)."""
+    out: List[Finding] = []
+    for name, entries in per_node.items():
+        groups: Dict[str, List[Dict]] = {}
+        for e in entries:
+            groups.setdefault(_shape_blind(e.get("signature", "")),
+                              []).append(e)
+        churned = {b: es for b, es in groups.items() if len(es) > 1}
+        if not churned:
+            continue
+        sigs = sum(len(es) for es in churned.values())
+        compile_s = sum(e.get("compile_s", 0.0)
+                        for es in churned.values() for e in es)
+        shapes = max(len(es) for es in churned.values())
+        out.append(Finding(
+            node=name, node_id=next(iter(churned.values()))[0].get("node_id"),
+            metric="bucketChurn", seconds=compile_s,
+            fraction=max(_FRACTION_FLOOR,
+                         compile_s / wall if wall else 0.0),
+            detail=f"bucket churn: {sigs} signatures in "
+                   f"{len(churned)} numeral-blind group(s) (worst group "
+                   f"spans {shapes} variants, {compile_s:.2f}s compiling) "
+                   f"— signatures differing only in numeric literals, "
+                   f"typically capacities the bucket ladder should have "
+                   f"collapsed (plan parameters like LIMIT n also match)",
+            suggestion="if the variants are shapes, raise spark.rapids."
+                       "tpu.shapeBuckets.minRows (or batchRowsMinBucket) "
+                       "or raise shapeBuckets.maxWasteFrac back toward "
+                       "0.5 — extra ladder rungs trade padding for "
+                       "exactly this recompile churn"))
+    return out
+
+
 #: heartbeat device_used / device_limit fraction above which a query is
 #: "in OOM territory" — spills/OOM are one bad batch away
 _HBM_PRESSURE_FLOOR = 0.9
@@ -310,6 +356,12 @@ def _diagnose_query(q, heartbeats=None) -> Optional[QueryDiagnosis]:
                 suggestion="shape-bucket churn — raise spark.rapids.tpu."
                            "batchRowsMinBucket so batch capacities collapse "
                            "onto fewer buckets"))
+
+    # 3b. bucket churn: kernel-table signatures for one operator that are
+    # IDENTICAL once numerals are stripped compiled the same computation
+    # for different shapes — direct evidence the shape-bucket policy
+    # failed to collapse this operator's partitions onto one capacity
+    findings.extend(_bucket_churn_findings(per_node, wall))
 
     # 4. query-level process-counter deltas (v2-compatible: works without
     # node metrics or kernel records)

@@ -21,7 +21,7 @@ from typing import Callable, Iterator, List, Optional, Sequence
 import numpy as np
 
 from ..columnar import dtypes as dt
-from ..columnar.device import DeviceTable
+from ..columnar.device import DeviceTable, resolve_min_bucket
 from ..columnar.host import HostColumn, HostTable
 from ..exec.base import TpuExec
 from ..expr.base import EvalCol, EvalContext, Expression
@@ -130,13 +130,13 @@ class TpuArrowEvalPythonExec(TpuExec):
     """
 
     def __init__(self, child: PhysicalPlan, exprs: Sequence[Expression],
-                 names: Sequence[str], min_bucket: int = 1024):
+                 names: Sequence[str], min_bucket: Optional[int] = None):
         super().__init__()
         self.child = child
         self.children = (child,)
         self.exprs = list(exprs)
         self.names = list(names)
-        self.min_bucket = min_bucket
+        self.min_bucket = resolve_min_bucket(min_bucket)
         self.schema = Schema([Field(n, e.data_type, e.nullable)
                               for n, e in zip(names, exprs)])
 

@@ -249,6 +249,47 @@ def test_jit_checker_donation_scopes_do_not_leak(tmp_path):
                 if f.rule == "jit-use-after-donate"]
 
 
+def test_bucket_checker_rules(tmp_path):
+    path = _write(tmp_path, "bucket_fixture.py", """\
+        from spark_rapids_tpu.columnar.device import (DeviceTable,
+                                                      bucket_rows,
+                                                      resolve_min_bucket)
+
+        def bad_call(n, host):
+            cap = bucket_rows(n, 256)                     # literal floor
+            t = DeviceTable.from_host(host, min_bucket=8)  # literal kw
+            return cap, t
+
+        class BadNode:
+            def __init__(self, child, min_bucket: int = 1024):  # ad-hoc
+                self.min_bucket = min_bucket
+
+        class GoodNode:
+            def __init__(self, child, min_bucket=None):
+                self.min_bucket = resolve_min_bucket(min_bucket)
+
+        def good_call(n, conf, host):
+            cap = bucket_rows(n)                      # policy default
+            cap2 = bucket_rows(n, conf.min_bucket_rows)  # conf-threaded
+            return cap, cap2, DeviceTable.from_host(host)
+        """)
+    report = analyze_paths([path], checks=["bucket"])
+    rules = [f.rule for f in report.findings]
+    assert rules.count("bucket-literal") == 2
+    assert rules.count("bucket-adhoc-default") == 1
+    syms = {f.symbol for f in report.findings}
+    assert syms == {"bad_call", "BadNode.__init__"}
+
+
+def test_bucket_checker_skips_cold_packages(tmp_path):
+    cold = tmp_path / "spark_rapids_tpu" / "tools"
+    cold.mkdir(parents=True)
+    (cold / "coldmod.py").write_text(
+        "def f(n):\n    return bucket_rows(n, 64)\n")
+    report = analyze_paths([str(tmp_path)], checks=["bucket"])
+    assert report.count("bucket") == 0
+
+
 # ---------------------------------------------------------------------------
 # suppressions
 # ---------------------------------------------------------------------------
@@ -376,6 +417,9 @@ def test_tier1_seeded_violation_fails_each_category(tmp_path,
                "cached_jit\n\ndef f(x, build):\n"
                "    fn = cached_jit('k', build, donate_argnums=(0,))\n"
                "    out = fn(x)\n    return x.sum()\n",
+        "bucket": "from spark_rapids_tpu.columnar.device import "
+                  "bucket_rows\n\ndef f(n):\n"
+                  "    return bucket_rows(n, 512)\n",
     }
     baseline = load_baseline(default_baseline_path())
     for check, body in seeds.items():
@@ -404,6 +448,10 @@ def test_tier1_thread_and_lock_and_jit_clean(package_report):
     assert package_report.count("lock") == 0
     assert package_report.count("jit") == 0
     assert package_report.count("meta") == 0
+    # the shape-bucket policy refactor drove literal floors out of the
+    # engine; the only survivors are reasoned bucket-ok suppressions
+    # (cross-process wire-protocol constants)
+    assert package_report.count("bucket") == 0
 
 
 def test_baseline_summary_matches_committed_file(package_report):

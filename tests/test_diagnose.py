@@ -115,6 +115,39 @@ def test_golden_diagnose_report(tmp_path):
         "ShuffleExchangeExec"
 
 
+def test_bucket_churn_section(tmp_path):
+    """Kernel-table signatures that differ only in shape for one operator
+    are reported as bucket churn (ISSUE 7 satellite); operators whose
+    signatures differ structurally are not."""
+    from spark_rapids_tpu.tools.diagnose import diagnose_path
+    path = _write_log(
+        tmp_path / "churn.jsonl",
+        nodes=[("TpuSortExec", 0, -1, 0.9, {}),
+               ("TpuProjectExec", 1, 0, 0.1, {})],
+        kernels=[
+            # same computation, three capacities -> churn
+            *({"signature": f"Sort|keys=[a]|cap{c}",
+               "node_name": "TpuSortExec", "node_id": 0,
+               "compiles": 1, "compile_s": 0.2}
+              for c in (1024, 2048, 4096)),
+            # structurally different signatures -> NOT churn
+            {"signature": "Project|exprs=[a+b]|cap1024",
+             "node_name": "TpuProjectExec", "node_id": 1,
+             "compiles": 1, "compile_s": 0.1},
+            {"signature": "Project|exprs=[a*b,c]|cap1024",
+             "node_name": "TpuProjectExec", "node_id": 1,
+             "compiles": 1, "compile_s": 0.1},
+        ],
+        wall_s=1.0)
+    (q,) = diagnose_path(path).queries
+    byname = {(f.node, f.metric): f for f in q.findings}
+    churn = byname[("TpuSortExec", "bucketChurn")]
+    assert "3 signatures" in churn.detail
+    assert "shapeBuckets" in churn.suggestion
+    assert churn.seconds == pytest.approx(0.6)
+    assert ("TpuProjectExec", "bucketChurn") not in byname
+
+
 def test_diagnose_errors_and_empty_queries_skipped(tmp_path):
     from spark_rapids_tpu.tools.diagnose import diagnose_path
     path = tmp_path / "err.jsonl"
