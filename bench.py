@@ -45,7 +45,10 @@ diagnose.txt), BENCH_STALL_TIMEOUT_S (watchdog threshold),
 BENCH_WARM=restart (cold-process re-run phase: after smoke populates the
 persistent compile tier, a FRESH worker process replays Q6+Q1 through the
 warm pool and records its second-run compile count — the zero-compiles
-trajectory metric, "restart" + per-phase "compile_cache" in the JSON).
+trajectory metric, "restart" + per-phase "compile_cache" in the JSON),
+BENCH_TRACE (1|0: span tracer per timed phase — each query's res gains a
+"critical_path" category breakdown + "sync_wait_frac", the measured
+ROADMAP-item-1 trajectory number).
 """
 import atexit
 import json
@@ -582,6 +585,43 @@ def _pipeline_conf() -> dict:
             os.environ.get("BENCH_PIPELINE", "on") != "off"}
 
 
+def _trace_conf() -> dict:
+    """Enable the span tracer so every timed query carries a
+    critical-path breakdown (sync_wait_frac is a tracked trajectory
+    number — ROADMAP item 1). BENCH_TRACE=0 disables."""
+    if os.environ.get("BENCH_TRACE", "1") == "0":
+        return {}
+    return {"spark.rapids.tpu.trace.enabled": True}
+
+
+def _bench_critical_path():
+    """Critical-path breakdown of the NEWEST query span in the live
+    tracer ring (the query the caller just timed): category seconds +
+    sync_wait_frac, or None when tracing is off. Never fails the bench."""
+    try:
+        from spark_rapids_tpu.tools.trace import critical_path_from_tracer
+        from spark_rapids_tpu.utils.tracing import get_tracer
+        tracer = get_tracer()
+        if not tracer.enabled:
+            return None
+        tid = None
+        for e in tracer.events():
+            if e.cat == "query" and "trace_id" in e.args:
+                tid = e.args["trace_id"]
+        if tid is None:
+            return None
+        cp = critical_path_from_tracer(tracer, tid)
+        if cp is None:
+            return None
+        d = cp.to_dict()
+        return {"sync_wait_frac": d["sync_wait_frac"],
+                "categories_s": d["categories_s"],
+                "coverage": d["coverage"],
+                "total_s": d["total_s"]}
+    except Exception:
+        return None
+
+
 def _health_conf(phase: str) -> dict:
     """Enable the live health monitor per phase: heartbeats land in the
     phase event log, stall forensics land next to it (appended to
@@ -668,7 +708,8 @@ def _worker_smoke(sink: _EventSink):
                        **_pipeline_conf(),
                        **_compile_cache_conf(),
                        **_eventlog_conf("smoke", sink),
-                       **_health_conf("smoke")})
+                       **_health_conf("smoke"),
+                       **_trace_conf()})
     df = sess.create_dataframe(lineitem, num_partitions=1).cache()
     t = {"lineitem": df}
 
@@ -719,10 +760,14 @@ def _worker_smoke(sink: _EventSink):
                 sink.emit(ev="error", name=name,
                           msg=f"mismatch rel_err={err:.2e}")
                 continue
+            cp = _bench_critical_path()
             sink.emit(ev="done", phase="smoke", name=name, res={
                 "dev_s": round(dev_t, 4), "cpu_s": round(cpu_t, 4),
                 "compile_s": round(warm, 2),
-                "speedup": cpu_t / max(dev_t, 1e-9)})
+                "speedup": cpu_t / max(dev_t, 1e-9),
+                **({"critical_path": cp,
+                    "sync_wait_frac": cp["sync_wait_frac"]}
+                   if cp else {})})
             _log(f"smoke {name}: dev={dev_t:.4f}s cpu={cpu_t:.4f}s "
                  f"compile={warm:.1f}s x{cpu_t/dev_t:.2f} rel_err={err:.1e}")
         except Exception as e:
@@ -776,6 +821,7 @@ def _worker_tpch(sink: _EventSink):
         **_compile_cache_conf(),
         **_eventlog_conf("tpch", sink),
         **_health_conf("tpch"),
+        **_trace_conf(),
     })
     dfs = tpch.build_dataframes(sess, tables, num_partitions=nparts)
 
@@ -803,10 +849,14 @@ def _worker_tpch(sink: _EventSink):
                           msg=f"device != host (rel err {err})")
                 _log(f"{name} MISMATCH rel_err={err}")
             else:
+                cp = _bench_critical_path()
                 sink.emit(ev="done", phase="tpch", name=name, res={
                     "dev_s": round(dev_t, 4), "cpu_s": round(cpu_t, 4),
                     "compile_s": round(warm, 2),
-                    "speedup": cpu_t / max(dev_t, 1e-9)})
+                    "speedup": cpu_t / max(dev_t, 1e-9),
+                    **({"critical_path": cp,
+                        "sync_wait_frac": cp["sync_wait_frac"]}
+                       if cp else {})})
                 _log(f"{name}: dev={dev_t:.3f}s cpu={cpu_t:.3f}s "
                      f"compile={warm:.1f}s x{cpu_t/dev_t:.2f}")
         except Exception as e:
@@ -884,7 +934,8 @@ def _worker_restart(sink: _EventSink):
                        **_pipeline_conf(),
                        **_compile_cache_conf(),
                        **_eventlog_conf("restart", sink),
-                       **_health_conf("restart")})
+                       **_health_conf("restart"),
+                       **_trace_conf()})
     warmed = warm_pool_wait()
     df = sess.create_dataframe(lineitem, num_partitions=1).cache()
     t = {"lineitem": df}
@@ -899,11 +950,15 @@ def _worker_restart(sink: _EventSink):
             q.collect(device=True)
             run_s = time.perf_counter() - t0
             after = cache_stats()
+            cp = _bench_critical_path()
             res = {"run_s": round(run_s, 4),
                    "compiles": after["compiles"] - before["compiles"],
                    "persist_hits": after["persist_hits"]
                    - before["persist_hits"],
-                   "warm_pool_settled": warmed}
+                   "warm_pool_settled": warmed,
+                   **({"critical_path": cp,
+                       "sync_wait_frac": cp["sync_wait_frac"]}
+                      if cp else {})}
             sink.emit(ev="done", phase="restart", name=name, res=res)
             _log(f"restart {name}: run={run_s:.4f}s "
                  f"second_run_compiles={res['compiles']} "

@@ -148,13 +148,20 @@ class LocalCluster:
 def _worker_main(worker_id: int, conf_values: dict, addr_q, task_q, result_q):
     # never let a worker grab the TPU tunnel (it admits one process);
     # jax.config is the only channel the axon plugin respects
+    import time
+
     import jax
     jax.config.update("jax_platforms", "cpu")
     from ..conf import RapidsConf
     from ..shuffle.tcp import TcpShuffleTransport
+    from ..utils.tracing import (TRACE_DISTRIBUTED_DIR, TraceContext,
+                                 activate_trace_context, configure_tracer,
+                                 get_tracer)
     from .executor import ExecutorContext
 
     conf = RapidsConf(conf_values)
+    tracer = configure_tracer(conf)
+    tracer.process_name = f"worker-{worker_id}"
     transport = TcpShuffleTransport(conf)
     addr_q.put((worker_id, transport.address))
     ctx = None
@@ -163,7 +170,7 @@ def _worker_main(worker_id: int, conf_values: dict, addr_q, task_q, result_q):
             task = task_q.get()
             if task is None:
                 return
-            tid, kind, payload = task
+            tid, kind, payload, ctx_wire = task
             if kind == "peers":
                 for host, port in payload:
                     transport.add_peer(host, port)
@@ -171,29 +178,56 @@ def _worker_main(worker_id: int, conf_values: dict, addr_q, task_q, result_q):
                                       transport=transport).initialize()
                 result_q.put((tid, "ok", None))
                 continue
+            if kind == "clock":
+                # clock handshake: the driver brackets this round trip and
+                # estimates our wall-clock offset NTP-style from the reply
+                result_q.put((tid, "ok",
+                              (time.time(), tracer.epoch_unix)))
+                continue
             fn, args = payload
             try:
-                result_q.put((tid, "ok", fn(ctx, *args)))
+                tctx = TraceContext.from_wire(ctx_wire)
+                with activate_trace_context(tctx), \
+                        get_tracer().span("task", "task", worker=worker_id,
+                                          fn=getattr(fn, "__name__", "?")):
+                    out = fn(ctx, *args)
+                result_q.put((tid, "ok", out))
             except Exception as e:  # surface to the driver, keep serving
                 result_q.put((tid, "err", f"{type(e).__name__}: {e}"))
     finally:
         if ctx is not None:
             ctx.shutdown()
         transport.close()
+        dump_dir = str(conf.get(TRACE_DISTRIBUTED_DIR))
+        if dump_dir and tracer.enabled:
+            import os
+            tracer.dump(os.path.join(
+                dump_dir, f"trace-{tracer.process_name}.json"))
 
 
 class ProcessCluster:
     """N executor processes, each owning a TcpShuffleTransport server, all
     peered with each other. Task functions must be module-level (pickled by
-    reference) and take the worker's ExecutorContext as first argument."""
+    reference) and take the worker's ExecutorContext as first argument.
+
+    Every task envelope carries the submitting thread's TraceContext
+    (``spark.rapids.tpu.trace.distributed.enabled``), so worker-side spans
+    parent under the driver's query span; a per-worker clock handshake at
+    startup estimates each worker's wall-clock offset for the merged
+    timeline (tools/trace.py)."""
 
     def __init__(self, n_executors: int, conf: Optional[dict] = None,
                  start_timeout_s: float = 120.0):
         import multiprocessing as mp
+
+        from ..utils.tracing import TRACE_CLOCK_PROBES, TRACE_DISTRIBUTED
         self._mp = mp.get_context("spawn")
         self._addr_q = self._mp.Queue()
         self._result_q = self._mp.Queue()
         self._task_qs = [self._mp.Queue() for _ in range(n_executors)]
+        rconf = RapidsConf(conf or {})
+        self._propagate = bool(rconf.get(TRACE_DISTRIBUTED))
+        self._clock_probes = int(rconf.get(TRACE_CLOCK_PROBES))
         self.procs = [
             self._mp.Process(
                 target=_worker_main,
@@ -214,10 +248,38 @@ class ProcessCluster:
         for i in range(n_executors):
             peers = [a for j, a in enumerate(self.addresses) if j != i]
             self._wait(self._submit(i, "peers", peers))
+        #: worker id -> estimated (worker_wall - driver_wall) seconds
+        self.clock_offsets: Dict[int, float] = {
+            i: self._estimate_clock_offset(i) for i in range(n_executors)}
+        #: worker id -> the worker tracer's epoch_unix (merge anchor)
+        self.worker_epochs: Dict[int, float] = dict(self._epochs)
+
+    def _estimate_clock_offset(self, worker: int) -> float:
+        """NTP-style offset estimate: bracket N clock round trips and keep
+        the probe with the smallest RTT — queue latency inflates RTT
+        symmetrically, so the tightest bracket bounds the offset best."""
+        import time
+        best_rtt, offset, epoch = float("inf"), 0.0, 0.0
+        for _ in range(max(1, self._clock_probes)):
+            t0 = time.time()
+            t1, worker_epoch = self._wait(self._submit(worker, "clock", None))
+            t2 = time.time()
+            rtt = t2 - t0
+            if rtt < best_rtt:
+                best_rtt = rtt
+                offset = t1 - (t0 + t2) / 2.0
+                epoch = worker_epoch
+        if not hasattr(self, "_epochs"):
+            self._epochs: Dict[int, float] = {}
+        self._epochs[worker] = epoch
+        return offset
 
     def _submit(self, worker: int, kind: str, payload) -> int:
+        from ..utils.tracing import current_trace_context
         tid = next(self._tids)
-        self._task_qs[worker].put((tid, kind, payload))
+        ctx = current_trace_context() if self._propagate else None
+        self._task_qs[worker].put(
+            (tid, kind, payload, None if ctx is None else ctx.to_wire()))
         return tid
 
     def submit(self, worker: int, fn, *args) -> int:
@@ -236,6 +298,45 @@ class ProcessCluster:
     def run_on(self, worker: int, fn, *args, timeout_s: float = 300.0):
         return self._wait(self.submit(worker, fn, *args), timeout_s)
 
+    # -- distributed trace collection -----------------------------------------
+    def collect_traces(self, drain: bool = False) -> List[dict]:
+        """One Chrome-trace dict per process (driver first, then every
+        live worker), each annotated with its clock-offset estimate —
+        the input set for tools/trace.py merge_process_traces. With
+        ``drain`` the worker rings are flushed (snapshot-and-reset), so
+        per-query collection attributes ring drops to the right query."""
+        from ..utils.tracing import get_tracer
+        tracer = get_tracer()
+        driver = tracer.drain() if drain else tracer.to_chrome_trace()
+        driver["otherData"]["process_name"] = tracer.process_name
+        driver["otherData"]["clock_offset_s"] = 0.0
+        driver["otherData"]["role"] = "driver"
+        traces = [driver]
+        for w, p in enumerate(self.procs):
+            if not p.is_alive():
+                continue
+            t = self.run_on(w, trace_flush_task, drain)
+            t["otherData"]["clock_offset_s"] = self.clock_offsets.get(w, 0.0)
+            t["otherData"]["role"] = f"worker-{w}"
+            traces.append(t)
+        return traces
+
+    def dump_traces(self, directory: str, drain: bool = False) -> List[str]:
+        """Write one trace-<process_name>.json per process into
+        ``directory`` (the file set ``python -m spark_rapids_tpu.tools.trace
+        merge <directory>`` consumes); returns the paths."""
+        import json
+        import os
+        os.makedirs(directory, exist_ok=True)
+        paths = []
+        for t in self.collect_traces(drain=drain):
+            name = t["otherData"].get("process_name", "unknown")
+            path = os.path.join(directory, f"trace-{name}.json")
+            with open(path, "w", encoding="utf-8") as f:
+                json.dump(t, f)
+            paths.append(path)
+        return paths
+
     def kill(self, worker: int):
         """Hard-kill one executor process (failure injection)."""
         self.procs[worker].terminate()
@@ -245,7 +346,7 @@ class ProcessCluster:
         for i, p in enumerate(self.procs):
             if p.is_alive():
                 try:
-                    self._task_qs[i].put(None)
+                    self._task_qs[i].put(None)  # srtpu: trace-ok(shutdown sentinel, not a task envelope — no context to inject)
                 except Exception:
                     pass
         for p in self.procs:
@@ -261,6 +362,35 @@ class ProcessCluster:
 
 
 # -- reusable cross-process task functions (module-level => picklable) -------
+def trace_flush_task(ctx: ExecutorContext, drain: bool = False) -> dict:
+    """Export this worker's tracer ring as a Chrome-trace dict (with the
+    process identity + wall-clock anchor in otherData). ``drain`` resets
+    the ring so the NEXT flush starts clean — per-process drop counts then
+    attribute to the window that overflowed."""
+    from ..utils.tracing import get_tracer
+    tracer = get_tracer()
+    return tracer.drain() if drain else tracer.to_chrome_trace()
+
+
+def metrics_text_task(ctx: ExecutorContext) -> str:
+    """This worker's StatsRegistry as Prometheus text — the scrape body
+    the driver's MetricsFederation (tools/statusd.py) pulls through the
+    task queue (workers run no HTTP server; the queue IS the scrape
+    transport)."""
+    from ..utils.metrics import get_stats
+    return get_stats().prometheus_text()
+
+
+def trace_probe_task(ctx: ExecutorContext, depth: int = 0) -> Optional[dict]:
+    """Record one probe span and report the TraceContext active inside it
+    — the round-trip test for envelope propagation (None when no context
+    arrived)."""
+    from ..utils.tracing import current_trace_context, get_tracer
+    with get_tracer().span("trace_probe", "task", depth=depth):
+        ctx_now = current_trace_context()
+        return None if ctx_now is None else ctx_now.to_wire()
+
+
 def shuffle_write_task(ctx: ExecutorContext, shuffle_id: int, map_id: int,
                        payload: bytes, key_names: List[str],
                        num_parts: int) -> List[int]:

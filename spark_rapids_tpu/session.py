@@ -236,7 +236,17 @@ class TpuSession:
         if log is not None:
             log.close()
             self._eventlog = None
-        from .utils.tracing import TRACE_DIR, get_tracer
+        from .utils.tracing import (TRACE_DIR, TRACE_DISTRIBUTED_DIR,
+                                    get_tracer)
+        dist_dir = self.conf.get(TRACE_DISTRIBUTED_DIR)
+        if dist_dir and get_tracer().enabled:
+            # one trace-<process_name>.json per process (workers dump
+            # theirs in _worker_main) — the input set for
+            # `python -m spark_rapids_tpu.tools.trace merge`
+            import os
+            tracer = get_tracer()
+            tracer.dump(os.path.join(
+                dist_dir, f"trace-{tracer.process_name}.json"))
         trace_dir = self.conf.get(TRACE_DIR)
         if trace_dir:
             import os

@@ -50,6 +50,7 @@ from typing import Callable, Dict, Iterator, List, Optional, Tuple
 import jax
 
 from ..columnar.device import DeviceTable
+from ..utils.tracing import get_tracer
 from .transport import BlockId, ShuffleFetchFailedException
 
 __all__ = ["MockDcnFabric", "DcnShuffleTransport",
@@ -76,7 +77,9 @@ class MockDcnFabric:
                  table: DeviceTable, device) -> DeviceTable:
         if self.fault is not None:
             self.fault(src, dst, block)
-        moved = jax.device_put(table, device)
+        with get_tracer().span("dcn_transfer", "shuffle", src=src, dst=dst,
+                               shuffle=block[0], map=block[1]):
+            moved = jax.device_put(table, device)
         nbytes = table.nbytes()
         with self._lock:
             self.link_bytes[(src, dst)] = \
@@ -216,7 +219,12 @@ class TcpDcnShuffleTransport:
         if table is None:
             raise ShuffleFetchFailedException(
                 block, "published table vanished before serialization")
-        payload = serialize_table(table.to_host(), codec=self.codec)
+        # runs on the TCP server thread under the REQUESTING query's
+        # TraceContext (the SRTC wire header activated it), so this span
+        # parents under the remote query span in the merged timeline
+        with get_tracer().span("dcn_serialize", "shuffle",
+                               shuffle=block[0], map=block[1]):
+            payload = serialize_table(table.to_host(), codec=self.codec)
         with self._lock:
             self.bytes_wired += len(payload)
         return payload
@@ -240,10 +248,13 @@ class TcpDcnShuffleTransport:
         if not remote:
             return
         for b, payload in self.tcp.fetch(remote):
-            host = deserialize_table(payload)
-            table = _DT.from_host(host)
-            if self.device is not None:
-                table = jax.device_put(table, self.device)
+            with get_tracer().span("dcn_fetch", "shuffle",
+                                   shuffle=b[0], map=b[1],
+                                   bytes=len(payload)):
+                host = deserialize_table(payload)
+                table = _DT.from_host(host)
+                if self.device is not None:
+                    table = jax.device_put(table, self.device)
             yield b, table
 
     def remove_shuffle(self, shuffle_id: int) -> None:

@@ -392,10 +392,16 @@ class ShuffleManager:
                     break
                 except ShuffleFetchFailedException as e:
                     map_id = e.block[1]
+                    get_tracer().instant(
+                        "shuffle_fetch_failed", "shuffle",
+                        shuffle=shuffle_id, map=map_id, reduce=reduce_id,
+                        retry=recompute is not None and map_id not in retried)
                     if recompute is None or map_id in retried:
                         raise
                     retried.add(map_id)
-                    recompute(map_id)
+                    with get_tracer().span("shuffle_recompute", "shuffle",
+                                           shuffle=shuffle_id, map=map_id):
+                        recompute(map_id)
                     pending = pending[pending.index(e.block):]
         _bump(blocks_fetched=len(tables), bytes_fetched=fetched_bytes,
               reads_transport_tier=1)
@@ -430,7 +436,13 @@ class ShuffleManager:
                 key = (shuffle_id, m, reduce_id)
                 handle = self.buffer_catalog.get(key)
                 if handle is None and recompute is not None:
-                    recompute(m)
+                    get_tracer().instant(
+                        "shuffle_fetch_failed", "shuffle",
+                        shuffle=shuffle_id, map=m, reduce=reduce_id,
+                        retry=True)
+                    with get_tracer().span("shuffle_recompute", "shuffle",
+                                           shuffle=shuffle_id, map=m):
+                        recompute(m)
                     handle = self.buffer_catalog.get(key)
                 if handle is None:
                     raise ShuffleFetchFailedException(

@@ -26,11 +26,19 @@ in a dict served in one send —
 
 Wire protocol (little-endian), one request per connection:
 
-    request:  magic 'SRTB' | u8 op | i64 shuffle | i64 map | i64 reduce
+    request:  magic 'SRTB'|'SRTC' | u8 op | i64 shuffle | i64 map |
+              i64 reduce
+              (magic SRTC only) | 16s trace_id | u64 parent_span | i64 qid
               (op GET_RANGE only) | i64 offset | i64 max_len
     response: u8 found | u64 total_len | (GET_RANGE only) u64 chunk_len |
               payload
     ops: 1 = GET (whole block), 2 = REMOVE_SHUFFLE, 3 = GET_RANGE
+
+The 'SRTC' magic is the traced variant: a fixed TraceContext header rides
+between the base request and any op extension, so the serving side's
+spans parent under the requesting query's span in the merged timeline
+(``spark.rapids.tpu.trace.distributed.enabled``). Servers accept both
+magics — an untraced client talks to a traced server and vice versa.
 """
 from __future__ import annotations
 
@@ -44,6 +52,9 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, Iterator, List, Optional, Tuple
 
 from ..conf import RapidsConf, register_conf
+from ..utils.tracing import (TRACE_DISTRIBUTED, TraceContext,
+                             activate_trace_context, current_trace_context,
+                             get_tracer)
 from .transport import (BlockId, ShuffleFetchFailedException,
                         ShuffleTransport)
 
@@ -71,6 +82,7 @@ HOST_STORE_BYTES = register_conf(
     256 << 20, checker=lambda v: None if int(v) > 0 else "must be positive")
 
 _MAGIC = b"SRTB"
+_MAGIC_TRACED = b"SRTC"
 _OP_GET = 1
 _OP_REMOVE = 2
 _OP_GET_RANGE = 3
@@ -300,6 +312,7 @@ class TcpShuffleTransport(ShuffleTransport):
                  host: str = "127.0.0.1", port: int = 0):
         conf = conf or RapidsConf()
         self.chunk_bytes = int(conf.get(TCP_CHUNK_BYTES))
+        self._trace_wire = bool(conf.get(TRACE_DISTRIBUTED))
         self.store = _HostBlockStore(int(conf.get(HOST_STORE_BYTES)))
         self.inflight = _InflightBudget(int(conf.get(MAX_RECEIVE_INFLIGHT)))
         self._lock = threading.Lock()
@@ -336,55 +349,76 @@ class TcpShuffleTransport(ShuffleTransport):
             with conn:
                 raw = _recv_exact(conn, _REQ.size)
                 magic, op, sid, mid, rid = _REQ.unpack(raw)
-                if magic != _MAGIC:
+                if magic == _MAGIC_TRACED:
+                    tctx = TraceContext.unpack(
+                        _recv_exact(conn, TraceContext.WIRE.size))
+                elif magic == _MAGIC:
+                    tctx = None
+                else:
                     return
-                if op == _OP_REMOVE:
-                    self.remove_shuffle(sid)
-                    conn.sendall(_RESP_HEAD.pack(1, 0))
-                    return
-                block = BlockId(sid, mid, rid)
-                if op == _OP_GET_RANGE:
-                    off, max_len = _RANGE_EXT.unpack(
-                        _recv_exact(conn, _RANGE_EXT.size))
-                    total = self.store.length(block)
-                    if total is None:
-                        conn.sendall(_RESP_HEAD.pack(0, 0))
-                        return
-                    n = max(0, min(max_len, self.chunk_bytes, total - off))
-                    payload = self.store.read(block, off, n) or b""
-                    conn.sendall(_RESP_HEAD.pack(1, total)
-                                 + _RESP_CHUNK.pack(len(payload)))
-                    conn.sendall(payload)
-                    return
-                # whole-block GET (compat): stream it in windows anyway so
-                # the server never materializes more than a chunk per send
-                total = self.store.length(block)
-                if total is None:
-                    conn.sendall(_RESP_HEAD.pack(0, 0))
-                    return
-                conn.sendall(_RESP_HEAD.pack(1, total))
-                off = 0
-                while off < total:
-                    n = min(self.chunk_bytes, total - off)
-                    piece = self.store.read(block, off, n)
-                    if not piece:
-                        return  # store lost the block mid-stream
-                    conn.sendall(piece)
-                    off += len(piece)
+                with activate_trace_context(tctx), \
+                        get_tracer().span("shuffle_serve", "shuffle",
+                                          op=op, shuffle=sid, map=mid,
+                                          reduce=rid):
+                    self._serve_request(conn, op, sid, mid, rid)
         except Exception:
             pass  # a broken client connection must not kill the server
+
+    def _serve_request(self, conn: socket.socket, op: int, sid: int,
+                       mid: int, rid: int):
+        if op == _OP_REMOVE:
+            self.remove_shuffle(sid)
+            conn.sendall(_RESP_HEAD.pack(1, 0))
+            return
+        block = BlockId(sid, mid, rid)
+        if op == _OP_GET_RANGE:
+            off, max_len = _RANGE_EXT.unpack(
+                _recv_exact(conn, _RANGE_EXT.size))
+            total = self.store.length(block)
+            if total is None:
+                conn.sendall(_RESP_HEAD.pack(0, 0))
+                return
+            n = max(0, min(max_len, self.chunk_bytes, total - off))
+            payload = self.store.read(block, off, n) or b""
+            conn.sendall(_RESP_HEAD.pack(1, total)
+                         + _RESP_CHUNK.pack(len(payload)))
+            conn.sendall(payload)
+            return
+        # whole-block GET (compat): stream it in windows anyway so
+        # the server never materializes more than a chunk per send
+        total = self.store.length(block)
+        if total is None:
+            conn.sendall(_RESP_HEAD.pack(0, 0))
+            return
+        conn.sendall(_RESP_HEAD.pack(1, total))
+        off = 0
+        while off < total:
+            n = min(self.chunk_bytes, total - off)
+            piece = self.store.read(block, off, n)
+            if not piece:
+                return  # store lost the block mid-stream
+            conn.sendall(piece)
+            off += len(piece)
 
     # -- client side ----------------------------------------------------------
     def add_peer(self, host: str, port: int):
         self._peers.append((host, port))
 
     def _range_from_peer(self, addr: Tuple[str, int], block: BlockId,
-                         offset: int, timeout: float = 10.0
+                         offset: int, timeout: float = 10.0,
+                         tctx: Optional[TraceContext] = None
                          ) -> Optional[Tuple[int, bytes]]:
-        """One ranged request -> (total_len, chunk) or None if absent."""
+        """One ranged request -> (total_len, chunk) or None if absent.
+        With a TraceContext the traced wire variant (magic SRTC) carries
+        it, so the server's shuffle_serve span parents under it."""
+        if tctx is not None and self._trace_wire:
+            head = _REQ.pack(_MAGIC_TRACED, _OP_GET_RANGE, *block) \
+                + tctx.pack()
+        else:
+            head = _REQ.pack(_MAGIC, _OP_GET_RANGE, *block)
         try:
             with socket.create_connection(addr, timeout=timeout) as s:
-                s.sendall(_REQ.pack(_MAGIC, _OP_GET_RANGE, *block)
+                s.sendall(head
                           + _RANGE_EXT.pack(offset, self.chunk_bytes))
                 found, total = _RESP_HEAD.unpack(
                     _recv_exact(s, _RESP_HEAD.size))
@@ -396,17 +430,21 @@ class TcpShuffleTransport(ShuffleTransport):
             return None  # dead peer == block not found here
 
     def _fetch_remote(self, block: BlockId, turnstile: "_Turnstile",
-                      ticket: int) -> Optional[Tuple[bytes, int]]:
+                      ticket: int,
+                      tctx: Optional[TraceContext] = None
+                      ) -> Optional[Tuple[bytes, int]]:
         """Assemble a block from a peer chunk by chunk.
 
         The inflight reservation is acquired in STRICT consumer order via
         the turnstile (ticket = position in the fetch list): ticket k's
         acquire can only ever wait on releases of blocks < k, so the
         budget can never deadlock head-of-line. Returns
-        (payload, reserved_bytes) — the caller owns the release."""
+        (payload, reserved_bytes) — the caller owns the release. ``tctx``
+        is the submitting thread's TraceContext, passed explicitly because
+        this runs on a prefetch-pool thread with no ambient context."""
         try:
             for addr in self._peers:
-                first = self._range_from_peer(addr, block, 0)
+                first = self._range_from_peer(addr, block, 0, tctx=tctx)
                 if first is None:
                     continue
                 total, chunk = first
@@ -417,7 +455,8 @@ class TcpShuffleTransport(ShuffleTransport):
                     parts = [chunk]
                     got = len(chunk)
                     while got < total:
-                        nxt = self._range_from_peer(addr, block, got)
+                        nxt = self._range_from_peer(addr, block, got,
+                                                    tctx=tctx)
                         if nxt is None or not nxt[1]:
                             break
                         parts.append(nxt[1])
@@ -452,10 +491,13 @@ class TcpShuffleTransport(ShuffleTransport):
         turnstile = _Turnstile()
         futures = {}
         consumed: set = set()
+        # capture the caller's context here: prefetch-pool threads have no
+        # ambient thread-local context of their own
+        tctx = current_trace_context()
         try:
             for ticket, b in enumerate(remote):
                 futures[b] = pool.submit(self._fetch_remote, b, turnstile,
-                                         ticket)
+                                         ticket, tctx)
             for b in blocks:
                 if local[b]:
                     total = self.store.length(b)

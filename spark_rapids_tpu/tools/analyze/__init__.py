@@ -24,6 +24,9 @@ Checkers (see the sibling modules):
 - ``bucket`` — hardcoded shape-bucket floors (``min_bucket`` literals /
                ad-hoc numeric defaults) bypassing the central
                ``shapeBuckets`` policy in columnar/device.py.
+- ``trace``  — tracer spans opened without a closing ``with`` scope;
+               ProcessCluster task-queue submissions bypassing the
+               ``_submit`` trace-context injection chokepoint.
 
 Workflow: findings are compared against a COMMITTED baseline
 (``tools/analyze/baseline.json``) so pre-existing debt is inventoried
@@ -298,12 +301,13 @@ def load_project(paths: Sequence[str]) -> Project:
 
 
 def _checkers() -> Dict[str, object]:
-    from . import buckets, host_sync, jit_purity, locks, threads
+    from . import buckets, host_sync, jit_purity, locks, threads, trace_ctx
     return {"sync": host_sync, "lock": locks,
-            "thread": threads, "jit": jit_purity, "bucket": buckets}
+            "thread": threads, "jit": jit_purity, "bucket": buckets,
+            "trace": trace_ctx}
 
 
-CHECKS = ("sync", "lock", "thread", "jit", "bucket")
+CHECKS = ("sync", "lock", "thread", "jit", "bucket", "trace")
 
 
 def analyze_paths(paths: Sequence[str],

@@ -1,0 +1,87 @@
+"""trace checker: span scoping + cross-process context injection.
+
+The distributed-tracing contract (utils/tracing.py, parallel/runtime.py)
+has two conventions a reviewer can't reliably hold by eye:
+
+- ``trace-span-no-with`` — ``tracer.span(...)`` / ``get_tracer().span(...)``
+  called anywhere except as a ``with`` item. ``span()`` is a
+  contextmanager: a bare call records nothing, re-parents nothing, and
+  silently punches a hole in the merged span DAG (the event "exists"
+  at the call site but never reaches the ring).
+- ``trace-ctx-bypass`` — a task envelope enqueued onto a ProcessCluster
+  ``_task_qs`` queue outside ``_submit``. ``_submit`` is the single
+  chokepoint that stamps the active TraceContext into every envelope;
+  a direct ``.put()`` ships a task whose worker spans orphan from the
+  driver's query span in the merged timeline. Non-envelope puts (the
+  shutdown ``None`` sentinel) carry an inline
+  ``# srtpu: trace-ok(<reason>)`` suppression.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from . import Finding, Project, ScopedVisitor
+
+__all__ = ["check"]
+
+
+def _is_span_call(qualified: str) -> bool:
+    """True for tracer span openings: get_tracer().span, tracer.span,
+    self.tracer.span, self._tracer.span — NOT arbitrary ``.span``
+    attributes (a DataFrame column named span must not flag)."""
+    if not qualified.endswith(".span"):
+        return False
+    base = qualified[: -len(".span")]
+    return (base.endswith("get_tracer()")
+            or base == "tracer"
+            or base.endswith(".tracer")
+            or base.endswith("._tracer"))
+
+
+class _TraceVisitor(ScopedVisitor):
+    def __init__(self, ctx):
+        super().__init__()
+        self.ctx = ctx
+        self.findings: List[Finding] = []
+        #: Call nodes that ARE with-items (properly scoped spans)
+        self._with_items: Set[int] = set()
+
+    def _hit(self, node, rule: str, msg: str) -> None:
+        self.findings.append(self.ctx.finding(
+            "trace", rule, node, self.symbol, msg))
+
+    def visit_With(self, node: ast.With) -> None:
+        for item in node.items:
+            if isinstance(item.context_expr, ast.Call):
+                self._with_items.add(id(item.context_expr))
+        self.generic_visit(node)
+
+    visit_AsyncWith = visit_With
+
+    def visit_Call(self, node: ast.Call) -> None:
+        q = self.ctx.qualify(node.func)
+        if _is_span_call(q) and id(node) not in self._with_items:
+            self._hit(node, "trace-span-no-with",
+                      f"{q}(...) called outside a with statement — "
+                      "span() is a contextmanager; a bare call records "
+                      "nothing and breaks the span DAG it should parent")
+        elif isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "put" \
+                and "_task_qs" in self.ctx.qualify(node.func.value) \
+                and not self.symbol.endswith("_submit"):
+            self._hit(node, "trace-ctx-bypass",
+                      "task queue .put() outside ProcessCluster._submit — "
+                      "_submit is the chokepoint that injects the "
+                      "TraceContext into every envelope; a direct put "
+                      "orphans the worker's spans from the query trace")
+        self.generic_visit(node)
+
+
+def check(project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    for ctx in project.modules:
+        v = _TraceVisitor(ctx)
+        v.visit(ctx.tree)
+        out.extend(v.findings)
+    return out

@@ -15,7 +15,12 @@ result JSONs:
   cache, upload cache, shuffle tiers, spill, semaphore) with regression
   flags: candidate slower than baseline by more than ``threshold``
   (relative) AND ``min_seconds`` (absolute floor, so microsecond noise on
-  trivial operators doesn't flag).
+  trivial operators doesn't flag);
+- critical-path category deltas when both runs carry a breakdown
+  (schema-v5 event logs / traced bench JSONs): a query whose sync-wait
+  fraction grew by more than 5 percentage points flags even when its
+  total wall time did NOT regress — the composition shifted toward the
+  ROADMAP-item-1 bottleneck and the next scale-up will pay for it.
 
 CLI: ``python -m spark_rapids_tpu.tools.compare A B [--threshold 0.2]``
 where A/B are event-log JSONL paths or bench summary JSONs.
@@ -27,7 +32,44 @@ import json
 from typing import Dict, List, Optional, Tuple
 
 __all__ = ["OpDelta", "QueryDelta", "CompareReport", "compare_event_logs",
-           "compare_bench_results", "compare_apps"]
+           "compare_bench_results", "compare_apps",
+           "critical_path_fractions", "critical_path_delta",
+           "CP_FRAC_FLAG_PP"]
+
+#: category-fraction growth (candidate minus baseline) that flags a
+#: critical-path regression: 5 percentage points
+CP_FRAC_FLAG_PP = 0.05
+
+
+def critical_path_fractions(cp: Optional[Dict]) -> Optional[Dict]:
+    """Category -> fraction-of-wall from a critical-path dict
+    (tools/trace.py ``CriticalPath.to_dict()`` or the trimmed bench form
+    with only ``categories_s`` + ``total_s``)."""
+    if not cp:
+        return None
+    if cp.get("fractions"):
+        return dict(cp["fractions"])
+    total = float(cp.get("total_s", 0.0))
+    if total <= 0:
+        return None
+    return {k: float(v) / total
+            for k, v in cp.get("categories_s", {}).items()}
+
+
+def critical_path_delta(cp_a: Optional[Dict], cp_b: Optional[Dict],
+                        flag_pp: float = CP_FRAC_FLAG_PP
+                        ) -> Tuple[Dict[str, float], List[str]]:
+    """(fraction deltas B - A, categories whose share grew > flag_pp).
+    Empty when either run lacks a breakdown — absence of tracing must
+    not flag."""
+    fa = critical_path_fractions(cp_a)
+    fb = critical_path_fractions(cp_b)
+    if fa is None or fb is None:
+        return {}, []
+    deltas = {k: round(fb.get(k, 0.0) - fa.get(k, 0.0), 4)
+              for k in sorted(set(fa) | set(fb))}
+    flagged = sorted(k for k, v in deltas.items() if v > flag_pp)
+    return deltas, flagged
 
 
 @dataclasses.dataclass
@@ -61,6 +103,11 @@ class QueryDelta:
     regressed: bool
     ops: List[OpDelta]
     metric_deltas: Dict[str, float]  # candidate minus baseline counters
+    #: critical-path fraction deltas (B - A) per category, when both
+    #: runs carried a breakdown
+    cp_deltas: Dict[str, float] = dataclasses.field(default_factory=dict)
+    #: categories whose share of the query wall grew > CP_FRAC_FLAG_PP
+    cp_flagged: List[str] = dataclasses.field(default_factory=list)
 
     @property
     def delta_s(self) -> float:
@@ -86,6 +133,12 @@ class CompareReport:
     def regressed_queries(self) -> List[QueryDelta]:
         return [q for q in self.queries if q.regressed]
 
+    def critical_path_regressions(self) -> List[QueryDelta]:
+        """Queries whose critical-path COMPOSITION regressed (a category's
+        share grew past the flag threshold) — orthogonal to wall-time
+        regressions; a query can flag here while getting faster."""
+        return [q for q in self.queries if q.cp_flagged]
+
     def summary(self) -> str:
         lines = [f"compare: A={self.label_a}  B={self.label_b}  "
                  f"(threshold {self.threshold:.0%}; positive delta = "
@@ -109,13 +162,27 @@ class CompareReport:
             if hot:
                 lines.append("  counter deltas (B - A): " + ", ".join(
                     f"{k}={q.metric_deltas[k]:+g}" for k in hot))
+            if q.cp_deltas:
+                moved = sorted((k for k, v in q.cp_deltas.items() if v),
+                               key=lambda k: -abs(q.cp_deltas[k]))[:6]
+                if moved:
+                    lines.append(
+                        "  critical-path share deltas (B - A): " + ", ".join(
+                            f"{k}={q.cp_deltas[k]:+.1%}" for k in moved))
+                if q.cp_flagged:
+                    lines.append(
+                        "  ** CRITICAL-PATH REGRESSION: "
+                        + ", ".join(f"{k} share +{q.cp_deltas[k]:.1%}"
+                                    for k in q.cp_flagged))
         if self.only_in_a:
             lines.append(f"queries only in A: {self.only_in_a}")
         if self.only_in_b:
             lines.append(f"queries only in B: {self.only_in_b}")
         n_reg = len(self.regressions())
         lines.append(f"{n_reg} regressed operator(s), "
-                     f"{len(self.regressed_queries())} regressed query(ies)")
+                     f"{len(self.regressed_queries())} regressed query(ies), "
+                     f"{len(self.critical_path_regressions())} "
+                     "critical-path regression(s)")
         return "\n".join(lines)
 
 
@@ -158,8 +225,12 @@ def compare_apps(app_a, app_b, threshold: float = 0.2,
                        and isinstance(qb.stats.get(k, 0), (int, float))}
         q_regressed = (qb.wall_s > qa.wall_s * (1.0 + threshold)
                        and qb.wall_s - qa.wall_s >= min_seconds)
+        cp_deltas, cp_flagged = critical_path_delta(
+            getattr(qa, "critical_path", None),
+            getattr(qb, "critical_path", None))
         queries.append(QueryDelta(qid, qa.wall_s, qb.wall_s,
-                                  q_regressed, ops, stats_delta))
+                                  q_regressed, ops, stats_delta,
+                                  cp_deltas, cp_flagged))
     return CompareReport(app_a.app_id or app_a.path,
                          app_b.app_id or app_b.path, queries, threshold,
                          sorted(qids_a - qids_b), sorted(qids_b - qids_a))
@@ -205,12 +276,17 @@ def compare_bench_results(path_a: str, path_b: str, threshold: float = 0.2,
                          and wall_b - wall_a >= min_seconds)
             deltas = {k: float(qs_b[name].get(k, 0))
                       - float(qs_a[name].get(k, 0))
-                      for k in ("dev_s", "cpu_s", "compile_s", "speedup")
+                      for k in ("dev_s", "cpu_s", "compile_s", "speedup",
+                                "sync_wait_frac")
                       if k in qs_a[name] or k in qs_b[name]}
+            cp_deltas, cp_flagged = critical_path_delta(
+                qs_a[name].get("critical_path"),
+                qs_b[name].get("critical_path"))
             queries.append(QueryDelta(
                 label, wall_a, wall_b, regressed,
                 [OpDelta(label, name, 0, wall_a, wall_b, 0, 0,
-                         regressed=regressed)], deltas))
+                         regressed=regressed)], deltas,
+                cp_deltas, cp_flagged))
     return CompareReport(path_a, path_b, queries, threshold,
                          only_a, only_b)
 
@@ -272,7 +348,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         report = compare_event_logs(args.log_a, args.log_b, args.threshold,
                                     args.min_seconds)
     print(report.summary())
-    return 1 if report.regressions() else 0
+    return 1 if report.regressions() \
+        or report.critical_path_regressions() else 0
 
 
 if __name__ == "__main__":  # pragma: no cover

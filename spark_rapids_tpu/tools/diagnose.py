@@ -55,6 +55,8 @@ class QueryDiagnosis:
     query_id: int
     wall_s: float
     findings: List[Finding]
+    #: the query_end critical-path breakdown (schema v5; None pre-v5)
+    critical_path: Optional[Dict] = None
 
     def top(self, n: int = 3) -> List[Finding]:
         return self.findings[:n]
@@ -79,8 +81,28 @@ class DiagnoseReport:
                 lines.append(f"  {rank}. ({f.node}, {f.metric}) {pct} of "
                              f"wall — {f.detail}")
                 lines.append(f"     suggest: {f.suggestion}")
-        lines.extend(_sync_debt_lines())
+        lines.extend(_sync_debt_lines(self._measured_sync()))
         return "\n".join(lines)
+
+    def _measured_sync(self) -> Optional[Dict]:
+        """Aggregate measured critical-path sync-wait over the report's
+        queries (schema-v5 logs) — the dynamic number the static
+        sync-site inventory is ranked against. None pre-v5."""
+        sync_s = wall_s = 0.0
+        counted = 0
+        for q in self.queries:
+            cp = q.critical_path
+            if not cp:
+                continue
+            counted += 1
+            wall_s += float(cp.get("total_s", q.wall_s))
+            sync_s += float((cp.get("categories_s") or {})
+                            .get("sync_wait", 0.0))
+        if not counted:
+            return None
+        return {"queries": counted, "sync_wait_s": sync_s,
+                "wall_s": wall_s,
+                "sync_wait_frac": sync_s / wall_s if wall_s > 0 else 0.0}
 
     def to_json(self, top: int = 3) -> str:
         return json.dumps({
@@ -88,8 +110,10 @@ class DiagnoseReport:
             "queries": [{
                 "query_id": q.query_id, "wall_s": q.wall_s,
                 "findings": [f.to_dict() for f in q.top(top)],
+                "critical_path": q.critical_path,
             } for q in self.queries],
             "sync_debt": _sync_debt_info(),
+            "measured_sync": self._measured_sync(),
         }, indent=1)
 
 
@@ -107,7 +131,7 @@ def _sync_debt_info() -> Dict:
         return {}
 
 
-def _sync_debt_lines() -> List[str]:
+def _sync_debt_lines(measured: Optional[Dict] = None) -> List[str]:
     info = _sync_debt_info()
     checks = (info.get("summary") or {}).get("checks") or {}
     sync = checks.get("sync")
@@ -120,6 +144,16 @@ def _sync_debt_lines() -> List[str]:
     if initial:
         head += f" (initial inventory {initial})"
     lines = [head]
+    if measured:
+        # the critical-path measurement closes the static/dynamic loop:
+        # the inventory says WHERE the blocking syncs live, the traced
+        # critical path says how much wall they actually COST
+        lines.append(
+            f"  measured critical-path sync wait: "
+            f"{measured['sync_wait_s']:.4f}s over "
+            f"{measured['queries']} traced query(ies) "
+            f"({measured['sync_wait_frac']:.1%} of wall) — the dynamic "
+            "cost of the sites in this inventory")
     top = (info.get("summary") or {}).get("top_sync_files") or []
     if top:
         lines.append("  top hot-sync files: " + ", ".join(
@@ -264,6 +298,62 @@ def _heartbeat_findings(q, heartbeats, wall: float) -> List[Finding]:
     return findings
 
 
+#: critical-path category -> actionable knob (the span-DAG analogue of
+#: _node_suggestion); "other" and device_compute below the floor stay
+#: silent — compute dominating the path is the HEALTHY profile
+_CP_SUGGESTIONS = {
+    "sync_wait": (
+        "blocking device->host sync on the critical path — the measured "
+        "ROADMAP item 1 cost; the static sync-site inventory at the end "
+        "of this report names the files to fix"),
+    "shuffle_transfer": (
+        "shuffle dominates the path — attach a mesh "
+        "(spark.rapids.tpu.shuffle.mode=ici) or enable cached writes so "
+        "blocks stay device-resident"),
+    "compile": (
+        "XLA compile on the critical path — persist the compile tier "
+        "(spark.rapids.tpu.compile.cacheDir) or raise "
+        "batchRowsMinBucket to collapse shape buckets"),
+    "semaphore_wait": (
+        "tasks serialized on the device semaphore — raise "
+        "spark.rapids.sql.concurrentGpuTasks or lower task parallelism"),
+    "pipeline_queue_idle": (
+        "the pipeline starved — raise "
+        "spark.rapids.tpu.pipeline.prefetchDepth or speed up the "
+        "producing stage"),
+    "h2d_upload": (
+        "host->device upload on the path — enable "
+        "spark.rapids.tpu.scan.deviceCache.* so re-scanned batches skip "
+        "the upload"),
+    "spill": (
+        "spill I/O on the path — raise "
+        "spark.rapids.memory.gpu.allocFraction or lower "
+        "spark.rapids.sql.batchSizeBytes"),
+}
+
+
+def _critical_path_findings(cp: Optional[Dict],
+                            wall: float) -> List[Finding]:
+    if not cp or wall <= 0:
+        return []
+    out: List[Finding] = []
+    for cat, sec in (cp.get("categories_s") or {}).items():
+        suggest = _CP_SUGGESTIONS.get(cat)
+        if suggest is None:
+            continue  # device_compute / other: not actionable debt
+        frac = float(sec) / wall
+        if frac < _FRACTION_FLOOR:
+            continue
+        out.append(Finding(
+            node="(critical-path)", node_id=None,
+            metric=f"criticalPath.{cat}", seconds=float(sec),
+            fraction=frac,
+            detail=f"{cat} holds {frac:.0%} of the traced critical path "
+                   f"({float(sec):.4f}s of {wall:.4f}s wall)",
+            suggestion=suggest))
+    return out
+
+
 def _diagnose_query(q, heartbeats=None) -> Optional[QueryDiagnosis]:
     wall = getattr(q, "wall_s", 0.0)
     if wall <= 0 or getattr(q, "error", None):
@@ -396,8 +486,15 @@ def _diagnose_query(q, heartbeats=None) -> Optional[QueryDiagnosis]:
     # 5. live-health heartbeats (schema v4): stall windows + HBM pressure
     findings.extend(_heartbeat_findings(q, heartbeats or [], wall))
 
+    # 6. critical-path attribution (schema v5): measured category costs
+    # from the traced span DAG — unlike the per-node signals above these
+    # sum to the whole query wall, so a category that dominates here IS
+    # the bottleneck, not merely a contributor
+    cp = getattr(q, "critical_path", None)
+    findings.extend(_critical_path_findings(cp, wall))
+
     findings.sort(key=lambda f: -f.fraction)
-    return QueryDiagnosis(q.query_id, wall, findings)
+    return QueryDiagnosis(q.query_id, wall, findings, critical_path=cp)
 
 
 def diagnose_app(app, path: str = "") -> DiagnoseReport:

@@ -23,7 +23,10 @@ file per session (``spark.rapids.tpu.eventLog.dir``), one record per event:
   (the writer is locked), so ``tools/diagnose.py`` can rank stall
   windows and flag queries that heartbeated into OOM territory
 - ``query_end``: wall time, spill/semaphore deltas, AQE events, per-query
-  process-counter deltas
+  process-counter deltas; schema v5 adds ``trace_id`` (the distributed
+  TraceContext minted for the query, also on ``query_start``) and
+  ``critical_path`` (the per-category wall-time attribution computed
+  from this process's tracer spans — tools/trace.py)
 - ``app_end``
 
 ``load_event_log`` replays a file into ``AppReplay``: per-query summaries,
@@ -48,8 +51,9 @@ __all__ = ["EventLogWriter", "load_event_log", "AppReplay", "QueryReplay",
 # Event-record schema version. Bump ONLY with a migration note in
 # docs/observability.md; tests/test_observability.py pins the current value
 # and the per-record required-key sets so replay/compare tooling can rely
-# on old logs staying loadable.
-SCHEMA_VERSION = 4
+# on old logs staying loadable. v5: query_start/query_end carry trace_id,
+# query_end carries the critical_path category breakdown.
+SCHEMA_VERSION = 5
 
 EVENT_LOG_DIR = register_conf(
     "spark.rapids.tpu.eventLog.dir",
@@ -95,10 +99,15 @@ class EventLogWriter:
         from ..memory.semaphore import get_semaphore
         from ..utils.compile_cache import kernel_seq, kernels_since
         from ..utils.metrics import StatsRegistry, get_stats
-        from ..utils.tracing import get_tracer
+        from ..utils.tracing import (activate_trace_context, get_tracer,
+                                     mint_trace_context)
         from .profiler import instrument_plan
 
         qid = self.next_query_id()
+        # v5: one TraceContext per query — the identity every process
+        # boundary (ProcessCluster envelope, shuffle wire header) carries
+        # so worker spans merge under this query's timeline
+        tctx = mint_trace_context(query_id=qid)
         epoch = time.perf_counter()
         stats: List = []
         from ..plan.aqe import AdaptiveExec
@@ -118,14 +127,16 @@ class EventLogWriter:
         counters_before = registry.collect()
         kseq_before = kernel_seq()
         self.write({"event": "query_start", "query_id": qid,
-                    "ts": time.time(), "plan": plan.tree_string()})
+                    "ts": time.time(), "trace_id": tctx.trace_id,
+                    "plan": plan.tree_string()})
         t0 = time.perf_counter()
         try:
-            with get_tracer().span("query", "query", query_id=qid):
+            with activate_trace_context(tctx), \
+                    get_tracer().span("query", "query", query_id=qid):
                 result = collect_fn()
         except Exception as e:
             self.write({"event": "query_end", "query_id": qid,
-                        "ts": time.time(),
+                        "ts": time.time(), "trace_id": tctx.trace_id,
                         "wall_s": time.perf_counter() - t0,
                         "error": f"{type(e).__name__}: {e}"})
             raise
@@ -149,6 +160,8 @@ class EventLogWriter:
         aqe_events: List[str] = list(getattr(plan, "events", []))
         self.write({
             "event": "query_end", "query_id": qid, "ts": time.time(),
+            "trace_id": tctx.trace_id,
+            "critical_path": _query_critical_path(tctx.trace_id),
             "wall_s": wall, "final_plan": plan.tree_string(),
             "aqe_events": aqe_events,
             "spill_count": {str(k): v - spill_before.get(k, 0)
@@ -165,6 +178,23 @@ class EventLogWriter:
     def close(self) -> None:
         self.write({"event": "app_end", "ts": time.time()})
         self._f.close()
+
+
+def _query_critical_path(trace_id: str) -> Optional[Dict]:
+    """The per-category wall-time breakdown of the query just run,
+    computed from THIS process's tracer spans (v5 query_end payload).
+    None when tracing is off or the query span was dropped from the
+    ring — never raises (trace math must not fail a query)."""
+    from ..utils.tracing import get_tracer
+    tracer = get_tracer()
+    if not tracer.enabled:
+        return None
+    try:
+        from .trace import critical_path_from_tracer
+        cp = critical_path_from_tracer(tracer, trace_id)
+        return None if cp is None else cp.to_dict()
+    except Exception:  # pragma: no cover — defensive
+        return None
 
 
 def _node_metrics(ns) -> Dict:
@@ -194,6 +224,9 @@ class QueryReplay:
         # app-level heartbeats can be attributed to the running query
         self.ts_start: float = 0.0
         self.ts_end: float = 0.0
+        # v5: distributed-trace identity + critical-path attribution
+        self.trace_id: str = ""
+        self.critical_path: Optional[Dict] = None
 
     def heartbeats_in_window(self, heartbeats: List[Dict]) -> List[Dict]:
         """App heartbeats whose timestamp falls inside this query's run
@@ -339,6 +372,7 @@ def load_event_log(path: str) -> AppReplay:
                                            QueryReplay(rec["query_id"]))
                 q.plan = rec.get("plan", "")
                 q.ts_start = rec.get("ts", 0.0)
+                q.trace_id = rec.get("trace_id", "")
             elif ev == "heartbeat":
                 app.heartbeats.append(rec)
             elif ev == "node":
@@ -355,6 +389,8 @@ def load_event_log(path: str) -> AppReplay:
                 q.wall_s = rec.get("wall_s", 0.0)
                 q.error = rec.get("error")
                 q.ts_end = rec.get("ts", 0.0)
+                q.trace_id = rec.get("trace_id", q.trace_id)
+                q.critical_path = rec.get("critical_path")
                 q.final_plan = rec.get("final_plan", "")
                 q.aqe_events = rec.get("aqe_events", [])
                 q.spill_count = rec.get("spill_count", {})

@@ -15,6 +15,14 @@ fleet needs a liveness probe per process):
   (``HealthMonitor.snapshot()``): semaphore holders/waiters, pipeline
   queue depths + in-flight task ages, HBM watermarks, active operator
   contexts, recent watermark history.
+- ``GET /federation`` — JSON scrape summary over every registered peer
+  process (ProcessCluster workers / remote status daemons): per-peer
+  reachability + sample counts.
+- ``GET /federation/metrics`` — ONE Prometheus text page combining the
+  driver's registry with every peer's, each sample tagged with a
+  ``process="<name>"`` label so worker counters never collide with the
+  driver's (the federation view a fleet scraper ingests; reference:
+  Prometheus federation's ``honor_labels`` pattern).
 
 stdlib ``http.server`` only (no new dependencies); a
 ``ThreadingHTTPServer`` on 127.0.0.1 whose serve loop runs on a
@@ -31,7 +39,112 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import urlparse
 
-__all__ = ["StatusServer"]
+__all__ = ["StatusServer", "MetricsFederation", "label_prometheus_text"]
+
+
+def label_prometheus_text(text: str, process: str) -> str:
+    """Tag every sample line of a Prometheus 0.0.4 text page with a
+    ``process="<name>"`` label (comments/HELP/TYPE lines pass through) so
+    pages from several processes can concatenate without name
+    collisions."""
+    esc = process.replace("\\", "\\\\").replace('"', '\\"')
+    out = []
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            out.append(line)
+            continue
+        metric, sep, rest = line.partition(" ")
+        if "{" in metric:
+            metric = metric.replace("{", '{process="%s",' % esc, 1)
+        else:
+            metric = metric + '{process="%s"}' % esc
+        out.append(metric + sep + rest)
+    return "\n".join(out) + ("\n" if text.endswith("\n") else "")
+
+
+class MetricsFederation:
+    """Aggregates peer-process metrics into the driver's status daemon.
+
+    Two kinds of peers, same scrape surface:
+
+    - ``register_url(name, url)`` — an HTTP ``/metrics`` endpoint
+      (another process's StatusServer);
+    - ``register_puller(name, fn)`` — a zero-arg callable returning
+      Prometheus text (e.g. ``ProcessCluster.run_on(w,
+      metrics_text_task)`` — workers don't run HTTP servers, the task
+      queue IS their scrape transport).
+
+    ``prometheus_text()`` returns one combined page: the local registry
+    first, then every peer, each sample labelled ``process="<name>"``."""
+
+    def __init__(self, local_name: str = "driver"):
+        self.local_name = local_name
+        self._peers: "dict" = {}
+        self._lock = threading.Lock()
+
+    def register_url(self, name: str, url: str) -> None:
+        with self._lock:
+            self._peers[name] = ("url", url)
+
+    def register_puller(self, name: str, fn) -> None:
+        with self._lock:
+            self._peers[name] = ("puller", fn)
+
+    def register_cluster(self, cluster) -> None:
+        """One puller per live ProcessCluster worker, scraped through the
+        cluster's task queues (no worker-side HTTP server needed)."""
+        from ..parallel.runtime import metrics_text_task
+        for w, p in enumerate(cluster.procs):
+            if not p.is_alive():
+                continue
+            self.register_puller(
+                f"worker-{w}",
+                lambda w=w: cluster.run_on(w, metrics_text_task))
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._peers.pop(name, None)
+
+    def peers(self) -> "dict":
+        with self._lock:
+            return dict(self._peers)
+
+    def _pull(self, kind: str, target, timeout_s: float) -> str:
+        if kind == "url":
+            from urllib.request import urlopen
+            with urlopen(target, timeout=timeout_s) as resp:
+                return resp.read().decode("utf-8")
+        return target()
+
+    def scrape(self, timeout_s: float = 2.0) -> "dict":
+        """name -> {"ok", "samples"|"error"} for every registered peer
+        (the /federation JSON body). A dead peer is reported, never
+        raised — federation must degrade, not 500."""
+        out = {}
+        for name, (kind, target) in sorted(self.peers().items()):
+            try:
+                text = self._pull(kind, target, timeout_s)
+                samples = sum(1 for ln in text.splitlines()
+                              if ln and not ln.startswith("#"))
+                out[name] = {"ok": True, "kind": kind, "samples": samples}
+            except Exception as e:  # noqa: BLE001 — report, don't fail
+                out[name] = {"ok": False, "kind": kind, "error": str(e)}
+        return out
+
+    def prometheus_text(self, timeout_s: float = 2.0) -> str:
+        from ..utils.metrics import get_stats
+        pages = [label_prometheus_text(get_stats().prometheus_text(),
+                                       self.local_name)]
+        for name, (kind, target) in sorted(self.peers().items()):
+            try:
+                text = self._pull(kind, target, timeout_s)
+            except Exception as e:  # noqa: BLE001
+                pages.append(f"# federation scrape of {name} FAILED: "
+                             f"{e}\n")
+                continue
+            pages.append(f"# federated from {name}\n"
+                         + label_prometheus_text(text, name))
+        return "\n".join(pages)
 
 
 class _StatusHandler(BaseHTTPRequestHandler):
@@ -70,10 +183,21 @@ class _StatusHandler(BaseHTTPRequestHandler):
                 self._send(200,
                            json.dumps(monitor.snapshot(), default=str),
                            "application/json")
+            elif path == "/federation":
+                fed = self.server.federation  # type: ignore[attr-defined]
+                body = {"local": fed.local_name,
+                        "peers": fed.scrape()}
+                self._send(200, json.dumps(body), "application/json")
+            elif path == "/federation/metrics":
+                fed = self.server.federation  # type: ignore[attr-defined]
+                self._send(200, fed.prometheus_text(),
+                           "text/plain; version=0.0.4")
             else:
                 self._send(404, json.dumps(
                     {"error": "not found",
-                     "endpoints": ["/healthz", "/metrics", "/status"]}),
+                     "endpoints": ["/healthz", "/metrics", "/status",
+                                   "/federation",
+                                   "/federation/metrics"]}),
                     "application/json")
         except (BrokenPipeError, ConnectionResetError):
             pass  # client went away mid-response
@@ -92,10 +216,13 @@ class StatusServer:
     snapshots. Request handling is threaded (daemon threads), so /healthz
     answers even while a long /status snapshot or a query runs."""
 
-    def __init__(self, monitor, port: int = 0, host: str = "127.0.0.1"):
+    def __init__(self, monitor, port: int = 0, host: str = "127.0.0.1",
+                 federation: Optional[MetricsFederation] = None):
         self._httpd = ThreadingHTTPServer((host, port), _StatusHandler)
         self._httpd.daemon_threads = True
         self._httpd.monitor = monitor  # type: ignore[attr-defined]
+        self.federation = federation or MetricsFederation()
+        self._httpd.federation = self.federation  # type: ignore[attr-defined]
         self.host = host
         self.port = self._httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None

@@ -22,18 +22,26 @@ and in TensorBoard's trace viewer.
 """
 from __future__ import annotations
 
+import itertools
 import json
+import os
+import struct
 import threading
 import time
+import uuid
 from collections import deque
 from contextlib import contextmanager
 from typing import Dict, List, Optional
 
 from ..conf import register_conf
 
-__all__ = ["TraceEvent", "Tracer", "get_tracer", "set_tracer",
-           "configure_tracer", "tracer_stats", "TRACE_ENABLED",
-           "TRACE_BUFFER_SIZE", "TRACE_DIR"]
+__all__ = ["TraceEvent", "Tracer", "TraceContext", "get_tracer",
+           "set_tracer", "configure_tracer", "tracer_stats",
+           "mint_trace_context", "current_trace_context",
+           "activate_trace_context", "new_span_id",
+           "TRACE_ENABLED", "TRACE_BUFFER_SIZE", "TRACE_DIR",
+           "TRACE_DISTRIBUTED", "TRACE_DISTRIBUTED_DIR",
+           "TRACE_CLOCK_PROBES"]
 
 TRACE_ENABLED = register_conf(
     "spark.rapids.tpu.trace.enabled",
@@ -53,6 +61,124 @@ TRACE_DIR = register_conf(
     "Directory to dump the Chrome trace-event JSON into on session close "
     "(one file per session, loadable in Perfetto / chrome://tracing). "
     "Empty disables the dump.", "")
+
+TRACE_DISTRIBUTED = register_conf(
+    "spark.rapids.tpu.trace.distributed.enabled",
+    "Propagate the per-query TraceContext (trace_id, parent span id, "
+    "query_id) across process boundaries: ProcessCluster task envelopes "
+    "and the TCP/DCN shuffle wire headers. Worker-side spans then parent "
+    "under the driver's query span in the merged timeline "
+    "(tools/trace.py merge). Near-zero cost; only disable to bisect "
+    "wire-protocol issues.", True)
+
+TRACE_DISTRIBUTED_DIR = register_conf(
+    "spark.rapids.tpu.trace.distributed.dir",
+    "Directory where each PROCESS (driver and every ProcessCluster "
+    "worker) dumps its own Chrome trace on shutdown/flush, named "
+    "trace-<process_name>.json — the input set for "
+    "`python -m spark_rapids_tpu.tools.trace merge`. Empty disables.", "")
+
+TRACE_CLOCK_PROBES = register_conf(
+    "spark.rapids.tpu.trace.distributed.clockProbes",
+    "Number of clock-handshake probes per ProcessCluster worker used to "
+    "estimate the worker->driver wall-clock offset (the probe with the "
+    "smallest round trip wins, NTP-style); the estimate aligns worker "
+    "span timestamps in the merged timeline.", 5,
+    checker=lambda v: None if v > 0 else f"must be positive, got {v}")
+
+
+# ---------------------------------------------------------------------------
+# trace context: the cross-process identity of one query's timeline
+# ---------------------------------------------------------------------------
+_SPAN_SEQ = itertools.count(1)
+
+
+def new_span_id() -> int:
+    """Process-unique span id: pid in the high bits, a monotonic counter
+    in the low bits — two processes can never mint the same id, so the
+    merged span DAG needs no renumbering."""
+    return ((os.getpid() & 0xFFFF) << 40) | (next(_SPAN_SEQ) & 0xFFFFFFFFFF)
+
+
+class TraceContext:
+    """Identity carried across every process boundary a query touches:
+    which trace (query execution) an event belongs to and which span it
+    parents under. Immutable; ``child()`` derives the context a nested
+    span propagates."""
+
+    __slots__ = ("trace_id", "span_id", "query_id")
+
+    #: wire encoding for the TCP shuffle header: 16 ascii-hex chars of
+    #: trace_id, u64 parent span id, i64 query id (-1 = none)
+    WIRE = struct.Struct("<16sQq")
+
+    def __init__(self, trace_id: str, span_id: int,
+                 query_id: Optional[int] = None):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.query_id = query_id
+
+    def child(self, span_id: int) -> "TraceContext":
+        return TraceContext(self.trace_id, span_id, self.query_id)
+
+    # -- serialization (task envelopes use the dict form; the TCP wire
+    #    uses the fixed-size pack) --------------------------------------
+    def to_wire(self) -> Dict:
+        return {"trace_id": self.trace_id, "span_id": self.span_id,
+                "query_id": self.query_id}
+
+    @classmethod
+    def from_wire(cls, d: Optional[Dict]) -> Optional["TraceContext"]:
+        if not d:
+            return None
+        return cls(d["trace_id"], d["span_id"], d.get("query_id"))
+
+    def pack(self) -> bytes:
+        return self.WIRE.pack(
+            self.trace_id[:16].ljust(16, "0").encode("ascii"),
+            self.span_id,
+            -1 if self.query_id is None else int(self.query_id))
+
+    @classmethod
+    def unpack(cls, raw: bytes) -> "TraceContext":
+        tid, span_id, qid = cls.WIRE.unpack(raw)
+        return cls(tid.decode("ascii"), span_id,
+                   None if qid < 0 else qid)
+
+    def __repr__(self):
+        return (f"TraceContext({self.trace_id!r}, span={self.span_id}, "
+                f"query={self.query_id})")
+
+
+_CTX_TLS = threading.local()
+
+
+def current_trace_context() -> Optional[TraceContext]:
+    """The TraceContext active on THIS thread (None outside a query)."""
+    stack = getattr(_CTX_TLS, "stack", None)
+    return stack[-1] if stack else None
+
+
+@contextmanager
+def activate_trace_context(ctx: Optional[TraceContext]):
+    """Make ``ctx`` the current context for the with-block (no-op on
+    None, so call sites need no conditionals)."""
+    if ctx is None:
+        yield None
+        return
+    stack = getattr(_CTX_TLS, "stack", None)
+    if stack is None:
+        stack = _CTX_TLS.stack = []
+    stack.append(ctx)
+    try:
+        yield ctx
+    finally:
+        stack.pop()
+
+
+def mint_trace_context(query_id: Optional[int] = None) -> TraceContext:
+    """A fresh trace root (driver side, one per query)."""
+    return TraceContext(uuid.uuid4().hex[:16], new_span_id(), query_id)
 
 
 class TraceEvent:
@@ -94,13 +220,19 @@ class TraceEvent:
 class Tracer:
     """Thread-safe bounded span recorder."""
 
-    def __init__(self, capacity: int = 65536, enabled: bool = False):
+    def __init__(self, capacity: int = 65536, enabled: bool = False,
+                 process_name: Optional[str] = None):
         self.enabled = enabled
         self.capacity = capacity
         self._events: deque = deque(maxlen=capacity)
         self._lock = threading.Lock()
         self._tls = threading.local()
+        # epoch (perf_counter domain) and its wall-clock anchor are taken
+        # at the SAME instant: merged timelines place this process's
+        # events at epoch_unix + ts, then correct by the handshake offset
         self.epoch = time.perf_counter()
+        self.epoch_unix = time.time()
+        self.process_name = process_name or f"pid-{os.getpid()}"
         self.dropped = 0
         self._drop_warned = False
 
@@ -130,25 +262,54 @@ class Tracer:
                 "spark.rapids.tpu.trace.bufferSize "
                 f"(currently {self.capacity})", RuntimeWarning)
 
+    @staticmethod
+    def _ctx_args(args: Dict,
+                  ctx: Optional[TraceContext] = None,
+                  span_id: Optional[int] = None) -> Dict:
+        """Fold the active TraceContext into event args: trace_id +
+        query_id tie the event to one query's timeline, span_id /
+        parent_span_id link the cross-process span DAG. No context
+        active -> args unchanged (process-local tracing stays lean)."""
+        ctx = ctx if ctx is not None else current_trace_context()
+        if ctx is None:
+            return args
+        out = dict(args)
+        out["trace_id"] = ctx.trace_id
+        out["span_id"] = span_id if span_id is not None else new_span_id()
+        out["parent_span_id"] = ctx.span_id
+        if ctx.query_id is not None:
+            out["query_id"] = out.get("query_id", ctx.query_id)
+        return out
+
     @contextmanager
     def span(self, name: str, cat: str = "misc", **args):
         """Record a complete event around the with-block. Nesting depth is
-        tracked per thread so exported traces preserve the span hierarchy."""
+        tracked per thread so exported traces preserve the span hierarchy.
+        Under an active TraceContext the span gets its own span id and
+        re-parents the context for the block, so nested spans (this thread
+        or a remote process the block talks to) chain under it."""
         if not self.enabled:
             yield
             return
         stack = self._stack()
         depth = len(stack)
         stack.append(name)
+        ctx = current_trace_context()
+        span_id = new_span_id() if ctx is not None else None
         t0 = time.perf_counter()
         try:
-            yield
+            if ctx is not None:
+                with activate_trace_context(ctx.child(span_id)):
+                    yield
+            else:
+                yield
         finally:
             t1 = time.perf_counter()
             stack.pop()
             self._record(TraceEvent(
                 name, cat, "X", (t0 - self.epoch) * 1e6, (t1 - t0) * 1e6,
-                threading.get_ident(), depth, args))
+                threading.get_ident(), depth,
+                self._ctx_args(args, ctx, span_id)))
 
     def complete(self, name: str, cat: str, start_s: float, dur_s: float,
                  **args) -> None:
@@ -159,14 +320,16 @@ class Tracer:
             return
         self._record(TraceEvent(
             name, cat, "X", (start_s - self.epoch) * 1e6, dur_s * 1e6,
-            threading.get_ident(), len(self._stack()), args))
+            threading.get_ident(), len(self._stack()),
+            self._ctx_args(args)))
 
     def instant(self, name: str, cat: str = "misc", **args) -> None:
         if not self.enabled:
             return
         self._record(TraceEvent(
             name, cat, "i", (time.perf_counter() - self.epoch) * 1e6, 0.0,
-            threading.get_ident(), len(self._stack()), args))
+            threading.get_ident(), len(self._stack()),
+            self._ctx_args(args)))
 
     # -- inspection / export --------------------------------------------------
     def events(self) -> List[TraceEvent]:
@@ -182,18 +345,41 @@ class Tracer:
             self.dropped = 0
             self._drop_warned = False
 
-    def to_chrome_trace(self) -> Dict:
-        """Chrome trace-event JSON object ({"traceEvents": [...]}), loadable
-        in Perfetto/chrome://tracing."""
-        evs = self.events()
+    def drain(self) -> Dict:
+        """Atomically snapshot-and-reset: returns a Chrome trace of
+        everything recorded since the last drain, with the drop count
+        scoped to THAT window (per-process, per-flush accounting — a
+        worker's per-query flush attributes its drops to the query that
+        overflowed the ring, and the counter starts clean for the next
+        one). The epoch is NOT reset: timestamps across drains stay in
+        one timebase."""
+        with self._lock:
+            evs = list(self._events)
+            dropped = self.dropped
+            self._events.clear()
+            self.dropped = 0
+            self._drop_warned = False
+        return self._chrome(evs, dropped)
+
+    def _chrome(self, evs: List[TraceEvent], dropped: int) -> Dict:
         return {
-            "traceEvents": [e.to_chrome() for e in evs],
+            "traceEvents": [e.to_chrome(pid=os.getpid()) for e in evs],
             "displayTimeUnit": "ms",
             "otherData": {
                 "producer": "spark-rapids-tpu",
-                "dropped_events": self.dropped,
+                "dropped_events": dropped,
+                "pid": os.getpid(),
+                "process_name": self.process_name,
+                "epoch_unix": self.epoch_unix,
             },
         }
+
+    def to_chrome_trace(self) -> Dict:
+        """Chrome trace-event JSON object ({"traceEvents": [...]}), loadable
+        in Perfetto/chrome://tracing. ``otherData`` carries the process
+        identity + wall-clock anchor tools/trace.py needs to merge traces
+        from several processes onto one timeline."""
+        return self._chrome(self.events(), self.dropped)
 
     def dump(self, path: str) -> str:
         """Write the Chrome trace JSON to ``path``; returns the path."""

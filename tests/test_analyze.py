@@ -281,6 +281,38 @@ def test_bucket_checker_rules(tmp_path):
     assert syms == {"bad_call", "BadNode.__init__"}
 
 
+def test_trace_checker_rules(tmp_path):
+    path = _write(tmp_path, "trace_fixture.py", """\
+        from spark_rapids_tpu.utils.tracing import get_tracer
+
+        class Cluster:
+            def _submit(self, w, envelope):
+                self._task_qs[w].put(envelope)        # the chokepoint
+
+            def sneaky(self, w, envelope):
+                self._task_qs[w].put(envelope)        # bypasses _submit
+
+            def sentinel(self, w):
+                self._task_qs[w].put(None)  # srtpu: trace-ok(shutdown)
+
+        def good(host):
+            with get_tracer().span("upload", "upload"):
+                return host
+
+        def bad(tracer):
+            tracer.span("upload", "upload")           # bare call: no-op
+
+        def not_a_tracer(df):
+            return df.span("2020", "2021")            # unrelated .span
+        """)
+    report = analyze_paths([path], checks=["trace"])
+    rules = [f.rule for f in report.findings]
+    assert rules.count("trace-span-no-with") == 1
+    assert rules.count("trace-ctx-bypass") == 1
+    assert {f.symbol for f in report.findings} == {"Cluster.sneaky", "bad"}
+    assert len(report.suppressed) == 1
+
+
 def test_bucket_checker_skips_cold_packages(tmp_path):
     cold = tmp_path / "spark_rapids_tpu" / "tools"
     cold.mkdir(parents=True)
@@ -420,6 +452,8 @@ def test_tier1_seeded_violation_fails_each_category(tmp_path,
         "bucket": "from spark_rapids_tpu.columnar.device import "
                   "bucket_rows\n\ndef f(n):\n"
                   "    return bucket_rows(n, 512)\n",
+        "trace": "def f(tracer):\n"
+                 "    tracer.span('q', 'query')\n    return 1\n",
     }
     baseline = load_baseline(default_baseline_path())
     for check, body in seeds.items():
@@ -452,6 +486,10 @@ def test_tier1_thread_and_lock_and_jit_clean(package_report):
     # engine; the only survivors are reasoned bucket-ok suppressions
     # (cross-process wire-protocol constants)
     assert package_report.count("bucket") == 0
+    # the trace-context contract is enforced from day one: every span is
+    # with-scoped and every envelope goes through _submit (the one
+    # shutdown-sentinel put carries a reasoned trace-ok suppression)
+    assert package_report.count("trace") == 0
 
 
 def test_baseline_summary_matches_committed_file(package_report):

@@ -323,7 +323,8 @@ def test_catalog_accounts_external_device_bytes():
 # ---------------------------------------------------------------------------
 _REQUIRED_KEYS = {
     "app_start": {"event", "app_id", "schema_version", "ts", "conf"},
-    "query_start": {"event", "query_id", "ts", "plan"},
+    # v5: queries carry their distributed trace identity
+    "query_start": {"event", "query_id", "ts", "plan", "trace_id"},
     "node": {"event", "query_id", "node_id", "parent_id", "name", "desc",
              "depth", "wall_s", "rows", "batches", "t_first", "t_last",
              "metrics"},
@@ -332,7 +333,8 @@ _REQUIRED_KEYS = {
                "node_name", "node_id", "hits", "misses", "compiles",
                "compile_s", "cost", "memory"},
     "query_end": {"event", "query_id", "ts", "wall_s", "final_plan",
-                  "aqe_events", "spill_count", "semaphore_wait_s", "stats"},
+                  "aqe_events", "spill_count", "semaphore_wait_s", "stats",
+                  "trace_id", "critical_path"},
     "app_end": {"event", "ts"},
 }
 
@@ -369,8 +371,10 @@ def test_eventlog_schema_version_and_required_keys(tmp_path):
     # the pinned version: bump SCHEMA_VERSION (and this test + the docs)
     # when the record shape changes. v4 added heartbeat records (health
     # monitor off in this run, so none appear here; tests/test_health.py
-    # pins the heartbeat record keys)
-    assert SCHEMA_VERSION == 4
+    # pins the heartbeat record keys). v5 adds the distributed-trace
+    # identity: trace_id on query_start/query_end, critical_path on
+    # query_end (null when tracing is off, as here)
+    assert SCHEMA_VERSION == 5
     assert by_type["app_start"][0]["schema_version"] == SCHEMA_VERSION
     for kind, required in _REQUIRED_KEYS.items():
         for rec in by_type[kind]:
@@ -571,7 +575,7 @@ def test_eventlog_query_stats_cover_all_subsystems(tmp_path):
     from spark_rapids_tpu.tools.eventlog import load_event_log
     path = _run_logged_app(tmp_path)
     app = load_event_log(path)
-    assert app.schema_version == 4
+    assert app.schema_version == 5
     q = app.query(1)
     assert q.stats, "query_end stats delta missing"
     for family in ("compile_cache_", "upload_cache_", "shuffle_",

@@ -1,0 +1,388 @@
+"""Distributed tracing: TraceContext wire round-trips, cross-process
+propagation through a real ProcessCluster worker, merged-timeline
+determinism + clock-skew alignment, per-query critical-path math on a
+hand-built span DAG, ring-drop flagging, and the driver-side metrics
+federation (reference: Spark's SQLAppStatusListener + the RAPIDS
+qualification tool's per-stage attribution, crossed with Chrome
+trace-event semantics)."""
+import copy
+import json
+
+import pytest
+
+from spark_rapids_tpu.tools.trace import (critical_path,
+                                          merge_process_traces,
+                                          query_trace_ids)
+from spark_rapids_tpu.utils.tracing import (TraceContext,
+                                            Tracer,
+                                            activate_trace_context,
+                                            current_trace_context,
+                                            mint_trace_context,
+                                            new_span_id)
+
+
+# ---------------------------------------------------------------------------
+# synthetic per-process traces (the shape collect_traces() emits)
+# ---------------------------------------------------------------------------
+def _proc_trace(process_name, role, epoch_unix, clock_offset_s, events,
+                dropped=0):
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "producer": "spark-rapids-tpu",
+            "pid": 1234,
+            "process_name": process_name,
+            "role": role,
+            "epoch_unix": epoch_unix,
+            "clock_offset_s": clock_offset_s,
+            "dropped_events": dropped,
+        },
+    }
+
+
+def _ev(name, cat, ts, dur, span_id=None, parent=None, trace_id=None,
+        tid=0):
+    args = {}
+    if span_id is not None:
+        args["span_id"] = span_id
+    if parent is not None:
+        args["parent_span_id"] = parent
+    if trace_id is not None:
+        args["trace_id"] = trace_id
+    return {"name": name, "cat": cat, "ph": "X", "ts": float(ts),
+            "dur": float(dur), "pid": 0, "tid": tid, "args": args}
+
+
+# ---------------------------------------------------------------------------
+# TraceContext identity
+# ---------------------------------------------------------------------------
+def test_trace_context_wire_roundtrips():
+    ctx = mint_trace_context(query_id=42)
+    assert len(ctx.trace_id) == 16
+
+    # dict form (pickled task envelopes)
+    back = TraceContext.from_wire(ctx.to_wire())
+    assert (back.trace_id, back.span_id, back.query_id) == \
+        (ctx.trace_id, ctx.span_id, ctx.query_id)
+    assert TraceContext.from_wire(None) is None
+
+    # fixed-size form (TCP shuffle header), including query_id=None -> -1
+    for qid in (42, None):
+        c = TraceContext(ctx.trace_id, ctx.span_id, qid)
+        raw = c.pack()
+        assert len(raw) == TraceContext.WIRE.size
+        u = TraceContext.unpack(raw)
+        assert (u.trace_id, u.span_id, u.query_id) == \
+            (c.trace_id, c.span_id, qid)
+
+    # child derivation keeps the trace, swaps the parent span
+    sid = new_span_id()
+    kid = ctx.child(sid)
+    assert kid.trace_id == ctx.trace_id
+    assert kid.span_id == sid != ctx.span_id
+    assert kid.query_id == 42
+
+
+def test_span_ids_are_process_unique_and_monotonic():
+    a, b = new_span_id(), new_span_id()
+    assert a != b
+    # same pid in the high bits, increasing counter in the low bits
+    assert (a >> 40) == (b >> 40)
+    assert (b & 0xFFFFFFFFFF) > (a & 0xFFFFFFFFFF)
+
+
+def test_span_reparents_under_active_context():
+    tracer = Tracer(enabled=True)
+    ctx = mint_trace_context(query_id=9)
+    with tracer.span("outside", "task"):
+        pass
+    with activate_trace_context(ctx):
+        with tracer.span("root_child", "task"):
+            inner_ctx = current_trace_context()
+            with tracer.span("grandchild", "shuffle"):
+                pass
+    assert current_trace_context() is None
+
+    by_name = {e.name: e for e in tracer.events()}
+    # no active context -> no trace identity keys
+    assert "trace_id" not in by_name["outside"].args
+    child = by_name["root_child"].args
+    assert child["trace_id"] == ctx.trace_id
+    assert child["parent_span_id"] == ctx.span_id
+    assert child["query_id"] == 9
+    # the span re-parented the context for its body
+    assert inner_ctx.span_id == child["span_id"]
+    grand = by_name["grandchild"].args
+    assert grand["parent_span_id"] == child["span_id"]
+    assert grand["trace_id"] == ctx.trace_id
+
+
+def test_tracer_drain_is_window_scoped():
+    tracer = Tracer(capacity=4, enabled=True, process_name="w")
+    with pytest.warns(RuntimeWarning, match="ring buffer wrapped"):
+        for i in range(10):
+            tracer.instant(f"e{i}", "task")
+    first = tracer.drain()
+    assert first["otherData"]["dropped_events"] == 6
+    assert len(first["traceEvents"]) == 4
+    epoch = first["otherData"]["epoch_unix"]
+
+    # the drain reset the window: ring empty, drop count rebased,
+    # but the clock anchor is NOT reset (merge alignment depends on it)
+    tracer.instant("fresh", "task")
+    second = tracer.drain()
+    assert second["otherData"]["dropped_events"] == 0
+    assert [e["name"] for e in second["traceEvents"]] == ["fresh"]
+    assert second["otherData"]["epoch_unix"] == epoch
+
+
+# ---------------------------------------------------------------------------
+# merged timeline: clock alignment, determinism, drop flagging
+# ---------------------------------------------------------------------------
+def _two_process_traces():
+    tid = "deadbeefcafe0042"
+    d_root = 1
+    w_task = (77 << 40) | 1
+    driver = _proc_trace("driver", "driver", 1000.0, 0.0, [
+        _ev("query", "query", 0.0, 1000.0, span_id=d_root, trace_id=tid),
+    ])
+    # worker's clock runs 0.0002s AHEAD of the driver's; its tracer was
+    # born 0.0004s (of its own wall time) after the driver's
+    worker = _proc_trace("worker-0", "worker-0", 1000.0004, 0.0002, [
+        _ev("task", "task", 300.0, 400.0, span_id=w_task, parent=d_root,
+            trace_id=tid),
+    ])
+    return driver, worker, tid
+
+
+def test_merge_aligns_worker_clock_skew():
+    driver, worker, _ = _two_process_traces()
+    merged = merge_process_traces([driver, worker])
+    by_name = {e["name"]: e for e in merged["traceEvents"]
+               if e.get("ph") == "X"}
+    # worker wall anchor 1000.0004 minus the 0.0002 offset estimate puts
+    # its epoch 200us after the driver's -> ts 300 lands at 500
+    assert by_name["query"]["ts"] == 0.0
+    assert by_name["task"]["ts"] == 500.0
+    # deterministic pids: driver first
+    assert by_name["query"]["pid"] == 1
+    assert by_name["task"]["pid"] == 2
+    procs = merged["otherData"]["processes"]
+    assert [p["role"] for p in procs] == ["driver", "worker-0"]
+    assert merged["otherData"]["reference_epoch_unix"] == 1000.0
+    assert merged["otherData"]["clock_aligned"] is True
+
+
+def test_merge_is_deterministic_under_input_order():
+    driver, worker, _ = _two_process_traces()
+    a = merge_process_traces([copy.deepcopy(driver), copy.deepcopy(worker)])
+    b = merge_process_traces([copy.deepcopy(worker), copy.deepcopy(driver)])
+    assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+
+
+def test_merge_flags_dropped_events():
+    driver, worker, _ = _two_process_traces()
+    worker["otherData"]["dropped_events"] = 3
+    merged = merge_process_traces([driver, worker])
+    trunc = [e for e in merged["traceEvents"]
+             if e["name"] == "trace_truncated"]
+    assert len(trunc) == 1
+    assert trunc[0]["ph"] == "i"
+    assert trunc[0]["pid"] == 2
+    # flagged at the front of the worker's row
+    assert trunc[0]["ts"] == 500.0
+    assert trunc[0]["args"]["dropped_events"] == 3
+    assert merged["otherData"]["truncated_processes"] == ["worker-0"]
+    procs = {p["process_name"]: p for p in merged["otherData"]["processes"]}
+    assert procs["worker-0"]["truncated"] is True
+    assert procs["driver"]["truncated"] is False
+
+
+def test_merge_trace_id_filter_drops_silent_processes():
+    driver, worker, tid = _two_process_traces()
+    other = _proc_trace("worker-1", "worker-1", 1000.0, 0.0, [
+        _ev("task", "task", 10.0, 5.0, span_id=99,
+            trace_id="0000000000000099"),
+    ])
+    merged = merge_process_traces([driver, worker, other], trace_id=tid)
+    assert merged["otherData"]["trace_id_filter"] == tid
+    names = {p["process_name"] for p in merged["otherData"]["processes"]}
+    # worker-1 contributed nothing to this query: no row, no metadata
+    assert names == {"driver", "worker-0"}
+    assert all(e["args"].get("trace_id") == tid
+               for e in merged["traceEvents"] if e.get("ph") == "X")
+
+
+def test_query_trace_ids_lists_roots():
+    driver, worker, tid = _two_process_traces()
+    merged = merge_process_traces([driver, worker])
+    ids = query_trace_ids(merged["traceEvents"])
+    assert [t for t, _ in ids] == [tid]
+
+
+# ---------------------------------------------------------------------------
+# critical-path attribution on a hand-built span DAG
+# ---------------------------------------------------------------------------
+def test_critical_path_math_on_hand_built_dag():
+    """query(1000us) -> task(600us) -> {download 300us, shuffle 200us};
+    plus compile 100us directly under the query. Self-times: query 300,
+    task 100, download 300 (sync_wait), shuffle 200, compile 100."""
+    tid = "00000000000000aa"
+    events = [
+        _ev("query", "query", 0.0, 1000.0, span_id=1, trace_id=tid),
+        _ev("task", "task", 100.0, 600.0, span_id=2, parent=1,
+            trace_id=tid),
+        _ev("device_sync", "download", 200.0, 300.0, span_id=3, parent=2,
+            trace_id=tid),
+        _ev("shuffle_fetch", "shuffle", 500.0, 200.0, span_id=4, parent=2,
+            trace_id=tid),
+        _ev("jit_compile", "compile", 800.0, 100.0, span_id=5, parent=1,
+            trace_id=tid),
+    ]
+    cp = critical_path(events, trace_id=tid)
+    assert cp.trace_id == tid
+    assert cp.total_s == pytest.approx(1000e-6)
+    assert cp.span_count == 5
+
+    cats = cp.categories
+    assert cats["sync_wait"] == pytest.approx(300e-6)
+    assert cats["shuffle_transfer"] == pytest.approx(200e-6)
+    assert cats["compile"] == pytest.approx(100e-6)
+    # query self 300us + task self 100us
+    assert cats["other"] == pytest.approx(400e-6)
+    # self-time attribution covers the root wall exactly
+    assert sum(cats.values()) == pytest.approx(cp.total_s)
+    assert cp.coverage == pytest.approx(1.0)
+    assert cp.sync_wait_frac == pytest.approx(0.3)
+
+    # the ranked chain follows the longest child at each level
+    assert [s["name"] for s in cp.ranked_path] == \
+        ["query", "task", "device_sync"]
+
+    d = cp.to_dict()
+    assert d["sync_wait_frac"] == pytest.approx(0.3)
+    assert d["coverage"] >= 0.95
+    assert set(d["fractions"]) == set(cats)
+    assert set(d["categories_s"]) == set(cats)
+
+    # the human rendering names the dominant categories
+    text = cp.render()
+    assert "sync_wait" in text and "device_sync" in text
+
+
+def test_critical_path_adopts_cross_process_orphans():
+    """A worker span whose parent id references a span that never made it
+    into the merged set (ring wrap) still attributes under the query
+    root instead of vanishing."""
+    tid = "00000000000000bb"
+    events = [
+        _ev("query", "query", 0.0, 100.0, span_id=1, trace_id=tid),
+        # parent 999 was dropped from the ring -> orphan, adopted by root
+        _ev("upload", "upload", 10.0, 40.0, span_id=2, parent=999,
+            trace_id=tid),
+    ]
+    cp = critical_path(events, trace_id=tid)
+    assert cp.categories["h2d_upload"] == pytest.approx(40e-6)
+    assert cp.coverage == pytest.approx(1.0)
+
+
+# ---------------------------------------------------------------------------
+# real cross-process round trip (spawn is the dominant cost; the dcn
+# tier test in test_process_cluster.py sets the non-slow precedent)
+# ---------------------------------------------------------------------------
+@pytest.fixture
+def _fresh_global_tracer():
+    """configure_tracer() is sticky on the process-wide tracer; swap in a
+    throwaway so enabling tracing here can't leak into other tests."""
+    from spark_rapids_tpu.utils.tracing import get_tracer, set_tracer
+    prev = get_tracer()
+    set_tracer(Tracer())
+    yield
+    set_tracer(prev)
+
+
+def test_trace_context_roundtrip_through_process_cluster(
+        _fresh_global_tracer):
+    from spark_rapids_tpu.parallel.runtime import (ProcessCluster,
+                                                   trace_probe_task)
+    from spark_rapids_tpu.utils.tracing import configure_tracer
+    from spark_rapids_tpu.conf import RapidsConf
+    conf = {"spark.rapids.tpu.trace.enabled": "true"}
+    configure_tracer(RapidsConf(conf))
+    with ProcessCluster(2, conf=conf) as cluster:
+        # the startup handshake estimated every worker's clock offset
+        assert set(cluster.clock_offsets) == {0, 1}
+        assert all(abs(off) < 5.0 for off in cluster.clock_offsets.values())
+
+        ctx = mint_trace_context(query_id=7)
+        with activate_trace_context(ctx):
+            wire = cluster.run_on(0, trace_probe_task)
+        # the worker saw OUR trace, under a worker-minted child span
+        assert wire is not None
+        assert wire["trace_id"] == ctx.trace_id
+        assert wire["query_id"] == 7
+        assert wire["span_id"] != ctx.span_id
+
+        # no active context -> the probe reports none (no stale leakage)
+        assert cluster.run_on(1, trace_probe_task) is None
+
+        traces = cluster.collect_traces(drain=True)
+        assert [t["otherData"]["role"] for t in traces] == \
+            ["driver", "worker-0", "worker-1"]
+        assert traces[0]["otherData"]["clock_offset_s"] == 0.0
+
+        merged = merge_process_traces(traces, trace_id=ctx.trace_id)
+        probes = [e for e in merged["traceEvents"]
+                  if e.get("name") == "trace_probe"]
+        assert len(probes) == 1
+        assert probes[0]["args"]["trace_id"] == ctx.trace_id
+        # the context the probe reported IS the probe span's identity
+        assert probes[0]["args"]["span_id"] == wire["span_id"]
+        # ...which parents under the worker's envelope "task" span,
+        # which itself parents under the driver's minted query context
+        tasks = [e for e in merged["traceEvents"]
+                 if e.get("ph") == "X" and e.get("name") == "task"]
+        assert probes[0]["args"]["parent_span_id"] in \
+            {t["args"]["span_id"] for t in tasks}
+        assert any(t["args"]["parent_span_id"] == ctx.span_id
+                   for t in tasks)
+
+
+# ---------------------------------------------------------------------------
+# metrics federation (driver aggregates worker registries)
+# ---------------------------------------------------------------------------
+def test_label_prometheus_text_injects_process_label():
+    from spark_rapids_tpu.tools.statusd import label_prometheus_text
+    src = ("# HELP srtpu_tasks tasks\n"
+           "# TYPE srtpu_tasks counter\n"
+           "srtpu_tasks 3\n"
+           'srtpu_spans{cat="shuffle"} 7\n')
+    out = label_prometheus_text(src, "worker-0")
+    assert 'srtpu_tasks{process="worker-0"} 3' in out
+    assert 'srtpu_spans{process="worker-0",cat="shuffle"} 7' in out
+    # comments pass through untouched
+    assert "# HELP srtpu_tasks tasks" in out
+
+
+def test_metrics_federation_scrape_degrades_per_peer():
+    from spark_rapids_tpu.tools.statusd import MetricsFederation
+    fed = MetricsFederation(local_name="driver")
+    fed.register_puller("worker-0", lambda: "srtpu_up 1\n")
+
+    def boom():
+        raise ConnectionError("peer gone")
+    fed.register_puller("worker-1", boom)
+
+    res = fed.scrape()
+    assert res["worker-0"]["ok"] is True
+    assert res["worker-1"]["ok"] is False
+    assert "peer gone" in res["worker-1"]["error"]
+
+    page = fed.prometheus_text()
+    assert 'srtpu_up{process="worker-0"} 1' in page
+    assert "# federated from worker-0" in page
+    assert "worker-1 FAILED" in page
+
+    fed.unregister("worker-1")
+    assert "worker-1" not in fed.peers()
