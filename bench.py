@@ -48,7 +48,12 @@ warm pool and records its second-run compile count — the zero-compiles
 trajectory metric, "restart" + per-phase "compile_cache" in the JSON),
 BENCH_TRACE (1|0: span tracer per timed phase — each query's res gains a
 "critical_path" category breakdown + "sync_wait_frac", the measured
-ROADMAP-item-1 trajectory number).
+ROADMAP-item-1 trajectory number), BENCH_MEMPROF (1|0, default on: the
+memory flight recorder per phase — each query's res gains
+"peak_hbm_bytes" + "spill_bytes" and the phase gains a "memory" summary
+with peak holders-by-operator / leak / postmortem counts in the bench
+JSON; tools/compare.py diffs the per-query numbers across rounds and
+gates >10% peak-HBM growth).
 """
 import atexit
 import json
@@ -78,6 +83,7 @@ _STATE = {
     "rows": None,
     "eventlog": {},   # phase -> event-log directory
     "health": {},     # phase -> /status snapshot + peak HBM watermark
+    "memory": {},     # phase -> memory flight-recorder summary
     "pipeline": os.environ.get("BENCH_PIPELINE", "on"),  # A/B knob
     "analyze": {},    # srtpu-analyze baseline summary (sync-site debt)
     "notes": [],
@@ -118,7 +124,7 @@ def _write_partial():
         json.dump({k: _STATE[k] for k in
                    ("backend", "fell_back", "sf", "rows", "smoke", "tpch",
                     "ablation", "restart", "compile_cache", "errors", "eventlog",
-                    "health", "pipeline", "analyze", "notes")}
+                    "health", "memory", "pipeline", "analyze", "notes")}
                   | {"elapsed_s": round(time.monotonic() - _T_START, 2)},
                   f, indent=1)
     os.replace(tmp, _PARTIAL_PATH)
@@ -312,6 +318,8 @@ def _consume(ev):
             _STATE["eventlog"].update(ev["eventlog"])
         if "health" in ev:
             _STATE["health"].update(ev["health"])
+        if "memory" in ev:
+            _STATE["memory"].update(ev["memory"])
     elif kind == "ablation":
         _STATE["ablation"][ev["name"]] = ev["res"]
     _write_partial()
@@ -656,6 +664,68 @@ def _emit_health_snapshot(sink: "_EventSink", phase: str, sess) -> None:
         _log(f"{phase}: health snapshot failed: {type(e).__name__}: {e}")
 
 
+def _memprof_conf() -> dict:
+    """BENCH_MEMPROF=1|0 -> memory flight recorder session conf (default
+    on; the recorder's engine default is also on, so =0 is the explicit
+    overhead-measurement off-switch)."""
+    return {"spark.rapids.tpu.memory.profile.enabled":
+            os.environ.get("BENCH_MEMPROF", "1") != "0"}
+
+
+def _mem_probe():
+    """Cumulative catalog memory counters (process-wide, monotonic) for
+    per-query deltas. None when profiling is off or the engine has no
+    catalog yet — memory probing must never fail the bench."""
+    if os.environ.get("BENCH_MEMPROF", "1") == "0":
+        return None
+    try:
+        from spark_rapids_tpu.memory.catalog import peek_catalog
+        cat = peek_catalog()
+        if cat is None:
+            return None
+        return {"peak": cat.peak_device_bytes,
+                "spilled": sum(cat.spilled_bytes.values())}
+    except Exception:
+        return None
+
+
+def _mem_res(before) -> dict:
+    """Per-query memory fields for the bench JSON: the process peak-HBM
+    watermark after this query and the bytes spilled while it ran.
+    tools/compare.py diffs these across rounds and fails its gate on
+    >10% peak growth."""
+    after = _mem_probe()
+    if after is None:
+        return {}
+    res = {"peak_hbm_bytes": after["peak"]}
+    if before is not None:
+        res["spill_bytes"] = after["spilled"] - before["spilled"]
+    return res
+
+
+def _emit_memory_snapshot(sink: "_EventSink", phase: str, sess) -> None:
+    """End-of-phase memory flight-recorder summary for the bench JSON:
+    peak watermark + holders-by-operator attribution, leak and
+    postmortem counts (never fails the bench)."""
+    if os.environ.get("BENCH_MEMPROF", "1") == "0":
+        return
+    try:
+        from spark_rapids_tpu.utils.memprof import active
+        mp = active()
+        if mp is None:
+            return
+        snap = mp.snapshot()
+        sink.emit(ev="meta", memory={phase: {
+            "peak_bytes": snap.get("peak_bytes", 0),
+            "peak_holders": snap.get("peak_holders", {}),
+            "leaks_detected": snap.get("leaks_detected", 0),
+            "postmortems": snap.get("postmortems", 0),
+            "external_bytes": snap.get("external_bytes", 0),
+            "events_recorded": snap.get("events_recorded", 0)}})
+    except Exception as e:
+        _log(f"{phase}: memory snapshot failed: {type(e).__name__}: {e}")
+
+
 def _rel_tol() -> float:
     """TPU computes float64 at f32 precision; loosen device-vs-host float
     comparisons there (the reference marks such queries approximate_float)."""
@@ -709,6 +779,7 @@ def _worker_smoke(sink: _EventSink):
                        **_compile_cache_conf(),
                        **_eventlog_conf("smoke", sink),
                        **_health_conf("smoke"),
+                       **_memprof_conf(),
                        **_trace_conf()})
     df = sess.create_dataframe(lineitem, num_partitions=1).cache()
     t = {"lineitem": df}
@@ -748,6 +819,7 @@ def _worker_smoke(sink: _EventSink):
             t0 = time.perf_counter()
             q.collect(device=True)
             warm = time.perf_counter() - t0
+            mb = _mem_probe()
             t0 = time.perf_counter()
             dev_res = q.collect(device=True)
             dev_t = time.perf_counter() - t0
@@ -765,6 +837,7 @@ def _worker_smoke(sink: _EventSink):
                 "dev_s": round(dev_t, 4), "cpu_s": round(cpu_t, 4),
                 "compile_s": round(warm, 2),
                 "speedup": cpu_t / max(dev_t, 1e-9),
+                **_mem_res(mb),
                 **({"critical_path": cp,
                     "sync_wait_frac": cp["sync_wait_frac"]}
                    if cp else {})})
@@ -777,6 +850,7 @@ def _worker_smoke(sink: _EventSink):
     from spark_rapids_tpu.utils.compile_cache import cache_stats
     sink.emit(ev="meta", compile_cache={"smoke": dict(cache_stats())})
     _emit_health_snapshot(sink, "smoke", sess)
+    _emit_memory_snapshot(sink, "smoke", sess)
     sess.close()  # flush the event log + persist the compile tier
     _write_diagnose_report("smoke")
 
@@ -821,6 +895,7 @@ def _worker_tpch(sink: _EventSink):
         **_compile_cache_conf(),
         **_eventlog_conf("tpch", sink),
         **_health_conf("tpch"),
+        **_memprof_conf(),
         **_trace_conf(),
     })
     dfs = tpch.build_dataframes(sess, tables, num_partitions=nparts)
@@ -837,6 +912,7 @@ def _worker_tpch(sink: _EventSink):
             t0 = time.perf_counter()
             dev_tbl = q.collect(device=True)
             warm = time.perf_counter() - t0
+            mb = _mem_probe()
             t0 = time.perf_counter()
             dev_tbl = q.collect(device=True)
             dev_t = time.perf_counter() - t0
@@ -854,6 +930,7 @@ def _worker_tpch(sink: _EventSink):
                     "dev_s": round(dev_t, 4), "cpu_s": round(cpu_t, 4),
                     "compile_s": round(warm, 2),
                     "speedup": cpu_t / max(dev_t, 1e-9),
+                    **_mem_res(mb),
                     **({"critical_path": cp,
                         "sync_wait_frac": cp["sync_wait_frac"]}
                        if cp else {})})
@@ -865,6 +942,7 @@ def _worker_tpch(sink: _EventSink):
             _log(f"{name} FAILED: {e}")
     sink.emit(ev="meta", compile_cache={"tpch": dict(cache_stats())})
     _emit_health_snapshot(sink, "tpch", sess)
+    _emit_memory_snapshot(sink, "tpch", sess)
     sess.close()  # flush the event log + persist the compile tier
     _write_diagnose_report("tpch")
 
@@ -935,6 +1013,7 @@ def _worker_restart(sink: _EventSink):
                        **_compile_cache_conf(),
                        **_eventlog_conf("restart", sink),
                        **_health_conf("restart"),
+                       **_memprof_conf(),
                        **_trace_conf()})
     warmed = warm_pool_wait()
     df = sess.create_dataframe(lineitem, num_partitions=1).cache()
@@ -945,6 +1024,7 @@ def _worker_restart(sink: _EventSink):
         sink.emit(ev="start", name=name)
         try:
             before = cache_stats()
+            mb = _mem_probe()
             q = getattr(tpch, name)(t)
             t0 = time.perf_counter()
             q.collect(device=True)
@@ -952,6 +1032,7 @@ def _worker_restart(sink: _EventSink):
             after = cache_stats()
             cp = _bench_critical_path()
             res = {"run_s": round(run_s, 4),
+                   **_mem_res(mb),
                    "compiles": after["compiles"] - before["compiles"],
                    "persist_hits": after["persist_hits"]
                    - before["persist_hits"],
@@ -969,6 +1050,7 @@ def _worker_restart(sink: _EventSink):
             _log(f"restart {name} FAILED: {e}")
     sink.emit(ev="meta", compile_cache={"restart": dict(cache_stats())})
     _emit_health_snapshot(sink, "restart", sess)
+    _emit_memory_snapshot(sink, "restart", sess)
     sess.close()
     _write_diagnose_report("restart")
 

@@ -182,8 +182,6 @@ class TpuShuffleExchangeExec(TpuExec):
 
         Only this method sits inside the op timer — child batch
         production accounts its own opTime upstream."""
-        import weakref
-
         from ..memory.catalog import SpillPriorities, get_catalog
         from ..shuffle.ici import ici_all_to_all_exchange, shard_table
         from ..shuffle.manager import device_partition_ids
@@ -218,9 +216,9 @@ class TpuShuffleExchangeExec(TpuExec):
                 exchanged = ici_all_to_all_exchange(
                     sharded, keys, self.mesh, self.axis, quota=quota)
                 # register output shards so the catalog accounts for them
-                # and can spill them until downstream consumption;
-                # finalizer releases the entries when the plan is
-                # garbage-collected
+                # and can spill them until downstream consumption; the
+                # entries release at query end (release_spill_handles),
+                # with a GC finalizer fallback
                 parts = _split_sharded(exchanged, n)
                 # ONE bulk D2H of n 4-byte scalars replaces a blocking
                 # round trip per shard plus one more for the row total
@@ -231,18 +229,11 @@ class TpuShuffleExchangeExec(TpuExec):
                         continue
                     h = catalog.register(
                         t, SpillPriorities.OUTPUT_FOR_SHUFFLE)
-                    weakref.finalize(self, _close_quietly, h)
+                    self._own_spill_handle(h)
                     shards[i].append(h)
                 return int(sum(shard_rows))
             finally:
                 inflight.close()
-
-
-def _close_quietly(handle):
-    try:
-        handle.close()
-    except Exception:
-        pass
 
 
 class TpuLocalExchangeExec(TpuExec):
@@ -291,8 +282,6 @@ class TpuLocalExchangeExec(TpuExec):
                 self._materialize_locked()
 
     def _materialize_locked(self) -> None:
-        import weakref
-
         from ..memory.catalog import SpillPriorities, get_catalog
         from ..parallel.pipeline import parallel_map
         catalog = get_catalog()
@@ -316,7 +305,7 @@ class TpuLocalExchangeExec(TpuExec):
                     self.metrics.add(M.SHUFFLE_BYTES, shrunk.nbytes())
                     h = catalog.register(
                         shrunk, SpillPriorities.OUTPUT_FOR_SHUFFLE)
-                weakref.finalize(self, _close_quietly, h)
+                self._own_spill_handle(h)
                 out.append((h, n))
             return out
 

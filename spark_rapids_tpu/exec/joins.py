@@ -904,8 +904,6 @@ class TpuShuffledHashJoinExec(TpuExec):
         return pt.columns[0].data, pt.columns[1].data[:cap], unique
 
     def _register_prep_hash(self, slot_row, bv, unique):
-        import weakref
-
         from ..columnar.device import canonical_names
         from ..memory.catalog import SpillPriorities, get_catalog
         T = slot_row.shape[0]
@@ -916,7 +914,7 @@ class TpuShuffledHashJoinExec(TpuExec):
         t = DeviceTable(cols, ones, jnp.asarray(T, jnp.int32),
                         canonical_names(2))
         h = get_catalog().register(t, SpillPriorities.ACTIVE_ON_DECK)
-        weakref.finalize(self, _close_quietly, h)
+        self._own_spill_handle(h)
         return (h, bool(np.asarray(unique)))
 
     def _get_prep(self, build: DeviceTable):
@@ -952,8 +950,6 @@ class TpuShuffledHashJoinExec(TpuExec):
         BufferCatalog so memory pressure can evict them like any other
         device buffer; the uniqueness flag syncs to a host bool here (one
         tiny transfer per build table)."""
-        import weakref
-
         from ..columnar.device import canonical_names
         from ..memory.catalog import SpillPriorities, get_catalog
         b_order, sv, nvalid, unique = pr
@@ -964,7 +960,7 @@ class TpuShuffledHashJoinExec(TpuExec):
         t = DeviceTable(cols, ones, jnp.asarray(cap, jnp.int32),
                         canonical_names(2))
         h = get_catalog().register(t, SpillPriorities.ACTIVE_ON_DECK)
-        weakref.finalize(self, _close_quietly, h)
+        self._own_spill_handle(h)
         return (h, nvalid, bool(np.asarray(unique)))
 
     def _probe_join(self, build_handle, probe_batches, seen_box=None
@@ -1207,8 +1203,9 @@ class TpuBroadcastHashJoinExec(TpuShuffledHashJoinExec):
     def _broadcast_handle(self):
         """Broadcast batch registered once with the BufferCatalog at
         BROADCAST priority — accounted and spillable rather than pinned to
-        the exec node for the plan's lifetime. A finalizer releases the
-        catalog entry when the plan is garbage-collected. The lock keeps
+        the exec node for the plan's lifetime. The catalog entry releases
+        at query end (release_spill_handles), with a GC-time finalizer
+        fallback for plans never explicitly released. The lock keeps
         concurrent (pipelined) probe partitions from double-building.
         Never block on the semaphore while holding it
         (pipeline.exempt_admission invariant)."""
@@ -1219,7 +1216,6 @@ class TpuBroadcastHashJoinExec(TpuShuffledHashJoinExec):
 
     def _broadcast_handle_locked(self):
         if self._bc_handle is None:
-            import weakref
             from ..memory.catalog import SpillPriorities, get_catalog
             batches = []
             for p in range(self.right.num_partitions):
@@ -1233,7 +1229,7 @@ class TpuBroadcastHashJoinExec(TpuShuffledHashJoinExec):
                     if len(batches) > 1 else batches[0]
             self._bc_handle = get_catalog().register(
                 table, SpillPriorities.BROADCAST)
-            weakref.finalize(self, _close_quietly, self._bc_handle)
+            self._own_spill_handle(self._bc_handle)
         return self._bc_handle
 
     def _build_table(self, pidx: int) -> DeviceTable:
@@ -1246,14 +1242,12 @@ class TpuBroadcastHashJoinExec(TpuShuffledHashJoinExec):
         """Split the broadcast once; reuse the parts for every partition."""
         with self._bc_lock:
             if self._bc_grace_parts is None:
-                import weakref
-
                 from ..parallel.pipeline import exempt_admission
                 with exempt_admission():
                     parts, _ = super()._grace_build_parts(build, n_sub)
                 self._bc_grace_parts = parts
                 for h in parts:
-                    weakref.finalize(self, _close_quietly, h)
+                    self._own_spill_handle(h)
             return self._bc_grace_parts, False
 
 
@@ -1309,7 +1303,6 @@ class TpuBroadcastNestedLoopJoinExec(TpuExec):
 
     def _broadcast_handle(self):
         if self._bc_handle is None:
-            import weakref
             from ..memory.catalog import SpillPriorities, get_catalog
             batches = []
             for p in range(self.right.num_partitions):
@@ -1324,7 +1317,7 @@ class TpuBroadcastNestedLoopJoinExec(TpuExec):
             table = shrink_to_fit(table, self.min_bucket)
             self._bc_handle = get_catalog().register(
                 table, SpillPriorities.BROADCAST)
-            weakref.finalize(self, _close_quietly, self._bc_handle)
+            self._own_spill_handle(self._bc_handle)
         return self._bc_handle
 
     # -- assembly & padding (stream side plays the probe role) ---------------

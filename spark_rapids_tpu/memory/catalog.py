@@ -21,6 +21,7 @@ import jax
 from .. import native
 from ..columnar.device import DeviceTable
 from ..conf import RapidsConf, register_conf
+from ..utils.memprof import active as _memprof
 from .stores import (DeviceStore, DiskStore, HostStore, StorageTier,
                      StoredTable, _host_arrays_to_table)
 
@@ -150,16 +151,26 @@ class BufferCatalog:
                 self.synchronous_spill(
                     nbytes - (self.device.limit_bytes - self.device.used_bytes))
             if self._pool_mode == "strict" and not self.device.fits(nbytes):
-                raise MemoryError(
-                    f"strict pool mode: {nbytes} bytes cannot fit "
-                    f"(used={self.device.used_bytes}, "
-                    f"limit={self.device.limit_bytes})")
+                msg = (f"strict pool mode: {nbytes} bytes cannot fit "
+                       f"(used={self.device.used_bytes}, "
+                       f"limit={self.device.limit_bytes})")
+                mp = _memprof()
+                if mp is not None:
+                    # attributed dump BEFORE the exception propagates
+                    # (reference: oomDumpDir state dumps)
+                    mp.oom_postmortem(f"allocation failure: {msg}",
+                                      catalog=self)
+                raise MemoryError(msg)
             bid = next(self._ids)
             stored = StoredTable(bid, table, priority, nbytes)
             self._buffers[bid] = stored
             self.device.used_bytes += nbytes
             self._note_peak_locked()
             self._pq_handles[bid] = self._spill_pq.push(priority, bid)
+            mp = _memprof()
+            if mp is not None:
+                mp.record("register", bid, nbytes, tier="DEVICE",
+                          ext_bytes=sum(self._external_cache.values()))
             if self._debug:
                 import traceback
                 frame = traceback.extract_stack(limit=4)[0]
@@ -185,6 +196,12 @@ class BufferCatalog:
                         self._pq_handles.pop(bid, None)
                         continue
                     if stored.refcount > 0:
+                        # pop the handle too: the entry left the queue, so
+                        # a map entry pointing at the popped handle is
+                        # stale — a later remove() on it would corrupt the
+                        # pq once handles recycle. The finally block
+                        # re-pushes under a fresh handle.
+                        self._pq_handles.pop(bid, None)
                         pinned.append((priority, bid))
                         continue
                     self._pq_handles.pop(bid, None)
@@ -224,6 +241,11 @@ class BufferCatalog:
             self.device.used_bytes -= stored.size_bytes
             self.spill_count[StorageTier.HOST] += 1
             self.spilled_bytes[StorageTier.HOST] += stored.size_bytes
+            mp = _memprof()
+            if mp is not None:
+                mp.record("spill", stored.buffer_id, stored.size_bytes,
+                          tier="HOST",
+                          ext_bytes=sum(self._external_cache.values()))
             if self._debug and stored.host_arrays is not None:
                 # jax-backed views are read-only; debug mode owns writable
                 # copies so close can poison them (use-after-free detection)
@@ -240,6 +262,11 @@ class BufferCatalog:
             self.device.used_bytes -= stored.size_bytes
             self.spill_count[StorageTier.DISK] += 1
             self.spilled_bytes[StorageTier.DISK] += stored.size_bytes
+            mp = _memprof()
+            if mp is not None:
+                mp.record("spill", stored.buffer_id, stored.size_bytes,
+                          tier="DISK",
+                          ext_bytes=sum(self._external_cache.values()))
 
     def _spill_host_to_disk(self, need_bytes: int):
         victims = sorted((s for s in self._buffers.values()
@@ -273,17 +300,35 @@ class BufferCatalog:
                 self.disk.drop(stored)
                 stored.tier = StorageTier.HOST
                 self.host.used_bytes += stored.size_bytes
+                mp = _memprof()
+                if mp is not None:
+                    mp.record("disk_load", buffer_id, stored.size_bytes,
+                              tier="HOST")
             if stored.tier == StorageTier.HOST:
                 if not self.device.fits(stored.size_bytes) and self._oom_spill:
                     self.synchronous_spill(stored.size_bytes)
-                table = _host_arrays_to_table(stored.host_arrays, stored.meta)
+                from ..utils.tracing import get_tracer
+                # cat="memory": restore time is memory pressure the
+                # critical path should see (tools/trace.py
+                # memory_pressure bucket), unlike the spill span above
+                with get_tracer().span("restore", "memory",
+                                       bytes=stored.size_bytes,
+                                       buffer=buffer_id):
+                    table = _host_arrays_to_table(stored.host_arrays,
+                                                  stored.meta)
                 self.host.drop(stored)
                 stored.device_table = table
                 stored.tier = StorageTier.DEVICE
                 self.device.used_bytes += stored.size_bytes
                 self._note_peak_locked()
-                self._pq_handles[stored.buffer_id] = \
-                    self._spill_pq.push(stored.priority, stored.buffer_id)
+                if buffer_id not in self._pq_handles:
+                    self._pq_handles[buffer_id] = \
+                        self._spill_pq.push(stored.priority, buffer_id)
+                mp = _memprof()
+                if mp is not None:
+                    mp.record("restore", buffer_id, stored.size_bytes,
+                              tier="DEVICE",
+                              ext_bytes=sum(self._external_cache.values()))
             return stored.device_table
 
     def release(self, buffer_id: int):
@@ -323,12 +368,18 @@ class BufferCatalog:
             handle = self._pq_handles.pop(buffer_id, None)
             if handle is not None:
                 self._spill_pq.remove(handle)
+            tier_name = StorageTier.NAMES[stored.tier]
             if stored.tier == StorageTier.DEVICE:
                 self.device.used_bytes -= stored.size_bytes
             elif stored.tier == StorageTier.HOST:
                 self.host.drop(stored)
             else:
                 self.disk.drop(stored)
+            mp = _memprof()
+            if mp is not None:
+                mp.record("free", buffer_id, stored.size_bytes,
+                          tier=tier_name,
+                          ext_bytes=sum(self._external_cache.values()))
             if self._debug:
                 self._check_invariants()
 
@@ -389,6 +440,10 @@ class BufferCatalog:
             except Exception:
                 self._external_cache[name] = 0
             self._note_peak_locked()
+            mp = _memprof()
+            if mp is not None:
+                mp.record("external", -1, self._external_cache[name],
+                          ext_bytes=sum(self._external_cache.values()))
 
     def _refresh_external_locked(self) -> Dict[str, int]:
         for name, fn in self._external_bytes.items():
@@ -422,6 +477,12 @@ class BufferCatalog:
         with self._lock:
             self._refresh_external_locked()
             self._note_peak_locked()
+            mp = _memprof()
+            if mp is not None:
+                # keep the flight recorder's external total (and thus peak
+                # attribution) in step with _note_peak_locked
+                mp.record("external", -1, 0,
+                          ext_bytes=sum(self._external_cache.values()))
 
     def handle_device_oom(self, context: str = "") -> int:
         """Runtime-OOM callback (reference: DeviceMemoryEventHandler.scala:33
@@ -453,8 +514,20 @@ class BufferCatalog:
                 warnings.warn(msg, RuntimeWarning)
         with self._lock:
             target = self.device.used_bytes
-        freed = self.synchronous_spill(max(target, 1))
+        # cat="memory": OOM-recovery spilling is memory-pressure time on
+        # the query's critical path (tools/trace.py)
+        with get_tracer().span("oom_recovery", "memory",
+                               context=context[:200]):
+            freed = self.synchronous_spill(max(target, 1))
         self.oom_events += 1
+        if freed + cb_freed == 0:
+            # nothing left to spill or drop: the caller's retry will fail
+            # and raise — dump the attributed postmortem first
+            mp = _memprof()
+            if mp is not None:
+                mp.oom_postmortem(
+                    f"device OOM with nothing left to spill: {context}"
+                    [:500], catalog=self)
         return freed + cb_freed
 
     def oom_dump(self) -> str:
@@ -473,6 +546,12 @@ class BufferCatalog:
             notes = list(self.diagnostics)
         report = ("device OOM after spill retry; catalog state: "
                   f"{s}\nlargest buffers:\n" + "\n".join(rows))
+        mp = _memprof()
+        if mp is not None:
+            holders = mp.holders_by_operator()[:10]
+            if holders:
+                report += ("\nholders by operator (live device bytes):\n"
+                           + "\n".join(f"  {k}={v}" for k, v in holders))
         if ext:
             report += "\nexternal device bytes: " + ", ".join(
                 f"{k}={v}" for k, v in sorted(ext.items()))
@@ -580,9 +659,16 @@ class SpillableDeviceTable:
         self.buffer_id = buffer_id
 
     def get(self) -> DeviceTable:
-        """Acquire the table on device (restoring from lower tiers)."""
-        table = self.catalog.acquire(self.buffer_id)
-        self.catalog.release(self.buffer_id)
+        """Acquire the table on device (restoring from lower tiers).
+
+        The acquire/release pair runs under ONE catalog-lock hold: as two
+        separate acquisitions, a spill pass could interleave between them
+        and race the restore's tier flip, double-counting the buffer's
+        bytes in the device store (regression test:
+        tests/test_memprof.py two-thread spill-vs-get stress)."""
+        with self.catalog._lock:
+            table = self.catalog.acquire(self.buffer_id)
+            self.catalog.release(self.buffer_id)
         return table
 
     def __enter__(self) -> DeviceTable:

@@ -286,7 +286,7 @@ def render_analyzed_plan(nodes, total_s: float, kernels=None) -> str:
                  M.DOWNLOAD_BYTES, M.SHUFFLE_BYTES,
                  M.SHUFFLE_PARTITION_TIME, M.COMPILE_TIME,
                  M.COMPILE_CACHE_HITS, M.COMPILE_CACHE_MISSES,
-                 M.SPILL_BYTES]
+                 M.SPILL_BYTES, M.PEAK_DEVICE_MEMORY]
         seen = set()
         for key in order:
             if key in metrics:

@@ -46,6 +46,13 @@ PLAN_EXPR_ATTRS = ("exprs", "condition", "projections", "orders",
                    "window_cols", "aggregates")
 
 
+def _close_handle_quietly(handle):
+    try:
+        handle.close()
+    except Exception:
+        pass
+
+
 class PhysicalPlan:
     children: Tuple["PhysicalPlan", ...] = ()
     schema: Schema
@@ -53,6 +60,48 @@ class PhysicalPlan:
     @property
     def num_partitions(self) -> int:
         return self.children[0].num_partitions if self.children else 1
+
+    def _own_spill_handle(self, handle) -> None:
+        """Track a catalog spill handle this node registered on behalf of
+        its output (shuffle partitions, broadcast builds). The handle is
+        closed deterministically by ``release_spill_handles()`` when the
+        owning query's collect finishes — relying on plan GC alone leaks:
+        compile-cache entries capture plan nodes in kernel closures, so a
+        finished plan can stay reachable indefinitely while its buffers
+        hold HBM (found by the memory flight recorder's leak gate). The
+        GC-time finalizer stays as a fallback for plans that never go
+        through an explicit release (to_device_batches / to_jax); a
+        finalizer runs at most once, so the two paths cannot double-close.
+        """
+        import weakref
+        fins = self.__dict__.setdefault("_spill_finalizers", [])
+        fins.append(weakref.finalize(self, _close_handle_quietly, handle))
+
+    def release_spill_handles(self) -> int:
+        """Close every spill handle owned by this (finished) plan tree.
+
+        Walks ``children`` plus the wrapper edges the tree hides from it
+        (AQE stage/reader nodes keep ``children = ()`` and reference the
+        materialized subtree via ``inner``/``stage``/``_final``). Safe to
+        call more than once. Returns the number of handles closed."""
+        closed = 0
+        seen = set()
+        stack: List[PhysicalPlan] = [self]
+        while stack:
+            node = stack.pop()
+            if id(node) in seen:
+                continue
+            seen.add(id(node))
+            for fin in node.__dict__.get("_spill_finalizers", ()):
+                if fin.alive:
+                    fin()
+                    closed += 1
+            stack.extend(getattr(node, "children", ()))
+            for attr in ("inner", "stage", "_final", "child"):
+                v = getattr(node, attr, None)
+                if isinstance(v, PhysicalPlan):
+                    stack.append(v)
+        return closed
 
     def execute(self, pidx: int) -> Iterator[HostTable]:
         raise NotImplementedError(type(self).__name__)

@@ -66,6 +66,11 @@ class TpuSession:
         # (gather all-valid guard; columnar/device.py)
         from .columnar.device import configure_debug
         configure_debug(self.conf)
+        # memory flight recorder (spark.rapids.tpu.memory.profile.*):
+        # buffer-lifecycle attribution, leak scans and OOM postmortems
+        # (utils/memprof.py; the catalog emits into it)
+        from .utils.memprof import configure_memprof
+        configure_memprof(self.conf)
         # live health subsystem: watchdog monitor thread + optional HTTP
         # status endpoints (utils/health.py + tools/statusd.py); None when
         # health.enabled is false and health.port < 0 (the default)
@@ -537,9 +542,17 @@ class DataFrame:
             return pipelined_collect(plan, self.session.conf)
 
         logger = self.session._event_logger()
-        if logger is not None:
-            return logger.run_query(plan, run).to_arrow()
-        return run().to_arrow()
+        try:
+            if logger is not None:
+                return logger.run_query(plan, run).to_arrow()
+            return run().to_arrow()
+        finally:
+            # the plan is single-use (re-planned per collect): close its
+            # spill-registered outputs now instead of waiting on GC — the
+            # compile cache can pin plan nodes in kernel closures, which
+            # would hold shuffle/broadcast HBM across queries (flagged by
+            # the memory flight recorder's leak gate)
+            plan.release_spill_handles()
 
     def to_pandas(self, device: Optional[bool] = None):
         return self.collect(device).to_pandas()

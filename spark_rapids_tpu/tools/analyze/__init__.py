@@ -27,6 +27,11 @@ Checkers (see the sibling modules):
 - ``trace``  — tracer spans opened without a closing ``with`` scope;
                ProcessCluster task-queue submissions bypassing the
                ``_submit`` trace-context injection chokepoint.
+- ``memtrack`` — ``DeviceTable.from_host`` uploads in hot packages whose
+               enclosing function never reaches
+               ``BufferCatalog.register`` — HBM invisible to spill,
+               watermark attribution, and OOM postmortems
+               (utils/memprof.py).
 
 Workflow: findings are compared against a COMMITTED baseline
 (``tools/analyze/baseline.json``) so pre-existing debt is inventoried
@@ -301,13 +306,14 @@ def load_project(paths: Sequence[str]) -> Project:
 
 
 def _checkers() -> Dict[str, object]:
-    from . import buckets, host_sync, jit_purity, locks, threads, trace_ctx
+    from . import (buckets, host_sync, jit_purity, locks, memtrack, threads,
+                   trace_ctx)
     return {"sync": host_sync, "lock": locks,
             "thread": threads, "jit": jit_purity, "bucket": buckets,
-            "trace": trace_ctx}
+            "trace": trace_ctx, "memtrack": memtrack}
 
 
-CHECKS = ("sync", "lock", "thread", "jit", "bucket", "trace")
+CHECKS = ("sync", "lock", "thread", "jit", "bucket", "trace", "memtrack")
 
 
 def analyze_paths(paths: Sequence[str],

@@ -1,0 +1,92 @@
+"""memtrack checker: device uploads that never reach the buffer catalog.
+
+The memory flight recorder (utils/memprof.py) can only attribute HBM it
+sees — a ``DeviceTable`` uploaded with ``from_host`` but never handed to
+``BufferCatalog.register`` is invisible to the spill framework, the
+per-operator watermark attribution, AND the OOM postmortem: it holds
+real device bytes that ``synchronous_spill`` cannot evict and
+``holders_by_operator`` cannot name. This checker inventories those
+sites statically:
+
+- ``memtrack-unregistered-upload`` — ``DeviceTable.from_host(...)`` in a
+  hot/warm scope whose enclosing function never reaches the catalog
+  (no ``*.register(...)`` call and no ``SpillableDeviceTable``
+  construction in the same or an enclosing function scope).
+
+Plain ``DeviceTable(cols, mask, ...)`` construction is deliberately NOT
+flagged: those are derived views recombining columns of tables that are
+already device-resident (usually inside jit-traced operator bodies) —
+they pin no *new* HBM beyond their inputs. ``from_host`` is the call
+that moves fresh bytes onto the device, so it is the one that must be
+accounted.
+
+A helper that uploads and returns the table for its CALLER to register
+is a legitimate shape the AST cannot follow across the call; such sites
+carry ``# srtpu: memtrack-ok(<reason>)`` (same suppression grammar as
+the sync checker) and pre-existing debt is seeded into the committed
+baseline like every other check.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from . import Finding, Project, ScopedVisitor
+
+__all__ = ["check"]
+
+#: severities the memtrack checker reports on (cold packages — tools,
+#: session setup, tests — upload outside the spill framework by design)
+REPORTED_SEVERITIES = ("hot", "warm")
+
+
+class _MemVisitor(ScopedVisitor):
+    """Collects, per enclosing-scope symbol, the ``from_host`` upload
+    sites and whether that scope reaches the catalog."""
+
+    def __init__(self, ctx):
+        super().__init__()
+        self.ctx = ctx
+        self.uploads: List[Tuple[str, ast.Call]] = []
+        self.registering: Set[str] = set()
+
+    def visit_Call(self, node: ast.Call) -> None:
+        q = self.ctx.qualify(node.func)
+        attr = node.func.attr if isinstance(node.func, ast.Attribute) \
+            else None
+        if attr == "from_host" and "DeviceTable" in q:
+            self.uploads.append((self.symbol, node))
+        # catalog accounting: register() on any receiver (the catalog is
+        # the only object in the engine exposing that method on tables),
+        # or wrapping in a SpillableDeviceTable handle directly
+        elif attr == "register" or q == "SpillableDeviceTable" \
+                or q.endswith(".SpillableDeviceTable"):
+            self.registering.add(self.symbol)
+        self.generic_visit(node)
+
+
+def _scope_registers(symbol: str, registering: Set[str]) -> bool:
+    """True when ``symbol`` or any enclosing function scope registers —
+    an upload inside a closure whose outer function registers the result
+    is accounted (the value flows out through the closure)."""
+    parts = symbol.split(".")
+    return any(".".join(parts[:i]) in registering
+               for i in range(1, len(parts) + 1))
+
+
+def check(project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    for ctx in project.modules:
+        if ctx.severity not in REPORTED_SEVERITIES:
+            continue
+        v = _MemVisitor(ctx)
+        v.visit(ctx.tree)
+        for symbol, node in v.uploads:
+            if _scope_registers(symbol, v.registering):
+                continue
+            out.append(ctx.finding(
+                "memtrack", "memtrack-unregistered-upload", node, symbol,
+                "DeviceTable.from_host upload never reaches "
+                "BufferCatalog.register — these HBM bytes are invisible "
+                "to spill, watermark attribution, and OOM postmortems"))
+    return out

@@ -20,7 +20,13 @@ result JSONs:
   (schema-v5 event logs / traced bench JSONs): a query whose sync-wait
   fraction grew by more than 5 percentage points flags even when its
   total wall time did NOT regress — the composition shifted toward the
-  ROADMAP-item-1 bottleneck and the next scale-up will pay for it.
+  ROADMAP-item-1 bottleneck and the next scale-up will pay for it;
+- per-query memory deltas when both runs carry the flight recorder's
+  numbers (schema-v6 ``memory_summary`` / bench ``peak_hbm_bytes``):
+  peak HBM and spilled bytes diff side by side, and a candidate whose
+  peak grew by more than ``MEM_PEAK_FLAG_FRAC`` (10%) flags a
+  peak-memory regression — also independent of wall time, since a run
+  can get faster by holding more HBM and pay later in spills/OOM.
 
 CLI: ``python -m spark_rapids_tpu.tools.compare A B [--threshold 0.2]``
 where A/B are event-log JSONL paths or bench summary JSONs.
@@ -34,11 +40,35 @@ from typing import Dict, List, Optional, Tuple
 __all__ = ["OpDelta", "QueryDelta", "CompareReport", "compare_event_logs",
            "compare_bench_results", "compare_apps",
            "critical_path_fractions", "critical_path_delta",
-           "CP_FRAC_FLAG_PP"]
+           "memory_delta", "CP_FRAC_FLAG_PP", "MEM_PEAK_FLAG_FRAC"]
 
 #: category-fraction growth (candidate minus baseline) that flags a
 #: critical-path regression: 5 percentage points
 CP_FRAC_FLAG_PP = 0.05
+
+#: relative peak-HBM growth (candidate over baseline) that flags a
+#: memory regression: 10%
+MEM_PEAK_FLAG_FRAC = 0.10
+
+
+def memory_delta(mem_a: Optional[Dict], mem_b: Optional[Dict],
+                 flag_frac: float = MEM_PEAK_FLAG_FRAC
+                 ) -> Tuple[Dict[str, float], List[str]]:
+    """(byte deltas B - A, flagged keys) from two per-query memory dicts
+    ({"peak_bytes", "spill_bytes"}, from a v6 event log's memory_summary
+    or a bench JSON's per-query fields). Empty when either run lacks the
+    numbers — profiling off must not flag. Peak HBM growing past
+    ``flag_frac`` flags "peak_bytes" (the >10%% peak-memory gate)."""
+    if not mem_a or not mem_b:
+        return {}, []
+    deltas = {k: float(mem_b.get(k) or 0) - float(mem_a.get(k) or 0)
+              for k in ("peak_bytes", "spill_bytes")}
+    flagged = []
+    peak_a = float(mem_a.get("peak_bytes") or 0)
+    peak_b = float(mem_b.get("peak_bytes") or 0)
+    if peak_a > 0 and peak_b > peak_a * (1.0 + flag_frac):
+        flagged.append("peak_bytes")
+    return deltas, flagged
 
 
 def critical_path_fractions(cp: Optional[Dict]) -> Optional[Dict]:
@@ -108,6 +138,14 @@ class QueryDelta:
     cp_deltas: Dict[str, float] = dataclasses.field(default_factory=dict)
     #: categories whose share of the query wall grew > CP_FRAC_FLAG_PP
     cp_flagged: List[str] = dataclasses.field(default_factory=list)
+    #: memory byte deltas (B - A): peak_bytes + spill_bytes, when both
+    #: runs carried the memory flight recorder's numbers
+    mem_deltas: Dict[str, float] = dataclasses.field(default_factory=dict)
+    #: ["peak_bytes"] when the candidate's peak HBM grew past
+    #: MEM_PEAK_FLAG_FRAC — the memory-regression gate
+    mem_flagged: List[str] = dataclasses.field(default_factory=list)
+    #: the baseline's absolute memory numbers (for % rendering)
+    mem_base: Dict[str, float] = dataclasses.field(default_factory=dict)
 
     @property
     def delta_s(self) -> float:
@@ -138,6 +176,12 @@ class CompareReport:
         share grew past the flag threshold) — orthogonal to wall-time
         regressions; a query can flag here while getting faster."""
         return [q for q in self.queries if q.cp_flagged]
+
+    def memory_regressions(self) -> List[QueryDelta]:
+        """Queries whose peak HBM grew past MEM_PEAK_FLAG_FRAC — also
+        orthogonal to wall time: a query can get faster by holding more
+        memory, and the next scale-up pays in spills/OOM."""
+        return [q for q in self.queries if q.mem_flagged]
 
     def summary(self) -> str:
         lines = [f"compare: A={self.label_a}  B={self.label_b}  "
@@ -174,6 +218,22 @@ class CompareReport:
                         "  ** CRITICAL-PATH REGRESSION: "
                         + ", ".join(f"{k} share +{q.cp_deltas[k]:.1%}"
                                     for k in q.cp_flagged))
+            if q.mem_deltas:
+                parts = []
+                for k in sorted(q.mem_deltas):
+                    v = q.mem_deltas[k]
+                    base = q.mem_base.get(k, 0.0)
+                    pct = f" ({v / base:+.1%})" if base > 0 else ""
+                    parts.append(f"{k}={v:+.0f}B{pct}")
+                lines.append("  memory deltas (B - A): " + ", ".join(parts))
+                if q.mem_flagged:
+                    lines.append(
+                        "  ** PEAK-MEMORY REGRESSION: "
+                        + ", ".join(
+                            f"{k} +{q.mem_deltas[k] / q.mem_base[k]:.1%}"
+                            if q.mem_base.get(k) else f"{k} grew"
+                            for k in q.mem_flagged)
+                        + f" (gate {MEM_PEAK_FLAG_FRAC:.0%})")
         if self.only_in_a:
             lines.append(f"queries only in A: {self.only_in_a}")
         if self.only_in_b:
@@ -182,7 +242,9 @@ class CompareReport:
         lines.append(f"{n_reg} regressed operator(s), "
                      f"{len(self.regressed_queries())} regressed query(ies), "
                      f"{len(self.critical_path_regressions())} "
-                     "critical-path regression(s)")
+                     "critical-path regression(s), "
+                     f"{len(self.memory_regressions())} "
+                     "peak-memory regression(s)")
         return "\n".join(lines)
 
 
@@ -195,6 +257,19 @@ def _op_key_counts(nodes: List[Dict]) -> List[Tuple[Tuple[str, int], Dict]]:
         seen[n["name"]] = idx + 1
         out.append(((n["name"], idx), n))
     return out
+
+
+def _query_memory(q) -> Optional[Dict]:
+    """Per-query memory numbers from a replay's v6 ``memory_summary``:
+    peak HBM bytes + total bytes its operators spilled. None pre-v6 or
+    with profiling off."""
+    ms = getattr(q, "memory_summary", None)
+    if not ms:
+        return None
+    per_op = ms.get("per_operator") or {}
+    return {"peak_bytes": int(ms.get("peak_bytes") or 0),
+            "spill_bytes": sum(int(d.get("spilled_bytes") or 0)
+                               for d in per_op.values())}
 
 
 def compare_apps(app_a, app_b, threshold: float = 0.2,
@@ -228,9 +303,14 @@ def compare_apps(app_a, app_b, threshold: float = 0.2,
         cp_deltas, cp_flagged = critical_path_delta(
             getattr(qa, "critical_path", None),
             getattr(qb, "critical_path", None))
+        mem_a, mem_b = _query_memory(qa), _query_memory(qb)
+        mem_deltas, mem_flagged = memory_delta(mem_a, mem_b)
         queries.append(QueryDelta(qid, qa.wall_s, qb.wall_s,
                                   q_regressed, ops, stats_delta,
-                                  cp_deltas, cp_flagged))
+                                  cp_deltas, cp_flagged,
+                                  mem_deltas, mem_flagged,
+                                  {k: float(v) for k, v in
+                                   (mem_a or {}).items()}))
     return CompareReport(app_a.app_id or app_a.path,
                          app_b.app_id or app_b.path, queries, threshold,
                          sorted(qids_a - qids_b), sorted(qids_b - qids_a))
@@ -243,6 +323,15 @@ def compare_event_logs(path_a: str, path_b: str, threshold: float = 0.2,
     from .eventlog import load_event_log
     return compare_apps(load_event_log(path_a), load_event_log(path_b),
                         threshold, min_seconds)
+
+
+def _bench_memory(entry: Dict) -> Optional[Dict]:
+    """Per-query memory numbers from a bench JSON entry (bench.py writes
+    peak_hbm_bytes + spill_bytes when BENCH_MEMPROF is on)."""
+    if "peak_hbm_bytes" not in entry:
+        return None
+    return {"peak_bytes": int(entry.get("peak_hbm_bytes") or 0),
+            "spill_bytes": int(entry.get("spill_bytes") or 0)}
 
 
 def compare_bench_results(path_a: str, path_b: str, threshold: float = 0.2,
@@ -282,11 +371,16 @@ def compare_bench_results(path_a: str, path_b: str, threshold: float = 0.2,
             cp_deltas, cp_flagged = critical_path_delta(
                 qs_a[name].get("critical_path"),
                 qs_b[name].get("critical_path"))
+            mem_a = _bench_memory(qs_a[name])
+            mem_b = _bench_memory(qs_b[name])
+            mem_deltas, mem_flagged = memory_delta(mem_a, mem_b)
             queries.append(QueryDelta(
                 label, wall_a, wall_b, regressed,
                 [OpDelta(label, name, 0, wall_a, wall_b, 0, 0,
                          regressed=regressed)], deltas,
-                cp_deltas, cp_flagged))
+                cp_deltas, cp_flagged,
+                mem_deltas, mem_flagged,
+                {k: float(v) for k, v in (mem_a or {}).items()}))
     return CompareReport(path_a, path_b, queries, threshold,
                          only_a, only_b)
 
@@ -349,7 +443,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                                     args.min_seconds)
     print(report.summary())
     return 1 if report.regressions() \
-        or report.critical_path_regressions() else 0
+        or report.critical_path_regressions() \
+        or report.memory_regressions() else 0
 
 
 if __name__ == "__main__":  # pragma: no cover

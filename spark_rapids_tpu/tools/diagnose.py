@@ -2,9 +2,12 @@
 
 Reference: the plugin's AutoTuner (tools/.../tuning/AutoTuner.scala) mines
 profiling output into concrete ``spark.rapids.*`` recommendations. Here the
-input is our own event log (tools/eventlog.py, schema v3): per-node wall
-times and metric snapshots, kernel records (XLA compile wall + cost
-analysis per plan signature), and per-query process-counter deltas. The
+input is our own event log (tools/eventlog.py, schema v3+): per-node
+wall times and metric snapshots, kernel records (XLA compile wall + cost
+analysis per plan signature), per-query process-counter deltas, and —
+on v6 logs — the memory flight recorder's per-query ``memory_summary``
+(leaked buffers, peak-HBM holders, spill churn) and any
+``oom_postmortem`` records. The
 output names, for every query, the top bottleneck (node, metric) pairs —
 "q1: 61% in ShuffleExchangeExec host serialization" — each with the conf
 knob that addresses it.
@@ -329,7 +332,94 @@ _CP_SUGGESTIONS = {
         "spill I/O on the path — raise "
         "spark.rapids.memory.gpu.allocFraction or lower "
         "spark.rapids.sql.batchSizeBytes"),
+    "memory_pressure": (
+        "spill-restore round-trips / OOM recovery on the path — the "
+        "memory flight recorder's per-operator holders (this query's "
+        "memory findings above, or /status \"memory\") name which "
+        "operator pins the HBM that forces them; raise "
+        "spark.rapids.memory.gpu.allocFraction or shrink that "
+        "operator's batches"),
 }
+
+
+def _memory_findings(q, wall: float) -> List[Finding]:
+    """v6 memory flight-recorder signals (utils/memprof.py): buffers
+    leaked past query end, the operators holding HBM at the query's peak
+    watermark, per-operator spill churn, and any OOM postmortems
+    recorded while the query ran."""
+    findings: List[Finding] = []
+    ms = getattr(q, "memory_summary", None) or {}
+
+    leaked = int(ms.get("leaked_bytes") or 0)
+    if leaked:
+        leaks = ms.get("leaked_buffers") or []
+        worst = leaks[0] if leaks else {}
+        findings.append(Finding(
+            node=worst.get("operator") or "(query)",
+            node_id=worst.get("node_id"),
+            metric="leakedBytes", seconds=0.0, fraction=_FRACTION_FLOOR,
+            detail=f"{len(leaks)} buffer(s) / {leaked} bytes still "
+                   f"registered at query end — retained HBM that the "
+                   f"next query pays for",
+            suggestion="a buffer outlived its query — close spillable "
+                       "handles (task_scope / SpillableDeviceTable) on "
+                       "the named operator; srtpu-analyze memtrack finds "
+                       "construction sites that never register"))
+
+    peak = int(ms.get("peak_bytes") or 0)
+    holders = ms.get("peak_holders") or {}
+    if peak and holders:
+        ranked = sorted(holders.items(), key=lambda kv: -kv[1])[:3]
+        top_op, top_bytes = ranked[0]
+        share = top_bytes / peak if peak else 0.0
+        if share >= 0.5:
+            detail = (f"held {share:.0%} of the query's peak HBM "
+                      f"watermark ({top_bytes} of {peak} bytes)")
+            if len(ranked) > 1:
+                detail += " — next: " + ", ".join(
+                    f"{op}={b}" for op, b in ranked[1:])
+            findings.append(Finding(
+                node=top_op, node_id=None, metric="peakHbmShare",
+                seconds=0.0, fraction=_FRACTION_FLOOR,
+                detail=detail,
+                suggestion="this operator sets the memory high-water "
+                           "mark — shrink its batches (spark.rapids.sql."
+                           "batchSizeBytes) or spill its output eagerly "
+                           "before it forces neighbours out"))
+
+    per_op = ms.get("per_operator") or {}
+    churn = sorted(((op, int(d.get("spilled_bytes") or 0))
+                    for op, d in per_op.items()
+                    if d.get("spilled_bytes")), key=lambda t: -t[1])
+    if churn:
+        total = sum(b for _, b in churn)
+        op, b = churn[0]
+        findings.append(Finding(
+            node=op, node_id=None, metric="spillChurn",
+            seconds=0.0, fraction=_FRACTION_FLOOR,
+            detail=f"spill churn: {b} of {total} bytes spilled this "
+                   f"query were this operator's buffers "
+                   f"({len(churn)} operator(s) spilled)",
+            suggestion="its buffers bounce between tiers — pin fewer of "
+                       "them (smaller batches) or raise spark.rapids."
+                       "memory.gpu.allocFraction so they stay resident"))
+
+    for pm in getattr(q, "oom_postmortems", []) or []:
+        # holders is a ranked {operator: bytes} mapping (insertion order
+        # = rank); the first key is the top holder at failure time
+        top_op = next(iter(pm.get("holders") or {}), None)
+        findings.append(Finding(
+            node=top_op or "(query)",
+            node_id=None, metric="oomPostmortem",
+            seconds=0.0, fraction=1.0,
+            detail=f"device OOM at {pm.get('live_bytes', 0)} live bytes "
+                   f"(peak {pm.get('peak_bytes', 0)}): "
+                   f"{pm.get('context', '')[:120]}",
+            suggestion=f"read the postmortem ({pm.get('path', '?')}) — "
+                       "it ranks holders by operator, spill-tier "
+                       "occupancy, and the last lifecycle events before "
+                       "the failure"))
+    return findings
 
 
 def _critical_path_findings(cp: Optional[Dict],
@@ -492,6 +582,10 @@ def _diagnose_query(q, heartbeats=None) -> Optional[QueryDiagnosis]:
     # the bottleneck, not merely a contributor
     cp = getattr(q, "critical_path", None)
     findings.extend(_critical_path_findings(cp, wall))
+
+    # 7. memory flight recorder (schema v6): leaks, peak-HBM holders,
+    # per-operator spill churn, OOM postmortems
+    findings.extend(_memory_findings(q, wall))
 
     findings.sort(key=lambda f: -f.fraction)
     return QueryDiagnosis(q.query_id, wall, findings, critical_path=cp)

@@ -27,6 +27,15 @@ file per session (``spark.rapids.tpu.eventLog.dir``), one record per event:
   TraceContext minted for the query, also on ``query_start``) and
   ``critical_path`` (the per-category wall-time attribution computed
   from this process's tracer spans — tools/trace.py)
+- ``memory_summary`` (schema v6): one per query (success AND error
+  paths) — the memory flight recorder's per-operator peak/live HBM
+  aggregation, peak-holder attribution and retained-buffer leak scan
+  (utils/memprof.py ``query_end``); ``summary`` is null when profiling
+  is off. v6 also adds ``peak_device_bytes`` to ``node`` records.
+- ``oom_postmortem`` (schema v6): one per OOM the catalog hit during the
+  query — context, ranked holders-by-operator, live/peak bytes and the
+  path of the full ``oom-<ts>.txt`` report (the record omits the report
+  text; the file carries it)
 - ``app_end``
 
 ``load_event_log`` replays a file into ``AppReplay``: per-query summaries,
@@ -51,9 +60,10 @@ __all__ = ["EventLogWriter", "load_event_log", "AppReplay", "QueryReplay",
 # Event-record schema version. Bump ONLY with a migration note in
 # docs/observability.md; tests/test_observability.py pins the current value
 # and the per-record required-key sets so replay/compare tooling can rely
-# on old logs staying loadable. v5: query_start/query_end carry trace_id,
-# query_end carries the critical_path category breakdown.
-SCHEMA_VERSION = 5
+# on old logs staying loadable. v6: per-query memory_summary records
+# (per-operator HBM attribution + leak scan), oom_postmortem records, and
+# peak_device_bytes on node records.
+SCHEMA_VERSION = 6
 
 EVENT_LOG_DIR = register_conf(
     "spark.rapids.tpu.eventLog.dir",
@@ -135,12 +145,26 @@ class EventLogWriter:
                     get_tracer().span("query", "query", query_id=qid):
                 result = collect_fn()
         except Exception as e:
+            # v6: the OOM that killed the query (if any) queued a
+            # postmortem in the flight recorder — persist it, and the leak
+            # scan, before the error record propagates
+            self._write_memory_records(qid)
             self.write({"event": "query_end", "query_id": qid,
                         "ts": time.time(), "trace_id": tctx.trace_id,
                         "wall_s": time.perf_counter() - t0,
                         "error": f"{type(e).__name__}: {e}"})
             raise
         wall = time.perf_counter() - t0
+        # close plan-owned spill handles (shuffle/broadcast outputs)
+        # BEFORE the leak scan in _write_memory_records below: the plan is
+        # single-use and its outputs release at query end by design — only
+        # what remains after this is a real leak
+        plan.release_spill_handles()
+        # v6: per-node peak HBM from the flight recorder (keys match the
+        # node ids instrument_plan assigned; {} when profiling is off)
+        from ..utils.memprof import active as memprof_active
+        mp = memprof_active()
+        node_peaks = mp.node_peaks(qid) if mp is not None else {}
         for ns in stats:
             self.write({"event": "node", "query_id": qid,
                         "node_id": ns.node_id, "parent_id": ns.parent_id,
@@ -148,6 +172,7 @@ class EventLogWriter:
                         "wall_s": ns.wall_s, "rows": ns.rows,
                         "batches": ns.batches, "t_first": ns.t_first,
                         "t_last": ns.t_last,
+                        "peak_device_bytes": node_peaks.get(ns.node_id, 0),
                         "metrics": _node_metrics(ns)})
         # schema v3: one kernel record per XLA program this query touched
         # (compile wall + cost/memory analysis keyed back to node ids)
@@ -157,6 +182,7 @@ class EventLogWriter:
             # query_id field records where the program first compiled)
             self.write({**entry, "event": "kernel", "query_id": qid,
                         "first_query_id": entry.get("query_id")})
+        self._write_memory_records(qid)
         aqe_events: List[str] = list(getattr(plan, "events", []))
         self.write({
             "event": "query_end", "query_id": qid, "ts": time.time(),
@@ -174,6 +200,23 @@ class EventLogWriter:
                                          counters_before),
         })
         return result
+
+    def _write_memory_records(self, qid: int) -> None:
+        """v6: drain queued oom_postmortem records, then run the flight
+        recorder's query-end leak scan and write ONE memory_summary
+        (``summary`` is null when profiling is off, so the record set per
+        query is stable either way)."""
+        from ..utils.memprof import active as memprof_active
+        mp = memprof_active()
+        summary = None
+        if mp is not None:
+            for pm in mp.drain_postmortems():
+                rec = {k: v for k, v in pm.items() if k != "report"}
+                self.write({"event": "oom_postmortem", "query_id": qid,
+                            **rec})
+            summary = mp.query_end(qid)
+        self.write({"event": "memory_summary", "query_id": qid,
+                    "ts": time.time(), "summary": summary})
 
     def close(self) -> None:
         self.write({"event": "app_end", "ts": time.time()})
@@ -227,6 +270,11 @@ class QueryReplay:
         # v5: distributed-trace identity + critical-path attribution
         self.trace_id: str = ""
         self.critical_path: Optional[Dict] = None
+        # v6: memory flight recorder — per-operator HBM attribution +
+        # leak scan (None for pre-v6 logs or profiling off), and any OOM
+        # postmortems the query hit
+        self.memory_summary: Optional[Dict] = None
+        self.oom_postmortems: List[Dict] = []
 
     def heartbeats_in_window(self, heartbeats: List[Dict]) -> List[Dict]:
         """App heartbeats whose timestamp falls inside this query's run
@@ -344,6 +392,18 @@ class AppReplay:
                 warnings.append(
                     f"q{q.query_id}: OOM cache-drop callbacks raised "
                     "(see catalog diagnostics)")
+            ms = q.memory_summary or {}
+            if ms.get("leaked_bytes"):
+                warnings.append(
+                    f"q{q.query_id}: {len(ms.get('leaked_buffers', []))} "
+                    f"buffer(s) still registered after query end "
+                    f"({ms['leaked_bytes']} bytes leaked — top holder: "
+                    f"{ms['leaked_buffers'][0]['operator']})")
+            for pm in q.oom_postmortems:
+                warnings.append(
+                    f"q{q.query_id}: OOM postmortem — {pm.get('context')}"
+                    + (f" (report: {pm['path']})" if pm.get("path")
+                       else ""))
         stalled = [h for h in self.heartbeats if h.get("stalled")]
         if stalled:
             age = max(h.get("last_progress_age_s", 0.0) for h in stalled)
@@ -383,6 +443,14 @@ def load_event_log(path: str) -> AppReplay:
                 q = app.queries.setdefault(rec["query_id"],
                                            QueryReplay(rec["query_id"]))
                 q.kernels.append(rec)
+            elif ev == "memory_summary":
+                q = app.queries.setdefault(rec["query_id"],
+                                           QueryReplay(rec["query_id"]))
+                q.memory_summary = rec.get("summary")
+            elif ev == "oom_postmortem":
+                q = app.queries.setdefault(rec["query_id"],
+                                           QueryReplay(rec["query_id"]))
+                q.oom_postmortems.append(rec)
             elif ev == "query_end":
                 q = app.queries.setdefault(rec["query_id"],
                                            QueryReplay(rec["query_id"]))

@@ -316,6 +316,7 @@ def profile_query(df, device: Optional[bool] = None,
     from ..memory.catalog import get_catalog
     from ..memory.semaphore import get_semaphore
     from ..utils.compile_cache import kernel_seq, kernels_since
+    from ..utils.memprof import active as memprof_active
     from ..utils.metrics import StatsRegistry, get_stats
     from ..utils.tracing import get_tracer
 
@@ -342,6 +343,12 @@ def profile_query(df, device: Optional[bool] = None,
     acq_before = sem.acquire_count
     counters_before = registry.collect()
     kseq_before = kernel_seq()
+    # profiled runs share query_id=None in the node contexts — drop any
+    # stale per-operator memory aggregation from a previous profile so
+    # node_peaks() below reflects only THIS run
+    mp = memprof_active()
+    if mp is not None:
+        mp.begin_query(None)
 
     if xla_trace_dir is not None:
         import jax.profiler
@@ -362,10 +369,22 @@ def profile_query(df, device: Optional[bool] = None,
         "spilled_bytes": {str(k): v - bytes_before.get(k, 0)
                           for k, v in cat.spilled_bytes.items()},
     }
+    # single-use profiled plan: close its spill-registered outputs now
+    # (same query-end release the session collect path performs)
+    plan.release_spill_handles()
     semaphore = {"total_wait_time": sem.total_wait_time - wait_before,
                  "acquire_count": sem.acquire_count - acq_before}
     counters = StatsRegistry.delta(registry.collect(), counters_before)
     snapshot_node_metrics(stats)
+    # fold per-node peak HBM from the memory flight recorder into the
+    # metric snapshots: EXPLAIN ANALYZE renders it as the peakDevMemory
+    # column (plan/meta.py render order)
+    if mp is not None:
+        from ..utils.metrics import PEAK_DEVICE_MEMORY
+        peaks = mp.node_peaks(None)
+        for ns in stats:
+            if peaks.get(ns.node_id):
+                ns.metrics[PEAK_DEVICE_MEMORY] = peaks[ns.node_id]
     finalize_self_times(stats)
     return QueryProfile(stats, total, spill, semaphore, counters,
                         kernels=kernels_since(kseq_before))

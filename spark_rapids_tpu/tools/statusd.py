@@ -10,11 +10,16 @@ fleet needs a liveness probe per process):
   balancers key off the status code alone.
 - ``GET /metrics`` — the process StatsRegistry as Prometheus text
   exposition 0.0.4 (utils/metrics.py), same payload
-  ``prometheus_text()`` returns programmatically.
+  ``prometheus_text()`` returns programmatically. Includes the memory
+  flight recorder's per-operator HBM gauges
+  (``spark_rapids_tpu_memprof_operator_live_bytes_<Op>``, plus
+  peak/leak/postmortem counters from utils/memprof.py), which the
+  federation endpoints re-export per process.
 - ``GET /status`` — the full live JSON snapshot
   (``HealthMonitor.snapshot()``): semaphore holders/waiters, pipeline
-  queue depths + in-flight task ages, HBM watermarks, active operator
-  contexts, recent watermark history.
+  queue depths + in-flight task ages, HBM watermarks, the memory
+  flight recorder's live/peak holders-by-operator attribution, active
+  operator contexts, recent watermark history.
 - ``GET /federation`` — JSON scrape summary over every registered peer
   process (ProcessCluster workers / remote status daemons): per-peer
   reachability + sample counts.

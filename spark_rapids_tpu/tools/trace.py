@@ -62,6 +62,9 @@ CATEGORY_BY_CAT: Dict[str, str] = {
     "download": "sync_wait",      # blocking D2H sync (ROADMAP item 1)
     "upload": "h2d_upload",
     "spill": "spill",
+    # spill-restore + OOM-recovery spans (memory/catalog.py) — time the
+    # query lost to HBM pressure, distinct from proactive spill writes
+    "memory": "memory_pressure",
 }
 
 

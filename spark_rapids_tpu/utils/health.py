@@ -283,9 +283,11 @@ class HealthMonitor:
         from ..memory.catalog import peek_catalog
         from ..memory.semaphore import peek_semaphore
         from ..parallel.pipeline import pipeline_snapshot
+        from .memprof import active as memprof_active
         from .node_context import active_contexts
         cat = peek_catalog()
         sem = peek_semaphore()
+        mp = memprof_active()
         return {
             "ts": time.time(),
             "uptime_s": round(time.monotonic() - self.started_at, 3),
@@ -300,6 +302,11 @@ class HealthMonitor:
             "pipeline": pipeline_snapshot(),
             "catalog":
                 cat.watermarks(timeout_s=0.5) if cat is not None else None,
+            # memory flight recorder (utils/memprof.py): per-operator HBM
+            # attribution + leak/postmortem counters; {"enabled": False}
+            # when profiling is off so pollers see the knob state
+            "memory": mp.snapshot() if mp is not None
+            else {"enabled": False},
             "active_operators": active_contexts(),
             "watermark_history": list(self.watermark_history)[-32:],
         }

@@ -396,6 +396,11 @@ def _tracer_source() -> Dict:
     return tracer_stats()
 
 
+def _memprof_source() -> Dict:
+    from .memprof import memprof_stats
+    return memprof_stats()
+
+
 _DEFAULT_SOURCES = {
     "compile_cache": _compile_cache_source,
     "catalog": _catalog_source,
@@ -404,6 +409,7 @@ _DEFAULT_SOURCES = {
     "shuffle": _shuffle_source,
     "pipeline": _pipeline_source,
     "tracer": _tracer_source,
+    "memprof": _memprof_source,
 }
 
 _GLOBAL_STATS: Optional[StatsRegistry] = None

@@ -313,6 +313,35 @@ def test_trace_checker_rules(tmp_path):
     assert len(report.suppressed) == 1
 
 
+def test_memtrack_checker_rules(tmp_path):
+    path = _write(tmp_path, "memtrack_fixture.py", """\
+        from spark_rapids_tpu.columnar import DeviceTable
+
+        def leaky(host):
+            return DeviceTable.from_host(host, min_bucket=8)
+
+        def accounted(host, catalog):
+            t = DeviceTable.from_host(host, min_bucket=8)
+            return catalog.register(t)
+
+        def closure_accounted(host, catalog):
+            def upload():
+                return DeviceTable.from_host(host, min_bucket=8)
+            return catalog.register(upload())
+
+        def helper(host):
+            return DeviceTable.from_host(host, 8)  # srtpu: memtrack-ok(caller registers)
+
+        def derived(cols, mask):
+            return DeviceTable(cols, mask)          # view: no new HBM
+        """)
+    report = analyze_paths([path], checks=["memtrack"])
+    assert [f.rule for f in report.findings] == \
+        ["memtrack-unregistered-upload"]
+    assert {f.symbol for f in report.findings} == {"leaky"}
+    assert len(report.suppressed) == 1
+
+
 def test_bucket_checker_skips_cold_packages(tmp_path):
     cold = tmp_path / "spark_rapids_tpu" / "tools"
     cold.mkdir(parents=True)
@@ -454,6 +483,9 @@ def test_tier1_seeded_violation_fails_each_category(tmp_path,
                   "    return bucket_rows(n, 512)\n",
         "trace": "def f(tracer):\n"
                  "    tracer.span('q', 'query')\n    return 1\n",
+        "memtrack": "from spark_rapids_tpu.columnar import DeviceTable\n\n"
+                    "def f(host):\n"
+                    "    return DeviceTable.from_host(host, min_bucket=8)\n",
     }
     baseline = load_baseline(default_baseline_path())
     for check, body in seeds.items():

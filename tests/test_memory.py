@@ -148,3 +148,9 @@ def test_pool_mode_none_and_strict():
     import pytest as _pytest
     with _pytest.raises(MemoryError, match="strict pool mode"):
         strict_cat.register(tbl())
+    # the strict OOM queued a postmortem on the process-global memory
+    # profiler; drain it so it doesn't ride into the next test's event log
+    from spark_rapids_tpu.utils import memprof
+    mp = memprof.active()
+    if mp is not None:
+        mp.drain_postmortems()

@@ -327,7 +327,7 @@ _REQUIRED_KEYS = {
     "query_start": {"event", "query_id", "ts", "plan", "trace_id"},
     "node": {"event", "query_id", "node_id", "parent_id", "name", "desc",
              "depth", "wall_s", "rows", "batches", "t_first", "t_last",
-             "metrics"},
+             "metrics", "peak_device_bytes"},  # peak_device_bytes: v6
     # v3: one record per XLA program the query touched (kernel table)
     "kernel": {"event", "query_id", "first_query_id", "signature",
                "node_name", "node_id", "hits", "misses", "compiles",
@@ -335,6 +335,12 @@ _REQUIRED_KEYS = {
     "query_end": {"event", "query_id", "ts", "wall_s", "final_plan",
                   "aqe_events", "spill_count", "semaphore_wait_s", "stats",
                   "trace_id", "critical_path"},
+    # v6: per-query memory flight-recorder summary, ALWAYS written
+    # (summary is null when profiling is off) so the record set is
+    # stable; oom_postmortem records appear only on an actual OOM and
+    # are pinned separately (test_eventlog_oom_postmortem_record_keys
+    # in tests/test_memprof.py)
+    "memory_summary": {"event", "query_id", "ts", "summary"},
     "app_end": {"event", "ts"},
 }
 
@@ -373,8 +379,10 @@ def test_eventlog_schema_version_and_required_keys(tmp_path):
     # monitor off in this run, so none appear here; tests/test_health.py
     # pins the heartbeat record keys). v5 adds the distributed-trace
     # identity: trace_id on query_start/query_end, critical_path on
-    # query_end (null when tracing is off, as here)
-    assert SCHEMA_VERSION == 5
+    # query_end (null when tracing is off, as here). v6 adds the memory
+    # flight recorder: per-query memory_summary, peak_device_bytes on
+    # node records, oom_postmortem records on OOM
+    assert SCHEMA_VERSION == 6
     assert by_type["app_start"][0]["schema_version"] == SCHEMA_VERSION
     for kind, required in _REQUIRED_KEYS.items():
         for rec in by_type[kind]:
@@ -575,7 +583,7 @@ def test_eventlog_query_stats_cover_all_subsystems(tmp_path):
     from spark_rapids_tpu.tools.eventlog import load_event_log
     path = _run_logged_app(tmp_path)
     app = load_event_log(path)
-    assert app.schema_version == 5
+    assert app.schema_version == 6
     q = app.query(1)
     assert q.stats, "query_end stats delta missing"
     for family in ("compile_cache_", "upload_cache_", "shuffle_",

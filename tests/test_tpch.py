@@ -12,6 +12,31 @@ from harness import assert_tpu_cpu_equal
 ROWS = 20_000
 
 
+@pytest.fixture(autouse=True)
+def _no_leaked_buffers():
+    """Tier-1 leak gate (memory flight recorder ISSUE): every TPC-H query
+    must return the buffer catalog to its pre-query registration set — a
+    buffer that outlives its query is retained HBM the next query pays
+    for. The plain collect() here runs uninstrumented (no event log), so
+    the gate snapshots the catalog registry directly instead of relying
+    on the profiler's query_end scan."""
+    from spark_rapids_tpu.memory.catalog import peek_catalog
+    cat = peek_catalog()
+    before = set(cat._buffers) if cat is not None else set()
+    yield
+    cat = peek_catalog()
+    after = set(cat._buffers) if cat is not None else set()
+    leaked = after - before
+    if leaked:
+        with cat._lock:
+            detail = "; ".join(
+                f"buffer {bid}: {cat._buffers[bid].size_bytes} bytes "
+                f"tier={cat._buffers[bid].tier}"
+                for bid in sorted(leaked) if bid in cat._buffers)
+        pytest.fail(f"{len(leaked)} buffer(s) still registered after the "
+                    f"query: {detail}")
+
+
 @pytest.fixture(scope="module")
 def lineitem():
     return tpch.gen_lineitem(0, seed=7, rows=ROWS)
