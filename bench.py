@@ -53,7 +53,13 @@ memory flight recorder per phase — each query's res gains
 "peak_hbm_bytes" + "spill_bytes" and the phase gains a "memory" summary
 with peak holders-by-operator / leak / postmortem counts in the bench
 JSON; tools/compare.py diffs the per-query numbers across rounds and
-gates >10% peak-HBM growth).
+gates >10% peak-HBM growth), BENCH_HISTORY (1|0, default on: each
+phase's run lands in the persistent history store (.bench_history/,
+override with BENCH_HISTORY_DIR) and the regression sentinel
+(tools/history.py) compares it against the previous round's pinned
+baseline — wall/critical-path/memory plus the sync-count and
+compile-count gates — writing a "history" verdict per phase into the
+bench JSON and pinning this run as the next round's baseline).
 """
 import atexit
 import json
@@ -84,6 +90,7 @@ _STATE = {
     "eventlog": {},   # phase -> event-log directory
     "health": {},     # phase -> /status snapshot + peak HBM watermark
     "memory": {},     # phase -> memory flight-recorder summary
+    "history": {},    # phase -> history-store sentinel verdict
     "pipeline": os.environ.get("BENCH_PIPELINE", "on"),  # A/B knob
     "analyze": {},    # srtpu-analyze baseline summary (sync-site debt)
     "notes": [],
@@ -124,7 +131,8 @@ def _write_partial():
         json.dump({k: _STATE[k] for k in
                    ("backend", "fell_back", "sf", "rows", "smoke", "tpch",
                     "ablation", "restart", "compile_cache", "errors", "eventlog",
-                    "health", "memory", "pipeline", "analyze", "notes")}
+                    "health", "memory", "history", "pipeline", "analyze",
+                    "notes")}
                   | {"elapsed_s": round(time.monotonic() - _T_START, 2)},
                   f, indent=1)
     os.replace(tmp, _PARTIAL_PATH)
@@ -320,6 +328,8 @@ def _consume(ev):
             _STATE["health"].update(ev["health"])
         if "memory" in ev:
             _STATE["memory"].update(ev["memory"])
+        if "history" in ev:
+            _STATE["history"].update(ev["history"])
     elif kind == "ablation":
         _STATE["ablation"][ev["name"]] = ev["res"]
     _write_partial()
@@ -587,6 +597,50 @@ def _eventlog_conf(phase: str, sink=None) -> dict:
     return {"spark.rapids.tpu.eventLog.dir": d}
 
 
+def _history_conf(phase: str) -> dict:
+    """Persistent cross-run history store (tools/history.py): with this
+    conf set, the phase's session appends its run to the store when it
+    closes; _bench_sentinel then gates it against the previous round.
+    Per-phase subdirectories keep smoke rounds comparing against smoke
+    rounds. BENCH_HISTORY=0 disables; BENCH_HISTORY_DIR relocates."""
+    if os.environ.get("BENCH_HISTORY", "1") == "0":
+        return {}
+    d = os.environ.get("BENCH_HISTORY_DIR",
+                       os.path.join(_REPO, ".bench_history"))
+    return {"spark.rapids.tpu.history.dir": os.path.join(d, phase)}
+
+
+def _bench_sentinel(sink: "_EventSink", phase: str) -> None:
+    """Regression sentinel over the history store: compare the run the
+    session just appended on close against the previous round's pinned
+    baseline (first round verdict: 'no-baseline'), emit the verdict into
+    the bench JSON, and pin this run as the next round's baseline.
+    Never fails the bench."""
+    if os.environ.get("BENCH_HISTORY", "1") == "0":
+        return
+    try:
+        from spark_rapids_tpu.tools.history import (HistoryStore,
+                                                    run_sentinel)
+        d = os.environ.get("BENCH_HISTORY_DIR",
+                           os.path.join(_REPO, ".bench_history"))
+        store = HistoryStore(os.path.join(d, phase))
+        if not store.apps():  # BENCH_EVENTLOG=0: session had no log
+            return
+        verdict = run_sentinel(store)
+        cand = verdict.get("candidate")
+        store.pin_baseline(cand)
+        sink.emit(ev="meta", history={phase: {
+            "store": store.root, "candidate": cand,
+            "baseline": verdict.get("baseline"),
+            "status": verdict.get("status"), "ok": verdict.get("ok"),
+            "flags": verdict.get("flags", [])}})
+        _log(f"{phase}: sentinel {verdict.get('status')}"
+             + (f" vs {verdict['baseline']}" if verdict.get("baseline")
+                else ""))
+    except Exception as e:  # the sentinel must never fail the bench
+        _log(f"{phase}: history sentinel failed: {type(e).__name__}: {e}")
+
+
 def _pipeline_conf() -> dict:
     """BENCH_PIPELINE=on|off A/B knob -> session conf (default on)."""
     return {"spark.rapids.tpu.pipeline.enabled":
@@ -778,6 +832,7 @@ def _worker_smoke(sink: _EventSink):
                        **_pipeline_conf(),
                        **_compile_cache_conf(),
                        **_eventlog_conf("smoke", sink),
+                       **_history_conf("smoke"),
                        **_health_conf("smoke"),
                        **_memprof_conf(),
                        **_trace_conf()})
@@ -853,6 +908,7 @@ def _worker_smoke(sink: _EventSink):
     _emit_memory_snapshot(sink, "smoke", sess)
     sess.close()  # flush the event log + persist the compile tier
     _write_diagnose_report("smoke")
+    _bench_sentinel(sink, "smoke")
 
 
 def _smoke_check(name, dev_res, exp):
@@ -894,6 +950,7 @@ def _worker_tpch(sink: _EventSink):
         **_pipeline_conf(),
         **_compile_cache_conf(),
         **_eventlog_conf("tpch", sink),
+        **_history_conf("tpch"),
         **_health_conf("tpch"),
         **_memprof_conf(),
         **_trace_conf(),
@@ -945,6 +1002,7 @@ def _worker_tpch(sink: _EventSink):
     _emit_memory_snapshot(sink, "tpch", sess)
     sess.close()  # flush the event log + persist the compile tier
     _write_diagnose_report("tpch")
+    _bench_sentinel(sink, "tpch")
 
 
 def _worker_ablation(sink: _EventSink):
@@ -1012,6 +1070,7 @@ def _worker_restart(sink: _EventSink):
                        **_pipeline_conf(),
                        **_compile_cache_conf(),
                        **_eventlog_conf("restart", sink),
+                       **_history_conf("restart"),
                        **_health_conf("restart"),
                        **_memprof_conf(),
                        **_trace_conf()})
@@ -1053,6 +1112,7 @@ def _worker_restart(sink: _EventSink):
     _emit_memory_snapshot(sink, "restart", sess)
     sess.close()
     _write_diagnose_report("restart")
+    _bench_sentinel(sink, "restart")
 
 
 def worker_main(phase: str):

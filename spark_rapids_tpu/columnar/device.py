@@ -41,7 +41,26 @@ from .host import HostColumn, HostTable
 __all__ = ["BucketPolicy", "DeviceColumn", "DeviceTable", "bucket_rows",
            "bucket_width", "canonical_names", "configure_buckets",
            "configure_debug", "current_bucket_policy",
-           "debug_assertions_enabled", "resolve_min_bucket"]
+           "debug_assertions_enabled", "host_sync_stats",
+           "resolve_min_bucket"]
+
+# process-wide count of deliberate D2H materializations (to_host calls —
+# the funnel every blocking download converges on per the srtpu-analyze
+# sync rules). Feeds utils/metrics.StatsRegistry as the ``host_sync``
+# source, so per-query event-log deltas carry it and the history
+# sentinel's sync-count gate can flag a run that started syncing more.
+_HOST_SYNC_LOCK = __import__("threading").Lock()
+_HOST_SYNC = {"d2h_count": 0}
+
+
+def host_sync_stats() -> Dict[str, int]:
+    with _HOST_SYNC_LOCK:
+        return dict(_HOST_SYNC)
+
+
+def _note_host_sync() -> None:
+    with _HOST_SYNC_LOCK:
+        _HOST_SYNC["d2h_count"] += 1
 
 # spark.rapids.tpu.debug.assertions snapshot (session-init chokepoint,
 # like parallel/pipeline.configure_pipeline — columns have no conf at
@@ -393,6 +412,7 @@ class DeviceTable:
 
     def to_host(self) -> HostTable:
         """Download and compact to exactly num_rows host rows."""
+        _note_host_sync()
         mask = np.asarray(self.row_mask)  # srtpu: sync-ok(result materialization: the deliberate D2H funnel)
         n = int(np.asarray(self.num_rows))  # srtpu: sync-ok(result materialization: the deliberate D2H funnel)
         # row_mask may be non-prefix (post-filter); boolean-index on host

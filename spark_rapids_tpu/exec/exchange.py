@@ -99,6 +99,11 @@ class TpuShuffleExchangeExec(TpuExec):
         self.schema = child.schema
         # spill handles per partition, one per exchanged chunk
         self._shards: Optional[List[List]] = None
+        # v7 skew telemetry: per-output-partition rows (free — the bulk
+        # shard_rows sync) and byte estimates accumulated across chunks;
+        # the event log turns this into a shuffle_skew record
+        self._skew_rows: Optional[List[int]] = None
+        self._skew_bytes: Optional[List[int]] = None
         # pipelined partition drains race to materialize; exactly one wins
         # (parallel/pipeline.py pipelined_collect contract)
         self._mat_lock = __import__("threading").Lock()
@@ -141,6 +146,8 @@ class TpuShuffleExchangeExec(TpuExec):
         from ..parallel.pipeline import maybe_prefetched
         n = self.num_partitions
         shards: List[List] = [[] for _ in range(n)]
+        self._skew_rows = [0] * n
+        self._skew_bytes = [0] * n
         total_rows = 0
         # NOTE: child batch consumption stays OUTSIDE the op timer — the
         # upstream pipeline accounts its own opTime; only the exchange
@@ -190,7 +197,8 @@ class TpuShuffleExchangeExec(TpuExec):
         catalog = get_catalog()
         with self.metrics.timed(M.OP_TIME):
             table = concat_device_tables(batches, self.min_bucket)
-            self.metrics.add(M.SHUFFLE_BYTES, table.nbytes())
+            chunk_nbytes = table.nbytes()
+            self.metrics.add(M.SHUFFLE_BYTES, chunk_nbytes)
             per_shard = bucket_rows(
                 max(1, -(-table.capacity // n)), self.min_bucket)
             table = pad_table_capacity(table, per_shard * n)
@@ -224,6 +232,15 @@ class TpuShuffleExchangeExec(TpuExec):
                 # round trip per shard plus one more for the row total
                 shard_rows = jax.device_get(  # srtpu: sync-ok(batched count sync, 4B per shard once per chunk)
                     [t.num_rows for t in parts])
+                # v7 skew: per-destination rows come free with the bulk
+                # count sync; bytes are estimated as rows × the chunk's
+                # mean row width (per-shard padded nbytes would read
+                # uniform regardless of the actual distribution)
+                chunk_total = int(sum(int(c) for c in shard_rows))
+                bpr = chunk_nbytes / max(1, chunk_total)
+                for i, cnt in enumerate(shard_rows):
+                    self._skew_rows[i] += int(cnt)
+                    self._skew_bytes[i] += int(round(int(cnt) * bpr))
                 for i, (t, cnt) in enumerate(zip(parts, shard_rows)):
                     if not int(cnt):
                         continue
@@ -231,9 +248,18 @@ class TpuShuffleExchangeExec(TpuExec):
                         t, SpillPriorities.OUTPUT_FOR_SHUFFLE)
                     self._own_spill_handle(h)
                     shards[i].append(h)
-                return int(sum(shard_rows))
+                return chunk_total
             finally:
                 inflight.close()
+
+    def shuffle_skew(self) -> Optional[dict]:
+        """v7 event-log payload: the per-output-partition row/byte
+        distribution accumulated across exchanged chunks. None until the
+        exchange materialized (skew records only describe work done)."""
+        if self._skew_rows is None:
+            return None
+        from ..utils.metrics import build_skew_record
+        return build_skew_record(self._skew_rows, self._skew_bytes)
 
 
 class TpuLocalExchangeExec(TpuExec):
@@ -264,6 +290,9 @@ class TpuLocalExchangeExec(TpuExec):
         self.min_bucket = resolve_min_bucket(min_bucket)
         self.schema = child.schema
         self._handles: Optional[List] = None
+        # v7 skew telemetry: one output partition, so the distribution is
+        # trivially balanced — recorded anyway for a uniform record set
+        self._skew: Optional[tuple] = None
         self._mat_lock = __import__("threading").Lock()
 
     @property
@@ -302,18 +331,21 @@ class TpuLocalExchangeExec(TpuExec):
                     # batches can be mostly masked slack — forwarding full
                     # capacity would inflate every downstream kernel
                     shrunk = shrink_to_fit(b, self.min_bucket, num_rows=n)
-                    self.metrics.add(M.SHUFFLE_BYTES, shrunk.nbytes())
+                    nbytes = shrunk.nbytes()
+                    self.metrics.add(M.SHUFFLE_BYTES, nbytes)
                     h = catalog.register(
                         shrunk, SpillPriorities.OUTPUT_FOR_SHUFFLE)
                 self._own_spill_handle(h)
-                out.append((h, n))
+                out.append((h, n, nbytes))
             return out
 
         per_part = parallel_map(drain, range(self.child.num_partitions),
                                 stage="local_exchange_map")
-        handles: List = [h for part in per_part for h, _n in part]
-        rows = sum(n for part in per_part for _h, n in part)
+        handles: List = [h for part in per_part for h, _n, _b in part]
+        rows = sum(n for part in per_part for _h, n, _b in part)
+        nbytes = sum(b for part in per_part for _h, _n, b in part)
         self._handles = handles
+        self._skew = ([rows], [nbytes])
         self.metrics.add(M.NUM_OUTPUT_BATCHES, len(handles))
         self.metrics.add(M.NUM_OUTPUT_ROWS, rows)
 
@@ -323,6 +355,13 @@ class TpuLocalExchangeExec(TpuExec):
         clear_input_file()  # post-shuffle rows have no single source file
         for handle in self._handles:
             yield handle.get()
+
+    def shuffle_skew(self) -> Optional[dict]:
+        """v7 event-log payload (single-partition tier: imbalance 1.0)."""
+        if self._skew is None:
+            return None
+        from ..utils.metrics import build_skew_record
+        return build_skew_record(*self._skew)
 
 
 def _split_sharded(table: DeviceTable, n: int) -> List[Optional[DeviceTable]]:

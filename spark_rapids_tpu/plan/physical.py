@@ -674,6 +674,10 @@ class ShuffleExchangeExec(PhysicalPlan):
         self.partitioning = partitioning
         self.schema = child.schema
         self._materialized: Optional[List[List[HostTable]]] = None
+        # v7 skew telemetry: per-output-partition rows/bytes, summed once
+        # at the end of materialize (tools/eventlog.py shuffle_skew)
+        self._skew_rows: Optional[List[int]] = None
+        self._skew_bytes: Optional[List[int]] = None
         self._mat_lock = threading.Lock()
         # host-tier shuffles are the single largest single-chip overhead
         # (download-partition-upload); the registry makes that visible to
@@ -743,6 +747,18 @@ class ShuffleExchangeExec(PhysicalPlan):
                 for p, sl in part:
                     out[p].append(sl)
         self._materialized = out
+        # v7 skew telemetry: summed here at the end rather than inside
+        # feed() so the parallel map-side writers need no extra locking
+        self._skew_rows = [sum(t.num_rows for t in part) for part in out]
+        self._skew_bytes = [sum(t.nbytes() for t in part) for part in out]
+
+    def shuffle_skew(self) -> Optional[Dict]:
+        """v7 event-log payload: per-output-partition row/byte
+        distribution (None until the exchange materialized)."""
+        if self._skew_rows is None:
+            return None
+        from ..utils.metrics import build_skew_record
+        return build_skew_record(self._skew_rows, self._skew_bytes)
 
     def execute(self, pidx: int) -> Iterator[HostTable]:
         self._materialize()

@@ -238,6 +238,7 @@ class TpuSession:
         from .parallel.pipeline import shutdown_workers
         shutdown_workers()
         log = getattr(self, "_eventlog", None)
+        log_path = log.path if log is not None else None
         if log is not None:
             log.close()
             self._eventlog = None
@@ -252,6 +253,7 @@ class TpuSession:
             tracer = get_tracer()
             tracer.dump(os.path.join(
                 dist_dir, f"trace-{tracer.process_name}.json"))
+        trace_artifacts = []
         trace_dir = self.conf.get(TRACE_DIR)
         if trace_dir:
             import os
@@ -262,11 +264,29 @@ class TpuSession:
                     "spark.rapids.tpu.trace.dir is set but tracing never "
                     "ran — set spark.rapids.tpu.trace.enabled=true",
                     RuntimeWarning)
-                return
-            seq = next(_TRACE_DUMP_SEQ)
-            path = os.path.join(
-                trace_dir, f"trace-{os.getpid()}-{seq}.json")
-            tracer.dump(path)
+            else:
+                seq = next(_TRACE_DUMP_SEQ)
+                path = os.path.join(
+                    trace_dir, f"trace-{os.getpid()}-{seq}.json")
+                tracer.dump(path)
+                trace_artifacts.append(path)
+        # persistent history: append this run LAST — the event log is
+        # flushed and the trace artifact (if any) exists, so the stored
+        # run is complete. Opt-in via spark.rapids.tpu.history.dir.
+        self._history_append(log_path, trace_artifacts)
+
+    def _history_append(self, log_path, artifacts) -> None:
+        from .tools.history import HISTORY_DIR
+        root = self.conf.get(HISTORY_DIR)
+        if not root or not log_path:
+            return
+        try:
+            from .tools.history import HistoryStore
+            HistoryStore(root).append_run(log_path, artifacts=artifacts)
+        except Exception as e:  # history must never fail close
+            import warnings
+            warnings.warn(f"history store append failed: {e}",
+                          RuntimeWarning)
 
 
 class DataFrame:

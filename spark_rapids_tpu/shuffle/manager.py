@@ -58,6 +58,7 @@ def shuffle_stats() -> Dict[str, int]:
     with _STATS_LOCK:
         return dict(_STATS)
 
+
 SHUFFLE_CACHE_WRITES = register_conf(
     "spark.rapids.tpu.shuffle.cacheWrites",
     "Cache written shuffle partitions in the device store as spillable "
@@ -210,6 +211,14 @@ class ShuffleManager:
             # to the best available codec
             self.codec = default_codec()
         self._ids = itertools.count()
+        # v7 skew telemetry: per-shuffle reduce-partition row/byte
+        # distribution, accumulated across map tasks on both write tiers
+        # from counts the write paths already compute (bounds diff +
+        # published block sizes). Instance state: shuffle ids are
+        # per-manager, so a process-wide map would alias id 0 across
+        # managers with different partition counts.
+        self._skew_lock = threading.Lock()
+        self._skew: Dict[int, Dict[str, List[int]]] = {}
         self.heartbeats = HeartbeatManager()
         from .buffer_catalog import ShuffleBufferCatalog
         self.buffer_catalog = ShuffleBufferCatalog()
@@ -224,6 +233,27 @@ class ShuffleManager:
     def new_shuffle_id(self) -> int:
         return next(self._ids)
 
+    def _bump_skew(self, shuffle_id: int, part_rows, part_bytes) -> None:
+        with self._skew_lock:
+            entry = self._skew.setdefault(
+                shuffle_id, {"rows": [0] * len(part_rows),
+                             "bytes": [0] * len(part_bytes)})
+            for p, r in enumerate(part_rows):
+                entry["rows"][p] += int(r)
+            for p, b in enumerate(part_bytes):
+                entry["bytes"][p] += int(b)
+
+    def shuffle_skew_stats(self, shuffle_id: int) -> Optional[Dict]:
+        """The v7 ``shuffle_skew`` payload for one shuffle's write-side
+        distribution (min/p50/max/imbalance over reduce partitions), or
+        None for an unknown/unwritten shuffle id."""
+        with self._skew_lock:
+            entry = self._skew.get(shuffle_id)
+            if entry is None:
+                return None
+            from ..utils.metrics import build_skew_record
+            return build_skew_record(entry["rows"], entry["bytes"])
+
     def unregister_shuffle(self, shuffle_id: int) -> None:
         """Free a finished shuffle's blocks in BOTH stores — device-resident
         catalog buffers and transport payloads (reference:
@@ -231,6 +261,8 @@ class ShuffleManager:
         Callers own the shuffle lifecycle: invoke when the consuming stage
         has fully drained the reduce partitions."""
         self.buffer_catalog.remove_shuffle(shuffle_id)
+        with self._skew_lock:
+            self._skew.pop(shuffle_id, None)
         try:
             self.transport.remove_shuffle(shuffle_id)
         except NotImplementedError:
@@ -271,6 +303,7 @@ class ShuffleManager:
                                    key_names: List[str],
                                    num_parts: int) -> List[int]:
         merged: List[List[HostTable]] = [[] for _ in range(num_parts)]
+        part_rows = np.zeros(num_parts, dtype=np.int64)
         schema_host: Optional[HostTable] = None
         for batch in batches:
             pids = device_partition_ids(batch, key_names, num_parts)
@@ -282,6 +315,7 @@ class ShuffleManager:
                 jnp.take(batch.row_mask, order), batch.num_rows, batch.names)
             sorted_pids = np.asarray(jnp.take(pids, order))  # srtpu: sync-ok(count pass: partition-id vector only, 4B/row, before the bulk download)
             bounds = np.searchsorted(sorted_pids, np.arange(num_parts + 1))
+            part_rows += np.diff(bounds)
             host = sorted_tbl.to_host()  # single download, dense prefix
             schema_host = host
             for p in range(num_parts):
@@ -306,6 +340,7 @@ class ShuffleManager:
                              stage="shuffle_serialize")
         _bump(blocks_published=num_parts, bytes_published=sum(sizes),
               writes_transport_tier=1)
+        self._bump_skew(shuffle_id, part_rows, sizes)
         return sizes
 
     def _write_partition_cached(self, shuffle_id: int, map_id: int,
@@ -327,6 +362,7 @@ class ShuffleManager:
             return DeviceTable(cols, mask, jnp.int32(hi - lo), tbl.names)
 
         per_part: List[List[DeviceTable]] = [[] for _ in range(num_parts)]
+        part_rows = np.zeros(num_parts, dtype=np.int64)
         schema_tbl: Optional[DeviceTable] = None
         for batch in batches:
             pids = device_partition_ids(batch, key_names, num_parts)
@@ -340,6 +376,7 @@ class ShuffleManager:
             # count download only (4B/row), like the ICI exchange count pass
             sorted_pids = np.asarray(jnp.take(pids, order))  # srtpu: sync-ok(count pass: partition-id vector only, 4B/row; slices stay on device)
             bounds = np.searchsorted(sorted_pids, np.arange(num_parts + 1))
+            part_rows += np.diff(bounds)
             for p in range(num_parts):
                 lo, hi = int(bounds[p]), int(bounds[p + 1])
                 if hi > lo:
@@ -357,6 +394,7 @@ class ShuffleManager:
             sizes[p] = table.nbytes()
         _bump(blocks_published=num_parts, bytes_published=sum(sizes),
               writes_cached_tier=1)
+        self._bump_skew(shuffle_id, part_rows, sizes)
         return sizes
 
     # -- read side ------------------------------------------------------------

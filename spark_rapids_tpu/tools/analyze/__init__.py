@@ -306,14 +306,16 @@ def load_project(paths: Sequence[str]) -> Project:
 
 
 def _checkers() -> Dict[str, object]:
-    from . import (buckets, host_sync, jit_purity, locks, memtrack, threads,
-                   trace_ctx)
+    from . import (buckets, eventlog_schema, host_sync, jit_purity, locks,
+                   memtrack, threads, trace_ctx)
     return {"sync": host_sync, "lock": locks,
             "thread": threads, "jit": jit_purity, "bucket": buckets,
-            "trace": trace_ctx, "memtrack": memtrack}
+            "trace": trace_ctx, "memtrack": memtrack,
+            "eventlog": eventlog_schema}
 
 
-CHECKS = ("sync", "lock", "thread", "jit", "bucket", "trace", "memtrack")
+CHECKS = ("sync", "lock", "thread", "jit", "bucket", "trace", "memtrack",
+          "eventlog")
 
 
 def analyze_paths(paths: Sequence[str],

@@ -1,0 +1,160 @@
+"""eventlog checker: event-record schema discipline.
+
+The event log's replay contract (tools/eventlog.py) is a closed record
+set: every record type a writer may emit is declared in ``RECORD_TYPES``
+alongside the schema version that introduced it, and ``SCHEMA_VERSION``
+is the ceiling the app_start record advertises. Three drift modes break
+replay silently — an unregistered record type loads as dead weight (no
+QueryReplay branch, no docs, no version history), a record type
+registered above SCHEMA_VERSION ships in logs whose advertised version
+predates it (downstream version gates mis-classify the log), and a
+record dict whose event type cannot be read statically defeats the
+registry audit entirely. Rules:
+
+- ``eventlog-unregistered-record`` — a ``write({"event": <const>, ...})``
+  call site naming a type absent from ``RECORD_TYPES``. Adding a record
+  type means registering it (with a version bump + migration note), not
+  just emitting it.
+- ``eventlog-version-skew`` — a ``RECORD_TYPES`` entry whose version
+  exceeds ``SCHEMA_VERSION``: the registry promises a schema the writer
+  does not declare, i.e. the version bump was forgotten.
+- ``eventlog-dynamic-record`` — the dict passed to ``write()`` has no
+  statically-readable ``"event"`` string: the key is missing, computed,
+  or a ``**spread`` placed after it can override the type at runtime.
+  Where the spread source provably never carries an ``event`` key (the
+  health monitor's flat heartbeat sample), suppress inline with
+  ``# srtpu: eventlog-ok(<reason>)``; otherwise put the spread FIRST so
+  the literal key wins.
+
+Only ``write``/``self.write`` attribute calls whose first argument is a
+dict literal are considered — file-handle ``.write(str)`` sites and
+other write methods don't match the shape and stay silent.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from . import Finding, Project, ScopedVisitor
+
+__all__ = ["check"]
+
+_EVENTLOG_RELPATH = "spark_rapids_tpu/tools/eventlog.py"
+
+
+def _registry_from_ast(tree: ast.AST) -> Tuple[Dict[str, int], int,
+                                               Optional[ast.AST]]:
+    """Extract (RECORD_TYPES, SCHEMA_VERSION, registry assignment node)
+    from eventlog.py's module AST — the checker must not import the
+    runtime module (analysis runs without jax)."""
+    registry: Dict[str, int] = {}
+    version = 0
+    reg_node: Optional[ast.AST] = None
+    for node in ast.walk(tree):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = [t.id for t in node.targets
+                       if isinstance(t, ast.Name)]
+        elif isinstance(node, ast.AnnAssign) \
+                and isinstance(node.target, ast.Name):
+            targets = [node.target.id]
+        else:
+            continue
+        value = node.value
+        if "SCHEMA_VERSION" in targets \
+                and isinstance(value, ast.Constant) \
+                and isinstance(value.value, int):
+            version = value.value
+        elif "RECORD_TYPES" in targets and isinstance(value, ast.Dict):
+            reg_node = node
+            for k, v in zip(value.keys, value.values):
+                if isinstance(k, ast.Constant) \
+                        and isinstance(v, ast.Constant):
+                    registry[str(k.value)] = int(v.value)
+    return registry, version, reg_node
+
+
+def _record_event(call: ast.Call) -> Tuple[Optional[str], bool]:
+    """(event type, verifiable) for a ``write({...})`` call: the
+    ``"event"`` constant from the dict literal, and whether that value
+    is trustworthy (no later ``**spread`` can override it)."""
+    arg = call.args[0]
+    assert isinstance(arg, ast.Dict)
+    event: Optional[str] = None
+    event_pos = -1
+    last_spread = -1
+    for i, (k, v) in enumerate(zip(arg.keys, arg.values)):
+        if k is None:  # **spread
+            last_spread = i
+        elif isinstance(k, ast.Constant) and k.value == "event":
+            event_pos = i
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                event = v.value
+    if event is None:
+        return None, False
+    return event, last_spread < event_pos
+
+
+class _EventlogVisitor(ScopedVisitor):
+    def __init__(self, ctx, registry: Dict[str, int]):
+        super().__init__()
+        self.ctx = ctx
+        self.registry = registry
+        self.findings: List[Finding] = []
+
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        is_write = (isinstance(func, ast.Attribute)
+                    and func.attr == "write") \
+            or (isinstance(func, ast.Name) and func.id == "write")
+        if is_write and node.args and isinstance(node.args[0], ast.Dict):
+            event, verifiable = _record_event(node)
+            if event is None:
+                self.findings.append(self.ctx.finding(
+                    "eventlog", "eventlog-dynamic-record", node,
+                    self.symbol,
+                    "record dict has no constant \"event\" key — the "
+                    "schema registry cannot audit this write site; name "
+                    "the type literally"))
+            elif not verifiable:
+                self.findings.append(self.ctx.finding(
+                    "eventlog", "eventlog-dynamic-record", node,
+                    self.symbol,
+                    f"\"event\": \"{event}\" precedes a **spread that "
+                    "can override it at runtime — put the spread first "
+                    "so the literal type wins, or suppress with the "
+                    "reason the source can never carry an event key"))
+            elif event not in self.registry:
+                self.findings.append(self.ctx.finding(
+                    "eventlog", "eventlog-unregistered-record", node,
+                    self.symbol,
+                    f"record type \"{event}\" is not in "
+                    "RECORD_TYPES — register it with the schema version "
+                    "that introduces it (and bump SCHEMA_VERSION + the "
+                    "docs/observability.md migration note)"))
+        self.generic_visit(node)
+
+
+def check(project: Project) -> List[Finding]:
+    eventlog_mod = project.module_for(_EVENTLOG_RELPATH)
+    if eventlog_mod is None:
+        # partial-tree invocation (explicit paths without eventlog.py):
+        # no registry to audit against, so no claims either way
+        return []
+    registry, version, reg_node = _registry_from_ast(eventlog_mod.tree)
+    out: List[Finding] = []
+    if registry and reg_node is not None:
+        stale = {k: v for k, v in registry.items() if v > version}
+        if stale:
+            worst = max(stale.values())
+            out.append(eventlog_mod.finding(
+                "eventlog", "eventlog-version-skew", reg_node, "<module>",
+                f"RECORD_TYPES registers {sorted(stale)} at version "
+                f"{worst} but SCHEMA_VERSION is {version} — bump "
+                "SCHEMA_VERSION so app_start advertises the schema "
+                "these records belong to"))
+    for ctx in project.modules:
+        v = _EventlogVisitor(ctx, registry)
+        v.visit(ctx.tree)
+        out.extend(v.findings)
+    return out

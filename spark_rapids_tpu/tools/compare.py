@@ -40,7 +40,8 @@ from typing import Dict, List, Optional, Tuple
 __all__ = ["OpDelta", "QueryDelta", "CompareReport", "compare_event_logs",
            "compare_bench_results", "compare_apps",
            "critical_path_fractions", "critical_path_delta",
-           "memory_delta", "CP_FRAC_FLAG_PP", "MEM_PEAK_FLAG_FRAC"]
+           "memory_delta", "CP_FRAC_FLAG_PP", "MEM_PEAK_FLAG_FRAC",
+           "MEM_PEAK_FLAG_MIN_BYTES"]
 
 #: category-fraction growth (candidate minus baseline) that flags a
 #: critical-path regression: 5 percentage points
@@ -50,15 +51,23 @@ CP_FRAC_FLAG_PP = 0.05
 #: memory regression: 10%
 MEM_PEAK_FLAG_FRAC = 0.10
 
+#: absolute peak-HBM growth floor for the memory gate: tiny queries jitter
+#: past 10% run-to-run (bucket rounding, warm-cache layout), so a relative
+#: gate alone makes the history sentinel cry wolf on clean back-to-back
+#: runs — both conditions must hold, like the sentinel's count gates
+MEM_PEAK_FLAG_MIN_BYTES = 1 << 20
+
 
 def memory_delta(mem_a: Optional[Dict], mem_b: Optional[Dict],
-                 flag_frac: float = MEM_PEAK_FLAG_FRAC
+                 flag_frac: float = MEM_PEAK_FLAG_FRAC,
+                 flag_min_bytes: int = MEM_PEAK_FLAG_MIN_BYTES
                  ) -> Tuple[Dict[str, float], List[str]]:
     """(byte deltas B - A, flagged keys) from two per-query memory dicts
     ({"peak_bytes", "spill_bytes"}, from a v6 event log's memory_summary
     or a bench JSON's per-query fields). Empty when either run lacks the
     numbers — profiling off must not flag. Peak HBM growing past
-    ``flag_frac`` flags "peak_bytes" (the >10%% peak-memory gate)."""
+    ``flag_frac`` AND ``flag_min_bytes`` flags "peak_bytes" (the
+    >10%%-and-≥1MiB peak-memory gate)."""
     if not mem_a or not mem_b:
         return {}, []
     deltas = {k: float(mem_b.get(k) or 0) - float(mem_a.get(k) or 0)
@@ -66,7 +75,8 @@ def memory_delta(mem_a: Optional[Dict], mem_b: Optional[Dict],
     flagged = []
     peak_a = float(mem_a.get("peak_bytes") or 0)
     peak_b = float(mem_b.get("peak_bytes") or 0)
-    if peak_a > 0 and peak_b > peak_a * (1.0 + flag_frac):
+    if (peak_a > 0 and peak_b > peak_a * (1.0 + flag_frac)
+            and peak_b - peak_a >= flag_min_bytes):
         flagged.append("peak_bytes")
     return deltas, flagged
 

@@ -444,6 +444,46 @@ def _critical_path_findings(cp: Optional[Dict],
     return out
 
 
+#: a partition holding more than 2x the mean rows of its exchange is
+#: skewed enough to flag — the straggler partition alone bounds the
+#: stage's wall time, so past 2x half the fleet idles behind it
+_SKEW_FLAG_IMBALANCE = 2.0
+
+
+def _skew_findings(q) -> List[Finding]:
+    """v7 shuffle_skew records: exchanges whose output-partition row
+    distribution is imbalanced past ``_SKEW_FLAG_IMBALANCE``. Surfaces
+    the worst (exchange node, partition) pair per record — the straggler
+    every downstream task waits on."""
+    findings: List[Finding] = []
+    for rec in getattr(q, "shuffle_skew", []) or []:
+        rows = rec.get("rows") or {}
+        imbalance = float(rows.get("imbalance") or 1.0)
+        if imbalance <= _SKEW_FLAG_IMBALANCE:
+            continue
+        per_part = rec.get("per_partition_rows") or []
+        worst_part = (max(range(len(per_part)), key=per_part.__getitem__)
+                      if per_part else -1)
+        findings.append(Finding(
+            node=rec.get("name", "(exchange)"),
+            node_id=rec.get("node_id"),
+            metric="shuffleSkew", seconds=0.0,
+            # rank among other findings by how lopsided the exchange is:
+            # at 2x the straggler doubles the stage, at 4x quadruples it
+            fraction=min(1.0, imbalance / 10.0),
+            detail=f"partition {worst_part} holds {rows.get('max', 0)} "
+                   f"rows vs p50 {rows.get('p50', 0)} across "
+                   f"{rec.get('partitions', 0)} partitions "
+                   f"({imbalance:.1f}x the mean) — every downstream task "
+                   f"waits on it",
+            suggestion="skewed partition key — raise spark.rapids.tpu."
+                       "shuffle.partitions to dilute the hot key, "
+                       "repartition on a higher-cardinality key, or "
+                       "coalesce+rebalance upstream; salting the key "
+                       "splits a single hot group"))
+    return findings
+
+
 def _diagnose_query(q, heartbeats=None) -> Optional[QueryDiagnosis]:
     wall = getattr(q, "wall_s", 0.0)
     if wall <= 0 or getattr(q, "error", None):
@@ -586,6 +626,11 @@ def _diagnose_query(q, heartbeats=None) -> Optional[QueryDiagnosis]:
     # 7. memory flight recorder (schema v6): leaks, peak-HBM holders,
     # per-operator spill churn, OOM postmortems
     findings.extend(_memory_findings(q, wall))
+
+    # 8. partition skew (schema v7): exchanges whose output partitions
+    # are row-imbalanced past 2x — the straggler partition that bounds
+    # the downstream stage
+    findings.extend(_skew_findings(q))
 
     findings.sort(key=lambda f: -f.fraction)
     return QueryDiagnosis(q.query_id, wall, findings, critical_path=cp)

@@ -36,6 +36,12 @@ file per session (``spark.rapids.tpu.eventLog.dir``), one record per event:
   query — context, ranked holders-by-operator, live/peak bytes and the
   path of the full ``oom-<ts>.txt`` report (the record omits the report
   text; the file carries it)
+- ``shuffle_skew`` (schema v7): one per exchange node that materialized
+  during the query — the per-output-partition row/byte distribution
+  (min/p50/max/mean, imbalance ratio = max/mean, per-partition row
+  counts) computed from counts the exchange tiers already gather in
+  bulk; the partition-level telemetry ROADMAP items 3–4 consume and
+  the history server's regression sentinel watches
 - ``app_end``
 
 ``load_event_log`` replays a file into ``AppReplay``: per-query summaries,
@@ -55,15 +61,37 @@ from typing import Dict, List, Optional
 from ..conf import register_conf
 
 __all__ = ["EventLogWriter", "load_event_log", "AppReplay", "QueryReplay",
-           "EVENT_LOG_DIR", "SCHEMA_VERSION"]
+           "EVENT_LOG_DIR", "SCHEMA_VERSION", "RECORD_TYPES"]
 
 # Event-record schema version. Bump ONLY with a migration note in
 # docs/observability.md; tests/test_observability.py pins the current value
 # and the per-record required-key sets so replay/compare tooling can rely
-# on old logs staying loadable. v6: per-query memory_summary records
-# (per-operator HBM attribution + leak scan), oom_postmortem records, and
-# peak_device_bytes on node records.
-SCHEMA_VERSION = 6
+# on old logs staying loadable. v7: shuffle_skew records — per-exchange
+# output-partition row/byte distribution (min/p50/max/imbalance), the
+# telemetry the history server's regression sentinel and diagnose's skew
+# finding consume. (v6 added memory_summary/oom_postmortem records and
+# peak_device_bytes on node records.)
+SCHEMA_VERSION = 7
+
+# The event-record schema registry: every record type a writer may emit,
+# mapped to the schema version that introduced it. srtpu-analyze's
+# ``eventlog`` checker statically verifies that each
+# ``write({"event": ...})`` call site across the package names a
+# registered type, and that no registered type claims a version above
+# SCHEMA_VERSION — adding a record type without bumping the version (and
+# the docs/observability.md migration note) is flagged at analyze time.
+RECORD_TYPES: Dict[str, int] = {
+    "app_start": 1,
+    "query_start": 1,
+    "node": 1,
+    "query_end": 1,
+    "app_end": 1,
+    "kernel": 3,
+    "heartbeat": 4,
+    "memory_summary": 6,
+    "oom_postmortem": 6,
+    "shuffle_skew": 7,
+}
 
 EVENT_LOG_DIR = register_conf(
     "spark.rapids.tpu.eventLog.dir",
@@ -97,7 +125,7 @@ class EventLogWriter:
     def write_heartbeat(self, record: Dict) -> None:
         """One schema-v4 heartbeat record (utils/health.py supplies the
         flat sample dict; event type + wall-clock stamp added here)."""
-        self.write({"event": "heartbeat", "ts": time.time(), **record})
+        self.write({"event": "heartbeat", "ts": time.time(), **record})  # srtpu: eventlog-ok(health.py sample dicts are flat metric counters and never carry an event key)
 
     def next_query_id(self) -> int:
         self._query_seq += 1
@@ -174,6 +202,17 @@ class EventLogWriter:
                         "t_last": ns.t_last,
                         "peak_device_bytes": node_peaks.get(ns.node_id, 0),
                         "metrics": _node_metrics(ns)})
+        # schema v7: per-exchange output-partition row/byte distribution.
+        # Exchange nodes (both tiers + the host fallback) accumulate the
+        # per-partition counts they already gather during materialize and
+        # expose them via shuffle_skew(); one record per exchange that
+        # actually materialized in this query.
+        for ns in stats:
+            skew = _node_shuffle_skew(ns)
+            if skew is not None:
+                self.write({**skew, "event": "shuffle_skew",
+                            "query_id": qid, "node_id": ns.node_id,
+                            "name": ns.name})
         # schema v3: one kernel record per XLA program this query touched
         # (compile wall + cost/memory analysis keyed back to node ids)
         for entry in kernels_since(kseq_before):
@@ -212,8 +251,8 @@ class EventLogWriter:
         if mp is not None:
             for pm in mp.drain_postmortems():
                 rec = {k: v for k, v in pm.items() if k != "report"}
-                self.write({"event": "oom_postmortem", "query_id": qid,
-                            **rec})
+                self.write({**rec, "event": "oom_postmortem",
+                            "query_id": qid})
             summary = mp.query_end(qid)
         self.write({"event": "memory_summary", "query_id": qid,
                     "ts": time.time(), "summary": summary})
@@ -247,6 +286,20 @@ def _node_metrics(ns) -> Dict:
     return registry_snapshot(getattr(ns, "_node", None))
 
 
+def _node_shuffle_skew(ns) -> Optional[Dict]:
+    """The live node's accumulated per-partition distribution (v7), or
+    None for non-exchange nodes / exchanges that never materialized.
+    Never raises — skew telemetry must not fail a query."""
+    node = getattr(ns, "_node", None)
+    fn = getattr(node, "shuffle_skew", None)
+    if fn is None:
+        return None
+    try:
+        return fn()
+    except Exception:  # pragma: no cover — defensive
+        return None
+
+
 # ---------------------------------------------------------------------------
 # Replay
 # ---------------------------------------------------------------------------
@@ -275,6 +328,9 @@ class QueryReplay:
         # postmortems the query hit
         self.memory_summary: Optional[Dict] = None
         self.oom_postmortems: List[Dict] = []
+        # v7: per-exchange output-partition row/byte distribution records
+        # (empty for pre-v7 logs or queries with no materialized exchange)
+        self.shuffle_skew: List[Dict] = []
 
     def heartbeats_in_window(self, heartbeats: List[Dict]) -> List[Dict]:
         """App heartbeats whose timestamp falls inside this query's run
@@ -451,6 +507,10 @@ def load_event_log(path: str) -> AppReplay:
                 q = app.queries.setdefault(rec["query_id"],
                                            QueryReplay(rec["query_id"]))
                 q.oom_postmortems.append(rec)
+            elif ev == "shuffle_skew":
+                q = app.queries.setdefault(rec["query_id"],
+                                           QueryReplay(rec["query_id"]))
+                q.shuffle_skew.append(rec)
             elif ev == "query_end":
                 q = app.queries.setdefault(rec["query_id"],
                                            QueryReplay(rec["query_id"]))

@@ -1,0 +1,433 @@
+"""History-server UI: browse the persistent run store over HTTP.
+
+The Spark History Server analogue for the store ``tools/history.py``
+owns, in the ``tools/statusd.py`` style: stdlib ``http.server`` only, a
+``ThreadingHTTPServer`` on 127.0.0.1 whose serve loop runs on a named
+daemon thread, port 0 binds an ephemeral port, ``stop()`` shuts it
+down. Endpoints:
+
+- ``GET /`` — application list: one row per stored run (queries, wall,
+  errors, sentinel verdict) plus a total-wall trend sparkline across
+  runs, newest last.
+- ``GET /app/<app_id>`` — per-run page: query table with wall time,
+  errors, sync/compile counts, worst shuffle imbalance, links to the
+  per-query pages and a diff-against-any-other-run form.
+- ``GET /app/<app_id>/query/<qid>`` — per-query detail: the analyzed
+  plan tree with per-node SELF-time %% (tools/profiler.py
+  ``compute_self_times``, the one attribution rule EXPLAIN ANALYZE and
+  diagnose share), operator metric tables, critical-path category
+  breakdown, memory flight-recorder summary, kernel/compile table, and
+  the v7 shuffle-skew records.
+- ``GET /diff?a=<app>&b=<app>`` — two-run diff rendered from
+  ``tools/compare.py`` (A = baseline, B = candidate).
+- ``GET /healthz`` — liveness JSON (store root, runs indexed).
+- ``GET /metrics`` — Prometheus text: store size in bytes, runs
+  indexed, sentinel verdict counts by outcome — the counters a fleet
+  scraper needs to alert on a red sentinel without polling the UI.
+
+CLI: ``python -m spark_rapids_tpu.tools.historyd --dir STORE [--port N]``.
+"""
+from __future__ import annotations
+
+import html
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+from urllib.parse import parse_qs, urlparse
+
+from .history import HistoryStore
+
+__all__ = ["HistoryServer"]
+
+_STYLE = """
+body { font-family: monospace; margin: 1.5em; color: #222; }
+table { border-collapse: collapse; margin: 0.6em 0; }
+th, td { border: 1px solid #bbb; padding: 2px 8px; text-align: left; }
+th { background: #eee; }
+.bar { background: #4C78A8; display: inline-block; height: 0.7em; }
+.err { color: #b00; font-weight: bold; }
+.ok { color: #070; }
+a { color: #246; }
+pre { background: #f6f6f6; padding: 0.6em; overflow-x: auto; }
+"""
+
+
+def _page(title: str, body: str) -> str:
+    return (f"<!doctype html><html><head><title>{html.escape(title)}"
+            f"</title><style>{_STYLE}</style></head>"
+            f"<body><h2>{html.escape(title)}</h2>{body}</body></html>")
+
+
+def _sparkline(values: List[float], width: int = 220,
+               height: int = 36) -> str:
+    """Inline SVG polyline of a metric trend across runs (oldest →
+    newest); empty string with fewer than two points."""
+    if len(values) < 2:
+        return ""
+    vmax = max(values) or 1.0
+    n = len(values)
+    pts = []
+    for i, v in enumerate(values):
+        x = 4 + i * (width - 8) / (n - 1)
+        y = height - 4 - (v / vmax) * (height - 8)
+        pts.append(f"{x:.1f},{y:.1f}")
+    return (f"<svg xmlns='http://www.w3.org/2000/svg' width='{width}' "
+            f"height='{height}'><polyline points='{' '.join(pts)}' "
+            f"fill='none' stroke='#4C78A8' stroke-width='1.5'/>"
+            f"<circle cx='{pts[-1].split(',')[0]}' "
+            f"cy='{pts[-1].split(',')[1]}' r='2.5' fill='#4C78A8'/>"
+            "</svg>")
+
+
+def _fmt_bytes(n: float) -> str:
+    n = float(n or 0)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024
+    return f"{n:.1f}GiB"  # pragma: no cover — loop always returns
+
+
+def _verdict_cell(headline: Dict) -> str:
+    v = headline.get("verdict")
+    if not v:
+        return "-"
+    if v.get("ok"):
+        return "<span class='ok'>clean</span>"
+    return ("<span class='err'>REGRESSED</span> ("
+            + html.escape(",".join(v.get("flags", []))) + ")")
+
+
+class _HistoryHandler(BaseHTTPRequestHandler):
+    server_version = "spark-rapids-tpu-historyd"
+
+    def log_message(self, fmt, *args):  # no stderr chatter per request
+        pass
+
+    @property
+    def store(self) -> HistoryStore:
+        return self.server.store  # type: ignore[attr-defined]
+
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+        parsed = urlparse(self.path)
+        path = parsed.path.rstrip("/") or "/"
+        try:
+            if path == "/":
+                self._send(200, self._render_index(), "text/html")
+            elif path == "/healthz":
+                body = {"status": "ok", "store": self.store.root,
+                        "runs_indexed": len(self.store.index())}
+                self._send(200, json.dumps(body), "application/json")
+            elif path == "/metrics":
+                self._send(200, self._render_metrics(),
+                           "text/plain; version=0.0.4")
+            elif path == "/diff":
+                q = parse_qs(parsed.query)
+                a = (q.get("a") or [""])[0]
+                b = (q.get("b") or [""])[0]
+                self._send(200, self._render_diff(a, b), "text/html")
+            elif path.startswith("/app/"):
+                parts = path.split("/")
+                # /app/<id> or /app/<id>/query/<qid>
+                if len(parts) == 3:
+                    self._send(200, self._render_app(parts[2]),
+                               "text/html")
+                elif len(parts) == 5 and parts[3] == "query":
+                    self._send(200, self._render_query(
+                        parts[2], int(parts[4])), "text/html")
+                else:
+                    self._not_found()
+            else:
+                self._not_found()
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client went away mid-response
+        except (KeyError, FileNotFoundError, ValueError) as e:
+            self._send(404, _page("not found", f"<pre>{html.escape(str(e))}"
+                                               "</pre>"), "text/html")
+
+    def _not_found(self) -> None:
+        self._send(404, json.dumps(
+            {"error": "not found",
+             "endpoints": ["/", "/app/<app_id>",
+                           "/app/<app_id>/query/<qid>",
+                           "/diff?a=<app>&b=<app>", "/healthz",
+                           "/metrics"]}), "application/json")
+
+    # -- pages ----------------------------------------------------------------
+    def _render_index(self) -> str:
+        apps = self.store.apps()
+        walls = [h.get("total_wall_s", 0.0) for h in apps]
+        rows = []
+        for h in reversed(apps):  # newest first in the table
+            aid = html.escape(h["app_id"])
+            err = (f"<span class='err'>{h['n_errors']}</span>"
+                   if h.get("n_errors") else "0")
+            rows.append(
+                f"<tr><td><a href='/app/{aid}'>{aid}</a></td>"
+                f"<td>{h.get('n_queries', 0)}</td>"
+                f"<td>{h.get('total_wall_s', 0.0):.4f}</td>"
+                f"<td>{err}</td><td>{_verdict_cell(h)}</td></tr>")
+        spark = _sparkline(walls)
+        trend = (f"<p>total wall trend (oldest → newest): {spark}</p>"
+                 if spark else "")
+        body = (trend
+                + "<table><tr><th>application</th><th>queries</th>"
+                  "<th>total wall s</th><th>errors</th>"
+                  "<th>sentinel</th></tr>"
+                + "".join(rows) + "</table>"
+                + f"<p>{len(apps)} run(s) in {html.escape(self.store.root)}"
+                  "</p>")
+        return _page("query history", body)
+
+    def _render_app(self, app_id: str) -> str:
+        headline = self.store.index().get(app_id)
+        if headline is None:
+            raise KeyError(f"unknown application {app_id}")
+        aid = html.escape(app_id)
+        rows = []
+        for qid, q in sorted(headline.get("queries", {}).items(),
+                             key=lambda kv: int(kv[0])):
+            err = (f"<span class='err'>{html.escape(str(q['error']))}"
+                   "</span>" if q.get("error") else "")
+            skew = q.get("skew_imbalance")
+            rows.append(
+                f"<tr><td><a href='/app/{aid}/query/{qid}'>q{qid}</a></td>"
+                f"<td>{q.get('wall_s', 0.0):.4f}</td>"
+                f"<td>{q.get('rows', 0)}</td>"
+                f"<td>{_fmt_bytes(q.get('peak_bytes', 0))}</td>"
+                f"<td>{q.get('sync_count', 0)}</td>"
+                f"<td>{q.get('compile_count', 0)}</td>"
+                f"<td>{'' if skew is None else f'{skew:.2f}x'}</td>"
+                f"<td>{err}</td></tr>")
+        others = [h["app_id"] for h in self.store.apps()
+                  if h["app_id"] != app_id]
+        diff_links = " ".join(
+            f"<a href='/diff?a={html.escape(o)}&b={aid}'>vs {html.escape(o)}"
+            "</a>" for o in others[-5:])
+        verdict = self.store.verdict(app_id)
+        vblock = ""
+        if verdict:
+            status = ("<span class='ok'>clean</span>" if verdict.get("ok")
+                      else "<span class='err'>REGRESSED</span>")
+            vblock = (f"<p>sentinel: {status} vs baseline "
+                      f"{html.escape(str(verdict.get('baseline')))} — "
+                      f"flags: {html.escape(','.join(verdict.get('flags', [])) or 'none')}</p>")
+        body = ("<p><a href='/'>← all runs</a></p>" + vblock
+                + "<table><tr><th>query</th><th>wall s</th><th>rows</th>"
+                  "<th>peak HBM</th><th>syncs</th><th>compiles</th>"
+                  "<th>worst skew</th><th>error</th></tr>"
+                + "".join(rows) + "</table>"
+                + (f"<p>diff this run (as candidate B): {diff_links}</p>"
+                   if diff_links else ""))
+        return _page(f"run {app_id}", body)
+
+    def _render_query(self, app_id: str, qid: int) -> str:
+        from .profiler import compute_self_times
+        app = self.store.load(app_id)
+        q = app.query(qid)
+        aid = html.escape(app_id)
+        self_s = compute_self_times(q.nodes)
+        total_self = sum(self_s.values()) or 1.0
+        # plan tree with self-time %
+        rows = []
+        for n in q.nodes:
+            frac = self_s.get(n["node_id"], 0.0) / total_self
+            indent = "&nbsp;" * 2 * n.get("depth", 0)
+            bar = f"<span class='bar' style='width:{frac * 120:.0f}px'></span>"
+            rows.append(
+                f"<tr><td>{indent}{html.escape(n['name'])}</td>"
+                f"<td>{html.escape(n.get('desc', '')[:60])}</td>"
+                f"<td>{n.get('wall_s', 0.0):.4f}</td>"
+                f"<td>{self_s.get(n['node_id'], 0.0):.4f}</td>"
+                f"<td>{frac:.1%} {bar}</td>"
+                f"<td>{n.get('rows', 0)}</td>"
+                f"<td>{_fmt_bytes(n.get('peak_device_bytes', 0))}</td>"
+                "</tr>")
+        plan_tbl = ("<h3>plan (self-time attribution)</h3>"
+                    "<table><tr><th>operator</th><th>desc</th>"
+                    "<th>wall s</th><th>self s</th><th>self %</th>"
+                    "<th>rows</th><th>peak HBM</th></tr>"
+                    + "".join(rows) + "</table>")
+        # per-node metric snapshots
+        mrows = []
+        for n in q.nodes:
+            for k, v in sorted((n.get("metrics") or {}).items()):
+                mrows.append(f"<tr><td>{html.escape(n['name'])}</td>"
+                             f"<td>{html.escape(k)}</td><td>{v}</td></tr>")
+        metrics_tbl = ("<h3>operator metrics</h3><table><tr><th>operator"
+                       "</th><th>metric</th><th>value</th></tr>"
+                       + "".join(mrows) + "</table>") if mrows else ""
+        # critical path
+        cp_tbl = ""
+        if q.critical_path:
+            cats = q.critical_path.get("categories_s", {})
+            fracs = q.critical_path.get("fractions", {})
+            crow = "".join(
+                f"<tr><td>{html.escape(k)}</td><td>{v:.4f}</td>"
+                f"<td>{fracs.get(k, 0.0):.1%}</td></tr>"
+                for k, v in sorted(cats.items(), key=lambda kv: -kv[1]))
+            cp_tbl = ("<h3>critical path</h3><table><tr><th>category</th>"
+                      "<th>seconds</th><th>share</th></tr>" + crow
+                      + "</table>")
+        # memory summary
+        mem_tbl = ""
+        ms = q.memory_summary
+        if ms:
+            per_op = ms.get("per_operator") or {}
+            orow = "".join(
+                f"<tr><td>{html.escape(op)}</td>"
+                f"<td>{_fmt_bytes(d.get('peak_bytes', 0))}</td>"
+                f"<td>{_fmt_bytes(d.get('spilled_bytes', 0))}</td></tr>"
+                for op, d in sorted(
+                    per_op.items(),
+                    key=lambda kv: -(kv[1].get("peak_bytes") or 0)))
+            mem_tbl = (f"<h3>memory (peak {_fmt_bytes(ms.get('peak_bytes', 0))}"
+                       ")</h3><table><tr><th>operator</th><th>peak</th>"
+                       "<th>spilled</th></tr>" + orow + "</table>")
+        # kernel / compile table
+        k_tbl = ""
+        if q.kernels:
+            krow = "".join(
+                f"<tr><td>{html.escape(str(k.get('node_name') or ''))}</td>"
+                f"<td>{html.escape(k.get('signature', '')[:48])}</td>"
+                f"<td>{k.get('compiles', 0)}</td><td>{k.get('hits', 0)}</td>"
+                f"<td>{k.get('misses', 0)}</td>"
+                f"<td>{k.get('compile_s', 0.0):.4f}</td></tr>"
+                for k in q.kernels)
+            k_tbl = ("<h3>kernels (XLA programs)</h3><table><tr>"
+                     "<th>operator</th><th>signature</th><th>compiles</th>"
+                     "<th>hits</th><th>misses</th><th>compile s</th></tr>"
+                     + krow + "</table>")
+        # shuffle skew (v7)
+        skew_tbl = ""
+        if q.shuffle_skew:
+            srow = "".join(
+                f"<tr><td>{html.escape(r.get('name', ''))} "
+                f"(node {r.get('node_id')})</td>"
+                f"<td>{r.get('partitions')}</td>"
+                f"<td>{r['rows'].get('min')}/{r['rows'].get('p50')}/"
+                f"{r['rows'].get('max')}</td>"
+                f"<td>{r['rows'].get('imbalance', 1.0):.2f}x</td>"
+                f"<td>{_fmt_bytes(r['bytes'].get('max', 0))}</td></tr>"
+                for r in q.shuffle_skew)
+            skew_tbl = ("<h3>shuffle skew (v7)</h3><table><tr>"
+                        "<th>exchange</th><th>partitions</th>"
+                        "<th>rows min/p50/max</th><th>imbalance</th>"
+                        "<th>max partition bytes</th></tr>" + srow
+                        + "</table>")
+        err = (f"<p class='err'>ERROR: {html.escape(q.error)}</p>"
+               if q.error else "")
+        body = (f"<p><a href='/app/{aid}'>← run {aid}</a></p>" + err
+                + f"<p>wall {q.wall_s:.4f}s</p>"
+                + plan_tbl + cp_tbl + mem_tbl + skew_tbl + k_tbl
+                + metrics_tbl)
+        return _page(f"{app_id} — query {qid}", body)
+
+    def _render_diff(self, a: str, b: str) -> str:
+        from .compare import compare_apps
+        report = compare_apps(self.store.load(a), self.store.load(b))
+        back = (f"<p><a href='/app/{html.escape(b)}'>← run "
+                f"{html.escape(b)}</a></p>")
+        return _page(f"diff {a} → {b}",
+                     back + f"<pre>{html.escape(report.summary())}</pre>")
+
+    def _render_metrics(self) -> str:
+        index = self.store.index()
+        verdicts = {"clean": 0, "regressed": 0, "none": 0}
+        for h in index.values():
+            v = h.get("verdict")
+            if v is None:
+                verdicts["none"] += 1
+            elif v.get("ok"):
+                verdicts["clean"] += 1
+            else:
+                verdicts["regressed"] += 1
+        lines = [
+            "# HELP spark_rapids_tpu_history_runs_indexed runs in the "
+            "history store index",
+            "# TYPE spark_rapids_tpu_history_runs_indexed gauge",
+            f"spark_rapids_tpu_history_runs_indexed {len(index)}",
+            "# HELP spark_rapids_tpu_history_store_bytes total bytes on "
+            "disk under the store root",
+            "# TYPE spark_rapids_tpu_history_store_bytes gauge",
+            f"spark_rapids_tpu_history_store_bytes "
+            f"{self.store.store_size_bytes()}",
+            "# HELP spark_rapids_tpu_history_sentinel_verdicts runs by "
+            "sentinel outcome",
+            "# TYPE spark_rapids_tpu_history_sentinel_verdicts gauge",
+        ]
+        for outcome, count in sorted(verdicts.items()):
+            lines.append(
+                "spark_rapids_tpu_history_sentinel_verdicts"
+                f'{{outcome="{outcome}"}} {count}')
+        return "\n".join(lines) + "\n"
+
+    def _send(self, code: int, body: str, ctype: str) -> None:
+        payload = body.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+
+class HistoryServer:
+    """Background HTTP server bound to 127.0.0.1 serving one history
+    store. Request handling is threaded (daemon threads); the serve loop
+    runs on a named daemon thread like statusd's."""
+
+    def __init__(self, store: HistoryStore, port: int = 0,
+                 host: str = "127.0.0.1"):
+        self._httpd = ThreadingHTTPServer((host, port), _HistoryHandler)
+        self._httpd.daemon_threads = True
+        self._httpd.store = store  # type: ignore[attr-defined]
+        self.store = store
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "HistoryServer":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever, daemon=True,
+                name="tpu-history-httpd")
+            self._thread.start()
+        return self
+
+    def stop(self, timeout_s: float = 5.0) -> None:
+        t, self._thread = self._thread, None
+        if t is None:
+            return
+        self._httpd.shutdown()
+        t.join(timeout=timeout_s)
+        self._httpd.server_close()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="python -m spark_rapids_tpu.tools.historyd",
+        description="Serve the query-history store UI")
+    ap.add_argument("--dir", required=True, help="history store root")
+    ap.add_argument("--port", type=int, default=18081)
+    ap.add_argument("--host", default="127.0.0.1")
+    args = ap.parse_args(argv)
+    server = HistoryServer(HistoryStore(args.dir), args.port,
+                           args.host).start()
+    print(f"history server on {server.url} (store {server.store.root})")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

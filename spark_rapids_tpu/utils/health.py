@@ -105,6 +105,14 @@ class HealthMonitor:
         self.interval_s = int(conf.get(HEALTH_INTERVAL_MS)) / 1000.0
         self.stall_timeout_s = float(conf.get(HEALTH_STALL_TIMEOUT))
         self.report_dir = str(conf.get(HEALTH_REPORT_DIR) or "")
+        # /status links the run's history store (tools/historyd.py UI);
+        # string key: tools.history registers the entry lazily and utils
+        # must not import the tools layer
+        try:
+            self.history_dir = str(
+                conf.get("spark.rapids.tpu.history.dir") or "")
+        except KeyError:  # tools.history never imported, conf unset
+            self.history_dir = ""
         # returns the session's EventLogWriter or None (heartbeats must
         # not conjure a writer: no eventLog.dir -> no log)
         self._eventlog_fn = eventlog_fn or (lambda: None)
@@ -309,6 +317,15 @@ class HealthMonitor:
             else {"enabled": False},
             "active_operators": active_contexts(),
             "watermark_history": list(self.watermark_history)[-32:],
+            # link to the persistent cross-run store this session appends
+            # to on close; browse it with the command in "serve" (the
+            # history UI runs out-of-process, so no port to link here)
+            "history": {
+                "store_dir": self.history_dir,
+                "serve": ("python -m spark_rapids_tpu.tools.historyd "
+                          f"--dir {self.history_dir}")
+                if self.history_dir else None,
+            },
         }
 
     # -- stall forensics -------------------------------------------------------

@@ -24,7 +24,36 @@ from contextlib import contextmanager
 from typing import Callable, Dict, List, Optional
 
 __all__ = ["MetricLevel", "Metric", "Histogram", "MetricRegistry",
-           "StatsRegistry", "get_stats", "reset_stats"]
+           "StatsRegistry", "get_stats", "reset_stats", "skew_summary"]
+
+
+def skew_summary(values: List) -> Dict:
+    """Distribution summary of one per-partition series (rows or bytes)
+    for the event-log v7 ``shuffle_skew`` records: min/p50/max/mean and
+    the imbalance ratio max/mean (1.0 = perfectly balanced; the diagnose
+    skew finding flags > 2.0). Partition counts are small, so a sort is
+    cheaper than carrying a sketch."""
+    if not values:
+        return {"min": 0, "p50": 0, "max": 0, "mean": 0.0,
+                "imbalance": 1.0}
+    ordered = sorted(int(v) for v in values)
+    mean = sum(ordered) / len(ordered)
+    return {"min": ordered[0],
+            "p50": ordered[len(ordered) // 2],
+            "max": ordered[-1],
+            "mean": mean,
+            "imbalance": (ordered[-1] / mean) if mean > 0 else 1.0}
+
+
+def build_skew_record(per_rows: List, per_bytes: List) -> Dict:
+    """The shared payload of a v7 ``shuffle_skew`` record, built from one
+    exchange's per-output-partition row and byte series. Lives here (not
+    tools/eventlog.py) so all three exchange tiers can call it without an
+    exec → tools import edge."""
+    return {"partitions": len(per_rows),
+            "rows": skew_summary(per_rows),
+            "bytes": skew_summary(per_bytes),
+            "per_partition_rows": [int(r) for r in per_rows]}
 
 
 class MetricLevel:
@@ -401,6 +430,11 @@ def _memprof_source() -> Dict:
     return memprof_stats()
 
 
+def _host_sync_source() -> Dict:
+    from ..columnar.device import host_sync_stats
+    return host_sync_stats()
+
+
 _DEFAULT_SOURCES = {
     "compile_cache": _compile_cache_source,
     "catalog": _catalog_source,
@@ -410,6 +444,7 @@ _DEFAULT_SOURCES = {
     "pipeline": _pipeline_source,
     "tracer": _tracer_source,
     "memprof": _memprof_source,
+    "host_sync": _host_sync_source,
 }
 
 _GLOBAL_STATS: Optional[StatsRegistry] = None

@@ -113,3 +113,33 @@ def test_qualify_event_log(session, tmp_path):
     assert rep.queries and 0.0 <= rep.score <= 1.0
     assert rep.estimated_speedup >= 1.0
     assert "qualification" in rep.summary()
+
+
+def test_event_log_shuffle_skew_records_v7(tmp_path):
+    """The v7 record: every materialized exchange in a logged app emits
+    one shuffle_skew record whose headline imbalance is max/mean of its
+    own per-partition row counts, and replay surfaces them per query."""
+    import json
+
+    from spark_rapids_tpu.tools.eventlog import (RECORD_TYPES,
+                                                 SCHEMA_VERSION)
+    assert SCHEMA_VERSION == 7 and RECORD_TYPES["shuffle_skew"] == 7
+    path = _run_app(tmp_path)  # host-tier group-by shuffle, 4 partitions
+    records = [json.loads(line) for line in open(path, encoding="utf-8")]
+    skews = [r for r in records if r["event"] == "shuffle_skew"]
+    assert skews, "no shuffle_skew records in a shuffling app"
+    for rec in skews:
+        per = rec["per_partition_rows"]
+        assert rec["partitions"] == len(per) == 4
+        assert rec["rows"]["min"] == min(per)
+        assert rec["rows"]["max"] == max(per)
+        mean = sum(per) / len(per)
+        assert abs(rec["rows"]["imbalance"] - max(per) / mean) < 1e-9
+        assert rec["bytes"]["imbalance"] >= 1.0
+    # replay: the records land on the query that ran the exchange
+    app = load_event_log(path)
+    assert any(q.shuffle_skew for q in app.queries.values())
+    for q in app.queries.values():
+        for rec in q.shuffle_skew:
+            assert {"event", "query_id", "node_id", "name", "partitions",
+                    "rows", "bytes", "per_partition_rows"} <= set(rec)

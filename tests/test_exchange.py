@@ -216,3 +216,107 @@ def test_hash_strategies_over_mesh():
                   "w": rng.uniform(0, 1, 16)}), num_partitions=2)
     jd = df.join(dim, on="k", how="inner").collect(device=True)
     assert jd.num_rows == n
+
+
+def test_ici_exchange_skew_record_matches_partition_counts():
+    """v7 skew telemetry parity (device tier): the shuffle_skew() record
+    an exchange exposes after materializing must agree with its actual
+    per-output-partition row counts — same totals, and the headline
+    imbalance IS max/mean of the published distribution. A shuffled-hash
+    join carries raw rows through the exchange (a group-by would
+    partial-aggregate the hot key away upstream), so a lopsided keyspace
+    shows up as a lopsided partition."""
+    from spark_rapids_tpu.exec.exchange import TpuShuffleExchangeExec
+    sess = _mesh_session(**{
+        "spark.rapids.tpu.autoBroadcastJoinThreshold": -1})
+    rng = np.random.default_rng(21)
+    nrows = 600
+    # deliberately lopsided keyspace: ~85% of rows share one hot key, so
+    # one hash partition dwarfs the rest
+    k = np.where(rng.uniform(size=nrows) < 0.85, 7,
+                 rng.integers(0, 40, nrows)).astype("int64")
+    left = sess.create_dataframe(
+        pa.table({"k": k, "v": rng.uniform(0, 10, nrows)}),
+        num_partitions=3)
+    right = sess.create_dataframe(
+        pa.table({"k": np.arange(40, dtype=np.int64),
+                  "w": rng.uniform(0, 1, 40)}), num_partitions=2)
+    q = left.join(right, on="k", how="inner")
+    plan = sess._physical(q.logical, device=True)
+    ex = _find(plan, TpuShuffleExchangeExec)
+    assert ex is not None, plan.tree_string()
+    assert ex.shuffle_skew() is None  # nothing materialized yet
+    plan.collect()
+    rec = ex.shuffle_skew()
+    assert rec is not None
+    per = rec["per_partition_rows"]
+    # device tier shards across the attached 8-device mesh
+    assert rec["partitions"] == len(per) == 8
+    assert sum(per) in (nrows, 40)  # whichever join side this exchange is
+    assert rec["rows"]["min"] == min(per)
+    assert rec["rows"]["max"] == max(per)
+    mean = sum(per) / len(per)
+    assert rec["rows"]["imbalance"] == pytest.approx(max(per) / mean)
+    # byte estimates follow the same shape: heaviest partition also
+    # carries the most bytes
+    assert rec["bytes"]["max"] >= rec["bytes"]["p50"]
+    # SOME exchange in the plan carried the raw hot-key side: its
+    # distribution must cross the diagnose 2x flag
+    def _all(plan, cls, out):
+        if isinstance(plan, cls):
+            out.append(plan)
+        for c in plan.children:
+            _all(c, cls, out)
+        return out
+    recs = [e.shuffle_skew() for e in _all(plan, TpuShuffleExchangeExec, [])]
+    recs = [r for r in recs if r is not None]
+    raw = [r for r in recs if sum(r["per_partition_rows"]) == nrows]
+    assert raw and raw[0]["rows"]["imbalance"] > 2.0, recs
+
+
+def test_host_exchange_skew_record_matches_partition_counts():
+    """v7 skew telemetry parity (host fallback tier): same contract as
+    the device tier, via the host hash-partition ShuffleExchangeExec."""
+    from spark_rapids_tpu.plan.physical import ShuffleExchangeExec
+    sess = TpuSession({
+        "spark.rapids.tpu.batchRowsMinBucket": 8,
+        "spark.rapids.tpu.shuffle.partitions": 4,
+        "spark.rapids.tpu.shuffle.mode": "host",
+        "spark.rapids.tpu.aqe.enabled": False,
+        "spark.rapids.tpu.autoBroadcastJoinThreshold": -1,
+    })
+    rng = np.random.default_rng(22)
+    nrows = 400
+    k = np.where(rng.uniform(size=nrows) < 0.8, 3,
+                 rng.integers(0, 30, nrows)).astype("int64")
+    left = sess.create_dataframe(
+        pa.table({"k": k, "v": rng.uniform(0, 1, nrows)}),
+        num_partitions=2)
+    right = sess.create_dataframe(
+        pa.table({"k": np.arange(30, dtype=np.int64),
+                  "w": rng.uniform(0, 1, 30)}), num_partitions=2)
+    q = left.join(right, on="k", how="inner")
+    plan = sess._physical(q.logical, device=False)
+    ex = _find(plan, ShuffleExchangeExec)
+    assert ex is not None, plan.tree_string()
+    plan.collect()
+
+    def _all(plan, cls, out):
+        if isinstance(plan, cls):
+            out.append(plan)
+        for c in plan.children:
+            _all(c, cls, out)
+        return out
+    recs = [e.shuffle_skew()
+            for e in _all(plan, ShuffleExchangeExec, [])]
+    recs = [r for r in recs if r is not None]
+    assert recs
+    for rec in recs:
+        per = rec["per_partition_rows"]
+        assert rec["partitions"] == len(per) == 4
+        assert rec["rows"]["min"] == min(per)
+        assert rec["rows"]["max"] == max(per)
+        mean = sum(per) / len(per)
+        assert rec["rows"]["imbalance"] == pytest.approx(max(per) / mean)
+    raw = [r for r in recs if sum(r["per_partition_rows"]) == nrows]
+    assert raw and raw[0]["rows"]["imbalance"] > 2.0, recs

@@ -295,6 +295,13 @@ def test_oom_callback_exception_is_logged():
     cat.register_oom_callback(bad_callback)
     with pytest.warns(RuntimeWarning, match="OOM callback .* failed"):
         cat.handle_device_oom("unit test")
+    # the empty catalog had nothing to spill, so if the sticky process
+    # global memory profiler is active this queued a postmortem — drain
+    # it so it can't leak into the next logged app's event log
+    from spark_rapids_tpu.utils.memprof import active
+    mp = active()
+    if mp is not None:
+        mp.drain_postmortems()
     assert cat.oom_callback_errors == 1
     assert any("boom from cache dropper" in d for d in cat.diagnostics)
     assert cat.counters()["oom_callback_errors"] == 1
@@ -341,6 +348,11 @@ _REQUIRED_KEYS = {
     # are pinned separately (test_eventlog_oom_postmortem_record_keys
     # in tests/test_memprof.py)
     "memory_summary": {"event", "query_id", "ts", "summary"},
+    # v7: per-exchange output-partition row/byte distribution — one per
+    # exchange node that materialized (the host-tier group-by shuffle in
+    # _run_logged_app below guarantees at least one)
+    "shuffle_skew": {"event", "query_id", "node_id", "name", "partitions",
+                     "rows", "bytes", "per_partition_rows"},
     "app_end": {"event", "ts"},
 }
 
@@ -348,6 +360,13 @@ _REQUIRED_KEYS = {
 def _run_logged_app(tmp_path):
     from spark_rapids_tpu.session import TpuSession
     from spark_rapids_tpu.expr.functions import col, sum as f_sum
+    from spark_rapids_tpu.utils.memprof import active
+    mp = active()
+    if mp is not None:
+        # postmortems queued by earlier tests against the sticky process
+        # global profiler would otherwise drain into THIS app's log and
+        # break the exact record-type-set assertion below
+        mp.drain_postmortems()
     sess = TpuSession({
         "spark.rapids.tpu.eventLog.dir": str(tmp_path),
         "spark.rapids.tpu.batchRowsMinBucket": 8,
@@ -381,8 +400,9 @@ def test_eventlog_schema_version_and_required_keys(tmp_path):
     # identity: trace_id on query_start/query_end, critical_path on
     # query_end (null when tracing is off, as here). v6 adds the memory
     # flight recorder: per-query memory_summary, peak_device_bytes on
-    # node records, oom_postmortem records on OOM
-    assert SCHEMA_VERSION == 6
+    # node records, oom_postmortem records on OOM. v7 adds shuffle_skew:
+    # per-exchange output-partition distribution records
+    assert SCHEMA_VERSION == 7
     assert by_type["app_start"][0]["schema_version"] == SCHEMA_VERSION
     for kind, required in _REQUIRED_KEYS.items():
         for rec in by_type[kind]:
@@ -583,7 +603,7 @@ def test_eventlog_query_stats_cover_all_subsystems(tmp_path):
     from spark_rapids_tpu.tools.eventlog import load_event_log
     path = _run_logged_app(tmp_path)
     app = load_event_log(path)
-    assert app.schema_version == 6
+    assert app.schema_version == 7
     q = app.query(1)
     assert q.stats, "query_end stats delta missing"
     for family in ("compile_cache_", "upload_cache_", "shuffle_",
