@@ -59,7 +59,15 @@ override with BENCH_HISTORY_DIR) and the regression sentinel
 (tools/history.py) compares it against the previous round's pinned
 baseline — wall/critical-path/memory plus the sync-count and
 compile-count gates — writing a "history" verdict per phase into the
-bench JSON and pinning this run as the next round's baseline).
+bench JSON and pinning this run as the next round's baseline),
+BENCH_CHAOS (1 opt-in: recovery-parity phase — each query runs twice on
+a 2-worker ProcessCluster, clean then under a deterministic worker-kill
+fault spec; the chaos answer must match the clean answer and the
+driver's recovery ledger must show the kill actually landed, recorded
+as "chaos" in the bench JSON with the recovery overhead;
+BENCH_CHAOS_SF scales the data), and the history sentinel treats a
+recovered-but-correct chaos run as clean (run_sentinel exempts queries
+whose event log carries fault records and no error).
 """
 import atexit
 import json
@@ -84,6 +92,7 @@ _STATE = {
     "errors": {},
     "ablation": {},
     "restart": {},
+    "chaos": {},      # query -> clean-vs-injected parity + recovery ledger
     "compile_cache": {},   # phase -> cache_stats() snapshot
     "sf": None,
     "rows": None,
@@ -491,6 +500,8 @@ def main():
         phase_with_retries("tpch", _TPCH_ORDER)
     if os.environ.get("BENCH_ABLATION", "1") != "0" and _remaining() > 120:
         phase_with_retries("ablation", None)
+    if os.environ.get("BENCH_CHAOS", "0") == "1" and _remaining() > 120:
+        phase_with_retries("chaos", [1, 3])
     _emit(reason="done")
 
 
@@ -1115,6 +1126,73 @@ def _worker_restart(sink: _EventSink):
     _bench_sentinel(sink, "restart")
 
 
+def _worker_chaos(sink: _EventSink):
+    """BENCH_CHAOS=1: the recovery-parity phase. Each query runs twice
+    on a 2-worker ProcessCluster — clean, then under a deterministic
+    worker-kill spec — and passes only if the chaos answer matches the
+    clean answer AND the driver's recovery ledger proves a worker
+    actually died and its tasks were resubmitted. shuffle.partitions is
+    pinned to 2 so each worker process evaluates the worker.task fault
+    point exactly once per query and after=1:times=1 kills exactly one
+    worker mid-query. The recovery overhead lands in the bench JSON;
+    the history sentinel never flags it because run_sentinel exempts
+    queries whose event log shows fault records and no error."""
+    _worker_setup_jax()
+    from spark_rapids_tpu.parallel.runtime import ProcessCluster
+    from spark_rapids_tpu.utils import faults
+    sf = float(os.environ.get("BENCH_CHAOS_SF", "0.01"))
+    queries = os.environ.get("BENCH_WORKER_QUERIES", "1,3").split(",")
+    base = {"spark.rapids.tpu.shuffle.partitions": "2"}
+    chaos = {**base,
+             "spark.rapids.tpu.faults.enabled": "true",
+             "spark.rapids.tpu.faults.seed": "7",
+             "spark.rapids.tpu.faults.spec":
+                 "worker.task:after=1:times=1:action=kill",
+             "spark.rapids.tpu.task.heartbeatInterval": "0.5"}
+
+    def _cluster_run(name, conf):
+        cl = ProcessCluster(2, conf=conf)
+        try:
+            t0 = time.perf_counter()
+            table = cl.run_tpch_query(name, sf=sf, tiny=True,
+                                      num_partitions=2, timeout_s=180)
+            return table, time.perf_counter() - t0
+        finally:
+            cl.close()
+
+    for qn in queries:
+        name = f"q{qn}"
+        sink.emit(ev="start", name=name)
+        try:
+            ref, base_s = _cluster_run(name, base)
+            faults.reset_recovery()
+            got, chaos_s = _cluster_run(name, chaos)
+            rec = {k: v for k, v in faults.recovery_counters().items()
+                   if v}
+            err = _tables_equal(got, ref)
+            if not (err <= _rel_tol()):
+                raise AssertionError(
+                    f"chaos run diverged from clean run: rel_err={err}")
+            if not rec.get("worker_deaths"):
+                raise AssertionError(
+                    "fault spec fired no worker kill; nothing recovered")
+            res = {"base_s": round(base_s, 4),
+                   "chaos_s": round(chaos_s, 4),
+                   "overhead": round(chaos_s / base_s, 3)
+                   if base_s > 0 else None,
+                   "rel_err": err, "rows": got.num_rows,
+                   "recovery": rec}
+            sink.emit(ev="done", phase="chaos", name=name, res=res)
+            _log(f"chaos {name}: clean={base_s:.3f}s "
+                 f"injected={chaos_s:.3f}s deaths="
+                 f"{rec.get('worker_deaths')} resubmits="
+                 f"{rec.get('task_resubmissions')} rel_err={err:.2e}")
+        except Exception as e:
+            sink.emit(ev="error", name=name,
+                      msg=f"{type(e).__name__}: {e}"[:300])
+            _log(f"chaos {name} FAILED: {e}")
+
+
 def worker_main(phase: str):
     sink = _EventSink()
     if phase == "smoke":
@@ -1125,6 +1203,8 @@ def worker_main(phase: str):
         _worker_ablation(sink)
     elif phase == "restart":
         _worker_restart(sink)
+    elif phase == "chaos":
+        _worker_chaos(sink)
     else:
         raise SystemExit(f"unknown worker phase {phase!r}")
 

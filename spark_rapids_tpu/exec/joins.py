@@ -1255,7 +1255,7 @@ def _close_quietly(handle):
     try:
         handle.close()
     except Exception:
-        pass
+        pass  # srtpu: net-ok(best-effort release of an already-consumed spill handle; the data was read before this)
 
 
 class TpuBroadcastNestedLoopJoinExec(TpuExec):

@@ -19,6 +19,7 @@ from ..columnar.device import (DeviceTable, bucket_rows,
 from ..columnar.host import HostTable
 from ..conf import register_conf
 from ..plan.physical import PhysicalPlan
+from ..utils import faults
 from ..utils import metrics as M
 from ..utils.tracing import get_tracer
 from .base import TpuExec
@@ -170,6 +171,9 @@ class HostToDeviceExec(TpuExec):
         if not self.cache_max_bytes:
             with get_tracer().span("h2d_upload", "upload",
                                    rows=int(batch.num_rows)):  # srtpu: sync-ok(HostTable.num_rows is a host int on the upload side)
+                action = faults.fire("h2d.upload")
+                if action is not None and action != "delay":
+                    raise faults.FaultInjectedError("h2d.upload", action)
                 dtb = DeviceTable.from_host(batch, self.min_bucket)
             self.metrics.add(M.UPLOAD_BYTES, dtb.nbytes())
             return mark_exclusive(dtb)
@@ -186,6 +190,9 @@ class HostToDeviceExec(TpuExec):
             return hit
         with get_tracer().span("h2d_upload", "upload",
                                rows=int(batch.num_rows)):  # srtpu: sync-ok(HostTable.num_rows is a host int on the upload side)
+            action = faults.fire("h2d.upload")
+            if action is not None and action != "delay":
+                raise faults.FaultInjectedError("h2d.upload", action)
             dtb = DeviceTable.from_host(batch, self.min_bucket)
         nbytes = dtb.nbytes()
         self.metrics.add(M.UPLOAD_BYTES, nbytes)

@@ -54,6 +54,13 @@ DISK_SPILL_DIRECT = register_conf(
     "analogue; reference: RapidsGdsStore). false uses compact npz files.",
     True)
 
+DISK_SPILL_CHECKSUM = register_conf(
+    "spark.rapids.tpu.memory.disk.checksum",
+    "CRC32-checksum disk-spilled buffers on write and verify them on "
+    "restore; a mismatch raises SpillCorruptionError, which the shuffle "
+    "read path converts to fetch-failed -> recompute instead of serving "
+    "silently corrupt rows.", True)
+
 DEVICE_POOL_MAX_FRACTION = register_conf(
     "spark.rapids.memory.gpu.maxAllocFraction",
     "Upper bound on the fraction of device HBM the spillable pool may "
@@ -105,7 +112,9 @@ class BufferCatalog:
             host_limit = conf.get(HOST_SPILL_STORAGE_SIZE)
         self.device = DeviceStore(device_limit)
         self.host = HostStore(host_limit)
-        self.disk = DiskStore(disk_dir, direct=bool(conf.get(DISK_SPILL_DIRECT)))
+        self.disk = DiskStore(disk_dir,
+                              direct=bool(conf.get(DISK_SPILL_DIRECT)),
+                              checksum=bool(conf.get(DISK_SPILL_CHECKSUM)))
         self._buffers: Dict[int, StoredTable] = {}
         # persistent device-tier spill queue (reference: RapidsBufferStore's
         # HashedPriorityQueue — O(log n) membership updates instead of

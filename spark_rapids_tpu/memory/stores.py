@@ -15,9 +15,11 @@ dropping references (buffer donation to XLA's allocator).
 """
 from __future__ import annotations
 
+import json
 import os
 import tempfile
 import threading
+import zlib
 from typing import Dict, Optional, Tuple
 
 import jax
@@ -25,9 +27,22 @@ import numpy as np
 
 from ..columnar import dtypes as dt
 from ..columnar.device import DeviceColumn, DeviceTable
+from ..utils import faults
 
 __all__ = ["StorageTier", "StoredTable", "DeviceStore", "HostStore",
-           "DiskStore"]
+           "DiskStore", "SpillCorruptionError"]
+
+
+class SpillCorruptionError(RuntimeError):
+    """A disk-spilled buffer failed CRC32 verification on restore. The
+    shuffle read path converts this to fetch-failed -> recompute; any
+    other consumer sees data loss loudly instead of silently wrong
+    bytes."""
+
+    def __init__(self, path: str, detail: str):
+        super().__init__(f"spill file {path} failed integrity check: "
+                         f"{detail}")
+        self.path = path
 
 
 class StorageTier:
@@ -153,39 +168,115 @@ class DiskStore:
     — the closest a host runtime gets to storage->accelerator DMA. Non-
     direct mode keeps the compact one-file ``.npz`` layout."""
 
-    def __init__(self, directory: Optional[str] = None, direct: bool = True):
+    #: per-directory checksum sidecar (direct mode); never a spilled array
+    CHECKSUM_SIDECAR = "CHECKSUMS.json"
+
+    def __init__(self, directory: Optional[str] = None, direct: bool = True,
+                 checksum: bool = True):
         self.dir = directory or tempfile.mkdtemp(prefix="srt_spill_")
         self.direct = direct
+        self.checksum = checksum
         os.makedirs(self.dir, exist_ok=True)
         self.used_bytes = 0
 
+    @staticmethod
+    def _crc32_file(path: str) -> int:
+        crc = 0
+        with open(path, "rb") as f:
+            while True:
+                chunk = f.read(1 << 20)
+                if not chunk:
+                    return crc
+                crc = zlib.crc32(chunk, crc)
+
+    @staticmethod
+    def _corrupt_file(path: str) -> None:
+        """spill.write action=corrupt: flip one byte mid-file AFTER the
+        checksum was recorded, so restore must catch it."""
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.seek(size // 2)
+            b = f.read(1)
+            f.seek(size // 2)
+            f.write(bytes([b[0] ^ 0xFF]) if b else b"\xff")
+
     def put(self, stored: StoredTable):
         assert stored.host_arrays is not None
+        action = faults.fire("spill.write")
+        if action == "raise":
+            raise faults.FaultInjectedError("spill.write")
         if self.direct:
             d = os.path.join(self.dir, f"buf{stored.buffer_id}")
             os.makedirs(d, exist_ok=True)
             size = 0
+            crcs: Dict[str, int] = {}
+            files = []
             for k, arr in stored.host_arrays.items():
                 fp = os.path.join(d, f"{k}.npy")
                 np.save(fp, np.ascontiguousarray(arr))
                 size += os.path.getsize(fp)
+                files.append(fp)
+                if self.checksum:
+                    crcs[f"{k}.npy"] = self._crc32_file(fp)
+            if self.checksum:
+                sidecar = os.path.join(d, self.CHECKSUM_SIDECAR)
+                with open(sidecar, "w", encoding="utf-8") as f:
+                    json.dump(crcs, f)
+                size += os.path.getsize(sidecar)
+            if action == "corrupt" and files:
+                self._corrupt_file(files[len(files) // 2])
             stored.disk_path = d
         else:
             path = os.path.join(self.dir, f"buf{stored.buffer_id}.npz")
             np.savez(path, **stored.host_arrays)
             stored.disk_path = path
             size = os.path.getsize(path)
+            if self.checksum:
+                with open(path + ".crc", "w", encoding="utf-8") as f:
+                    f.write(str(self._crc32_file(path)))
+                size += os.path.getsize(path + ".crc")
+            if action == "corrupt":
+                self._corrupt_file(path)
         stored.host_arrays = None
         stored.tier = StorageTier.DISK
         self.used_bytes += size
 
+    def _verify(self, path: str, expected: int) -> None:
+        actual = self._crc32_file(path)
+        if actual != expected:
+            faults.note_recovery("spill_corruptions")
+            raise SpillCorruptionError(
+                path, f"crc32 {actual:#010x} != recorded {expected:#010x}")
+
     def load(self, stored: StoredTable) -> dict:
+        action = faults.fire("spill.read")
+        if action is not None and action != "delay":
+            faults.note_recovery("spill_corruptions")
+            raise SpillCorruptionError(stored.disk_path or "?",
+                                       "injected fault 'spill.read'")
         if os.path.isdir(stored.disk_path):
+            crcs: Optional[Dict[str, int]] = None
+            sidecar = os.path.join(stored.disk_path, self.CHECKSUM_SIDECAR)
+            if self.checksum and os.path.exists(sidecar):
+                with open(sidecar, "r", encoding="utf-8") as f:
+                    crcs = json.load(f)
             out = {}
             for fn in os.listdir(stored.disk_path):
-                out[fn[:-4]] = np.load(os.path.join(stored.disk_path, fn),
-                                       mmap_mode="r", allow_pickle=False)
+                if not fn.endswith(".npy"):
+                    continue  # the checksum sidecar is not an array
+                fp = os.path.join(stored.disk_path, fn)
+                if crcs is not None:
+                    if fn not in crcs:
+                        raise SpillCorruptionError(
+                            fp, "no recorded checksum for spilled array")
+                    self._verify(fp, int(crcs[fn]))
+                out[fn[:-4]] = np.load(fp, mmap_mode="r",
+                                       allow_pickle=False)
             return out
+        crc_path = stored.disk_path + ".crc"
+        if self.checksum and os.path.exists(crc_path):
+            with open(crc_path, "r", encoding="utf-8") as f:
+                self._verify(stored.disk_path, int(f.read().strip()))
         with np.load(stored.disk_path, allow_pickle=False) as z:
             return {k: z[k] for k in z.files}
 
@@ -193,7 +284,10 @@ class DiskStore:
         if os.path.isdir(path):
             return sum(os.path.getsize(os.path.join(path, f))
                        for f in os.listdir(path))
-        return os.path.getsize(path)
+        size = os.path.getsize(path)
+        if os.path.exists(path + ".crc"):
+            size += os.path.getsize(path + ".crc")
+        return size
 
     def drop(self, stored: StoredTable):
         if stored.disk_path and os.path.exists(stored.disk_path):
@@ -203,4 +297,6 @@ class DiskStore:
                 shutil.rmtree(stored.disk_path, ignore_errors=True)
             else:
                 os.unlink(stored.disk_path)
+                if os.path.exists(stored.disk_path + ".crc"):
+                    os.unlink(stored.disk_path + ".crc")
         stored.disk_path = None

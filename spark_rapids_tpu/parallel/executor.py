@@ -127,7 +127,7 @@ class FailureDetector:
                 try:
                     fn(e)
                 except Exception:
-                    pass
+                    pass  # srtpu: net-ok(a buggy listener must not stop the failure detector from notifying the remaining listeners)
         return newly
 
     def live(self) -> List[int]:

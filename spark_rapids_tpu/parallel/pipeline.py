@@ -308,12 +308,12 @@ def _attach_context(exc: BaseException, stage: str) -> BaseException:
         try:
             add_note(f"[spark-rapids-tpu] {note}")
         except Exception:
-            pass
+            pass  # srtpu: net-ok(annotating a propagating error is cosmetic; the original exception still raises either way)
     try:
         ctx = getattr(exc, "pipeline_context", ())
         exc.pipeline_context = tuple(ctx) + (stage,)
     except Exception:
-        pass  # exceptions with __slots__: the note (or type) is all we get
+        pass  # srtpu: net-ok(exceptions with slots reject new attributes; the note or type is all we get and the error still raises)
     return exc
 
 

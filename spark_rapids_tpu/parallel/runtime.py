@@ -27,11 +27,80 @@ from typing import Callable, Dict, List, Optional, Tuple
 import pyarrow as pa
 
 from ..columnar.host import HostTable
-from ..conf import RapidsConf
+from ..conf import RapidsConf, _positive, register_conf
 from ..shuffle.transport import LocalShuffleTransport, ShuffleTransport
+from ..utils import faults
 from .executor import ExecutorContext, FailureDetector
 
-__all__ = ["DriverRuntime", "LocalCluster"]
+__all__ = ["DriverRuntime", "LocalCluster", "ProcessCluster",
+           "TaskFailedError", "TaskTimeoutError"]
+
+TASK_TIMEOUT = register_conf(
+    "spark.rapids.tpu.task.timeout",
+    "Default seconds a ProcessCluster task may run before the driver gives "
+    "up and raises TaskTimeoutError with worker forensics (last heartbeat "
+    "age, pending-queue depth). Per-call override via run_on(timeout_s=...).",
+    300.0, checker=_positive("task timeout"))
+
+TASK_MAX_FAILURES = register_conf(
+    "spark.rapids.tpu.task.maxFailures",
+    "Times a task may be attempted across worker deaths before the driver "
+    "fails it with TaskFailedError (the spark.task.maxFailures analogue; "
+    "tasks are only re-attempted on worker loss, never on application "
+    "errors, which fail fast).",
+    4, checker=_positive("max failures"))
+
+TASK_RESPAWN_WORKERS = register_conf(
+    "spark.rapids.tpu.task.respawnWorkers",
+    "Replace a worker process that died on its own (crash, injected kill, "
+    "heartbeat wedge) with a fresh one on the same slot. Deliberate "
+    "ProcessCluster.kill() always excludes the slot instead.",
+    True)
+
+TASK_MAX_WORKER_RESPAWNS = register_conf(
+    "spark.rapids.tpu.task.maxWorkerRespawns",
+    "Respawns allowed per worker slot before the slot is excluded from "
+    "the cluster (the executor-exclusion analogue).",
+    2)
+
+TASK_HEARTBEAT_INTERVAL = register_conf(
+    "spark.rapids.tpu.task.heartbeatInterval",
+    "Seconds between worker heartbeat records on the result queue.",
+    2.0, checker=_positive("heartbeat interval"))
+
+TASK_HEARTBEAT_TIMEOUT = register_conf(
+    "spark.rapids.tpu.task.heartbeatTimeout",
+    "Seconds of heartbeat silence (measured only while the driver is "
+    "actively waiting on a task) before a live-looking worker process is "
+    "declared wedged, recycled, and its tasks resubmitted.",
+    60.0, checker=_positive("heartbeat timeout"))
+
+
+class TaskFailedError(RuntimeError):
+    """A ProcessCluster task failed terminally: its worker(s) died and the
+    task exhausted resubmission, or no live workers remain. Carries the
+    forensics the old silent 300s hang threw away."""
+
+    def __init__(self, message: str, *, task_id: Optional[int] = None,
+                 worker: Optional[int] = None, attempts: int = 0,
+                 history: Tuple[str, ...] = (),
+                 fault: Optional[str] = None,
+                 last_heartbeat_age_s: Optional[float] = None,
+                 pending_tasks: Optional[int] = None,
+                 exitcode: Optional[int] = None):
+        super().__init__(message)
+        self.task_id = task_id
+        self.worker = worker
+        self.attempts = attempts
+        self.history = tuple(history)
+        self.fault = fault
+        self.last_heartbeat_age_s = last_heartbeat_age_s
+        self.pending_tasks = pending_tasks
+        self.exitcode = exitcode
+
+
+class TaskTimeoutError(TaskFailedError):
+    """The task.timeout deadline expired while a task was in flight."""
 
 
 class DriverRuntime:
@@ -148,22 +217,43 @@ class LocalCluster:
 def _worker_main(worker_id: int, conf_values: dict, addr_q, task_q, result_q):
     # never let a worker grab the TPU tunnel (it admits one process);
     # jax.config is the only channel the axon plugin respects
+    import os
     import time
 
     import jax
     jax.config.update("jax_platforms", "cpu")
     from ..conf import RapidsConf
     from ..shuffle.tcp import TcpShuffleTransport
+    from ..utils import faults as wfaults
     from ..utils.tracing import (TRACE_DISTRIBUTED_DIR, TraceContext,
                                  activate_trace_context, configure_tracer,
                                  get_tracer)
     from .executor import ExecutorContext
 
     conf = RapidsConf(conf_values)
+    # per-worker seed offset decorrelates probabilistic chaos streams
+    # across workers while keeping every process deterministic
+    wfaults.configure_faults(conf, seed_offset=worker_id)
     tracer = configure_tracer(conf)
     tracer.process_name = f"worker-{worker_id}"
     transport = TcpShuffleTransport(conf)
     addr_q.put((worker_id, transport.address))
+
+    # heartbeat publisher: the driver's FailureDetector distinguishes a
+    # busy worker from a wedged one only through these records
+    hb_stop = threading.Event()
+    hb_interval = float(conf.get(TASK_HEARTBEAT_INTERVAL))
+
+    def _heartbeat_loop():
+        while not hb_stop.is_set():
+            try:
+                result_q.put((-1, "hb", (worker_id, time.time())))
+            except Exception:  # queue torn down mid-shutdown
+                return
+            hb_stop.wait(hb_interval)
+
+    threading.Thread(target=_heartbeat_loop, daemon=True,
+                     name=f"srtpu-worker-hb-{worker_id}").start()
     ctx = None
     try:
         while True:
@@ -178,6 +268,14 @@ def _worker_main(worker_id: int, conf_values: dict, addr_q, task_q, result_q):
                                       transport=transport).initialize()
                 result_q.put((tid, "ok", None))
                 continue
+            if kind == "addpeer":
+                # a respawned worker announcing its replacement address;
+                # the stale address stays in the peer list and simply
+                # fails fast on the next fetch attempt
+                host, port = payload
+                transport.add_peer(host, port)
+                result_q.put((tid, "ok", None))
+                continue
             if kind == "clock":
                 # clock handshake: the driver brackets this round trip and
                 # estimates our wall-clock offset NTP-style from the reply
@@ -186,21 +284,36 @@ def _worker_main(worker_id: int, conf_values: dict, addr_q, task_q, result_q):
                 continue
             fn, args = payload
             try:
+                action = wfaults.fire("worker.task")
+                if action == "kill":
+                    # simulate abrupt worker loss, but first tell the
+                    # driver which fault did it so TaskFailedError can
+                    # name it; flush the queue feeder thread before the
+                    # no-cleanup exit or the notice can be lost
+                    result_q.put((tid, "dying",
+                                  "injected fault 'worker.task' "
+                                  "(action=kill)"))
+                    result_q.close()
+                    result_q.join_thread()
+                    os._exit(13)
                 tctx = TraceContext.from_wire(ctx_wire)
                 with activate_trace_context(tctx), \
                         get_tracer().span("task", "task", worker=worker_id,
                                           fn=getattr(fn, "__name__", "?")):
+                    if action is not None:
+                        raise wfaults.FaultInjectedError("worker.task",
+                                                         action)
                     out = fn(ctx, *args)
                 result_q.put((tid, "ok", out))
             except Exception as e:  # surface to the driver, keep serving
                 result_q.put((tid, "err", f"{type(e).__name__}: {e}"))
     finally:
+        hb_stop.set()
         if ctx is not None:
             ctx.shutdown()
         transport.close()
         dump_dir = str(conf.get(TRACE_DISTRIBUTED_DIR))
         if dump_dir and tracer.enabled:
-            import os
             tracer.dump(os.path.join(
                 dump_dir, f"trace-{tracer.process_name}.json"))
 
@@ -225,15 +338,26 @@ class ProcessCluster:
         self._addr_q = self._mp.Queue()
         self._result_q = self._mp.Queue()
         self._task_qs = [self._mp.Queue() for _ in range(n_executors)]
-        rconf = RapidsConf(conf or {})
+        self._conf_values = dict(conf or {})
+        rconf = RapidsConf(self._conf_values)
         self._propagate = bool(rconf.get(TRACE_DISTRIBUTED))
         self._clock_probes = int(rconf.get(TRACE_CLOCK_PROBES))
-        self.procs = [
-            self._mp.Process(
-                target=_worker_main,
-                args=(i, conf or {}, self._addr_q, self._task_qs[i],
-                      self._result_q), daemon=True)
-            for i in range(n_executors)]
+        self._task_timeout = float(rconf.get(TASK_TIMEOUT))
+        self._max_failures = int(rconf.get(TASK_MAX_FAILURES))
+        self._respawn_enabled = bool(rconf.get(TASK_RESPAWN_WORKERS))
+        self._max_respawns = int(rconf.get(TASK_MAX_WORKER_RESPAWNS))
+        self._hb_timeout = float(rconf.get(TASK_HEARTBEAT_TIMEOUT))
+        self._start_timeout = float(start_timeout_s)
+        #: wedge detection over worker heartbeat records (reference:
+        #: heartbeat-driven executor exclusion, Plugin.scala:149-161)
+        self.detector = FailureDetector(self._hb_timeout)
+        self._inflight: Dict[int, dict] = {}
+        self._excluded: set = set()
+        self._respawns: Dict[int, int] = {}
+        self._last_hb: Dict[int, float] = {}
+        self._closing = False
+        self._recovering = False
+        self.procs = [self._spawn_process(i) for i in range(n_executors)]
         for p in self.procs:
             p.start()
         addrs: Dict[int, tuple] = {}
@@ -243,8 +367,7 @@ class ProcessCluster:
         self.addresses = [addrs[i] for i in range(n_executors)]
         self._tids = itertools.count()
         self._done: Dict[int, tuple] = {}
-        # peer everyone with everyone else (reference: heartbeat-driven
-        # executor discovery, Plugin.scala:149-161)
+        # peer everyone with everyone else
         for i in range(n_executors):
             peers = [a for j, a in enumerate(self.addresses) if j != i]
             self._wait(self._submit(i, "peers", peers))
@@ -253,6 +376,16 @@ class ProcessCluster:
             i: self._estimate_clock_offset(i) for i in range(n_executors)}
         #: worker id -> the worker tracer's epoch_unix (merge anchor)
         self.worker_epochs: Dict[int, float] = dict(self._epochs)
+
+    def _spawn_process(self, worker: int):
+        return self._mp.Process(
+            target=_worker_main,
+            args=(worker, self._conf_values, self._addr_q,
+                  self._task_qs[worker], self._result_q), daemon=True)
+
+    def live_workers(self) -> List[int]:
+        return [i for i, p in enumerate(self.procs)
+                if i not in self._excluded and p.is_alive()]
 
     def _estimate_clock_offset(self, worker: int) -> float:
         """NTP-style offset estimate: bracket N clock round trips and keep
@@ -278,25 +411,238 @@ class ProcessCluster:
         from ..utils.tracing import current_trace_context
         tid = next(self._tids)
         ctx = current_trace_context() if self._propagate else None
-        self._task_qs[worker].put(
-            (tid, kind, payload, None if ctx is None else ctx.to_wire()))
+        wire = None if ctx is None else ctx.to_wire()
+        self._inflight[tid] = {"worker": worker, "kind": kind,
+                               "payload": payload, "wire": wire,
+                               "attempts": 1, "history": [], "fault": None}
+        self._task_qs[worker].put((tid, kind, payload, wire))
         return tid
 
     def submit(self, worker: int, fn, *args) -> int:
         """Run ``fn(ctx, *args)`` on a worker; returns a task id."""
         return self._submit(worker, "call", (fn, args))
 
-    def _wait(self, tid: int, timeout_s: float = 300.0):
+    def _wait(self, tid: int, timeout_s: Optional[float] = None):
+        import queue as _queue
+        import time
+        budget = self._task_timeout if timeout_s is None else float(timeout_s)
+        deadline = time.monotonic() + budget
+        # baseline the detector: wedge detection measures heartbeat
+        # silence during THIS wait — nobody drains the result queue while
+        # the driver is idle, so stale stamps would be false positives
+        for w in self.live_workers():
+            self.detector.heartbeat(w)
         while tid not in self._done:
-            got_tid, status, value = self._result_q.get(timeout=timeout_s)
+            try:
+                got_tid, status, value = self._result_q.get(timeout=0.2)
+            except _queue.Empty:
+                self._check_workers()
+                if time.monotonic() >= deadline:
+                    self._raise_timeout(tid, budget)
+                continue
+            if status == "hb":
+                wid, _ts = value
+                self.detector.heartbeat(wid)
+                self._last_hb[wid] = time.monotonic()
+                continue
+            if status == "dying":
+                # a worker's last words before an injected kill: remember
+                # the fault name for the task's forensics
+                rec = self._inflight.get(got_tid)
+                if rec is not None:
+                    rec["fault"] = value
+                continue
+            if got_tid not in self._inflight:
+                # stale duplicate: the task was already resubmitted after
+                # its first worker died mid-answer, or already failed
+                continue
+            self._inflight.pop(got_tid, None)
             self._done[got_tid] = (status, value)
         status, value = self._done.pop(tid)
+        if status == "failed":
+            raise value
         if status == "err":
             raise RuntimeError(f"task {tid} failed on worker: {value}")
         return value
 
-    def run_on(self, worker: int, fn, *args, timeout_s: float = 300.0):
+    def _raise_timeout(self, tid: int, budget: float):
+        import time
+        rec = self._inflight.pop(tid, None)
+        faults.note_recovery("task_timeouts")
+        worker = rec["worker"] if rec else None
+        hb_age = None
+        depth = None
+        if worker is not None:
+            last = self._last_hb.get(worker)
+            hb_age = None if last is None else time.monotonic() - last
+            try:
+                depth = self._task_qs[worker].qsize()
+            except (NotImplementedError, OSError):
+                depth = None
+        age_txt = "never seen" if hb_age is None else f"{hb_age:.1f}s ago"
+        depth_txt = "?" if depth is None else str(depth)
+        raise TaskTimeoutError(
+            f"task {tid} timed out after {budget:.1f}s on worker {worker} "
+            f"(last heartbeat {age_txt}, ~{depth_txt} pending tasks); "
+            f"raise spark.rapids.tpu.task.timeout if the task is legitimately "
+            f"slow",
+            task_id=tid, worker=worker,
+            attempts=rec["attempts"] if rec else 0,
+            history=tuple(rec["history"]) if rec else (),
+            fault=rec.get("fault") if rec else None,
+            last_heartbeat_age_s=hb_age, pending_tasks=depth)
+
+    # -- worker supervision ---------------------------------------------------
+    def _check_workers(self):
+        """Detect dead or wedged workers and run recovery. Called from
+        inside _wait's poll loop; re-entrancy (recovery itself waits on
+        control tasks) is cut off with the _recovering latch."""
+        if self._closing or self._recovering:
+            return
+        self._recovering = True
+        try:
+            for i, p in enumerate(self.procs):
+                if i in self._excluded:
+                    continue
+                if not p.is_alive():
+                    self._on_worker_death(
+                        i, f"worker {i} process exited "
+                           f"(exitcode={p.exitcode})")
+            for wid in self.detector.check():
+                if wid in self._excluded or wid >= len(self.procs):
+                    continue
+                p = self.procs[wid]
+                if p.is_alive():
+                    # alive but silent past heartbeatTimeout: wedged
+                    p.terminate()
+                    p.join(timeout=10)
+                    self._on_worker_death(
+                        wid, f"worker {wid} wedged (no heartbeat for "
+                             f"{self._hb_timeout:.0f}s)")
+        finally:
+            self._recovering = False
+
+    def _on_worker_death(self, worker: int, reason: str,
+                         allow_respawn: bool = True):
+        faults.note_recovery("worker_deaths")
+        orphans = [t for t, r in self._inflight.items()
+                   if r["worker"] == worker]
+        respawned = False
+        if (allow_respawn and self._respawn_enabled and not self._closing
+                and self._respawns.get(worker, 0) < self._max_respawns):
+            try:
+                self._respawn_worker(worker)
+                respawned = True
+                faults.note_recovery("worker_respawns")
+            except Exception:
+                respawned = False
+        if not respawned:
+            self._excluded.add(worker)
+            faults.note_recovery("worker_exclusions")
+        for t in orphans:
+            self._resubmit_or_fail(t, reason)
+
+    def _respawn_worker(self, worker: int):
+        """Replace a dead worker with a fresh process on the same slot:
+        fresh task queue (the old one may hold stale envelopes), new
+        transport address announced to every surviving peer, clock offset
+        re-estimated."""
+        self._respawns[worker] = self._respawns.get(worker, 0) + 1
+        old_q = self._task_qs[worker]
+        self._task_qs[worker] = self._mp.Queue()
+        p = self._spawn_process(worker)
+        self.procs[worker] = p
+        p.start()
+        while True:
+            wid, addr = self._addr_q.get(timeout=self._start_timeout)
+            if wid == worker:
+                break
+        self.addresses[worker] = addr
+        peers = [a for j, a in enumerate(self.addresses)
+                 if j != worker and j not in self._excluded
+                 and self.procs[j].is_alive()]
+        self._wait(self._submit(worker, "peers", peers),
+                   timeout_s=self._start_timeout)
+        for j in self.live_workers():
+            if j != worker:
+                self._wait(self._submit(j, "addpeer", addr),
+                           timeout_s=self._start_timeout)
+        self.clock_offsets[worker] = self._estimate_clock_offset(worker)
+        self.worker_epochs[worker] = self._epochs[worker]
+        old_q.close()
+
+    def _resubmit_or_fail(self, tid: int, reason: str):
+        """Bounded task re-attempt after worker loss. Control tasks and
+        exhausted tasks become terminal TaskFailedError results that the
+        owning _wait raises."""
+        rec = self._inflight.get(tid)
+        if rec is None:
+            return
+        rec["history"].append(reason)
+        live = self.live_workers()
+        terminal = None
+        if rec["kind"] != "call":
+            terminal = "control task cannot be resubmitted"
+        elif rec["attempts"] >= self._max_failures:
+            terminal = (f"exhausted spark.rapids.tpu.task.maxFailures="
+                        f"{self._max_failures}")
+        elif not live:
+            terminal = "no live workers remain"
+        if terminal is not None:
+            self._inflight.pop(tid, None)
+            faults.note_recovery("task_failures")
+            fault = rec.get("fault")
+            msg = (f"task {tid} failed after {rec['attempts']} attempt(s): "
+                   f"{terminal}; failures: {'; '.join(rec['history'])}")
+            if fault:
+                msg += f"; fault: {fault}"
+            self._done[tid] = ("failed", TaskFailedError(
+                msg, task_id=tid, worker=rec["worker"],
+                attempts=rec["attempts"], history=tuple(rec["history"]),
+                fault=fault))
+            return
+        rec["attempts"] += 1
+        target = live[rec["attempts"] % len(live)]
+        rec["worker"] = target
+        faults.note_recovery("task_resubmissions")
+        self._task_qs[target].put((tid, rec["kind"], rec["payload"], rec["wire"]))  # srtpu: trace-ok(resubmission replays the original envelope whose context was captured at _submit)
+
+    def run_on(self, worker: int, fn, *args,
+               timeout_s: Optional[float] = None):
         return self._wait(self.submit(worker, fn, *args), timeout_s)
+
+    def run_tpch_query(self, query: str, sf: float = 0.01,
+                       tiny: bool = True, num_partitions: int = 4,
+                       timeout_s: Optional[float] = None) -> pa.Table:
+        """Fan the partitions of one TPC-H query across the live workers
+        and merge the results — the chaos-parity vehicle: a mid-query
+        worker kill must yield exactly the sequential answer via
+        supervision + resubmission."""
+        from ..shuffle.serializer import deserialize_table
+        live = self.live_workers()
+        if not live:
+            raise TaskFailedError("no live workers to plan the query on")
+        n_parts = self.run_on(live[0], query_num_partitions_task, query,
+                              sf, tiny, num_partitions, self._conf_values,
+                              timeout_s=timeout_s)
+        tids = []
+        for pidx in range(n_parts):
+            live = self.live_workers()
+            if not live:
+                raise TaskFailedError(
+                    f"no live workers remain for partition {pidx}")
+            w = live[pidx % len(live)]
+            tids.append(self.submit(w, run_query_task, query, sf, tiny,
+                                    num_partitions, pidx,
+                                    self._conf_values))
+        parts: List[HostTable] = []
+        for tid in tids:
+            payload = self._wait(tid, timeout_s)
+            if payload is not None:
+                parts.append(deserialize_table(payload))
+        if not parts:
+            return pa.table({})
+        return HostTable.concat(parts).to_arrow()
 
     # -- distributed trace collection -----------------------------------------
     def collect_traces(self, drain: bool = False) -> List[dict]:
@@ -338,17 +684,22 @@ class ProcessCluster:
         return paths
 
     def kill(self, worker: int):
-        """Hard-kill one executor process (failure injection)."""
+        """Hard-kill one executor process (deliberate failure injection).
+        The slot is excluded — never respawned — and any of its in-flight
+        tasks are resubmitted to surviving workers."""
         self.procs[worker].terminate()
         self.procs[worker].join(timeout=30)
+        self._on_worker_death(worker, f"worker {worker} killed by driver",
+                              allow_respawn=False)
 
     def close(self):
+        self._closing = True
         for i, p in enumerate(self.procs):
             if p.is_alive():
                 try:
                     self._task_qs[i].put(None)  # srtpu: trace-ok(shutdown sentinel, not a task envelope — no context to inject)
                 except Exception:
-                    pass
+                    pass  # srtpu: net-ok(a full queue or dead worker during shutdown is fine; terminate below is the backstop)
         for p in self.procs:
             p.join(timeout=30)
             if p.is_alive():
@@ -481,6 +832,60 @@ def broadcast_build_task(ctx: ExecutorContext, bcast_id: int,
                                      min_bucket=8)
     ctx.broadcast.build_and_publish(bcast_id, build)
     return ctx.broadcast.builds, ctx.broadcast.fetches
+
+
+#: (query, sf, tiny, partitions, conf) -> (TpuSession, physical plan);
+#: per-worker plan cache so every partition task reuses one build
+_QUERY_PLANS: Dict[tuple, tuple] = {}
+
+
+def _query_plan(query: str, sf: float, tiny: bool, num_partitions: int,
+                conf_overrides: Optional[dict]):
+    from ..session import TpuSession
+    from ..tools import tpch
+    key = (query, sf, tiny, num_partitions,
+           tuple(sorted((conf_overrides or {}).items())))
+    cached = _QUERY_PLANS.get(key)
+    if cached is None:
+        # a worker-side TpuSession re-runs configure_faults with the
+        # plain conf seed — preserve this worker's seed-offset injector
+        prev_injector = faults.active()
+        sess = TpuSession(dict(conf_overrides or {}))
+        faults.install(prev_injector)
+        tables = tpch.gen_all(sf, tiny=tiny)
+        dfs = tpch.build_dataframes(sess, tables,
+                                    num_partitions=num_partitions)
+        df = tpch.QUERIES[query](dfs)
+        cached = (sess, sess._physical(df.logical, device=False))
+        _QUERY_PLANS[key] = cached
+    return cached
+
+
+def query_num_partitions_task(ctx: ExecutorContext, query: str, sf: float,
+                              tiny: bool, num_partitions: int,
+                              conf_overrides: Optional[dict] = None) -> int:
+    """Build (and cache) the query plan worker-side; -> its output
+    partition count, which the driver fans run_query_task over."""
+    _sess, plan = _query_plan(query, sf, tiny, num_partitions,
+                              conf_overrides)
+    return int(plan.num_partitions)
+
+
+def run_query_task(ctx: ExecutorContext, query: str, sf: float, tiny: bool,
+                   num_partitions: int, pidx: int,
+                   conf_overrides: Optional[dict] = None
+                   ) -> Optional[bytes]:
+    """Execute one output partition of a TPC-H query inside the worker.
+    Every worker regenerates the seeded TPC-H tables and materializes its
+    own exchanges — duplicated work, but each partition's rows are exactly
+    the sequential run's, which is what the chaos-parity tests pin."""
+    from ..shuffle.serializer import serialize_table
+    _sess, plan = _query_plan(query, sf, tiny, num_partitions,
+                              conf_overrides)
+    out = list(plan.execute(pidx))
+    if not out:
+        return None
+    return serialize_table(HostTable.concat(out))
 
 
 def broadcast_probe_task(ctx: ExecutorContext, bcast_id: int,

@@ -50,7 +50,7 @@ def _close_handle_quietly(handle):
     try:
         handle.close()
     except Exception:
-        pass
+        pass  # srtpu: net-ok(best-effort release at plan teardown; a failed close cannot affect the already-collected result)
 
 
 class PhysicalPlan:

@@ -71,6 +71,11 @@ class TpuSession:
         # (utils/memprof.py; the catalog emits into it)
         from .utils.memprof import configure_memprof
         configure_memprof(self.conf)
+        # fault injection (spark.rapids.tpu.faults.*): install or clear
+        # the process-wide injector behind the named fault points
+        # (utils/faults.py); None/no-op unless faults.enabled
+        from .utils.faults import configure_faults
+        configure_faults(self.conf)
         # live health subsystem: watchdog monitor thread + optional HTTP
         # status endpoints (utils/health.py + tools/statusd.py); None when
         # health.enabled is false and health.port < 0 (the default)

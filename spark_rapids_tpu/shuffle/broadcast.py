@@ -116,4 +116,4 @@ class BroadcastManager:
                 try:
                     close()
                 except Exception:
-                    pass
+                    pass  # srtpu: net-ok(best-effort handle release during broadcast teardown; nothing reads these buffers again)

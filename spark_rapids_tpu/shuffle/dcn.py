@@ -50,6 +50,7 @@ from typing import Callable, Dict, Iterator, List, Optional, Tuple
 import jax
 
 from ..columnar.device import DeviceTable
+from ..utils import faults
 from ..utils.tracing import get_tracer
 from .transport import BlockId, ShuffleFetchFailedException
 
@@ -157,7 +158,7 @@ class DcnShuffleTransport:
                 try:
                     close()
                 except Exception:
-                    pass
+                    pass  # srtpu: net-ok(best-effort handle release while dropping a finished shuffle; the blocks are dead either way)
 
     def close(self) -> None:
         self.remove_all()
@@ -204,6 +205,9 @@ class TcpDcnShuffleTransport:
 
     # -- publish/fetch --------------------------------------------------------
     def publish_table(self, block: BlockId, table: DeviceTable) -> None:
+        action = faults.fire("dcn.publish")
+        if action is not None and action != "delay":
+            raise faults.FaultInjectedError("dcn.publish", action)
         entry: object = table
         if self.catalog is not None:
             from ..memory.catalog import SpillPriorities
@@ -247,6 +251,9 @@ class TcpDcnShuffleTransport:
             yield b, self._local(b)
         if not remote:
             return
+        action = faults.fire("dcn.fetch")
+        if action is not None and action != "delay":
+            raise faults.FaultInjectedError("dcn.fetch", action)
         for b, payload in self.tcp.fetch(remote):
             with get_tracer().span("dcn_fetch", "shuffle",
                                    shuffle=b[0], map=b[1],
@@ -267,7 +274,7 @@ class TcpDcnShuffleTransport:
                 try:
                     close()
                 except Exception:
-                    pass
+                    pass  # srtpu: net-ok(best-effort handle release while dropping a finished shuffle; the blocks are dead either way)
         self.tcp.remove_shuffle(shuffle_id)
 
     def close(self) -> None:

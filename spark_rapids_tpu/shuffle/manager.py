@@ -28,6 +28,8 @@ import numpy as np
 from ..columnar.device import DeviceTable, stable_counting_order
 from ..columnar.host import HostTable
 from ..conf import RapidsConf, SHUFFLE_COMPRESSION_CODEC, register_conf
+from ..memory.stores import SpillCorruptionError
+from ..utils import faults
 from ..utils.tracing import get_tracer
 from .serializer import deserialize_table, serialize_table
 from .transport import BlockId, ShuffleTransport, load_transport
@@ -288,6 +290,9 @@ class ShuffleManager:
         buffer catalog (RapidsCachingWriter): no download, no serialization;
         same-process readers concat the device blocks directly and the spill
         framework owns the memory."""
+        action = faults.fire("shuffle.publish")
+        if action is not None and action != "delay":
+            raise faults.FaultInjectedError("shuffle.publish", action)
         if self.cache_writes:
             with get_tracer().span("shuffle_write", "shuffle", tier="cached",
                                    shuffle=shuffle_id, map=map_id):
@@ -423,6 +428,11 @@ class ShuffleManager:
                                maps=num_maps):
             while pending:
                 try:
+                    if faults.fire("shuffle.fetch") not in (None, "delay"):
+                        # injected through the REAL failure type so the
+                        # recompute-once machinery below recovers it
+                        raise ShuffleFetchFailedException(
+                            pending[0], "injected fault 'shuffle.fetch'")
                     for bid, payload in self.transport.fetch(pending):
                         tables.append(deserialize_table(payload))
                         fetched_bytes += len(payload)
@@ -437,6 +447,7 @@ class ShuffleManager:
                     if recompute is None or map_id in retried:
                         raise
                     retried.add(map_id)
+                    faults.note_recovery("shuffle_recomputes")
                     with get_tracer().span("shuffle_recompute", "shuffle",
                                            shuffle=shuffle_id, map=map_id):
                         recompute(map_id)
@@ -473,11 +484,16 @@ class ShuffleManager:
             for m in range(num_maps):
                 key = (shuffle_id, m, reduce_id)
                 handle = self.buffer_catalog.get(key)
+                if handle is not None and \
+                        faults.fire("shuffle.fetch") not in (None, "delay"):
+                    handle = None  # injected miss: exercises the same
+                    # recompute path a genuinely lost block takes
                 if handle is None and recompute is not None:
                     get_tracer().instant(
                         "shuffle_fetch_failed", "shuffle",
                         shuffle=shuffle_id, map=m, reduce=reduce_id,
                         retry=True)
+                    faults.note_recovery("shuffle_recomputes")
                     with get_tracer().span("shuffle_recompute", "shuffle",
                                            shuffle=shuffle_id, map=m):
                         recompute(m)
@@ -486,7 +502,35 @@ class ShuffleManager:
                     raise ShuffleFetchFailedException(
                         BlockId(shuffle_id, m, reduce_id),
                         "block not in the shuffle buffer catalog")
-                t = handle.get()
+                try:
+                    t = handle.get()
+                except SpillCorruptionError as e:
+                    # a corrupt disk-spilled block is recoverable the same
+                    # way a lost remote block is: recompute the map output
+                    # (put() overwrites and closes the corrupt handle)
+                    get_tracer().instant(
+                        "shuffle_fetch_failed", "shuffle",
+                        shuffle=shuffle_id, map=m, reduce=reduce_id,
+                        retry=recompute is not None)
+                    if recompute is None:
+                        raise ShuffleFetchFailedException(
+                            BlockId(shuffle_id, m, reduce_id),
+                            f"spilled block corrupt: {e}")
+                    faults.note_recovery("shuffle_recomputes")
+                    with get_tracer().span("shuffle_recompute", "shuffle",
+                                           shuffle=shuffle_id, map=m):
+                        recompute(m)
+                    fresh = self.buffer_catalog.get(key)
+                    if fresh is None:
+                        raise ShuffleFetchFailedException(
+                            BlockId(shuffle_id, m, reduce_id),
+                            "block missing after corruption recompute")
+                    try:
+                        t = fresh.get()
+                    except SpillCorruptionError as e2:
+                        raise ShuffleFetchFailedException(
+                            BlockId(shuffle_id, m, reduce_id),
+                            f"spilled block corrupt after recompute: {e2}")
                 fetched_bytes += t.nbytes()
                 if t.num_columns:
                     tables.append(t)

@@ -43,6 +43,7 @@ magics — an untraced client talks to a traced server and vice versa.
 from __future__ import annotations
 
 import os
+import random
 import socket
 import struct
 import tempfile
@@ -51,7 +52,8 @@ from collections import OrderedDict
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, Iterator, List, Optional, Tuple
 
-from ..conf import RapidsConf, register_conf
+from ..conf import RapidsConf, _positive, register_conf
+from ..utils import faults
 from ..utils.tracing import (TRACE_DISTRIBUTED, TraceContext,
                              activate_trace_context, current_trace_context,
                              get_tracer)
@@ -81,6 +83,46 @@ HOST_STORE_BYTES = register_conf(
     "(reference: spillable shuffle buffers backing BufferSendState).",
     256 << 20, checker=lambda v: None if int(v) > 0 else "must be positive")
 
+TCP_CONNECT_TIMEOUT = register_conf(
+    "spark.rapids.tpu.shuffle.tcp.connectTimeout",
+    "Seconds to wait for a TCP connect to a shuffle peer before the "
+    "attempt counts as a transient failure (retried with backoff).",
+    10.0, checker=_positive("connect timeout"))
+
+TCP_READ_TIMEOUT = register_conf(
+    "spark.rapids.tpu.shuffle.tcp.readTimeout",
+    "Per-socket-operation read timeout (seconds) on shuffle connections, "
+    "client and server side — no socket in the transport blocks forever.",
+    30.0, checker=_positive("read timeout"))
+
+TCP_RETRY_ATTEMPTS = register_conf(
+    "spark.rapids.tpu.shuffle.tcp.retryAttempts",
+    "Attempts per peer for one ranged shuffle request. Transient socket "
+    "errors (refused, reset, timeout) are retried with exponential "
+    "backoff + jitter; a peer answering 'block not found' is definitive "
+    "and never retried (that path stays ShuffleFetchFailedException -> "
+    "recompute).",
+    4, checker=_positive("retry attempts"))
+
+TCP_RETRY_BACKOFF_MS = register_conf(
+    "spark.rapids.tpu.shuffle.tcp.retryBackoffMs",
+    "Base backoff (milliseconds) between transient-error retries; grows "
+    "exponentially per attempt with +/-50% jitter.",
+    50.0, checker=_positive("retry backoff"))
+
+TCP_RETRY_MAX_BACKOFF_MS = register_conf(
+    "spark.rapids.tpu.shuffle.tcp.retryMaxBackoffMs",
+    "Cap (milliseconds) on the exponential retry backoff.",
+    1000.0, checker=_positive("max backoff"))
+
+TCP_MAX_PROVIDER_RETRIES = register_conf(
+    "spark.rapids.tpu.shuffle.host.maxProviderRetries",
+    "Times a lazy block provider that raised may be re-registered for "
+    "another request. Keeping a block requestable after a failed send is "
+    "what lets a retrying peer succeed, but a crash-looping provider "
+    "must not stay requestable (and pin its inputs) forever.",
+    3, checker=_positive("provider retries"))
+
 _MAGIC = b"SRTB"
 _MAGIC_TRACED = b"SRTC"
 _OP_GET = 1
@@ -95,7 +137,7 @@ _RESP_CHUNK = struct.Struct("<Q")
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
     buf = b""
     while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
+        chunk = sock.recv(n - len(buf))  # srtpu: net-ok(every caller sets a read timeout on the socket before handing it here)
         if not chunk:
             raise ConnectionError("peer closed mid-message")
         buf += chunk
@@ -105,11 +147,13 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 class _HostBlockStore:
     """Budgeted in-memory block store with oldest-first disk spill."""
 
-    def __init__(self, budget_bytes: int):
+    def __init__(self, budget_bytes: int, max_provider_retries: int = 3):
         self._budget = budget_bytes
+        self._max_provider_retries = max(1, int(max_provider_retries))
         self._mem: "OrderedDict[BlockId, bytes]" = OrderedDict()
         self._disk: Dict[BlockId, Tuple[str, int]] = {}   # path, length
         self._providers: Dict[BlockId, object] = {}   # lazy payload fns
+        self._provider_retries: Dict[BlockId, int] = {}
         self._spilling: set = set()   # victims mid-write, still in _mem
         self._lock = threading.Lock()
         self._mat_inflight: set = set()   # blocks materializing right now
@@ -185,13 +229,22 @@ class _HostBlockStore:
         try:
             payload = provider()
         except Exception:
-            with self._lock:       # keep it requestable for a retry
-                self._providers.setdefault(block, provider)
+            with self._lock:
+                # keep it requestable for a retry, but bounded: a
+                # crash-looping provider must not stay registered (and
+                # pin its inputs in host memory) forever — after the
+                # budget the block simply reports missing, which the
+                # fetch path turns into fetch-failed -> recompute
+                n = self._provider_retries.get(block, 0) + 1
+                self._provider_retries[block] = n
+                if n < self._max_provider_retries:
+                    self._providers.setdefault(block, provider)
                 self._mat_inflight.discard(block)
                 self._mat_cond.notify_all()
             raise
         self.put(block, payload)
         with self._lock:
+            self._provider_retries.pop(block, None)
             self._mat_inflight.discard(block)
             self._mat_cond.notify_all()
 
@@ -233,6 +286,9 @@ class _HostBlockStore:
         with self._lock:
             for b in [b for b in self._providers if b[0] == shuffle_id]:
                 del self._providers[b]
+            for b in [b for b in self._provider_retries
+                      if b[0] == shuffle_id]:
+                del self._provider_retries[b]
             for b in [b for b in self._mem if b[0] == shuffle_id]:
                 self.mem_bytes -= len(self._mem.pop(b))
             doomed = [self._disk.pop(b)[0]
@@ -313,7 +369,19 @@ class TcpShuffleTransport(ShuffleTransport):
         conf = conf or RapidsConf()
         self.chunk_bytes = int(conf.get(TCP_CHUNK_BYTES))
         self._trace_wire = bool(conf.get(TRACE_DISTRIBUTED))
-        self.store = _HostBlockStore(int(conf.get(HOST_STORE_BYTES)))
+        self._connect_timeout = float(conf.get(TCP_CONNECT_TIMEOUT))
+        self._read_timeout = float(conf.get(TCP_READ_TIMEOUT))
+        self._retry_attempts = max(1, int(conf.get(TCP_RETRY_ATTEMPTS)))
+        self._backoff_s = float(conf.get(TCP_RETRY_BACKOFF_MS)) / 1000.0
+        self._max_backoff_s = \
+            float(conf.get(TCP_RETRY_MAX_BACKOFF_MS)) / 1000.0
+        self._jitter = random.Random()
+        #: set at close(): retry backoffs wait on it so shutdown never
+        #: has to wait out a backoff schedule
+        self._closed = threading.Event()
+        self.store = _HostBlockStore(
+            int(conf.get(HOST_STORE_BYTES)),
+            int(conf.get(TCP_MAX_PROVIDER_RETRIES)))
         self.inflight = _InflightBudget(int(conf.get(MAX_RECEIVE_INFLIGHT)))
         self._lock = threading.Lock()
         self._peers: List[Tuple[str, int]] = []
@@ -337,7 +405,7 @@ class TcpShuffleTransport(ShuffleTransport):
     def _serve(self):
         while not self._closing:
             try:
-                conn, _ = self._server.accept()
+                conn, _ = self._server.accept()  # srtpu: net-ok(the listener blocks until close tears the socket down — an accept deadline would only add spurious wakeups)
             except OSError:
                 return  # socket closed
             threading.Thread(target=self._handle, args=(conn,),
@@ -347,6 +415,9 @@ class TcpShuffleTransport(ShuffleTransport):
     def _handle(self, conn: socket.socket):
         try:
             with conn:
+                # a stalled or malicious client must not pin a server
+                # thread forever
+                conn.settimeout(self._read_timeout)
                 raw = _recv_exact(conn, _REQ.size)
                 magic, op, sid, mid, rid = _REQ.unpack(raw)
                 if magic == _MAGIC_TRACED:
@@ -362,7 +433,7 @@ class TcpShuffleTransport(ShuffleTransport):
                                           reduce=rid):
                     self._serve_request(conn, op, sid, mid, rid)
         except Exception:
-            pass  # a broken client connection must not kill the server
+            pass  # srtpu: net-ok(a broken client connection must not kill the server; the client side retries or treats the block as missing)
 
     def _serve_request(self, conn: socket.socket, op: int, sid: int,
                        mid: int, rid: int):
@@ -405,29 +476,55 @@ class TcpShuffleTransport(ShuffleTransport):
         self._peers.append((host, port))
 
     def _range_from_peer(self, addr: Tuple[str, int], block: BlockId,
-                         offset: int, timeout: float = 10.0,
+                         offset: int,
                          tctx: Optional[TraceContext] = None
                          ) -> Optional[Tuple[int, bytes]]:
         """One ranged request -> (total_len, chunk) or None if absent.
-        With a TraceContext the traced wire variant (magic SRTC) carries
-        it, so the server's shuffle_serve span parents under it."""
+
+        Transient socket errors (connect refused/reset/timeout) are
+        retried with exponential backoff + jitter up to
+        ``tcp.retryAttempts``; a live peer answering found=0 is a
+        definitive miss and returns immediately — that distinction keeps
+        the missing-block path on ShuffleFetchFailedException ->
+        recompute while flaky networks just retry. With a TraceContext
+        the traced wire variant (magic SRTC) carries it, so the server's
+        shuffle_serve span parents under it."""
         if tctx is not None and self._trace_wire:
             head = _REQ.pack(_MAGIC_TRACED, _OP_GET_RANGE, *block) \
                 + tctx.pack()
         else:
             head = _REQ.pack(_MAGIC, _OP_GET_RANGE, *block)
-        try:
-            with socket.create_connection(addr, timeout=timeout) as s:
-                s.sendall(head
-                          + _RANGE_EXT.pack(offset, self.chunk_bytes))
-                found, total = _RESP_HEAD.unpack(
-                    _recv_exact(s, _RESP_HEAD.size))
-                if not found:
-                    return None
-                (clen,) = _RESP_CHUNK.unpack(_recv_exact(s, _RESP_CHUNK.size))
-                return int(total), _recv_exact(s, clen)
-        except OSError:
-            return None  # dead peer == block not found here
+        for attempt in range(self._retry_attempts):
+            if attempt:
+                faults.note_recovery("transport_retries")
+                delay = min(self._backoff_s * (2 ** (attempt - 1)),
+                            self._max_backoff_s)
+                delay *= 0.5 + self._jitter.random()  # +/-50% jitter
+                if self._closed.wait(delay):
+                    return None  # transport shut down mid-backoff
+            try:
+                if faults.fire("tcp.connect") not in (None, "delay"):
+                    raise ConnectionRefusedError(
+                        "injected fault 'tcp.connect'")
+                with socket.create_connection(
+                        addr, timeout=self._connect_timeout) as s:
+                    s.settimeout(self._read_timeout)
+                    s.sendall(head
+                              + _RANGE_EXT.pack(offset, self.chunk_bytes))
+                    if faults.fire("tcp.read") not in (None, "delay"):
+                        raise ConnectionResetError(
+                            "injected fault 'tcp.read'")
+                    found, total = _RESP_HEAD.unpack(
+                        _recv_exact(s, _RESP_HEAD.size))
+                    if not found:
+                        return None  # definitive miss: peer is up, no block
+                    (clen,) = _RESP_CHUNK.unpack(
+                        _recv_exact(s, _RESP_CHUNK.size))
+                    return int(total), _recv_exact(s, clen)
+            except OSError:
+                continue  # transient or dead peer: back off and retry
+        faults.note_recovery("transport_giveups")
+        return None  # unreachable after retries == block not found here
 
     def _fetch_remote(self, block: BlockId, turnstile: "_Turnstile",
                       ticket: int,
@@ -542,6 +639,7 @@ class TcpShuffleTransport(ShuffleTransport):
 
     def close(self) -> None:
         self._closing = True
+        self._closed.set()  # interrupt any retry backoff in flight
         try:
             self._server.close()
         except OSError:

@@ -32,6 +32,11 @@ Checkers (see the sibling modules):
                ``BufferCatalog.register`` — HBM invisible to spill,
                watermark attribution, and OOM postmortems
                (utils/memprof.py).
+- ``net``    — socket deadline discipline: blocking socket calls with
+               no timeout (a dead peer hangs them forever, defeating
+               the fault-tolerance arc's retry/recompute machinery) and
+               except-everything-pass handlers that swallow transport
+               faults in hot/warm packages.
 
 Workflow: findings are compared against a COMMITTED baseline
 (``tools/analyze/baseline.json``) so pre-existing debt is inventoried
@@ -307,15 +312,15 @@ def load_project(paths: Sequence[str]) -> Project:
 
 def _checkers() -> Dict[str, object]:
     from . import (buckets, eventlog_schema, host_sync, jit_purity, locks,
-                   memtrack, threads, trace_ctx)
+                   memtrack, net, threads, trace_ctx)
     return {"sync": host_sync, "lock": locks,
             "thread": threads, "jit": jit_purity, "bucket": buckets,
             "trace": trace_ctx, "memtrack": memtrack,
-            "eventlog": eventlog_schema}
+            "eventlog": eventlog_schema, "net": net}
 
 
 CHECKS = ("sync", "lock", "thread", "jit", "bucket", "trace", "memtrack",
-          "eventlog")
+          "eventlog", "net")
 
 
 def analyze_paths(paths: Sequence[str],

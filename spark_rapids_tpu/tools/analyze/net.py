@@ -1,0 +1,145 @@
+"""net checker: socket deadline discipline + swallowed transport errors.
+
+The fault-injection arc (utils/faults.py, docs/fault_tolerance.md) made
+network failure a first-class, recoverable event — but recovery only
+triggers if the failure SURFACES. Two patterns defeat it statically:
+
+- a socket operation with no deadline turns a dead peer into an
+  infinite hang (the exact 300s-wedge the worker supervisor exists to
+  kill, except nothing supervises the shuffle client's own sockets);
+- a blanket ``except ...: pass`` around transport code turns a real
+  fault into silently-missing data.
+
+Rules (all scoped to ``hot``/``warm`` packages — tools and session
+setup may block interactively):
+
+- ``net-connect-no-timeout`` — ``socket.create_connection(...)`` with
+  no ``timeout`` (second positional or keyword): connect hangs ride the
+  kernel's default, minutes long. Pass the conf-driven connect timeout.
+- ``net-socket-no-timeout`` — a ``.recv(``/``.accept(``/``.connect(``
+  call inside a function that never calls ``settimeout`` (and, for
+  connect, doesn't use a deadline-bearing ``create_connection``): the
+  blocking call has no local evidence of a deadline. Where the deadline
+  is provably set by every caller (a helper that receives an
+  already-configured socket), suppress inline with
+  ``# srtpu: net-ok(<reason>)``.
+- ``net-bare-except-pass`` — ``except Exception:`` / bare ``except:``
+  whose entire body is ``pass``: transport and spill errors vanish
+  instead of reaching the retry/recompute machinery. Best-effort
+  close() paths are the legitimate case — suppress with the reason.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from . import Finding, Project, ScopedVisitor
+
+__all__ = ["check"]
+
+#: socket methods that block until the peer acts
+_BLOCKING_ATTRS = frozenset({"recv", "accept", "connect"})
+
+
+def _has_timeout_arg(node: ast.Call) -> bool:
+    """create_connection(addr[, timeout]) — deadline as 2nd positional
+    or timeout= keyword."""
+    if len(node.args) >= 2:
+        return True
+    return any(k.arg == "timeout" for k in node.keywords)
+
+
+def _body_is_pass(handler: ast.ExceptHandler) -> bool:
+    return len(handler.body) == 1 and isinstance(handler.body[0], ast.Pass)
+
+
+def _swallows_everything(handler: ast.ExceptHandler, ctx) -> bool:
+    """Bare ``except:`` or ``except Exception:`` (incl. BaseException);
+    typed handlers (OSError, ...) express intent and stay silent."""
+    if handler.type is None:
+        return True
+    q = ctx.qualify(handler.type)
+    return q in ("Exception", "BaseException",
+                 "builtins.Exception", "builtins.BaseException")
+
+
+class _NetVisitor(ScopedVisitor):
+    def __init__(self, ctx):
+        super().__init__()
+        self.ctx = ctx
+        self.findings: List[Finding] = []
+        #: per-function stack: does the enclosing function set a
+        #: deadline anywhere (settimeout, or create_connection with one)?
+        self._deadline_stack: List[bool] = []
+
+    def _hit(self, node, rule: str, msg: str) -> None:
+        self.findings.append(self.ctx.finding(
+            "net", rule, node, self.symbol, msg))
+
+    def _fn_sets_deadline(self, fn: ast.AST) -> bool:
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr == "settimeout":
+                return True
+            if self.ctx.qualify(f) == "socket.create_connection" \
+                    and _has_timeout_arg(node):
+                return True
+        return False
+
+    def _scoped_fn(self, node):
+        self._deadline_stack.append(self._fn_sets_deadline(node))
+        try:
+            ScopedVisitor._scoped(self, node)
+        finally:
+            self._deadline_stack.pop()
+
+    visit_FunctionDef = _scoped_fn
+    visit_AsyncFunctionDef = _scoped_fn
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        if self.ctx.qualify(f) == "socket.create_connection":
+            if not _has_timeout_arg(node):
+                self._hit(node, "net-connect-no-timeout",
+                          "socket.create_connection without a timeout — "
+                          "a dead peer hangs the connect for the kernel "
+                          "default (minutes); pass the conf-driven "
+                          "connect timeout")
+        elif isinstance(f, ast.Attribute) and f.attr in _BLOCKING_ATTRS:
+            in_fn = bool(self._deadline_stack)
+            deadline = self._deadline_stack[-1] if in_fn else False
+            if in_fn and not deadline:
+                self._hit(node, "net-socket-no-timeout",
+                          f".{f.attr}() in a function that never sets a "
+                          f"socket deadline — a dead peer blocks here "
+                          f"forever; settimeout() the socket (or suppress "
+                          f"with why every caller already did)")
+        self.generic_visit(node)
+
+    def visit_Try(self, node: ast.Try) -> None:
+        for handler in node.handlers:
+            if _body_is_pass(handler) \
+                    and _swallows_everything(handler, self.ctx):
+                # anchor on the ``pass`` statement so a trailing
+                # suppression comment on that line applies
+                self.findings.append(self.ctx.finding(
+                    "net", "net-bare-except-pass", handler.body[0],
+                    self.symbol,
+                    "except-everything with a pass body — transport and "
+                    "spill faults vanish here instead of reaching the "
+                    "retry/recompute machinery; catch the specific "
+                    "error or suppress with why best-effort is correct"))
+        self.generic_visit(node)
+
+
+def check(project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    for ctx in project.modules:
+        if ctx.severity == "cold":
+            continue  # tools/session setup may block interactively
+        v = _NetVisitor(ctx)
+        v.visit(ctx.tree)
+        out.extend(v.findings)
+    return out

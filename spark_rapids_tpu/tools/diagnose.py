@@ -484,6 +484,62 @@ def _skew_findings(q) -> List[Finding]:
     return findings
 
 
+def _recovery_findings(q) -> List[Finding]:
+    """v8 recovery records: the query finished, but only because the
+    runtime recovered from failures along the way — worker deaths,
+    transport retries, shuffle recomputes, corrupted spill files. The
+    result is correct; the latency and the underlying fault are the
+    signal. Null/absent ``recovery`` (the healthy common case) emits
+    nothing."""
+    rec = getattr(q, "recovery", None) or {}
+    if not any(rec.values()):
+        return []
+    findings: List[Finding] = []
+    injected = bool(getattr(q, "faults", []))
+    detail = ", ".join(f"{k}={v}" for k, v in sorted(rec.items()) if v)
+    if rec.get("worker_deaths") or rec.get("task_resubmissions"):
+        findings.append(Finding(
+            node="(query)", node_id=None, metric="workerRecovery",
+            seconds=0.0, fraction=min(1.0, 0.2 * rec.get(
+                "worker_deaths", rec.get("task_resubmissions", 1))),
+            detail=f"worker failures recovered mid-query ({detail})",
+            suggestion="injected chaos — expected" if injected else
+                       "workers died mid-query; check worker logs/rlimits "
+                       "and spark.rapids.tpu.task.maxWorkerRespawns — "
+                       "each respawn re-pays session + compile warmup"))
+    if rec.get("transport_retries") or rec.get("transport_giveups"):
+        findings.append(Finding(
+            node="(query)", node_id=None, metric="transportRetries",
+            seconds=0.0, fraction=min(1.0, 0.05 * rec.get(
+                "transport_retries", 1)),
+            detail=f"shuffle transport retried ({detail})",
+            suggestion="injected chaos — expected" if injected else
+                       "flaky shuffle network — each retry backs off up "
+                       "to shuffle.tcp.retryMaxBackoffMs; check peer "
+                       "liveness and raise retryAttempts only if the "
+                       "fabric is genuinely lossy"))
+    if rec.get("spill_corruptions"):
+        findings.append(Finding(
+            node="(query)", node_id=None, metric="spillCorruption",
+            seconds=0.0, fraction=min(1.0, 0.25 * rec["spill_corruptions"]),
+            detail=f"spilled blocks failed CRC32 on restore ({detail})",
+            suggestion="injected chaos — expected" if injected else
+                       "disk returned corrupt spill bytes — recompute "
+                       "saved the query but the storage device is "
+                       "suspect; check the spill dir's filesystem/disk "
+                       "health (memory.disk.checksum caught this)"))
+    if rec.get("shuffle_recomputes") and not findings:
+        findings.append(Finding(
+            node="(query)", node_id=None, metric="shuffleRecompute",
+            seconds=0.0, fraction=min(1.0, 0.1 * rec["shuffle_recomputes"]),
+            detail=f"shuffle blocks recomputed from lineage ({detail})",
+            suggestion="injected chaos — expected" if injected else
+                       "missing shuffle blocks recomputed — upstream "
+                       "stages re-ran; check for evicted/removed "
+                       "map outputs"))
+    return findings
+
+
 def _diagnose_query(q, heartbeats=None) -> Optional[QueryDiagnosis]:
     wall = getattr(q, "wall_s", 0.0)
     if wall <= 0 or getattr(q, "error", None):
@@ -631,6 +687,11 @@ def _diagnose_query(q, heartbeats=None) -> Optional[QueryDiagnosis]:
     # are row-imbalanced past 2x — the straggler partition that bounds
     # the downstream stage
     findings.extend(_skew_findings(q))
+
+    # 9. recovery ledger (schema v8): the query survived failures —
+    # worker deaths, transport retries, corrupt spills — rank what the
+    # runtime had to absorb
+    findings.extend(_recovery_findings(q))
 
     findings.sort(key=lambda f: -f.fraction)
     return QueryDiagnosis(q.query_id, wall, findings, critical_path=cp)

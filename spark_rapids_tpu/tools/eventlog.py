@@ -42,6 +42,16 @@ file per session (``spark.rapids.tpu.eventLog.dir``), one record per event:
   counts) computed from counts the exchange tiers already gather in
   bulk; the partition-level telemetry ROADMAP items 3–4 consume and
   the history server's regression sentinel watches
+- ``fault`` (schema v8): one per injected-fault fire drained from the
+  fault-injection framework (utils/faults.py) — point, action and the
+  per-point fire/evaluation ordinals; absent entirely when injection is
+  off (the common case)
+- ``recovery`` (schema v8): ONE per query (success AND error paths) —
+  the per-query delta of the recovery ledger (worker deaths/respawns,
+  task resubmissions, transport retries, shuffle recomputes, spill
+  corruptions...); the ``recovery`` payload is null when the query saw
+  no recovery activity, so the record set per query is stable whether
+  or not faults fired
 - ``app_end``
 
 ``load_event_log`` replays a file into ``AppReplay``: per-query summaries,
@@ -66,12 +76,14 @@ __all__ = ["EventLogWriter", "load_event_log", "AppReplay", "QueryReplay",
 # Event-record schema version. Bump ONLY with a migration note in
 # docs/observability.md; tests/test_observability.py pins the current value
 # and the per-record required-key sets so replay/compare tooling can rely
-# on old logs staying loadable. v7: shuffle_skew records — per-exchange
-# output-partition row/byte distribution (min/p50/max/imbalance), the
-# telemetry the history server's regression sentinel and diagnose's skew
-# finding consume. (v6 added memory_summary/oom_postmortem records and
-# peak_device_bytes on node records.)
-SCHEMA_VERSION = 7
+# on old logs staying loadable. v8: fault/recovery records — the fault-
+# injection framework's per-fire telemetry plus an always-written
+# per-query recovery-ledger delta (null payload when the query saw no
+# recovery activity), the evidence trail docs/fault_tolerance.md and the
+# chaos bench phase consume. (v7 added shuffle_skew records; v6 added
+# memory_summary/oom_postmortem records and peak_device_bytes on node
+# records.)
+SCHEMA_VERSION = 8
 
 # The event-record schema registry: every record type a writer may emit,
 # mapped to the schema version that introduced it. srtpu-analyze's
@@ -91,6 +103,8 @@ RECORD_TYPES: Dict[str, int] = {
     "memory_summary": 6,
     "oom_postmortem": 6,
     "shuffle_skew": 7,
+    "fault": 8,
+    "recovery": 8,
 }
 
 EVENT_LOG_DIR = register_conf(
@@ -164,6 +178,8 @@ class EventLogWriter:
         wait_before = sem.total_wait_time
         counters_before = registry.collect()
         kseq_before = kernel_seq()
+        from ..utils import faults
+        recovery_before = faults.recovery_counters()
         self.write({"event": "query_start", "query_id": qid,
                     "ts": time.time(), "trace_id": tctx.trace_id,
                     "plan": plan.tree_string()})
@@ -177,6 +193,10 @@ class EventLogWriter:
             # postmortem in the flight recorder — persist it, and the leak
             # scan, before the error record propagates
             self._write_memory_records(qid)
+            # v8: whatever recovery the runtime managed BEFORE giving up
+            # (retries, recomputes, respawns) is exactly the forensics a
+            # failed query needs — write it on the error path too
+            self._write_fault_records(qid, recovery_before)
             self.write({"event": "query_end", "query_id": qid,
                         "ts": time.time(), "trace_id": tctx.trace_id,
                         "wall_s": time.perf_counter() - t0,
@@ -222,6 +242,7 @@ class EventLogWriter:
             self.write({**entry, "event": "kernel", "query_id": qid,
                         "first_query_id": entry.get("query_id")})
         self._write_memory_records(qid)
+        self._write_fault_records(qid, recovery_before)
         aqe_events: List[str] = list(getattr(plan, "events", []))
         self.write({
             "event": "query_end", "query_id": qid, "ts": time.time(),
@@ -256,6 +277,24 @@ class EventLogWriter:
             summary = mp.query_end(qid)
         self.write({"event": "memory_summary", "query_id": qid,
                     "ts": time.time(), "summary": summary})
+
+    def _write_fault_records(self, qid: int,
+                             before: Dict[str, int]) -> None:
+        """v8: drain the injector's fire records (one ``fault`` record
+        each; none when injection is off — the common case) and write
+        ONE ``recovery`` record whose payload is the per-query delta of
+        the recovery ledger. ``recovery`` is null when the query saw no
+        recovery activity, so the per-query record set is identical
+        whether or not faults are enabled."""
+        from ..utils import faults
+        for fr in faults.drain_fault_records():
+            self.write({**fr, "event": "fault", "query_id": qid,
+                        "ts": time.time()})
+        after = faults.recovery_counters()
+        delta = {k: after.get(k, 0) - before.get(k, 0)
+                 for k in after if after.get(k, 0) != before.get(k, 0)}
+        self.write({"event": "recovery", "query_id": qid,
+                    "ts": time.time(), "recovery": delta or None})
 
     def close(self) -> None:
         self.write({"event": "app_end", "ts": time.time()})
@@ -331,6 +370,12 @@ class QueryReplay:
         # v7: per-exchange output-partition row/byte distribution records
         # (empty for pre-v7 logs or queries with no materialized exchange)
         self.shuffle_skew: List[Dict] = []
+        # v8: fault-injection + recovery telemetry — ``recovery`` is the
+        # per-query recovery-ledger delta (None for pre-v8 logs AND for
+        # queries that needed no recovery), ``faults`` the injected-fire
+        # records (empty when injection is off)
+        self.recovery: Optional[Dict] = None
+        self.faults: List[Dict] = []
 
     def heartbeats_in_window(self, heartbeats: List[Dict]) -> List[Dict]:
         """App heartbeats whose timestamp falls inside this query's run
@@ -460,6 +505,12 @@ class AppReplay:
                     f"q{q.query_id}: OOM postmortem — {pm.get('context')}"
                     + (f" (report: {pm['path']})" if pm.get("path")
                        else ""))
+            if q.recovery:
+                detail = ", ".join(f"{k}={v}"
+                                   for k, v in sorted(q.recovery.items()))
+                warnings.append(
+                    f"q{q.query_id}: recovered from failures ({detail})"
+                    + (" — faults were injected" if q.faults else ""))
         stalled = [h for h in self.heartbeats if h.get("stalled")]
         if stalled:
             age = max(h.get("last_progress_age_s", 0.0) for h in stalled)
@@ -511,6 +562,14 @@ def load_event_log(path: str) -> AppReplay:
                 q = app.queries.setdefault(rec["query_id"],
                                            QueryReplay(rec["query_id"]))
                 q.shuffle_skew.append(rec)
+            elif ev == "fault":
+                q = app.queries.setdefault(rec["query_id"],
+                                           QueryReplay(rec["query_id"]))
+                q.faults.append(rec)
+            elif ev == "recovery":
+                q = app.queries.setdefault(rec["query_id"],
+                                           QueryReplay(rec["query_id"]))
+                q.recovery = rec.get("recovery")
             elif ev == "query_end":
                 q = app.queries.setdefault(rec["query_id"],
                                            QueryReplay(rec["query_id"]))

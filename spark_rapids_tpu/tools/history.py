@@ -334,12 +334,25 @@ def run_sentinel(store: HistoryStore,
     for q in report.queries:
         qb = app_base.queries.get(q.query_id)
         q._stats_base = dict(qb.stats) if qb is not None else {}
-    sync_flags = _count_gate(report, SYNC_COUNT_KEY)
-    compile_flags = _count_gate(report, COMPILE_COUNT_KEY)
-    wall_q = [q.query_id for q in report.regressed_queries()]
-    wall_ops = [(op.query_id, op.name) for op in report.regressions()]
-    cp_q = [q.query_id for q in report.critical_path_regressions()]
-    mem_q = [q.query_id for q in report.memory_regressions()]
+    # chaos-awareness (event-log v8): a candidate query that recovered
+    # from INJECTED faults and still answered correctly pays its
+    # recovery overhead on purpose — exempt it from every gate instead
+    # of flagging the slowdown as a regression. Uninjected recovery
+    # (fault records absent) still gates: that slowdown is real.
+    chaos_ok = {q.query_id for q in app_cand.queries.values()
+                if getattr(q, "faults", None) and q.error is None}
+    sync_flags = [f for f in _count_gate(report, SYNC_COUNT_KEY)
+                  if f["query_id"] not in chaos_ok]
+    compile_flags = [f for f in _count_gate(report, COMPILE_COUNT_KEY)
+                     if f["query_id"] not in chaos_ok]
+    wall_q = [q.query_id for q in report.regressed_queries()
+              if q.query_id not in chaos_ok]
+    wall_ops = [(op.query_id, op.name) for op in report.regressions()
+                if op.query_id not in chaos_ok]
+    cp_q = [q.query_id for q in report.critical_path_regressions()
+            if q.query_id not in chaos_ok]
+    mem_q = [q.query_id for q in report.memory_regressions()
+             if q.query_id not in chaos_ok]
     flags: List[str] = []
     if wall_q or wall_ops:
         flags.append("wall_time")
@@ -366,6 +379,7 @@ def run_sentinel(store: HistoryStore,
         "memory_regressed_queries": mem_q,
         "sync_count_regressions": sync_flags,
         "compile_count_regressions": compile_flags,
+        "chaos_recovered_queries": sorted(chaos_ok),
         "summary": report.summary(),
     }
     store.write_verdict(cand_id, verdict)

@@ -435,6 +435,11 @@ def _host_sync_source() -> Dict:
     return host_sync_stats()
 
 
+def _faults_source() -> Dict:
+    from .faults import faults_stats
+    return faults_stats()
+
+
 _DEFAULT_SOURCES = {
     "compile_cache": _compile_cache_source,
     "catalog": _catalog_source,
@@ -445,6 +450,7 @@ _DEFAULT_SOURCES = {
     "tracer": _tracer_source,
     "memprof": _memprof_source,
     "host_sync": _host_sync_source,
+    "faults": _faults_source,
 }
 
 _GLOBAL_STATS: Optional[StatsRegistry] = None

@@ -342,6 +342,56 @@ def test_memtrack_checker_rules(tmp_path):
     assert len(report.suppressed) == 1
 
 
+def test_net_checker_rules(tmp_path):
+    path = _write(tmp_path, "net_fixture.py", """\
+        import socket
+
+        def no_deadline(addr):
+            s = socket.create_connection(addr)
+            return s.recv(4)
+
+        def with_deadline(addr):
+            s = socket.create_connection(addr, timeout=5.0)
+            s.settimeout(5.0)
+            return s.recv(4)
+
+        def positional_deadline(addr):
+            with socket.create_connection(addr, 5.0) as s:
+                return s.recv(4)
+
+        def helper_recv(s):
+            return s.recv(4)  # srtpu: net-ok(every caller sets the deadline before handing the socket here)
+
+        def swallow(sock):
+            try:
+                sock.sendall(b"x")
+            except Exception:
+                pass
+
+        def typed_handler(sock):
+            try:
+                sock.sendall(b"x")
+            except OSError:
+                return None
+        """)
+    report = analyze_paths([path], checks=["net"])
+    assert sorted(f.rule for f in report.findings) == [
+        "net-bare-except-pass", "net-connect-no-timeout",
+        "net-socket-no-timeout"]
+    assert {f.symbol for f in report.findings} == {"no_deadline", "swallow"}
+    assert len(report.suppressed) == 1
+
+
+def test_net_checker_skips_cold_packages(tmp_path):
+    cold = tmp_path / "spark_rapids_tpu" / "tools"
+    cold.mkdir(parents=True)
+    (cold / "coldnet.py").write_text(
+        "import socket\n\ndef f(addr):\n"
+        "    return socket.create_connection(addr)\n")
+    report = analyze_paths([str(tmp_path)], checks=["net"])
+    assert report.count("net") == 0
+
+
 def test_bucket_checker_skips_cold_packages(tmp_path):
     cold = tmp_path / "spark_rapids_tpu" / "tools"
     cold.mkdir(parents=True)
@@ -486,6 +536,8 @@ def test_tier1_seeded_violation_fails_each_category(tmp_path,
         "memtrack": "from spark_rapids_tpu.columnar import DeviceTable\n\n"
                     "def f(host):\n"
                     "    return DeviceTable.from_host(host, min_bucket=8)\n",
+        "net": "def f(sock):\n    try:\n        sock.sendall(b'x')\n"
+               "    except Exception:\n        pass\n",
     }
     baseline = load_baseline(default_baseline_path())
     for check, body in seeds.items():

@@ -1,0 +1,590 @@
+"""Chaos suite: the fault-injection framework + end-to-end recovery.
+
+The contract under test (docs/fault_tolerance.md): injected faults are
+deterministic and conf-gated (zero overhead when off); every recovery
+surface — worker supervision/resubmission, transport retry, spill CRC →
+recompute, shuffle fetch-failed → recompute — yields exactly the
+uninjected answer (parity) or a structured error naming the fault
+(never a hang)."""
+import threading
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.conf import RapidsConf
+from spark_rapids_tpu.utils import faults
+from spark_rapids_tpu.utils.faults import (FaultInjectedError, FaultInjector,
+                                           configure_faults)
+
+_FAULT_CONF = {
+    "spark.rapids.tpu.faults.enabled": "true",
+    "spark.rapids.tpu.faults.seed": "7",
+}
+
+
+@pytest.fixture(autouse=True)
+def _pristine_faults():
+    """Every test starts and ends with injection off and the recovery
+    ledger zeroed — the injector is process-global by design."""
+    faults.reset_faults()
+    faults.reset_recovery()
+    yield
+    faults.reset_faults()
+    faults.reset_recovery()
+
+
+def _conf(spec, **extra):
+    vals = dict(_FAULT_CONF)
+    vals["spark.rapids.tpu.faults.spec"] = spec
+    vals.update({k: str(v) for k, v in extra.items()})
+    return vals
+
+
+# ---------------------------------------------------------------------------
+# injector semantics
+# ---------------------------------------------------------------------------
+def test_spec_validation_rejects_typos():
+    with pytest.raises(ValueError, match="unknown fault point"):
+        FaultInjector("shuffel.fetch")
+    with pytest.raises(ValueError, match="unknown fault clause key"):
+        FaultInjector("shuffle.fetch:chance=0.5")
+    with pytest.raises(ValueError, match="unknown fault action"):
+        FaultInjector("shuffle.fetch:action=explode")
+    with pytest.raises(ValueError, match="not in"):
+        FaultInjector("shuffle.fetch:p=1.5")
+    with pytest.raises(ValueError, match="not key=value"):
+        FaultInjector("shuffle.fetch:p")
+
+
+def test_injector_is_deterministic_and_streams_are_independent():
+    spec = "tcp.connect:p=0.3;spill.read:p=0.3"
+
+    def run(seed, order):
+        inj = FaultInjector(spec, seed=seed)
+        return [(p, inj.fire(p) is not None) for p in order]
+
+    interleaved = ["tcp.connect", "spill.read"] * 50
+    grouped = ["tcp.connect"] * 50 + ["spill.read"] * 50
+    a = dict_of_streams(run(7, interleaved))
+    # same seed, same per-point decision sequence regardless of how the
+    # points interleave (each point owns its RNG stream)
+    b = dict_of_streams(run(7, grouped))
+    assert a == b
+    # a different seed (e.g. another worker's seed offset) decorrelates
+    c = dict_of_streams(run(8, interleaved))
+    assert a != c
+    # and re-running the same seed reproduces exactly
+    assert dict_of_streams(run(7, interleaved)) == a
+
+
+def dict_of_streams(pairs):
+    out = {}
+    for point, fired in pairs:
+        out.setdefault(point, []).append(fired)
+    return out
+
+
+def test_times_after_and_budget():
+    inj = FaultInjector("worker.task:after=2:times=2")
+    got = [inj.fire("worker.task") for _ in range(6)]
+    assert got == [None, None, "raise", "raise", None, None]
+    c = inj.counters()["worker.task"]
+    assert c == {"evaluations": 6, "fires": 2}
+    recs = inj.drain_records()
+    assert [r["evaluation"] for r in recs] == [3, 4]
+    assert [r["fire"] for r in recs] == [1, 2]
+    assert all(r["point"] == "worker.task" and r["action"] == "raise"
+               for r in recs)
+    assert inj.drain_records() == []
+
+
+def test_zero_overhead_pin():
+    """With injection disabled, a fault point is ONE module-global
+    is-None check. Pin the shape so a refactor cannot quietly put
+    parsing, dict lookups, or locks on the disabled path."""
+    assert faults.active() is None  # the default: nothing installed
+    assert faults.fire("shuffle.fetch") is None
+    assert faults.drain_fault_records() == []
+    # the fast path reads the module constant FIRST — the first global
+    # the function body touches is _INJECTOR, and the disabled branch
+    # calls nothing else
+    assert faults.fire.__code__.co_names[0] == "_INJECTOR"
+    # disabled conf clears any previously-installed injector
+    configure_faults(RapidsConf(_conf("shuffle.fetch")))
+    assert faults.active() is not None
+    configure_faults(RapidsConf({}))
+    assert faults.active() is None
+
+
+def test_configure_faults_seed_offset_decorrelates_workers():
+    spec = _conf("worker.task:p=0.4")
+    w0 = configure_faults(RapidsConf(spec), seed_offset=0)
+    s0 = [w0.fire("worker.task") is not None for _ in range(40)]
+    w1 = configure_faults(RapidsConf(spec), seed_offset=1)
+    s1 = [w1.fire("worker.task") is not None for _ in range(40)]
+    assert s0 != s1
+    w0b = configure_faults(RapidsConf(spec), seed_offset=0)
+    assert [w0b.fire("worker.task") is not None for _ in range(40)] == s0
+
+
+def test_fault_error_names_point_and_action():
+    e = FaultInjectedError("spill.read", "corrupt")
+    assert e.point == "spill.read" and e.action == "corrupt"
+    assert "spill.read" in str(e) and "corrupt" in str(e)
+
+
+def test_recovery_ledger_and_stats_source():
+    faults.note_recovery("transport_retries")
+    faults.note_recovery("transport_retries")
+    faults.note_recovery("some_new_mechanism")  # unknown keys register
+    assert faults.recovery_counters()["transport_retries"] == 2
+    assert faults.recovery_counters()["some_new_mechanism"] == 1
+    stats = faults.faults_stats()
+    assert stats["transport_retries"] == 2
+    configure_faults(RapidsConf(_conf("tcp.read:times=1")))
+    faults.fire("tcp.read")
+    assert faults.faults_stats()["injected_tcp_read"] == 1
+    # the gauge reaches /metrics through the default stats sources
+    from spark_rapids_tpu.utils.metrics import get_stats
+    collected = get_stats().collect()
+    assert collected.get("faults_transport_retries") == 2.0
+    faults.reset_recovery()
+    assert faults.recovery_counters()["transport_retries"] == 0
+
+
+def test_delay_action_is_latency_only():
+    configure_faults(RapidsConf(_conf(
+        "shuffle.fetch:action=delay:latency_ms=1")))
+    assert faults.fire("shuffle.fetch") == "delay"
+
+
+# ---------------------------------------------------------------------------
+# spill integrity: CRC32 on write, verified on restore
+# ---------------------------------------------------------------------------
+def _stored_table(buffer_id=1):
+    from spark_rapids_tpu.columnar import dtypes as _dt
+    from spark_rapids_tpu.columnar.device import DeviceTable
+    from spark_rapids_tpu.columnar.host import HostColumn, HostTable
+    from spark_rapids_tpu.memory.stores import HostStore, StoredTable
+    host = HostTable(["v"], [HostColumn(
+        _dt.LONG, np.arange(64, dtype=np.int64))])
+    table = DeviceTable.from_host(host, min_bucket=8)
+    stored = StoredTable(buffer_id, table, priority=0, size_bytes=1024)
+    HostStore(1 << 20).put(stored)
+    return stored
+
+
+@pytest.mark.parametrize("direct", [True, False])
+def test_spill_corrupt_action_is_caught_on_restore(tmp_path, direct):
+    from spark_rapids_tpu.memory.stores import DiskStore, \
+        SpillCorruptionError
+    configure_faults(RapidsConf(_conf("spill.write:action=corrupt:times=1")))
+    store = DiskStore(str(tmp_path / ("d" if direct else "z")),
+                      direct=direct)
+    stored = _stored_table()
+    store.put(stored)  # the injected action flips a byte AFTER the CRC
+    with pytest.raises(SpillCorruptionError, match="integrity check"):
+        store.load(stored)
+    assert faults.recovery_counters()["spill_corruptions"] >= 1
+    # an uncorrupted spill round-trips and verifies clean
+    faults.reset_faults()
+    clean = _stored_table(buffer_id=2)
+    store.put(clean)
+    arrays = store.load(clean)
+    assert "col0.data" in arrays
+
+
+@pytest.mark.parametrize("direct", [True, False])
+def test_spill_roundtrip_without_checksum_still_works(tmp_path, direct):
+    from spark_rapids_tpu.memory.stores import DiskStore
+    store = DiskStore(str(tmp_path), direct=direct, checksum=False)
+    stored = _stored_table()
+    store.put(stored)
+    assert "col0.data" in store.load(stored)
+    store.drop(stored)
+    assert store.used_bytes == 0
+
+
+def test_spill_read_injection_surfaces_as_corruption(tmp_path):
+    from spark_rapids_tpu.memory.stores import DiskStore, \
+        SpillCorruptionError
+    store = DiskStore(str(tmp_path), direct=True)
+    stored = _stored_table()
+    store.put(stored)
+    configure_faults(RapidsConf(_conf("spill.read:times=1")))
+    with pytest.raises(SpillCorruptionError, match="spill.read"):
+        store.load(stored)
+    # bounded: the next restore succeeds (times=1 exhausted)
+    assert "col0.data" in store.load(stored)
+
+
+# ---------------------------------------------------------------------------
+# shuffle manager: injected fetch failures recover through recompute
+# ---------------------------------------------------------------------------
+def _host_table(vals, keys):
+    from spark_rapids_tpu.columnar import dtypes as _dt
+    from spark_rapids_tpu.columnar.host import HostColumn, HostTable
+    return HostTable(["k", "v"], [
+        HostColumn(_dt.LONG, np.asarray(keys, dtype=np.int64)),
+        HostColumn(_dt.LONG, np.asarray(vals, dtype=np.int64))])
+
+
+def _manager_rows(conf_extra, spec=None):
+    """Write 2 map outputs, read every reduce partition back (with a
+    recompute hook), return the sorted row multiset."""
+    from spark_rapids_tpu.columnar.device import DeviceTable
+    from spark_rapids_tpu.shuffle.manager import ShuffleManager
+    from spark_rapids_tpu.shuffle.transport import LocalShuffleTransport
+    if spec is not None:
+        configure_faults(RapidsConf(_conf(spec)))
+    mgr = ShuffleManager(RapidsConf(conf_extra),
+                         transport=LocalShuffleTransport())
+    sid = mgr.new_shuffle_id()
+    tables = {m: _host_table(np.arange(m * 10, m * 10 + 10),
+                             np.arange(10) % 3) for m in range(2)}
+
+    def write(m):
+        mgr.write_partition(sid, m, iter([DeviceTable.from_host(
+            tables[m], min_bucket=8)]), ["k"], 3)
+
+    for m in tables:
+        write(m)
+    rows = []
+    for r in range(3):
+        for t in mgr.read_partition(sid, 2, r, min_bucket=8,
+                                    recompute=write):
+            h = t.to_host()
+            rows.extend(zip(h.column("k").values.tolist(),
+                            h.column("v").values.tolist()))
+    return sorted(rows)
+
+
+def test_manager_injected_fetch_failures_recompute_to_parity():
+    baseline = _manager_rows({"spark.rapids.tpu.shuffle.cacheWrites": "off"})
+    # recompute is once-per-map, so each injected failure must land on a
+    # fresh map: a deterministic single shot, then a probabilistic one
+    for spec in ("shuffle.fetch:times=1", "shuffle.fetch:p=0.4:times=1"):
+        faults.reset_faults()
+        faults.reset_recovery()
+        chaotic = _manager_rows(
+            {"spark.rapids.tpu.shuffle.cacheWrites": "off"}, spec=spec)
+        assert chaotic == baseline
+        assert faults.recovery_counters()["shuffle_recomputes"] >= 1
+
+
+def test_manager_cached_tier_injected_miss_recomputes_to_parity():
+    baseline = _manager_rows({})
+    faults.reset_faults()
+    faults.reset_recovery()
+    chaotic = _manager_rows({}, spec="shuffle.fetch:times=1")
+    assert chaotic == baseline
+    assert faults.recovery_counters()["shuffle_recomputes"] >= 1
+
+
+def test_manager_publish_fault_surfaces_structured():
+    from spark_rapids_tpu.columnar.device import DeviceTable
+    from spark_rapids_tpu.shuffle.manager import ShuffleManager
+    from spark_rapids_tpu.shuffle.transport import LocalShuffleTransport
+    configure_faults(RapidsConf(_conf("shuffle.publish:times=1")))
+    mgr = ShuffleManager(RapidsConf({}), transport=LocalShuffleTransport())
+    sid = mgr.new_shuffle_id()
+    with pytest.raises(FaultInjectedError, match="shuffle.publish"):
+        mgr.write_partition(sid, 0, iter([DeviceTable.from_host(
+            _host_table([1], [0]), min_bucket=8)]), ["k"], 1)
+
+
+# ---------------------------------------------------------------------------
+# TCP transport: transient socket errors retry to parity
+# ---------------------------------------------------------------------------
+def test_tcp_transient_socket_errors_retry_to_parity():
+    from spark_rapids_tpu.shuffle.serializer import deserialize_table, \
+        serialize_table
+    from spark_rapids_tpu.shuffle.tcp import TcpShuffleTransport
+    from spark_rapids_tpu.shuffle.transport import BlockId
+    conf = RapidsConf({
+        "spark.rapids.tpu.shuffle.tcp.retryBackoffMs": "5",
+        "spark.rapids.tpu.shuffle.tcp.retryMaxBackoffMs": "20",
+    })
+    a = TcpShuffleTransport(conf)
+    b = TcpShuffleTransport(conf)
+    try:
+        b.add_peer(*a.address)
+        payload = serialize_table(_host_table([1, 2, 3], [0, 1, 2]))
+        a.publish(BlockId(5, 0, 0), payload)
+        # first connect attempt AND first read attempt fail; the retry
+        # loop must deliver the identical payload anyway
+        configure_faults(RapidsConf(_conf(
+            "tcp.connect:times=1;tcp.read:times=1")))
+        got = dict(b.fetch([BlockId(5, 0, 0)]))
+        assert deserialize_table(got[BlockId(5, 0, 0)]) \
+            .column("v").values.tolist() == [1, 2, 3]
+        assert faults.recovery_counters()["transport_retries"] >= 1
+        assert faults.recovery_counters()["transport_giveups"] == 0
+    finally:
+        a.close()
+        b.close()
+
+
+def test_tcp_exhausted_retries_become_fetch_failed_not_hang():
+    from spark_rapids_tpu.shuffle.serializer import serialize_table
+    from spark_rapids_tpu.shuffle.tcp import TcpShuffleTransport
+    from spark_rapids_tpu.shuffle.transport import BlockId, \
+        ShuffleFetchFailedException
+    conf = RapidsConf({
+        "spark.rapids.tpu.shuffle.tcp.retryAttempts": "2",
+        "spark.rapids.tpu.shuffle.tcp.retryBackoffMs": "5",
+        "spark.rapids.tpu.shuffle.tcp.retryMaxBackoffMs": "10",
+    })
+    a = TcpShuffleTransport(conf)
+    b = TcpShuffleTransport(conf)
+    try:
+        b.add_peer(*a.address)
+        a.publish(BlockId(6, 0, 0), serialize_table(
+            _host_table([1], [0])))
+        configure_faults(RapidsConf(_conf("tcp.connect")))  # always
+        with pytest.raises(ShuffleFetchFailedException):
+            list(b.fetch([BlockId(6, 0, 0)]))
+        assert faults.recovery_counters()["transport_giveups"] >= 1
+    finally:
+        a.close()
+        b.close()
+
+
+def test_tcp_missing_block_is_definitive_not_retried():
+    """A live peer answering found=0 must NOT consume the retry budget —
+    the miss goes straight to fetch-failed -> recompute."""
+    from spark_rapids_tpu.shuffle.tcp import TcpShuffleTransport
+    from spark_rapids_tpu.shuffle.transport import BlockId, \
+        ShuffleFetchFailedException
+    a = TcpShuffleTransport()
+    b = TcpShuffleTransport()
+    try:
+        b.add_peer(*a.address)
+        with pytest.raises(ShuffleFetchFailedException):
+            list(b.fetch([BlockId(9, 9, 9)]))
+        assert faults.recovery_counters()["transport_retries"] == 0
+    finally:
+        a.close()
+        b.close()
+
+
+def test_host_block_store_bounds_provider_reserves():
+    """A crash-looping lazy provider is re-registered at most
+    maxProviderRetries times, then the block reports missing (->
+    fetch-failed -> recompute) instead of pinning its inputs forever."""
+    from spark_rapids_tpu.shuffle.tcp import _HostBlockStore
+    from spark_rapids_tpu.shuffle.transport import BlockId
+    store = _HostBlockStore(1 << 20, max_provider_retries=3)
+    block = BlockId(1, 0, 0)
+    calls = []
+
+    def bad_provider():
+        calls.append(1)
+        raise RuntimeError("serialization keeps failing")
+
+    store.put_lazy(block, bad_provider)
+    for _ in range(5):  # ask more times than the budget allows
+        try:
+            store.length(block)
+        except RuntimeError:
+            continue
+    assert len(calls) == 3      # bounded: budget consumed, then dropped
+    assert store.length(block) is None   # missing, no further calls
+    assert len(calls) == 3
+    # a provider that recovers clears its retry count on success
+    good = BlockId(1, 1, 0)
+    flaky = {"n": 0}
+
+    def flaky_provider():
+        flaky["n"] += 1
+        if flaky["n"] == 1:
+            raise RuntimeError("transient")
+        return b"payload"
+
+    store.put_lazy(good, flaky_provider)
+    try:
+        store.length(good)
+    except RuntimeError:
+        pass
+    assert store.length(good) == len(b"payload")
+    assert good not in store._provider_retries
+
+
+# ---------------------------------------------------------------------------
+# event-log + replay integration (schema v8)
+# ---------------------------------------------------------------------------
+def test_eventlog_recovery_record_null_when_disabled(tmp_path):
+    from spark_rapids_tpu.tools.eventlog import EventLogWriter, \
+        load_event_log
+
+    class _Plan:
+        children = ()
+
+        def tree_string(self):
+            return "plan"
+
+        def release_spill_handles(self):
+            pass
+
+    w = EventLogWriter(str(tmp_path), "app-clean", {})
+    w.run_query(_Plan(), lambda: 42)
+    w.close()
+    app = load_event_log(w.path)
+    assert app.query(1).recovery is None
+    assert app.query(1).faults == []
+    assert app.health_check() == []
+
+
+def test_eventlog_fault_and_recovery_records(tmp_path):
+    from spark_rapids_tpu.tools.diagnose import diagnose_path
+    from spark_rapids_tpu.tools.eventlog import EventLogWriter, \
+        load_event_log
+
+    class _Plan:
+        children = ()
+
+        def tree_string(self):
+            return "plan"
+
+        def release_spill_handles(self):
+            pass
+
+    configure_faults(RapidsConf(_conf("h2d.upload:times=1")))
+
+    def collect():
+        faults.fire("h2d.upload")
+        faults.note_recovery("transport_retries", 3)
+        faults.note_recovery("shuffle_recomputes")
+        return 1
+
+    w = EventLogWriter(str(tmp_path), "app-chaos", {})
+    w.run_query(_Plan(), collect)
+
+    # error path: recovery-so-far is still persisted before the raise
+    def boom():
+        faults.note_recovery("spill_corruptions")
+        raise RuntimeError("query died")
+
+    with pytest.raises(RuntimeError):
+        w.run_query(_Plan(), boom)
+    w.close()
+
+    app = load_event_log(w.path)
+    q1 = app.query(1)
+    assert q1.recovery == {"transport_retries": 3, "shuffle_recomputes": 1}
+    assert [f["point"] for f in q1.faults] == ["h2d.upload"]
+    assert q1.faults[0]["action"] == "raise"
+    q2 = app.query(2)
+    assert q2.error and q2.recovery == {"spill_corruptions": 1}
+    warnings = app.health_check()
+    assert any("recovered from failures" in s for s in warnings)
+    # diagnose surfaces the recovery ledger as ranked findings
+    rep = diagnose_path(w.path)
+    metrics = [f.metric for q in rep.queries for f in q.findings]
+    assert "transportRetries" in metrics
+
+
+# ---------------------------------------------------------------------------
+# worker supervision: kills, resubmission, structured exhaustion
+# ---------------------------------------------------------------------------
+def _thread_names():
+    return {t.name for t in threading.enumerate()
+            if t is not threading.main_thread()}
+
+
+def test_worker_kill_resubmits_and_query_reaches_parity():
+    """Acceptance pin: a worker killed mid-query (injected worker.task
+    kill) yields exactly the uninjected sequential answer — supervision
+    detects the death, respawns/excludes, and resubmits the orphaned
+    partition tasks."""
+    from spark_rapids_tpu.parallel.runtime import (ProcessCluster,
+                                                   _query_plan)
+    from spark_rapids_tpu.columnar.host import HostTable
+
+    # 2 output partitions keep the fan-out to one task per worker, so
+    # the kill below lands on exactly one process across the whole run
+    shuffle = {"spark.rapids.tpu.shuffle.partitions": "2"}
+    # sequential (uninjected) reference, built in-process with the same
+    # plan cache the workers use
+    _sess, plan = _query_plan("q1", 0.01, True, 2, dict(shuffle))
+    parts = []
+    for pidx in range(plan.num_partitions):
+        parts.extend(plan.execute(pidx))
+    expected = HostTable.concat(parts).to_arrow()
+
+    # after=1 lets worker 0's first task through (the plan-partition
+    # probe), then its partition task dies mid-query
+    conf = _conf("worker.task:after=1:times=1:action=kill",
+                 **{"spark.rapids.tpu.task.timeout": 120,
+                    "spark.rapids.tpu.task.heartbeatInterval": 0.5,
+                    "spark.rapids.tpu.task.heartbeatTimeout": 60,
+                    **shuffle})
+    before = _thread_names()
+    with ProcessCluster(2, conf=conf) as cluster:
+        got = cluster.run_tpch_query("q1", sf=0.01, tiny=True,
+                                     num_partitions=2, timeout_s=120)
+    # supervision noted the death + resubmission in the driver's ledger
+    assert faults.recovery_counters()["worker_deaths"] >= 1
+    assert faults.recovery_counters()["task_resubmissions"] >= 1
+    assert got.num_rows == expected.num_rows
+    key = [(c, "ascending") for c in expected.column_names]
+    assert got.sort_by(key).equals(expected.sort_by(key))
+    # supervision leaves no non-daemon driver threads behind after close
+    leaked = [t for t in threading.enumerate()
+              if t is not threading.main_thread()
+              and t.name not in before and not t.daemon]
+    assert not leaked, leaked
+
+
+def test_exhausted_max_failures_is_structured_not_a_hang():
+    """Every submitted task dies (kill on every evaluation) with
+    respawn disabled: the task must fail FAST with a TaskFailedError
+    naming the injected fault and the exhausted conf — the old behavior
+    was a silent 300s hang."""
+    from spark_rapids_tpu.parallel.runtime import (ProcessCluster,
+                                                   TaskFailedError,
+                                                   trace_probe_task)
+    conf = _conf("worker.task:action=kill",
+                 **{"spark.rapids.tpu.task.maxFailures": 2,
+                    "spark.rapids.tpu.task.respawnWorkers": "false",
+                    "spark.rapids.tpu.task.timeout": 60})
+    with ProcessCluster(2, conf=conf) as cluster:
+        with pytest.raises(TaskFailedError) as ei:
+            cluster.run_on(0, trace_probe_task, timeout_s=60)
+    e = ei.value
+    msg = str(e)
+    assert "maxFailures=2" in msg or "no live workers" in msg
+    assert e.attempts >= 1 and e.task_id is not None
+    assert e.history, "failure history missing from the structured error"
+    assert e.fault and "worker.task" in e.fault, \
+        f"error does not name the injected fault: {msg}"
+    assert faults.recovery_counters()["task_failures"] >= 1
+    assert faults.recovery_counters()["worker_exclusions"] >= 1
+
+
+@pytest.mark.slow
+def test_worker_kill_parity_q3_q5():
+    """The full acceptance matrix: join-heavy TPC-H queries reach exact
+    parity through a mid-query worker kill."""
+    from spark_rapids_tpu.columnar.host import HostTable
+    from spark_rapids_tpu.parallel.runtime import (ProcessCluster,
+                                                   _query_plan)
+    shuffle = {"spark.rapids.tpu.shuffle.partitions": "2"}
+    for query in ("q3", "q5"):
+        faults.reset_faults()
+        faults.reset_recovery()
+        _sess, plan = _query_plan(query, 0.01, True, 2, dict(shuffle))
+        parts = []
+        for pidx in range(plan.num_partitions):
+            parts.extend(plan.execute(pidx))
+        expected = HostTable.concat(parts).to_arrow()
+        conf = _conf("worker.task:after=1:times=1:action=kill",
+                     **{"spark.rapids.tpu.task.timeout": 240,
+                        "spark.rapids.tpu.task.heartbeatInterval": 0.5,
+                        **shuffle})
+        with ProcessCluster(2, conf=conf) as cluster:
+            got = cluster.run_tpch_query(query, sf=0.01, tiny=True,
+                                         num_partitions=2, timeout_s=240)
+        assert faults.recovery_counters()["worker_deaths"] >= 1
+        key = [(c, "ascending") for c in expected.column_names]
+        assert got.sort_by(key).equals(expected.sort_by(key)), query

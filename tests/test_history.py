@@ -23,11 +23,12 @@ from spark_rapids_tpu.tools.history import main as history_main
 
 
 def _write_log(path, app_id, wall=1.0, stats=None, skew_rows=None,
-               n_queries=2, error_qid=None):
+               n_queries=2, error_qid=None, fault_qids=()):
     """One synthetic schema-v7 event log: ``n_queries`` queries of
     ``wall`` seconds each, a two-node plan, optional per-query counter
     stats, and an optional shuffle_skew record built from an explicit
-    per-partition row list."""
+    per-partition row list. Queries in ``fault_qids`` additionally carry
+    schema-v8 ``fault`` + ``recovery`` records (an injected-chaos run)."""
     recs = [{"event": "app_start", "app_id": app_id, "schema_version": 7,
              "ts": 1000.0, "conf": {}}]
     for qid in range(1, n_queries + 1):
@@ -61,6 +62,14 @@ def _write_log(path, app_id, wall=1.0, stats=None, skew_rows=None,
                           "max": 8 * max(skew_rows), "mean": 8 * mean,
                           "imbalance": max(skew_rows) / mean},
                 "per_partition_rows": list(skew_rows)})
+        if qid in fault_qids:
+            recs.append({"event": "fault", "query_id": qid, "ts": t0,
+                         "point": "worker.task", "action": "kill",
+                         "fire": 1, "evaluation": 2})
+            recs.append({"event": "recovery", "query_id": qid,
+                         "ts": t0 + wall,
+                         "recovery": {"worker_deaths": 1,
+                                      "task_resubmissions": 1}})
         end = {"event": "query_end", "query_id": qid, "ts": t0 + wall,
                "wall_s": wall, "stats": dict(stats or {})}
         if qid == error_qid:
@@ -166,6 +175,45 @@ def test_sentinel_clean_then_regressed(tmp_path):
     assert store.index()["regressed"]["verdict"]["ok"] is False
 
 
+def test_sentinel_treats_recovered_chaos_run_as_clean(tmp_path):
+    """A candidate whose queries carry schema-v8 fault records but no
+    errors (an injected-chaos run that recovered to the right answer,
+    e.g. BENCH_CHAOS=1) is exempt from every gate — its recovery
+    overhead is paid on purpose. A query that regressed WITHOUT
+    injection in the same run still flags."""
+    store = HistoryStore(str(tmp_path / "store"))
+    store.append_run(_write_log(str(tmp_path / "b.jsonl"), "base",
+                                wall=1.0, stats=_BASE_STATS))
+    store.pin_baseline("base")
+
+    # every query slower + counter explosions, but all injected+recovered
+    store.append_run(_write_log(
+        str(tmp_path / "ch.jsonl"), "chaos", wall=10.0,
+        stats={SYNC_COUNT_KEY: 60, COMPILE_COUNT_KEY: 58},
+        fault_qids=(1, 2)))
+    v = run_sentinel(store, candidate="chaos")
+    assert v["ok"] is True and v["status"] == "clean"
+    assert v["flags"] == []
+    assert v["chaos_recovered_queries"] == [1, 2]
+
+    # same slowdown but only query 2 was injected: query 1's regression
+    # is real and still gates
+    store.append_run(_write_log(
+        str(tmp_path / "m.jsonl"), "mixed", wall=10.0,
+        stats=_BASE_STATS, fault_qids=(2,)))
+    v = run_sentinel(store, candidate="mixed", baseline="base")
+    assert v["ok"] is False and "wall_time" in v["flags"]
+    assert v["wall_regressed_queries"] == [1]
+    assert v["chaos_recovered_queries"] == [2]
+
+    # an injected query that ERRORED is not exempt — recovery failed
+    store.append_run(_write_log(
+        str(tmp_path / "e.jsonl"), "chaos-err", wall=10.0,
+        stats=_BASE_STATS, fault_qids=(1, 2), error_qid=1))
+    v = run_sentinel(store, candidate="chaos-err", baseline="base")
+    assert v["chaos_recovered_queries"] == [2]
+
+
 def test_sentinel_no_baseline_and_cli_exit_codes(tmp_path):
     store_dir = str(tmp_path / "store")
     store = HistoryStore(store_dir)
@@ -244,14 +292,15 @@ def test_history_server_ui_smoke(tmp_path):
 
 
 def test_shuffle_skew_record_schema_v7_pin():
-    """The v7 pin: shuffle_skew is registered at exactly schema 7, the
-    writer's version IS 7, and the summary math the exchanges feed from
-    (utils/metrics.py) produces the pinned stat keys."""
+    """The skew pin: shuffle_skew is registered at exactly schema 7
+    (the writer has since moved to v8 for fault/recovery records), and
+    the summary math the exchanges feed from (utils/metrics.py)
+    produces the pinned stat keys."""
     from spark_rapids_tpu.tools.eventlog import (RECORD_TYPES,
                                                  SCHEMA_VERSION)
     from spark_rapids_tpu.utils.metrics import (build_skew_record,
                                                 skew_summary)
-    assert SCHEMA_VERSION == 7
+    assert SCHEMA_VERSION == 8
     assert RECORD_TYPES["shuffle_skew"] == 7
     assert max(RECORD_TYPES.values()) == SCHEMA_VERSION
 
@@ -293,7 +342,7 @@ def test_session_close_appends_run(tmp_path):
     apps = store.apps()
     assert len(apps) == 1
     h = apps[0]
-    assert h["n_queries"] == 1 and h["schema_version"] == 7
+    assert h["n_queries"] == 1 and h["schema_version"] == 8
     app = store.load(h["app_id"])
     (q,) = app.queries.values()
     assert q.nodes  # plan replays

@@ -353,6 +353,11 @@ _REQUIRED_KEYS = {
     # _run_logged_app below guarantees at least one)
     "shuffle_skew": {"event", "query_id", "node_id", "name", "partitions",
                      "rows", "bytes", "per_partition_rows"},
+    # v8: per-query recovery-ledger delta, ALWAYS written (recovery is
+    # null when the query needed no recovery — the zero-overhead pin);
+    # fault records appear only when injection actually fired and are
+    # pinned separately in tests/test_faults.py
+    "recovery": {"event", "query_id", "ts", "recovery"},
     "app_end": {"event", "ts"},
 }
 
@@ -401,8 +406,11 @@ def test_eventlog_schema_version_and_required_keys(tmp_path):
     # query_end (null when tracing is off, as here). v6 adds the memory
     # flight recorder: per-query memory_summary, peak_device_bytes on
     # node records, oom_postmortem records on OOM. v7 adds shuffle_skew:
-    # per-exchange output-partition distribution records
-    assert SCHEMA_VERSION == 7
+    # per-exchange output-partition distribution records. v8 adds the
+    # fault-injection/recovery telemetry: an always-written per-query
+    # recovery record (null payload here — no faults, no recovery) plus
+    # fault records when injection fires
+    assert SCHEMA_VERSION == 8
     assert by_type["app_start"][0]["schema_version"] == SCHEMA_VERSION
     for kind, required in _REQUIRED_KEYS.items():
         for rec in by_type[kind]:
@@ -603,7 +611,7 @@ def test_eventlog_query_stats_cover_all_subsystems(tmp_path):
     from spark_rapids_tpu.tools.eventlog import load_event_log
     path = _run_logged_app(tmp_path)
     app = load_event_log(path)
-    assert app.schema_version == 7
+    assert app.schema_version == 8
     q = app.query(1)
     assert q.stats, "query_end stats delta missing"
     for family in ("compile_cache_", "upload_cache_", "shuffle_",
