@@ -68,6 +68,17 @@ as "chaos" in the bench JSON with the recovery overhead;
 BENCH_CHAOS_SF scales the data), and the history sentinel treats a
 recovered-but-correct chaos run as clean (run_sentinel exempts queries
 whose event log carries fault records and no error).
+BENCH_OOM (1 opt-in: pressure-parity phase — each query first runs
+clean to record its reference answer and the clean-run peak-HBM
+watermark, then re-runs in a fresh session whose device pool is capped
+at BENCH_OOM_FRAC (default 0.40) of that peak in strict mode, plus a
+deterministic times-bounded alloc.jit OOM fault spec; the pressured
+answer must match the clean answer and the memory/retry.py ladder
+counters must show nonzero oom_retries + oom_splits, recorded as "oom"
+in the bench JSON with per-query retry/split/spill deltas;
+BENCH_OOM_SF scales the data, and the history sentinel treats a
+recovered run as clean — run_sentinel exempts queries whose event log
+carries oom_retry records and no error).
 """
 import atexit
 import json
@@ -93,6 +104,7 @@ _STATE = {
     "ablation": {},
     "restart": {},
     "chaos": {},      # query -> clean-vs-injected parity + recovery ledger
+    "oom": {},        # query -> pressure-vs-clean parity + retry ladder deltas
     "compile_cache": {},   # phase -> cache_stats() snapshot
     "sf": None,
     "rows": None,
@@ -502,6 +514,8 @@ def main():
         phase_with_retries("ablation", None)
     if os.environ.get("BENCH_CHAOS", "0") == "1" and _remaining() > 120:
         phase_with_retries("chaos", [1, 3])
+    if os.environ.get("BENCH_OOM", "0") == "1" and _remaining() > 120:
+        phase_with_retries("oom", [1, 6])
     _emit(reason="done")
 
 
@@ -1193,6 +1207,131 @@ def _worker_chaos(sink: _EventSink):
             _log(f"chaos {name} FAILED: {e}")
 
 
+def _worker_oom(sink: _EventSink):
+    """BENCH_OOM=1: the pressure-parity phase. Each query runs twice in
+    one worker process — clean (recording the reference answer and the
+    clean-run peak-HBM watermark), then in a FRESH session whose device
+    pool is capped at BENCH_OOM_FRAC (default 0.40) of that peak in
+    strict mode, with a deterministic times-bounded alloc.jit OOM spec
+    layered on top so the ladder's plain-retry rung fires even when
+    spilling alone absorbs the pool pressure. Passes only if the
+    pressured answer matches the clean answer AND the memory/retry.py
+    ladder counters moved (nonzero oom_retries + oom_splits across the
+    phase). The history sentinel never flags it because run_sentinel
+    exempts queries whose event log carries oom_retry records and no
+    error."""
+    _worker_setup_jax()
+    from spark_rapids_tpu.memory.catalog import peek_catalog
+    from spark_rapids_tpu.memory.retry import reset_retry_state, retry_stats
+    from spark_rapids_tpu.session import TpuSession
+    from spark_rapids_tpu.tools import tpch
+
+    sf = float(os.environ.get("BENCH_OOM_SF", "0.05"))
+    frac = float(os.environ.get("BENCH_OOM_FRAC", "0.40"))
+    nparts = 2
+    tables = tpch.gen_all(sf)
+    queries = [int(q) for q in
+               os.environ.get("BENCH_WORKER_QUERIES", "1,6").split(",")
+               if q]
+    base_conf = {
+        "spark.rapids.tpu.batchRowsMinBucket": 4096,
+        "spark.rapids.tpu.shuffle.partitions": nparts,
+    }
+
+    # pass 1: clean run — reference answers (host path) + the device
+    # peak-HBM watermark the pressure pool is derived from
+    sess = TpuSession(base_conf)
+    dfs = tpch.build_dataframes(sess, tables, num_partitions=nparts)
+    refs, clean_s = {}, {}
+    for i in queries:
+        name = f"q{i}"
+        try:
+            q = getattr(tpch, name)(dfs)
+            t0 = time.perf_counter()
+            q.collect(device=True)          # drive the device watermark
+            clean_s[name] = time.perf_counter() - t0
+            refs[name] = q.collect(device=False)
+        except Exception as e:
+            sink.emit(ev="error", name=name,
+                      msg=f"clean pass: {type(e).__name__}: {e}"[:300])
+            _log(f"oom {name} clean pass FAILED: {e}")
+    cat = peek_catalog()
+    peak = cat.peak_device_bytes if cat is not None else 0
+    sess.close()
+    if not refs or peak <= 0:
+        sink.emit(ev="error", name="setup",
+                  msg=f"no clean references (peak={peak})")
+        return
+    pool = max(int(peak * frac), 1 << 20)
+    _log(f"oom: clean peak={peak} -> strict pool={pool} ({frac:.0%})")
+
+    # pass 2: fresh session under pressure — strict pool + injected OOMs
+    reset_retry_state()
+    sess = TpuSession({
+        **base_conf,
+        "spark.rapids.tpu.memory.pool.size": pool,
+        "spark.rapids.tpu.memory.pool.mode": "strict",
+        "spark.rapids.tpu.faults.enabled": True,
+        "spark.rapids.tpu.faults.seed": 11,
+        # times <= oom.maxRetries so a spill-only scope can absorb the
+        # injected failures via plain retries; splits come from the pool
+        "spark.rapids.tpu.faults.spec":
+            "alloc.jit:after=3:times=2:action=oom",
+        **_eventlog_conf("oom", sink),
+        **_history_conf("oom"),
+        **_memprof_conf(),
+    })
+    dfs = tpch.build_dataframes(sess, tables, num_partitions=nparts)
+    for i in queries:
+        name = f"q{i}"
+        if name not in refs:
+            continue
+        sink.emit(ev="start", name=name)
+        try:
+            before = retry_stats()
+            mb = _mem_probe()
+            t0 = time.perf_counter()
+            got = getattr(tpch, name)(dfs).collect(device=True)
+            oom_s = time.perf_counter() - t0
+            after = retry_stats()
+            err = _tables_equal(got, refs[name])
+            if not (err <= _rel_tol()):
+                raise AssertionError(
+                    f"pressured run diverged from clean run: rel_err={err}")
+            delta = {k: after[k] - before[k]
+                     for k in ("oom_retries", "oom_splits",
+                               "oom_rematerializations", "oom_recoveries",
+                               "oom_spilled_bytes")
+                     if after[k] - before[k]}
+            res = {"clean_s": round(clean_s[name], 4),
+                   "oom_s": round(oom_s, 4),
+                   "overhead": round(oom_s / clean_s[name], 3)
+                   if clean_s.get(name) else None,
+                   "rel_err": err, "pool_bytes": pool,
+                   "retry": delta, **_mem_res(mb)}
+            sink.emit(ev="done", phase="oom", name=name, res=res)
+            _log(f"oom {name}: clean={clean_s[name]:.3f}s "
+                 f"pressured={oom_s:.3f}s retries="
+                 f"{delta.get('oom_retries', 0)} splits="
+                 f"{delta.get('oom_splits', 0)} rel_err={err:.2e}")
+        except Exception as e:
+            sink.emit(ev="error", name=name,
+                      msg=f"{type(e).__name__}: {e}"[:300])
+            _log(f"oom {name} FAILED: {e}")
+    totals = retry_stats()
+    if not (totals["oom_retries"] and totals["oom_splits"]):
+        sink.emit(ev="error", name="counters",
+                  msg="pressure phase exercised no ladder: "
+                      f"retries={totals['oom_retries']} "
+                      f"splits={totals['oom_splits']}")
+        _log(f"oom: LADDER IDLE retries={totals['oom_retries']} "
+             f"splits={totals['oom_splits']}")
+    _emit_memory_snapshot(sink, "oom", sess)
+    sess.close()  # flush the event log (oom_retry records) + history run
+    _write_diagnose_report("oom")
+    _bench_sentinel(sink, "oom")
+
+
 def worker_main(phase: str):
     sink = _EventSink()
     if phase == "smoke":
@@ -1205,6 +1344,8 @@ def worker_main(phase: str):
         _worker_restart(sink)
     elif phase == "chaos":
         _worker_chaos(sink)
+    elif phase == "oom":
+        _worker_oom(sink)
     else:
         raise SystemExit(f"unknown worker phase {phase!r}")
 

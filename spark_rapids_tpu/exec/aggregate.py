@@ -756,10 +756,27 @@ class TpuHashAggregateExec(TpuExec):
     def execute_columnar(self, pidx: int) -> Iterator[DeviceTable]:
         from ..columnar.device import concat_device_tables, shrink_to_fit
         from ..memory.catalog import SpillPriorities, get_catalog
+        from ..memory.retry import (split_device_rows, with_retry,
+                                    with_retry_split)
         fn = self._canon_fn()
         merge_fn = None  # built lazily, loop-invariant
         catalog = get_catalog()
         pending = None  # SpillableDeviceTable holding the running merge state
+
+        def agg_combine(outs):
+            """Split-and-retry combiner: half-outputs are PARTIAL states
+            with overlapping keys, so plain row-concat would double-count
+            groups — re-aggregate the concat through the merge exec."""
+            nonlocal merge_fn
+            both = concat_device_tables(outs)
+            if merge_fn is None:
+                merge_fn = self._merged_exec()._canon_fn()
+            return merge_fn(both)
+
+        # only the partial pass is splittable: its half-outputs are
+        # mergeable states. A final-mode aggregate emits finished values
+        # (e.g. avg = sum/count), which no merge pass can recombine.
+        splitter = split_device_rows if self.mode == "partial" else None
 
         def chunked_inputs():
             """Stage child batches and aggregate one CONCAT per ~1M-row
@@ -786,7 +803,9 @@ class TpuHashAggregateExec(TpuExec):
                 with self.metrics.timed(M.AGG_TIME):
                     # shrink to the group bucket: the running state must
                     # not scale with input capacity (out-of-core bound)
-                    out = shrink_to_fit(fn(batch))
+                    out = shrink_to_fit(with_retry_split(
+                        fn, batch, splitter=splitter, combiner=agg_combine,
+                        scope="partial-agg", context=self.node_desc()))
                 if pending is None:
                     pending = catalog.register(
                         out, SpillPriorities.ACTIVE_ON_DECK)
@@ -802,7 +821,11 @@ class TpuHashAggregateExec(TpuExec):
                         both = concat_device_tables([prev, out])
                     if merge_fn is None:
                         merge_fn = self._merged_exec()._canon_fn()
-                    merged = shrink_to_fit(merge_fn(both))
+                    # spill-only retry: the concat'd pair is already at
+                    # the group bucket — there is nothing useful to halve
+                    merged = shrink_to_fit(with_retry(
+                        merge_fn, both, scope="agg-merge",
+                        context=self.node_desc()))
                     pending.close()
                     pending = catalog.register(
                         merged, SpillPriorities.ACTIVE_ON_DECK)

@@ -93,10 +93,15 @@ class TpuProjectExec(TpuExec):
                 self.account_batch()
                 yield out
             return
+        from ..memory.retry import split_device_rows, with_retry_split
         fn = cached_jit(self.plan_signature(), self.batch_fn)
         for batch in self.child_device_batches(pidx):
             with self.metrics.timed(M.OP_TIME):
-                out = fn(batch)
+                # row-wise: halves concat back into the same projection
+                out = with_retry_split(fn, batch,
+                                       splitter=split_device_rows,
+                                       scope="project",
+                                       context=self.node_desc())
             self.account_batch()
             yield out
 
@@ -150,10 +155,16 @@ class TpuFilterExec(TpuExec):
                 self.account_batch()
                 yield out
             return
+        from ..memory.retry import split_device_rows, with_retry_split
         fn = cached_jit(self.plan_signature(), self.batch_fn)
         for batch in self.child_device_batches(pidx):
             with self.metrics.timed(M.OP_TIME):
-                out = fn(batch)
+                # row-wise: filtering halves and concatenating preserves
+                # the partition's surviving rows and their order
+                out = with_retry_split(fn, batch,
+                                       splitter=split_device_rows,
+                                       scope="filter",
+                                       context=self.node_desc())
             self.account_batch()
             yield out
 
@@ -191,12 +202,17 @@ class TpuSampleExec(TpuExec):
                 c = mask_expr.eval(ctx)
                 return table.filter_mask(c.values)
             return fn
+        from ..memory.retry import with_retry
         fn = cached_jit(self.plan_signature() + f"|p{pidx}", make)
         offset = 0
         for batch in self.child_device_batches(pidx):
             with self.metrics.timed(M.OP_TIME):
                 batch = batch.compact()
-                out = fn(batch, jnp.int64(offset))
+                # spill-only retry: the sample mask hashes ABSOLUTE row
+                # positions, so row-axis halves (which renumber rows from
+                # 0) would sample different rows — unsplittable
+                out = with_retry(fn, batch, jnp.int64(offset),
+                                 scope="sample", context=self.node_desc())
             offset += int(batch.num_rows)  # true rows: match host positions
             self.account_batch()
             yield out
@@ -260,10 +276,15 @@ class TpuExpandExec(TpuExec):
                 self.account_batch()
                 yield out
             return
+        from ..memory.retry import with_retry
         fn = cached_jit(self.plan_signature(), self.batch_fn)
         for batch in self.child_device_batches(pidx):
             with self.metrics.timed(M.OP_TIME):
-                out = fn(batch)
+                # spill-only retry: expand interleaves P projections per
+                # batch, so half-outputs would reorder rows across the
+                # projection boundary — unsplittable
+                out = with_retry(fn, batch, scope="expand",
+                                 context=self.node_desc())
             self.account_batch()
             yield out
 

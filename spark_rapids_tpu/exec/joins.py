@@ -779,12 +779,17 @@ class TpuShuffledHashJoinExec(TpuExec):
 
     # -- execution ------------------------------------------------------------
     def _build_table(self, pidx: int) -> DeviceTable:
+        from ..memory.retry import with_retry
         batches = list(_device_batches(self.right, pidx))
         if not batches:
             from .aggregate import _empty_device_table
             return _empty_device_table(self.right.schema, self.min_bucket)
-        table = concat_device_tables(batches) if len(batches) > 1 else batches[0]
-        return table
+        if len(batches) == 1:
+            return batches[0]
+        # build sides are unsplittable (the probe needs the WHOLE build
+        # table in one piece) — spill-only retry, no split escalation
+        return with_retry(concat_device_tables, batches,
+                          scope="join-build", context=self.node_desc())
 
     def _max_out_rows(self) -> int:
         """Gather-output row budget derived from the byte budget."""
@@ -1224,9 +1229,15 @@ class TpuBroadcastHashJoinExec(TpuShuffledHashJoinExec):
                 from .aggregate import _empty_device_table
                 table = _empty_device_table(self.right.schema,
                                             self.min_bucket)
+            elif len(batches) == 1:
+                table = batches[0]
             else:
-                table = concat_device_tables(batches) \
-                    if len(batches) > 1 else batches[0]
+                # broadcast build tables are unsplittable: every probe
+                # partition needs the whole table — spill-only retry
+                from ..memory.retry import with_retry
+                table = with_retry(concat_device_tables, batches,
+                                   scope="join-build",
+                                   context=self.node_desc())
             self._bc_handle = get_catalog().register(
                 table, SpillPriorities.BROADCAST)
             self._own_spill_handle(self._bc_handle)
@@ -1311,9 +1322,15 @@ class TpuBroadcastNestedLoopJoinExec(TpuExec):
                 from .aggregate import _empty_device_table
                 table = _empty_device_table(self.right.schema,
                                             self.min_bucket)
+            elif len(batches) == 1:
+                table = batches[0]
             else:
-                table = concat_device_tables(batches) \
-                    if len(batches) > 1 else batches[0]
+                # broadcast build tables are unsplittable: every stream
+                # window crosses the whole table — spill-only retry
+                from ..memory.retry import with_retry
+                table = with_retry(concat_device_tables, batches,
+                                   scope="join-build",
+                                   context=self.node_desc())
             table = shrink_to_fit(table, self.min_bucket)
             self._bc_handle = get_catalog().register(
                 table, SpillPriorities.BROADCAST)

@@ -144,15 +144,31 @@ class TpuTakeOrderedExec(TpuExec):
         return cached_jit(self.plan_signature() + cap_key, make)
 
     def execute_columnar(self, pidx: int) -> Iterator[DeviceTable]:
+        from ..memory.retry import (split_device_rows, with_retry,
+                                    with_retry_split)
+
+        def topn_combine(outs):
+            """Half top-n's are each sorted-and-truncated; re-running
+            top-n over their concat restores the global order + bound."""
+            merged = concat_device_tables(outs)
+            return self._topn_fn(f"|cap{merged.capacity}")(merged)
+
         state = None
         for batch in self.child_device_batches(pidx):
             with self.metrics.timed(M.SORT_TIME):
-                top = self._topn_fn(f"|cap{batch.capacity}")(batch)
+                top = with_retry_split(
+                    lambda b: self._topn_fn(f"|cap{b.capacity}")(b), batch,
+                    splitter=split_device_rows, combiner=topn_combine,
+                    scope="topn", context=self.node_desc())
                 if state is None:
                     state = top
                 else:
                     merged = concat_device_tables([state, top])
-                    state = self._topn_fn(f"|cap{merged.capacity}")(merged)
+                    # spill-only: the running state is already bounded at
+                    # the bucketed n-row capacity
+                    state = with_retry(
+                        self._topn_fn(f"|cap{merged.capacity}"), merged,
+                        scope="topn-merge", context=self.node_desc())
         if state is not None:
             self.account_batch()
             yield state
@@ -181,7 +197,15 @@ class TpuSortExec(TpuExec):
         return cached_jit(self.plan_signature() + cap_key,
                           lambda: (lambda t: device_sort_table(t, orders)))
 
+    def _sort_combine(self, outs):
+        """Split-and-retry combiner: half-sorts are only locally ordered,
+        so re-sort their concat — by combine time the ladder has spilled
+        everything else, leaving the merged sort the whole HBM."""
+        merged = concat_device_tables(outs)
+        return self._sort_fn(f"|cap{merged.capacity}")(merged)
+
     def execute_columnar(self, pidx: int) -> Iterator[DeviceTable]:
+        from ..memory.retry import split_device_rows, with_retry_split
         batches = list(self.child_device_batches(pidx))
         if not batches:
             return
@@ -191,7 +215,10 @@ class TpuSortExec(TpuExec):
             table = concat_device_tables(batches) if len(batches) > 1 \
                 else batches[0]
             with self.metrics.timed(M.SORT_TIME):
-                out = self._sort_fn(f"|cap{table.capacity}")(table)
+                out = with_retry_split(
+                    lambda t: self._sort_fn(f"|cap{t.capacity}")(t), table,
+                    splitter=split_device_rows, combiner=self._sort_combine,
+                    scope="sort", context=self.node_desc())
             self.account_batch()
             yield out
             return
@@ -201,12 +228,17 @@ class TpuSortExec(TpuExec):
     def _out_of_core(self, batches: List[DeviceTable]
                      ) -> Iterator[DeviceTable]:
         from ..memory.catalog import SpillPriorities, get_catalog
+        from ..memory.retry import split_device_rows, with_retry_split
         catalog = get_catalog()
         runs = []  # (SpillableDeviceTable, active_rows)
         try:
             with self.metrics.timed(M.SORT_TIME):
                 for b in batches:
-                    sorted_b = self._sort_fn(f"|cap{b.capacity}")(b)
+                    sorted_b = with_retry_split(
+                        lambda t: self._sort_fn(f"|cap{t.capacity}")(t), b,
+                        splitter=split_device_rows,
+                        combiner=self._sort_combine,
+                        scope="sort", context=self.node_desc())
                     n = int(sorted_b.num_rows)
                     if n:
                         runs.append((catalog.register(
@@ -249,7 +281,12 @@ class TpuSortExec(TpuExec):
                 for t, f in zip(inputs, flags)]
             merged = concat_device_tables(tagged, self.min_bucket)
             with self.metrics.timed(M.SORT_TIME):
-                sorted_m = self._sort_fn(f"|merge{merged.capacity}")(merged)
+                # spill-only: merge inputs are fixed-size chunks already
+                # bounded by the out-of-core chunking policy
+                from ..memory.retry import with_retry
+                sorted_m = with_retry(
+                    self._sort_fn(f"|merge{merged.capacity}"), merged,
+                    scope="sort-merge", context=self.node_desc())
             sent = jnp.logical_and(sorted_m.column(_SENT).data,
                                    sorted_m.row_mask)
             any_sent = bool(jnp.any(sent))

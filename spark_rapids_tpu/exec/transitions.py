@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import threading
 import weakref
-from typing import Iterator, List
+from typing import Iterator, List, Optional
 
 from ..columnar.device import (DeviceTable, bucket_rows,
                                concat_device_tables, resolve_min_bucket)
@@ -70,8 +70,16 @@ COALESCE_TARGET_BYTES = register_conf(
 # never be donated. The mark rides the DeviceTable instance (plain
 # dataclass) and is consumed exactly once.
 # ---------------------------------------------------------------------------
-def mark_exclusive(table: DeviceTable) -> DeviceTable:
+def mark_exclusive(table: DeviceTable, origin: Optional[HostTable] = None,
+                   min_bucket: Optional[int] = None) -> DeviceTable:
     table._tpu_exclusive = True
+    if origin is not None:
+        # donated-input OOM recovery (memory/retry.py wrap_jit_donating):
+        # a failed donating dispatch may have consumed the buffers, so the
+        # ladder re-materializes from the retained host-side origin — the
+        # host batch is alive for the duration of the consumer's dispatch
+        # anyway, so this pins no extra memory
+        table._tpu_remat = lambda: DeviceTable.from_host(origin, min_bucket)  # srtpu: retry-ok(this lambda IS the ladder's recovery hook — wrap_jit_donating invokes it from inside the retry scope after spilling) srtpu: memtrack-ok(the fresh table replaces a donated batch inside the consuming dispatch and dies with it — never long-lived HBM)
     return table
 
 
@@ -166,17 +174,36 @@ class HostToDeviceExec(TpuExec):
         self.min_bucket = resolve_min_bucket(min_bucket)
         self.cache_max_bytes = cache_max_bytes
 
+    def _upload_retryable(self, batch: HostTable) -> DeviceTable:
+        """One H2D upload under the full OOM ladder (memory/retry.py):
+        spill → retry → split the HOST batch and upload the halves (each
+        half needs half the device allocation) → structured failure."""
+        from ..memory.retry import split_host_rows, with_retry_split
+        min_bucket = self.min_bucket
+
+        def upload(hb: HostTable) -> DeviceTable:
+            action = faults.fire("h2d.upload")
+            if action is not None and action != "delay":
+                raise faults.FaultInjectedError("h2d.upload", action)
+            return DeviceTable.from_host(hb, min_bucket)  # srtpu: memtrack-ok(upload-cache bytes are accounted via register_external_bytes + clear_upload_cache OOM hook; uncached uploads are consumed/donated by the fused chain)
+
+        def combine(outs):
+            return concat_device_tables(outs, min_bucket)
+
+        with get_tracer().span("h2d_upload", "upload",
+                               rows=int(batch.num_rows)):  # srtpu: sync-ok(HostTable.num_rows is a host int on the upload side)
+            return with_retry_split(upload, batch, splitter=split_host_rows,
+                                    combiner=combine, scope="h2d-upload",
+                                    context=f"rows={int(batch.num_rows)}",  # srtpu: sync-ok(HostTable.num_rows is a host int on the upload side)
+                                    fault_point="alloc.upload")
+
     def _upload(self, batch: HostTable) -> DeviceTable:
         global _CACHED_BYTES, _CACHE_HITS, _CACHE_INSERTS
         if not self.cache_max_bytes:
-            with get_tracer().span("h2d_upload", "upload",
-                                   rows=int(batch.num_rows)):  # srtpu: sync-ok(HostTable.num_rows is a host int on the upload side)
-                action = faults.fire("h2d.upload")
-                if action is not None and action != "delay":
-                    raise faults.FaultInjectedError("h2d.upload", action)
-                dtb = DeviceTable.from_host(batch, self.min_bucket)
+            dtb = self._upload_retryable(batch)
             self.metrics.add(M.UPLOAD_BYTES, dtb.nbytes())
-            return mark_exclusive(dtb)
+            return mark_exclusive(dtb, origin=batch,
+                                  min_bucket=self.min_bucket)
         key = id(batch)
         with _UPLOAD_LOCK:
             entry = _UPLOAD_CACHE.get(key)
@@ -188,12 +215,7 @@ class HostToDeviceExec(TpuExec):
         if hit is not None:
             self.metrics.add(M.UPLOAD_CACHE_HITS, 1)
             return hit
-        with get_tracer().span("h2d_upload", "upload",
-                               rows=int(batch.num_rows)):  # srtpu: sync-ok(HostTable.num_rows is a host int on the upload side)
-            action = faults.fire("h2d.upload")
-            if action is not None and action != "delay":
-                raise faults.FaultInjectedError("h2d.upload", action)
-            dtb = DeviceTable.from_host(batch, self.min_bucket)
+        dtb = self._upload_retryable(batch)
         nbytes = dtb.nbytes()
         self.metrics.add(M.UPLOAD_BYTES, nbytes)
         cached = False
@@ -226,7 +248,7 @@ class HostToDeviceExec(TpuExec):
         else:
             # not retained by the cache: the consumer owns the only
             # reference, so fused stages may donate it (wholestage.py)
-            mark_exclusive(dtb)
+            mark_exclusive(dtb, origin=batch, min_bucket=self.min_bucket)
         return dtb
 
     def execute_columnar(self, pidx: int) -> Iterator[DeviceTable]:
@@ -338,8 +360,13 @@ class TpuCoalesceBatchesExec(TpuExec):
             yield self._flush(pending)
 
     def _flush(self, pending: List[DeviceTable]) -> DeviceTable:
+        from ..memory.retry import with_retry
         with self.metrics.timed(M.OP_TIME):
-            out = concat_device_tables(pending, self.min_bucket)
+            # spill-only retry: a half-concat is not the requested
+            # coalesce (and under require_single would be wrong) — the
+            # byte-goal bound already caps the flush size
+            out = with_retry(concat_device_tables, pending, self.min_bucket,
+                             scope="coalesce", context=self.node_desc())
         self.metrics.add(M.NUM_OUTPUT_BATCHES, 1)
         self.metrics.add(M.COALESCED_BYTES, out.nbytes())
         return out

@@ -104,6 +104,7 @@ class TpuWholeStageExec(TpuExec):
         return run
 
     def execute_columnar(self, pidx: int) -> Iterator[DeviceTable]:
+        from ..memory.retry import split_device_rows, with_retry_split
         from ..parallel.pipeline import maybe_prefetched, stage_name
         from ..utils.compile_cache import cached_jit
         from .transitions import take_exclusive
@@ -130,15 +131,24 @@ class TpuWholeStageExec(TpuExec):
             lambda: self.source.execute_columnar(pidx),
             stage=f"source:{stage_name(self.source)}",
             registry=self.metrics)
+        def dispatch(b: DeviceTable) -> DeviceTable:
+            if donating is not None and take_exclusive(b):
+                # nbytes BEFORE the call: donated buffers may be dead
+                # the moment dispatch returns
+                self.metrics.add(M.DONATED_BYTES, b.nbytes())
+                return donating(b)
+            return fused(b)
+
         for batch in source:
             with self.metrics.timed(M.OP_TIME):
-                if donating is not None and take_exclusive(batch):
-                    # nbytes BEFORE the call: donated buffers may be dead
-                    # the moment dispatch returns
-                    self.metrics.add(M.DONATED_BYTES, batch.nbytes())
-                    out = donating(batch)
-                else:
-                    out = fused(batch)
+                # full OOM escalation ladder (memory/retry.py): the chain
+                # is row-wise, so halves of the input concat back into the
+                # same output. Split halves lose the exclusive flag and
+                # dispatch through the non-donating entry.
+                out = with_retry_split(dispatch, batch,
+                                       splitter=split_device_rows,
+                                       scope="wholestage",
+                                       context=self.node_name())
             self.metrics.add(M.NUM_OUTPUT_BATCHES, 1)
             yield out
 

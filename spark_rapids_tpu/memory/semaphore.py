@@ -22,6 +22,7 @@ from typing import Dict, Optional, Tuple
 
 from ..conf import RapidsConf
 from ..utils.metrics import Histogram
+from .retry import oom_admission_gate
 
 __all__ = ["TpuSemaphore", "get_semaphore", "peek_semaphore"]
 
@@ -67,6 +68,11 @@ class TpuSemaphore:
             if hold is not None:
                 hold.depth += 1
                 return
+        # HBM pressure arbitration (memory/retry.py): while a thread is
+        # retrying after device OOM, NEW admissions park here so the
+        # retrier's final attempts get the chip's HBM to themselves.
+        # One module-global check when no retrier is engaged.
+        oom_admission_gate()
         from ..utils.tracing import get_tracer
         thread = threading.current_thread()
         t0 = time.perf_counter()

@@ -76,6 +76,10 @@ class TpuSession:
         # (utils/faults.py); None/no-op unless faults.enabled
         from .utils.faults import configure_faults
         configure_faults(self.conf)
+        # structured OOM retry (spark.rapids.tpu.oom.*): escalation-ladder
+        # bounds + HBM pressure arbitration (memory/retry.py)
+        from .memory.retry import configure_oom_retry
+        configure_oom_retry(self.conf)
         # live health subsystem: watchdog monitor thread + optional HTTP
         # status endpoints (utils/health.py + tools/statusd.py); None when
         # health.enabled is false and health.port < 0 (the default)

@@ -37,6 +37,11 @@ Checkers (see the sibling modules):
                the fault-tolerance arc's retry/recompute machinery) and
                except-everything-pass handlers that swallow transport
                faults in hot/warm packages.
+- ``retry``  — device compute (``cached_jit`` dispatch) and
+               ``DeviceTable.from_host`` uploads in hot packages whose
+               scope chain never references the OOM retry API
+               (memory/retry.py) — a device OOM there raises instead of
+               walking the spill/retry/split ladder.
 
 Workflow: findings are compared against a COMMITTED baseline
 (``tools/analyze/baseline.json``) so pre-existing debt is inventoried
@@ -312,15 +317,15 @@ def load_project(paths: Sequence[str]) -> Project:
 
 def _checkers() -> Dict[str, object]:
     from . import (buckets, eventlog_schema, host_sync, jit_purity, locks,
-                   memtrack, net, threads, trace_ctx)
+                   memtrack, net, retry_scope, threads, trace_ctx)
     return {"sync": host_sync, "lock": locks,
             "thread": threads, "jit": jit_purity, "bucket": buckets,
             "trace": trace_ctx, "memtrack": memtrack,
-            "eventlog": eventlog_schema, "net": net}
+            "eventlog": eventlog_schema, "net": net, "retry": retry_scope}
 
 
 CHECKS = ("sync", "lock", "thread", "jit", "bucket", "trace", "memtrack",
-          "eventlog", "net")
+          "eventlog", "net", "retry")
 
 
 def analyze_paths(paths: Sequence[str],
@@ -458,6 +463,12 @@ def write_baseline(report: Report, path: Optional[str] = None) -> Dict:
             initial = None
     if not initial:
         initial = {c: report.count(c) for c in report.checks}
+    else:
+        # a checker added after the first baseline write records ITS
+        # initial inventory the first time it appears; existing entries
+        # stay sticky
+        for c in report.checks:
+            initial.setdefault(c, report.count(c))
     lines: Dict[str, List[int]] = {}
     for f in report.findings:
         lines.setdefault(f.key(), []).append(f.line)

@@ -540,6 +540,49 @@ def _recovery_findings(q) -> List[Finding]:
     return findings
 
 
+def _retry_findings(q) -> List[Finding]:
+    """v9 oom_retry records: the query survived device OOM, but every
+    retry re-pays the failed dispatch and every split halves the batch
+    (re-paying compile for the half shape). Rank by how hard the ladder
+    had to work; a split storm means batches are sized far above what
+    HBM can hold under the current concurrency."""
+    records = getattr(q, "oom_retries", []) or []
+    if not records:
+        return []
+    injected = bool(getattr(q, "faults", []))
+    findings: List[Finding] = []
+    retries = sum(r.get("attempts", 0) for r in records)
+    splits = sum(r.get("splits", 0) for r in records)
+    spilled = sum(r.get("spilled_bytes", 0) for r in records)
+    scopes = ", ".join(sorted({r.get("scope", "?") for r in records}))
+    if splits >= 2:
+        worst = max(records, key=lambda r: r.get("splits", 0))
+        findings.append(Finding(
+            node="(query)", node_id=None, metric="oomSplitStorm",
+            seconds=0.0, fraction=min(1.0, 0.25 * splits),
+            detail=f"split-and-retry storm: {splits} splits across "
+                   f"scopes [{scopes}] (worst: '{worst.get('scope')}' "
+                   f"x{worst.get('splits', 0)})",
+            suggestion="injected chaos — expected" if injected else
+                       "batches repeatedly halved to fit HBM — lower "
+                       "spark.rapids.sql.batchSizeBytes (cheaper than "
+                       "retry-time splitting, which re-pays the failed "
+                       "dispatch plus a compile per half shape) or lower "
+                       "spark.rapids.sql.concurrentGpuTasks"))
+    elif retries or splits:
+        findings.append(Finding(
+            node="(query)", node_id=None, metric="oomRetries",
+            seconds=0.0, fraction=min(1.0, 0.1 * (retries + splits)),
+            detail=f"device OOM recovered: {retries} retries, {splits} "
+                   f"splits, {spilled} bytes spilled (scopes [{scopes}])",
+            suggestion="injected chaos — expected" if injected else
+                       "HBM pressure forced spill-and-retry — raise "
+                       "spark.rapids.memory.gpu.allocFraction headroom, "
+                       "lower spark.rapids.sql.batchSizeBytes, or lower "
+                       "spark.rapids.sql.concurrentGpuTasks"))
+    return findings
+
+
 def _diagnose_query(q, heartbeats=None) -> Optional[QueryDiagnosis]:
     wall = getattr(q, "wall_s", 0.0)
     if wall <= 0 or getattr(q, "error", None):
@@ -692,6 +735,10 @@ def _diagnose_query(q, heartbeats=None) -> Optional[QueryDiagnosis]:
     # worker deaths, transport retries, corrupt spills — rank what the
     # runtime had to absorb
     findings.extend(_recovery_findings(q))
+
+    # 10. OOM retry ladder (schema v9): retries, splits, and split storms
+    # the query absorbed to stay under HBM
+    findings.extend(_retry_findings(q))
 
     findings.sort(key=lambda f: -f.fraction)
     return QueryDiagnosis(q.query_id, wall, findings, critical_path=cp)

@@ -76,14 +76,15 @@ __all__ = ["EventLogWriter", "load_event_log", "AppReplay", "QueryReplay",
 # Event-record schema version. Bump ONLY with a migration note in
 # docs/observability.md; tests/test_observability.py pins the current value
 # and the per-record required-key sets so replay/compare tooling can rely
-# on old logs staying loadable. v8: fault/recovery records — the fault-
-# injection framework's per-fire telemetry plus an always-written
-# per-query recovery-ledger delta (null payload when the query saw no
-# recovery activity), the evidence trail docs/fault_tolerance.md and the
-# chaos bench phase consume. (v7 added shuffle_skew records; v6 added
+# on old logs staying loadable. v9: oom_retry records — one per retry
+# scope that engaged the device-OOM escalation ladder (memory/retry.py):
+# spill → retry → split-and-retry, with the attempt/split/spilled-bytes
+# counts and the recovered/failed outcome. (v8 added fault/recovery
+# records — per-fire injection telemetry plus an always-written per-query
+# recovery-ledger delta; v7 added shuffle_skew records; v6 added
 # memory_summary/oom_postmortem records and peak_device_bytes on node
 # records.)
-SCHEMA_VERSION = 8
+SCHEMA_VERSION = 9
 
 # The event-record schema registry: every record type a writer may emit,
 # mapped to the schema version that introduced it. srtpu-analyze's
@@ -105,6 +106,7 @@ RECORD_TYPES: Dict[str, int] = {
     "shuffle_skew": 7,
     "fault": 8,
     "recovery": 8,
+    "oom_retry": 9,
 }
 
 EVENT_LOG_DIR = register_conf(
@@ -197,6 +199,9 @@ class EventLogWriter:
             # (retries, recomputes, respawns) is exactly the forensics a
             # failed query needs — write it on the error path too
             self._write_fault_records(qid, recovery_before)
+            # v9: ditto for the OOM-retry ladder — the scopes that
+            # retried/split before the query died are the postmortem trail
+            self._write_oom_retry_records(qid)
             self.write({"event": "query_end", "query_id": qid,
                         "ts": time.time(), "trace_id": tctx.trace_id,
                         "wall_s": time.perf_counter() - t0,
@@ -243,6 +248,7 @@ class EventLogWriter:
                         "first_query_id": entry.get("query_id")})
         self._write_memory_records(qid)
         self._write_fault_records(qid, recovery_before)
+        self._write_oom_retry_records(qid)
         aqe_events: List[str] = list(getattr(plan, "events", []))
         self.write({
             "event": "query_end", "query_id": qid, "ts": time.time(),
@@ -295,6 +301,14 @@ class EventLogWriter:
                  for k in after if after.get(k, 0) != before.get(k, 0)}
         self.write({"event": "recovery", "query_id": qid,
                     "ts": time.time(), "recovery": delta or None})
+
+    def _write_oom_retry_records(self, qid: int) -> None:
+        """v9: drain the OOM-retry ladder's per-scope records (one
+        ``oom_retry`` record per retry scope that saw at least one retry
+        or split; none in the common no-pressure case)."""
+        from ..memory.retry import drain_oom_retry_records
+        for rr in drain_oom_retry_records():
+            self.write({**rr, "event": "oom_retry", "query_id": qid})
 
     def close(self) -> None:
         self.write({"event": "app_end", "ts": time.time()})
@@ -376,6 +390,9 @@ class QueryReplay:
         # records (empty when injection is off)
         self.recovery: Optional[Dict] = None
         self.faults: List[Dict] = []
+        # v9: device-OOM retry-ladder records — one per retry scope that
+        # retried or split (empty for pre-v9 logs and unpressured queries)
+        self.oom_retries: List[Dict] = []
 
     def heartbeats_in_window(self, heartbeats: List[Dict]) -> List[Dict]:
         """App heartbeats whose timestamp falls inside this query's run
@@ -511,6 +528,16 @@ class AppReplay:
                 warnings.append(
                     f"q{q.query_id}: recovered from failures ({detail})"
                     + (" — faults were injected" if q.faults else ""))
+            # v9: a scope that had to split repeatedly is running batches
+            # far above what HBM can hold — a split storm
+            storm = [r for r in q.oom_retries if r.get("splits", 0) >= 2]
+            if storm:
+                worst = max(storm, key=lambda r: r.get("splits", 0))
+                warnings.append(
+                    f"q{q.query_id}: OOM split storm — scope "
+                    f"'{worst.get('scope')}' split {worst['splits']}x "
+                    "(lower spark.rapids.sql.batchSizeBytes so batches "
+                    "fit HBM without retry-time splitting)")
         stalled = [h for h in self.heartbeats if h.get("stalled")]
         if stalled:
             age = max(h.get("last_progress_age_s", 0.0) for h in stalled)
@@ -570,6 +597,10 @@ def load_event_log(path: str) -> AppReplay:
                 q = app.queries.setdefault(rec["query_id"],
                                            QueryReplay(rec["query_id"]))
                 q.recovery = rec.get("recovery")
+            elif ev == "oom_retry":
+                q = app.queries.setdefault(rec["query_id"],
+                                           QueryReplay(rec["query_id"]))
+                q.oom_retries.append(rec)
             elif ev == "query_end":
                 q = app.queries.setdefault(rec["query_id"],
                                            QueryReplay(rec["query_id"]))

@@ -292,63 +292,24 @@ def _introspect(key: str, builder: Callable[[], Callable],
         if entry is not None:
             entry.update(entry_update)
 
-_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "RESOURCE EXHAUSTED", "Out of memory",
-                "out of memory", "OOM")
-
-
-def _is_device_oom(e: BaseException) -> bool:
-    msg = str(e)
-    return isinstance(e, (RuntimeError, MemoryError)) \
-        and any(m in msg for m in _OOM_MARKERS)
-
-
 def oom_retry(fn: Callable) -> Callable:
-    """Wrap a device-invoking callable with spill-and-retry-once OOM
-    recovery (reference: DeviceMemoryEventHandler.scala:33)."""
-    @functools.wraps(fn)
-    def wrapped(*args, **kwargs):
-        try:
-            return fn(*args, **kwargs)
-        except Exception as e:
-            if not _is_device_oom(e):
-                raise
-            from ..memory.catalog import get_catalog
-            catalog = get_catalog()
-            freed = catalog.handle_device_oom(context=repr(e)[:200])
-            print(f"# device OOM: spilled {freed} bytes, retrying once "
-                  f"({type(e).__name__})", file=sys.stderr)
-            if freed <= 0:
-                raise RuntimeError(catalog.oom_dump()) from e
-            try:
-                return fn(*args, **kwargs)
-            except Exception as e2:
-                if _is_device_oom(e2):
-                    raise RuntimeError(catalog.oom_dump()) from e2
-                raise
-    return wrapped
+    """Spill-and-retry OOM recovery at the jit chokepoint. The
+    classification and the escalation ladder live in memory/retry.py
+    (wrap_jit) — this name survives as the cache's chokepoint so every
+    existing call site (and test) keeps working."""
+    from ..memory.retry import wrap_jit
+    return wrap_jit(fn)
 
 
 def oom_spill_noretry(fn: Callable) -> Callable:
     """OOM handling for DONATING entries (donate_argnums): a failed
     dispatch may already have invalidated the donated input buffers, so
-    re-calling with the same arguments — oom_retry's recovery — is
-    unsound. Spill to relieve pressure for SUBSEQUENT batches, then
-    re-raise with the catalog's OOM dump attached."""
-    @functools.wraps(fn)
-    def wrapped(*args, **kwargs):
-        try:
-            return fn(*args, **kwargs)
-        except Exception as e:
-            if not _is_device_oom(e):
-                raise
-            from ..memory.catalog import get_catalog
-            catalog = get_catalog()
-            freed = catalog.handle_device_oom(context=repr(e)[:200])
-            print(f"# device OOM in donating dispatch: spilled {freed} "
-                  f"bytes for later batches (input was donated — no "
-                  f"retry)", file=sys.stderr)
-            raise RuntimeError(catalog.oom_dump()) from e
-    return wrapped
+    re-calling with the same arguments is unsound. memory/retry.py's
+    wrap_jit_donating re-materializes the input from the host origin
+    retained by the upload site and retries; with no origin it spills
+    for SUBSEQUENT batches and raises a structured DeviceOomError."""
+    from ..memory.retry import wrap_jit_donating
+    return wrap_jit_donating(fn)
 
 
 _EXEC_MISMATCH_MARKERS = ("but got buffer with incompatible size",
@@ -491,9 +452,10 @@ def cached_jit(key: str, builder: Callable[[], Callable],
         built = _time_first_call(key, _rebuild_on_mismatch(
             key, builder, oom_retry(jax.jit(builder()))), builder)
     else:
-        # donating entries get NO call-again recovery (oom_retry or the
-        # mismatch rebuild): the failed dispatch may have consumed the
-        # donated input, so the only sound OOM response is spill-and-raise
+        # donating entries get NO call-again recovery with the SAME args
+        # (the failed dispatch may have consumed the donated input); the
+        # donating ladder re-materializes from the retained host origin
+        # instead, or spills-and-raises structured when there is none
         built = _time_first_call(key, oom_spill_noretry(
             jax.jit(builder(), donate_argnums=donate_argnums)), builder)
     with _LOCK:

@@ -440,6 +440,11 @@ def _faults_source() -> Dict:
     return faults_stats()
 
 
+def _retry_source() -> Dict:
+    from ..memory.retry import retry_stats
+    return retry_stats()
+
+
 _DEFAULT_SOURCES = {
     "compile_cache": _compile_cache_source,
     "catalog": _catalog_source,
@@ -451,6 +456,7 @@ _DEFAULT_SOURCES = {
     "memprof": _memprof_source,
     "host_sync": _host_sync_source,
     "faults": _faults_source,
+    "retry": _retry_source,
 }
 
 _GLOBAL_STATS: Optional[StatsRegistry] = None

@@ -48,3 +48,19 @@ def _clear_jax_caches_per_module():
     jax.clear_caches()
     from spark_rapids_tpu.utils.compile_cache import clear_cache
     clear_cache()
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _drain_oom_telemetry_per_module():
+    """OOM-ladder failures queue postmortem/retry records in process-wide
+    stores for the event-log writer to fold into the NEXT query. Tests
+    that exercise the ladder outside a query would otherwise leak those
+    records into whichever module logs a query next — drain between
+    modules so each starts clean."""
+    yield
+    from spark_rapids_tpu.memory.retry import reset_retry_state
+    from spark_rapids_tpu.utils.memprof import active
+    reset_retry_state()
+    mp = active()
+    if mp is not None:
+        mp.drain_postmortems()

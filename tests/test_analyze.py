@@ -342,6 +342,60 @@ def test_memtrack_checker_rules(tmp_path):
     assert len(report.suppressed) == 1
 
 
+def test_retry_checker_rules(tmp_path):
+    path = _write(tmp_path, "retry_fixture.py", """\
+        from spark_rapids_tpu.columnar import DeviceTable
+        from spark_rapids_tpu.memory.retry import (split_device_rows,
+                                                   with_retry_split)
+        from spark_rapids_tpu.utils.compile_cache import cached_jit
+
+        def unguarded_dispatch(batch, build):
+            fn = cached_jit('k', build)
+            return fn(batch)
+
+        def unguarded_upload(host):
+            return DeviceTable.from_host(host, min_bucket=8)
+
+        def guarded_dispatch(batch, build):
+            fn = cached_jit('k', build)
+            return with_retry_split(fn, batch,
+                                    splitter=split_device_rows,
+                                    scope='fixture')
+
+        def guarded_closure(batch, build):
+            fn = cached_jit('k', build)
+            def dispatch(b):
+                return fn(b)
+            return with_retry_split(dispatch, batch,
+                                    splitter=split_device_rows,
+                                    scope='fixture')
+
+        def merge_only(merged, build):
+            fn = cached_jit('m', build)
+            return fn(merged)  # srtpu: retry-ok(merge inputs cannot split)
+
+        def plain_call(helper, batch):
+            return helper(batch)   # not cached_jit-bound: never flagged
+        """)
+    report = analyze_paths([path], checks=["retry"])
+    assert sorted(f.rule for f in report.findings) == [
+        "retry-unguarded-dispatch", "retry-unguarded-upload"]
+    assert {f.symbol for f in report.findings} == \
+        {"unguarded_dispatch", "unguarded_upload"}
+    assert len(report.suppressed) == 1
+
+
+def test_retry_checker_skips_warm_packages(tmp_path):
+    warm = tmp_path / "spark_rapids_tpu" / "parallel"
+    warm.mkdir(parents=True)
+    (warm / "warmmod.py").write_text(
+        "from spark_rapids_tpu.columnar import DeviceTable\n\n"
+        "def f(host):\n"
+        "    return DeviceTable.from_host(host, min_bucket=8)\n")
+    report = analyze_paths([str(tmp_path)], checks=["retry"])
+    assert report.count("retry") == 0
+
+
 def test_net_checker_rules(tmp_path):
     path = _write(tmp_path, "net_fixture.py", """\
         import socket
@@ -538,6 +592,10 @@ def test_tier1_seeded_violation_fails_each_category(tmp_path,
                     "    return DeviceTable.from_host(host, min_bucket=8)\n",
         "net": "def f(sock):\n    try:\n        sock.sendall(b'x')\n"
                "    except Exception:\n        pass\n",
+        "retry": "from spark_rapids_tpu.utils.compile_cache import "
+                 "cached_jit\n\ndef f(x, build):\n"
+                 "    fn = cached_jit('k', build)\n"
+                 "    return fn(x)\n",
     }
     baseline = load_baseline(default_baseline_path())
     for check, body in seeds.items():

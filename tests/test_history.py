@@ -293,14 +293,14 @@ def test_history_server_ui_smoke(tmp_path):
 
 def test_shuffle_skew_record_schema_v7_pin():
     """The skew pin: shuffle_skew is registered at exactly schema 7
-    (the writer has since moved to v8 for fault/recovery records), and
+    (the writer has since moved on — v8 fault/recovery, v9 oom_retry), and
     the summary math the exchanges feed from (utils/metrics.py)
     produces the pinned stat keys."""
     from spark_rapids_tpu.tools.eventlog import (RECORD_TYPES,
                                                  SCHEMA_VERSION)
     from spark_rapids_tpu.utils.metrics import (build_skew_record,
                                                 skew_summary)
-    assert SCHEMA_VERSION == 8
+    assert SCHEMA_VERSION == 9
     assert RECORD_TYPES["shuffle_skew"] == 7
     assert max(RECORD_TYPES.values()) == SCHEMA_VERSION
 
@@ -342,7 +342,7 @@ def test_session_close_appends_run(tmp_path):
     apps = store.apps()
     assert len(apps) == 1
     h = apps[0]
-    assert h["n_queries"] == 1 and h["schema_version"] == 8
+    assert h["n_queries"] == 1 and h["schema_version"] == 9
     app = store.load(h["app_id"])
     (q,) = app.queries.values()
     assert q.nodes  # plan replays

@@ -409,8 +409,10 @@ def test_eventlog_schema_version_and_required_keys(tmp_path):
     # per-exchange output-partition distribution records. v8 adds the
     # fault-injection/recovery telemetry: an always-written per-query
     # recovery record (null payload here — no faults, no recovery) plus
-    # fault records when injection fires
-    assert SCHEMA_VERSION == 8
+    # fault records when injection fires. v9 adds oom_retry records —
+    # one per retry scope that engaged the device-OOM escalation ladder
+    # (none in this pressure-free run; pinned in tests/test_oom_retry.py)
+    assert SCHEMA_VERSION == 9
     assert by_type["app_start"][0]["schema_version"] == SCHEMA_VERSION
     for kind, required in _REQUIRED_KEYS.items():
         for rec in by_type[kind]:
@@ -611,7 +613,7 @@ def test_eventlog_query_stats_cover_all_subsystems(tmp_path):
     from spark_rapids_tpu.tools.eventlog import load_event_log
     path = _run_logged_app(tmp_path)
     app = load_event_log(path)
-    assert app.schema_version == 8
+    assert app.schema_version == 9
     q = app.query(1)
     assert q.stats, "query_end stats delta missing"
     for family in ("compile_cache_", "upload_cache_", "shuffle_",

@@ -1,0 +1,587 @@
+"""Structured OOM retry: the escalation ladder, split-and-retry, HBM
+pressure arbitration, and the v9 telemetry trail (PR-14).
+
+The contract under test (docs/fault_tolerance.md "Device OOM retry"):
+a device allocation failure walks spill → retry → split-and-retry and
+either recovers to exactly the unpressured answer or fails with a
+structured DeviceOomError carrying the ladder's forensics; while a
+retrier is engaged, new admissions park on the arbitration gate so two
+concurrent pipeline tasks cannot spill each other into a mutual-OOM
+livelock; every completed ladder leaves an ``oom_retry`` record in the
+schema-v9 event log.
+"""
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.conf import RapidsConf
+from spark_rapids_tpu.memory import retry as retry_mod
+from spark_rapids_tpu.memory.retry import (DeviceOomError, arbiter_snapshot,
+                                           configure_oom_retry,
+                                           drain_oom_retry_records,
+                                           is_retryable_oom,
+                                           oom_admission_gate,
+                                           reset_retry_state, retry_stats,
+                                           split_device_rows, split_host_rows,
+                                           with_retry, with_retry_split)
+from spark_rapids_tpu.utils import faults
+from spark_rapids_tpu.utils.faults import configure_faults
+
+
+@pytest.fixture(autouse=True)
+def _pristine_ladder():
+    """Counters, pending records and the arbiter are process-global by
+    design; every test starts and ends zeroed, with the production
+    defaults for the sticky oom.* config and injection off."""
+    reset_retry_state()
+    configure_oom_retry(RapidsConf({}))
+    faults.reset_faults()
+    faults.reset_recovery()
+    yield
+    reset_retry_state()
+    configure_oom_retry(RapidsConf({}))
+    faults.reset_faults()
+    faults.reset_recovery()
+
+
+def _fake_spill(freed):
+    """Stand-in for _Ladder.spill so ladder control flow is tested
+    deterministically (the real rung drains the buffer catalog)."""
+    def spill(self):
+        self.spilled_bytes += freed
+        return freed
+    return spill
+
+
+class _OomAfter:
+    """Callable failing with a runtime-OOM string for its first N calls."""
+
+    def __init__(self, failures, result="ok"):
+        self.failures = failures
+        self.calls = 0
+        self.result = result
+
+    def __call__(self, *args):
+        self.calls += 1
+        if self.calls <= self.failures:
+            raise RuntimeError("RESOURCE_EXHAUSTED: out of memory while "
+                               "allocating 1234 bytes")
+        return self.result
+
+
+# ---------------------------------------------------------------------------
+# classification
+# ---------------------------------------------------------------------------
+def test_is_retryable_oom_classification():
+    assert is_retryable_oom(RuntimeError("RESOURCE_EXHAUSTED: oom"))
+    assert is_retryable_oom(RuntimeError("XLA: Out of memory allocating"))
+    # the strict-pool MemoryError from BufferCatalog.register
+    assert is_retryable_oom(MemoryError(
+        "strict pool mode: 999 bytes cannot fit in pool"))
+    # a nested ladder's structured error is retryable at the OUTER scope
+    assert is_retryable_oom(DeviceOomError("inner ladder exhausted"))
+    # non-OOM runtime errors and OOM-ish strings on other types are not
+    assert not is_retryable_oom(RuntimeError("shape mismatch"))
+    assert not is_retryable_oom(ValueError("RESOURCE_EXHAUSTED"))
+    assert not is_retryable_oom(KeyError("out of memory"))
+
+
+# ---------------------------------------------------------------------------
+# spill-and-retry rung (with_retry)
+# ---------------------------------------------------------------------------
+def test_with_retry_recovers_after_spill(monkeypatch):
+    monkeypatch.setattr(retry_mod._Ladder, "spill", _fake_spill(1024))
+    fn = _OomAfter(2)
+    assert with_retry(fn, scope="jit") == "ok"
+    assert fn.calls == 3
+    s = retry_stats()
+    assert s["oom_retries"] == 2
+    assert s["oom_recoveries"] == 1 and s["oom_failures"] == 0
+    (rec,) = drain_oom_retry_records()
+    assert rec["scope"] == "jit" and rec["outcome"] == "recovered"
+    assert rec["attempts"] == 2 and rec["splits"] == 0
+
+
+def test_with_retry_exhaustion_is_structured(monkeypatch):
+    monkeypatch.setattr(retry_mod._Ladder, "spill", _fake_spill(512))
+    fn = _OomAfter(99)
+    with pytest.raises(DeviceOomError) as ei:
+        with_retry(fn, scope="join-build", context="hash build",
+                   max_retries=2)
+    e = ei.value
+    # forensics: 1 initial + 2 retries, all bytes the ladder spilled
+    assert e.scope == "join-build"
+    assert e.attempts == 3 and e.splits == 0
+    assert e.spilled_bytes == 3 * 512
+    assert "survived the retry ladder" in str(e)
+    s = retry_stats()
+    assert s["oom_failures"] == 1 and s["oom_recoveries"] == 0
+    (rec,) = drain_oom_retry_records()
+    assert rec["outcome"] == "failed"
+
+
+def test_with_retry_zero_byte_spill_fails_fast(monkeypatch):
+    """Retrying identical work after a spill that freed nothing cannot
+    succeed — the ladder must not burn its retry budget spinning."""
+    monkeypatch.setattr(retry_mod._Ladder, "spill", _fake_spill(0))
+    fn = _OomAfter(99)
+    with pytest.raises(DeviceOomError):
+        with_retry(fn, scope="jit")
+    assert fn.calls == 1
+    assert retry_stats()["oom_retries"] == 0
+
+
+def test_with_retry_non_oom_passes_through(monkeypatch):
+    monkeypatch.setattr(retry_mod._Ladder, "spill", _fake_spill(1024))
+
+    def boom():
+        raise ValueError("not an OOM")
+
+    with pytest.raises(ValueError, match="not an OOM"):
+        with_retry(boom, scope="jit")
+    s = retry_stats()
+    assert s["oom_retries"] == 0 and s["oom_failures"] == 0
+    assert drain_oom_retry_records() == []
+
+
+# ---------------------------------------------------------------------------
+# split-and-retry rung (with_retry_split)
+# ---------------------------------------------------------------------------
+def _list_splitter(batch):
+    if len(batch) <= 1:
+        return None
+    half = len(batch) // 2
+    return batch[:half], batch[half:]
+
+
+def _list_combine(outs):
+    return [x for o in outs for x in o]
+
+
+def test_split_ladder_recovers_and_preserves_order(monkeypatch):
+    """A batch too big for the device is halved (recursively) and the
+    half-results recombine to exactly the unsplit answer."""
+    monkeypatch.setattr(retry_mod._Ladder, "spill", _fake_spill(0))
+    ran = []
+
+    def fn(batch):
+        if len(batch) > 2:
+            raise RuntimeError("RESOURCE_EXHAUSTED: batch too big")
+        ran.append(list(batch))
+        return [x * 10 for x in batch]
+
+    batch = list(range(8))
+    out = with_retry_split(fn, batch, splitter=_list_splitter,
+                           combiner=_list_combine, scope="project")
+    assert out == [x * 10 for x in batch]
+    assert ran == [[0, 1], [2, 3], [4, 5], [6, 7]]
+    # 8 -> 4+4 (1 split), each 4 -> 2+2 (2 more); the budget is scoped
+    # to the whole ladder, not per recursion level
+    s = retry_stats()
+    assert s["oom_splits"] == 3 and s["oom_recoveries"] == 1
+    (rec,) = drain_oom_retry_records()
+    assert rec["splits"] == 3 and rec["outcome"] == "recovered"
+
+
+def test_split_budget_is_bounded(monkeypatch):
+    monkeypatch.setattr(retry_mod._Ladder, "spill", _fake_spill(0))
+
+    def fn(batch):
+        raise RuntimeError("RESOURCE_EXHAUSTED: never fits")
+
+    with pytest.raises(DeviceOomError) as ei:
+        with_retry_split(fn, list(range(64)), splitter=_list_splitter,
+                         combiner=_list_combine, scope="sort", max_splits=1)
+    assert ei.value.splits == 1
+    assert retry_stats()["oom_splits"] == 1
+
+
+def test_split_without_splitter_is_spill_only(monkeypatch):
+    monkeypatch.setattr(retry_mod._Ladder, "spill", _fake_spill(0))
+    fn = _OomAfter(99)
+    with pytest.raises(DeviceOomError) as ei:
+        with_retry_split(fn, [1, 2, 3], splitter=None, scope="agg-merge")
+    assert ei.value.splits == 0
+
+
+def test_nested_ladder_escalates_straight_to_split(monkeypatch):
+    """A DeviceOomError from an inner (jit-level) ladder must not be
+    plain-retried by the outer scope — the inner ladder already
+    exhausted its retries, so the outer escalates straight to split."""
+    monkeypatch.setattr(retry_mod._Ladder, "spill", _fake_spill(4096))
+    inner_calls = []
+
+    def fn(batch):
+        inner_calls.append(len(batch))
+        if len(batch) > 2:
+            # what wrap_jit raises after ITS retries are spent
+            raise DeviceOomError("inner jit ladder exhausted", scope="jit")
+        return list(batch)
+
+    out = with_retry_split(fn, [1, 2, 3, 4], splitter=_list_splitter,
+                           combiner=_list_combine, scope="wholestage")
+    assert out == [1, 2, 3, 4]
+    # 4-row batch tried once, then split; no identical-work plain retry
+    assert inner_calls == [4, 2, 2]
+    assert retry_stats()["oom_retries"] == 0
+    assert retry_stats()["oom_splits"] == 1
+
+
+# ---------------------------------------------------------------------------
+# splitters: real device/host tables round-trip
+# ---------------------------------------------------------------------------
+def test_split_device_rows_roundtrip(session):
+    from spark_rapids_tpu.columnar.device import DeviceTable
+    from spark_rapids_tpu.columnar.host import HostTable
+    t = pa.table({"a": pa.array(np.arange(12), type=pa.int64()),
+                  "b": pa.array(np.arange(12) * 0.5, type=pa.float64())})
+    dev = DeviceTable.from_host(HostTable.from_arrow(t), 8)
+    halves = split_device_rows(dev)
+    assert halves is not None and len(halves) == 2
+    back = retry_mod._concat_combine(list(halves))
+    got = back.to_host().to_arrow()
+    assert got.sort_by("a").equals(t.sort_by("a"))
+
+
+def test_split_device_rows_refuses_capacity_one(session):
+    from spark_rapids_tpu.columnar.device import DeviceTable
+    from spark_rapids_tpu.columnar.host import HostTable
+    t = pa.table({"a": pa.array([7], type=pa.int64())})
+    dev = DeviceTable.from_host(HostTable.from_arrow(t), 1)
+    assert dev.capacity == 1
+    assert split_device_rows(dev) is None
+
+
+def test_split_host_rows_roundtrip():
+    from spark_rapids_tpu.columnar.host import HostTable
+    t = pa.table({"a": pa.array(np.arange(11), type=pa.int64())})
+    ht = HostTable.from_arrow(t)
+    a, b = split_host_rows(ht)
+    assert a.num_rows + b.num_rows == 11
+    got = pa.concat_tables([a.to_arrow(), b.to_arrow()])
+    assert got.equals(t)
+    single = HostTable.from_arrow(t.slice(0, 1))
+    assert split_host_rows(single) is None
+
+
+# ---------------------------------------------------------------------------
+# HBM pressure arbitration
+# ---------------------------------------------------------------------------
+def test_admission_gate_parks_until_retriers_disengage():
+    configure_oom_retry(RapidsConf(
+        {"spark.rapids.tpu.oom.arbitration.maxWaitSeconds": "10"}))
+    retry_mod._ARBITER.engage()
+    try:
+        assert retry_mod._GATE_ACTIVE
+        assert arbiter_snapshot()["gate_active"]
+        waited = {}
+
+        def admit():
+            t0 = time.monotonic()
+            oom_admission_gate()
+            waited["s"] = time.monotonic() - t0
+
+        t = threading.Thread(target=admit, daemon=True)
+        t.start()
+        time.sleep(0.4)
+        assert t.is_alive(), "admission should park while a retrier is engaged"
+    finally:
+        retry_mod._ARBITER.disengage()
+    t.join(5)
+    assert not t.is_alive() and waited["s"] >= 0.3
+    assert not retry_mod._GATE_ACTIVE
+    assert retry_stats()["gate_waits"] == 1
+
+
+def test_admission_gate_is_a_pressure_valve_not_a_lock():
+    """A wedged retrier must not deadlock the task pool: the gate wait
+    is bounded by oom.arbitration.maxWaitSeconds."""
+    configure_oom_retry(RapidsConf(
+        {"spark.rapids.tpu.oom.arbitration.maxWaitSeconds": "0.3"}))
+    retry_mod._ARBITER.engage()
+    try:
+        t = threading.Thread(target=oom_admission_gate, daemon=True)
+        t.start()
+        t.join(5)
+        assert not t.is_alive(), "bounded gate wait must return"
+    finally:
+        retry_mod._ARBITER.disengage()
+
+
+def test_retrier_never_gates_itself():
+    retry_mod._ARBITER.engage()
+    try:
+        t0 = time.monotonic()
+        oom_admission_gate()  # this thread IS the retrier
+        assert time.monotonic() - t0 < 0.1
+    finally:
+        retry_mod._ARBITER.disengage()
+
+
+def test_gate_is_zero_overhead_when_idle():
+    assert not retry_mod._GATE_ACTIVE
+    t0 = time.monotonic()
+    for _ in range(10_000):
+        oom_admission_gate()
+    assert time.monotonic() - t0 < 0.5
+    assert retry_stats()["gate_waits"] == 0
+
+
+def test_concurrent_retriers_no_mutual_oom_livelock(monkeypatch):
+    """Acceptance pin: two pipeline tasks whose batches fit HBM alone
+    but not together. Both first attempts overlap and OOM; arbitration
+    serializes the retries on the exclusive token so each retry runs
+    with the (fake) HBM to itself and BOTH recover — no livelock where
+    each retry is re-failed by the other's resident batch."""
+    monkeypatch.setattr(retry_mod._Ladder, "spill", _fake_spill(1))
+    cap, state, lk = 100, {"used": 0}, threading.Lock()
+    barrier = threading.Barrier(2)
+
+    def make_task():
+        st = {"first": True}
+
+        def fn():
+            if st["first"]:
+                st["first"] = False
+                barrier.wait(timeout=10)
+                with lk:
+                    state["used"] += 60
+                barrier.wait(timeout=10)  # both resident: 120 > cap
+                with lk:
+                    state["used"] -= 60
+                # both must have rolled back before either retries, or a
+                # fast thread's retry races the peer's dying first attempt
+                barrier.wait(timeout=10)
+                raise RuntimeError(
+                    "RESOURCE_EXHAUSTED: concurrent batches exceed HBM")
+            with lk:
+                state["used"] += 60
+                over = state["used"] > cap
+            if over:
+                with lk:
+                    state["used"] -= 60
+                raise RuntimeError("RESOURCE_EXHAUSTED: still contended")
+            time.sleep(0.02)  # hold while a non-serialized peer would retry
+            with lk:
+                state["used"] -= 60
+            return "ok"
+        return fn
+
+    results = {}
+
+    def run(key):
+        try:
+            results[key] = with_retry(make_task(), scope=f"pipeline-{key}",
+                                      max_retries=3)
+        except BaseException as e:  # pragma: no cover - failure forensics
+            results[key] = e
+
+    threads = [threading.Thread(target=run, args=(k,), daemon=True)
+               for k in ("a", "b")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(15)
+    assert not any(t.is_alive() for t in threads), "mutual-OOM livelock"
+    assert results == {"a": "ok", "b": "ok"}
+    s = retry_stats()
+    assert s["arbitrations"] == 2 and s["oom_recoveries"] == 2
+    # both ladders closed: gate down, token released, no retrier leaked
+    snap = arbiter_snapshot()
+    assert snap == {"active_retriers": 0, "gate_active": False,
+                    "token_held": False}
+    assert not retry_mod._GATE_ACTIVE
+
+
+def test_arbitration_disabled_never_engages(monkeypatch):
+    configure_oom_retry(RapidsConf(
+        {"spark.rapids.tpu.oom.arbitration.enabled": "false"}))
+    monkeypatch.setattr(retry_mod._Ladder, "spill", _fake_spill(1024))
+    assert with_retry(_OomAfter(1), scope="jit") == "ok"
+    assert retry_stats()["arbitrations"] == 0
+    assert not retry_mod._GATE_ACTIVE
+
+
+# ---------------------------------------------------------------------------
+# TPC-H parity under injected device OOM (action=oom)
+# ---------------------------------------------------------------------------
+def _oom_spec(spec):
+    return RapidsConf({"spark.rapids.tpu.faults.enabled": "true",
+                       "spark.rapids.tpu.faults.seed": "7",
+                       "spark.rapids.tpu.faults.spec": spec})
+
+
+@pytest.mark.parametrize("query", ["q1", "q3", "q6"])
+def test_tpch_parity_under_injected_oom(session, query):
+    """Acceptance pin: a query whose jit dispatches OOM (injected
+    alloc.jit, action=oom) recovers through the ladder to exactly the
+    uninjected answer, and the recovery ledger proves the ladder ran."""
+    from spark_rapids_tpu.tools import tpch
+    tables = tpch.gen_all(0, tiny=True)
+    dfs = tpch.build_dataframes(session, tables, num_partitions=2)
+    q = getattr(tpch, query)(dfs)
+    ref = q.collect(device=True)
+
+    configure_faults(_oom_spec("alloc.jit:after=1:times=2:action=oom"))
+    got = q.collect(device=True)
+    faults.reset_faults()
+
+    assert got.num_rows == ref.num_rows
+    for name in ref.column_names:
+        g, r = got.column(name).to_pylist(), ref.column(name).to_pylist()
+        if ref.column(name).type in (pa.float64(), pa.float32()):
+            np.testing.assert_allclose(np.array(g, dtype=float),
+                                       np.array(r, dtype=float), rtol=1e-9)
+        else:
+            assert g == r
+    s = retry_stats()
+    assert s["oom_retries"] + s["oom_splits"] >= 1
+    led = faults.recovery_counters()
+    assert led.get("oom_retries", 0) + led.get("oom_splits", 0) >= 1
+
+
+def test_upload_oom_splits_host_batch(session):
+    """alloc.upload pressure on the H2D path: the upload scope splits
+    the HOST batch (halving the transfer's device footprint) and the
+    query still reaches the right answer."""
+    from spark_rapids_tpu.tools import tpch
+    tables = tpch.gen_all(0, tiny=True)
+    dfs = tpch.build_dataframes(session, tables, num_partitions=2)
+    q = getattr(tpch, "q6")(dfs)
+    ref = q.collect(device=True)
+
+    configure_faults(_oom_spec("alloc.upload:times=3:action=oom"))
+    # fresh dataframes: the first run's uploads are cached, and a cache
+    # hit never reaches the H2D fault point
+    dfs = tpch.build_dataframes(session, tables, num_partitions=2)
+    got = getattr(tpch, "q6")(dfs).collect(device=True)
+    faults.reset_faults()
+    np.testing.assert_allclose(got.column("revenue").to_numpy(),
+                               ref.column("revenue").to_numpy(), rtol=1e-9)
+    s = retry_stats()
+    assert s["oom_retries"] + s["oom_splits"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# v9 event log: oom_retry records, health check, diagnose
+# ---------------------------------------------------------------------------
+class _Plan:
+    children = ()
+
+    def tree_string(self):
+        return "plan"
+
+    def release_spill_handles(self):
+        pass
+
+
+def test_eventlog_v9_oom_retry_records(tmp_path, monkeypatch):
+    from spark_rapids_tpu.tools.eventlog import (RECORD_TYPES,
+                                                 SCHEMA_VERSION,
+                                                 EventLogWriter,
+                                                 load_event_log)
+    assert SCHEMA_VERSION == 9 and RECORD_TYPES["oom_retry"] == 9
+    monkeypatch.setattr(retry_mod._Ladder, "spill", _fake_spill(2048))
+
+    w = EventLogWriter(str(tmp_path), "app-oom", {})
+    w.run_query(_Plan(), lambda: with_retry(_OomAfter(1), scope="jit",
+                                            context="q1 wholestage"))
+
+    # error path: the ladder trail is persisted before the raise
+    def exhausted():
+        return with_retry(_OomAfter(99), scope="join-build", max_retries=1)
+
+    with pytest.raises(DeviceOomError):
+        w.run_query(_Plan(), exhausted)
+    w.close()
+
+    app = load_event_log(w.path)
+    assert app.schema_version == 9
+    (rec,) = app.query(1).oom_retries
+    assert rec["event"] == "oom_retry" and rec["query_id"] == 1
+    # the full v9 record shape — renaming any of these is a schema break
+    for key in ("ts", "scope", "context", "attempts", "splits",
+                "rematerializations", "spilled_bytes", "outcome"):
+        assert key in rec, f"v9 oom_retry record lost key {key!r}"
+    assert rec["scope"] == "jit" and rec["outcome"] == "recovered"
+    assert rec["attempts"] == 1 and rec["spilled_bytes"] == 2048
+    q2 = app.query(2)
+    assert q2.error is not None
+    (rec2,) = q2.oom_retries
+    assert rec2["outcome"] == "failed" and rec2["scope"] == "join-build"
+
+
+def test_health_check_flags_split_storms(tmp_path, monkeypatch):
+    from spark_rapids_tpu.tools.diagnose import diagnose_path
+    from spark_rapids_tpu.tools.eventlog import (EventLogWriter,
+                                                 load_event_log)
+    monkeypatch.setattr(retry_mod._Ladder, "spill", _fake_spill(0))
+
+    def storm():
+        def fn(batch):
+            if len(batch) > 2:
+                raise RuntimeError("RESOURCE_EXHAUSTED: storm")
+            return batch
+        # 8 rows at a 2-row ceiling: 3 splits, inside the default budget
+        # of 4 and over the health checker's storm threshold of 2
+        return with_retry_split(fn, list(range(8)),
+                                splitter=_list_splitter,
+                                combiner=_list_combine, scope="project")
+
+    w = EventLogWriter(str(tmp_path), "app-storm", {})
+    w.run_query(_Plan(), storm)
+    w.close()
+    app = load_event_log(w.path)
+    warnings = app.health_check()
+    assert any("split storm" in s and "batchSizeBytes" in s
+               for s in warnings), warnings
+    # diagnose ranks the same signal as a finding with a conf suggestion
+    rep = diagnose_path(w.path)
+    metrics = [f.metric for q in rep.queries for f in q.findings]
+    assert "oomSplitStorm" in metrics
+
+
+def test_single_recovered_retry_is_not_a_health_warning(tmp_path,
+                                                        monkeypatch):
+    """One spill-and-retry that recovered is the ladder doing its job —
+    health_check stays quiet (split storms are the pathology)."""
+    from spark_rapids_tpu.tools.eventlog import (EventLogWriter,
+                                                 load_event_log)
+    monkeypatch.setattr(retry_mod._Ladder, "spill", _fake_spill(1024))
+    w = EventLogWriter(str(tmp_path), "app-quiet", {})
+    w.run_query(_Plan(), lambda: with_retry(_OomAfter(1), scope="jit"))
+    w.close()
+    app = load_event_log(w.path)
+    assert not any("OOM" in s for s in app.health_check())
+
+
+# ---------------------------------------------------------------------------
+# stats registry + leak gates
+# ---------------------------------------------------------------------------
+def test_retry_stats_feed_metrics_endpoint():
+    s = retry_stats()
+    for key in ("oom_retries", "oom_splits", "oom_rematerializations",
+                "oom_recoveries", "oom_failures", "oom_spilled_bytes",
+                "arbitrations", "gate_waits", "active_retriers",
+                "gate_active"):
+        assert key in s
+
+
+def test_no_leaked_threads_or_arbiter_state():
+    """The ladder spawns no threads and every exit path disengages the
+    arbiter — a leaked retrier would gate all future admissions for
+    maxWaitSeconds each."""
+    from spark_rapids_tpu.parallel.pipeline import active_workers
+    before = {t.ident for t in threading.enumerate()}
+    with pytest.raises(DeviceOomError):
+        with_retry(_OomAfter(99), scope="jit")  # real spill: frees 0
+    assert with_retry(lambda: 1, scope="jit") == 1
+    after = {t.ident for t in threading.enumerate()}
+    assert after <= before
+    assert active_workers() == 0
+    snap = arbiter_snapshot()
+    assert snap["active_retriers"] == 0 and not snap["gate_active"]
+    assert not retry_mod._GATE_ACTIVE
