@@ -79,6 +79,17 @@ in the bench JSON with per-query retry/split/spill deltas;
 BENCH_OOM_SF scales the data, and the history sentinel treats a
 recovered run as clean — run_sentinel exempts queries whose event log
 carries oom_retry records and no error).
+BENCH_FALLBACK (1 opt-in: degradation-parity phase — each query first
+runs clean to record its reference answer, then re-runs in a fresh
+session under a deterministic times-bounded alloc.jit:action=fatal
+spec (a NON-retryable XLA failure the ladder refuses to retry); the
+degraded answer must match the clean answer and the exec/fallback.py
+counters must show nonzero host_fallbacks, recorded as "fallback" in
+the bench JSON with per-query fallback counts, transfer bytes and
+overhead; BENCH_FALLBACK_SF scales the data, and the history sentinel
+treats a fallback-recovered run as clean — run_sentinel exempts
+queries whose event log carries schema-v10 fallback records and no
+error).
 """
 import atexit
 import json
@@ -105,6 +116,7 @@ _STATE = {
     "restart": {},
     "chaos": {},      # query -> clean-vs-injected parity + recovery ledger
     "oom": {},        # query -> pressure-vs-clean parity + retry ladder deltas
+    "fallback": {},   # query -> degraded-vs-clean parity + fallback counters
     "compile_cache": {},   # phase -> cache_stats() snapshot
     "sf": None,
     "rows": None,
@@ -151,7 +163,8 @@ def _write_partial():
     with open(tmp, "w") as f:
         json.dump({k: _STATE[k] for k in
                    ("backend", "fell_back", "sf", "rows", "smoke", "tpch",
-                    "ablation", "restart", "compile_cache", "errors", "eventlog",
+                    "ablation", "restart", "chaos", "oom", "fallback",
+                    "compile_cache", "errors", "eventlog",
                     "health", "memory", "history", "pipeline", "analyze",
                     "notes")}
                   | {"elapsed_s": round(time.monotonic() - _T_START, 2)},
@@ -516,6 +529,8 @@ def main():
         phase_with_retries("chaos", [1, 3])
     if os.environ.get("BENCH_OOM", "0") == "1" and _remaining() > 120:
         phase_with_retries("oom", [1, 6])
+    if os.environ.get("BENCH_FALLBACK", "0") == "1" and _remaining() > 120:
+        phase_with_retries("fallback", [1, 6])
     _emit(reason="done")
 
 
@@ -1332,6 +1347,123 @@ def _worker_oom(sink: _EventSink):
     _bench_sentinel(sink, "oom")
 
 
+def _worker_fallback(sink: _EventSink):
+    """BENCH_FALLBACK=1: the degradation-parity phase. Each query runs
+    twice in one worker process — clean (recording the reference
+    answer), then in a FRESH session under a deterministic
+    times-bounded alloc.jit:action=fatal spec: a NON-retryable INTERNAL
+    XLA failure the retry ladder refuses to touch, so recovery can only
+    come from the exec/fallback.py host-fallback boundary. Passes only
+    if the degraded answer matches the clean answer AND the fallback
+    counters moved (nonzero host_fallbacks across the phase). The
+    history sentinel never flags it because run_sentinel exempts
+    queries whose event log carries schema-v10 fallback records and no
+    error."""
+    _worker_setup_jax()
+    from spark_rapids_tpu.exec.fallback import (fallback_stats,
+                                                reset_fallback_state)
+    from spark_rapids_tpu.session import TpuSession
+    from spark_rapids_tpu.tools import tpch
+
+    sf = float(os.environ.get("BENCH_FALLBACK_SF", "0.05"))
+    nparts = 2
+    tables = tpch.gen_all(sf)
+    queries = [int(q) for q in
+               os.environ.get("BENCH_WORKER_QUERIES", "1,6").split(",")
+               if q]
+    base_conf = {
+        "spark.rapids.tpu.batchRowsMinBucket": 4096,
+        "spark.rapids.tpu.shuffle.partitions": nparts,
+    }
+
+    # pass 1: clean run — reference answers
+    sess = TpuSession(base_conf)
+    dfs = tpch.build_dataframes(sess, tables, num_partitions=nparts)
+    refs, clean_s = {}, {}
+    for i in queries:
+        name = f"q{i}"
+        try:
+            q = getattr(tpch, name)(dfs)
+            t0 = time.perf_counter()
+            refs[name] = q.collect(device=True)
+            clean_s[name] = time.perf_counter() - t0
+        except Exception as e:
+            sink.emit(ev="error", name=name,
+                      msg=f"clean pass: {type(e).__name__}: {e}"[:300])
+            _log(f"fallback {name} clean pass FAILED: {e}")
+    sess.close()
+    if not refs:
+        sink.emit(ev="error", name="setup", msg="no clean references")
+        return
+
+    # pass 2: fresh session under injected non-retryable failures — the
+    # quarantine threshold is raised past what the phase can accumulate
+    # so every injection exercises the RUNTIME boundary, not the planner
+    reset_fallback_state()
+    sess = TpuSession({
+        **base_conf,
+        "spark.rapids.tpu.faults.enabled": True,
+        "spark.rapids.tpu.faults.seed": 11,
+        # no after-offset: the first alloc.jit dispatches sit inside the
+        # fallback-capable whole-stage boundary; later evaluations can
+        # land in note-only merge scopes where fatal is terminal
+        "spark.rapids.tpu.faults.spec":
+            "alloc.jit:times=2:action=fatal",
+        "spark.rapids.tpu.fallback.quarantine.threshold": 1000,
+        **_eventlog_conf("fallback", sink),
+        **_history_conf("fallback"),
+        **_memprof_conf(),
+    })
+    dfs = tpch.build_dataframes(sess, tables, num_partitions=nparts)
+    for i in queries:
+        name = f"q{i}"
+        if name not in refs:
+            continue
+        sink.emit(ev="start", name=name)
+        try:
+            before = fallback_stats()
+            mb = _mem_probe()
+            t0 = time.perf_counter()
+            got = getattr(tpch, name)(dfs).collect(device=True)
+            fb_s = time.perf_counter() - t0
+            after = fallback_stats()
+            err = _tables_equal(got, refs[name])
+            if not (err <= _rel_tol()):
+                raise AssertionError(
+                    f"degraded run diverged from clean run: rel_err={err}")
+            delta = {k: after[k] - before[k]
+                     for k in ("host_fallbacks", "fallback_bytes_down",
+                               "fallback_bytes_up", "fallback_failures",
+                               "quarantine_notes")
+                     if after[k] - before[k]}
+            res = {"clean_s": round(clean_s[name], 4),
+                   "fallback_s": round(fb_s, 4),
+                   "overhead": round(fb_s / clean_s[name], 3)
+                   if clean_s.get(name) else None,
+                   "rel_err": err, "degrade": delta, **_mem_res(mb)}
+            sink.emit(ev="done", phase="fallback", name=name, res=res)
+            _log(f"fallback {name}: clean={clean_s[name]:.3f}s "
+                 f"degraded={fb_s:.3f}s host_fallbacks="
+                 f"{delta.get('host_fallbacks', 0)} bytes_down="
+                 f"{delta.get('fallback_bytes_down', 0)} rel_err={err:.2e}")
+        except Exception as e:
+            sink.emit(ev="error", name=name,
+                      msg=f"{type(e).__name__}: {e}"[:300])
+            _log(f"fallback {name} FAILED: {e}")
+    totals = fallback_stats()
+    if not totals["host_fallbacks"]:
+        sink.emit(ev="error", name="counters",
+                  msg="degradation phase exercised no host fallback: "
+                      f"host_fallbacks={totals['host_fallbacks']} "
+                      f"failures={totals['fallback_failures']}")
+        _log(f"fallback: BOUNDARY IDLE "
+             f"host_fallbacks={totals['host_fallbacks']}")
+    _emit_memory_snapshot(sink, "fallback", sess)
+    sess.close()  # flush the event log (fallback records) + history run
+    _write_diagnose_report("fallback")
+    _bench_sentinel(sink, "fallback")
+
+
 def worker_main(phase: str):
     sink = _EventSink()
     if phase == "smoke":
@@ -1346,6 +1478,8 @@ def worker_main(phase: str):
         _worker_chaos(sink)
     elif phase == "oom":
         _worker_oom(sink)
+    elif phase == "fallback":
+        _worker_fallback(sink)
     else:
         raise SystemExit(f"unknown worker phase {phase!r}")
 

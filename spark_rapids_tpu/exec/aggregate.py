@@ -562,6 +562,57 @@ class TpuHashAggregateExec(TpuExec):
         return any(op in _COLLECT_OPS
                    for (_, op, _, _) in self._columns_ops())
 
+    def host_batch_fn(self):
+        # host-engine partial aggregation over one downloaded batch — the
+        # per-table body of CpuHashAggregateExec.execute. Only the
+        # fusible (partial, no collect_*) form gets a fallback path: its
+        # per-batch state outputs merge downstream exactly like the
+        # device partial's would
+        if not self.fusible:
+            return None
+        key_names = list(self.key_names)
+        cols_ops = self._columns_ops()
+        out_names = list(self.schema.names)
+        schema = self.schema
+        child_schema = self.child.schema
+
+        def fn(table):
+            import numpy as np
+            from ..columnar.host import HostColumn, HostTable
+            from ..plan.host_groupby import group_codes, host_group_reduce
+            from ..plan.physical import _empty_values
+            if table.num_rows == 0:
+                if key_names:
+                    return HostTable(
+                        out_names,
+                        [HostColumn(f.dtype, _empty_values(f.dtype))
+                         for f in schema])
+                # grand aggregate over an empty batch: one null/zero row
+                table = HostTable(
+                    [c for c, _, _, _ in cols_ops],
+                    [HostColumn(child_schema.field(c).dtype,
+                                _empty_values(child_schema.field(c).dtype))
+                     for c, _, _, _ in cols_ops])
+            gid, ngroups, rep = group_codes(table, key_names)
+            out_cols = []
+            for k in key_names:
+                out_cols.append(table.column(k).take(rep))
+            for in_col, op, out_col, out_dt in cols_ops:
+                vals, validity = host_group_reduce(
+                    op, table.column(in_col), gid, ngroups, out_dt)
+                if not isinstance(out_dt, (dt.StringType, dt.BinaryType,
+                                           dt.ArrayType, dt.StructType,
+                                           dt.MapType)) \
+                        and not dt.is_d128(out_dt) \
+                        and vals.dtype != out_dt.np_dtype():
+                    with np.errstate(invalid="ignore"):
+                        vals = vals.astype(out_dt.np_dtype())
+                if validity is not None and validity.all():
+                    validity = None
+                out_cols.append(HostColumn(out_dt, vals, validity))
+            return HostTable(out_names, out_cols)
+        return fn
+
     # -- kernels -------------------------------------------------------------
     def batch_fn(self, list_width: int = 0
                  ) -> Callable[[DeviceTable], DeviceTable]:
@@ -798,9 +849,14 @@ class TpuHashAggregateExec(TpuExec):
                 yield staged[0] if len(staged) == 1 \
                     else concat_device_tables(staged)
 
+        from .fallback import quarantine_on_failure
         try:
             for batch in chunked_inputs():
-                with self.metrics.timed(M.AGG_TIME):
+                # note-only boundary: aggregate state spans batches, so a
+                # terminal failure can't fall back mid-stream — but it
+                # feeds the quarantine store for plan-time routing
+                with quarantine_on_failure(self), \
+                        self.metrics.timed(M.AGG_TIME):
                     # shrink to the group bucket: the running state must
                     # not scale with input capacity (out-of-core bound)
                     out = shrink_to_fit(with_retry_split(

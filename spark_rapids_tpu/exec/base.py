@@ -70,6 +70,17 @@ class TpuExec(PhysicalPlan):
         """Pure per-batch device function, or None if not fusible."""
         return None
 
+    def host_batch_fn(self) -> Optional[Callable[[HostTable], HostTable]]:
+        """Host-engine equivalent of ``batch_fn`` (``HostTable ->
+        HostTable``), or None when the operator has no batch-local host
+        path. Non-None makes this operator recoverable at RUN time: the
+        fallback boundary (exec/fallback.py with_host_fallback) re-runs
+        a terminally-failed batch through it instead of failing the
+        query. Operators whose semantics span batches (final aggregates,
+        sorts, joins) return None — they quarantine on terminal failure
+        but cannot fall back mid-stream."""
+        return None
+
     @property
     def fusible(self) -> bool:
         """Whether per-batch application preserves semantics (operators that

@@ -69,6 +69,19 @@ class TpuProjectExec(TpuExec):
             return eval_exprs_device(table, exprs, names)
         return fn
 
+    def host_batch_fn(self):
+        # the host-engine projection over one downloaded batch
+        # (plan/physical.py CpuProjectExec's per-batch body); context-
+        # dependent exprs need the real task context and cannot fall back
+        if any(e.tree_context_dependent() for e in self.exprs):
+            return None
+        exprs, names = self.exprs, self.names
+
+        def fn(table):
+            from ..plan.physical import host_eval_exprs
+            return host_eval_exprs(table, exprs, names)
+        return fn
+
     def plan_signature(self) -> str:
         child_schema = repr(self.children[0].schema) if self.children else ""
         return f"Project|{[repr(e) for e in self.exprs]}|{self.names}|{child_schema}"
@@ -94,14 +107,21 @@ class TpuProjectExec(TpuExec):
                 yield out
             return
         from ..memory.retry import split_device_rows, with_retry_split
+        from .fallback import with_host_fallback
         fn = cached_jit(self.plan_signature(), self.batch_fn)
+        # degradation boundary: ladder inside (spill → retry → split),
+        # host fallback outside — a terminal device failure re-runs the
+        # batch through the host projection instead of failing the query
+        run = with_host_fallback(
+            self,
+            lambda b: with_retry_split(fn, b, splitter=split_device_rows,
+                                       scope="project",
+                                       context=self.node_desc()),
+            self.host_batch_fn())
         for batch in self.child_device_batches(pidx):
             with self.metrics.timed(M.OP_TIME):
                 # row-wise: halves concat back into the same projection
-                out = with_retry_split(fn, batch,
-                                       splitter=split_device_rows,
-                                       scope="project",
-                                       context=self.node_desc())
+                out = run(batch)
             self.account_batch()
             yield out
 
@@ -127,6 +147,24 @@ class TpuFilterExec(TpuExec):
             if c.validity is not None:
                 keep = jnp.logical_and(keep, c.validity)
             return table.filter_mask(keep)
+        return fn
+
+    def host_batch_fn(self):
+        # the host-engine filter over one downloaded batch
+        # (plan/physical.py CpuFilterExec's per-batch body)
+        if self.condition.tree_context_dependent():
+            return None
+        cond = self.condition
+
+        def fn(table):
+            import numpy as np
+            from ..expr.base import EvalContext as _Ctx
+            ctx = _Ctx.for_host(table)
+            c = cond.eval(ctx)
+            keep = np.asarray(c.values, dtype=np.bool_)  # srtpu: sync-ok(host fallback path over a downloaded host table)
+            if c.validity is not None:
+                keep = keep & c.validity
+            return table.take(np.nonzero(keep)[0])
         return fn
 
     def plan_signature(self) -> str:
@@ -156,15 +194,21 @@ class TpuFilterExec(TpuExec):
                 yield out
             return
         from ..memory.retry import split_device_rows, with_retry_split
+        from .fallback import with_host_fallback
         fn = cached_jit(self.plan_signature(), self.batch_fn)
+        # degradation boundary (see TpuProjectExec): ladder inside,
+        # host fallback outside
+        run = with_host_fallback(
+            self,
+            lambda b: with_retry_split(fn, b, splitter=split_device_rows,
+                                       scope="filter",
+                                       context=self.node_desc()),
+            self.host_batch_fn())
         for batch in self.child_device_batches(pidx):
             with self.metrics.timed(M.OP_TIME):
                 # row-wise: filtering halves and concatenating preserves
                 # the partition's surviving rows and their order
-                out = with_retry_split(fn, batch,
-                                       splitter=split_device_rows,
-                                       scope="filter",
-                                       context=self.node_desc())
+                out = run(batch)
             self.account_batch()
             yield out
 

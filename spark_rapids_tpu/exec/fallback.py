@@ -1,0 +1,513 @@
+"""Runtime degradation layer: host fallback + operator quarantine.
+
+The reference plugin's defining robustness property is that a query
+never dies because the accelerated path couldn't run it — unsupported
+operators fall back to CPU at plan time (plan/overrides.py). This
+module extends that property to RUN time: when a device operator's
+dispatch fails terminally — the OOM escalation ladder exhausted
+(:class:`~..memory.retry.DeviceOomError`) or XLA raised a classified
+non-retryable error (compile failure, ``INVALID_ARGUMENT``,
+``INTERNAL``) — the batch is downloaded, re-executed through the host
+engine's implementation of the same operator, and re-uploaded, so the
+query degrades per-operator instead of failing per-query.
+
+**Fallback boundary.** :func:`with_host_fallback(node, device_fn,
+host_fn)` wraps an operator's per-batch dispatch. ``device_fn`` is the
+full ladder-protected device path; ``host_fn`` is the operator's
+host-engine batch function (``HostTable -> HostTable``; None for
+operators with no batch-local host equivalent — those still quarantine
+on terminal failure, they just re-raise). Every completed fallback
+leaves a schema-v10 ``fallback`` event-log record (operator, reason,
+bytes moved, wall) and bumps the recovery ledger's ``host_fallbacks``
+key.
+
+**Quarantine.** Repeated runtime fallbacks mean repeated pay-the-
+failure-then-recover tax. The process-wide quarantine store — keyed by
+(operator class, plan-signature hash, failure class), persisted as
+``quarantine.json`` next to the compile-cache manifest — counts
+fallbacks per key; once a key crosses
+``spark.rapids.tpu.fallback.quarantine.threshold`` the planner's
+quarantine pass (plan/overrides.py) trial-converts each candidate node
+at tag time and routes matching operators to host AT PLAN TIME, with
+``df.explain()`` showing the quarantine reason. Entries expire after
+``quarantine.ttlSeconds`` and the store is bounded by
+``quarantine.maxEntries`` (oldest evicted first).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..conf import register_conf
+
+__all__ = [
+    "with_host_fallback",
+    "quarantine_on_failure",
+    "classify_failure",
+    "configure_fallback",
+    "persist_quarantine",
+    "quarantine_entries",
+    "quarantine_reason",
+    "note_quarantine",
+    "plan_quarantine_pass",
+    "fallback_stats",
+    "drain_fallback_records",
+    "reset_fallback_state",
+]
+
+FALLBACK_ENABLED = register_conf(
+    "spark.rapids.tpu.fallback.enabled",
+    "Runtime host fallback: when a device operator's dispatch fails "
+    "terminally (OOM ladder exhausted, or a non-retryable XLA error), "
+    "download the batch, re-execute it through the host engine's "
+    "implementation and re-upload — the query degrades per-operator "
+    "instead of failing per-query. Each fallback writes a schema-v10 "
+    "fallback event-log record.",
+    True)
+
+QUARANTINE_ENABLED = register_conf(
+    "spark.rapids.tpu.fallback.quarantine.enabled",
+    "Operator quarantine: count runtime fallbacks per (operator class, "
+    "plan signature, failure class); past quarantine.threshold the "
+    "planner routes that operator to host at PLAN time (explain shows "
+    "the reason), so repeated traffic stops paying the "
+    "fail-then-fallback tax. Persisted as quarantine.json next to the "
+    "compile-cache manifest when the persistent cache is enabled.",
+    True)
+
+QUARANTINE_THRESHOLD = register_conf(
+    "spark.rapids.tpu.fallback.quarantine.threshold",
+    "Runtime fallbacks a (operator, plan-signature, failure-class) key "
+    "must accumulate before the planner quarantines it to host.",
+    3, checker=lambda v: None if v >= 1 else f"threshold must be >= 1, got {v}")
+
+QUARANTINE_TTL = register_conf(
+    "spark.rapids.tpu.fallback.quarantine.ttlSeconds",
+    "Quarantine entry lifetime in seconds; expired entries are pruned "
+    "on load and lookup, so a quarantined operator gets retried on the "
+    "device after the TTL (the failure may have been environmental).",
+    86400.0, conf_type=float,
+    checker=lambda v: None if v > 0 else f"ttlSeconds must be > 0, got {v}")
+
+QUARANTINE_MAX_ENTRIES = register_conf(
+    "spark.rapids.tpu.fallback.quarantine.maxEntries",
+    "Upper bound on quarantine-store entries; the oldest entries are "
+    "evicted first (a runaway failure storm must not grow the store "
+    "without bound).",
+    256, checker=lambda v: None if v >= 1 else f"maxEntries must be >= 1, got {v}")
+
+# sticky module config (configure_fallback; defaults match the conf
+# registrations so bare unit tests get the production behavior)
+_ENABLED = True
+_Q_ENABLED = True
+_Q_THRESHOLD = 3
+_Q_TTL_S = 86400.0
+_Q_MAX = 256
+
+
+# ---------------------------------------------------------------------------
+# failure classification: which terminal errors are fallback-eligible
+# ---------------------------------------------------------------------------
+#: (marker substring, failure class) — first match wins. INVALID_ARGUMENT
+#: before INTERNAL: XLA nests both in compile diagnostics.
+_XLA_CLASSES: Tuple[Tuple[str, str], ...] = (
+    ("INVALID_ARGUMENT", "xla_invalid_argument"),
+    ("UNIMPLEMENTED", "xla_unimplemented"),
+    ("Compilation failure", "xla_compile"),
+    ("compilation failure", "xla_compile"),
+    ("INTERNAL", "xla_internal"),
+)
+
+
+def classify_failure(e: BaseException) -> Optional[str]:
+    """The failure class when ``e`` is a terminal device failure the
+    host-fallback boundary may recover from, else None (re-raise).
+
+    A :class:`QueryTimeoutError` is never fallback-eligible — the query
+    is being cancelled, not rescued. A retryable OOM normally never
+    reaches the boundary raw (the ladder inside ``device_fn`` consumes
+    it and terminates in DeviceOomError); if one does escape, it is
+    still a recoverable device failure and classifies as ``oom``."""
+    from ..utils.deadline import QueryTimeoutError
+    if isinstance(e, QueryTimeoutError):
+        return None
+    from ..memory.retry import DeviceOomError, is_retryable_oom
+    if isinstance(e, DeviceOomError):
+        return "oom_exhausted"
+    if not isinstance(e, RuntimeError):  # XlaRuntimeError subclasses this
+        return None
+    msg = str(e)
+    for marker, cls in _XLA_CLASSES:
+        if marker in msg:
+            return cls
+    if is_retryable_oom(e):
+        return "oom"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# telemetry: counters (stats registry), drainable records (event log v10)
+# ---------------------------------------------------------------------------
+_STATS_LOCK = threading.Lock()
+_COUNTS: Dict[str, Any] = {
+    "host_fallbacks": 0,        # batches re-executed through the host engine
+    "fallback_bytes_down": 0,   # D2H bytes moved for fallback inputs
+    "fallback_bytes_up": 0,     # H2D bytes re-uploaded after host execution
+    "fallback_failures": 0,     # terminal failures with no host path (re-raised)
+    "quarantine_notes": 0,      # fallback events folded into the store
+    "quarantine_plan_routes": 0,  # nodes the planner routed to host
+}
+_RECORDS: List[Dict[str, Any]] = []
+
+
+def _bump(key: str, n: int = 1) -> None:
+    with _STATS_LOCK:
+        _COUNTS[key] = _COUNTS.get(key, 0) + n
+
+
+def fallback_stats() -> Dict[str, Any]:
+    """Stats-registry source (/metrics gauges under the fallback_ prefix)."""
+    with _STATS_LOCK:
+        out: Dict[str, Any] = dict(_COUNTS)
+    out["quarantine_entries"] = _QUARANTINE.size()
+    return out
+
+
+def drain_fallback_records() -> List[Dict[str, Any]]:
+    """Pop completed-fallback records (the event-log writer turns each
+    into one schema-v10 ``fallback`` record on the owning query)."""
+    global _RECORDS
+    with _STATS_LOCK:
+        out, _RECORDS = _RECORDS, []
+    return out
+
+
+def reset_fallback_state() -> None:
+    """Test hook: zero counters, drop pending records, clear the
+    in-memory quarantine store (the on-disk store is untouched)."""
+    global _RECORDS
+    with _STATS_LOCK:
+        for k in list(_COUNTS):
+            _COUNTS[k] = 0
+        _RECORDS = []
+    _QUARANTINE.clear()
+
+
+# ---------------------------------------------------------------------------
+# quarantine store
+# ---------------------------------------------------------------------------
+def _sig_hash(plan_signature: str) -> str:
+    return hashlib.sha256(plan_signature.encode("utf-8")).hexdigest()[:16]
+
+
+class _QuarantineStore:
+    """(operator class, plan-signature hash, failure class) -> fallback
+    count + last-seen + reason. TTL-pruned on load and lookup, bounded
+    by maxEntries (oldest last_ts evicted first)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: Dict[str, Dict[str, Any]] = {}
+
+    @staticmethod
+    def key(operator: str, sig_hash: str, failure_class: str) -> str:
+        return f"{operator}|{sig_hash}|{failure_class}"
+
+    def note(self, operator: str, sig_hash: str, failure_class: str,
+             reason: str) -> int:
+        """Fold one terminal device failure in; returns the new count."""
+        now = time.time()
+        k = self.key(operator, sig_hash, failure_class)
+        with self._lock:
+            ent = self._entries.get(k)
+            if ent is None:
+                ent = {"operator": operator, "sig_hash": sig_hash,
+                       "failure_class": failure_class, "count": 0,
+                       "first_ts": now, "last_ts": now, "reason": ""}
+                self._entries[k] = ent
+            ent["count"] += 1
+            ent["last_ts"] = now
+            ent["reason"] = reason[:200]
+            self._evict_locked(now)
+            return ent["count"]
+
+    def check(self, operator: str, sig_hash: str) -> Optional[str]:
+        """The quarantine reason when ANY failure class for (operator,
+        sig) crossed the threshold and is not expired, else None."""
+        now = time.time()
+        with self._lock:
+            self._prune_locked(now)
+            for ent in self._entries.values():
+                if (ent["operator"] == operator
+                        and ent["sig_hash"] == sig_hash
+                        and ent["count"] >= _Q_THRESHOLD):
+                    return (f"{ent['count']} runtime "
+                            f"{ent['failure_class']} failure(s), last: "
+                            f"{ent['reason']}")
+        return None
+
+    def entries(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [dict(e) for e in self._entries.values()]
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+    def _prune_locked(self, now: float) -> None:
+        dead = [k for k, e in self._entries.items()
+                if now - e["last_ts"] > _Q_TTL_S]
+        for k in dead:
+            del self._entries[k]
+
+    def _evict_locked(self, now: float) -> None:
+        self._prune_locked(now)
+        while len(self._entries) > _Q_MAX:
+            oldest = min(self._entries, key=lambda k: self._entries[k]["last_ts"])
+            del self._entries[oldest]
+
+    # -- persistence (the compile-cache manifest idiom: atomic replace on
+    # write, corruption-tolerant on read) ------------------------------------
+    def load(self, path: str) -> None:
+        try:
+            with open(path, encoding="utf-8") as f:
+                doc = json.load(f)
+            entries = doc.get("entries", {})
+            if not isinstance(entries, dict):
+                return
+        except (OSError, json.JSONDecodeError, AttributeError):
+            return  # missing/corrupt store: start empty, never fail startup
+        now = time.time()
+        with self._lock:
+            for k, e in entries.items():
+                if not isinstance(e, dict) or "count" not in e:
+                    continue
+                self._entries[k] = e
+            self._prune_locked(now)
+            self._evict_locked(now)
+
+    def persist(self, path: str) -> None:
+        with self._lock:
+            self._prune_locked(time.time())
+            doc = {"version": 1, "entries": dict(self._entries)}
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(doc, f, indent=1, sort_keys=True)
+            os.replace(tmp, path)
+        except OSError:
+            pass  # srtpu: net-ok(quarantine persistence is best-effort; a read-only cache dir must not fail session close)
+
+
+_QUARANTINE = _QuarantineStore()
+
+
+def _quarantine_path() -> Optional[str]:
+    """quarantine.json beside the compile-cache manifest, or None when
+    the persistent cache tier is disabled (store stays session-only)."""
+    from ..utils.compile_cache import persistent_cache_dir
+    tier = persistent_cache_dir()
+    if not tier:
+        return None
+    return os.path.join(tier, "quarantine.json")
+
+
+def configure_fallback(conf) -> None:
+    """Apply spark.rapids.tpu.fallback.* (TpuSession chokepoint; sticky)
+    and load the persisted quarantine store when quarantine is on."""
+    global _ENABLED, _Q_ENABLED, _Q_THRESHOLD, _Q_TTL_S, _Q_MAX
+    _ENABLED = bool(conf.get(FALLBACK_ENABLED))
+    _Q_ENABLED = bool(conf.get(QUARANTINE_ENABLED))
+    _Q_THRESHOLD = int(conf.get(QUARANTINE_THRESHOLD))
+    _Q_TTL_S = float(conf.get(QUARANTINE_TTL))
+    _Q_MAX = int(conf.get(QUARANTINE_MAX_ENTRIES))
+    if _ENABLED and _Q_ENABLED:
+        path = _quarantine_path()
+        if path:
+            _QUARANTINE.load(path)
+
+
+def persist_quarantine() -> None:
+    """Flush the quarantine store next to the compile-cache manifest
+    (TpuSession.close); no-op when quarantine is off, empty, or the
+    persistent cache tier is disabled."""
+    if not (_ENABLED and _Q_ENABLED) or _QUARANTINE.size() == 0:
+        return
+    path = _quarantine_path()
+    if path:
+        _QUARANTINE.persist(path)
+
+
+def quarantine_entries() -> List[Dict[str, Any]]:
+    return _QUARANTINE.entries()
+
+
+def quarantine_reason(operator: str, plan_signature: str) -> Optional[str]:
+    """The quarantine reason for a (device operator class, plan
+    signature), or None. Zero store lookups when quarantine is idle."""
+    if not (_ENABLED and _Q_ENABLED) or _QUARANTINE.size() == 0:
+        return None
+    return _QUARANTINE.check(operator, _sig_hash(plan_signature))
+
+
+def note_quarantine(operator: str, plan_signature: str, failure_class: str,
+                    reason: str) -> None:
+    if not (_ENABLED and _Q_ENABLED):
+        return
+    _QUARANTINE.note(operator, _sig_hash(plan_signature), failure_class,
+                     reason)
+    _bump("quarantine_notes")
+
+
+# ---------------------------------------------------------------------------
+# the fallback boundary
+# ---------------------------------------------------------------------------
+def _quarantine_targets(node) -> List[Tuple[str, str]]:
+    """(operator class, plan signature) keys a terminal failure at
+    ``node`` charges. A fused whole-stage charges every chain MEMBER:
+    the planner's quarantine pass trial-converts individual operators
+    (fusion happens after conversion), so member-level keys are what it
+    can match — and XLA fuses the chain into one program, so any member
+    may be the culprit."""
+    chain = getattr(node, "chain", None)
+    nodes = list(chain) if chain else [node]
+    out = []
+    for n in nodes:
+        try:
+            out.append((type(n).__name__, n.plan_signature()))
+        except Exception:  # srtpu: degrade-ok(best-effort signature walk while HANDLING a device failure — the member just goes un-quarantined)
+            continue
+    return out
+
+
+def with_host_fallback(node, device_fn: Callable[[Any], Any],
+                       host_fn: Optional[Callable[[Any], Any]]):
+    """Wrap one device operator's per-batch dispatch in the runtime
+    degradation boundary.
+
+    ``device_fn(batch)`` is the ladder-protected device path (typically
+    a ``with_retry_split`` closure). ``host_fn(host_table)`` is the
+    operator's host-engine equivalent, or None for operators without a
+    batch-local host path — a terminal failure then still notes the
+    quarantine store (so the NEXT session plans the operator on host)
+    before re-raising. Returns ``device_fn`` unchanged when fallback is
+    disabled (zero overhead on the hot path)."""
+    if not _ENABLED:
+        return device_fn
+
+    def run(batch):
+        try:
+            return device_fn(batch)
+        except Exception as e:
+            cls = classify_failure(e)
+            if cls is None:
+                raise
+            reason = f"{type(e).__name__}: {str(e)[:160]}"
+            for op_name, sig in _quarantine_targets(node):
+                note_quarantine(op_name, sig, cls, reason)
+            if host_fn is None:
+                _bump("fallback_failures")
+                raise
+            return _host_fallback(node, batch, host_fn, e, cls, reason)
+    return run
+
+
+class quarantine_on_failure:
+    """Note-only degradation boundary for operators whose semantics span
+    batches (final aggregates, sorts, joins): a terminal device failure
+    inside the block cannot be recovered mid-stream, but it still feeds
+    the quarantine store so the NEXT session plans the operator on host.
+    The exception always propagates."""
+
+    def __init__(self, node):
+        self._node = node
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc is None or not _ENABLED:
+            return False
+        cls = classify_failure(exc)
+        if cls is not None:
+            reason = f"{type(exc).__name__}: {str(exc)[:160]}"
+            for op_name, sig in _quarantine_targets(self._node):
+                note_quarantine(op_name, sig, cls, reason)
+            _bump("fallback_failures")
+        return False
+
+
+def _host_fallback(node, batch, host_fn, exc, failure_class: str,
+                   reason: str):
+    """Download -> host execute -> re-upload, with the v10 record."""
+    from ..columnar.device import DeviceTable
+    t0 = time.perf_counter()
+    try:
+        ht = batch.to_host()
+    except Exception:
+        # a donated batch's buffers may be dead after the failed
+        # dispatch; the ladder hands the resurrection hook back on its
+        # structured error (memory/retry.py rematerialize)
+        remat = getattr(exc, "rematerialize", None)
+        if remat is None:
+            raise exc
+        ht = remat().to_host()
+    bytes_down = int(ht.nbytes())
+    out_host = host_fn(ht)
+    out = DeviceTable.from_host(out_host)
+    bytes_up = int(out.nbytes())
+    wall = time.perf_counter() - t0
+    op_name = type(node).__name__
+    print(f"# device failure in {op_name} ({failure_class}): batch "
+          f"re-executed on the host engine ({bytes_down} bytes down, "
+          f"{bytes_up} bytes up)", file=sys.stderr)
+    _bump("host_fallbacks")
+    _bump("fallback_bytes_down", bytes_down)
+    _bump("fallback_bytes_up", bytes_up)
+    from ..utils import faults
+    faults.note_recovery("host_fallbacks")
+    rec = {"ts": time.time(), "operator": op_name,
+           "context": str(getattr(node, "node_desc", lambda: "")())[:200],
+           "failure_class": failure_class, "reason": reason,
+           "rows": int(out_host.num_rows), "bytes_down": bytes_down,
+           "bytes_up": bytes_up, "wall_s": wall}
+    with _STATS_LOCK:
+        _RECORDS.append(rec)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# plan-time quarantine pass (called from plan/overrides.py after tag)
+# ---------------------------------------------------------------------------
+def plan_quarantine_pass(meta, conf) -> None:
+    """Route quarantined operators to host at PLAN time. For every
+    still-convertible node, trial-convert it (with its UNCONVERTED CPU
+    children — conversion preserves child schemas, which is all the
+    device plan_signature reads) to learn the device class + signature
+    it WOULD run as, and mark it cannot_run when the store says that key
+    has crossed the threshold. Zero work when the store is empty."""
+    if not (_ENABLED and _Q_ENABLED) or _QUARANTINE.size() == 0:
+        return
+    for m in meta.walk():
+        if not m.can_run or m.rule is None:
+            continue
+        try:
+            dev = m.rule.convert(m.plan, list(m.plan.children), conf)
+            sig = dev.plan_signature()
+            op_name = type(dev).__name__
+        except Exception:  # srtpu: degrade-ok(plan-time trial conversion — un-trial-convertible nodes simply are not quarantined)
+            continue
+        reason = quarantine_reason(op_name, sig)
+        if reason:
+            _bump("quarantine_plan_routes")
+            m.cannot_run(f"quarantined: {reason}")

@@ -803,9 +803,13 @@ class TpuShuffledHashJoinExec(TpuExec):
         return max(self.min_bucket, self.batch_bytes // max(row_bytes, 1))
 
     def execute_columnar(self, pidx: int) -> Iterator[DeviceTable]:
-        for out in self._join_batches(pidx):
-            self.account_batch()
-            yield out
+        from .fallback import quarantine_on_failure
+        # note-only boundary: the probe needs the whole build table, so a
+        # terminal failure can't fall back per-batch — but it quarantines
+        with quarantine_on_failure(self):
+            for out in self._join_batches(pidx):
+                self.account_batch()
+                yield out
 
     def _join_batches(self, pidx: int) -> Iterator[DeviceTable]:
         build = self._build_table(pidx)
@@ -1436,9 +1440,13 @@ class TpuBroadcastNestedLoopJoinExec(TpuExec):
                                                     self.min_bucket))
 
     def execute_columnar(self, pidx: int) -> Iterator[DeviceTable]:
-        for out in self._join_batches(pidx):
-            self.account_batch()
-            yield out
+        from .fallback import quarantine_on_failure
+        # note-only boundary: the probe needs the whole build table, so a
+        # terminal failure can't fall back per-batch — but it quarantines
+        with quarantine_on_failure(self):
+            for out in self._join_batches(pidx):
+                self.account_batch()
+                yield out
 
     def _join_batches(self, pidx: int) -> Iterator[DeviceTable]:
         track = self.how in ("right", "full")

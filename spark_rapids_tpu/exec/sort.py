@@ -153,9 +153,13 @@ class TpuTakeOrderedExec(TpuExec):
             merged = concat_device_tables(outs)
             return self._topn_fn(f"|cap{merged.capacity}")(merged)
 
+        from .fallback import quarantine_on_failure
         state = None
         for batch in self.child_device_batches(pidx):
-            with self.metrics.timed(M.SORT_TIME):
+            # note-only boundary: top-n state spans batches, so a terminal
+            # failure can't fall back mid-stream — but it quarantines
+            with quarantine_on_failure(self), \
+                    self.metrics.timed(M.SORT_TIME):
                 top = with_retry_split(
                     lambda b: self._topn_fn(f"|cap{b.capacity}")(b), batch,
                     splitter=split_device_rows, combiner=topn_combine,
@@ -212,9 +216,11 @@ class TpuSortExec(TpuExec):
         total_bytes = sum(b.nbytes() for b in batches)
         if len(batches) == 1 or total_bytes <= self.batch_bytes:
             # FullSortSingleBatch mode
+            from .fallback import quarantine_on_failure
             table = concat_device_tables(batches) if len(batches) > 1 \
                 else batches[0]
-            with self.metrics.timed(M.SORT_TIME):
+            with quarantine_on_failure(self), \
+                    self.metrics.timed(M.SORT_TIME):
                 out = with_retry_split(
                     lambda t: self._sort_fn(f"|cap{t.capacity}")(t), table,
                     splitter=split_device_rows, combiner=self._sort_combine,
@@ -229,10 +235,12 @@ class TpuSortExec(TpuExec):
                      ) -> Iterator[DeviceTable]:
         from ..memory.catalog import SpillPriorities, get_catalog
         from ..memory.retry import split_device_rows, with_retry_split
+        from .fallback import quarantine_on_failure
         catalog = get_catalog()
         runs = []  # (SpillableDeviceTable, active_rows)
         try:
-            with self.metrics.timed(M.SORT_TIME):
+            with quarantine_on_failure(self), \
+                    self.metrics.timed(M.SORT_TIME):
                 for b in batches:
                     sorted_b = with_retry_split(
                         lambda t: self._sort_fn(f"|cap{t.capacity}")(t), b,

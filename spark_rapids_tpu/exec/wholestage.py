@@ -54,7 +54,7 @@ def donation_active(conf) -> bool:
         return True
     try:
         return jax.default_backend() != "cpu"
-    except Exception:  # backend init failure: planning must not die here
+    except Exception:  # backend init failure: planning must not die here  # srtpu: degrade-ok(plan-time capability probe, no device work in flight)
         return False
 
 
@@ -103,6 +103,20 @@ class TpuWholeStageExec(TpuExec):
             return table
         return run
 
+    def host_batch_fn(self):
+        """Composed host-engine chain, or None when any member lacks a
+        host path — the whole stage then quarantines on terminal failure
+        but cannot recover the failing batch."""
+        fns = [n.host_batch_fn() for n in self.chain]
+        if any(f is None for f in fns):
+            return None
+
+        def run(table):
+            for f in fns:
+                table = f(table)
+            return table
+        return run
+
     def execute_columnar(self, pidx: int) -> Iterator[DeviceTable]:
         from ..memory.retry import split_device_rows, with_retry_split
         from ..parallel.pipeline import maybe_prefetched, stage_name
@@ -139,16 +153,26 @@ class TpuWholeStageExec(TpuExec):
                 return donating(b)
             return fused(b)
 
+        # degradation boundary: the OOM ladder escalates INSIDE (spill →
+        # retry → split); when it terminates — or the failure is a
+        # classified non-retryable XLA error — the boundary re-runs the
+        # batch through the composed host chain instead of failing the
+        # query (exec/fallback.py)
+        from .fallback import with_host_fallback
+        run = with_host_fallback(
+            self,
+            lambda b: with_retry_split(dispatch, b,
+                                       splitter=split_device_rows,
+                                       scope="wholestage",
+                                       context=self.node_name()),
+            self.host_batch_fn())
         for batch in source:
             with self.metrics.timed(M.OP_TIME):
                 # full OOM escalation ladder (memory/retry.py): the chain
                 # is row-wise, so halves of the input concat back into the
                 # same output. Split halves lose the exclusive flag and
                 # dispatch through the non-donating entry.
-                out = with_retry_split(dispatch, batch,
-                                       splitter=split_device_rows,
-                                       scope="wholestage",
-                                       context=self.node_name())
+                out = run(batch)
             self.metrics.add(M.NUM_OUTPUT_BATCHES, 1)
             yield out
 

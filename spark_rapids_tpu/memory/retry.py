@@ -136,8 +136,15 @@ def configure_oom_retry(conf) -> None:
 #: Runtime/allocator substrings that mark an exception as device OOM.
 #: "cannot fit" is the strict-pool MemoryError from BufferCatalog.register
 #: — without it a pinned-HBM-limit run (BENCH_OOM) could never retry.
+#: "Failed to allocate" covers the XLA allocator variants surfaced under
+#: an INTERNAL status ("INTERNAL: Failed to allocate 123B ...") — those
+#: are memory pressure, not engine bugs, and must walk the ladder before
+#: ever reaching the host-fallback boundary (exec/fallback.py classifies
+#: INTERNAL as non-retryable, so misclassifying here would skip the
+#: spill/split rungs entirely).
 _OOM_MARKERS = ("RESOURCE_EXHAUSTED", "RESOURCE EXHAUSTED", "Out of memory",
-                "out of memory", "OOM", "cannot fit")
+                "out of memory", "OOM", "cannot fit", "Failed to allocate",
+                "failed to allocate")
 
 
 class DeviceOomError(RuntimeError):
@@ -210,6 +217,7 @@ class _OomArbiter:
     def wait_admission(self) -> None:
         """Park the calling (non-retrier) thread until no retrier is
         engaged, bounded by oom.arbitration.maxWaitSeconds."""
+        from ..utils.deadline import check_deadline
         me = threading.get_ident()
         deadline = time.monotonic() + _GATE_WAIT_S
         waited = False
@@ -217,6 +225,7 @@ class _OomArbiter:
             if me in self._retriers:
                 return  # a retrier must never gate itself (deadlock)
             while self._retriers:
+                check_deadline()  # a parked admission must honor the query deadline
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     break  # pressure valve, not a correctness lock
@@ -270,7 +279,10 @@ _GATE_ACTIVE = False
 
 def oom_admission_gate() -> None:
     """Called by TpuSemaphore.acquire_if_necessary before a NEW admission
-    queues on the permit. No-op unless a retrier is engaged."""
+    queues on the permit. No-op unless a retrier is engaged or a query
+    deadline is armed (both are one module-global truthiness check)."""
+    from ..utils.deadline import check_deadline
+    check_deadline()
     if not _GATE_ACTIVE:
         return
     _ARBITER.wait_admission()
@@ -337,7 +349,7 @@ def _memprof_event(kind: str, nbytes: int = 0) -> None:
         mp = memprof.active()
         if mp is not None:
             mp.record(kind, -1, max(int(nbytes), 0))
-    except Exception:
+    except Exception:  # srtpu: degrade-ok(best-effort telemetry inside the ladder itself — nothing structured can originate here)
         pass  # srtpu: net-ok(best-effort telemetry — a memprof failure must never break the OOM recovery path it is narrating)
 
 
@@ -359,6 +371,14 @@ def _maybe_inject(point: Optional[str]) -> None:
         raise RuntimeError(
             f"RESOURCE_EXHAUSTED: injected device OOM at {point} "
             f"(faults action=oom)")
+    if action == "fatal":
+        # the NON-retryable twin of action=oom: the same INTERNAL status
+        # string a wedged XLA runtime produces, with no OOM marker — the
+        # ladder re-raises it and the host-fallback boundary
+        # (exec/fallback.py) classifies it as xla_internal
+        raise RuntimeError(
+            f"INTERNAL: injected non-retryable XLA failure at {point} "
+            f"(faults action=fatal)")
     raise faults.FaultInjectedError(point, action)
 
 
@@ -422,7 +442,7 @@ class _Ladder:
         faults.note_recovery("oom_splits")
         try:
             nbytes = batch.nbytes()
-        except Exception:
+        except Exception:  # srtpu: degrade-ok(size probe for telemetry; the split itself proceeds either way)
             nbytes = 0
         _memprof_event("oom_split", nbytes)
         print(f"# device OOM in {self.scope}: splitting input on the row "
@@ -451,7 +471,7 @@ class _Ladder:
                 pm_path = mp.oom_postmortem(
                     f"oom-retry exhausted [{self.scope}]: {self.context}",
                     catalog).get("path")
-        except Exception:
+        except Exception:  # srtpu: degrade-ok(postmortem capture while BUILDING the structured error — the DeviceOomError is raised regardless)
             pm_path = None
         msg = (f"device OOM in scope {self.scope!r} survived the retry "
                f"ladder: {self.attempts} attempt(s), {self.splits} "
@@ -484,6 +504,11 @@ class _Ladder:
 
 
 def _invoke(lad: _Ladder, fn: Callable, args: tuple, kwargs: dict):
+    # cooperative cancellation checkpoint: every ladder-protected device
+    # dispatch passes here, so a query past its deadline stops BEFORE its
+    # next device call instead of thrashing the spill/retry rungs
+    from ..utils.deadline import check_deadline
+    check_deadline()
     with lad.exclusive():
         _maybe_inject(lad.fault_point)
         return fn(*args, **kwargs)

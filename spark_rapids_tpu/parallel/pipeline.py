@@ -381,7 +381,7 @@ def prefetched(make_iter: Callable[[], Iterator], *, stage: str,
                     close()
             if not _put(_Done):
                 _put_final(_Done)
-        except BaseException as e:  # noqa: BLE001 — crosses the queue
+        except BaseException as e:  # noqa: BLE001 — crosses the queue  # srtpu: degrade-ok(the failure is forwarded through the queue and re-raised in the consumer)
             with _WORKERS_LOCK:
                 _STATS["stage_errors"] += 1
             if not _put(_Failure(_attach_context(e, stage))):
@@ -401,10 +401,26 @@ def prefetched(make_iter: Callable[[], Iterator], *, stage: str,
     t.start()
 
     tracer = get_tracer()
+
+    def _get():
+        # cooperative deadline: the consumer must not block forever on a
+        # producer that wedged after the query's deadline passed — poll
+        # with a short timeout only while a deadline is armed (the plain
+        # blocking get stays on the hot path otherwise)
+        from ..utils.deadline import check_deadline, deadline_active
+        if not deadline_active():
+            return q.get()
+        while True:
+            check_deadline()
+            try:
+                return q.get(timeout=0.25)
+            except queue.Empty:
+                continue
+
     try:
         while True:
             t0 = time.perf_counter()
-            item = q.get()
+            item = _get()
             wait = time.perf_counter() - t0
             if registry is not None:
                 registry.add(M.PIPELINE_WAIT, wait)
@@ -477,6 +493,8 @@ def parallel_map(fn: Callable[[T], R], items: Sequence[T],
                 "thread": threading.current_thread().name,
                 "started": time.monotonic()}
         try:
+            from ..utils.deadline import check_deadline
+            check_deadline()  # expired deadline: fail fast, don't start
             with _worker_scope():
                 return fn(x)
         finally:

@@ -1043,6 +1043,8 @@ _upgrade_decimal128_rules()
 def explain_plan(cpu_plan: PhysicalPlan, conf: RapidsConf) -> str:
     meta = wrap_plan(cpu_plan)
     meta.tag(conf)
+    from ..exec.fallback import plan_quarantine_pass
+    plan_quarantine_pass(meta, conf)
     return meta.explain(not_on_device_only=(conf.explain == "NOT_ON_GPU"))
 
 
@@ -1057,6 +1059,8 @@ def apply_overrides(cpu_plan: PhysicalPlan, conf: RapidsConf) -> PhysicalPlan:
         compile_plan_udfs(cpu_plan)
     meta = wrap_plan(cpu_plan)
     meta.tag(conf)
+    from ..exec.fallback import plan_quarantine_pass
+    plan_quarantine_pass(meta, conf)
     from .cbo import optimize
     optimize(meta, conf)  # reference: optional CostBasedOptimizer pass
     if conf.explain != "NONE":
@@ -1067,6 +1071,11 @@ def apply_overrides(cpu_plan: PhysicalPlan, conf: RapidsConf) -> PhysicalPlan:
         allowed = set(conf.allowed_non_tpu)
         for m in meta.walk():
             name = type(m.plan).__name__.replace("Cpu", "")
+            # a quarantined node is DELIBERATE host routing (runtime
+            # failure history), not a support gap — don't fail the assert
+            if m.reasons and all(r.startswith("quarantined:")
+                                 for r in m.reasons):
+                continue
             if not m.can_run and name not in allowed \
                     and not _always_cpu(m.plan):
                 raise AssertionError(

@@ -80,6 +80,11 @@ class TpuSession:
         # bounds + HBM pressure arbitration (memory/retry.py)
         from .memory.retry import configure_oom_retry
         configure_oom_retry(self.conf)
+        # runtime degradation (spark.rapids.tpu.fallback.*): host-fallback
+        # boundary + operator quarantine store (exec/fallback.py); loads
+        # the persisted quarantine.json so past failures route at plan time
+        from .exec.fallback import configure_fallback
+        configure_fallback(self.conf)
         # live health subsystem: watchdog monitor thread + optional HTTP
         # status endpoints (utils/health.py + tools/statusd.py); None when
         # health.enabled is false and health.port < 0 (the default)
@@ -241,6 +246,10 @@ class TpuSession:
                                           stop_warm_pool)
         stop_warm_pool()
         persist_compile_cache()
+        # flush the operator-quarantine store next to the compile-cache
+        # manifest so the NEXT session plans known-bad operators on host
+        from .exec.fallback import persist_quarantine
+        persist_quarantine()
         # cancel + join any straggling pipeline prefetch workers (queries
         # that drained fully already left none; this is the abandoned-
         # iterator backstop, and the no-leaked-threads test contract)
@@ -566,15 +575,25 @@ class DataFrame:
         # TpuSemaphore admission (parallel/pipeline.py); sequential
         # PhysicalPlan.collect when pipeline.enabled=false or 1 partition
         from .parallel.pipeline import pipelined_collect
+        from .utils.deadline import QUERY_TIMEOUT, deadline_scope
+        from .utils.health import HEALTH_REPORT_DIR
 
         def run():
             return pipelined_collect(plan, self.session.conf)
 
         logger = self.session._event_logger()
         try:
-            if logger is not None:
-                return logger.run_query(plan, run).to_arrow()
-            return run().to_arrow()
+            # query deadline (spark.rapids.tpu.query.timeoutSeconds):
+            # cooperative cancellation checkpoints across the retry
+            # ladder, the arbitration gate and the pipeline raise a
+            # structured QueryTimeoutError past the deadline (no-op scope
+            # when the timeout is 0)
+            with deadline_scope(
+                    self.session.conf.get(QUERY_TIMEOUT),
+                    report_dir=self.session.conf.get(HEALTH_REPORT_DIR)):
+                if logger is not None:
+                    return logger.run_query(plan, run).to_arrow()
+                return run().to_arrow()
         finally:
             # the plan is single-use (re-planned per collect): close its
             # spill-registered outputs now instead of waiting on GC — the

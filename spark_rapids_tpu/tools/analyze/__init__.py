@@ -42,6 +42,14 @@ Checkers (see the sibling modules):
                scope chain never references the OOM retry API
                (memory/retry.py) — a device OOM there raises instead of
                walking the spill/retry/split ladder.
+- ``degrade`` — dispatch sites outside BOTH the retry scope and the
+               fallback boundary (exec/fallback.py) — a terminal device
+               failure there gets no host re-execution and no
+               quarantine note; plus except handlers that swallow the
+               ladder's structured errors (``DeviceOomError``,
+               ``QueryTimeoutError``) without re-raising or
+               classifying, breaking split-and-retry bookkeeping and
+               cooperative cancellation.
 
 Workflow: findings are compared against a COMMITTED baseline
 (``tools/analyze/baseline.json``) so pre-existing debt is inventoried
@@ -316,16 +324,17 @@ def load_project(paths: Sequence[str]) -> Project:
 
 
 def _checkers() -> Dict[str, object]:
-    from . import (buckets, eventlog_schema, host_sync, jit_purity, locks,
-                   memtrack, net, retry_scope, threads, trace_ctx)
+    from . import (buckets, degrade, eventlog_schema, host_sync, jit_purity,
+                   locks, memtrack, net, retry_scope, threads, trace_ctx)
     return {"sync": host_sync, "lock": locks,
             "thread": threads, "jit": jit_purity, "bucket": buckets,
             "trace": trace_ctx, "memtrack": memtrack,
-            "eventlog": eventlog_schema, "net": net, "retry": retry_scope}
+            "eventlog": eventlog_schema, "net": net, "retry": retry_scope,
+            "degrade": degrade}
 
 
 CHECKS = ("sync", "lock", "thread", "jit", "bucket", "trace", "memtrack",
-          "eventlog", "net", "retry")
+          "eventlog", "net", "retry", "degrade")
 
 
 def analyze_paths(paths: Sequence[str],

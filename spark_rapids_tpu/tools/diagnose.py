@@ -583,6 +583,33 @@ def _retry_findings(q) -> List[Finding]:
     return findings
 
 
+def _fallback_findings(q) -> List[Finding]:
+    """Schema-v10 host-fallback records: batches that terminally failed
+    on the device and re-executed through the host engine. Correct
+    results, but each batch pays download + host execute + upload."""
+    fallbacks = getattr(q, "fallbacks", []) or []
+    if not fallbacks:
+        return []
+    injected = bool(getattr(q, "faults", []))
+    ops = sorted({f.get("operator", "?") for f in fallbacks})
+    classes = sorted({f.get("failure_class", "?") for f in fallbacks})
+    down = sum(f.get("bytes_down", 0) for f in fallbacks)
+    wall = sum(f.get("wall_s", 0.0) for f in fallbacks)
+    return [Finding(
+        node="(query)", node_id=None, metric="hostFallbacks",
+        seconds=wall, fraction=min(1.0, 0.2 * len(fallbacks)),
+        detail=f"{len(fallbacks)} batch(es) re-executed on the host "
+               f"engine: operators [{', '.join(ops)}], failure classes "
+               f"[{', '.join(classes)}], {down} bytes downloaded",
+        suggestion="injected chaos — expected" if injected else
+                   "the device path is failing terminally for these "
+                   "operators — repeated failures quarantine them to "
+                   "host at plan time (see explain); inspect the "
+                   "fallback records' failure_class to decide whether "
+                   "to fix the operator or disable it via "
+                   "spark.rapids.sql.exec.* ahead of the quarantine")]
+
+
 def _diagnose_query(q, heartbeats=None) -> Optional[QueryDiagnosis]:
     wall = getattr(q, "wall_s", 0.0)
     if wall <= 0 or getattr(q, "error", None):
@@ -739,6 +766,10 @@ def _diagnose_query(q, heartbeats=None) -> Optional[QueryDiagnosis]:
     # 10. OOM retry ladder (schema v9): retries, splits, and split storms
     # the query absorbed to stay under HBM
     findings.extend(_retry_findings(q))
+
+    # 11. host fallbacks (schema v10): batches the degradation layer
+    # re-executed on the host engine after terminal device failures
+    findings.extend(_fallback_findings(q))
 
     findings.sort(key=lambda f: -f.fraction)
     return QueryDiagnosis(q.query_id, wall, findings, critical_path=cp)

@@ -76,15 +76,18 @@ __all__ = ["EventLogWriter", "load_event_log", "AppReplay", "QueryReplay",
 # Event-record schema version. Bump ONLY with a migration note in
 # docs/observability.md; tests/test_observability.py pins the current value
 # and the per-record required-key sets so replay/compare tooling can rely
-# on old logs staying loadable. v9: oom_retry records — one per retry
-# scope that engaged the device-OOM escalation ladder (memory/retry.py):
-# spill → retry → split-and-retry, with the attempt/split/spilled-bytes
-# counts and the recovered/failed outcome. (v8 added fault/recovery
-# records — per-fire injection telemetry plus an always-written per-query
-# recovery-ledger delta; v7 added shuffle_skew records; v6 added
-# memory_summary/oom_postmortem records and peak_device_bytes on node
-# records.)
-SCHEMA_VERSION = 9
+# on old logs staying loadable. v10: fallback records — one per batch a
+# device operator re-executed through the host engine after a terminal
+# device failure (exec/fallback.py): operator + failure class + bytes
+# moved each way + host wall time. (v9 added oom_retry records — one per
+# retry scope that engaged the device-OOM escalation ladder
+# (memory/retry.py): spill → retry → split-and-retry, with the
+# attempt/split/spilled-bytes counts and the recovered/failed outcome;
+# v8 added fault/recovery records — per-fire injection telemetry plus an
+# always-written per-query recovery-ledger delta; v7 added shuffle_skew
+# records; v6 added memory_summary/oom_postmortem records and
+# peak_device_bytes on node records.)
+SCHEMA_VERSION = 10
 
 # The event-record schema registry: every record type a writer may emit,
 # mapped to the schema version that introduced it. srtpu-analyze's
@@ -107,6 +110,7 @@ RECORD_TYPES: Dict[str, int] = {
     "fault": 8,
     "recovery": 8,
     "oom_retry": 9,
+    "fallback": 10,
 }
 
 EVENT_LOG_DIR = register_conf(
@@ -202,6 +206,8 @@ class EventLogWriter:
             # v9: ditto for the OOM-retry ladder — the scopes that
             # retried/split before the query died are the postmortem trail
             self._write_oom_retry_records(qid)
+            # v10: host fallbacks completed before the query died anyway
+            self._write_fallback_records(qid)
             self.write({"event": "query_end", "query_id": qid,
                         "ts": time.time(), "trace_id": tctx.trace_id,
                         "wall_s": time.perf_counter() - t0,
@@ -249,6 +255,7 @@ class EventLogWriter:
         self._write_memory_records(qid)
         self._write_fault_records(qid, recovery_before)
         self._write_oom_retry_records(qid)
+        self._write_fallback_records(qid)
         aqe_events: List[str] = list(getattr(plan, "events", []))
         self.write({
             "event": "query_end", "query_id": qid, "ts": time.time(),
@@ -309,6 +316,14 @@ class EventLogWriter:
         from ..memory.retry import drain_oom_retry_records
         for rr in drain_oom_retry_records():
             self.write({**rr, "event": "oom_retry", "query_id": qid})
+
+    def _write_fallback_records(self, qid: int) -> None:
+        """v10: drain the degradation layer's completed-fallback records
+        (one ``fallback`` record per batch re-executed through the host
+        engine; none in the healthy-device common case)."""
+        from ..exec.fallback import drain_fallback_records
+        for fr in drain_fallback_records():
+            self.write({**fr, "event": "fallback", "query_id": qid})
 
     def close(self) -> None:
         self.write({"event": "app_end", "ts": time.time()})
@@ -393,6 +408,9 @@ class QueryReplay:
         # v9: device-OOM retry-ladder records — one per retry scope that
         # retried or split (empty for pre-v9 logs and unpressured queries)
         self.oom_retries: List[Dict] = []
+        # v10: host-fallback records — one per batch re-executed through
+        # the host engine (empty for pre-v10 logs and healthy devices)
+        self.fallbacks: List[Dict] = []
 
     def heartbeats_in_window(self, heartbeats: List[Dict]) -> List[Dict]:
         """App heartbeats whose timestamp falls inside this query's run
@@ -538,6 +556,17 @@ class AppReplay:
                     f"'{worst.get('scope')}' split {worst['splits']}x "
                     "(lower spark.rapids.sql.batchSizeBytes so batches "
                     "fit HBM without retry-time splitting)")
+            # v10: batches that had to re-execute on the host engine —
+            # correct results, but the device path is failing for that
+            # operator and each batch pays a download/upload round trip
+            if q.fallbacks:
+                ops = sorted({f.get("operator", "?") for f in q.fallbacks})
+                down = sum(f.get("bytes_down", 0) for f in q.fallbacks)
+                warnings.append(
+                    f"q{q.query_id}: {len(q.fallbacks)} batch(es) fell "
+                    f"back to the host engine ({', '.join(ops)}; "
+                    f"{down} bytes downloaded) — repeated failures "
+                    "quarantine the operator to host at plan time")
         stalled = [h for h in self.heartbeats if h.get("stalled")]
         if stalled:
             age = max(h.get("last_progress_age_s", 0.0) for h in stalled)
@@ -601,6 +630,10 @@ def load_event_log(path: str) -> AppReplay:
                 q = app.queries.setdefault(rec["query_id"],
                                            QueryReplay(rec["query_id"]))
                 q.oom_retries.append(rec)
+            elif ev == "fallback":
+                q = app.queries.setdefault(rec["query_id"],
+                                           QueryReplay(rec["query_id"]))
+                q.fallbacks.append(rec)
             elif ev == "query_end":
                 q = app.queries.setdefault(rec["query_id"],
                                            QueryReplay(rec["query_id"]))

@@ -342,9 +342,13 @@ def run_sentinel(store: HistoryStore,
     # v9: same exemption for queries the BENCH_OOM phase ran under a
     # shrunken HBM pool — their oom_retry records (spills, retries,
     # splits) are deliberate pressure, not a regression.
+    # v10: ditto for queries that recovered via host fallback — the
+    # download/host-execute/upload round trips are the degradation
+    # working as designed, not a device-path slowdown.
     chaos_ok = {q.query_id for q in app_cand.queries.values()
                 if (getattr(q, "faults", None)
-                    or getattr(q, "oom_retries", None))
+                    or getattr(q, "oom_retries", None)
+                    or getattr(q, "fallbacks", None))
                 and q.error is None}
     sync_flags = [f for f in _count_gate(report, SYNC_COUNT_KEY)
                   if f["query_id"] not in chaos_ok]

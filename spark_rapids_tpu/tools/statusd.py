@@ -197,11 +197,24 @@ class _StatusHandler(BaseHTTPRequestHandler):
                 fed = self.server.federation  # type: ignore[attr-defined]
                 self._send(200, fed.prometheus_text(),
                            "text/plain; version=0.0.4")
+            elif path == "/quarantine":
+                # degradation state: live quarantine entries + the
+                # fallback/deadline counters (exec/fallback.py,
+                # utils/deadline.py) — what an operator checks when
+                # explain() starts showing "quarantined:" reasons
+                from ..exec.fallback import (fallback_stats,
+                                             quarantine_entries)
+                from ..utils.deadline import deadline_stats
+                body = {"fallback": fallback_stats(),
+                        "deadline": deadline_stats(),
+                        "quarantine": quarantine_entries()}
+                self._send(200, json.dumps(body, default=str),
+                           "application/json")
             else:
                 self._send(404, json.dumps(
                     {"error": "not found",
                      "endpoints": ["/healthz", "/metrics", "/status",
-                                   "/federation",
+                                   "/quarantine", "/federation",
                                    "/federation/metrics"]}),
                     "application/json")
         except (BrokenPipeError, ConnectionResetError):

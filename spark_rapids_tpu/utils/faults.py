@@ -24,7 +24,7 @@ Spec grammar (``spark.rapids.tpu.faults.spec``)::
                times         stop after N fires          (default unlimited)
                after         skip the first N evaluations (default 0)
                latency_ms    inject latency before returning
-               action        raise|kill|corrupt|delay|oom (default raise)
+               action        raise|kill|corrupt|delay|oom|fatal (default raise)
 
 e.g. ``tcp.connect:p=0.2:times=3;worker.task:after=1:action=kill``.
 Each point gets its own ``random.Random(f"{seed}:{point}")`` stream, so
@@ -73,7 +73,8 @@ FAULTS_SPEC = register_conf(
     "Fault-injection spec: semicolon-separated clauses of the form "
     "point[:key=value]* with keys p|prob (fire probability), times "
     "(max fires), after (skip first N evaluations), latency_ms and "
-    "action (raise|kill|corrupt|delay|oom). See docs/fault_tolerance.md.",
+    "action (raise|kill|corrupt|delay|oom|fatal). See "
+    "docs/fault_tolerance.md.",
     "")
 
 FAULTS_SEED = register_conf(
@@ -96,11 +97,16 @@ FAULT_POINTS = (
     "spill.read",        # memory/stores.py disk-spill restore
     "worker.task",       # parallel/runtime.py worker task execution (supports kill)
     "h2d.upload",        # exec/transitions.py host->device upload
-    "alloc.jit",         # memory/retry.py jit-dispatch retry scope (supports oom)
-    "alloc.upload",      # memory/retry.py H2D-upload retry scope (supports oom)
+    "alloc.jit",         # memory/retry.py jit-dispatch retry scope (supports oom/fatal)
+    "alloc.upload",      # memory/retry.py H2D-upload retry scope (supports oom/fatal)
 )
 
-_ACTIONS = ("raise", "kill", "corrupt", "delay", "oom")
+# "fatal" is the non-retryable twin of "oom": memory/retry.py raises an
+# INTERNAL-status RuntimeError with no OOM marker, so the retry ladder
+# passes it through and the host-fallback boundary (exec/fallback.py)
+# classifies it — the injection that exercises the degradation path
+# BELOW the ladder.
+_ACTIONS = ("raise", "kill", "corrupt", "delay", "oom", "fatal")
 
 
 class FaultInjectedError(RuntimeError):
@@ -301,6 +307,7 @@ _LEDGER_KEYS = (
     "spill_corruptions",    # disk-spill blocks that failed CRC verification
     "oom_retries",          # device-OOM spill-and-retry attempts (memory/retry.py)
     "oom_splits",           # device-OOM row-axis input halvings (memory/retry.py)
+    "host_fallbacks",       # batches re-executed on the host engine (exec/fallback.py)
 )
 
 _LEDGER: Dict[str, int] = {k: 0 for k in _LEDGER_KEYS}

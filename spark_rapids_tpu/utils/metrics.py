@@ -445,6 +445,16 @@ def _retry_source() -> Dict:
     return retry_stats()
 
 
+def _fallback_source() -> Dict:
+    from ..exec.fallback import fallback_stats
+    return fallback_stats()
+
+
+def _deadline_source() -> Dict:
+    from .deadline import deadline_stats
+    return deadline_stats()
+
+
 _DEFAULT_SOURCES = {
     "compile_cache": _compile_cache_source,
     "catalog": _catalog_source,
@@ -457,6 +467,8 @@ _DEFAULT_SOURCES = {
     "host_sync": _host_sync_source,
     "faults": _faults_source,
     "retry": _retry_source,
+    "fallback": _fallback_source,
+    "deadline": _deadline_source,
 }
 
 _GLOBAL_STATS: Optional[StatsRegistry] = None

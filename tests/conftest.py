@@ -64,3 +64,21 @@ def _drain_oom_telemetry_per_module():
     mp = active()
     if mp is not None:
         mp.drain_postmortems()
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _drain_degradation_state_per_module():
+    """The degradation layer's quarantine store, fallback ledger and
+    deadline state are process-wide by design (exec/fallback.py,
+    utils/deadline.py). A module that drove operators into quarantine
+    would otherwise poison the NEXT module's planning (its operators
+    silently route to host) — reset between modules, and restore the
+    production defaults for the sticky fallback.* config."""
+    yield
+    from spark_rapids_tpu.conf import RapidsConf
+    from spark_rapids_tpu.exec.fallback import (configure_fallback,
+                                                reset_fallback_state)
+    from spark_rapids_tpu.utils.deadline import reset_deadline
+    reset_fallback_state()
+    configure_fallback(RapidsConf({}))
+    reset_deadline()

@@ -436,6 +436,105 @@ def test_net_checker_rules(tmp_path):
     assert len(report.suppressed) == 1
 
 
+def test_degrade_checker_rules(tmp_path):
+    path = _write(tmp_path, "degrade_fixture.py", """\
+        from spark_rapids_tpu.exec.fallback import (quarantine_on_failure,
+                                                    with_host_fallback)
+        from spark_rapids_tpu.memory.retry import (DeviceOomError,
+                                                   with_retry_split)
+        from spark_rapids_tpu.utils.compile_cache import cached_jit
+
+        def unguarded(batch, build):
+            fn = cached_jit('k', build)
+            return fn(batch)
+
+        def retry_guarded(batch, build):
+            fn = cached_jit('k', build)
+            return with_retry_split(fn, batch, scope='fixture')
+
+        def fallback_guarded(node, batch, build, host_fn):
+            fn = cached_jit('k', build)
+            return with_host_fallback(node, fn, host_fn)(batch)
+
+        def note_only_guarded(node, batch, build):
+            fn = cached_jit('k', build)
+            with quarantine_on_failure(node):
+                return fn(batch)
+
+        def swallows_everything(batch, fn):
+            try:
+                return fn(batch)
+            except Exception:
+                return None
+
+        def swallows_structured(batch, fn):
+            try:
+                return fn(batch)
+            except DeviceOomError:
+                return None
+
+        def reraises(batch, fn):
+            try:
+                return fn(batch)
+            except Exception:
+                raise
+
+        def forwards(q, batch, fn):
+            try:
+                return fn(batch)
+            except Exception:  # srtpu: degrade-ok(forwarded to the consumer queue)
+                q.put(None)
+
+        def typed_cleanup(handle):
+            try:
+                handle.close()
+            except OSError:
+                return None
+        """)
+    report = analyze_paths([path], checks=["degrade"])
+    assert sorted(f.rule for f in report.findings) == [
+        "degrade-swallowed-failure", "degrade-swallowed-failure",
+        "degrade-unguarded-dispatch"]
+    assert {f.symbol for f in report.findings} == \
+        {"unguarded", "swallows_everything", "swallows_structured"}
+    assert len(report.suppressed) == 1
+    # the structured-error message names what was caught
+    (structured,) = [f for f in report.findings
+                     if f.symbol == "swallows_structured"]
+    assert "DeviceOomError" in structured.message
+
+
+def test_degrade_checker_skips_cold_packages(tmp_path):
+    cold = tmp_path / "spark_rapids_tpu" / "tools"
+    cold.mkdir(parents=True)
+    (cold / "coldmod.py").write_text(
+        "def f(x, fn):\n"
+        "    try:\n"
+        "        return fn(x)\n"
+        "    except Exception:\n"
+        "        return None\n")
+    report = analyze_paths([str(tmp_path)], checks=["degrade"])
+    assert report.count("degrade") == 0
+
+
+def test_degrade_swallow_rule_covers_warm_packages(tmp_path):
+    warm = tmp_path / "spark_rapids_tpu" / "parallel"
+    warm.mkdir(parents=True)
+    (warm / "warmmod.py").write_text(
+        "from spark_rapids_tpu.utils.compile_cache import cached_jit\n\n"
+        "def swallow(x, fn):\n"
+        "    try:\n"
+        "        return fn(x)\n"
+        "    except Exception:\n"
+        "        return None\n\n"
+        "def dispatch(batch, build):\n"
+        "    fn = cached_jit('k', build)\n"
+        "    return fn(batch)\n")
+    report = analyze_paths([str(tmp_path)], checks=["degrade"])
+    # swallow rule reaches warm; the dispatch rule stays hot-only
+    assert [f.rule for f in report.findings] == ["degrade-swallowed-failure"]
+
+
 def test_net_checker_skips_cold_packages(tmp_path):
     cold = tmp_path / "spark_rapids_tpu" / "tools"
     cold.mkdir(parents=True)

@@ -1,0 +1,477 @@
+"""Graceful degradation: runtime host fallback, operator quarantine and
+query deadlines (PR-15).
+
+The contract under test (docs/fault_tolerance.md "Degradation ladder"):
+a terminal device failure — the OOM ladder exhausted, or a classified
+non-retryable XLA error — re-executes the failing batch through the
+host engine and the query still returns exactly the healthy-device
+answer, leaving a schema-v10 ``fallback`` event-log record. Repeated
+failures quarantine the (operator, plan-signature, failure-class) key:
+a later session plans the operator on host outright, with explain()
+showing the reason. A query past
+``spark.rapids.tpu.query.timeoutSeconds`` cancels cooperatively with a
+structured QueryTimeoutError carrying a forensics dump, leaving no
+stuck semaphore permits or arbiter state behind.
+"""
+import json
+import os
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.conf import RapidsConf
+from spark_rapids_tpu.exec import fallback as fb
+from spark_rapids_tpu.exec.fallback import (classify_failure,
+                                            configure_fallback,
+                                            drain_fallback_records,
+                                            fallback_stats, note_quarantine,
+                                            persist_quarantine,
+                                            quarantine_entries,
+                                            quarantine_reason,
+                                            reset_fallback_state,
+                                            with_host_fallback)
+from spark_rapids_tpu.memory.retry import DeviceOomError, configure_oom_retry
+from spark_rapids_tpu.utils import faults
+from spark_rapids_tpu.utils.deadline import (QueryTimeoutError,
+                                             deadline_active, deadline_scope,
+                                             reset_deadline)
+from spark_rapids_tpu.utils.faults import configure_faults
+
+
+@pytest.fixture(autouse=True)
+def _pristine_degradation():
+    """The quarantine store, fallback ledger and deadline state are
+    process-global by design; every test starts and ends zeroed with
+    the production defaults for the sticky config."""
+    def reset():
+        reset_fallback_state()
+        configure_fallback(RapidsConf({}))
+        reset_deadline()
+        configure_oom_retry(RapidsConf({}))
+        faults.reset_faults()
+        faults.reset_recovery()
+    reset()
+    yield
+    reset()
+
+
+def _chaos_conf(spec):
+    return RapidsConf({"spark.rapids.tpu.faults.enabled": "true",
+                       "spark.rapids.tpu.faults.seed": "7",
+                       "spark.rapids.tpu.faults.spec": spec})
+
+
+def _assert_parity(got, ref):
+    assert got.num_rows == ref.num_rows
+    for name in ref.column_names:
+        g, r = got.column(name).to_pylist(), ref.column(name).to_pylist()
+        if ref.column(name).type in (pa.float64(), pa.float32()):
+            np.testing.assert_allclose(np.array(g, dtype=float),
+                                       np.array(r, dtype=float), rtol=1e-9)
+        else:
+            assert g == r, name
+
+
+# ---------------------------------------------------------------------------
+# failure classification
+# ---------------------------------------------------------------------------
+def test_classify_failure():
+    # fallback-eligible terminal classes
+    assert classify_failure(DeviceOomError("exhausted")) == "oom_exhausted"
+    assert classify_failure(RuntimeError(
+        "INVALID_ARGUMENT: donated buffer reused")) == "xla_invalid_argument"
+    assert classify_failure(RuntimeError(
+        "UNIMPLEMENTED: no kernel for dtype")) == "xla_unimplemented"
+    assert classify_failure(RuntimeError(
+        "Compilation failure: while lowering")) == "xla_compile"
+    assert classify_failure(RuntimeError(
+        "INTERNAL: unexpected HLO pass failure")) == "xla_internal"
+    # an escaped retryable OOM is still a recoverable device failure
+    assert classify_failure(RuntimeError(
+        "RESOURCE_EXHAUSTED: out of memory")) == "oom"
+    # never fallback-eligible: cancellation, plain bugs, non-Runtime types
+    assert classify_failure(QueryTimeoutError(1.0, 2.0)) is None
+    assert classify_failure(RuntimeError("shape mismatch")) is None
+    assert classify_failure(ValueError("INTERNAL: nope")) is None
+    assert classify_failure(KeyError("INVALID_ARGUMENT")) is None
+
+
+def test_query_timeout_error_is_not_retryable_oom():
+    """The timeout message must never pattern-match the OOM markers —
+    a deadline expiry inside a retry scope has to propagate, not spin
+    the ladder."""
+    from spark_rapids_tpu.memory.retry import is_retryable_oom
+    err = QueryTimeoutError(0.5, 1.25)
+    assert not is_retryable_oom(err)
+    assert "deadline" in str(err)
+
+
+# ---------------------------------------------------------------------------
+# the fallback boundary (unit)
+# ---------------------------------------------------------------------------
+class _FakeNode:
+    def plan_signature(self):
+        return "Fake|sig"
+
+    def node_desc(self):
+        return "fake"
+
+
+def _device_batch(n=16):
+    from spark_rapids_tpu.columnar.device import DeviceTable
+    from spark_rapids_tpu.columnar.host import HostTable
+    t = pa.table({"a": pa.array(np.arange(n, dtype=np.int64)),
+                  "b": pa.array(np.arange(n, dtype=np.float64))})
+    return DeviceTable.from_host(HostTable.from_arrow(t), min_bucket=8)
+
+
+def test_with_host_fallback_recovers_and_records():
+    batch = _device_batch()
+
+    def device_fn(b):
+        raise RuntimeError("INTERNAL: injected")
+
+    def host_fn(ht):
+        return ht  # identity on the host engine
+
+    out = with_host_fallback(_FakeNode(), device_fn, host_fn)(batch)
+    got = out.to_host().to_arrow()
+    assert got.column("a").to_pylist() == list(range(16))
+    s = fallback_stats()
+    assert s["host_fallbacks"] == 1
+    assert s["fallback_bytes_down"] > 0 and s["fallback_bytes_up"] > 0
+    assert faults.recovery_counters()["host_fallbacks"] == 1
+    (rec,) = drain_fallback_records()
+    for key in ("ts", "operator", "context", "failure_class", "reason",
+                "rows", "bytes_down", "bytes_up", "wall_s"):
+        assert key in rec, key
+    assert rec["operator"] == "_FakeNode"
+    assert rec["failure_class"] == "xla_internal"
+    assert rec["rows"] == 16
+    # the failure was noted in the quarantine store either way
+    (ent,) = quarantine_entries()
+    assert ent["operator"] == "_FakeNode" and ent["count"] == 1
+
+
+def test_with_host_fallback_without_host_path_reraises_but_quarantines():
+    def device_fn(b):
+        raise RuntimeError("UNIMPLEMENTED: no kernel")
+
+    run = with_host_fallback(_FakeNode(), device_fn, None)
+    with pytest.raises(RuntimeError, match="UNIMPLEMENTED"):
+        run(_device_batch())
+    s = fallback_stats()
+    assert s["host_fallbacks"] == 0 and s["fallback_failures"] == 1
+    (ent,) = quarantine_entries()
+    assert ent["failure_class"] == "xla_unimplemented"
+
+
+def test_with_host_fallback_passes_through_unclassified_errors():
+    def device_fn(b):
+        raise ValueError("a plain bug")
+
+    run = with_host_fallback(_FakeNode(), device_fn, lambda ht: ht)
+    with pytest.raises(ValueError):
+        run(_device_batch())
+    assert fallback_stats()["host_fallbacks"] == 0
+    assert not quarantine_entries()
+
+
+def test_with_host_fallback_disabled_is_identity():
+    configure_fallback(RapidsConf(
+        {"spark.rapids.tpu.fallback.enabled": "false"}))
+    def device_fn(b):
+        return b
+    assert with_host_fallback(_FakeNode(), device_fn, None) is device_fn
+
+
+# ---------------------------------------------------------------------------
+# quarantine store: threshold, TTL, eviction, persistence
+# ---------------------------------------------------------------------------
+def test_quarantine_threshold_and_reason():
+    configure_fallback(RapidsConf(
+        {"spark.rapids.tpu.fallback.quarantine.threshold": "3"}))
+    for _ in range(2):
+        note_quarantine("TpuFilterExec", "Filter|sig", "xla_internal",
+                        "RuntimeError: INTERNAL: boom")
+    assert quarantine_reason("TpuFilterExec", "Filter|sig") is None
+    note_quarantine("TpuFilterExec", "Filter|sig", "xla_internal",
+                    "RuntimeError: INTERNAL: boom")
+    reason = quarantine_reason("TpuFilterExec", "Filter|sig")
+    assert reason is not None and "3 runtime xla_internal" in reason
+    # a different signature of the same operator is NOT quarantined
+    assert quarantine_reason("TpuFilterExec", "Filter|other") is None
+
+
+def test_quarantine_ttl_expiry(monkeypatch):
+    configure_fallback(RapidsConf(
+        {"spark.rapids.tpu.fallback.quarantine.threshold": "1",
+         "spark.rapids.tpu.fallback.quarantine.ttlSeconds": "60"}))
+    note_quarantine("TpuSortExec", "Sort|sig", "xla_compile", "boom")
+    assert quarantine_reason("TpuSortExec", "Sort|sig") is not None
+    # age the entry past the TTL: the operator gets retried on device
+    real_time = time.time
+    monkeypatch.setattr(time, "time", lambda: real_time() + 120.0)
+    assert quarantine_reason("TpuSortExec", "Sort|sig") is None
+    assert not quarantine_entries()
+
+
+def test_quarantine_max_entries_evicts_oldest():
+    configure_fallback(RapidsConf(
+        {"spark.rapids.tpu.fallback.quarantine.maxEntries": "4"}))
+    for i in range(8):
+        note_quarantine(f"Op{i}", f"sig{i}", "xla_internal", "boom")
+    ents = quarantine_entries()
+    assert len(ents) == 4
+    assert {e["operator"] for e in ents} == {"Op4", "Op5", "Op6", "Op7"}
+
+
+def test_quarantine_persist_and_load_roundtrip(tmp_path):
+    path = str(tmp_path / "quarantine.json")
+    configure_fallback(RapidsConf(
+        {"spark.rapids.tpu.fallback.quarantine.threshold": "2"}))
+    for _ in range(2):
+        note_quarantine("TpuProjectExec", "Project|sig", "xla_internal",
+                        "boom")
+    fb._QUARANTINE.persist(path)
+    reset_fallback_state()
+    assert quarantine_reason("TpuProjectExec", "Project|sig") is None
+    fb._QUARANTINE.load(path)
+    configure_fallback(RapidsConf(
+        {"spark.rapids.tpu.fallback.quarantine.threshold": "2"}))
+    assert quarantine_reason("TpuProjectExec", "Project|sig") is not None
+
+
+def test_quarantine_load_tolerates_corruption(tmp_path):
+    path = tmp_path / "quarantine.json"
+    path.write_text("{ not json", encoding="utf-8")
+    fb._QUARANTINE.load(str(path))  # must not raise
+    assert not quarantine_entries()
+    fb._QUARANTINE.load(str(tmp_path / "missing.json"))  # ditto
+
+
+# ---------------------------------------------------------------------------
+# deadline scope (unit)
+# ---------------------------------------------------------------------------
+def test_deadline_scope_noop_when_unset():
+    with deadline_scope(0.0):
+        assert not deadline_active()
+
+
+def test_deadline_scope_arms_fires_and_disarms(tmp_path):
+    from spark_rapids_tpu.utils.deadline import check_deadline
+    with pytest.raises(QueryTimeoutError) as ei:
+        with deadline_scope(0.01, report_dir=str(tmp_path)):
+            assert deadline_active()
+            time.sleep(0.05)
+            check_deadline()
+    err = ei.value
+    assert err.timeout_s == 0.01 and err.elapsed_s >= 0.01
+    assert err.forensics_path and os.path.exists(err.forensics_path)
+    doc = json.loads(open(err.forensics_path, encoding="utf-8").read())
+    for key in ("timeout_s", "elapsed_s", "semaphore", "oom_arbiter",
+                "pipeline"):
+        assert key in doc, key
+    assert not deadline_active()  # disarmed on scope exit
+
+
+# ---------------------------------------------------------------------------
+# chaos matrix: q1/q3/q6 x {fatal XLA error, ladder exhaustion, deadline}
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("query", ["q1", "q3", "q6"])
+def test_tpch_parity_under_fatal_xla_failure(session, query):
+    """Acceptance pin: an injected NON-retryable failure (action=fatal
+    at alloc.jit) re-executes the failing batches through the host
+    engine and the answer is bit-identical to the clean run."""
+    from spark_rapids_tpu.tools import tpch
+    tables = tpch.gen_all(0, tiny=True)
+    dfs = tpch.build_dataframes(session, tables, num_partitions=2)
+    q = getattr(tpch, query)(dfs)
+    ref = q.collect(device=True)
+
+    configure_faults(_chaos_conf("alloc.jit:times=2:action=fatal"))
+    got = q.collect(device=True)
+    faults.reset_faults()
+
+    _assert_parity(got, ref)
+    s = fallback_stats()
+    assert s["host_fallbacks"] >= 1
+    assert faults.recovery_counters()["host_fallbacks"] >= 1
+    recs = drain_fallback_records()
+    assert recs and all(r["failure_class"] == "xla_internal" for r in recs)
+
+
+@pytest.mark.parametrize("query", ["q1", "q3", "q6"])
+def test_tpch_parity_under_ladder_exhaustion(session, query):
+    """With the escalation ladder pinned shut (maxRetries=0,
+    maxSplits=0) an injected OOM terminates in DeviceOomError — the
+    fallback boundary catches the structured error and the host engine
+    still produces the exact answer."""
+    from spark_rapids_tpu.tools import tpch
+    tables = tpch.gen_all(0, tiny=True)
+    dfs = tpch.build_dataframes(session, tables, num_partitions=2)
+    q = getattr(tpch, query)(dfs)
+    ref = q.collect(device=True)
+
+    configure_oom_retry(RapidsConf({"spark.rapids.tpu.oom.maxRetries": "0",
+                                    "spark.rapids.tpu.oom.maxSplits": "0"}))
+    configure_faults(_chaos_conf("alloc.jit:times=1:action=oom"))
+    got = q.collect(device=True)
+    faults.reset_faults()
+    configure_oom_retry(RapidsConf({}))
+
+    _assert_parity(got, ref)
+    recs = drain_fallback_records()
+    assert recs and all(r["failure_class"] == "oom_exhausted" for r in recs)
+
+
+def test_tpch_deadline_expiry_cancels_cleanly():
+    """A query wedged past spark.rapids.tpu.query.timeoutSeconds
+    cancels with the structured QueryTimeoutError, the forensics dump
+    exists, and no semaphore permits or arbiter state leak."""
+    from spark_rapids_tpu.session import TpuSession
+    from spark_rapids_tpu.tools import tpch
+    sess = TpuSession(RapidsConf({
+        "spark.rapids.tpu.batchRowsMinBucket": 8,
+        "spark.rapids.tpu.query.timeoutSeconds": "0.2",
+        "spark.rapids.tpu.faults.enabled": "true",
+        "spark.rapids.tpu.faults.seed": "7",
+        "spark.rapids.tpu.faults.spec": "alloc.jit:action=delay:latency_ms=300",
+    }))
+    try:
+        tables = tpch.gen_all(0, tiny=True)
+        dfs = tpch.build_dataframes(sess, tables, num_partitions=2)
+        with pytest.raises(QueryTimeoutError) as ei:
+            tpch.q1(dfs).collect(device=True)
+        err = ei.value
+        assert err.timeout_s == pytest.approx(0.2)
+        assert err.forensics_path and os.path.exists(err.forensics_path)
+        # released runtime state: no stuck permits, no engaged arbiter
+        from spark_rapids_tpu.memory.retry import arbiter_snapshot
+        from spark_rapids_tpu.memory.semaphore import peek_semaphore
+        sem = peek_semaphore()
+        if sem is not None:
+            assert sem.holder_count() == 0 and sem.waiter_count() == 0
+        arb = arbiter_snapshot()
+        assert arb["active_retriers"] == 0 and not arb["gate_active"]
+        assert not deadline_active()
+    finally:
+        faults.reset_faults()
+        sess.close()
+
+
+# ---------------------------------------------------------------------------
+# plan-time quarantine routing
+# ---------------------------------------------------------------------------
+def test_quarantine_routes_operator_to_host_at_plan_time(session):
+    """After the threshold, explain() shows the quarantine reason and a
+    re-planned query runs the operator on host — zero device attempts
+    (no further fallbacks) while still matching the clean answer."""
+    from spark_rapids_tpu.tools import tpch
+    configure_fallback(RapidsConf(
+        {"spark.rapids.tpu.fallback.quarantine.threshold": "2"}))
+    tables = tpch.gen_all(0, tiny=True)
+    dfs = tpch.build_dataframes(session, tables, num_partitions=2)
+    q = tpch.q6(dfs)
+    ref = q.collect(device=True)
+
+    configure_faults(_chaos_conf("alloc.jit:times=2:action=fatal"))
+    got = q.collect(device=True)
+    faults.reset_faults()
+    _assert_parity(got, ref)
+    assert any(e["count"] >= 2 for e in quarantine_entries())
+
+    text = q.explain("tpu")
+    assert "quarantined:" in text and "xla_internal" in text
+
+    before = fallback_stats()
+    got2 = q.collect(device=True)
+    _assert_parity(got2, ref)
+    after = fallback_stats()
+    # the quarantined operators planned on host: the planner routed them
+    # and the run needed no runtime fallbacks (zero device attempts)
+    assert after["quarantine_plan_routes"] > before["quarantine_plan_routes"]
+    assert after["host_fallbacks"] == before["host_fallbacks"]
+
+
+def test_quarantine_survives_into_fresh_session(tmp_path):
+    """The store persists next to the compile-cache manifest on session
+    close; a FRESH session over the same cache dir plans the operator
+    on host before ever dispatching it."""
+    from spark_rapids_tpu.session import TpuSession
+    from spark_rapids_tpu.tools import tpch
+    cache_dir = str(tmp_path / "cache")
+    conf = {"spark.rapids.tpu.batchRowsMinBucket": 8,
+            "spark.rapids.tpu.compile.cacheDir": cache_dir,
+            "spark.rapids.tpu.fallback.quarantine.threshold": "2"}
+    sess1 = TpuSession(RapidsConf(dict(conf)))
+    try:
+        tables = tpch.gen_all(0, tiny=True)
+        dfs = tpch.build_dataframes(sess1, tables, num_partitions=2)
+        q = tpch.q6(dfs)
+        ref = q.collect(device=True)
+        configure_faults(_chaos_conf("alloc.jit:times=2:action=fatal"))
+        q.collect(device=True)
+        faults.reset_faults()
+        assert any(e["count"] >= 2 for e in quarantine_entries())
+    finally:
+        faults.reset_faults()
+        sess1.close()  # persists quarantine.json into the cache tier
+    reset_fallback_state()
+    assert not quarantine_entries()
+
+    sess2 = TpuSession(RapidsConf(dict(conf)))
+    try:
+        assert quarantine_entries(), "fresh session did not load the store"
+        tables = tpch.gen_all(0, tiny=True)
+        dfs = tpch.build_dataframes(sess2, tables, num_partitions=2)
+        q = tpch.q6(dfs)
+        text = q.explain("tpu")
+        assert "quarantined:" in text
+        before = fallback_stats()
+        got = q.collect(device=True)
+        _assert_parity(got, ref)
+        after = fallback_stats()
+        assert after["quarantine_plan_routes"] > 0
+        assert after["host_fallbacks"] == before["host_fallbacks"] == 0
+    finally:
+        sess2.close()
+
+
+# ---------------------------------------------------------------------------
+# schema-v10 fallback records in the event log
+# ---------------------------------------------------------------------------
+def test_eventlog_v10_fallback_records(tmp_path):
+    from spark_rapids_tpu.session import TpuSession
+    from spark_rapids_tpu.tools import tpch
+    from spark_rapids_tpu.tools.eventlog import load_event_log
+    sess = TpuSession(RapidsConf({
+        "spark.rapids.tpu.batchRowsMinBucket": 8,
+        "spark.rapids.tpu.eventLog.dir": str(tmp_path),
+        "spark.rapids.tpu.faults.enabled": "true",
+        "spark.rapids.tpu.faults.seed": "7",
+        "spark.rapids.tpu.faults.spec": "alloc.jit:times=2:action=fatal",
+    }))
+    try:
+        tables = tpch.gen_all(0, tiny=True)
+        dfs = tpch.build_dataframes(sess, tables, num_partitions=2)
+        tpch.q6(dfs).collect(device=True)
+        path = sess._eventlog.path
+    finally:
+        faults.reset_faults()
+        sess.close()
+    app = load_event_log(path)
+    assert app.schema_version == 10
+    (q,) = [q for q in app.queries.values() if q.fallbacks]
+    for rec in q.fallbacks:
+        for key in ("event", "query_id", "ts", "operator", "context",
+                    "failure_class", "reason", "rows", "bytes_down",
+                    "bytes_up", "wall_s"):
+            assert key in rec, key
+        assert rec["event"] == "fallback"
+        assert rec["failure_class"] == "xla_internal"
+    # replay health check surfaces the degradation
+    warnings = app.health_check()
+    assert any("fell back to the host engine" in w for w in warnings)

@@ -358,6 +358,9 @@ _REQUIRED_KEYS = {
     # fault records appear only when injection actually fired and are
     # pinned separately in tests/test_faults.py
     "recovery": {"event", "query_id", "ts", "recovery"},
+    # v10: fallback records appear only when a batch actually re-executed
+    # on the host engine and are pinned separately
+    # (test_eventlog_v10_fallback_records in tests/test_fallback.py)
     "app_end": {"event", "ts"},
 }
 
@@ -411,8 +414,11 @@ def test_eventlog_schema_version_and_required_keys(tmp_path):
     # recovery record (null payload here — no faults, no recovery) plus
     # fault records when injection fires. v9 adds oom_retry records —
     # one per retry scope that engaged the device-OOM escalation ladder
-    # (none in this pressure-free run; pinned in tests/test_oom_retry.py)
-    assert SCHEMA_VERSION == 9
+    # (none in this pressure-free run; pinned in tests/test_oom_retry.py).
+    # v10 adds fallback records — one per batch re-executed through the
+    # host engine after a terminal device failure (none on a healthy
+    # device; pinned in tests/test_fallback.py)
+    assert SCHEMA_VERSION == 10
     assert by_type["app_start"][0]["schema_version"] == SCHEMA_VERSION
     for kind, required in _REQUIRED_KEYS.items():
         for rec in by_type[kind]:
@@ -613,7 +619,7 @@ def test_eventlog_query_stats_cover_all_subsystems(tmp_path):
     from spark_rapids_tpu.tools.eventlog import load_event_log
     path = _run_logged_app(tmp_path)
     app = load_event_log(path)
-    assert app.schema_version == 9
+    assert app.schema_version == 10
     q = app.query(1)
     assert q.stats, "query_end stats delta missing"
     for family in ("compile_cache_", "upload_cache_", "shuffle_",

@@ -89,6 +89,32 @@ def test_is_retryable_oom_classification():
     assert not is_retryable_oom(KeyError("out of memory"))
 
 
+def test_is_retryable_oom_xla_internal_alloc_variants():
+    """XLA allocation failures surfacing under an INTERNAL banner are
+    still OOM — they must walk the ladder (spill/retry/split), NOT the
+    non-retryable host-fallback path. Pins the marker set against the
+    real TPU runtime message shapes."""
+    assert is_retryable_oom(RuntimeError(
+        "INTERNAL: Failed to allocate 4294967296 bytes for buffer"))
+    assert is_retryable_oom(RuntimeError(
+        "INTERNAL: failed to allocate region of 1073741824 bytes"))
+    assert is_retryable_oom(RuntimeError(
+        "Out of memory allocating 123456 bytes (allocated so far: 0)"))
+    # a bare INTERNAL with no allocation marker is a real XLA bug, not
+    # memory pressure — non-retryable (host-fallback territory)
+    assert not is_retryable_oom(RuntimeError(
+        "INTERNAL: during context [hlo verifier]: mismatched shapes"))
+    # exec/fallback.py's classifier must agree: alloc-INTERNAL walks the
+    # ladder; bare INTERNAL classifies for host fallback
+    from spark_rapids_tpu.exec.fallback import classify_failure
+    assert classify_failure(RuntimeError(
+        "INTERNAL: unexpected HLO pass failure")) == "xla_internal"
+    assert classify_failure(RuntimeError(
+        "INVALID_ARGUMENT: buffer donated twice")) == "xla_invalid_argument"
+    assert classify_failure(
+        DeviceOomError("ladder exhausted")) == "oom_exhausted"
+
+
 # ---------------------------------------------------------------------------
 # spill-and-retry rung (with_retry)
 # ---------------------------------------------------------------------------
@@ -483,7 +509,7 @@ def test_eventlog_v9_oom_retry_records(tmp_path, monkeypatch):
                                                  SCHEMA_VERSION,
                                                  EventLogWriter,
                                                  load_event_log)
-    assert SCHEMA_VERSION == 9 and RECORD_TYPES["oom_retry"] == 9
+    assert SCHEMA_VERSION == 10 and RECORD_TYPES["oom_retry"] == 9
     monkeypatch.setattr(retry_mod._Ladder, "spill", _fake_spill(2048))
 
     w = EventLogWriter(str(tmp_path), "app-oom", {})
@@ -499,7 +525,7 @@ def test_eventlog_v9_oom_retry_records(tmp_path, monkeypatch):
     w.close()
 
     app = load_event_log(w.path)
-    assert app.schema_version == 9
+    assert app.schema_version == 10
     (rec,) = app.query(1).oom_retries
     assert rec["event"] == "oom_retry" and rec["query_id"] == 1
     # the full v9 record shape — renaming any of these is a schema break
