@@ -696,6 +696,44 @@ def _trace_conf() -> dict:
     return {"spark.rapids.tpu.trace.enabled": True}
 
 
+def _movement_conf() -> dict:
+    """Enable the data-movement observatory so every timed query's res
+    carries its transfer totals (D2H/H2D bytes, blocking syncs, round
+    trips) and the event log gets real v11 movement_summary payloads.
+    BENCH_MOVEMENT=0 disables."""
+    if os.environ.get("BENCH_MOVEMENT", "1") == "0":
+        return {}
+    return {"spark.rapids.tpu.movement.enabled": True}
+
+
+def _movement_probe() -> dict:
+    """Snapshot of the process-wide movement-ledger totals ({} when the
+    observatory is off) — diff two around a timed run for that run's
+    transfer cost. Never fails the bench."""
+    try:
+        from spark_rapids_tpu.utils.movement import movement_stats
+        return dict(movement_stats())
+    except Exception:
+        return {}
+
+
+def _movement_res(before: dict) -> dict:
+    """Movement-total deltas across one timed run, keyed the way
+    tools/compare.py's bench transfer-byte gate reads them; {} when the
+    observatory is off."""
+    after = _movement_probe()
+    if not after or not before:
+        return {}
+    return {"d2h_bytes": int(after.get("d2h_bytes", 0)
+                             - before.get("d2h_bytes", 0)),
+            "h2d_bytes": int(after.get("h2d_bytes", 0)
+                             - before.get("h2d_bytes", 0)),
+            "blocking_syncs": int(after.get("blocking_count", 0)
+                                  - before.get("blocking_count", 0)),
+            "round_trips": int(after.get("round_trips", 0)
+                               - before.get("round_trips", 0))}
+
+
 def _bench_critical_path():
     """Critical-path breakdown of the NEWEST query span in the live
     tracer ring (the query the caller just timed): category seconds +
@@ -875,6 +913,7 @@ def _worker_smoke(sink: _EventSink):
                        **_history_conf("smoke"),
                        **_health_conf("smoke"),
                        **_memprof_conf(),
+                       **_movement_conf(),
                        **_trace_conf()})
     df = sess.create_dataframe(lineitem, num_partitions=1).cache()
     t = {"lineitem": df}
@@ -915,9 +954,11 @@ def _worker_smoke(sink: _EventSink):
             q.collect(device=True)
             warm = time.perf_counter() - t0
             mb = _mem_probe()
+            mv = _movement_probe()
             t0 = time.perf_counter()
             dev_res = q.collect(device=True)
             dev_t = time.perf_counter() - t0
+            mv_res = _movement_res(mv)
             t0 = time.perf_counter()
             exp = pandas_fn()
             cpu_t = time.perf_counter() - t0
@@ -933,6 +974,7 @@ def _worker_smoke(sink: _EventSink):
                 "compile_s": round(warm, 2),
                 "speedup": cpu_t / max(dev_t, 1e-9),
                 **_mem_res(mb),
+                **mv_res,
                 **({"critical_path": cp,
                     "sync_wait_frac": cp["sync_wait_frac"]}
                    if cp else {})})
@@ -993,6 +1035,7 @@ def _worker_tpch(sink: _EventSink):
         **_history_conf("tpch"),
         **_health_conf("tpch"),
         **_memprof_conf(),
+        **_movement_conf(),
         **_trace_conf(),
     })
     dfs = tpch.build_dataframes(sess, tables, num_partitions=nparts)
@@ -1010,9 +1053,11 @@ def _worker_tpch(sink: _EventSink):
             dev_tbl = q.collect(device=True)
             warm = time.perf_counter() - t0
             mb = _mem_probe()
+            mv = _movement_probe()
             t0 = time.perf_counter()
             dev_tbl = q.collect(device=True)
             dev_t = time.perf_counter() - t0
+            mv_res = _movement_res(mv)
             t0 = time.perf_counter()
             cpu_tbl = q.collect(device=False)
             cpu_t = time.perf_counter() - t0
@@ -1028,6 +1073,7 @@ def _worker_tpch(sink: _EventSink):
                     "compile_s": round(warm, 2),
                     "speedup": cpu_t / max(dev_t, 1e-9),
                     **_mem_res(mb),
+                    **mv_res,
                     **({"critical_path": cp,
                         "sync_wait_frac": cp["sync_wait_frac"]}
                        if cp else {})})
@@ -1113,6 +1159,7 @@ def _worker_restart(sink: _EventSink):
                        **_history_conf("restart"),
                        **_health_conf("restart"),
                        **_memprof_conf(),
+                       **_movement_conf(),
                        **_trace_conf()})
     warmed = warm_pool_wait()
     df = sess.create_dataframe(lineitem, num_partitions=1).cache()
@@ -1124,6 +1171,7 @@ def _worker_restart(sink: _EventSink):
         try:
             before = cache_stats()
             mb = _mem_probe()
+            mv = _movement_probe()
             q = getattr(tpch, name)(t)
             t0 = time.perf_counter()
             q.collect(device=True)
@@ -1132,6 +1180,7 @@ def _worker_restart(sink: _EventSink):
             cp = _bench_critical_path()
             res = {"run_s": round(run_s, 4),
                    **_mem_res(mb),
+                   **_movement_res(mv),
                    "compiles": after["compiles"] - before["compiles"],
                    "persist_hits": after["persist_hits"]
                    - before["persist_hits"],

@@ -36,6 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import dtypes as dt
+from ..utils import movement
 from .host import HostColumn, HostTable
 
 __all__ = ["BucketPolicy", "DeviceColumn", "DeviceTable", "bucket_rows",
@@ -61,6 +62,12 @@ def host_sync_stats() -> Dict[str, int]:
 def _note_host_sync() -> None:
     with _HOST_SYNC_LOCK:
         _HOST_SYNC["d2h_count"] += 1
+
+# movement-observatory site identities (utils/movement.py SITES): the
+# ``path::symbol`` names the ledger aggregates these funnels under and
+# joins onto the srtpu-analyze baseline keys
+_MOVE_TO_HOST = "spark_rapids_tpu/columnar/device.py::DeviceTable.to_host"
+_MOVE_SHRINK = "spark_rapids_tpu/columnar/device.py::shrink_to_fit"
 
 # spark.rapids.tpu.debug.assertions snapshot (session-init chokepoint,
 # like parallel/pipeline.configure_pipeline — columns have no conf at
@@ -413,11 +420,14 @@ class DeviceTable:
     def to_host(self) -> HostTable:
         """Download and compact to exactly num_rows host rows."""
         _note_host_sync()
+        t0 = movement.clock()
         mask = np.asarray(self.row_mask)  # srtpu: sync-ok(result materialization: the deliberate D2H funnel)
         n = int(np.asarray(self.num_rows))  # srtpu: sync-ok(result materialization: the deliberate D2H funnel)
         # row_mask may be non-prefix (post-filter); boolean-index on host
         cols = [_download_column(c, mask, n) for c in self.columns]
-        return HostTable(list(self.names), cols)
+        ht = HostTable(list(self.names), cols)
+        movement.note_d2h(_MOVE_TO_HOST, self.nbytes, t0, table=ht)
+        return ht
 
 
 def _download_column(c: DeviceColumn, mask: np.ndarray, n: int) -> HostColumn:
@@ -885,8 +895,12 @@ def shrink_to_fit(table: DeviceTable, min_bucket: Optional[int] = None,
     min_bucket = resolve_min_bucket(min_bucket)
     if table.capacity <= min_bucket:
         return table  # cannot shrink below one bucket: skip the device sync
-    n = num_rows if num_rows is not None \
-        else int(table.num_rows)  # srtpu: sync-ok(capacity choice needs the host count; callers with one pass it in)
+    if num_rows is not None:
+        n = num_rows
+    else:
+        t0 = movement.clock()
+        n = int(table.num_rows)  # srtpu: sync-ok(capacity choice needs the host count; callers with one pass it in)
+        movement.note_d2h(_MOVE_SHRINK, 4, t0)
     cap = bucket_rows(max(n, 1), min_bucket)
     if cap >= table.capacity:
         return table

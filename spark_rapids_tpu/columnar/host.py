@@ -16,6 +16,7 @@ import numpy as np
 import pyarrow as pa
 
 from . import dtypes as dt
+from ..utils import movement
 
 __all__ = ["HostColumn", "HostTable"]
 
@@ -269,7 +270,10 @@ class HostTable:
         return HostTable(list(self.names), [c.take(indices) for c in self.columns])
 
     def slice(self, start: int, length: int) -> "HostTable":
-        return HostTable(list(self.names), [c.slice(start, length) for c in self.columns])
+        out = HostTable(list(self.names),
+                        [c.slice(start, length) for c in self.columns])
+        movement.tag_lineage(out, self)
+        return out
 
     @staticmethod
     def concat(tables: "Sequence[HostTable]") -> "HostTable":
@@ -286,7 +290,9 @@ class HostTable:
             else:
                 validity = None
             cols.append(HostColumn(first.columns[i].dtype, values, validity))
-        return HostTable(list(first.names), cols)
+        out = HostTable(list(first.names), cols)
+        movement.tag_lineage(out, *tables)
+        return out
 
     def nbytes(self) -> int:
         cached = getattr(self, "_nbytes", None)

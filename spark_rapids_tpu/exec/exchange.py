@@ -31,6 +31,7 @@ from ..columnar.device import (DeviceColumn, DeviceTable, bucket_rows,
 from ..conf import register_conf
 from ..plan.physical import HashPartitioning, PhysicalPlan
 from ..utils import metrics as M
+from ..utils import movement
 from .base import TpuExec
 
 __all__ = ["TpuShuffleExchangeExec", "TpuLocalExchangeExec", "SHUFFLE_MODE",
@@ -46,6 +47,12 @@ SHUFFLE_MODE = register_conf(
     "vs default Spark shuffle, SURVEY §2.7).", "auto",
     checker=lambda v: None if v in ("auto", "host", "ici", "local")
     else f"must be one of auto/host/ici/local, got {v!r}")
+
+# movement-observatory site identities (utils/movement.py SITES)
+_MOVE_CHUNK = ("spark_rapids_tpu/exec/exchange.py"
+               "::TpuShuffleExchangeExec._exchange_chunk")
+_MOVE_DRAIN = ("spark_rapids_tpu/exec/exchange.py"
+               "::TpuLocalExchangeExec._materialize_locked.drain")
 
 EXCHANGE_CHUNK_ROWS = register_conf(
     "spark.rapids.tpu.shuffle.exchangeChunkRows",
@@ -211,7 +218,9 @@ class TpuShuffleExchangeExec(TpuExec):
                 keys = self.partitioning.key_names
                 pid = jax.jit(lambda t: jnp.where(
                     t.row_mask, device_partition_ids(t, keys, n), n))(table)
+                t0 = movement.clock()
                 pid_host = np.asarray(jax.device_get(pid))
+                movement.note_d2h(_MOVE_CHUNK, pid_host.nbytes, t0)
                 src = np.arange(table.capacity) // per_shard
                 active = pid_host < n
                 counts = np.zeros((n, n), dtype=np.int64)
@@ -230,8 +239,10 @@ class TpuShuffleExchangeExec(TpuExec):
                 parts = _split_sharded(exchanged, n)
                 # ONE bulk D2H of n 4-byte scalars replaces a blocking
                 # round trip per shard plus one more for the row total
+                t0 = movement.clock()
                 shard_rows = jax.device_get(  # srtpu: sync-ok(batched count sync, 4B per shard once per chunk)
                     [t.num_rows for t in parts])
+                movement.note_d2h(_MOVE_CHUNK, 4 * len(shard_rows), t0)
                 # v7 skew: per-destination rows come free with the bulk
                 # count sync; bytes are estimated as rows × the chunk's
                 # mean row width (per-shard padded nbytes would read
@@ -322,7 +333,9 @@ class TpuLocalExchangeExec(TpuExec):
             writes) — the catalog and metric registries are thread-safe."""
             out = []
             for b in self.child_device_batches(p):
+                t0 = movement.clock()
                 n = int(b.num_rows)  # srtpu: sync-ok(shared with shrink_to_fit below — one 4B sync per map batch, not two)
+                movement.note_d2h(_MOVE_DRAIN, 4, t0)
                 if not n:
                     continue
                 with self.metrics.timed(M.OP_TIME):

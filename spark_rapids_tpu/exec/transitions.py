@@ -21,6 +21,7 @@ from ..conf import register_conf
 from ..plan.physical import PhysicalPlan
 from ..utils import faults
 from ..utils import metrics as M
+from ..utils import movement
 from ..utils.tracing import get_tracer
 from .base import TpuExec
 
@@ -161,6 +162,11 @@ def _hook_oom() -> None:
     _OOM_HOOKED = True
 
 
+# movement-observatory site identity (utils/movement.py SITES)
+_MOVE_UPLOAD = ("spark_rapids_tpu/exec/transitions.py"
+                "::HostToDeviceExec._upload_retryable")
+
+
 class HostToDeviceExec(TpuExec):
     EXTRA_METRICS = (M.UPLOAD_TIME, M.UPLOAD_BYTES, M.UPLOAD_CACHE_HITS,
                      M.PIPELINE_WAIT)
@@ -190,12 +196,15 @@ class HostToDeviceExec(TpuExec):
         def combine(outs):
             return concat_device_tables(outs, min_bucket)
 
+        t0 = movement.clock()
         with get_tracer().span("h2d_upload", "upload",
                                rows=int(batch.num_rows)):  # srtpu: sync-ok(HostTable.num_rows is a host int on the upload side)
-            return with_retry_split(upload, batch, splitter=split_host_rows,
-                                    combiner=combine, scope="h2d-upload",
-                                    context=f"rows={int(batch.num_rows)}",  # srtpu: sync-ok(HostTable.num_rows is a host int on the upload side)
-                                    fault_point="alloc.upload")
+            dtb = with_retry_split(upload, batch, splitter=split_host_rows,
+                                   combiner=combine, scope="h2d-upload",
+                                   context=f"rows={int(batch.num_rows)}",  # srtpu: sync-ok(HostTable.num_rows is a host int on the upload side)
+                                   fault_point="alloc.upload")
+        movement.note_h2d(_MOVE_UPLOAD, dtb.nbytes, t0, origin=batch)
+        return dtb
 
     def _upload(self, batch: HostTable) -> DeviceTable:
         global _CACHED_BYTES, _CACHE_HITS, _CACHE_INSERTS

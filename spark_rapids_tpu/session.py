@@ -76,6 +76,12 @@ class TpuSession:
         # (utils/faults.py); None/no-op unless faults.enabled
         from .utils.faults import configure_faults
         configure_faults(self.conf)
+        # data-movement observatory (spark.rapids.tpu.movement.*): install
+        # or clear the process-wide host<->device transfer ledger behind the
+        # engine's D2H/H2D funnels (utils/movement.py); None/no-op unless
+        # movement.enabled
+        from .utils.movement import configure_movement
+        configure_movement(self.conf)
         # structured OOM retry (spark.rapids.tpu.oom.*): escalation-ladder
         # bounds + HBM pressure arbitration (memory/retry.py)
         from .memory.retry import configure_oom_retry

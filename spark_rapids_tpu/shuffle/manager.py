@@ -29,7 +29,7 @@ from ..columnar.device import DeviceTable, stable_counting_order
 from ..columnar.host import HostTable
 from ..conf import RapidsConf, SHUFFLE_COMPRESSION_CODEC, register_conf
 from ..memory.stores import SpillCorruptionError
-from ..utils import faults
+from ..utils import faults, movement
 from ..utils.tracing import get_tracer
 from .serializer import deserialize_table, serialize_table
 from .transport import BlockId, ShuffleTransport, load_transport
@@ -70,6 +70,17 @@ SHUFFLE_CACHE_WRITES = register_conf(
     "auto",
     checker=lambda v: None if v in ("auto", "on", "off")
     else f"must be one of auto/on/off, got {v!r}")
+
+
+# movement-ledger funnel names (see utils/movement.py SITES)
+_MOVE_WRITE_TRANSPORT = ("spark_rapids_tpu/shuffle/manager.py"
+                         "::ShuffleManager._write_partition_transport")
+_MOVE_WRITE_CACHED = ("spark_rapids_tpu/shuffle/manager.py"
+                      "::ShuffleManager._write_partition_cached")
+_MOVE_READ_CACHED = ("spark_rapids_tpu/shuffle/manager.py"
+                     "::ShuffleManager._read_partition_cached")
+_MOVE_READ_UPLOAD = ("spark_rapids_tpu/shuffle/manager.py"
+                     "::ShuffleManager.read_partition")
 
 
 def _partition_order(pids, num_parts: int):
@@ -318,7 +329,9 @@ class ShuffleManager:
                 tuple(c.gather(order, keep_all_valid=True)
                       for c in batch.columns),
                 jnp.take(batch.row_mask, order), batch.num_rows, batch.names)
+            t0 = movement.clock()
             sorted_pids = np.asarray(jnp.take(pids, order))  # srtpu: sync-ok(count pass: partition-id vector only, 4B/row, before the bulk download)
+            movement.note_d2h(_MOVE_WRITE_TRANSPORT, sorted_pids.nbytes, t0)
             bounds = np.searchsorted(sorted_pids, np.arange(num_parts + 1))
             part_rows += np.diff(bounds)
             host = sorted_tbl.to_host()  # single download, dense prefix
@@ -379,7 +392,9 @@ class ShuffleManager:
                 jnp.take(batch.row_mask, order), batch.num_rows, batch.names)
             schema_tbl = sorted_tbl
             # count download only (4B/row), like the ICI exchange count pass
+            t0 = movement.clock()
             sorted_pids = np.asarray(jnp.take(pids, order))  # srtpu: sync-ok(count pass: partition-id vector only, 4B/row; slices stay on device)
+            movement.note_d2h(_MOVE_WRITE_CACHED, sorted_pids.nbytes, t0)
             bounds = np.searchsorted(sorted_pids, np.arange(num_parts + 1))
             part_rows += np.diff(bounds)
             for p in range(num_parts):
@@ -464,7 +479,10 @@ class ShuffleManager:
             return
         # host-side coalesce then single upload (GpuShuffleCoalesceExec)
         merged = HostTable.concat(non_empty)
-        yield DeviceTable.from_host(merged, min_bucket)
+        t0 = movement.clock()
+        dtb = DeviceTable.from_host(merged, min_bucket)
+        movement.note_h2d(_MOVE_READ_UPLOAD, dtb.nbytes, t0, origin=merged)
+        yield dtb
 
     def _read_partition_cached(self, shuffle_id: int, num_maps: int,
                                reduce_id: int, min_bucket: int,
@@ -536,8 +554,10 @@ class ShuffleManager:
                     tables.append(t)
             # ONE bulk D2H of all block row counts instead of a blocking
             # 4-byte round trip per map block (ROADMAP item 1)
+            t0 = movement.clock()
             counts = jax.device_get(  # srtpu: sync-ok(batched count sync, 4B per block once per reduce partition)
                 [t.num_rows for t in tables])
+            movement.note_d2h(_MOVE_READ_CACHED, 4 * len(tables), t0)
             for t, cnt in zip(tables, counts):
                 if int(cnt):
                     parts.append(t)

@@ -16,6 +16,19 @@ the call patterns that force the host to block on device state:
                              int32), so ``int()`` blocks on the device;
                              the exchange row-count syncs ROADMAP item 1
                              calls out are exactly this shape
+- ``movement-unledgered``    a direct ``jax.device_get``/``.item()`` in
+                             a HOT package whose enclosing scope never
+                             talks to the movement ledger
+                             (utils/movement.py ``note_d2h``/``note_h2d``
+                             /``clock``) — the crossing happens but the
+                             data-movement observatory can't see it, so
+                             its bytes/wall never reach the v11
+                             movement_summary or the diagnose ranking.
+                             Only fires inside the package (loose
+                             fixture files are exempt); deliberate
+                             unledgered syncs carry the same
+                             ``# srtpu: sync-ok(reason)`` suppression as
+                             the other sync rules.
 
 Only ``hot`` and ``warm`` packages are scanned (exec/, expr/,
 columnar/, shuffle/, memory/ + the per-partition tier); tools and
@@ -31,14 +44,27 @@ to_host, while ``np.array([...])`` builds host constants.
 from __future__ import annotations
 
 import ast
-from typing import List
+from typing import List, Set, Tuple
 
-from . import Finding, Project, ScopedVisitor
+from . import Finding, Project, ScopedVisitor, _HOT_PACKAGES
 
 __all__ = ["check"]
 
 #: severities the sync checker reports on (cold packages sync by design)
 REPORTED_SEVERITIES = ("hot", "warm")
+
+#: utils/movement.py hooks whose presence in a scope marks its syncs as
+#: ledgered (the funnel reports the crossing to the observatory)
+_LEDGER_HOOKS = ("note_d2h", "note_h2d", "clock")
+
+
+def _movement_eligible(ctx) -> bool:
+    """movement-unledgered only fires on HOT packages INSIDE the
+    package tree: loose files rank hot by policy (fixtures rely on it)
+    but carry no ledger obligation."""
+    parts = ctx.relpath.split("/")
+    return (parts[0] == "spark_rapids_tpu" and len(parts) >= 3
+            and parts[1] in _HOT_PACKAGES)
 
 
 class _SyncVisitor(ScopedVisitor):
@@ -46,6 +72,12 @@ class _SyncVisitor(ScopedVisitor):
         super().__init__()
         self.ctx = ctx
         self.findings: List[Finding] = []
+        self.movement_eligible = _movement_eligible(ctx)
+        # movement-unledgered bookkeeping: candidate direct-sync calls
+        # plus every scope that talks to the movement ledger — resolved
+        # after the walk so hook order within a function doesn't matter
+        self.unledgered: List[Tuple[ast.Call, str, str]] = []
+        self.ledgered_symbols: Set[str] = set()
 
     def _hit(self, node: ast.Call, rule: str, what: str) -> None:
         self.findings.append(self.ctx.finding(
@@ -59,12 +91,22 @@ class _SyncVisitor(ScopedVisitor):
         # ((a - b).item()) that qualify() cannot name
         attr = node.func.attr if isinstance(node.func, ast.Attribute) \
             else None
+        if (self.movement_eligible and attr in _LEDGER_HOOKS
+                and self.ctx.qualify(node.func.value)
+                    .endswith("movement")):
+            self.ledgered_symbols.add(self.symbol)
         if attr == "item" and not node.args and not node.keywords:
             self._hit(node, "sync-item", f"{_tail(q) or '.item'}()")
+            if self.movement_eligible:
+                self.unledgered.append(
+                    (node, self.symbol, f"{_tail(q) or '.item'}()"))
         elif q in ("numpy.asarray", "numpy.ndarray.__array__"):
             self._hit(node, "sync-asarray", "np.asarray(...)")
         elif q == "jax.device_get" or q.endswith(".device_get"):
             self._hit(node, "sync-device-get", "jax.device_get(...)")
+            if self.movement_eligible:
+                self.unledgered.append(
+                    (node, self.symbol, "jax.device_get(...)"))
         elif attr == "block_until_ready":
             self._hit(node, "sync-block-until-ready",
                       f"{_tail(q) or '.block_until_ready'}()")
@@ -74,6 +116,22 @@ class _SyncVisitor(ScopedVisitor):
                 self._hit(node, "sync-int-scalar",
                           f"int({_tail(aq)}) on a device scalar")
         self.generic_visit(node)
+
+    def movement_findings(self) -> List[Finding]:
+        """Resolve the candidates against the ledgered scopes: a direct
+        sync is covered when its own scope — or an enclosing/nested one
+        (closures like the exchange drain) — reports to the ledger."""
+        def covered(sym: str) -> bool:
+            return any(s == sym or sym.startswith(s + ".")
+                       or s.startswith(sym + ".")
+                       for s in self.ledgered_symbols)
+        return [self.ctx.finding(
+                    "sync", "movement-unledgered", node, sym,
+                    f"direct {what} bypasses the movement ledger — "
+                    "route through a utils/movement.py note_d2h/"
+                    "note_h2d funnel or suppress with a reason")
+                for node, sym, what in self.unledgered
+                if not covered(sym)]
 
 
 def _tail(q: str, n: int = 2) -> str:
@@ -88,4 +146,5 @@ def check(project: Project) -> List[Finding]:
         v = _SyncVisitor(ctx)
         v.visit(ctx.tree)
         out.extend(v.findings)
+        out.extend(v.movement_findings())
     return out

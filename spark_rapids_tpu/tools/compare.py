@@ -26,7 +26,15 @@ result JSONs:
   peak HBM and spilled bytes diff side by side, and a candidate whose
   peak grew by more than ``MEM_PEAK_FLAG_FRAC`` (10%) flags a
   peak-memory regression — also independent of wall time, since a run
-  can get faster by holding more HBM and pay later in spills/OOM.
+  can get faster by holding more HBM and pay later in spills/OOM;
+- per-query transfer-byte deltas when both runs carry the data-movement
+  ledger's numbers (schema-v11 ``movement_summary`` / bench
+  ``d2h_bytes``+``h2d_bytes``): D2H/H2D bytes and round trips diff side
+  by side, and a candidate whose transfer bytes grew past
+  ``MOVE_BYTES_FLAG_FRAC`` (10%) and ``MOVE_BYTES_FLAG_MIN`` flags a
+  transfer-byte regression — the same wall-orthogonal logic: a plan
+  change that bounces batches through the host can hide inside an
+  unchanged total on a fast PCI link and still sink the scale-up.
 
 CLI: ``python -m spark_rapids_tpu.tools.compare A B [--threshold 0.2]``
 where A/B are event-log JSONL paths or bench summary JSONs.
@@ -40,8 +48,9 @@ from typing import Dict, List, Optional, Tuple
 __all__ = ["OpDelta", "QueryDelta", "CompareReport", "compare_event_logs",
            "compare_bench_results", "compare_apps",
            "critical_path_fractions", "critical_path_delta",
-           "memory_delta", "CP_FRAC_FLAG_PP", "MEM_PEAK_FLAG_FRAC",
-           "MEM_PEAK_FLAG_MIN_BYTES"]
+           "memory_delta", "movement_delta", "CP_FRAC_FLAG_PP",
+           "MEM_PEAK_FLAG_FRAC", "MEM_PEAK_FLAG_MIN_BYTES",
+           "MOVE_BYTES_FLAG_FRAC", "MOVE_BYTES_FLAG_MIN"]
 
 #: category-fraction growth (candidate minus baseline) that flags a
 #: critical-path regression: 5 percentage points
@@ -56,6 +65,43 @@ MEM_PEAK_FLAG_FRAC = 0.10
 #: gate alone makes the history sentinel cry wolf on clean back-to-back
 #: runs — both conditions must hold, like the sentinel's count gates
 MEM_PEAK_FLAG_MIN_BYTES = 1 << 20
+
+
+#: relative transfer-byte growth (candidate over baseline) that flags a
+#: movement regression: 10%, same shape as the peak-HBM gate
+MOVE_BYTES_FLAG_FRAC = 0.10
+
+#: absolute transfer-byte growth floor for the movement gate — shape
+#: buckets round batch capacities, so tiny queries jitter in bytes
+#: run-to-run; both conditions must hold, like the memory gate
+MOVE_BYTES_FLAG_MIN = 1 << 20
+
+
+def movement_delta(mv_a: Optional[Dict], mv_b: Optional[Dict],
+                   flag_frac: float = MOVE_BYTES_FLAG_FRAC,
+                   flag_min_bytes: int = MOVE_BYTES_FLAG_MIN
+                   ) -> Tuple[Dict[str, float], List[str]]:
+    """(deltas B - A, flagged keys) from two per-query movement dicts
+    ({"d2h_bytes", "h2d_bytes", "round_trips"}, from a v11 event log's
+    movement_summary totals or a bench JSON's movement fields). Empty
+    when either run lacks the numbers — ledger off must not flag. A
+    byte direction growing past ``flag_frac`` AND ``flag_min_bytes``
+    flags; new round trips (baseline had none) always flag."""
+    if not mv_a or not mv_b:
+        return {}, []
+    keys = ("d2h_bytes", "h2d_bytes", "round_trips")
+    deltas = {k: float(mv_b.get(k) or 0) - float(mv_a.get(k) or 0)
+              for k in keys}
+    flagged = []
+    for k in ("d2h_bytes", "h2d_bytes"):
+        a = float(mv_a.get(k) or 0)
+        b = float(mv_b.get(k) or 0)
+        if a > 0 and b > a * (1.0 + flag_frac) and b - a >= flag_min_bytes:
+            flagged.append(k)
+    if not float(mv_a.get("round_trips") or 0) \
+            and float(mv_b.get("round_trips") or 0):
+        flagged.append("round_trips")
+    return deltas, flagged
 
 
 def memory_delta(mem_a: Optional[Dict], mem_b: Optional[Dict],
@@ -156,6 +202,15 @@ class QueryDelta:
     mem_flagged: List[str] = dataclasses.field(default_factory=list)
     #: the baseline's absolute memory numbers (for % rendering)
     mem_base: Dict[str, float] = dataclasses.field(default_factory=dict)
+    #: movement deltas (B - A): d2h/h2d bytes + round trips, when both
+    #: runs carried the data-movement ledger's numbers (schema v11)
+    move_deltas: Dict[str, float] = dataclasses.field(default_factory=dict)
+    #: byte directions grown past MOVE_BYTES_FLAG_FRAC (+ floor), or
+    #: "round_trips" when the candidate bounces batches the baseline kept
+    #: device-resident — the transfer-byte regression gate
+    move_flagged: List[str] = dataclasses.field(default_factory=list)
+    #: the baseline's absolute movement numbers (for % rendering)
+    move_base: Dict[str, float] = dataclasses.field(default_factory=dict)
 
     @property
     def delta_s(self) -> float:
@@ -192,6 +247,13 @@ class CompareReport:
         orthogonal to wall time: a query can get faster by holding more
         memory, and the next scale-up pays in spills/OOM."""
         return [q for q in self.queries if q.mem_flagged]
+
+    def movement_regressions(self) -> List[QueryDelta]:
+        """Queries whose host<->device transfer bytes grew past
+        MOVE_BYTES_FLAG_FRAC (or that started round-tripping batches) —
+        orthogonal to wall time like the memory gate: extra transfers
+        hide on a fast link and sink the scale-up."""
+        return [q for q in self.queries if q.move_flagged]
 
     def summary(self) -> str:
         lines = [f"compare: A={self.label_a}  B={self.label_b}  "
@@ -244,6 +306,24 @@ class CompareReport:
                             if q.mem_base.get(k) else f"{k} grew"
                             for k in q.mem_flagged)
                         + f" (gate {MEM_PEAK_FLAG_FRAC:.0%})")
+            if q.move_deltas:
+                parts = []
+                for k in sorted(q.move_deltas):
+                    v = q.move_deltas[k]
+                    base = q.move_base.get(k, 0.0)
+                    pct = f" ({v / base:+.1%})" if base > 0 else ""
+                    unit = "" if k == "round_trips" else "B"
+                    parts.append(f"{k}={v:+.0f}{unit}{pct}")
+                lines.append("  movement deltas (B - A): "
+                             + ", ".join(parts))
+                if q.move_flagged:
+                    lines.append(
+                        "  ** TRANSFER-BYTE REGRESSION: "
+                        + ", ".join(
+                            f"{k} +{q.move_deltas[k] / q.move_base[k]:.1%}"
+                            if q.move_base.get(k) else f"{k} grew"
+                            for k in q.move_flagged)
+                        + f" (gate {MOVE_BYTES_FLAG_FRAC:.0%})")
         if self.only_in_a:
             lines.append(f"queries only in A: {self.only_in_a}")
         if self.only_in_b:
@@ -254,7 +334,9 @@ class CompareReport:
                      f"{len(self.critical_path_regressions())} "
                      "critical-path regression(s), "
                      f"{len(self.memory_regressions())} "
-                     "peak-memory regression(s)")
+                     "peak-memory regression(s), "
+                     f"{len(self.movement_regressions())} "
+                     "transfer-byte regression(s)")
         return "\n".join(lines)
 
 
@@ -280,6 +362,18 @@ def _query_memory(q) -> Optional[Dict]:
     return {"peak_bytes": int(ms.get("peak_bytes") or 0),
             "spill_bytes": sum(int(d.get("spilled_bytes") or 0)
                                for d in per_op.values())}
+
+
+def _query_movement(q) -> Optional[Dict]:
+    """Per-query transfer numbers from a replay's v11 ``movement_summary``
+    totals. None pre-v11 or with the ledger off."""
+    mv = getattr(q, "movement_summary", None)
+    if not mv:
+        return None
+    t = mv.get("totals") or {}
+    return {"d2h_bytes": int(t.get("d2h_bytes") or 0),
+            "h2d_bytes": int(t.get("h2d_bytes") or 0),
+            "round_trips": int(t.get("round_trips") or 0)}
 
 
 def compare_apps(app_a, app_b, threshold: float = 0.2,
@@ -315,12 +409,17 @@ def compare_apps(app_a, app_b, threshold: float = 0.2,
             getattr(qb, "critical_path", None))
         mem_a, mem_b = _query_memory(qa), _query_memory(qb)
         mem_deltas, mem_flagged = memory_delta(mem_a, mem_b)
+        mv_a, mv_b = _query_movement(qa), _query_movement(qb)
+        move_deltas, move_flagged = movement_delta(mv_a, mv_b)
         queries.append(QueryDelta(qid, qa.wall_s, qb.wall_s,
                                   q_regressed, ops, stats_delta,
                                   cp_deltas, cp_flagged,
                                   mem_deltas, mem_flagged,
                                   {k: float(v) for k, v in
-                                   (mem_a or {}).items()}))
+                                   (mem_a or {}).items()},
+                                  move_deltas, move_flagged,
+                                  {k: float(v) for k, v in
+                                   (mv_a or {}).items()}))
     return CompareReport(app_a.app_id or app_a.path,
                          app_b.app_id or app_b.path, queries, threshold,
                          sorted(qids_a - qids_b), sorted(qids_b - qids_a))
@@ -342,6 +441,17 @@ def _bench_memory(entry: Dict) -> Optional[Dict]:
         return None
     return {"peak_bytes": int(entry.get("peak_hbm_bytes") or 0),
             "spill_bytes": int(entry.get("spill_bytes") or 0)}
+
+
+def _bench_movement(entry: Dict) -> Optional[Dict]:
+    """Per-query transfer numbers from a bench JSON entry (bench.py
+    writes d2h_bytes/h2d_bytes/round_trips when the movement ledger is
+    on)."""
+    if "d2h_bytes" not in entry:
+        return None
+    return {"d2h_bytes": int(entry.get("d2h_bytes") or 0),
+            "h2d_bytes": int(entry.get("h2d_bytes") or 0),
+            "round_trips": int(entry.get("round_trips") or 0)}
 
 
 def compare_bench_results(path_a: str, path_b: str, threshold: float = 0.2,
@@ -384,13 +494,18 @@ def compare_bench_results(path_a: str, path_b: str, threshold: float = 0.2,
             mem_a = _bench_memory(qs_a[name])
             mem_b = _bench_memory(qs_b[name])
             mem_deltas, mem_flagged = memory_delta(mem_a, mem_b)
+            mv_a = _bench_movement(qs_a[name])
+            mv_b = _bench_movement(qs_b[name])
+            move_deltas, move_flagged = movement_delta(mv_a, mv_b)
             queries.append(QueryDelta(
                 label, wall_a, wall_b, regressed,
                 [OpDelta(label, name, 0, wall_a, wall_b, 0, 0,
                          regressed=regressed)], deltas,
                 cp_deltas, cp_flagged,
                 mem_deltas, mem_flagged,
-                {k: float(v) for k, v in (mem_a or {}).items()}))
+                {k: float(v) for k, v in (mem_a or {}).items()},
+                move_deltas, move_flagged,
+                {k: float(v) for k, v in (mv_a or {}).items()}))
     return CompareReport(path_a, path_b, queries, threshold,
                          only_a, only_b)
 
@@ -454,7 +569,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     print(report.summary())
     return 1 if report.regressions() \
         or report.critical_path_regressions() \
-        or report.memory_regressions() else 0
+        or report.memory_regressions() \
+        or report.movement_regressions() else 0
 
 
 if __name__ == "__main__":  # pragma: no cover

@@ -60,6 +60,8 @@ class QueryDiagnosis:
     findings: List[Finding]
     #: the query_end critical-path breakdown (schema v5; None pre-v5)
     critical_path: Optional[Dict] = None
+    #: the movement_summary payload (schema v11; None pre-v11 / ledger off)
+    movement: Optional[Dict] = None
 
     def top(self, n: int = 3) -> List[Finding]:
         return self.findings[:n]
@@ -85,6 +87,7 @@ class DiagnoseReport:
                              f"wall — {f.detail}")
                 lines.append(f"     suggest: {f.suggestion}")
         lines.extend(_sync_debt_lines(self._measured_sync()))
+        lines.extend(_movement_lines(self._measured_movement()))
         return "\n".join(lines)
 
     def _measured_sync(self) -> Optional[Dict]:
@@ -107,6 +110,53 @@ class DiagnoseReport:
                 "wall_s": wall_s,
                 "sync_wait_frac": sync_s / wall_s if wall_s > 0 else 0.0}
 
+    def _measured_movement(self) -> List[Dict]:
+        """Measured per-site movement cost aggregated over the report's
+        queries (schema-v11 logs with the ledger on), each site joined
+        onto its srtpu-analyze sync baseline keys (``path::rule::symbol``)
+        — the static<->runtime join: the baseline says WHERE the sticky
+        sync debt lives, these rows say what each site measurably COSTS
+        in wall and bytes. Empty for pre-v11 logs / ledger off."""
+        agg: Dict[str, Dict] = {}
+        for q in self.queries:
+            for s in (q.movement or {}).get("sites") or []:
+                a = agg.setdefault(s.get("site", "?"), {
+                    "site": s.get("site", "?"),
+                    "direction": s.get("direction"),
+                    "count": 0, "bytes": 0, "wall_s": 0.0,
+                    "blocking_count": 0, "round_trips": 0})
+                for k in ("count", "bytes", "blocking_count",
+                          "round_trips"):
+                    a[k] += int(s.get(k) or 0)
+                a["wall_s"] += float(s.get("wall_s") or 0.0)
+        if not agg:
+            return []
+        try:
+            from .analyze import load_baseline
+            base_keys = set(load_baseline().get("counts") or {})
+        except Exception:
+            base_keys = set()
+        from ..utils import movement as _movement
+        rows: List[Dict] = []
+        for site, a in agg.items():
+            info = _movement.site_info(site)
+            keys = list(info.baseline_keys) if info is not None else []
+            in_base = sorted(k for k in keys if k in base_keys)
+            a["baseline_keys"] = keys
+            a["baselined_debt"] = in_base
+            # a funnel whose keys sit in the committed baseline is sticky
+            # sync debt with a measured price tag; one whose keys are all
+            # sync-ok-suppressed is deliberate; keyless sites (uploads)
+            # are deferred transfers, not syncs
+            a["status"] = ("baselined sync debt" if in_base
+                           else "suppressed (deliberate sync)" if keys
+                           else "deferred transfer")
+            a["suggestion"] = info.hint if info is not None else ""
+            a["wall_s"] = round(a["wall_s"], 6)
+            rows.append(a)
+        rows.sort(key=lambda r: (-r["wall_s"], -r["bytes"], r["site"]))
+        return rows
+
     def to_json(self, top: int = 3) -> str:
         return json.dumps({
             "path": self.path,
@@ -114,9 +164,11 @@ class DiagnoseReport:
                 "query_id": q.query_id, "wall_s": q.wall_s,
                 "findings": [f.to_dict() for f in q.top(top)],
                 "critical_path": q.critical_path,
+                "movement": q.movement,
             } for q in self.queries],
             "sync_debt": _sync_debt_info(),
             "measured_sync": self._measured_sync(),
+            "measured_movement": self._measured_movement(),
         }, indent=1)
 
 
@@ -169,6 +221,30 @@ def _sync_debt_lines(measured: Optional[Dict] = None) -> List[str]:
     return lines
 
 
+
+
+def _movement_lines(rows: List[Dict]) -> List[str]:
+    """The "data movement" section: the movement ledger's measured
+    per-site ranking, each row cross-referenced to its srtpu-analyze
+    baseline keys, heaviest wall first."""
+    if not rows:
+        return []
+    lines = ["data movement (measured, movement ledger):"]
+    for r in rows[:8]:
+        lines.append(
+            f"  {r['site']}: {r['wall_s']:.4f}s wall, {r['bytes']} bytes "
+            f"over {r['count']} crossing(s), {r['blocking_count']} "
+            f"blocking [{r['status']}]")
+        if r.get("baselined_debt"):
+            lines.append("    baseline keys: "
+                         + ", ".join(r["baselined_debt"]))
+        if r.get("suggestion"):
+            lines.append(f"    suggest: {r['suggestion']}")
+    trips = sum(r.get("round_trips", 0) for r in rows)
+    if trips:
+        lines.append(f"  {trips} host round trip(s) detected — batches "
+                     "downloaded then re-uploaded within one query")
+    return lines
 
 
 def _node_suggestion(name: str, metrics: Dict) -> str:
@@ -610,6 +686,49 @@ def _fallback_findings(q) -> List[Finding]:
                    "spark.rapids.sql.exec.* ahead of the quarantine")]
 
 
+def _movement_findings(q, wall: float) -> List[Finding]:
+    """Schema-v11 movement_summary records: the data-movement ledger's
+    per-query aggregation. A round trip (batch downloaded then
+    re-uploaded within the query) is the prime async-first target; a
+    single funnel holding a measurable share of wall is the next."""
+    mv = getattr(q, "movement_summary", None) or {}
+    totals = mv.get("totals") or {}
+    findings: List[Finding] = []
+    rt = int(totals.get("round_trips") or 0)
+    if rt:
+        findings.append(Finding(
+            node="(query)", node_id=None, metric="hostRoundTrips",
+            seconds=0.0, fraction=min(1.0, 0.1 * rt),
+            detail=f"{rt} batch(es) made a host round trip (downloaded "
+                   f"then re-uploaded within the query; "
+                   f"{totals.get('d2h_bytes', 0)} bytes D2H / "
+                   f"{totals.get('h2d_bytes', 0)} bytes H2D total)",
+            suggestion="device residency lost mid-plan — keep the "
+                       "intermediate on device (cached shuffle writes, "
+                       "device-resident exchange) instead of bouncing it "
+                       "through host memory"))
+    if wall <= 0:
+        return findings
+    from ..utils import movement as _movement
+    for s in (mv.get("sites") or []):
+        sec = float(s.get("wall_s") or 0.0)
+        frac = sec / wall
+        if frac < _FRACTION_FLOOR:
+            continue
+        info = _movement.site_info(s.get("site", ""))
+        findings.append(Finding(
+            node=s.get("site", "?").split("::")[-1], node_id=None,
+            metric="movementWall", seconds=sec, fraction=frac,
+            detail=f"{s.get('direction')} funnel moved "
+                   f"{s.get('bytes', 0)} bytes over {s.get('count', 0)} "
+                   f"crossing(s) ({s.get('blocking_count', 0)} blocking) "
+                   f"— {sec:.4f}s of wall",
+            suggestion=info.hint if info is not None else
+                       "un-ledgered crossing — route it through a "
+                       "utils/movement.py funnel for attribution"))
+    return findings
+
+
 def _diagnose_query(q, heartbeats=None) -> Optional[QueryDiagnosis]:
     wall = getattr(q, "wall_s", 0.0)
     if wall <= 0 or getattr(q, "error", None):
@@ -771,8 +890,13 @@ def _diagnose_query(q, heartbeats=None) -> Optional[QueryDiagnosis]:
     # re-executed on the host engine after terminal device failures
     findings.extend(_fallback_findings(q))
 
+    # 12. data-movement ledger (schema v11): round-trip batches and the
+    # funnels whose measured crossings hold a share of the query wall
+    findings.extend(_movement_findings(q, wall))
+
     findings.sort(key=lambda f: -f.fraction)
-    return QueryDiagnosis(q.query_id, wall, findings, critical_path=cp)
+    return QueryDiagnosis(q.query_id, wall, findings, critical_path=cp,
+                          movement=getattr(q, "movement_summary", None))
 
 
 def diagnose_app(app, path: str = "") -> DiagnoseReport:

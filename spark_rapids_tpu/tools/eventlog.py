@@ -52,6 +52,14 @@ file per session (``spark.rapids.tpu.eventLog.dir``), one record per event:
   corruptions...); the ``recovery`` payload is null when the query saw
   no recovery activity, so the record set per query is stable whether
   or not faults fired
+- ``movement_summary`` (schema v11): ONE per query (success AND error
+  paths) — the data-movement ledger's per-query aggregation
+  (utils/movement.py): total D2H/H2D bytes and counts, blocking vs.
+  deferred syncs, detected round trips (downloaded then re-uploaded
+  within the query), and the per-(site, operator) breakdown keyed by the
+  same funnel names srtpu-analyze's sync baseline tracks; the
+  ``movement`` payload is null when the ledger is off (the default), so
+  the per-query record set is stable either way
 - ``app_end``
 
 ``load_event_log`` replays a file into ``AppReplay``: per-query summaries,
@@ -76,10 +84,14 @@ __all__ = ["EventLogWriter", "load_event_log", "AppReplay", "QueryReplay",
 # Event-record schema version. Bump ONLY with a migration note in
 # docs/observability.md; tests/test_observability.py pins the current value
 # and the per-record required-key sets so replay/compare tooling can rely
-# on old logs staying loadable. v10: fallback records — one per batch a
+# on old logs staying loadable. v11: movement_summary records — ONE per
+# query, the data-movement ledger's per-query aggregation of every
+# host<->device crossing (utils/movement.py): per-site and per-operator
+# bytes/wall/counts plus round-trip detections; null payload when the
+# ledger is off. (v10 added fallback records — one per batch a
 # device operator re-executed through the host engine after a terminal
 # device failure (exec/fallback.py): operator + failure class + bytes
-# moved each way + host wall time. (v9 added oom_retry records — one per
+# moved each way + host wall time; v9 added oom_retry records — one per
 # retry scope that engaged the device-OOM escalation ladder
 # (memory/retry.py): spill → retry → split-and-retry, with the
 # attempt/split/spilled-bytes counts and the recovered/failed outcome;
@@ -87,7 +99,7 @@ __all__ = ["EventLogWriter", "load_event_log", "AppReplay", "QueryReplay",
 # always-written per-query recovery-ledger delta; v7 added shuffle_skew
 # records; v6 added memory_summary/oom_postmortem records and
 # peak_device_bytes on node records.)
-SCHEMA_VERSION = 10
+SCHEMA_VERSION = 11
 
 # The event-record schema registry: every record type a writer may emit,
 # mapped to the schema version that introduced it. srtpu-analyze's
@@ -111,7 +123,13 @@ RECORD_TYPES: Dict[str, int] = {
     "recovery": 8,
     "oom_retry": 9,
     "fallback": 10,
+    "movement_summary": 11,
 }
+
+#: health_check flags a query whose critical-path ``sync_wait`` fraction
+#: exceeds this (v11) — past it, host<->device synchronization is the
+#: dominant cost and the movement ledger's site ranking is the worklist
+SYNC_WAIT_WARN_FRAC = 0.4
 
 EVENT_LOG_DIR = register_conf(
     "spark.rapids.tpu.eventLog.dir",
@@ -208,6 +226,9 @@ class EventLogWriter:
             self._write_oom_retry_records(qid)
             # v10: host fallbacks completed before the query died anyway
             self._write_fallback_records(qid)
+            # v11: whatever the query moved across the PCI boundary before
+            # failing is exactly where a timeout/OOM forensics starts
+            self._write_movement_records(qid)
             self.write({"event": "query_end", "query_id": qid,
                         "ts": time.time(), "trace_id": tctx.trace_id,
                         "wall_s": time.perf_counter() - t0,
@@ -256,6 +277,7 @@ class EventLogWriter:
         self._write_fault_records(qid, recovery_before)
         self._write_oom_retry_records(qid)
         self._write_fallback_records(qid)
+        self._write_movement_records(qid)
         aqe_events: List[str] = list(getattr(plan, "events", []))
         self.write({
             "event": "query_end", "query_id": qid, "ts": time.time(),
@@ -316,6 +338,16 @@ class EventLogWriter:
         from ..memory.retry import drain_oom_retry_records
         for rr in drain_oom_retry_records():
             self.write({**rr, "event": "oom_retry", "query_id": qid})
+
+    def _write_movement_records(self, qid: int) -> None:
+        """v11: write ONE ``movement_summary`` record — the data-movement
+        ledger's per-query aggregation of every host<->device crossing
+        (utils/movement.py). ``movement`` is null when the ledger is off
+        (the default), so the per-query record set is stable either way."""
+        from ..utils import movement
+        self.write({"event": "movement_summary", "query_id": qid,
+                    "ts": time.time(),
+                    "movement": movement.query_summary(qid)})
 
     def _write_fallback_records(self, qid: int) -> None:
         """v10: drain the degradation layer's completed-fallback records
@@ -411,6 +443,10 @@ class QueryReplay:
         # v10: host-fallback records — one per batch re-executed through
         # the host engine (empty for pre-v10 logs and healthy devices)
         self.fallbacks: List[Dict] = []
+        # v11: data-movement ledger aggregation — per-site/per-operator
+        # host<->device bytes, wall, blocking counts and round trips
+        # (None for pre-v11 logs AND when the ledger is off)
+        self.movement_summary: Optional[Dict] = None
 
     def heartbeats_in_window(self, heartbeats: List[Dict]) -> List[Dict]:
         """App heartbeats whose timestamp falls inside this query's run
@@ -567,6 +603,32 @@ class AppReplay:
                     f"back to the host engine ({', '.join(ops)}; "
                     f"{down} bytes downloaded) — repeated failures "
                     "quarantine the operator to host at plan time")
+            # v11: the query spent most of its wall blocked on host<->
+            # device synchronization — the data-movement observatory's
+            # per-site ranking says which funnel to make non-blocking
+            cp = q.critical_path or {}
+            sync_frac = cp.get("sync_wait_frac", 0.0) or 0.0
+            if sync_frac > SYNC_WAIT_WARN_FRAC:
+                msg = (f"q{q.query_id}: sync wait is {sync_frac:.0%} of "
+                       "wall time — host<->device crossings dominate")
+                mv = q.movement_summary or {}
+                sites = mv.get("sites") or []
+                if sites:
+                    top = sites[0]
+                    msg += (f" (heaviest site: {top.get('site')} — "
+                            f"{top.get('bytes', 0)} bytes, "
+                            f"{top.get('count', 0)} crossings)")
+                else:
+                    msg += (" (enable spark.rapids.tpu.movement.enabled "
+                            "for per-site attribution)")
+                warnings.append(msg)
+            mvt = (q.movement_summary or {}).get("totals") or {}
+            if mvt.get("round_trips"):
+                warnings.append(
+                    f"q{q.query_id}: {mvt['round_trips']} batch(es) made a "
+                    "host round trip (downloaded then re-uploaded within "
+                    "the query) — keep them device-resident or cache the "
+                    "shuffle on device")
         stalled = [h for h in self.heartbeats if h.get("stalled")]
         if stalled:
             age = max(h.get("last_progress_age_s", 0.0) for h in stalled)
@@ -634,6 +696,10 @@ def load_event_log(path: str) -> AppReplay:
                 q = app.queries.setdefault(rec["query_id"],
                                            QueryReplay(rec["query_id"]))
                 q.fallbacks.append(rec)
+            elif ev == "movement_summary":
+                q = app.queries.setdefault(rec["query_id"],
+                                           QueryReplay(rec["query_id"]))
+                q.movement_summary = rec.get("movement")
             elif ev == "query_end":
                 q = app.queries.setdefault(rec["query_id"],
                                            QueryReplay(rec["query_id"]))

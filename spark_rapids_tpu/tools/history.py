@@ -22,15 +22,17 @@ the process exits; this module makes them durable:
   run (default: newest) against the pinned baseline (default: the run
   before it) using the existing compare.py gates — per-operator wall
   time, per-operator peak memory > 10 %, critical-path share > 5 pp —
-  plus two gates of its own over the per-query counter deltas the event
-  log already carries: **sync count** (``host_sync_d2h_count``, the
-  deliberate-D2H funnel counter in columnar/device.py) and **compile
-  count** (``compile_cache_compiles``). Either growing past
-  ``COUNT_FLAG_FRAC`` (10 %, absolute floor ``COUNT_FLAG_MIN``) flags a
-  regression wall-time comparison alone would miss: the run got slower
-  *structurally* (more host round trips, compile-cache churn) even if
-  this machine absorbed it. The verdict is written into the store next
-  to the candidate's event log.
+  plus three gates of its own over the per-query counter deltas the
+  event log already carries: **sync count** (``host_sync_d2h_count``,
+  the deliberate-D2H funnel counter in columnar/device.py), **compile
+  count** (``compile_cache_compiles``), and — when the movement ledger
+  is on — **D2H bytes** (``movement_d2h_bytes``, floor
+  ``BYTES_FLAG_MIN``). Any growing past ``COUNT_FLAG_FRAC`` (10 %,
+  absolute floor ``COUNT_FLAG_MIN`` for counts) flags a regression
+  wall-time comparison alone would miss: the run got slower
+  *structurally* (more host round trips, wider downloads,
+  compile-cache churn) even if this machine absorbed it. The verdict is
+  written into the store next to the candidate's event log.
 
 CLI::
 
@@ -53,7 +55,7 @@ from ..conf import register_conf
 
 __all__ = ["HistoryStore", "run_sentinel", "HISTORY_DIR",
            "COUNT_FLAG_FRAC", "COUNT_FLAG_MIN", "SYNC_COUNT_KEY",
-           "COMPILE_COUNT_KEY"]
+           "COMPILE_COUNT_KEY", "D2H_BYTES_KEY", "BYTES_FLAG_MIN"]
 
 HISTORY_DIR = register_conf(
     "spark.rapids.tpu.history.dir",
@@ -84,6 +86,18 @@ SYNC_COUNT_KEY = "host_sync_d2h_count"
 #: per-query stats key for the compile-count gate (XLA programs compiled
 #: by the query, utils/compile_cache.py)
 COMPILE_COUNT_KEY = "compile_cache_compiles"
+
+#: per-query stats key for the D2H transfer-BYTES gate (movement-ledger
+#: totals via the movement stats source, utils/movement.py). Where the
+#: sync-count gate catches new host round trips, this one catches the
+#: same number of syncs moving structurally more data — a widened
+#: download that wall time on a fast link absorbs. Requires
+#: spark.rapids.tpu.movement.enabled on both runs; absent stats gate
+#: nothing.
+D2H_BYTES_KEY = "movement_d2h_bytes"
+#: absolute growth floor for the byte gate (1 MiB), so per-run row-count
+#: jitter on small queries doesn't flap the sentinel
+BYTES_FLAG_MIN = 1 << 20
 
 _EVENTLOG_NAME = "eventlog.jsonl"
 _APP_JSON = "app.json"
@@ -181,6 +195,7 @@ class HistoryStore:
                 "sync_count": int(q.stats.get(SYNC_COUNT_KEY, 0) or 0),
                 "compile_count": int(
                     q.stats.get(COMPILE_COUNT_KEY, 0) or 0),
+                "d2h_bytes": int(q.stats.get(D2H_BYTES_KEY, 0) or 0),
                 "skew_imbalance": skew,
             }
         if not ts:
@@ -274,11 +289,14 @@ class HistoryStore:
 # ---------------------------------------------------------------------------
 # Regression sentinel
 # ---------------------------------------------------------------------------
-def _count_gate(report, key: str) -> List[Dict]:
+def _count_gate(report, key: str,
+                flag_min: int = COUNT_FLAG_MIN) -> List[Dict]:
     """Queries whose per-query counter ``key`` grew past the sentinel's
     count gate (relative COUNT_FLAG_FRAC with absolute floor
-    COUNT_FLAG_MIN). Works off QueryDelta.metric_deltas, which compare.py
-    already computes as candidate minus baseline."""
+    ``flag_min`` — COUNT_FLAG_MIN for sync/compile counts,
+    BYTES_FLAG_MIN for the transfer-byte gate). Works off
+    QueryDelta.metric_deltas, which compare.py already computes as
+    candidate minus baseline."""
     flagged = []
     for q in report.queries:
         delta = q.metric_deltas.get(key)
@@ -289,7 +307,7 @@ def _count_gate(report, key: str) -> List[Dict]:
         # stats the report retained; fall back to treating the delta as
         # 100% growth when the baseline count is unknown/zero
         base = getattr(q, "_stats_base", {}).get(key, 0)
-        grew_enough = delta >= COUNT_FLAG_MIN and (
+        grew_enough = delta >= flag_min and (
             base <= 0 or delta > base * COUNT_FLAG_FRAC)
         if grew_enough:
             flagged.append({"query_id": q.query_id, "key": key,
@@ -354,6 +372,12 @@ def run_sentinel(store: HistoryStore,
                   if f["query_id"] not in chaos_ok]
     compile_flags = [f for f in _count_gate(report, COMPILE_COUNT_KEY)
                      if f["query_id"] not in chaos_ok]
+    # v11: movement-ledger D2H byte growth — same relative threshold as
+    # the count gates, but floored at BYTES_FLAG_MIN so only material
+    # transfer growth flags
+    d2h_flags = [f for f in _count_gate(report, D2H_BYTES_KEY,
+                                        BYTES_FLAG_MIN)
+                 if f["query_id"] not in chaos_ok]
     wall_q = [q.query_id for q in report.regressed_queries()
               if q.query_id not in chaos_ok]
     wall_ops = [(op.query_id, op.name) for op in report.regressions()
@@ -373,6 +397,8 @@ def run_sentinel(store: HistoryStore,
         flags.append("sync_count")
     if compile_flags:
         flags.append("compile_count")
+    if d2h_flags:
+        flags.append("d2h_bytes")
     verdict = {
         "ok": not flags,
         "status": "regressed" if flags else "clean",
@@ -388,6 +414,7 @@ def run_sentinel(store: HistoryStore,
         "memory_regressed_queries": mem_q,
         "sync_count_regressions": sync_flags,
         "compile_count_regressions": compile_flags,
+        "d2h_bytes_regressions": d2h_flags,
         "chaos_recovered_queries": sorted(chaos_ok),
         "summary": report.summary(),
     }

@@ -16,8 +16,10 @@ down. Endpoints:
   plan tree with per-node SELF-time %% (tools/profiler.py
   ``compute_self_times``, the one attribution rule EXPLAIN ANALYZE and
   diagnose share), operator metric tables, critical-path category
-  breakdown, memory flight-recorder summary, kernel/compile table, and
-  the v7 shuffle-skew records.
+  breakdown, memory flight-recorder summary, the v11 data-movement
+  table (per-site D2H/H2D bytes, wall, blocking syncs, round trips
+  from the movement ledger), kernel/compile table, and the v7
+  shuffle-skew records.
 - ``GET /diff?a=<app>&b=<app>`` — two-run diff rendered from
   ``tools/compare.py`` (A = baseline, B = candidate).
 - ``GET /healthz`` — liveness JSON (store root, runs indexed).
@@ -300,6 +302,29 @@ class _HistoryHandler(BaseHTTPRequestHandler):
                      "<th>operator</th><th>signature</th><th>compiles</th>"
                      "<th>hits</th><th>misses</th><th>compile s</th></tr>"
                      + krow + "</table>")
+        # data movement (v11 movement ledger)
+        mv_tbl = ""
+        mv = getattr(q, "movement_summary", None)
+        if mv:
+            tot = mv.get("totals") or {}
+            srow = "".join(
+                f"<tr><td>{html.escape(s.get('site', ''))}</td>"
+                f"<td>{html.escape(s.get('direction', ''))}</td>"
+                f"<td>{s.get('count', 0)}</td>"
+                f"<td>{_fmt_bytes(s.get('bytes', 0))}</td>"
+                f"<td>{s.get('wall_s', 0.0):.4f}</td>"
+                f"<td>{s.get('blocking_count', 0)}</td>"
+                f"<td>{s.get('round_trips', 0)}</td></tr>"
+                for s in mv.get("sites") or [])
+            mv_tbl = (
+                f"<h3>data movement (v11: D2H "
+                f"{_fmt_bytes(tot.get('d2h_bytes', 0))}, H2D "
+                f"{_fmt_bytes(tot.get('h2d_bytes', 0))}, "
+                f"{tot.get('blocking_count', 0)} blocking sync(s), "
+                f"{tot.get('round_trips', 0)} round trip(s))</h3>"
+                "<table><tr><th>site</th><th>dir</th><th>count</th>"
+                "<th>bytes</th><th>wall s</th><th>blocking</th>"
+                "<th>round trips</th></tr>" + srow + "</table>")
         # shuffle skew (v7)
         skew_tbl = ""
         if q.shuffle_skew:
@@ -321,8 +346,8 @@ class _HistoryHandler(BaseHTTPRequestHandler):
                if q.error else "")
         body = (f"<p><a href='/app/{aid}'>← run {aid}</a></p>" + err
                 + f"<p>wall {q.wall_s:.4f}s</p>"
-                + plan_tbl + cp_tbl + mem_tbl + skew_tbl + k_tbl
-                + metrics_tbl)
+                + plan_tbl + cp_tbl + mem_tbl + mv_tbl + skew_tbl
+                + k_tbl + metrics_tbl)
         return _page(f"{app_id} — query {qid}", body)
 
     def _render_diff(self, a: str, b: str) -> str:

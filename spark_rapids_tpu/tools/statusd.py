@@ -13,8 +13,12 @@ fleet needs a liveness probe per process):
   ``prometheus_text()`` returns programmatically. Includes the memory
   flight recorder's per-operator HBM gauges
   (``spark_rapids_tpu_memprof_operator_live_bytes_<Op>``, plus
-  peak/leak/postmortem counters from utils/memprof.py), which the
-  federation endpoints re-export per process.
+  peak/leak/postmortem counters from utils/memprof.py) and — when
+  ``spark.rapids.tpu.movement.enabled`` is on — the movement ledger's
+  transfer gauges (``spark_rapids_tpu_movement_d2h_bytes``,
+  ``..._h2d_bytes``, ``..._blocking_count``, ``..._round_trips``,
+  ``..._wall_s`` from utils/movement.py), which the federation
+  endpoints re-export per process.
 - ``GET /status`` — the full live JSON snapshot
   (``HealthMonitor.snapshot()``): semaphore holders/waiters, pipeline
   queue depths + in-flight task ages, HBM watermarks, the memory

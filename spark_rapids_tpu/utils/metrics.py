@@ -455,6 +455,11 @@ def _deadline_source() -> Dict:
     return deadline_stats()
 
 
+def _movement_source() -> Dict:
+    from .movement import movement_stats
+    return movement_stats()
+
+
 _DEFAULT_SOURCES = {
     "compile_cache": _compile_cache_source,
     "catalog": _catalog_source,
@@ -469,6 +474,7 @@ _DEFAULT_SOURCES = {
     "retry": _retry_source,
     "fallback": _fallback_source,
     "deadline": _deadline_source,
+    "movement": _movement_source,
 }
 
 _GLOBAL_STATS: Optional[StatsRegistry] = None

@@ -1,0 +1,468 @@
+"""Data-movement observatory: the runtime sync/transfer ledger.
+
+ROADMAP item 1 (async-first execution) is blocked on a measurement gap:
+the srtpu-analyze ``sync`` checker knows *where* the hot static sync
+sites live and the critical-path walker (tools/trace.py) knows *how
+much* ``sync_wait`` costs per query, but nothing joins the two. Theseus
+(PAPERS.md) is built around minimizing data movement in distributed
+query engines; this module is the instrument that turns its principle
+into a ranked worklist: every host<->device crossing at the engine's
+existing funnels (``DeviceTable.to_host``, the H2D upload exec, the
+exchange/manager count passes) reports into a process-wide
+**MovementLedger** recording call-site, operator, query, bytes, wall
+and blocking-vs-deferred into a bounded ring plus per-(site, operator)
+aggregation.
+
+Cost model mirrors utils/faults.py: a module-level ``_LEDGER`` that is
+``None`` when disabled, so every funnel pays exactly one global load +
+is-None check when the observatory is off (the zero-overhead pin that
+tests/test_movement.py asserts on). Byte counts are passed as callables
+so nothing is computed on the disabled path.
+
+On top of the raw ledger:
+
+- **device-residency tracking**: ``to_host`` tags the downloaded
+  ``HostTable`` with its (query, site) lineage; host-side derivations
+  (``HostTable.slice``/``concat``) propagate the tag; the H2D funnels
+  check it, so a batch that is downloaded and re-uploaded within one
+  query is flagged as a **round trip** — the prime async-first target.
+- **static<->runtime join**: every instrumented site is named
+  ``path::symbol`` and maps onto the srtpu-analyze baseline keys
+  (``path::rule::symbol``) via ``SITES``, so tools/diagnose.py can rank
+  the sticky sync debt by *measured* wall/bytes and attach a
+  make-nonblocking suggestion.
+- **event-log surfacing**: tools/eventlog.py writes ONE schema-v11
+  ``movement_summary`` record per query (null payload when the
+  observatory is off, matching the memory_summary/recovery convention)
+  from ``query_summary()``; ``movement_stats()`` feeds the stats
+  registry so per-query deltas, the history sentinel's D2H-bytes gate
+  and the statusd ``/metrics`` movement gauges come for free.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+from ..conf import register_conf
+
+__all__ = [
+    "MovementLedger",
+    "MovementSite",
+    "SITES",
+    "configure_movement",
+    "reset_movement",
+    "active",
+    "clock",
+    "note_d2h",
+    "note_h2d",
+    "tag_lineage",
+    "drain_ring",
+    "query_summary",
+    "movement_stats",
+    "site_info",
+]
+
+MOVEMENT_ENABLED = register_conf(
+    "spark.rapids.tpu.movement.enabled",
+    "Enable the data-movement observatory (utils/movement.py): every "
+    "host<->device crossing at the engine's sync/transfer funnels is "
+    "recorded with call-site, operator, bytes and wall time, batches "
+    "are lineage-tagged so host<->device round trips are flagged, and "
+    "each query's event log carries a movement_summary record. When "
+    "false (the default) every funnel compiles down to a single "
+    "module-constant check and nothing is recorded.",
+    False)
+
+MOVEMENT_RING_SIZE = register_conf(
+    "spark.rapids.tpu.movement.ringSize",
+    "Bounded capacity of the movement ledger's raw-event ring. Oldest "
+    "events drop first; the per-(site, operator) aggregation is exact "
+    "regardless of ring occupancy.",
+    4096,
+    checker=lambda v: None if int(v) > 0 else "must be positive")
+
+
+class MovementSite:
+    """Static description of one instrumented funnel: its direction,
+    the srtpu-analyze baseline keys (``path::rule::symbol``) its
+    measured cost attributes to, and the make-nonblocking suggestion
+    tools/diagnose.py renders next to the measured ranking."""
+
+    __slots__ = ("direction", "baseline_keys", "hint")
+
+    def __init__(self, direction: str, baseline_keys: Tuple[str, ...],
+                 hint: str):
+        self.direction = direction
+        self.baseline_keys = baseline_keys
+        self.hint = hint
+
+
+#: every instrumented funnel, keyed ``path::symbol`` — the identity the
+#: ledger aggregates under and the join point onto the static baseline.
+SITES: Dict[str, MovementSite] = {
+    "spark_rapids_tpu/columnar/device.py::DeviceTable.to_host":
+        MovementSite("d2h", (
+            "spark_rapids_tpu/columnar/device.py::sync-asarray"
+            "::DeviceTable.to_host",
+            "spark_rapids_tpu/columnar/device.py::sync-asarray"
+            "::_download_column",
+        ), "the deliberate bulk-download funnel — keep results "
+           "device-resident longer or defer materialization so compute "
+           "overlaps the download (ROADMAP item 1)"),
+    "spark_rapids_tpu/columnar/device.py::shrink_to_fit":
+        MovementSite("d2h", (
+            "spark_rapids_tpu/columnar/device.py::sync-int-scalar"
+            "::shrink_to_fit",
+        ), "4-byte row-count sync per compaction — thread num_rows in "
+           "from a caller that already synced it"),
+    "spark_rapids_tpu/exec/exchange.py"
+    "::TpuShuffleExchangeExec._exchange_chunk":
+        MovementSite("d2h", (
+            "spark_rapids_tpu/exec/exchange.py::sync-asarray"
+            "::TpuShuffleExchangeExec._exchange_chunk",
+            "spark_rapids_tpu/exec/exchange.py::sync-device-get"
+            "::TpuShuffleExchangeExec._exchange_chunk",
+        ), "count pass + bulk shard-rows sync per exchanged chunk — "
+           "double-buffer so chunk N's count pass overlaps chunk N-1's "
+           "all-to-all"),
+    "spark_rapids_tpu/exec/exchange.py"
+    "::TpuLocalExchangeExec._materialize_locked.drain":
+        MovementSite("d2h", (
+            "spark_rapids_tpu/exec/exchange.py::sync-int-scalar"
+            "::TpuLocalExchangeExec._materialize_locked.drain",
+        ), "per-batch 4-byte row-count sync on the map drain — batch "
+           "the counts into one bulk device_get per partition"),
+    "spark_rapids_tpu/shuffle/manager.py"
+    "::ShuffleManager._write_partition_transport":
+        MovementSite("d2h", (
+            "spark_rapids_tpu/shuffle/manager.py::sync-asarray"
+            "::ShuffleManager._write_partition_transport",
+        ), "partition-id count pass (4B/row) before the bulk download "
+           "— overlap it with the previous batch's serialize"),
+    "spark_rapids_tpu/shuffle/manager.py"
+    "::ShuffleManager._write_partition_cached":
+        MovementSite("d2h", (
+            "spark_rapids_tpu/shuffle/manager.py::sync-asarray"
+            "::ShuffleManager._write_partition_cached",
+        ), "partition-id count pass (4B/row); slices stay on device — "
+           "overlap it with the previous batch's gather"),
+    "spark_rapids_tpu/shuffle/manager.py"
+    "::ShuffleManager._read_partition_cached":
+        MovementSite("d2h", (
+            "spark_rapids_tpu/shuffle/manager.py::sync-device-get"
+            "::ShuffleManager._read_partition_cached",
+        ), "batched block-count sync (4B per block) once per reduce "
+           "partition — already bulk; growth tracks partition count"),
+    "spark_rapids_tpu/exec/transitions.py"
+    "::HostToDeviceExec._upload_retryable":
+        MovementSite("h2d", (),
+                     "uploads are async-dispatched (deferred); growth "
+                     "here means device residency was lost upstream — "
+                     "check the round-trip count first"),
+    "spark_rapids_tpu/shuffle/manager.py::ShuffleManager.read_partition":
+        MovementSite("h2d", (),
+                     "reduce-side re-upload of host-staged shuffle "
+                     "blocks — the cached device tier "
+                     "(spark.rapids.tpu.shuffle.cacheWrites) skips the "
+                     "whole round trip"),
+}
+
+
+def site_info(site: str) -> Optional[MovementSite]:
+    return SITES.get(site)
+
+
+#: keys of the per-query / process-wide totals dict — one place so the
+#: event-log record, the stats source and the tests agree on the shape
+TOTAL_KEYS = ("d2h_bytes", "h2d_bytes", "d2h_count", "h2d_count",
+              "blocking_count", "deferred_count", "round_trips")
+
+
+def _zero_totals() -> Dict[str, Any]:
+    t: Dict[str, Any] = {k: 0 for k in TOTAL_KEYS}
+    t["wall_s"] = 0.0
+    return t
+
+
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _canonical(filename: str) -> str:
+    """Repo-relative posix path of a frame's file (mirrors
+    srtpu-analyze's canonical_relpath so call sites and baseline keys
+    share a vocabulary)."""
+    parts = filename.replace(os.sep, "/").split("/")
+    if "spark_rapids_tpu" in parts:
+        idx = len(parts) - 1 - parts[::-1].index("spark_rapids_tpu")
+        return "/".join(parts[idx:])
+    return "/".join(parts)
+
+
+class MovementLedger:
+    """Process-wide ledger of host<->device crossings.
+
+    Raw events land in a bounded ring (forensics: the exact sequence of
+    crossings with call sites); exact aggregation is kept per
+    (site, operator) process-wide and per query for the event-log
+    ``movement_summary`` record. All state is lock-guarded — funnels
+    fire from pipeline workers, shuffle writers and the query thread
+    concurrently."""
+
+    def __init__(self, ring_size: int = 4096):
+        self.ring_size = int(ring_size)
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=self.ring_size)
+        # (site, operator) -> {direction, count, bytes, wall_s,
+        #                      blocking_count, round_trips}
+        self._agg: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        self._totals = _zero_totals()
+        # query_id -> {"totals", "sites", "operators"} accumulators
+        self._queries: Dict[Any, Dict[str, Any]] = {}
+
+    # -- recording --------------------------------------------------------
+    def note(self, direction: str, site: str,
+             nbytes: Union[int, Callable[[], int]], t0: float,
+             blocking: bool, table: Any = None, origin: Any = None,
+             plan_sig: Optional[str] = None) -> None:
+        """Record one crossing. ``table`` (D2H) is the downloaded host
+        batch to lineage-tag; ``origin`` (H2D) is the uploaded host
+        batch whose lineage tag marks a round trip. ``nbytes`` may be a
+        callable so funnels never compute sizes on the disabled path."""
+        wall = (time.perf_counter() - t0) if t0 else 0.0
+        n = int(nbytes() if callable(nbytes) else nbytes)
+        from . import node_context
+        ctx = node_context.current()
+        operator = ctx.name if ctx is not None else None
+        query_id = ctx.query_id if ctx is not None else None
+        call_site = self._call_site(site)
+        round_trip = False
+        bounced_from = None
+        if direction == "d2h" and table is not None:
+            try:
+                table._tpu_lineage = (query_id, site)
+            except (AttributeError, TypeError):
+                pass
+        elif direction == "h2d" and origin is not None:
+            tag = getattr(origin, "_tpu_lineage", None)
+            if tag is not None and tag[0] == query_id:
+                round_trip = True
+                bounced_from = tag[1]
+        entry = {
+            "ts": time.time(),
+            "direction": direction,
+            "site": site,
+            "call_site": call_site,
+            "operator": operator,
+            "query_id": query_id,
+            "plan_sig": plan_sig,
+            "bytes": n,
+            "wall_s": wall,
+            "blocking": blocking,
+            "round_trip": round_trip,
+        }
+        if bounced_from is not None:
+            entry["bounced_from"] = bounced_from
+        with self._lock:
+            self._ring.append(entry)
+            self._fold(self._agg, self._totals, entry)
+            q = self._queries.get(query_id)
+            if q is None:
+                q = self._queries[query_id] = {
+                    "totals": _zero_totals(), "sites": {},
+                    "operators": {}}
+            self._fold(q["sites"], q["totals"], entry,
+                       key=site, extra=q["operators"])
+
+    @staticmethod
+    def _fold(agg: Dict, totals: Dict[str, Any], entry: Dict,
+              key: Any = None, extra: Optional[Dict] = None) -> None:
+        direction, n, wall = (entry["direction"], entry["bytes"],
+                              entry["wall_s"])
+        totals[f"{direction}_bytes"] += n
+        totals[f"{direction}_count"] += 1
+        totals["blocking_count" if entry["blocking"]
+               else "deferred_count"] += 1
+        totals["round_trips"] += 1 if entry["round_trip"] else 0
+        totals["wall_s"] += wall
+        buckets = [(agg, key if key is not None
+                    else (entry["site"], entry["operator"] or "<none>"))]
+        if extra is not None:
+            buckets.append((extra, entry["operator"] or "<none>"))
+        for table, k in buckets:
+            a = table.get(k)
+            if a is None:
+                a = table[k] = {"direction": direction, "count": 0,
+                                "bytes": 0, "wall_s": 0.0,
+                                "blocking_count": 0, "round_trips": 0}
+            a["count"] += 1
+            a["bytes"] += n
+            a["wall_s"] += wall
+            if entry["blocking"]:
+                a["blocking_count"] += 1
+            if entry["round_trip"]:
+                a["round_trips"] += 1
+
+    @staticmethod
+    def _call_site(site: str) -> Optional[str]:
+        """file:line of the first frame OUTSIDE this module and the
+        funnel's own file — who asked for the crossing, not where the
+        funnel lives (the site already says that)."""
+        site_file = site.split("::", 1)[0].rsplit("/", 1)[-1]
+        try:
+            f = sys._getframe(3)
+        except ValueError:  # pragma: no cover — shallow stack
+            return None
+        while f is not None:
+            fn = f.f_code.co_filename
+            base = os.path.basename(fn)
+            if base != site_file and not fn.startswith(
+                    os.path.join(_PKG_ROOT, "utils")):
+                return f"{_canonical(fn)}:{f.f_lineno}"
+            f = f.f_back
+        return None
+
+    # -- reads ------------------------------------------------------------
+    def drain_ring(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            out = list(self._ring)
+            self._ring.clear()
+            return out
+
+    def totals(self) -> Dict[str, Any]:
+        with self._lock:
+            return dict(self._totals)
+
+    def site_aggregate(self) -> List[Dict[str, Any]]:
+        """Process-wide per-(site, operator) rows, heaviest wall first."""
+        with self._lock:
+            rows = [{"site": site, "operator": op, **dict(a)}
+                    for (site, op), a in self._agg.items()]
+        rows.sort(key=lambda r: (-r["wall_s"], -r["bytes"], r["site"]))
+        return rows
+
+    def query_summary(self, query_id: Any,
+                      drain: bool = True) -> Dict[str, Any]:
+        """The per-query ``movement_summary`` payload: totals plus
+        per-site and per-operator breakdowns (wall-heavy first). A query
+        that moved nothing gets a zero summary — the event-log record
+        set stays stable whether or not data moved."""
+        with self._lock:
+            q = (self._queries.pop(query_id, None) if drain
+                 else self._queries.get(query_id))
+        if q is None:
+            return {"totals": _zero_totals(), "sites": [],
+                    "operators": []}
+        sites = [{"site": site, **dict(a)}
+                 for site, a in q["sites"].items()]
+        sites.sort(key=lambda r: (-r["wall_s"], -r["bytes"], r["site"]))
+        ops = [{"operator": op, **dict(a)}
+               for op, a in q["operators"].items()]
+        ops.sort(key=lambda r: (-r["wall_s"], -r["bytes"], r["operator"]))
+        return {"totals": dict(q["totals"]), "sites": sites,
+                "operators": ops}
+
+
+# ---------------------------------------------------------------------------
+# module-level ledger: None when disabled (the zero-overhead pin)
+# ---------------------------------------------------------------------------
+_LEDGER: Optional[MovementLedger] = None
+
+
+def clock() -> float:
+    """Funnel-side timestamp: perf_counter when the observatory is on,
+    0.0 (= "don't time") when off. One global load + is-None check on
+    the disabled path."""
+    if _LEDGER is None:
+        return 0.0
+    return time.perf_counter()
+
+
+def note_d2h(site: str, nbytes: Union[int, Callable[[], int]],
+             t0: float = 0.0, blocking: bool = True,
+             table: Any = None, plan_sig: Optional[str] = None) -> None:
+    """Hot-path D2H funnel hook. Disabled: one global load + is-None
+    check (the zero-overhead pin)."""
+    if _LEDGER is None:
+        return
+    _LEDGER.note("d2h", site, nbytes, t0, blocking, table=table,
+                 plan_sig=plan_sig)
+
+
+def note_h2d(site: str, nbytes: Union[int, Callable[[], int]],
+             t0: float = 0.0, blocking: bool = False,
+             origin: Any = None, plan_sig: Optional[str] = None) -> None:
+    """Hot-path H2D funnel hook. Disabled: one global load + is-None
+    check (the zero-overhead pin)."""
+    if _LEDGER is None:
+        return
+    _LEDGER.note("h2d", site, nbytes, t0, blocking, origin=origin,
+                 plan_sig=plan_sig)
+
+
+def tag_lineage(dst: Any, *srcs: Any) -> None:
+    """Propagate device-residency lineage onto a host batch derived from
+    ``srcs`` (HostTable.slice/concat call this) so a downloaded batch
+    that is re-uploaded after host-side reshaping still flags as a
+    round trip. Disabled: one global load + is-None check."""
+    if _LEDGER is None:
+        return
+    for s in srcs:
+        tag = getattr(s, "_tpu_lineage", None)
+        if tag is not None:
+            try:
+                dst._tpu_lineage = tag
+            except (AttributeError, TypeError):
+                pass
+            return
+
+
+def configure_movement(conf) -> Optional[MovementLedger]:
+    """Install (or clear) the process-wide ledger from a RapidsConf
+    (TpuSession.__init__ chokepoint — the most recent session wins)."""
+    global _LEDGER
+    if not conf.get(MOVEMENT_ENABLED):
+        _LEDGER = None
+        return None
+    _LEDGER = MovementLedger(int(conf.get(MOVEMENT_RING_SIZE)))
+    return _LEDGER
+
+
+def reset_movement() -> None:
+    global _LEDGER
+    _LEDGER = None
+
+
+def active() -> Optional[MovementLedger]:
+    return _LEDGER
+
+
+def drain_ring() -> List[Dict[str, Any]]:
+    led = _LEDGER
+    return led.drain_ring() if led is not None else []
+
+
+def query_summary(query_id: Any,
+                  drain: bool = True) -> Optional[Dict[str, Any]]:
+    """Per-query movement summary for the event log; None when the
+    observatory is off (the v11 record's null-payload convention)."""
+    led = _LEDGER
+    if led is None:
+        return None
+    return led.query_summary(query_id, drain=drain)
+
+
+def movement_stats() -> Dict[str, Any]:
+    """Stats-registry source: process-wide movement totals, flattened
+    as ``movement_*`` gauges on /metrics and per-query event-log stats
+    deltas (the history sentinel's D2H-bytes gate reads
+    ``movement_d2h_bytes``). Empty when the observatory is off."""
+    led = _LEDGER
+    if led is None:
+        return {}
+    t = led.totals()
+    t["wall_s"] = round(t["wall_s"], 6)
+    return t

@@ -82,3 +82,16 @@ def _drain_degradation_state_per_module():
     reset_fallback_state()
     configure_fallback(RapidsConf({}))
     reset_deadline()
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _drain_movement_state_per_module():
+    """The movement ledger is process-wide and installed by whichever
+    session configured it last (utils/movement.py). A module that turned
+    the observatory on would otherwise keep every later module's funnels
+    recording — and its per-query accumulators would leak into the next
+    module's movement_summary records. Clear the ledger between modules
+    so the default (off, zero-overhead) state is restored."""
+    yield
+    from spark_rapids_tpu.utils.movement import reset_movement
+    reset_movement()

@@ -67,6 +67,63 @@ def test_sync_checker_rules(tmp_path):
     assert all(f.symbol == "hot_path" for f in report.findings)
 
 
+def test_movement_unledgered_rule(tmp_path):
+    """Direct device_get/.item() in a HOT package file that never talks
+    to the movement ledger flags movement-unledgered; the same sync in a
+    scope that notes the crossing (a funnel) is covered, and loose
+    fixture files (hot by policy, no ledger obligation) never flag."""
+    hot = tmp_path / "spark_rapids_tpu" / "exec"
+    hot.mkdir(parents=True)
+    (hot / "bypass.py").write_text(textwrap.dedent("""\
+        import jax
+        from ..utils import movement
+
+        _SITE = "spark_rapids_tpu/exec/bypass.py::funnel"
+
+        def funnel(col):
+            t0 = movement.clock()
+            host = jax.device_get(col)
+            movement.note_d2h(_SITE, host.nbytes, t0)
+            return host
+
+        def bypass(col):
+            return jax.device_get(col)
+
+        def bypass_item(col):
+            return col.sum().item()
+        """))
+    report = analyze_paths([str(tmp_path)], checks=["sync"])
+    mv = [f for f in report.findings if f.rule == "movement-unledgered"]
+    assert sorted(f.symbol for f in mv) == ["bypass", "bypass_item"]
+    # the ledgered funnel still carries its plain sync finding, but no
+    # movement-unledgered one
+    assert not any(f.symbol == "funnel" for f in mv)
+    # loose file outside the package tree: plain sync rules only
+    loose = _write(tmp_path, "loose.py", """\
+        import jax
+
+        def f(col):
+            return jax.device_get(col)
+        """)
+    loose_report = analyze_paths([loose], checks=["sync"])
+    assert _rules(loose_report) == ["sync-device-get"]
+
+
+def test_movement_unledgered_suppression(tmp_path):
+    """sync-ok covers movement-unledgered too — one annotation per
+    deliberate sync site, not one per rule."""
+    hot = tmp_path / "spark_rapids_tpu" / "columnar"
+    hot.mkdir(parents=True)
+    (hot / "ok.py").write_text(
+        "import jax\n\ndef f(col):\n"
+        "    return jax.device_get(col)"
+        "  # srtpu: sync-ok(cold scalar, once per query)\n")
+    report = analyze_paths([str(tmp_path)], checks=["sync"])
+    assert report.count("sync") == 0
+    assert {f.rule for f in report.suppressed} \
+        == {"sync-device-get", "movement-unledgered"}
+
+
 def test_sync_checker_computed_receivers(tmp_path):
     """.item()/.block_until_ready() on computed expressions — the
     receiver has no qualifiable name but the sync is just as blocking."""

@@ -1,0 +1,406 @@
+"""Data-movement observatory: the runtime sync/transfer ledger (ISSUE 17).
+
+Covers the acceptance contract:
+- ledger attribution round-trip on TPC-H q1/q3/q6: every query's event
+  log carries a v11 ``movement_summary`` whose per-site walls/bytes are
+  internally consistent and agree (within tolerance) with the
+  critical-path ``sync_wait`` + ``h2d_upload`` categories,
+- device-residency tracking: an injected D2H->H2D bounce (download,
+  host-side reshape, re-upload within one query) flags as a round trip,
+- zero overhead when off: the funnel hooks compile down to a single
+  module-constant check (bytecode pin, the utils/faults.py pattern) and
+  the v11 record's payload is null,
+- the static<->runtime join: every instrumented site maps onto
+  srtpu-analyze sync-baseline keys and tools/diagnose.py ranks measured
+  sites against them,
+- the history sentinel's D2H-bytes gate and compare.py's transfer-byte
+  regression gate read the summary's totals.
+
+Process-wide ledger state is drained between modules by the conftest
+``_drain_movement_state_per_module`` fixture (the retry/fallback drain
+pattern), so nothing here leaks into later modules.
+"""
+import glob
+import json
+import os
+import pathlib
+
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.conf import RapidsConf
+from spark_rapids_tpu.utils import movement
+
+PKG = pathlib.Path(__file__).resolve().parent.parent / "spark_rapids_tpu"
+
+_TO_HOST = "spark_rapids_tpu/columnar/device.py::DeviceTable.to_host"
+_UPLOAD = ("spark_rapids_tpu/exec/transitions.py"
+           "::HostToDeviceExec._upload_retryable")
+
+
+@pytest.fixture
+def ledger():
+    """A fresh process-wide ledger; cleared afterwards so the module
+    leaves the default (off) state behind."""
+    led = movement.configure_movement(RapidsConf(
+        {"spark.rapids.tpu.movement.enabled": True}))
+    yield led
+    movement.reset_movement()
+
+
+# ---------------------------------------------------------------------------
+# zero overhead when off
+# ---------------------------------------------------------------------------
+def test_zero_overhead_when_off_bytecode_pin():
+    """Off is the default; every funnel hook's FIRST action must be the
+    module-constant is-None check — co_names[0] pins that no other
+    global (let alone a conf lookup) is touched before the early return
+    (the utils/faults.py cost-model pattern)."""
+    movement.reset_movement()
+    for fn in (movement.clock, movement.note_d2h, movement.note_h2d,
+               movement.tag_lineage):
+        assert fn.__code__.co_names[0] == "_LEDGER", fn.__name__
+    assert movement.active() is None
+    # and the disabled path records nothing / returns the null payload
+    movement.note_d2h(_TO_HOST, 1024)
+    movement.note_h2d(_UPLOAD, 1024)
+    assert movement.clock() == 0.0
+    assert movement.drain_ring() == []
+    assert movement.query_summary(0) is None
+    assert movement.movement_stats() == {}
+
+
+def test_conf_off_means_no_ledger():
+    assert movement.configure_movement(RapidsConf({})) is None
+    assert movement.active() is None
+
+
+# ---------------------------------------------------------------------------
+# ledger mechanics: recording, lineage, round trips
+# ---------------------------------------------------------------------------
+def _device_table(n=64):
+    from spark_rapids_tpu.columnar import DeviceTable, HostTable
+    t = pa.table({"x": pa.array([float(i) for i in range(n)]),
+                  "y": pa.array(list(range(n)), type=pa.int64())})
+    return DeviceTable.from_host(HostTable.from_arrow(t), min_bucket=8)
+
+
+def test_round_trip_bounce_detected(ledger):
+    """Injected D2H->H2D bounce: download through the real to_host
+    funnel, reshape on the host (lineage propagates through slice), then
+    re-upload — the H2D funnel must flag a round trip and name the site
+    the batch came from."""
+    dt = _device_table()
+    ht = dt.to_host()                      # real D2H funnel fires
+    assert getattr(ht, "_tpu_lineage", None) is not None
+    part = ht.slice(0, 16)                 # host-side reshape keeps lineage
+    assert getattr(part, "_tpu_lineage", None) == ht._tpu_lineage
+    movement.note_h2d(_UPLOAD, 1024, movement.clock(), origin=part)
+    ring = movement.drain_ring()
+    d2h = [e for e in ring if e["direction"] == "d2h"]
+    h2d = [e for e in ring if e["direction"] == "h2d"]
+    assert d2h and d2h[0]["site"] == _TO_HOST and d2h[0]["bytes"] > 0
+    assert d2h[0]["blocking"] is True
+    assert h2d[0]["round_trip"] is True
+    assert h2d[0]["bounced_from"] == _TO_HOST
+    summary = movement.query_summary(None)
+    assert summary["totals"]["round_trips"] == 1
+    up = [s for s in summary["sites"] if s["site"] == _UPLOAD]
+    assert up and up[0]["round_trips"] == 1
+
+
+def test_no_round_trip_without_lineage(ledger):
+    """An upload of a host batch that never came off the device is NOT a
+    round trip."""
+    from spark_rapids_tpu.columnar import HostTable
+    fresh = HostTable.from_arrow(pa.table({"x": [1.0, 2.0]}))
+    movement.note_h2d(_UPLOAD, 64, origin=fresh)
+    (entry,) = movement.drain_ring()
+    assert entry["round_trip"] is False
+    assert movement.query_summary(None)["totals"]["round_trips"] == 0
+
+
+def test_callable_nbytes_and_call_site(ledger):
+    """Byte counts may be lazy callables (nothing computed when off) and
+    every entry carries the caller's file:line — who asked for the
+    crossing, not where the funnel lives."""
+    movement.note_d2h(_TO_HOST, lambda: 4096, movement.clock())
+    (entry,) = movement.drain_ring()
+    assert entry["bytes"] == 4096
+    assert entry["call_site"] and "test_movement.py" in entry["call_site"]
+
+
+def test_ring_is_bounded(ledger):
+    led = movement.configure_movement(RapidsConf(
+        {"spark.rapids.tpu.movement.enabled": True,
+         "spark.rapids.tpu.movement.ringSize": 8}))
+    for _ in range(50):
+        movement.note_d2h(_TO_HOST, 4)
+    assert len(led.drain_ring()) == 8           # oldest dropped
+    assert led.totals()["d2h_count"] == 50      # aggregation stays exact
+
+
+def test_every_site_maps_onto_static_baseline():
+    """The static<->runtime join: every instrumented D2H site's baseline
+    keys name a LIVE srtpu-analyze sync finding — either baselined debt
+    (in the committed counts) or a deliberately suppressed sync-ok site.
+    A key matching neither is stale and the diagnose ranking would join
+    against nothing. H2D sites (deferred uploads) carry no sync-baseline
+    keys by design."""
+    from spark_rapids_tpu.tools.analyze import analyze_paths, load_baseline
+    counts = (load_baseline() or {}).get("counts", {})
+    report = analyze_paths([str(PKG)], checks=["sync"])
+    suppressed = {f.key() for f in report.suppressed}
+    joined = 0
+    for site, info in movement.SITES.items():
+        assert info.direction in ("d2h", "h2d")
+        assert info.hint
+        if info.direction == "h2d":
+            assert info.baseline_keys == ()
+            continue
+        assert info.baseline_keys, site
+        for key in info.baseline_keys:
+            path, rule, _sym = key.split("::")
+            assert path == site.split("::")[0]
+            assert rule.startswith("sync-")
+            assert key in counts or key in suppressed, f"stale key {key}"
+            if key in counts:
+                joined += 1
+    assert joined >= 2   # the baselined-debt side of the join is live
+
+
+# ---------------------------------------------------------------------------
+# TPC-H end to end: v11 records, attribution, critical-path consistency
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def tpch_app(tmp_path_factory):
+    """q1/q3/q6 under the observatory + tracer + event log, replayed."""
+    from spark_rapids_tpu.session import TpuSession
+    from spark_rapids_tpu.tools import tpch
+    from spark_rapids_tpu.tools.eventlog import load_event_log
+    logdir = str(tmp_path_factory.mktemp("movement_evl"))
+    sess = TpuSession({
+        "spark.rapids.tpu.eventLog.dir": logdir,
+        "spark.rapids.tpu.movement.enabled": True,
+        "spark.rapids.tpu.trace.enabled": True,
+        "spark.rapids.tpu.batchRowsMinBucket": 8,
+        "spark.rapids.tpu.shuffle.partitions": 2,
+    })
+    tables = tpch.gen_all(0, tiny=True)
+    dfs = tpch.build_dataframes(sess, tables, num_partitions=2)
+    for name in ("q1", "q3", "q6"):
+        getattr(tpch, name)(dfs).collect(device=True)
+    sess.close()
+    movement.reset_movement()
+    (path,) = glob.glob(os.path.join(logdir, "*.jsonl"))
+    records = [json.loads(line) for line in open(path, encoding="utf-8")]
+    return load_event_log(path), records
+
+
+def test_tpch_every_query_carries_v11_movement_summary(tpch_app):
+    app, _records = tpch_app
+    assert len(app.queries) == 3
+    for q in app.queries.values():
+        mv = q.movement_summary
+        assert mv is not None, f"q{q.query_id} movement_summary missing"
+        t = mv["totals"]
+        assert t["d2h_bytes"] > 0 and t["d2h_count"] > 0
+        assert t["h2d_bytes"] > 0 and t["h2d_count"] > 0
+        assert t["blocking_count"] > 0
+        assert mv["sites"] and mv["operators"]
+        # attribution: sites are the known funnels, operators are real
+        # plan operators (the node-context attribution)
+        for s in mv["sites"]:
+            assert s["site"] in movement.SITES, s["site"]
+        assert any(o["operator"] != "<none>" for o in mv["operators"])
+
+
+def test_tpch_summary_internal_consistency(tpch_app):
+    """Per-site rows must sum back to the totals exactly — the ledger
+    folds each crossing into both under one lock."""
+    app, _records = tpch_app
+    for q in app.queries.values():
+        mv = q.movement_summary
+        t = mv["totals"]
+        for direction in ("d2h", "h2d"):
+            rows = [s for s in mv["sites"]
+                    if s["direction"] == direction]
+            assert sum(s["bytes"] for s in rows) == t[f"{direction}_bytes"]
+            assert sum(s["count"] for s in rows) == t[f"{direction}_count"]
+        assert sum(s["wall_s"] for s in mv["sites"]) \
+            == pytest.approx(t["wall_s"], abs=1e-9)
+        assert sum(o["bytes"] for o in mv["operators"]) \
+            == t["d2h_bytes"] + t["h2d_bytes"]
+
+
+def test_tpch_walls_consistent_with_critical_path(tpch_app):
+    """The measured ledger walls and the critical path's sync_wait +
+    h2d_upload categories watch the same crossings from two sides (the
+    ledger times the raw transfer inside the funnel, the tracer spans
+    wrap it), so per query they must agree within a generous band —
+    catching gross drift (a funnel that stopped reporting, a span that
+    moved off the transfer) without flaking on scheduler noise."""
+    app, _records = tpch_app
+    checked = 0
+    for q in app.queries.values():
+        cp = q.critical_path or {}
+        cats = cp.get("categories_s") or {}
+        cp_both = (cats.get("sync_wait", 0.0) or 0.0) \
+            + (cats.get("h2d_upload", 0.0) or 0.0)
+        if cp_both <= 0:
+            continue
+        mv_wall = sum(s["wall_s"] for s in q.movement_summary["sites"]
+                      if s["site"] in (_TO_HOST, _UPLOAD))
+        # the ledger region sits strictly inside the traced span, so it
+        # can't exceed the span time by more than noise; and the span
+        # can't dwarf the transfer it wraps
+        assert mv_wall <= cp_both * 5 + 0.25
+        assert cp_both <= max(q.movement_summary["totals"]["wall_s"],
+                              mv_wall) * 20 + 0.25
+        checked += 1
+    assert checked >= 1   # tracing was on: at least one query has both
+
+
+def test_v11_record_shape(tpch_app):
+    """Pin the populated movement_summary record shape (the null-payload
+    variant is pinned in tests/test_observability.py)."""
+    _app, records = tpch_app
+    mvs = [r for r in records if r["event"] == "movement_summary"]
+    assert len(mvs) == 3
+    for rec in mvs:
+        assert set(rec) == {"event", "query_id", "ts", "movement"}
+        mv = rec["movement"]
+        assert set(mv) == {"totals", "sites", "operators"}
+        assert set(mv["totals"]) == set(movement.TOTAL_KEYS) | {"wall_s"}
+        for s in mv["sites"]:
+            assert set(s) == {"site", "direction", "count", "bytes",
+                              "wall_s", "blocking_count", "round_trips"}
+        for o in mv["operators"]:
+            assert set(o) == {"operator", "direction", "count", "bytes",
+                              "wall_s", "blocking_count", "round_trips"}
+    # per-query stats carry the movement gauges the sentinel's
+    # D2H-bytes gate and statusd /metrics read
+    ends = [r for r in records if r["event"] == "query_end"
+            and not r.get("error")]
+    assert ends and all(
+        r["stats"].get("movement_d2h_bytes", 0) > 0 for r in ends)
+
+
+def test_diagnose_measured_movement_ranking(tpch_app):
+    """tools/diagnose.py joins the measured sites onto the srtpu-analyze
+    baseline keys and renders the ranked data-movement section next to
+    the static sync_debt inventory."""
+    from spark_rapids_tpu.tools.diagnose import diagnose_app
+    app, _records = tpch_app
+    report = diagnose_app(app)
+    obj = json.loads(report.to_json())
+    rows = obj["measured_movement"]
+    assert rows, "no measured movement rows"
+    for row in rows:
+        assert row["site"] in movement.SITES
+        assert row["status"] in ("baselined sync debt",
+                                 "suppressed (deliberate sync)",
+                                 "deferred transfer")
+        assert row["suggestion"]
+    # ranked heaviest-wall first
+    walls = [r["wall_s"] for r in rows]
+    assert walls == sorted(walls, reverse=True)
+    # the static inventory renders alongside, not instead
+    assert "sync_debt" in obj
+    text = report.summary()
+    assert "data movement (measured, movement ledger)" in text
+    assert "static sync-site debt" in text
+
+
+def test_health_check_warns_on_sync_wait_fraction(tmp_path):
+    """A query whose critical path is mostly sync_wait gets a health
+    warning naming the heaviest measured site (v11)."""
+    from spark_rapids_tpu.tools.eventlog import load_event_log
+    recs = [
+        {"event": "app_start", "app_id": "mv", "schema_version": 11,
+         "ts": 0.0, "conf": {}},
+        {"event": "query_start", "query_id": 0, "ts": 1.0, "plan": "p",
+         "trace_id": "t"},
+        {"event": "movement_summary", "query_id": 0, "ts": 2.0,
+         "movement": {
+             "totals": {"d2h_bytes": 4096, "h2d_bytes": 0, "d2h_count": 2,
+                        "h2d_count": 0, "blocking_count": 2,
+                        "deferred_count": 0, "round_trips": 2,
+                        "wall_s": 0.5},
+             "sites": [{"site": _TO_HOST, "direction": "d2h", "count": 2,
+                        "bytes": 4096, "wall_s": 0.5, "blocking_count": 2,
+                        "round_trips": 2}],
+             "operators": []}},
+        {"event": "query_end", "query_id": 0, "ts": 2.0, "wall_s": 1.0,
+         "final_plan": "p", "aqe_events": [], "spill_count": {},
+         "semaphore_wait_s": 0.0, "stats": {}, "trace_id": "t",
+         "critical_path": {"sync_wait_frac": 0.6,
+                           "categories_s": {"sync_wait": 0.6},
+                           "fractions": {"sync_wait": 0.6},
+                           "total_s": 1.0, "coverage": 1.0}},
+        {"event": "app_end", "ts": 3.0},
+    ]
+    path = tmp_path / "mv.jsonl"
+    path.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+    app = load_event_log(str(path))
+    warnings = app.health_check()
+    sync_warns = [w for w in warnings if "sync wait is 60%" in w]
+    assert sync_warns and _TO_HOST in sync_warns[0]
+    assert any("round trip" in w for w in warnings)
+
+
+# ---------------------------------------------------------------------------
+# regression gates: sentinel D2H bytes + compare.py transfer bytes
+# ---------------------------------------------------------------------------
+def test_compare_movement_delta_gate():
+    from spark_rapids_tpu.tools.compare import movement_delta
+    base = {"d2h_bytes": 10 << 20, "h2d_bytes": 1 << 20, "round_trips": 0}
+    # +5% under the 1 MiB floor: clean
+    small = dict(base, d2h_bytes=base["d2h_bytes"] + (1 << 19))
+    _deltas, flagged = movement_delta(base, small)
+    assert "d2h_bytes" not in flagged
+    # +50% and past the floor: flagged, and new round trips always flag
+    big = dict(base, d2h_bytes=15 << 20, round_trips=3)
+    deltas, flagged = movement_delta(base, big)
+    assert deltas["d2h_bytes"] == 5 << 20
+    assert "d2h_bytes" in flagged and "round_trips" in flagged
+    # missing on either side (ledger off): nothing to gate
+    assert movement_delta(None, big) == ({}, [])
+
+
+def test_sentinel_d2h_bytes_gate(tmp_path):
+    """Two synthetic runs whose only difference is movement_d2h_bytes
+    growth past the 10% + 1 MiB gate: the sentinel flags d2h_bytes."""
+    from spark_rapids_tpu.tools.history import (HistoryStore, run_sentinel,
+                                                D2H_BYTES_KEY)
+
+    def _log(path, app_id, d2h):
+        recs = [
+            {"event": "app_start", "app_id": app_id, "schema_version": 11,
+             "ts": 0.0, "conf": {}},
+            {"event": "query_start", "query_id": 0, "ts": 1.0,
+             "plan": "p", "trace_id": "t"},
+            {"event": "query_end", "query_id": 0, "ts": 2.0,
+             "wall_s": 1.0, "final_plan": "p", "aqe_events": [],
+             "spill_count": 0, "semaphore_wait_s": 0.0,
+             "stats": {D2H_BYTES_KEY: d2h}, "trace_id": "t",
+             "critical_path": None},
+            {"event": "app_end", "ts": 3.0},
+        ]
+        path.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+        return str(path)
+
+    store = HistoryStore(str(tmp_path / "store"))
+    store.append_run(_log(tmp_path / "a.jsonl", "run_a", 10 << 20),
+                     app_id="run_a")
+    store.append_run(_log(tmp_path / "b.jsonl", "run_b", 20 << 20),
+                     app_id="run_b")
+    verdict = run_sentinel(store, candidate="run_b", baseline="run_a")
+    assert not verdict["ok"]
+    assert "d2h_bytes" in verdict["flags"]
+    assert verdict["d2h_bytes_regressions"][0]["delta"] == 10 << 20
+    # same bytes: clean
+    store.append_run(_log(tmp_path / "c.jsonl", "run_c", 10 << 20),
+                     app_id="run_c")
+    verdict = run_sentinel(store, candidate="run_c", baseline="run_a")
+    assert verdict["ok"] and "d2h_bytes" not in verdict["flags"]

@@ -361,6 +361,11 @@ _REQUIRED_KEYS = {
     # v10: fallback records appear only when a batch actually re-executed
     # on the host engine and are pinned separately
     # (test_eventlog_v10_fallback_records in tests/test_fallback.py)
+    # v11: per-query data-movement summary, ALWAYS written (movement is
+    # null when the observatory is off, as in this run) so the record
+    # set is stable; the populated shape is pinned in
+    # tests/test_movement.py
+    "movement_summary": {"event", "query_id", "ts", "movement"},
     "app_end": {"event", "ts"},
 }
 
@@ -417,8 +422,10 @@ def test_eventlog_schema_version_and_required_keys(tmp_path):
     # (none in this pressure-free run; pinned in tests/test_oom_retry.py).
     # v10 adds fallback records — one per batch re-executed through the
     # host engine after a terminal device failure (none on a healthy
-    # device; pinned in tests/test_fallback.py)
-    assert SCHEMA_VERSION == 10
+    # device; pinned in tests/test_fallback.py). v11 adds the
+    # always-written per-query movement_summary (null payload here —
+    # observatory off; populated shape pinned in tests/test_movement.py)
+    assert SCHEMA_VERSION == 11
     assert by_type["app_start"][0]["schema_version"] == SCHEMA_VERSION
     for kind, required in _REQUIRED_KEYS.items():
         for rec in by_type[kind]:
@@ -619,7 +626,7 @@ def test_eventlog_query_stats_cover_all_subsystems(tmp_path):
     from spark_rapids_tpu.tools.eventlog import load_event_log
     path = _run_logged_app(tmp_path)
     app = load_event_log(path)
-    assert app.schema_version == 10
+    assert app.schema_version == 11
     q = app.query(1)
     assert q.stats, "query_end stats delta missing"
     for family in ("compile_cache_", "upload_cache_", "shuffle_",
