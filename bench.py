@@ -709,10 +709,17 @@ def _movement_conf() -> dict:
 def _movement_probe() -> dict:
     """Snapshot of the process-wide movement-ledger totals ({} when the
     observatory is off) — diff two around a timed run for that run's
-    transfer cost. Never fails the bench."""
+    transfer cost. Carries a per-site wall snapshot under "_site_wall"
+    so the res can name the heaviest ledger funnel (the sync-wait
+    gate's attribution). Never fails the bench."""
     try:
-        from spark_rapids_tpu.utils.movement import movement_stats
-        return dict(movement_stats())
+        from spark_rapids_tpu.utils.movement import active, movement_stats
+        stats = dict(movement_stats())
+        led = active()
+        if stats and led is not None:
+            stats["_site_wall"] = {r["site"]: float(r["wall_s"])
+                                   for r in led.site_aggregate()}
+        return stats
     except Exception:
         return {}
 
@@ -720,18 +727,28 @@ def _movement_probe() -> dict:
 def _movement_res(before: dict) -> dict:
     """Movement-total deltas across one timed run, keyed the way
     tools/compare.py's bench transfer-byte gate reads them; {} when the
-    observatory is off."""
+    observatory is off. "sync_top_site" names the ledger funnel that
+    held the most wall during the run — the site tools/compare.py's
+    sync-wait gate points at when sync_wait_frac trips it."""
     after = _movement_probe()
     if not after or not before:
         return {}
-    return {"d2h_bytes": int(after.get("d2h_bytes", 0)
-                             - before.get("d2h_bytes", 0)),
-            "h2d_bytes": int(after.get("h2d_bytes", 0)
-                             - before.get("h2d_bytes", 0)),
-            "blocking_syncs": int(after.get("blocking_count", 0)
-                                  - before.get("blocking_count", 0)),
-            "round_trips": int(after.get("round_trips", 0)
-                               - before.get("round_trips", 0))}
+    sites_a = before.get("_site_wall") or {}
+    sites_b = after.get("_site_wall") or {}
+    deltas = {s: w - sites_a.get(s, 0.0) for s, w in sites_b.items()
+              if w - sites_a.get(s, 0.0) > 0.0}
+    top = max(deltas.items(), key=lambda kv: kv[1])[0] if deltas else ""
+    res = {"d2h_bytes": int(after.get("d2h_bytes", 0)
+                            - before.get("d2h_bytes", 0)),
+           "h2d_bytes": int(after.get("h2d_bytes", 0)
+                            - before.get("h2d_bytes", 0)),
+           "blocking_syncs": int(after.get("blocking_count", 0)
+                                 - before.get("blocking_count", 0)),
+           "round_trips": int(after.get("round_trips", 0)
+                              - before.get("round_trips", 0))}
+    if top:
+        res["sync_top_site"] = top
+    return res
 
 
 def _bench_critical_path():

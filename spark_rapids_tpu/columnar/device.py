@@ -39,11 +39,13 @@ from . import dtypes as dt
 from ..utils import movement
 from .host import HostColumn, HostTable
 
-__all__ = ["BucketPolicy", "DeviceColumn", "DeviceTable", "bucket_rows",
-           "bucket_width", "canonical_names", "configure_buckets",
+__all__ = ["BucketPolicy", "DeferredScalar", "DeviceColumn", "DeviceTable",
+           "async_enabled", "bucket_rows",
+           "bucket_width", "bulk_download_stats", "canonical_names",
+           "configure_async", "configure_buckets",
            "configure_debug", "current_bucket_policy",
            "debug_assertions_enabled", "host_sync_stats",
-           "resolve_min_bucket"]
+           "resolve_min_bucket", "resolve_scalars", "to_host_batched"]
 
 # process-wide count of deliberate D2H materializations (to_host calls —
 # the funnel every blocking download converges on per the srtpu-analyze
@@ -68,6 +70,112 @@ def _note_host_sync() -> None:
 # joins onto the srtpu-analyze baseline keys
 _MOVE_TO_HOST = "spark_rapids_tpu/columnar/device.py::DeviceTable.to_host"
 _MOVE_SHRINK = "spark_rapids_tpu/columnar/device.py::shrink_to_fit"
+_MOVE_RESOLVE = "spark_rapids_tpu/columnar/device.py::resolve_scalars"
+_MOVE_BULK = "spark_rapids_tpu/columnar/device.py::to_host_batched"
+
+# spark.rapids.tpu.async.enabled snapshot (session-init chokepoint, same
+# contract as configure_debug below). True = deferred scalars stay async
+# until a fusible boundary and downloads batch per drain; False = the
+# sync-forcing debug mode (every site blocks where it stands).
+_ASYNC_ENABLED = True
+
+
+def configure_async(conf) -> None:
+    """Apply spark.rapids.tpu.async.enabled (called from
+    TpuSession.__init__; the most recent session wins)."""
+    global _ASYNC_ENABLED
+    from ..conf import ASYNC_ENABLED
+    _ASYNC_ENABLED = bool(conf.get(ASYNC_ENABLED))
+
+
+def async_enabled() -> bool:
+    return _ASYNC_ENABLED
+
+
+def resolve_scalars(*values) -> Tuple:
+    """Materialize any number of device scalars in ONE bulk transfer.
+
+    This is the sanctioned funnel for every host decision that needs a
+    device scalar (row counts, expansion totals, uniqueness flags): call
+    sites hand over everything they need for the next decision at once,
+    so a control-flow boundary costs one ledgered round trip however
+    many scalars it consumes. Python numbers pass through untouched.
+    Under the sync-forcing debug conf (``spark.rapids.tpu.async.enabled
+    =false``) each scalar transfers separately so a stall localizes to
+    its site in the trace."""
+    if not values:
+        return ()
+    if _ASYNC_ENABLED:
+        t0 = movement.clock()
+        got = jax.device_get(list(values))  # srtpu: sync-ok(the deliberate batched-scalar funnel: one transfer per decision boundary)
+        movement.note_d2h(_MOVE_RESOLVE, 4 * len(values), t0)
+    else:
+        # one ledger entry per transfer: the sync-forcing mode really
+        # does N blocking crossings, and the ledger must say so (the
+        # async-vs-sync blocking_count delta is the measured win)
+        got = []
+        for v in values:
+            t0 = movement.clock()
+            got.append(jax.device_get(v))  # srtpu: sync-ok(sync-forcing debug mode: per-scalar blocking transfers localize stalls)
+            movement.note_d2h(_MOVE_RESOLVE, 4, t0)
+    return tuple(v.item() if hasattr(v, "item") else v for v in got)  # srtpu: sync-ok(item on numpy scalars the device_get above already fetched — no extra transfer)
+
+
+class DeferredScalar:
+    """A device scalar that stays async until the host actually branches
+    on it (ROADMAP item 1: nonblocking row counts).
+
+    ``DeviceTable.num_rows`` and friends are JAX arrays whose values are
+    still in flight under async dispatch — wrapping one defers the
+    blocking materialization to the first ``int()``/``bool()``, and
+    several can resolve together through ``resolve_scalars`` with one
+    transfer. Under the sync-forcing debug conf the constructor resolves
+    eagerly, restoring blocking-at-site semantics."""
+
+    __slots__ = ("_device", "_host")
+
+    def __init__(self, value):
+        if isinstance(value, (int, float, bool, np.generic)):
+            self._device, self._host = None, value
+        else:
+            self._device, self._host = value, None
+            if not _ASYNC_ENABLED:
+                self.resolve()
+
+    @property
+    def is_resolved(self) -> bool:
+        return self._host is not None
+
+    def resolve(self):
+        if self._host is None:
+            (self._host,) = resolve_scalars(self._device)
+            self._device = None
+        return self._host
+
+    @staticmethod
+    def resolve_all(*scalars) -> Tuple:
+        """Resolve many DeferredScalars with ONE transfer for the whole
+        unresolved set (the batched-future boundary)."""
+        pending = [s for s in scalars if isinstance(s, DeferredScalar)
+                   and not s.is_resolved]
+        if pending:
+            got = resolve_scalars(*[s._device for s in pending])
+            for s, v in zip(pending, got):
+                s._host, s._device = v, None
+        return tuple(s.resolve() if isinstance(s, DeferredScalar) else s
+                     for s in scalars)
+
+    def __int__(self) -> int:
+        return int(self.resolve())
+
+    __index__ = __int__
+
+    def __bool__(self) -> bool:
+        return bool(self.resolve())
+
+    def __repr__(self) -> str:
+        state = self._host if self._host is not None else "<deferred>"
+        return f"DeferredScalar({state})"
 
 # spark.rapids.tpu.debug.assertions snapshot (session-init chokepoint,
 # like parallel/pipeline.configure_pipeline — columns have no conf at
@@ -477,6 +585,56 @@ def _download_column(c: DeviceColumn, mask: np.ndarray, n: int) -> HostColumn:
     if isinstance(c.dtype, dt.BooleanType):
         vals = vals.astype(np.bool_)
     return HostColumn(c.dtype, vals, opt_valid)
+
+
+# bulk-download counters: the async-parity suite pins "<= 1 bulk
+# device_get per output drain" against these (tests/test_async_exec.py)
+_BULK_STATS = {"calls": 0, "tables": 0}
+
+
+def bulk_download_stats() -> Dict[str, int]:
+    with _HOST_SYNC_LOCK:
+        return dict(_BULK_STATS)
+
+
+def to_host_batched(tables: Sequence[DeviceTable]) -> List[HostTable]:
+    """Download many device batches with ONE bulk transfer.
+
+    The deferred-D2H funnel (ROADMAP item 1): a drain accumulates its
+    device batches and materializes them here in a single ``device_get``
+    over all pytrees, so the host blocks once per drain instead of once
+    per batch and XLA keeps dispatching while earlier batches transfer.
+    Under the sync-forcing debug conf this degrades to the per-batch
+    ``to_host`` path so each download blocks at its own site."""
+    tables = list(tables)
+    if not tables:
+        return []
+    if not _ASYNC_ENABLED:
+        return [t.to_host() for t in tables]
+    _note_host_sync()
+    t0 = movement.clock()
+    nbytes = sum(t.nbytes() for t in tables)
+    host_np = jax.device_get(tables)  # srtpu: sync-ok(the deliberate bulk-download funnel: one transfer for the whole drain)
+    out: List[HostTable] = []
+    for t in host_np:
+        mask = np.asarray(t.row_mask)  # srtpu: sync-ok(already numpy after the bulk device_get above — no further transfer)
+        n = int(np.asarray(t.num_rows))  # srtpu: sync-ok(already numpy after the bulk device_get above — no further transfer)
+        cols = [_download_column(c, mask, n) for c in t.columns]
+        out.append(HostTable(list(t.names), cols))
+    movement.note_d2h(_MOVE_BULK, nbytes, t0, table=out[0])
+    # propagate the lineage tag to every table of the drain so a re-upload
+    # of ANY of them flags a round trip, not just the first
+    tag = getattr(out[0], "_tpu_lineage", None)
+    if tag is not None:
+        for ht in out[1:]:
+            try:
+                ht._tpu_lineage = tag
+            except (AttributeError, TypeError):
+                pass
+    with _HOST_SYNC_LOCK:
+        _BULK_STATS["calls"] += 1
+        _BULK_STATS["tables"] += len(tables)
+    return out
 
 
 def _obj_array(n: int) -> np.ndarray:

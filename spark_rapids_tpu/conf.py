@@ -245,6 +245,18 @@ PARQUET_READER_TYPE = register_conf(
     "(reference: RapidsConf.scala:721).", "COALESCING",
     checker=_in("PERFILE", "COALESCING", "MULTITHREADED", "AUTO"))
 
+ASYNC_ENABLED = register_conf(
+    "spark.rapids.tpu.async.enabled",
+    "Async-first execution: batch row counts and validity flags resolve "
+    "as batched futures at fusible boundaries (one bulk transfer for many "
+    "scalars), and the device->host drain accumulates device batches and "
+    "downloads them in one bulk device_get per drain (columnar/device.py "
+    "DeferredScalar / resolve_scalars / to_host_batched). 'false' is the "
+    "sync-forcing debug mode: every deferred scalar materializes eagerly "
+    "at its call site and downloads go back to one blocking to_host per "
+    "batch, so a stall localizes to the exact site in the movement "
+    "ledger and the Chrome trace.", True)
+
 DEBUG_ASSERTIONS = register_conf(
     "spark.rapids.tpu.debug.assertions",
     "Enable extra runtime invariant guards on the columnar layer "

@@ -248,16 +248,18 @@ class TpuSampleExec(TpuExec):
             return fn
         from ..memory.retry import with_retry
         fn = cached_jit(self.plan_signature() + f"|p{pidx}", make)
-        offset = 0
+        # device-resident row offset: the accumulation rides async
+        # dispatch, so sampling never blocks the host between batches
+        offset = jnp.zeros((), dtype=jnp.int64)
         for batch in self.child_device_batches(pidx):
             with self.metrics.timed(M.OP_TIME):
                 batch = batch.compact()
                 # spill-only retry: the sample mask hashes ABSOLUTE row
                 # positions, so row-axis halves (which renumber rows from
                 # 0) would sample different rows — unsplittable
-                out = with_retry(fn, batch, jnp.int64(offset),
+                out = with_retry(fn, batch, offset,
                                  scope="sample", context=self.node_desc())
-            offset += int(batch.num_rows)  # true rows: match host positions
+            offset = offset + batch.num_rows.astype(jnp.int64)
             self.account_batch()
             yield out
 
@@ -417,12 +419,15 @@ class TpuLocalLimitExec(TpuExec):
             mask = iota < nr
             return DeviceTable(t.columns, mask, nr, t.names)
 
+        from ..columnar.device import resolve_scalars
         for batch in self.child_device_batches(pidx):
             if remaining <= 0:
                 return
             with self.metrics.timed(M.OP_TIME):
                 out = take(batch, jnp.asarray(remaining, jnp.int32))
-            emitted = int(out.num_rows)
+            # early-exit decision: one batched-funnel transfer per batch
+            (emitted,) = resolve_scalars(out.num_rows)
+            emitted = int(emitted)
             remaining -= emitted
             self.account_batch(rows=emitted)
             yield out

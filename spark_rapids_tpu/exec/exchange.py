@@ -51,9 +51,6 @@ SHUFFLE_MODE = register_conf(
 # movement-observatory site identities (utils/movement.py SITES)
 _MOVE_CHUNK = ("spark_rapids_tpu/exec/exchange.py"
                "::TpuShuffleExchangeExec._exchange_chunk")
-_MOVE_DRAIN = ("spark_rapids_tpu/exec/exchange.py"
-               "::TpuLocalExchangeExec._materialize_locked.drain")
-
 EXCHANGE_CHUNK_ROWS = register_conf(
     "spark.rapids.tpu.shuffle.exchangeChunkRows",
     "Max staged row capacity per device-exchange chunk. Child batches "
@@ -219,7 +216,7 @@ class TpuShuffleExchangeExec(TpuExec):
                 pid = jax.jit(lambda t: jnp.where(
                     t.row_mask, device_partition_ids(t, keys, n), n))(table)
                 t0 = movement.clock()
-                pid_host = np.asarray(jax.device_get(pid))
+                pid_host = np.asarray(jax.device_get(pid))  # srtpu: sync-ok(the deliberate partition-count funnel: one transfer sizes every shard buffer for the chunk)
                 movement.note_d2h(_MOVE_CHUNK, pid_host.nbytes, t0)
                 src = np.arange(table.capacity) // per_shard
                 active = pid_host < n
@@ -325,17 +322,22 @@ class TpuLocalExchangeExec(TpuExec):
         from ..memory.catalog import SpillPriorities, get_catalog
         from ..parallel.pipeline import parallel_map
         catalog = get_catalog()
-        from ..columnar.device import shrink_to_fit
+        from ..columnar.device import resolve_scalars, shrink_to_fit
 
         def drain(p: int):
             """One map-side partition: drain, compact, spill-register.
             Runs per-partition on the bounded task pool (parallel map-side
             writes) — the catalog and metric registries are thread-safe."""
             out = []
-            for b in self.child_device_batches(p):
-                t0 = movement.clock()
-                n = int(b.num_rows)  # srtpu: sync-ok(shared with shrink_to_fit below — one 4B sync per map batch, not two)
-                movement.note_d2h(_MOVE_DRAIN, 4, t0)
+            batches = list(self.child_device_batches(p))
+            if not batches:
+                return out
+            # ONE batched-funnel transfer resolves every map batch's row
+            # count for the partition (was one 4B sync per batch); every
+            # batch's compute has dispatched before the host blocks
+            ns = resolve_scalars(*[b.num_rows for b in batches])
+            for b, n in zip(batches, ns):
+                n = int(n)
                 if not n:
                     continue
                 with self.metrics.timed(M.OP_TIME):

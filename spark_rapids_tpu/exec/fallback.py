@@ -479,7 +479,7 @@ def _host_fallback(node, batch, host_fn, exc, failure_class: str,
     rec = {"ts": time.time(), "operator": op_name,
            "context": str(getattr(node, "node_desc", lambda: "")())[:200],
            "failure_class": failure_class, "reason": reason,
-           "rows": int(out_host.num_rows), "bytes_down": bytes_down,
+           "rows": int(out_host.num_rows), "bytes_down": bytes_down,  # srtpu: sync-ok(out_host is a HostTable — num_rows is a host int, no device sync)
            "bytes_up": bytes_up, "wall_s": wall}
     with _STATS_LOCK:
         _RECORDS.append(rec)

@@ -17,7 +17,7 @@ import jax.numpy as jnp
 
 from ..columnar import dtypes as dt
 from ..columnar.device import (DeviceColumn, DeviceTable, bucket_rows,
-                               resolve_min_bucket)
+                               resolve_min_bucket, resolve_scalars)
 from ..expr.base import EvalContext
 from ..expr.collections import PosExplode
 from ..plan.physical import PhysicalPlan
@@ -54,12 +54,14 @@ class TpuGenerateExec(TpuExec):
     def execute_columnar(self, pidx: int) -> Iterator[DeviceTable]:
         for batch in self.child_device_batches(pidx):
             with self.metrics.timed(M.OP_TIME):
-                out = self._explode_batch(batch, pidx)
+                # total was already host-resolved for the capacity choice
+                # — reuse it for the row metric instead of a second sync
+                out, total = self._explode_batch(batch, pidx)
             self.metrics.add(M.NUM_OUTPUT_BATCHES, 1)
-            self.metrics.add(M.NUM_OUTPUT_ROWS, int(out.num_rows))
+            self.metrics.add(M.NUM_OUTPUT_ROWS, total)
             yield out
 
-    def _explode_batch(self, batch: DeviceTable, pidx: int) -> DeviceTable:
+    def _explode_batch(self, batch: DeviceTable, pidx: int):
         ctx = EvalContext.for_device(batch, partition_id=pidx)
         col = self.generator.children[0].eval(ctx)
         cap = batch.capacity
@@ -71,7 +73,10 @@ class TpuGenerateExec(TpuExec):
             counts = jnp.where(active, jnp.maximum(lens, 1), 0)
         else:
             counts = lens
-        total = int(jnp.sum(counts))
+        # output capacity is data-dependent: one batched-funnel transfer
+        # resolves the exploded total (the decision boundary)
+        (total,) = resolve_scalars(jnp.sum(counts))
+        total = int(total)
         out_cap = bucket_rows(max(total, 1), self.min_bucket)
 
         cum = jnp.cumsum(counts)
@@ -108,4 +113,4 @@ class TpuGenerateExec(TpuExec):
         out_cols.append(DeviceColumn(evals, elem_valid, elem_dt, None))
         return DeviceTable(tuple(out_cols), row_ok,
                            jnp.asarray(total, jnp.int32),
-                           tuple(names + gen_names))
+                           tuple(names + gen_names)), total

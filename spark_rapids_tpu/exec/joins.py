@@ -34,7 +34,7 @@ import numpy as np
 
 from ..columnar import dtypes as dt
 from ..columnar.device import (DeviceColumn, DeviceTable, bucket_rows,
-                               resolve_min_bucket,
+                               resolve_min_bucket, resolve_scalars,
                                concat_device_tables, shrink_to_fit,
                                slice_rows)
 from ..expr.base import EvalContext, Expression
@@ -924,7 +924,10 @@ class TpuShuffledHashJoinExec(TpuExec):
                         canonical_names(2))
         h = get_catalog().register(t, SpillPriorities.ACTIVE_ON_DECK)
         self._own_spill_handle(h)
-        return (h, bool(np.asarray(unique)))
+        # uniqueness gates the PK fast path: one batched-funnel transfer
+        # per build table (cached across probe batches/partitions)
+        (uniq,) = resolve_scalars(unique)
+        return (h, bool(uniq))
 
     def _get_prep(self, build: DeviceTable):
         """Per-build-table sorted-key prep: (b_order, sv, nvalid, unique).
@@ -970,7 +973,8 @@ class TpuShuffledHashJoinExec(TpuExec):
                         canonical_names(2))
         h = get_catalog().register(t, SpillPriorities.ACTIVE_ON_DECK)
         self._own_spill_handle(h)
-        return (h, nvalid, bool(np.asarray(unique)))
+        (uniq,) = resolve_scalars(unique)
+        return (h, nvalid, bool(uniq))
 
     def _probe_join(self, build_handle, probe_batches, seen_box=None
                     ) -> Iterator[DeviceTable]:
@@ -1051,11 +1055,13 @@ class TpuShuffledHashJoinExec(TpuExec):
                         .with_names(probe.names)
                     continue
                 outer_slots = self.how in ("left", "full") and not has_cond
-                slot_counts = np.asarray(
+                # output capacity is data-dependent: one batched-funnel
+                # transfer resolves the slot total (the decision boundary)
+                (total,) = resolve_scalars(
                     jnp.sum(jnp.where(
                         probe.row_mask,
                         jnp.maximum(counts, 1) if outer_slots else counts, 0)))
-                total = int(slot_counts)
+                total = int(total)
                 max_out = self._max_out_rows()
                 if total > max_out:
                     # oversized gather: emit in probe row windows (reference:
@@ -1111,7 +1117,8 @@ class TpuShuffledHashJoinExec(TpuExec):
                          total: int, max_out: int, counts_fn, seen_box=None
                          ) -> Iterator[DeviceTable]:
         probe = probe.compact()
-        nrows = max(1, int(probe.num_rows))
+        (nrows,) = resolve_scalars(probe.num_rows)
+        nrows = max(1, int(nrows))
         # size windows by average multiplicity; skewed windows re-split below
         avg_mult = max(1.0, total / nrows)
         wsize = bucket_rows(max(self.min_bucket, int(max_out / avg_mult)),
@@ -1122,9 +1129,10 @@ class TpuShuffledHashJoinExec(TpuExec):
             window = slice_rows(probe, start, wsize)
             start += wsize
             b_order, starts, counts, _ = counts_fn(build, window)
-            wtotal = int(np.asarray(jnp.sum(jnp.where(
+            (wtotal,) = resolve_scalars(jnp.sum(jnp.where(
                 window.row_mask,
-                jnp.maximum(counts, 1) if outer_slots else counts, 0))))
+                jnp.maximum(counts, 1) if outer_slots else counts, 0)))
+            wtotal = int(wtotal)
             if wtotal == 0 and not outer_slots and self.condition is None \
                     and self.how not in ("left_semi", "left_anti"):
                 continue
@@ -1164,9 +1172,12 @@ class TpuShuffledHashJoinExec(TpuExec):
         probe_parts: List[List] = [[] for _ in range(n_sub)]
         try:
             for probe in _device_batches(self.left, pidx):
-                for s, t in enumerate(self._grace_split(
-                        probe, self.left_keys, n_sub)):
-                    if int(t.num_rows):
+                parts = self._grace_split(probe, self.left_keys, n_sub)
+                # one batched-funnel transfer resolves every bucket's
+                # count instead of n_sub per-bucket syncs
+                ns = resolve_scalars(*[t.num_rows for t in parts])
+                for s, (t, tn) in enumerate(zip(parts, ns)):
+                    if int(tn):
                         probe_parts[s].append(
                             catalog.register(t, SpillPriorities.INPUT))
             for s in range(n_sub):
@@ -1476,7 +1487,8 @@ class TpuBroadcastNestedLoopJoinExec(TpuExec):
         for sp in parts:
             for batch in _device_batches(self.left, sp):
                 batch = batch.compact()
-                nrows = max(0, int(batch.num_rows))
+                (nrows,) = resolve_scalars(batch.num_rows)
+                nrows = max(0, int(nrows))
                 start = 0
                 while start < nrows:
                     window = slice_rows(batch, start, ws)

@@ -68,7 +68,10 @@ class TpuParquetScanExec(TpuExec):
                     raw, pf.metadata, rg, pf.schema_arrow, cols,
                     self.min_bucket, conf=self.source.conf)
             self.metrics.add(M.NUM_OUTPUT_BATCHES, 1)
-            self.metrics.add(M.NUM_OUTPUT_ROWS, int(table.num_rows))
+            # row count from parquet metadata, not the device batch: the
+            # scan metric must not block on the decode's async dispatch
+            self.metrics.add(M.NUM_OUTPUT_ROWS,
+                             pf.metadata.row_group(rg).num_rows)
             self.metrics.add("deviceDecodedColumns", n_dev)
             yield table
 

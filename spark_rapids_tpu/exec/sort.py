@@ -23,7 +23,7 @@ import jax.numpy as jnp
 
 from ..columnar import dtypes as dt
 from ..columnar.device import (DeviceColumn, DeviceTable, append_column,
-                               resolve_min_bucket,
+                               resolve_min_bucket, resolve_scalars,
                                bucket_rows, concat_device_tables, drop_column,
                                shrink_to_fit, slice_rows)
 from ..expr.base import EvalContext
@@ -241,13 +241,18 @@ class TpuSortExec(TpuExec):
         try:
             with quarantine_on_failure(self), \
                     self.metrics.timed(M.SORT_TIME):
-                for b in batches:
-                    sorted_b = with_retry_split(
-                        lambda t: self._sort_fn(f"|cap{t.capacity}")(t), b,
-                        splitter=split_device_rows,
-                        combiner=self._sort_combine,
-                        scope="sort", context=self.node_desc())
-                    n = int(sorted_b.num_rows)
+                sorted_bs = [with_retry_split(
+                    lambda t: self._sort_fn(f"|cap{t.capacity}")(t), b,
+                    splitter=split_device_rows,
+                    combiner=self._sort_combine,
+                    scope="sort", context=self.node_desc())
+                    for b in batches]
+                # every run's sort dispatches before the host blocks:
+                # one batched-funnel transfer resolves all run counts
+                counts = resolve_scalars(
+                    *[b.num_rows for b in sorted_bs])
+                for sorted_b, n in zip(sorted_bs, counts):
+                    n = int(n)
                     if n:
                         runs.append((catalog.register(
                             sorted_b, SpillPriorities.INPUT), n))
@@ -297,20 +302,26 @@ class TpuSortExec(TpuExec):
                     scope="sort-merge", context=self.node_desc())
             sent = jnp.logical_and(sorted_m.column(_SENT).data,
                                    sorted_m.row_mask)
-            any_sent = bool(jnp.any(sent))
-            emit_n = int(jnp.argmax(sent)) if any_sent \
-                else int(sorted_m.num_rows)
+            # the emitted-count decision stays on device; ONE batched
+            # transfer then resolves both loop controls (emit count and
+            # carry count) instead of three scalar syncs per round
+            emit_dev = jnp.where(jnp.any(sent),
+                                 jnp.argmax(sent).astype(jnp.int32),
+                                 sorted_m.num_rows)
             iota = jnp.arange(sorted_m.capacity, dtype=jnp.int32)
+            rest_mask = jnp.logical_and(
+                iota >= emit_dev,
+                jnp.logical_not(sorted_m.column(_SENT).data))
+            rest = drop_column(sorted_m.filter_mask(rest_mask), _SENT)
+            emit_n, rest_n = resolve_scalars(emit_dev, rest.num_rows)
+            emit_n, rest_n = int(emit_n), int(rest_n)
             if emit_n > 0:
                 out = drop_column(
                     sorted_m.filter_mask(iota < emit_n), _SENT)
                 self.account_batch(rows=emit_n)
-                yield shrink_to_fit(out, self.min_bucket)
-            rest_mask = jnp.logical_and(
-                iota >= emit_n, jnp.logical_not(sorted_m.column(_SENT).data))
-            rest = drop_column(sorted_m.filter_mask(rest_mask), _SENT)
-            carry = shrink_to_fit(rest, self.min_bucket) \
-                if int(rest.num_rows) else None
+                yield shrink_to_fit(out, self.min_bucket, num_rows=emit_n)
+            carry = shrink_to_fit(rest, self.min_bucket, num_rows=rest_n) \
+                if rest_n else None
 
     def node_desc(self):
         return ", ".join(f"{o.expr!r} {'ASC' if o.ascending else 'DESC'}"

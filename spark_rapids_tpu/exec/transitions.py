@@ -289,9 +289,47 @@ class DeviceToHostExec(PhysicalPlan):
     def num_partitions(self) -> int:
         return self.child.num_partitions
 
-    def execute(self, pidx: int) -> Iterator[HostTable]:
+    def device_batches(self, pidx: int) -> List[DeviceTable]:
+        """Drain the child's device batches WITHOUT materializing — the
+        accumulate half of the deferred-D2H contract. Dispatch of later
+        batches overlaps device execution of earlier ones (JAX async
+        dispatch); nothing here blocks on device state."""
         # stage boundary: jitted compute (async dispatch) keeps running on
-        # the prefetch worker while this thread blocks in to_host()
+        # the prefetch worker while this thread accumulates/downloads
+        from ..parallel.pipeline import maybe_prefetched, stage_name
+        child = maybe_prefetched(
+            lambda: self.child.execute_columnar(pidx),
+            stage=f"compute:{stage_name(self.child)}", registry=self.metrics)
+        return list(child)
+
+    def download(self, batches: List[DeviceTable]) -> List[HostTable]:
+        """Materialize accumulated device batches in ONE bulk device_get
+        (columnar/device.py to_host_batched) — the other half of the
+        deferred-D2H contract; pipelined_collect calls this once per
+        output drain across every partition's batches."""
+        from ..columnar.device import to_host_batched
+        if not batches:
+            return []
+        with self.metrics.timed(M.DOWNLOAD_TIME), \
+                get_tracer().span("d2h_download", "download",
+                                  batches=len(batches)):
+            hts = to_host_batched(batches)
+        for batch, ht in zip(batches, hts):
+            self.metrics.add(M.DOWNLOAD_BYTES, batch.nbytes())
+            self.metrics.add(M.NUM_OUTPUT_BATCHES, 1)
+            self.metrics.add(M.NUM_OUTPUT_ROWS, ht.num_rows)
+        return hts
+
+    def execute(self, pidx: int) -> Iterator[HostTable]:
+        from ..columnar.device import async_enabled
+        if async_enabled():
+            # deferred D2H: accumulate the partition's device batches,
+            # then one bulk transfer for the whole drain
+            yield from self.download(self.device_batches(pidx))
+            return
+        # sync-forcing debug mode (spark.rapids.tpu.async.enabled=false):
+        # one blocking to_host per batch, so each download blocks at its
+        # own site in the ledger/trace
         from ..parallel.pipeline import maybe_prefetched, stage_name
         child = maybe_prefetched(
             lambda: self.child.execute_columnar(pidx),
@@ -299,7 +337,7 @@ class DeviceToHostExec(PhysicalPlan):
         for batch in child:
             with self.metrics.timed(M.DOWNLOAD_TIME), \
                     get_tracer().span("d2h_download", "download",
-                                      rows=int(batch.num_rows)):  # srtpu: sync-ok(trace-span rows at the deliberate download boundary)
+                                      rows=int(batch.num_rows)):  # srtpu: sync-ok(sync-forcing debug mode: trace-span rows at the per-batch download boundary)
                 ht = batch.to_host()
             self.metrics.add(M.DOWNLOAD_BYTES, batch.nbytes())
             self.metrics.add(M.NUM_OUTPUT_BATCHES, 1)
@@ -349,7 +387,10 @@ class TpuCoalesceBatchesExec(TpuExec):
         pending_rows = 0
         pending_bytes = 0
         for batch in self.child_device_batches(pidx):
-            n = int(batch.num_rows)
+            # capacity, not num_rows: the goal accounting stays sync-free
+            # (capacity >= num_rows, so the row/byte goals flush
+            # conservatively — never an over-sized concat)
+            n = batch.capacity
             nb = batch.nbytes()
             if self.require_single:
                 pending.append(batch)

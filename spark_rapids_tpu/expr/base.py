@@ -100,7 +100,7 @@ class EvalContext:
         return self._columns[name]
 
     def to_host_column(self, col: EvalCol) -> HostColumn:
-        return HostColumn(col.dtype, np.asarray(col.values)
+        return HostColumn(col.dtype, np.asarray(col.values)  # srtpu: sync-ok(deliberate host materialization boundary for the host-engine eval path)
                           if not isinstance(col.values, np.ndarray) else col.values,
                           col.validity)
 

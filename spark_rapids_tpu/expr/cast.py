@@ -51,10 +51,10 @@ class Cast(Expression):
             if ctx.is_device:
                 return EvalCol(c.values, c.validity, to, c.lengths)
             if isinstance(to, dt.BinaryType):
-                vals = np.asarray([v.encode() if isinstance(v, str) else v
+                vals = np.asarray([v.encode() if isinstance(v, str) else v  # srtpu: sync-ok(host-eval branch: object array from Python values, no device transfer)
                                    for v in c.values], dtype=object)
             else:
-                vals = np.asarray(
+                vals = np.asarray(  # srtpu: sync-ok(host-eval branch: object array from Python values, no device transfer)
                     [v.decode("utf-8", "replace")
                      if isinstance(v, (bytes, bytearray)) else v
                      for v in c.values], dtype=object)
@@ -86,10 +86,10 @@ class Cast(Expression):
                 small = v <= float(info.min)
                 safe = xp.where(nan | big | small, xp.zeros_like(v), v)
                 out = safe.astype(sat_np)
-                out = xp.where(big, np.asarray(info.max, dtype=sat_np), out)
-                out = xp.where(small, np.asarray(info.min, dtype=sat_np),
+                out = xp.where(big, np.asarray(info.max, dtype=sat_np), out)  # srtpu: sync-ok(np.asarray of a host finfo scalar constant — no device transfer)
+                out = xp.where(small, np.asarray(info.min, dtype=sat_np),  # srtpu: sync-ok(np.asarray of a host finfo scalar constant — no device transfer)
                                out)
-                out = xp.where(nan, np.asarray(0, dtype=sat_np), out)
+                out = xp.where(nan, np.asarray(0, dtype=sat_np), out)  # srtpu: sync-ok(np.asarray of a host finfo scalar constant — no device transfer)
                 return EvalCol(out.astype(np_to), c.validity, to)
             return EvalCol(c.values.astype(to.np_dtype()), c.validity, to)
         if isinstance(src, dt.DecimalType) and not isinstance(to, dt.DecimalType):
@@ -99,7 +99,7 @@ class Cast(Expression):
                     from .decimal128 import d128_to_f64
                     fvals = d128_to_f64(vals)
                 else:
-                    fvals = np.asarray([float(int(v)) for v in vals],
+                    fvals = np.asarray([float(int(v)) for v in vals],  # srtpu: sync-ok(host-eval branch: values are Python ints on the host path)
                                        dtype=np.float64)
             else:
                 fvals = vals.astype(xp.float64)
@@ -192,23 +192,23 @@ class Cast(Expression):
                 raise TypeError(f"device cast {src!r} -> string unsupported")
             return EvalCol(data, c.validity, dt.STRING, lengths)
         if isinstance(src, dt.BooleanType):
-            vals = np.asarray(["true" if v else "false" for v in c.values],
+            vals = np.asarray(["true" if v else "false" for v in c.values],  # srtpu: sync-ok(host-eval branch: formats host values into strings, no device transfer)
                               dtype=object)
         elif isinstance(src, dt.DateType):
             import datetime
-            vals = np.asarray(
+            vals = np.asarray(  # srtpu: sync-ok(host-eval branch: formats host values into strings, no device transfer)
                 [datetime.date.fromordinal(int(v) + 719163).isoformat()
                  for v in c.values], dtype=object)
         elif isinstance(src, dt.TimestampType):
-            vals = np.asarray([_format_timestamp(int(v)) for v in c.values],
+            vals = np.asarray([_format_timestamp(int(v)) for v in c.values],  # srtpu: sync-ok(host-eval branch: formats host values into strings, no device transfer)
                               dtype=object)
         elif isinstance(src, dt.DecimalType):
-            vals = np.asarray([_format_decimal(int(v), src.scale)
+            vals = np.asarray([_format_decimal(int(v), src.scale)  # srtpu: sync-ok(host-eval branch: formats host values into strings, no device transfer)
                                for v in c.values], dtype=object)
         elif src in (dt.FLOAT, dt.DOUBLE):
-            vals = np.asarray([repr(float(v)) for v in c.values], dtype=object)
+            vals = np.asarray([repr(float(v)) for v in c.values], dtype=object)  # srtpu: sync-ok(host-eval branch: formats host values into strings, no device transfer)
         else:
-            vals = np.asarray([str(int(v)) for v in c.values], dtype=object)
+            vals = np.asarray([str(int(v)) for v in c.values], dtype=object)  # srtpu: sync-ok(host-eval branch: formats host values into strings, no device transfer)
         return EvalCol(vals, c.validity, dt.STRING)
 
     # -- from string ----------------------------------------------------------

@@ -382,8 +382,8 @@ class FromUnixTime(Expression):
         c = self.sec.eval(ctx)
         sf = _java_fmt_to_strftime(self.fmt)
         out = [_dt.datetime.fromtimestamp(int(v), _dt.timezone.utc).strftime(sf)
-               for v in np.asarray(c.values)]
-        return EvalCol(np.asarray(out, dtype=object), c.validity, dt.STRING)
+               for v in np.asarray(c.values)]  # srtpu: sync-ok(host-only expression: values are host numpy on the host-eval path)
+        return EvalCol(np.asarray(out, dtype=object), c.validity, dt.STRING)  # srtpu: sync-ok(host-only expression: builds an object array from Python strings)
 
 
 class DateFormatClass(Expression):
@@ -404,7 +404,7 @@ class DateFormatClass(Expression):
         import datetime as _dt
         c = self.child.eval(ctx)
         sf = _java_fmt_to_strftime(self.fmt)
-        vals = np.asarray(c.values)
+        vals = np.asarray(c.values)  # srtpu: sync-ok(host-only expression: values are host numpy on the host-eval path)
         out = []
         for v in vals:
             if isinstance(c.dtype, dt.DateType):
@@ -413,7 +413,7 @@ class DateFormatClass(Expression):
             else:
                 t = _dt.datetime.fromtimestamp(int(v) / 1e6, _dt.timezone.utc)
             out.append(t.strftime(sf))
-        return EvalCol(np.asarray(out, dtype=object), c.validity, dt.STRING)
+        return EvalCol(np.asarray(out, dtype=object), c.validity, dt.STRING)  # srtpu: sync-ok(host-only expression: builds an object array from Python strings)
 
 
 class TruncDate(Expression):

@@ -282,10 +282,10 @@ class XxHash64(Expression):
                     # vectorized device kernel over the byte matrix
                     nh = _xx_bytes_device(c.values, c.lengths, h)
                 else:
-                    nh = np.asarray([_xx_bytes_host(
+                    nh = np.asarray([_xx_bytes_host(  # srtpu: sync-ok(host-eval branch — ctx.is_device is false; inputs are host values)
                         s.encode() if isinstance(s, str) else bytes(s),
                         int(sd))
-                        for s, sd in zip(c.values, np.asarray(h))],
+                        for s, sd in zip(c.values, np.asarray(h))],  # srtpu: sync-ok(host-eval branch — ctx.is_device is false; inputs are host values)
                         dtype=np.uint64)
             elif isinstance(d, dt.BooleanType):
                 nh = _xx_int(xp, c.values.astype(xp.uint32), h)

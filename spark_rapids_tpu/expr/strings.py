@@ -111,7 +111,7 @@ class UnaryString(Expression):
         c = self.child.eval(ctx)
         if ctx.is_device:
             return self._eval_device(ctx, c)
-        vals = np.asarray([self._host_one(s) for s in c.values], dtype=object)
+        vals = np.asarray([self._host_one(s) for s in c.values], dtype=object)  # srtpu: sync-ok(host-eval path builds an object array from Python strings — no device transfer)
         return EvalCol(vals, c.validity, self.data_type)
 
     def _host_one(self, s: str):
@@ -209,7 +209,7 @@ class Length(Expression):
             xp = ctx.xp
             n = _char_starts(xp, c.values, c.lengths).sum(axis=1)
             return EvalCol(n.astype(xp.int32), c.validity, dt.INT)
-        vals = np.asarray([len(s) for s in c.values], dtype=np.int32)
+        vals = np.asarray([len(s) for s in c.values], dtype=np.int32)  # srtpu: sync-ok(host-eval path builds an object array from Python strings — no device transfer)
         return EvalCol(vals, c.validity, dt.INT)
 
 
@@ -226,7 +226,7 @@ class OctetLength(Expression):
         c = self.child.eval(ctx)
         if ctx.is_device:
             return EvalCol(c.lengths.astype(ctx.xp.int32), c.validity, dt.INT)
-        vals = np.asarray([_utf8_len(s) for s in c.values], dtype=np.int32)
+        vals = np.asarray([_utf8_len(s) for s in c.values], dtype=np.int32)  # srtpu: sync-ok(host-eval path builds an object array from Python strings — no device transfer)
         return EvalCol(vals, c.validity, dt.INT)
 
 
@@ -253,7 +253,7 @@ class Ascii(Expression):
             xp = ctx.xp
             first = c.values[:, 0].astype(xp.int32)
             return EvalCol(xp.where(c.lengths > 0, first, 0), c.validity, dt.INT)
-        vals = np.asarray([ord(s[0]) if len(s) else 0 for s in c.values],
+        vals = np.asarray([ord(s[0]) if len(s) else 0 for s in c.values],  # srtpu: sync-ok(host-eval path builds an object array from Python strings — no device transfer)
                           dtype=np.int32)
         return EvalCol(vals, c.validity, dt.INT)
 
@@ -288,7 +288,7 @@ class Chr(Expression):
                 .astype(xp.int32)
             return EvalCol(_zero_tail(xp, data, lengths), c.validity,
                            dt.STRING, lengths)
-        vals = np.asarray([chr(int(v) & 0xFF) if int(v) >= 0 else ""
+        vals = np.asarray([chr(int(v) & 0xFF) if int(v) >= 0 else ""  # srtpu: sync-ok(host-eval path builds an object array from Python strings — no device transfer)
                            for v in c.values], dtype=object)
         return EvalCol(vals, c.validity, dt.STRING)
 
@@ -321,7 +321,7 @@ class Substring(Expression):
             out = []
             for s, pos, ln in zip(c.values, p.values, l.values):
                 out.append(_host_substr(s, int(pos), int(ln)))
-            return EvalCol(np.asarray(out, dtype=object), validity, dt.STRING)
+            return EvalCol(np.asarray(out, dtype=object), validity, dt.STRING)  # srtpu: sync-ok(host-eval path builds an object array from Python strings — no device transfer)
         xp = ctx.xp
         v, lengths = c.values, c.lengths
         w = v.shape[1]
@@ -379,7 +379,7 @@ class SubstringIndex(Expression):
         out = []
         for s in c.values:
             out.append(_substring_index(s, delim, cnt))
-        return EvalCol(np.asarray(out, dtype=object), c.validity, dt.STRING)
+        return EvalCol(np.asarray(out, dtype=object), c.validity, dt.STRING)  # srtpu: sync-ok(host-eval path builds an object array from Python strings — no device transfer)
 
     def _eval_device(self, ctx, c, delim: str, cnt: int) -> EvalCol:
         xp = ctx.xp
@@ -469,7 +469,7 @@ class BinaryStringPredicate(Expression):
         r = self.right.eval(ctx)
         validity = _combine_validity(ctx, l, r)
         if not ctx.is_device:
-            vals = np.asarray([self._host_one(a, b)
+            vals = np.asarray([self._host_one(a, b)  # srtpu: sync-ok(host-eval path builds an object array from Python strings — no device transfer)
                                for a, b in zip(l.values, r.values)])
             return EvalCol(vals, validity, dt.BOOLEAN)
         return EvalCol(self._eval_device(ctx, l, r), validity, dt.BOOLEAN)
@@ -552,7 +552,7 @@ class StringLocate(Expression):
                     out.append(0)
                 else:
                     out.append(a.find(b, k - 1) + 1)
-            return EvalCol(np.asarray(out, dtype=np.int32), validity, dt.INT)
+            return EvalCol(np.asarray(out, dtype=np.int32), validity, dt.INT)  # srtpu: sync-ok(host-eval path builds an object array from Python strings — no device transfer)
         xp = ctx.xp
         pat = literal_value(self.substr)
         start = int(literal_value(self.start) or 1)
@@ -614,7 +614,7 @@ class Concat(Expression):
             validity = _combine_validity(
                 ctx, EvalCol(None, validity, dt.STRING), c)
         if not ctx.is_device:
-            vals = np.asarray(["".join(parts) for parts in
+            vals = np.asarray(["".join(parts) for parts in  # srtpu: sync-ok(host-eval path builds an object array from Python strings — no device transfer)
                                zip(*[c.values for c in cols])], dtype=object)
             return EvalCol(vals, validity, dt.STRING)
         acc = cols[0]
@@ -671,7 +671,7 @@ class ConcatWs(Expression):
         for i in range(n):
             parts = [c.values[i] for c, m in zip(cols, masks) if m[i]]
             out.append(sep.values[i].join(parts))
-        return EvalCol(np.asarray(out, dtype=object), sep.validity, dt.STRING)
+        return EvalCol(np.asarray(out, dtype=object), sep.validity, dt.STRING)  # srtpu: sync-ok(host-eval path builds an object array from Python strings — no device transfer)
 
     def _eval_device(self, ctx, sep, cols) -> EvalCol:
         xp = ctx.xp
@@ -716,7 +716,7 @@ class StringRpad(Expression):
             out = []
             for s, k, p in zip(c.values, ln.values, pd.values):
                 out.append(_host_pad(s, int(k), p, self.pad_left))
-            return EvalCol(np.asarray(out, dtype=object), validity, dt.STRING)
+            return EvalCol(np.asarray(out, dtype=object), validity, dt.STRING)  # srtpu: sync-ok(host-eval path builds an object array from Python strings — no device transfer)
         xp = ctx.xp
         pad = literal_value(self.pad)
         tgt = int(literal_value(self.length))
@@ -776,7 +776,7 @@ class StringRepeat(Expression):
         t = self.times.eval(ctx)
         validity = _combine_validity(ctx, c, t)
         if not ctx.is_device:
-            vals = np.asarray([s * max(int(k), 0)
+            vals = np.asarray([s * max(int(k), 0)  # srtpu: sync-ok(host-eval path builds an object array from Python strings — no device transfer)
                                for s, k in zip(c.values, t.values)], dtype=object)
             return EvalCol(vals, validity, dt.STRING)
         xp = ctx.xp
@@ -823,7 +823,7 @@ class StringTrim(Expression):
                 f = lambda s: s.lstrip(" ")
             else:
                 f = lambda s: s.rstrip(" ")
-            vals = np.asarray([f(s) for s in c.values], dtype=object)
+            vals = np.asarray([f(s) for s in c.values], dtype=object)  # srtpu: sync-ok(host-eval path builds an object array from Python strings — no device transfer)
             return EvalCol(vals, c.validity, dt.STRING)
         xp = ctx.xp
         v, lengths = c.values, c.lengths
@@ -886,7 +886,7 @@ class StringReplace(Expression):
         out = []
         for a, b, rep in zip(c.values, s.values, r.values):
             out.append(a.replace(b, rep) if b else a)
-        return EvalCol(np.asarray(out, dtype=object), validity, dt.STRING)
+        return EvalCol(np.asarray(out, dtype=object), validity, dt.STRING)  # srtpu: sync-ok(host-eval path builds an object array from Python strings — no device transfer)
 
 
 class Like(Expression):
@@ -959,7 +959,7 @@ class Like(Expression):
         if not ctx.is_device:
             import re as _re
             rx = _re.compile(self.to_regex(), _re.DOTALL)
-            vals = np.asarray([rx.match(s) is not None for s in c.values])
+            vals = np.asarray([rx.match(s) is not None for s in c.values])  # srtpu: sync-ok(host-eval path builds an object array from Python strings — no device transfer)
             return EvalCol(vals, c.validity, dt.BOOLEAN)
         xp = ctx.xp
         if kind is not None:
@@ -1001,7 +1001,7 @@ class RLike(Expression):
         if not ctx.is_device:
             import re as _re
             rx = _re.compile(pat)
-            vals = np.asarray([rx.search(s) is not None for s in c.values])
+            vals = np.asarray([rx.search(s) is not None for s in c.values])  # srtpu: sync-ok(host-eval path builds an object array from Python strings — no device transfer)
             return EvalCol(vals, c.validity, dt.BOOLEAN)
         from .regex import compile_device_nfa
         nfa = compile_device_nfa(pat)
@@ -1052,7 +1052,7 @@ class RegExpExtract(Expression):
         for s in c.values:
             m = rx.search(s)
             out.append(m.group(gi) if m and m.group(gi) is not None else "")
-        return EvalCol(np.asarray(out, dtype=object), c.validity, dt.STRING)
+        return EvalCol(np.asarray(out, dtype=object), c.validity, dt.STRING)  # srtpu: sync-ok(host-eval path builds an object array from Python strings — no device transfer)
 
 
 class RegExpReplace(Expression):
@@ -1087,7 +1087,7 @@ class RegExpReplace(Expression):
         rx = _re.compile(literal_value(self.pattern))
         rep = _java_repl_to_python(literal_value(self.replacement))
         out = [rx.sub(rep, s) for s in c.values]
-        return EvalCol(np.asarray(out, dtype=object), c.validity, dt.STRING)
+        return EvalCol(np.asarray(out, dtype=object), c.validity, dt.STRING)  # srtpu: sync-ok(host-eval path builds an object array from Python strings — no device transfer)
 
 
 def _java_repl_to_python(repl: str) -> str:

@@ -535,6 +535,16 @@ def pipelined_collect(plan, conf=None):
         return plan.collect()
     sem = get_semaphore(conf)
     tracer = get_tracer()
+    # async-first drain (ROADMAP item 1): when the plan root is a
+    # DeviceToHostExec and async execution is on, tasks accumulate DEVICE
+    # batches — no task ever blocks in to_host, so partition P+1's
+    # dispatch overlaps partition P's device execution — and the whole
+    # query materializes in ONE bulk device_get after every partition
+    # drains (exec/transitions.py download -> device.py to_host_batched).
+    from ..columnar.device import async_enabled
+    deferred = (async_enabled()
+                and hasattr(plan, "device_batches")
+                and hasattr(plan, "download"))
     # num_partitions above may have run AQE stage materialization on THIS
     # thread; operators (python-UDF exec) end that work re-holding the
     # semaphore for the "task" to release. This thread's task is done —
@@ -545,6 +555,10 @@ def pipelined_collect(plan, conf=None):
     def drain(p: int):
         with tracer.span("task", "task", partition=p, pipelined=True), \
                 _task_admission():
+            if deferred:
+                # no iterator to close: device_batches drains eagerly
+                with sem.task_scope():
+                    return plan.device_batches(p)
             it = plan.execute(p)
             try:
                 # task_scope, not held(): operators (python-UDF exec) may
@@ -565,6 +579,10 @@ def pipelined_collect(plan, conf=None):
     finally:
         sem.release_all()  # holds a failed/partial run left on this thread
     batches = [b for part in per_part for b in part]
+    if deferred:
+        # one bulk transfer for the whole output drain (the ≤1-device_get
+        # pin in tests/test_async_exec.py holds across partitions too)
+        batches = plan.download(batches)
     if not batches:
         from ..plan.physical import empty_result_table
         return empty_result_table(plan.schema)

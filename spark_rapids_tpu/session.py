@@ -66,6 +66,11 @@ class TpuSession:
         # (gather all-valid guard; columnar/device.py)
         from .columnar.device import configure_debug
         configure_debug(self.conf)
+        # async-first execution (spark.rapids.tpu.async.enabled): deferred
+        # scalar resolution + bulk per-drain downloads, or the sync-forcing
+        # debug mode (columnar/device.py DeferredScalar/to_host_batched)
+        from .columnar.device import configure_async
+        configure_async(self.conf)
         # memory flight recorder (spark.rapids.tpu.memory.profile.*):
         # buffer-lifecycle attribution, leak scans and OOM postmortems
         # (utils/memprof.py; the catalog emits into it)

@@ -53,8 +53,8 @@ def shard_table(table: DeviceTable, mesh: Mesh, axis: str = "dp"
 def unshard_table(table: DeviceTable) -> DeviceTable:
     import numpy as np
     cols = jax.tree_util.tree_map(
-        lambda a: jnp.asarray(np.asarray(a)), table.columns)
-    mask = jnp.asarray(np.asarray(table.row_mask))
+        lambda a: jnp.asarray(np.asarray(a)), table.columns)  # srtpu: sync-ok(deliberate unshard gather: host materialization at the shuffle boundary)
+    mask = jnp.asarray(np.asarray(table.row_mask))  # srtpu: sync-ok(deliberate unshard gather: host materialization at the shuffle boundary)
     return DeviceTable(cols, mask, jnp.sum(mask, dtype=jnp.int32), table.names)
 
 

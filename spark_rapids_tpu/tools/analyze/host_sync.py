@@ -28,7 +28,13 @@ the call patterns that force the host to block on device state:
                              fixture files are exempt); deliberate
                              unledgered syncs carry the same
                              ``# srtpu: sync-ok(reason)`` suppression as
-                             the other sync rules.
+                             the other sync rules. The async-first
+                             funnels — ``resolve_scalars`` (batched
+                             scalar decisions) and ``to_host_batched``
+                             (one bulk download per drain), both in
+                             columnar/device.py — note to the ledger
+                             internally, so a scope that routes its
+                             syncs through them counts as ledgered.
 
 Only ``hot`` and ``warm`` packages are scanned (exec/, expr/,
 columnar/, shuffle/, memory/ + the per-partition tier); tools and
@@ -56,6 +62,11 @@ REPORTED_SEVERITIES = ("hot", "warm")
 #: utils/movement.py hooks whose presence in a scope marks its syncs as
 #: ledgered (the funnel reports the crossing to the observatory)
 _LEDGER_HOOKS = ("note_d2h", "note_h2d", "clock")
+
+#: columnar/device.py funnels that note to the movement ledger
+#: internally — calling one makes the caller's scope ledgered too
+#: (the async-first batched-scalar and bulk-download funnels)
+_LEDGER_FUNNELS = ("resolve_scalars", "to_host_batched")
 
 
 def _movement_eligible(ctx) -> bool:
@@ -94,6 +105,8 @@ class _SyncVisitor(ScopedVisitor):
         if (self.movement_eligible and attr in _LEDGER_HOOKS
                 and self.ctx.qualify(node.func.value)
                     .endswith("movement")):
+            self.ledgered_symbols.add(self.symbol)
+        if self.movement_eligible and _tail(q, 1) in _LEDGER_FUNNELS:
             self.ledgered_symbols.add(self.symbol)
         if attr == "item" and not node.args and not node.keywords:
             self._hit(node, "sync-item", f"{_tail(q) or '.item'}()")

@@ -50,7 +50,8 @@ __all__ = ["OpDelta", "QueryDelta", "CompareReport", "compare_event_logs",
            "critical_path_fractions", "critical_path_delta",
            "memory_delta", "movement_delta", "CP_FRAC_FLAG_PP",
            "MEM_PEAK_FLAG_FRAC", "MEM_PEAK_FLAG_MIN_BYTES",
-           "MOVE_BYTES_FLAG_FRAC", "MOVE_BYTES_FLAG_MIN"]
+           "MOVE_BYTES_FLAG_FRAC", "MOVE_BYTES_FLAG_MIN",
+           "SYNC_WAIT_GATE_FRAC"]
 
 #: category-fraction growth (candidate minus baseline) that flags a
 #: critical-path regression: 5 percentage points
@@ -75,6 +76,15 @@ MOVE_BYTES_FLAG_FRAC = 0.10
 #: buckets round batch capacities, so tiny queries jitter in bytes
 #: run-to-run; both conditions must hold, like the memory gate
 MOVE_BYTES_FLAG_MIN = 1 << 20
+
+#: ABSOLUTE sync-wait ceiling for the candidate run: a query spending
+#: more than 10% of its wall blocked on device->host syncs fails the
+#: async-first budget regardless of how the baseline did — this is a
+#: gate on the candidate, not a delta, so a regression that was already
+#: present in the baseline still flags. The violation names the
+#: heaviest movement-ledger funnel (bench "sync_top_site") so the fix
+#: starts at a file:symbol, not a number.
+SYNC_WAIT_GATE_FRAC = 0.10
 
 
 def movement_delta(mv_a: Optional[Dict], mv_b: Optional[Dict],
@@ -211,6 +221,12 @@ class QueryDelta:
     move_flagged: List[str] = dataclasses.field(default_factory=list)
     #: the baseline's absolute movement numbers (for % rendering)
     move_base: Dict[str, float] = dataclasses.field(default_factory=dict)
+    #: candidate sync-wait fraction when it exceeds SYNC_WAIT_GATE_FRAC
+    #: (None otherwise) — the absolute async-first budget gate
+    sync_gate_frac: Optional[float] = None
+    #: the heaviest movement-ledger funnel during the candidate run
+    #: (bench "sync_top_site"); where a sync_gate violation points
+    sync_top_site: str = ""
 
     @property
     def delta_s(self) -> float:
@@ -254,6 +270,14 @@ class CompareReport:
         orthogonal to wall time like the memory gate: extra transfers
         hide on a fast link and sink the scale-up."""
         return [q for q in self.queries if q.move_flagged]
+
+    def sync_wait_violations(self) -> List[QueryDelta]:
+        """Queries whose CANDIDATE run spent more than
+        SYNC_WAIT_GATE_FRAC of wall blocked on device->host syncs — an
+        absolute budget, not a delta, so debt the baseline already
+        carried still fails; each violation names the heaviest
+        movement-ledger funnel to fix first."""
+        return [q for q in self.queries if q.sync_gate_frac is not None]
 
     def summary(self) -> str:
         lines = [f"compare: A={self.label_a}  B={self.label_b}  "
@@ -324,6 +348,13 @@ class CompareReport:
                             if q.move_base.get(k) else f"{k} grew"
                             for k in q.move_flagged)
                         + f" (gate {MOVE_BYTES_FLAG_FRAC:.0%})")
+            if q.sync_gate_frac is not None:
+                site = q.sync_top_site or "(no ledger attribution)"
+                lines.append(
+                    f"  ** SYNC-WAIT GATE: {q.sync_gate_frac:.1%} of "
+                    f"wall blocked on device->host syncs (budget "
+                    f"{SYNC_WAIT_GATE_FRAC:.0%}) — heaviest funnel: "
+                    f"{site}")
         if self.only_in_a:
             lines.append(f"queries only in A: {self.only_in_a}")
         if self.only_in_b:
@@ -336,7 +367,9 @@ class CompareReport:
                      f"{len(self.memory_regressions())} "
                      "peak-memory regression(s), "
                      f"{len(self.movement_regressions())} "
-                     "transfer-byte regression(s)")
+                     "transfer-byte regression(s), "
+                     f"{len(self.sync_wait_violations())} "
+                     "sync-wait gate violation(s)")
         return "\n".join(lines)
 
 
@@ -497,6 +530,14 @@ def compare_bench_results(path_a: str, path_b: str, threshold: float = 0.2,
             mv_a = _bench_movement(qs_a[name])
             mv_b = _bench_movement(qs_b[name])
             move_deltas, move_flagged = movement_delta(mv_a, mv_b)
+            # absolute sync-wait budget on the CANDIDATE run: > 10% of
+            # wall blocked on syncs fails even if the baseline was just
+            # as bad; the heaviest ledger funnel gives the fix a target
+            frac_b = qs_b[name].get("sync_wait_frac")
+            gate_frac = (float(frac_b)
+                         if frac_b is not None
+                         and float(frac_b) > SYNC_WAIT_GATE_FRAC
+                         else None)
             queries.append(QueryDelta(
                 label, wall_a, wall_b, regressed,
                 [OpDelta(label, name, 0, wall_a, wall_b, 0, 0,
@@ -505,7 +546,9 @@ def compare_bench_results(path_a: str, path_b: str, threshold: float = 0.2,
                 mem_deltas, mem_flagged,
                 {k: float(v) for k, v in (mem_a or {}).items()},
                 move_deltas, move_flagged,
-                {k: float(v) for k, v in (mv_a or {}).items()}))
+                {k: float(v) for k, v in (mv_a or {}).items()},
+                sync_gate_frac=gate_frac,
+                sync_top_site=str(qs_b[name].get("sync_top_site") or "")))
     return CompareReport(path_a, path_b, queries, threshold,
                          only_a, only_b)
 

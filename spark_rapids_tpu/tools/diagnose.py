@@ -520,6 +520,47 @@ def _critical_path_findings(cp: Optional[Dict],
     return out
 
 
+#: the async-first sync-wait budget: a query blocking more than 10% of
+#: its wall on device->host syncs violates the ISSUE-18 acceptance
+#: floor regardless of composition deltas — an absolute gate, matching
+#: tools/compare.py SYNC_WAIT_GATE_FRAC
+_SYNC_WAIT_GATE_FRAC = 0.10
+
+
+def _sync_wait_gate_findings(cp: Optional[Dict], mv: Optional[Dict],
+                             wall: float) -> List[Finding]:
+    """The async-first budget gate: traced sync_wait over 10% of wall is
+    a violation (not just a ranked share), and the finding names the
+    heaviest movement-ledger funnel so the fix starts at a file:symbol.
+    Needs the tracer's critical path; the ledger only sharpens the
+    attribution."""
+    if not cp or wall <= 0:
+        return []
+    sync_s = float((cp.get("categories_s") or {}).get("sync_wait", 0.0))
+    frac = sync_s / wall
+    if frac <= _SYNC_WAIT_GATE_FRAC:
+        return []
+    sites = (mv or {}).get("sites") or []
+    top = max(sites, key=lambda s: float(s.get("wall_s") or 0.0)) \
+        if sites else None
+    where = (f"heaviest ledger funnel: {top['site']} "
+             f"({float(top.get('wall_s') or 0.0):.4f}s, "
+             f"{top.get('bytes', 0)} bytes)") if top else \
+        "movement ledger off — enable spark.rapids.tpu.movement.enabled " \
+        "for funnel attribution"
+    return [Finding(
+        node="(query)", node_id=None, metric="syncWaitGate",
+        seconds=sync_s, fraction=frac,
+        detail=f"sync wait holds {frac:.1%} of wall "
+               f"({sync_s:.4f}s of {wall:.4f}s) — over the "
+               f"{_SYNC_WAIT_GATE_FRAC:.0%} async-first budget; {where}",
+        suggestion="route the named funnel through the batched "
+                   "resolve_scalars / to_host_batched endpoints "
+                   "(columnar/device.py) or defer the decision the sync "
+                   "feeds — spark.rapids.tpu.async.enabled=false "
+                   "localizes the stall for bisection")]
+
+
 #: a partition holding more than 2x the mean rows of its exchange is
 #: skewed enough to flag — the straggler partition alone bounds the
 #: stage's wall time, so past 2x half the fleet idles behind it
@@ -893,6 +934,11 @@ def _diagnose_query(q, heartbeats=None) -> Optional[QueryDiagnosis]:
     # 12. data-movement ledger (schema v11): round-trip batches and the
     # funnels whose measured crossings hold a share of the query wall
     findings.extend(_movement_findings(q, wall))
+
+    # 13. the async-first budget gate: sync wait past 10% of wall is a
+    # hard violation, attributed to the heaviest movement-ledger funnel
+    findings.extend(_sync_wait_gate_findings(
+        cp, getattr(q, "movement_summary", None), wall))
 
     findings.sort(key=lambda f: -f.fraction)
     return QueryDiagnosis(q.query_id, wall, findings, critical_path=cp,

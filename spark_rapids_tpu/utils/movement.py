@@ -119,6 +119,21 @@ SITES: Dict[str, MovementSite] = {
             "::shrink_to_fit",
         ), "4-byte row-count sync per compaction — thread num_rows in "
            "from a caller that already synced it"),
+    "spark_rapids_tpu/columnar/device.py::resolve_scalars":
+        MovementSite("d2h", (
+            "spark_rapids_tpu/columnar/device.py::sync-device-get"
+            "::resolve_scalars",
+        ), "the batched-scalar funnel (DeferredScalar boundary): one "
+           "transfer per host decision, 4B per scalar — growth here "
+           "tracks decision points, not data; widen the batch (hand "
+           "more scalars to one call) before anything else"),
+    "spark_rapids_tpu/columnar/device.py::to_host_batched":
+        MovementSite("d2h", (
+            "spark_rapids_tpu/columnar/device.py::sync-device-get"
+            "::to_host_batched",
+        ), "the deferred-D2H drain funnel: one bulk device_get per "
+           "output drain — already the async-first endpoint; growth "
+           "here is real result volume, not sync debt"),
     "spark_rapids_tpu/exec/exchange.py"
     "::TpuShuffleExchangeExec._exchange_chunk":
         MovementSite("d2h", (
@@ -129,13 +144,6 @@ SITES: Dict[str, MovementSite] = {
         ), "count pass + bulk shard-rows sync per exchanged chunk — "
            "double-buffer so chunk N's count pass overlaps chunk N-1's "
            "all-to-all"),
-    "spark_rapids_tpu/exec/exchange.py"
-    "::TpuLocalExchangeExec._materialize_locked.drain":
-        MovementSite("d2h", (
-            "spark_rapids_tpu/exec/exchange.py::sync-int-scalar"
-            "::TpuLocalExchangeExec._materialize_locked.drain",
-        ), "per-batch 4-byte row-count sync on the map drain — batch "
-           "the counts into one bulk device_get per partition"),
     "spark_rapids_tpu/shuffle/manager.py"
     "::ShuffleManager._write_partition_transport":
         MovementSite("d2h", (

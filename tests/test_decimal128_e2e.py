@@ -168,8 +168,11 @@ def test_d128_sum_overflow_to_null(session):
     assert dev[1] is None and cpu[1] is None  # 1.5e38 >= 10^38
 
 
+@pytest.mark.slow
 def test_decimal_tpch_q1_q6(session):
-    """Q1/Q6 over DECIMAL(12,2) lineitem: device vs host vs exact Decimal."""
+    """Q1/Q6 over DECIMAL(12,2) lineitem: device vs host vs exact Decimal.
+    Slow tier (~15s of compiles); tier-1 keeps the cheaper
+    test_d128_q1_style_device_plan pin on the same decimal agg lowering."""
     from decimal import Decimal as D
 
     from spark_rapids_tpu.tools import tpch

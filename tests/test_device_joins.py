@@ -180,9 +180,12 @@ def test_device_bnlj(session, rng, how):
     assert_tpu_cpu_equal(q)
 
 
+@pytest.mark.slow
 def test_bnlj_unmatched_broadcast_rows_once(session, rng):
     """right/full BNLJ: unmatched broadcast rows appear exactly once even
-    with multiple stream partitions and batches."""
+    with multiple stream partitions and batches. Slow tier: compiles the
+    BNLJ kernel for two join kinds (~27s); tier-1 keeps the hash-join
+    unmatched-once guard (test_right_outer_not_broadcast_with_partitions)."""
     lt = data_gen(rng, 50, {"a": ("int64", 0, 10)}, null_prob=0.0)
     rt = pa.table({"b": [5, 1000]})
     l = session.create_dataframe(lt, num_partitions=3)

@@ -129,7 +129,12 @@ def _apply_random_op(rng, df, other):
     return df.select("k", *NUM_COLS).distinct()
 
 
-@pytest.mark.parametrize("seed", range(36))
+# Tier-1 keeps a 4-seed sweep (even/odd split still exercises AQE both
+# ways); the long tail of seeds stays in the slow tier.
+@pytest.mark.parametrize(
+    "seed",
+    [s if s < 4 else pytest.param(s, marks=pytest.mark.slow)
+     for s in range(36)])
 def test_random_pipeline_differential(seed):
     rng = np.random.default_rng(1000 + seed)
     sess = TpuSession({
