@@ -90,6 +90,21 @@ overhead; BENCH_FALLBACK_SF scales the data, and the history sentinel
 treats a fallback-recovered run as clean — run_sentinel exempts
 queries whose event log carries schema-v10 fallback records and no
 error).
+BENCH_SHUFFLE (1|0, default on: the shuffle observatory
+(shuffle/telemetry.py) per phase — each query's res gains
+"shuffle_wall_s" + "shuffle_wall_frac" + "wire_bytes" and the event
+log gets real v12 shuffle_summary payloads; tools/compare.py diffs the
+per-query numbers across rounds and gates >10% shuffle-wall / wire-byte
+growth).
+`bench.py --multichip [out.json]` is a separate parent mode: the
+MULTICHIP trajectory phase runs q3/q5/q7 on an
+BENCH_MULTICHIP_DEVICES (default 8) virtual-device CPU mesh — the ICI
+all-to-all shuffle tier — and writes per-query wall, shuffle wall,
+per-tier transfer breakdown, wire bytes and straggler stats to the
+JSON. On a per-query timeout (BENCH_MULTICHIP_QUERY_TIMEOUT_S,
+in-worker alarm) or worker death the JSON carries the partial per-query
+results plus the observatory's forensics ring for the failed query —
+never an opaque {rc, tail} stub.
 """
 import atexit
 import json
@@ -115,6 +130,8 @@ _STATE = {
     "ablation": {},
     "restart": {},
     "chaos": {},      # query -> clean-vs-injected parity + recovery ledger
+    "multichip": {},  # query -> mesh wall + shuffle tier breakdown
+    "multichip_forensics": {},  # query -> timeout/crash observatory dump
     "oom": {},        # query -> pressure-vs-clean parity + retry ladder deltas
     "fallback": {},   # query -> degraded-vs-clean parity + fallback counters
     "compile_cache": {},   # phase -> cache_stats() snapshot
@@ -364,6 +381,8 @@ def _consume(ev):
             _STATE["memory"].update(ev["memory"])
         if "history" in ev:
             _STATE["history"].update(ev["history"])
+        if "multichip_forensics" in ev:
+            _STATE["multichip_forensics"].update(ev["multichip_forensics"])
     elif kind == "ablation":
         _STATE["ablation"][ev["name"]] = ev["res"]
     _write_partial()
@@ -751,6 +770,46 @@ def _movement_res(before: dict) -> dict:
     return res
 
 
+def _shuffle_conf() -> dict:
+    """Enable the shuffle observatory so every timed query's res carries
+    its shuffle cost (shuffle wall, wire bytes) and the event log gets
+    real v12 shuffle_summary payloads. BENCH_SHUFFLE=0 disables."""
+    if os.environ.get("BENCH_SHUFFLE", "1") == "0":
+        return {}
+    return {"spark.rapids.tpu.shuffle.telemetry.enabled": True}
+
+
+def _shuffle_probe() -> dict:
+    """Snapshot of the process-wide shuffle-observatory totals ({} when
+    the observatory is off) — diff two around a timed run for that run's
+    shuffle cost. Never fails the bench."""
+    try:
+        from spark_rapids_tpu.shuffle.telemetry import active
+        obs = active()
+        return dict(obs.totals()) if obs is not None else {}
+    except Exception:
+        return {}
+
+
+def _shuffle_res(before: dict, wall_s: float) -> dict:
+    """Shuffle-total deltas across one timed run, keyed the way
+    tools/compare.py's bench shuffle gate reads them ("shuffle_wall_s" +
+    "wire_bytes"); {} when the observatory is off. "shuffle_wall_frac"
+    is the run's shuffle wall over its total wall — the ROADMAP item 3
+    trajectory number."""
+    after = _shuffle_probe()
+    if not after or not before:
+        return {}
+    sh_wall = float(after.get("wall_s", 0.0) - before.get("wall_s", 0.0))
+    return {
+        "shuffle_wall_s": round(sh_wall, 4),
+        "shuffle_wall_frac": round(sh_wall / wall_s, 4)
+        if wall_s > 0 else 0.0,
+        "wire_bytes": int(after.get("wire_bytes", 0)
+                          - before.get("wire_bytes", 0)),
+    }
+
+
 def _bench_critical_path():
     """Critical-path breakdown of the NEWEST query span in the live
     tracer ring (the query the caller just timed): category seconds +
@@ -931,6 +990,7 @@ def _worker_smoke(sink: _EventSink):
                        **_health_conf("smoke"),
                        **_memprof_conf(),
                        **_movement_conf(),
+                       **_shuffle_conf(),
                        **_trace_conf()})
     df = sess.create_dataframe(lineitem, num_partitions=1).cache()
     t = {"lineitem": df}
@@ -972,10 +1032,12 @@ def _worker_smoke(sink: _EventSink):
             warm = time.perf_counter() - t0
             mb = _mem_probe()
             mv = _movement_probe()
+            sh = _shuffle_probe()
             t0 = time.perf_counter()
             dev_res = q.collect(device=True)
             dev_t = time.perf_counter() - t0
             mv_res = _movement_res(mv)
+            sh_res = _shuffle_res(sh, dev_t)
             t0 = time.perf_counter()
             exp = pandas_fn()
             cpu_t = time.perf_counter() - t0
@@ -992,6 +1054,7 @@ def _worker_smoke(sink: _EventSink):
                 "speedup": cpu_t / max(dev_t, 1e-9),
                 **_mem_res(mb),
                 **mv_res,
+                **sh_res,
                 **({"critical_path": cp,
                     "sync_wait_frac": cp["sync_wait_frac"]}
                    if cp else {})})
@@ -1053,6 +1116,7 @@ def _worker_tpch(sink: _EventSink):
         **_health_conf("tpch"),
         **_memprof_conf(),
         **_movement_conf(),
+        **_shuffle_conf(),
         **_trace_conf(),
     })
     dfs = tpch.build_dataframes(sess, tables, num_partitions=nparts)
@@ -1071,10 +1135,12 @@ def _worker_tpch(sink: _EventSink):
             warm = time.perf_counter() - t0
             mb = _mem_probe()
             mv = _movement_probe()
+            sh = _shuffle_probe()
             t0 = time.perf_counter()
             dev_tbl = q.collect(device=True)
             dev_t = time.perf_counter() - t0
             mv_res = _movement_res(mv)
+            sh_res = _shuffle_res(sh, dev_t)
             t0 = time.perf_counter()
             cpu_tbl = q.collect(device=False)
             cpu_t = time.perf_counter() - t0
@@ -1091,6 +1157,7 @@ def _worker_tpch(sink: _EventSink):
                     "speedup": cpu_t / max(dev_t, 1e-9),
                     **_mem_res(mb),
                     **mv_res,
+                    **sh_res,
                     **({"critical_path": cp,
                         "sync_wait_frac": cp["sync_wait_frac"]}
                        if cp else {})})
@@ -1530,6 +1597,172 @@ def _worker_fallback(sink: _EventSink):
     _bench_sentinel(sink, "fallback")
 
 
+def _worker_multichip(sink: _EventSink):
+    """MULTICHIP trajectory phase: q3/q5/q7 on an n-virtual-device CPU
+    mesh — the hash exchanges lower to the on-device ICI all-to-all tier
+    (shuffle/ici.py) and the shuffle observatory attributes every
+    transfer. Each query runs under an in-worker alarm: on timeout the
+    res that lands in the JSON is the partial shuffle delta plus the
+    observatory's forensics ring for THAT query, and the phase moves on
+    — an rc=124 wall-of-silence can't happen at this layer (the parent
+    watchdog above still catches a GIL-held native hang)."""
+    import __graft_entry__
+    n = int(os.environ.get("BENCH_MULTICHIP_DEVICES", "8"))
+    __graft_entry__._force_cpu_devices(n)
+    _silence_xla_cpu_noise()
+    from spark_rapids_tpu.parallel.mesh import virtual_cpu_mesh
+    from spark_rapids_tpu.session import TpuSession
+    from spark_rapids_tpu.shuffle import telemetry as shuffle_telemetry
+    from spark_rapids_tpu.tools import tpch
+
+    sf = float(os.environ.get("BENCH_MULTICHIP_SF", "0"))
+    tables = tpch.gen_all(sf) if sf > 0 else tpch.gen_all(0, tiny=True)
+    sink.emit(ev="meta", sf=sf, rows=tables["lineitem"].num_rows)
+    sess = TpuSession({
+        "spark.rapids.tpu.batchRowsMinBucket": 8192 if sf > 0 else 8,
+        "spark.rapids.tpu.shuffle.partitions":
+            int(os.environ.get("BENCH_PARTITIONS", "4")),
+        # static ICI lowering (the shape tests/test_exchange.py pins):
+        # AQE re-plans exchanges into materialized stages and a broadcast
+        # join would route the probe side around the device exchange
+        "spark.rapids.tpu.aqe.enabled": False,
+        "spark.rapids.tpu.autoBroadcastJoinThreshold": -1,
+        **_shuffle_conf(),
+        **_movement_conf(),
+        **_eventlog_conf("multichip", sink),
+        **_history_conf("multichip"),
+    })
+    sess.attach_mesh(virtual_cpu_mesh(n))
+    dfs = tpch.build_dataframes(sess, tables, num_partitions=2)
+
+    per_q_timeout = float(
+        os.environ.get("BENCH_MULTICHIP_QUERY_TIMEOUT_S", "180"))
+
+    class _QueryTimeout(Exception):
+        pass
+
+    def _on_alarm(signum, frame):
+        raise _QueryTimeout()
+
+    signal.signal(signal.SIGALRM, _on_alarm)
+
+    queries = [q for q in
+               os.environ.get("BENCH_WORKER_QUERIES", "").split(",") if q]
+    queries = queries or ["3", "5", "7"]
+    exec_log = []   # collect order -> name (maps event-log qids back)
+    results = {}
+    for qn in queries:
+        name = f"q{qn}"
+        sink.emit(ev="start", name=name)
+        shuffle_telemetry.drain_ring()  # scope the forensics ring to THIS query
+        sh = _shuffle_probe()
+        signal.alarm(int(per_q_timeout))
+        try:
+            q = getattr(tpch, name)(dfs)
+            t0 = time.perf_counter()
+            out = q.collect(device=True)
+            wall = time.perf_counter() - t0
+            signal.alarm(0)
+            exec_log.append(name)
+            res = {"wall_s": round(wall, 4), "rows": out.num_rows,
+                   **_shuffle_res(sh, wall)}
+            results[name] = res
+            sink.emit(ev="done", phase="multichip", name=name, res=res)
+            _log(f"multichip {name}: wall={wall:.3f}s "
+                 f"shuffle={res.get('shuffle_wall_s', 0):.3f}s "
+                 f"wire={res.get('wire_bytes', 0)}B")
+        except _QueryTimeout:
+            signal.alarm(0)
+            exec_log.append(name)  # the error path still logs the query
+            sink.emit(ev="error", name=name,
+                      msg=f"query timeout > {per_q_timeout:.0f}s "
+                          f"(in-worker alarm)")
+            sink.emit(ev="meta", multichip_forensics={name: {
+                "kind": "timeout", "timeout_s": per_q_timeout,
+                "partial": _shuffle_res(sh, per_q_timeout),
+                "ring": shuffle_telemetry.drain_ring()[-64:]}})
+            _log(f"multichip {name} TIMEOUT after {per_q_timeout:.0f}s")
+        except Exception as e:
+            signal.alarm(0)
+            exec_log.append(name)
+            sink.emit(ev="error", name=name,
+                      msg=f"{type(e).__name__}: {e}"[:300])
+            sink.emit(ev="meta", multichip_forensics={name: {
+                "kind": type(e).__name__,
+                "partial": _shuffle_res(sh, 0.0),
+                "ring": shuffle_telemetry.drain_ring()[-64:]}})
+            _log(f"multichip {name} FAILED: {e}")
+    sess.close()  # flush the event log (shuffle_summary records)
+    _enrich_multichip(sink, exec_log, results)
+    _write_diagnose_report("multichip")
+    _bench_sentinel(sink, "multichip")
+
+
+def _enrich_multichip(sink: _EventSink, exec_log, results):
+    """Re-emit each multichip query's res enriched with the event log's
+    v12 shuffle_summary (per-tier breakdown, straggler attribution,
+    stitched count) — the log is only guaranteed flushed after
+    sess.close(), so the per-query "done" events carry the scalar deltas
+    first and the full breakdown lands here. Never fails the bench."""
+    d = os.path.join(
+        os.environ.get("BENCH_EVENTLOG_DIR",
+                       os.path.join(_REPO, ".bench_eventlogs")),
+        "multichip")
+    try:
+        import glob as _glob
+        from spark_rapids_tpu.tools.eventlog import load_event_log
+        logs = [p for p in _glob.glob(os.path.join(d, "*.jsonl"))
+                if os.path.getmtime(p) >= _WALL_START]
+        if not logs:
+            return
+        app = load_event_log(sorted(logs, key=os.path.getmtime)[-1])
+        for i, qid in enumerate(sorted(app.queries)):
+            if i >= len(exec_log):
+                break
+            name = exec_log[i]
+            sh = getattr(app.queries[qid], "shuffle_summary", None)
+            if not sh or name not in results:
+                continue
+            res = results[name]
+            res["shuffle"] = {"totals": sh["totals"],
+                              "tiers": sh["tiers"],
+                              "straggler": sh["straggler"]}
+            sink.emit(ev="done", phase="multichip", name=name, res=res)
+    except Exception as e:
+        _log(f"multichip: enrich failed: {type(e).__name__}: {e}")
+
+
+def multichip_main(out_path: str):
+    """Parent mode (``bench.py --multichip [out.json]``): run the
+    multichip phase worker under the watchdog and write the MULTICHIP
+    trajectory JSON — per-query wall, shuffle wall, per-tier transfer
+    breakdown, wire bytes and straggler stats, with per-query forensics
+    (partial results + observatory ring) on timeout or worker death."""
+    _silence_xla_cpu_noise()
+    n = int(os.environ.get("BENCH_MULTICHIP_DEVICES", "8"))
+    timeout = float(os.environ.get("BENCH_MULTICHIP_TIMEOUT_S", "300"))
+    status, current = _run_phase("multichip", "cpu", None, timeout)
+    queries = _STATE["multichip"]
+    out = {
+        "n_devices": n,
+        "status": status,
+        "ok": status == "clean" and not _STATE["errors"],
+        "queries": queries,
+        "errors": _STATE["errors"],
+        "forensics": _STATE["multichip_forensics"],
+        "eventlog": _STATE["eventlog"].get("multichip"),
+        "history": _STATE["history"].get("multichip"),
+        "notes": _STATE["notes"],
+    }
+    if current:
+        out["killed_on"] = current
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=2, default=str)
+        f.write("\n")
+    _log(f"multichip -> {out_path} status={status} "
+         f"queries={sorted(queries)} errors={sorted(_STATE['errors'])}")
+
+
 def worker_main(phase: str):
     sink = _EventSink()
     if phase == "smoke":
@@ -1546,6 +1779,8 @@ def worker_main(phase: str):
         _worker_oom(sink)
     elif phase == "fallback":
         _worker_fallback(sink)
+    elif phase == "multichip":
+        _worker_multichip(sink)
     else:
         raise SystemExit(f"unknown worker phase {phase!r}")
 
@@ -1553,6 +1788,10 @@ def worker_main(phase: str):
 if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--worker":
         worker_main(sys.argv[2])
+        sys.exit(0)
+    if len(sys.argv) >= 2 and sys.argv[1] == "--multichip":
+        multichip_main(sys.argv[2] if len(sys.argv) > 2
+                       else os.path.join(_REPO, "MULTICHIP_r06.json"))
         sys.exit(0)
     try:
         main()

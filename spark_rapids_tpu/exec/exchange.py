@@ -30,6 +30,7 @@ from ..columnar.device import (DeviceColumn, DeviceTable, bucket_rows,
                                concat_device_tables)
 from ..conf import register_conf
 from ..plan.physical import HashPartitioning, PhysicalPlan
+from ..shuffle import telemetry as shuffle_telemetry
 from ..utils import metrics as M
 from ..utils import movement
 from .base import TpuExec
@@ -51,6 +52,11 @@ SHUFFLE_MODE = register_conf(
 # movement-observatory site identities (utils/movement.py SITES)
 _MOVE_CHUNK = ("spark_rapids_tpu/exec/exchange.py"
                "::TpuShuffleExchangeExec._exchange_chunk")
+
+# shuffle-observatory identities for planner exchanges: a process-wide
+# counter (manager shuffle ids are per-manager and the planner tiers
+# never allocate one)
+_EXCHANGE_IDS = __import__("itertools").count()
 EXCHANGE_CHUNK_ROWS = register_conf(
     "spark.rapids.tpu.shuffle.exchangeChunkRows",
     "Max staged row capacity per device-exchange chunk. Child batches "
@@ -101,6 +107,7 @@ class TpuShuffleExchangeExec(TpuExec):
         self.min_bucket = resolve_min_bucket(min_bucket)
         self.chunk_rows = max(int(chunk_rows), 1)
         self.schema = child.schema
+        self.telemetry_sid = next(_EXCHANGE_IDS)
         # spill handles per partition, one per exchanged chunk
         self._shards: Optional[List[List]] = None
         # v7 skew telemetry: per-output-partition rows (free — the bulk
@@ -203,6 +210,12 @@ class TpuShuffleExchangeExec(TpuExec):
             table = concat_device_tables(batches, self.min_bucket)
             chunk_nbytes = table.nbytes()
             self.metrics.add(M.SHUFFLE_BYTES, chunk_nbytes)
+            # observatory enqueue note mirrors the shuffleBytes metric
+            # exactly (pre-padding logical bytes), so the shuffle_summary
+            # tier breakdown reconciles with the operator metric
+            shuffle_telemetry.note_transfer(
+                "ici", "enqueue", shuffle_id=self.telemetry_sid,
+                logical_bytes=chunk_nbytes)
             per_shard = bucket_rows(
                 max(1, -(-table.capacity // n)), self.min_bucket)
             table = pad_table_capacity(table, per_shard * n)
@@ -228,7 +241,8 @@ class TpuShuffleExchangeExec(TpuExec):
                 sharded = shard_table(table, self.mesh, self.axis)
                 del table, batches
                 exchanged = ici_all_to_all_exchange(
-                    sharded, keys, self.mesh, self.axis, quota=quota)
+                    sharded, keys, self.mesh, self.axis, quota=quota,
+                    telemetry_sid=self.telemetry_sid)
                 # register output shards so the catalog accounts for them
                 # and can spill them until downstream consumption; the
                 # entries release at query end (release_spill_handles),
@@ -297,6 +311,7 @@ class TpuLocalExchangeExec(TpuExec):
         self.partitioning = partitioning
         self.min_bucket = resolve_min_bucket(min_bucket)
         self.schema = child.schema
+        self.telemetry_sid = next(_EXCHANGE_IDS)
         self._handles: Optional[List] = None
         # v7 skew telemetry: one output partition, so the distribution is
         # trivially balanced — recorded anyway for a uniform record set
@@ -323,6 +338,12 @@ class TpuLocalExchangeExec(TpuExec):
         from ..parallel.pipeline import parallel_map
         catalog = get_catalog()
         from ..columnar.device import resolve_scalars, shrink_to_fit
+        # node context is thread-local; drain() runs on pool workers, so
+        # capture the query identity here (the materializing thread holds
+        # the instrumented node scope) and attribute notes explicitly
+        from ..utils import node_context
+        _ctx = node_context.current()
+        _qid = _ctx.query_id if _ctx is not None else None
 
         def drain(p: int):
             """One map-side partition: drain, compact, spill-register.
@@ -348,6 +369,12 @@ class TpuLocalExchangeExec(TpuExec):
                     shrunk = shrink_to_fit(b, self.min_bucket, num_rows=n)
                     nbytes = shrunk.nbytes()
                     self.metrics.add(M.SHUFFLE_BYTES, nbytes)
+                    # mirrors the shuffleBytes metric add exactly so the
+                    # shuffle_summary tier bytes reconcile with it
+                    shuffle_telemetry.note_transfer(
+                        "local", "enqueue",
+                        shuffle_id=self.telemetry_sid, partition=p,
+                        logical_bytes=nbytes, query_id=_qid)
                     h = catalog.register(
                         shrunk, SpillPriorities.OUTPUT_FOR_SHUFFLE)
                 self._own_spill_handle(h)

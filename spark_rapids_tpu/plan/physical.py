@@ -683,6 +683,10 @@ class ShuffleExchangeExec(PhysicalPlan):
         # (download-partition-upload); the registry makes that visible to
         # EXPLAIN ANALYZE / the diagnose tool per node
         self.metrics = MetricRegistry()
+        # process-unique shuffle id for observatory attribution (shared
+        # counter with the device-tier exchanges in exec/exchange.py)
+        from ..exec.exchange import _EXCHANGE_IDS
+        self.telemetry_sid = next(_EXCHANGE_IDS)
 
     @property
     def num_partitions(self) -> int:
@@ -713,11 +717,25 @@ class ShuffleExchangeExec(PhysicalPlan):
         else:
             inputs = None
         out: List[List[HostTable]] = [[] for _ in range(self.num_partitions)]
+        from ..shuffle import telemetry as shuffle_telemetry
         from ..utils import metrics as M
+        # node context is thread-local; feed() runs on the parallel_map
+        # pool workers below, so capture the query identity here (the
+        # materializing thread holds the instrumented node scope) and
+        # attribute notes explicitly
+        from ..utils import node_context
+        _ctx = node_context.current()
+        _qid = _ctx.query_id if _ctx is not None else None
 
         def feed(batch: HostTable) -> List:
             with self.metrics.timed(M.SHUFFLE_PARTITION_TIME):
-                self.metrics.add(M.SHUFFLE_BYTES, batch.nbytes())
+                nb = batch.nbytes()
+                self.metrics.add(M.SHUFFLE_BYTES, nb)
+                # mirrors the shuffleBytes metric add exactly so the
+                # shuffle_summary tier bytes reconcile with it
+                shuffle_telemetry.note_transfer(
+                    "local", "enqueue", shuffle_id=self.telemetry_sid,
+                    logical_bytes=nb, query_id=_qid)
                 self.metrics.add(M.NUM_OUTPUT_ROWS, batch.num_rows)
                 pids = self.partitioning.partition_indices(batch)
                 slices = []

@@ -87,6 +87,12 @@ class TpuSession:
         # movement.enabled
         from .utils.movement import configure_movement
         configure_movement(self.conf)
+        # shuffle & collective observatory (spark.rapids.tpu.shuffle.
+        # telemetry.*): install or clear the process-wide per-tier
+        # transfer ledger behind the shuffle chokepoints
+        # (shuffle/telemetry.py); None/no-op unless telemetry.enabled
+        from .shuffle.telemetry import configure_shuffle_telemetry
+        configure_shuffle_telemetry(self.conf)
         # structured OOM retry (spark.rapids.tpu.oom.*): escalation-ladder
         # bounds + HBM pressure arbitration (memory/retry.py)
         from .memory.retry import configure_oom_retry

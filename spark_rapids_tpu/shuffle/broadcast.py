@@ -21,6 +21,7 @@ import threading
 from typing import Callable, Dict, Optional
 
 from ..columnar.device import DeviceTable, resolve_min_bucket
+from . import telemetry
 from .serializer import deserialize_table, serialize_table
 from .transport import BlockId, ShuffleFetchFailedException, ShuffleTransport
 
@@ -49,8 +50,19 @@ class BroadcastManager:
 
     def publish(self, bcast_id: int, table: DeviceTable) -> None:
         """Builder side: serialize once and make it fetchable by peers."""
+        t0 = telemetry.clock()
         payload = serialize_table(table.to_host())
+        telemetry.note_transfer(
+            "transport", "serialize", shuffle_id=BROADCAST_SHUFFLE_ID,
+            map_id=bcast_id, partition=0, t0=t0,
+            logical_bytes=lambda: table.nbytes(),
+            wire_bytes=len(payload))
+        t1 = telemetry.clock()
         self.transport.publish(self.block_of(bcast_id), payload)
+        telemetry.note_transfer(
+            "transport", "publish", shuffle_id=BROADCAST_SHUFFLE_ID,
+            map_id=bcast_id, partition=0, t0=t1,
+            wire_bytes=len(payload))
 
     def build_and_publish(self, bcast_id: int,
                           build_fn: Callable[[], DeviceTable]) -> DeviceTable:
@@ -60,7 +72,7 @@ class BroadcastManager:
             return h.get()
         table = build_fn()
         self.builds += 1
-        self.publish(bcast_id, table)
+        self.publish(bcast_id, table)  # srtpu: shuffle-ok(BroadcastManager.publish itself notes the serialize and publish phases)
         return self._cache(bcast_id, table)
 
     def get(self, bcast_id: int) -> DeviceTable:
@@ -69,11 +81,22 @@ class BroadcastManager:
             h = self._handles.get(bcast_id)
         if h is not None:
             return h.get()
+        t0 = telemetry.clock()
         for bid, payload in self.transport.fetch([self.block_of(bcast_id)]):
             self.fetches += 1
+            telemetry.note_transfer(
+                "transport", "fetch", shuffle_id=BROADCAST_SHUFFLE_ID,
+                map_id=bcast_id, partition=0, t0=t0,
+                wire_bytes=len(payload))
+            t1 = telemetry.clock()
             host = deserialize_table(payload)
-            return self._cache(
-                bcast_id, DeviceTable.from_host(host, self.min_bucket))
+            table = DeviceTable.from_host(host, self.min_bucket)
+            telemetry.note_transfer(
+                "transport", "deserialize",
+                shuffle_id=BROADCAST_SHUFFLE_ID, map_id=bcast_id,
+                partition=0, t0=t1,
+                logical_bytes=lambda: host.nbytes())
+            return self._cache(bcast_id, table)
         raise ShuffleFetchFailedException(
             self.block_of(bcast_id), "broadcast block unavailable")
 

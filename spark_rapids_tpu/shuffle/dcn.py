@@ -51,7 +51,8 @@ import jax
 
 from ..columnar.device import DeviceTable
 from ..utils import faults
-from ..utils.tracing import get_tracer
+from ..utils.tracing import current_trace_context, get_tracer
+from . import telemetry
 from .transport import BlockId, ShuffleFetchFailedException
 
 __all__ = ["MockDcnFabric", "DcnShuffleTransport",
@@ -139,7 +140,7 @@ class DcnShuffleTransport:
                 remote = host._local(b)
                 if remote is None:
                     continue
-                yield b, self.fabric.transfer(
+                yield b, self.fabric.transfer(  # srtpu: shuffle-ok(in-process mock fabric hop with its own link_bytes accounting; the real DCN tier TcpDcnShuffleTransport notes the observatory)
                     name, self.host_name, b, remote, self.device)
                 found = True
                 break
@@ -215,7 +216,13 @@ class TcpDcnShuffleTransport:
                 table, SpillPriorities.OUTPUT_FOR_SHUFFLE)
         with self._lock:
             self._blocks[block] = entry
+        t0 = telemetry.clock()
         self.tcp.store.put_lazy(block, lambda: self._serialize(block))
+        telemetry.note_transfer(
+            "dcn", "enqueue", shuffle_id=block[0], map_id=block[1],
+            partition=block[2], t0=t0,
+            logical_bytes=lambda: table.nbytes(),
+            queue_depth=self.tcp.store.lazy_depth())
 
     def _serialize(self, block: BlockId) -> bytes:
         from .serializer import serialize_table
@@ -226,9 +233,18 @@ class TcpDcnShuffleTransport:
         # runs on the TCP server thread under the REQUESTING query's
         # TraceContext (the SRTC wire header activated it), so this span
         # parents under the remote query span in the merged timeline
+        t0 = telemetry.clock()
         with get_tracer().span("dcn_serialize", "shuffle",
                                shuffle=block[0], map=block[1]):
             payload = serialize_table(table.to_host(), codec=self.codec)
+        tctx = current_trace_context()
+        telemetry.note_transfer(
+            "dcn", "serialize", shuffle_id=block[0], map_id=block[1],
+            partition=block[2], t0=t0,
+            logical_bytes=lambda: table.nbytes(),
+            wire_bytes=len(payload),
+            queue_depth=self.tcp.store.lazy_depth(),
+            query_id=tctx.query_id if tctx is not None else None)
         with self._lock:
             self.bytes_wired += len(payload)
         return payload
@@ -254,7 +270,13 @@ class TcpDcnShuffleTransport:
         action = faults.fire("dcn.fetch")
         if action is not None and action != "delay":
             raise faults.FaultInjectedError("dcn.fetch", action)
+        t_fetch = telemetry.clock()
         for b, payload in self.tcp.fetch(remote):
+            telemetry.note_transfer(
+                "dcn", "fetch", shuffle_id=b[0], map_id=b[1],
+                partition=b[2], wire_bytes=len(payload), t0=t_fetch,
+                queue_depth=len(remote))
+            t_des = telemetry.clock()
             with get_tracer().span("dcn_fetch", "shuffle",
                                    shuffle=b[0], map=b[1],
                                    bytes=len(payload)):
@@ -262,7 +284,12 @@ class TcpDcnShuffleTransport:
                 table = _DT.from_host(host)
                 if self.device is not None:
                     table = jax.device_put(table, self.device)
+            telemetry.note_transfer(
+                "dcn", "deserialize", shuffle_id=b[0], map_id=b[1],
+                partition=b[2], t0=t_des,
+                logical_bytes=lambda: table.nbytes())
             yield b, table
+            t_fetch = telemetry.clock()
 
     def remove_shuffle(self, shuffle_id: int) -> None:
         with self._lock:

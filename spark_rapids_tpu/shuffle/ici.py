@@ -29,6 +29,7 @@ from ..parallel.shard_compat import shard_map
 
 from ..columnar.device import (DeviceColumn, DeviceTable,
                                stable_counting_order)
+from . import telemetry
 from .manager import device_partition_ids
 
 __all__ = ["ici_all_to_all_exchange", "shard_table", "unshard_table"]
@@ -60,7 +61,9 @@ def unshard_table(table: DeviceTable) -> DeviceTable:
 
 def ici_all_to_all_exchange(table: DeviceTable, key_names: List[str],
                             mesh: Mesh, axis: str = "dp",
-                            quota: int | None = None) -> DeviceTable:
+                            quota: int | None = None,
+                            telemetry_sid: int | None = None
+                            ) -> DeviceTable:
     """Hash-exchange a row-sharded table so rows with equal keys land on the
     same shard, as one jitted shard_map program (collectives over ICI).
 
@@ -110,6 +113,14 @@ def ici_all_to_all_exchange(table: DeviceTable, key_names: List[str],
     col_specs = jax.tree_util.tree_map(lambda _: P(axis), table.columns)
     fn = jax.jit(shard_map(local, mesh=mesh, in_specs=(col_specs, P(axis)),
                            out_specs=(col_specs, P(axis)), check=False))
+    # collective dispatch wall: compile (first call) + dispatch of the
+    # all-to-all over n devices; wire bytes are the padded sharded input
+    # actually crossing ICI links (vs the pre-padding logical bytes the
+    # exchange exec notes at enqueue)
+    t0 = telemetry.clock()
     out_cols, mask = fn(table.columns, table.row_mask)
+    telemetry.note_transfer("ici", "dispatch", shuffle_id=telemetry_sid,
+                            t0=t0, queue_depth=n,
+                            wire_bytes=lambda: table.nbytes())
     total = jnp.sum(mask, dtype=jnp.int32)
     return DeviceTable(tuple(out_cols), mask, total, names)

@@ -31,6 +31,7 @@ from ..conf import RapidsConf, SHUFFLE_COMPRESSION_CODEC, register_conf
 from ..memory.stores import SpillCorruptionError
 from ..utils import faults, movement
 from ..utils.tracing import get_tracer
+from . import telemetry
 from .serializer import deserialize_table, serialize_table
 from .transport import BlockId, ShuffleTransport, load_transport
 
@@ -347,8 +348,19 @@ class ShuffleManager:
                 table = schema_host.slice(0, 0)
             else:  # map task saw no batches at all: typed-empty marker
                 table = HostTable([], [])
+            t0 = telemetry.clock()
             payload = serialize_table(table, self.codec)
+            telemetry.note_transfer(
+                "transport", "serialize", shuffle_id=shuffle_id,
+                map_id=map_id, partition=p, t0=t0,
+                logical_bytes=lambda: table.nbytes(),
+                wire_bytes=len(payload))
+            t1 = telemetry.clock()
             self.transport.publish(BlockId(shuffle_id, map_id, p), payload)
+            telemetry.note_transfer(
+                "transport", "publish", shuffle_id=shuffle_id,
+                map_id=map_id, partition=p, t0=t1,
+                wire_bytes=len(payload))
             return len(payload)
 
         # parallel map-side writes: per-block concat+serialize (+codec) is
@@ -410,8 +422,13 @@ class ShuffleManager:
             else:  # map task saw no batches at all
                 table = DeviceTable((), jnp.zeros(0, dtype=bool),
                                     jnp.int32(0), ())
+            t0 = telemetry.clock()
             self.buffer_catalog.put((shuffle_id, map_id, p), table)
             sizes[p] = table.nbytes()
+            telemetry.note_transfer(
+                "cached", "publish", shuffle_id=shuffle_id,
+                map_id=map_id, partition=p, t0=t0,
+                logical_bytes=sizes[p], wire_bytes=sizes[p])
         _bump(blocks_published=num_parts, bytes_published=sum(sizes),
               writes_cached_tier=1)
         self._bump_skew(shuffle_id, part_rows, sizes)
@@ -448,10 +465,25 @@ class ShuffleManager:
                         # recompute-once machinery below recovers it
                         raise ShuffleFetchFailedException(
                             pending[0], "injected fault 'shuffle.fetch'")
+                    t_fetch = telemetry.clock()
                     for bid, payload in self.transport.fetch(pending):
-                        tables.append(deserialize_table(payload))
+                        telemetry.note_transfer(
+                            "transport", "fetch", shuffle_id=shuffle_id,
+                            map_id=bid[1], partition=reduce_id,
+                            wire_bytes=len(payload), t0=t_fetch,
+                            retries=1 if bid[1] in retried else 0,
+                            queue_depth=len(pending))
+                        t_des = telemetry.clock()
+                        host = deserialize_table(payload)
+                        telemetry.note_transfer(
+                            "transport", "deserialize",
+                            shuffle_id=shuffle_id, map_id=bid[1],
+                            partition=reduce_id, t0=t_des,
+                            logical_bytes=lambda: host.nbytes())
+                        tables.append(host)
                         fetched_bytes += len(payload)
                         pending = pending[pending.index(bid) + 1:]
+                        t_fetch = telemetry.clock()
                     break
                 except ShuffleFetchFailedException as e:
                     map_id = e.block[1]
@@ -549,7 +581,12 @@ class ShuffleManager:
                         raise ShuffleFetchFailedException(
                             BlockId(shuffle_id, m, reduce_id),
                             f"spilled block corrupt after recompute: {e2}")
-                fetched_bytes += t.nbytes()
+                nb = t.nbytes()
+                telemetry.note_transfer(
+                    "cached", "fetch", shuffle_id=shuffle_id,
+                    map_id=m, partition=reduce_id,
+                    logical_bytes=nb, wire_bytes=nb)
+                fetched_bytes += nb
                 if t.num_columns:
                     tables.append(t)
             # ONE bulk D2H of all block row counts instead of a blocking

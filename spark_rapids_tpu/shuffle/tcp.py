@@ -57,6 +57,7 @@ from ..utils import faults
 from ..utils.tracing import (TRACE_DISTRIBUTED, TraceContext,
                              activate_trace_context, current_trace_context,
                              get_tracer)
+from . import telemetry
 from .transport import (BlockId, ShuffleFetchFailedException,
                         ShuffleTransport)
 
@@ -215,6 +216,12 @@ class _HostBlockStore:
         until a remote peer actually asks — most never serialize)."""
         with self._lock:
             self._providers[block] = provider
+
+    def lazy_depth(self) -> int:
+        """Publish-queue depth: lazy providers registered but not yet
+        materialized (the shuffle observatory's backpressure signal)."""
+        with self._lock:
+            return len(self._providers)
 
     def _materialize(self, block: BlockId) -> None:
         with self._lock:
@@ -445,6 +452,7 @@ class TcpShuffleTransport(ShuffleTransport):
         if op == _OP_GET_RANGE:
             off, max_len = _RANGE_EXT.unpack(
                 _recv_exact(conn, _RANGE_EXT.size))
+            t0 = telemetry.clock()
             total = self.store.length(block)
             if total is None:
                 conn.sendall(_RESP_HEAD.pack(0, 0))
@@ -454,6 +462,16 @@ class TcpShuffleTransport(ShuffleTransport):
             conn.sendall(_RESP_HEAD.pack(1, total)
                          + _RESP_CHUNK.pack(len(payload)))
             conn.sendall(payload)
+            # server half of the transfer: stitched with the client's
+            # recv via the SRTC header's trace id + block identity (the
+            # first chunk stands for the block)
+            tctx = current_trace_context()
+            telemetry.note_transfer(
+                "transport", "serve", shuffle_id=sid, map_id=mid,
+                partition=rid, wire_bytes=len(payload), t0=t0,
+                side="send" if (tctx is not None and off == 0) else None,
+                trace_id=tctx.trace_id if tctx is not None else None,
+                query_id=tctx.query_id if tctx is not None else None)
             return
         # whole-block GET (compat): stream it in windows anyway so
         # the server never materializes more than a chunk per send
@@ -506,21 +524,46 @@ class TcpShuffleTransport(ShuffleTransport):
                 if faults.fire("tcp.connect") not in (None, "delay"):
                     raise ConnectionRefusedError(
                         "injected fault 'tcp.connect'")
+                t_conn = telemetry.clock()
                 with socket.create_connection(
                         addr, timeout=self._connect_timeout) as s:
+                    telemetry.note_transfer(
+                        "transport", "connect", shuffle_id=block[0],
+                        map_id=block[1], partition=block[2], t0=t_conn,
+                        retries=attempt)
                     s.settimeout(self._read_timeout)
+                    t_send = telemetry.clock()
                     s.sendall(head
                               + _RANGE_EXT.pack(offset, self.chunk_bytes))
+                    telemetry.note_transfer(
+                        "transport", "send", shuffle_id=block[0],
+                        map_id=block[1], partition=block[2], t0=t_send,
+                        wire_bytes=len(head) + _RANGE_EXT.size)
                     if faults.fire("tcp.read") not in (None, "delay"):
                         raise ConnectionResetError(
                             "injected fault 'tcp.read'")
+                    t_recv = telemetry.clock()
                     found, total = _RESP_HEAD.unpack(
                         _recv_exact(s, _RESP_HEAD.size))
                     if not found:
                         return None  # definitive miss: peer is up, no block
                     (clen,) = _RESP_CHUNK.unpack(
                         _recv_exact(s, _RESP_CHUNK.size))
-                    return int(total), _recv_exact(s, clen)
+                    chunk = _recv_exact(s, clen)
+                    # client half: the first chunk carries the stitch key
+                    # (trace id + block identity) the server's serve note
+                    # pairs with
+                    telemetry.note_transfer(
+                        "transport", "recv", shuffle_id=block[0],
+                        map_id=block[1], partition=block[2], t0=t_recv,
+                        wire_bytes=clen, retries=attempt,
+                        side="recv" if (tctx is not None and offset == 0)
+                        else None,
+                        trace_id=tctx.trace_id if tctx is not None
+                        else None,
+                        query_id=tctx.query_id if tctx is not None
+                        else None)
+                    return int(total), chunk
             except OSError:
                 continue  # transient or dead peer: back off and retry
         faults.note_recovery("transport_giveups")

@@ -325,16 +325,17 @@ def load_project(paths: Sequence[str]) -> Project:
 
 def _checkers() -> Dict[str, object]:
     from . import (buckets, degrade, eventlog_schema, host_sync, jit_purity,
-                   locks, memtrack, net, retry_scope, threads, trace_ctx)
+                   locks, memtrack, net, retry_scope, shuffle_observed,
+                   threads, trace_ctx)
     return {"sync": host_sync, "lock": locks,
             "thread": threads, "jit": jit_purity, "bucket": buckets,
             "trace": trace_ctx, "memtrack": memtrack,
             "eventlog": eventlog_schema, "net": net, "retry": retry_scope,
-            "degrade": degrade}
+            "degrade": degrade, "shuffle": shuffle_observed}
 
 
 CHECKS = ("sync", "lock", "thread", "jit", "bucket", "trace", "memtrack",
-          "eventlog", "net", "retry", "degrade")
+          "eventlog", "net", "retry", "degrade", "shuffle")
 
 
 def analyze_paths(paths: Sequence[str],

@@ -34,7 +34,16 @@ result JSONs:
   ``MOVE_BYTES_FLAG_FRAC`` (10%) and ``MOVE_BYTES_FLAG_MIN`` flags a
   transfer-byte regression — the same wall-orthogonal logic: a plan
   change that bounces batches through the host can hide inside an
-  unchanged total on a fast PCI link and still sink the scale-up.
+  unchanged total on a fast PCI link and still sink the scale-up;
+- per-query shuffle deltas when both runs carry the shuffle
+  observatory's numbers (schema-v12 ``shuffle_summary`` totals / bench
+  ``shuffle_wall_s``+``wire_bytes``): wall measurably spent inside
+  transfer phases and bytes actually crossing the wire diff side by
+  side, and a candidate whose shuffle wall grew past
+  ``SHUFFLE_WALL_FLAG_FRAC`` (+ the 50 ms floor) or whose wire bytes
+  grew past the byte gate flags a shuffle regression — pipeline
+  overlap hides a slower tier inside flat query wall, and serializer
+  changes inflate wire bytes without touching logical bytes.
 
 CLI: ``python -m spark_rapids_tpu.tools.compare A B [--threshold 0.2]``
 where A/B are event-log JSONL paths or bench summary JSONs.
@@ -48,9 +57,11 @@ from typing import Dict, List, Optional, Tuple
 __all__ = ["OpDelta", "QueryDelta", "CompareReport", "compare_event_logs",
            "compare_bench_results", "compare_apps",
            "critical_path_fractions", "critical_path_delta",
-           "memory_delta", "movement_delta", "CP_FRAC_FLAG_PP",
+           "memory_delta", "movement_delta", "shuffle_delta",
+           "CP_FRAC_FLAG_PP",
            "MEM_PEAK_FLAG_FRAC", "MEM_PEAK_FLAG_MIN_BYTES",
            "MOVE_BYTES_FLAG_FRAC", "MOVE_BYTES_FLAG_MIN",
+           "SHUFFLE_WALL_FLAG_FRAC", "SHUFFLE_WALL_FLAG_MIN_S",
            "SYNC_WAIT_GATE_FRAC"]
 
 #: category-fraction growth (candidate minus baseline) that flags a
@@ -76,6 +87,14 @@ MOVE_BYTES_FLAG_FRAC = 0.10
 #: buckets round batch capacities, so tiny queries jitter in bytes
 #: run-to-run; both conditions must hold, like the memory gate
 MOVE_BYTES_FLAG_MIN = 1 << 20
+
+#: relative shuffle-transfer-wall growth (candidate over baseline) that
+#: flags a shuffle regression: 10%, same shape as the byte gates
+SHUFFLE_WALL_FLAG_FRAC = 0.10
+
+#: absolute shuffle-wall growth floor (50 ms) — tiny transfers jitter
+#: with scheduler noise, so both conditions must hold
+SHUFFLE_WALL_FLAG_MIN_S = 0.05
 
 #: ABSOLUTE sync-wait ceiling for the candidate run: a query spending
 #: more than 10% of its wall blocked on device->host syncs fails the
@@ -111,6 +130,33 @@ def movement_delta(mv_a: Optional[Dict], mv_b: Optional[Dict],
     if not float(mv_a.get("round_trips") or 0) \
             and float(mv_b.get("round_trips") or 0):
         flagged.append("round_trips")
+    return deltas, flagged
+
+
+def shuffle_delta(sh_a: Optional[Dict], sh_b: Optional[Dict],
+                  flag_frac: float = SHUFFLE_WALL_FLAG_FRAC,
+                  flag_min_s: float = SHUFFLE_WALL_FLAG_MIN_S,
+                  flag_min_bytes: int = MOVE_BYTES_FLAG_MIN
+                  ) -> Tuple[Dict[str, float], List[str]]:
+    """(deltas B - A, flagged keys) from two per-query shuffle dicts
+    ({"shuffle_wall_s", "wire_bytes"}, from a v12 event log's
+    shuffle_summary totals or a bench JSON's shuffle fields). Empty
+    when either run lacks the numbers — telemetry off must not flag.
+    Shuffle wall growing past ``flag_frac`` AND ``flag_min_s`` flags
+    "shuffle_wall_s"; wire bytes growing past ``flag_frac`` AND
+    ``flag_min_bytes`` flags "wire_bytes"."""
+    if not sh_a or not sh_b:
+        return {}, []
+    keys = ("shuffle_wall_s", "wire_bytes")
+    deltas = {k: float(sh_b.get(k) or 0) - float(sh_a.get(k) or 0)
+              for k in keys}
+    flagged = []
+    floors = {"shuffle_wall_s": flag_min_s, "wire_bytes": flag_min_bytes}
+    for k in keys:
+        a = float(sh_a.get(k) or 0)
+        b = float(sh_b.get(k) or 0)
+        if a > 0 and b > a * (1.0 + flag_frac) and b - a >= floors[k]:
+            flagged.append(k)
     return deltas, flagged
 
 
@@ -227,6 +273,16 @@ class QueryDelta:
     #: the heaviest movement-ledger funnel during the candidate run
     #: (bench "sync_top_site"); where a sync_gate violation points
     sync_top_site: str = ""
+    #: shuffle deltas (B - A): transfer wall + wire bytes, when both
+    #: runs carried the shuffle observatory's numbers (schema v12)
+    shuffle_deltas: Dict[str, float] = dataclasses.field(
+        default_factory=dict)
+    #: keys grown past SHUFFLE_WALL_FLAG_FRAC (+ their floors) — the
+    #: shuffle-regression gate
+    shuffle_flagged: List[str] = dataclasses.field(default_factory=list)
+    #: the baseline's absolute shuffle numbers (for % rendering)
+    shuffle_base: Dict[str, float] = dataclasses.field(
+        default_factory=dict)
 
     @property
     def delta_s(self) -> float:
@@ -270,6 +326,13 @@ class CompareReport:
         orthogonal to wall time like the memory gate: extra transfers
         hide on a fast link and sink the scale-up."""
         return [q for q in self.queries if q.move_flagged]
+
+    def shuffle_regressions(self) -> List[QueryDelta]:
+        """Queries whose shuffle transfer wall or wire bytes grew past
+        SHUFFLE_WALL_FLAG_FRAC (+ floors) — orthogonal to wall time:
+        pipeline overlap hides a slower shuffle tier inside flat query
+        wall until the tier saturates at scale."""
+        return [q for q in self.queries if q.shuffle_flagged]
 
     def sync_wait_violations(self) -> List[QueryDelta]:
         """Queries whose CANDIDATE run spent more than
@@ -348,6 +411,24 @@ class CompareReport:
                             if q.move_base.get(k) else f"{k} grew"
                             for k in q.move_flagged)
                         + f" (gate {MOVE_BYTES_FLAG_FRAC:.0%})")
+            if q.shuffle_deltas:
+                parts = []
+                for k in sorted(q.shuffle_deltas):
+                    v = q.shuffle_deltas[k]
+                    base = q.shuffle_base.get(k, 0.0)
+                    pct = f" ({v / base:+.1%})" if base > 0 else ""
+                    unit = "s" if k.endswith("_s") else "B"
+                    parts.append(f"{k}={v:+.4g}{unit}{pct}")
+                lines.append("  shuffle deltas (B - A): "
+                             + ", ".join(parts))
+                if q.shuffle_flagged:
+                    lines.append(
+                        "  ** SHUFFLE REGRESSION: "
+                        + ", ".join(
+                            f"{k} +{q.shuffle_deltas[k] / q.shuffle_base[k]:.1%}"
+                            if q.shuffle_base.get(k) else f"{k} grew"
+                            for k in q.shuffle_flagged)
+                        + f" (gate {SHUFFLE_WALL_FLAG_FRAC:.0%})")
             if q.sync_gate_frac is not None:
                 site = q.sync_top_site or "(no ledger attribution)"
                 lines.append(
@@ -368,6 +449,8 @@ class CompareReport:
                      "peak-memory regression(s), "
                      f"{len(self.movement_regressions())} "
                      "transfer-byte regression(s), "
+                     f"{len(self.shuffle_regressions())} "
+                     "shuffle regression(s), "
                      f"{len(self.sync_wait_violations())} "
                      "sync-wait gate violation(s)")
         return "\n".join(lines)
@@ -409,6 +492,17 @@ def _query_movement(q) -> Optional[Dict]:
             "round_trips": int(t.get("round_trips") or 0)}
 
 
+def _query_shuffle(q) -> Optional[Dict]:
+    """Per-query shuffle numbers from a replay's v12 ``shuffle_summary``
+    totals. None pre-v12 or with telemetry off."""
+    sh = getattr(q, "shuffle_summary", None)
+    if not sh:
+        return None
+    t = sh.get("totals") or {}
+    return {"shuffle_wall_s": float(t.get("wall_s") or 0.0),
+            "wire_bytes": int(t.get("wire_bytes") or 0)}
+
+
 def compare_apps(app_a, app_b, threshold: float = 0.2,
                  min_seconds: float = 0.001) -> CompareReport:
     """Compare two loaded ``AppReplay``s (tools/eventlog.py)."""
@@ -444,6 +538,8 @@ def compare_apps(app_a, app_b, threshold: float = 0.2,
         mem_deltas, mem_flagged = memory_delta(mem_a, mem_b)
         mv_a, mv_b = _query_movement(qa), _query_movement(qb)
         move_deltas, move_flagged = movement_delta(mv_a, mv_b)
+        sh_a, sh_b = _query_shuffle(qa), _query_shuffle(qb)
+        sh_deltas, sh_flagged = shuffle_delta(sh_a, sh_b)
         queries.append(QueryDelta(qid, qa.wall_s, qb.wall_s,
                                   q_regressed, ops, stats_delta,
                                   cp_deltas, cp_flagged,
@@ -452,7 +548,11 @@ def compare_apps(app_a, app_b, threshold: float = 0.2,
                                    (mem_a or {}).items()},
                                   move_deltas, move_flagged,
                                   {k: float(v) for k, v in
-                                   (mv_a or {}).items()}))
+                                   (mv_a or {}).items()},
+                                  shuffle_deltas=sh_deltas,
+                                  shuffle_flagged=sh_flagged,
+                                  shuffle_base={k: float(v) for k, v in
+                                                (sh_a or {}).items()}))
     return CompareReport(app_a.app_id or app_a.path,
                          app_b.app_id or app_b.path, queries, threshold,
                          sorted(qids_a - qids_b), sorted(qids_b - qids_a))
@@ -485,6 +585,16 @@ def _bench_movement(entry: Dict) -> Optional[Dict]:
     return {"d2h_bytes": int(entry.get("d2h_bytes") or 0),
             "h2d_bytes": int(entry.get("h2d_bytes") or 0),
             "round_trips": int(entry.get("round_trips") or 0)}
+
+
+def _bench_shuffle(entry: Dict) -> Optional[Dict]:
+    """Per-query shuffle numbers from a bench JSON entry (bench.py
+    writes shuffle_wall_s/shuffle_wall_frac/wire_bytes when shuffle
+    telemetry is on)."""
+    if "shuffle_wall_s" not in entry:
+        return None
+    return {"shuffle_wall_s": float(entry.get("shuffle_wall_s") or 0.0),
+            "wire_bytes": int(entry.get("wire_bytes") or 0)}
 
 
 def compare_bench_results(path_a: str, path_b: str, threshold: float = 0.2,
@@ -530,6 +640,9 @@ def compare_bench_results(path_a: str, path_b: str, threshold: float = 0.2,
             mv_a = _bench_movement(qs_a[name])
             mv_b = _bench_movement(qs_b[name])
             move_deltas, move_flagged = movement_delta(mv_a, mv_b)
+            sh_a = _bench_shuffle(qs_a[name])
+            sh_b = _bench_shuffle(qs_b[name])
+            sh_deltas, sh_flagged = shuffle_delta(sh_a, sh_b)
             # absolute sync-wait budget on the CANDIDATE run: > 10% of
             # wall blocked on syncs fails even if the baseline was just
             # as bad; the heaviest ledger funnel gives the fix a target
@@ -548,7 +661,10 @@ def compare_bench_results(path_a: str, path_b: str, threshold: float = 0.2,
                 move_deltas, move_flagged,
                 {k: float(v) for k, v in (mv_a or {}).items()},
                 sync_gate_frac=gate_frac,
-                sync_top_site=str(qs_b[name].get("sync_top_site") or "")))
+                sync_top_site=str(qs_b[name].get("sync_top_site") or ""),
+                shuffle_deltas=sh_deltas, shuffle_flagged=sh_flagged,
+                shuffle_base={k: float(v) for k, v in
+                              (sh_a or {}).items()}))
     return CompareReport(path_a, path_b, queries, threshold,
                          only_a, only_b)
 
@@ -613,7 +729,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     return 1 if report.regressions() \
         or report.critical_path_regressions() \
         or report.memory_regressions() \
-        or report.movement_regressions() else 0
+        or report.movement_regressions() \
+        or report.shuffle_regressions() else 0
 
 
 if __name__ == "__main__":  # pragma: no cover

@@ -62,6 +62,9 @@ class QueryDiagnosis:
     critical_path: Optional[Dict] = None
     #: the movement_summary payload (schema v11; None pre-v11 / ledger off)
     movement: Optional[Dict] = None
+    #: the shuffle_summary payload (schema v12; None pre-v12 /
+    #: telemetry off)
+    shuffle: Optional[Dict] = None
 
     def top(self, n: int = 3) -> List[Finding]:
         return self.findings[:n]
@@ -165,6 +168,7 @@ class DiagnoseReport:
                 "findings": [f.to_dict() for f in q.top(top)],
                 "critical_path": q.critical_path,
                 "movement": q.movement,
+                "shuffle": q.shuffle,
             } for q in self.queries],
             "sync_debt": _sync_debt_info(),
             "measured_sync": self._measured_sync(),
@@ -770,6 +774,60 @@ def _movement_findings(q, wall: float) -> List[Finding]:
     return findings
 
 
+#: measured-wall straggler gate: the slowest (shuffle, partition, tier)
+#: triple must exceed the p50 partition wall by this factor AND clear
+#: the absolute floor below before it flags — complements the v7
+#: row-count skew records, which can't see a balanced partition
+#: crawling on a slow link
+_STRAGGLER_FLAG_SKEW = 4.0
+_STRAGGLER_FLAG_WALL_S = 0.05
+
+
+def _shuffle_findings(q, wall: float) -> List[Finding]:
+    """Schema-v12 shuffle_summary records: the shuffle observatory's
+    per-query aggregation. A measured-time straggler (one partition's
+    transfer wall far above the p50) bounds the stage no matter how
+    balanced the row counts look; retries and deep publish queues are
+    transport-tier backpressure."""
+    sh = getattr(q, "shuffle_summary", None) or {}
+    findings: List[Finding] = []
+    st = sh.get("straggler") or {}
+    skew = float(st.get("skew") or 0.0)
+    slowest = float(st.get("slowest_wall_s") or 0.0)
+    if skew >= _STRAGGLER_FLAG_SKEW and slowest >= _STRAGGLER_FLAG_WALL_S:
+        worst = st.get("worst") or {}
+        findings.append(Finding(
+            node="(query)", node_id=None, metric="shuffleStraggler",
+            seconds=slowest,
+            fraction=min(1.0, slowest / wall) if wall > 0
+            else _FRACTION_FLOOR,
+            detail=f"slowest shuffle partition took {slowest:.4f}s vs "
+                   f"p50 {float(st.get('p50_wall_s') or 0.0):.4f}s "
+                   f"({skew:.1f}x) — shuffle {worst.get('shuffle_id')} "
+                   f"partition {worst.get('partition')} on the "
+                   f"{worst.get('tier')} tier; the stage waits on it",
+            suggestion="measured-time straggler — repartition on a "
+                       "higher-cardinality key or salt the hot key to "
+                       "split the heavy partition; if row counts are "
+                       "balanced (no shuffleSkew finding), the slow "
+                       "link/peer itself is the suspect"))
+    totals = sh.get("totals") or {}
+    retries = int(totals.get("retries") or 0)
+    depth = int(totals.get("max_queue_depth") or 0)
+    if retries:
+        findings.append(Finding(
+            node="(query)", node_id=None, metric="shuffleBackpressure",
+            seconds=0.0, fraction=min(1.0, 0.05 * retries),
+            detail=f"{retries} shuffle transfer retr(y/ies), max "
+                   f"publish-queue depth {depth} — peers answered late "
+                   "or the map side outran the reducers",
+            suggestion="transport-tier backpressure — check peer "
+                       "liveness; raise shuffle.tcp.retryAttempts only "
+                       "if the fabric is genuinely lossy, and lower map "
+                       "parallelism if the publish queue keeps growing"))
+    return findings
+
+
 def _diagnose_query(q, heartbeats=None) -> Optional[QueryDiagnosis]:
     wall = getattr(q, "wall_s", 0.0)
     if wall <= 0 or getattr(q, "error", None):
@@ -940,9 +998,14 @@ def _diagnose_query(q, heartbeats=None) -> Optional[QueryDiagnosis]:
     findings.extend(_sync_wait_gate_findings(
         cp, getattr(q, "movement_summary", None), wall))
 
+    # 14. shuffle observatory (schema v12): measured-time stragglers and
+    # transport-tier backpressure from the per-tier transfer telemetry
+    findings.extend(_shuffle_findings(q, wall))
+
     findings.sort(key=lambda f: -f.fraction)
     return QueryDiagnosis(q.query_id, wall, findings, critical_path=cp,
-                          movement=getattr(q, "movement_summary", None))
+                          movement=getattr(q, "movement_summary", None),
+                          shuffle=getattr(q, "shuffle_summary", None))
 
 
 def diagnose_app(app, path: str = "") -> DiagnoseReport:

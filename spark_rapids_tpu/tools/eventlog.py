@@ -84,11 +84,17 @@ __all__ = ["EventLogWriter", "load_event_log", "AppReplay", "QueryReplay",
 # Event-record schema version. Bump ONLY with a migration note in
 # docs/observability.md; tests/test_observability.py pins the current value
 # and the per-record required-key sets so replay/compare tooling can rely
-# on old logs staying loadable. v11: movement_summary records — ONE per
+# on old logs staying loadable. v12: shuffle_summary records — ONE per
+# query, the shuffle observatory's per-query aggregation of every
+# transfer on every shuffle tier (shuffle/telemetry.py): per-tier and
+# per-(shuffle, tier) bytes/wall/phase breakdowns, stitched TCP
+# sender/receiver counts and straggler attribution (slowest-partition
+# wall vs p50); null payload when the observatory is off.
+# (v11 added movement_summary records — ONE per
 # query, the data-movement ledger's per-query aggregation of every
 # host<->device crossing (utils/movement.py): per-site and per-operator
 # bytes/wall/counts plus round-trip detections; null payload when the
-# ledger is off. (v10 added fallback records — one per batch a
+# ledger is off; v10 added fallback records — one per batch a
 # device operator re-executed through the host engine after a terminal
 # device failure (exec/fallback.py): operator + failure class + bytes
 # moved each way + host wall time; v9 added oom_retry records — one per
@@ -99,7 +105,7 @@ __all__ = ["EventLogWriter", "load_event_log", "AppReplay", "QueryReplay",
 # always-written per-query recovery-ledger delta; v7 added shuffle_skew
 # records; v6 added memory_summary/oom_postmortem records and
 # peak_device_bytes on node records.)
-SCHEMA_VERSION = 11
+SCHEMA_VERSION = 12
 
 # The event-record schema registry: every record type a writer may emit,
 # mapped to the schema version that introduced it. srtpu-analyze's
@@ -124,12 +130,20 @@ RECORD_TYPES: Dict[str, int] = {
     "oom_retry": 9,
     "fallback": 10,
     "movement_summary": 11,
+    "shuffle_summary": 12,
 }
 
 #: health_check flags a query whose critical-path ``sync_wait`` fraction
 #: exceeds this (v11) — past it, host<->device synchronization is the
 #: dominant cost and the movement ledger's site ranking is the worklist
 SYNC_WAIT_WARN_FRAC = 0.4
+
+#: health_check flags a shuffle straggler when the slowest partition's
+#: measured transfer wall exceeds the p50 by this factor (v12) AND the
+#: absolute wall clears ``SHUFFLE_STRAGGLER_WARN_WALL_S`` — tiny queries
+#: have noisy ratios, so both gates must fire
+SHUFFLE_STRAGGLER_WARN_SKEW = 4.0
+SHUFFLE_STRAGGLER_WARN_WALL_S = 0.05
 
 EVENT_LOG_DIR = register_conf(
     "spark.rapids.tpu.eventLog.dir",
@@ -229,6 +243,9 @@ class EventLogWriter:
             # v11: whatever the query moved across the PCI boundary before
             # failing is exactly where a timeout/OOM forensics starts
             self._write_movement_records(qid)
+            # v12: ditto for shuffle transfers — a query that died mid
+            # exchange leaves the straggler/backpressure trail here
+            self._write_shuffle_records(qid)
             self.write({"event": "query_end", "query_id": qid,
                         "ts": time.time(), "trace_id": tctx.trace_id,
                         "wall_s": time.perf_counter() - t0,
@@ -278,6 +295,7 @@ class EventLogWriter:
         self._write_oom_retry_records(qid)
         self._write_fallback_records(qid)
         self._write_movement_records(qid)
+        self._write_shuffle_records(qid)
         aqe_events: List[str] = list(getattr(plan, "events", []))
         self.write({
             "event": "query_end", "query_id": qid, "ts": time.time(),
@@ -348,6 +366,17 @@ class EventLogWriter:
         self.write({"event": "movement_summary", "query_id": qid,
                     "ts": time.time(),
                     "movement": movement.query_summary(qid)})
+
+    def _write_shuffle_records(self, qid: int) -> None:
+        """v12: write ONE ``shuffle_summary`` record — the shuffle
+        observatory's per-query aggregation of every transfer on every
+        shuffle tier (shuffle/telemetry.py), with straggler attribution.
+        ``shuffle`` is null when the observatory is off (the default),
+        so the per-query record set is stable either way."""
+        from ..shuffle import telemetry
+        self.write({"event": "shuffle_summary", "query_id": qid,
+                    "ts": time.time(),
+                    "shuffle": telemetry.query_summary(qid)})
 
     def _write_fallback_records(self, qid: int) -> None:
         """v10: drain the degradation layer's completed-fallback records
@@ -447,6 +476,10 @@ class QueryReplay:
         # host<->device bytes, wall, blocking counts and round trips
         # (None for pre-v11 logs AND when the ledger is off)
         self.movement_summary: Optional[Dict] = None
+        # v12: shuffle observatory aggregation — per-tier/per-shuffle
+        # transfer bytes, walls, retries and straggler attribution
+        # (None for pre-v12 logs AND when shuffle telemetry is off)
+        self.shuffle_summary: Optional[Dict] = None
 
     def heartbeats_in_window(self, heartbeats: List[Dict]) -> List[Dict]:
         """App heartbeats whose timestamp falls inside this query's run
@@ -629,6 +662,29 @@ class AppReplay:
                     "host round trip (downloaded then re-uploaded within "
                     "the query) — keep them device-resident or cache the "
                     "shuffle on device")
+            # v12: shuffle observatory — measured per-partition transfer
+            # walls expose stragglers that row-count skew records can't
+            # (a balanced partition on a slow link still stalls the stage)
+            sh = q.shuffle_summary or {}
+            st = sh.get("straggler") or {}
+            if ((st.get("skew") or 0.0) >= SHUFFLE_STRAGGLER_WARN_SKEW
+                    and (st.get("slowest_wall_s") or 0.0)
+                    >= SHUFFLE_STRAGGLER_WARN_WALL_S):
+                worst = st.get("worst") or {}
+                warnings.append(
+                    f"q{q.query_id}: shuffle straggler — slowest partition "
+                    f"wall {st['slowest_wall_s']:.3f}s vs p50 "
+                    f"{st['p50_wall_s']:.3f}s ({st['skew']:.1f}x; shuffle "
+                    f"{worst.get('shuffle_id')} partition "
+                    f"{worst.get('partition')} on the {worst.get('tier')} "
+                    "tier) — repartition or salt the hot keys")
+            sht = sh.get("totals") or {}
+            if sht.get("retries"):
+                warnings.append(
+                    f"q{q.query_id}: {sht['retries']} shuffle transfer "
+                    "retrie(s) — peers answered late or died; check "
+                    "transport-tier backpressure (max publish-queue depth "
+                    f"{sht.get('max_queue_depth', 0)})")
         stalled = [h for h in self.heartbeats if h.get("stalled")]
         if stalled:
             age = max(h.get("last_progress_age_s", 0.0) for h in stalled)
@@ -700,6 +756,10 @@ def load_event_log(path: str) -> AppReplay:
                 q = app.queries.setdefault(rec["query_id"],
                                            QueryReplay(rec["query_id"]))
                 q.movement_summary = rec.get("movement")
+            elif ev == "shuffle_summary":
+                q = app.queries.setdefault(rec["query_id"],
+                                           QueryReplay(rec["query_id"]))
+                q.shuffle_summary = rec.get("shuffle")
             elif ev == "query_end":
                 q = app.queries.setdefault(rec["query_id"],
                                            QueryReplay(rec["query_id"]))

@@ -25,9 +25,13 @@ the process exits; this module makes them durable:
   plus three gates of its own over the per-query counter deltas the
   event log already carries: **sync count** (``host_sync_d2h_count``,
   the deliberate-D2H funnel counter in columnar/device.py), **compile
-  count** (``compile_cache_compiles``), and — when the movement ledger
+  count** (``compile_cache_compiles``), — when the movement ledger
   is on — **D2H bytes** (``movement_d2h_bytes``, floor
-  ``BYTES_FLAG_MIN``). Any growing past ``COUNT_FLAG_FRAC`` (10 %,
+  ``BYTES_FLAG_MIN``), and — when shuffle telemetry is on — **shuffle
+  wall** (``shuffle_telemetry_wall_s``, floor
+  ``SHUFFLE_WALL_FLAG_MIN_S``: time measurably spent inside shuffle
+  transfer phases, which a fast machine can hide inside flat wall
+  time). Any growing past ``COUNT_FLAG_FRAC`` (10 %,
   absolute floor ``COUNT_FLAG_MIN`` for counts) flags a regression
   wall-time comparison alone would miss: the run got slower
   *structurally* (more host round trips, wider downloads,
@@ -55,7 +59,8 @@ from ..conf import register_conf
 
 __all__ = ["HistoryStore", "run_sentinel", "HISTORY_DIR",
            "COUNT_FLAG_FRAC", "COUNT_FLAG_MIN", "SYNC_COUNT_KEY",
-           "COMPILE_COUNT_KEY", "D2H_BYTES_KEY", "BYTES_FLAG_MIN"]
+           "COMPILE_COUNT_KEY", "D2H_BYTES_KEY", "BYTES_FLAG_MIN",
+           "SHUFFLE_WALL_KEY", "SHUFFLE_WALL_FLAG_MIN_S"]
 
 HISTORY_DIR = register_conf(
     "spark.rapids.tpu.history.dir",
@@ -98,6 +103,18 @@ D2H_BYTES_KEY = "movement_d2h_bytes"
 #: absolute growth floor for the byte gate (1 MiB), so per-run row-count
 #: jitter on small queries doesn't flap the sentinel
 BYTES_FLAG_MIN = 1 << 20
+
+#: per-query stats key for the shuffle-wall gate (shuffle-observatory
+#: totals via the shuffle_telemetry stats source, shuffle/telemetry.py):
+#: wall measurably spent inside transfer phases (serialize/publish/
+#: fetch/deserialize/dispatch). Catches a shuffle tier getting slower
+#: even when overlap keeps query wall flat. Requires
+#: spark.rapids.tpu.shuffle.telemetry.enabled on both runs; absent
+#: stats gate nothing.
+SHUFFLE_WALL_KEY = "shuffle_telemetry_wall_s"
+#: absolute growth floor for the shuffle-wall gate (50 ms), so
+#: scheduler jitter on tiny transfers doesn't flap the sentinel
+SHUFFLE_WALL_FLAG_MIN_S = 0.05
 
 _EVENTLOG_NAME = "eventlog.jsonl"
 _APP_JSON = "app.json"
@@ -378,6 +395,12 @@ def run_sentinel(store: HistoryStore,
     d2h_flags = [f for f in _count_gate(report, D2H_BYTES_KEY,
                                         BYTES_FLAG_MIN)
                  if f["query_id"] not in chaos_ok]
+    # v12: shuffle-observatory transfer-wall growth — time spent inside
+    # shuffle phases regressing past 10% and the 50ms floor flags even
+    # when pipeline overlap keeps end-to-end wall flat
+    shuffle_flags = [f for f in _count_gate(report, SHUFFLE_WALL_KEY,
+                                            SHUFFLE_WALL_FLAG_MIN_S)
+                     if f["query_id"] not in chaos_ok]
     wall_q = [q.query_id for q in report.regressed_queries()
               if q.query_id not in chaos_ok]
     wall_ops = [(op.query_id, op.name) for op in report.regressions()
@@ -399,6 +422,8 @@ def run_sentinel(store: HistoryStore,
         flags.append("compile_count")
     if d2h_flags:
         flags.append("d2h_bytes")
+    if shuffle_flags:
+        flags.append("shuffle_wall")
     verdict = {
         "ok": not flags,
         "status": "regressed" if flags else "clean",
@@ -415,6 +440,7 @@ def run_sentinel(store: HistoryStore,
         "sync_count_regressions": sync_flags,
         "compile_count_regressions": compile_flags,
         "d2h_bytes_regressions": d2h_flags,
+        "shuffle_wall_regressions": shuffle_flags,
         "chaos_recovered_queries": sorted(chaos_ok),
         "summary": report.summary(),
     }

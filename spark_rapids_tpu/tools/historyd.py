@@ -325,6 +325,42 @@ class _HistoryHandler(BaseHTTPRequestHandler):
                 "<table><tr><th>site</th><th>dir</th><th>count</th>"
                 "<th>bytes</th><th>wall s</th><th>blocking</th>"
                 "<th>round trips</th></tr>" + srow + "</table>")
+        # shuffle observatory (v12: per-tier transfers + straggler)
+        sh_tbl = ""
+        sh = getattr(q, "shuffle_summary", None)
+        if sh:
+            tot = sh.get("totals") or {}
+            trow = "".join(
+                f"<tr><td>{html.escape(t.get('tier', ''))}</td>"
+                f"<td>{t.get('count', 0)}</td>"
+                f"<td>{_fmt_bytes(t.get('logical_bytes', 0))}</td>"
+                f"<td>{_fmt_bytes(t.get('wire_bytes', 0))}</td>"
+                f"<td>{t.get('wall_s', 0.0):.4f}</td>"
+                f"<td>{t.get('retries', 0)}</td>"
+                f"<td>{t.get('max_queue_depth', 0)}</td></tr>"
+                for t in sh.get("tiers") or [])
+            strag = ""
+            st = sh.get("straggler")
+            if st:
+                worst = st.get("worst") or {}
+                strag = (
+                    f"<p>straggler: slowest partition "
+                    f"{st.get('slowest_wall_s', 0.0):.4f}s vs p50 "
+                    f"{st.get('p50_wall_s', 0.0):.4f}s "
+                    f"({st.get('skew', 0.0):.1f}x) — shuffle "
+                    f"{html.escape(str(worst.get('shuffle_id')))} partition "
+                    f"{html.escape(str(worst.get('partition')))} on "
+                    f"{html.escape(str(worst.get('tier')))}</p>")
+            sh_tbl = (
+                f"<h3>shuffle observatory (v12: "
+                f"{tot.get('transfers', 0)} transfer(s), "
+                f"{_fmt_bytes(tot.get('logical_bytes', 0))} logical, "
+                f"{_fmt_bytes(tot.get('wire_bytes', 0))} on the wire, "
+                f"{tot.get('retries', 0)} retr(y/ies), "
+                f"{tot.get('stitched', 0)} stitched)</h3>"
+                "<table><tr><th>tier</th><th>count</th><th>logical</th>"
+                "<th>wire</th><th>wall s</th><th>retries</th>"
+                "<th>max queue</th></tr>" + trow + "</table>" + strag)
         # shuffle skew (v7)
         skew_tbl = ""
         if q.shuffle_skew:
@@ -346,7 +382,7 @@ class _HistoryHandler(BaseHTTPRequestHandler):
                if q.error else "")
         body = (f"<p><a href='/app/{aid}'>← run {aid}</a></p>" + err
                 + f"<p>wall {q.wall_s:.4f}s</p>"
-                + plan_tbl + cp_tbl + mem_tbl + mv_tbl + skew_tbl
+                + plan_tbl + cp_tbl + mem_tbl + mv_tbl + sh_tbl + skew_tbl
                 + k_tbl + metrics_tbl)
         return _page(f"{app_id} — query {qid}", body)
 

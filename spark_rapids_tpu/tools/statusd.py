@@ -17,8 +17,14 @@ fleet needs a liveness probe per process):
   ``spark.rapids.tpu.movement.enabled`` is on — the movement ledger's
   transfer gauges (``spark_rapids_tpu_movement_d2h_bytes``,
   ``..._h2d_bytes``, ``..._blocking_count``, ``..._round_trips``,
-  ``..._wall_s`` from utils/movement.py), which the federation
-  endpoints re-export per process.
+  ``..._wall_s`` from utils/movement.py), and — when
+  ``spark.rapids.tpu.shuffle.telemetry.enabled`` is on — the shuffle
+  observatory's per-tier transfer gauges
+  (``spark_rapids_tpu_shuffle_telemetry_transfers``,
+  ``..._logical_bytes``, ``..._wire_bytes``, ``..._wall_s``,
+  ``..._retries``, ``..._stitched``, ``..._max_queue_depth`` from
+  shuffle/telemetry.py), which the federation endpoints re-export per
+  process.
 - ``GET /status`` — the full live JSON snapshot
   (``HealthMonitor.snapshot()``): semaphore holders/waiters, pipeline
   queue depths + in-flight task ages, HBM watermarks, the memory

@@ -460,6 +460,11 @@ def _movement_source() -> Dict:
     return movement_stats()
 
 
+def _shuffle_telemetry_source() -> Dict:
+    from ..shuffle.telemetry import shuffle_telemetry_stats
+    return shuffle_telemetry_stats()
+
+
 _DEFAULT_SOURCES = {
     "compile_cache": _compile_cache_source,
     "catalog": _catalog_source,
@@ -475,6 +480,7 @@ _DEFAULT_SOURCES = {
     "fallback": _fallback_source,
     "deadline": _deadline_source,
     "movement": _movement_source,
+    "shuffle_telemetry": _shuffle_telemetry_source,
 }
 
 _GLOBAL_STATS: Optional[StatsRegistry] = None

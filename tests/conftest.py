@@ -85,6 +85,19 @@ def _drain_degradation_state_per_module():
 
 
 @pytest.fixture(autouse=True, scope="module")
+def _drain_shuffle_observatory_per_module():
+    """The shuffle observatory is process-wide and installed by whichever
+    session configured it last (shuffle/telemetry.py). A module that
+    turned it on would otherwise keep every later module's transfers
+    recording — and its per-query accumulators would leak into the next
+    module's shuffle_summary records. Reset between modules so the
+    default (off, zero-overhead) state is restored."""
+    yield
+    from spark_rapids_tpu.shuffle.telemetry import reset_shuffle_telemetry
+    reset_shuffle_telemetry()
+
+
+@pytest.fixture(autouse=True, scope="module")
 def _drain_movement_state_per_module():
     """The movement ledger is process-wide and installed by whichever
     session configured it last (utils/movement.py). A module that turned

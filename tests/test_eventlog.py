@@ -123,7 +123,7 @@ def test_event_log_shuffle_skew_records_v7(tmp_path):
 
     from spark_rapids_tpu.tools.eventlog import (RECORD_TYPES,
                                                  SCHEMA_VERSION)
-    assert SCHEMA_VERSION == 11 and RECORD_TYPES["shuffle_skew"] == 7
+    assert SCHEMA_VERSION == 12 and RECORD_TYPES["shuffle_skew"] == 7
     path = _run_app(tmp_path)  # host-tier group-by shuffle, 4 partitions
     records = [json.loads(line) for line in open(path, encoding="utf-8")]
     skews = [r for r in records if r["event"] == "shuffle_skew"]

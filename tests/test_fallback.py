@@ -467,7 +467,7 @@ def test_eventlog_v10_fallback_records(tmp_path):
         faults.reset_faults()
         sess.close()
     app = load_event_log(path)
-    assert app.schema_version == 11
+    assert app.schema_version == 12
     (q,) = [q for q in app.queries.values() if q.fallbacks]
     for rec in q.fallbacks:
         for key in ("event", "query_id", "ts", "operator", "context",

@@ -306,8 +306,8 @@ def test_heartbeat_schema_v4_roundtrip(tmp_path):
     from spark_rapids_tpu.tools.eventlog import (SCHEMA_VERSION,
                                                  load_event_log)
 
-    assert SCHEMA_VERSION == 11  # v11: movement_summary records (see
-    # test_observability.py + test_oom_retry.py pins);
+    assert SCHEMA_VERSION == 12  # v12: shuffle_summary records (see
+    # test_observability.py + test_shuffle_observatory.py pins);
     # heartbeat records are unchanged from v4
     sess = TpuSession({
         "spark.rapids.tpu.eventLog.dir": str(tmp_path),
@@ -337,7 +337,7 @@ def test_heartbeat_schema_v4_roundtrip(tmp_path):
     assert [hb["seq"] for hb in hbs] == [1, 2]
     # replay: heartbeats surface on the app, version pinned
     app = load_event_log(path)
-    assert app.schema_version == 11
+    assert app.schema_version == 12
     assert len(app.heartbeats) == 2
     # query window timestamps replay (heartbeats here fired after the
     # query, so the window is empty — attribution, not accidental capture)

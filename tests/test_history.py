@@ -300,7 +300,7 @@ def test_shuffle_skew_record_schema_v7_pin():
                                                  SCHEMA_VERSION)
     from spark_rapids_tpu.utils.metrics import (build_skew_record,
                                                 skew_summary)
-    assert SCHEMA_VERSION == 11
+    assert SCHEMA_VERSION == 12
     assert RECORD_TYPES["shuffle_skew"] == 7
     assert max(RECORD_TYPES.values()) == SCHEMA_VERSION
 
@@ -342,7 +342,7 @@ def test_session_close_appends_run(tmp_path):
     apps = store.apps()
     assert len(apps) == 1
     h = apps[0]
-    assert h["n_queries"] == 1 and h["schema_version"] == 11
+    assert h["n_queries"] == 1 and h["schema_version"] == 12
     app = store.load(h["app_id"])
     (q,) = app.queries.values()
     assert q.nodes  # plan replays

@@ -321,7 +321,7 @@ def test_health_check_warns_on_sync_wait_fraction(tmp_path):
     warning naming the heaviest measured site (v11)."""
     from spark_rapids_tpu.tools.eventlog import load_event_log
     recs = [
-        {"event": "app_start", "app_id": "mv", "schema_version": 11,
+        {"event": "app_start", "app_id": "mv", "schema_version": 12,
          "ts": 0.0, "conf": {}},
         {"event": "query_start", "query_id": 0, "ts": 1.0, "plan": "p",
          "trace_id": "t"},
@@ -380,7 +380,7 @@ def test_sentinel_d2h_bytes_gate(tmp_path):
 
     def _log(path, app_id, d2h):
         recs = [
-            {"event": "app_start", "app_id": app_id, "schema_version": 11,
+            {"event": "app_start", "app_id": app_id, "schema_version": 12,
              "ts": 0.0, "conf": {}},
             {"event": "query_start", "query_id": 0, "ts": 1.0,
              "plan": "p", "trace_id": "t"},

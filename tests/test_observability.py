@@ -366,6 +366,11 @@ _REQUIRED_KEYS = {
     # set is stable; the populated shape is pinned in
     # tests/test_movement.py
     "movement_summary": {"event", "query_id", "ts", "movement"},
+    # v12: per-query shuffle-observatory summary, ALWAYS written
+    # (shuffle is null when the observatory is off, as in this run) so
+    # the record set is stable; the populated shape is pinned in
+    # tests/test_shuffle_observatory.py
+    "shuffle_summary": {"event", "query_id", "ts", "shuffle"},
     "app_end": {"event", "ts"},
 }
 
@@ -424,8 +429,11 @@ def test_eventlog_schema_version_and_required_keys(tmp_path):
     # host engine after a terminal device failure (none on a healthy
     # device; pinned in tests/test_fallback.py). v11 adds the
     # always-written per-query movement_summary (null payload here —
-    # observatory off; populated shape pinned in tests/test_movement.py)
-    assert SCHEMA_VERSION == 11
+    # observatory off; populated shape pinned in tests/test_movement.py).
+    # v12 adds the always-written per-query shuffle_summary (null payload
+    # here — shuffle observatory off; populated shape pinned in
+    # tests/test_shuffle_observatory.py)
+    assert SCHEMA_VERSION == 12
     assert by_type["app_start"][0]["schema_version"] == SCHEMA_VERSION
     for kind, required in _REQUIRED_KEYS.items():
         for rec in by_type[kind]:
@@ -626,7 +634,7 @@ def test_eventlog_query_stats_cover_all_subsystems(tmp_path):
     from spark_rapids_tpu.tools.eventlog import load_event_log
     path = _run_logged_app(tmp_path)
     app = load_event_log(path)
-    assert app.schema_version == 11
+    assert app.schema_version == 12
     q = app.query(1)
     assert q.stats, "query_end stats delta missing"
     for family in ("compile_cache_", "upload_cache_", "shuffle_",

@@ -512,7 +512,7 @@ def test_eventlog_v9_oom_retry_records(tmp_path, monkeypatch):
                                                  SCHEMA_VERSION,
                                                  EventLogWriter,
                                                  load_event_log)
-    assert SCHEMA_VERSION == 11 and RECORD_TYPES["oom_retry"] == 9
+    assert SCHEMA_VERSION == 12 and RECORD_TYPES["oom_retry"] == 9
     monkeypatch.setattr(retry_mod._Ladder, "spill", _fake_spill(2048))
 
     w = EventLogWriter(str(tmp_path), "app-oom", {})
@@ -528,7 +528,7 @@ def test_eventlog_v9_oom_retry_records(tmp_path, monkeypatch):
     w.close()
 
     app = load_event_log(w.path)
-    assert app.schema_version == 11
+    assert app.schema_version == 12
     (rec,) = app.query(1).oom_retries
     assert rec["event"] == "oom_retry" and rec["query_id"] == 1
     # the full v9 record shape — renaming any of these is a schema break
