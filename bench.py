@@ -104,7 +104,11 @@ per-tier transfer breakdown, wire bytes and straggler stats to the
 JSON. On a per-query timeout (BENCH_MULTICHIP_QUERY_TIMEOUT_S,
 in-worker alarm) or worker death the JSON carries the partial per-query
 results plus the observatory's forensics ring for the failed query —
-never an opaque {rc, tail} stub.
+never an opaque {rc, tail} stub. BENCH_MESH=on|off (default on) sets
+mesh-parallel stage execution (exec/mesh.py) for the headline arm, and
+after the headline runs a second eventlog-free session measures each
+query with the mesh stage OFF then ON (warm collect, then timed) — the
+A/B lands in each query's "mesh_ab".
 """
 import atexit
 import json
@@ -1618,7 +1622,8 @@ def _worker_multichip(sink: _EventSink):
     sf = float(os.environ.get("BENCH_MULTICHIP_SF", "0"))
     tables = tpch.gen_all(sf) if sf > 0 else tpch.gen_all(0, tiny=True)
     sink.emit(ev="meta", sf=sf, rows=tables["lineitem"].num_rows)
-    sess = TpuSession({
+    mesh_on = os.environ.get("BENCH_MESH", "on") != "off"
+    base_conf = {
         "spark.rapids.tpu.batchRowsMinBucket": 8192 if sf > 0 else 8,
         "spark.rapids.tpu.shuffle.partitions":
             int(os.environ.get("BENCH_PARTITIONS", "4")),
@@ -1627,6 +1632,12 @@ def _worker_multichip(sink: _EventSink):
         # join would route the probe side around the device exchange
         "spark.rapids.tpu.aqe.enabled": False,
         "spark.rapids.tpu.autoBroadcastJoinThreshold": -1,
+    }
+    sess = TpuSession({
+        **base_conf,
+        # the headline arm's mesh-parallel stage execution knob; the
+        # post-headline A/B below measures both settings either way
+        "spark.rapids.tpu.mesh.stageExecution.enabled": mesh_on,
         **_shuffle_conf(),
         **_movement_conf(),
         **_eventlog_conf("multichip", sink),
@@ -1694,8 +1705,77 @@ def _worker_multichip(sink: _EventSink):
             _log(f"multichip {name} FAILED: {e}")
     sess.close()  # flush the event log (shuffle_summary records)
     _enrich_multichip(sink, exec_log, results)
+    _mesh_ab(sink, tables, results, base_conf, n, per_q_timeout, queries)
     _write_diagnose_report("multichip")
     _bench_sentinel(sink, "multichip")
+
+
+def _mesh_ab(sink: _EventSink, tables, results, base_conf, n,
+             per_q_timeout, queries):
+    """Mesh-stage execution A/B (exec/mesh.py): re-measure each headline
+    query with mesh-parallel stage execution OFF then ON, in fresh
+    sessions WITHOUT eventlog/history conf — the A/B collects never
+    pollute the trajectory store or the sentinel's baseline chain. Each
+    arm warms a query (build + XLA compile land in the process-global
+    caches) before its timed collect, so the A/B compares steady-state
+    dispatch, not compilation order. Folds {off,on}_wall_s/_rows into
+    each query's res as "mesh_ab"; never fails the bench."""
+    from spark_rapids_tpu.parallel.mesh import virtual_cpu_mesh
+    from spark_rapids_tpu.session import TpuSession
+    from spark_rapids_tpu.tools import tpch
+
+    class _ABTimeout(Exception):
+        pass
+
+    def _on_alarm(signum, frame):
+        raise _ABTimeout()
+
+    signal.signal(signal.SIGALRM, _on_alarm)
+    ab = {name: {} for name in results}
+    for arm, enabled in (("off", False), ("on", True)):
+        try:
+            sess = TpuSession({
+                **base_conf,
+                "spark.rapids.tpu.mesh.stageExecution.enabled": enabled})
+            sess.attach_mesh(virtual_cpu_mesh(n))
+            dfs = tpch.build_dataframes(sess, tables, num_partitions=2)
+        except Exception as e:
+            _log(f"multichip mesh A/B arm={arm}: setup failed: {e}")
+            continue
+        for qn in queries:
+            name = f"q{qn}"
+            if name not in ab:
+                continue  # headline run never finished this query
+            signal.alarm(int(per_q_timeout))
+            try:
+                q = getattr(tpch, name)(dfs)
+                q.collect(device=True)  # warm: plan + compile
+                t0 = time.perf_counter()
+                out = q.collect(device=True)
+                wall = time.perf_counter() - t0
+                signal.alarm(0)
+                ab[name][f"{arm}_wall_s"] = round(wall, 4)
+                ab[name][f"{arm}_rows"] = out.num_rows
+                _log(f"multichip mesh A/B {name} {arm}: {wall:.3f}s "
+                     f"rows={out.num_rows}")
+            except _ABTimeout:
+                signal.alarm(0)
+                ab[name][f"{arm}_error"] = \
+                    f"timeout > {per_q_timeout:.0f}s"
+                _log(f"multichip mesh A/B {name} {arm}: TIMEOUT")
+            except Exception as e:
+                signal.alarm(0)
+                ab[name][f"{arm}_error"] = \
+                    f"{type(e).__name__}: {e}"[:200]
+                _log(f"multichip mesh A/B {name} {arm} FAILED: {e}")
+        try:
+            sess.close()
+        except Exception:
+            pass
+    for name, res in results.items():
+        if ab.get(name):
+            res["mesh_ab"] = ab[name]
+            sink.emit(ev="done", phase="multichip", name=name, res=res)
 
 
 def _enrich_multichip(sink: _EventSink, exec_log, results):
@@ -1740,11 +1820,14 @@ def multichip_main(out_path: str):
     (partial results + observatory ring) on timeout or worker death."""
     _silence_xla_cpu_noise()
     n = int(os.environ.get("BENCH_MULTICHIP_DEVICES", "8"))
-    timeout = float(os.environ.get("BENCH_MULTICHIP_TIMEOUT_S", "300"))
+    # budget covers the headline queries PLUS the mesh A/B's two extra
+    # warm+timed collects per query (warm arms reuse compiled programs)
+    timeout = float(os.environ.get("BENCH_MULTICHIP_TIMEOUT_S", "480"))
     status, current = _run_phase("multichip", "cpu", None, timeout)
     queries = _STATE["multichip"]
     out = {
         "n_devices": n,
+        "mesh": os.environ.get("BENCH_MESH", "on"),
         "status": status,
         "ok": status == "clean" and not _STATE["errors"],
         "queries": queries,
@@ -1791,7 +1874,7 @@ if __name__ == "__main__":
         sys.exit(0)
     if len(sys.argv) >= 2 and sys.argv[1] == "--multichip":
         multichip_main(sys.argv[2] if len(sys.argv) > 2
-                       else os.path.join(_REPO, "MULTICHIP_r06.json"))
+                       else os.path.join(_REPO, "MULTICHIP_r07.json"))
         sys.exit(0)
     try:
         main()

@@ -45,7 +45,8 @@ __all__ = ["BucketPolicy", "DeferredScalar", "DeviceColumn", "DeviceTable",
            "configure_async", "configure_buckets",
            "configure_debug", "current_bucket_policy",
            "debug_assertions_enabled", "host_sync_stats",
-           "resolve_min_bucket", "resolve_scalars", "to_host_batched"]
+           "resolve_min_bucket", "resolve_scalars", "shard_row_counts",
+           "to_host_batched"]
 
 # process-wide count of deliberate D2H materializations (to_host calls —
 # the funnel every blocking download converges on per the srtpu-analyze
@@ -1092,6 +1093,19 @@ def drop_column(table: DeviceTable, name: str) -> DeviceTable:
     return DeviceTable(table.columns[:i] + table.columns[i + 1:],
                        table.row_mask, table.num_rows,
                        table.names[:i] + table.names[i + 1:])
+
+
+def shard_row_counts(table: DeviceTable, n: int) -> List["jax.Array"]:
+    """Per-shard active-row counts of a row-sharded table, in shard
+    order. Each count is a LAZY device scalar (a sum over the shard's
+    addressable mask piece) — callers bulk-resolve them in one funnel
+    transfer (``resolve_scalars`` / ``jax.device_get``) instead of
+    syncing per shard. Used by the keep-sharded exchange path, where
+    the mask is never split into per-device tables."""
+    shards = sorted(table.row_mask.addressable_shards,
+                    key=lambda s: s.index[0].start or 0)
+    assert len(shards) == n, f"{len(shards)} shards, expected {n}"
+    return [jnp.sum(s.data, dtype=jnp.int32) for s in shards]
 
 
 def pack_string_key_words(data: "jax.Array", lengths: "jax.Array"):

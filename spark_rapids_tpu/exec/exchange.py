@@ -26,7 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..columnar.device import (DeviceColumn, DeviceTable, bucket_rows,
-                               resolve_min_bucket,
+                               resolve_min_bucket, shard_row_counts,
                                concat_device_tables)
 from ..conf import register_conf
 from ..plan.physical import HashPartitioning, PhysicalPlan
@@ -110,6 +110,17 @@ class TpuShuffleExchangeExec(TpuExec):
         self.telemetry_sid = next(_EXCHANGE_IDS)
         # spill handles per partition, one per exchanged chunk
         self._shards: Optional[List[List]] = None
+        # keep-sharded mode (exec/mesh.py): a mesh-capable consumer takes
+        # the exchanged output STILL row-sharded over the mesh — no
+        # _split_sharded, no per-shard spill registration; the chunk
+        # tables live here until the mesh stage dispatches over them (or
+        # a per-partition consumer forces a late split, _ensure_split)
+        self._keep_sharded = False
+        self._sharded_chunks: Optional[List[DeviceTable]] = None
+        # per-chunk, per-shard input row counts (host ints — the batched
+        # count sync pays for them anyway): the mesh stage uses them to
+        # mirror the split path's non-empty-shard-only drain contract
+        self._sharded_chunk_rows: Optional[List[List[int]]] = None
         # v7 skew telemetry: per-output-partition rows (free — the bulk
         # shard_rows sync) and byte estimates accumulated across chunks;
         # the event log turns this into a shuffle_skew record
@@ -129,10 +140,57 @@ class TpuShuffleExchangeExec(TpuExec):
 
     def execute_columnar(self, pidx: int) -> Iterator[DeviceTable]:
         self._materialize()
+        self._ensure_split()
         from ..io.file_block import clear_input_file
         clear_input_file()  # post-shuffle rows have no single source file
         for handle in self._shards[pidx]:
             yield handle.get()
+
+    # -- keep-sharded consumer API (exec/mesh.py) -----------------------------
+    def request_keep_sharded(self) -> None:
+        """Planner hook: the consumer is mesh-capable, so materialization
+        should keep exchanged chunks row-sharded over the mesh instead of
+        splitting them into per-device spill-registered partitions. Must
+        be called before the exchange materializes (plan rewrite time)."""
+        self._keep_sharded = True
+
+    def sharded_chunks(self) -> Optional[List[tuple]]:
+        """Materialize and return ``(chunk, shard_rows)`` pairs — each
+        exchanged chunk table still row-sharded over the mesh ``dp``
+        axis (one entry per streamed chunk) with its per-shard input row
+        counts (host ints, from the chunk's batched count sync). Returns
+        None when the output already split per-partition (keep-sharded
+        was never requested, or a per-partition consumer forced the
+        split first) — the caller must use the per-partition
+        ``execute_columnar`` path instead."""
+        self._materialize()
+        with self._mat_lock:
+            if self._shards is not None:
+                return None
+            return list(zip(self._sharded_chunks or [],
+                            self._sharded_chunk_rows or []))
+
+    def _ensure_split(self) -> None:
+        """Late per-partition conversion of keep-sharded output: a
+        non-mesh consumer (the mesh stage's fallback path, or a plan that
+        reused the exchange) needs spill-registered per-device shards
+        after all."""
+        if self._shards is not None:
+            return
+        with self._mat_lock:
+            if self._shards is not None:
+                return
+            # registration's budget check can spill; never block on the
+            # semaphore while holding this shared lock (PR-3 class)
+            from ..parallel.pipeline import exempt_admission
+            with exempt_admission():
+                chunks, self._sharded_chunks = self._sharded_chunks, None
+                self._sharded_chunk_rows = None
+                n = self.num_partitions
+                shards: List[List] = [[] for _ in range(n)]
+                for t in chunks or []:
+                    self._register_split(t, shards)
+                self._shards = shards
 
     # -- the exchange ---------------------------------------------------------
     def _materialize(self) -> None:
@@ -145,7 +203,7 @@ class TpuShuffleExchangeExec(TpuExec):
         not require the whole input resident (reference: per-batch
         streaming in GpuShuffleExchangeExecBase.scala:146)."""
         with self._mat_lock:
-            if self._shards is not None:
+            if self._shards is not None or self._sharded_chunks is not None:
                 return
             # never block on the semaphore while holding this shared lock
             # (parallel/pipeline.py exempt_admission invariant)
@@ -157,6 +215,9 @@ class TpuShuffleExchangeExec(TpuExec):
         from ..parallel.pipeline import maybe_prefetched
         n = self.num_partitions
         shards: List[List] = [[] for _ in range(n)]
+        if self._keep_sharded:
+            self._sharded_chunks = []
+            self._sharded_chunk_rows = []
         self._skew_rows = [0] * n
         self._skew_bytes = [0] * n
         total_rows = 0
@@ -170,7 +231,7 @@ class TpuShuffleExchangeExec(TpuExec):
             """Map-side production across every input partition; the ICI
             collective itself must stay on one thread, so the overlap is a
             bounded prefetch of child batches under it."""
-            for p in range(self.child.num_partitions):
+            for p in range(self.child.num_partitions):  # srtpu: mesh-ok(map-side INPUT production: upstream partitions stream into the collective, the ICI all-to-all itself runs mesh-wide)
                 yield from self.child_device_batches(p)
 
         batches = maybe_prefetched(all_child_batches, stage="shuffle_map",
@@ -189,9 +250,16 @@ class TpuShuffleExchangeExec(TpuExec):
                 pending, staged = [], 0
         if pending:
             total_rows += self._exchange_chunk(pending, shards)
-        self._shards = shards
-        self.metrics.add(M.NUM_OUTPUT_BATCHES,
-                         sum(len(s) for s in shards))
+        if self._keep_sharded:
+            # output stays one sharded table per chunk (the mesh stage
+            # dispatches over all shards at once); _shards stays None
+            # until a per-partition consumer forces _ensure_split
+            self.metrics.add(M.NUM_OUTPUT_BATCHES,
+                             len(self._sharded_chunks))
+        else:
+            self._shards = shards
+            self.metrics.add(M.NUM_OUTPUT_BATCHES,
+                             sum(len(s) for s in shards))
         self.metrics.add(M.NUM_OUTPUT_ROWS, total_rows)
 
     def _exchange_chunk(self, batches: List[DeviceTable],
@@ -243,17 +311,35 @@ class TpuShuffleExchangeExec(TpuExec):
                 exchanged = ici_all_to_all_exchange(
                     sharded, keys, self.mesh, self.axis, quota=quota,
                     telemetry_sid=self.telemetry_sid)
-                # register output shards so the catalog accounts for them
-                # and can spill them until downstream consumption; the
-                # entries release at query end (release_spill_handles),
-                # with a GC finalizer fallback
-                parts = _split_sharded(exchanged, n)
-                # ONE bulk D2H of n 4-byte scalars replaces a blocking
-                # round trip per shard plus one more for the row total
-                t0 = movement.clock()
-                shard_rows = jax.device_get(  # srtpu: sync-ok(batched count sync, 4B per shard once per chunk)
-                    [t.num_rows for t in parts])
-                movement.note_d2h(_MOVE_CHUNK, 4 * len(shard_rows), t0)
+                if self._keep_sharded and self._sharded_chunks:
+                    # a SECOND chunk is streaming: kept-sharded chunks
+                    # are not spill-registered, so accumulating them
+                    # would break the exchange's out-of-core contract
+                    # (only one chunk's worth resident, earlier output
+                    # spillable). The contract wins — revert to split
+                    # mode, registering the kept chunk; the mesh stage
+                    # sees sharded_chunks() == None and falls back to
+                    # the per-partition path (exec/mesh.py)
+                    self._keep_sharded = False
+                    kept, self._sharded_chunks = self._sharded_chunks, None
+                    self._sharded_chunk_rows = None
+                    for t in kept:
+                        self._register_split(t, shards)
+                if self._keep_sharded:
+                    # mesh-capable consumer: the chunk stays ONE sharded
+                    # table (no split, no per-shard spill registration —
+                    # the mesh stage dispatches over it next); only the
+                    # per-destination row counts sync, for skew + quota
+                    # telemetry parity with the split path
+                    t0 = movement.clock()
+                    shard_rows = jax.device_get(  # srtpu: sync-ok(batched count sync, 4B per shard once per chunk)
+                        shard_row_counts(exchanged, n))
+                    movement.note_d2h(_MOVE_CHUNK, 4 * len(shard_rows), t0)
+                    self._sharded_chunks.append(exchanged)
+                    self._sharded_chunk_rows.append(
+                        [int(c) for c in shard_rows])
+                else:
+                    shard_rows = self._register_split(exchanged, shards)
                 # v7 skew: per-destination rows come free with the bulk
                 # count sync; bytes are estimated as rows × the chunk's
                 # mean row width (per-shard padded nbytes would read
@@ -263,16 +349,34 @@ class TpuShuffleExchangeExec(TpuExec):
                 for i, cnt in enumerate(shard_rows):
                     self._skew_rows[i] += int(cnt)
                     self._skew_bytes[i] += int(round(int(cnt) * bpr))
-                for i, (t, cnt) in enumerate(zip(parts, shard_rows)):
-                    if not int(cnt):
-                        continue
-                    h = catalog.register(
-                        t, SpillPriorities.OUTPUT_FOR_SHUFFLE)
-                    self._own_spill_handle(h)
-                    shards[i].append(h)
                 return chunk_total
             finally:
                 inflight.close()
+
+    def _register_split(self, exchanged: DeviceTable,
+                        shards: List[List]) -> List[int]:
+        """Split one exchanged chunk into per-device partition views and
+        spill-register each non-empty shard so the catalog accounts for
+        them and can spill them until downstream consumption; the entries
+        release at query end (release_spill_handles), with a GC finalizer
+        fallback. Returns the per-shard row counts."""
+        from ..memory.catalog import SpillPriorities, get_catalog
+        catalog = get_catalog()
+        n = self.num_partitions
+        parts = _split_sharded(exchanged, n)
+        # ONE bulk D2H of n 4-byte scalars replaces a blocking round
+        # trip per shard plus one more for the row total
+        t0 = movement.clock()
+        shard_rows = jax.device_get(  # srtpu: sync-ok(batched count sync, 4B per shard once per chunk)
+            [t.num_rows for t in parts])
+        movement.note_d2h(_MOVE_CHUNK, 4 * len(shard_rows), t0)
+        for i, (t, cnt) in enumerate(zip(parts, shard_rows)):
+            if not int(cnt):
+                continue
+            h = catalog.register(t, SpillPriorities.OUTPUT_FOR_SHUFFLE)
+            self._own_spill_handle(h)
+            shards[i].append(h)
+        return [int(c) for c in shard_rows]
 
     def shuffle_skew(self) -> Optional[dict]:
         """v7 event-log payload: the per-output-partition row/byte

@@ -1238,7 +1238,7 @@ class TpuBroadcastHashJoinExec(TpuShuffledHashJoinExec):
         if self._bc_handle is None:
             from ..memory.catalog import SpillPriorities, get_catalog
             batches = []
-            for p in range(self.right.num_partitions):
+            for p in range(self.right.num_partitions):  # srtpu: mesh-ok(build-side INPUT drain: collecting the broadcast table's partitions, not per-shard compute)
                 batches.extend(_device_batches(self.right, p))
             if not batches:
                 from .aggregate import _empty_device_table
@@ -1331,7 +1331,7 @@ class TpuBroadcastNestedLoopJoinExec(TpuExec):
         if self._bc_handle is None:
             from ..memory.catalog import SpillPriorities, get_catalog
             batches = []
-            for p in range(self.right.num_partitions):
+            for p in range(self.right.num_partitions):  # srtpu: mesh-ok(build-side INPUT drain: collecting the broadcast table's partitions, not per-shard compute)
                 batches.extend(_device_batches(self.right, p))
             if not batches:
                 from .aggregate import _empty_device_table

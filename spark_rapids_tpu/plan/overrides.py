@@ -1084,9 +1084,13 @@ def apply_overrides(cpu_plan: PhysicalPlan, conf: RapidsConf) -> PhysicalPlan:
         return cpu_plan
     converted = meta.convert_if_needed(conf)
     from .transitions import insert_transitions
+    from ..exec.mesh import plan_mesh_stages
     from ..exec.wholestage import fuse_stages
     with_transitions = insert_transitions(converted, conf)
-    return fuse_stages(with_transitions, conf)
+    fused = fuse_stages(with_transitions, conf)
+    # after fusion, so a whole ICI-exchange-fed fused stage lifts onto
+    # the mesh in one piece (exec/mesh.py)
+    return plan_mesh_stages(fused, conf)
 
 
 def _always_cpu(plan: PhysicalPlan) -> bool:

@@ -18,6 +18,7 @@ the CPU test mesh (tests/conftest.py).
 """
 from __future__ import annotations
 
+from collections import OrderedDict
 from typing import List
 
 import jax
@@ -29,10 +30,15 @@ from ..parallel.shard_compat import shard_map
 
 from ..columnar.device import (DeviceColumn, DeviceTable,
                                stable_counting_order)
+from ..utils import movement
 from . import telemetry
 from .manager import device_partition_ids
 
-__all__ = ["ici_all_to_all_exchange", "shard_table", "unshard_table"]
+__all__ = ["ici_all_to_all_exchange", "shard_table", "unshard_table",
+           "clear_exchange_programs"]
+
+# movement-observatory site identity (utils/movement.py SITES)
+_MOVE_UNSHARD = "spark_rapids_tpu/shuffle/ici.py::unshard_table"
 
 
 def shard_table(table: DeviceTable, mesh: Mesh, axis: str = "dp"
@@ -52,11 +58,47 @@ def shard_table(table: DeviceTable, mesh: Mesh, axis: str = "dp"
 
 
 def unshard_table(table: DeviceTable) -> DeviceTable:
-    import numpy as np
-    cols = jax.tree_util.tree_map(
-        lambda a: jnp.asarray(np.asarray(a)), table.columns)  # srtpu: sync-ok(deliberate unshard gather: host materialization at the shuffle boundary)
-    mask = jnp.asarray(np.asarray(table.row_mask))  # srtpu: sync-ok(deliberate unshard gather: host materialization at the shuffle boundary)
+    # ONE bulk device_get of the whole (columns, mask) leaf pytree — the
+    # PR-18 funnel shape — instead of one blocking np.asarray round trip
+    # per column plane; the ledger sees a single D2H crossing
+    t0 = movement.clock()
+    host_cols, host_mask = jax.device_get(  # srtpu: sync-ok(deliberate unshard gather: one bulk host materialization at the shuffle boundary)
+        (table.columns, table.row_mask))
+    movement.note_d2h(
+        _MOVE_UNSHARD,
+        lambda: sum(a.nbytes for a in
+                    jax.tree_util.tree_leaves((host_cols, host_mask))),
+        t0)
+    cols = jax.tree_util.tree_map(jnp.asarray, host_cols)
+    mask = jnp.asarray(host_mask)
     return DeviceTable(cols, mask, jnp.sum(mask, dtype=jnp.int32), table.names)
+
+
+# Exchange programs are AOT-compiled (lower + compile) and cached by
+# their semantic key so repeated same-shape exchanges reuse the
+# executable instead of re-tracing a fresh ``jax.jit`` closure per call,
+# and so the one-time XLA compile can be timed SEPARATELY from the
+# collective dispatch (the ``compile`` vs ``dispatch`` phase split in the
+# shuffle observatory — a cold cache must not read as shuffle wall).
+# Bounded LRU: shapes are bucketed upstream (quota bucketing,
+# exec/exchange.py), so a handful of entries covers a whole run.
+_PROGRAMS: "OrderedDict[tuple, object]" = OrderedDict()
+_PROGRAMS_MAX = 64
+
+
+def clear_exchange_programs() -> None:
+    """Drop cached exchange executables (test hygiene: compiled-program
+    caches accumulate per shape family, tests/conftest.py)."""
+    _PROGRAMS.clear()
+
+
+def _program_key(table: DeviceTable, key_names: List[str], mesh: Mesh,
+                 axis: str, quota: int | None) -> tuple:
+    leaves, treedef = jax.tree_util.tree_flatten(table.columns)
+    return (tuple(str(d) for d in mesh.devices.flat), axis, quota,
+            tuple(table.names), tuple(key_names), str(treedef),
+            tuple((l.shape, str(l.dtype)) for l in leaves),
+            (table.row_mask.shape, str(table.row_mask.dtype)))
 
 
 def ici_all_to_all_exchange(table: DeviceTable, key_names: List[str],
@@ -110,15 +152,31 @@ def ici_all_to_all_exchange(table: DeviceTable, key_names: List[str],
         out_cols = jax.tree_util.tree_map(xform, columns)
         return out_cols, out_mask
 
-    col_specs = jax.tree_util.tree_map(lambda _: P(axis), table.columns)
-    fn = jax.jit(shard_map(local, mesh=mesh, in_specs=(col_specs, P(axis)),
-                           out_specs=(col_specs, P(axis)), check=False))
-    # collective dispatch wall: compile (first call) + dispatch of the
-    # all-to-all over n devices; wire bytes are the padded sharded input
-    # actually crossing ICI links (vs the pre-padding logical bytes the
-    # exchange exec notes at enqueue)
+    key = _program_key(table, key_names, mesh, axis, quota)
+    prog = _PROGRAMS.get(key)
+    if prog is None:
+        col_specs = jax.tree_util.tree_map(lambda _: P(axis), table.columns)
+        fn = jax.jit(shard_map(local, mesh=mesh,
+                               in_specs=(col_specs, P(axis)),
+                               out_specs=(col_specs, P(axis)), check=False))
+        # one-time lower + XLA compile, timed as its own observatory
+        # phase: folding it into ``dispatch`` would read cold caches as
+        # shuffle wall and trip the sentinel's shuffle-wall gate
+        t0 = telemetry.clock()
+        prog = fn.lower(table.columns, table.row_mask).compile()
+        telemetry.note_transfer("ici", "compile", shuffle_id=telemetry_sid,
+                                t0=t0, queue_depth=n)
+        _PROGRAMS[key] = prog
+        while len(_PROGRAMS) > _PROGRAMS_MAX:
+            _PROGRAMS.popitem(last=False)
+    else:
+        _PROGRAMS.move_to_end(key)
+    # collective dispatch wall: dispatch of the all-to-all over n devices
+    # (compile is its own phase above); wire bytes are the padded sharded
+    # input actually crossing ICI links (vs the pre-padding logical bytes
+    # the exchange exec notes at enqueue)
     t0 = telemetry.clock()
-    out_cols, mask = fn(table.columns, table.row_mask)
+    out_cols, mask = prog(table.columns, table.row_mask)
     telemetry.note_transfer("ici", "dispatch", shuffle_id=telemetry_sid,
                             t0=t0, queue_depth=n,
                             wire_bytes=lambda: table.nbytes())

@@ -325,17 +325,18 @@ def load_project(paths: Sequence[str]) -> Project:
 
 def _checkers() -> Dict[str, object]:
     from . import (buckets, degrade, eventlog_schema, host_sync, jit_purity,
-                   locks, memtrack, net, retry_scope, shuffle_observed,
-                   threads, trace_ctx)
+                   locks, memtrack, mesh_loops, net, retry_scope,
+                   shuffle_observed, threads, trace_ctx)
     return {"sync": host_sync, "lock": locks,
             "thread": threads, "jit": jit_purity, "bucket": buckets,
             "trace": trace_ctx, "memtrack": memtrack,
             "eventlog": eventlog_schema, "net": net, "retry": retry_scope,
-            "degrade": degrade, "shuffle": shuffle_observed}
+            "degrade": degrade, "shuffle": shuffle_observed,
+            "mesh": mesh_loops}
 
 
 CHECKS = ("sync", "lock", "thread", "jit", "bucket", "trace", "memtrack",
-          "eventlog", "net", "retry", "degrade", "shuffle")
+          "eventlog", "net", "retry", "degrade", "shuffle", "mesh")
 
 
 def analyze_paths(paths: Sequence[str],

@@ -1,0 +1,137 @@
+"""mesh checker: per-shard Python loops that serialize mesh-wide work.
+
+The ICI exchange re-homes rows across the whole mesh in ONE collective
+program (shuffle/ici.py), and mesh-stage execution (exec/mesh.py) runs
+post-exchange operator chains as ONE ``shard_map`` program over the
+``dp`` axis. A Python ``for`` loop over the mesh extent — ``for i in
+range(mesh.shape[axis])`` / ``range(...num_partitions)`` — in those same
+hot scopes is the serialization anti-pattern this pipeline exists to
+remove: each iteration dispatches single-device work while n-1 devices
+idle.
+
+- ``mesh-shard-loop`` — a ``for`` statement iterating ``range(X)`` where
+  X derives from the mesh extent (``mesh.shape[...]``, a
+  ``num_partitions`` attribute, or a local name assigned from either),
+  inside an ``exec``/``shuffle`` package function whose scope never
+  references ``shard_map``. Route the work through a single shard_map
+  program (exec/mesh.py) or justify with ``# srtpu: mesh-ok(reason)``.
+
+Deliberately narrow: comprehensions (allocation patterns like
+``[[] for _ in range(n)]``) and non-``range`` iteration never flag, and a
+scope that builds or dispatches a shard_map program is exempt wholesale —
+its loops are spec/plumbing around the collective, not per-shard compute.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from . import Finding, Project, ScopedVisitor
+
+__all__ = ["check"]
+
+#: packages whose scopes sit on the post-exchange execution path
+_MESH_PACKAGES = ("exec", "shuffle")
+
+
+def _in_scope(ctx) -> bool:
+    parts = ctx.relpath.split("/")
+    return (len(parts) > 2 and parts[0] == "spark_rapids_tpu"
+            and parts[1] in _MESH_PACKAGES)
+
+
+def _mesh_extent(node: ast.AST, ctx, tainted: Set[str]) -> bool:
+    """Whether an expression derives from the mesh extent: mentions
+    ``X.shape[...]`` with a mesh-ish base, a ``num_partitions``
+    attribute, or a name assigned from either."""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute) and n.attr == "num_partitions":
+            return True
+        if isinstance(n, ast.Subscript) \
+                and isinstance(n.value, ast.Attribute) \
+                and n.value.attr == "shape" \
+                and "mesh" in ctx.qualify(n.value.value).lower():
+            return True
+        if isinstance(n, ast.Name) and n.id in tainted:
+            return True
+    return False
+
+
+class _Frame:
+    __slots__ = ("tainted", "shard_map", "candidates")
+
+    def __init__(self):
+        self.tainted: Set[str] = set()
+        self.shard_map = False
+        self.candidates: List[tuple] = []  # (ast node, symbol)
+
+
+class _MeshVisitor(ScopedVisitor):
+    def __init__(self, ctx):
+        super().__init__()
+        self.ctx = ctx
+        self.findings: List[Finding] = []
+        self._frames: List[_Frame] = []
+
+    def _visit_def(self, node) -> None:
+        self._scope.append(node.name)
+        self._frames.append(_Frame())
+        try:
+            self.generic_visit(node)
+        finally:
+            frame = self._frames.pop()
+            self._scope.pop()
+            if not frame.shard_map:
+                for loop, symbol in frame.candidates:
+                    self.findings.append(self.ctx.finding(
+                        "mesh", "mesh-shard-loop", loop, symbol,
+                        "Python loop over the mesh extent serializes "
+                        "per-shard work (one device computes while the "
+                        "rest idle) — run the stage as one shard_map "
+                        "program over the dp axis (exec/mesh.py) or "
+                        "justify with mesh-ok(reason)"))
+
+    visit_FunctionDef = _visit_def
+    visit_AsyncFunctionDef = _visit_def
+
+    def _note_shard_map(self, qualname: str) -> None:
+        if qualname.rsplit(".", 1)[-1].rstrip("()") == "shard_map":
+            for f in self._frames:
+                f.shard_map = True
+
+    def visit_Name(self, node: ast.Name) -> None:
+        self._note_shard_map(self.ctx.qualify(node))
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if node.attr == "shard_map":
+            self._note_shard_map("shard_map")
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if self._frames and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and _mesh_extent(node.value, self.ctx,
+                                 self._frames[-1].tainted):
+            self._frames[-1].tainted.add(node.targets[0].id)
+        self.generic_visit(node)
+
+    def visit_For(self, node: ast.For) -> None:
+        if self._frames and isinstance(node.iter, ast.Call) \
+                and self.ctx.qualify(node.iter.func) == "range" \
+                and any(_mesh_extent(a, self.ctx,
+                                     self._frames[-1].tainted)
+                        for a in node.iter.args):
+            self._frames[-1].candidates.append((node, self.symbol))
+        self.generic_visit(node)
+
+
+def check(project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    for ctx in project.modules:
+        if not _in_scope(ctx):
+            continue
+        v = _MeshVisitor(ctx)
+        v.visit(ctx.tree)
+        out.extend(v.findings)
+    return out

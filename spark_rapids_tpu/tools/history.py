@@ -116,6 +116,13 @@ SHUFFLE_WALL_KEY = "shuffle_telemetry_wall_s"
 #: scheduler jitter on tiny transfers doesn't flap the sentinel
 SHUFFLE_WALL_FLAG_MIN_S = 0.05
 
+#: absolute growth floor for the aggregate total-wall gate (2 s): the
+#: MULTICHIP trajectory gate sums per-query walls across the run, so a
+#: fleet-wide slowdown spread thinly over every query (each one under
+#: the per-query threshold) still flags, while compile-cache jitter on
+#: a single tiny query doesn't
+TOTAL_WALL_FLAG_MIN_S = 2.0
+
 _EVENTLOG_NAME = "eventlog.jsonl"
 _APP_JSON = "app.json"
 _VERDICT_JSON = "verdict.json"
@@ -401,6 +408,22 @@ def run_sentinel(store: HistoryStore,
     shuffle_flags = [f for f in _count_gate(report, SHUFFLE_WALL_KEY,
                                             SHUFFLE_WALL_FLAG_MIN_S)
                      if f["query_id"] not in chaos_ok]
+    # v13: aggregate total-wall gate (the MULTICHIP trajectory number) —
+    # per-query wall gates can miss a fleet-wide slowdown spread thinly
+    # across the run; sum walls over the query ids present in BOTH runs
+    # (chaos-exempt ones excluded, like every other gate) and flag
+    # material aggregate growth past the relative threshold + 2s floor
+    shared_q = [k for k in set(app_base.queries) & set(app_cand.queries)
+                if k not in chaos_ok]
+    base_total = sum(app_base.queries[k].wall_s for k in shared_q)
+    cand_total = sum(app_cand.queries[k].wall_s for k in shared_q)
+    total_wall = {"baseline_s": round(base_total, 4),
+                  "candidate_s": round(cand_total, 4),
+                  "n_queries": len(shared_q)} if shared_q else None
+    total_wall_flagged = bool(
+        shared_q
+        and cand_total - base_total > TOTAL_WALL_FLAG_MIN_S
+        and cand_total > base_total * (1.0 + threshold))
     wall_q = [q.query_id for q in report.regressed_queries()
               if q.query_id not in chaos_ok]
     wall_ops = [(op.query_id, op.name) for op in report.regressions()
@@ -424,6 +447,8 @@ def run_sentinel(store: HistoryStore,
         flags.append("d2h_bytes")
     if shuffle_flags:
         flags.append("shuffle_wall")
+    if total_wall_flagged:
+        flags.append("total_wall")
     verdict = {
         "ok": not flags,
         "status": "regressed" if flags else "clean",
@@ -441,6 +466,7 @@ def run_sentinel(store: HistoryStore,
         "compile_count_regressions": compile_flags,
         "d2h_bytes_regressions": d2h_flags,
         "shuffle_wall_regressions": shuffle_flags,
+        "total_wall": total_wall,
         "chaos_recovered_queries": sorted(chaos_ok),
         "summary": report.summary(),
     }

@@ -99,6 +99,7 @@ FAULT_POINTS = (
     "h2d.upload",        # exec/transitions.py host->device upload
     "alloc.jit",         # memory/retry.py jit-dispatch retry scope (supports oom/fatal)
     "alloc.upload",      # memory/retry.py H2D-upload retry scope (supports oom/fatal)
+    "mesh.dispatch",     # exec/mesh.py mesh-stage shard_map dispatch (degrades to the per-partition path)
 )
 
 # "fatal" is the non-retryable twin of "oom": memory/retry.py raises an

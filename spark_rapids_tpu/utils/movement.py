@@ -144,6 +144,15 @@ SITES: Dict[str, MovementSite] = {
         ), "count pass + bulk shard-rows sync per exchanged chunk — "
            "double-buffer so chunk N's count pass overlaps chunk N-1's "
            "all-to-all"),
+    "spark_rapids_tpu/shuffle/ici.py::unshard_table":
+        MovementSite("d2h", (
+            "spark_rapids_tpu/shuffle/ici.py::sync-device-get"
+            "::unshard_table",
+        ), "the bulk unshard gather: ONE device_get of the whole column "
+           "pytree at the shuffle boundary (was one blocking np.asarray "
+           "per column plane) — growth here is unshard volume; keep "
+           "consumers mesh-capable (exec/mesh.py) so the boundary never "
+           "materializes at all"),
     "spark_rapids_tpu/shuffle/manager.py"
     "::ShuffleManager._write_partition_transport":
         MovementSite("d2h", (

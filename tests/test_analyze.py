@@ -124,6 +124,52 @@ def test_movement_unledgered_suppression(tmp_path):
         == {"sync-device-get", "movement-unledgered"}
 
 
+def test_mesh_checker_rules(tmp_path):
+    """mesh-shard-loop trips on a per-shard Python loop over the mesh
+    extent in a hot exec scope; a scope that enters shard_map, a
+    comprehension, and a mesh-ok'd site all stay clean."""
+    hot = tmp_path / "spark_rapids_tpu" / "exec"
+    hot.mkdir(parents=True)
+    (hot / "serial.py").write_text(textwrap.dedent("""\
+        def drain(node, mesh, axis):
+            out = []
+            for i in range(mesh.shape[axis]):
+                out.append(node.dispatch(i))
+            return out
+
+        def drain_parts(node):
+            n = node.num_partitions
+            for p in range(n):
+                node.dispatch(p)
+
+        def spmd(mesh, cols, run):
+            from spark_rapids_tpu.parallel.shard_compat import shard_map
+            for i in range(mesh.shape["dp"]):
+                prime(i)   # spec plumbing around the collective: exempt
+            return shard_map(run, mesh=mesh, in_specs=None,
+                             out_specs=None)(cols)
+
+        def alloc(node):
+            return [[] for _ in range(node.num_partitions)]
+
+        def ok(node):
+            for p in range(node.num_partitions):  # srtpu: mesh-ok(input drain, not per-shard compute)
+                node.pull(p)
+        """))
+    report = analyze_paths([str(tmp_path)], checks=["mesh"])
+    assert _rules(report) == ["mesh-shard-loop"]
+    assert sorted(f.symbol for f in report.findings) \
+        == ["drain", "drain_parts"]
+    assert [f.rule for f in report.suppressed] == ["mesh-shard-loop"]
+    # outside the exec/shuffle packages the rule never fires
+    loose = _write(tmp_path, "loose.py", """\
+        def drain(node):
+            for p in range(node.num_partitions):
+                node.dispatch(p)
+        """)
+    assert analyze_paths([loose], checks=["mesh"]).count("mesh") == 0
+
+
 def test_sync_checker_computed_receivers(tmp_path):
     """.item()/.block_until_ready() on computed expressions — the
     receiver has no qualifiable name but the sync is just as blocking."""

@@ -214,6 +214,35 @@ def test_sentinel_treats_recovered_chaos_run_as_clean(tmp_path):
     assert v["chaos_recovered_queries"] == [2]
 
 
+def test_sentinel_total_wall_gate(tmp_path):
+    """The v13 aggregate gate (the MULTICHIP trajectory number): summed
+    wall over the queries present in both runs flags past the relative
+    threshold AND the 2s absolute floor — a material fleet-wide slowdown
+    trips it, while the same relative growth on a tiny run doesn't
+    flap the sentinel."""
+    store = HistoryStore(str(tmp_path / "store"))
+    store.append_run(_write_log(str(tmp_path / "b.jsonl"), "base",
+                                wall=1.0, stats=_BASE_STATS))
+    store.pin_baseline("base")
+
+    # 2 queries x (1.0s -> 3.0s): total 2s -> 6s, past 20% and the floor
+    store.append_run(_write_log(str(tmp_path / "s.jsonl"), "slow",
+                                wall=3.0, stats=_BASE_STATS))
+    v = run_sentinel(store, candidate="slow")
+    assert "total_wall" in v["flags"]
+    assert v["total_wall"]["baseline_s"] == pytest.approx(2.0)
+    assert v["total_wall"]["candidate_s"] == pytest.approx(6.0)
+    assert v["total_wall"]["n_queries"] == 2
+
+    # 50% relative growth but only +1s aggregate: under the 2s floor,
+    # the per-query wall gate still owns this one
+    store.append_run(_write_log(str(tmp_path / "j.jsonl"), "jitter",
+                                wall=1.5, stats=_BASE_STATS))
+    v = run_sentinel(store, candidate="jitter", baseline="base")
+    assert "total_wall" not in v["flags"]
+    assert v["total_wall"]["candidate_s"] == pytest.approx(3.0)
+
+
 def test_sentinel_no_baseline_and_cli_exit_codes(tmp_path):
     store_dir = str(tmp_path / "store")
     store = HistoryStore(store_dir)

@@ -1,0 +1,324 @@
+"""Mesh-parallel stage execution (exec/mesh.py): the post-exchange
+operator chain runs as ONE shard_map program over the dp axis, consuming
+the ICI exchange's output still sharded (reference analogue: partitioned
+operators running on all executors at once, SURVEY §2.7).
+
+Covers the planner rewrite, byte-parity against the per-partition path,
+the keep-sharded exchange contract, the unshard-boundary/fault fallback
+semantics, and the observatory's mesh_stage/compile phases."""
+import glob
+import os
+
+import jax
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.session import TpuSession
+from spark_rapids_tpu.utils import faults
+
+from harness import assert_tables_equal
+
+
+def _mesh_session(n=8, **extra):
+    from spark_rapids_tpu.parallel.mesh import virtual_cpu_mesh
+    if len(jax.devices()) < n:
+        pytest.skip(f"needs {n} virtual devices")
+    sess = TpuSession({
+        "spark.rapids.tpu.batchRowsMinBucket": 8,
+        "spark.rapids.tpu.shuffle.partitions": 4,
+        # pin the STATIC plan shape (mesh-stage nodes in the tree); AQE
+        # replaces exchanges with materialized stages
+        "spark.rapids.tpu.aqe.enabled": False,
+        **extra,
+    })
+    sess.attach_mesh(virtual_cpu_mesh(n))
+    return sess
+
+
+def _frame(sess, rows=64, num_partitions=2, seed=0, prefix=""):
+    rng = np.random.default_rng(seed)
+    t = pa.table({
+        prefix + "k": rng.integers(0, 9, rows),
+        prefix + "v": rng.random(rows),
+        prefix + "w": rng.integers(-50, 50, rows),
+    })
+    return sess.create_dataframe(t, num_partitions=num_partitions)
+
+
+def _agg_query(df, prefix=""):
+    from spark_rapids_tpu.expr.functions import col, count, sum as fsum
+    return df.group_by(prefix + "k").agg(
+        fsum(col(prefix + "v")).alias("s"),
+        count(col(prefix + "w")).alias("c"))
+
+
+def _find(plan, cls):
+    if isinstance(plan, cls):
+        return plan
+    for c in plan.children:
+        r = _find(c, cls)
+        if r is not None:
+            return r
+    return None
+
+
+@pytest.fixture(autouse=True)
+def _pristine_state():
+    """Fault injection and the degradation ledger are process-global by
+    design; the fallback tests below bump both."""
+    from spark_rapids_tpu.conf import RapidsConf
+    from spark_rapids_tpu.exec.fallback import (configure_fallback,
+                                                reset_fallback_state)
+    faults.reset_faults()
+    reset_fallback_state()
+    yield
+    faults.reset_faults()
+    reset_fallback_state()
+    configure_fallback(RapidsConf({}))
+
+
+# ---------------------------------------------------------------------------
+# planner rewrite
+# ---------------------------------------------------------------------------
+def test_planner_lifts_exchange_consumer_onto_the_mesh():
+    """Exchange -> final-aggregate(+fused stage above) rewrites into one
+    TpuMeshStageExec whose child is the keep-sharded exchange; the conf
+    kill-switch restores the per-partition plan."""
+    from spark_rapids_tpu.exec.exchange import TpuShuffleExchangeExec
+    from spark_rapids_tpu.exec.mesh import TpuMeshStageExec
+    from spark_rapids_tpu.expr.functions import col
+
+    sess = _mesh_session()
+    q = _agg_query(_frame(sess)).select(
+        (col("s") + col("c")).alias("t"), col("k"))
+    plan = sess._physical(q.logical, device=True)
+    node = _find(plan, TpuMeshStageExec)
+    assert node is not None, plan.tree_string()
+    assert isinstance(node.exchange, TpuShuffleExchangeExec)
+    assert node.exchange._keep_sharded
+    # the chain absorbed everything above the exchange: the final-mode
+    # aggregate AND the projection stage over it
+    assert len(node.chain) >= 2, node.node_name()
+    assert node._has_final_agg()
+    # fallback topology intact: chain links run exchange -> ... -> top
+    assert node.chain[0].children == (node.exchange,)
+    for below, above in zip(node.chain, node.chain[1:]):
+        assert above.children == (below,)
+
+    off = _mesh_session(
+        **{"spark.rapids.tpu.mesh.stageExecution.enabled": False})
+    plan_off = off._physical(_agg_query(_frame(off)).logical, device=True)
+    assert _find(plan_off, TpuMeshStageExec) is None, plan_off.tree_string()
+
+
+# ---------------------------------------------------------------------------
+# parity with the per-partition path
+# ---------------------------------------------------------------------------
+def _parity(mk_query, n=8, seed=0, rows=64, **on_extra):
+    sess_on = _mesh_session(n, **on_extra)
+    got = mk_query(_frame(sess_on, rows=rows, seed=seed)).collect(device=True)
+    sess_off = _mesh_session(
+        n, **{"spark.rapids.tpu.mesh.stageExecution.enabled": False})
+    exp = mk_query(_frame(sess_off, rows=rows, seed=seed)).collect(device=True)
+    assert_tables_equal(got, exp)
+    return got
+
+
+def test_parity_final_aggregate():
+    out = _parity(_agg_query)
+    assert out.num_rows == 9  # every key present
+
+
+def test_mesh_does_not_add_host_syncs():
+    """Download-count parity: empty shards yield nothing (exactly like
+    the split path's non-empty-only registration + the keyed aggregate's
+    skip of input-less partitions), so the mesh path must not grow the
+    deliberate-D2H funnel count the history sentinel gates on."""
+    from spark_rapids_tpu.columnar.device import host_sync_stats
+
+    def syncs(mesh_on):
+        sess = _mesh_session(**{
+            "spark.rapids.tpu.mesh.stageExecution.enabled": mesh_on})
+        q = _agg_query(_frame(sess, rows=24))  # several empty shards
+        before = host_sync_stats()["d2h_count"]
+        q.collect(device=True)
+        return host_sync_stats()["d2h_count"] - before
+
+    assert syncs(True) <= syncs(False)
+
+
+def test_parity_projection_and_filter_above_aggregate():
+    from spark_rapids_tpu.expr.functions import col
+
+    def q(df):
+        return (_agg_query(df)
+                .select(col("k"), (col("s") * 2.0).alias("s2"), col("c"))
+                .filter(col("c") > 2))
+
+    _parity(q, seed=3)
+
+
+def test_parity_on_tiny_two_device_mesh():
+    """The rewrite is extent-agnostic: same bytes on a 2-device mesh."""
+    _parity(_agg_query, n=2, seed=5)
+
+
+# ---------------------------------------------------------------------------
+# keep-sharded exchange contract
+# ---------------------------------------------------------------------------
+def test_keep_sharded_exchange_skips_per_shard_registration():
+    """In keep-sharded mode the exchange holds whole sharded chunks (no
+    per-shard split/spill registration); a later per-partition consumer
+    late-splits via _ensure_split and drains the identical rows."""
+    from spark_rapids_tpu.columnar.host import HostTable
+    from spark_rapids_tpu.exec.mesh import TpuMeshStageExec
+
+    sess = _mesh_session()
+    q = _agg_query(_frame(sess))
+    plan = sess._physical(q.logical, device=True)
+    node = _find(plan, TpuMeshStageExec)
+    assert node is not None
+    got = plan.collect().to_arrow()
+    assert not node._fell_back
+    ex = node.exchange
+    assert ex._shards is None           # nothing was split/registered
+    assert ex._sharded_chunks           # the kept whole-sharded chunks
+    pairs = ex.sharded_chunks()         # still available to mesh consumers
+    assert pairs
+    # each chunk rides with its per-shard input row counts (host ints)
+    for _chunk, shard_rows in pairs:
+        assert len(shard_rows) == ex.num_partitions
+        assert all(isinstance(r, int) for r in shard_rows)
+    # late conversion for a per-partition consumer: splits once, then the
+    # sharded view is gone and the partition drain serves the same rows
+    rows = 0
+    for p in range(ex.num_partitions):
+        rows += sum(t.num_rows for t in ex.execute(p))
+    assert ex._shards is not None
+    assert ex.sharded_chunks() is None
+    total_in = sum(int(c.num_rows) for c in
+                   (HostTable.concat(list(ex.child.execute(p)))
+                    for p in range(ex.child.num_partitions)))
+    assert rows == total_in
+    assert got.num_rows == 9
+
+
+# ---------------------------------------------------------------------------
+# fallback semantics
+# ---------------------------------------------------------------------------
+def test_injected_dispatch_failure_degrades_with_parity():
+    """A classified (INTERNAL) failure in the mesh program quarantines the
+    stage and falls back to the per-partition path — same bytes out."""
+    from spark_rapids_tpu.exec.fallback import fallback_stats
+    from spark_rapids_tpu.exec.mesh import TpuMeshStageExec
+
+    sess = _mesh_session(**{
+        "spark.rapids.tpu.faults.enabled": True,
+        "spark.rapids.tpu.faults.seed": 7,
+        "spark.rapids.tpu.faults.spec": "mesh.dispatch:action=raise",
+    })
+    q = _agg_query(_frame(sess))
+    plan = sess._physical(q.logical, device=True)
+    node = _find(plan, TpuMeshStageExec)
+    assert node is not None
+    got = plan.collect().to_arrow()
+    assert node._fell_back
+    assert fallback_stats()["quarantine_notes"] >= 1
+
+    faults.reset_faults()
+    sess_off = _mesh_session(
+        **{"spark.rapids.tpu.mesh.stageExecution.enabled": False})
+    exp = _agg_query(_frame(sess_off)).collect(device=True)
+    assert_tables_equal(got, exp)
+
+
+def test_unclassified_failure_propagates(monkeypatch):
+    """An error with no XLA status marker -> classify_failure returns
+    None -> the mesh stage must NOT mask it as a degrade (that would
+    hide real bugs behind a silent per-partition re-run)."""
+    from spark_rapids_tpu.exec.mesh import TpuMeshStageExec
+
+    sess = _mesh_session()
+    plan = sess._physical(_agg_query(_frame(sess)).logical, device=True)
+    node = _find(plan, TpuMeshStageExec)
+    assert node is not None
+
+    def boom(chunk):
+        raise ValueError("not an XLA status")
+
+    monkeypatch.setattr(node, "_dispatch_chunk", boom)
+    with pytest.raises(ValueError, match="not an XLA status"):
+        plan.collect()
+    assert not node._fell_back
+
+
+def test_multi_chunk_exchange_hits_the_unshard_boundary():
+    """With chunked exchange streaming (>1 chunk) a final-mode aggregate
+    can't merge per-shard (each shard holds only a chunk's slice of its
+    hash partition) — the stage falls back, with parity."""
+    from spark_rapids_tpu.exec.mesh import TpuMeshStageExec
+
+    chunked = {"spark.rapids.tpu.shuffle.exchangeChunkRows": 256}
+    sess = _mesh_session(**chunked)
+    q = _agg_query(_frame(sess, rows=2048))
+    plan = sess._physical(q.logical, device=True)
+    node = _find(plan, TpuMeshStageExec)
+    assert node is not None
+    got = plan.collect().to_arrow()
+    assert node._fell_back
+
+    sess_off = _mesh_session(**{
+        "spark.rapids.tpu.mesh.stageExecution.enabled": False, **chunked})
+    exp = _agg_query(_frame(sess_off, rows=2048)).collect(device=True)
+    assert_tables_equal(got, exp)
+
+
+# ---------------------------------------------------------------------------
+# observatory phases
+# ---------------------------------------------------------------------------
+def test_mesh_stage_and_compile_phases_in_shuffle_summary(tmp_path):
+    """The one SPMD dispatch notes a mesh_stage phase and the one-time XLA
+    build a compile phase on the ici tier of the query's shuffle
+    summary (distinct columns -> guaranteed program-cache miss)."""
+    from spark_rapids_tpu.shuffle.telemetry import reset_shuffle_telemetry
+    from spark_rapids_tpu.tools.eventlog import load_event_log
+
+    logdir = str(tmp_path / "evl")
+    sess = _mesh_session(**{
+        "spark.rapids.tpu.eventLog.dir": logdir,
+        "spark.rapids.tpu.shuffle.telemetry.enabled": True,
+    })
+    out = _agg_query(_frame(sess, prefix="ph_"), prefix="ph_") \
+        .collect(device=True)
+    assert out.num_rows == 9
+    sess.close()
+    reset_shuffle_telemetry()
+    (path,) = glob.glob(os.path.join(logdir, "*.jsonl"))
+    (q,) = load_event_log(path).queries.values()
+    (ici,) = [t for t in q.shuffle_summary["tiers"] if t["tier"] == "ici"]
+    for phase in ("dispatch", "compile", "mesh_stage"):
+        assert phase in ici["phases"], ici["phases"]
+
+
+# ---------------------------------------------------------------------------
+# slow tier: real TPC-H shapes on the 8-device mesh
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+@pytest.mark.parametrize("query", ["q3", "q5"])
+def test_tpch_parity_on_mesh(query):
+    from spark_rapids_tpu.tools import tpch
+
+    def run(**extra):
+        sess = _mesh_session(**{
+            "spark.rapids.tpu.autoBroadcastJoinThreshold": -1, **extra})
+        tables = tpch.gen_all(0, tiny=True)
+        dfs = tpch.build_dataframes(sess, tables, num_partitions=2)
+        out = getattr(tpch, query)(dfs).collect(device=True)
+        sess.close()
+        return out
+
+    got = run()
+    exp = run(**{"spark.rapids.tpu.mesh.stageExecution.enabled": False})
+    assert got.num_rows > 0
+    assert_tables_equal(got, exp)
